@@ -1,0 +1,91 @@
+"""pytorch_distributed_tpu — a TPU-native distributed training framework.
+
+A ground-up re-design of the capability surface of ``gheur/pytorch-distributed``
+(a CUDA/NCCL multi-GPU recipe collection; see SURVEY.md — the reference tree
+was unavailable, so the capability matrix comes from BASELINE.json:5-12) for
+TPU hardware:
+
+* single-controller SPMD over a ``jax.sharding.Mesh`` instead of
+  process-per-GPU + NCCL process groups;
+* XLA collectives (``psum`` / ``all_gather`` / ``reduce_scatter`` /
+  ``ppermute``) over ICI/DCN instead of NCCL rings;
+* DDP / ZeRO-1 / FSDP expressed as three sharding configurations of one
+  mechanism (NamedSharding of params / optimizer state / batch) instead of
+  three separate wrapper classes with gradient hooks;
+* bf16 compute policy instead of CUDA AMP loss scaling (a
+  GradScaler-compatible API is kept so recipe scripts read like the
+  originals).
+
+Public API is re-exported here so recipes can do::
+
+    import pytorch_distributed_tpu as ptd
+    ptd.init_process_group(backend="ici")
+    mesh = ptd.current_mesh()
+"""
+
+from pytorch_distributed_tpu.runtime.device import (
+    device_count,
+    local_device_count,
+    platform,
+    is_tpu,
+)
+from pytorch_distributed_tpu.runtime.mesh import (
+    MeshSpec,
+    make_mesh,
+    current_mesh,
+    set_current_mesh,
+    mesh_axis_size,
+)
+from pytorch_distributed_tpu.runtime.distributed import (
+    init_process_group,
+    destroy_process_group,
+    is_initialized,
+    get_world_size,
+    get_rank,
+    get_backend,
+    all_reduce,
+    all_gather,
+    reduce_scatter,
+    broadcast,
+    barrier,
+    ReduceOp,
+)
+from pytorch_distributed_tpu.runtime.precision import (
+    Policy,
+    autocast,
+    GradScaler,
+    current_policy,
+)
+from pytorch_distributed_tpu.runtime.prng import RngSeq, seed_all
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "device_count",
+    "local_device_count",
+    "platform",
+    "is_tpu",
+    "MeshSpec",
+    "make_mesh",
+    "current_mesh",
+    "set_current_mesh",
+    "mesh_axis_size",
+    "init_process_group",
+    "destroy_process_group",
+    "is_initialized",
+    "get_world_size",
+    "get_rank",
+    "get_backend",
+    "all_reduce",
+    "all_gather",
+    "reduce_scatter",
+    "broadcast",
+    "barrier",
+    "ReduceOp",
+    "Policy",
+    "autocast",
+    "GradScaler",
+    "current_policy",
+    "RngSeq",
+    "seed_all",
+]

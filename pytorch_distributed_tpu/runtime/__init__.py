@@ -1,0 +1,7 @@
+"""Core runtime: device discovery, mesh construction, collectives facade,
+precision policy, PRNG management.
+
+This is the TPU-native replacement for the layer the reference's recipes get
+from upstream torch: ``torch.distributed`` process groups + NCCL, CUDA device
+placement, and ``torch.cuda.amp`` (capability matrix per BASELINE.json:5).
+"""

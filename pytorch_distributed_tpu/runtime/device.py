@@ -1,0 +1,59 @@
+"""Device discovery.
+
+TPU-native replacement for the reference recipes' ``model.cuda()`` /
+``.to(rank)`` device placement (BASELINE.json:5): under single-controller
+SPMD there is no per-rank device object to move tensors to — placement is a
+property of an array's sharding. This module only answers "what hardware am I
+driving", which the mesh layer turns into a ``jax.sharding.Mesh``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def platform() -> str:
+    """Platform string of the default backend: ``tpu`` | ``cpu`` | ``gpu``."""
+    return jax.devices()[0].platform
+
+
+def is_tpu() -> bool:
+    return platform() == "tpu"
+
+
+def device_count() -> int:
+    """Total number of addressable devices across all hosts."""
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    """Devices attached to this host (== device_count on single host)."""
+    return jax.local_device_count()
+
+
+def process_index() -> int:
+    """Index of this controller process (0 on single host)."""
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+@functools.lru_cache(maxsize=None)
+def device_kind() -> str:
+    """Hardware name, e.g. ``TPU v5 lite`` — useful for logging/benchmarks."""
+    return jax.devices()[0].device_kind
+
+
+def memory_stats() -> dict:
+    """Per-device memory stats where the backend exposes them (TPU does)."""
+    stats = {}
+    for d in jax.local_devices():
+        try:
+            stats[str(d)] = d.memory_stats()
+        except Exception:  # pragma: no cover - backend-dependent
+            stats[str(d)] = None
+    return stats

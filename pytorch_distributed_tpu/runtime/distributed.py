@@ -1,0 +1,266 @@
+"""Process-group-shaped facade over XLA collectives.
+
+The reference's recipes call ``torch.distributed.init_process_group('nccl')``
+then use rank-centric collectives (BASELINE.json:5). Under single-controller
+SPMD there are no ranks — one Python process drives every chip, and
+collectives are compiler-inserted ops over the mesh. This module keeps the
+*texture* of that API so recipe scripts read like the originals, with honest
+single-controller semantics:
+
+* ``init_process_group`` builds the device mesh ("the world") and picks a
+  backend: ``"ici"`` — XLA collectives over ICI/DCN on TPU (the NCCL
+  equivalent), ``"gloo"``/``"cpu"`` — the same XLA collectives on host CPU
+  devices (smoke-test path, matching the reference's gloo recipe,
+  BASELINE.json:7).
+* Eager collectives (``all_reduce`` & co) take an array whose leading
+  dimension is the participant axis — "each participant's tensor" — and
+  reduce/gather across it on-device via ``shard_map``. Inside a jitted
+  step you don't call these: you call ``jax.lax.psum`` et al. directly (or
+  let sharding propagation insert them).
+* ``get_rank()`` is the controller process index (0 on a single host) —
+  used by recipes only to gate logging/checkpointing, which is exactly what
+  it still means here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from pytorch_distributed_tpu.runtime import device as _device
+from pytorch_distributed_tpu.runtime import mesh as _mesh
+
+
+class ReduceOp(enum.Enum):
+    SUM = "sum"
+    AVG = "avg"
+    MAX = "max"
+    MIN = "min"
+    PRODUCT = "product"
+
+
+@dataclasses.dataclass
+class ProcessGroup:
+    mesh: Mesh
+    backend: str
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(list(self.mesh.shape.values())))
+
+
+_GROUP: Optional[ProcessGroup] = None
+
+_BACKENDS = ("ici", "cpu")
+
+
+def init_process_group(
+    backend: Optional[str] = None,
+    *,
+    mesh_spec: Optional[_mesh.MeshSpec] = None,
+    world_size: Optional[int] = None,
+) -> ProcessGroup:
+    """Create the global "world": a mesh over all addressable devices.
+
+    ``backend=None`` auto-selects ``"ici"`` on TPU and ``"cpu"`` otherwise.
+    ``world_size`` may restrict to the first N devices (smoke tests).
+    """
+    global _GROUP
+    if backend is None:
+        backend = "ici" if _device.is_tpu() else "cpu"
+    if backend in ("nccl", "xla"):
+        # Reference recipes say init_process_group('nccl') (BASELINE.json:5)
+        # and the torch-xla port spelling is 'xla'; the TPU equivalent of
+        # both fast paths is XLA collectives over ICI.
+        backend = "ici" if _device.is_tpu() else "cpu"
+    elif backend == "gloo":
+        backend = "cpu"
+    if backend not in _BACKENDS:
+        raise ValueError(f"Unknown backend {backend!r}; expected one of {_BACKENDS}")
+    if backend == "ici" and not _device.is_tpu():
+        raise RuntimeError(
+            "backend='ici' requires TPU devices; use 'cpu' (gloo-equivalent) "
+            "for the host smoke path"
+        )
+    devices = jax.devices()
+    if world_size is not None:
+        if world_size > len(devices):
+            raise ValueError(f"world_size {world_size} > {len(devices)} devices")
+        devices = devices[:world_size]
+    mesh = _mesh.make_mesh(mesh_spec, devices=devices)
+    _GROUP = ProcessGroup(mesh=mesh, backend=backend)
+    return _GROUP
+
+
+def destroy_process_group() -> None:
+    global _GROUP
+    _GROUP = None
+    _mesh.set_current_mesh(None)
+    _collective.cache_clear()
+
+
+def is_initialized() -> bool:
+    return _GROUP is not None
+
+
+def _group() -> ProcessGroup:
+    if _GROUP is None:
+        init_process_group()
+    return _GROUP  # type: ignore[return-value]
+
+
+def get_world_size() -> int:
+    """Total devices in the world — the SPMD analogue of nranks."""
+    return _group().size
+
+
+def get_rank() -> int:
+    """Controller process index; gates logging/checkpoint like rank==0."""
+    return _device.process_index()
+
+
+def get_backend() -> str:
+    return _group().backend
+
+
+# --------------------------------------------------------------------------
+# Eager collectives.
+#
+# Convention: the input's leading dimension indexes participants (size must
+# equal the product of the mesh axes being reduced over). This is the
+# single-controller translation of "every rank passes its tensor".
+# --------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=256)
+def _collective(kind: str, op: ReduceOp, axes: tuple, mesh: Mesh):
+    in_spec = P(axes)
+
+    def reduce_fn(v):  # v: this participant's tensor (leading dim stripped)
+        if op is ReduceOp.SUM:
+            return lax.psum(v, axes)
+        if op is ReduceOp.AVG:
+            return lax.pmean(v, axes)
+        if op is ReduceOp.MAX:
+            return lax.pmax(v, axes)
+        if op is ReduceOp.MIN:
+            return lax.pmin(v, axes)
+        if op is ReduceOp.PRODUCT:
+            g = lax.all_gather(v, axes)  # [participants, ...]
+            return jnp.prod(g, axis=0)
+        raise ValueError(op)
+
+    if kind == "all_reduce":
+
+        def f(x):  # x: [1, ...] per-shard slice of the participant dim
+            return reduce_fn(x[0])
+
+        out_spec = P()
+    elif kind == "all_gather":
+
+        def f(x):
+            return lax.all_gather(x, axes, tiled=True)
+
+        out_spec = P()
+    elif kind == "reduce_scatter":
+
+        def f(x):
+            # x per-shard: [1, participants * chunk, ...]; sum across
+            # participants, each keeps its chunk -> global result is the
+            # reduced vector, sharded over the axis.
+            return lax.psum_scatter(x[0], axes, scatter_dimension=0, tiled=True)
+
+        out_spec = P(axes)
+    else:
+        raise ValueError(kind)
+
+    fn = shard_map(
+        f, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec, check_vma=False
+    )
+    return jax.jit(fn)
+
+
+def _participant_axes(axis) -> tuple:
+    if axis is None:
+        return tuple(a for a in _mesh.AXES)
+    if isinstance(axis, str):
+        return (axis,)
+    return tuple(axis)
+
+
+def _check_leading(x, axes, mesh) -> int:
+    size = int(np.prod([mesh.shape[a] for a in axes]))
+    if x.shape[0] != size:
+        raise ValueError(
+            f"leading dim {x.shape[0]} must equal participant count {size} "
+            f"for axes {axes}"
+        )
+    return size
+
+
+def all_reduce(x, op: ReduceOp = ReduceOp.SUM, *, axis=None):
+    """Reduce across the leading (participant) dim; returns shape x[0].
+
+    ``axis=None`` reduces over the whole mesh.
+    """
+    g = _group()
+    axes = _participant_axes(axis)
+    x = jnp.asarray(x)
+    _check_leading(x, axes, g.mesh)
+    fn = _collective("all_reduce", op, axes, g.mesh)
+    return fn(jax.device_put(x, NamedSharding(g.mesh, P(axes))))
+
+
+def all_gather(x, *, axis=None):
+    """Gather participant slices; identity values, replicated layout."""
+    g = _group()
+    axes = _participant_axes(axis)
+    x = jnp.asarray(x)
+    _check_leading(x, axes, g.mesh)
+    fn = _collective("all_gather", ReduceOp.SUM, axes, g.mesh)
+    return fn(jax.device_put(x, NamedSharding(g.mesh, P(axes))))
+
+
+def reduce_scatter(x, op: ReduceOp = ReduceOp.SUM, *, axis=None):
+    """Reduce across participants, scatter chunks of dim 1 back over them.
+
+    Input: [participants, participants * chunk, ...] — returns the
+    reduced array of shape [participants * chunk, ...], sharded over the axis.
+    """
+    if op is not ReduceOp.SUM:
+        raise NotImplementedError("reduce_scatter supports SUM")
+    g = _group()
+    axes = _participant_axes(axis)
+    x = jnp.asarray(x)
+    _check_leading(x, axes, g.mesh)
+    fn = _collective("reduce_scatter", op, axes, g.mesh)
+    return fn(jax.device_put(x, NamedSharding(g.mesh, P(axes))))
+
+
+def broadcast(x, src: int = 0, *, axis=None):
+    """Replicate participant ``src``'s slice to everyone (shape x[0])."""
+    g = _group()
+    axes = _participant_axes(axis)
+    x = jnp.asarray(x)
+    size = _check_leading(x, axes, g.mesh)
+    if not 0 <= src < size:
+        raise ValueError(f"src {src} out of range for {size} participants")
+    return jax.device_put(x[src], NamedSharding(g.mesh, P()))
+
+
+def barrier() -> None:
+    """Synchronize: run a whole-mesh psum and block on the result."""
+    g = _group()
+    n = g.size
+    x = jnp.ones((n,), jnp.int32)
+    out = all_reduce(x.reshape(n, 1), ReduceOp.SUM)
+    jax.block_until_ready(out)

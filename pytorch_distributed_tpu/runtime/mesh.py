@@ -1,0 +1,137 @@
+"""Mesh construction — the TPU-native replacement for process-group "world"
+setup.
+
+Where the reference's recipes build a world of N one-GPU processes
+(``torchrun`` / ``mp.spawn`` + ``init_process_group('nccl')``,
+BASELINE.json:5), a TPU framework builds ONE logical device mesh and lets
+XLA place collectives over ICI/DCN. All parallelism strategies in
+``pytorch_distributed_tpu.parallel`` are expressed against the named axes of
+this mesh:
+
+=========  =====================================================
+axis       meaning
+=========  =====================================================
+``dp``     data parallel (batch sharding; DDP / ZeRO-1 gradient axis)
+``fsdp``   fully-sharded data parallel (params + batch sharded)
+``tp``     tensor/model parallel (weight matrices sharded)
+``sp``     sequence/context parallel (ring attention axis)
+``ep``     expert parallel (MoE experts sharded)
+=========  =====================================================
+
+Axes of size 1 are kept in the mesh so PartitionSpecs mentioning them are
+always valid; XLA elides the no-op collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order. dp outermost, tp innermost: tensor-parallel
+# collectives are per-layer and latency-bound, so they should ride the
+# fastest (innermost/ICI-adjacent) axis; dp allreduce happens once per step
+# and tolerates the slower outer axis (DCN on multi-pod).
+AXES: Tuple[str, ...] = ("dp", "fsdp", "ep", "sp", "tp")
+
+_CURRENT_MESH: Optional[Mesh] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Named axis sizes. ``-1`` on at most one axis means "absorb the
+    remaining devices" (like a reshape wildcard)."""
+
+    dp: int = -1
+    fsdp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def sizes(self) -> Tuple[int, ...]:
+        return tuple(getattr(self, a) for a in AXES)
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        """Fill in the -1 wildcard so the product equals ``n_devices``."""
+        sizes = list(self.sizes())
+        wild = [i for i, s in enumerate(sizes) if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"At most one -1 axis allowed, got spec {self}")
+        fixed = math.prod(s for s in sizes if s != -1)
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product "
+                    f"{fixed} (spec {self})"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"MeshSpec {self} wants {fixed} devices, have {n_devices}"
+            )
+        return MeshSpec(**dict(zip(AXES, sizes)))
+
+
+def make_mesh(
+    spec: MeshSpec | None = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    set_current: bool = True,
+) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` over all (or the given) devices.
+
+    Uses ``mesh_utils.create_device_mesh`` on real hardware so axis
+    adjacency maps onto the physical ICI torus; falls back to a plain
+    reshape for CPU/virtual devices.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    spec = (spec or MeshSpec()).resolve(len(devices))
+    shape = spec.sizes()
+    if devices[0].platform == "tpu" and len(devices) > 1:
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+        except (ValueError, NotImplementedError) as e:
+            # A flat reshape still works but loses ICI adjacency — tp
+            # collectives may cross slow links. Loud, not silent.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "create_device_mesh failed (%s); falling back to flat reshape "
+                "— mesh axes will not follow the physical ICI torus", e
+            )
+            dev_array = np.asarray(devices).reshape(shape)
+    else:
+        dev_array = np.asarray(devices).reshape(shape)
+    mesh = Mesh(dev_array, AXES)
+    if set_current:
+        set_current_mesh(mesh)
+    return mesh
+
+
+def set_current_mesh(mesh: Optional[Mesh]) -> None:
+    global _CURRENT_MESH
+    _CURRENT_MESH = mesh
+
+
+def current_mesh() -> Mesh:
+    """The process-wide mesh, creating a default (pure-dp) one on demand."""
+    global _CURRENT_MESH
+    if _CURRENT_MESH is None:
+        _CURRENT_MESH = make_mesh(set_current=False)
+    return _CURRENT_MESH
+
+
+def mesh_axis_size(axis: str, mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or current_mesh()
+    return mesh.shape[axis]
+
+
+def data_axes() -> Tuple[str, ...]:
+    """Axes over which the global batch is sharded (dp and fsdp)."""
+    return ("dp", "fsdp")

@@ -1,0 +1,56 @@
+"""Deterministic PRNG management.
+
+The reference's recipes seed torch/numpy per process and rely on
+per-rank offsets. Under single-controller SPMD there is one logical
+program, so randomness is a single key tree: a base seed, folded with
+stable integer tags (step number, purpose) — never Python-side RNG state
+that could drift from the compiled program.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import jax
+import numpy as np
+
+_BASE_KEY: Optional[jax.Array] = None
+
+
+def seed_all(seed: int) -> None:
+    """Set the process-wide base key (and numpy, for host-side shuffles)."""
+    global _BASE_KEY
+    _BASE_KEY = jax.random.key(seed)
+    np.random.seed(seed % (2**32))
+
+
+def base_key() -> jax.Array:
+    global _BASE_KEY
+    if _BASE_KEY is None:
+        seed_all(0)
+    return _BASE_KEY  # type: ignore[return-value]
+
+
+def key_for(step: int, tag: int = 0) -> jax.Array:
+    """Stable per-(step, tag) key: fold_in twice, no sequential state."""
+    return jax.random.fold_in(jax.random.fold_in(base_key(), step), tag)
+
+
+class RngSeq:
+    """Stateful convenience for eager call sites (init, data shuffling).
+
+    Inside jitted code pass explicit keys (``key_for``) instead — hidden
+    state cannot cross a trace boundary.
+    """
+
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.key(seed)
+
+    def next(self, n: int = 1):
+        keys = jax.random.split(self._key, n + 1)
+        self._key = keys[0]
+        return keys[1] if n == 1 else keys[1:]
+
+    def __iter__(self) -> Iterator[jax.Array]:
+        while True:
+            yield self.next()

@@ -1,0 +1,172 @@
+"""Core runtime tests: mesh construction, collectives facade, precision, PRNG."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import pytorch_distributed_tpu as ptd
+from pytorch_distributed_tpu.runtime.mesh import AXES, MeshSpec, make_mesh
+
+
+class TestMesh:
+    def test_eight_cpu_devices(self):
+        assert jax.device_count() == 8
+        assert ptd.platform() == "cpu"
+
+    def test_default_spec_all_dp(self):
+        mesh = make_mesh()
+        assert mesh.shape["dp"] == 8
+        assert all(mesh.shape[a] == 1 for a in AXES if a != "dp")
+
+    def test_wildcard_resolution(self):
+        spec = MeshSpec(dp=-1, tp=4).resolve(8)
+        assert spec.dp == 2 and spec.tp == 4
+
+    def test_explicit_shape(self):
+        mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+        assert mesh.shape["dp"] == 2
+        assert mesh.shape["fsdp"] == 2
+        assert mesh.shape["tp"] == 2
+
+    def test_bad_shape_raises(self):
+        with pytest.raises(ValueError):
+            MeshSpec(dp=3, tp=3).resolve(8)
+        with pytest.raises(ValueError):
+            MeshSpec(dp=-1, fsdp=-1).resolve(8)
+
+    def test_current_mesh_roundtrip(self):
+        mesh = make_mesh(MeshSpec(dp=4, tp=2))
+        assert ptd.current_mesh() is mesh
+        assert ptd.mesh_axis_size("tp") == 2
+
+
+class TestProcessGroupFacade:
+    def test_init_defaults_cpu_backend(self):
+        g = ptd.init_process_group()
+        assert g.backend == "cpu"
+        assert ptd.get_world_size() == 8
+        assert ptd.get_rank() == 0
+        assert ptd.is_initialized()
+
+    def test_ici_requires_tpu(self):
+        with pytest.raises(RuntimeError):
+            ptd.init_process_group("ici")
+
+    def test_world_size_restriction(self):
+        g = ptd.init_process_group(world_size=4)
+        assert g.size == 4
+
+    def test_all_reduce_sum(self):
+        ptd.init_process_group()
+        x = np.arange(8, dtype=np.float32).reshape(8, 1) + 1.0
+        out = ptd.all_reduce(x)
+        np.testing.assert_allclose(np.asarray(out), [36.0])
+
+    def test_all_reduce_ops(self):
+        ptd.init_process_group()
+        x = np.arange(1, 9, dtype=np.float32).reshape(8, 1)
+        assert np.asarray(ptd.all_reduce(x, ptd.ReduceOp.AVG))[0] == pytest.approx(4.5)
+        assert np.asarray(ptd.all_reduce(x, ptd.ReduceOp.MAX))[0] == 8.0
+        assert np.asarray(ptd.all_reduce(x, ptd.ReduceOp.MIN))[0] == 1.0
+        x2 = np.full((8, 1), 2.0, np.float32)
+        assert np.asarray(ptd.all_reduce(x2, ptd.ReduceOp.PRODUCT))[0] == 256.0
+
+    def test_all_reduce_matrix_payload(self):
+        ptd.init_process_group()
+        x = np.random.default_rng(1).normal(size=(8, 4, 3)).astype(np.float32)
+        out = ptd.all_reduce(x)
+        np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=1e-5)
+
+    def test_all_gather_identity(self):
+        ptd.init_process_group()
+        x = np.arange(16, dtype=np.float32).reshape(8, 2)
+        out = ptd.all_gather(x)
+        np.testing.assert_allclose(np.asarray(out), x)
+
+    def test_broadcast(self):
+        ptd.init_process_group()
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        out = ptd.broadcast(x, src=3)
+        np.testing.assert_allclose(np.asarray(out), [3.0])
+
+    def test_reduce_scatter(self):
+        ptd.init_process_group()
+        # 8 participants each contribute a (8*2,) vector; result: summed,
+        # length-16, sharded over dp.
+        x = np.ones((8, 16), np.float32) * np.arange(8, dtype=np.float32)[:, None]
+        out = ptd.reduce_scatter(x)
+        np.testing.assert_allclose(np.asarray(out), np.full((16,), 28.0))
+
+    def test_leading_dim_mismatch_raises(self):
+        ptd.init_process_group()
+        with pytest.raises(ValueError):
+            ptd.all_reduce(np.ones((3, 1), np.float32))
+
+    def test_barrier(self):
+        ptd.init_process_group()
+        ptd.barrier()  # just must not hang/raise
+
+    def test_subaxis_collective(self):
+        ptd.init_process_group(mesh_spec=MeshSpec(dp=4, tp=2))
+        x = np.arange(4, dtype=np.float32).reshape(4, 1)
+        out = ptd.all_reduce(x, axis="dp")
+        np.testing.assert_allclose(np.asarray(out), [6.0])
+
+
+class TestPrecision:
+    def test_default_policy(self):
+        p = ptd.current_policy()
+        assert p.compute_dtype == jnp.bfloat16
+        assert p.param_dtype == jnp.float32
+
+    def test_autocast_context(self):
+        with ptd.autocast(dtype=jnp.float16) as p:
+            assert ptd.current_policy().compute_dtype == jnp.float16
+        assert ptd.current_policy().compute_dtype == jnp.bfloat16
+        with ptd.autocast(enabled=False):
+            assert ptd.current_policy().compute_dtype == jnp.float32
+
+    def test_policy_casting_skips_ints(self):
+        p = ptd.Policy()
+        tree = {"w": jnp.ones((2,), jnp.float32), "i": jnp.ones((2,), jnp.int32)}
+        out = p.cast_to_compute(tree)
+        assert out["w"].dtype == jnp.bfloat16
+        assert out["i"].dtype == jnp.int32
+
+    def test_gradscaler_bf16_noop(self):
+        scaler = ptd.GradScaler()
+        assert scaler.init_state() is None
+        loss = jnp.float32(3.0)
+        assert scaler.scale_value(loss, None) == loss
+        state, ok = scaler.functional_update({"g": jnp.ones(2)}, None)
+        assert state is None and bool(ok)
+
+    def test_gradscaler_fp16_dynamic(self):
+        scaler = ptd.GradScaler(init_scale=4.0, dtype=jnp.float16, growth_interval=1)
+        st = scaler.init_state()
+        assert float(st.scale) == 4.0
+        # finite grads -> growth (interval 1)
+        st2, ok = scaler.functional_update({"g": jnp.ones(2)}, st)
+        assert bool(ok) and float(st2.scale) == 8.0
+        # inf grads -> backoff, step skipped
+        st3, ok = scaler.functional_update({"g": jnp.array([jnp.inf, 1.0])}, st2)
+        assert not bool(ok) and float(st3.scale) == 4.0
+        # unscale divides
+        g = scaler.unscale_grads({"g": jnp.full((2,), 8.0)}, st2)
+        np.testing.assert_allclose(np.asarray(g["g"]), [1.0, 1.0])
+
+
+class TestPrng:
+    def test_key_for_deterministic(self):
+        ptd.seed_all(123)
+        k1 = ptd.runtime.prng.key_for(5, 1)
+        k2 = ptd.runtime.prng.key_for(5, 1)
+        assert jnp.array_equal(jax.random.key_data(k1), jax.random.key_data(k2))
+        k3 = ptd.runtime.prng.key_for(6, 1)
+        assert not jnp.array_equal(jax.random.key_data(k1), jax.random.key_data(k3))
+
+    def test_rngseq_advances(self):
+        seq = ptd.RngSeq(0)
+        a, b = seq.next(), seq.next()
+        assert not jnp.array_equal(jax.random.key_data(a), jax.random.key_data(b))
