@@ -1,0 +1,128 @@
+"""Datasets: array-backed, synthetic, and CIFAR-10 from local files.
+
+The recipe matrix needs CIFAR-10, ImageNet, and text corpora
+(BASELINE.json:7-11). This environment has no network, so every dataset
+has a deterministic synthetic stand-in with the real shapes/dtypes; real
+CIFAR-10 is loaded when its standard python-batch files exist on disk.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+class ArrayDataset:
+    """Dict-of-arrays dataset; leading dim indexes samples."""
+
+    def __init__(self, **arrays: np.ndarray):
+        if not arrays:
+            raise ValueError("ArrayDataset needs at least one array")
+        lengths = {k: len(v) for k, v in arrays.items()}
+        if len(set(lengths.values())) != 1:
+            raise ValueError(f"Mismatched lengths: {lengths}")
+        self.arrays = arrays
+        self._len = next(iter(lengths.values()))
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __getitem__(self, i) -> Dict[str, np.ndarray]:
+        return {k: v[i] for k, v in self.arrays.items()}
+
+
+class SyntheticImageDataset:
+    """Deterministic random images+labels with real-recipe shapes.
+
+    Index-addressable with stable per-index content (hash-seeded), so
+    distributed order tests and resume tests behave like a real dataset.
+    """
+
+    def __init__(
+        self,
+        n: int = 50_000,
+        image_shape: Tuple[int, int, int] = (32, 32, 3),  # NHWC for TPU
+        num_classes: int = 10,
+        seed: int = 0,
+    ):
+        self.n = n
+        self.image_shape = image_shape
+        self.num_classes = num_classes
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __getitem__(self, i):
+        # batch assembly lives in the loader's _default_fetch fallback
+        i = int(i)
+        if not 0 <= i < self.n:
+            raise IndexError(i)
+        g = np.random.default_rng(self.seed * 1_000_003 + i)
+        return {
+            "image": g.normal(size=self.image_shape).astype(np.float32),
+            "label": np.int32(g.integers(self.num_classes)),
+        }
+
+
+class SyntheticTextDataset:
+    """Deterministic random token sequences for LM/fine-tune recipes."""
+
+    def __init__(
+        self,
+        n: int = 10_000,
+        seq_len: int = 512,
+        vocab_size: int = 50_257,
+        num_classes: Optional[int] = None,  # set for classification heads
+        seed: int = 0,
+    ):
+        self.n = n
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self.num_classes = num_classes
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __getitem__(self, i):
+        i = int(i)
+        if not 0 <= i < self.n:
+            raise IndexError(i)
+        g = np.random.default_rng(self.seed * 1_000_003 + i)
+        item = {
+            "input_ids": g.integers(
+                self.vocab_size, size=(self.seq_len,), dtype=np.int32
+            )
+        }
+        if self.num_classes is not None:
+            item["label"] = np.int32(g.integers(self.num_classes))
+        return item
+
+
+def load_cifar10(root: str, train: bool = True) -> Optional[ArrayDataset]:
+    """Load CIFAR-10 from the standard ``cifar-10-batches-py`` pickles.
+
+    Returns None when the files aren't on disk (no network to fetch them) —
+    callers fall back to :class:`SyntheticImageDataset` with CIFAR shapes.
+    Images come back NHWC float32 in [0, 1].
+    """
+    base = os.path.join(root, "cifar-10-batches-py")
+    names = [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
+    paths = [os.path.join(base, n) for n in names]
+    if not all(os.path.exists(p) for p in paths):
+        return None
+    images, labels = [], []
+    for p in paths:
+        with open(p, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        images.append(d[b"data"])
+        labels.extend(d[b"labels"])
+    x = np.concatenate(images).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return ArrayDataset(
+        image=(x.astype(np.float32) / 255.0),
+        label=np.asarray(labels, np.int32),
+    )

@@ -1,0 +1,135 @@
+"""Deterministic epoch-seeded samplers.
+
+Semantics match torch's ``DistributedSampler`` (the reference's per-rank
+dataset sharding mechanism, BASELINE.json:5): a permutation seeded by
+``seed + epoch``, padded (or truncated with ``drop_last``) so every
+replica sees the same number of samples, then strided across replicas.
+Determinism is the contract: same (seed, epoch, world) -> same indices,
+so preempted runs resume on identical data order.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, Optional
+
+import numpy as np
+
+from pytorch_distributed_tpu.runtime import device as _device
+
+
+class DistributedSampler:
+    """Per-replica index iterator, torch-shaped.
+
+    In single-controller SPMD the natural "replica" is the *host* (each
+    host feeds its slice of the global batch), so ``num_replicas`` defaults
+    to the process count — not the chip count.
+    """
+
+    def __init__(
+        self,
+        dataset_len: int,
+        num_replicas: Optional[int] = None,
+        rank: Optional[int] = None,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        if num_replicas is None:
+            num_replicas = _device.process_count()
+        if rank is None:
+            rank = _device.process_index()
+        if not 0 <= rank < num_replicas:
+            raise ValueError(f"rank {rank} out of range for {num_replicas} replicas")
+        self.dataset_len = dataset_len
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        if drop_last:
+            # every replica gets exactly this many (0 if len < replicas) —
+            # unequal counts would desync lockstep multi-host feeding
+            self.num_samples = dataset_len // num_replicas
+        else:
+            self.num_samples = math.ceil(dataset_len / num_replicas)
+        self.total_size = self.num_samples * num_replicas
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed the shuffle for a new epoch (same contract as torch)."""
+        self.epoch = epoch
+
+    def _global_indices(self) -> np.ndarray:
+        if self.shuffle:
+            g = np.random.default_rng(self.seed + self.epoch)
+            idx = g.permutation(self.dataset_len)
+        else:
+            idx = np.arange(self.dataset_len)
+        if self.drop_last:
+            idx = idx[: self.total_size]
+        else:
+            pad = self.total_size - len(idx)
+            if pad > 0:
+                reps = math.ceil(pad / max(len(idx), 1))
+                idx = np.concatenate([idx] + [idx] * reps)[: self.total_size]
+        return idx
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self._global_indices()[self.rank :: self.num_replicas].tolist())
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+
+class GlobalBatchSampler:
+    """Yields whole global batches of indices — the SPMD-native sampler.
+
+    One of these per training run replaces world-size many per-rank
+    samplers: the loader materializes the full global batch and the
+    sharding split happens at ``device_put``. Keeps the reference's
+    epoch/seed/drop_last semantics so data order is reproducible.
+    """
+
+    def __init__(
+        self,
+        dataset_len: int,
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+    ):
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got {batch_size}")
+        self.dataset_len = dataset_len
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        if self.shuffle:
+            g = np.random.default_rng(self.seed + self.epoch)
+            idx = g.permutation(self.dataset_len)
+        else:
+            idx = np.arange(self.dataset_len)
+        n_full = len(idx) // self.batch_size
+        for i in range(n_full):
+            yield idx[i * self.batch_size : (i + 1) * self.batch_size]
+        rem = len(idx) - n_full * self.batch_size
+        if rem and not self.drop_last:
+            # pad the tail batch by cyclic wrapping so the batch shape is
+            # static — a ragged final batch would trigger an XLA recompile
+            # (np.resize tiles, covering datasets smaller than one batch).
+            tail = idx[n_full * self.batch_size :]
+            pad = np.resize(idx, self.batch_size - rem)
+            yield np.concatenate([tail, pad])
+
+    def __len__(self) -> int:
+        if self.drop_last:
+            return self.dataset_len // self.batch_size
+        return math.ceil(self.dataset_len / self.batch_size)

@@ -1,0 +1,40 @@
+"""Parallelism strategies.
+
+The reference reaches its parallelism through three distinct torch wrappers —
+``DistributedDataParallel`` (bucketed gradient allreduce),
+``ZeroRedundancyOptimizer`` (ZeRO-1 optimizer-state sharding) and ``FSDP``
+(full param sharding) — each a separate runtime mechanism with its own hooks
+(BASELINE.json:5,10,11). Under XLA SPMD all three are *the same mechanism*:
+a choice of NamedSharding for (params, optimizer state, batch) on the mesh,
+with the compiler inserting the collectives the torch wrappers hand-roll
+(gradient allreduce, per-shard weight update + allgather, per-layer
+allgather/reduce-scatter). This package expresses them exactly that way,
+plus tensor parallelism (free under SPMD) and sequence/context parallelism
+for long-context training.
+"""
+
+from pytorch_distributed_tpu.parallel.sharding import (
+    PartitionRules,
+    infer_sharding,
+    infer_tree_shardings,
+    shard_along,
+    with_sharding_constraint,
+)
+from pytorch_distributed_tpu.parallel.strategies import (
+    Strategy,
+    DataParallel,
+    ZeRO1,
+    FSDP,
+)
+
+__all__ = [
+    "PartitionRules",
+    "infer_sharding",
+    "infer_tree_shardings",
+    "shard_along",
+    "with_sharding_constraint",
+    "Strategy",
+    "DataParallel",
+    "ZeRO1",
+    "FSDP",
+]

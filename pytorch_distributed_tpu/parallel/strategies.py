@@ -1,0 +1,222 @@
+"""DDP / ZeRO-1 / FSDP as sharding configurations of one SPMD mechanism.
+
+Equivalence map to the reference's wrappers (BASELINE.json:5,10,11):
+
+=================  ==========================  =============================
+reference          torch mechanism             here: sharding of
+=================  ==========================  =============================
+DDP                grad hooks + bucketed       params/opt replicated, batch
+                   NCCL allreduce              sharded over dp -> XLA emits
+                                               one fused grad allreduce
+ZeRO-1             ZeroRedundancyOptimizer     + optimizer state sharded
+                   (per-rank shard + param     over dp -> XLA emits
+                   broadcast after step)       reduce-scatter(grads) +
+                                               allgather(updated params)
+                                               ("cross-replica weight
+                                               update sharding",
+                                               PAPERS.md:5)
+FSDP               flat-param shards,          + params sharded over fsdp ->
+                   per-layer allgather /       XLA emits per-use allgather
+                   reduce-scatter hooks        and grad reduce-scatter
+=================  ==========================  =============================
+
+Tensor-parallel rules (model-provided, path-based) compose with any of the
+three: TP-matched tensors keep their TP axes, and FSDP augments them with
+an ``fsdp`` axis on the largest still-unsharded divisible dim.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pytorch_distributed_tpu.parallel.sharding import (
+    PartitionRules,
+    infer_tree_shardings,
+    shard_along,
+)
+from pytorch_distributed_tpu.runtime.mesh import current_mesh, data_axes
+
+
+def _augment_spec_with_axis(spec: P, axis: str, shape, mesh: Mesh) -> P:
+    """Add ``axis`` to the largest unsharded, divisible dim of ``spec``."""
+    size = mesh.shape[axis]
+    if size == 1:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    used = set()
+    for e in entries:
+        for a in (e if isinstance(e, tuple) else (e,)):
+            if a is not None:
+                used.add(a)
+    if axis in used:
+        return spec
+    candidates = [
+        i for i, (e, d) in enumerate(zip(entries, shape))
+        if e is None and d % size == 0 and d >= size
+    ]
+    if not candidates:
+        return spec
+    best = max(candidates, key=lambda i: shape[i])
+    entries[best] = axis
+    return P(*entries)
+
+
+class Strategy:
+    """Base: replicate everything (single-device semantics on any mesh).
+
+    ``extra_rules`` are model-provided tensor-parallel rules; they apply to
+    params (and are mirrored onto same-shaped optimizer-state leaves by
+    shape-matching fallback in subclasses).
+    """
+
+    #: global batch is split over these mesh axes
+    batch_axes: Tuple[str, ...] = data_axes()
+
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        *,
+        extra_rules: Sequence[Tuple[str, object]] = (),
+    ):
+        self.mesh = mesh or current_mesh()
+        self.extra_rules = tuple(extra_rules)
+
+    # -- override points ----------------------------------------------------
+    def _fallback_param_spec(self):
+        return None  # replicate
+
+    def _fallback_opt_spec(self):
+        return None
+
+    def _transform_tp_param_spec(self, spec: P, shape) -> P:
+        return spec
+
+    def _transform_tp_opt_spec(self, spec: P, shape) -> P:
+        return spec
+
+    # -- rule assembly ------------------------------------------------------
+    def param_rules(self) -> PartitionRules:
+        tp = [
+            (pat, self._wrap_tp(spec, self._transform_tp_param_spec))
+            for pat, spec in self.extra_rules
+        ]
+        return PartitionRules(tp + [(".*", self._fallback_param_spec())])
+
+    def opt_rules(self) -> PartitionRules:
+        # Optimizer moments mirror param shapes, and optax state pytrees
+        # embed the param tree, so path-based TP rules still match (paths
+        # end with the param path). Scalars (count, ...) match nothing
+        # divisible and replicate.
+        tp = [
+            (pat, self._wrap_tp(spec, self._transform_tp_opt_spec))
+            for pat, spec in self.extra_rules
+        ]
+        return PartitionRules(tp + [(".*", self._fallback_opt_spec())])
+
+    def _wrap_tp(self, spec, transform):
+        def wrapped(shape, mesh):
+            s = spec(shape, mesh) if callable(spec) else spec
+            if s is None:
+                s = P()
+            return transform(s, shape)
+
+        return wrapped
+
+    # -- placement ----------------------------------------------------------
+    def state_shardings(self, state):
+        """TrainState-of-NamedShardings matching ``state``'s structure."""
+        repl = NamedSharding(self.mesh, P())
+        params = infer_tree_shardings(state.params, self.param_rules(), self.mesh)
+        opt = infer_tree_shardings(state.opt_state, self.opt_rules(), self.mesh)
+        aux = jax.tree_util.tree_map(lambda _: repl, state.batch_stats)
+        scaler = jax.tree_util.tree_map(lambda _: repl, state.scaler_state)
+        return state.replace(
+            step=repl, params=params, opt_state=opt,
+            batch_stats=aux, scaler_state=scaler,
+        )
+
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(self.batch_axes))
+
+    def place(self, state):
+        """device_put the state according to this strategy's shardings."""
+        return jax.device_put(state, self.state_shardings(state))
+
+    def shard_batch(self, batch):
+        """Place a host batch on the mesh, dim 0 split over the data axes."""
+        return jax.device_put(batch, self.batch_sharding())
+
+    def compile(self, step_fn, state, *, donate: bool = True):
+        """jit ``step_fn(state, batch) -> (state, metrics)`` with this
+        strategy's shardings pinned on state in/out (donating the input
+        state buffers, like an in-place optimizer step)."""
+        st_sh = self.state_shardings(state)
+        return jax.jit(
+            step_fn,
+            in_shardings=(st_sh, self.batch_sharding()),
+            out_shardings=(st_sh, None),
+            donate_argnums=(0,) if donate else (),
+        )
+
+    def describe(self) -> str:
+        return (
+            f"{type(self).__name__}(mesh={dict(self.mesh.shape)}, "
+            f"batch_axes={self.batch_axes})"
+        )
+
+
+class DataParallel(Strategy):
+    """DDP equivalent: replicated params/opt, dp-sharded batch.
+
+    The backward's gradient sum over the batch axis becomes a single XLA
+    allreduce over ``dp`` — the compiler-scheduled analogue of DDP's
+    bucketed overlap (BASELINE.json:5); bucketing/overlap is XLA's job.
+    """
+
+
+class ZeRO1(DataParallel):
+    """ZeRO-1: DataParallel + optimizer state sharded over ``dp``.
+
+    The weight update runs on 1/dp-th of the elements per device, then the
+    updated params are (compiler-)allgathered — per-tensor cross-replica
+    weight-update sharding (PAPERS.md:5; reference:
+    ZeroRedundancyOptimizer, BASELINE.json:10).
+    """
+
+    def __init__(self, mesh=None, *, axis="dp", **kw):
+        super().__init__(mesh, **kw)
+        self.axis = axis
+
+    def _fallback_opt_spec(self):
+        return shard_along(self.axis)
+
+    def _transform_tp_opt_spec(self, spec, shape):
+        # TP-sharded moments additionally split over dp where possible;
+        # params stay replicated (that's what makes this ZeRO-1, not FSDP).
+        return _augment_spec_with_axis(spec, self.axis, shape, self.mesh)
+
+
+class FSDP(Strategy):
+    """Fully-sharded: params AND optimizer state sharded over ``fsdp``
+    (+ batch over the data axes). XLA inserts per-use allgather of params
+    and reduce-scatter of grads — the hook-free analogue of torch FSDP's
+    FlatParameter machinery (BASELINE.json:11)."""
+
+    def __init__(self, mesh=None, *, axis="fsdp", **kw):
+        super().__init__(mesh, **kw)
+        self.axis = axis
+
+    def _fallback_param_spec(self):
+        return shard_along(self.axis)
+
+    def _fallback_opt_spec(self):
+        return shard_along(self.axis)
+
+    def _transform_tp_param_spec(self, spec, shape):
+        return _augment_spec_with_axis(spec, self.axis, shape, self.mesh)
+
+    _transform_tp_opt_spec = _transform_tp_param_spec

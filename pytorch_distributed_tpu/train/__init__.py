@@ -1,0 +1,10 @@
+"""Training loop layer: train state, step builders, metrics, checkpointing.
+
+Replaces the reference recipes' torch training scaffolding (optimizer.step
+loops, AMP scaffolding, grad accumulation, torch.save checkpoints —
+BASELINE.json:5,9,10) with a functional, jit-compiled equivalent.
+"""
+
+from pytorch_distributed_tpu.train.train_state import TrainState
+
+__all__ = ["TrainState"]

@@ -1,0 +1,73 @@
+"""Functional train state — the unit the parallelism strategies shard.
+
+One pytree holds everything a step mutates: params, optimizer state, step
+counter, mutable model collections (BatchNorm stats), and fp16 loss-scale
+state. The reference spreads this across module buffers, optimizer
+``state_dict`` and GradScaler internals; collecting it in one pytree is
+what lets DDP/ZeRO-1/FSDP become pure sharding choices and makes
+checkpointing a single tree serialization.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["step", "params", "opt_state", "batch_stats", "scaler_state"],
+    meta_fields=["apply_fn", "tx"],
+)
+@dataclasses.dataclass
+class TrainState:
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+    batch_stats: Any  # None for stat-free models
+    scaler_state: Any  # None unless fp16 dynamic scaling
+    apply_fn: Callable = dataclasses.field(compare=False)
+    tx: optax.GradientTransformation = dataclasses.field(compare=False)
+
+    @classmethod
+    def create(
+        cls,
+        *,
+        apply_fn: Callable,
+        params: Any,
+        tx: optax.GradientTransformation,
+        batch_stats: Any = None,
+        scaler_state: Any = None,
+    ) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            batch_stats=batch_stats,
+            scaler_state=scaler_state,
+            apply_fn=apply_fn,
+            tx=tx,
+        )
+
+    def apply_gradients(self, grads, **updates) -> "TrainState":
+        updates_tx, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates_tx)
+        return dataclasses.replace(
+            self,
+            step=self.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            **updates,
+        )
+
+    def replace(self, **kw) -> "TrainState":
+        return dataclasses.replace(self, **kw)
+
+
+def param_count(state: TrainState) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(state.params))
