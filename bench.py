@@ -1,0 +1,107 @@
+"""Driver benchmark: ResNet-50 synthetic-ImageNet training throughput.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric is the north star (BASELINE.json:2): ResNet-50 ImageNet
+images/sec/chip in the DDP (data-parallel) configuration.
+
+Baseline anchor: no published numbers exist for the reference
+(BASELINE.json:13, BASELINE.md). The target is ">= 0.8x per-chip A100
+images/sec" (BASELINE.json:5); with the widely used A100 ResNet-50
+mixed-precision training figure of ~2500 images/sec/GPU, the target is
+2000 images/sec/chip, and vs_baseline = value / 2000 (so 1.0 == target
+met, higher is better).
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import pytorch_distributed_tpu as ptd
+from pytorch_distributed_tpu.models import ResNet50
+from pytorch_distributed_tpu.parallel import DataParallel
+from pytorch_distributed_tpu.train import (
+    TrainState,
+    build_train_step,
+    classification_loss_fn,
+)
+
+A100_TARGET_IMG_PER_SEC = 2000.0  # 0.8 x ~2500 (A100 mixed-precision RN50)
+
+
+def main():
+    on_tpu = ptd.is_tpu()
+    # TPU: the real benchmark. CPU (no TPU attached): tiny proxy so the
+    # script still completes and the harness contract holds.
+    batch_per_chip = 128 if on_tpu else 8
+    image = 224 if on_tpu else 32
+    # enough iters that the relay's fixed ~65ms fetch RTT amortizes away
+    warmup, iters = (5, 50) if on_tpu else (1, 3)
+
+    ptd.init_process_group()
+    n_chips = ptd.get_world_size()
+    batch = batch_per_chip * n_chips
+
+    model = ResNet50(num_classes=1000)
+    variables = model.init(
+        jax.random.key(0), jnp.zeros((1, image, image, 3)), train=False
+    )
+    state = TrainState.create(
+        apply_fn=model.apply,
+        params=variables["params"],
+        tx=optax.sgd(0.1, momentum=0.9),
+        batch_stats=variables["batch_stats"],
+    )
+    strategy = DataParallel()
+    state = strategy.place(state)
+    step = strategy.compile(
+        build_train_step(classification_loss_fn(model)), state
+    )
+
+    rng = np.random.default_rng(0)
+    host_batch = {
+        "image": rng.normal(size=(batch, image, image, 3)).astype(np.float32),
+        "label": rng.integers(1000, size=(batch,)).astype(np.int32),
+    }
+    dev_batch = strategy.shard_batch(host_batch)
+
+    for _ in range(warmup):
+        state, metrics = step(state, dev_batch)
+    float(metrics["loss"])  # forces the chain; block_until_ready does not
+    # block on the axon relay backend, so timing MUST end with a value fetch
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, dev_batch)
+    final_loss = float(metrics["loss"])  # chained through state: syncs all
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * iters / dt
+    img_per_sec_chip = img_per_sec / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_imagenet_images_per_sec_per_chip",
+                "value": round(img_per_sec_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(img_per_sec_chip / A100_TARGET_IMG_PER_SEC, 4),
+            }
+        )
+    )
+    # context for humans reading round logs (stderr keeps stdout one-line)
+    print(
+        f"# chips={n_chips} platform={ptd.platform()} batch={batch} "
+        f"image={image} step_time={dt / iters * 1e3:.1f}ms "
+        f"loss={final_loss:.3f}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,9 @@
+"""Model zoo: native TPU-first implementations of the reference's recipe
+models (BASELINE.json:6-12) — ResNet-18/50, BERT-base, GPT-2-medium,
+Llama-3-8B. All NHWC / bf16-compute / f32-params by default, written
+against the framework's precision policy and partition-rule system.
+"""
+
+from pytorch_distributed_tpu.models.resnet import ResNet, ResNet18, ResNet50
+
+__all__ = ["ResNet", "ResNet18", "ResNet50"]

@@ -1,0 +1,164 @@
+"""ResNet-18/50 — recipes 1 and 2 of the reference matrix
+(BASELINE.json:7-8: ResNet-18/CIFAR-10 smoke test, ResNet-50/ImageNet DDP).
+
+TPU-first choices:
+
+* NHWC layout (XLA's native conv layout on TPU — NCHW would transpose on
+  every conv) and bf16 compute / f32 params via the precision policy.
+* BatchNorm statistics are computed over the *global* (sharded) batch:
+  under jit the batch-axis mean lowers to a psum over the data axes, i.e.
+  SyncBN semantics. The reference's DDP runs per-GPU local BN; global
+  stats are the SPMD-natural equivalent and match or beat its accuracy.
+* CIFAR stem (3x3, no maxpool) vs ImageNet stem (7x7/2 + maxpool) selected
+  by ``stem``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.runtime.precision import current_policy
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides, name="proj")(
+                residual
+            )
+            residual = self.norm(name="proj_bn")(residual)
+        return self.act(residual + y)
+
+
+class Bottleneck(nn.Module):
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        # zero-init the last BN scale so each block starts as identity —
+        # standard trick for large-batch ResNet training
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides, name="proj")(
+                residual
+            )
+            residual = self.norm(name="proj_bn")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int
+    width: int = 64
+    stem: str = "imagenet"  # or "cifar"
+    dtype: Optional[Any] = None  # default: precision policy compute dtype
+    bn_momentum: float = 0.9
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        policy = current_policy()
+        dtype = self.dtype or policy.compute_dtype
+        conv = functools.partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=dtype,
+            param_dtype=policy.param_dtype,
+            kernel_init=nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+        )
+        norm = functools.partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=self.bn_momentum,
+            epsilon=1e-5,
+            dtype=dtype,
+            param_dtype=policy.param_dtype,
+        )
+        act = nn.relu
+
+        x = x.astype(dtype)
+        if self.stem == "imagenet":
+            x = conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="stem")(x)
+            x = norm(name="stem_bn")(x)
+            x = act(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        elif self.stem == "cifar":
+            x = conv(self.width, (3, 3), name="stem")(x)
+            x = norm(name="stem_bn")(x)
+            x = act(x)
+        else:
+            raise ValueError(f"unknown stem {self.stem!r}")
+
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    filters=self.width * 2**i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                    act=act,
+                    name=f"stage{i + 1}_block{j + 1}",
+                )(x)
+
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(
+            self.num_classes,
+            dtype=dtype,
+            param_dtype=policy.param_dtype,
+            name="head",
+        )(x)
+        return x.astype(policy.output_dtype)
+
+
+def ResNet18(num_classes: int = 10, stem: str = "cifar", **kw) -> ResNet:
+    """Recipe-1 model (BASELINE.json:7): CIFAR smoke-test configuration."""
+    return ResNet(
+        stage_sizes=[2, 2, 2, 2],
+        block_cls=BasicBlock,
+        num_classes=num_classes,
+        stem=stem,
+        **kw,
+    )
+
+
+def ResNet50(num_classes: int = 1000, stem: str = "imagenet", **kw) -> ResNet:
+    """Recipe-2 / north-star model (BASELINE.json:2,8)."""
+    return ResNet(
+        stage_sizes=[3, 4, 6, 3],
+        block_cls=Bottleneck,
+        num_classes=num_classes,
+        stem=stem,
+        **kw,
+    )

@@ -9,26 +9,29 @@ that could drift from the compiled program.
 
 from __future__ import annotations
 
-from typing import Iterator, Optional
+from typing import Iterator
 
 import jax
 import numpy as np
 
-_BASE_KEY: Optional[jax.Array] = None
+_SEED: int = 0
 
 
 def seed_all(seed: int) -> None:
-    """Set the process-wide base key (and numpy, for host-side shuffles)."""
-    global _BASE_KEY
-    _BASE_KEY = jax.random.key(seed)
+    """Set the process-wide base seed (and numpy, for host-side shuffles)."""
+    global _SEED
+    _SEED = int(seed)
     np.random.seed(seed % (2**32))
 
 
 def base_key() -> jax.Array:
-    global _BASE_KEY
-    if _BASE_KEY is None:
-        seed_all(0)
-    return _BASE_KEY  # type: ignore[return-value]
+    """Fresh key from the base seed.
+
+    Built on every call (never cached): a cached key array created while
+    tracing would leak a tracer into global state; a fresh
+    ``jax.random.key(int)`` is constant-folded by jit anyway.
+    """
+    return jax.random.key(_SEED)
 
 
 def key_for(step: int, tag: int = 0) -> jax.Array:

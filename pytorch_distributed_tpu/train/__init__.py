@@ -6,5 +6,35 @@ BASELINE.json:5,9,10) with a functional, jit-compiled equivalent.
 """
 
 from pytorch_distributed_tpu.train.train_state import TrainState
+from pytorch_distributed_tpu.train.trainer import (
+    Trainer,
+    TrainerConfig,
+    build_train_step,
+)
+from pytorch_distributed_tpu.train.losses import (
+    classification_eval_step,
+    classification_loss_fn,
+    cross_entropy,
+    accuracy,
+)
+from pytorch_distributed_tpu.train.checkpoint import (
+    save_checkpoint,
+    restore_checkpoint,
+    checkpoint_exists,
+    checkpoint_step,
+)
 
-__all__ = ["TrainState"]
+__all__ = [
+    "TrainState",
+    "Trainer",
+    "TrainerConfig",
+    "build_train_step",
+    "classification_eval_step",
+    "classification_loss_fn",
+    "cross_entropy",
+    "accuracy",
+    "save_checkpoint",
+    "restore_checkpoint",
+    "checkpoint_exists",
+    "checkpoint_step",
+]

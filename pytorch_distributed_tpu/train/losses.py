@@ -1,0 +1,99 @@
+"""Loss/metric helpers + loss_fn builders for flax modules.
+
+The builders adapt a linen model to the trainer's functional contract
+``loss_fn(params, batch_stats, batch, rng) -> (loss, aux)`` so recipes
+stay as small as the reference's scripts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def cross_entropy(logits, labels, label_smoothing: float = 0.0):
+    """Mean softmax cross-entropy over the batch (f32 regardless of policy)."""
+    logits = logits.astype(jnp.float32)
+    n = logits.shape[-1]
+    if label_smoothing:
+        oh = jax.nn.one_hot(labels, n)
+        oh = oh * (1.0 - label_smoothing) + label_smoothing / n
+        return jnp.mean(optax.softmax_cross_entropy(logits, oh))
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    )
+
+
+def accuracy(logits, labels):
+    return jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+
+
+def classification_loss_fn(
+    model,
+    *,
+    image_key: str = "image",
+    label_key: str = "label",
+    label_smoothing: float = 0.0,
+    weight_decay: float = 0.0,
+) -> Callable:
+    """Trainer-contract loss for image classifiers with BatchNorm state.
+
+    ``weight_decay`` here is classic L2-in-the-loss (the reference recipes'
+    SGD style); for AdamW-style decoupled decay use optax.adamw instead.
+    """
+
+    def loss_fn(params, batch_stats, batch, rng):
+        variables = {"params": params}
+        if batch_stats is not None:
+            variables["batch_stats"] = batch_stats
+            logits, mutated = model.apply(
+                variables,
+                batch[image_key],
+                train=True,
+                mutable=["batch_stats"],
+                rngs={"dropout": rng},
+            )
+            new_stats = mutated["batch_stats"]
+        else:
+            logits = model.apply(
+                variables, batch[image_key], train=True, rngs={"dropout": rng}
+            )
+            new_stats = None
+        loss = cross_entropy(logits, batch[label_key], label_smoothing)
+        if weight_decay:
+            l2 = sum(
+                jnp.sum(jnp.square(p))
+                for p in jax.tree_util.tree_leaves(params)
+                if p.ndim > 1  # decay kernels, not biases/BN scales
+            )
+            loss = loss + 0.5 * weight_decay * l2
+        return loss, {
+            "metrics": {
+                "loss": loss,
+                "accuracy": accuracy(logits, batch[label_key]),
+            },
+            "batch_stats": new_stats,
+        }
+
+    return loss_fn
+
+
+def classification_eval_step(
+    model, *, image_key: str = "image", label_key: str = "label"
+) -> Callable:
+    """``eval_step(state, batch) -> metrics`` using running BN stats."""
+
+    def eval_step(state, batch) -> Dict[str, jax.Array]:
+        variables = {"params": state.params}
+        if state.batch_stats is not None:
+            variables["batch_stats"] = state.batch_stats
+        logits = model.apply(variables, batch[image_key], train=False)
+        return {
+            "loss": cross_entropy(logits, batch[label_key]),
+            "accuracy": accuracy(logits, batch[label_key]),
+        }
+
+    return eval_step

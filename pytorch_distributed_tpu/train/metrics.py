@@ -1,0 +1,51 @@
+"""Step-time / throughput meters — the north-star metrics
+(images/sec/chip and step time, BASELINE.json:2)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+from pytorch_distributed_tpu.runtime import device as _device
+
+
+@dataclasses.dataclass
+class MeterState:
+    step_time: float  # seconds
+    samples_per_sec: float
+
+
+class ScalarMeter:
+    """Running window over step timings; reports per-chip throughput."""
+
+    def __init__(self, window: int = 50):
+        self.window = window
+        self._states: List[MeterState] = []
+
+    def update(self, s: MeterState) -> None:
+        self._states.append(s)
+        if len(self._states) > self.window:
+            self._states.pop(0)
+
+    @property
+    def samples_per_sec(self) -> float:
+        if not self._states:
+            return 0.0
+        return sum(s.samples_per_sec for s in self._states) / len(self._states)
+
+    @property
+    def step_time(self) -> float:
+        if not self._states:
+            return 0.0
+        return sum(s.step_time for s in self._states) / len(self._states)
+
+    @property
+    def samples_per_sec_per_chip(self) -> float:
+        return self.samples_per_sec / max(_device.device_count(), 1)
+
+    def summary(self) -> Dict[str, float]:
+        return {
+            "samples_per_sec": self.samples_per_sec,
+            "samples_per_sec_per_chip": self.samples_per_sec_per_chip,
+            "step_time_ms": self.step_time * 1e3,
+        }

@@ -1,0 +1,298 @@
+"""Train-step builder and epoch-loop Trainer.
+
+Replaces the reference recipes' hot loop (forward / backward / allreduce /
+optimizer.step with optional AMP scaling and grad accumulation,
+BASELINE.json:5,9,10) with one jit-compiled function:
+
+* gradient accumulation is a ``lax.scan`` over microbatches *inside* the
+  step (the reference's ``no_sync()`` dance is unnecessary — there is no
+  per-microbatch allreduce to suppress; the grad average is one collective
+  emitted after the scan),
+* BatchNorm stats thread through the scan carry,
+* fp16 dynamic loss scaling (when a ``GradScaler`` is given) scales inside
+  the grad computation and conditionally skips the optimizer update,
+* the whole step is compiled by the Strategy with state shardings pinned.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.runtime import distributed as dist
+from pytorch_distributed_tpu.runtime.precision import GradScaler
+from pytorch_distributed_tpu.runtime.prng import key_for
+from pytorch_distributed_tpu.train.train_state import TrainState
+from pytorch_distributed_tpu.train.metrics import MeterState, ScalarMeter
+from pytorch_distributed_tpu.utils.logging import get_logger
+
+# loss_fn(params, batch_stats, batch, rng) ->
+#     (loss, {"metrics": {...}, "batch_stats": new_stats_or_None})
+LossFn = Callable[[Any, Any, Any, jax.Array], Tuple[jax.Array, Dict[str, Any]]]
+
+logger = get_logger(__name__)
+
+
+def _split_microbatches(batch, accum_steps: int):
+    """[B, ...] -> [accum, B/accum, ...] on every leaf."""
+
+    def split(x):
+        if x.shape[0] % accum_steps != 0:
+            raise ValueError(
+                f"batch dim {x.shape[0]} not divisible by accum_steps={accum_steps}"
+            )
+        return x.reshape((accum_steps, x.shape[0] // accum_steps) + x.shape[1:])
+
+    return jax.tree_util.tree_map(split, batch)
+
+
+def build_train_step(
+    loss_fn: LossFn,
+    *,
+    accum_steps: int = 1,
+    scaler: Optional[GradScaler] = None,
+) -> Callable[[TrainState, Any], Tuple[TrainState, Dict[str, jax.Array]]]:
+    """Build ``step(state, batch) -> (state, metrics)`` for jit/Strategy.compile.
+
+    ``accum_steps > 1`` splits the (global) batch into microbatches scanned
+    sequentially — the ZeRO-1/GPT-2 recipe shape (BASELINE.json:10) — giving
+    the memory profile of small batches with the optimizer math of the full
+    batch.
+    """
+    scaling = scaler is not None and scaler.enabled
+
+    def grad_fn(params, batch_stats, mb, rng, scaler_state):
+        def scaled_loss(p):
+            loss, aux = loss_fn(p, batch_stats, mb, rng)
+            if scaling:
+                loss = scaler.scale_value(loss, scaler_state)
+            return loss, aux
+
+        (_, aux), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params)
+        if scaling:
+            grads = scaler.unscale_grads(grads, scaler_state)
+        return grads, aux
+
+    def step(state: TrainState, batch):
+        rng = key_for(state.step)
+
+        if accum_steps == 1:
+            grads, aux = grad_fn(
+                state.params, state.batch_stats, batch, rng, state.scaler_state
+            )
+            metrics = dict(aux.get("metrics", {}))
+            new_stats = aux.get("batch_stats", state.batch_stats)
+        else:
+            mbs = _split_microbatches(batch, accum_steps)
+            zero_grads = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+
+            def body(carry, mb):
+                grads_acc, stats, metrics_acc = carry
+                k = jax.random.fold_in(rng, metrics_acc["_i"].astype(jnp.int32))
+                grads, aux = grad_fn(state.params, stats, mb, k, state.scaler_state)
+                grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+                stats = aux.get("batch_stats", stats)
+                m = dict(aux.get("metrics", {}))
+                m["_i"] = metrics_acc["_i"] + 1
+                for key in m:
+                    if key != "_i" and key in metrics_acc:
+                        m[key] = metrics_acc[key] + m[key]
+                return (grads_acc, stats, m), None
+
+            # seed metric accumulators with zeros from a traced first call
+            probe_metrics = {"_i": jnp.zeros((), jnp.float32)}
+            first_mb = jax.tree_util.tree_map(lambda x: x[0], mbs)
+            _, probe_aux = jax.eval_shape(
+                lambda: grad_fn(
+                    state.params, state.batch_stats, first_mb, rng,
+                    state.scaler_state,
+                )
+            )
+            for key, v in probe_aux.get("metrics", {}).items():
+                probe_metrics[key] = jnp.zeros(v.shape, v.dtype)
+
+            (grads_sum, new_stats, metrics_sum), _ = jax.lax.scan(
+                body, (zero_grads, state.batch_stats, probe_metrics), mbs
+            )
+            inv = 1.0 / accum_steps
+            grads = jax.tree_util.tree_map(lambda g: g * inv, grads_sum)
+            metrics = {
+                k: v * inv for k, v in metrics_sum.items() if k != "_i"
+            }
+
+        if scaling:
+            new_scaler_state, grads_ok = scaler.functional_update(
+                grads, state.scaler_state
+            )
+            candidate = state.apply_gradients(
+                grads, batch_stats=new_stats, scaler_state=new_scaler_state
+            )
+            skipped = state.replace(
+                scaler_state=new_scaler_state, step=state.step + 1
+            )
+            new_state = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(grads_ok, a, b), candidate, skipped
+            )
+            metrics["loss_scale"] = new_scaler_state.scale
+            metrics["grads_finite"] = grads_ok.astype(jnp.float32)
+        else:
+            new_state = state.apply_gradients(grads, batch_stats=new_stats)
+
+        return new_state, metrics
+
+    return step
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    epochs: int = 1
+    log_every: int = 50
+    ckpt_dir: Optional[str] = None
+    ckpt_every_steps: Optional[int] = None  # None -> end of epoch only
+    eval_every_epochs: int = 1
+    samples_axis: str = "image"  # batch leaf whose dim0 counts samples
+
+
+class Trainer:
+    """Epoch loop: feed, step, meter, log, checkpoint, eval.
+
+    The reference spreads this boilerplate across each recipe script; here
+    recipes assemble a Trainer from (state, strategy, step, loaders) and
+    keep only model/loss definitions local.
+    """
+
+    def __init__(
+        self,
+        state: TrainState,
+        strategy,
+        train_step,
+        train_loader,
+        *,
+        eval_step=None,
+        eval_loader=None,
+        config: Optional[TrainerConfig] = None,
+    ):
+        self.config = config or TrainerConfig()
+        self.strategy = strategy
+        self.state = strategy.place(state)
+        self.train_step = strategy.compile(train_step, self.state)
+        self.eval_step = (
+            jax.jit(eval_step) if eval_step is not None else None
+        )
+        self.train_loader = train_loader
+        self.eval_loader = eval_loader
+        self.meter = ScalarMeter()
+        self.last_eval_metrics: Dict[str, float] = {}
+        self._first_epoch = 0
+        self._resume_skip_batches = 0
+
+    # -- checkpointing ------------------------------------------------------
+    def save_checkpoint(self, tag: str = "latest") -> Optional[str]:
+        if self.config.ckpt_dir is None or dist.get_rank() != 0:
+            return None
+        from pytorch_distributed_tpu.train.checkpoint import save_checkpoint
+
+        path = save_checkpoint(self.config.ckpt_dir, self.state, tag=tag)
+        logger.info("checkpoint saved: %s (step %d)", path, int(self.state.step))
+        return path
+
+    def restore_checkpoint(self, tag: str = "latest") -> bool:
+        if self.config.ckpt_dir is None:
+            return False
+        from pytorch_distributed_tpu.train.checkpoint import (
+            checkpoint_exists,
+            restore_checkpoint,
+        )
+
+        if not checkpoint_exists(self.config.ckpt_dir, tag):
+            return False
+        self.state = restore_checkpoint(
+            self.config.ckpt_dir,
+            self.state,
+            self.strategy.state_shardings(self.state),
+            tag=tag,
+        )
+        steps_per_epoch = max(len(self.train_loader), 1)
+        step = int(self.state.step)
+        self._first_epoch = step // steps_per_epoch
+        # mid-epoch checkpoint: fast-forward past the batches this epoch
+        # already consumed, so no batch trains twice and total step count
+        # stays epochs * steps_per_epoch (LR schedules depend on it)
+        self._resume_skip_batches = step % steps_per_epoch
+        logger.info(
+            "resumed from step %d (epoch %d, skipping %d batches)",
+            step, self._first_epoch, self._resume_skip_batches,
+        )
+        return True
+
+    # -- loops --------------------------------------------------------------
+    def fit(self) -> TrainState:
+        cfg = self.config
+        for epoch in range(self._first_epoch, cfg.epochs):
+            self.train_loader.set_epoch(epoch)
+            self._train_epoch(epoch)
+            if self.eval_step is not None and (
+                (epoch + 1) % cfg.eval_every_epochs == 0
+            ):
+                self.evaluate(epoch)
+            self.save_checkpoint()
+        return self.state
+
+    def _train_epoch(self, epoch: int) -> None:
+        cfg = self.config
+        t_last = time.perf_counter()
+        steps_since_log = 0
+        skip = self._resume_skip_batches
+        self._resume_skip_batches = 0
+        for batch in self.train_loader:
+            if skip > 0:
+                skip -= 1
+                continue
+            n = self._batch_samples(batch)
+            self.state, metrics = self.train_step(self.state, batch)
+            step = int(self.state.step)
+            steps_since_log += 1
+            if cfg.log_every and step % cfg.log_every == 0:
+                # sync point: pull metrics (blocks on the step's result)
+                metrics = {k: float(v) for k, v in metrics.items()}
+                now = time.perf_counter()
+                dt = (now - t_last) / steps_since_log
+                t_last = now
+                steps_since_log = 0
+                self.meter.update(MeterState(step_time=dt, samples_per_sec=n / dt))
+                logger.info(
+                    "epoch %d step %d %s %.1f samples/s (%.1f ms/step)",
+                    epoch,
+                    step,
+                    " ".join(f"{k}={v:.4f}" for k, v in metrics.items()),
+                    n / dt,
+                    dt * 1e3,
+                )
+            if cfg.ckpt_every_steps and step % cfg.ckpt_every_steps == 0:
+                self.save_checkpoint()
+
+    def evaluate(self, epoch: int) -> Dict[str, float]:
+        sums: Dict[str, float] = {}
+        count = 0
+        for batch in self.eval_loader:
+            metrics = self.eval_step(self.state, batch)
+            n = self._batch_samples(batch)
+            for k, v in metrics.items():
+                sums[k] = sums.get(k, 0.0) + float(v) * n
+            count += n
+        means = {k: v / max(count, 1) for k, v in sums.items()}
+        self.last_eval_metrics = means
+        logger.info(
+            "eval epoch %d: %s",
+            epoch,
+            " ".join(f"{k}={v:.4f}" for k, v in means.items()),
+        )
+        return means
+
+    def _batch_samples(self, batch) -> int:
+        leaves = jax.tree_util.tree_leaves(batch)
+        return int(leaves[0].shape[0]) if leaves else 0

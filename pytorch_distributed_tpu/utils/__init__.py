@@ -1,0 +1,5 @@
+"""Shared utilities: logging, tree helpers."""
+
+from pytorch_distributed_tpu.utils.logging import get_logger, log_rank0
+
+__all__ = ["get_logger", "log_rank0"]

@@ -1,0 +1,43 @@
+"""Rank-0 structured logging.
+
+Single-controller SPMD has one process per host; only the first host
+(process_index 0) should emit training logs — the analogue of the
+reference recipes' ``if rank == 0: print(...)`` gating.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from pytorch_distributed_tpu.runtime import device as _device
+
+_CONFIGURED = False
+
+
+def _configure_root() -> None:
+    global _CONFIGURED
+    if _CONFIGURED:
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+    )
+    root = logging.getLogger("pytorch_distributed_tpu")
+    root.addHandler(handler)
+    root.setLevel(logging.INFO)
+    root.propagate = False
+    _CONFIGURED = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    """Logger that is silent on non-zero hosts."""
+    _configure_root()
+    logger = logging.getLogger(name)
+    if _device.process_index() != 0:
+        logger.setLevel(logging.CRITICAL)
+    return logger
+
+
+def log_rank0(msg: str, *args) -> None:
+    get_logger("pytorch_distributed_tpu").info(msg, *args)

@@ -1,0 +1,82 @@
+"""Model zoo tests (shapes, dtypes, param counts, policy interaction)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import pytorch_distributed_tpu as ptd
+from pytorch_distributed_tpu.models import ResNet18, ResNet50
+from pytorch_distributed_tpu.models.resnet import BasicBlock, ResNet
+
+
+def count_params(tree):
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(tree))
+
+
+class TestResNet:
+    def test_resnet18_param_count(self):
+        # torch resnet18 (CIFAR stem, 10 classes) ~= 11.17M
+        model = ResNet18(num_classes=10, stem="cifar")
+        v = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False)
+        n = count_params(v["params"])
+        assert 11.0e6 < n < 11.4e6, n
+
+    def test_resnet50_param_count(self):
+        # torch resnet50 (1000 classes) ~= 25.56M
+        model = ResNet50()
+        v = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)), train=False)
+        n = count_params(v["params"])
+        assert 25.3e6 < n < 25.8e6, n
+
+    def test_forward_shapes_and_output_dtype(self):
+        model = ResNet18(num_classes=10, stem="cifar")
+        v = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False)
+        x = jnp.zeros((4, 32, 32, 3))
+        logits = model.apply(v, x, train=False)
+        assert logits.shape == (4, 10)
+        assert logits.dtype == jnp.float32  # policy output dtype
+
+    def test_params_f32_compute_bf16(self):
+        model = ResNet18(num_classes=10, stem="cifar")
+        v = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False)
+        kernels = jax.tree_util.tree_leaves(v["params"])
+        assert all(k.dtype == jnp.float32 for k in kernels)
+
+    def test_autocast_full_precision(self):
+        with ptd.autocast(enabled=False):
+            model = ResNet18(num_classes=10, stem="cifar")
+            v = model.init(
+                jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False
+            )
+            logits = model.apply(v, jnp.zeros((2, 32, 32, 3)), train=False)
+        assert logits.dtype == jnp.float32
+
+    def test_train_mode_mutates_stats(self):
+        model = ResNet(
+            stage_sizes=[1], block_cls=BasicBlock, num_classes=4, width=8,
+            stem="cifar",
+        )
+        v = model.init(jax.random.key(0), jnp.zeros((1, 8, 8, 3)), train=False)
+        x = jax.random.normal(jax.random.key(1), (4, 8, 8, 3))
+        _, mutated = model.apply(
+            v, x, train=True, mutable=["batch_stats"]
+        )
+        before = jax.tree_util.tree_leaves(v["batch_stats"])
+        after = jax.tree_util.tree_leaves(mutated["batch_stats"])
+        assert any(
+            not np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(before, after)
+        )
+
+    def test_bad_stem_raises(self):
+        with pytest.raises(ValueError, match="stem"):
+            ResNet18(stem="nope").init(
+                jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False
+            )
+
+    def test_imagenet_stem_downsamples(self):
+        model = ResNet50(num_classes=10)
+        v = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)), train=False)
+        logits = model.apply(v, jnp.zeros((2, 64, 64, 3)), train=False)
+        assert logits.shape == (2, 10)

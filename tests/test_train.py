@@ -1,0 +1,273 @@
+"""Trainer stack tests: step builder (accum, fp16), checkpoint, Trainer loop."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import pytorch_distributed_tpu as ptd
+from pytorch_distributed_tpu.data import ArrayDataset, DataLoader, SyntheticImageDataset
+from pytorch_distributed_tpu.models.resnet import BasicBlock, ResNet
+from pytorch_distributed_tpu.parallel import DataParallel, FSDP
+from pytorch_distributed_tpu.runtime.mesh import MeshSpec, make_mesh
+from pytorch_distributed_tpu.train import (
+    Trainer,
+    TrainerConfig,
+    TrainState,
+    build_train_step,
+    checkpoint_step,
+    classification_eval_step,
+    classification_loss_fn,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+
+def linear_loss_fn(params, batch_stats, batch, rng):
+    loss = jnp.mean((batch["x"] @ params["w"] - batch["y"]) ** 2)
+    return loss, {"metrics": {"loss": loss}, "batch_stats": batch_stats}
+
+
+def linear_state(lr=0.1):
+    return TrainState.create(
+        apply_fn=lambda p, x: x @ p["w"],
+        params={"w": jnp.ones((4, 2))},
+        tx=optax.sgd(lr),
+    )
+
+
+def linear_batch(n=32):
+    rng = np.random.default_rng(0)
+    return {
+        "x": rng.normal(size=(n, 4)).astype(np.float32),
+        "y": rng.normal(size=(n, 2)).astype(np.float32),
+    }
+
+
+@pytest.fixture
+def dp8():
+    make_mesh(MeshSpec(dp=8))
+    return DataParallel()
+
+
+class TestBuildTrainStep:
+    def test_accum_equals_full_batch(self, dp8):
+        batch = linear_batch()
+        s1, s4 = dp8.place(linear_state()), dp8.place(linear_state())
+        step1 = dp8.compile(build_train_step(linear_loss_fn), s1)
+        step4 = dp8.compile(build_train_step(linear_loss_fn, accum_steps=4), s4)
+        n1, m1 = step1(s1, dp8.shard_batch(batch))
+        n4, m4 = step4(s4, dp8.shard_batch(batch))
+        assert m1["loss"] == pytest.approx(float(m4["loss"]), rel=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(n1.params["w"]), np.asarray(n4.params["w"]), rtol=1e-5
+        )
+
+    def test_accum_indivisible_raises(self, dp8):
+        state = dp8.place(linear_state())
+        step = build_train_step(linear_loss_fn, accum_steps=3)
+        with pytest.raises(ValueError, match="not divisible"):
+            dp8.compile(step, state)(state, dp8.shard_batch(linear_batch(32)))
+
+    def test_fp16_scaler_scale_and_skip(self, dp8):
+        scaler = ptd.GradScaler(
+            dtype=jnp.float16, init_scale=8.0, growth_interval=1
+        )
+        state = dp8.place(linear_state().replace(scaler_state=scaler.init_state()))
+        step = dp8.compile(build_train_step(linear_loss_fn, scaler=scaler), state)
+        batch = linear_batch()
+        state, m = step(state, dp8.shard_batch(batch))
+        assert float(m["grads_finite"]) == 1.0
+        assert float(m["loss_scale"]) == 16.0  # grew
+        w_before = np.asarray(state.params["w"])
+        step_before = int(state.step)
+        bad = {"x": np.full((32, 4), np.inf, np.float32), "y": batch["y"]}
+        state, m = step(state, dp8.shard_batch(bad))
+        assert float(m["grads_finite"]) == 0.0
+        assert float(m["loss_scale"]) == 8.0  # backoff
+        np.testing.assert_array_equal(np.asarray(state.params["w"]), w_before)
+        assert int(state.step) == step_before + 1  # iteration still counts
+
+    def test_step_metrics_present(self, dp8):
+        state = dp8.place(linear_state())
+        step = dp8.compile(build_train_step(linear_loss_fn), state)
+        _, m = step(state, dp8.shard_batch(linear_batch()))
+        assert "loss" in m
+
+
+def tiny_resnet():
+    return ResNet(
+        stage_sizes=[1, 1], block_cls=BasicBlock, num_classes=10, width=8,
+        stem="cifar",
+    )
+
+
+def tiny_image_state(model, seed=0):
+    v = model.init(
+        jax.random.key(seed), jnp.zeros((1, 16, 16, 3)), train=False
+    )
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=v["params"],
+        tx=optax.sgd(0.1, momentum=0.9),
+        batch_stats=v["batch_stats"],
+    )
+
+
+class TestTrainerLoop:
+    def test_fit_reduces_loss_and_updates_bn(self, dp8):
+        model = tiny_resnet()
+        state = tiny_image_state(model)
+        ds = SyntheticImageDataset(n=64, image_shape=(16, 16, 3), seed=0)
+        loader = DataLoader(ds, 32, sharding=dp8.batch_sharding())
+        trainer = Trainer(
+            state,
+            dp8,
+            build_train_step(classification_loss_fn(model)),
+            loader,
+            config=TrainerConfig(epochs=2, log_every=0),
+        )
+        bn_before = np.asarray(
+            jax.tree_util.tree_leaves(trainer.state.batch_stats)[0]
+        ).copy()
+        out = trainer.fit()
+        assert int(out.step) == 4
+        bn_after = np.asarray(jax.tree_util.tree_leaves(out.batch_stats)[0])
+        assert not np.array_equal(bn_before, bn_after)  # stats really update
+
+    def test_evaluate_runs(self, dp8):
+        model = tiny_resnet()
+        state = tiny_image_state(model)
+        ds = SyntheticImageDataset(n=32, image_shape=(16, 16, 3), seed=1)
+        loader = DataLoader(ds, 16, shuffle=False, sharding=dp8.batch_sharding())
+        trainer = Trainer(
+            state, dp8, build_train_step(classification_loss_fn(model)), loader,
+            eval_step=classification_eval_step(model), eval_loader=loader,
+            config=TrainerConfig(epochs=1, log_every=0),
+        )
+        metrics = trainer.evaluate(0)
+        assert 0.0 <= metrics["accuracy"] <= 1.0
+
+
+class TestCheckpoint:
+    def test_roundtrip_preserves_state(self, dp8, tmp_path):
+        state = dp8.place(linear_state())
+        step = dp8.compile(build_train_step(linear_loss_fn), state)
+        state, _ = step(state, dp8.shard_batch(linear_batch()))
+        path = save_checkpoint(str(tmp_path), state)
+        assert checkpoint_step(str(tmp_path)) == 1
+        restored = restore_checkpoint(
+            str(tmp_path), linear_state(), dp8.state_shardings(linear_state())
+        )
+        np.testing.assert_allclose(
+            np.asarray(restored.params["w"]), np.asarray(state.params["w"])
+        )
+        assert int(restored.step) == 1
+
+    def test_restore_across_strategies(self, tmp_path):
+        # save under DP, restore under FSDP: the sharded-checkpoint property
+        mesh = make_mesh(MeshSpec(dp=8))
+        dp = DataParallel(mesh)
+        state = dp.place(linear_state())
+        save_checkpoint(str(tmp_path), state)
+        mesh2 = make_mesh(MeshSpec(dp=4, fsdp=2))
+        fsdp = FSDP(mesh2)
+        restored = restore_checkpoint(
+            str(tmp_path), linear_state(), fsdp.state_shardings(linear_state())
+        )
+        np.testing.assert_allclose(
+            np.asarray(restored.params["w"]), np.asarray(state.params["w"])
+        )
+
+    def test_structure_mismatch_raises(self, dp8, tmp_path):
+        save_checkpoint(str(tmp_path), dp8.place(linear_state()))
+        other = TrainState.create(
+            apply_fn=lambda p, x: x,
+            params={"w": jnp.ones((4, 2)), "b": jnp.zeros((2,))},
+            tx=optax.sgd(0.1),
+        )
+        with pytest.raises(ValueError, match="structure mismatch"):
+            restore_checkpoint(str(tmp_path), other)
+
+    def test_shape_mismatch_raises(self, dp8, tmp_path):
+        save_checkpoint(str(tmp_path), dp8.place(linear_state()))
+        other = TrainState.create(
+            apply_fn=lambda p, x: x, params={"w": jnp.ones((5, 2))}, tx=optax.sgd(0.1)
+        )
+        with pytest.raises(ValueError, match="shape"):
+            restore_checkpoint(str(tmp_path), other)
+
+    def test_path_rename_detected(self, dp8, tmp_path):
+        save_checkpoint(str(tmp_path), dp8.place(linear_state()))
+        renamed = TrainState.create(
+            apply_fn=lambda p, x: x,
+            params={"w2": jnp.ones((4, 2))},  # same shape, different name
+            tx=optax.sgd(0.1),
+        )
+        with pytest.raises(ValueError, match="path mismatch"):
+            restore_checkpoint(str(tmp_path), renamed)
+
+    def test_old_checkpoint_survives_overwrite(self, dp8, tmp_path):
+        import os
+
+        state = dp8.place(linear_state())
+        save_checkpoint(str(tmp_path), state)
+        save_checkpoint(str(tmp_path), state)  # second save replaces first
+        assert checkpoint_step(str(tmp_path)) == 0
+        assert not os.path.exists(os.path.join(str(tmp_path), "latest.old"))
+
+    def test_mid_epoch_resume_skips_consumed_batches(self, dp8, tmp_path):
+        # manufacture a preemption: checkpoint at step 3 of a 4-step epoch
+        model = tiny_resnet()
+        state = dp8.place(tiny_image_state(model))
+        step = dp8.compile(
+            build_train_step(classification_loss_fn(model)), state
+        )
+        ds = SyntheticImageDataset(n=128, image_shape=(16, 16, 3), seed=0)
+        loader = DataLoader(ds, 32, sharding=dp8.batch_sharding())
+        loader.set_epoch(0)
+        for i, batch in enumerate(loader):
+            if i == 3:
+                break
+            state, _ = step(state, batch)
+        assert int(state.step) == 3
+        save_checkpoint(str(tmp_path), state)
+
+        t2 = Trainer(
+            tiny_image_state(model), dp8,
+            build_train_step(classification_loss_fn(model)),
+            DataLoader(ds, 32, sharding=dp8.batch_sharding()),
+            config=TrainerConfig(
+                epochs=1, log_every=0, ckpt_dir=str(tmp_path)
+            ),
+        )
+        assert t2.restore_checkpoint()
+        assert t2._resume_skip_batches == 3
+        out = t2.fit()
+        # finishes the epoch with exactly 1 more step: 4 total, not 3+4
+        assert int(out.step) == 4
+
+    def test_trainer_resume(self, dp8, tmp_path):
+        def make_trainer():
+            model = tiny_resnet()
+            state = tiny_image_state(model)
+            ds = SyntheticImageDataset(n=64, image_shape=(16, 16, 3), seed=0)
+            loader = DataLoader(ds, 32, sharding=dp8.batch_sharding())
+            return Trainer(
+                state, dp8, build_train_step(classification_loss_fn(model)),
+                loader,
+                config=TrainerConfig(
+                    epochs=2, log_every=0, ckpt_dir=str(tmp_path)
+                ),
+            )
+
+        t1 = make_trainer()
+        t1.fit()  # 2 epochs x 2 steps
+        assert checkpoint_step(str(tmp_path)) == 4
+
+        t2 = make_trainer()
+        assert t2.restore_checkpoint()
+        assert int(t2.state.step) == 4
+        out = t2.fit()  # resumed at epoch 2 == done; no extra steps
+        assert int(out.step) == 4
