@@ -5,5 +5,35 @@ against the framework's precision policy and partition-rule system.
 """
 
 from pytorch_distributed_tpu.models.resnet import ResNet, ResNet18, ResNet50
+from pytorch_distributed_tpu.models.bert import (
+    BertConfig,
+    BertModel,
+    BertForSequenceClassification,
+    bert_partition_rules,
+)
+from pytorch_distributed_tpu.models.gpt2 import (
+    GPT2Config,
+    GPT2LMHead,
+    gpt2_partition_rules,
+)
+from pytorch_distributed_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    llama_partition_rules,
+)
 
-__all__ = ["ResNet", "ResNet18", "ResNet50"]
+__all__ = [
+    "ResNet",
+    "ResNet18",
+    "ResNet50",
+    "BertConfig",
+    "BertModel",
+    "BertForSequenceClassification",
+    "bert_partition_rules",
+    "GPT2Config",
+    "GPT2LMHead",
+    "gpt2_partition_rules",
+    "LlamaConfig",
+    "LlamaForCausalLM",
+    "llama_partition_rules",
+]

@@ -1,0 +1,209 @@
+"""BERT-base — recipe 3 of the reference matrix (BASELINE.json:9:
+"BERT-base fine-tune, DDP + amp.GradScaler -> XLA bf16").
+
+Classic post-LN encoder. bf16 compute comes from the precision policy
+(the recipe's GradScaler is a no-op in bf16 — see runtime.precision);
+tensor-parallel partition rules ship with the model (column-parallel
+QKV/up, row-parallel out/down — Megatron layout, expressed as sharding
+specs instead of module surgery).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_tpu.ops.attention import dot_product_attention
+from pytorch_distributed_tpu.runtime.precision import current_policy
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30_522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3_072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.1
+    layer_norm_eps: float = 1e-12
+
+    @classmethod
+    def base(cls) -> "BertConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "BertConfig":  # test/smoke configuration
+        return cls(
+            vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
+            intermediate_size=128, max_position_embeddings=128,
+        )
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask, deterministic: bool):
+        cfg = self.config
+        policy = current_policy()
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (cfg.num_heads, cfg.hidden_size // cfg.num_heads),
+            dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype,
+            name=name,
+        )
+        q = dense("query")(x)
+        k = dense("key")(x)
+        v = dense("value")(x)
+        out = dot_product_attention(q, k, v, mask=attention_mask)
+        out = nn.DenseGeneral(
+            cfg.hidden_size,
+            axis=(-2, -1),
+            dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype,
+            name="out",
+        )(out)
+        return nn.Dropout(cfg.dropout_rate)(out, deterministic=deterministic)
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask, deterministic: bool):
+        cfg = self.config
+        policy = current_policy()
+        ln = lambda name: nn.LayerNorm(  # noqa: E731
+            epsilon=cfg.layer_norm_eps,
+            dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype,
+            name=name,
+        )
+        attn_out = BertSelfAttention(cfg, name="attn")(
+            x, attention_mask, deterministic
+        )
+        x = ln("attn_ln")(x + attn_out)
+        h = nn.Dense(
+            cfg.intermediate_size,
+            dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype,
+            name="mlp_up",
+        )(x)
+        h = nn.gelu(h)
+        h = nn.Dense(
+            cfg.hidden_size,
+            dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype,
+            name="mlp_down",
+        )(h)
+        h = nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
+        return ln("mlp_ln")(x + h)
+
+
+class BertModel(nn.Module):
+    """Encoder trunk: returns (sequence_output, pooled_output)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids,
+        attention_mask: Optional[jnp.ndarray] = None,
+        token_type_ids: Optional[jnp.ndarray] = None,
+        *,
+        train: bool = False,
+    ):
+        cfg = self.config
+        policy = current_policy()
+        B, S = input_ids.shape
+        if S > cfg.max_position_embeddings:
+            raise ValueError(
+                f"sequence {S} > max_position_embeddings "
+                f"{cfg.max_position_embeddings}"
+            )
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if attention_mask is None:
+            attention_mask = jnp.ones((B, S), jnp.bool_)
+        attention_mask = attention_mask.astype(jnp.bool_)
+
+        embed = lambda n, num: nn.Embed(  # noqa: E731
+            num, cfg.hidden_size, param_dtype=policy.param_dtype, name=n
+        )
+        x = (
+            embed("word_embeddings", cfg.vocab_size)(input_ids)
+            + embed("position_embeddings", cfg.max_position_embeddings)(
+                jnp.arange(S)[None, :]
+            )
+            + embed("token_type_embeddings", cfg.type_vocab_size)(token_type_ids)
+        )
+        x = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, param_dtype=policy.param_dtype,
+            dtype=policy.compute_dtype, name="embed_ln",
+        )(x)
+        x = nn.Dropout(cfg.dropout_rate)(x, deterministic=not train)
+        x = x.astype(policy.compute_dtype)
+
+        for i in range(cfg.num_layers):
+            x = BertLayer(cfg, name=f"layer{i}")(
+                x, attention_mask, deterministic=not train
+            )
+
+        pooled = nn.tanh(
+            nn.Dense(
+                cfg.hidden_size,
+                dtype=policy.compute_dtype,
+                param_dtype=policy.param_dtype,
+                name="pooler",
+            )(x[:, 0])
+        )
+        return x.astype(policy.output_dtype), pooled.astype(policy.output_dtype)
+
+
+class BertForSequenceClassification(nn.Module):
+    """Recipe-3 fine-tuning head (BASELINE.json:9)."""
+
+    config: BertConfig
+    num_labels: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 *, train: bool = False):
+        policy = current_policy()
+        _, pooled = BertModel(self.config, name="bert")(
+            input_ids, attention_mask, token_type_ids, train=train
+        )
+        pooled = nn.Dropout(self.config.dropout_rate)(
+            pooled.astype(policy.compute_dtype), deterministic=not train
+        )
+        logits = nn.Dense(
+            self.num_labels,
+            dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype,
+            name="classifier",
+        )(pooled)
+        return logits.astype(policy.output_dtype)
+
+
+def bert_partition_rules():
+    """Megatron-style TP: column-parallel QKV/up, row-parallel out/down.
+
+    DenseGeneral QKV kernels have shape [hidden, heads, head_dim]; the
+    heads dim is the column-parallel axis. Embeddings shard the hidden dim.
+    """
+    return [
+        (r"attn/(query|key|value)/kernel", P(None, "tp", None)),
+        (r"attn/(query|key|value)/bias", P("tp", None)),
+        (r"attn/out/kernel", P("tp", None, None)),
+        (r"mlp_up/kernel", P(None, "tp")),
+        (r"mlp_up/bias", P("tp")),
+        (r"mlp_down/kernel", P("tp", None)),
+        (r"_embeddings/embedding", P(None, "tp")),
+    ]

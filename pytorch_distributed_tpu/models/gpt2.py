@@ -1,0 +1,141 @@
+"""GPT-2 — recipe 4 of the reference matrix (BASELINE.json:10:
+"GPT-2-medium, DDP + grad-accum + ZeRO-1").
+
+Pre-LN decoder with learned positions and a weight-tied LM head (logits
+through the transposed token embedding — halves the largest tensor, which
+matters for ZeRO-1 state sharding). Causal masking is closed-form inside
+the fused attention op.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_tpu.ops.attention import dot_product_attention
+from pytorch_distributed_tpu.runtime.precision import current_policy
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50_257
+    n_positions: int = 1_024
+    hidden_size: int = 1_024
+    num_layers: int = 24
+    num_heads: int = 16
+    dropout_rate: float = 0.1
+    layer_norm_eps: float = 1e-5
+
+    @property
+    def intermediate_size(self) -> int:
+        return 4 * self.hidden_size
+
+    @classmethod
+    def medium(cls) -> "GPT2Config":  # the recipe's size (355M params)
+        return cls()
+
+    @classmethod
+    def small(cls) -> "GPT2Config":
+        return cls(hidden_size=768, num_layers=12, num_heads=12)
+
+    @classmethod
+    def tiny(cls) -> "GPT2Config":
+        return cls(
+            vocab_size=512, n_positions=64, hidden_size=64, num_layers=2,
+            num_heads=4,
+        )
+
+
+class GPT2Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool):
+        cfg = self.config
+        policy = current_policy()
+        ln = lambda name: nn.LayerNorm(  # noqa: E731
+            epsilon=cfg.layer_norm_eps, dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype, name=name,
+        )
+        h = ln("ln1")(x)
+        qkv = nn.DenseGeneral(
+            (3, cfg.num_heads, cfg.hidden_size // cfg.num_heads),
+            dtype=policy.compute_dtype, param_dtype=policy.param_dtype,
+            name="attn_qkv",
+        )(h)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = dot_product_attention(q, k, v, causal=True)
+        attn = nn.DenseGeneral(
+            cfg.hidden_size, axis=(-2, -1), dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype, name="attn_out",
+        )(attn)
+        x = x + nn.Dropout(cfg.dropout_rate)(attn, deterministic=deterministic)
+
+        h = ln("ln2")(x)
+        h = nn.Dense(
+            cfg.intermediate_size, dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype, name="mlp_up",
+        )(h)
+        h = nn.gelu(h)
+        h = nn.Dense(
+            cfg.hidden_size, dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype, name="mlp_down",
+        )(h)
+        return x + nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
+
+
+class GPT2LMHead(nn.Module):
+    """Causal LM: returns [B, S, vocab] logits (head tied to wte)."""
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, *, train: bool = False):
+        cfg = self.config
+        policy = current_policy()
+        B, S = input_ids.shape
+        if S > cfg.n_positions:
+            raise ValueError(f"sequence {S} > n_positions {cfg.n_positions}")
+        wte = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, param_dtype=policy.param_dtype,
+            name="wte",
+        )
+        wpe = nn.Embed(
+            cfg.n_positions, cfg.hidden_size, param_dtype=policy.param_dtype,
+            name="wpe",
+        )
+        x = wte(input_ids) + wpe(jnp.arange(S)[None, :])
+        x = nn.Dropout(cfg.dropout_rate)(x, deterministic=not train)
+        x = x.astype(policy.compute_dtype)
+        for i in range(cfg.num_layers):
+            x = GPT2Block(cfg, name=f"block{i}")(x, deterministic=not train)
+        x = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype, name="ln_f",
+        )(x)
+        # tied head in compute dtype (bf16 MXU path for the largest matmul),
+        # f32 accumulation
+        logits = jnp.einsum(
+            "bsd,vd->bsv",
+            x,
+            wte.embedding.astype(policy.compute_dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return logits.astype(policy.output_dtype)
+
+
+def gpt2_partition_rules():
+    """TP rules: qkv kernel [hidden, 3, heads, head_dim] — shard heads."""
+    return [
+        (r"attn_qkv/kernel", P(None, None, "tp", None)),
+        (r"attn_qkv/bias", P(None, "tp", None)),
+        (r"attn_out/kernel", P("tp", None, None)),  # [heads, hd, hidden]
+        (r"mlp_up/kernel", P(None, "tp")),
+        (r"mlp_up/bias", P("tp")),
+        (r"mlp_down/kernel", P("tp", None)),
+        (r"wte/embedding", P(None, "tp")),
+    ]

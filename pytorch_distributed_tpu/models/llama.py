@@ -1,0 +1,142 @@
+"""Llama-3 — recipe 5, the stretch goal (BASELINE.json:11:
+"Llama-3-8B, FSDP full-shard -> XLA SPMD").
+
+Decoder with RMSNorm, rotary positions (theta 500k), grouped-query
+attention (32 q / 8 kv heads at 8B) and SwiGLU MLP. Sequence length is an
+explicit axis everywhere so the sequence-parallel strategies
+(parallel/sequence.py) can shard it; ``positions`` plumb through to RoPE
+for mid-sequence shards.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_tpu.ops.attention import (
+    apply_rope,
+    dot_product_attention,
+    rope_frequencies,
+)
+from pytorch_distributed_tpu.runtime.precision import current_policy
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    hidden_size: int = 4_096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    intermediate_size: int = 14_336
+    max_seq_len: int = 8_192
+    rope_theta: float = 500_000.0
+    rms_eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "LlamaConfig":
+        return cls(
+            vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+            num_kv_heads=2, intermediate_size=128, max_seq_len=128,
+        )
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        policy = current_policy()
+        scale = self.param(
+            "scale", nn.initializers.ones, (x.shape[-1],), policy.param_dtype
+        )
+        x32 = x.astype(jnp.float32)
+        rms = jnp.sqrt(jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + self.eps)
+        return (x32 / rms * scale).astype(x.dtype)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin, positions, deterministic: bool):
+        cfg = self.config
+        policy = current_policy()
+        dense = lambda feats, name, axis=-1: nn.DenseGeneral(  # noqa: E731
+            feats, axis=axis, use_bias=False, dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype, name=name,
+        )
+        h = RMSNorm(cfg.rms_eps, name="attn_norm")(x)
+        q = dense((cfg.num_heads, cfg.head_dim), "q")(h)
+        k = dense((cfg.num_kv_heads, cfg.head_dim), "k")(h)
+        v = dense((cfg.num_kv_heads, cfg.head_dim), "v")(h)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        attn = dot_product_attention(q, k, v, causal=True)
+        attn = dense(cfg.hidden_size, "o", axis=(-2, -1))(attn)
+        x = x + attn
+
+        h = RMSNorm(cfg.rms_eps, name="mlp_norm")(x)
+        gate = dense(cfg.intermediate_size, "gate")(h)
+        up = dense(cfg.intermediate_size, "up")(h)
+        h = nn.silu(gate) * up
+        h = dense(cfg.hidden_size, "down")(h)
+        return x + h
+
+
+class LlamaForCausalLM(nn.Module):
+    """Returns [B, S, vocab] logits. Untied LM head (Llama-3 layout)."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids,
+        positions: Optional[jnp.ndarray] = None,
+        *,
+        train: bool = False,
+    ):
+        cfg = self.config
+        policy = current_policy()
+        B, S = input_ids.shape
+        x = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, param_dtype=policy.param_dtype,
+            name="embed",
+        )(input_ids).astype(policy.compute_dtype)
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        for i in range(cfg.num_layers):
+            x = LlamaBlock(cfg, name=f"layer{i}")(
+                x, cos, sin, positions, deterministic=not train
+            )
+        x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
+        logits = nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype, name="lm_head",
+        )(x)
+        return logits.astype(policy.output_dtype)
+
+
+def llama_partition_rules():
+    """Megatron TP: column-parallel q/k/v/gate/up, row-parallel o/down;
+    embedding sharded on hidden, lm_head kernel on vocab (its dim 1)."""
+    return [
+        (r"/(q|k|v)/kernel", P(None, "tp", None)),
+        (r"/o/kernel", P("tp", None, None)),
+        (r"/(gate|up)/kernel", P(None, "tp")),
+        (r"/down/kernel", P("tp", None)),
+        (r"embed/embedding", P(None, "tp")),
+        (r"lm_head/kernel", P(None, "tp")),
+    ]

@@ -1,0 +1,15 @@
+"""Compute ops: attention (XLA and Pallas paths), rotary embeddings.
+
+The hot ops of the transformer recipes live here, written MXU-first:
+batched einsums in bf16, f32 softmax accumulation, no data-dependent
+shapes. The Pallas flash-attention kernel (ops/pallas/) is selected
+automatically for long sequences on TPU.
+"""
+
+from pytorch_distributed_tpu.ops.attention import (
+    dot_product_attention,
+    apply_rope,
+    rope_frequencies,
+)
+
+__all__ = ["dot_product_attention", "apply_rope", "rope_frequencies"]

@@ -1,0 +1,104 @@
+"""Attention + rotary embeddings, TPU-first.
+
+Design notes:
+
+* Grouped-query attention is computed with the KV-head group kept as an
+  einsum dimension — no ``repeat`` materialization of KV to Q heads
+  (saves HBM bandwidth, the usual TPU bottleneck).
+* Logits/softmax accumulate in f32 while inputs stay bf16 (MXU-native);
+  this is the numerically-safe AMP pattern the reference gets from CUDA
+  autocast's op allowlist.
+* Static shapes and a closed-form causal mask — nothing data-dependent,
+  so XLA can fuse the whole thing.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(
+    head_dim: int, max_seq_len: int, theta: float = 10_000.0
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(cos, sin) tables of shape [max_seq_len, head_dim//2], f32."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)  # [S, D/2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(
+    x: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    positions: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Rotate [B, S, H, D] by position. Tables are gathered at ``positions``
+    (default arange) — pass explicit positions for sequence-parallel shards."""
+    if positions is None:
+        c = cos[: x.shape[1]][None, :, None, :]
+        s = sin[: x.shape[1]][None, :, None, :]
+    else:
+        c = cos[positions][:, :, None, :]
+        s = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+def dot_product_attention(
+    q: jnp.ndarray,  # [B, S, Hq, D]
+    k: jnp.ndarray,  # [B, T, Hkv, D]
+    v: jnp.ndarray,  # [B, T, Hkv, D]
+    *,
+    causal: bool = False,
+    mask: Optional[jnp.ndarray] = None,  # [B, 1|Hq, S, T] or [B, T] padding
+    q_offset: int = 0,
+    softmax_dtype=jnp.float32,
+) -> jnp.ndarray:
+    """MXU-friendly grouped attention; returns [B, S, Hq, D] in q.dtype.
+
+    ``q_offset`` shifts query positions for the causal mask — used by
+    sequence-parallel shards where the local block starts mid-sequence.
+    """
+    B, S, Hq, D = q.shape
+    _, T, Hkv, _ = k.shape
+    if Hq % Hkv != 0:
+        raise ValueError(f"query heads {Hq} not a multiple of kv heads {Hkv}")
+    G = Hq // Hkv
+
+    qg = q.reshape(B, S, Hkv, G, D)
+    scale = 1.0 / math.sqrt(D)
+    # [B, Hkv, G, S, T]; accumulate in f32 on the MXU, not post-cast
+    logits = (
+        jnp.einsum(
+            "bskgd,btkd->bkgst", qg, k, preferred_element_type=softmax_dtype
+        )
+        * scale
+    )
+
+    neg = jnp.finfo(softmax_dtype).min
+    if causal:
+        qpos = jnp.arange(S) + q_offset
+        kpos = jnp.arange(T)
+        causal_mask = qpos[:, None] >= kpos[None, :]  # [S, T]
+        logits = jnp.where(causal_mask[None, None, None], logits, neg)
+    if mask is not None:
+        if mask.ndim == 2:  # [B, T] key padding mask
+            mask = mask[:, None, None, None, :]
+        elif mask.ndim == 4:  # [B, H, S, T] -> group layout
+            h = mask.shape[1]
+            mask = (
+                mask.reshape(B, Hkv, G, S, T)
+                if h == Hq
+                else mask[:, :, None, :, :]
+            )
+        logits = jnp.where(mask, logits, neg)
+
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgst,btkd->bskgd", weights.astype(q.dtype), v)
+    return out.reshape(B, S, Hq, D)

@@ -146,6 +146,17 @@ class Strategy:
         """device_put the state according to this strategy's shardings."""
         return jax.device_put(state, self.state_shardings(state))
 
+    def create_sharded(self, make_state_fn, *args):
+        """Build a state directly onto its shards — no replicated copy ever
+        exists. This is how pod-scale models (Llama-3-8B FSDP,
+        BASELINE.json:11) must initialize: ``make_state_fn`` (e.g.
+        ``lambda key: TrainState.create(... model.init(key, x) ...)``) is
+        traced abstractly, its shardings inferred, then jitted with
+        out_shardings so every device materializes only its own shard."""
+        abstract = jax.eval_shape(make_state_fn, *args)
+        shardings = self.state_shardings(abstract)
+        return jax.jit(make_state_fn, out_shardings=shardings)(*args)
+
     def shard_batch(self, batch):
         """Place a host batch on the mesh, dim 0 split over the data axes."""
         return jax.device_put(batch, self.batch_sharding())

@@ -14,6 +14,8 @@ from pytorch_distributed_tpu.train.trainer import (
 from pytorch_distributed_tpu.train.losses import (
     classification_eval_step,
     classification_loss_fn,
+    causal_lm_loss_fn,
+    text_classification_loss_fn,
     cross_entropy,
     accuracy,
 )
@@ -31,6 +33,8 @@ __all__ = [
     "build_train_step",
     "classification_eval_step",
     "classification_loss_fn",
+    "causal_lm_loss_fn",
+    "text_classification_loss_fn",
     "cross_entropy",
     "accuracy",
     "save_checkpoint",

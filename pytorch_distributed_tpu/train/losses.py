@@ -81,6 +81,56 @@ def classification_loss_fn(
     return loss_fn
 
 
+def causal_lm_loss_fn(
+    model, *, ids_key: str = "input_ids"
+) -> Callable:
+    """Trainer-contract loss for decoder LMs: next-token CE (shift-by-one).
+
+    Matches the reference's GPT-2 recipe loss (BASELINE.json:10). Also
+    reports perplexity-ready mean token loss as the metric.
+    """
+
+    def loss_fn(params, batch_stats, batch, rng):
+        ids = batch[ids_key]
+        logits = model.apply(
+            {"params": params}, ids, train=True, rngs={"dropout": rng}
+        )
+        # predict token t+1 from prefix..t
+        shift_logits = logits[:, :-1].astype(jnp.float32)
+        shift_labels = ids[:, 1:]
+        loss = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(
+                shift_logits, shift_labels
+            )
+        )
+        return loss, {
+            "metrics": {"loss": loss},
+            "batch_stats": batch_stats,
+        }
+
+    return loss_fn
+
+
+def text_classification_loss_fn(model) -> Callable:
+    """Trainer-contract loss for BERT-style sequence classification."""
+
+    def loss_fn(params, batch_stats, batch, rng):
+        logits = model.apply(
+            {"params": params},
+            batch["input_ids"],
+            batch.get("attention_mask"),
+            train=True,
+            rngs={"dropout": rng},
+        )
+        loss = cross_entropy(logits, batch["label"])
+        return loss, {
+            "metrics": {"loss": loss, "accuracy": accuracy(logits, batch["label"])},
+            "batch_stats": batch_stats,
+        }
+
+    return loss_fn
+
+
 def classification_eval_step(
     model, *, image_key: str = "image", label_key: str = "label"
 ) -> Callable:

@@ -294,5 +294,8 @@ class Trainer:
         return means
 
     def _batch_samples(self, batch) -> int:
+        key = self.config.samples_axis
+        if isinstance(batch, dict) and key in batch:
+            return int(batch[key].shape[0])
         leaves = jax.tree_util.tree_leaves(batch)
         return int(leaves[0].shape[0]) if leaves else 0

@@ -1,0 +1,114 @@
+"""Recipe 3: BERT-base fine-tune — DDP + mixed precision.
+
+Mirrors the reference recipe (BASELINE.json:9: "BERT-base fine-tune,
+DDP + amp.GradScaler -> XLA bf16"): the AMP scaffolding is kept —
+``autocast()`` selects bf16 compute and the GradScaler is an exact no-op
+(bf16 needs no loss scaling; pass ``--fp16`` to see real dynamic scaling).
+
+Run:
+    python recipes/bert_finetune.py --tiny --steps-per-epoch 3
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import pytorch_distributed_tpu as ptd
+from pytorch_distributed_tpu.data import DataLoader, SyntheticTextDataset
+from pytorch_distributed_tpu.models import (
+    BertConfig,
+    BertForSequenceClassification,
+    bert_partition_rules,
+)
+from pytorch_distributed_tpu.parallel import DataParallel
+from pytorch_distributed_tpu.runtime.mesh import MeshSpec
+from pytorch_distributed_tpu.train import (
+    Trainer,
+    TrainerConfig,
+    TrainState,
+    build_train_step,
+    text_classification_loss_fn,
+)
+from pytorch_distributed_tpu.utils import log_rank0
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--backend", default=None)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--lr", type=float, default=2e-5)
+    p.add_argument("--num-labels", type=int, default=2)
+    p.add_argument("--dp", type=int, default=-1)
+    p.add_argument("--tiny", action="store_true", help="tiny config (smoke)")
+    p.add_argument("--fp16", action="store_true",
+                   help="fp16 + real dynamic loss scaling instead of bf16")
+    p.add_argument("--steps-per-epoch", type=int, default=None)
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--log-every", type=int, default=10)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    ptd.seed_all(args.seed)
+    ptd.init_process_group(args.backend, mesh_spec=MeshSpec(dp=args.dp))
+    log_rank0("world=%d backend=%s", ptd.get_world_size(), ptd.get_backend())
+
+    cfg = BertConfig.tiny() if args.tiny else BertConfig.base()
+    seq_len = min(args.seq_len, cfg.max_position_embeddings)
+    n = (args.steps_per_epoch or 100) * args.batch_size
+    train_ds = SyntheticTextDataset(
+        n=n, seq_len=seq_len, vocab_size=cfg.vocab_size,
+        num_classes=args.num_labels, seed=args.seed,
+    )
+
+    amp_dtype = jnp.float16 if args.fp16 else jnp.bfloat16
+    scaler = ptd.GradScaler(dtype=amp_dtype)
+    with ptd.autocast(dtype=amp_dtype):
+        model = BertForSequenceClassification(cfg, num_labels=args.num_labels)
+        variables = model.init(
+            jax.random.key(args.seed),
+            jnp.zeros((1, seq_len), jnp.int32),
+        )
+        state = TrainState.create(
+            apply_fn=model.apply,
+            params=variables["params"],
+            tx=optax.adamw(args.lr),
+            scaler_state=scaler.init_state(),
+        )
+        strategy = DataParallel(extra_rules=bert_partition_rules())
+        train_step = build_train_step(
+            text_classification_loss_fn(model), scaler=scaler
+        )
+        trainer = Trainer(
+            state,
+            strategy,
+            train_step,
+            DataLoader(
+                train_ds, args.batch_size, seed=args.seed,
+                sharding=strategy.batch_sharding(),
+            ),
+            config=TrainerConfig(
+                epochs=args.epochs, log_every=args.log_every,
+                ckpt_dir=args.ckpt_dir, samples_axis="input_ids",
+            ),
+        )
+        # fit() must stay inside autocast: jit traces lazily at the first
+        # step, and the policy is read at trace time
+        trainer.restore_checkpoint()
+        state = trainer.fit()
+    log_rank0("done: step=%d", int(state.step))
+    return state
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,158 @@
+"""Attention op tests: reference numerics, causality, GQA, RoPE."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.ops.attention import (
+    apply_rope,
+    dot_product_attention,
+    rope_frequencies,
+)
+
+
+def reference_attention(q, k, v, causal=False):
+    """Naive f32 reference."""
+    B, S, H, D = q.shape
+    T = k.shape[1]
+    kv_rep = H // k.shape[2]
+    k = np.repeat(k, kv_rep, axis=2)
+    v = np.repeat(v, kv_rep, axis=2)
+    logits = np.einsum("bshd,bthd->bhst", q, k) / np.sqrt(D)
+    if causal:
+        mask = np.tril(np.ones((S, T), bool))
+        logits = np.where(mask[None, None], logits, -1e30)
+    w = np.exp(logits - logits.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    return np.einsum("bhst,bthd->bshd", w, v)
+
+
+class TestDotProductAttention:
+    def test_matches_reference(self):
+        rng = np.random.default_rng(0)
+        q = rng.normal(size=(2, 8, 4, 16)).astype(np.float32)
+        k = rng.normal(size=(2, 8, 4, 16)).astype(np.float32)
+        v = rng.normal(size=(2, 8, 4, 16)).astype(np.float32)
+        out = dot_product_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        np.testing.assert_allclose(
+            np.asarray(out), reference_attention(q, k, v), rtol=2e-5, atol=2e-5
+        )
+
+    def test_gqa_matches_repeated_kv(self):
+        rng = np.random.default_rng(1)
+        q = rng.normal(size=(2, 8, 8, 16)).astype(np.float32)
+        k = rng.normal(size=(2, 8, 2, 16)).astype(np.float32)
+        v = rng.normal(size=(2, 8, 2, 16)).astype(np.float32)
+        out = dot_product_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        np.testing.assert_allclose(
+            np.asarray(out), reference_attention(q, k, v), rtol=2e-5, atol=2e-5
+        )
+
+    def test_causal_no_future_leakage(self):
+        rng = np.random.default_rng(2)
+        q = rng.normal(size=(1, 8, 2, 8)).astype(np.float32)
+        k = rng.normal(size=(1, 8, 2, 8)).astype(np.float32)
+        v = rng.normal(size=(1, 8, 2, 8)).astype(np.float32)
+        base = dot_product_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True
+        )
+        # perturb the future: outputs at positions < 5 must not move
+        k2, v2 = k.copy(), v.copy()
+        k2[:, 5:] += 100.0
+        v2[:, 5:] -= 50.0
+        pert = dot_product_attention(
+            jnp.asarray(q), jnp.asarray(k2), jnp.asarray(v2), causal=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(base)[:, :5], np.asarray(pert)[:, :5], rtol=1e-5, atol=1e-6
+        )
+        assert not np.allclose(np.asarray(base)[:, 5:], np.asarray(pert)[:, 5:])
+
+    def test_q_offset_shifts_causality(self):
+        # a 1-token query block at offset 3 sees keys 0..3 only
+        rng = np.random.default_rng(3)
+        q = rng.normal(size=(1, 1, 2, 8)).astype(np.float32)
+        k = rng.normal(size=(1, 8, 2, 8)).astype(np.float32)
+        v = rng.normal(size=(1, 8, 2, 8)).astype(np.float32)
+        out3 = dot_product_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True, q_offset=3
+        )
+        v2 = v.copy()
+        v2[:, 4:] += 99.0  # beyond position 3: invisible
+        out3b = dot_product_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v2), causal=True, q_offset=3
+        )
+        np.testing.assert_allclose(np.asarray(out3), np.asarray(out3b), rtol=1e-5)
+
+    def test_padding_mask(self):
+        rng = np.random.default_rng(4)
+        q = rng.normal(size=(1, 4, 2, 8)).astype(np.float32)
+        k = rng.normal(size=(1, 4, 2, 8)).astype(np.float32)
+        v = rng.normal(size=(1, 4, 2, 8)).astype(np.float32)
+        mask = np.array([[True, True, False, False]])
+        out = dot_product_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mask=jnp.asarray(mask)
+        )
+        # masked keys must not affect output: zero them instead and compare
+        k2, v2 = k.copy(), v.copy()
+        k2[:, 2:] = 7.0
+        v2[:, 2:] = -7.0
+        out2 = dot_product_attention(
+            jnp.asarray(q), jnp.asarray(k2), jnp.asarray(v2), mask=jnp.asarray(mask)
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out2), rtol=1e-5)
+
+    def test_bad_head_ratio_raises(self):
+        x = jnp.zeros((1, 4, 3, 8))
+        kv = jnp.zeros((1, 4, 2, 8))
+        with pytest.raises(ValueError, match="heads"):
+            dot_product_attention(x, kv, kv)
+
+    def test_bf16_inputs_stable(self):
+        rng = np.random.default_rng(5)
+        q = jnp.asarray(rng.normal(size=(1, 16, 2, 32)), jnp.bfloat16)
+        out = dot_product_attention(q, q, q, causal=True)
+        assert out.dtype == jnp.bfloat16
+        assert bool(jnp.all(jnp.isfinite(out.astype(jnp.float32))))
+
+
+class TestRope:
+    def test_rotation_preserves_norm(self):
+        cos, sin = rope_frequencies(16, 32)
+        x = jax.random.normal(jax.random.key(0), (1, 8, 2, 16))
+        y = apply_rope(x, cos, sin)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(x), axis=-1),
+            np.linalg.norm(np.asarray(y), axis=-1),
+            rtol=1e-5,
+        )
+
+    def test_position_zero_is_identity(self):
+        cos, sin = rope_frequencies(8, 16)
+        x = jax.random.normal(jax.random.key(1), (1, 1, 1, 8))
+        y = apply_rope(x, cos, sin)
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-6)
+
+    def test_relative_property(self):
+        # <rope(q,m), rope(k,n)> depends only on m-n
+        cos, sin = rope_frequencies(8, 64)
+        q = jax.random.normal(jax.random.key(2), (1, 1, 1, 8))
+        k = jax.random.normal(jax.random.key(3), (1, 1, 1, 8))
+
+        def dot_at(m, n):
+            qm = apply_rope(q, cos, sin, positions=jnp.array([[m]]))
+            kn = apply_rope(k, cos, sin, positions=jnp.array([[n]]))
+            return float(jnp.sum(qm * kn))
+
+        assert dot_at(5, 3) == pytest.approx(dot_at(12, 10), rel=1e-4)
+        assert dot_at(5, 3) != pytest.approx(dot_at(5, 4), rel=1e-2)
+
+    def test_explicit_positions_match_arange(self):
+        cos, sin = rope_frequencies(8, 32)
+        x = jax.random.normal(jax.random.key(4), (2, 6, 2, 8))
+        auto = apply_rope(x, cos, sin)
+        manual = apply_rope(
+            x, cos, sin, positions=jnp.broadcast_to(jnp.arange(6), (2, 6))
+        )
+        np.testing.assert_allclose(np.asarray(auto), np.asarray(manual), rtol=1e-6)

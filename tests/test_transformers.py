@@ -1,0 +1,228 @@
+"""Transformer model tests: shapes, param counts (via eval_shape — no
+materialization of the big configs), causality, TP rules, tiny train steps."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import optax
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_tpu.models import (
+    BertConfig,
+    BertForSequenceClassification,
+    BertModel,
+    GPT2Config,
+    GPT2LMHead,
+    LlamaConfig,
+    LlamaForCausalLM,
+    bert_partition_rules,
+    gpt2_partition_rules,
+    llama_partition_rules,
+)
+from pytorch_distributed_tpu.parallel import FSDP, ZeRO1
+from pytorch_distributed_tpu.runtime.mesh import MeshSpec, make_mesh
+from pytorch_distributed_tpu.train import (
+    TrainState,
+    build_train_step,
+    causal_lm_loss_fn,
+    text_classification_loss_fn,
+)
+
+
+def abstract_param_count(model, *args, **kwargs):
+    shapes = jax.eval_shape(lambda: model.init(jax.random.key(0), *args, **kwargs))
+    return sum(
+        int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(shapes["params"])
+    )
+
+
+class TestParamCounts:
+    def test_bert_base_110m(self):
+        model = BertModel(BertConfig.base())
+        n = abstract_param_count(model, jnp.zeros((1, 16), jnp.int32))
+        # HF bert-base-uncased: 109,482,240 (incl. pooler)
+        assert 108e6 < n < 111e6, n
+
+    def test_gpt2_medium_355m(self):
+        model = GPT2LMHead(GPT2Config.medium())
+        n = abstract_param_count(model, jnp.zeros((1, 16), jnp.int32))
+        # HF gpt2-medium: 354,823,168 (tied head)
+        assert 350e6 < n < 360e6, n
+
+    def test_llama3_8b(self):
+        model = LlamaForCausalLM(LlamaConfig.llama3_8b())
+        n = abstract_param_count(model, jnp.zeros((1, 16), jnp.int32))
+        # Meta Llama-3-8B: 8,030,261,248
+        assert 7.9e9 < n < 8.1e9, n
+
+
+class TestForward:
+    def test_bert_shapes(self):
+        cfg = BertConfig.tiny()
+        model = BertForSequenceClassification(cfg, num_labels=3)
+        ids = jnp.ones((2, 16), jnp.int32)
+        v = model.init(jax.random.key(0), ids)
+        logits = model.apply(v, ids)
+        assert logits.shape == (2, 3)
+        assert logits.dtype == jnp.float32
+
+    def test_bert_attention_mask_effect(self):
+        cfg = BertConfig.tiny()
+        model = BertModel(cfg)
+        ids = jnp.ones((1, 8), jnp.int32)
+        v = model.init(jax.random.key(0), ids)
+        seq_full, _ = model.apply(v, ids, jnp.ones((1, 8), jnp.bool_))
+        ids2 = ids.at[:, 4:].set(99)  # tokens behind the mask
+        seq_masked, _ = model.apply(
+            v, ids2, jnp.asarray([[1, 1, 1, 1, 0, 0, 0, 0]], jnp.bool_)
+        )
+        seq_masked_same, _ = model.apply(
+            v, ids, jnp.asarray([[1, 1, 1, 1, 0, 0, 0, 0]], jnp.bool_)
+        )
+        # visible positions only depend on visible tokens
+        np.testing.assert_allclose(
+            np.asarray(seq_masked)[:, :4],
+            np.asarray(seq_masked_same)[:, :4],
+            rtol=2e-2, atol=2e-2,
+        )
+
+    def test_gpt2_causal_lm_shapes(self):
+        cfg = GPT2Config.tiny()
+        model = GPT2LMHead(cfg)
+        ids = jnp.ones((2, 12), jnp.int32)
+        v = model.init(jax.random.key(0), ids)
+        logits = model.apply(v, ids)
+        assert logits.shape == (2, 12, cfg.vocab_size)
+
+    def test_gpt2_causality(self):
+        cfg = GPT2Config.tiny()
+        model = GPT2LMHead(cfg)
+        ids = jnp.arange(24, dtype=jnp.int32).reshape(2, 12) % cfg.vocab_size
+        v = model.init(jax.random.key(0), ids)
+        base = model.apply(v, ids)
+        ids2 = ids.at[:, 8:].set(7)
+        pert = model.apply(v, ids2)
+        np.testing.assert_allclose(
+            np.asarray(base)[:, :8], np.asarray(pert)[:, :8], rtol=1e-4, atol=1e-4
+        )
+
+    def test_gpt2_seq_too_long_raises(self):
+        cfg = GPT2Config.tiny()
+        model = GPT2LMHead(cfg)
+        ids = jnp.ones((1, cfg.n_positions + 1), jnp.int32)
+        with pytest.raises(ValueError, match="n_positions"):
+            model.init(jax.random.key(0), ids)
+
+    def test_llama_shapes_and_causality(self):
+        cfg = LlamaConfig.tiny()
+        model = LlamaForCausalLM(cfg)
+        ids = jnp.arange(32, dtype=jnp.int32).reshape(2, 16) % cfg.vocab_size
+        v = model.init(jax.random.key(0), ids)
+        logits = model.apply(v, ids)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        pert = model.apply(v, ids.at[:, 10:].set(3))
+        np.testing.assert_allclose(
+            np.asarray(logits)[:, :10], np.asarray(pert)[:, :10],
+            rtol=1e-4, atol=1e-4,
+        )
+
+    def test_llama_gqa_config(self):
+        cfg = LlamaConfig.tiny()
+        model = LlamaForCausalLM(cfg)
+        v = model.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))
+        k_kernel = v["params"]["layer0"]["k"]["kernel"]
+        assert k_kernel.shape == (cfg.hidden_size, cfg.num_kv_heads, cfg.head_dim)
+
+
+class TestTrainSteps:
+    def test_gpt2_zero1_accum_step(self):
+        # the recipe-4 shape: ZeRO-1 + grad accumulation (BASELINE.json:10)
+        mesh = make_mesh(MeshSpec(dp=4, fsdp=1, tp=2))
+        cfg = GPT2Config.tiny()
+        model = GPT2LMHead(cfg)
+        ids = np.random.default_rng(0).integers(
+            cfg.vocab_size, size=(8, 16)
+        ).astype(np.int32)
+        v = model.init(jax.random.key(0), jnp.asarray(ids[:1]))
+        state = TrainState.create(
+            apply_fn=model.apply, params=v["params"], tx=optax.adamw(1e-3)
+        )
+        strategy = ZeRO1(mesh, extra_rules=gpt2_partition_rules())
+        state = strategy.place(state)
+        step = strategy.compile(
+            build_train_step(causal_lm_loss_fn(model), accum_steps=2), state
+        )
+        batch = strategy.shard_batch({"input_ids": ids})
+        state, m1 = step(state, batch)
+        state, m2 = step(state, batch)
+        assert float(m2["loss"]) < float(m1["loss"])
+        # ZeRO-1 placement: opt state sharded, params TP-only
+        mu = state.opt_state[0].mu
+        assert "dp" in str(mu["block0"]["mlp_up"]["kernel"].sharding.spec)
+
+    def test_llama_fsdp_tp_step(self):
+        # the recipe-5 shape: FSDP full-shard (BASELINE.json:11) + TP
+        mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+        cfg = LlamaConfig.tiny()
+        model = LlamaForCausalLM(cfg)
+        ids = np.random.default_rng(1).integers(
+            cfg.vocab_size, size=(8, 16)
+        ).astype(np.int32)
+        v = model.init(jax.random.key(0), jnp.asarray(ids[:1]))
+        state = TrainState.create(
+            apply_fn=model.apply, params=v["params"], tx=optax.adamw(1e-3)
+        )
+        strategy = FSDP(mesh, extra_rules=llama_partition_rules())
+        state = strategy.place(state)
+        # TP+FSDP composition on the gate kernel [hidden, ffn]
+        spec = state.params["layer0"]["gate"]["kernel"].sharding.spec
+        assert spec == P("fsdp", "tp")
+        step = strategy.compile(build_train_step(causal_lm_loss_fn(model)), state)
+        state, m = step(state, strategy.shard_batch({"input_ids": ids}))
+        assert np.isfinite(float(m["loss"]))
+
+    def test_create_sharded_never_replicates(self):
+        mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+        cfg = LlamaConfig.tiny()
+        model = LlamaForCausalLM(cfg)
+        strategy = FSDP(mesh, extra_rules=llama_partition_rules())
+
+        def make_state(key):
+            v = model.init(key, jnp.zeros((1, 8), jnp.int32))
+            return TrainState.create(
+                apply_fn=model.apply, params=v["params"], tx=optax.adamw(1e-3)
+            )
+
+        state = strategy.create_sharded(make_state, jax.random.key(0))
+        spec = state.params["layer0"]["gate"]["kernel"].sharding.spec
+        assert spec == P("fsdp", "tp")
+        mu = state.opt_state[0].mu  # adamw: (ScaleByAdamState, ...)
+        assert mu["layer0"]["gate"]["kernel"].sharding.spec == P("fsdp", "tp")
+
+    def test_bert_ddp_amp_step(self):
+        # the recipe-3 shape: DDP + autocast bf16 (BASELINE.json:9)
+        import pytorch_distributed_tpu as ptd
+        from pytorch_distributed_tpu.parallel import DataParallel
+
+        mesh = make_mesh(MeshSpec(dp=8))
+        cfg = BertConfig.tiny()
+        model = BertForSequenceClassification(cfg, num_labels=2)
+        rng = np.random.default_rng(2)
+        batch = {
+            "input_ids": rng.integers(cfg.vocab_size, size=(16, 12)).astype(np.int32),
+            "label": rng.integers(2, size=(16,)).astype(np.int32),
+        }
+        with ptd.autocast():  # bf16 compute; GradScaler is identity
+            v = model.init(jax.random.key(0), jnp.asarray(batch["input_ids"][:1]))
+            state = TrainState.create(
+                apply_fn=model.apply, params=v["params"], tx=optax.adamw(1e-4)
+            )
+            strategy = DataParallel(mesh, extra_rules=bert_partition_rules())
+            state = strategy.place(state)
+            step = strategy.compile(
+                build_train_step(text_classification_loss_fn(model)), state
+            )
+        state, m = step(state, strategy.shard_batch(batch))
+        assert np.isfinite(float(m["loss"]))
+        assert 0.0 <= float(m["accuracy"]) <= 1.0
