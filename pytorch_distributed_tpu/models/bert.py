@@ -17,7 +17,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from pytorch_distributed_tpu.ops.attention import dot_product_attention
+from pytorch_distributed_tpu.ops.attention import attention
 from pytorch_distributed_tpu.runtime.precision import current_policy
 
 
@@ -61,7 +61,7 @@ class BertSelfAttention(nn.Module):
         q = dense("query")(x)
         k = dense("key")(x)
         v = dense("value")(x)
-        out = dot_product_attention(q, k, v, mask=attention_mask)
+        out = attention(q, k, v, mask=attention_mask)
         out = nn.DenseGeneral(
             cfg.hidden_size,
             axis=(-2, -1),

@@ -16,7 +16,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from pytorch_distributed_tpu.ops.attention import dot_product_attention
+from pytorch_distributed_tpu.ops.attention import attention
 from pytorch_distributed_tpu.runtime.precision import current_policy
 
 
@@ -68,7 +68,7 @@ class GPT2Block(nn.Module):
             name="attn_qkv",
         )(h)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        attn = dot_product_attention(q, k, v, causal=True)
+        attn = attention(q, k, v, causal=True)
         attn = nn.DenseGeneral(
             cfg.hidden_size, axis=(-2, -1), dtype=policy.compute_dtype,
             param_dtype=policy.param_dtype, name="attn_out",

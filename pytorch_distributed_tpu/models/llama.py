@@ -19,7 +19,7 @@ from jax.sharding import PartitionSpec as P
 
 from pytorch_distributed_tpu.ops.attention import (
     apply_rope,
-    dot_product_attention,
+    attention,
     rope_frequencies,
 )
 from pytorch_distributed_tpu.runtime.precision import current_policy
@@ -84,7 +84,7 @@ class LlamaBlock(nn.Module):
         v = dense((cfg.num_kv_heads, cfg.head_dim), "v")(h)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
-        attn = dot_product_attention(q, k, v, causal=True)
+        attn = attention(q, k, v, causal=True)
         attn = dense(cfg.hidden_size, "o", axis=(-2, -1))(attn)
         x = x + attn
 

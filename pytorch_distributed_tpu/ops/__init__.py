@@ -7,9 +7,22 @@ automatically for long sequences on TPU.
 """
 
 from pytorch_distributed_tpu.ops.attention import (
+    attention as scaled_dot_product_attention,  # torch-texture alias; the
+    # bare name would shadow the ops.attention submodule on the package
     dot_product_attention,
+    get_attention_impl,
+    set_attention_impl,
     apply_rope,
     rope_frequencies,
 )
+from pytorch_distributed_tpu.ops.flash_attention import flash_attention
 
-__all__ = ["dot_product_attention", "apply_rope", "rope_frequencies"]
+__all__ = [
+    "scaled_dot_product_attention",
+    "dot_product_attention",
+    "flash_attention",
+    "get_attention_impl",
+    "set_attention_impl",
+    "apply_rope",
+    "rope_frequencies",
+]

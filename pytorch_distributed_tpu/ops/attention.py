@@ -102,3 +102,72 @@ def dot_product_attention(
     weights = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bkgst,btkd->bskgd", weights.astype(q.dtype), v)
     return out.reshape(B, S, Hq, D)
+
+
+# --------------------------------------------------------------------------
+# implementation dispatch: XLA einsum path vs Pallas flash kernel
+# --------------------------------------------------------------------------
+
+_IMPL = "auto"  # auto | flash | xla
+
+
+def set_attention_impl(impl: str) -> None:
+    """Select the attention backend for :func:`attention`.
+
+    * ``"xla"``   — the einsum/softmax path above (XLA fuses it).
+    * ``"flash"`` — the Pallas blocked kernel (ops/flash_attention.py).
+    * ``"auto"``  — flash on TPU when the call qualifies (no padding
+      mask, block-divisible sequence), XLA otherwise. The CPU test mesh
+      keeps the XLA path: interpret-mode kernels are orders of magnitude
+      slower and numerically identical.
+    """
+    if impl not in ("auto", "flash", "xla"):
+        raise ValueError(f"unknown attention impl {impl!r}")
+    global _IMPL
+    if impl != _IMPL:
+        _IMPL = impl
+        # jit caches don't key on this flag; drop them so already-compiled
+        # steps retrace with the newly selected backend
+        jax.clear_caches()
+
+
+def get_attention_impl() -> str:
+    return _IMPL
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    mask: Optional[jnp.ndarray] = None,
+    q_offset: int = 0,
+) -> jnp.ndarray:
+    """Dispatching attention: models call this instead of an impl directly."""
+    from pytorch_distributed_tpu.parallel.sequence import (
+        sequence_parallel_attention,
+        sequence_parallel_mode,
+    )
+
+    seq_axis, _ = sequence_parallel_mode()
+    if seq_axis is not None and mask is None and q_offset == 0:
+        return sequence_parallel_attention(q, k, v, causal=causal)
+    use_flash = False
+    if mask is None and q_offset == 0:
+        if _IMPL == "flash":
+            use_flash = True
+        elif _IMPL == "auto":
+            # only worth it when blocks stay at full (128) tile size; odd
+            # lengths would degrade to tiny blocks below the TPU tiling floor
+            use_flash = (
+                jax.default_backend() == "tpu"
+                and q.shape[1] >= 256
+                and q.shape[1] % 128 == 0
+                and k.shape[1] % 128 == 0
+            )
+    if use_flash:
+        from pytorch_distributed_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    return dot_product_attention(q, k, v, causal=causal, mask=mask, q_offset=q_offset)

@@ -1,0 +1,433 @@
+"""Flash attention as a Pallas TPU kernel (fwd + custom-VJP bwd).
+
+The reference's transformer recipes (BERT/GPT-2/Llama, BASELINE.json:9-11)
+lean on cuDNN/FlashAttention CUDA kernels via ``scaled_dot_product_attention``.
+The TPU-native equivalent is a Pallas kernel: blocked online-softmax
+attention that never materializes the [S, T] score matrix in HBM —
+O(S) memory instead of O(S^2), with f32 accumulation on the MXU.
+
+Design (standard TPU flash schedule):
+
+* grid = (batch*heads, q_blocks, k_blocks); the k dimension is innermost
+  and sequential ("arbitrary"), so VMEM scratch (acc, running max m,
+  running sum l) persists across k steps — the online-softmax carry.
+* Causal masking skips the compute for fully-masked blocks via
+  ``pl.when`` (blocks still iterate; skipping grid steps needs no-op
+  reads anyway) and applies an elementwise mask on the diagonal blocks.
+* Grouped-query attention: KV arrays are indexed per *query* head via
+  the BlockSpec index_map (``kv_head = q_head * Hkv // Hq``) — no
+  repeat/materialization of KV to Q heads, matching
+  ``ops.attention.dot_product_attention``'s einsum design.
+* Backward recomputes the blocked scores from the saved logsumexp
+  (no S^2 residuals): a dq kernel with the same schedule, and a dkv
+  kernel with q innermost. GQA grads for K/V are emitted per q-head and
+  group-summed outside the kernel (G is small; this keeps kernel
+  outputs race-free across the parallel head grid dim).
+* On non-TPU backends every pallas_call runs ``interpret=True`` so the
+  whole stack (and CI, per tests/conftest.py) works on the 8-device CPU
+  mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30  # large-but-finite: avoids NaN from (-inf) - (-inf)
+# lse/delta carry a small replicated trailing dim: 8 == sublane tile floor,
+# the minimum that satisfies TPU block tiling without 128x HBM blow-up
+_LANES = 8
+
+
+def _causal_live(q_start: int, k_start: int, block_q: int):
+    """Block participates iff its last q row can see the block's first k."""
+    return q_start + block_q - 1 >= k_start
+
+
+def _causal_mask(s, q_start, k_start, block_q: int, block_k: int):
+    """Mask scores above the causal diagonal (shared by fwd/dq/dkv)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return jnp.where((q_start + rows) >= (k_start + cols), s, _NEG_INF)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pick_block(seq: int, want: int) -> int:
+    b = min(want, seq)
+    while seq % b:
+        b -= 1
+    return b
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, sm_scale: float, causal: bool, block_q: int, block_k: int):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    live = _causal_live(q_start, k_start, block_q) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]  # [bq, d]
+        k = k_ref[0]  # [bk, d]
+        v = v_ref[0]  # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale  # [bq, bk]
+
+        if causal:
+            s = _causal_mask(s, q_start, k_start, block_q, block_k)
+
+        m_prev = m_ref[:, :1]  # [bq, 1] (lanes replicated)
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)  # rescale of old accumulator
+        p = jnp.exp(s - m_new)  # [bq, bk]
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc_ref[:] / safe).astype(o_ref.dtype)
+        # logsumexp for the backward recompute; lane-replicated to 128 so
+        # the output block meets the TPU (8, 128) tiling floor
+        lse_ref[0] = jnp.broadcast_to(
+            m_ref[:, :1] + jnp.log(safe), lse_ref.shape[1:]
+        )
+
+
+def _kv_head_map(bh, hq: int, hkv: int):
+    """Flat (batch*q_head) index -> flat (batch*kv_head) index."""
+    return (bh // hq) * hkv + (bh % hq) * hkv // hq
+
+
+def _flash_forward(q, k, v, *, hq, hkv, sm_scale, causal, block_q, block_k):
+    """q: [B*Hq, S, D]; k, v: [B*Hkv, T, D] -> (out [B*Hq, S, D], lse)."""
+    BH, S, D = q.shape
+    _, T, _ = k.shape
+    bq = _pick_block(S, block_q)
+    bk = _pick_block(T, block_k)
+    grid = (BH, S // bq, T // bk)
+
+    kv_map = lambda bh, qi, ki: (_kv_head_map(bh, hq, hkv), ki, 0)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=bq, block_k=bk
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, D), kv_map),
+            pl.BlockSpec((1, bk, D), kv_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, S, _LANES), jnp.float32),
+        ],
+        scratch_shapes=_fwd_scratch(bq, bk, D),
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+def _fwd_scratch(bq, bk, d):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return [
+        pltpu.VMEM((bq, d), jnp.float32),  # acc
+        pltpu.VMEM((bq, 128), jnp.float32),  # running max (lane-replicated)
+        pltpu.VMEM((bq, 128), jnp.float32),  # running sum
+    ]
+
+
+def _compiler_params():
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+    )
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_ref, *, sm_scale, causal, block_q, block_k):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    live = _causal_live(q_start, k_start, block_q) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]  # [bq, 1] (lanes replicated)
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        if causal:
+            s = _causal_mask(s, q_start, k_start, block_q, block_k)
+        p = jnp.exp(s - lse)  # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * sm_scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc,
+                *, sm_scale, causal, block_q, block_k):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    live = _causal_live(q_start, k_start, block_q) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]  # [bq, 1] (lanes replicated)
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        if causal:
+            s = _causal_mask(s, q_start, k_start, block_q, block_k)
+        p = jnp.exp(s - lse)  # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * sm_scale  # [bq, bk]
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+# --------------------------------------------------------------------------
+# public op
+# --------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
+)
+def _flash(q, k, v, sm_scale, causal, block_q, block_k):
+    out, _lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+    return out
+
+
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
+    B, S, Hq, D = q.shape
+    _, T, Hkv, _ = k.shape
+    qf = q.transpose(0, 2, 1, 3).reshape(B * Hq, S, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * Hkv, T, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * Hkv, T, D)
+    out, lse = _flash_forward(
+        qf, kf, vf, hq=Hq, hkv=Hkv, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    return out.reshape(B, Hq, S, D).transpose(0, 2, 1, 3), lse
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
+    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_k, res, dout):
+    q, k, v, out, lse = res
+    B, S, Hq, D = q.shape
+    _, T, Hkv, _ = k.shape
+    G = Hq // Hkv
+
+    qf = q.transpose(0, 2, 1, 3).reshape(B * Hq, S, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * Hkv, T, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * Hkv, T, D)
+    dof = dout.transpose(0, 2, 1, 3).reshape(B * Hq, S, D)
+    of = out.transpose(0, 2, 1, 3).reshape(B * Hq, S, D)
+    # delta_i = rowsum(dO_i * O_i) — the softmax-grad correction term,
+    # lane-replicated like lse to satisfy TPU block tiling
+    delta = jnp.broadcast_to(
+        jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)[
+            ..., None
+        ],
+        (B * Hq, S, _LANES),
+    )
+
+    BH = B * Hq
+    bq = _pick_block(S, block_q)
+    bk = _pick_block(T, block_k)
+    kv_map = lambda bh, qi, ki: (_kv_head_map(bh, Hq, Hkv), ki, 0)
+    q_map = lambda bh, qi, ki: (bh, qi, 0)
+    lse_map = lambda bh, qi, ki: (bh, qi, 0)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=bq, block_k=bk,
+        ),
+        grid=(BH, S // bq, T // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), q_map),
+            pl.BlockSpec((1, bk, D), kv_map),
+            pl.BlockSpec((1, bk, D), kv_map),
+            pl.BlockSpec((1, bq, D), q_map),
+            pl.BlockSpec((1, bq, _LANES), lse_map),
+            pl.BlockSpec((1, bq, _LANES), lse_map),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), q_map),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        scratch_shapes=_bwd_scratch(bq, D, n=1),
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, lse, delta)
+
+    # dk/dv per *query* head (race-free), group-summed to kv heads after
+    kv_q_map = lambda bh, ki, qi: (_kv_head_map(bh, Hq, Hkv), ki, 0)
+    dk_per_q, dv_per_q = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=bq, block_k=bk,
+        ),
+        grid=(BH, T // bk, S // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, D), kv_q_map),
+            pl.BlockSpec((1, bk, D), kv_q_map),
+            pl.BlockSpec((1, bq, D), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda bh, ki, qi: (bh, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda bh, ki, qi: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, T, D), v.dtype),
+        ],
+        scratch_shapes=_bwd_scratch(bk, D, n=2),
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, lse, delta)
+
+    dq = dq.reshape(B, Hq, S, D).transpose(0, 2, 1, 3)
+    dk = (
+        dk_per_q.reshape(B, Hkv, G, T, D).sum(axis=2)
+        .transpose(0, 2, 1, 3)
+    )
+    dv = (
+        dv_per_q.reshape(B, Hkv, G, T, D).sum(axis=2)
+        .transpose(0, 2, 1, 3)
+    )
+    return dq, dk, dv
+
+
+def _bwd_scratch(rows, d, n):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return [pltpu.VMEM((rows, d), jnp.float32) for _ in range(n)]
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,  # [B, S, Hq, D]
+    k: jnp.ndarray,  # [B, T, Hkv, D]
+    v: jnp.ndarray,  # [B, T, Hkv, D]
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jnp.ndarray:
+    """Blocked flash attention; drop-in for
+    :func:`~pytorch_distributed_tpu.ops.attention.dot_product_attention`
+    when there is no padding mask. Returns [B, S, Hq, D] in q.dtype."""
+    B, S, Hq, D = q.shape
+    _, T, Hkv, _ = k.shape
+    if Hq % Hkv:
+        raise ValueError(f"query heads {Hq} not a multiple of kv heads {Hkv}")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    return _flash(q, k, v, sm_scale, causal, block_q, block_k)

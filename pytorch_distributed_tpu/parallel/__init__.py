@@ -26,6 +26,13 @@ from pytorch_distributed_tpu.parallel.strategies import (
     ZeRO1,
     FSDP,
 )
+from pytorch_distributed_tpu.parallel.sequence import (
+    ring_attention,
+    ulysses_attention,
+    enable_sequence_parallel,
+    disable_sequence_parallel,
+    sequence_parallel_mode,
+)
 
 __all__ = [
     "PartitionRules",
@@ -37,4 +44,9 @@ __all__ = [
     "DataParallel",
     "ZeRO1",
     "FSDP",
+    "ring_attention",
+    "ulysses_attention",
+    "enable_sequence_parallel",
+    "disable_sequence_parallel",
+    "sequence_parallel_mode",
 ]

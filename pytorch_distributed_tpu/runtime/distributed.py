@@ -91,7 +91,11 @@ def init_process_group(
             "backend='ici' requires TPU devices; use 'cpu' (gloo-equivalent) "
             "for the host smoke path"
         )
-    devices = jax.devices()
+    # The cpu/gloo path asks the CPU backend for its devices explicitly:
+    # the default platform may be TPU (and on axon images the plugin
+    # registration pins it), but jax.devices("cpu") still yields the host
+    # devices, honouring --xla_force_host_platform_device_count.
+    devices = jax.devices("cpu") if backend == "cpu" else jax.devices()
     if world_size is not None:
         if world_size > len(devices):
             raise ValueError(f"world_size {world_size} > {len(devices)} devices")

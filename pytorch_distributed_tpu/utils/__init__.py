@@ -1,5 +1,19 @@
-"""Shared utilities: logging, tree helpers."""
+"""Shared utilities: logging, config, profiling."""
 
 from pytorch_distributed_tpu.utils.logging import get_logger, log_rank0
+from pytorch_distributed_tpu.utils.config import RecipeConfig, parse_cli
+from pytorch_distributed_tpu.utils.profiler import (
+    StepTimer,
+    annotate,
+    maybe_trace,
+)
 
-__all__ = ["get_logger", "log_rank0"]
+__all__ = [
+    "get_logger",
+    "log_rank0",
+    "RecipeConfig",
+    "parse_cli",
+    "StepTimer",
+    "annotate",
+    "maybe_trace",
+]

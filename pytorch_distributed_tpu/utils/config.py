@@ -1,0 +1,122 @@
+"""Dataclass configs with CLI overrides.
+
+The reference's recipes configure themselves with per-script argparse
+(SURVEY.md §5, config/flag system). Here every recipe declares one
+dataclass; ``parse_cli`` turns its fields into ``--flag`` options
+(types, defaults, and help from the dataclass) so all recipes share
+one convention and configs are importable/testable objects rather than
+``argparse.Namespace`` grab-bags.
+
+Usage::
+
+    @dataclasses.dataclass
+    class Config(RecipeConfig):
+        lr: float = 0.1          # doc: peak learning rate
+
+    cfg = parse_cli(Config)      # python recipe.py --lr 0.4 --dp 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import re
+from typing import Optional, Sequence, Type, TypeVar
+
+T = TypeVar("T")
+
+_FIELD_DOC = re.compile(r"#\s*doc:\s*(.*)")
+
+
+@dataclasses.dataclass
+class RecipeConfig:
+    """Fields shared by every recipe in the matrix (BASELINE.json:6-12)."""
+
+    backend: Optional[str] = None  # doc: ici|gloo (default: auto-detect)
+    epochs: int = 1  # doc: training epochs
+    batch_size: int = 128  # doc: GLOBAL batch size (split over the mesh)
+    lr: float = 0.1  # doc: peak learning rate
+    dp: int = -1  # doc: data-parallel width (-1: all remaining devices)
+    fsdp: int = 1  # doc: fully-sharded axis width
+    tp: int = 1  # doc: tensor-parallel axis width
+    seed: int = 0  # doc: global PRNG seed
+    data_dir: str = "/tmp/data"  # doc: dataset root
+    synthetic: bool = False  # doc: force synthetic data
+    steps_per_epoch: Optional[int] = None  # doc: truncate epochs (smoke tests)
+    ckpt_dir: Optional[str] = None  # doc: checkpoint directory (enables resume)
+    log_every: int = 50  # doc: steps between metric logs
+    profile_dir: Optional[str] = None  # doc: write JAX profiler traces here
+
+
+def _field_docs(cls: type) -> dict:
+    """Pull ``# doc:`` trailing comments out of the dataclass source."""
+    import inspect
+
+    docs = {}
+    try:
+        src = inspect.getsource(cls)
+    except (OSError, TypeError):
+        return docs
+    for line in src.splitlines():
+        m = _FIELD_DOC.search(line)
+        if m:
+            name = line.split(":")[0].strip()
+            if name.isidentifier():
+                docs[name] = m.group(1).strip()
+    return docs
+
+
+def _add_field_arg(parser: argparse.ArgumentParser, f, doc: str) -> None:
+    flag = "--" + f.name.replace("_", "-")
+    default = (
+        f.default
+        if f.default is not dataclasses.MISSING
+        else f.default_factory()  # type: ignore[misc]
+    )
+    ftype = f.type
+    # Optional[X] / "Optional[X]" -> X, nullable
+    if isinstance(ftype, str):
+        m = re.match(r"Optional\[(\w+)\]", ftype)
+        inner = m.group(1) if m else ftype
+        ftype = {"int": int, "float": float, "str": str, "bool": bool}.get(
+            inner, str
+        )
+    else:
+        import typing
+
+        if typing.get_origin(ftype) is typing.Union:
+            args = [a for a in typing.get_args(ftype) if a is not type(None)]
+            ftype = args[0] if args else str
+    if ftype is bool:
+        if default:
+            parser.add_argument(
+                flag.replace("--", "--no-", 1),
+                dest=f.name,
+                action="store_false",
+                help=f"disable: {doc}" if doc else None,
+            )
+        else:
+            parser.add_argument(flag, action="store_true", help=doc or None)
+    else:
+        parser.add_argument(
+            flag, type=ftype, default=default,
+            help=(doc or "") + f" (default: {default})",
+        )
+
+
+def parse_cli(
+    cls: Type[T], argv: Optional[Sequence[str]] = None, description: str = ""
+) -> T:
+    """Build ``cls`` from CLI args, one ``--flag`` per dataclass field."""
+    parser = argparse.ArgumentParser(
+        description=description,
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    docs = {}
+    for klass in reversed(cls.__mro__):
+        if dataclasses.is_dataclass(klass):
+            docs.update(_field_docs(klass))
+    for f in dataclasses.fields(cls):  # type: ignore[arg-type]
+        _add_field_arg(parser, f, docs.get(f.name, ""))
+    ns = parser.parse_args(argv)
+    return cls(**vars(ns))

@@ -1,0 +1,94 @@
+"""Profiling/tracing hooks — the torch.profiler/nvprof analogue.
+
+The reference's recipes (if instrumented at all) would wrap the hot loop in
+``torch.profiler``; on TPU the native story is the JAX/XLA profiler, whose
+traces (TensorBoard "Profile" tab / xprof) show per-op device time, HBM
+usage, and collective overlap. This module wraps it with:
+
+* :func:`maybe_trace` — context manager; no-op when ``logdir`` is None so
+  recipes can pass ``--profile-dir`` unconditionally.
+* :class:`StepTimer` — cheap per-step wall-clock timer with a rolling
+  window, for the images/sec meters the north star cares about
+  (BASELINE.json:2) without a full trace.
+* :func:`annotate` — named trace region (``jax.profiler.TraceAnnotation``)
+  so custom phases (data, step, eval) show up in the timeline.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import time
+from typing import Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def maybe_trace(logdir: Optional[str], *, host_tracer_level: int = 2):
+    """Trace device+host activity into ``logdir`` (view with TensorBoard).
+
+    No-op when ``logdir`` is None.
+    """
+    if logdir is None:
+        yield
+        return
+    options = jax.profiler.ProfileOptions()
+    options.host_tracer_level = host_tracer_level
+    jax.profiler.start_trace(logdir, profiler_options=options)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region in the profiler timeline (host + device)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Rolling-window step timer: mean/p50/p95 step time + rate.
+
+    Call :meth:`tick` once per step *after* a sync point (metric fetch).
+    """
+
+    def __init__(self, window: int = 100):
+        self.times = collections.deque(maxlen=window)
+        self._last: Optional[float] = None
+
+    def tick(self) -> Optional[float]:
+        now = time.perf_counter()
+        dt = None
+        if self._last is not None:
+            dt = now - self._last
+            self.times.append(dt)
+        self._last = now
+        return dt
+
+    def reset(self) -> None:
+        self._last = None
+
+    @property
+    def mean(self) -> float:
+        return sum(self.times) / len(self.times) if self.times else 0.0
+
+    def percentile(self, q: float) -> float:
+        if not self.times:
+            return 0.0
+        s = sorted(self.times)
+        i = min(int(q * len(s)), len(s) - 1)
+        return s[i]
+
+    def rate(self, samples_per_step: int) -> float:
+        """Samples/sec over the window."""
+        m = self.mean
+        return samples_per_step / m if m else 0.0
+
+    def summary(self) -> dict:
+        return {
+            "step_time_mean_s": self.mean,
+            "step_time_p50_s": self.percentile(0.50),
+            "step_time_p95_s": self.percentile(0.95),
+            "steps_timed": len(self.times),
+        }
