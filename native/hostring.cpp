@@ -1,0 +1,369 @@
+// hostring: shared-memory multi-process host collectives (Gloo equivalent).
+//
+// The reference's CPU smoke path runs real multi-process training over the
+// gloo process group (BASELINE.json:7); upstream gloo is a C++ rendezvous +
+// ring-collectives library. This is the TPU-framework's native equivalent
+// for the single-host multi-process case: N OS processes rendezvous over a
+// POSIX shared-memory segment and run collectives through per-rank data
+// slots guarded by a process-shared sense-reversing barrier.
+//
+// Algorithm per collective (flat, bandwidth-fine for the smoke path):
+//   barrier -> each rank writes its contribution to its slot
+//   barrier -> each rank reads the slots it needs and combines locally
+//   barrier -> (write-after-read hazard fence before the next collective)
+// Data larger than the slot size is processed in slot-sized chunks inside
+// the C library.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image). All entry
+// points return 0 on success, a negative errno-style code on failure;
+// spin-waits carry a deadline so a dead peer fails the job instead of
+// hanging it.
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <sched.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x48524E47;  // "HRNG"
+constexpr int kErrTimeout = -110;        // -ETIMEDOUT
+constexpr int kErrInval = -22;           // -EINVAL
+constexpr int kErrSys = -5;              // -EIO
+
+struct Barrier {
+  std::atomic<uint32_t> count;
+  std::atomic<uint32_t> generation;
+};
+
+struct ShmHeader {
+  std::atomic<uint32_t> magic;  // kMagic once rank 0 finished initialising
+  uint32_t world;
+  uint64_t slot_bytes;
+  Barrier barrier;
+  std::atomic<uint32_t> attached;
+  std::atomic<uint32_t> abort_flag;  // a rank died; everyone bails out
+};
+
+constexpr size_t kHeaderBytes = 256;  // ShmHeader, padded to cache lines
+static_assert(sizeof(ShmHeader) <= kHeaderBytes, "header overflow");
+
+struct Group {
+  ShmHeader* hdr;
+  uint8_t* slots;  // world * slot_bytes
+  size_t map_bytes;
+  int rank;
+  int world;
+  size_t slot_bytes;
+  char name[256];
+  double timeout_s;
+};
+
+double now_s() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return double(ts.tv_sec) + 1e-9 * double(ts.tv_nsec);
+}
+
+// Sense-reversing central barrier. Safe for arbitrary reuse: waiters key on
+// the generation counter, the last arrival resets the count and bumps it.
+int barrier_wait(Group* g) {
+  Barrier* b = &g->hdr->barrier;
+  const uint32_t gen = b->generation.load(std::memory_order_acquire);
+  if (b->count.fetch_add(1, std::memory_order_acq_rel) + 1 ==
+      uint32_t(g->world)) {
+    b->count.store(0, std::memory_order_release);
+    b->generation.fetch_add(1, std::memory_order_acq_rel);
+    return 0;
+  }
+  const double deadline = now_s() + g->timeout_s;
+  while (b->generation.load(std::memory_order_acquire) == gen) {
+    if (g->hdr->abort_flag.load(std::memory_order_acquire)) return kErrSys;
+    if (now_s() > deadline) {
+      g->hdr->abort_flag.store(1, std::memory_order_release);
+      return kErrTimeout;
+    }
+    sched_yield();
+  }
+  return 0;
+}
+
+// U8 is the raw-byte dtype for copy-shaped collectives (gather/broadcast);
+// reductions over it are bytewise and only meaningful for MAX/MIN.
+enum Dtype : int32_t { F32 = 0, F64 = 1, I32 = 2, I64 = 3, U8 = 4 };
+enum Op : int32_t { SUM = 0, PROD = 1, MAX = 2, MIN = 3 };
+
+size_t dtype_size(int32_t d) {
+  switch (d) {
+    case F32: case I32: return 4;
+    case F64: case I64: return 8;
+    case U8: return 1;
+    default: return 0;
+  }
+}
+
+template <typename T>
+void combine(T* acc, const T* src, size_t n, int32_t op) {
+  switch (op) {
+    case SUM:  for (size_t i = 0; i < n; ++i) acc[i] += src[i]; break;
+    case PROD: for (size_t i = 0; i < n; ++i) acc[i] *= src[i]; break;
+    case MAX:
+      for (size_t i = 0; i < n; ++i) acc[i] = acc[i] < src[i] ? src[i] : acc[i];
+      break;
+    case MIN:
+      for (size_t i = 0; i < n; ++i) acc[i] = src[i] < acc[i] ? src[i] : acc[i];
+      break;
+  }
+}
+
+void combine_dispatch(void* acc, const void* src, size_t n, int32_t dtype,
+                      int32_t op) {
+  switch (dtype) {
+    case F32: combine((float*)acc, (const float*)src, n, op); break;
+    case F64: combine((double*)acc, (const double*)src, n, op); break;
+    case I32: combine((int32_t*)acc, (const int32_t*)src, n, op); break;
+    case I64: combine((int64_t*)acc, (const int64_t*)src, n, op); break;
+    case U8: combine((uint8_t*)acc, (const uint8_t*)src, n, op); break;
+  }
+}
+
+uint8_t* slot(Group* g, int rank) { return g->slots + size_t(rank) * g->slot_bytes; }
+
+}  // namespace
+
+extern "C" {
+
+// Rendezvous: every rank calls hr_init with the same name/world/slot_bytes.
+// Rank 0 creates and sizes the segment; the rest open-retry until the magic
+// lands. Returns an opaque handle through *out.
+int hr_init(const char* name, int rank, int world, uint64_t slot_bytes,
+            double timeout_s, void** out) {
+  if (!name || !out || world <= 0 || rank < 0 || rank >= world ||
+      slot_bytes == 0)
+    return kErrInval;
+  const size_t map_bytes = kHeaderBytes + size_t(world) * slot_bytes;
+  int fd = -1;
+  const double deadline = now_s() + timeout_s;
+  if (rank == 0) {
+    shm_unlink(name);  // stale segment from a crashed prior run
+    fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return -errno;
+    if (ftruncate(fd, off_t(map_bytes)) != 0) {
+      int e = -errno; close(fd); shm_unlink(name); return e;
+    }
+  } else {
+    for (;;) {
+      fd = shm_open(name, O_RDWR, 0600);
+      if (fd >= 0) {
+        struct stat st;
+        if (fstat(fd, &st) == 0 && size_t(st.st_size) >= map_bytes) break;
+        close(fd);
+        fd = -1;
+      }
+      if (now_s() > deadline) return kErrTimeout;
+      sched_yield();
+    }
+  }
+  void* map = mmap(nullptr, map_bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (map == MAP_FAILED) return -errno;
+
+  Group* g = new Group();
+  g->hdr = (ShmHeader*)map;
+  g->slots = (uint8_t*)map + kHeaderBytes;
+  g->map_bytes = map_bytes;
+  g->rank = rank;
+  g->world = world;
+  g->slot_bytes = slot_bytes;
+  g->timeout_s = timeout_s;
+  strncpy(g->name, name, sizeof(g->name) - 1);
+  g->name[sizeof(g->name) - 1] = '\0';
+
+  if (rank == 0) {
+    g->hdr->world = uint32_t(world);
+    g->hdr->slot_bytes = slot_bytes;
+    g->hdr->barrier.count.store(0);
+    g->hdr->barrier.generation.store(0);
+    g->hdr->attached.store(0);
+    g->hdr->abort_flag.store(0);
+    g->hdr->magic.store(kMagic, std::memory_order_release);
+  } else {
+    while (g->hdr->magic.load(std::memory_order_acquire) != kMagic) {
+      if (now_s() > deadline) {
+        munmap(map, map_bytes);
+        delete g;
+        return kErrTimeout;
+      }
+      sched_yield();
+    }
+    if (g->hdr->world != uint32_t(world) || g->hdr->slot_bytes != slot_bytes) {
+      munmap(map, map_bytes);
+      delete g;
+      return kErrInval;
+    }
+  }
+  g->hdr->attached.fetch_add(1);
+  int rc = barrier_wait(g);  // everyone attached before first collective
+  if (rc != 0) {
+    munmap(map, map_bytes);
+    delete g;
+    return rc;
+  }
+  *out = g;
+  return 0;
+}
+
+int hr_barrier(void* h) { return barrier_wait((Group*)h); }
+
+int hr_rank(void* h) { return ((Group*)h)->rank; }
+int hr_world(void* h) { return ((Group*)h)->world; }
+
+// In-place allreduce over `count` elements of `data`, chunked by slot size.
+int hr_allreduce(void* h, void* data, uint64_t count, int32_t dtype,
+                 int32_t op) {
+  Group* g = (Group*)h;
+  const size_t esize = dtype_size(dtype);
+  if (esize == 0) return kErrInval;
+  const size_t chunk_elems = g->slot_bytes / esize;
+  if (chunk_elems == 0) return kErrInval;
+  uint8_t* p = (uint8_t*)data;
+  for (uint64_t off = 0; off < count; off += chunk_elems) {
+    const size_t n = size_t(count - off < chunk_elems ? count - off : chunk_elems);
+    int rc = barrier_wait(g);
+    if (rc != 0) return rc;
+    memcpy(slot(g, g->rank), p + off * esize, n * esize);
+    rc = barrier_wait(g);
+    if (rc != 0) return rc;
+    // Local combine of all slots, starting from our own contribution.
+    memcpy(p + off * esize, slot(g, g->rank), n * esize);
+    for (int r = 1; r < g->world; ++r) {
+      const int src = (g->rank + r) % g->world;
+      combine_dispatch(p + off * esize, slot(g, src), n, dtype, op);
+    }
+    rc = barrier_wait(g);
+    if (rc != 0) return rc;
+  }
+  return 0;
+}
+
+// Gather each rank's `count` elements into out[world * count].
+int hr_allgather(void* h, const void* in, void* out, uint64_t count,
+                 int32_t dtype) {
+  Group* g = (Group*)h;
+  const size_t esize = dtype_size(dtype);
+  if (esize == 0) return kErrInval;
+  const size_t chunk_elems = g->slot_bytes / esize;
+  if (chunk_elems == 0) return kErrInval;
+  const uint8_t* src = (const uint8_t*)in;
+  uint8_t* dst = (uint8_t*)out;
+  for (uint64_t off = 0; off < count; off += chunk_elems) {
+    const size_t n = size_t(count - off < chunk_elems ? count - off : chunk_elems);
+    int rc = barrier_wait(g);
+    if (rc != 0) return rc;
+    memcpy(slot(g, g->rank), src + off * esize, n * esize);
+    rc = barrier_wait(g);
+    if (rc != 0) return rc;
+    for (int r = 0; r < g->world; ++r)
+      memcpy(dst + (uint64_t(r) * count + off) * esize, slot(g, r), n * esize);
+    rc = barrier_wait(g);
+    if (rc != 0) return rc;
+  }
+  return 0;
+}
+
+// Reduce in[world * chunk] across ranks; this rank keeps chunk `rank`.
+int hr_reduce_scatter(void* h, const void* in, void* out, uint64_t chunk,
+                      int32_t dtype, int32_t op) {
+  Group* g = (Group*)h;
+  const size_t esize = dtype_size(dtype);
+  if (esize == 0) return kErrInval;
+  const size_t chunk_elems = g->slot_bytes / esize;
+  if (chunk_elems == 0) return kErrInval;
+  const uint8_t* src = (const uint8_t*)in;
+  uint8_t* dst = (uint8_t*)out;
+  // Round r: everyone publishes its contribution TO chunk-owner r; owner
+  // combines. world rounds of slot traffic, chunked.
+  for (uint64_t off = 0; off < chunk; off += chunk_elems) {
+    const size_t n = size_t(chunk - off < chunk_elems ? chunk - off : chunk_elems);
+    for (int owner = 0; owner < g->world; ++owner) {
+      int rc = barrier_wait(g);
+      if (rc != 0) return rc;
+      memcpy(slot(g, g->rank),
+             src + (uint64_t(owner) * chunk + off) * esize, n * esize);
+      rc = barrier_wait(g);
+      if (rc != 0) return rc;
+      if (owner == g->rank) {
+        memcpy(dst + off * esize, slot(g, g->rank), n * esize);
+        for (int r = 1; r < g->world; ++r) {
+          const int from = (g->rank + r) % g->world;
+          combine_dispatch(dst + off * esize, slot(g, from), n, dtype, op);
+        }
+      }
+      rc = barrier_wait(g);
+      if (rc != 0) return rc;
+    }
+  }
+  return 0;
+}
+
+// In-place broadcast of `bytes` from rank `src` to everyone.
+int hr_broadcast(void* h, void* data, uint64_t bytes, int32_t src) {
+  Group* g = (Group*)h;
+  if (src < 0 || src >= g->world) return kErrInval;
+  uint8_t* p = (uint8_t*)data;
+  for (uint64_t off = 0; off < bytes; off += g->slot_bytes) {
+    const size_t n =
+        size_t(bytes - off < g->slot_bytes ? bytes - off : g->slot_bytes);
+    int rc = barrier_wait(g);
+    if (rc != 0) return rc;
+    if (g->rank == src) memcpy(slot(g, src), p + off, n);
+    rc = barrier_wait(g);
+    if (rc != 0) return rc;
+    if (g->rank != src) memcpy(p + off, slot(g, src), n);
+    rc = barrier_wait(g);
+    if (rc != 0) return rc;
+  }
+  return 0;
+}
+
+// Point-to-point: send `bytes` from rank src to rank dst (both call this).
+int hr_sendrecv(void* h, void* data, uint64_t bytes, int32_t src, int32_t dst) {
+  Group* g = (Group*)h;
+  if (src < 0 || src >= g->world || dst < 0 || dst >= g->world)
+    return kErrInval;
+  uint8_t* p = (uint8_t*)data;
+  for (uint64_t off = 0; off < bytes; off += g->slot_bytes) {
+    const size_t n =
+        size_t(bytes - off < g->slot_bytes ? bytes - off : g->slot_bytes);
+    int rc = barrier_wait(g);
+    if (rc != 0) return rc;
+    if (g->rank == src) memcpy(slot(g, src), p + off, n);
+    rc = barrier_wait(g);
+    if (rc != 0) return rc;
+    if (g->rank == dst) memcpy(p + off, slot(g, src), n);
+    rc = barrier_wait(g);
+    if (rc != 0) return rc;
+  }
+  return 0;
+}
+
+int hr_finalize(void* h) {
+  Group* g = (Group*)h;
+  // Best-effort exit barrier so nobody unlinks a segment in active use; a
+  // timed-out peer just falls through to cleanup.
+  barrier_wait(g);
+  const uint32_t left = g->hdr->attached.fetch_sub(1) - 1;
+  if (left == 0 || g->rank == 0) shm_unlink(g->name);
+  munmap((void*)g->hdr, g->map_bytes);
+  delete g;
+  return 0;
+}
+
+}  // extern "C"

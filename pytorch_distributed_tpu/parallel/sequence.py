@@ -183,9 +183,13 @@ def ulysses_attention(
         )
 
     def inner(q, k, v, causal):
-        from pytorch_distributed_tpu.ops.attention import dot_product_attention
+        # Route through the impl dispatcher so the post-all-to-all local
+        # attention (full sequence, head subset) still gets the Pallas
+        # flash kernel when it qualifies — the einsum path would
+        # materialize the [S, S] scores this layer exists to avoid.
+        from pytorch_distributed_tpu.ops.attention import attention
 
-        return dot_product_attention(q, k, v, causal=causal)
+        return attention(q, k, v, causal=causal)
 
     spec = P(data_axes(), axis, "tp", None)
     fn = shard_map(

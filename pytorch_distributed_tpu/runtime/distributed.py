@@ -20,6 +20,13 @@ single-controller semantics:
 * ``get_rank()`` is the controller process index (0 on a single host) —
   used by recipes only to gate logging/checkpointing, which is exactly what
   it still means here.
+* ``backend="hostring"`` — the genuine multi-process path: when launched
+  one-process-per-rank (``pytorch_distributed_tpu.run`` / ``spawn``, the
+  torchrun/mp.spawn texture of BASELINE.json:5), ranks rendezvous over the
+  native shared-memory collectives library (``native/hostring.cpp``, the
+  gloo equivalent) and the eager collectives below take *this rank's local
+  tensor* — exact torch.distributed semantics. Selected automatically when
+  ``RANK``/``WORLD_SIZE`` env vars are present (set by the launcher).
 """
 
 from __future__ import annotations
@@ -27,6 +34,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -52,9 +60,12 @@ class ReduceOp(enum.Enum):
 class ProcessGroup:
     mesh: Mesh
     backend: str
+    ring: Optional[object] = None  # HostRingGroup in multi-process mode
 
     @property
     def size(self) -> int:
+        if self.ring is not None:
+            return self.ring.world_size
         return int(np.prod(list(self.mesh.shape.values())))
 
 
@@ -68,13 +79,56 @@ def init_process_group(
     *,
     mesh_spec: Optional[_mesh.MeshSpec] = None,
     world_size: Optional[int] = None,
+    rank: Optional[int] = None,
+    group_name: str = "ptd_world",
+    timeout_s: float = 120.0,
 ) -> ProcessGroup:
     """Create the global "world": a mesh over all addressable devices.
 
     ``backend=None`` auto-selects ``"ici"`` on TPU and ``"cpu"`` otherwise.
     ``world_size`` may restrict to the first N devices (smoke tests).
+
+    When this process was launched one-per-rank (``rank`` given, or
+    ``RANK``/``WORLD_SIZE`` in the env — the launcher sets them), the group
+    joins the native shared-memory backend instead: real multi-process
+    collectives, matching the reference's gloo smoke path.
     """
     global _GROUP
+    if rank is None and "RANK" in os.environ:
+        rank = int(os.environ["RANK"])
+    if backend == "hostring" or (
+        rank is not None and backend in (None, "gloo", "cpu")
+    ):
+        from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
+
+        if world_size is None and "WORLD_SIZE" in os.environ:
+            world_size = int(os.environ["WORLD_SIZE"])
+        if world_size is None:
+            raise ValueError("multi-process init needs world_size (or env)")
+        if rank is None:
+            raise ValueError(
+                "multi-process init needs this process's rank (arg or RANK "
+                "env) — every rank defaulting to 0 would corrupt the group"
+            )
+        if mesh_spec is not None:
+            raise ValueError(
+                "mesh_spec is a single-controller concept; under the "
+                "multi-process hostring backend each rank drives one device. "
+                "Unset RANK/WORLD_SIZE (or don't pass rank=) to run "
+                "single-controller SPMD with a mesh."
+            )
+        if _GROUP is not None and _GROUP.ring is not None:
+            _GROUP.ring.close()  # re-init: release the old shm membership
+        ring = HostRingGroup(
+            group_name, rank, world_size, timeout_s=timeout_s
+        )
+        # Each rank still gets a local 1-device mesh so jit/sharding code
+        # paths work unchanged within the rank.
+        mesh = _mesh.make_mesh(
+            _mesh.MeshSpec(dp=1), devices=jax.devices("cpu")[:1]
+        )
+        _GROUP = ProcessGroup(mesh=mesh, backend="hostring", ring=ring)
+        return _GROUP
     if backend is None:
         backend = "ici" if _device.is_tpu() else "cpu"
     if backend in ("nccl", "xla"):
@@ -107,6 +161,8 @@ def init_process_group(
 
 def destroy_process_group() -> None:
     global _GROUP
+    if _GROUP is not None and _GROUP.ring is not None:
+        _GROUP.ring.close()
     _GROUP = None
     _mesh.set_current_mesh(None)
     _collective.cache_clear()
@@ -128,7 +184,12 @@ def get_world_size() -> int:
 
 
 def get_rank() -> int:
-    """Controller process index; gates logging/checkpoint like rank==0."""
+    """Controller process index; gates logging/checkpoint like rank==0.
+
+    Under the hostring (multi-process) backend this is the real rank."""
+    g = _GROUP
+    if g is not None and g.ring is not None:
+        return g.ring.rank
     return _device.process_index()
 
 
@@ -214,9 +275,13 @@ def _check_leading(x, axes, mesh) -> int:
 def all_reduce(x, op: ReduceOp = ReduceOp.SUM, *, axis=None):
     """Reduce across the leading (participant) dim; returns shape x[0].
 
-    ``axis=None`` reduces over the whole mesh.
+    ``axis=None`` reduces over the whole mesh. Under the hostring backend
+    ``x`` is this rank's local tensor (torch semantics) and the result has
+    the same shape.
     """
     g = _group()
+    if g.ring is not None:
+        return jnp.asarray(g.ring.all_reduce(np.asarray(x), op=op.value))
     axes = _participant_axes(axis)
     x = jnp.asarray(x)
     _check_leading(x, axes, g.mesh)
@@ -225,8 +290,12 @@ def all_reduce(x, op: ReduceOp = ReduceOp.SUM, *, axis=None):
 
 
 def all_gather(x, *, axis=None):
-    """Gather participant slices; identity values, replicated layout."""
+    """Gather participant slices; identity values, replicated layout.
+
+    Under hostring: gathers each rank's local tensor into [world, ...]."""
     g = _group()
+    if g.ring is not None:
+        return jnp.asarray(g.ring.all_gather(np.asarray(x)))
     axes = _participant_axes(axis)
     x = jnp.asarray(x)
     _check_leading(x, axes, g.mesh)
@@ -243,6 +312,8 @@ def reduce_scatter(x, op: ReduceOp = ReduceOp.SUM, *, axis=None):
     if op is not ReduceOp.SUM:
         raise NotImplementedError("reduce_scatter supports SUM")
     g = _group()
+    if g.ring is not None:
+        return jnp.asarray(g.ring.reduce_scatter(np.asarray(x), op="sum"))
     axes = _participant_axes(axis)
     x = jnp.asarray(x)
     _check_leading(x, axes, g.mesh)
@@ -251,8 +322,12 @@ def reduce_scatter(x, op: ReduceOp = ReduceOp.SUM, *, axis=None):
 
 
 def broadcast(x, src: int = 0, *, axis=None):
-    """Replicate participant ``src``'s slice to everyone (shape x[0])."""
+    """Replicate participant ``src``'s slice to everyone (shape x[0]).
+
+    Under hostring: replicates rank ``src``'s local tensor (torch shape)."""
     g = _group()
+    if g.ring is not None:
+        return jnp.asarray(g.ring.broadcast(np.asarray(x), src=src))
     axes = _participant_axes(axis)
     x = jnp.asarray(x)
     size = _check_leading(x, axes, g.mesh)
@@ -264,6 +339,9 @@ def broadcast(x, src: int = 0, *, axis=None):
 def barrier() -> None:
     """Synchronize: run a whole-mesh psum and block on the result."""
     g = _group()
+    if g.ring is not None:
+        g.ring.barrier()
+        return
     n = g.size
     x = jnp.ones((n,), jnp.int32)
     out = all_reduce(x.reshape(n, 1), ReduceOp.SUM)

@@ -1,0 +1,254 @@
+"""ctypes bindings for the native shared-memory host collectives.
+
+The reference's CPU smoke path is true multi-process training over the gloo
+process group (SURVEY.md §2: gloo -> "single-host CPU backend of the same
+API (... host ring in C++)"). ``native/hostring.cpp`` is that backend: N OS
+processes rendezvous on a POSIX shm segment and run collectives through
+per-rank slots under a process-shared barrier.
+
+This module is deliberately JAX-free so spawned worker processes can import
+it without dragging in (or re-initialising) a TPU runtime. Semantics are
+torch.distributed-shaped: each *process* passes its local tensor.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_SRC = os.path.join(_NATIVE_DIR, "hostring.cpp")
+_SO = os.path.join(_NATIVE_DIR, "libhostring.so")
+
+_DTYPES = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3,
+}
+_U8 = 4  # raw-byte dtype: copy-shaped collectives on arbitrary dtypes
+_OPS = {"sum": 0, "prod": 1, "product": 1, "max": 2, "min": 3}
+
+# Half dtypes (the TPU compute dtypes) reduce via an f32 round trip: the
+# host ring is a smoke/CPU path, so the upcast bandwidth is irrelevant and
+# f32 accumulation is strictly more accurate than native-half combines.
+_HALF = {np.dtype(np.float16)}
+try:  # ml_dtypes ships with jax
+    import ml_dtypes
+
+    _HALF.add(np.dtype(ml_dtypes.bfloat16))
+except ImportError:  # pragma: no cover
+    pass
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def build_library(force: bool = False) -> str:
+    """Compile libhostring.so if missing/stale; returns the path."""
+    stale = (
+        force
+        or not os.path.exists(_SO)
+        or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+    )
+    if stale:
+        # Build to a temp name then rename: concurrent builders race benignly.
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_NATIVE_DIR)
+        os.close(fd)
+        try:
+            subprocess.run(
+                [
+                    os.environ.get("CXX", "g++"),
+                    "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+                    "-o", tmp, _SRC, "-lrt",
+                ],
+                check=True,
+                capture_output=True,
+                text=True,
+            )
+            os.replace(tmp, _SO)
+        except subprocess.CalledProcessError as e:  # pragma: no cover
+            os.unlink(tmp)
+            raise RuntimeError(f"hostring build failed:\n{e.stderr}") from e
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+    return _SO
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(build_library())
+        lib.hr_init.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_double, ctypes.POINTER(ctypes.c_void_p),
+        ]
+        lib.hr_init.restype = ctypes.c_int
+        for name, args in {
+            "hr_barrier": [ctypes.c_void_p],
+            "hr_allreduce": [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+                ctypes.c_int32, ctypes.c_int32,
+            ],
+            "hr_allgather": [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_uint64, ctypes.c_int32,
+            ],
+            "hr_reduce_scatter": [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32,
+            ],
+            "hr_broadcast": [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+                ctypes.c_int32,
+            ],
+            "hr_sendrecv": [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+                ctypes.c_int32, ctypes.c_int32,
+            ],
+            "hr_finalize": [ctypes.c_void_p],
+        }.items():
+            fn = getattr(lib, name)
+            fn.argtypes = args
+            fn.restype = ctypes.c_int
+        _lib = lib
+    return _lib
+
+
+def _check(rc: int, what: str) -> None:
+    if rc != 0:
+        raise RuntimeError(f"hostring {what} failed (rc={rc}; "
+                           f"-110=peer timeout, -22=bad args, -5=peer died)")
+
+
+def _as_contig(x, dtype_required=True) -> np.ndarray:
+    a = np.ascontiguousarray(x)
+    if dtype_required and a.dtype not in _DTYPES:
+        raise TypeError(
+            f"unsupported dtype {a.dtype}; one of {list(_DTYPES)} required"
+        )
+    return a
+
+
+class HostRingGroup:
+    """One process's membership in a shared-memory collectives group."""
+
+    def __init__(
+        self,
+        name: str,
+        rank: int,
+        world_size: int,
+        *,
+        slot_bytes: int = 4 << 20,
+        timeout_s: float = 120.0,
+    ):
+        lib = _load()
+        handle = ctypes.c_void_p()
+        # shm names must start with '/' and contain no further slashes
+        shm = "/" + name.strip("/").replace("/", "_")
+        rc = lib.hr_init(
+            shm.encode(), rank, world_size, slot_bytes, timeout_s,
+            ctypes.byref(handle),
+        )
+        _check(rc, "init")
+        self._h = handle
+        self.rank = rank
+        self.world_size = world_size
+
+    def barrier(self) -> None:
+        _check(_load().hr_barrier(self._h), "barrier")
+
+    def all_reduce(self, x, op: str = "sum") -> np.ndarray:
+        avg = op == "avg"
+        half = np.asarray(x).dtype if np.asarray(x).dtype in _HALF else None
+        if half is not None:
+            x = np.asarray(x).astype(np.float32)
+        a = _as_contig(x).copy()
+        rc = _load().hr_allreduce(
+            self._h, a.ctypes.data_as(ctypes.c_void_p), a.size,
+            _DTYPES[a.dtype], _OPS["sum" if avg else op],
+        )
+        _check(rc, "all_reduce")
+        if avg:
+            a = a / self.world_size if a.dtype.kind == "f" else a // self.world_size
+        return a.astype(half) if half is not None else a
+
+    def all_gather(self, x) -> np.ndarray:
+        a = _as_contig(x, dtype_required=False)
+        out = np.empty((self.world_size,) + a.shape, a.dtype)
+        if a.dtype in _DTYPES:
+            count, dt = a.size, _DTYPES[a.dtype]
+        else:  # any other dtype gathers as raw bytes
+            count, dt = a.nbytes, _U8
+        rc = _load().hr_allgather(
+            self._h, a.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p), count, dt,
+        )
+        _check(rc, "all_gather")
+        return out
+
+    def reduce_scatter(self, x, op: str = "sum") -> np.ndarray:
+        """x: [world_size, ...] — returns this rank's reduced chunk x[rank]."""
+        half = np.asarray(x).dtype if np.asarray(x).dtype in _HALF else None
+        if half is not None:
+            x = np.asarray(x).astype(np.float32)
+        a = _as_contig(x)
+        if a.shape[0] != self.world_size:
+            raise ValueError(
+                f"leading dim {a.shape[0]} != world_size {self.world_size}"
+            )
+        out = np.empty(a.shape[1:], a.dtype)
+        chunk = int(np.prod(a.shape[1:], dtype=np.int64))
+        rc = _load().hr_reduce_scatter(
+            self._h, a.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p), chunk, _DTYPES[a.dtype],
+            _OPS[op],
+        )
+        _check(rc, "reduce_scatter")
+        return out.astype(half) if half is not None else out
+
+    def broadcast(self, x, src: int = 0) -> np.ndarray:
+        a = _as_contig(x, dtype_required=False).copy()
+        rc = _load().hr_broadcast(
+            self._h, a.ctypes.data_as(ctypes.c_void_p), a.nbytes, src
+        )
+        _check(rc, "broadcast")
+        return a
+
+    def send(self, x, dst: int) -> None:
+        a = _as_contig(x, dtype_required=False).copy()
+        rc = _load().hr_sendrecv(
+            self._h, a.ctypes.data_as(ctypes.c_void_p), a.nbytes,
+            self.rank, dst,
+        )
+        _check(rc, "send")
+
+    def recv(self, x, src: int) -> np.ndarray:
+        """x supplies shape/dtype; returns the received array."""
+        a = _as_contig(x, dtype_required=False).copy()
+        rc = _load().hr_sendrecv(
+            self._h, a.ctypes.data_as(ctypes.c_void_p), a.nbytes,
+            src, self.rank,
+        )
+        _check(rc, "recv")
+        return a
+
+    def close(self) -> None:
+        if self._h:
+            _load().hr_finalize(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

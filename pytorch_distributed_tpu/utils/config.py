@@ -98,10 +98,8 @@ def _add_field_arg(parser: argparse.ArgumentParser, f, doc: str) -> None:
         else:
             parser.add_argument(flag, action="store_true", help=doc or None)
     else:
-        parser.add_argument(
-            flag, type=ftype, default=default,
-            help=(doc or "") + f" (default: {default})",
-        )
+        # ArgumentDefaultsHelpFormatter already appends "(default: X)"
+        parser.add_argument(flag, type=ftype, default=default, help=doc or " ")
 
 
 def parse_cli(

@@ -1,0 +1,68 @@
+"""Spawn targets for the multi-process hostring tests.
+
+Lives in its own importable module because ``multiprocessing`` spawn needs
+to pickle the target by reference. Workers must stay lightweight: the raw
+worker is JAX-free; the facade worker imports the framework (JAX on CPU).
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def raw_worker(rank: int, world: int, name: str, q) -> None:
+    """Exercise the ctypes layer directly (no JAX in the child)."""
+    try:
+        from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
+
+        with HostRingGroup(name, rank, world, timeout_s=60) as g:
+            ar = g.all_reduce(np.full(1000, rank + 1.0, np.float32))
+            assert np.all(ar == world * (world + 1) / 2), ar[:4]
+            ag = g.all_gather(np.array([rank], np.int32))
+            assert list(ag.ravel()) == list(range(world))
+            rs = g.reduce_scatter(
+                np.ones((world, 4), np.float64) * (rank + 1)
+            )
+            assert np.all(rs == world * (world + 1) / 2)
+            bc = g.broadcast(np.full(3, rank, np.int64), src=1)
+            assert np.all(bc == 1)
+            mx = g.all_reduce(np.array([rank], np.int32), op="max")
+            assert mx[0] == world - 1
+            # big payload: crosses the chunking path
+            big = g.all_reduce(np.ones(3_000_000, np.float32))
+            assert np.all(big == world)
+        q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover - reported via queue
+        q.put((rank, f"{type(e).__name__}: {e}"))
+
+
+def facade_worker(rank: int, world: int, name: str, q) -> None:
+    """Exercise the torch-shaped facade in true multi-process mode."""
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["RANK"] = str(rank)
+        os.environ["WORLD_SIZE"] = str(world)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import pytorch_distributed_tpu as ptd
+
+        ptd.init_process_group("gloo", group_name=name, timeout_s=120.0)
+        assert ptd.get_backend() == "hostring"
+        assert ptd.get_rank() == rank
+        assert ptd.get_world_size() == world
+        out = ptd.all_reduce(np.full(8, float(rank), np.float32))
+        expect = sum(range(world))
+        assert np.all(np.asarray(out) == expect), out
+        g = ptd.all_gather(np.array([rank], np.int32))
+        assert list(np.asarray(g).ravel()) == list(range(world))
+        b = ptd.broadcast(np.array([rank * 10.0], np.float32), src=2)
+        assert float(np.asarray(b)[0]) == 20.0
+        ptd.barrier()
+        ptd.destroy_process_group()
+        q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover - reported via queue
+        q.put((rank, f"{type(e).__name__}: {e}"))

@@ -1,0 +1,112 @@
+"""Native shared-memory collectives backend (the gloo equivalent).
+
+SURVEY.md §2: the reference's CPU smoke path is real multi-process training
+over the gloo process group; our native equivalent is
+``native/hostring.cpp``. These tests spawn genuine OS processes (spawn
+context, no fork of the JAX runtime) and validate both the raw ctypes layer
+and the ``init_process_group`` facade on top of it.
+"""
+
+import multiprocessing as mp
+import os
+import uuid
+
+import pytest
+
+from tests import hostring_workers
+
+
+def _run(world: int, target, timeout: float = 180.0):
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    name = f"ptdtest_{uuid.uuid4().hex[:8]}"
+    procs = [
+        ctx.Process(target=target, args=(r, world, name, q))
+        for r in range(world)
+    ]
+    # Children must never touch the (single, shared) TPU: contending for it
+    # serializes their startup past the collective timeouts. Env is
+    # inherited at child interpreter start, so set it before spawning.
+    old = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        for p in procs:
+            p.start()
+    finally:
+        if old is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = old
+    try:
+        results = [q.get(timeout=timeout) for _ in range(world)]
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+    return sorted(results)
+
+
+def test_build_library():
+    from pytorch_distributed_tpu.runtime.hostring import build_library
+
+    path = build_library()
+    assert os.path.exists(path)
+
+
+def test_raw_collectives_4proc():
+    results = _run(4, hostring_workers.raw_worker)
+    assert results == [(r, "ok") for r in range(4)], results
+
+
+def test_raw_collectives_2proc():
+    results = _run(2, hostring_workers.raw_worker)
+    assert results == [(r, "ok") for r in range(2)], results
+
+
+def test_facade_multiprocess():
+    results = _run(4, hostring_workers.facade_worker, timeout=300.0)
+    assert results == [(r, "ok") for r in range(4)], results
+
+
+def test_single_process_group_direct():
+    """HostRingGroup degenerates correctly at world_size=1."""
+    import numpy as np
+
+    from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
+
+    with HostRingGroup(f"ptdtest_{uuid.uuid4().hex[:8]}", 0, 1) as g:
+        x = np.arange(5, dtype=np.float32)
+        assert np.all(g.all_reduce(x) == x)
+        assert np.all(g.all_gather(x) == x[None])
+        assert np.all(g.broadcast(x) == x)
+        g.barrier()
+
+
+def test_half_dtypes_supported():
+    """bf16/f16 (the TPU compute dtypes) reduce via the f32 round trip."""
+    import ml_dtypes
+    import numpy as np
+
+    from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
+
+    with HostRingGroup(f"ptdtest_{uuid.uuid4().hex[:8]}", 0, 1) as g:
+        x = np.ones(4, ml_dtypes.bfloat16) * 1.5
+        out = g.all_reduce(x)
+        assert out.dtype == x.dtype and np.all(out == x)
+        h = np.ones(4, np.float16)
+        assert g.all_reduce(h, op="avg").dtype == np.float16
+        gathered = g.all_gather(x)  # raw-byte gather path
+        assert gathered.dtype == x.dtype and gathered.shape == (1, 4)
+        rs = g.reduce_scatter(x[None])
+        assert rs.dtype == x.dtype
+
+
+def test_bad_dtype_rejected():
+    import numpy as np
+
+    from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
+
+    with HostRingGroup(f"ptdtest_{uuid.uuid4().hex[:8]}", 0, 1) as g:
+        with pytest.raises(TypeError):
+            g.all_reduce(np.ones(3, np.complex64))
