@@ -57,6 +57,11 @@ from pytorch_distributed_tpu.runtime.precision import (
     current_policy,
 )
 from pytorch_distributed_tpu.runtime.prng import RngSeq, seed_all
+from pytorch_distributed_tpu.launch import (
+    ElasticAgent,
+    init_multihost,
+    spawn,
+)
 
 __version__ = "0.1.0"
 
@@ -88,4 +93,7 @@ __all__ = [
     "current_policy",
     "RngSeq",
     "seed_all",
+    "ElasticAgent",
+    "init_multihost",
+    "spawn",
 ]

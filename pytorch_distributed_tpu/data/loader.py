@@ -73,12 +73,27 @@ class DataLoader:
     def __len__(self) -> int:
         return len(self.sampler)
 
+    @staticmethod
+    def _rank_slice(indices: np.ndarray) -> np.ndarray:
+        """Under the multi-process (hostring) backend each rank fetches its
+        strided share of every global batch — the DistributedSampler
+        contract (BASELINE.json:5) without changing recipe code. Equal
+        shares are guaranteed by dropping the indivisible remainder."""
+        from pytorch_distributed_tpu.runtime import distributed as dist
+
+        g = dist._GROUP
+        if g is None or g.ring is None or g.ring.world_size == 1:
+            return indices
+        w, r = g.ring.world_size, g.ring.rank
+        n = (len(indices) // w) * w
+        return indices[r:n:w]
+
     def _produce(self, out_q: queue.Queue, stop: threading.Event) -> None:
         try:
             for indices in self.sampler:
                 if stop.is_set():
                     return
-                batch = _default_fetch(self.dataset, indices)
+                batch = _default_fetch(self.dataset, self._rank_slice(indices))
                 if self.transform is not None:
                     batch = self.transform(batch)
                 if self.sharding is not None:

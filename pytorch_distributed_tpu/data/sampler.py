@@ -35,6 +35,16 @@ class DistributedSampler:
         seed: int = 0,
         drop_last: bool = False,
     ):
+        if num_replicas is None or rank is None:
+            # multi-process (hostring) group: replicas are the ranks
+            from pytorch_distributed_tpu.runtime import distributed as dist
+
+            g = dist._GROUP
+            if g is not None and g.ring is not None:
+                if num_replicas is None:
+                    num_replicas = g.ring.world_size
+                if rank is None:
+                    rank = g.ring.rank
         if num_replicas is None:
             num_replicas = _device.process_count()
         if rank is None:

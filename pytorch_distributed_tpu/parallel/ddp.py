@@ -1,0 +1,61 @@
+"""Gradient synchronisation for the true multi-process (hostring) path.
+
+The reference's DDP wraps the model and averages gradients across ranks
+with bucketed NCCL/gloo allreduce during backward (BASELINE.json:5,
+SURVEY.md §2/§3). Under single-controller SPMD that role is played by
+sharding propagation — gradients of replicated params come out of jit
+already psum-med, so there is nothing to do.
+
+Under the *multi-process* hostring backend (one OS process per rank, the
+reference's gloo smoke path) gradients really are per-rank and must be
+averaged explicitly. ``sync_grads`` is that averaging: a single host
+callback per step that ring-allreduces every gradient leaf through the
+native shm backend. It is inserted by ``build_train_step`` between the
+gradient computation and ``apply_gradients`` — the same position as the
+reference's backward-hook allreduce, minus the bucketing (one callback
+already moves all leaves; shm "bandwidth" is a memcpy).
+
+Lockstep safety: every rank traces the same step function, so the flat
+leaf order — and therefore the allreduce order inside the callback — is
+identical across ranks.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax import tree_util
+
+
+def is_multiprocess() -> bool:
+    """True when the current process group is per-rank OS processes."""
+    from pytorch_distributed_tpu.runtime import distributed as dist
+
+    g = dist._GROUP
+    return g is not None and g.ring is not None and g.ring.world_size > 1
+
+
+def sync_grads(grads):
+    """Average gradient pytree across ranks (no-op unless multi-process).
+
+    Safe to call inside jit: the collective runs as one host callback
+    through the native hostring backend.
+    """
+    from pytorch_distributed_tpu.runtime import distributed as dist
+
+    g = dist._GROUP
+    if g is None or g.ring is None or g.ring.world_size == 1:
+        return grads
+    ring = g.ring
+    leaves, treedef = tree_util.tree_flatten(grads)
+    if not leaves:
+        return grads
+    shapes = tuple(
+        jax.ShapeDtypeStruct(np.shape(l), l.dtype) for l in leaves
+    )
+
+    def _allreduce_all(*arrs):
+        return tuple(ring.all_reduce(np.asarray(a), op="avg") for a in arrs)
+
+    synced = jax.pure_callback(_allreduce_all, shapes, *leaves)
+    return tree_util.tree_unflatten(treedef, synced)

@@ -1,0 +1,72 @@
+"""``python -m pytorch_distributed_tpu.run`` — the torchrun equivalent.
+
+torchrun-shaped flags over the ElasticAgent supervisor (launch.py):
+
+    python -m pytorch_distributed_tpu.run --nproc-per-node 4 \
+        recipes/resnet18_cifar10.py --synthetic --steps-per-epoch 5
+
+Workers get RANK/WORLD_SIZE/LOCAL_RANK/... env; ``init_process_group``
+inside the script joins the native hostring backend (multi-process CPU,
+the reference's gloo path) or, with ``--platform tpu`` on a pod, each
+worker drives its own slice after ``init_multihost()``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from pytorch_distributed_tpu.launch import ElasticAgent
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="pytorch_distributed_tpu.run",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("--nproc-per-node", type=int, default=1)
+    parser.add_argument("--nnodes", type=int, default=1)
+    parser.add_argument("--node-rank", type=int, default=0)
+    parser.add_argument("--max-restarts", type=int, default=3)
+    parser.add_argument(
+        "--platform", default="cpu", choices=("cpu", "tpu"),
+        help="worker JAX platform; cpu = hostring smoke path",
+    )
+    parser.add_argument(
+        "--standalone", action="store_true",
+        help="single-node shorthand (accepted for torchrun parity; implied)",
+    )
+    parser.add_argument("--master-addr", default=None)
+    parser.add_argument("--master-port", default=None)
+    parser.add_argument(
+        "-m", "--module", action="store_true",
+        help="treat script as a python module name (python -m style)",
+    )
+    parser.add_argument("script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    cmd = [sys.executable]
+    if args.module:
+        cmd += ["-m"]
+    cmd += [args.script] + args.script_args
+    extra_env = {}
+    if args.master_addr:
+        extra_env["MASTER_ADDR"] = args.master_addr
+    if args.master_port:
+        extra_env["MASTER_PORT"] = args.master_port
+    agent = ElasticAgent(
+        cmd=cmd,
+        nproc_per_node=args.nproc_per_node,
+        nnodes=args.nnodes,
+        node_rank=args.node_rank,
+        max_restarts=args.max_restarts,
+        platform=args.platform,
+        extra_env=extra_env,
+    )
+    return agent.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,7 +80,7 @@ def init_process_group(
     mesh_spec: Optional[_mesh.MeshSpec] = None,
     world_size: Optional[int] = None,
     rank: Optional[int] = None,
-    group_name: str = "ptd_world",
+    group_name: Optional[str] = None,
     timeout_s: float = 120.0,
 ) -> ProcessGroup:
     """Create the global "world": a mesh over all addressable devices.
@@ -111,14 +111,23 @@ def init_process_group(
                 "env) — every rank defaulting to 0 would corrupt the group"
             )
         if mesh_spec is not None:
-            raise ValueError(
-                "mesh_spec is a single-controller concept; under the "
-                "multi-process hostring backend each rank drives one device. "
-                "Unset RANK/WORLD_SIZE (or don't pass rank=) to run "
-                "single-controller SPMD with a mesh."
-            )
+            # Recipes pass MeshSpec(dp=-1) unconditionally; under the
+            # launcher each rank drives ONE device, so specs that resolve
+            # to a single device are fine (wildcards collapse to 1). Only
+            # an explicit multi-device request is a conflict.
+            if any(s > 1 for s in mesh_spec.sizes()):
+                raise ValueError(
+                    f"mesh_spec {mesh_spec} requests multiple devices but "
+                    "this process was launched one-rank-per-process "
+                    "(RANK/WORLD_SIZE set): each rank drives one device. "
+                    "Unset RANK/WORLD_SIZE to run single-controller SPMD "
+                    "with a mesh."
+                )
         if _GROUP is not None and _GROUP.ring is not None:
             _GROUP.ring.close()  # re-init: release the old shm membership
+        if group_name is None:
+            # the launcher hands every worker a per-rendezvous group name
+            group_name = os.environ.get("PTD_GROUP_NAME", "ptd_world")
         ring = HostRingGroup(
             group_name, rank, world_size, timeout_s=timeout_s
         )

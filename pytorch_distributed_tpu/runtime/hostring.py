@@ -139,6 +139,20 @@ def _as_contig(x, dtype_required=True) -> np.ndarray:
     return a
 
 
+def unlink_segment(name: str) -> None:
+    """Best-effort removal of a group's shm segment (launcher teardown).
+
+    Workers killed mid-collective never reach ``hr_finalize``; the
+    supervising agent calls this after reaping them so abandoned attempts
+    don't accumulate in /dev/shm.
+    """
+    shm = name.strip("/").replace("/", "_")
+    try:
+        os.unlink(os.path.join("/dev/shm", shm))
+    except OSError:
+        pass
+
+
 class HostRingGroup:
     """One process's membership in a shared-memory collectives group."""
 

@@ -124,6 +124,14 @@ def build_train_step(
                 k: v * inv for k, v in metrics_sum.items() if k != "_i"
             }
 
+        # True multi-process mode (hostring backend): per-rank grads must be
+        # averaged across ranks, DDP-style. Single-controller SPMD skips
+        # this — sharding propagation already psums replicated-param grads.
+        from pytorch_distributed_tpu.parallel import ddp
+
+        if ddp.is_multiprocess():
+            grads = ddp.sync_grads(grads)
+
         if scaling:
             new_scaler_state, grads_ok = scaler.functional_update(
                 grads, state.scaler_state

@@ -39,6 +39,85 @@ def raw_worker(rank: int, world: int, name: str, q) -> None:
         q.put((rank, f"{type(e).__name__}: {e}"))
 
 
+def spawn_worker(rank: int, path: str) -> None:
+    """Target for launch.spawn: env is pre-set by the launcher."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import pytorch_distributed_tpu as ptd
+
+    ptd.init_process_group("gloo")
+    world = ptd.get_world_size()
+    out = ptd.all_reduce(np.array([1.0], np.float32))
+    assert float(np.asarray(out)[0]) == world
+    assert int(os.environ["LOCAL_RANK"]) == rank
+    with open(os.path.join(path, f"rank{rank}.ok"), "w") as f:
+        f.write(str(world))
+    ptd.destroy_process_group()
+
+
+def ddp_train_worker(rank: int, path: str) -> None:
+    """Two train steps on per-rank data shards; params must stay identical
+    across ranks (the DDP invariant: averaged grads -> lockstep updates)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+
+    import pytorch_distributed_tpu as ptd
+    from pytorch_distributed_tpu.data import ArrayDataset, DataLoader
+    from pytorch_distributed_tpu.models.resnet import BasicBlock, ResNet
+    from pytorch_distributed_tpu.parallel import DataParallel
+    from pytorch_distributed_tpu.train import (
+        TrainState,
+        build_train_step,
+        classification_loss_fn,
+    )
+
+    ptd.init_process_group("gloo")
+    world = ptd.get_world_size()
+    model = ResNet(stage_sizes=[1], block_cls=BasicBlock, num_classes=4,
+                   width=8, stem="cifar")
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 8, 8, 3)),
+                           train=False)
+    state = TrainState.create(
+        apply_fn=model.apply, params=variables["params"],
+        tx=optax.sgd(0.1), batch_stats=variables["batch_stats"],
+    )
+    rng = np.random.default_rng(7)
+    ds = ArrayDataset(
+        image=rng.normal(size=(32, 8, 8, 3)).astype(np.float32),
+        label=rng.integers(4, size=(32,)).astype(np.int32),
+    )
+    strategy = DataParallel()
+    state = strategy.place(state)
+    step = strategy.compile(
+        build_train_step(classification_loss_fn(model)), state
+    )
+    loader = DataLoader(ds, 16, seed=1, sharding=strategy.batch_sharding())
+    for batch in loader:
+        # per-rank shard: loader slices the global batch by rank
+        assert batch["image"].shape[0] == 16 // world, batch["image"].shape
+        state, _ = step(state, batch)
+    flat = jnp.concatenate([
+        jnp.ravel(x).astype(jnp.float32)
+        for x in jax.tree_util.tree_leaves(state.params)
+    ])
+    # the invariant check itself runs over the ring: gather every rank's
+    # param vector and require exact agreement
+    allp = np.asarray(ptd.all_gather(np.asarray(flat)))
+    assert np.array_equal(allp[0], allp[rank]), "params diverged across ranks"
+    with open(os.path.join(path, f"ddp{rank}.ok"), "w") as f:
+        f.write("ok")
+    ptd.destroy_process_group()
+
+
+def failing_worker(rank: int) -> None:
+    """Deliberate crash target for failure-propagation tests (no JAX)."""
+    raise SystemExit(3)
+
+
 def facade_worker(rank: int, world: int, name: str, q) -> None:
     """Exercise the torch-shaped facade in true multi-process mode."""
     try:

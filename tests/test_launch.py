@@ -1,0 +1,127 @@
+"""Launcher layer: spawn() facade, the torchrun-equivalent CLI agent,
+elastic restart policy, and env plumbing (SURVEY.md §2: torchrun /
+mp.spawn -> SPMD launcher)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from pytorch_distributed_tpu.launch import ElasticAgent, _worker_env
+from tests import hostring_workers
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_worker_env_shape():
+    env = _worker_env(5, 8, "g1", node_rank=1, nproc_per_node=4)
+    assert env["RANK"] == "5"
+    assert env["WORLD_SIZE"] == "8"
+    assert env["LOCAL_RANK"] == "1"
+    assert env["LOCAL_WORLD_SIZE"] == "4"
+    assert env["GROUP_RANK"] == "1"
+    assert env["PTD_GROUP_NAME"] == "g1"
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env["MASTER_ADDR"] == "127.0.0.1"
+
+
+def test_spawn_facade(tmp_path):
+    from pytorch_distributed_tpu.launch import spawn
+
+    spawn(hostring_workers.spawn_worker, args=(str(tmp_path),), nprocs=2,
+          timeout_s=300)
+    for r in range(2):
+        assert (tmp_path / f"rank{r}.ok").read_text() == "2"
+
+
+def test_ddp_invariant_across_ranks(tmp_path):
+    """Multi-process DDP: grads average over the ring, loader shards by
+    rank, params stay bit-identical on every rank after training."""
+    from pytorch_distributed_tpu.launch import spawn
+
+    spawn(hostring_workers.ddp_train_worker, args=(str(tmp_path),),
+          nprocs=2, timeout_s=300)
+    for r in range(2):
+        assert (tmp_path / f"ddp{r}.ok").read_text() == "ok"
+
+
+def test_spawn_propagates_failure():
+    from pytorch_distributed_tpu.launch import spawn
+
+    with pytest.raises(RuntimeError, match="nonzero"):
+        spawn(hostring_workers.failing_worker, nprocs=2, timeout_s=60)
+
+
+def test_cli_end_to_end(tmp_path):
+    """The torchrun-shaped CLI runs a real collective script, 2 procs."""
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import sys; sys.path.insert(0, {REPO!r})
+        import numpy as np
+        import jax; jax.config.update("jax_platforms", "cpu")
+        import pytorch_distributed_tpu as ptd
+        ptd.init_process_group("gloo")
+        out = ptd.all_reduce(np.ones(3, np.float32))
+        assert float(np.asarray(out)[0]) == ptd.get_world_size()
+        print("WORKER_OK", ptd.get_rank())
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytorch_distributed_tpu.run",
+         "--nproc-per-node", "2", "--max-restarts", "0", str(script)],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+def test_elastic_restart(tmp_path):
+    """Agent re-rendezvouses after a worker failure (elastic recovery)."""
+    marker = tmp_path / "attempts"
+    script = tmp_path / "flaky.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        attempt = int(os.environ["TORCHELASTIC_RESTART_COUNT"])
+        rank = int(os.environ["RANK"])
+        with open({str(marker)!r} + f"_a{{attempt}}_r{{rank}}", "w"):
+            pass
+        if attempt == 0 and rank == 1:
+            sys.exit(13)  # simulated worker crash on first rendezvous
+    """))
+    agent = ElasticAgent(
+        cmd=[sys.executable, str(script)], nproc_per_node=2, max_restarts=2
+    )
+    assert agent.run() == 0
+    assert os.path.exists(str(marker) + "_a0_r1")  # crashed attempt ran
+    assert os.path.exists(str(marker) + "_a1_r0")  # restarted cleanly
+    assert not os.path.exists(str(marker) + "_a2_r0")  # no third round
+
+
+def test_elastic_gives_up():
+    agent = ElasticAgent(
+        cmd=[sys.executable, "-c", "import sys; sys.exit(7)"],
+        nproc_per_node=2, max_restarts=1,
+    )
+    assert agent.run() == 7
+
+
+def test_init_multihost_env_mapping(monkeypatch):
+    """torchrun-style env maps onto jax.distributed.initialize args."""
+    import pytorch_distributed_tpu.launch as launch
+
+    captured = {}
+
+    def fake_init(coordinator_address=None, num_processes=None,
+                  process_id=None):
+        captured.update(addr=coordinator_address, n=num_processes,
+                        pid=process_id)
+
+    import jax
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.setenv("MASTER_ADDR", "10.0.0.1")
+    monkeypatch.setenv("MASTER_PORT", "12345")
+    monkeypatch.setenv("WORLD_SIZE", "16")
+    monkeypatch.setenv("RANK", "3")
+    launch.init_multihost()
+    assert captured == {"addr": "10.0.0.1:12345", "n": 16, "pid": 3}
