@@ -77,16 +77,20 @@ class DataLoader:
     def _rank_slice(indices: np.ndarray) -> np.ndarray:
         """Under the multi-process (hostring) backend each rank fetches its
         strided share of every global batch — the DistributedSampler
-        contract (BASELINE.json:5) without changing recipe code. Equal
-        shares are guaranteed by dropping the indivisible remainder."""
+        contract (BASELINE.json:5) without changing recipe code."""
         from pytorch_distributed_tpu.runtime import distributed as dist
 
-        g = dist._GROUP
-        if g is None or g.ring is None or g.ring.world_size == 1:
+        ring = dist.multiprocess_ring()
+        if ring is None or ring.world_size == 1:
             return indices
-        w, r = g.ring.world_size, g.ring.rank
-        n = (len(indices) // w) * w
-        return indices[r:n:w]
+        w, r = ring.world_size, ring.rank
+        if len(indices) % w != 0:
+            raise ValueError(
+                f"global batch size {len(indices)} is not divisible by "
+                f"world_size {w}: every rank must get an equal share "
+                "(pick a batch size that is a multiple of the rank count)"
+            )
+        return indices[r::w]
 
     def _produce(self, out_q: queue.Queue, stop: threading.Event) -> None:
         try:

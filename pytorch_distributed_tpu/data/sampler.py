@@ -39,12 +39,12 @@ class DistributedSampler:
             # multi-process (hostring) group: replicas are the ranks
             from pytorch_distributed_tpu.runtime import distributed as dist
 
-            g = dist._GROUP
-            if g is not None and g.ring is not None:
+            ring = dist.multiprocess_ring()
+            if ring is not None:
                 if num_replicas is None:
-                    num_replicas = g.ring.world_size
+                    num_replicas = ring.world_size
                 if rank is None:
-                    rank = g.ring.rank
+                    rank = ring.rank
         if num_replicas is None:
             num_replicas = _device.process_count()
         if rank is None:

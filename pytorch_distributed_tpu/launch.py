@@ -61,6 +61,11 @@ def _worker_env(
     if platform == "cpu":
         # stop the axon TPU plugin registration in workers
         env["PALLAS_AXON_POOL_IPS"] = ""
+    if platform == "tpu":
+        # tpu workers are one-controller-per-HOST: init_process_group must
+        # rendezvous via jax.distributed (RANK = host index), never join
+        # the host-local shm ring with the global world size.
+        env["PTD_MULTIHOST"] = "1"
     return env
 
 
@@ -100,6 +105,7 @@ def spawn(
     os.environ["JAX_PLATFORMS"] = platform
     if platform == "cpu":
         os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    procs = []
     try:
         procs = [
             ctx.Process(
@@ -110,6 +116,17 @@ def spawn(
         ]
         for p in procs:
             p.start()
+    except BaseException:
+        # partial start: reap the workers already running — they'd block
+        # in the rendezvous waiting for ranks that will never come
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=10)
+        from pytorch_distributed_tpu.runtime.hostring import unlink_segment
+
+        unlink_segment(group_name)
+        raise
     finally:
         for k, v in old_env.items():
             if v is None:

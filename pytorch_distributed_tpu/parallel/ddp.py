@@ -31,22 +31,26 @@ def is_multiprocess() -> bool:
     """True when the current process group is per-rank OS processes."""
     from pytorch_distributed_tpu.runtime import distributed as dist
 
-    g = dist._GROUP
-    return g is not None and g.ring is not None and g.ring.world_size > 1
+    ring = dist.multiprocess_ring()
+    return ring is not None and ring.world_size > 1
 
 
 def sync_grads(grads):
     """Average gradient pytree across ranks (no-op unless multi-process).
 
-    Safe to call inside jit: the collective runs as one host callback
-    through the native hostring backend.
+    Safe to call inside jit: the collective runs as ONE ordered io_callback
+    through the native hostring backend. ``io_callback(ordered=True)`` is
+    mandatory — a collective is a side-effecting, peer-synchronised call,
+    and ``pure_callback`` is documented as freely elidable/duplicable,
+    either of which would desync the ring and hang the other ranks.
     """
+    from jax.experimental import io_callback
+
     from pytorch_distributed_tpu.runtime import distributed as dist
 
-    g = dist._GROUP
-    if g is None or g.ring is None or g.ring.world_size == 1:
+    ring = dist.multiprocess_ring()
+    if ring is None or ring.world_size == 1:
         return grads
-    ring = g.ring
     leaves, treedef = tree_util.tree_flatten(grads)
     if not leaves:
         return grads
@@ -57,5 +61,5 @@ def sync_grads(grads):
     def _allreduce_all(*arrs):
         return tuple(ring.all_reduce(np.asarray(a), op="avg") for a in arrs)
 
-    synced = jax.pure_callback(_allreduce_all, shapes, *leaves)
+    synced = io_callback(_allreduce_all, shapes, *leaves, ordered=True)
     return tree_util.tree_unflatten(treedef, synced)

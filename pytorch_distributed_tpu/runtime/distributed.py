@@ -96,6 +96,12 @@ def init_process_group(
     global _GROUP
     if rank is None and "RANK" in os.environ:
         rank = int(os.environ["RANK"])
+    # Multi-host (pod) launch: one controller per host. Rendezvous first so
+    # jax.devices() spans the pod, then fall through to the single-controller
+    # path — RANK here is the host index, not a per-device rank.
+    if os.environ.get("PTD_MULTIHOST") == "1":
+        _ensure_multihost_init()
+        rank = None
     if backend == "hostring" or (
         rank is not None and backend in (None, "gloo", "cpu")
     ):
@@ -166,6 +172,28 @@ def init_process_group(
     mesh = _mesh.make_mesh(mesh_spec, devices=devices)
     _GROUP = ProcessGroup(mesh=mesh, backend=backend)
     return _GROUP
+
+
+_MULTIHOST_DONE = False
+
+
+def _ensure_multihost_init() -> None:
+    global _MULTIHOST_DONE
+    if not _MULTIHOST_DONE:
+        from pytorch_distributed_tpu.launch import init_multihost
+
+        init_multihost()
+        _MULTIHOST_DONE = True
+
+
+def multiprocess_ring():
+    """The HostRingGroup when running one-process-per-rank, else None.
+
+    The public accessor for "is this the true multi-process path" — data
+    loaders, samplers, and the DDP grad sync all key off it.
+    """
+    g = _GROUP
+    return g.ring if g is not None else None
 
 
 def destroy_process_group() -> None:

@@ -164,6 +164,7 @@ class HostRingGroup:
         *,
         slot_bytes: int = 4 << 20,
         timeout_s: float = 120.0,
+        debug: Optional[bool] = None,
     ):
         lib = _load()
         handle = ctypes.c_void_p()
@@ -177,6 +178,37 @@ class HostRingGroup:
         self._h = handle
         self.rank = rank
         self.world_size = world_size
+        if debug is None:
+            # DETAIL turns on cross-rank call verification, the analogue
+            # of TORCH_DISTRIBUTED_DEBUG=DETAIL (SURVEY.md §5: collective
+            # mismatch is the SPMD-era data race)
+            debug = os.environ.get(
+                "PTD_DISTRIBUTED_DEBUG", ""
+            ).upper() == "DETAIL"
+        self.debug = debug
+
+    _FP_BYTES = 96
+
+    def _verify_uniform(self, kind: str, a: np.ndarray, op: str = "") -> None:
+        """Debug mode: every rank must be issuing the SAME collective with
+        the same shape/dtype — divergence otherwise corrupts data or hangs.
+        The fingerprints themselves ride a raw allgather over the ring."""
+        sig = f"{kind}|{a.shape}|{a.dtype}|{op}".encode()[: self._FP_BYTES]
+        buf = np.zeros(self._FP_BYTES, np.uint8)
+        buf[: len(sig)] = np.frombuffer(sig, np.uint8)
+        out = np.empty((self.world_size, self._FP_BYTES), np.uint8)
+        rc = _load().hr_allgather(
+            self._h, buf.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p), self._FP_BYTES, _U8,
+        )
+        _check(rc, "debug fingerprint allgather")
+        sigs = [bytes(row).rstrip(b"\x00").decode() for row in out]
+        if len(set(sigs)) != 1:
+            detail = "; ".join(f"rank{r}: {s}" for r, s in enumerate(sigs))
+            raise RuntimeError(
+                f"collective mismatch across ranks (PTD_DISTRIBUTED_DEBUG"
+                f"=DETAIL): {detail}"
+            )
 
     def barrier(self) -> None:
         _check(_load().hr_barrier(self._h), "barrier")
@@ -187,6 +219,8 @@ class HostRingGroup:
         if half is not None:
             x = np.asarray(x).astype(np.float32)
         a = _as_contig(x).copy()
+        if self.debug:
+            self._verify_uniform("all_reduce", a, op)
         rc = _load().hr_allreduce(
             self._h, a.ctypes.data_as(ctypes.c_void_p), a.size,
             _DTYPES[a.dtype], _OPS["sum" if avg else op],
@@ -198,6 +232,8 @@ class HostRingGroup:
 
     def all_gather(self, x) -> np.ndarray:
         a = _as_contig(x, dtype_required=False)
+        if self.debug:
+            self._verify_uniform("all_gather", a)
         out = np.empty((self.world_size,) + a.shape, a.dtype)
         if a.dtype in _DTYPES:
             count, dt = a.size, _DTYPES[a.dtype]
@@ -220,6 +256,8 @@ class HostRingGroup:
             raise ValueError(
                 f"leading dim {a.shape[0]} != world_size {self.world_size}"
             )
+        if self.debug:
+            self._verify_uniform("reduce_scatter", a, op)
         out = np.empty(a.shape[1:], a.dtype)
         chunk = int(np.prod(a.shape[1:], dtype=np.int64))
         rc = _load().hr_reduce_scatter(
@@ -232,6 +270,8 @@ class HostRingGroup:
 
     def broadcast(self, x, src: int = 0) -> np.ndarray:
         a = _as_contig(x, dtype_required=False).copy()
+        if self.debug:
+            self._verify_uniform("broadcast", a, str(src))
         rc = _load().hr_broadcast(
             self._h, a.ctypes.data_as(ctypes.c_void_p), a.nbytes, src
         )

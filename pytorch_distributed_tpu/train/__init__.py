@@ -25,6 +25,13 @@ from pytorch_distributed_tpu.train.checkpoint import (
     checkpoint_exists,
     checkpoint_step,
 )
+from pytorch_distributed_tpu.train.elastic import (
+    EX_TEMPFAIL,
+    Preempted,
+    PreemptionHandler,
+    Watchdog,
+    fit_elastic,
+)
 
 __all__ = [
     "TrainState",
@@ -40,5 +47,10 @@ __all__ = [
     "save_checkpoint",
     "restore_checkpoint",
     "checkpoint_exists",
+    "EX_TEMPFAIL",
+    "Preempted",
+    "PreemptionHandler",
+    "Watchdog",
+    "fit_elastic",
     "checkpoint_step",
 ]

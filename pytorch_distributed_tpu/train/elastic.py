@@ -1,0 +1,196 @@
+"""Failure detection & elastic recovery (SURVEY.md §5).
+
+The reference gets elasticity from torchrun's agent: detect a dead worker,
+tear down the world, restart from the last checkpoint. On TPU the failure
+mode that matters is different — pods are *preempted* (SIGTERM with a
+grace window) and single-controller SPMD has no per-rank crash to detect —
+so the TPU-native subsystem is:
+
+* ``PreemptionHandler`` — catches SIGTERM/SIGINT (and cloud "about to be
+  preempted" signals routed as SIGTERM), flips a flag the Trainer checks
+  between steps; the Trainer then checkpoints and raises ``Preempted``.
+  Paired with ``ElasticAgent``'s restart policy (launch.py) and
+  ``Trainer.restore_checkpoint``, this closes the preempt→resume loop.
+* ``Watchdog`` — hang detection: a daemon thread that fires if no train
+  step completes within ``stall_timeout_s`` (XLA collective deadlocks and
+  input-pipeline stalls present as silent hangs), dumping all Python
+  stacks via ``faulthandler`` before optionally killing the process so
+  the supervising agent can restart it.
+
+``EX_TEMPFAIL`` (75) is the conventional "retry me" exit code recipes use
+after a preemption checkpoint.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+EX_TEMPFAIL = 75  # exit code: "transient failure, restart me"
+
+
+class Preempted(RuntimeError):
+    """Raised by the Trainer after a preemption checkpoint is on disk."""
+
+    def __init__(self, step: int, message: str = ""):
+        super().__init__(message or f"preempted at step {step}")
+        self.step = step
+
+
+class PreemptionHandler:
+    """Flag-based SIGTERM/SIGINT latch, installable as a context manager.
+
+    Signal handlers must do almost nothing (they can run inside XLA
+    dispatch); the handler only records the request. The training loop
+    polls ``requested`` at step boundaries — the only points where state
+    is consistent enough to checkpoint.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        # SIGTERM only by default: cloud preemption is SIGTERM, and users
+        # expect Ctrl-C to stay a KeyboardInterrupt. Pass
+        # ``signals=(SIGTERM, SIGINT)`` to checkpoint on Ctrl-C too.
+        self._signals = tuple(signals)
+        self._prev = {}
+        self._requested = threading.Event()
+        self._installed = False
+
+    def _on_signal(self, signum, frame):
+        self._requested.set()
+        logger.warning(
+            "signal %s received — will checkpoint and stop at the next "
+            "step boundary", signal.Signals(signum).name,
+        )
+
+    def install(self) -> "PreemptionHandler":
+        if not self._installed:
+            try:
+                for s in self._signals:
+                    self._prev[s] = signal.signal(s, self._on_signal)
+            except ValueError:
+                # signal.signal only works on the main thread; fit() in a
+                # worker thread simply runs without preemption handling
+                logger.warning(
+                    "not on the main thread — preemption signals will not "
+                    "be caught (checkpoint via ckpt_every_steps instead)"
+                )
+                self._prev.clear()
+                return self
+            self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed:
+            for s, prev in self._prev.items():
+                signal.signal(s, prev)
+            self._prev.clear()
+            self._installed = False
+
+    @property
+    def requested(self) -> bool:
+        return self._requested.is_set()
+
+    def reset(self) -> None:
+        self._requested.clear()
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+
+
+def fit_elastic(trainer):
+    """``trainer.fit()`` with the elastic exit contract: on preemption the
+    checkpoint is already on disk (Trainer wrote it before raising), so
+    exit ``EX_TEMPFAIL`` — the ElasticAgent / cluster scheduler restarts
+    the job, and ``restore_checkpoint`` resumes it."""
+    try:
+        return trainer.fit()
+    except Preempted as e:
+        logger.warning(
+            "exiting %d after preemption checkpoint (step %d)",
+            EX_TEMPFAIL, e.step,
+        )
+        sys.exit(EX_TEMPFAIL)
+
+
+class Watchdog:
+    """Detect silent hangs: no progress tick within ``stall_timeout_s``.
+
+    ``tick()`` is called by the training loop after every step. On stall
+    the watchdog logs, dumps every thread's Python stack (faulthandler),
+    calls ``on_stall`` if given, and — when ``fatal`` — kills the process
+    with SIGABRT so a supervising ElasticAgent restarts it from the last
+    checkpoint instead of burning the job's walltime on a deadlock.
+    """
+
+    def __init__(
+        self,
+        stall_timeout_s: float,
+        *,
+        fatal: bool = False,
+        on_stall: Optional[Callable[[float], None]] = None,
+        poll_s: Optional[float] = None,
+    ):
+        self.stall_timeout_s = float(stall_timeout_s)
+        self.fatal = fatal
+        self.on_stall = on_stall
+        self._poll_s = poll_s or max(0.5, self.stall_timeout_s / 10.0)
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stalled = False
+
+    def tick(self) -> None:
+        self._last = time.monotonic()
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            idle = time.monotonic() - self._last
+            if idle > self.stall_timeout_s:
+                self.stalled = True
+                logger.error(
+                    "watchdog: no train step for %.1fs (limit %.1fs) — "
+                    "dumping stacks", idle, self.stall_timeout_s,
+                )
+                try:
+                    faulthandler.dump_traceback(file=sys.stderr)
+                except Exception:  # pragma: no cover
+                    pass
+                if self.on_stall is not None:
+                    self.on_stall(idle)
+                if self.fatal:  # pragma: no cover - kills the process
+                    os.kill(os.getpid(), signal.SIGABRT)
+                # one report per stall: wait for the next tick to re-arm
+                self._last = time.monotonic()
+
+    def start(self) -> "Watchdog":
+        if self._thread is None:
+            self.tick()
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._watch, name="ptd-watchdog", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from pytorch_distributed_tpu.runtime import distributed as dist
 from pytorch_distributed_tpu.runtime.precision import GradScaler
@@ -163,6 +164,9 @@ class TrainerConfig:
     ckpt_every_steps: Optional[int] = None  # None -> end of epoch only
     eval_every_epochs: int = 1
     samples_axis: str = "image"  # batch leaf whose dim0 counts samples
+    # failure detection / elastic recovery (train/elastic.py):
+    handle_preemption: bool = True  # SIGTERM -> checkpoint -> Preempted
+    stall_timeout_s: Optional[float] = None  # watchdog hang detection
 
 
 class Trainer:
@@ -197,6 +201,8 @@ class Trainer:
         self.last_eval_metrics: Dict[str, float] = {}
         self._first_epoch = 0
         self._resume_skip_batches = 0
+        self._preemption = None
+        self._watchdog = None
 
     # -- checkpointing ------------------------------------------------------
     def save_checkpoint(self, tag: str = "latest") -> Optional[str]:
@@ -239,16 +245,45 @@ class Trainer:
 
     # -- loops --------------------------------------------------------------
     def fit(self) -> TrainState:
+        from pytorch_distributed_tpu.train import elastic
+
         cfg = self.config
-        for epoch in range(self._first_epoch, cfg.epochs):
-            self.train_loader.set_epoch(epoch)
-            self._train_epoch(epoch)
-            if self.eval_step is not None and (
-                (epoch + 1) % cfg.eval_every_epochs == 0
-            ):
-                self.evaluate(epoch)
-            self.save_checkpoint()
+        self._preemption = (
+            elastic.PreemptionHandler().install()
+            if cfg.handle_preemption else None
+        )
+        self._watchdog = (
+            elastic.Watchdog(cfg.stall_timeout_s).start()
+            if cfg.stall_timeout_s else None
+        )
+        try:
+            for epoch in range(self._first_epoch, cfg.epochs):
+                self.train_loader.set_epoch(epoch)
+                self._train_epoch(epoch)
+                if self.eval_step is not None and (
+                    (epoch + 1) % cfg.eval_every_epochs == 0
+                ):
+                    self.evaluate(epoch)
+                self.save_checkpoint()
+        finally:
+            if self._preemption is not None:
+                self._preemption.uninstall()
+            if self._watchdog is not None:
+                self._watchdog.stop()
         return self.state
+
+    def _check_preemption(self) -> None:
+        """Step-boundary poll: checkpoint and bail out on SIGTERM/SIGINT."""
+        from pytorch_distributed_tpu.train import elastic
+
+        if self._preemption is not None and self._preemption.requested:
+            step = int(self.state.step)
+            self.save_checkpoint()
+            logger.warning(
+                "preemption checkpoint written at step %d — exiting for "
+                "restart (resume restores from ckpt_dir)", step,
+            )
+            raise elastic.Preempted(step)
 
     def _train_epoch(self, epoch: int) -> None:
         cfg = self.config
@@ -263,6 +298,9 @@ class Trainer:
             n = self._batch_samples(batch)
             self.state, metrics = self.train_step(self.state, batch)
             step = int(self.state.step)
+            if self._watchdog is not None:
+                self._watchdog.tick()
+            self._check_preemption()
             steps_since_log += 1
             if cfg.log_every and step % cfg.log_every == 0:
                 # sync point: pull metrics (blocks on the step's result)
@@ -292,6 +330,18 @@ class Trainer:
             for k, v in metrics.items():
                 sums[k] = sums.get(k, 0.0) + float(v) * n
             count += n
+        # multi-process mode: each rank saw 1/world of the eval set; sum
+        # the weighted sums and counts over the ring so every rank reports
+        # full-set metrics (reference DDP evals the full set too)
+
+        ring = dist.multiprocess_ring()
+        if ring is not None and ring.world_size > 1 and sums:
+            keys = sorted(sums)
+            vec = np.array([sums[k] for k in keys] + [float(count)],
+                           np.float64)
+            vec = ring.all_reduce(vec, op="sum")
+            sums = dict(zip(keys, vec[:-1]))
+            count = int(vec[-1])
         means = {k: v / max(count, 1) for k, v in sums.items()}
         self.last_eval_metrics = means
         logger.info(

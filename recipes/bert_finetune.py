@@ -29,6 +29,7 @@ from pytorch_distributed_tpu.models import (
 from pytorch_distributed_tpu.parallel import DataParallel
 from pytorch_distributed_tpu.runtime.mesh import MeshSpec
 from pytorch_distributed_tpu.train import (
+    fit_elastic,
     Trainer,
     TrainerConfig,
     TrainState,
@@ -105,7 +106,7 @@ def main(argv=None):
         # fit() must stay inside autocast: jit traces lazily at the first
         # step, and the policy is read at trace time
         trainer.restore_checkpoint()
-        state = trainer.fit()
+        state = fit_elastic(trainer)
     log_rank0("done: step=%d", int(state.step))
     return state
 

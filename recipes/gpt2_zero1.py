@@ -28,6 +28,7 @@ from pytorch_distributed_tpu.models import GPT2Config, GPT2LMHead, gpt2_partitio
 from pytorch_distributed_tpu.parallel import ZeRO1
 from pytorch_distributed_tpu.runtime.mesh import MeshSpec
 from pytorch_distributed_tpu.train import (
+    fit_elastic,
     Trainer,
     TrainerConfig,
     TrainState,
@@ -102,7 +103,7 @@ def main(argv=None):
         ),
     )
     trainer.restore_checkpoint()
-    state = trainer.fit()
+    state = fit_elastic(trainer)
     log_rank0("done: step=%d", int(state.step))
     return state
 

@@ -31,6 +31,7 @@ from pytorch_distributed_tpu.models import (
 from pytorch_distributed_tpu.parallel import FSDP
 from pytorch_distributed_tpu.runtime.mesh import MeshSpec
 from pytorch_distributed_tpu.train import (
+    fit_elastic,
     Trainer,
     TrainerConfig,
     TrainState,
@@ -103,7 +104,7 @@ def main(argv=None):
         ),
     )
     trainer.restore_checkpoint()
-    state = trainer.fit()
+    state = fit_elastic(trainer)
     log_rank0("done: step=%d", int(state.step))
     return state
 

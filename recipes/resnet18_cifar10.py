@@ -27,6 +27,7 @@ from pytorch_distributed_tpu.models import ResNet18
 from pytorch_distributed_tpu.parallel import DataParallel
 from pytorch_distributed_tpu.runtime.mesh import MeshSpec
 from pytorch_distributed_tpu.train import (
+    fit_elastic,
     Trainer,
     TrainerConfig,
     TrainState,
@@ -120,7 +121,7 @@ def main(argv=None):
         ),
     )
     trainer.restore_checkpoint()
-    state = trainer.fit()  # fit() already evaluates the final epoch
+    state = fit_elastic(trainer)  # fit() already evaluates the final epoch
     metrics = trainer.last_eval_metrics
     log_rank0("done: step=%d %s", int(state.step), metrics)
     return metrics

@@ -36,6 +36,7 @@ from pytorch_distributed_tpu.models import ResNet50
 from pytorch_distributed_tpu.parallel import DataParallel
 from pytorch_distributed_tpu.runtime.mesh import MeshSpec
 from pytorch_distributed_tpu.train import (
+    fit_elastic,
     Trainer,
     TrainerConfig,
     TrainState,
@@ -152,7 +153,7 @@ def main(argv=None):
     )
     trainer.restore_checkpoint()
     with maybe_trace(cfg.profile_dir):
-        state = trainer.fit()
+        state = fit_elastic(trainer)
     metrics = trainer.last_eval_metrics
     log_rank0("done: step=%d %s", int(state.step), metrics)
     return metrics

@@ -113,6 +113,27 @@ def ddp_train_worker(rank: int, path: str) -> None:
     ptd.destroy_process_group()
 
 
+def mismatch_worker(rank: int, world: int, name: str, q) -> None:
+    """Debug mode must catch ranks issuing different collectives."""
+    try:
+        from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
+
+        with HostRingGroup(name, rank, world, timeout_s=60,
+                           debug=True) as g:
+            # uniform call passes
+            g.all_reduce(np.ones(4, np.float32))
+            # divergent shapes must raise on every rank
+            try:
+                g.all_reduce(np.ones(4 + rank, np.float32))
+            except RuntimeError as e:
+                assert "collective mismatch" in str(e), e
+                q.put((rank, "ok"))
+                return
+            q.put((rank, "no error raised"))
+    except Exception as e:  # pragma: no cover - reported via queue
+        q.put((rank, f"{type(e).__name__}: {e}"))
+
+
 def failing_worker(rank: int) -> None:
     """Deliberate crash target for failure-propagation tests (no JAX)."""
     raise SystemExit(3)

@@ -69,6 +69,13 @@ def test_facade_multiprocess():
     assert results == [(r, "ok") for r in range(4)], results
 
 
+def test_collective_mismatch_detected():
+    """PTD_DISTRIBUTED_DEBUG=DETAIL analogue: divergent collective calls
+    across ranks raise instead of corrupting data (SURVEY.md §5)."""
+    results = _run(2, hostring_workers.mismatch_worker)
+    assert results == [(r, "ok") for r in range(2)], results
+
+
 def test_single_process_group_direct():
     """HostRingGroup degenerates correctly at world_size=1."""
     import numpy as np

@@ -105,6 +105,26 @@ def test_elastic_gives_up():
     assert agent.run() == 7
 
 
+def test_multihost_env_routes_to_single_controller(monkeypatch):
+    """PTD_MULTIHOST=1 (tpu pod launch): init_process_group rendezvouses
+    via jax.distributed and stays single-controller — it must NOT join the
+    host-local shm ring with the global world size."""
+    from pytorch_distributed_tpu.runtime import distributed as dist
+
+    called = []
+    monkeypatch.setattr(
+        "pytorch_distributed_tpu.launch.init_multihost",
+        lambda: called.append(1),
+    )
+    monkeypatch.setattr(dist, "_MULTIHOST_DONE", False)
+    monkeypatch.setenv("PTD_MULTIHOST", "1")
+    monkeypatch.setenv("RANK", "0")
+    monkeypatch.setenv("WORLD_SIZE", "2")
+    g = dist.init_process_group()
+    assert called == [1]
+    assert g.ring is None
+
+
 def test_init_multihost_env_mapping(monkeypatch):
     """torchrun-style env maps onto jax.distributed.initialize args."""
     import pytorch_distributed_tpu.launch as launch
