@@ -1,0 +1,128 @@
+// prefetch: native data-loader kernels (threaded gather + image pipeline).
+//
+// The reference's input pipeline leans on torch DataLoader worker
+// *processes* plus torchvision's C++ image ops; the host-side equivalent
+// here is a GIL-free, multithreaded batch assembler: gather rows of a
+// (possibly memmapped) dataset array and, for images, fuse
+// crop -> horizontal flip -> u8->f32 normalize into one pass over the
+// pixels. ctypes releases the GIL for the whole call, so worker threads
+// scale with host cores — the property that matters for feeding an
+// ImageNet-rate TPU from the host (SURVEY.md §7 hard part b).
+//
+// Augmentation *parameters* (crop offsets, flip flags) are produced by the
+// caller: randomness stays in Python where it is seeded/reproducible, the
+// pixel work stays here.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kErrInval = -22;
+
+int clamp_threads(int want, int64_t items) {
+  unsigned hw = std::thread::hardware_concurrency();
+  int t = want > 0 ? want : int(hw ? hw : 1);
+  t = std::min<int64_t>(t, items > 0 ? items : 1);
+  return std::max(t, 1);
+}
+
+template <typename Fn>
+void parallel_for(int64_t n, int num_threads, Fn&& fn) {
+  const int t = clamp_threads(num_threads, n);
+  if (t == 1) {
+    fn(int64_t{0}, n);
+    return;
+  }
+  std::vector<std::thread> workers;
+  workers.reserve(t);
+  const int64_t chunk = (n + t - 1) / t;
+  for (int i = 0; i < t; ++i) {
+    const int64_t lo = i * chunk;
+    const int64_t hi = std::min<int64_t>(lo + chunk, n);
+    if (lo >= hi) break;
+    workers.emplace_back([&fn, lo, hi] { fn(lo, hi); });
+  }
+  for (auto& w : workers) w.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Gather rows: out[i, :] = src[indices[i], :] for fixed-size rows.
+int pf_gather_rows(const void* src, uint64_t row_bytes, int64_t n_src,
+                   const int64_t* indices, int64_t n, void* out,
+                   int num_threads) {
+  if (!src || !indices || !out || row_bytes == 0) return kErrInval;
+  for (int64_t i = 0; i < n; ++i)
+    if (indices[i] < 0 || indices[i] >= n_src) return kErrInval;
+  const uint8_t* s = (const uint8_t*)src;
+  uint8_t* d = (uint8_t*)out;
+  parallel_for(n, num_threads, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i)
+      memcpy(d + uint64_t(i) * row_bytes,
+             s + uint64_t(indices[i]) * row_bytes, row_bytes);
+  });
+  return 0;
+}
+
+// Fused image batch assembly:
+//   for sample i:  src[indices[i]] (u8, H x W x C, row-major)
+//     -> crop outH x outW at (crop_y[i], crop_x[i])
+//     -> optional horizontal flip (flip[i])
+//     -> f32 normalize: (px/255 - mean[c]) * stdinv[c]
+// Caller guarantees 0 <= crop_y <= H-outH and 0 <= crop_x <= W-outW.
+int pf_image_batch(const uint8_t* src, int64_t n_src, int H, int W, int C,
+                   const int64_t* indices, int64_t n,
+                   const int32_t* crop_y, const int32_t* crop_x,
+                   const uint8_t* flip, const float* mean,
+                   const float* stdinv, float* out, int outH, int outW,
+                   int num_threads) {
+  if (!src || !indices || !out || !mean || !stdinv) return kErrInval;
+  if (outH <= 0 || outW <= 0 || outH > H || outW > W || C <= 0 || C > 16)
+    return kErrInval;
+  for (int64_t i = 0; i < n; ++i) {
+    if (indices[i] < 0 || indices[i] >= n_src) return kErrInval;
+    if (crop_y && (crop_y[i] < 0 || crop_y[i] > H - outH)) return kErrInval;
+    if (crop_x && (crop_x[i] < 0 || crop_x[i] > W - outW)) return kErrInval;
+  }
+  const uint64_t src_img = uint64_t(H) * W * C;
+  const uint64_t out_img = uint64_t(outH) * outW * C;
+  // precompute the u8 -> normalized-f32 LUT per channel: 256*C floats
+  std::vector<float> lut(size_t(256) * C);
+  for (int c = 0; c < C; ++c)
+    for (int v = 0; v < 256; ++v)
+      lut[size_t(c) * 256 + v] = (float(v) / 255.0f - mean[c]) * stdinv[c];
+
+  parallel_for(n, num_threads, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const uint8_t* img = src + uint64_t(indices[i]) * src_img;
+      float* dst = out + uint64_t(i) * out_img;
+      const int cy = crop_y ? crop_y[i] : (H - outH) / 2;
+      const int cx = crop_x ? crop_x[i] : (W - outW) / 2;
+      const bool fl = flip && flip[i];
+      for (int y = 0; y < outH; ++y) {
+        const uint8_t* row = img + (uint64_t(cy + y) * W + cx) * C;
+        float* drow = dst + uint64_t(y) * outW * C;
+        if (!fl) {
+          for (int x = 0; x < outW; ++x)
+            for (int c = 0; c < C; ++c)
+              drow[x * C + c] = lut[size_t(c) * 256 + row[x * C + c]];
+        } else {
+          for (int x = 0; x < outW; ++x) {
+            const uint8_t* px = row + (outW - 1 - x) * C;
+            for (int c = 0; c < C; ++c)
+              drow[x * C + c] = lut[size_t(c) * 256 + px[c]];
+          }
+        }
+      }
+    }
+  });
+  return 0;
+}
+
+}  // extern "C"

@@ -15,6 +15,10 @@ TPU-native counterpart of the reference recipes' ``DistributedSampler`` +
 
 from pytorch_distributed_tpu.data.sampler import DistributedSampler, GlobalBatchSampler
 from pytorch_distributed_tpu.data.loader import DataLoader
+from pytorch_distributed_tpu.data.native_pipeline import (
+    ImageBatchPipeline,
+    gather_rows,
+)
 from pytorch_distributed_tpu.data.datasets import (
     ArrayDataset,
     SyntheticImageDataset,
@@ -26,6 +30,8 @@ __all__ = [
     "DistributedSampler",
     "GlobalBatchSampler",
     "DataLoader",
+    "ImageBatchPipeline",
+    "gather_rows",
     "ArrayDataset",
     "SyntheticImageDataset",
     "SyntheticTextDataset",

@@ -58,14 +58,19 @@ class DataLoader:
         prefetch: int = 2,
         sampler: Optional[GlobalBatchSampler] = None,
         transform: Optional[Callable[[Any], Any]] = None,
+        fetch: Optional[Callable[[Any, np.ndarray], Any]] = None,
     ):
+        """``fetch(dataset, indices) -> batch`` overrides the default
+        gather — e.g. the native augmenting ImageBatchPipeline."""
         self.dataset = dataset
         self.sampler = sampler or GlobalBatchSampler(
             len(dataset), batch_size, shuffle=shuffle, seed=seed, drop_last=drop_last
         )
+        self.fetch = fetch
         self.sharding = sharding
         self.prefetch = max(1, prefetch)
         self.transform = transform
+        self._warned_remainder = False
 
     def set_epoch(self, epoch: int) -> None:
         self.sampler.set_epoch(epoch)
@@ -73,31 +78,46 @@ class DataLoader:
     def __len__(self) -> int:
         return len(self.sampler)
 
-    @staticmethod
-    def _rank_slice(indices: np.ndarray) -> np.ndarray:
+    def _rank_slice(self, indices: np.ndarray) -> np.ndarray:
         """Under the multi-process (hostring) backend each rank fetches its
         strided share of every global batch — the DistributedSampler
-        contract (BASELINE.json:5) without changing recipe code."""
+        contract (BASELINE.json:5) without changing recipe code.
+
+        A batch that doesn't divide by world_size (the ``drop_last=False``
+        tail batch of an eval epoch) sheds its remainder so every rank
+        stays in lockstep — loudly, once. A batch smaller than the rank
+        count cannot be sharded at all and raises."""
         from pytorch_distributed_tpu.runtime import distributed as dist
 
         ring = dist.multiprocess_ring()
         if ring is None or ring.world_size == 1:
             return indices
         w, r = ring.world_size, ring.rank
-        if len(indices) % w != 0:
+        n = (len(indices) // w) * w
+        if n == 0:
             raise ValueError(
-                f"global batch size {len(indices)} is not divisible by "
-                f"world_size {w}: every rank must get an equal share "
-                "(pick a batch size that is a multiple of the rank count)"
+                f"batch of {len(indices)} cannot be split across "
+                f"world_size {w} ranks; use a batch size >= the rank count"
             )
-        return indices[r::w]
+        if n != len(indices) and not self._warned_remainder:
+            self._warned_remainder = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "batch of %d not divisible by world_size %d — dropping %d "
+                "sample(s) per such batch to keep ranks in lockstep",
+                len(indices), w, len(indices) - n,
+            )
+        return indices[r:n:w]
 
     def _produce(self, out_q: queue.Queue, stop: threading.Event) -> None:
         try:
             for indices in self.sampler:
                 if stop.is_set():
                     return
-                batch = _default_fetch(self.dataset, self._rank_slice(indices))
+                batch = (self.fetch or _default_fetch)(
+                    self.dataset, self._rank_slice(indices)
+                )
                 if self.transform is not None:
                     batch = self.transform(batch)
                 if self.sharding is not None:

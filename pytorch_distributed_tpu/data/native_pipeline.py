@@ -1,0 +1,214 @@
+"""Native (C++) batch assembly: threaded gather + fused image augment.
+
+The reference feeds GPUs through torch DataLoader worker processes doing
+decode/augment in native code; the TPU-host equivalent is
+``native/prefetch.cpp`` — ctypes calls release the GIL, so one Python
+process drives all host cores assembling batches (gather -> random crop ->
+flip -> u8->f32 normalize in a single pass with a per-channel LUT), which
+is what ImageNet-rate feeding needs (SURVEY.md §7 hard part b).
+
+Randomness stays in Python: ``ImageBatchPipeline`` draws crop/flip
+parameters from a seeded generator keyed by the batch indices, so a resumed
+run replays identical augmentations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional, Sequence
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_SRC = os.path.join(_NATIVE_DIR, "prefetch.cpp")
+_SO = os.path.join(_NATIVE_DIR, "libprefetch.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def build_library(force: bool = False) -> str:
+    """Compile libprefetch.so if missing/stale; returns the path."""
+    stale = (
+        force
+        or not os.path.exists(_SO)
+        or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+    )
+    if stale:
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_NATIVE_DIR)
+        os.close(fd)
+        try:
+            subprocess.run(
+                [
+                    os.environ.get("CXX", "g++"),
+                    "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+                    "-o", tmp, _SRC,
+                ],
+                check=True, capture_output=True, text=True,
+            )
+            os.replace(tmp, _SO)
+        except subprocess.CalledProcessError as e:  # pragma: no cover
+            os.unlink(tmp)
+            raise RuntimeError(f"prefetch build failed:\n{e.stderr}") from e
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+    return _SO
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(build_library())
+        lib.pf_gather_rows.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.pf_gather_rows.restype = ctypes.c_int
+        lib.pf_image_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.pf_image_batch.restype = ctypes.c_int
+        _lib = lib
+    return _lib
+
+
+def _check(rc: int, what: str) -> None:
+    if rc != 0:
+        raise RuntimeError(f"prefetch {what} failed (rc={rc})")
+
+
+def gather_rows(src: np.ndarray, indices, num_threads: int = 0) -> np.ndarray:
+    """out[i] = src[indices[i]] with GIL-free threaded memcpy.
+
+    ``src`` may be any contiguous array (incl. np.memmap); rows are
+    src[j] slices of fixed byte size.
+    """
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(indices, np.int64)
+    out = np.empty((len(idx),) + src.shape[1:], src.dtype)
+    row_bytes = src.strides[0] if src.ndim > 1 else src.itemsize
+    rc = _load().pf_gather_rows(
+        src.ctypes.data_as(ctypes.c_void_p), row_bytes, src.shape[0],
+        idx.ctypes.data_as(ctypes.c_void_p), len(idx),
+        out.ctypes.data_as(ctypes.c_void_p), num_threads,
+    )
+    _check(rc, "gather_rows")
+    return out
+
+
+class ImageBatchPipeline:
+    """Fetch callable for :class:`DataLoader`: native augmenting assembly.
+
+    Expects the dataset to expose uint8 images ``[N, H, W, C]`` and int
+    labels via ``dataset.arrays`` (ArrayDataset layout). Produces
+    ``{"image": f32 [B, crop, crop, C], "label": i32 [B]}``.
+
+    train=True: random crop (after ``pad`` reflected/zero padding is NOT
+    applied — crops sample within the source frame, ImageNet-style; for
+    CIFAR pass ``pad`` to pre-pad once) + horizontal flip.
+    train=False: deterministic center crop, no flip.
+    """
+
+    def __init__(
+        self,
+        crop: int,
+        *,
+        train: bool = True,
+        flip: bool = True,
+        pad: int = 0,
+        mean: Sequence[float] = (0.485, 0.456, 0.406),
+        std: Sequence[float] = (0.229, 0.224, 0.225),
+        seed: int = 0,
+        num_threads: int = 0,
+        image_key: str = "image",
+        label_key: str = "label",
+    ):
+        self.crop = crop
+        self.train = train
+        self.flip = flip
+        self.pad = pad
+        self.mean = np.asarray(mean, np.float32)
+        self.stdinv = 1.0 / np.asarray(std, np.float32)
+        self.seed = seed
+        self.num_threads = num_threads
+        self.image_key = image_key
+        self.label_key = label_key
+        self._padded: Optional[np.ndarray] = None
+
+    def _source(self, dataset) -> np.ndarray:
+        imgs = dataset.arrays[self.image_key]
+        if self.pad:
+            if self._padded is None:
+                p = self.pad
+                self._padded = np.pad(
+                    imgs, ((0, 0), (p, p), (p, p), (0, 0)), mode="reflect"
+                )
+            return self._padded
+        if not imgs.flags.c_contiguous:
+            imgs = np.ascontiguousarray(imgs)
+            dataset.arrays[self.image_key] = imgs  # cache the copy once
+        return imgs
+
+    def __call__(self, dataset, indices: np.ndarray):
+        imgs = self._source(dataset)
+        if imgs.dtype != np.uint8:
+            raise TypeError(
+                f"native image pipeline needs uint8 images, got {imgs.dtype}"
+            )
+        idx = np.ascontiguousarray(indices, np.int64)
+        n = len(idx)
+        N, H, W, C = imgs.shape
+        crop = self.crop
+        if self.train:
+            # augmentation params derived from (seed, batch indices) so a
+            # resumed epoch replays the same crops/flips
+            rng = np.random.default_rng(
+                [self.seed, int(idx[0]) if n else 0, n]
+            )
+            cy = rng.integers(0, H - crop + 1, size=n, dtype=np.int32)
+            cx = rng.integers(0, W - crop + 1, size=n, dtype=np.int32)
+            fl = (
+                rng.integers(0, 2, size=n, dtype=np.uint8)
+                if self.flip else np.zeros(n, np.uint8)
+            )
+        else:
+            cy = np.full(n, (H - crop) // 2, np.int32)
+            cx = np.full(n, (W - crop) // 2, np.int32)
+            fl = np.zeros(n, np.uint8)
+        out = np.empty((n, crop, crop, C), np.float32)
+        mean = np.ascontiguousarray(
+            np.broadcast_to(self.mean, (C,)), np.float32
+        )
+        stdinv = np.ascontiguousarray(
+            np.broadcast_to(self.stdinv, (C,)), np.float32
+        )
+        rc = _load().pf_image_batch(
+            imgs.ctypes.data_as(ctypes.c_void_p), N, H, W, C,
+            idx.ctypes.data_as(ctypes.c_void_p), n,
+            cy.ctypes.data_as(ctypes.c_void_p),
+            cx.ctypes.data_as(ctypes.c_void_p),
+            fl.ctypes.data_as(ctypes.c_void_p),
+            mean.ctypes.data_as(ctypes.c_void_p),
+            stdinv.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p), crop, crop,
+            self.num_threads,
+        )
+        _check(rc, "image_batch")
+        batch = {self.image_key: out}
+        labels = dataset.arrays.get(self.label_key)
+        if labels is not None:
+            batch[self.label_key] = gather_rows(
+                np.ascontiguousarray(labels), idx, self.num_threads
+            ).astype(np.int32)
+        return batch

@@ -16,8 +16,16 @@ from pytorch_distributed_tpu.ops.attention import (
     rope_frequencies,
 )
 from pytorch_distributed_tpu.ops.flash_attention import flash_attention
+from pytorch_distributed_tpu.ops.moe import (
+    MoEMLP,
+    collect_aux_loss,
+    moe_partition_rules,
+)
 
 __all__ = [
+    "MoEMLP",
+    "collect_aux_loss",
+    "moe_partition_rules",
     "scaled_dot_product_attention",
     "dot_product_attention",
     "flash_attention",

@@ -1,0 +1,130 @@
+"""Mixture-of-Experts MLP with expert parallelism (the ``ep`` mesh axis).
+
+Not present in the reference (SURVEY.md §2 — DDP/ZeRO-1/FSDP recipes
+only); built TPU-first as a capability extension: experts live as one
+stacked weight tensor with a leading expert dim sharded ``P("ep")``, and
+token routing is expressed as dense one-hot dispatch/combine einsums
+(Switch-Transformer style) — static shapes, MXU-friendly, and XLA lowers
+the token movement to all-to-alls over ICI when the expert dim is sharded.
+
+Routing: top-k softmax gating with a per-expert capacity
+``C = ceil(k * tokens * capacity_factor / E)``; tokens over capacity are
+dropped (their combine weight is zero, the residual path carries them).
+The Switch load-balance auxiliary loss is exposed via ``sow`` under
+``("intermediates", "moe_aux_loss")`` — add it to the task loss scaled by
+``aux_loss_weight``.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.runtime.precision import current_policy
+
+
+class MoEMLP(nn.Module):
+    """Drop-in replacement for a transformer FFN block."""
+
+    num_experts: int
+    d_ff: int
+    k: int = 2
+    capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        policy = current_policy()
+        *batch_dims, D = x.shape
+        E, F, K = self.num_experts, self.d_ff, self.k
+        tokens = x.reshape(-1, D)
+        T = tokens.shape[0]
+        C = max(1, int(K * T * self.capacity_factor / E + 0.999))
+
+        # ---- router (f32: tiny, and gate precision matters) -------------
+        logits = nn.Dense(
+            E, use_bias=False, dtype=jnp.float32,
+            param_dtype=policy.param_dtype, name="router",
+        )(tokens.astype(jnp.float32))  # [T, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_vals, expert_idx = jax.lax.top_k(probs, K)  # [T, K]
+        # renormalise the kept gates so they sum to 1 per token
+        gate_vals = gate_vals / jnp.clip(
+            jnp.sum(gate_vals, -1, keepdims=True), 1e-9
+        )
+
+        # ---- capacity assignment ---------------------------------------
+        # one-hot over experts per (token, k): [T, K, E]
+        sel = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)
+        # position of each (t, k) within its expert's queue, k-major so
+        # primary assignments win capacity over secondary ones
+        flat_sel = sel.transpose(1, 0, 2).reshape(K * T, E)  # k-major
+        pos_flat = jnp.cumsum(flat_sel, axis=0) - 1.0  # [K*T, E]
+        pos = pos_flat.reshape(K, T, E).transpose(1, 0, 2)  # [T, K, E]
+        in_cap = (pos < C).astype(jnp.float32)
+        kept = sel * in_cap  # [T, K, E]
+        slot = jax.nn.one_hot(
+            jnp.sum(pos * sel, -1).astype(jnp.int32), C, dtype=jnp.float32
+        )  # [T, K, C]
+        # dispatch: does token t occupy (expert e, slot c)?  [T, E, C]
+        dispatch = jnp.einsum("tke,tkc->tec", kept, slot)
+        combine = jnp.einsum(
+            "tke,tkc,tk->tec", kept, slot, gate_vals.astype(jnp.float32)
+        )
+
+        # ---- expert computation (stacked, expert dim shardable) ---------
+        w_in = self.param(
+            "w_in", nn.initializers.lecun_normal(), (E, D, F),
+            policy.param_dtype,
+        )
+        w_out = self.param(
+            "w_out", nn.initializers.lecun_normal(), (E, F, D),
+            policy.param_dtype,
+        )
+        ctype = policy.compute_dtype
+        expert_in = jnp.einsum(
+            "tec,td->ecd", dispatch.astype(ctype), tokens.astype(ctype)
+        )
+        h = jnp.einsum("ecd,edf->ecf", expert_in, w_in.astype(ctype))
+        h = nn.gelu(h)
+        expert_out = jnp.einsum("ecf,efd->ecd", h, w_out.astype(ctype))
+        y = jnp.einsum(
+            "tec,ecd->td", combine.astype(ctype), expert_out
+        )
+
+        # ---- Switch load-balance aux loss ------------------------------
+        # fraction of tokens routed to e (primary assignment) x mean router
+        # prob for e, scaled by E — minimised when routing is uniform
+        primary = sel[:, 0, :]  # [T, E]
+        aux = E * jnp.sum(
+            jnp.mean(primary, axis=0) * jnp.mean(probs, axis=0)
+        )
+        self.sow("intermediates", "moe_aux_loss", aux)
+
+        return y.reshape(*batch_dims, D).astype(x.dtype)
+
+
+def moe_partition_rules(ep_axis: str = "ep", tp_axis: str = "tp"):
+    """Partition rules for MoE params: experts over ``ep``, the FFN hidden
+    dim over ``tp`` (composes with Megatron-style TP inside each expert).
+    Feed to the Strategy ``extra_rules`` machinery."""
+    from jax.sharding import PartitionSpec as P
+
+    return [
+        ("router/kernel", P(None, None)),
+        ("w_in", P(ep_axis, None, tp_axis)),
+        ("w_out", P(ep_axis, tp_axis, None)),
+    ]
+
+
+def collect_aux_loss(intermediates, weight: float = 0.01):
+    """Sum every sown ``moe_aux_loss`` in an intermediates tree."""
+    total = 0.0
+    n = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(intermediates)[0]:
+        if any(
+            getattr(k, "key", None) == "moe_aux_loss" for k in path
+        ):
+            total = total + jnp.sum(jnp.asarray(leaf))
+            n += 1
+    return weight * total if n else jnp.asarray(0.0)

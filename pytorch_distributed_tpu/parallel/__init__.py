@@ -33,6 +33,16 @@ from pytorch_distributed_tpu.parallel.sequence import (
     disable_sequence_parallel,
     sequence_parallel_mode,
 )
+from pytorch_distributed_tpu.parallel.pipeline import (
+    pipeline_forward,
+    stage_sharding,
+    split_microbatches,
+    merge_microbatches,
+)
+from pytorch_distributed_tpu.parallel.ddp import (
+    is_multiprocess,
+    sync_grads,
+)
 
 __all__ = [
     "PartitionRules",
@@ -49,4 +59,10 @@ __all__ = [
     "enable_sequence_parallel",
     "disable_sequence_parallel",
     "sequence_parallel_mode",
+    "pipeline_forward",
+    "stage_sharding",
+    "split_microbatches",
+    "merge_microbatches",
+    "is_multiprocess",
+    "sync_grads",
 ]

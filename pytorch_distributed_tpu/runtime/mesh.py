@@ -13,6 +13,7 @@ axis       meaning
 =========  =====================================================
 ``dp``     data parallel (batch sharding; DDP / ZeRO-1 gradient axis)
 ``fsdp``   fully-sharded data parallel (params + batch sharded)
+``pp``     pipeline parallel (layer stages; GPipe schedule over ppermute)
 ``tp``     tensor/model parallel (weight matrices sharded)
 ``sp``     sequence/context parallel (ring attention axis)
 ``ep``     expert parallel (MoE experts sharded)
@@ -36,7 +37,7 @@ from jax.sharding import Mesh
 # collectives are per-layer and latency-bound, so they should ride the
 # fastest (innermost/ICI-adjacent) axis; dp allreduce happens once per step
 # and tolerates the slower outer axis (DCN on multi-pod).
-AXES: Tuple[str, ...] = ("dp", "fsdp", "ep", "sp", "tp")
+AXES: Tuple[str, ...] = ("dp", "fsdp", "pp", "ep", "sp", "tp")
 
 _CURRENT_MESH: Optional[Mesh] = None
 
@@ -48,6 +49,7 @@ class MeshSpec:
 
     dp: int = -1
     fsdp: int = 1
+    pp: int = 1
     ep: int = 1
     sp: int = 1
     tp: int = 1

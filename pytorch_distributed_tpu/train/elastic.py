@@ -76,13 +76,17 @@ class PreemptionHandler:
                 for s in self._signals:
                     self._prev[s] = signal.signal(s, self._on_signal)
             except ValueError:
-                # signal.signal only works on the main thread; fit() in a
-                # worker thread simply runs without preemption handling
-                logger.warning(
-                    "not on the main thread — preemption signals will not "
-                    "be caught (checkpoint via ckpt_every_steps instead)"
-                )
+                # signal.signal only works on the main thread (or the
+                # signal is invalid here); roll back any handlers already
+                # swapped in, then run without preemption handling
+                for s, prev in self._prev.items():
+                    signal.signal(s, prev)
                 self._prev.clear()
+                logger.warning(
+                    "cannot install preemption signal handlers (non-main "
+                    "thread or unsupported signal) — checkpoint via "
+                    "ckpt_every_steps instead"
+                )
                 return self
             self._installed = True
         return self
@@ -140,6 +144,7 @@ class Watchdog:
         fatal: bool = False,
         on_stall: Optional[Callable[[float], None]] = None,
         poll_s: Optional[float] = None,
+        first_grace_s: float = 900.0,
     ):
         self.stall_timeout_s = float(stall_timeout_s)
         self.fatal = fatal
@@ -149,14 +154,21 @@ class Watchdog:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.stalled = False
+        # until the first tick the threshold is the (long) grace window:
+        # the first train step includes XLA compilation, which can dwarf
+        # the steady-state step time by orders of magnitude
+        self.first_grace_s = max(float(first_grace_s), self.stall_timeout_s)
+        self._armed = False
 
     def tick(self) -> None:
+        self._armed = True
         self._last = time.monotonic()
 
     def _watch(self) -> None:
         while not self._stop.wait(self._poll_s):
             idle = time.monotonic() - self._last
-            if idle > self.stall_timeout_s:
+            limit = self.stall_timeout_s if self._armed else self.first_grace_s
+            if idle > limit:
                 self.stalled = True
                 logger.error(
                     "watchdog: no train step for %.1fs (limit %.1fs) — "
@@ -175,7 +187,7 @@ class Watchdog:
 
     def start(self) -> "Watchdog":
         if self._thread is None:
-            self.tick()
+            self._last = time.monotonic()  # not tick(): stay in grace mode
             self._stop.clear()
             self._thread = threading.Thread(
                 target=self._watch, name="ptd-watchdog", daemon=True

@@ -212,6 +212,8 @@ class Trainer:
 
         path = save_checkpoint(self.config.ckpt_dir, self.state, tag=tag)
         logger.info("checkpoint saved: %s (step %d)", path, int(self.state.step))
+        if self._watchdog is not None:
+            self._watchdog.tick()  # a slow (sharded) save is not a hang
         return path
 
     def restore_checkpoint(self, tag: str = "latest") -> bool:
@@ -326,6 +328,8 @@ class Trainer:
         count = 0
         for batch in self.eval_loader:
             metrics = self.eval_step(self.state, batch)
+            if self._watchdog is not None:
+                self._watchdog.tick()  # eval progress is progress
             n = self._batch_samples(batch)
             for k, v in metrics.items():
                 sums[k] = sums.get(k, 0.0) + float(v) * n

@@ -22,7 +22,12 @@ import jax
 import optax
 
 import pytorch_distributed_tpu as ptd
-from pytorch_distributed_tpu.data import DataLoader, SyntheticImageDataset, load_cifar10
+from pytorch_distributed_tpu.data import (
+    DataLoader,
+    ImageBatchPipeline,
+    SyntheticImageDataset,
+    load_cifar10,
+)
 from pytorch_distributed_tpu.models import ResNet18
 from pytorch_distributed_tpu.parallel import DataParallel
 from pytorch_distributed_tpu.runtime.mesh import MeshSpec
@@ -65,8 +70,26 @@ def main(argv=None):
         "world=%d backend=%s", ptd.get_world_size(), ptd.get_backend()
     )
 
-    train_ds = None if args.synthetic else load_cifar10(args.data_dir, train=True)
-    eval_ds = None if args.synthetic else load_cifar10(args.data_dir, train=False)
+    train_ds = None if args.synthetic else load_cifar10(
+        args.data_dir, train=True, raw_uint8=True
+    )
+    eval_ds = None if args.synthetic else load_cifar10(
+        args.data_dir, train=False, raw_uint8=True
+    )
+    # real data goes through the native augmenting pipeline (pad-4 random
+    # crop + flip + fused normalize — the reference recipe's torchvision
+    # transforms, assembled in C++ threads); synthetic stays on the plain
+    # gather path
+    train_fetch = eval_fetch = None
+    if train_ds is not None:
+        cifar_mean, cifar_std = (0.4914, 0.4822, 0.4465), (0.247, 0.243, 0.262)
+        train_fetch = ImageBatchPipeline(
+            32, train=True, pad=4, mean=cifar_mean, std=cifar_std,
+            seed=args.seed,
+        )
+        eval_fetch = ImageBatchPipeline(
+            32, train=False, mean=cifar_mean, std=cifar_std
+        )
     if train_ds is None:
         log_rank0("CIFAR-10 files not found — using synthetic data")
         train_ds = SyntheticImageDataset(n=50_000, seed=args.seed)
@@ -98,11 +121,11 @@ def main(argv=None):
     strategy = DataParallel()
     train_loader = DataLoader(
         train_ds, args.batch_size, seed=args.seed,
-        sharding=strategy.batch_sharding(),
+        sharding=strategy.batch_sharding(), fetch=train_fetch,
     )
     eval_loader = DataLoader(
         eval_ds, args.batch_size, shuffle=False, drop_last=False,
-        sharding=strategy.batch_sharding(),
+        sharding=strategy.batch_sharding(), fetch=eval_fetch,
     )
 
     trainer = Trainer(

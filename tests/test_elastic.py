@@ -36,7 +36,8 @@ def test_preemption_handler_latches_sigterm():
 
 def test_watchdog_fires_on_stall_and_rearms():
     fired = []
-    wd = Watchdog(0.2, on_stall=fired.append, poll_s=0.05)
+    wd = Watchdog(0.2, on_stall=fired.append, poll_s=0.05,
+                  first_grace_s=0.2)
     with wd:
         time.sleep(0.5)
         assert wd.stalled and len(fired) >= 1
@@ -98,14 +99,25 @@ def _tiny_trainer(tmp_path, epochs, **cfg_kw):
 def test_trainer_preempt_checkpoint_resume(tmp_path):
     """SIGTERM mid-fit -> checkpoint written + Preempted raised; a fresh
     trainer resumes from the checkpoint and completes the run."""
-    trainer = _tiny_trainer(tmp_path, epochs=100)
-    killer = threading.Timer(1.5, os.kill, (os.getpid(), signal.SIGTERM))
+    trainer = _tiny_trainer(tmp_path, epochs=5000)
+
+    def kill_when_training():
+        # gate on observed progress, not wall-clock: fire as soon as a
+        # step has completed so fit() cannot finish (or not start) first
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if int(trainer.state.step) >= 1:
+                os.kill(os.getpid(), signal.SIGTERM)
+                return
+            time.sleep(0.02)
+
+    killer = threading.Thread(target=kill_when_training, daemon=True)
     killer.start()
     try:
         with pytest.raises(Preempted) as ei:
             trainer.fit()
     finally:
-        killer.cancel()
+        killer.join(timeout=5)
     stopped_at = ei.value.step
     assert stopped_at >= 1
 
