@@ -199,6 +199,14 @@ class Trainer:
         self.eval_loader = eval_loader
         self.meter = ScalarMeter()
         self.last_eval_metrics: Dict[str, float] = {}
+        # Host-side mirror of state.step (monotonic Python int, +1 per
+        # train_step call — apply_gradients increments exactly once per
+        # call, including the scaler's skip path). Control flow (logging,
+        # checkpoint cadence, preemption) reads this instead of
+        # state.step: it needs no device sync, and it is safe to read
+        # from watchdog/test threads while state's buffers are donated
+        # into the in-flight compiled step.
+        self.host_step = int(self.state.step)
         self._first_epoch = 0
         self._resume_skip_batches = 0
         self._preemption = None
@@ -234,6 +242,7 @@ class Trainer:
         )
         steps_per_epoch = max(len(self.train_loader), 1)
         step = int(self.state.step)
+        self.host_step = step
         self._first_epoch = step // steps_per_epoch
         # mid-epoch checkpoint: fast-forward past the batches this epoch
         # already consumed, so no batch trains twice and total step count
@@ -279,7 +288,7 @@ class Trainer:
         from pytorch_distributed_tpu.train import elastic
 
         if self._preemption is not None and self._preemption.requested:
-            step = int(self.state.step)
+            step = self.host_step
             self.save_checkpoint()
             logger.warning(
                 "preemption checkpoint written at step %d — exiting for "
@@ -299,7 +308,8 @@ class Trainer:
                 continue
             n = self._batch_samples(batch)
             self.state, metrics = self.train_step(self.state, batch)
-            step = int(self.state.step)
+            self.host_step += 1
+            step = self.host_step
             if self._watchdog is not None:
                 self._watchdog.tick()
             self._check_preemption()

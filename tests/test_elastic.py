@@ -96,17 +96,21 @@ def _tiny_trainer(tmp_path, epochs, **cfg_kw):
     )
 
 
+@pytest.mark.slow
 def test_trainer_preempt_checkpoint_resume(tmp_path):
     """SIGTERM mid-fit -> checkpoint written + Preempted raised; a fresh
     trainer resumes from the checkpoint and completes the run."""
-    trainer = _tiny_trainer(tmp_path, epochs=5000)
+    trainer = _tiny_trainer(tmp_path, epochs=50)
 
     def kill_when_training():
         # gate on observed progress, not wall-clock: fire as soon as a
-        # step has completed so fit() cannot finish (or not start) first
+        # step has completed so fit() cannot finish (or not start) first.
+        # Poll trainer.host_step (plain int) — reading trainer.state.step
+        # from this thread would touch buffers donated into the in-flight
+        # compiled step and raise.
         deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
-            if int(trainer.state.step) >= 1:
+            if trainer.host_step >= 1:
                 os.kill(os.getpid(), signal.SIGTERM)
                 return
             time.sleep(0.02)
@@ -144,6 +148,7 @@ def test_fit_elastic_exit_code(tmp_path, monkeypatch):
     assert ei.value.code == EX_TEMPFAIL
 
 
+@pytest.mark.slow
 def test_trainer_watchdog_wired(tmp_path):
     """stall_timeout_s config plumbs a live watchdog through fit()."""
     trainer = _tiny_trainer(tmp_path, epochs=1, stall_timeout_s=300.0)

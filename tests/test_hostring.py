@@ -54,6 +54,7 @@ def test_build_library():
     assert os.path.exists(path)
 
 
+@pytest.mark.slow
 def test_raw_collectives_4proc():
     results = _run(4, hostring_workers.raw_worker)
     assert results == [(r, "ok") for r in range(4)], results
@@ -64,6 +65,7 @@ def test_raw_collectives_2proc():
     assert results == [(r, "ok") for r in range(2)], results
 
 
+@pytest.mark.slow
 def test_facade_multiprocess():
     results = _run(4, hostring_workers.facade_worker, timeout=300.0)
     assert results == [(r, "ok") for r in range(4)], results

@@ -36,6 +36,7 @@ def test_spawn_facade(tmp_path):
         assert (tmp_path / f"rank{r}.ok").read_text() == "2"
 
 
+@pytest.mark.slow
 def test_ddp_invariant_across_ranks(tmp_path):
     """Multi-process DDP: grads average over the ring, loader shards by
     rank, params stay bit-identical on every rank after training."""
@@ -54,6 +55,7 @@ def test_spawn_propagates_failure():
         spawn(hostring_workers.failing_worker, nprocs=2, timeout_s=60)
 
 
+@pytest.mark.slow
 def test_cli_end_to_end(tmp_path):
     """The torchrun-shaped CLI runs a real collective script, 2 procs."""
     script = tmp_path / "worker.py"

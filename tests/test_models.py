@@ -15,6 +15,7 @@ def count_params(tree):
 
 
 class TestResNet:
+    @pytest.mark.slow
     def test_resnet18_param_count(self):
         # torch resnet18 (CIFAR stem, 10 classes) ~= 11.17M
         model = ResNet18(num_classes=10, stem="cifar")
@@ -22,6 +23,7 @@ class TestResNet:
         n = count_params(v["params"])
         assert 11.0e6 < n < 11.4e6, n
 
+    @pytest.mark.slow
     def test_resnet50_param_count(self):
         # torch resnet50 (1000 classes) ~= 25.56M
         model = ResNet50()
@@ -29,6 +31,7 @@ class TestResNet:
         n = count_params(v["params"])
         assert 25.3e6 < n < 25.8e6, n
 
+    @pytest.mark.slow
     def test_forward_shapes_and_output_dtype(self):
         model = ResNet18(num_classes=10, stem="cifar")
         v = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False)
@@ -75,6 +78,7 @@ class TestResNet:
                 jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False
             )
 
+    @pytest.mark.slow
     def test_imagenet_stem_downsamples(self):
         model = ResNet50(num_classes=10)
         v = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)), train=False)

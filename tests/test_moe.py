@@ -24,6 +24,7 @@ def _init(k=2, capacity_factor=1.25, num_experts=E):
     return model, params, x
 
 
+@pytest.mark.slow
 def test_forward_shape_and_finite():
     model, params, x = _init()
     y = model.apply({"params": params}, x)

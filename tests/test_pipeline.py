@@ -63,6 +63,7 @@ def test_pipeline_under_jit_with_sharded_params():
     )
 
 
+@pytest.mark.slow
 def test_pipeline_backprop_matches_sequential():
     stacked, xs = _setup()
 

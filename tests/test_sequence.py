@@ -95,6 +95,7 @@ class TestUlyssesAttention:
 
 
 class TestModelTransparentSP:
+    @pytest.mark.slow
     def test_llama_forward_sequence_parallel(self, rng):
         """Tiny Llama forward under sp=4: same logits as single-device."""
         from pytorch_distributed_tpu.models.llama import (

@@ -116,6 +116,7 @@ def tiny_image_state(model, seed=0):
 
 
 class TestTrainerLoop:
+    @pytest.mark.slow
     def test_fit_reduces_loss_and_updates_bn(self, dp8):
         model = tiny_resnet()
         state = tiny_image_state(model)
@@ -136,6 +137,7 @@ class TestTrainerLoop:
         bn_after = np.asarray(jax.tree_util.tree_leaves(out.batch_stats)[0])
         assert not np.array_equal(bn_before, bn_after)  # stats really update
 
+    @pytest.mark.slow
     def test_evaluate_runs(self, dp8):
         model = tiny_resnet()
         state = tiny_image_state(model)
@@ -217,6 +219,7 @@ class TestCheckpoint:
         assert checkpoint_step(str(tmp_path)) == 0
         assert not os.path.exists(os.path.join(str(tmp_path), "latest.old"))
 
+    @pytest.mark.slow
     def test_mid_epoch_resume_skips_consumed_batches(self, dp8, tmp_path):
         # manufacture a preemption: checkpoint at step 3 of a 4-step epoch
         model = tiny_resnet()

@@ -58,6 +58,7 @@ class TestParamCounts:
 
 
 class TestForward:
+    @pytest.mark.slow
     def test_bert_shapes(self):
         cfg = BertConfig.tiny()
         model = BertForSequenceClassification(cfg, num_labels=3)
@@ -136,6 +137,7 @@ class TestForward:
 
 
 class TestTrainSteps:
+    @pytest.mark.slow
     def test_gpt2_zero1_accum_step(self):
         # the recipe-4 shape: ZeRO-1 + grad accumulation (BASELINE.json:10)
         mesh = make_mesh(MeshSpec(dp=4, fsdp=1, tp=2))
@@ -161,6 +163,7 @@ class TestTrainSteps:
         mu = state.opt_state[0].mu
         assert "dp" in str(mu["block0"]["mlp_up"]["kernel"].sharding.spec)
 
+    @pytest.mark.slow
     def test_llama_fsdp_tp_step(self):
         # the recipe-5 shape: FSDP full-shard (BASELINE.json:11) + TP
         mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
@@ -200,6 +203,7 @@ class TestTrainSteps:
         mu = state.opt_state[0].mu  # adamw: (ScaleByAdamState, ...)
         assert mu["layer0"]["gate"]["kernel"].sharding.spec == P("fsdp", "tp")
 
+    @pytest.mark.slow
     def test_bert_ddp_amp_step(self):
         # the recipe-3 shape: DDP + autocast bf16 (BASELINE.json:9)
         import pytorch_distributed_tpu as ptd

@@ -91,6 +91,7 @@ def test_maybe_trace_writes(tmp_path):
 # -- recipe 2 entry --------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_resnet50_imagenet_recipe_smoke():
     import resnet50_imagenet
 
