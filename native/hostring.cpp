@@ -43,6 +43,28 @@ struct Barrier {
   std::atomic<uint32_t> generation;
 };
 
+// Per-(src,dst) SPSC mailbox for true point-to-point transfers. Collectives
+// use the barrier-guarded rank slots; P2P must NOT — a barrier needs every
+// rank, so barrier-based sendrecv deadlocks any group with bystander ranks.
+// Protocol: sender waits seq_send == seq_recv (mailbox free), writes, bumps
+// seq_send; receiver waits seq_send > seq_recv, reads, bumps seq_recv.
+struct P2PChannel {
+  std::atomic<uint64_t> seq_send;
+  std::atomic<uint64_t> seq_recv;
+};
+
+constexpr size_t kP2PHeaderBytes = 64;  // P2PChannel padded to a cache line
+static_assert(sizeof(P2PChannel) <= kP2PHeaderBytes, "p2p header overflow");
+
+// Mailbox payload per ordered pair: 256 KiB capped by a 64 MiB total budget
+// so large worlds don't blow up /dev/shm (world^2 channels).
+size_t p2p_data_bytes(int world) {
+  size_t per = (64ull << 20) / (size_t(world) * size_t(world));
+  if (per > (256u << 10)) per = 256u << 10;
+  if (per < (4u << 10)) per = 4u << 10;
+  return per & ~size_t(63);
+}
+
 struct ShmHeader {
   std::atomic<uint32_t> magic;  // kMagic once rank 0 finished initialising
   uint32_t world;
@@ -58,10 +80,12 @@ static_assert(sizeof(ShmHeader) <= kHeaderBytes, "header overflow");
 struct Group {
   ShmHeader* hdr;
   uint8_t* slots;  // world * slot_bytes
+  uint8_t* p2p;    // world * world * (kP2PHeaderBytes + p2p_bytes)
   size_t map_bytes;
   int rank;
   int world;
   size_t slot_bytes;
+  size_t p2p_bytes;  // mailbox payload per channel
   char name[256];
   double timeout_s;
 };
@@ -136,6 +160,15 @@ void combine_dispatch(void* acc, const void* src, size_t n, int32_t dtype,
 
 uint8_t* slot(Group* g, int rank) { return g->slots + size_t(rank) * g->slot_bytes; }
 
+P2PChannel* p2p_channel(Group* g, int src, int dst) {
+  return (P2PChannel*)(g->p2p + (size_t(src) * g->world + dst) *
+                                    (kP2PHeaderBytes + g->p2p_bytes));
+}
+
+uint8_t* p2p_mailbox(Group* g, int src, int dst) {
+  return (uint8_t*)p2p_channel(g, src, dst) + kP2PHeaderBytes;
+}
+
 }  // namespace
 
 extern "C" {
@@ -148,7 +181,10 @@ int hr_init(const char* name, int rank, int world, uint64_t slot_bytes,
   if (!name || !out || world <= 0 || rank < 0 || rank >= world ||
       slot_bytes == 0)
     return kErrInval;
-  const size_t map_bytes = kHeaderBytes + size_t(world) * slot_bytes;
+  const size_t p2p_bytes = p2p_data_bytes(world);
+  const size_t map_bytes =
+      kHeaderBytes + size_t(world) * slot_bytes +
+      size_t(world) * size_t(world) * (kP2PHeaderBytes + p2p_bytes);
   int fd = -1;
   const double deadline = now_s() + timeout_s;
   if (rank == 0) {
@@ -178,10 +214,13 @@ int hr_init(const char* name, int rank, int world, uint64_t slot_bytes,
   Group* g = new Group();
   g->hdr = (ShmHeader*)map;
   g->slots = (uint8_t*)map + kHeaderBytes;
+  g->p2p = g->slots + size_t(world) * slot_bytes;
   g->map_bytes = map_bytes;
   g->rank = rank;
   g->world = world;
   g->slot_bytes = slot_bytes;
+  g->p2p_bytes = p2p_bytes;  // channel seqnos start 0: fresh O_EXCL
+                             // segments are ftruncate-zero-filled
   g->timeout_s = timeout_s;
   strncpy(g->name, name, sizeof(g->name) - 1);
   g->name[sizeof(g->name) - 1] = '\0';
@@ -333,23 +372,47 @@ int hr_broadcast(void* h, void* data, uint64_t bytes, int32_t src) {
   return 0;
 }
 
-// Point-to-point: send `bytes` from rank src to rank dst (both call this).
+// True point-to-point: send `bytes` from rank src to rank dst through the
+// pair's SPSC mailbox. Only src and dst call this — bystander ranks are
+// not involved (and calling from one is an error). Concurrent transfers on
+// distinct ordered pairs proceed independently; no group barrier anywhere.
 int hr_sendrecv(void* h, void* data, uint64_t bytes, int32_t src, int32_t dst) {
   Group* g = (Group*)h;
-  if (src < 0 || src >= g->world || dst < 0 || dst >= g->world)
+  if (src < 0 || src >= g->world || dst < 0 || dst >= g->world || src == dst)
     return kErrInval;
+  if (g->rank != src && g->rank != dst) return kErrInval;
+  P2PChannel* ch = p2p_channel(g, src, dst);
+  uint8_t* mbox = p2p_mailbox(g, src, dst);
   uint8_t* p = (uint8_t*)data;
-  for (uint64_t off = 0; off < bytes; off += g->slot_bytes) {
+  const double deadline = now_s() + g->timeout_s;
+  for (uint64_t off = 0; off < bytes; off += g->p2p_bytes) {
     const size_t n =
-        size_t(bytes - off < g->slot_bytes ? bytes - off : g->slot_bytes);
-    int rc = barrier_wait(g);
-    if (rc != 0) return rc;
-    if (g->rank == src) memcpy(slot(g, src), p + off, n);
-    rc = barrier_wait(g);
-    if (rc != 0) return rc;
-    if (g->rank == dst) memcpy(p + off, slot(g, src), n);
-    rc = barrier_wait(g);
-    if (rc != 0) return rc;
+        size_t(bytes - off < g->p2p_bytes ? bytes - off : g->p2p_bytes);
+    if (g->rank == src) {
+      const uint64_t s = ch->seq_send.load(std::memory_order_acquire);
+      while (ch->seq_recv.load(std::memory_order_acquire) != s) {
+        if (g->hdr->abort_flag.load(std::memory_order_acquire)) return kErrSys;
+        if (now_s() > deadline) {
+          g->hdr->abort_flag.store(1, std::memory_order_release);
+          return kErrTimeout;
+        }
+        sched_yield();
+      }
+      memcpy(mbox, p + off, n);
+      ch->seq_send.store(s + 1, std::memory_order_release);
+    } else {
+      const uint64_t r = ch->seq_recv.load(std::memory_order_acquire);
+      while (ch->seq_send.load(std::memory_order_acquire) == r) {
+        if (g->hdr->abort_flag.load(std::memory_order_acquire)) return kErrSys;
+        if (now_s() > deadline) {
+          g->hdr->abort_flag.store(1, std::memory_order_release);
+          return kErrTimeout;
+        }
+        sched_yield();
+      }
+      memcpy(p + off, mbox, n);
+      ch->seq_recv.store(r + 1, std::memory_order_release);
+    }
   }
   return 0;
 }

@@ -59,13 +59,24 @@ class DataLoader:
         sampler: Optional[GlobalBatchSampler] = None,
         transform: Optional[Callable[[Any], Any]] = None,
         fetch: Optional[Callable[[Any, np.ndarray], Any]] = None,
+        shard: Optional[bool] = None,
     ):
         """``fetch(dataset, indices) -> batch`` overrides the default
-        gather — e.g. the native augmenting ImageBatchPipeline."""
+        gather — e.g. the native augmenting ImageBatchPipeline.
+
+        ``shard``: whether to rank-slice each batch under the multi-process
+        (hostring) backend. Default (None) auto-detects: slice unless the
+        provided ``sampler`` is already rank-aware (has ``num_replicas``,
+        like DistributedSampler) — feeding per-rank batches through the
+        implicit slice would silently double-shard to 1/world^2 per rank.
+        Pass True/False to force."""
         self.dataset = dataset
         self.sampler = sampler or GlobalBatchSampler(
             len(dataset), batch_size, shuffle=shuffle, seed=seed, drop_last=drop_last
         )
+        if shard is None:
+            shard = sampler is None or not hasattr(sampler, "num_replicas")
+        self.shard = shard
         self.fetch = fetch
         self.sharding = sharding
         self.prefetch = max(1, prefetch)
@@ -74,6 +85,8 @@ class DataLoader:
 
     def set_epoch(self, epoch: int) -> None:
         self.sampler.set_epoch(epoch)
+        if self.fetch is not None and hasattr(self.fetch, "set_epoch"):
+            self.fetch.set_epoch(epoch)  # e.g. ImageBatchPipeline aug stream
 
     def __len__(self) -> int:
         return len(self.sampler)
@@ -89,6 +102,8 @@ class DataLoader:
         count cannot be sharded at all and raises."""
         from pytorch_distributed_tpu.runtime import distributed as dist
 
+        if not self.shard:
+            return indices
         ring = dist.multiprocess_ring()
         if ring is None or ring.world_size == 1:
             return indices

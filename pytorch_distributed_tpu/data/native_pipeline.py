@@ -144,7 +144,12 @@ class ImageBatchPipeline:
         self.num_threads = num_threads
         self.image_key = image_key
         self.label_key = label_key
+        self.epoch = 0
         self._padded: Optional[np.ndarray] = None
+
+    def set_epoch(self, epoch: int) -> None:
+        """Advance the augmentation stream (DataLoader forwards this)."""
+        self.epoch = epoch
 
     def _source(self, dataset) -> np.ndarray:
         imgs = dataset.arrays[self.image_key]
@@ -171,10 +176,15 @@ class ImageBatchPipeline:
         N, H, W, C = imgs.shape
         crop = self.crop
         if self.train:
-            # augmentation params derived from (seed, batch indices) so a
-            # resumed epoch replays the same crops/flips
+            # augmentation params derived from (seed, epoch, batch indices)
+            # so a resumed epoch replays the same crops/flips while distinct
+            # epochs — and distinct batches even under shuffle=False — get
+            # fresh augmentation (the full index array is hashed, not just
+            # its head)
+            import zlib
+
             rng = np.random.default_rng(
-                [self.seed, int(idx[0]) if n else 0, n]
+                [self.seed, self.epoch, zlib.crc32(idx.tobytes()), n]
             )
             cy = rng.integers(0, H - crop + 1, size=n, dtype=np.int32)
             cx = rng.integers(0, W - crop + 1, size=n, dtype=np.int32)

@@ -70,6 +70,7 @@ class ProcessGroup:
 
 
 _GROUP: Optional[ProcessGroup] = None
+_INIT_GENERATION = 0  # per-init shm-name suffix; see hostring re-init guard
 
 _BACKENDS = ("ici", "cpu")
 
@@ -134,8 +135,17 @@ def init_process_group(
         if group_name is None:
             # the launcher hands every worker a per-rendezvous group name
             group_name = os.environ.get("PTD_GROUP_NAME", "ptd_world")
+        # Re-init race guard: after close(), a fast peer's fresh hr_init
+        # could attach the OLD segment before rank 0 unlinks/recreates it
+        # (its magic is still set), splitting the group until timeout. A
+        # per-init generation suffix gives every rendezvous a fresh shm
+        # name; all ranks tear down and re-init in lockstep (collectives
+        # are group-wide), so the counter stays in step across processes.
+        global _INIT_GENERATION
+        _INIT_GENERATION += 1
         ring = HostRingGroup(
-            group_name, rank, world_size, timeout_s=timeout_s
+            f"{group_name}_g{_INIT_GENERATION}", rank, world_size,
+            timeout_s=timeout_s,
         )
         # Each rank still gets a local 1-device mesh so jit/sharding code
         # paths work unchanged within the rank.

@@ -146,11 +146,18 @@ def unlink_segment(name: str) -> None:
     supervising agent calls this after reaping them so abandoned attempts
     don't accumulate in /dev/shm.
     """
+    import glob
+
     shm = name.strip("/").replace("/", "_")
-    try:
-        os.unlink(os.path.join("/dev/shm", shm))
-    except OSError:
-        pass
+    # init_process_group suffixes a per-init generation (_gN) onto the
+    # group name; reap those too so abandoned re-inits don't accumulate.
+    for path in [os.path.join("/dev/shm", shm)] + glob.glob(
+        os.path.join("/dev/shm", shm + "_g*")
+    ):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
 
 
 class HostRingGroup:
@@ -279,6 +286,9 @@ class HostRingGroup:
         return a
 
     def send(self, x, dst: int) -> None:
+        """True point-to-point send: only this rank and ``dst`` participate
+        (per-pair shm mailbox — no group barrier, bystander ranks are free
+        to run other collectives or nothing at all)."""
         a = _as_contig(x, dtype_required=False).copy()
         rc = _load().hr_sendrecv(
             self._h, a.ctypes.data_as(ctypes.c_void_p), a.nbytes,
@@ -287,7 +297,8 @@ class HostRingGroup:
         _check(rc, "send")
 
     def recv(self, x, src: int) -> np.ndarray:
-        """x supplies shape/dtype; returns the received array."""
+        """x supplies shape/dtype; returns the received array. True P2P —
+        see :meth:`send`."""
         a = _as_contig(x, dtype_required=False).copy()
         rc = _load().hr_sendrecv(
             self._h, a.ctypes.data_as(ctypes.c_void_p), a.nbytes,

@@ -1,13 +1,28 @@
-"""Native checkpoint/resume.
+"""Native sharded checkpoint/resume.
 
 TPU-native replacement for the reference's assumed ``torch.save`` of
-model/optimizer state dicts (SURVEY.md §5): the whole TrainState pytree is
-one checkpoint — params, optimizer state, step counter, BN stats, loss
-scale — serialized leaf-per-file (.npy) with a JSON manifest of paths,
-shapes and dtypes. Restore places every leaf directly onto its target
-sharding, so a run can resume under a *different* parallelism strategy
-than it was saved with (the sharded-checkpoint property torch FSDP needs
-special handling for).
+model/optimizer state dicts (SURVEY.md §5), built for pod scale the way
+orbax is:
+
+* **Per-shard writes, no host gather.** Each jax Array leaf is written as
+  one file per *addressable shard* (``leaf.addressable_shards``), with the
+  shard's global index box recorded in the manifest. A replicated leaf
+  writes one copy (``replica_id == 0``); an FSDP-sharded 8B model writes
+  1/N of the weights per host. Nothing ever materializes the full array.
+* **Parallel + async.** Shard files are written by a thread pool;
+  :func:`save_checkpoint_async` snapshots shards to host, then does file IO
+  and the atomic rename in a background thread so training resumes
+  immediately (the preemption path still uses the blocking save).
+* **Restore onto an arbitrary mesh/strategy.** Leaves are loaded through
+  ``jax.make_array_from_callback`` against the *target* sharding: each
+  device reads exactly the slice it needs from the overlapping shard files
+  (memory-mapped, so a DP-replicated restore of an FSDP checkpoint streams
+  rather than double-buffers). Save under FSDP, restore under DataParallel
+  — or any other layout — works by construction.
+* **Path-keyed, order-independent matching.** Leaves are matched by their
+  tree-path name, not position, so reordering fields in an optimizer
+  doesn't orphan old checkpoints; a genuinely missing path is a hard error
+  (or keeps the template value with ``strict=False``).
 
 Writes are atomic (tmp dir + rename) so a preemption mid-save never
 corrupts the latest checkpoint — preemption-safety is the TPU-pod
@@ -16,17 +31,23 @@ equivalent of torchrun's elastic restart (SURVEY.md §5).
 
 from __future__ import annotations
 
+import concurrent.futures as _futures
 import json
 import os
 import shutil
-from typing import Any, Optional
+import threading
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from pytorch_distributed_tpu.train.train_state import TrainState
+from pytorch_distributed_tpu.utils.logging import get_logger
 
 _MANIFEST = "manifest.json"
+_IO_THREADS = 8
+
+logger = get_logger(__name__)
 
 
 def _leaf_files(tree) -> list:
@@ -42,32 +63,127 @@ def _leaf_files(tree) -> list:
     return out
 
 
-def save_checkpoint(ckpt_dir: str, state: TrainState, *, tag: str = "latest") -> str:
-    """Write ``state`` under ``ckpt_dir/tag`` atomically; returns the path."""
+def _shard_boxes(leaf) -> List[Tuple[Tuple[int, ...], Tuple[int, ...], Any]]:
+    """(start, stop, host_data) per addressable shard worth writing.
+
+    Replicated shards write once globally (replica_id == 0 — each shard
+    index has replica 0 on exactly one device, so exactly one process owns
+    it); a process may legitimately own zero shards of a leaf. Non-jax
+    leaves (python scalars, numpy arrays) are a single full-extent shard.
+    """
+    shape = tuple(getattr(leaf, "shape", ()))
+    if not isinstance(leaf, jax.Array):
+        arr = np.asarray(leaf)
+        return [((0,) * arr.ndim, arr.shape, arr)]
+    boxes = []
+    for shard in leaf.addressable_shards:
+        if shard.replica_id != 0:
+            continue
+        idx = shard.index  # tuple of slices into the global shape
+        start = tuple(
+            (s.indices(dim))[0] for s, dim in zip(idx, shape)
+        )
+        stop = tuple((s.indices(dim))[1] for s, dim in zip(idx, shape))
+        boxes.append((start, stop, np.asarray(shard.data)))
+    return boxes
+
+
+def _snapshot(state: TrainState) -> list:
+    """Host copy of this process's shards: [(name, boxes, shape, dtype)].
+
+    After this returns, the device arrays are free to be donated/updated —
+    the IO below touches only host memory.
+    """
+    snap = []
+    for name, leaf in _leaf_files(state):
+        shape = list(getattr(leaf, "shape", np.asarray(leaf).shape))
+        dtype = str(getattr(leaf, "dtype", None) or np.asarray(leaf).dtype)
+        snap.append((name, _shard_boxes(leaf), shape, dtype))
+    return snap
+
+
+def _write_files(tmp: str, snap: list, step: int) -> None:
+    """Write this process's shard files + its per-process manifest."""
+    proc = jax.process_index()
+    entries = []
+    jobs = []  # (fname, host_array)
+    for i, (name, boxes, shape, dtype) in enumerate(snap):
+        shards = []
+        for j, (start, stop, data) in enumerate(boxes):
+            fname = f"{i:05d}_{name[:72]}.p{proc}s{j}.npy"
+            shards.append(
+                {"file": fname, "start": list(start), "stop": list(stop)}
+            )
+            jobs.append((fname, data))
+        entries.append(
+            {"path": name, "shape": shape, "dtype": dtype, "shards": shards}
+        )
+    with _futures.ThreadPoolExecutor(max_workers=_IO_THREADS) as pool:
+        list(
+            pool.map(
+                lambda job: np.save(os.path.join(tmp, job[0]), job[1]), jobs
+            )
+        )
+    with open(os.path.join(tmp, f"manifest-p{proc}.json"), "w") as f:
+        json.dump({"version": 2, "step": step, "leaves": entries}, f)
+
+
+def _merge_manifests(tmp: str, step: int) -> dict:
+    """Union the per-process manifests (each contributes its own shards)."""
+    import glob as _glob
+
+    merged: Dict[str, dict] = {}
+    order: List[str] = []
+    for path in sorted(_glob.glob(os.path.join(tmp, "manifest-p*.json"))):
+        with open(path) as f:
+            part = json.load(f)
+        for e in part["leaves"]:
+            if e["path"] not in merged:
+                merged[e["path"]] = e
+                order.append(e["path"])
+            else:
+                merged[e["path"]]["shards"].extend(e["shards"])
+        os.unlink(path)
+    return {
+        "version": 2, "step": step, "leaves": [merged[p] for p in order]
+    }
+
+
+def _barrier(tag: str) -> None:
+    if jax.process_count() > 1:  # pragma: no cover - needs a real pod
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
+def _save_sync(ckpt_dir: str, tag: str, snap: list, step: int) -> str:
+    """Shared save body: write files, barrier, merge + swing on process 0.
+
+    All processes write into the same tmp dir (shared filesystem at pod
+    scale, the orbax model); process 0 merges manifests and performs the
+    atomic rename after everyone's shards are down.
+    """
     final = os.path.join(ckpt_dir, tag)
     tmp = final + ".tmp"
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
-    os.makedirs(tmp, exist_ok=True)
+    if jax.process_index() == 0:
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp, exist_ok=True)
+    _barrier("ptd_ckpt_tmp_ready")
+    _write_files(tmp, snap, step)
+    _barrier("ptd_ckpt_shards_written")
+    if jax.process_index() == 0:
+        manifest = _merge_manifests(tmp, step)
+        with open(os.path.join(tmp, _MANIFEST), "w") as f:
+            json.dump(manifest, f, indent=1)
+        _swing(ckpt_dir, tag, tmp)
+    _barrier("ptd_ckpt_committed")
+    return final
 
-    entries = []
-    for i, (name, leaf) in enumerate(_leaf_files(state)):
-        arr = np.asarray(jax.device_get(leaf))
-        fname = f"{i:05d}_{name[:80]}.npy"
-        np.save(os.path.join(tmp, fname), arr)
-        entries.append(
-            {
-                "file": fname,
-                "path": name,
-                "shape": list(arr.shape),
-                "dtype": str(arr.dtype),
-            }
-        )
-    with open(os.path.join(tmp, _MANIFEST), "w") as f:
-        json.dump({"step": int(state.step), "leaves": entries}, f, indent=1)
 
-    # never delete the old checkpoint before the new one is in place:
-    # rename it aside, swing the tmp dir in, then drop the old copy
+def _swing(ckpt_dir: str, tag: str, tmp: str) -> str:
+    """Atomically replace ckpt_dir/tag with the fully-written tmp dir."""
+    final = os.path.join(ckpt_dir, tag)
     old = final + ".old"
     if os.path.exists(old):
         shutil.rmtree(old)
@@ -77,6 +193,63 @@ def save_checkpoint(ckpt_dir: str, state: TrainState, *, tag: str = "latest") ->
     if os.path.exists(old):
         shutil.rmtree(old)
     return final
+
+
+def save_checkpoint(ckpt_dir: str, state: TrainState, *, tag: str = "latest") -> str:
+    """Write ``state`` under ``ckpt_dir/tag`` atomically; returns the path.
+
+    Multi-host: EVERY process must call this (each writes its addressable
+    shards; process 0 merges and commits) — gate rank-0-only saving only
+    for backends where the state is fully replicated per process (the
+    hostring path; the Trainer does this).
+    """
+    return _save_sync(ckpt_dir, tag, _snapshot(state), int(state.step))
+
+
+class AsyncCheckpointer:
+    """Overlap checkpoint IO with training.
+
+    ``save()`` copies every shard device->host synchronously (the cheap
+    part), then writes files and swings the rename on a background thread.
+    At most one save is in flight; a new save (or ``wait()``/preemption)
+    joins the previous one first, so the atomic-rename ordering is
+    preserved.
+    """
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def save(self, ckpt_dir: str, state: TrainState, *, tag: str = "latest") -> None:
+        self.wait()
+        # Host snapshot happens on the caller's thread: after this, the
+        # device arrays are free to be donated/updated by the next step.
+        snap = _snapshot(state)
+        step = int(state.step)
+        if jax.process_count() > 1:  # pragma: no cover - needs a real pod
+            # Multi-host save needs cross-process barriers, which must run
+            # on the main thread (they are device collectives and would
+            # race the training step's). Fall back to the blocking save.
+            _save_sync(ckpt_dir, tag, snap, step)
+            return
+
+        def _write():
+            try:
+                _save_sync(ckpt_dir, tag, snap, step)
+            except BaseException as e:  # surfaced on next wait()/save()
+                self._error = e
+
+        self._thread = threading.Thread(target=_write, daemon=True)
+        self._thread.start()
+
+    def wait(self) -> None:
+        """Block until the in-flight save (if any) has landed."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("async checkpoint save failed") from err
 
 
 def checkpoint_exists(ckpt_dir: str, tag: str = "latest") -> bool:
@@ -91,59 +264,136 @@ def checkpoint_step(ckpt_dir: str, tag: str = "latest") -> Optional[int]:
         return int(json.load(f)["step"])
 
 
+def _entry_shards(entry: dict) -> List[dict]:
+    """Shard list for a manifest entry; v1 manifests are one full shard."""
+    if "shards" in entry:
+        return entry["shards"]
+    shape = entry["shape"]
+    return [
+        {"file": entry["file"], "start": [0] * len(shape), "stop": shape}
+    ]
+
+
+def _assemble(
+    final: str,
+    entry: dict,
+    box_start: Tuple[int, ...],
+    box_stop: Tuple[int, ...],
+    dtype,
+) -> np.ndarray:
+    """Read the [start, stop) box of a leaf from its overlapping shards."""
+    out_shape = tuple(b - a for a, b in zip(box_start, box_stop))
+    shards = _entry_shards(entry)
+    # Fast path: one shard covering exactly the requested box.
+    for s in shards:
+        if tuple(s["start"]) == box_start and tuple(s["stop"]) == box_stop:
+            return np.load(os.path.join(final, s["file"])).astype(dtype, copy=False)
+    out = np.empty(out_shape, dtype)
+    filled = 0
+    for s in shards:
+        s_start, s_stop = s["start"], s["stop"]
+        lo = tuple(max(a, b) for a, b in zip(box_start, s_start))
+        hi = tuple(min(a, b) for a, b in zip(box_stop, s_stop))
+        if any(l >= h for l, h in zip(lo, hi)) and out.ndim > 0:
+            continue
+        src = np.load(os.path.join(final, s["file"]), mmap_mode="r")
+        src_sel = tuple(
+            slice(l - a, h - a) for l, h, a in zip(lo, hi, s_start)
+        )
+        dst_sel = tuple(
+            slice(l - a, h - a) for l, h, a in zip(lo, hi, box_start)
+        )
+        out[dst_sel] = src[src_sel]
+        filled += int(np.prod([h - l for l, h in zip(lo, hi)])) if out.ndim else 1
+    if out.ndim == 0 and shards:
+        out[()] = np.load(os.path.join(final, shards[0]["file"]))
+    elif filled < int(np.prod(out_shape)):
+        raise ValueError(
+            f"checkpoint shards for {entry['path']!r} do not cover the "
+            f"requested box [{box_start}, {box_stop}) — incomplete save?"
+        )
+    return out
+
+
 def restore_checkpoint(
     ckpt_dir: str,
     state_template: TrainState,
     shardings: Optional[Any] = None,
     *,
     tag: str = "latest",
+    strict: bool = True,
 ) -> TrainState:
-    """Load leaves into ``state_template``'s structure.
+    """Load leaves into ``state_template``'s structure, matched by path.
 
     ``shardings`` (same structure, e.g. ``strategy.state_shardings(state)``)
-    places each leaf straight onto the mesh; without it leaves arrive as
-    host numpy and jit placement applies on first use.
+    places each leaf directly onto the *target* mesh: every device reads
+    only its own slice from the shard files, whatever layout the checkpoint
+    was saved under. Without it leaves arrive as host numpy and jit
+    placement applies on first use.
+
+    ``strict=False`` keeps the template's value for paths absent from the
+    checkpoint (e.g. a newly added optimizer field) instead of raising.
     """
     final = os.path.join(ckpt_dir, tag)
     with open(os.path.join(final, _MANIFEST)) as f:
         manifest = json.load(f)
 
+    by_path: Dict[str, dict] = {e["path"]: e for e in manifest["leaves"]}
     template_named = _leaf_files(state_template)
     treedef = jax.tree_util.tree_structure(state_template)
-    template_leaves = [leaf for _, leaf in template_named]
-    if len(manifest["leaves"]) != len(template_leaves):
-        raise ValueError(
-            f"checkpoint has {len(manifest['leaves'])} leaves, state has "
-            f"{len(template_leaves)} — structure mismatch (different model/"
-            f"optimizer than the one saved?)"
-        )
-    for entry, (name, _) in zip(manifest["leaves"], template_named):
-        if entry["path"] != name:
-            raise ValueError(
-                f"leaf path mismatch: checkpoint has {entry['path']!r}, "
-                f"state has {name!r} — same-shaped leaves in different "
-                f"positions would load into the wrong tensors"
-            )
     sharding_leaves = (
         jax.tree_util.tree_leaves(shardings) if shardings is not None else None
     )
-    if sharding_leaves is not None and len(sharding_leaves) != len(template_leaves):
+    if sharding_leaves is not None and len(sharding_leaves) != len(template_named):
         raise ValueError(
             f"shardings tree has {len(sharding_leaves)} leaves, state has "
-            f"{len(template_leaves)}"
+            f"{len(template_named)}"
         )
+
+    used = set()
     loaded = []
-    for i, (entry, tmpl) in enumerate(zip(manifest["leaves"], template_leaves)):
-        arr = np.load(os.path.join(final, entry["file"]))
-        if tuple(arr.shape) != tuple(getattr(tmpl, "shape", arr.shape)):
+    for i, (name, tmpl) in enumerate(template_named):
+        entry = by_path.get(name)
+        if entry is None:
+            if strict:
+                raise ValueError(
+                    f"state leaf {name!r} not found in checkpoint "
+                    f"(strict=True); checkpoint paths: "
+                    f"{sorted(by_path)[:8]}..."
+                )
+            loaded.append(tmpl)
+            continue
+        used.add(name)
+        shape = tuple(entry["shape"])
+        tmpl_shape = tuple(getattr(tmpl, "shape", np.asarray(tmpl).shape))
+        if shape != tmpl_shape:
             raise ValueError(
-                f"leaf {entry['path']}: checkpoint shape {arr.shape} != "
-                f"state shape {tmpl.shape}"
+                f"leaf {name}: checkpoint shape {shape} != state shape "
+                f"{tmpl_shape}"
             )
-        # leaf-wise placement (not whole-tree device_put): the shardings
-        # tree may carry different static metadata (apply_fn identity)
-        # than the template, which would fail treedef prefix matching
-        if sharding_leaves is not None:
-            arr = jax.device_put(arr, sharding_leaves[i])
+        dtype = np.dtype(entry["dtype"])
+        if sharding_leaves is not None and isinstance(tmpl, jax.Array):
+            sharding = sharding_leaves[i]
+
+            def cb(index, entry=entry, shape=shape, dtype=dtype):
+                start = tuple(
+                    s.indices(d)[0] for s, d in zip(index, shape)
+                )
+                stop = tuple(s.indices(d)[1] for s, d in zip(index, shape))
+                return _assemble(final, entry, start, stop, dtype)
+
+            arr = jax.make_array_from_callback(shape, sharding, cb)
+        else:
+            arr = _assemble(
+                final, entry, (0,) * len(shape), shape, dtype
+            )
+            if sharding_leaves is not None:
+                arr = jax.device_put(arr, sharding_leaves[i])
         loaded.append(arr)
+    unused = set(by_path) - used
+    if unused:
+        logger.warning(
+            "checkpoint has %d leaves not present in the state (ignored): %s",
+            len(unused), sorted(unused)[:5],
+        )
     return jax.tree_util.tree_unflatten(treedef, loaded)

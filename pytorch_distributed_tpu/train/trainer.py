@@ -17,6 +17,7 @@ BASELINE.json:5,9,10) with one jit-compiled function:
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -164,6 +165,7 @@ class TrainerConfig:
     ckpt_every_steps: Optional[int] = None  # None -> end of epoch only
     eval_every_epochs: int = 1
     samples_axis: str = "image"  # batch leaf whose dim0 counts samples
+    async_checkpoint: bool = False  # overlap ckpt IO with training
     # failure detection / elastic recovery (train/elastic.py):
     handle_preemption: bool = True  # SIGTERM -> checkpoint -> Preempted
     stall_timeout_s: Optional[float] = None  # watchdog hang detection
@@ -211,15 +213,31 @@ class Trainer:
         self._resume_skip_batches = 0
         self._preemption = None
         self._watchdog = None
+        self._async_ckpt = None
+        if self.config.async_checkpoint:
+            from pytorch_distributed_tpu.train.checkpoint import (
+                AsyncCheckpointer,
+            )
+
+            self._async_ckpt = AsyncCheckpointer()
 
     # -- checkpointing ------------------------------------------------------
     def save_checkpoint(self, tag: str = "latest") -> Optional[str]:
-        if self.config.ckpt_dir is None or dist.get_rank() != 0:
+        if self.config.ckpt_dir is None:
+            return None
+        # hostring backend: state is fully replicated per rank, rank 0
+        # writes alone. SPMD multi-host: every process must participate
+        # (each writes its addressable shards; process 0 commits).
+        if dist.multiprocess_ring() is not None and dist.get_rank() != 0:
             return None
         from pytorch_distributed_tpu.train.checkpoint import save_checkpoint
 
-        path = save_checkpoint(self.config.ckpt_dir, self.state, tag=tag)
-        logger.info("checkpoint saved: %s (step %d)", path, int(self.state.step))
+        if self._async_ckpt is not None:
+            self._async_ckpt.save(self.config.ckpt_dir, self.state, tag=tag)
+            path = os.path.join(self.config.ckpt_dir, tag)
+        else:
+            path = save_checkpoint(self.config.ckpt_dir, self.state, tag=tag)
+        logger.info("checkpoint saved: %s (step %d)", path, self.host_step)
         if self._watchdog is not None:
             self._watchdog.tick()  # a slow (sharded) save is not a hang
         return path
@@ -277,6 +295,8 @@ class Trainer:
                     self.evaluate(epoch)
                 self.save_checkpoint()
         finally:
+            if self._async_ckpt is not None:
+                self._async_ckpt.wait()  # last save must land before exit
             if self._preemption is not None:
                 self._preemption.uninstall()
             if self._watchdog is not None:
@@ -290,6 +310,8 @@ class Trainer:
         if self._preemption is not None and self._preemption.requested:
             step = self.host_step
             self.save_checkpoint()
+            if self._async_ckpt is not None:
+                self._async_ckpt.wait()  # the restart will read it now
             logger.warning(
                 "preemption checkpoint written at step %d — exiting for "
                 "restart (resume restores from ckpt_dir)", step,

@@ -134,6 +134,39 @@ def mismatch_worker(rank: int, world: int, name: str, q) -> None:
         q.put((rank, f"{type(e).__name__}: {e}"))
 
 
+def p2p_worker(rank: int, world: int, name: str, q) -> None:
+    """True P2P: transfers between rank pairs with BYSTANDER ranks that never
+    enter the call — the case the old barrier-based sendrecv deadlocked on.
+    Also exercises multi-chunk payloads and a bidirectional exchange."""
+    try:
+        from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
+
+        with HostRingGroup(name, rank, world, timeout_s=60) as g:
+            # 0 -> world-1 while ranks in between do nothing
+            big = 1_000_003  # odd size: crosses the mailbox chunking path
+            if rank == 0:
+                g.send(np.arange(big, dtype=np.float32), dst=world - 1)
+            elif rank == world - 1:
+                out = g.recv(np.empty(big, np.float32), src=0)
+                assert np.array_equal(out, np.arange(big, dtype=np.float32))
+            # bidirectional pair exchange on (0, 1): distinct channels per
+            # direction, so ordering between the two sends is free
+            if rank == 0:
+                g.send(np.full(5, 10.0, np.float32), dst=1)
+                got = g.recv(np.empty(5, np.float32), src=1)
+                assert np.all(got == 20.0), got
+            elif rank == 1:
+                got = g.recv(np.empty(5, np.float32), src=0)
+                assert np.all(got == 10.0), got
+                g.send(np.full(5, 20.0, np.float32), dst=0)
+            # group still healthy for collectives afterwards
+            ar = g.all_reduce(np.ones(8, np.float32))
+            assert np.all(ar == world), ar
+        q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover - reported via queue
+        q.put((rank, f"{type(e).__name__}: {e}"))
+
+
 def failing_worker(rank: int) -> None:
     """Deliberate crash target for failure-propagation tests (no JAX)."""
     raise SystemExit(3)

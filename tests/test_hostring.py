@@ -71,6 +71,13 @@ def test_facade_multiprocess():
     assert results == [(r, "ok") for r in range(4)], results
 
 
+def test_p2p_send_recv_with_bystanders():
+    """send/recv between two ranks must complete while other ranks do
+    nothing (true P2P mailbox, not a barrier-gated group collective)."""
+    results = _run(3, hostring_workers.p2p_worker)
+    assert results == [(r, "ok") for r in range(3)], results
+
+
 def test_collective_mismatch_detected():
     """PTD_DISTRIBUTED_DEBUG=DETAIL analogue: divergent collective calls
     across ranks raise instead of corrupting data (SURVEY.md §5)."""

@@ -182,15 +182,31 @@ class TestCheckpoint:
             np.asarray(restored.params["w"]), np.asarray(state.params["w"])
         )
 
-    def test_structure_mismatch_raises(self, dp8, tmp_path):
+    def test_missing_leaf_raises_strict(self, dp8, tmp_path):
         save_checkpoint(str(tmp_path), dp8.place(linear_state()))
         other = TrainState.create(
             apply_fn=lambda p, x: x,
             params={"w": jnp.ones((4, 2)), "b": jnp.zeros((2,))},
             tx=optax.sgd(0.1),
         )
-        with pytest.raises(ValueError, match="structure mismatch"):
+        with pytest.raises(ValueError, match="not found in checkpoint"):
             restore_checkpoint(str(tmp_path), other)
+
+    def test_missing_leaf_kept_nonstrict(self, dp8, tmp_path):
+        saved = dp8.place(linear_state())
+        save_checkpoint(str(tmp_path), saved)
+        other = TrainState.create(
+            apply_fn=lambda p, x: x,
+            params={"w": jnp.zeros((4, 2)), "b": jnp.full((2,), 7.0)},
+            tx=optax.sgd(0.1),
+        )
+        restored = restore_checkpoint(str(tmp_path), other, strict=False)
+        # present path loads from the checkpoint...
+        np.testing.assert_allclose(
+            np.asarray(restored.params["w"]), np.asarray(saved.params["w"])
+        )
+        # ...absent path keeps the template value (new optimizer field case)
+        np.testing.assert_allclose(np.asarray(restored.params["b"]), 7.0)
 
     def test_shape_mismatch_raises(self, dp8, tmp_path):
         save_checkpoint(str(tmp_path), dp8.place(linear_state()))
@@ -207,8 +223,123 @@ class TestCheckpoint:
             params={"w2": jnp.ones((4, 2))},  # same shape, different name
             tx=optax.sgd(0.1),
         )
-        with pytest.raises(ValueError, match="path mismatch"):
+        with pytest.raises(ValueError, match="not found in checkpoint"):
             restore_checkpoint(str(tmp_path), renamed)
+
+    def test_fsdp_save_writes_shard_files_no_gather(self, tmp_path):
+        """The pod-scale property: an FSDP-sharded leaf is written as one
+        file per shard (each 1/N of the array), never a gathered whole."""
+        import json
+        import os
+
+        mesh = make_mesh(MeshSpec(fsdp=8))
+        fsdp = FSDP(mesh)
+        state = fsdp.place(
+            TrainState.create(
+                apply_fn=lambda p, x: x,
+                params={"w": jnp.ones((64, 16))},
+                tx=optax.sgd(0.1),
+            )
+        )
+        save_checkpoint(str(tmp_path), state)
+        with open(os.path.join(str(tmp_path), "latest", "manifest.json")) as f:
+            manifest = json.load(f)
+        entry = {e["path"]: e for e in manifest["leaves"]}["params_w"]
+        assert len(entry["shards"]) == 8  # one file per fsdp shard
+        sizes = [
+            tuple(b - a for a, b in zip(s["start"], s["stop"]))
+            for s in entry["shards"]
+        ]
+        assert all(sz == (8, 16) for sz in sizes), sizes  # 1/8 each
+        restored = restore_checkpoint(
+            str(tmp_path),
+            TrainState.create(
+                apply_fn=lambda p, x: x,
+                params={"w": jnp.zeros((64, 16))},
+                tx=optax.sgd(0.1),
+            ),
+        )
+        np.testing.assert_allclose(np.asarray(restored.params["w"]), 1.0)
+
+    def test_fsdp_to_dp_and_back(self, tmp_path):
+        """FSDP save -> DP restore and DP save -> FSDP restore, values
+        bit-identical both ways (VERDICT r1 #7)."""
+        rng = np.random.default_rng(5)
+        w = rng.normal(size=(64, 16)).astype(np.float32)
+
+        def mk_state():
+            return TrainState.create(
+                apply_fn=lambda p, x: x, params={"w": jnp.asarray(w)},
+                tx=optax.adam(1e-3),
+            )
+
+        mesh_f = make_mesh(MeshSpec(dp=2, fsdp=4))
+        fsdp = FSDP(mesh_f)
+        state_f = fsdp.place(mk_state())
+        save_checkpoint(str(tmp_path / "a"), state_f)
+
+        mesh_d = make_mesh(MeshSpec(dp=8))
+        dp = DataParallel(mesh_d)
+        restored_d = restore_checkpoint(
+            str(tmp_path / "a"), mk_state(), dp.state_shardings(mk_state())
+        )
+        np.testing.assert_array_equal(np.asarray(restored_d.params["w"]), w)
+
+        save_checkpoint(str(tmp_path / "b"), restored_d)
+        restored_f = restore_checkpoint(
+            str(tmp_path / "b"), mk_state(), fsdp.state_shardings(mk_state())
+        )
+        np.testing.assert_array_equal(np.asarray(restored_f.params["w"]), w)
+
+    @pytest.mark.slow
+    def test_gigabyte_state_saves_in_seconds(self, tmp_path):
+        """~1 GB FSDP state: sharded parallel save + sharded restore must
+        be IO-bound seconds, not gather-bound minutes (VERDICT r1 #7)."""
+        import time
+
+        mesh = make_mesh(MeshSpec(fsdp=8))
+        fsdp = FSDP(mesh)
+        # 8 x 32M f32 = 1.0 GB across 8 leaves
+        params = {
+            f"w{i}": jnp.ones((4096, 8192), jnp.float32) for i in range(8)
+        }
+        state = fsdp.place(
+            TrainState.create(
+                apply_fn=lambda p, x: x, params=params, tx=optax.sgd(0.1)
+            )
+        )
+        t0 = time.perf_counter()
+        save_checkpoint(str(tmp_path), state)
+        save_s = time.perf_counter() - t0
+        template = TrainState.create(
+            apply_fn=lambda p, x: x,
+            params={
+                f"w{i}": jnp.zeros((4096, 8192), jnp.float32)
+                for i in range(8)
+            },
+            tx=optax.sgd(0.1),
+        )
+        t0 = time.perf_counter()
+        restored = restore_checkpoint(
+            str(tmp_path), template, fsdp.state_shardings(template)
+        )
+        jax.block_until_ready(restored.params)
+        restore_s = time.perf_counter() - t0
+        assert float(restored.params["w3"][0, 0]) == 1.0
+        assert save_s < 60 and restore_s < 60, (save_s, restore_s)
+
+    def test_async_checkpointer(self, dp8, tmp_path):
+        from pytorch_distributed_tpu.train.checkpoint import AsyncCheckpointer
+
+        state = dp8.place(linear_state())
+        ck = AsyncCheckpointer()
+        ck.save(str(tmp_path), state)
+        ck.wait()
+        assert checkpoint_step(str(tmp_path)) == 0
+        restored = restore_checkpoint(str(tmp_path), linear_state())
+        np.testing.assert_allclose(
+            np.asarray(restored.params["w"]), np.asarray(state.params["w"])
+        )
 
     def test_old_checkpoint_survives_overwrite(self, dp8, tmp_path):
         import os
