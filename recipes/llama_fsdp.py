@@ -46,7 +46,7 @@ SIZES = {"tiny": LlamaConfig.tiny, "8b": LlamaConfig.llama3_8b}
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--backend", default=None)
-    p.add_argument("--size", choices=SIZES, default="8b")
+    p.add_argument("--size", choices=SIZES, default="tiny")
     p.add_argument("--epochs", type=int, default=1)
     p.add_argument("--batch-size", type=int, default=8, help="global batch")
     p.add_argument("--accum-steps", type=int, default=1)

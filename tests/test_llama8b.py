@@ -1,0 +1,137 @@
+"""Llama-3-8B FSDP feasibility proof (BASELINE.json:11, VERDICT r1 #8).
+
+No pod is available offline, so feasibility is proven abstractly — and
+cheaply — with the tools XLA itself uses:
+
+* ``jax.eval_shape`` builds the full 8B TrainState (params + AdamW moments)
+  as shapes only;
+* the FSDP strategy's shardings are computed against a *v5p-64-shaped*
+  ``AbstractMesh`` (dp=4, fsdp=16);
+* per-device bytes are summed from ``NamedSharding.shard_shape`` — the
+  exact shard math the runtime would use — and asserted under HBM;
+* the full train step is AOT-lowered for the ``tpu`` platform against
+  those shardings, proving the sharded program traces and lowers
+  end-to-end.
+
+If someone regresses the FSDP rules (e.g. a new param stops sharding),
+the byte budget assertion fails.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import AbstractMesh
+
+from pytorch_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from pytorch_distributed_tpu.parallel import FSDP
+from pytorch_distributed_tpu.train import (
+    TrainState,
+    build_train_step,
+    causal_lm_loss_fn,
+)
+
+SEQ = 2048
+GLOBAL_BATCH = 64
+V4_HBM_BYTES = 32e9  # per chip; v5p has 95GB — assert against the smaller
+
+
+@pytest.fixture(scope="module")
+def abstract_8b_state():
+    cfg = LlamaConfig.llama3_8b()
+    model = LlamaForCausalLM(cfg)
+
+    def make_state(key):
+        params = model.init(key, jnp.zeros((1, SEQ), jnp.int32))["params"]
+        return TrainState.create(
+            apply_fn=model.apply, params=params, tx=optax.adamw(1e-4)
+        )
+
+    abstract = jax.eval_shape(make_state, jax.random.key(0))
+    return cfg, model, abstract
+
+
+def test_8b_param_count(abstract_8b_state):
+    _, _, abstract = abstract_8b_state
+    n_params = sum(
+        int(np.prod(l.shape))
+        for l in jax.tree_util.tree_leaves(abstract.params)
+    )
+    assert 7.9e9 < n_params < 8.2e9, f"{n_params/1e9:.2f}B params"
+
+
+def _per_device_bytes(abstract, strategy):
+    per_device = 0
+    replicated_big = []
+    for (path, leaf), sh in zip(
+        jax.tree_util.tree_leaves_with_path(abstract),
+        jax.tree_util.tree_leaves(strategy.state_shardings(abstract)),
+    ):
+        if not hasattr(leaf, "shape"):
+            continue
+        shard_elems = int(np.prod(sh.shard_shape(tuple(leaf.shape))))
+        per_device += shard_elems * leaf.dtype.itemsize
+        if shard_elems == int(np.prod(leaf.shape)) and shard_elems > 1e6:
+            replicated_big.append(jax.tree_util.keystr(path))
+    return per_device, replicated_big
+
+
+def test_8b_fsdp_state_fits_v5p64(abstract_8b_state):
+    """Static state (params f32 + AdamW m/v f32 = ~96 GB total) per device,
+    under the two realistic 64-chip layouts. A broken FSDP rule that leaves
+    an 8B-scale tensor replicated blows straight past either ceiling."""
+    _, _, abstract = abstract_8b_state
+
+    # full-shard over all 64 chips (the reference FSDP full-shard shape):
+    # 96 GB / 64 = ~1.5 GB/device
+    per_device, replicated_big = _per_device_bytes(
+        abstract, FSDP(AbstractMesh((1, 64), ("dp", "fsdp")))
+    )
+    assert not replicated_big, (
+        f"large tensors left fully replicated: {replicated_big[:5]}"
+    )
+    assert per_device < 2e9, f"{per_device/1e9:.2f} GB static state/device"
+    assert per_device * 64 > 80e9, "state no longer 8B-sized — test stale?"
+
+    # hybrid dp=4 x fsdp=16 (params replicate across dp): 96/16 = 6 GB —
+    # still comfortably inside even v4's 32 GB HBM, leaving >3x headroom
+    # for grads + activations at seq 2048
+    per_device, _ = _per_device_bytes(
+        abstract, FSDP(AbstractMesh((4, 16), ("dp", "fsdp")))
+    )
+    assert per_device < 8e9, f"{per_device/1e9:.2f} GB static state/device"
+    assert per_device < V4_HBM_BYTES / 3
+
+
+@pytest.mark.slow
+def test_8b_fsdp_train_step_lowers_for_tpu(abstract_8b_state):
+    cfg, model, abstract = abstract_8b_state
+    mesh = AbstractMesh((4, 16), ("dp", "fsdp"))
+    strategy = FSDP(mesh)
+    shardings = strategy.state_shardings(abstract)
+    state_shapes = jax.tree_util.tree_map(
+        lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+        abstract,
+        shardings,
+    )
+    batch_shapes = {
+        "input_ids": jax.ShapeDtypeStruct(
+            (GLOBAL_BATCH, SEQ), jnp.int32, sharding=strategy.batch_sharding()
+        )
+    }
+    step = build_train_step(causal_lm_loss_fn(model))
+    lowered = (
+        jax.jit(step, donate_argnums=(0,))
+        .trace(state_shapes, batch_shapes)
+        .lower(lowering_platforms=("tpu",))
+    )
+    # the lowered module exists and is genuinely the sharded 8B program
+    text = lowered.as_text()
+    assert "stablehlo" in text or "module" in text
+    out_state, _ = lowered.out_info
+    n_out = sum(
+        int(np.prod(l.shape))
+        for l in jax.tree_util.tree_leaves(out_state.params)
+    )
+    assert n_out > 7.9e9
