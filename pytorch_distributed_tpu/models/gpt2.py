@@ -29,6 +29,12 @@ class GPT2Config:
     num_heads: int = 16
     dropout_rate: float = 0.1
     layer_norm_eps: float = 1e-5
+    # scan over layers: ONE block is traced/compiled instead of num_layers
+    # copies — the TPU-idiomatic layout (compile time scales O(1) in depth;
+    # block params stack to [L, ...], which sharding rules and pipeline
+    # stages consume directly). False restores the unrolled per-layer tree.
+    scan_layers: bool = True
+    remat: bool = False  # rematerialize each block in backward (saves HBM)
 
     @property
     def intermediate_size(self) -> int:
@@ -111,8 +117,15 @@ class GPT2LMHead(nn.Module):
         x = wte(input_ids) + wpe(jnp.arange(S)[None, :])
         x = nn.Dropout(cfg.dropout_rate)(x, deterministic=not train)
         x = x.astype(policy.compute_dtype)
-        for i in range(cfg.num_layers):
-            x = GPT2Block(cfg, name=f"block{i}")(x, deterministic=not train)
+        if cfg.scan_layers:
+            from pytorch_distributed_tpu.models.scan import scan_stack
+
+            x = scan_stack(
+                GPT2Block, cfg, static_argnums=(1,), name="blocks"
+            )(x, not train)
+        else:
+            for i in range(cfg.num_layers):
+                x = GPT2Block(cfg, name=f"block{i}")(x, deterministic=not train)
         x = nn.LayerNorm(
             epsilon=cfg.layer_norm_eps, dtype=policy.compute_dtype,
             param_dtype=policy.param_dtype, name="ln_f",
@@ -129,13 +142,19 @@ class GPT2LMHead(nn.Module):
 
 
 def gpt2_partition_rules():
-    """TP rules: qkv kernel [hidden, 3, heads, head_dim] — shard heads."""
+    """TP rules: qkv kernel [hidden, 3, heads, head_dim] — shard heads.
+
+    ``stacked`` adapts each spec to the scan layout's leading layer dim,
+    so the same rules serve scan_layers=True and the unrolled tree.
+    """
+    from pytorch_distributed_tpu.parallel.sharding import stacked
+
     return [
-        (r"attn_qkv/kernel", P(None, None, "tp", None)),
-        (r"attn_qkv/bias", P(None, "tp", None)),
-        (r"attn_out/kernel", P("tp", None, None)),  # [heads, hd, hidden]
-        (r"mlp_up/kernel", P(None, "tp")),
-        (r"mlp_up/bias", P("tp")),
-        (r"mlp_down/kernel", P("tp", None)),
+        (r"attn_qkv/kernel", stacked(P(None, None, "tp", None))),
+        (r"attn_qkv/bias", stacked(P(None, "tp", None))),
+        (r"attn_out/kernel", stacked(P("tp", None, None))),  # [heads, hd, hidden]
+        (r"mlp_up/kernel", stacked(P(None, "tp"))),
+        (r"mlp_up/bias", stacked(P("tp"))),
+        (r"mlp_down/kernel", stacked(P("tp", None))),
         (r"wte/embedding", P(None, "tp")),
     ]

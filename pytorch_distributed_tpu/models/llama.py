@@ -36,6 +36,10 @@ class LlamaConfig:
     max_seq_len: int = 8_192
     rope_theta: float = 500_000.0
     rms_eps: float = 1e-5
+    # scan over layers (models/scan.py): one compiled block, [L, ...]
+    # stacked params. False restores the unrolled per-layer tree.
+    scan_layers: bool = True
+    remat: bool = False  # recompute block activations in backward
 
     @property
     def head_dim(self) -> int:
@@ -117,10 +121,17 @@ class LlamaForCausalLM(nn.Module):
             name="embed",
         )(input_ids).astype(policy.compute_dtype)
         cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
-        for i in range(cfg.num_layers):
-            x = LlamaBlock(cfg, name=f"layer{i}")(
-                x, cos, sin, positions, deterministic=not train
-            )
+        if cfg.scan_layers:
+            from pytorch_distributed_tpu.models.scan import scan_stack
+
+            x = scan_stack(
+                LlamaBlock, cfg, static_argnums=(4,), name="layers"
+            )(x, cos, sin, positions, not train)
+        else:
+            for i in range(cfg.num_layers):
+                x = LlamaBlock(cfg, name=f"layer{i}")(
+                    x, cos, sin, positions, deterministic=not train
+                )
         x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
         logits = nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=policy.compute_dtype,
@@ -132,11 +143,13 @@ class LlamaForCausalLM(nn.Module):
 def llama_partition_rules():
     """Megatron TP: column-parallel q/k/v/gate/up, row-parallel o/down;
     embedding sharded on hidden, lm_head kernel on vocab (its dim 1)."""
+    from pytorch_distributed_tpu.parallel.sharding import stacked
+
     return [
-        (r"/(q|k|v)/kernel", P(None, "tp", None)),
-        (r"/o/kernel", P("tp", None, None)),
-        (r"/(gate|up)/kernel", P(None, "tp")),
-        (r"/down/kernel", P("tp", None)),
+        (r"/(q|k|v)/kernel", stacked(P(None, "tp", None))),
+        (r"/o/kernel", stacked(P("tp", None, None))),
+        (r"/(gate|up)/kernel", stacked(P(None, "tp"))),
+        (r"/down/kernel", stacked(P("tp", None))),
         (r"embed/embedding", P(None, "tp")),
         (r"lm_head/kernel", P(None, "tp")),
     ]

@@ -1,0 +1,69 @@
+"""Scan-over-layers: compile ONE transformer block, not ``num_layers``.
+
+The reference's eager CUDA modules pay nothing for Python-unrolled layer
+stacks; under XLA an unrolled stack multiplies trace/compile time by depth
+(GPT-2-medium = 24 copies of the same HLO) and bloats the program. The
+TPU-idiomatic layout is ``lax.scan`` over the depth axis — via ``nn.scan``
+so the block's params stack to ``[L, ...]``:
+
+* compile time is O(1) in depth,
+* sharding rules see one stacked tensor per weight (FSDP shards a dim of
+  it; TP rules adapt via ``parallel.sharding.stacked``),
+* pipeline parallelism consumes the stacked layout directly (stage dim =
+  groups of layers, ``parallel/pipeline.py``).
+
+``remat=True`` wraps the block in ``nn.remat`` so the backward pass
+recomputes each block's activations instead of storing them — the standard
+HBM/FLOPs trade for long sequences (jax.checkpoint).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple, Type
+
+import flax.linen as nn
+
+
+def scan_stack(
+    block_cls: Type[nn.Module],
+    cfg,
+    *,
+    length: Optional[int] = None,
+    remat: Optional[bool] = None,
+    static_argnums: Tuple[int, ...] = (),
+    name: str = "blocks",
+) -> Callable:
+    """Build the scanned stack and return ``f(x, *bcast) -> x``.
+
+    Must be called inside the parent module's ``@nn.compact`` ``__call__``
+    (the scanned module attaches to the caller's scope under ``name``).
+    ``block_cls(cfg).__call__(x, *bcast)`` takes the carried activation
+    first; every further argument is broadcast unchanged to all layers.
+    Under ``remat``, pass ``static_argnums`` (0 = ``x``) marking python-bool
+    args like ``deterministic`` so they stay static.
+    """
+    use_remat = cfg.remat if remat is None else remat
+
+    class Body(nn.Module):
+        @nn.compact
+        def __call__(self, x, *bcast):
+            return block_cls(cfg, name="block")(x, *bcast), None
+
+    body = (
+        nn.remat(Body, prevent_cse=False, static_argnums=static_argnums)
+        if use_remat
+        else Body
+    )
+    mod = nn.scan(
+        body,
+        variable_axes={"params": 0},
+        split_rngs={"params": True, "dropout": True},
+        in_axes=nn.broadcast,
+        length=length if length is not None else cfg.num_layers,
+    )(name=name)
+
+    def apply_stack(x, *bcast):
+        y, _ = mod(x, *bcast)
+        return y
+
+    return apply_stack

@@ -116,10 +116,12 @@ def set_attention_impl(impl: str) -> None:
 
     * ``"xla"``   — the einsum/softmax path above (XLA fuses it).
     * ``"flash"`` — the Pallas blocked kernel (ops/flash_attention.py).
-    * ``"auto"``  — flash on TPU when the call qualifies (no padding
-      mask, block-divisible sequence), XLA otherwise. The CPU test mesh
-      keeps the XLA path: interpret-mode kernels are orders of magnitude
-      slower and numerically identical.
+    * ``"auto"``  — currently the XLA path everywhere. The Pallas kernel
+      is opt-in ("flash") until its compile time on the axon remote-compile
+      toolchain is bounded: as of r2, compiling the fwd kernel at
+      (B8,S1024,H16,D64) exceeded 9 minutes and wedged the shared relay —
+      auto-dispatching it would hang any transformer step on the chip.
+      The XLA einsum path fuses well on TPU and is the measured default.
     """
     if impl not in ("auto", "flash", "xla"):
         raise ValueError(f"unknown attention impl {impl!r}")
@@ -157,15 +159,7 @@ def attention(
     if mask is None and q_offset == 0:
         if _IMPL == "flash":
             use_flash = True
-        elif _IMPL == "auto":
-            # only worth it when blocks stay at full (128) tile size; odd
-            # lengths would degrade to tiny blocks below the TPU tiling floor
-            use_flash = (
-                jax.default_backend() == "tpu"
-                and q.shape[1] >= 256
-                and q.shape[1] % 128 == 0
-                and k.shape[1] % 128 == 0
-            )
+        # _IMPL == "auto": XLA path — see set_attention_impl docstring.
     if use_flash:
         from pytorch_distributed_tpu.ops.flash_attention import flash_attention
 

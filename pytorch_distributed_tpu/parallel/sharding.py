@@ -104,6 +104,24 @@ def shard_along(
     return spec
 
 
+def stacked(spec: P) -> Callable[[Tuple[int, ...], Mesh], P]:
+    """Adapt a per-layer TP spec to scan-stacked params.
+
+    Models compiled with ``nn.scan`` over their blocks carry a leading
+    layer dim on every block param ([L, ...]); the layer dim is never
+    sharded by TP rules (it is the scan axis). When the tensor has exactly
+    one more dim than the spec, prepend None; otherwise (unrolled layout)
+    apply the spec as-is — so one rule set serves both layouts.
+    """
+
+    def f(shape: Tuple[int, ...], mesh: Mesh) -> P:
+        if len(shape) == len(spec) + 1:
+            return P(*([None] + list(spec)))
+        return spec
+
+    return f
+
+
 def infer_sharding(
     rules: PartitionRules,
     path: str,

@@ -132,8 +132,10 @@ class TestForward:
         cfg = LlamaConfig.tiny()
         model = LlamaForCausalLM(cfg)
         v = model.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))
-        k_kernel = v["params"]["layer0"]["k"]["kernel"]
-        assert k_kernel.shape == (cfg.hidden_size, cfg.num_kv_heads, cfg.head_dim)
+        k_kernel = v["params"]["layers"]["block"]["k"]["kernel"]
+        assert k_kernel.shape == (
+            cfg.num_layers, cfg.hidden_size, cfg.num_kv_heads, cfg.head_dim
+        )
 
 
 class TestTrainSteps:
@@ -161,7 +163,7 @@ class TestTrainSteps:
         assert float(m2["loss"]) < float(m1["loss"])
         # ZeRO-1 placement: opt state sharded, params TP-only
         mu = state.opt_state[0].mu
-        assert "dp" in str(mu["block0"]["mlp_up"]["kernel"].sharding.spec)
+        assert "dp" in str(mu["blocks"]["block"]["mlp_up"]["kernel"].sharding.spec)
 
     @pytest.mark.slow
     def test_llama_fsdp_tp_step(self):
@@ -179,8 +181,8 @@ class TestTrainSteps:
         strategy = FSDP(mesh, extra_rules=llama_partition_rules())
         state = strategy.place(state)
         # TP+FSDP composition on the gate kernel [hidden, ffn]
-        spec = state.params["layer0"]["gate"]["kernel"].sharding.spec
-        assert spec == P("fsdp", "tp")
+        spec = state.params["layers"]["block"]["gate"]["kernel"].sharding.spec
+        assert spec == P(None, "fsdp", "tp")  # [L, hidden, ffn]: tp rule + fsdp augment
         step = strategy.compile(build_train_step(causal_lm_loss_fn(model)), state)
         state, m = step(state, strategy.shard_batch({"input_ids": ids}))
         assert np.isfinite(float(m["loss"]))
@@ -198,10 +200,13 @@ class TestTrainSteps:
             )
 
         state = strategy.create_sharded(make_state, jax.random.key(0))
-        spec = state.params["layer0"]["gate"]["kernel"].sharding.spec
-        assert spec == P("fsdp", "tp")
+        spec = state.params["layers"]["block"]["gate"]["kernel"].sharding.spec
+        assert spec == P(None, "fsdp", "tp")  # [L, hidden, ffn]: tp rule + fsdp augment
         mu = state.opt_state[0].mu  # adamw: (ScaleByAdamState, ...)
-        assert mu["layer0"]["gate"]["kernel"].sharding.spec == P("fsdp", "tp")
+        assert (
+            mu["layers"]["block"]["gate"]["kernel"].sharding.spec
+            == P(None, "fsdp", "tp")
+        )
 
     @pytest.mark.slow
     def test_bert_ddp_amp_step(self):
