@@ -1,0 +1,175 @@
+"""Pipeline-parallel decoder LMs: GPipe stages over the scanned block stack.
+
+Bridges the generic schedule (``parallel/pipeline.py``) to a *real*
+transformer: the scanned models (``models/scan.py``) already keep their
+block params stacked ``[L, ...]``, which is exactly the pipeline's stage
+layout once grouped to ``[pp, L/pp, ...]``. Embedding / final-norm / head
+run replicated on every stage (they are <1% of the FLOPs; SPMD dedups the
+memory via sharding propagation), the block stack runs through the
+``ppermute`` tick loop, and autodiff of the scan yields the reverse
+schedule — so the SAME ``build_train_step``/Trainer machinery trains a
+pipelined model with no bespoke training loop.
+
+The reference has no pipeline parallelism (SURVEY.md §2) — capability
+extension. Blocks run with dropout disabled inside the pipeline (per-layer
+rng plumbing through the tick loop isn't worth the complexity for a
+regularizer; GPT-2 convergence is unaffected at recipe scale).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_tpu.parallel.pipeline import (
+    merge_microbatches,
+    pipeline_forward,
+    split_microbatches,
+)
+from pytorch_distributed_tpu.parallel.sharding import PartitionRules
+from pytorch_distributed_tpu.parallel.strategies import Strategy
+
+
+def _block_stage_fn(block_module) -> Callable:
+    """stage_fn for pipeline_forward: scan this stage's layers of a block.
+
+    ``stage_params`` leaves are [L/pp, ...]; the scan consumes the leading
+    per-stage layer dim. Blocks run deterministic (see module docstring).
+    """
+
+    def stage_fn(stage_params, x):
+        def body(c, p):
+            return block_module.apply({"params": p}, c, True), None
+
+        y, _ = lax.scan(body, x, stage_params)
+        return y
+
+    return stage_fn
+
+
+def gpt2_pipeline_logits(
+    cfg,
+    params,
+    input_ids,
+    *,
+    num_microbatches: int,
+    axis: str = "pp",
+):
+    """[B, S] ids -> [B, S, vocab] logits, block stack pipelined over
+    ``axis``. ``params`` is the scanned GPT2LMHead tree (scan_layers=True;
+    blocks/block/* stacked [L, ...])."""
+    import flax.linen as nn
+
+    from pytorch_distributed_tpu.models.gpt2 import GPT2Block
+    from pytorch_distributed_tpu.runtime.mesh import current_mesh
+    from pytorch_distributed_tpu.runtime.precision import current_policy
+
+    policy = current_policy()
+    mesh = current_mesh()
+    pp = mesh.shape[axis]
+    B, S = input_ids.shape
+
+    wte = params["wte"]["embedding"]
+    wpe = params["wpe"]["embedding"]
+    x = wte[input_ids] + wpe[jnp.arange(S)][None, :]
+    x = x.astype(policy.compute_dtype)
+
+    blocks = params["blocks"]["block"]
+    L = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+    if L % pp:
+        raise ValueError(f"{L} layers not divisible by {pp} pipeline stages")
+    staged = jax.tree_util.tree_map(
+        lambda p: p.reshape((pp, L // pp) + p.shape[1:]), blocks
+    )
+    mbs = split_microbatches(x, num_microbatches)
+    y = pipeline_forward(
+        _block_stage_fn(GPT2Block(cfg)), staged, mbs, axis=axis, mesh=mesh
+    )
+    x = merge_microbatches(y)
+
+    x = nn.LayerNorm(
+        epsilon=cfg.layer_norm_eps, dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype,
+    ).apply({"params": params["ln_f"]}, x)
+    logits = jnp.einsum(
+        "bsd,vd->bsv",
+        x,
+        wte.astype(policy.compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits.astype(policy.output_dtype)
+
+
+def pipelined_causal_lm_loss_fn(
+    cfg,
+    *,
+    num_microbatches: int,
+    axis: str = "pp",
+    ids_key: str = "input_ids",
+) -> Callable:
+    """Trainer-contract loss: next-token CE through the pipelined forward.
+
+    Drop-in for ``causal_lm_loss_fn`` — same (params, batch_stats, batch,
+    rng) signature, so ``build_train_step``/Trainer/recipes work unchanged.
+    """
+
+    def loss_fn(params, batch_stats, batch, rng):
+        ids = batch[ids_key]
+        logits = gpt2_pipeline_logits(
+            cfg, params, ids, num_microbatches=num_microbatches, axis=axis
+        )
+        shift_logits = logits[:, :-1].astype(jnp.float32)
+        shift_labels = ids[:, 1:]
+        loss = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(
+                shift_logits, shift_labels
+            )
+        )
+        return loss, {"metrics": {"loss": loss}, "batch_stats": batch_stats}
+
+    return loss_fn
+
+
+def _shard_leading(axis: str):
+    def spec(shape, mesh):
+        if shape and shape[0] % mesh.shape[axis] == 0 and shape[0] > 1:
+            return P(axis)
+        return P()
+
+    return spec
+
+
+class PipelineParallel(Strategy):
+    """Stacked block params sharded over ``pp`` on the layer dim; embed /
+    norms / head replicated; batch over the data axes (composes with dp).
+
+    The [L, ...] layer dim sharded P("pp") IS the stage assignment:
+    reshaping to [pp, L/pp, ...] inside the step lands each stage's layers
+    exactly on its own shard — no data movement at the pipeline boundary.
+    """
+
+    def __init__(self, mesh=None, *, axis: str = "pp",
+                 block_pat: str = r"(blocks|layers)/block/", **kw):
+        super().__init__(mesh, **kw)
+        self.axis = axis
+        self.block_pat = block_pat
+
+    def param_rules(self) -> PartitionRules:
+        tp = [
+            (pat, self._wrap_tp(spec, self._transform_tp_param_spec))
+            for pat, spec in self.extra_rules
+        ]
+        return PartitionRules(
+            tp
+            + [
+                (self.block_pat, _shard_leading(self.axis)),
+                (".*", None),
+            ]
+        )
+
+    opt_rules = param_rules  # moments mirror the param layout
