@@ -116,6 +116,120 @@ def bench_resnet50(on_tpu: bool) -> None:
     )
 
 
+def bench_input_pipeline(on_tpu: bool) -> None:
+    """ResNet-50 with the REAL input pipeline in the measured loop.
+
+    VERDICT r1 missing #4: the synthetic-batch number above re-feeds one
+    pre-sharded device batch; this variant assembles every batch on the
+    host — DataLoader + native prefetch.cpp (threaded gather + fused
+    random-crop/flip/u8->f32-normalize) — and device_puts it each step,
+    like the reference's DataLoader+pinned-memory path. Reports the
+    host-feed rate alone and the end-to-end training rate.
+    """
+    from pytorch_distributed_tpu.data import ArrayDataset, DataLoader
+    from pytorch_distributed_tpu.data.native_pipeline import ImageBatchPipeline
+    from pytorch_distributed_tpu.models import ResNet50
+    from pytorch_distributed_tpu.parallel import DataParallel
+    from pytorch_distributed_tpu.train import (
+        TrainState,
+        build_train_step,
+        classification_loss_fn,
+    )
+
+    if on_tpu:
+        n_img, src, crop, batch_per_chip, steps = 1024, 256, 224, 128, 40
+    else:
+        n_img, src, crop, batch_per_chip, steps = 64, 40, 32, 8, 3
+
+    n_chips = ptd.get_world_size()
+    batch = batch_per_chip * n_chips
+    rng = np.random.default_rng(0)
+    ds = ArrayDataset(
+        image=rng.integers(0, 256, size=(n_img, src, src, 3), dtype=np.uint8),
+        label=rng.integers(1000, size=(n_img,)).astype(np.int64),
+    )
+    strategy = DataParallel()
+    pipe = ImageBatchPipeline(crop, train=True)
+
+    def make_loader():
+        return DataLoader(
+            ds, batch, shuffle=True, sharding=strategy.batch_sharding(),
+            fetch=pipe, prefetch=4,
+        )
+
+    # -- host-feed rate alone (assemble + device_put, no compute) ----------
+    loader = make_loader()
+    done = 0
+    t0 = time.perf_counter()
+    epoch = 0
+    while done < steps:
+        loader.set_epoch(epoch)
+        for b in loader:
+            jax.block_until_ready(b["image"])
+            done += 1
+            if done >= steps:
+                break
+        epoch += 1
+    feed_dt = time.perf_counter() - t0
+    feed_rate = batch * steps / feed_dt
+
+    # -- end-to-end: loader feeding the jitted train step ------------------
+    model = ResNet50(num_classes=1000)
+    variables = model.init(
+        jax.random.key(0), jnp.zeros((1, crop, crop, 3)), train=False
+    )
+    state = TrainState.create(
+        apply_fn=model.apply,
+        params=variables["params"],
+        tx=optax.sgd(0.1, momentum=0.9),
+        batch_stats=variables["batch_stats"],
+    )
+    state = strategy.place(state)
+    step = strategy.compile(
+        build_train_step(classification_loss_fn(model)), state
+    )
+    warm = next(iter(make_loader()))
+    state, metrics = step(state, warm)  # compile outside the timed loop
+    float(metrics["loss"])
+
+    done = 0
+    epoch = 0
+    t0 = time.perf_counter()
+    while done < steps:
+        loader.set_epoch(epoch)
+        for b in loader:
+            state, metrics = step(state, b)
+            done += 1
+            if done >= steps:
+                break
+        epoch += 1
+    final_loss = float(metrics["loss"])  # sync the whole chain
+    e2e_dt = time.perf_counter() - t0
+    e2e_rate = batch * steps / e2e_dt / n_chips
+
+    _emit(
+        {
+            "metric": "input_pipeline_feed_images_per_sec",
+            "value": round(feed_rate, 1),
+            "unit": f"images/sec host->device, src={src} crop={crop}",
+            "vs_baseline": None,
+        }
+    )
+    _emit(
+        {
+            "metric": "resnet50_e2e_dataloader_images_per_sec_per_chip",
+            "value": round(e2e_rate, 2),
+            "unit": "images/sec/chip",
+            "vs_baseline": round(e2e_rate / A100_TARGET_IMG_PER_SEC, 4),
+        }
+    )
+    print(
+        f"# input_pipeline: feed={feed_rate:.0f} img/s e2e={e2e_rate:.0f} "
+        f"img/s/chip steps={steps} loss={final_loss:.3f}",
+        file=sys.stderr,
+    )
+
+
 def bench_gpt2(on_tpu: bool) -> None:
     """GPT-2-medium train-step tokens/sec (scanned blocks, XLA attention).
 
@@ -283,6 +397,7 @@ def main():
     on_tpu = ptd.is_tpu()
     ptd.init_process_group()
     bench_resnet50(on_tpu)
+    bench_input_pipeline(on_tpu)
     bench_gpt2(on_tpu)
     bench_allreduce_device(on_tpu)
     try:

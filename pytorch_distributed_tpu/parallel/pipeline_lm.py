@@ -18,6 +18,7 @@ regularizer; GPT-2 convergence is unaffected at recipe scale).
 
 from __future__ import annotations
 
+import re
 from typing import Callable, Optional
 
 import jax
@@ -62,7 +63,13 @@ def gpt2_pipeline_logits(
 ):
     """[B, S] ids -> [B, S, vocab] logits, block stack pipelined over
     ``axis``. ``params`` is the scanned GPT2LMHead tree (scan_layers=True;
-    blocks/block/* stacked [L, ...])."""
+    blocks/block/* stacked [L, ...]).
+
+    The embed/ln_f/tied-head tails here mirror ``GPT2LMHead.__call__``
+    (models/gpt2.py) — keep the two in lockstep when changing either;
+    ``test_gpt2_pipeline_logits_match_plain_forward`` pins the pairing.
+    (Embedding dropout is omitted: blocks run deterministic in the
+    pipeline, see module docstring.)"""
     import flax.linen as nn
 
     from pytorch_distributed_tpu.models.gpt2 import GPT2Block
@@ -135,13 +142,33 @@ def pipelined_causal_lm_loss_fn(
     return loss_fn
 
 
-def _shard_leading(axis: str):
-    def spec(shape, mesh):
-        if shape and shape[0] % mesh.shape[axis] == 0 and shape[0] > 1:
-            return P(axis)
-        return P()
+class _PipelineRules(PartitionRules):
+    """TP rules composed with the pp stage sharding, not racing it.
 
-    return spec
+    Plain first-match-wins rules can't express "apply the TP spec AND
+    shard the layer dim over pp" — a TP rule matching a block param would
+    win and silently drop the stage sharding. This subclass resolves the
+    TP/fallback spec first, then forces the leading (layer) dim of every
+    block-stack param onto ``axis``.
+    """
+
+    def __init__(self, rules, block_pat: str, axis: str):
+        super().__init__(rules)
+        self._block = re.compile(block_pat)
+        self._axis = axis
+
+    def spec_for(self, path, shape, mesh=None):
+        spec = super().spec_for(path, shape, mesh)
+        if not self._block.search(path):
+            return spec
+        from pytorch_distributed_tpu.runtime.mesh import current_mesh
+
+        size = (mesh or current_mesh()).shape[self._axis]
+        entries = list(spec) if spec is not None else []
+        entries += [None] * (len(shape) - len(entries))
+        if entries and entries[0] is None and shape[0] % size == 0 and shape[0] >= size:
+            entries[0] = self._axis
+        return P(*entries)
 
 
 class PipelineParallel(Strategy):
@@ -151,6 +178,8 @@ class PipelineParallel(Strategy):
     The [L, ...] layer dim sharded P("pp") IS the stage assignment:
     reshaping to [pp, L/pp, ...] inside the step lands each stage's layers
     exactly on its own shard — no data movement at the pipeline boundary.
+    TP ``extra_rules`` compose: block params keep their TP axes *and* get
+    the leading layer dim on ``pp`` (see _PipelineRules).
     """
 
     def __init__(self, mesh=None, *, axis: str = "pp",
@@ -164,12 +193,8 @@ class PipelineParallel(Strategy):
             (pat, self._wrap_tp(spec, self._transform_tp_param_spec))
             for pat, spec in self.extra_rules
         ]
-        return PartitionRules(
-            tp
-            + [
-                (self.block_pat, _shard_leading(self.axis)),
-                (".*", None),
-            ]
+        return _PipelineRules(
+            tp + [(".*", None)], self.block_pat, self.axis
         )
 
     opt_rules = param_rules  # moments mirror the param layout

@@ -322,6 +322,7 @@ class Trainer:
         cfg = self.config
         t_last = time.perf_counter()
         steps_since_log = 0
+        steps_since_sync = 0
         skip = self._resume_skip_batches
         self._resume_skip_batches = 0
         for batch in self.train_loader:
@@ -336,6 +337,15 @@ class Trainer:
                 self._watchdog.tick()
             self._check_preemption()
             steps_since_log += 1
+            steps_since_sync += 1
+            if steps_since_sync >= 64:
+                # Bound the async dispatch chain: with logging off (or a
+                # huge log_every) nothing else syncs, and thousands of
+                # donated steps queued unsynced abort the XLA runtime.
+                # A value fetch (not block_until_ready, which the axon
+                # relay backend doesn't honor) drains the queue.
+                float(jax.tree_util.tree_leaves(metrics)[0])
+                steps_since_sync = 0
             if cfg.log_every and step % cfg.log_every == 0:
                 # sync point: pull metrics (blocks on the step's result)
                 metrics = {k: float(v) for k, v in metrics.items()}
@@ -343,6 +353,7 @@ class Trainer:
                 dt = (now - t_last) / steps_since_log
                 t_last = now
                 steps_since_log = 0
+                steps_since_sync = 0  # the float()s above just synced
                 self.meter.update(MeterState(step_time=dt, samples_per_sec=n / dt))
                 logger.info(
                     "epoch %d step %d %s %.1f samples/s (%.1f ms/step)",

@@ -3,6 +3,13 @@
 Single-controller SPMD has one process per host; only the first host
 (process_index 0) should emit training logs — the analogue of the
 reference recipes' ``if rank == 0: print(...)`` gating.
+
+The rank check is deferred to the first *emitted* record (via a logging
+filter), not done at ``get_logger`` time: modules create loggers at import,
+and resolving ``jax.process_index()`` there would initialize the backend as
+an import side effect — on the axon relay that dials the single-chip tunnel
+(and blocks indefinitely if another process holds the lease) before the
+importer has run a single line.
 """
 
 from __future__ import annotations
@@ -10,9 +17,35 @@ from __future__ import annotations
 import logging
 import sys
 
-from pytorch_distributed_tpu.runtime import device as _device
-
 _CONFIGURED = False
+
+
+class _Rank0Filter(logging.Filter):
+    """Drop records on non-zero hosts; resolve the rank lazily per record.
+
+    The answer is only cached once ``jax.distributed`` is initialized (or
+    provably single-process): before that, ``jax.process_index()`` returns
+    0 on *every* host, and caching that early answer would permanently
+    disable the gate on non-zero hosts for records emitted during setup.
+    """
+
+    _is_rank0 = None
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if _Rank0Filter._is_rank0 is not None:
+            return _Rank0Filter._is_rank0
+        from pytorch_distributed_tpu.runtime import device as _device
+
+        is_rank0 = _device.process_index() == 0
+        try:
+            from jax._src import distributed as _jdist
+
+            multihost_settled = _jdist.global_state.client is not None
+        except Exception:  # pragma: no cover - jax internals moved
+            multihost_settled = True
+        if multihost_settled or _device.process_count() > 1:
+            _Rank0Filter._is_rank0 = is_rank0
+        return is_rank0
 
 
 def _configure_root() -> None:
@@ -23,6 +56,9 @@ def _configure_root() -> None:
     handler.setFormatter(
         logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
     )
+    # on the HANDLER, not the logger: logger-level filters don't see
+    # records propagated up from child loggers, handler filters do
+    handler.addFilter(_Rank0Filter())
     root = logging.getLogger("pytorch_distributed_tpu")
     root.addHandler(handler)
     root.setLevel(logging.INFO)
@@ -31,12 +67,9 @@ def _configure_root() -> None:
 
 
 def get_logger(name: str) -> logging.Logger:
-    """Logger that is silent on non-zero hosts."""
+    """Logger that is silent on non-zero hosts (decided at first emit)."""
     _configure_root()
-    logger = logging.getLogger(name)
-    if _device.process_index() != 0:
-        logger.setLevel(logging.CRITICAL)
-    return logger
+    return logging.getLogger(name)
 
 
 def log_rank0(msg: str, *args) -> None:

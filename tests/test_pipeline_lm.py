@@ -1,0 +1,152 @@
+"""Pipeline-parallel GPT-2: the real-transformer bridge (parallel/pipeline_lm.py).
+
+Validates that the GPipe schedule over the scanned block stack reproduces
+the plain (non-pipelined) forward, and that a full Strategy-compiled train
+step through ``pipelined_causal_lm_loss_fn`` learns, with the block stack
+genuinely sharded over the ``pp`` mesh axis.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import pytorch_distributed_tpu as ptd
+from pytorch_distributed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from pytorch_distributed_tpu.parallel.pipeline_lm import (
+    PipelineParallel,
+    gpt2_pipeline_logits,
+    pipelined_causal_lm_loss_fn,
+)
+from pytorch_distributed_tpu.runtime.mesh import MeshSpec
+from pytorch_distributed_tpu.train import (
+    TrainState,
+    build_train_step,
+    causal_lm_loss_fn,
+)
+
+CFG = GPT2Config(
+    vocab_size=128, n_positions=32, hidden_size=32, num_layers=4, num_heads=2,
+    dropout_rate=0.0,
+)
+
+
+def _init(seed=0, B=4, S=16):
+    model = GPT2LMHead(CFG)
+    rng = np.random.default_rng(seed)
+    ids = jnp.asarray(rng.integers(CFG.vocab_size, size=(B, S)).astype(np.int32))
+    params = model.init(jax.random.key(0), ids[:1])["params"]
+    return model, params, ids
+
+
+def test_gpt2_pipeline_logits_match_plain_forward():
+    ptd.init_process_group(mesh_spec=MeshSpec(dp=-1, pp=2))
+    model, params, ids = _init()
+    want = model.apply({"params": params}, ids, train=False)
+    got = gpt2_pipeline_logits(CFG, params, ids, num_microbatches=2)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-2, rtol=1e-2
+    )
+
+
+@pytest.mark.slow
+def test_gpt2_pipeline_four_stages_one_layer_each():
+    ptd.init_process_group(mesh_spec=MeshSpec(dp=-1, pp=4))
+    model, params, ids = _init()
+    want = model.apply({"params": params}, ids, train=False)
+    got = gpt2_pipeline_logits(CFG, params, ids, num_microbatches=4)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-2, rtol=1e-2
+    )
+
+
+@pytest.mark.slow
+def test_pipelined_loss_matches_plain_loss():
+    ptd.init_process_group(mesh_spec=MeshSpec(dp=-1, pp=2))
+    model, params, ids = _init()
+    plain = causal_lm_loss_fn(model)
+    piped = pipelined_causal_lm_loss_fn(CFG, num_microbatches=2)
+    rng = jax.random.key(1)
+    l_plain, _ = plain(params, {}, {"input_ids": ids}, rng)
+    l_piped, _ = piped(params, {}, {"input_ids": ids}, rng)
+    np.testing.assert_allclose(
+        float(l_piped), float(l_plain), rtol=2e-2
+    )
+
+
+def test_pipeline_parallel_strategy_trains_gpt2():
+    """Strategy-compiled train step: blocks sharded over pp, loss decreases."""
+    ptd.init_process_group(mesh_spec=MeshSpec(dp=-1, pp=2))
+    model, params, ids = _init(B=8)
+    state = TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.adam(1e-2)
+    )
+    strategy = PipelineParallel()
+    state = strategy.place(state)
+
+    # the stacked block params must actually live sharded over pp
+    block_leaf = state.params["blocks"]["block"]["attn_qkv"]["kernel"]
+    spec = block_leaf.sharding.spec
+    assert spec and spec[0] == "pp", spec
+    # embeddings/head stay replicated
+    wte = state.params["wte"]["embedding"]
+    assert wte.sharding.is_fully_replicated
+
+    step = strategy.compile(
+        build_train_step(
+            pipelined_causal_lm_loss_fn(CFG, num_microbatches=4)
+        ),
+        state,
+    )
+    batch = strategy.shard_batch({"input_ids": np.asarray(ids)})
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_pipeline_composes_with_tensor_parallel_rules():
+    """TP extra_rules must not evict the pp stage sharding (r2 review)."""
+    from pytorch_distributed_tpu.models.gpt2 import gpt2_partition_rules
+
+    ptd.init_process_group(mesh_spec=MeshSpec(dp=-1, pp=2, tp=2))
+    model, params, ids = _init()
+    state = TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.adam(1e-2)
+    )
+    strategy = PipelineParallel(extra_rules=gpt2_partition_rules())
+    state = strategy.place(state)
+    qkv = state.params["blocks"]["block"]["attn_qkv"]["kernel"]
+    spec = qkv.sharding.spec
+    assert spec[0] == "pp", spec              # stage sharding kept
+    assert "tp" in jax.tree_util.tree_leaves(tuple(spec)), spec  # TP kept
+    mlp = state.params["blocks"]["block"]["mlp_up"]["kernel"].sharding.spec
+    assert mlp[0] == "pp" and "tp" in tuple(mlp), mlp
+    # embeddings: TP rule applies, no pp
+    wte = state.params["wte"]["embedding"].sharding.spec
+    assert "pp" not in tuple(wte), wte
+
+    step = strategy.compile(
+        build_train_step(
+            pipelined_causal_lm_loss_fn(CFG, num_microbatches=2)
+        ),
+        state,
+    )
+    batch = strategy.shard_batch({"input_ids": np.asarray(ids)})
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_pipeline_layer_count_mismatch_raises():
+    ptd.init_process_group(mesh_spec=MeshSpec(dp=-1, pp=2))
+    cfg = GPT2Config(
+        vocab_size=64, n_positions=16, hidden_size=16, num_layers=3,
+        num_heads=2, dropout_rate=0.0,
+    )
+    model = GPT2LMHead(cfg)
+    ids = jnp.zeros((2, 8), jnp.int32)
+    params = model.init(jax.random.key(0), ids)["params"]
+    with pytest.raises(ValueError, match="divisible"):
+        gpt2_pipeline_logits(cfg, params, ids, num_microbatches=2)

@@ -104,3 +104,44 @@ def test_resnet50_imagenet_recipe_smoke():
         ]
     )
     assert "accuracy" in metrics and "loss" in metrics
+
+
+def test_imports_never_initialize_a_backend():
+    """Importing the framework must not touch a device.
+
+    On the axon relay a backend init dials the single-chip tunnel and can
+    block for minutes when another process holds the lease; an import-time
+    init (e.g. a module-level logger resolving jax.process_index(), the
+    r2 regression this test pins) hangs every importer — including the
+    driver's dryrun parent whose only job is to re-exec a CPU child.
+    """
+    import subprocess
+    import sys
+
+    code = (
+        "import sys\n"
+        "import jax._src.xla_bridge as xb\n"
+        # fail loudly if jax renames the internal this tripwire patches —
+        # otherwise the assignment silently tests nothing
+        "assert callable(getattr(xb, '_init_backend', None)), "
+        "'jax moved _init_backend; update this tripwire'\n"
+        "def _bomb(p):\n"
+        "    print('INIT-BACKEND:', p, file=sys.stderr, flush=True)\n"
+        "    raise SystemExit(7)\n"
+        "xb._init_backend = _bomb\n"
+        "import pytorch_distributed_tpu\n"
+        "import pytorch_distributed_tpu.train\n"
+        "import pytorch_distributed_tpu.parallel\n"
+        "import pytorch_distributed_tpu.data\n"
+        "import pytorch_distributed_tpu.models\n"
+        "import pytorch_distributed_tpu.utils.profiler\n"
+        "import pytorch_distributed_tpu.utils.config\n"
+        "import pytorch_distributed_tpu.launch\n"
+        "import pytorch_distributed_tpu.run\n"
+        "print('CLEAN')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0 and "CLEAN" in proc.stdout, proc.stderr[-2000:]
