@@ -25,6 +25,7 @@ Public API is re-exported here so recipes can do::
 
 from pytorch_distributed_tpu.runtime.device import (
     device_count,
+    enable_compilation_cache,
     local_device_count,
     platform,
     is_tpu,
@@ -45,9 +46,13 @@ from pytorch_distributed_tpu.runtime.distributed import (
     get_backend,
     all_reduce,
     all_gather,
+    all_to_all,
     reduce_scatter,
     broadcast,
     barrier,
+    gather,
+    scatter,
+    permute,
     ReduceOp,
 )
 from pytorch_distributed_tpu.runtime.precision import (
@@ -83,10 +88,15 @@ __all__ = [
     "get_backend",
     "all_reduce",
     "all_gather",
+    "all_to_all",
     "reduce_scatter",
     "broadcast",
     "barrier",
+    "gather",
+    "scatter",
+    "permute",
     "ReduceOp",
+    "enable_compilation_cache",
     "Policy",
     "autocast",
     "GradScaler",

@@ -277,6 +277,24 @@ def _collective(kind: str, op: ReduceOp, axes: tuple, mesh: Mesh):
             return reduce_fn(x[0])
 
         out_spec = P()
+    elif kind == "all_to_all":
+
+        def f(x):
+            # participant p sends chunk j of its [W*c, ...] row to j and
+            # concatenates what it receives — torch all_to_all_single
+            return lax.all_to_all(
+                x[0], axes, split_axis=0, concat_axis=0, tiled=True
+            )[None]
+
+        out_spec = P(axes)
+    elif kind == "permute":
+        # op smuggles the perm tuple (hashable) through the lru_cache key
+        perm = op
+
+        def f(x):
+            return lax.ppermute(x, axes, perm=perm)
+
+        out_spec = P(axes)
     elif kind == "all_gather":
 
         def f(x):
@@ -381,6 +399,84 @@ def broadcast(x, src: int = 0, *, axis=None):
     if not 0 <= src < size:
         raise ValueError(f"src {src} out of range for {size} participants")
     return jax.device_put(x[src], NamedSharding(g.mesh, P()))
+
+
+def all_to_all(x, *, axis=None):
+    """Each participant splits its row into per-peer chunks and exchanges.
+
+    Input [participants, participants * chunk, ...]; output the same shape
+    where ``out[p] = concat_j x[j][p-th chunk]`` — the facade translation
+    of ``torch.distributed.all_to_all_single`` (the Ulysses/expert-parallel
+    exchange). Rides the ICI as one XLA AllToAll.
+    """
+    g = _group()
+    if g.ring is not None:
+        return jnp.asarray(g.ring.all_to_all(np.asarray(x)))
+    axes = _participant_axes(axis)
+    x = jnp.asarray(x)
+    size = _check_leading(x, axes, g.mesh)
+    if x.ndim < 2 or x.shape[1] % size != 0:
+        raise ValueError(
+            f"all_to_all needs dim 1 divisible by participant count {size}, "
+            f"got shape {x.shape}"
+        )
+    fn = _collective("all_to_all", ReduceOp.SUM, axes, g.mesh)
+    return fn(jax.device_put(x, NamedSharding(g.mesh, P(axes))))
+
+
+def permute(x, perm, *, axis=None):
+    """Point-to-point block exchange: ``out[dst] = x[src]`` per (src, dst).
+
+    The TPU-native replacement for NCCL send/recv pairs — a ``ppermute``
+    whose transfers ride the ICI torus concurrently (neighbor exchanges,
+    halo swaps, pipeline handoffs). Destinations no pair names receive
+    zeros. For true host-side P2P under the multi-process backend, use
+    ``HostRingGroup.send``/``recv``.
+    """
+    g = _group()
+    if g.ring is not None:
+        raise NotImplementedError(
+            "permute is an SPMD collective; under the hostring backend use "
+            "HostRingGroup.send/recv"
+        )
+    axes = _participant_axes(axis)
+    x = jnp.asarray(x)
+    size = _check_leading(x, axes, g.mesh)
+    perm = tuple((int(s), int(d)) for s, d in perm)
+    for s, d in perm:
+        if not (0 <= s < size and 0 <= d < size):
+            raise ValueError(f"perm pair ({s},{d}) out of range for {size}")
+    fn = _collective("permute", perm, axes, g.mesh)
+    return fn(jax.device_put(x, NamedSharding(g.mesh, P(axes))))
+
+
+def gather(x, dst: int = 0, *, axis=None):
+    """Gather participant slices to ``dst`` (torch.distributed.gather).
+
+    Single-controller SPMD has no per-rank host to collect *to* — the
+    controller addresses every shard — so this is ``all_gather`` with the
+    torch call shape; ``dst`` is accepted for recipe-script parity.
+    """
+    del dst
+    return all_gather(x, axis=axis)
+
+
+def scatter(x, src: int = 0, *, axis=None):
+    """Scatter ``src``'s per-participant slices (torch.distributed.scatter).
+
+    Input [participants, ...] (the list rank ``src`` would pass in torch);
+    participant p's slice is row p — returned sharded over ``axis`` so each
+    device holds exactly its row.
+    """
+    g = _group()
+    if g.ring is not None:
+        return jnp.asarray(g.ring.scatter(np.asarray(x), src=src))
+    axes = _participant_axes(axis)
+    x = jnp.asarray(x)
+    size = _check_leading(x, axes, g.mesh)
+    if not 0 <= src < size:
+        raise ValueError(f"src {src} out of range for {size} participants")
+    return jax.device_put(x, NamedSharding(g.mesh, P(axes)))
 
 
 def barrier() -> None:

@@ -285,6 +285,32 @@ class HostRingGroup:
         _check(rc, "broadcast")
         return a
 
+    def all_to_all(self, x) -> np.ndarray:
+        """x: this rank's [world*chunk, ...] row, chunk j destined for rank
+        j — returns [world*chunk, ...] of the chunks addressed to this rank
+        (torch ``all_to_all_single`` semantics). Composed from all_gather;
+        the CPU smoke path favors simplicity over the 2x bandwidth."""
+        a = _as_contig(x, dtype_required=False)
+        w = self.world_size
+        if a.shape[0] % w:
+            raise ValueError(
+                f"dim 0 {a.shape[0]} not divisible by world_size {w}"
+            )
+        g = self.all_gather(a)  # [w, w*chunk, ...]
+        c = a.shape[0] // w
+        r = self.rank
+        return np.concatenate([g[j, r * c:(r + 1) * c] for j in range(w)])
+
+    def scatter(self, x, src: int = 0) -> np.ndarray:
+        """x: [world_size, ...] (meaningful on ``src``) — returns this
+        rank's row x[rank] (torch ``scatter`` semantics)."""
+        a = _as_contig(x, dtype_required=False)
+        if a.shape[0] != self.world_size:
+            raise ValueError(
+                f"leading dim {a.shape[0]} != world_size {self.world_size}"
+            )
+        return self.broadcast(a, src=src)[self.rank]
+
     def send(self, x, dst: int) -> None:
         """True point-to-point send: only this rank and ``dst`` participate
         (per-pair shm mailbox — no group barrier, bystander ranks are free

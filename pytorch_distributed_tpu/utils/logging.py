@@ -69,7 +69,14 @@ def _configure_root() -> None:
 def get_logger(name: str) -> logging.Logger:
     """Logger that is silent on non-zero hosts (decided at first emit)."""
     _configure_root()
-    return logging.getLogger(name)
+    logger = logging.getLogger(name)
+    if name.split(".")[0] != "pytorch_distributed_tpu" and not any(
+        isinstance(f, _Rank0Filter) for f in logger.filters
+    ):
+        # out-of-namespace loggers (recipe code) don't route through the
+        # namespace handler above — gate them at the logger itself
+        logger.addFilter(_Rank0Filter())
+    return logger
 
 
 def log_rank0(msg: str, *args) -> None:

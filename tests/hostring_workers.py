@@ -31,6 +31,20 @@ def raw_worker(rank: int, world: int, name: str, q) -> None:
             assert np.all(bc == 1)
             mx = g.all_reduce(np.array([rank], np.int32), op="max")
             assert mx[0] == world - 1
+            # all_to_all: rank r sends chunk j = [r, j]; receives [j, r]
+            a2a_in = np.array(
+                [[rank, j] for j in range(world)], np.float32
+            ).reshape(world, 2)
+            a2a = g.all_to_all(a2a_in.reshape(world * 2))
+            want = np.array(
+                [[j, rank] for j in range(world)], np.float32
+            ).reshape(world * 2)
+            assert np.array_equal(a2a, want), (a2a, want)
+            sc = g.scatter(
+                np.arange(world * 3, dtype=np.float32).reshape(world, 3),
+                src=0,
+            )
+            assert np.array_equal(sc, np.arange(3) + rank * 3.0), sc
             # big payload: crosses the chunking path
             big = g.all_reduce(np.ones(3_000_000, np.float32))
             assert np.all(big == world)

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 import pytorch_distributed_tpu as ptd
+from jax.sharding import PartitionSpec as P
 from pytorch_distributed_tpu.runtime.mesh import AXES, MeshSpec, make_mesh
 
 
@@ -97,6 +98,48 @@ class TestProcessGroupFacade:
         x = np.ones((8, 16), np.float32) * np.arange(8, dtype=np.float32)[:, None]
         out = ptd.reduce_scatter(x)
         np.testing.assert_allclose(np.asarray(out), np.full((16,), 28.0))
+
+    def test_all_to_all(self):
+        ptd.init_process_group()
+        w, c = 8, 2
+        x = np.arange(w * w * c, dtype=np.float32).reshape(w, w * c)
+        out = np.asarray(ptd.all_to_all(x))
+        want = np.stack(
+            [
+                np.concatenate([x[j, p * c:(p + 1) * c] for j in range(w)])
+                for p in range(w)
+            ]
+        )
+        np.testing.assert_allclose(out, want)
+
+    def test_all_to_all_indivisible_raises(self):
+        ptd.init_process_group()
+        with pytest.raises(ValueError, match="divisible"):
+            ptd.all_to_all(np.ones((8, 3), np.float32))
+
+    def test_permute_ring_shift(self):
+        ptd.init_process_group()
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        perm = [(i, (i + 1) % 8) for i in range(8)]
+        out = np.asarray(ptd.permute(x, perm))
+        np.testing.assert_allclose(out[:, 0], np.roll(np.arange(8.0), 1))
+
+    def test_permute_partial_pairs_zero_fill(self):
+        ptd.init_process_group()
+        x = np.ones((8, 1), np.float32)
+        out = np.asarray(ptd.permute(x, [(0, 5)]))
+        want = np.zeros((8, 1), np.float32)
+        want[5] = 1.0
+        np.testing.assert_allclose(out, want)
+
+    def test_gather_and_scatter(self):
+        ptd.init_process_group()
+        x = np.arange(16, dtype=np.float32).reshape(8, 2)
+        np.testing.assert_allclose(np.asarray(ptd.gather(x, dst=2)), x)
+        out = ptd.scatter(x, src=0)
+        np.testing.assert_allclose(np.asarray(out), x)
+        # each device holds exactly its row
+        assert out.sharding.spec == P(tuple(AXES))
 
     def test_leading_dim_mismatch_raises(self):
         ptd.init_process_group()
