@@ -391,12 +391,15 @@ def main():
     ptd.init_process_group()
     bench_resnet50(on_tpu)
     bench_input_pipeline(on_tpu)
-    bench_gpt2(on_tpu)
     bench_allreduce_device(on_tpu)
     try:
         bench_allreduce_hostring()
     except Exception as e:
         print(f"# hostring bench skipped: {e}", file=sys.stderr)
+    # LAST: the transformer step is the largest compile on the axon
+    # remote-compile path (>10 min cold); if it wedges, every other
+    # metric above has already been emitted
+    bench_gpt2(on_tpu)
 
 
 if __name__ == "__main__":

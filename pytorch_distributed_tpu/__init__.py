@@ -62,6 +62,7 @@ from pytorch_distributed_tpu.runtime.precision import (
     current_policy,
 )
 from pytorch_distributed_tpu.runtime.prng import RngSeq, seed_all
+from pytorch_distributed_tpu.generation import generate, sample_logits
 from pytorch_distributed_tpu.launch import (
     ElasticAgent,
     init_multihost,
@@ -97,6 +98,8 @@ __all__ = [
     "permute",
     "ReduceOp",
     "enable_compilation_cache",
+    "generate",
+    "sample_logits",
     "Policy",
     "autocast",
     "GradScaler",

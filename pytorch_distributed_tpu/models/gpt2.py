@@ -60,7 +60,7 @@ class GPT2Block(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, deterministic: bool):
+    def __call__(self, x, deterministic: bool, decode: bool = False):
         cfg = self.config
         policy = current_policy()
         ln = lambda name: nn.LayerNorm(  # noqa: E731
@@ -74,7 +74,13 @@ class GPT2Block(nn.Module):
             name="attn_qkv",
         )(h)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        attn = attention(q, k, v, causal=True)
+        if decode:
+            from pytorch_distributed_tpu.ops.attention import decode_cache
+
+            k, v, offset = decode_cache(self, k, v, cfg.n_positions)
+            attn = attention(q, k, v, causal=True, q_offset=offset)
+        else:
+            attn = attention(q, k, v, causal=True)
         attn = nn.DenseGeneral(
             cfg.hidden_size, axis=(-2, -1), dtype=policy.compute_dtype,
             param_dtype=policy.param_dtype, name="attn_out",
@@ -100,7 +106,8 @@ class GPT2LMHead(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, input_ids, *, train: bool = False):
+    def __call__(self, input_ids, *, train: bool = False,
+                 decode: bool = False):
         cfg = self.config
         policy = current_policy()
         B, S = input_ids.shape
@@ -114,18 +121,26 @@ class GPT2LMHead(nn.Module):
             cfg.n_positions, cfg.hidden_size, param_dtype=policy.param_dtype,
             name="wpe",
         )
-        x = wte(input_ids) + wpe(jnp.arange(S)[None, :])
+        if decode:
+            from pytorch_distributed_tpu.ops.attention import decode_positions
+
+            positions = decode_positions(self, S)
+        else:
+            positions = jnp.arange(S)
+        x = wte(input_ids) + wpe(positions[None, :])
         x = nn.Dropout(cfg.dropout_rate)(x, deterministic=not train)
         x = x.astype(policy.compute_dtype)
         if cfg.scan_layers:
             from pytorch_distributed_tpu.models.scan import scan_stack
 
             x = scan_stack(
-                GPT2Block, cfg, static_argnums=(1,), name="blocks"
-            )(x, not train)
+                GPT2Block, cfg, static_argnums=(1, 2), name="blocks"
+            )(x, not train, decode)
         else:
             for i in range(cfg.num_layers):
-                x = GPT2Block(cfg, name=f"block{i}")(x, deterministic=not train)
+                x = GPT2Block(cfg, name=f"block{i}")(
+                    x, deterministic=not train, decode=decode
+                )
         x = nn.LayerNorm(
             epsilon=cfg.layer_norm_eps, dtype=policy.compute_dtype,
             param_dtype=policy.param_dtype, name="ln_f",

@@ -75,7 +75,8 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin, positions, deterministic: bool):
+    def __call__(self, x, cos, sin, positions, deterministic: bool,
+                 decode: bool = False):
         cfg = self.config
         policy = current_policy()
         dense = lambda feats, name, axis=-1: nn.DenseGeneral(  # noqa: E731
@@ -88,7 +89,13 @@ class LlamaBlock(nn.Module):
         v = dense((cfg.num_kv_heads, cfg.head_dim), "v")(h)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
-        attn = attention(q, k, v, causal=True)
+        if decode:
+            from pytorch_distributed_tpu.ops.attention import decode_cache
+
+            k, v, offset = decode_cache(self, k, v, cfg.max_seq_len)
+            attn = attention(q, k, v, causal=True, q_offset=offset)
+        else:
+            attn = attention(q, k, v, causal=True)
         attn = dense(cfg.hidden_size, "o", axis=(-2, -1))(attn)
         x = x + attn
 
@@ -112,6 +119,7 @@ class LlamaForCausalLM(nn.Module):
         positions: Optional[jnp.ndarray] = None,
         *,
         train: bool = False,
+        decode: bool = False,
     ):
         cfg = self.config
         policy = current_policy()
@@ -121,16 +129,24 @@ class LlamaForCausalLM(nn.Module):
             name="embed",
         )(input_ids).astype(policy.compute_dtype)
         cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        if decode and positions is None:
+            from pytorch_distributed_tpu.ops.attention import decode_positions
+
+            # rotary positions continue from the decode offset
+            positions = jnp.broadcast_to(
+                decode_positions(self, S)[None, :], (B, S)
+            )
         if cfg.scan_layers:
             from pytorch_distributed_tpu.models.scan import scan_stack
 
             x = scan_stack(
-                LlamaBlock, cfg, static_argnums=(4,), name="layers"
-            )(x, cos, sin, positions, not train)
+                LlamaBlock, cfg, static_argnums=(4, 5), name="layers"
+            )(x, cos, sin, positions, not train, decode)
         else:
             for i in range(cfg.num_layers):
                 x = LlamaBlock(cfg, name=f"layer{i}")(
-                    x, cos, sin, positions, deterministic=not train
+                    x, cos, sin, positions, deterministic=not train,
+                    decode=decode,
                 )
         x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
         logits = nn.Dense(

@@ -56,7 +56,8 @@ def scan_stack(
     )
     mod = nn.scan(
         body,
-        variable_axes={"params": 0},
+        # cache: per-layer KV decode caches stack [L, ...] like params
+        variable_axes={"params": 0, "cache": 0},
         split_rngs={"params": True, "dropout": True},
         in_axes=nn.broadcast,
         length=length if length is not None else cfg.num_layers,

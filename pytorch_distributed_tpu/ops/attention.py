@@ -104,6 +104,52 @@ def dot_product_attention(
     return out.reshape(B, S, Hq, D)
 
 
+def decode_positions(module, seq_len: int) -> jnp.ndarray:
+    """Model-level decode position counter: [seq_len] absolute positions.
+
+    Learned position tables (GPT-2) and rotary embeddings (Llama) both
+    need the decode offset BEFORE the blocks run; this keeps one counter
+    in the model's own ``cache`` collection, advanced per call.
+    """
+    pos = module.variable(
+        "cache", "position", lambda: jnp.zeros((), jnp.int32)
+    )
+    positions = pos.value + jnp.arange(seq_len)
+    pos.value = pos.value + seq_len
+    return positions
+
+
+def decode_cache(module, k, v, max_len: int):
+    """Append k/v to this block's KV cache (flax ``cache`` collection).
+
+    TPU-first decode: the cache is a STATIC [B, max_len, H, D] buffer
+    written with ``dynamic_update_slice`` — no growing shapes, so one
+    compiled step serves every position and `lax.scan` can drive the token
+    loop. Returns ``(k_all, v_all, offset)`` where offset is the (traced)
+    number of tokens already cached; attend with ``q_offset=offset`` so
+    the causal mask hides both the future and the unwritten tail.
+    """
+    B, S, H, D = k.shape
+    ck = module.variable(
+        "cache", "cached_key", jnp.zeros, (B, max_len, H, D), k.dtype
+    )
+    cv = module.variable(
+        "cache", "cached_value", jnp.zeros, (B, max_len, H, D), v.dtype
+    )
+    ci = module.variable(
+        "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+    )
+    offset = ci.value
+    ck.value = jax.lax.dynamic_update_slice(
+        ck.value, k.astype(ck.value.dtype), (0, offset, 0, 0)
+    )
+    cv.value = jax.lax.dynamic_update_slice(
+        cv.value, v.astype(cv.value.dtype), (0, offset, 0, 0)
+    )
+    ci.value = offset + S
+    return ck.value, cv.value, offset
+
+
 # --------------------------------------------------------------------------
 # implementation dispatch: XLA einsum path vs Pallas flash kernel
 # --------------------------------------------------------------------------
@@ -152,11 +198,21 @@ def attention(
         sequence_parallel_mode,
     )
 
+    # q_offset may be a traced value (KV-cache decode); only a static
+    # python 0 qualifies for the flash / sequence-parallel fast paths
+    static_zero_offset = isinstance(q_offset, int) and q_offset == 0
     seq_axis, _ = sequence_parallel_mode()
-    if seq_axis is not None and mask is None and q_offset == 0:
+    if seq_axis is not None and mask is None:
+        if not static_zero_offset:
+            # decode (traced offset) under sequence parallelism would
+            # silently attend only to the local KV shard — fail loudly
+            raise NotImplementedError(
+                "KV-cache decode is not supported inside sequence-parallel "
+                "mode; disable_sequence_parallel() around generation"
+            )
         return sequence_parallel_attention(q, k, v, causal=causal)
     use_flash = False
-    if mask is None and q_offset == 0:
+    if mask is None and static_zero_offset:
         if _IMPL == "flash":
             use_flash = True
         # _IMPL == "auto": XLA path — see set_attention_impl docstring.

@@ -1,0 +1,130 @@
+"""KV-cache generation (generation.py): decode must equal full recompute.
+
+The static-cache decode path recomputes nothing; the reference
+implementation here recomputes the full prefix every step. Greedy outputs
+must match exactly (same ops, same dtypes), which pins prefill cache
+writes, rotary/learned position offsets, and the causal mask over the
+unwritten cache tail.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import pytorch_distributed_tpu as ptd
+from pytorch_distributed_tpu.generation import generate, sample_logits
+from pytorch_distributed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from pytorch_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from pytorch_distributed_tpu.runtime.mesh import MeshSpec
+
+
+def _naive_greedy(model, params, ids, n):
+    for _ in range(n):
+        logits = model.apply({"params": params}, ids)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(ids.dtype)
+        ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+    return ids
+
+
+@pytest.fixture
+def gpt2():
+    ptd.init_process_group(mesh_spec=MeshSpec(dp=-1))
+    cfg = GPT2Config(
+        vocab_size=97, n_positions=48, hidden_size=32, num_layers=2,
+        num_heads=2, dropout_rate=0.0,
+    )
+    model = GPT2LMHead(cfg)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(97, size=(2, 7)).astype(np.int32))
+    params = model.init(jax.random.key(0), ids)["params"]
+    return model, params, ids
+
+
+def test_gpt2_greedy_matches_full_recompute(gpt2):
+    model, params, ids = gpt2
+    want = _naive_greedy(model, params, ids, 12)
+    got = generate(model, params, ids, max_new_tokens=12, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.slow
+def test_gpt2_unrolled_layout_decodes_too(gpt2):
+    _, _, ids = gpt2
+    cfg = GPT2Config(
+        vocab_size=97, n_positions=48, hidden_size=32, num_layers=2,
+        num_heads=2, dropout_rate=0.0, scan_layers=False,
+    )
+    model = GPT2LMHead(cfg)
+    params = model.init(jax.random.key(0), ids)["params"]
+    want = _naive_greedy(model, params, ids, 6)
+    got = generate(model, params, ids, max_new_tokens=6, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_llama_greedy_matches_full_recompute():
+    ptd.init_process_group(mesh_spec=MeshSpec(dp=-1))
+    cfg = LlamaConfig(
+        vocab_size=89, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=64, max_seq_len=64,
+    )
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(89, size=(2, 5)).astype(np.int32))
+    params = model.init(jax.random.key(0), ids)["params"]
+    want = _naive_greedy(model, params, ids, 10)
+    got = generate(model, params, ids, max_new_tokens=10, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_generate_inside_jit(gpt2):
+    model, params, ids = gpt2
+
+    @jax.jit
+    def run(params, ids):
+        return generate(model, params, ids, max_new_tokens=5, temperature=0.0)
+
+    got = run(params, ids)
+    want = _naive_greedy(model, params, ids, 5)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.slow
+def test_eos_pads_after_stop(gpt2):
+    model, params, ids = gpt2
+    ref = generate(model, params, ids, max_new_tokens=8, temperature=0.0)
+    eos = int(np.asarray(ref)[0, ids.shape[1] + 2])  # force an early stop
+    got = np.asarray(
+        generate(
+            model, params, ids, max_new_tokens=8, temperature=0.0,
+            eos_id=eos, pad_id=0,
+        )
+    )
+    row = got[0, ids.shape[1]:]
+    stop = list(row).index(eos)
+    assert np.all(row[stop + 1:] == 0), row
+
+
+def test_sampling_respects_top_k():
+    logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0, 10.0]])
+    for seed in range(8):
+        tok = sample_logits(
+            logits, jax.random.key(seed), temperature=1.0, top_k=2
+        )
+        assert int(tok[0]) in (3, 4)
+    greedy = sample_logits(logits, None, temperature=0.0)
+    assert int(greedy[0]) == 4
+
+
+def test_temperature_zero_needs_no_rng(gpt2):
+    model, params, ids = gpt2
+    out = generate(model, params, ids, max_new_tokens=3, temperature=0.0)
+    assert out.shape == (2, ids.shape[1] + 3)
+
+
+def test_overflowing_max_positions_raises(gpt2):
+    model, params, ids = gpt2  # n_positions=48, prompt len 7
+    with pytest.raises(ValueError, match="maximum sequence length"):
+        generate(model, params, ids, max_new_tokens=42, temperature=0.0)
+    with pytest.raises(ValueError, match=">= 1"):
+        generate(model, params, ids, max_new_tokens=0, temperature=0.0)
