@@ -82,10 +82,15 @@ def generate(
         )
     if rng is None:
         rng = jax.random.key(0)
+    # size the KV cache to exactly what this generation needs — NOT the
+    # model's max positions (at 8B scale that difference is gigabytes of
+    # HBM and a proportionally wider attention every step)
+    cache_len = P + max_new_tokens
 
     # prefill: one full-width pass fills every layer's cache
     logits, state = model.apply(
-        {"params": params}, prompt_ids, decode=True, mutable=["cache"]
+        {"params": params}, prompt_ids, decode=True, cache_len=cache_len,
+        mutable=["cache"],
     )
     cache = state["cache"]
     rng, sub = jax.random.split(rng)
@@ -103,6 +108,7 @@ def generate(
             {"params": params, "cache": cache},
             tok[:, None],
             decode=True,
+            cache_len=cache_len,
             mutable=["cache"],
         )
         rng, sub = jax.random.split(rng)

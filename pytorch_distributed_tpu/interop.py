@@ -1,0 +1,250 @@
+"""PyTorch-ecosystem weight interop.
+
+A user of the reference switches frameworks with trained torch weights in
+hand; these converters map Hugging Face ``state_dict`` layouts onto this
+framework's parameter trees so those weights keep working:
+
+* :func:`load_gpt2_weights`  — ``transformers.GPT2LMHeadModel``
+* :func:`load_llama_weights` — ``transformers.LlamaForCausalLM``
+* :func:`load_bert_weights`  — ``transformers.BertModel`` /
+  ``BertForSequenceClassification``
+
+Orientation notes (the whole difficulty lives here):
+
+* torch ``nn.Linear`` stores ``weight [out, in]`` — transpose to the flax
+  kernel ``[in, out]``. HF GPT-2's ``Conv1D`` already stores ``[in, out]``.
+* our attention projections are ``DenseGeneral`` with head axes: QKV
+  kernels are ``[hidden, (3,) heads, head_dim]`` and output kernels
+  ``[heads, head_dim, hidden]`` — reshapes of the torch 2-D mats with the
+  SAME element order torch uses to split heads, so no permutation beyond
+  the documented reshape/transpose is ever needed.
+* scanned models (``scan_layers=True``) stack per-layer trees to
+  ``[L, ...]`` — exactly ``np.stack`` over the layer index.
+
+Everything is numpy-in / numpy-out (no torch import needed here; pass
+``{k: v.numpy() for k, v in module.state_dict().items()}``). Tested for
+numerical parity against the torch forward in tests/test_interop.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping
+
+import numpy as np
+
+
+Array = np.ndarray
+StateDict = Mapping[str, Array]
+
+
+def _np(sd: StateDict, key: str) -> Array:
+    if key not in sd:
+        raise KeyError(
+            f"{key!r} missing from state_dict (have e.g. "
+            f"{list(sd)[:4]}...)"
+        )
+    return np.asarray(sd[key])
+
+
+def _maybe_stack(layers, scan: bool, container: str, unroll_prefix: str):
+    """[{layer tree}, ...] -> scan-stacked or unrolled container tree.
+
+    Scan layout nests under ``container/block`` (models/scan.py); the
+    unrolled layout uses each model's own per-layer naming
+    (``unroll_prefix{i}``: GPT-2 ``block{i}``, Llama ``layer{i}``).
+    """
+    if scan:
+        stacked = {}
+        for name in layers[0]:
+            stacked[name] = {
+                p: np.stack([lyr[name][p] for lyr in layers])
+                for p in layers[0][name]
+            }
+        return {container: {"block": stacked}}
+    return {f"{unroll_prefix}{i}": lyr for i, lyr in enumerate(layers)}
+
+
+# --------------------------------------------------------------------------
+# GPT-2
+# --------------------------------------------------------------------------
+
+def load_gpt2_weights(sd: StateDict, cfg) -> Dict:
+    """HF ``GPT2LMHeadModel`` (or bare ``GPT2Model``) state_dict -> params
+    for :class:`~pytorch_distributed_tpu.models.gpt2.GPT2LMHead`."""
+    pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    H, D = cfg.num_heads, cfg.hidden_size
+    hd = D // H
+
+    def block(i):
+        p = f"{pre}h.{i}."
+        w_qkv = _np(sd, p + "attn.c_attn.weight")      # [D, 3D] (Conv1D)
+        b_qkv = _np(sd, p + "attn.c_attn.bias")        # [3D]
+        w_out = _np(sd, p + "attn.c_proj.weight")      # [D, D]
+        return {
+            "ln1": {
+                "scale": _np(sd, p + "ln_1.weight"),
+                "bias": _np(sd, p + "ln_1.bias"),
+            },
+            "attn_qkv": {
+                "kernel": w_qkv.reshape(D, 3, H, hd),
+                "bias": b_qkv.reshape(3, H, hd),
+            },
+            "attn_out": {
+                "kernel": w_out.reshape(H, hd, D),
+                "bias": _np(sd, p + "attn.c_proj.bias"),
+            },
+            "ln2": {
+                "scale": _np(sd, p + "ln_2.weight"),
+                "bias": _np(sd, p + "ln_2.bias"),
+            },
+            "mlp_up": {
+                "kernel": _np(sd, p + "mlp.c_fc.weight"),    # [D, 4D]
+                "bias": _np(sd, p + "mlp.c_fc.bias"),
+            },
+            "mlp_down": {
+                "kernel": _np(sd, p + "mlp.c_proj.weight"),  # [4D, D]
+                "bias": _np(sd, p + "mlp.c_proj.bias"),
+            },
+        }
+
+    layers = [block(i) for i in range(cfg.num_layers)]
+    params = {
+        "wte": {"embedding": _np(sd, pre + "wte.weight")},
+        "wpe": {"embedding": _np(sd, pre + "wpe.weight")},
+        "ln_f": {
+            "scale": _np(sd, pre + "ln_f.weight"),
+            "bias": _np(sd, pre + "ln_f.bias"),
+        },
+    }
+    params.update(_maybe_stack(layers, cfg.scan_layers, "blocks", "block"))
+    return params
+
+
+# --------------------------------------------------------------------------
+# Llama
+# --------------------------------------------------------------------------
+
+def load_llama_weights(sd: StateDict, cfg) -> Dict:
+    """HF ``LlamaForCausalLM`` state_dict -> params for
+    :class:`~pytorch_distributed_tpu.models.llama.LlamaForCausalLM`."""
+    H, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.hidden_size
+    hd = cfg.head_dim
+
+    def block(i):
+        p = f"model.layers.{i}."
+        return {
+            "attn_norm": {"scale": _np(sd, p + "input_layernorm.weight")},
+            # torch Linear [out, in] -> transpose -> head reshape
+            "q": {
+                "kernel": _np(sd, p + "self_attn.q_proj.weight").T.reshape(
+                    D, H, hd
+                )
+            },
+            "k": {
+                "kernel": _np(sd, p + "self_attn.k_proj.weight").T.reshape(
+                    D, Hkv, hd
+                )
+            },
+            "v": {
+                "kernel": _np(sd, p + "self_attn.v_proj.weight").T.reshape(
+                    D, Hkv, hd
+                )
+            },
+            "o": {
+                "kernel": _np(sd, p + "self_attn.o_proj.weight").T.reshape(
+                    H, hd, D
+                )
+            },
+            "mlp_norm": {
+                "scale": _np(sd, p + "post_attention_layernorm.weight")
+            },
+            "gate": {"kernel": _np(sd, p + "mlp.gate_proj.weight").T},
+            "up": {"kernel": _np(sd, p + "mlp.up_proj.weight").T},
+            "down": {"kernel": _np(sd, p + "mlp.down_proj.weight").T},
+        }
+
+    layers = [block(i) for i in range(cfg.num_layers)]
+    lm_head = (
+        _np(sd, "lm_head.weight")
+        if "lm_head.weight" in sd
+        else _np(sd, "model.embed_tokens.weight")  # tied
+    )
+    params = {
+        "embed": {"embedding": _np(sd, "model.embed_tokens.weight")},
+        "final_norm": {"scale": _np(sd, "model.norm.weight")},
+        "lm_head": {"kernel": lm_head.T},
+    }
+    params.update(_maybe_stack(layers, cfg.scan_layers, "layers", "layer"))
+    return params
+
+
+# --------------------------------------------------------------------------
+# BERT
+# --------------------------------------------------------------------------
+
+def load_bert_weights(sd: StateDict, cfg, *, num_labels: int | None = None) -> Dict:
+    """HF ``BertModel`` state_dict -> params for
+    :class:`~pytorch_distributed_tpu.models.bert.BertModel`.
+
+    With ``num_labels`` (and a ``classifier.*`` in ``sd``, i.e. an HF
+    ``BertForSequenceClassification``), returns the tree for
+    :class:`BertForSequenceClassification` instead (trunk under "bert").
+    """
+    pre = "bert." if any(k.startswith("bert.") for k in sd) else ""
+    H, D = cfg.num_heads, cfg.hidden_size
+    hd = D // H
+
+    def lin(key):  # torch Linear -> flax Dense
+        return {
+            "kernel": _np(sd, key + ".weight").T,
+            "bias": _np(sd, key + ".bias"),
+        }
+
+    def ln(key):
+        return {
+            "scale": _np(sd, key + ".weight"),
+            "bias": _np(sd, key + ".bias"),
+        }
+
+    def head_proj(key):  # [D, D] Linear -> [D, H, hd] DenseGeneral
+        return {
+            "kernel": _np(sd, key + ".weight").T.reshape(D, H, hd),
+            "bias": _np(sd, key + ".bias").reshape(H, hd),
+        }
+
+    trunk = {
+        "word_embeddings": {
+            "embedding": _np(sd, pre + "embeddings.word_embeddings.weight")
+        },
+        "position_embeddings": {
+            "embedding": _np(sd, pre + "embeddings.position_embeddings.weight")
+        },
+        "token_type_embeddings": {
+            "embedding": _np(
+                sd, pre + "embeddings.token_type_embeddings.weight"
+            )
+        },
+        "embed_ln": ln(pre + "embeddings.LayerNorm"),
+        "pooler": lin(pre + "pooler.dense"),
+    }
+    for i in range(cfg.num_layers):
+        p = f"{pre}encoder.layer.{i}."
+        a_out = _np(sd, p + "attention.output.dense.weight")  # [D, D]
+        trunk[f"layer{i}"] = {
+            "attn": {
+                "query": head_proj(p + "attention.self.query"),
+                "key": head_proj(p + "attention.self.key"),
+                "value": head_proj(p + "attention.self.value"),
+                "out": {
+                    "kernel": a_out.T.reshape(H, hd, D),
+                    "bias": _np(sd, p + "attention.output.dense.bias"),
+                },
+            },
+            "attn_ln": ln(p + "attention.output.LayerNorm"),
+            "mlp_up": lin(p + "intermediate.dense"),
+            "mlp_down": lin(p + "output.dense"),
+            "mlp_ln": ln(p + "output.LayerNorm"),
+        }
+    if num_labels is None:
+        return trunk
+    return {"bert": trunk, "classifier": lin("classifier")}

@@ -95,7 +95,7 @@ class BertLayer(nn.Module):
             param_dtype=policy.param_dtype,
             name="mlp_up",
         )(x)
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=False)  # BERT uses exact-erf gelu
         h = nn.Dense(
             cfg.hidden_size,
             dtype=policy.compute_dtype,

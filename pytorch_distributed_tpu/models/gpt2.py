@@ -60,7 +60,8 @@ class GPT2Block(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, deterministic: bool, decode: bool = False):
+    def __call__(self, x, deterministic: bool, decode: bool = False,
+                 cache_len: Optional[int] = None):
         cfg = self.config
         policy = current_policy()
         ln = lambda name: nn.LayerNorm(  # noqa: E731
@@ -77,7 +78,9 @@ class GPT2Block(nn.Module):
         if decode:
             from pytorch_distributed_tpu.ops.attention import decode_cache
 
-            k, v, offset = decode_cache(self, k, v, cfg.n_positions)
+            k, v, offset = decode_cache(
+                self, k, v, cache_len or cfg.n_positions
+            )
             attn = attention(q, k, v, causal=True, q_offset=offset)
         else:
             attn = attention(q, k, v, causal=True)
@@ -107,12 +110,16 @@ class GPT2LMHead(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, *, train: bool = False,
-                 decode: bool = False):
+                 decode: bool = False, cache_len: Optional[int] = None):
         cfg = self.config
         policy = current_policy()
         B, S = input_ids.shape
         if S > cfg.n_positions:
             raise ValueError(f"sequence {S} > n_positions {cfg.n_positions}")
+        if cache_len is not None and cache_len > cfg.n_positions:
+            raise ValueError(
+                f"cache_len {cache_len} > n_positions {cfg.n_positions}"
+            )
         wte = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, param_dtype=policy.param_dtype,
             name="wte",
@@ -134,12 +141,13 @@ class GPT2LMHead(nn.Module):
             from pytorch_distributed_tpu.models.scan import scan_stack
 
             x = scan_stack(
-                GPT2Block, cfg, static_argnums=(1, 2), name="blocks"
-            )(x, not train, decode)
+                GPT2Block, cfg, static_argnums=(1, 2, 3), name="blocks"
+            )(x, not train, decode, cache_len)
         else:
             for i in range(cfg.num_layers):
                 x = GPT2Block(cfg, name=f"block{i}")(
-                    x, deterministic=not train, decode=decode
+                    x, deterministic=not train, decode=decode,
+                    cache_len=cache_len,
                 )
         x = nn.LayerNorm(
             epsilon=cfg.layer_norm_eps, dtype=policy.compute_dtype,

@@ -76,7 +76,7 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, cos, sin, positions, deterministic: bool,
-                 decode: bool = False):
+                 decode: bool = False, cache_len: Optional[int] = None):
         cfg = self.config
         policy = current_policy()
         dense = lambda feats, name, axis=-1: nn.DenseGeneral(  # noqa: E731
@@ -92,7 +92,9 @@ class LlamaBlock(nn.Module):
         if decode:
             from pytorch_distributed_tpu.ops.attention import decode_cache
 
-            k, v, offset = decode_cache(self, k, v, cfg.max_seq_len)
+            k, v, offset = decode_cache(
+                self, k, v, cache_len or cfg.max_seq_len
+            )
             attn = attention(q, k, v, causal=True, q_offset=offset)
         else:
             attn = attention(q, k, v, causal=True)
@@ -120,10 +122,15 @@ class LlamaForCausalLM(nn.Module):
         *,
         train: bool = False,
         decode: bool = False,
+        cache_len: Optional[int] = None,
     ):
         cfg = self.config
         policy = current_policy()
         B, S = input_ids.shape
+        if cache_len is not None and cache_len > cfg.max_seq_len:
+            raise ValueError(
+                f"cache_len {cache_len} > max_seq_len {cfg.max_seq_len}"
+            )
         x = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, param_dtype=policy.param_dtype,
             name="embed",
@@ -140,13 +147,13 @@ class LlamaForCausalLM(nn.Module):
             from pytorch_distributed_tpu.models.scan import scan_stack
 
             x = scan_stack(
-                LlamaBlock, cfg, static_argnums=(4, 5), name="layers"
-            )(x, cos, sin, positions, not train, decode)
+                LlamaBlock, cfg, static_argnums=(4, 5, 6), name="layers"
+            )(x, cos, sin, positions, not train, decode, cache_len)
         else:
             for i in range(cfg.num_layers):
                 x = LlamaBlock(cfg, name=f"layer{i}")(
                     x, cos, sin, positions, deterministic=not train,
-                    decode=decode,
+                    decode=decode, cache_len=cache_len,
                 )
         x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
         logits = nn.Dense(

@@ -122,6 +122,26 @@ def test_temperature_zero_needs_no_rng(gpt2):
     assert out.shape == (2, ids.shape[1] + 3)
 
 
+def test_cache_sized_to_generation_not_model_max(gpt2):
+    """decode_cache buffers must be [B, P+new, H, D], not n_positions."""
+    model, params, ids = gpt2  # n_positions=48
+    _, state = model.apply(
+        {"params": params}, ids, decode=True, cache_len=13,
+        mutable=["cache"],
+    )
+    ck = state["cache"]["blocks"]["block"]["cached_key"]
+    assert ck.shape[2] == 13, ck.shape  # [L, B, cache_len, H, hd]
+
+
+def test_cache_len_above_model_max_raises(gpt2):
+    model, params, ids = gpt2
+    with pytest.raises(ValueError, match="cache_len"):
+        model.apply(
+            {"params": params}, ids, decode=True, cache_len=64,
+            mutable=["cache"],
+        )
+
+
 def test_overflowing_max_positions_raises(gpt2):
     model, params, ids = gpt2  # n_positions=48, prompt len 7
     with pytest.raises(ValueError, match="maximum sequence length"):

@@ -1,0 +1,191 @@
+"""Torch-weight interop parity: HF state_dict -> our params, same logits.
+
+The strongest offline evidence that the model families are faithful
+re-implementations: random-initialized Hugging Face torch models and our
+models produce matching outputs through the converted weights (f32, eval
+mode). Tolerances are f32-accumulation loose-ness only.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from pytorch_distributed_tpu.interop import (
+    load_bert_weights,
+    load_gpt2_weights,
+    load_llama_weights,
+)
+from pytorch_distributed_tpu.runtime.precision import autocast
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _sd(model):
+    return {k: v.detach().numpy() for k, v in model.state_dict().items()}
+
+
+def test_gpt2_logits_match_hf():
+    from pytorch_distributed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=211, n_positions=32, n_embd=48, n_layer=3, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    cfg = GPT2Config(
+        vocab_size=211, n_positions=32, hidden_size=48, num_layers=3,
+        num_heads=4, dropout_rate=0.0,
+    )
+    params = load_gpt2_weights(_sd(hf), cfg)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(211, size=(2, 17)).astype(np.int32)
+    with torch.no_grad():
+        want = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    with autocast(enabled=False):
+        got = GPT2LMHead(cfg).apply({"params": params}, ids)
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=2e-4)
+
+
+def test_gpt2_unrolled_layout_matches_hf():
+    from pytorch_distributed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=97, n_positions=16, n_embd=32, n_layer=2, n_head=2,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    cfg = GPT2Config(
+        vocab_size=97, n_positions=16, hidden_size=32, num_layers=2,
+        num_heads=2, dropout_rate=0.0, scan_layers=False,
+    )
+    params = load_gpt2_weights(_sd(hf), cfg)
+    ids = np.random.default_rng(1).integers(97, size=(2, 9)).astype(np.int32)
+    with torch.no_grad():
+        want = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    with autocast(enabled=False):
+        got = GPT2LMHead(cfg).apply({"params": params}, ids)
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.slow
+def test_llama_logits_match_hf():
+    from pytorch_distributed_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+    )
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=151, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=32, rope_theta=500_000.0,
+        rms_norm_eps=1e-5, attention_dropout=0.0, tie_word_embeddings=False,
+    )
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = LlamaConfig(
+        vocab_size=151, hidden_size=48, intermediate_size=96, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_seq_len=32,
+    )
+    params = load_llama_weights(_sd(hf), cfg)
+    ids = np.random.default_rng(2).integers(151, size=(2, 11)).astype(np.int32)
+    with torch.no_grad():
+        want = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    with autocast(enabled=False):
+        got = LlamaForCausalLM(cfg).apply({"params": params}, ids)
+    np.testing.assert_allclose(np.asarray(got), want, atol=3e-4, rtol=3e-4)
+
+
+def test_llama_unrolled_layout_loads_and_matches():
+    """Unrolled llama uses 'layer{i}' keys (r2 review: prefix mismatch)."""
+    from pytorch_distributed_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+    )
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=73, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=16, rope_theta=500_000.0,
+        rms_norm_eps=1e-5, tie_word_embeddings=False,
+    )
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = LlamaConfig(
+        vocab_size=73, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=2, num_kv_heads=2, max_seq_len=16, scan_layers=False,
+    )
+    params = load_llama_weights(_sd(hf), cfg)
+    ids = np.random.default_rng(4).integers(73, size=(1, 6)).astype(np.int32)
+    with torch.no_grad():
+        want = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    with autocast(enabled=False):
+        got = LlamaForCausalLM(cfg).apply({"params": params}, ids)
+    np.testing.assert_allclose(np.asarray(got), want, atol=3e-4, rtol=3e-4)
+
+
+@pytest.mark.slow
+def test_bert_classifier_matches_hf():
+    from pytorch_distributed_tpu.models.bert import (
+        BertConfig,
+        BertForSequenceClassification,
+    )
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=119, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=96,
+        max_position_embeddings=32, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        num_labels=3,
+    )
+    hf = transformers.BertForSequenceClassification(hf_cfg).eval()
+    cfg = BertConfig(
+        vocab_size=119, hidden_size=48, num_layers=2, num_heads=4,
+        intermediate_size=96, max_position_embeddings=32,
+        dropout_rate=0.0,
+    )
+    params = load_bert_weights(_sd(hf), cfg, num_labels=3)
+
+    rng = np.random.default_rng(3)
+    ids = rng.integers(119, size=(2, 13)).astype(np.int32)
+    mask = np.ones((2, 13), np.int64)
+    mask[1, 9:] = 0  # padding on one row exercises the mask path
+    with torch.no_grad():
+        want = hf(
+            torch.tensor(ids.astype(np.int64)),
+            attention_mask=torch.tensor(mask),
+        ).logits.numpy()
+    with autocast(enabled=False):
+        got = BertForSequenceClassification(cfg, num_labels=3).apply(
+            {"params": params}, ids, attention_mask=mask.astype(bool)
+        )
+    np.testing.assert_allclose(np.asarray(got), want, atol=3e-4, rtol=3e-4)
+
+
+def test_converted_tree_structure_matches_init():
+    """Converter output must be loadable exactly where init puts params."""
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=61, n_positions=8, n_embd=16, n_layer=2, n_head=2,
+    )
+    hf = transformers.GPT2LMHeadModel(hf_cfg)
+    cfg = GPT2Config(
+        vocab_size=61, n_positions=8, hidden_size=16, num_layers=2,
+        num_heads=2,
+    )
+    params = load_gpt2_weights(_sd(hf), cfg)
+    ref = GPT2LMHead(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    ref_paths = {
+        jax.tree_util.keystr(p): v.shape
+        for p, v in jax.tree_util.tree_leaves_with_path(ref)
+    }
+    got_paths = {
+        jax.tree_util.keystr(p): np.asarray(v).shape
+        for p, v in jax.tree_util.tree_leaves_with_path(params)
+    }
+    assert ref_paths == got_paths
