@@ -42,9 +42,12 @@ def gpt2():
 
 
 def test_gpt2_greedy_matches_full_recompute(gpt2):
+    # 6 tokens: every decode bug class (cache write offset, position
+    # offset, tail masking) shows by token 2-3; the naive reference
+    # recompiles per length, so more tokens only buy compile time
     model, params, ids = gpt2
-    want = _naive_greedy(model, params, ids, 12)
-    got = generate(model, params, ids, max_new_tokens=12, temperature=0.0)
+    want = _naive_greedy(model, params, ids, 6)
+    got = generate(model, params, ids, max_new_tokens=6, temperature=0.0)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
@@ -62,6 +65,7 @@ def test_gpt2_unrolled_layout_decodes_too(gpt2):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+@pytest.mark.slow
 def test_llama_greedy_matches_full_recompute():
     ptd.init_process_group(mesh_spec=MeshSpec(dp=-1))
     cfg = LlamaConfig(

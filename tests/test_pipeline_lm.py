@@ -107,6 +107,7 @@ def test_pipeline_parallel_strategy_trains_gpt2():
     assert losses[-1] < losses[0] * 0.7, losses
 
 
+@pytest.mark.slow
 def test_pipeline_composes_with_tensor_parallel_rules():
     """TP extra_rules must not evict the pp stage sharding (r2 review)."""
     from pytorch_distributed_tpu.models.gpt2 import gpt2_partition_rules
