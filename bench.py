@@ -75,6 +75,28 @@ def _resnet50_train_setup(image: int):
     return strategy, step, state
 
 
+def _mfu_note(step, state, batch, dt_per_step: float) -> str:
+    """' mfu=..' fragment from XLA's own cost analysis, or ''."""
+    from pytorch_distributed_tpu.runtime.device import (
+        compiled_flops,
+        peak_flops,
+    )
+
+    try:
+        compiled = step.lower(state, batch).compile()
+    except Exception:
+        return ""
+    flops = compiled_flops(compiled)
+    if not flops:
+        return ""
+    achieved = flops / dt_per_step
+    note = f" tflops={achieved / 1e12:.1f}"
+    peak = peak_flops()
+    if peak:
+        note += f" mfu={achieved / peak * 100:.1f}%"
+    return note
+
+
 def bench_resnet50(on_tpu: bool) -> None:
     batch_per_chip = 128 if on_tpu else 8
     image = 224 if on_tpu else 32
@@ -116,7 +138,8 @@ def bench_resnet50(on_tpu: bool) -> None:
     print(
         f"# resnet50: chips={n_chips} platform={ptd.platform()} batch={batch} "
         f"image={image} step_time={dt / iters * 1e3:.1f}ms "
-        f"loss={final_loss:.3f}",
+        f"loss={final_loss:.3f}"
+        + _mfu_note(step, state, dev_batch, dt / iters),
         file=sys.stderr,
     )
 
@@ -282,7 +305,8 @@ def bench_gpt2(on_tpu: bool) -> None:
     )
     print(
         f"# gpt2: attention=xla scan_layers=on batch={batch} "
-        f"seq={seq} step_time={dt / iters * 1e3:.1f}ms loss={loss:.3f}",
+        f"seq={seq} step_time={dt / iters * 1e3:.1f}ms loss={loss:.3f}"
+        + _mfu_note(step, state, dev_batch, dt / iters),
         file=sys.stderr,
     )
 

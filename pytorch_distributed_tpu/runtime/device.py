@@ -48,6 +48,40 @@ def device_kind() -> str:
     return jax.devices()[0].device_kind
 
 
+#: advertised peak bf16 matmul throughput per chip (FLOP/s) — the MFU
+#: denominator. Sources: public TPU spec sheets.
+_PEAK_BF16_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops() -> float | None:
+    """Peak bf16 FLOP/s of this chip, or None when unknown (e.g. CPU)."""
+    kind = device_kind()
+    for name, flops in _PEAK_BF16_FLOPS.items():
+        if kind.startswith(name):
+            return flops
+    return None
+
+
+def compiled_flops(compiled) -> float | None:
+    """FLOPs per execution from a lowered+compiled computation's XLA cost
+    analysis; None when the backend doesn't expose it."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca["flops"]) if ca and "flops" in ca else None
+    except Exception:
+        return None
+
+
 def enable_compilation_cache(
     path: str | None = None, *, min_compile_time_secs: float | None = None
 ) -> str:

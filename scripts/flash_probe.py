@@ -1,0 +1,89 @@
+"""Bounded Pallas flash-attention probe for the real chip.
+
+VERDICT r1 #2: the flash kernels (ops/flash_attention.py) have never
+executed on actual TPU hardware — interpret-mode tests only — and one r2
+attempt saw the fwd kernel's remote compile exceed 9 minutes. This probe
+walks shapes smallest-first with wall-clock logging and the persistent
+compilation cache enabled, so each shape's verdict (compile time, run
+time, numerics vs the XLA path) is recorded even if a later shape wedges.
+
+Run ON THE CHIP ONLY (it dials the relay):  python scripts/flash_probe.py
+"""
+
+import time
+
+t0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time() - t0:8.1f}s] {msg}", flush=True)
+
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import pytorch_distributed_tpu as ptd
+from pytorch_distributed_tpu.ops.attention import dot_product_attention
+from pytorch_distributed_tpu.ops.flash_attention import flash_attention
+
+SHAPES = [  # (B, S, H, D) smallest-first
+    (1, 256, 4, 64),
+    (2, 512, 8, 64),
+    (4, 1024, 8, 64),
+    (8, 1024, 16, 64),  # the GPT-2-medium bench shape that wedged in r2
+]
+
+
+def main():
+    ptd.enable_compilation_cache()
+    log(f"platform={ptd.platform()} kind={jax.devices()[0].device_kind}")
+    for shape in SHAPES:
+        B, S, H, D = shape
+        rng = np.random.default_rng(0)
+        q, k, v = (
+            jnp.asarray(rng.normal(size=(B, S, H, D)).astype(np.float32))
+            .astype(jnp.bfloat16)
+            for _ in range(3)
+        )
+        log(f"--- {shape} fwd compile start")
+        f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+        t = time.time()
+        out = f(q, k, v)
+        got = np.asarray(out.astype(jnp.float32))
+        log(f"{shape} fwd compile+run {time.time() - t:.1f}s")
+        want = np.asarray(
+            dot_product_attention(q, k, v, causal=True).astype(jnp.float32)
+        )
+        err = np.max(np.abs(got - want))
+        log(f"{shape} fwd max|err| vs xla = {err:.4f}")
+
+        log(f"{shape} bwd compile start")
+        g = jax.jit(
+            jax.grad(
+                lambda q, k, v: flash_attention(q, k, v, causal=True)
+                .astype(jnp.float32)
+                .sum(),
+                argnums=(0, 1, 2),
+            )
+        )
+        t = time.time()
+        dq, dk, dv = g(q, k, v)
+        jax.block_until_ready(dq)
+        float(dq.astype(jnp.float32).ravel()[0])
+        log(f"{shape} bwd compile+run {time.time() - t:.1f}s")
+
+        # steady-state timing
+        iters = 20
+        t = time.time()
+        for _ in range(iters):
+            out = f(q, k, v)
+        float(out.astype(jnp.float32).ravel()[0])
+        dt = (time.time() - t) / iters
+        flops = 4 * B * H * S * S * D / 2  # causal: half the square
+        log(f"{shape} fwd {dt * 1e3:.2f}ms  ~{flops / dt / 1e12:.1f} TFLOP/s")
+    log("ALL SHAPES OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+"""ResNet-50 single-chip throughput sweep (VERDICT r1 #6: raise MFU).
+
+Sweeps per-chip batch size and image layout knobs on the real chip with
+MFU from XLA's cost analysis, and optionally captures a profiler trace of
+the best configuration (--trace DIR). Run ON THE CHIP ONLY.
+"""
+
+import argparse
+import time
+
+t0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time() - t0:8.1f}s] {msg}", flush=True)
+
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import pytorch_distributed_tpu as ptd
+from pytorch_distributed_tpu.runtime.device import compiled_flops, peak_flops
+
+
+def bench_batch(batch: int, image: int = 224, iters: int = 50):
+    from bench import _resnet50_train_setup
+
+    strategy, step, state = _resnet50_train_setup(image)
+    rng = np.random.default_rng(0)
+    dev_batch = strategy.shard_batch(
+        {
+            "image": rng.normal(size=(batch, image, image, 3)).astype(
+                np.float32
+            ),
+            "label": rng.integers(1000, size=(batch,)).astype(np.int32),
+        }
+    )
+    log(f"batch={batch} compiling...")
+    compiled = step.lower(state, dev_batch).compile()
+    flops = compiled_flops(compiled)
+    for _ in range(5):
+        state, metrics = step(state, dev_batch)
+    float(metrics["loss"])
+    t = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, dev_batch)
+    float(metrics["loss"])
+    dt = (time.perf_counter() - t) / iters
+    rate = batch / dt
+    note = ""
+    if flops:
+        peak = peak_flops() or float("nan")
+        note = (
+            f" tflops={flops / dt / 1e12:.1f}"
+            f" mfu={flops / dt / peak * 100:.1f}%"
+        )
+    log(f"batch={batch} {rate:.0f} img/s step={dt * 1e3:.1f}ms{note}")
+    return rate, state, step, dev_batch
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", type=int, nargs="+",
+                    default=[128, 256, 512])
+    ap.add_argument("--trace", type=str, default=None)
+    args = ap.parse_args()
+
+    ptd.enable_compilation_cache()
+    ptd.init_process_group()
+    log(f"platform={ptd.platform()} kind={jax.devices()[0].device_kind}")
+
+    best = (0.0, None)
+    for b in args.batches:
+        rate, state, step, dev_batch = bench_batch(b)
+        if rate > best[0]:
+            best = (rate, (b, state, step, dev_batch))
+
+    if args.trace and best[1]:
+        b, state, step, dev_batch = best[1]
+        log(f"tracing batch={b} -> {args.trace}")
+        with jax.profiler.trace(args.trace):
+            for _ in range(10):
+                state, metrics = step(state, dev_batch)
+            float(metrics["loss"])
+        log("trace written")
+
+
+if __name__ == "__main__":
+    main()
