@@ -12,6 +12,7 @@ from pytorch_distributed_tpu.train.trainer import (
     build_train_step,
 )
 from pytorch_distributed_tpu.train.losses import (
+    causal_lm_eval_step,
     classification_eval_step,
     classification_loss_fn,
     causal_lm_loss_fn,
@@ -38,6 +39,7 @@ __all__ = [
     "Trainer",
     "TrainerConfig",
     "build_train_step",
+    "causal_lm_eval_step",
     "classification_eval_step",
     "classification_loss_fn",
     "causal_lm_loss_fn",

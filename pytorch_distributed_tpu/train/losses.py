@@ -131,6 +131,26 @@ def text_classification_loss_fn(model) -> Callable:
     return loss_fn
 
 
+def causal_lm_eval_step(model, *, ids_key: str = "input_ids") -> Callable:
+    """``eval_step(state, batch) -> metrics`` for decoder LMs.
+
+    Reports mean next-token loss and perplexity (the LM recipes' standard
+    eval, e.g. GPT-2 validation) — exp of the f32 token-mean CE.
+    """
+
+    def eval_step(state, batch) -> Dict[str, jax.Array]:
+        ids = batch[ids_key]
+        logits = model.apply({"params": state.params}, ids, train=False)
+        loss = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1].astype(jnp.float32), ids[:, 1:]
+            )
+        )
+        return {"loss": loss, "perplexity": jnp.exp(loss)}
+
+    return eval_step
+
+
 def classification_eval_step(
     model, *, image_key: str = "image", label_key: str = "label"
 ) -> Callable:

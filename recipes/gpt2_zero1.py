@@ -8,8 +8,13 @@ and the global batch is scanned in ``--accum-steps`` microbatches inside
 the jitted step (no ``no_sync()`` needed: the grad allreduce happens once
 after the scan by construction).
 
+``--pp N`` switches to GPipe pipeline parallelism (beyond-reference
+capability): the scanned block stack is sharded over N stages and the
+microbatches tick through a ppermute schedule (parallel/pipeline_lm.py).
+
 Run:
     python recipes/gpt2_zero1.py --size tiny --steps-per-epoch 3
+    python recipes/gpt2_zero1.py --size tiny --pp 2 --steps-per-epoch 3
 """
 
 import argparse
@@ -33,6 +38,7 @@ from pytorch_distributed_tpu.train import (
     TrainerConfig,
     TrainState,
     build_train_step,
+    causal_lm_eval_step,
     causal_lm_loss_fn,
 )
 from pytorch_distributed_tpu.utils import log_rank0
@@ -55,10 +61,15 @@ def parse_args(argv=None):
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--dp", type=int, default=-1)
     p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1, help="pipeline stages")
     p.add_argument("--steps-per-epoch", type=int, default=None)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=10)
+    p.add_argument(
+        "--sample", type=int, default=0, metavar="N",
+        help="generate N tokens from the trained model at the end",
+    )
     return p.parse_args(argv)
 
 
@@ -66,7 +77,8 @@ def main(argv=None):
     args = parse_args(argv)
     ptd.seed_all(args.seed)
     ptd.init_process_group(
-        args.backend, mesh_spec=MeshSpec(dp=args.dp, tp=args.tp)
+        args.backend,
+        mesh_spec=MeshSpec(dp=args.dp, tp=args.tp, pp=args.pp),
     )
     log_rank0("world=%d backend=%s", ptd.get_world_size(), ptd.get_backend())
 
@@ -88,13 +100,37 @@ def main(argv=None):
             optax.clip_by_global_norm(1.0), optax.adamw(args.lr)
         ),
     )
-    strategy = ZeRO1(extra_rules=gpt2_partition_rules())
+    if args.pp > 1:
+        from pytorch_distributed_tpu.parallel.pipeline_lm import (
+            PipelineParallel,
+            pipelined_causal_lm_loss_fn,
+        )
+
+        strategy = PipelineParallel(extra_rules=gpt2_partition_rules())
+        loss_fn = pipelined_causal_lm_loss_fn(
+            cfg, num_microbatches=max(args.accum_steps, 2 * args.pp)
+        )
+        # microbatching lives inside the pipeline schedule here
+        accum_steps = 1
+    else:
+        strategy = ZeRO1(extra_rules=gpt2_partition_rules())
+        loss_fn = causal_lm_loss_fn(model)
+        accum_steps = args.accum_steps
+    eval_ds = SyntheticTextDataset(
+        n=max(args.batch_size, 64), seq_len=seq_len,
+        vocab_size=cfg.vocab_size, seed=args.seed + 1,  # held out
+    )
     trainer = Trainer(
         state,
         strategy,
-        build_train_step(causal_lm_loss_fn(model), accum_steps=args.accum_steps),
+        build_train_step(loss_fn, accum_steps=accum_steps),
         DataLoader(
             ds, args.batch_size, seed=args.seed,
+            sharding=strategy.batch_sharding(),
+        ),
+        eval_step=causal_lm_eval_step(model),
+        eval_loader=DataLoader(
+            eval_ds, args.batch_size, shuffle=False,
             sharding=strategy.batch_sharding(),
         ),
         config=TrainerConfig(
@@ -104,7 +140,19 @@ def main(argv=None):
     )
     trainer.restore_checkpoint()
     state = fit_elastic(trainer)
-    log_rank0("done: step=%d", int(state.step))
+    log_rank0("done: step=%d eval=%s", int(state.step),
+              trainer.last_eval_metrics)
+    if args.sample:
+        import numpy as np
+
+        prompt = jnp.asarray(
+            np.stack([eval_ds[i]["input_ids"] for i in range(2)])[:, :8]
+        )
+        out = ptd.generate(
+            model, state.params, prompt, max_new_tokens=args.sample,
+            temperature=0.8, top_k=40, rng=jax.random.key(args.seed),
+        )
+        log_rank0("sampled continuation ids: %s", np.asarray(out)[0].tolist())
     return state
 
 

@@ -10,7 +10,11 @@ time, numerics vs the XLA path) is recorded even if a later shape wedges.
 Run ON THE CHIP ONLY (it dials the relay):  python scripts/flash_probe.py
 """
 
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 t0 = time.time()
 

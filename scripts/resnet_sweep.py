@@ -6,7 +6,13 @@ the best configuration (--trace DIR). Run ON THE CHIP ONLY.
 """
 
 import argparse
+import os
+import sys
 import time
+
+# repo root: the package is not pip-installed, and bench.py (for
+# _resnet50_train_setup) is a repo-root module
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 t0 = time.time()
 
