@@ -25,8 +25,14 @@ from pytorch_distributed_tpu.data.datasets import (
     SyntheticTextDataset,
     load_cifar10,
 )
+from pytorch_distributed_tpu.data.image_folder import (
+    FolderImagePipeline,
+    ImageFolderDataset,
+)
 
 __all__ = [
+    "FolderImagePipeline",
+    "ImageFolderDataset",
     "DistributedSampler",
     "GlobalBatchSampler",
     "DataLoader",

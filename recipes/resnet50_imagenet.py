@@ -89,17 +89,42 @@ def main(argv=None):
     )
 
     shape = (cfg.image_size, cfg.image_size, 3)
-    n_train = cfg.train_samples
-    n_eval = cfg.eval_samples
-    if cfg.steps_per_epoch:
-        n_train = cfg.steps_per_epoch * cfg.batch_size
-        n_eval = min(n_eval, cfg.batch_size * 2)
-    train_ds = SyntheticImageDataset(
-        n=n_train, image_shape=shape, num_classes=1000, seed=cfg.seed
+    # real ImageNet layout on disk (root/{train,val}/<class>/<img>)?
+    real_root = (
+        None if cfg.synthetic else
+        cfg.data_dir if os.path.isdir(os.path.join(cfg.data_dir, "train"))
+        else None
     )
-    eval_ds = SyntheticImageDataset(
-        n=n_eval, image_shape=shape, num_classes=1000, seed=cfg.seed + 1
-    )
+    train_fetch = eval_fetch = None
+    if real_root is not None:
+        from pytorch_distributed_tpu.data import (
+            FolderImagePipeline,
+            ImageFolderDataset,
+        )
+
+        train_ds = ImageFolderDataset(os.path.join(real_root, "train"))
+        eval_ds = ImageFolderDataset(os.path.join(real_root, "val"))
+        train_fetch = FolderImagePipeline(
+            cfg.image_size, train=True, seed=cfg.seed
+        )
+        eval_fetch = FolderImagePipeline(cfg.image_size, train=False)
+        n_train = len(train_ds)
+        log_rank0(
+            "real data: %d train / %d eval images, %d classes",
+            n_train, len(eval_ds), len(train_ds.classes),
+        )
+    else:
+        n_train = cfg.train_samples
+        n_eval = cfg.eval_samples
+        if cfg.steps_per_epoch:
+            n_train = cfg.steps_per_epoch * cfg.batch_size
+            n_eval = min(n_eval, cfg.batch_size * 2)
+        train_ds = SyntheticImageDataset(
+            n=n_train, image_shape=shape, num_classes=1000, seed=cfg.seed
+        )
+        eval_ds = SyntheticImageDataset(
+            n=n_eval, image_shape=shape, num_classes=1000, seed=cfg.seed + 1
+        )
 
     model = ResNet50(num_classes=1000)
     variables = model.init(
@@ -125,11 +150,16 @@ def main(argv=None):
     train_loader = DataLoader(
         train_ds, cfg.batch_size, seed=cfg.seed,
         sharding=strategy.batch_sharding(),
-        transform=_flip_transform(cfg.seed) if cfg.flip_augment else None,
+        fetch=train_fetch,
+        transform=(
+            _flip_transform(cfg.seed)
+            if cfg.flip_augment and train_fetch is None else None
+        ),  # the folder pipeline flips internally
     )
     eval_loader = DataLoader(
         eval_ds, cfg.batch_size, shuffle=False, drop_last=False,
         sharding=strategy.batch_sharding(),
+        fetch=eval_fetch,
     )
 
     trainer = Trainer(
