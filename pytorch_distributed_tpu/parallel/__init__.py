@@ -30,6 +30,7 @@ from pytorch_distributed_tpu.parallel.sequence import (
     ring_attention,
     ulysses_attention,
     enable_sequence_parallel,
+    sequence_parallel,
     disable_sequence_parallel,
     sequence_parallel_mode,
 )
@@ -57,6 +58,7 @@ __all__ = [
     "ring_attention",
     "ulysses_attention",
     "enable_sequence_parallel",
+    "sequence_parallel",
     "disable_sequence_parallel",
     "sequence_parallel_mode",
     "pipeline_forward",

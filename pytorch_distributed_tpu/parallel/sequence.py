@@ -23,6 +23,7 @@ framework (long-context training is mesh-axis cheap under SPMD).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Optional, Tuple
 
@@ -183,13 +184,23 @@ def ulysses_attention(
         )
 
     def inner(q, k, v, causal):
-        # Route through the impl dispatcher so the post-all-to-all local
-        # attention (full sequence, head subset) still gets the Pallas
-        # flash kernel when it qualifies — the einsum path would
-        # materialize the [S, S] scores this layer exists to avoid.
-        from pytorch_distributed_tpu.ops.attention import attention
+        # The post-all-to-all local attention (full sequence, head subset)
+        # picks the flash kernel when selected. NOT the attention()
+        # dispatcher: sequence-parallel mode is still active here, and
+        # re-entering it would recurse into ulysses with the local
+        # (already head-sharded) shapes.
+        from pytorch_distributed_tpu.ops.attention import (
+            dot_product_attention,
+            get_attention_impl,
+        )
 
-        return attention(q, k, v, causal=causal)
+        if get_attention_impl() == "flash":
+            from pytorch_distributed_tpu.ops.flash_attention import (
+                flash_attention,
+            )
+
+            return flash_attention(q, k, v, causal=causal)
+        return dot_product_attention(q, k, v, causal=causal)
 
     spec = P(data_axes(), axis, "tp", None)
     fn = shard_map(
@@ -233,6 +244,20 @@ def disable_sequence_parallel() -> None:
     if _SEQ_MODE[0] is not None:
         _SEQ_MODE = (None, "ring")
         jax.clear_caches()
+
+
+@contextlib.contextmanager
+def sequence_parallel(axis: str = "sp", impl: str = "ring"):
+    """Context manager form of enable/disable_sequence_parallel."""
+    prev = _SEQ_MODE
+    enable_sequence_parallel(axis, impl)
+    try:
+        yield
+    finally:
+        if prev[0] is None:
+            disable_sequence_parallel()
+        else:
+            enable_sequence_parallel(*prev)
 
 
 def sequence_parallel_mode() -> Tuple[Optional[str], str]:

@@ -1,4 +1,4 @@
-"""Recipe 5: Llama-3 — FSDP full-shard (+ optional TP), the stretch goal.
+"""Recipe 5: Llama-3 — FSDP full-shard (+ optional TP/SP), the stretch goal.
 
 Mirrors the reference recipe (BASELINE.json:11: "Llama-3-8B, FSDP
 full-shard -> XLA SPMD on v5p-64"): parameters AND optimizer state shard
@@ -7,8 +7,16 @@ reduce-scatter that torch FSDP implements with FlatParameter hooks. The
 8B configuration needs a pod-scale mesh — on a single chip use ``--size
 tiny`` (smoke) or supply ``--fsdp/--tp`` matching your slice.
 
+Long context: ``--sp N`` shards the sequence axis over N devices with
+ring attention (``--sp-mode ulysses`` for the all-to-all head-sharding
+variant) — the attention dispatcher handles it model-transparently; add
+``--remat`` to recompute block activations in backward so sequence
+length trades FLOPs for HBM instead of OOMing.
+
 Run:
     python recipes/llama_fsdp.py --size tiny --fsdp 2 --tp 2 --steps-per-epoch 2
+    python recipes/llama_fsdp.py --size tiny --sp 4 --remat --seq-len 8192 \\
+        --steps-per-epoch 2   # long-context shape
 """
 
 import argparse
@@ -55,6 +63,10 @@ def parse_args(argv=None):
     p.add_argument("--dp", type=int, default=-1)
     p.add_argument("--fsdp", type=int, default=1)
     p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1, help="sequence-parallel ways")
+    p.add_argument("--sp-mode", choices=("ring", "ulysses"), default="ring")
+    p.add_argument("--remat", action="store_true",
+                   help="recompute block activations in backward")
     p.add_argument("--steps-per-epoch", type=int, default=None)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--seed", type=int, default=0)
@@ -63,15 +75,27 @@ def parse_args(argv=None):
 
 
 def main(argv=None):
+    import contextlib
+    import dataclasses
+
     args = parse_args(argv)
     ptd.seed_all(args.seed)
     ptd.init_process_group(
         args.backend,
-        mesh_spec=MeshSpec(dp=args.dp, fsdp=args.fsdp, tp=args.tp),
+        mesh_spec=MeshSpec(
+            dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=args.sp
+        ),
     )
     log_rank0("world=%d backend=%s", ptd.get_world_size(), ptd.get_backend())
 
     cfg = SIZES[args.size]()
+    if args.remat:
+        cfg = dataclasses.replace(cfg, remat=True)
+    sp_ctx = contextlib.nullcontext()
+    if args.sp > 1:
+        from pytorch_distributed_tpu.parallel import sequence_parallel
+
+        sp_ctx = sequence_parallel("sp", args.sp_mode)
     seq_len = min(args.seq_len, cfg.max_seq_len)
     n = (args.steps_per_epoch or 50) * args.batch_size
     ds = SyntheticTextDataset(
@@ -104,7 +128,8 @@ def main(argv=None):
         ),
     )
     trainer.restore_checkpoint()
-    state = fit_elastic(trainer)
+    with sp_ctx:  # ring/ulysses attention while the step traces+runs
+        state = fit_elastic(trainer)
     log_rank0("done: step=%d", int(state.step))
     return state
 

@@ -122,6 +122,46 @@ class TestModelTransparentSP:
             np.asarray(out), np.asarray(ref), rtol=0.08, atol=0.08
         )
 
+    def test_llama_forward_ulysses(self, rng):
+        """Model-transparent ULYSSES: pins the dispatcher re-entrancy bug
+        (r2: the inner attention recursed back into sequence-parallel mode
+        with already-head-sharded shapes)."""
+        from pytorch_distributed_tpu.models.llama import (
+            LlamaConfig,
+            LlamaForCausalLM,
+        )
+
+        make_mesh(MeshSpec(dp=4, sp=2))
+        cfg = LlamaConfig.tiny()  # heads=4, kv=2: divisible by sp=2
+        model = LlamaForCausalLM(cfg)
+        ids = jnp.asarray(
+            rng.integers(cfg.vocab_size, size=(4, 32)), jnp.int32
+        )
+        params = model.init(jax.random.key(0), ids)["params"]
+        ref = model.apply({"params": params}, ids)
+        enable_sequence_parallel("sp", "ulysses")
+        try:
+            out = model.apply({"params": params}, ids)
+        finally:
+            disable_sequence_parallel()
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=0.08, atol=0.08
+        )
+
+    def test_sequence_parallel_context_manager(self):
+        from pytorch_distributed_tpu.parallel import sequence_parallel
+        from pytorch_distributed_tpu.parallel.sequence import (
+            sequence_parallel_mode,
+        )
+
+        assert sequence_parallel_mode()[0] is None
+        with sequence_parallel("sp", "ring"):
+            assert sequence_parallel_mode() == ("sp", "ring")
+            with sequence_parallel("sp", "ulysses"):
+                assert sequence_parallel_mode() == ("sp", "ulysses")
+            assert sequence_parallel_mode() == ("sp", "ring")
+        assert sequence_parallel_mode()[0] is None
+
     def test_mode_roundtrip(self):
         from pytorch_distributed_tpu.parallel.sequence import (
             sequence_parallel_mode,
