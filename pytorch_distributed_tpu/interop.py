@@ -9,6 +9,10 @@ framework's parameter trees so those weights keep working:
 * :func:`load_bert_weights`  — ``transformers.BertModel`` /
   ``BertForSequenceClassification``
 
+and the inverse direction (:func:`export_gpt2_weights`,
+:func:`export_llama_weights`) so models trained here can be evaluated or
+served by the torch ecosystem.
+
 Orientation notes (the whole difficulty lives here):
 
 * torch ``nn.Linear`` stores ``weight [out, in]`` — transpose to the flax
@@ -176,6 +180,89 @@ def load_llama_weights(sd: StateDict, cfg) -> Dict:
     }
     params.update(_maybe_stack(layers, cfg.scan_layers, "layers", "layer"))
     return params
+
+
+def _unstack(params, cfg, container: str, unroll_prefix: str):
+    """Per-layer trees from either layout: [{...}, ...] of length L."""
+    if cfg.scan_layers:
+        stacked = params[container]["block"]
+        return [
+            {
+                name: {p: np.asarray(v)[i] for p, v in sub.items()}
+                for name, sub in stacked.items()
+            }
+            for i in range(cfg.num_layers)
+        ]
+    return [params[f"{unroll_prefix}{i}"] for i in range(cfg.num_layers)]
+
+
+def export_gpt2_weights(params, cfg) -> Dict[str, Array]:
+    """Our GPT2LMHead params -> HF ``GPT2LMHeadModel`` state_dict arrays
+    (numpy; wrap with ``torch.tensor`` to ``load_state_dict``)."""
+    H, D = cfg.num_heads, cfg.hidden_size
+    sd = {
+        "transformer.wte.weight": np.asarray(params["wte"]["embedding"]),
+        "transformer.wpe.weight": np.asarray(params["wpe"]["embedding"]),
+        "transformer.ln_f.weight": np.asarray(params["ln_f"]["scale"]),
+        "transformer.ln_f.bias": np.asarray(params["ln_f"]["bias"]),
+        "lm_head.weight": np.asarray(params["wte"]["embedding"]),  # tied
+    }
+    for i, lyr in enumerate(_unstack(params, cfg, "blocks", "block")):
+        p = f"transformer.h.{i}."
+        sd[p + "ln_1.weight"] = np.asarray(lyr["ln1"]["scale"])
+        sd[p + "ln_1.bias"] = np.asarray(lyr["ln1"]["bias"])
+        sd[p + "attn.c_attn.weight"] = np.asarray(
+            lyr["attn_qkv"]["kernel"]
+        ).reshape(D, 3 * D)
+        sd[p + "attn.c_attn.bias"] = np.asarray(
+            lyr["attn_qkv"]["bias"]
+        ).reshape(3 * D)
+        sd[p + "attn.c_proj.weight"] = np.asarray(
+            lyr["attn_out"]["kernel"]
+        ).reshape(D, D)
+        sd[p + "attn.c_proj.bias"] = np.asarray(lyr["attn_out"]["bias"])
+        sd[p + "ln_2.weight"] = np.asarray(lyr["ln2"]["scale"])
+        sd[p + "ln_2.bias"] = np.asarray(lyr["ln2"]["bias"])
+        sd[p + "mlp.c_fc.weight"] = np.asarray(lyr["mlp_up"]["kernel"])
+        sd[p + "mlp.c_fc.bias"] = np.asarray(lyr["mlp_up"]["bias"])
+        sd[p + "mlp.c_proj.weight"] = np.asarray(lyr["mlp_down"]["kernel"])
+        sd[p + "mlp.c_proj.bias"] = np.asarray(lyr["mlp_down"]["bias"])
+    return sd
+
+
+def export_llama_weights(params, cfg) -> Dict[str, Array]:
+    """Our LlamaForCausalLM params -> HF ``LlamaForCausalLM`` state_dict."""
+    H, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.hidden_size
+    hd = cfg.head_dim
+    sd = {
+        "model.embed_tokens.weight": np.asarray(params["embed"]["embedding"]),
+        "model.norm.weight": np.asarray(params["final_norm"]["scale"]),
+        "lm_head.weight": np.asarray(params["lm_head"]["kernel"]).T,
+    }
+    for i, lyr in enumerate(_unstack(params, cfg, "layers", "layer")):
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = np.asarray(
+            lyr["attn_norm"]["scale"]
+        )
+        sd[p + "self_attn.q_proj.weight"] = (
+            np.asarray(lyr["q"]["kernel"]).reshape(D, H * hd).T
+        )
+        sd[p + "self_attn.k_proj.weight"] = (
+            np.asarray(lyr["k"]["kernel"]).reshape(D, Hkv * hd).T
+        )
+        sd[p + "self_attn.v_proj.weight"] = (
+            np.asarray(lyr["v"]["kernel"]).reshape(D, Hkv * hd).T
+        )
+        sd[p + "self_attn.o_proj.weight"] = (
+            np.asarray(lyr["o"]["kernel"]).reshape(H * hd, D).T
+        )
+        sd[p + "post_attention_layernorm.weight"] = np.asarray(
+            lyr["mlp_norm"]["scale"]
+        )
+        sd[p + "mlp.gate_proj.weight"] = np.asarray(lyr["gate"]["kernel"]).T
+        sd[p + "mlp.up_proj.weight"] = np.asarray(lyr["up"]["kernel"]).T
+        sd[p + "mlp.down_proj.weight"] = np.asarray(lyr["down"]["kernel"]).T
+    return sd
 
 
 # --------------------------------------------------------------------------

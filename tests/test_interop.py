@@ -162,6 +162,75 @@ def test_bert_classifier_matches_hf():
     np.testing.assert_allclose(np.asarray(got), want, atol=3e-4, rtol=3e-4)
 
 
+def test_gpt2_export_roundtrips_into_torch():
+    """Our trained params -> torch state_dict -> HF forward matches ours."""
+    from pytorch_distributed_tpu.interop import export_gpt2_weights
+    from pytorch_distributed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+    cfg = GPT2Config(
+        vocab_size=83, n_positions=16, hidden_size=32, num_layers=2,
+        num_heads=2, dropout_rate=0.0,
+    )
+    import jax.numpy as jnp
+
+    model = GPT2LMHead(cfg)
+    ids = np.random.default_rng(5).integers(83, size=(2, 9)).astype(np.int32)
+    params = model.init(
+        __import__("jax").random.key(3), jnp.asarray(ids[:1])
+    )["params"]
+    sd = export_gpt2_weights(params, cfg)
+    hf = transformers.GPT2LMHeadModel(
+        transformers.GPT2Config(
+            vocab_size=83, n_positions=16, n_embd=32, n_layer=2, n_head=2,
+            resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        )
+    )
+    missing, unexpected = hf.load_state_dict(
+        {k: torch.tensor(v) for k, v in sd.items()}, strict=False
+    )
+    # HF keeps non-param buffers (attn.bias causal masks) — those may be
+    # "missing" from our export; no exported key may be unexpected
+    assert not unexpected, unexpected
+    assert all("attn.bias" in k or "masked_bias" in k for k in missing), missing
+    hf.eval()
+    with torch.no_grad():
+        want = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    with autocast(enabled=False):
+        got = model.apply({"params": params}, ids)
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.slow
+def test_llama_export_import_roundtrip():
+    """export -> import is the identity on every leaf (both layouts)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.interop import export_llama_weights
+    from pytorch_distributed_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+    )
+
+    for scan in (True, False):
+        cfg = LlamaConfig(
+            vocab_size=51, hidden_size=32, intermediate_size=48,
+            num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=16,
+            scan_layers=scan,
+        )
+        params = LlamaForCausalLM(cfg).init(
+            jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        back = load_llama_weights(export_llama_weights(params, cfg), cfg)
+        for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(params),
+            jax.tree_util.tree_leaves_with_path(back),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-6, err_msg=str(pa)
+            )
+
+
 def test_converted_tree_structure_matches_init():
     """Converter output must be loadable exactly where init puts params."""
     import jax.numpy as jnp
