@@ -63,6 +63,7 @@ from pytorch_distributed_tpu.runtime.precision import (
 )
 from pytorch_distributed_tpu.runtime.prng import RngSeq, seed_all
 from pytorch_distributed_tpu.generation import generate, sample_logits
+from pytorch_distributed_tpu import optim
 from pytorch_distributed_tpu.launch import (
     ElasticAgent,
     init_multihost,
@@ -99,6 +100,7 @@ __all__ = [
     "ReduceOp",
     "enable_compilation_cache",
     "generate",
+    "optim",
     "sample_logits",
     "Policy",
     "autocast",

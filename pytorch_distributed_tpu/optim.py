@@ -1,0 +1,132 @@
+"""torch.optim-shaped constructors over optax.
+
+The reference's recipes read ``torch.optim.SGD(params, lr, momentum=0.9,
+weight_decay=1e-4)`` + ``lr_scheduler.CosineAnnealingLR``; this module
+keeps those call shapes while staying functional underneath — every
+constructor returns an ``optax.GradientTransformation`` (drop into
+``TrainState.create(tx=...)``), and schedulers return optax schedules
+(pass as the learning rate). No stateful ``.step()`` objects: under jit
+the optimizer state lives in the TrainState, which is what lets ZeRO-1 /
+FSDP shard it (parallel/strategies.py).
+
+Example, reference-texture:
+
+    tx = ptd.optim.SGD(lr=ptd.optim.CosineAnnealingLR(0.4, T_max=total),
+                       momentum=0.9, weight_decay=1e-4, nesterov=True)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import optax
+
+ScalarOrSchedule = Union[float, optax.Schedule]
+
+
+def SGD(
+    lr: ScalarOrSchedule,
+    momentum: float = 0.0,
+    weight_decay: float = 0.0,
+    nesterov: bool = False,
+    dampening: float = 0.0,
+) -> optax.GradientTransformation:
+    """``torch.optim.SGD`` semantics (incl. decoupled-from-loss L2 as torch
+    does it: weight decay added to the gradient before momentum)."""
+    if dampening != 0.0:
+        raise NotImplementedError("dampening != 0 is not supported")
+    chain = []
+    if weight_decay:
+        chain.append(optax.add_decayed_weights(weight_decay))
+    chain.append(
+        optax.sgd(lr, momentum=momentum or None, nesterov=nesterov)
+    )
+    return optax.chain(*chain)
+
+
+def Adam(
+    lr: ScalarOrSchedule = 1e-3,
+    betas: Sequence[float] = (0.9, 0.999),
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """``torch.optim.Adam`` (L2 folded into grads, NOT AdamW decoupling)."""
+    chain = []
+    if weight_decay:
+        chain.append(optax.add_decayed_weights(weight_decay))
+    chain.append(optax.adam(lr, b1=betas[0], b2=betas[1], eps=eps))
+    return optax.chain(*chain)
+
+
+def AdamW(
+    lr: ScalarOrSchedule = 1e-3,
+    betas: Sequence[float] = (0.9, 0.999),
+    eps: float = 1e-8,
+    weight_decay: float = 0.01,
+) -> optax.GradientTransformation:
+    return optax.adamw(
+        lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay
+    )
+
+
+# -- lr "schedulers": schedules you pass AS the lr -------------------------
+
+
+def StepLR(lr: float, step_size: int, gamma: float = 0.1) -> optax.Schedule:
+    """Decay by ``gamma`` every ``step_size`` optimizer steps."""
+
+    def schedule(count):
+        return lr * gamma ** (count // step_size)
+
+    return schedule
+
+
+def MultiStepLR(
+    lr: float, milestones: Sequence[int], gamma: float = 0.1
+) -> optax.Schedule:
+    boundaries = {int(m): gamma for m in milestones}
+    return optax.piecewise_constant_schedule(lr, boundaries)
+
+
+def CosineAnnealingLR(
+    lr: float, T_max: int, eta_min: float = 0.0
+) -> optax.Schedule:
+    return optax.cosine_decay_schedule(
+        lr, decay_steps=max(T_max, 1), alpha=eta_min / lr if lr else 0.0
+    )
+
+
+def WarmupCosine(
+    lr: float,
+    warmup_steps: int,
+    total_steps: int,
+    eta_min: float = 0.0,
+    init_lr: float = 0.0,
+) -> optax.Schedule:
+    """The modern default (linear warmup -> cosine decay) the reference
+    recipes hand-roll with LambdaLR."""
+    return optax.warmup_cosine_decay_schedule(
+        init_value=init_lr,
+        peak_value=lr,
+        warmup_steps=warmup_steps,
+        decay_steps=max(total_steps, 1),
+        end_value=eta_min,
+    )
+
+
+def LinearLR(
+    lr: float,
+    start_factor: float = 1.0 / 3,
+    end_factor: float = 1.0,
+    total_iters: int = 5,
+) -> optax.Schedule:
+    return optax.linear_schedule(
+        lr * start_factor, lr * end_factor, max(total_iters, 1)
+    )
+
+
+def clip_grad_norm(
+    tx: optax.GradientTransformation, max_norm: float
+) -> optax.GradientTransformation:
+    """``torch.nn.utils.clip_grad_norm_`` as a transformation prefix."""
+    return optax.chain(optax.clip_by_global_norm(max_norm), tx)
