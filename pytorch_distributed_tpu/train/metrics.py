@@ -1,9 +1,14 @@
 """Step-time / throughput meters — the north-star metrics
-(images/sec/chip and step time, BASELINE.json:2)."""
+(images/sec/chip and step time, BASELINE.json:2) — and the structured
+metrics log (JSONL scalars per log event; the tensorboard-scalars
+equivalent that works with zero extra dependencies)."""
 
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
+import time
 from typing import Dict, List
 
 from pytorch_distributed_tpu.runtime import device as _device
@@ -49,3 +54,46 @@ class ScalarMeter:
             "samples_per_sec_per_chip": self.samples_per_sec_per_chip,
             "step_time_ms": self.step_time * 1e3,
         }
+
+
+class MetricsWriter:
+    """Append-only JSONL scalar log: one record per (step, metrics) event.
+
+    ``{"step": 120, "wall_time": ..., "split": "train", "loss": ...}`` per
+    line — trivially loadable with pandas/jq, durable across preemption
+    restarts (append mode), rank-0-gated by the Trainer.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a", buffering=1)  # line-buffered
+
+    def write(
+        self, step: int, metrics: Dict[str, float], *, split: str = "train"
+    ) -> None:
+        if self._f is None:  # closed (end of a fit()) — reopen on reuse
+            self._f = open(self.path, "a", buffering=1)
+        rec = {"step": int(step), "wall_time": time.time(), "split": split}
+        for k, v in metrics.items():
+            try:
+                rec[k] = float(v)
+            except (TypeError, ValueError):
+                rec[k] = str(v)
+        self._f.write(json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+def read_metrics(path: str) -> List[Dict[str, float]]:
+    """Load a MetricsWriter JSONL back into a list of records."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

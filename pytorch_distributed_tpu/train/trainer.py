@@ -29,7 +29,11 @@ from pytorch_distributed_tpu.runtime import distributed as dist
 from pytorch_distributed_tpu.runtime.precision import GradScaler
 from pytorch_distributed_tpu.runtime.prng import key_for
 from pytorch_distributed_tpu.train.train_state import TrainState
-from pytorch_distributed_tpu.train.metrics import MeterState, ScalarMeter
+from pytorch_distributed_tpu.train.metrics import (
+    MeterState,
+    MetricsWriter,
+    ScalarMeter,
+)
 from pytorch_distributed_tpu.utils.logging import get_logger
 
 # loss_fn(params, batch_stats, batch, rng) ->
@@ -166,6 +170,7 @@ class TrainerConfig:
     eval_every_epochs: int = 1
     samples_axis: str = "image"  # batch leaf whose dim0 counts samples
     async_checkpoint: bool = False  # overlap ckpt IO with training
+    metrics_path: Optional[str] = None  # JSONL scalar log (rank 0)
     # failure detection / elastic recovery (train/elastic.py):
     handle_preemption: bool = True  # SIGTERM -> checkpoint -> Preempted
     stall_timeout_s: Optional[float] = None  # watchdog hang detection
@@ -200,6 +205,11 @@ class Trainer:
         self.train_loader = train_loader
         self.eval_loader = eval_loader
         self.meter = ScalarMeter()
+        self.metrics_writer = None
+        if self.config.metrics_path and (
+            dist.multiprocess_ring() is None or dist.get_rank() == 0
+        ):
+            self.metrics_writer = MetricsWriter(self.config.metrics_path)
         self.last_eval_metrics: Dict[str, float] = {}
         # Host-side mirror of state.step (monotonic Python int, +1 per
         # train_step call — apply_gradients increments exactly once per
@@ -301,6 +311,8 @@ class Trainer:
                 self._preemption.uninstall()
             if self._watchdog is not None:
                 self._watchdog.stop()
+            if self.metrics_writer is not None:
+                self.metrics_writer.close()
         return self.state
 
     def _check_preemption(self) -> None:
@@ -363,6 +375,12 @@ class Trainer:
                     n / dt,
                     dt * 1e3,
                 )
+                if self.metrics_writer is not None:
+                    self.metrics_writer.write(
+                        step,
+                        {**metrics, "samples_per_sec": n / dt,
+                         "step_time_ms": dt * 1e3, "epoch": epoch},
+                    )
             if cfg.ckpt_every_steps and step % cfg.ckpt_every_steps == 0:
                 self.save_checkpoint()
 
@@ -396,6 +414,10 @@ class Trainer:
             epoch,
             " ".join(f"{k}={v:.4f}" for k, v in means.items()),
         )
+        if self.metrics_writer is not None:
+            self.metrics_writer.write(
+                self.host_step, {**means, "epoch": epoch}, split="eval"
+            )
         return means
 
     def _batch_samples(self, batch) -> int:

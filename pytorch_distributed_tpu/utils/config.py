@@ -46,6 +46,7 @@ class RecipeConfig:
     ckpt_dir: Optional[str] = None  # doc: checkpoint directory (enables resume)
     log_every: int = 50  # doc: steps between metric logs
     profile_dir: Optional[str] = None  # doc: write JAX profiler traces here
+    metrics_path: Optional[str] = None  # doc: JSONL scalar metrics log
 
 
 def _field_docs(cls: type) -> dict:

@@ -179,6 +179,7 @@ def main(argv=None):
             epochs=cfg.epochs,
             log_every=cfg.log_every,
             ckpt_dir=cfg.ckpt_dir,
+            metrics_path=cfg.metrics_path,
         ),
     )
     trainer.restore_checkpoint()

@@ -405,3 +405,47 @@ class TestCheckpoint:
         assert int(t2.state.step) == 4
         out = t2.fit()  # resumed at epoch 2 == done; no extra steps
         assert int(out.step) == 4
+
+
+class TestMetricsWriter:
+    def test_jsonl_train_and_eval_records(self, dp8, tmp_path):
+        from pytorch_distributed_tpu.train.metrics import read_metrics
+
+        model = tiny_resnet()
+        state = tiny_image_state(model)
+        ds = SyntheticImageDataset(n=32, image_shape=(16, 16, 3), seed=0)
+        path = str(tmp_path / "m" / "metrics.jsonl")
+        trainer = Trainer(
+            state,
+            dp8,
+            build_train_step(classification_loss_fn(model)),
+            DataLoader(ds, 16, sharding=dp8.batch_sharding()),
+            eval_step=classification_eval_step(model),
+            eval_loader=DataLoader(
+                ds, 16, shuffle=False, sharding=dp8.batch_sharding()
+            ),
+            config=TrainerConfig(
+                epochs=1, log_every=1, metrics_path=path,
+                handle_preemption=False,
+            ),
+        )
+        trainer.fit()
+        recs = read_metrics(path)
+        train = [r for r in recs if r["split"] == "train"]
+        evals = [r for r in recs if r["split"] == "eval"]
+        assert len(train) == 2 and len(evals) == 1
+        assert {"step", "wall_time", "loss"} <= set(train[0])
+        assert "accuracy" in evals[0]
+        # append across a second fit (restart durability)
+        trainer2 = Trainer(
+            trainer.state.replace(step=trainer.state.step),
+            dp8,
+            build_train_step(classification_loss_fn(model)),
+            DataLoader(ds, 16, sharding=dp8.batch_sharding()),
+            config=TrainerConfig(
+                epochs=1, log_every=1, metrics_path=path,
+                handle_preemption=False,
+            ),
+        )
+        trainer2.fit()
+        assert len(read_metrics(path)) > len(recs)
