@@ -41,6 +41,9 @@ ALLREDUCE_ELEMS = 25_600_000  # ~RN50 gradient volume, f32 -> 102.4 MB
 
 
 def _emit(obj, primary=False):
+    # every record names the platform it ran on, so a CPU-fallback run
+    # (dead relay) is self-describing rather than a mystery slow number
+    obj.setdefault("platform", ptd.platform())
     line = json.dumps(obj)
     print(line, file=sys.stdout if primary else sys.stderr)
     sys.stdout.flush()

@@ -109,6 +109,28 @@ def test_eos_pads_after_stop(gpt2):
     assert np.all(row[stop + 1:] == 0), row
 
 
+def test_generate_with_sharded_params(gpt2):
+    """Inference under FSDP+TP sharding: same greedy tokens as replicated."""
+    from pytorch_distributed_tpu.models.gpt2 import gpt2_partition_rules
+    from pytorch_distributed_tpu.parallel import FSDP
+
+    model, params, ids = gpt2
+    want = generate(model, params, ids, max_new_tokens=5, temperature=0.0)
+
+    ptd.destroy_process_group()
+    ptd.init_process_group(mesh_spec=MeshSpec(dp=2, fsdp=2, tp=2))
+    strategy = FSDP(extra_rules=gpt2_partition_rules())
+    from pytorch_distributed_tpu.parallel.sharding import infer_tree_shardings
+
+    sharded = jax.device_put(
+        params, infer_tree_shardings(params, strategy.param_rules())
+    )
+    qkv = sharded["blocks"]["block"]["attn_qkv"]["kernel"]
+    assert not qkv.sharding.is_fully_replicated
+    got = generate(model, sharded, ids, max_new_tokens=5, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
 def test_sampling_respects_top_k():
     logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0, 10.0]])
     for seed in range(8):
