@@ -104,6 +104,38 @@ def test_8b_fsdp_state_fits_v5p64(abstract_8b_state):
     assert per_device < V4_HBM_BYTES / 3
 
 
+def test_8b_decode_cache_bytes_bounded_by_cache_len(abstract_8b_state):
+    """8B KV-cache decode traces via eval_shape, and the generation-sized
+    cache (generation.py passes cache_len = prompt+new) is ~27x smaller
+    than naively caching to max_seq_len — the difference between fitting
+    on one chip and not."""
+    cfg, model, abstract = abstract_8b_state
+    B, P, NEW = 8, 128, 128
+
+    def cache_bytes(cache_len):
+        def prefill(params):
+            _, state = model.apply(
+                {"params": params},
+                jnp.zeros((B, P), jnp.int32),
+                decode=True,
+                cache_len=cache_len,
+                mutable=["cache"],
+            )
+            return state["cache"]
+
+        cache = jax.eval_shape(prefill, abstract.params)
+        return sum(
+            int(np.prod(l.shape)) * l.dtype.itemsize
+            for l in jax.tree_util.tree_leaves(cache)
+        )
+
+    bounded = cache_bytes(P + NEW)
+    naive = cache_bytes(cfg.max_seq_len)
+    # 2 (K,V) x 32 layers x [8, 256, 8kv, 128] bf16 ~= 2.1 GB
+    assert bounded < 3e9, f"{bounded/1e9:.2f} GB"
+    assert naive > 20 * bounded  # the cache_len bound is load-bearing
+
+
 @pytest.mark.slow
 def test_8b_fsdp_train_step_lowers_for_tpu(abstract_8b_state):
     cfg, model, abstract = abstract_8b_state
