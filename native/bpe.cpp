@@ -1,0 +1,164 @@
+// Byte-level BPE tokenizer: train + encode, GIL-free.
+//
+// The reference's LM recipes lean on Hugging Face tokenizers (Rust) for
+// corpus preparation; this is the framework's native equivalent for the
+// TPU host: byte-level BPE (no pre-tokenization — every byte is a base
+// token, merges learned greedily by pair frequency), exposed through a
+// minimal C ABI consumed by ctypes (data/tokenizer.py).
+//
+// Determinism: ties on pair frequency break toward the smaller (left,
+// right) pair, so training is reproducible across runs and platforms.
+//
+// Complexity: training re-counts pairs each merge over the current token
+// stream — O(merges * corpus). Fine for the multi-MB corpora recipes
+// prepare on-host; encode is the classic lowest-rank-merge loop per
+// chunk with a linked-list so each merge is O(chunk).
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+namespace {
+
+using Pair = std::pair<int32_t, int32_t>;
+
+struct PairHash {
+  size_t operator()(const Pair& p) const {
+    return (static_cast<size_t>(static_cast<uint32_t>(p.first)) << 32) ^
+           static_cast<uint32_t>(p.second);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Train merges on a byte corpus. merges_out receives num_merges (left,
+// right) int32 pairs: merge i produces token id 256 + i.
+// Returns the number of merges actually learned (< num_merges when the
+// corpus runs out of repeating pairs), or -1 on bad args.
+int64_t bpe_train(const uint8_t* corpus, int64_t n, int64_t num_merges,
+                  int32_t* merges_out) {
+  if (!corpus || n < 2 || num_merges < 0 || !merges_out) return -1;
+  std::vector<int32_t> toks(corpus, corpus + n);
+  int64_t learned = 0;
+  std::vector<int32_t> next;
+  next.reserve(toks.size());
+  for (; learned < num_merges; ++learned) {
+    std::unordered_map<Pair, int64_t, PairHash> counts;
+    counts.reserve(toks.size() / 2);
+    for (size_t i = 0; i + 1 < toks.size(); ++i) {
+      ++counts[{toks[i], toks[i + 1]}];
+    }
+    Pair best{-1, -1};
+    int64_t best_count = 1;  // a pair must appear at least twice
+    for (const auto& [pair, count] : counts) {
+      if (count > best_count ||
+          (count == best_count && best.first >= 0 && pair < best)) {
+        best = pair;
+        best_count = count;
+      }
+    }
+    if (best.first < 0) break;
+    const int32_t new_id = static_cast<int32_t>(256 + learned);
+    merges_out[2 * learned] = best.first;
+    merges_out[2 * learned + 1] = best.second;
+    next.clear();
+    for (size_t i = 0; i < toks.size();) {
+      if (i + 1 < toks.size() && toks[i] == best.first &&
+          toks[i + 1] == best.second) {
+        next.push_back(new_id);
+        i += 2;
+      } else {
+        next.push_back(toks[i]);
+        ++i;
+      }
+    }
+    toks.swap(next);
+    if (toks.size() < 2) { ++learned; break; }
+  }
+  return learned;
+}
+
+// Encode bytes with trained merges. ids_out must hold >= n entries
+// (output never exceeds input length). Returns the number of ids
+// written, or -1 on bad args.
+int64_t bpe_encode(const uint8_t* text, int64_t n, const int32_t* merges,
+                   int64_t num_merges, int32_t* ids_out) {
+  if (!text || n < 0 || (num_merges > 0 && !merges) || !ids_out) return -1;
+  if (n == 0) return 0;
+  // rank lookup: pair -> merged id (rank == id order: lower id = earlier
+  // merge = higher priority)
+  std::unordered_map<Pair, int32_t, PairHash> rank;
+  rank.reserve(static_cast<size_t>(num_merges) * 2);
+  for (int64_t i = 0; i < num_merges; ++i) {
+    rank[{merges[2 * i], merges[2 * i + 1]}] =
+        static_cast<int32_t>(256 + i);
+  }
+  // linked list over the token buffer so merges are O(1) splices
+  std::vector<int32_t> tok(text, text + n);
+  std::vector<int64_t> nxt(n), prv(n);
+  for (int64_t i = 0; i < n; ++i) { nxt[i] = i + 1; prv[i] = i - 1; }
+  // ordered worklist of candidate merges keyed by (merged id, position):
+  // always apply the earliest-learned merge first — BPE's definition
+  std::map<std::pair<int32_t, int64_t>, Pair> work;
+  auto consider = [&](int64_t i) {
+    const int64_t j = nxt[i];
+    if (i < 0 || j >= n) return;
+    auto it = rank.find({tok[i], tok[j]});
+    if (it != rank.end()) work[{it->second, i}] = {tok[i], tok[j]};
+  };
+  for (int64_t i = 0; i + 1 < n; ++i) consider(i);
+  while (!work.empty()) {
+    const auto entry = *work.begin();
+    work.erase(work.begin());
+    const int64_t i = entry.first.second;
+    const int64_t j = nxt[i];
+    // stale entry? (either side already merged away)
+    if (j >= n || tok[i] != entry.second.first ||
+        tok[j] != entry.second.second) {
+      continue;
+    }
+    tok[i] = entry.first.first;  // the merged id
+    nxt[i] = nxt[j];
+    if (nxt[j] < n) prv[nxt[j]] = i;
+    tok[j] = -1;
+    if (prv[i] >= 0) consider(prv[i]);  // re-examine both new neighbors
+    consider(i);
+  }
+  int64_t m = 0;
+  for (int64_t i = 0; i >= 0 && i < n; i = nxt[i]) ids_out[m++] = tok[i];
+  return m;
+}
+
+// Decode ids back to bytes. out must hold >= max_out bytes; returns
+// bytes written or -1 (bad args / id out of range / overflow).
+int64_t bpe_decode(const int32_t* ids, int64_t n, const int32_t* merges,
+                   int64_t num_merges, uint8_t* out, int64_t max_out) {
+  if (!ids || n < 0 || (num_merges > 0 && !merges) || !out) return -1;
+  // expand each id depth-first over its merge tree
+  int64_t m = 0;
+  std::vector<int32_t> stack;
+  for (int64_t i = 0; i < n; ++i) {
+    stack.push_back(ids[i]);
+    while (!stack.empty()) {
+      const int32_t t = stack.back();
+      stack.pop_back();
+      if (t < 0 || t >= 256 + num_merges) return -1;
+      if (t < 256) {
+        if (m >= max_out) return -1;
+        out[m++] = static_cast<uint8_t>(t);
+      } else {
+        const int64_t k = t - 256;
+        stack.push_back(merges[2 * k + 1]);  // right after left (stack)
+        stack.push_back(merges[2 * k]);
+      }
+    }
+  }
+  return m;
+}
+
+}  // extern "C"

@@ -29,10 +29,16 @@ from pytorch_distributed_tpu.data.image_folder import (
     FolderImagePipeline,
     ImageFolderDataset,
 )
+from pytorch_distributed_tpu.data.tokenizer import (
+    TokenizedTextDataset,
+    Tokenizer,
+)
 
 __all__ = [
     "FolderImagePipeline",
     "ImageFolderDataset",
+    "Tokenizer",
+    "TokenizedTextDataset",
     "DistributedSampler",
     "GlobalBatchSampler",
     "DataLoader",

@@ -1,0 +1,192 @@
+"""Byte-level BPE tokenizer over the native trainer/encoder (native/bpe.cpp).
+
+The reference's LM recipes prepare corpora with Hugging Face tokenizers;
+offline, this framework trains its own: byte-level BPE (every byte is a
+base token, so ANY text round-trips losslessly; merges learned by pair
+frequency). Training and encoding run in C with the GIL released via
+ctypes, so the DataLoader's background thread can tokenize at full speed.
+
+    tok = Tokenizer.train(text, vocab_size=1024)
+    ids = tok.encode("hello world")
+    assert tok.decode(ids) == "hello world"
+    tok.save(path); Tokenizer.load(path)
+
+``TokenizedTextDataset`` chunks an encoded corpus into fixed-length
+sequences for the causal-LM recipes — pass ``--text-file`` to
+recipes/gpt2_zero1.py to train on a real local corpus.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional, Union
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_SRC = os.path.join(_NATIVE_DIR, "bpe.cpp")
+_SO = os.path.join(_NATIVE_DIR, "libbpe.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def build_library(force: bool = False) -> str:
+    stale = (
+        force
+        or not os.path.exists(_SO)
+        or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+    )
+    if stale:
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_NATIVE_DIR)
+        os.close(fd)
+        try:
+            subprocess.run(
+                [
+                    os.environ.get("CXX", "g++"),
+                    "-O3", "-std=c++17", "-fPIC", "-shared",
+                    "-o", tmp, _SRC,
+                ],
+                check=True, capture_output=True, text=True,
+            )
+            os.replace(tmp, _SO)
+        except subprocess.CalledProcessError as e:  # pragma: no cover
+            os.unlink(tmp)
+            raise RuntimeError(f"bpe build failed:\n{e.stderr}") from e
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+    return _SO
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(build_library())
+        i64, p = ctypes.c_int64, ctypes.c_void_p
+        lib.bpe_train.argtypes = [p, i64, i64, p]
+        lib.bpe_train.restype = i64
+        lib.bpe_encode.argtypes = [p, i64, p, i64, p]
+        lib.bpe_encode.restype = i64
+        lib.bpe_decode.argtypes = [p, i64, p, i64, p, i64]
+        lib.bpe_decode.restype = i64
+        _lib = lib
+    return _lib
+
+
+def _ptr(a: np.ndarray) -> ctypes.c_void_p:
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+class Tokenizer:
+    """Byte-level BPE: ids ``0..255`` are raw bytes, ``256+i`` is merge i."""
+
+    def __init__(self, merges: np.ndarray):
+        merges = np.ascontiguousarray(merges, np.int32)
+        if merges.ndim != 2 or merges.shape[1] != 2:
+            raise ValueError(f"merges must be [n, 2], got {merges.shape}")
+        self.merges = merges
+        # byte length of every token id (exact decode-buffer sizing)
+        lengths = np.ones(256 + len(merges), np.int64)
+        for k, (left, right) in enumerate(merges):
+            lengths[256 + k] = lengths[left] + lengths[right]
+        self._token_bytes = lengths
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + len(self.merges)
+
+    @classmethod
+    def train(
+        cls, corpus: Union[str, bytes], vocab_size: int = 1024
+    ) -> "Tokenizer":
+        if vocab_size < 256:
+            raise ValueError("byte-level vocab_size must be >= 256")
+        data = corpus.encode("utf-8") if isinstance(corpus, str) else corpus
+        buf = np.frombuffer(data, np.uint8)
+        want = vocab_size - 256
+        merges = np.zeros((max(want, 1), 2), np.int32)
+        got = _load().bpe_train(_ptr(buf), len(buf), want, _ptr(merges))
+        if got < 0:
+            raise RuntimeError("bpe_train failed")
+        return cls(merges[:got])
+
+    def encode(self, text: Union[str, bytes]) -> np.ndarray:
+        data = text.encode("utf-8") if isinstance(text, str) else text
+        buf = np.frombuffer(data, np.uint8)
+        out = np.empty(max(len(buf), 1), np.int32)
+        m = _load().bpe_encode(
+            _ptr(buf), len(buf), _ptr(self.merges), len(self.merges),
+            _ptr(out),
+        )
+        if m < 0:
+            raise RuntimeError("bpe_encode failed")
+        return out[:m].copy()
+
+    def decode(self, ids) -> str:
+        ids = np.ascontiguousarray(ids, np.int32)
+        if np.any(ids < 0) or np.any(ids >= self.vocab_size):
+            raise ValueError("token id out of range")
+        # exact output size from per-token byte lengths
+        cap = int(self._token_bytes[ids].sum()) if len(ids) else 1
+        out = np.empty(cap, np.uint8)
+        m = _load().bpe_decode(
+            _ptr(ids), len(ids), _ptr(self.merges), len(self.merges),
+            _ptr(out), cap,
+        )
+        if m < 0:
+            raise RuntimeError("bpe_decode failed (bad id or overflow)")
+        return out[:m].tobytes().decode("utf-8", errors="replace")
+
+    def save(self, path: str) -> None:
+        np.savez(path if path.endswith(".npz") else path + ".npz",
+                 merges=self.merges)
+
+    @classmethod
+    def load(cls, path: str) -> "Tokenizer":
+        with np.load(
+            path if path.endswith(".npz") else path + ".npz"
+        ) as f:
+            return cls(f["merges"])
+
+
+class TokenizedTextDataset:
+    """Fixed-length id sequences chunked from an encoded corpus.
+
+    ``{"input_ids": int32 [seq_len]}`` per item — the causal-LM recipe
+    contract (same as SyntheticTextDataset, but real text).
+    """
+
+    def __init__(
+        self,
+        text: Union[str, bytes],
+        tokenizer: Tokenizer,
+        seq_len: int,
+        *,
+        stride: Optional[int] = None,
+    ):
+        ids = tokenizer.encode(text)
+        stride = stride or seq_len
+        n = (len(ids) - seq_len) // stride + 1 if len(ids) >= seq_len else 0
+        if n <= 0:
+            raise ValueError(
+                f"corpus of {len(ids)} tokens too short for seq_len {seq_len}"
+            )
+        self._windows = np.stack(
+            [ids[i * stride: i * stride + seq_len] for i in range(n)]
+        )
+        self.tokenizer = tokenizer
+
+    def __len__(self) -> int:
+        return len(self._windows)
+
+    def __getitem__(self, i):
+        if isinstance(i, (int, np.integer)):
+            return {"input_ids": self._windows[int(i)]}
+        return {"input_ids": self._windows[np.asarray(i)]}

@@ -1,0 +1,152 @@
+"""Native byte-level BPE (native/bpe.cpp via data/tokenizer.py)."""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.data.tokenizer import (
+    TokenizedTextDataset,
+    Tokenizer,
+)
+
+CORPUS = (
+    "the quick brown fox jumps over the lazy dog. "
+    "the quick brown fox jumps over the lazy dog again. "
+    "pack my box with five dozen liquor jugs. "
+) * 40
+
+
+def _py_train(data: bytes, num_merges: int):
+    """Slow reference trainer: same greedy rule, ties to smallest pair."""
+    toks = list(data)
+    merges = []
+    for k in range(num_merges):
+        counts = {}
+        for a, b in zip(toks, toks[1:]):
+            counts[(a, b)] = counts.get((a, b), 0) + 1
+        best = None
+        for pair, c in counts.items():
+            if c < 2:
+                continue
+            if best is None or c > counts[best] or (
+                c == counts[best] and pair < best
+            ):
+                best = pair
+        if best is None:
+            break
+        new_id = 256 + k
+        merges.append(best)
+        out, i = [], 0
+        while i < len(toks):
+            if i + 1 < len(toks) and (toks[i], toks[i + 1]) == best:
+                out.append(new_id)
+                i += 2
+            else:
+                out.append(toks[i])
+                i += 1
+        toks = out
+    return merges
+
+
+def _py_encode(data: bytes, merges):
+    rank = {pair: 256 + i for i, pair in enumerate(merges)}
+    toks = list(data)
+    while True:
+        best_i, best_rank = None, None
+        for i, pair in enumerate(zip(toks, toks[1:])):
+            r = rank.get(pair)
+            if r is not None and (best_rank is None or r < best_rank):
+                best_i, best_rank = i, r
+        if best_i is None:
+            return toks
+        toks = toks[:best_i] + [best_rank] + toks[best_i + 2:]
+
+
+def test_train_matches_python_reference():
+    data = CORPUS[:400].encode()
+    tok = Tokenizer.train(data, vocab_size=256 + 24)
+    want = _py_train(data, 24)
+    got = [tuple(m) for m in tok.merges]
+    assert got == want
+
+
+def test_encode_matches_python_reference():
+    tok = Tokenizer.train(CORPUS, vocab_size=512)
+    for text in ("the quick brown fox", "zebra!?", "dozen liquor jugs"):
+        got = tok.encode(text).tolist()
+        want = _py_encode(text.encode(), [tuple(m) for m in tok.merges])
+        assert got == want, text
+
+
+def test_roundtrip_lossless_any_text():
+    tok = Tokenizer.train(CORPUS, vocab_size=400)
+    for text in (
+        "the quick brown fox",
+        "bytes the trainer never saw: \x00\x7f ütf-8 ✓ 日本語",
+        "",
+    ):
+        assert tok.decode(tok.encode(text)) == text
+
+
+def test_compression_actually_happens():
+    tok = Tokenizer.train(CORPUS, vocab_size=768)
+    ids = tok.encode(CORPUS)
+    assert len(ids) < len(CORPUS.encode()) * 0.5  # >2x on its own corpus
+    assert tok.vocab_size <= 768
+
+
+def test_save_load_roundtrip(tmp_path):
+    tok = Tokenizer.train(CORPUS, vocab_size=300)
+    tok.save(str(tmp_path / "tok"))
+    tok2 = Tokenizer.load(str(tmp_path / "tok"))
+    np.testing.assert_array_equal(tok.merges, tok2.merges)
+    s = "the lazy dog"
+    np.testing.assert_array_equal(tok.encode(s), tok2.encode(s))
+
+
+def test_decode_rejects_bad_ids():
+    tok = Tokenizer.train(CORPUS, vocab_size=300)
+    with pytest.raises(ValueError):
+        tok.decode(np.asarray([tok.vocab_size], np.int32))
+
+
+def test_tokenized_dataset_windows():
+    tok = Tokenizer.train(CORPUS, vocab_size=320)
+    ds = TokenizedTextDataset(CORPUS, tok, seq_len=32)
+    assert len(ds) > 4
+    item = ds[0]
+    assert item["input_ids"].shape == (32,)
+    assert item["input_ids"].dtype == np.int32
+    # windows tile the corpus: decoding the first window gives real text
+    text = tok.decode(ds[0]["input_ids"])
+    assert "the" in text
+    with pytest.raises(ValueError):
+        TokenizedTextDataset("tiny", tok, seq_len=512)
+
+
+@pytest.mark.slow
+def test_gpt2_recipe_trains_on_text_file(tmp_path):
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "recipes")
+    )
+    import gpt2_zero1
+
+    corpus = tmp_path / "corpus.txt"
+    # varied text: an exact-repeat corpus BPE-compresses to a handful of
+    # tokens (merges absorb whole sentences) and can't fill a window
+    corpus.write_text(
+        "".join(
+            f"line {i}: the {i % 7} quick foxes jumped {i * 13} times.\n"
+            for i in range(400)
+        )
+    )
+    state = gpt2_zero1.main(
+        [
+            "--size", "tiny", "--text-file", str(corpus), "--epochs", "1",
+            "--batch-size", "8", "--seq-len", "16", "--log-every", "0",
+            "--sample", "4",
+        ]
+    )
+    assert int(state.step) >= 1
