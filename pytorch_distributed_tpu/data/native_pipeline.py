@@ -16,11 +16,11 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import tempfile
 from typing import Optional, Sequence
 
 import numpy as np
+
+from pytorch_distributed_tpu.utils.native_build import build_native_library
 
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
@@ -34,32 +34,9 @@ _lib: Optional[ctypes.CDLL] = None
 
 def build_library(force: bool = False) -> str:
     """Compile libprefetch.so if missing/stale; returns the path."""
-    stale = (
-        force
-        or not os.path.exists(_SO)
-        or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+    return build_native_library(
+        _SRC, _SO, extra_flags=("-pthread",), force=force
     )
-    if stale:
-        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_NATIVE_DIR)
-        os.close(fd)
-        try:
-            subprocess.run(
-                [
-                    os.environ.get("CXX", "g++"),
-                    "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
-                    "-o", tmp, _SRC,
-                ],
-                check=True, capture_output=True, text=True,
-            )
-            os.replace(tmp, _SO)
-        except subprocess.CalledProcessError as e:  # pragma: no cover
-            os.unlink(tmp)
-            raise RuntimeError(f"prefetch build failed:\n{e.stderr}") from e
-        except BaseException:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-            raise
-    return _SO
 
 
 def _load() -> ctypes.CDLL:

@@ -20,11 +20,11 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import tempfile
 from typing import Optional, Union
 
 import numpy as np
+
+from pytorch_distributed_tpu.utils.native_build import build_native_library
 
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
@@ -37,32 +37,7 @@ _lib: Optional[ctypes.CDLL] = None
 
 
 def build_library(force: bool = False) -> str:
-    stale = (
-        force
-        or not os.path.exists(_SO)
-        or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
-    )
-    if stale:
-        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_NATIVE_DIR)
-        os.close(fd)
-        try:
-            subprocess.run(
-                [
-                    os.environ.get("CXX", "g++"),
-                    "-O3", "-std=c++17", "-fPIC", "-shared",
-                    "-o", tmp, _SRC,
-                ],
-                check=True, capture_output=True, text=True,
-            )
-            os.replace(tmp, _SO)
-        except subprocess.CalledProcessError as e:  # pragma: no cover
-            os.unlink(tmp)
-            raise RuntimeError(f"bpe build failed:\n{e.stderr}") from e
-        except BaseException:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-            raise
-    return _SO
+    return build_native_library(_SRC, _SO, force=force)
 
 
 def _load() -> ctypes.CDLL:
@@ -170,23 +145,38 @@ class TokenizedTextDataset:
         seq_len: int,
         *,
         stride: Optional[int] = None,
+        max_windows: Optional[int] = None,
     ):
-        ids = tokenizer.encode(text)
-        stride = stride or seq_len
-        n = (len(ids) - seq_len) // stride + 1 if len(ids) >= seq_len else 0
+        # one flat id array; windows are slices of it (overlapping strides
+        # would otherwise duplicate the whole stream in memory)
+        self._ids = tokenizer.encode(text)
+        self.seq_len = seq_len
+        self.stride = stride or seq_len
+        n = (
+            (len(self._ids) - seq_len) // self.stride + 1
+            if len(self._ids) >= seq_len else 0
+        )
         if n <= 0:
             raise ValueError(
-                f"corpus of {len(ids)} tokens too short for seq_len {seq_len}"
+                f"corpus of {len(self._ids)} tokens too short for "
+                f"seq_len {seq_len}"
             )
-        self._windows = np.stack(
-            [ids[i * stride: i * stride + seq_len] for i in range(n)]
-        )
+        self._n = min(n, max_windows) if max_windows else n
         self.tokenizer = tokenizer
 
+    @property
+    def num_tokens(self) -> int:
+        return len(self._ids)
+
     def __len__(self) -> int:
-        return len(self._windows)
+        return self._n
+
+    def _window(self, i: int) -> np.ndarray:
+        start = int(i) * self.stride
+        return self._ids[start: start + self.seq_len]
 
     def __getitem__(self, i):
         if isinstance(i, (int, np.integer)):
-            return {"input_ids": self._windows[int(i)]}
-        return {"input_ids": self._windows[np.asarray(i)]}
+            return {"input_ids": self._window(i)}
+        idx = np.asarray(i)
+        return {"input_ids": np.stack([self._window(j) for j in idx])}

@@ -15,8 +15,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import tempfile
 from typing import Optional
 
 import numpy as np
@@ -53,35 +51,13 @@ _lib: Optional[ctypes.CDLL] = None
 
 def build_library(force: bool = False) -> str:
     """Compile libhostring.so if missing/stale; returns the path."""
-    stale = (
-        force
-        or not os.path.exists(_SO)
-        or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+    from pytorch_distributed_tpu.utils.native_build import (
+        build_native_library,
     )
-    if stale:
-        # Build to a temp name then rename: concurrent builders race benignly.
-        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_NATIVE_DIR)
-        os.close(fd)
-        try:
-            subprocess.run(
-                [
-                    os.environ.get("CXX", "g++"),
-                    "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
-                    "-o", tmp, _SRC, "-lrt",
-                ],
-                check=True,
-                capture_output=True,
-                text=True,
-            )
-            os.replace(tmp, _SO)
-        except subprocess.CalledProcessError as e:  # pragma: no cover
-            os.unlink(tmp)
-            raise RuntimeError(f"hostring build failed:\n{e.stderr}") from e
-        except BaseException:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-            raise
-    return _SO
+
+    return build_native_library(
+        _SRC, _SO, extra_flags=("-pthread", "-lrt"), force=force
+    )
 
 
 def _load() -> ctypes.CDLL:

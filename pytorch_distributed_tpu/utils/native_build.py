@@ -1,0 +1,49 @@
+"""Shared build-on-first-use for the native (C++) components.
+
+One stale-checked, atomic (mkstemp + rename) g++ build used by the shm
+collectives ring, the prefetch pipeline, and the BPE tokenizer — the
+runtime fallback when ``make -C native`` wasn't run ahead of time.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+from typing import Sequence
+
+
+def build_native_library(
+    src: str, so: str, extra_flags: Sequence[str] = (), force: bool = False
+) -> str:
+    """Compile ``src`` -> ``so`` if missing/stale; returns ``so``."""
+    stale = (
+        force
+        or not os.path.exists(so)
+        or os.path.getmtime(so) < os.path.getmtime(src)
+    )
+    if stale:
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(so))
+        os.close(fd)
+        try:
+            subprocess.run(
+                [
+                    os.environ.get("CXX", "g++"),
+                    "-O3", "-std=c++17", "-fPIC", "-shared",
+                    "-o", tmp, src,
+                    # after the source: -l libraries resolve left-to-right
+                    *extra_flags,
+                ],
+                check=True, capture_output=True, text=True,
+            )
+            os.replace(tmp, so)
+        except subprocess.CalledProcessError as e:
+            os.unlink(tmp)
+            raise RuntimeError(
+                f"native build of {os.path.basename(src)} failed:\n{e.stderr}"
+            ) from e
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+    return so

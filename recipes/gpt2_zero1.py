@@ -106,11 +106,15 @@ def main(argv=None):
         # shrink the model's vocab to what the corpus actually needs
         cfg = dataclasses.replace(cfg, vocab_size=tokenizer.vocab_size)
         ds = TokenizedTextDataset(
-            corpus, tokenizer, seq_len, stride=seq_len // 2
+            corpus, tokenizer, seq_len, stride=seq_len // 2,
+            max_windows=(
+                args.steps_per_epoch * args.batch_size
+                if args.steps_per_epoch else None
+            ),
         )
         log_rank0(
             "text corpus: %d tokens vocab=%d windows=%d",
-            len(tokenizer.encode(corpus)), tokenizer.vocab_size, len(ds),
+            ds.num_tokens, tokenizer.vocab_size, len(ds),
         )
     else:
         n = (args.steps_per_epoch or 100) * args.batch_size
