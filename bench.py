@@ -316,6 +316,56 @@ def bench_gpt2(on_tpu: bool) -> None:
     )
 
 
+def bench_generate(on_tpu: bool) -> None:
+    """KV-cache decode throughput (tokens/sec) — the serving-side number.
+
+    GPT-2 (small on chip, tiny on CPU) generating with a static cache via
+    generation.py's prefill + lax.scan decode; greedy so the measurement
+    is deterministic.
+    """
+    from pytorch_distributed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+    if on_tpu:
+        cfg, B, P, NEW = GPT2Config.small(), 8, 128, 128
+    else:
+        cfg, B, P, NEW = GPT2Config.tiny(), 2, 8, 16
+
+    model = GPT2LMHead(cfg)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(
+        rng.integers(cfg.vocab_size, size=(B, P)).astype(np.int32)
+    )
+    params = model.init(jax.random.key(0), ids[:1])["params"]
+
+    run = jax.jit(
+        lambda params, ids: ptd.generate(
+            model, params, ids, max_new_tokens=NEW, temperature=0.0
+        )
+    )
+    out = run(params, ids)
+    int(out[0, -1])  # compile + sync
+    iters = 5 if on_tpu else 2
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run(params, ids)
+    int(out[0, -1])
+    dt = time.perf_counter() - t0
+    tok_per_sec = B * NEW * iters / dt
+    _emit(
+        {
+            "metric": "gpt2_decode_tokens_per_sec",
+            "value": round(tok_per_sec, 1),
+            "unit": f"tokens/sec, batch={B} prompt={P} new={NEW}",
+            "vs_baseline": None,
+        }
+    )
+    print(
+        f"# generate: kv-cache decode {NEW} tokens x batch {B} in "
+        f"{dt / iters * 1e3:.0f}ms/call",
+        file=sys.stderr,
+    )
+
+
 def bench_allreduce_device(on_tpu: bool) -> None:
     """Grad-sized allreduce over the dp mesh axis (BASELINE.json:2)."""
     from pytorch_distributed_tpu.runtime.distributed import ReduceOp
@@ -459,9 +509,10 @@ def main():
         bench_allreduce_hostring()
     except Exception as e:
         print(f"# hostring bench skipped: {e}", file=sys.stderr)
-    # LAST: the transformer step is the largest compile on the axon
-    # remote-compile path (>10 min cold); if it wedges, every other
-    # metric above has already been emitted
+    # LAST: the transformer compiles are the largest on the axon
+    # remote-compile path (>10 min cold); if one wedges, every metric
+    # above has already been emitted
+    bench_generate(on_tpu)
     bench_gpt2(on_tpu)
 
 
