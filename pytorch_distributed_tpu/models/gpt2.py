@@ -35,6 +35,16 @@ class GPT2Config:
     # stages consume directly). False restores the unrolled per-layer tree.
     scan_layers: bool = True
     remat: bool = False  # rematerialize each block in backward (saves HBM)
+    # > 0 turns every block's FFN into a mixture-of-experts (ops/moe.py):
+    # experts shard over the ep mesh axis. Uniform across layers so the
+    # scanned stack stays homogeneous.
+    moe_experts: int = 0
+    moe_k: int = 2
+    # expert queue length = k*T*factor/E. NOTE: capacity dropping makes
+    # routing depend on how many tokens share the call — a token dropped
+    # at full-batch width may survive at decode width — so outputs are
+    # only decode-vs-recompute identical when capacity is ample.
+    moe_capacity_factor: float = 1.25
 
     @property
     def intermediate_size(self) -> int:
@@ -91,15 +101,24 @@ class GPT2Block(nn.Module):
         x = x + nn.Dropout(cfg.dropout_rate)(attn, deterministic=deterministic)
 
         h = ln("ln2")(x)
-        h = nn.Dense(
-            cfg.intermediate_size, dtype=policy.compute_dtype,
-            param_dtype=policy.param_dtype, name="mlp_up",
-        )(h)
-        h = nn.gelu(h)
-        h = nn.Dense(
-            cfg.hidden_size, dtype=policy.compute_dtype,
-            param_dtype=policy.param_dtype, name="mlp_down",
-        )(h)
+        if cfg.moe_experts > 0:
+            from pytorch_distributed_tpu.ops.moe import MoEMLP
+
+            h = MoEMLP(
+                num_experts=cfg.moe_experts, d_ff=cfg.intermediate_size,
+                k=cfg.moe_k, capacity_factor=cfg.moe_capacity_factor,
+                name="moe",
+            )(h)
+        else:
+            h = nn.Dense(
+                cfg.intermediate_size, dtype=policy.compute_dtype,
+                param_dtype=policy.param_dtype, name="mlp_up",
+            )(h)
+            h = nn.gelu(h)
+            h = nn.Dense(
+                cfg.hidden_size, dtype=policy.compute_dtype,
+                param_dtype=policy.param_dtype, name="mlp_down",
+            )(h)
         return x + nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
 
 
@@ -168,7 +187,9 @@ def gpt2_partition_rules():
     """TP rules: qkv kernel [hidden, 3, heads, head_dim] — shard heads.
 
     ``stacked`` adapts each spec to the scan layout's leading layer dim,
-    so the same rules serve scan_layers=True and the unrolled tree.
+    so the same rules serve scan_layers=True and the unrolled tree. MoE
+    expert weights (when ``moe_experts > 0``) shard over ``ep`` with the
+    FFN hidden dim over ``tp``.
     """
     from pytorch_distributed_tpu.parallel.sharding import stacked
 
@@ -179,5 +200,7 @@ def gpt2_partition_rules():
         (r"mlp_up/kernel", stacked(P(None, "tp"))),
         (r"mlp_up/bias", stacked(P("tp"))),
         (r"mlp_down/kernel", stacked(P("tp", None))),
+        (r"moe/w_in", stacked(P("ep", None, "tp"))),
+        (r"moe/w_out", stacked(P("ep", "tp", None))),
         (r"wte/embedding", P(None, "tp")),
     ]

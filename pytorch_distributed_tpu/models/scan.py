@@ -56,8 +56,9 @@ def scan_stack(
     )
     mod = nn.scan(
         body,
-        # cache: per-layer KV decode caches stack [L, ...] like params
-        variable_axes={"params": 0, "cache": 0},
+        # cache: per-layer KV decode caches stack [L, ...] like params;
+        # intermediates: per-layer sown values (e.g. MoE aux losses)
+        variable_axes={"params": 0, "cache": 0, "intermediates": 0},
         split_rngs={"params": True, "dropout": True},
         in_axes=nn.broadcast,
         length=length if length is not None else cfg.num_layers,

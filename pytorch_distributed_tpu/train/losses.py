@@ -82,19 +82,35 @@ def classification_loss_fn(
 
 
 def causal_lm_loss_fn(
-    model, *, ids_key: str = "input_ids"
+    model, *, ids_key: str = "input_ids", moe_aux_weight: float = 0.0
 ) -> Callable:
     """Trainer-contract loss for decoder LMs: next-token CE (shift-by-one).
 
     Matches the reference's GPT-2 recipe loss (BASELINE.json:10). Also
     reports perplexity-ready mean token loss as the metric.
+
+    ``moe_aux_weight > 0`` collects the MoE load-balance auxiliary losses
+    sown by expert layers (ops/moe.py) and adds their weighted sum — set
+    it whenever the model has ``moe_experts > 0``.
     """
 
     def loss_fn(params, batch_stats, batch, rng):
         ids = batch[ids_key]
-        logits = model.apply(
-            {"params": params}, ids, train=True, rngs={"dropout": rng}
-        )
+        if moe_aux_weight > 0.0:
+            from pytorch_distributed_tpu.ops.moe import collect_aux_loss
+
+            logits, inter = model.apply(
+                {"params": params}, ids, train=True,
+                rngs={"dropout": rng}, mutable=["intermediates"],
+            )
+            aux = collect_aux_loss(
+                inter["intermediates"], weight=moe_aux_weight
+            )
+        else:
+            logits = model.apply(
+                {"params": params}, ids, train=True, rngs={"dropout": rng}
+            )
+            aux = None
         # predict token t+1 from prefix..t
         shift_logits = logits[:, :-1].astype(jnp.float32)
         shift_labels = ids[:, 1:]
@@ -103,8 +119,12 @@ def causal_lm_loss_fn(
                 shift_logits, shift_labels
             )
         )
+        metrics = {"loss": loss}
+        if aux is not None:
+            metrics["moe_aux_loss"] = aux
+            loss = loss + aux
         return loss, {
-            "metrics": {"loss": loss},
+            "metrics": metrics,
             "batch_stats": batch_stats,
         }
 

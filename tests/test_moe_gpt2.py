@@ -1,0 +1,98 @@
+"""MoE transformer end-to-end: GPT-2 with expert FFNs over the ep axis."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import pytorch_distributed_tpu as ptd
+from pytorch_distributed_tpu.models.gpt2 import (
+    GPT2Config,
+    GPT2LMHead,
+    gpt2_partition_rules,
+)
+from pytorch_distributed_tpu.parallel import DataParallel
+from pytorch_distributed_tpu.runtime.mesh import MeshSpec
+from pytorch_distributed_tpu.train import (
+    TrainState,
+    build_train_step,
+    causal_lm_loss_fn,
+)
+
+CFG = GPT2Config(
+    vocab_size=128, n_positions=32, hidden_size=32, num_layers=2,
+    num_heads=2, dropout_rate=0.0, moe_experts=4, moe_k=2,
+)
+
+
+def _init(B=8, S=16):
+    model = GPT2LMHead(CFG)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(CFG.vocab_size, size=(B, S)).astype(np.int32))
+    params = model.init(jax.random.key(0), ids[:1])["params"]
+    return model, params, ids
+
+
+def test_moe_gpt2_forward_shapes_and_params():
+    model, params, ids = _init()
+    # expert weights exist stacked [L, E, ...] in the scanned tree
+    w_in = params["blocks"]["block"]["moe"]["w_in"]
+    assert w_in.shape == (2, 4, 32, 128), w_in.shape
+    assert "mlp_up" not in params["blocks"]["block"]
+    logits = model.apply({"params": params}, ids)
+    assert logits.shape == (*ids.shape, CFG.vocab_size)
+
+
+def test_moe_gpt2_trains_with_aux_loss_on_ep_mesh():
+    ptd.init_process_group(mesh_spec=MeshSpec(dp=-1, ep=2, tp=2))
+    model, params, ids = _init()
+    state = TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.adam(1e-2)
+    )
+    strategy = DataParallel(extra_rules=gpt2_partition_rules())
+    state = strategy.place(state)
+    # experts genuinely sharded over ep (and FFN dim over tp)
+    spec = state.params["blocks"]["block"]["moe"]["w_in"].sharding.spec
+    assert "ep" in jax.tree_util.tree_leaves(tuple(spec)), spec
+    step = strategy.compile(
+        build_train_step(causal_lm_loss_fn(model, moe_aux_weight=0.01)),
+        state,
+    )
+    batch = strategy.shard_batch({"input_ids": np.asarray(ids)})
+    losses, aux = [], []
+    for _ in range(8):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+        aux.append(float(metrics["moe_aux_loss"]))
+    assert losses[-1] < losses[0] * 0.8, losses
+    # the load-balance penalty is present and order-1 x weight
+    assert 0 < aux[0] < 1.0, aux
+
+
+def test_moe_gpt2_decode_generates():
+    """KV-cache decode works through MoE blocks too.
+
+    Compared in the no-drop regime (ample capacity): with finite capacity,
+    routing depends on how many tokens share the call, so decode (1-token
+    steps) and full recompute legitimately diverge — see
+    GPT2Config.moe_capacity_factor.
+    """
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, moe_capacity_factor=8.0)
+    model = GPT2LMHead(cfg)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(cfg.vocab_size, size=(2, 6)).astype(np.int32))
+    params = model.init(jax.random.key(0), ids[:1])["params"]
+    out = ptd.generate(
+        model, params, ids, max_new_tokens=4, temperature=0.0
+    )
+    assert out.shape == (2, 10)
+    # matches the naive full-recompute greedy
+    cur = ids
+    for _ in range(4):
+        logits = model.apply({"params": params}, cur)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(cur.dtype)
+        cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
