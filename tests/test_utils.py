@@ -238,3 +238,18 @@ def test_optim_adamw_trains():
         updates, state = tx.update(g, state, params)
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
     assert float(loss(params)) < 0.2
+
+
+@pytest.mark.slow
+def test_bert_recipe_smoke_fp16_scaler():
+    """Recipe 3 end-to-end with the REAL fp16 dynamic loss scaling path
+    (the reference's amp.GradScaler texture, BASELINE.json:9)."""
+    import bert_finetune
+
+    state = bert_finetune.main(
+        [
+            "--tiny", "--fp16", "--epochs", "1", "--steps-per-epoch", "2",
+            "--batch-size", "8", "--seq-len", "16", "--log-every", "1",
+        ]
+    )
+    assert int(state.step) == 2
