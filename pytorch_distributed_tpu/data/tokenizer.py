@@ -104,7 +104,8 @@ class Tokenizer:
             raise RuntimeError("bpe_encode failed")
         return out[:m].copy()
 
-    def decode(self, ids) -> str:
+    def decode_bytes(self, ids) -> bytes:
+        """Exact inverse of ``encode`` on the byte level."""
         ids = np.ascontiguousarray(ids, np.int32)
         if np.any(ids < 0) or np.any(ids >= self.vocab_size):
             raise ValueError("token id out of range")
@@ -117,7 +118,10 @@ class Tokenizer:
         )
         if m < 0:
             raise RuntimeError("bpe_decode failed (bad id or overflow)")
-        return out[:m].tobytes().decode("utf-8", errors="replace")
+        return out[:m].tobytes()
+
+    def decode(self, ids) -> str:
+        return self.decode_bytes(ids).decode("utf-8", errors="replace")
 
     def save(self, path: str) -> None:
         np.savez(path if path.endswith(".npz") else path + ".npz",

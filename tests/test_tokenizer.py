@@ -150,3 +150,13 @@ def test_gpt2_recipe_trains_on_text_file(tmp_path):
         ]
     )
     assert int(state.step) >= 1
+
+
+def test_roundtrip_fuzz_random_bytes():
+    """decode_bytes(encode(x)) == x for arbitrary binary input."""
+    rng = np.random.default_rng(0)
+    train_bytes = rng.integers(0, 256, size=4000, dtype=np.uint8).tobytes()
+    tok = Tokenizer.train(train_bytes + CORPUS.encode(), vocab_size=384)
+    for n in (0, 1, 7, 257, 1024):
+        data = rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+        assert tok.decode_bytes(tok.encode(data)) == data
