@@ -109,6 +109,7 @@ def test_eos_pads_after_stop(gpt2):
     assert np.all(row[stop + 1:] == 0), row
 
 
+@pytest.mark.slow
 def test_generate_with_sharded_params(gpt2):
     """Inference under FSDP+TP sharding: same greedy tokens as replicated."""
     from pytorch_distributed_tpu.models.gpt2 import gpt2_partition_rules

@@ -40,6 +40,7 @@ class TestResNet:
         assert logits.shape == (4, 10)
         assert logits.dtype == jnp.float32  # policy output dtype
 
+    @pytest.mark.slow
     def test_params_f32_compute_bf16(self):
         model = ResNet18(num_classes=10, stem="cifar")
         v = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False)

@@ -34,6 +34,7 @@ def _init(B=8, S=16):
     return model, params, ids
 
 
+@pytest.mark.slow
 def test_moe_gpt2_forward_shapes_and_params():
     model, params, ids = _init()
     # expert weights exist stacked [L, E, ...] in the scanned tree
@@ -70,6 +71,7 @@ def test_moe_gpt2_trains_with_aux_loss_on_ep_mesh():
     assert 0 < aux[0] < 1.0, aux
 
 
+@pytest.mark.slow
 def test_moe_gpt2_decode_generates():
     """KV-cache decode works through MoE blocks too.
 

@@ -75,6 +75,7 @@ def test_pipelined_loss_matches_plain_loss():
     )
 
 
+@pytest.mark.slow
 def test_pipeline_parallel_strategy_trains_gpt2():
     """Strategy-compiled train step: blocks sharded over pp, loss decreases."""
     ptd.init_process_group(mesh_spec=MeshSpec(dp=-1, pp=2))

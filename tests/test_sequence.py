@@ -122,6 +122,7 @@ class TestModelTransparentSP:
             np.asarray(out), np.asarray(ref), rtol=0.08, atol=0.08
         )
 
+    @pytest.mark.slow
     def test_llama_forward_ulysses(self, rng):
         """Model-transparent ULYSSES: pins the dispatcher re-entrancy bug
         (r2: the inner attention recursed back into sequence-parallel mode
