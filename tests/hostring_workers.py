@@ -213,3 +213,68 @@ def facade_worker(rank: int, world: int, name: str, q) -> None:
         q.put((rank, "ok"))
     except Exception as e:  # pragma: no cover - reported via queue
         q.put((rank, f"{type(e).__name__}: {e}"))
+
+
+def multihost_worker(rank: int, world: int, port: int, q) -> None:
+    """REAL jax.distributed rendezvous: N controller processes, each with
+    one CPU device, forming a single global device world (the pod story
+    on DCN, minus the TPUs)."""
+    try:
+        import re
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        # each "host" must expose exactly ONE local device; scrub any
+        # inherited virtual-device-count flag (pytest's conftest sets 8)
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "",
+            os.environ.get("XLA_FLAGS", ""),
+        ).strip()
+        # a whitespace-only XLA_FLAGS FATALLY aborts XLA's flag parser
+        # (it treats non--- tokens as flag-file names) — drop it instead
+        if flags:
+            os.environ["XLA_FLAGS"] = flags
+        else:
+            os.environ.pop("XLA_FLAGS", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import pytorch_distributed_tpu as ptd
+        from pytorch_distributed_tpu.launch import init_multihost
+
+        init_multihost(
+            coordinator_address=f"localhost:{port}",
+            num_processes=world,
+            process_id=rank,
+        )
+        assert jax.process_count() == world, jax.process_count()
+        assert jax.device_count() == world, jax.device_count()
+        assert jax.local_device_count() == 1
+        assert ptd.get_rank() == rank
+
+        # a global computation over the pod-wide mesh: every process
+        # contributes its local shard, jit emits the cross-process psum
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        sharding = NamedSharding(mesh, P("dp"))
+        global_shape = (world, 4)
+        local = np.full((1, 4), float(rank + 1), np.float32)
+        arr = jax.make_array_from_single_device_arrays(
+            global_shape, sharding,
+            [jax.device_put(local, jax.local_devices()[0])],
+        )
+        total = jax.jit(
+            lambda x: jnp.sum(x, axis=0),
+            out_shardings=NamedSharding(mesh, P()),
+        )(arr)
+        want = sum(range(1, world + 1))
+        # replicated output: this process's addressable shard IS the value
+        got = np.asarray(total.addressable_shards[0].data)
+        assert np.all(got == want), (got, want)
+        jax.distributed.shutdown()
+        q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover - reported via queue
+        import traceback
+
+        q.put((rank, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
