@@ -158,8 +158,24 @@ class Strategy:
         return jax.jit(make_state_fn, out_shardings=shardings)(*args)
 
     def shard_batch(self, batch):
-        """Place a host batch on the mesh, dim 0 split over the data axes."""
-        return jax.device_put(batch, self.batch_sharding())
+        """Place a host batch on the mesh, dim 0 split over the data axes.
+
+        Single host: a plain sharded device_put. Multi-host (pod): each
+        controller passes its PROCESS-LOCAL slice of the global batch
+        (the DistributedSampler contract) and the global array is
+        assembled without any cross-host transfer —
+        ``jax.make_array_from_process_local_data`` validates that local
+        shapes tile the global shape.
+        """
+        sharding = self.batch_sharding()
+        if jax.process_count() > 1:
+            return jax.tree_util.tree_map(
+                lambda x: jax.make_array_from_process_local_data(
+                    sharding, np.asarray(x)
+                ),
+                batch,
+            )
+        return jax.device_put(batch, sharding)
 
     def compile(self, step_fn, state, *, donate: bool = True):
         """jit ``step_fn(state, batch) -> (state, metrics)`` with this

@@ -278,3 +278,88 @@ def multihost_worker(rank: int, world: int, port: int, q) -> None:
         import traceback
 
         q.put((rank, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
+
+
+def multihost_ddp_worker(rank: int, world: int, port: int, q) -> None:
+    """Pod-story DDP: each controller process ("host") feeds its local
+    slice of the global batch; training must stay in lockstep — the same
+    losses and bit-identical params on every host."""
+    try:
+        import re
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "",
+            os.environ.get("XLA_FLAGS", ""),
+        ).strip()
+        if flags:
+            os.environ["XLA_FLAGS"] = flags
+        else:
+            os.environ.pop("XLA_FLAGS", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import optax
+
+        import pytorch_distributed_tpu as ptd
+        from pytorch_distributed_tpu.launch import init_multihost
+        from pytorch_distributed_tpu.parallel import DataParallel
+        from pytorch_distributed_tpu.runtime.mesh import MeshSpec
+        from pytorch_distributed_tpu.train import (
+            TrainState,
+            build_train_step,
+        )
+
+        init_multihost(
+            coordinator_address=f"localhost:{port}",
+            num_processes=world,
+            process_id=rank,
+        )
+        ptd.init_process_group(mesh_spec=MeshSpec(dp=world))
+
+        def apply_fn(params, x):
+            return jnp.tanh(x @ params["w"]) @ params["v"]
+
+        params = {
+            "w": jnp.ones((4, 8)) * 0.1,
+            "v": jnp.ones((8, 2)) * 0.1,
+        }
+        state = TrainState.create(
+            apply_fn=apply_fn, params=params, tx=optax.sgd(0.1)
+        )
+        strategy = DataParallel()
+        state = strategy.place(state)
+
+        def step_fn(state, batch):
+            def loss_fn(p):
+                pred = state.apply_fn(p, batch["x"])
+                return jnp.mean((pred - batch["y"]) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            return state.apply_gradients(grads), {"loss": loss}
+
+        step = strategy.compile(step_fn, state)
+        rng = np.random.default_rng(0)  # same stream on all hosts
+        w_true = rng.normal(size=(4, 2)).astype(np.float32)
+        losses = []
+        for i in range(12):
+            gx = rng.normal(size=(8, 4)).astype(np.float32)
+            gy = (gx @ w_true).astype(np.float32)  # learnable target
+            # this host's slice of the global batch (sampler contract)
+            lo, hi = rank * 4, (rank + 1) * 4
+            batch = strategy.shard_batch({"x": gx[lo:hi], "y": gy[lo:hi]})
+            state, metrics = step(state, batch)
+            losses.append(
+                float(np.asarray(metrics["loss"].addressable_shards[0].data)
+                      if hasattr(metrics["loss"], "addressable_shards")
+                      else metrics["loss"])
+            )
+        w = np.asarray(state.params["w"].addressable_shards[0].data)
+        q.put((rank, "ok", losses, w.tobytes()))
+        jax.distributed.shutdown()
+    except Exception as e:  # pragma: no cover - reported via queue
+        import traceback
+
+        q.put((rank, f"{type(e).__name__}: {e}\n{traceback.format_exc()}",
+               None, None))
