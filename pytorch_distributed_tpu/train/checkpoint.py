@@ -96,10 +96,25 @@ def _snapshot(state: TrainState) -> list:
     """
     snap = []
     for name, leaf in _leaf_files(state):
-        shape = list(getattr(leaf, "shape", np.asarray(leaf).shape))
-        dtype = str(getattr(leaf, "dtype", None) or np.asarray(leaf).dtype)
-        snap.append((name, _shard_boxes(leaf), shape, dtype))
+        # NOTE: getattr defaults are evaluated eagerly — np.asarray(leaf)
+        # in the default slot would materialize EVERY leaf to host (and
+        # raise outright on pod-global arrays). Only touch np for leaves
+        # that genuinely lack shape/dtype (python scalars).
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            arr = np.asarray(leaf)
+            shape = arr.shape if shape is None else shape
+            dtype = arr.dtype if dtype is None else dtype
+        snap.append((name, _shard_boxes(leaf), list(shape), str(dtype)))
     return snap
+
+
+def _host_int(x) -> int:
+    """int() that works on pod-global (non-fully-addressable) arrays."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        return int(np.asarray(x.addressable_shards[0].data))
+    return int(x)
 
 
 def _write_files(tmp: str, snap: list, step: int) -> None:
@@ -203,7 +218,7 @@ def save_checkpoint(ckpt_dir: str, state: TrainState, *, tag: str = "latest") ->
     for backends where the state is fully replicated per process (the
     hostring path; the Trainer does this).
     """
-    return _save_sync(ckpt_dir, tag, _snapshot(state), int(state.step))
+    return _save_sync(ckpt_dir, tag, _snapshot(state), _host_int(state.step))
 
 
 class AsyncCheckpointer:
@@ -225,7 +240,7 @@ class AsyncCheckpointer:
         # Host snapshot happens on the caller's thread: after this, the
         # device arrays are free to be donated/updated by the next step.
         snap = _snapshot(state)
-        step = int(state.step)
+        step = _host_int(state.step)
         if jax.process_count() > 1:  # pragma: no cover - needs a real pod
             # Multi-host save needs cross-process barriers, which must run
             # on the main thread (they are device collectives and would
@@ -365,7 +380,10 @@ def restore_checkpoint(
             continue
         used.add(name)
         shape = tuple(entry["shape"])
-        tmpl_shape = tuple(getattr(tmpl, "shape", np.asarray(tmpl).shape))
+        tmpl_shape = getattr(tmpl, "shape", None)  # eager-default trap:
+        if tmpl_shape is None:  # np.asarray would gather/raise on globals
+            tmpl_shape = np.asarray(tmpl).shape
+        tmpl_shape = tuple(tmpl_shape)
         if shape != tmpl_shape:
             raise ValueError(
                 f"leaf {name}: checkpoint shape {shape} != state shape "

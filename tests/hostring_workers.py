@@ -363,3 +363,81 @@ def multihost_ddp_worker(rank: int, world: int, port: int, q) -> None:
 
         q.put((rank, f"{type(e).__name__}: {e}\n{traceback.format_exc()}",
                None, None))
+
+
+def multihost_ckpt_worker(rank: int, world: int, port: int, ckpt_dir: str,
+                          q) -> None:
+    """Pod-story checkpointing: every process writes ITS shards of the
+    dp-sharded state; process 0 merges manifests and commits; restore
+    reassembles each host's slice through make_array_from_callback."""
+    try:
+        import re
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "",
+            os.environ.get("XLA_FLAGS", ""),
+        ).strip()
+        if flags:
+            os.environ["XLA_FLAGS"] = flags
+        else:
+            os.environ.pop("XLA_FLAGS", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import optax
+
+        import pytorch_distributed_tpu as ptd
+        from pytorch_distributed_tpu.launch import init_multihost
+        from pytorch_distributed_tpu.parallel import FSDP
+        from pytorch_distributed_tpu.runtime.mesh import MeshSpec
+        from pytorch_distributed_tpu.train import TrainState
+        from pytorch_distributed_tpu.train.checkpoint import (
+            restore_checkpoint,
+            save_checkpoint,
+        )
+
+        init_multihost(
+            coordinator_address=f"localhost:{port}",
+            num_processes=world,
+            process_id=rank,
+        )
+        ptd.init_process_group(mesh_spec=MeshSpec(dp=world))
+
+        def make_state(fill):
+            params = {
+                "big": jnp.full((8, 6), fill, jnp.float32)
+                + jnp.arange(48.0).reshape(8, 6),
+                "small": jnp.full((3,), fill, jnp.float32),
+            }
+            return TrainState.create(
+                apply_fn=lambda p, x: x, params=params, tx=optax.sgd(0.1)
+            )
+
+        strategy = FSDP(axis="dp")
+        state = strategy.place(make_state(1.0))
+        save_checkpoint(ckpt_dir, state)
+
+        template = strategy.place(make_state(0.0))
+        restored = restore_checkpoint(
+            ckpt_dir, template, strategy.state_shardings(template)
+        )
+        for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(state.params),
+            jax.tree_util.tree_leaves_with_path(restored.params),
+        ):
+            ga = np.asarray(a.addressable_shards[0].data)
+            gb = np.asarray(b.addressable_shards[0].data)
+            assert np.array_equal(ga, gb), (pa, ga, gb)
+        # both processes' shard files landed in the committed dir
+        files = os.listdir(os.path.join(ckpt_dir, "latest"))
+        has_p = {p for p in range(world)
+                 if any(f".p{p}s" in f for f in files)}
+        q.put((rank, "ok", sorted(has_p)))
+        jax.distributed.shutdown()
+    except Exception as e:  # pragma: no cover - reported via queue
+        import traceback
+
+        q.put((rank, f"{type(e).__name__}: {e}\n{traceback.format_exc()}",
+               None))

@@ -215,3 +215,37 @@ def test_multihost_ddp_training_lockstep():
     assert losses0 == losses1, (losses0, losses1)
     assert w0 == w1  # bit-identical params across hosts
     assert losses0[-1] < losses0[0]  # and it actually learned
+
+
+@pytest.mark.slow
+def test_multihost_sharded_checkpoint_roundtrip(tmp_path):
+    """2-host checkpoint: each process writes its own dp-shard files,
+    process 0 merges+commits, restore reassembles per-host slices."""
+    import multiprocessing as mp
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(
+            target=hostring_workers.multihost_ckpt_worker,
+            args=(r, 2, port, str(tmp_path), q),
+        )
+        for r in range(2)
+    ]
+    for p in procs:
+        p.start()
+    try:
+        results = [q.get(timeout=240) for _ in range(2)]
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+    bad = [r for r in results if r[1] != "ok"]
+    assert not bad, bad
+    for _, _, procs_seen in results:
+        assert procs_seen == [0, 1], procs_seen  # BOTH hosts wrote shards
