@@ -92,9 +92,15 @@ class DataLoader:
         return len(self.sampler)
 
     def _rank_slice(self, indices: np.ndarray) -> np.ndarray:
-        """Under the multi-process (hostring) backend each rank fetches its
-        strided share of every global batch — the DistributedSampler
-        contract (BASELINE.json:5) without changing recipe code.
+        """Each rank fetches only its share of every global batch — the
+        DistributedSampler contract (BASELINE.json:5) without changing
+        recipe code. Two multi-rank worlds exist:
+
+        * hostring backend: strided share per OS process;
+        * SPMD multi-host (pod): a CONTIGUOUS block per controller process
+          (contiguous so the global sample order matches single-host; the
+          block becomes this process's device shards in
+          ``make_array_from_process_local_data``).
 
         A batch that doesn't divide by world_size (the ``drop_last=False``
         tail batch of an eval epoch) sheds its remainder so every rank
@@ -105,7 +111,19 @@ class DataLoader:
         if not self.shard:
             return indices
         ring = dist.multiprocess_ring()
-        if ring is None or ring.world_size == 1:
+        if ring is None:
+            if jax.process_count() > 1:
+                w, p = jax.process_count(), jax.process_index()
+                n = (len(indices) // w) * w
+                if n == 0:
+                    raise ValueError(
+                        f"batch of {len(indices)} cannot be split across "
+                        f"{w} processes"
+                    )
+                per = n // w
+                return indices[p * per:(p + 1) * per]
+            return indices
+        if ring.world_size == 1:
             return indices
         w, r = ring.world_size, ring.rank
         n = (len(indices) // w) * w
@@ -136,7 +154,17 @@ class DataLoader:
                 if self.transform is not None:
                     batch = self.transform(batch)
                 if self.sharding is not None:
-                    batch = jax.device_put(batch, self.sharding)
+                    if jax.process_count() > 1:
+                        # pod: this process holds only its slice; assemble
+                        # the global array from the local block
+                        batch = jax.tree_util.tree_map(
+                            lambda x: jax.make_array_from_process_local_data(
+                                self.sharding, np.asarray(x)
+                            ),
+                            batch,
+                        )
+                    else:
+                        batch = jax.device_put(batch, self.sharding)
                 out_q.put(batch)
             out_q.put(_SENTINEL)
         except BaseException as e:  # surface worker errors to the consumer

@@ -114,6 +114,20 @@ def enable_compilation_cache(
     return path
 
 
+def host_scalar(x) -> float:
+    """Fetch a scalar to host, pod-safe.
+
+    ``float(x)`` on a replicated array whose devices span processes raises
+    ("spans non-addressable devices"); the replicated value is present in
+    this process's addressable shard, so read it from there.
+    """
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        import numpy as np
+
+        return float(np.asarray(x.addressable_shards[0].data))
+    return float(x)
+
+
 def memory_stats() -> dict:
     """Per-device memory stats where the backend exposes them (TPU does)."""
     stats = {}

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pytorch_distributed_tpu.runtime import distributed as dist
+from pytorch_distributed_tpu.runtime.device import host_scalar
 from pytorch_distributed_tpu.runtime.precision import GradScaler
 from pytorch_distributed_tpu.runtime.prng import key_for
 from pytorch_distributed_tpu.train.train_state import TrainState
@@ -218,7 +219,7 @@ class Trainer:
         # state.step: it needs no device sync, and it is safe to read
         # from watchdog/test threads while state's buffers are donated
         # into the in-flight compiled step.
-        self.host_step = int(self.state.step)
+        self.host_step = int(host_scalar(self.state.step))
         self._first_epoch = 0
         self._resume_skip_batches = 0
         self._preemption = None
@@ -269,7 +270,7 @@ class Trainer:
             tag=tag,
         )
         steps_per_epoch = max(len(self.train_loader), 1)
-        step = int(self.state.step)
+        step = int(host_scalar(self.state.step))
         self.host_step = step
         self._first_epoch = step // steps_per_epoch
         # mid-epoch checkpoint: fast-forward past the batches this epoch
@@ -356,11 +357,11 @@ class Trainer:
                 # donated steps queued unsynced abort the XLA runtime.
                 # A value fetch (not block_until_ready, which the axon
                 # relay backend doesn't honor) drains the queue.
-                float(jax.tree_util.tree_leaves(metrics)[0])
+                host_scalar(jax.tree_util.tree_leaves(metrics)[0])
                 steps_since_sync = 0
             if cfg.log_every and step % cfg.log_every == 0:
                 # sync point: pull metrics (blocks on the step's result)
-                metrics = {k: float(v) for k, v in metrics.items()}
+                metrics = {k: host_scalar(v) for k, v in metrics.items()}
                 now = time.perf_counter()
                 dt = (now - t_last) / steps_since_log
                 t_last = now
@@ -393,7 +394,7 @@ class Trainer:
                 self._watchdog.tick()  # eval progress is progress
             n = self._batch_samples(batch)
             for k, v in metrics.items():
-                sums[k] = sums.get(k, 0.0) + float(v) * n
+                sums[k] = sums.get(k, 0.0) + host_scalar(v) * n
             count += n
         # multi-process mode: each rank saw 1/world of the eval set; sum
         # the weighted sums and counts over the ring so every rank reports

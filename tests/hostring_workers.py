@@ -441,3 +441,108 @@ def multihost_ckpt_worker(rank: int, world: int, port: int, ckpt_dir: str,
 
         q.put((rank, f"{type(e).__name__}: {e}\n{traceback.format_exc()}",
                None))
+
+
+def multihost_trainer_worker(rank: int, world: int, port: int, out_dir: str,
+                             q) -> None:
+    """The COMPLETE pod story through the stock stack: Trainer + DataLoader
+    (per-process batch slices), eval, JSONL metrics, checkpoint —
+    two controller processes, zero recipe-code changes."""
+    try:
+        import re
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "",
+            os.environ.get("XLA_FLAGS", ""),
+        ).strip()
+        if flags:
+            os.environ["XLA_FLAGS"] = flags
+        else:
+            os.environ.pop("XLA_FLAGS", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import optax
+
+        import pytorch_distributed_tpu as ptd
+        from pytorch_distributed_tpu.data import ArrayDataset, DataLoader
+        from pytorch_distributed_tpu.launch import init_multihost
+        from pytorch_distributed_tpu.parallel import DataParallel
+        from pytorch_distributed_tpu.runtime.mesh import MeshSpec
+        from pytorch_distributed_tpu.train import (
+            Trainer,
+            TrainerConfig,
+            TrainState,
+            build_train_step,
+        )
+
+        init_multihost(
+            coordinator_address=f"localhost:{port}",
+            num_processes=world,
+            process_id=rank,
+        )
+        ptd.init_process_group(mesh_spec=MeshSpec(dp=world))
+
+        import flax.linen as nn
+
+        class MLP(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=False):
+                return nn.Dense(2)(nn.tanh(nn.Dense(8)(x)))
+
+        model = MLP()
+        rng = np.random.default_rng(0)  # identical datasets on all hosts
+        w_true = rng.normal(size=(4, 2)).astype(np.float32)
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        ds = ArrayDataset(image=x, label=(x @ w_true).astype(np.float32))
+
+        def loss_fn(params, batch_stats, batch, _rng):
+            pred = model.apply({"params": params}, batch["image"])
+            loss = jnp.mean((pred - batch["label"]) ** 2)
+            return loss, {"metrics": {"loss": loss}, "batch_stats": batch_stats}
+
+        state = TrainState.create(
+            apply_fn=model.apply,
+            params=model.init(jax.random.key(0), x[:1])["params"],
+            tx=optax.adam(1e-2),
+        )
+        strategy = DataParallel()
+
+        def eval_step(state, batch):
+            pred = model.apply({"params": state.params}, batch["image"])
+            return {"loss": jnp.mean((pred - batch["label"]) ** 2)}
+
+        trainer = Trainer(
+            state,
+            strategy,
+            build_train_step(loss_fn),
+            DataLoader(ds, 16, seed=3, sharding=strategy.batch_sharding()),
+            eval_step=eval_step,
+            eval_loader=DataLoader(
+                ds, 16, shuffle=False, sharding=strategy.batch_sharding()
+            ),
+            config=TrainerConfig(
+                epochs=8, log_every=2, handle_preemption=False,
+                ckpt_dir=os.path.join(out_dir, "ckpt"),
+                metrics_path=(
+                    os.path.join(out_dir, f"metrics-p{rank}.jsonl")
+                ),
+            ),
+        )
+        final = trainer.fit()
+        from pytorch_distributed_tpu.runtime.device import host_scalar
+
+        w = np.asarray(
+            jax.tree_util.tree_leaves(final.params)[0]
+            .addressable_shards[0].data
+        )
+        q.put((rank, "ok", trainer.last_eval_metrics["loss"],
+               int(trainer.host_step), w.tobytes()))
+        jax.distributed.shutdown()
+    except Exception as e:  # pragma: no cover - reported via queue
+        import traceback
+
+        q.put((rank, f"{type(e).__name__}: {e}\n{traceback.format_exc()}",
+               None, None, None))
