@@ -114,34 +114,34 @@ class DataLoader:
         if ring is None:
             if jax.process_count() > 1:
                 w, p = jax.process_count(), jax.process_index()
-                n = (len(indices) // w) * w
-                if n == 0:
-                    raise ValueError(
-                        f"batch of {len(indices)} cannot be split across "
-                        f"{w} processes"
-                    )
+                n = self._sheddable_count(len(indices), w)
                 per = n // w
                 return indices[p * per:(p + 1) * per]
             return indices
         if ring.world_size == 1:
             return indices
         w, r = ring.world_size, ring.rank
-        n = (len(indices) // w) * w
+        n = self._sheddable_count(len(indices), w)
+        return indices[r:n:w]
+
+    def _sheddable_count(self, count: int, world: int) -> int:
+        """Largest multiple of ``world`` <= count; warns once on a shed."""
+        n = (count // world) * world
         if n == 0:
             raise ValueError(
-                f"batch of {len(indices)} cannot be split across "
-                f"world_size {w} ranks; use a batch size >= the rank count"
+                f"batch of {count} cannot be split across "
+                f"world_size {world} ranks; use a batch size >= the rank count"
             )
-        if n != len(indices) and not self._warned_remainder:
+        if n != count and not self._warned_remainder:
             self._warned_remainder = True
             import logging
 
             logging.getLogger(__name__).warning(
                 "batch of %d not divisible by world_size %d — dropping %d "
                 "sample(s) per such batch to keep ranks in lockstep",
-                len(indices), w, len(indices) - n,
+                count, world, count - n,
             )
-        return indices[r:n:w]
+        return n
 
     def _produce(self, out_q: queue.Queue, stop: threading.Event) -> None:
         try:
@@ -154,17 +154,20 @@ class DataLoader:
                 if self.transform is not None:
                     batch = self.transform(batch)
                 if self.sharding is not None:
-                    if jax.process_count() > 1:
-                        # pod: this process holds only its slice; assemble
-                        # the global array from the local block
-                        batch = jax.tree_util.tree_map(
-                            lambda x: jax.make_array_from_process_local_data(
-                                self.sharding, np.asarray(x)
-                            ),
-                            batch,
-                        )
-                    else:
-                        batch = jax.device_put(batch, self.sharding)
+                    from pytorch_distributed_tpu.parallel.sharding import (
+                        place_global_batch,
+                    )
+
+                    # on a pod the fetched batch is this process's LOCAL
+                    # block iff somebody rank-sliced it (this loader or a
+                    # rank-aware sampler); otherwise it is the full global
+                    # batch and must be deduplicated by the helper
+                    batch = place_global_batch(
+                        self.sharding,
+                        batch,
+                        local=self.shard
+                        or hasattr(self.sampler, "num_replicas"),
+                    )
                 out_q.put(batch)
             out_q.put(_SENTINEL)
         except BaseException as e:  # surface worker errors to the consumer

@@ -149,3 +149,33 @@ def infer_tree_shardings(tree, rules: PartitionRules, mesh: Optional[Mesh] = Non
 
 
 REPLICATED = PartitionRules([(".*", None)])
+
+
+def place_global_batch(sharding: NamedSharding, batch, *, local: bool = True):
+    """Host batch pytree -> jax Arrays placed under ``sharding``.
+
+    Single process: a plain sharded ``device_put``. Multi-process (pod),
+    where no process can address every device:
+
+    * ``local=True`` — each controller passes its PROCESS-LOCAL contiguous
+      block of the global batch (the DistributedSampler contract);
+      assembled with ``make_array_from_process_local_data``, which
+      validates the blocks tile the global shape. No cross-host transfer.
+    * ``local=False`` — every controller passes the FULL global batch;
+      the global array is built by slicing this process's full copy per
+      device. (Feeding a full copy through the ``local`` path would
+      silently concatenate the copies into a world-times-duplicated
+      batch — the one-true-helper exists so every caller gets this right.)
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+
+    def place(x):
+        x = np.asarray(x)
+        if local:
+            return jax.make_array_from_process_local_data(sharding, x)
+        return jax.make_array_from_callback(
+            x.shape, sharding, lambda idx: x[idx]
+        )
+
+    return jax.tree_util.tree_map(place, batch)

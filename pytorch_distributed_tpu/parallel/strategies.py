@@ -36,6 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from pytorch_distributed_tpu.parallel.sharding import (
     PartitionRules,
     infer_tree_shardings,
+    place_global_batch,
     shard_along,
 )
 from pytorch_distributed_tpu.runtime.mesh import current_mesh, data_axes
@@ -167,15 +168,7 @@ class Strategy:
         ``jax.make_array_from_process_local_data`` validates that local
         shapes tile the global shape.
         """
-        sharding = self.batch_sharding()
-        if jax.process_count() > 1:
-            return jax.tree_util.tree_map(
-                lambda x: jax.make_array_from_process_local_data(
-                    sharding, np.asarray(x)
-                ),
-                batch,
-            )
-        return jax.device_put(batch, sharding)
+        return place_global_batch(self.batch_sharding(), batch, local=True)
 
     def compile(self, step_fn, state, *, donate: bool = True):
         """jit ``step_fn(state, batch) -> (state, metrics)`` with this

@@ -112,9 +112,9 @@ def _snapshot(state: TrainState) -> list:
 
 def _host_int(x) -> int:
     """int() that works on pod-global (non-fully-addressable) arrays."""
-    if isinstance(x, jax.Array) and not x.is_fully_addressable:
-        return int(np.asarray(x.addressable_shards[0].data))
-    return int(x)
+    from pytorch_distributed_tpu.runtime.device import host_scalar
+
+    return int(host_scalar(x))
 
 
 def _write_files(tmp: str, snap: list, step: int) -> None:

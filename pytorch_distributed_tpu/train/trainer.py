@@ -366,7 +366,7 @@ class Trainer:
                 dt = (now - t_last) / steps_since_log
                 t_last = now
                 steps_since_log = 0
-                steps_since_sync = 0  # the float()s above just synced
+                steps_since_sync = 0  # the host_scalar()s above just synced
                 self.meter.update(MeterState(step_time=dt, samples_per_sec=n / dt))
                 logger.info(
                     "epoch %d step %d %s %.1f samples/s (%.1f ms/step)",

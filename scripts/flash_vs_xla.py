@@ -1,0 +1,124 @@
+"""Flash-vs-XLA attention timing on the real chip (decides the dispatcher
+default — ops/attention.py keeps flash opt-in until it demonstrably wins).
+
+Times fwd and fwd+bwd for both paths at increasing sequence lengths,
+chaining iterations inside one jitted lax.scan so the axon relay's
+per-dispatch RTT amortizes away. Run ON THE CHIP ONLY.
+
+IMPORTANT: never kill this process externally mid-compile — a killed
+relay client wedges the chip lease for everyone (observed r2, BASELINE.md).
+It budgets its own wall clock instead: once BUDGET_S is spent, remaining
+shapes are skipped and it exits cleanly after the in-flight compile.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+t0 = time.time()
+BUDGET_S = float(os.environ.get("PTD_PROBE_BUDGET_S", "900"))
+
+
+def log(msg):
+    print(f"[{time.time() - t0:8.1f}s] {msg}", flush=True)
+
+
+def over_budget() -> bool:
+    return time.time() - t0 > BUDGET_S
+
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import pytorch_distributed_tpu as ptd
+from pytorch_distributed_tpu.ops.attention import dot_product_attention
+from pytorch_distributed_tpu.ops.flash_attention import flash_attention
+
+ITERS = 20
+SHAPES = [  # (B, S, H, D)
+    (8, 1024, 16, 64),   # GPT-2-medium bench shape
+    (4, 2048, 16, 64),
+    (2, 4096, 16, 64),
+    (1, 8192, 16, 64),   # long-context: XLA materializes S^2 here
+]
+
+
+def timed(fn, q, k, v, label, flops):
+    """Run fn ITERS times inside one scan; fetch one scalar at the end."""
+
+    @jax.jit
+    def loop(q, k, v):
+        def body(carry, _):
+            o = fn(q + carry, k, v)
+            # feed a scalar of the output back in so iterations chain
+            return o[0, 0, 0, 0].astype(jnp.bfloat16) * 0, o
+        carry, outs = jax.lax.scan(
+            body, jnp.bfloat16(0), None, length=ITERS
+        )
+        return outs[-1]
+
+    t = time.time()
+    out = loop(q, k, v)
+    float(out.astype(jnp.float32)[0, 0, 0, 0])
+    compile_s = time.time() - t
+    t = time.time()
+    out = loop(q, k, v)
+    float(out.astype(jnp.float32)[0, 0, 0, 0])
+    dt = (time.time() - t) / ITERS
+    log(f"  {label:10s} {dt * 1e3:7.2f}ms/iter  ~{flops / dt / 1e12:5.1f} "
+        f"TFLOP/s  (compile {compile_s:.1f}s)")
+    return dt
+
+
+def grad_of(fn):
+    def loss(q, k, v):
+        return fn(q, k, v).astype(jnp.float32).sum()
+
+    g = jax.grad(loss, argnums=(0, 1, 2))
+
+    def fwdbwd(q, k, v):
+        dq, dk, dv = g(q, k, v)
+        return dq  # same rank as fwd out for the chaining scalar
+
+    return fwdbwd
+
+
+def main():
+    ptd.enable_compilation_cache()
+    log(f"platform={ptd.platform()} kind={jax.devices()[0].device_kind}")
+    xla = lambda q, k, v: dot_product_attention(q, k, v, causal=True)
+    fla = lambda q, k, v: flash_attention(q, k, v, causal=True)
+    for B, S, H, D in SHAPES:
+        if over_budget():
+            log(f"budget {BUDGET_S:.0f}s spent — skipping remaining shapes")
+            break
+        rng = np.random.default_rng(0)
+        q, k, v = (
+            jnp.asarray(rng.normal(size=(B, S, H, D)).astype(np.float32))
+            .astype(jnp.bfloat16)
+            for _ in range(3)
+        )
+        fwd_flops = 4 * B * H * S * S * D / 2  # causal
+        bwd_flops = fwd_flops * 3.5  # fwd recompute + dq,dk,dv
+        log(f"--- B={B} S={S} H={H} D={D}")
+        for label, fn, flops in (
+            ("xla fwd", xla, fwd_flops),
+            ("flash fwd", fla, fwd_flops),
+            ("xla bwd", grad_of(xla), bwd_flops),
+            ("flash bwd", grad_of(fla), bwd_flops),
+        ):
+            if over_budget():
+                log(f"budget {BUDGET_S:.0f}s spent — skipping {label}")
+                continue
+            try:
+                timed(fn, q, k, v, label, flops)
+            except Exception as e:
+                log(f"  {label} FAILED: {type(e).__name__}: {e}")
+    log("DONE")
+
+
+if __name__ == "__main__":
+    main()

@@ -215,29 +215,39 @@ def facade_worker(rank: int, world: int, name: str, q) -> None:
         q.put((rank, f"{type(e).__name__}: {e}"))
 
 
+def _single_cpu_device_bootstrap():
+    """Pin this process to ONE CPU device, before jax's first use.
+
+    Every multihost worker needs the same dance: each "host" must expose
+    exactly one local device, so scrub any inherited virtual-device-count
+    flag (pytest's conftest sets 8) and force the cpu platform. Returns
+    the configured jax module.
+    """
+    import re
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        os.environ.get("XLA_FLAGS", ""),
+    ).strip()
+    # a whitespace-only XLA_FLAGS FATALLY aborts XLA's flag parser
+    # (it treats non--- tokens as flag-file names) — drop it instead
+    if flags:
+        os.environ["XLA_FLAGS"] = flags
+    else:
+        os.environ.pop("XLA_FLAGS", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return jax
+
+
 def multihost_worker(rank: int, world: int, port: int, q) -> None:
     """REAL jax.distributed rendezvous: N controller processes, each with
     one CPU device, forming a single global device world (the pod story
     on DCN, minus the TPUs)."""
     try:
-        import re
-
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        # each "host" must expose exactly ONE local device; scrub any
-        # inherited virtual-device-count flag (pytest's conftest sets 8)
-        flags = re.sub(
-            r"--xla_force_host_platform_device_count=\d+", "",
-            os.environ.get("XLA_FLAGS", ""),
-        ).strip()
-        # a whitespace-only XLA_FLAGS FATALLY aborts XLA's flag parser
-        # (it treats non--- tokens as flag-file names) — drop it instead
-        if flags:
-            os.environ["XLA_FLAGS"] = flags
-        else:
-            os.environ.pop("XLA_FLAGS", None)
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+        jax = _single_cpu_device_bootstrap()
         import pytorch_distributed_tpu as ptd
         from pytorch_distributed_tpu.launch import init_multihost
 
@@ -272,6 +282,29 @@ def multihost_worker(rank: int, world: int, port: int, q) -> None:
         # replicated output: this process's addressable shard IS the value
         got = np.asarray(total.addressable_shards[0].data)
         assert np.all(got == want), (got, want)
+
+        # DataLoader pod assembly: shard=True fetches only this process's
+        # contiguous block; shard=False fetches the FULL batch on every
+        # process and must still yield the correct (not duplicated) global
+        # batch. Either way this process's device shard of the global
+        # array must be rows [rank*per:(rank+1)*per] of the global batch.
+        from pytorch_distributed_tpu.data import ArrayDataset, DataLoader
+
+        n, batch = 8, 4
+        ds = ArrayDataset(x=np.arange(n * 3, dtype=np.float32).reshape(n, 3))
+        for shard in (True, False):
+            loader = DataLoader(
+                ds, batch, shuffle=False, sharding=sharding, shard=shard,
+            )
+            b = next(iter(loader))["x"]
+            assert b.shape == (batch, 3), (shard, b.shape)
+            per = batch // world
+            mine = np.asarray(b.addressable_shards[0].data)
+            expect = np.arange(n * 3, dtype=np.float32).reshape(n, 3)[
+                rank * per:(rank + 1) * per
+            ]
+            assert np.array_equal(mine, expect), (shard, mine, expect)
+
         jax.distributed.shutdown()
         q.put((rank, "ok"))
     except Exception as e:  # pragma: no cover - reported via queue
@@ -285,20 +318,7 @@ def multihost_ddp_worker(rank: int, world: int, port: int, q) -> None:
     slice of the global batch; training must stay in lockstep — the same
     losses and bit-identical params on every host."""
     try:
-        import re
-
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        flags = re.sub(
-            r"--xla_force_host_platform_device_count=\d+", "",
-            os.environ.get("XLA_FLAGS", ""),
-        ).strip()
-        if flags:
-            os.environ["XLA_FLAGS"] = flags
-        else:
-            os.environ.pop("XLA_FLAGS", None)
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+        jax = _single_cpu_device_bootstrap()
         import jax.numpy as jnp
         import optax
 
@@ -371,20 +391,7 @@ def multihost_ckpt_worker(rank: int, world: int, port: int, ckpt_dir: str,
     dp-sharded state; process 0 merges manifests and commits; restore
     reassembles each host's slice through make_array_from_callback."""
     try:
-        import re
-
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        flags = re.sub(
-            r"--xla_force_host_platform_device_count=\d+", "",
-            os.environ.get("XLA_FLAGS", ""),
-        ).strip()
-        if flags:
-            os.environ["XLA_FLAGS"] = flags
-        else:
-            os.environ.pop("XLA_FLAGS", None)
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+        jax = _single_cpu_device_bootstrap()
         import jax.numpy as jnp
         import optax
 
@@ -449,20 +456,7 @@ def multihost_trainer_worker(rank: int, world: int, port: int, out_dir: str,
     (per-process batch slices), eval, JSONL metrics, checkpoint —
     two controller processes, zero recipe-code changes."""
     try:
-        import re
-
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        flags = re.sub(
-            r"--xla_force_host_platform_device_count=\d+", "",
-            os.environ.get("XLA_FLAGS", ""),
-        ).strip()
-        if flags:
-            os.environ["XLA_FLAGS"] = flags
-        else:
-            os.environ.pop("XLA_FLAGS", None)
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+        jax = _single_cpu_device_bootstrap()
         import jax.numpy as jnp
         import optax
 
@@ -532,8 +526,6 @@ def multihost_trainer_worker(rank: int, world: int, port: int, out_dir: str,
             ),
         )
         final = trainer.fit()
-        from pytorch_distributed_tpu.runtime.device import host_scalar
-
         w = np.asarray(
             jax.tree_util.tree_leaves(final.params)[0]
             .addressable_shards[0].data
