@@ -46,9 +46,11 @@ from pytorch_distributed_tpu.runtime.distributed import (
     get_backend,
     all_reduce,
     all_gather,
+    all_gather_object,
     all_to_all,
     reduce_scatter,
     broadcast,
+    broadcast_object_list,
     barrier,
     gather,
     scatter,
@@ -90,9 +92,11 @@ __all__ = [
     "get_backend",
     "all_reduce",
     "all_gather",
+    "all_gather_object",
     "all_to_all",
     "reduce_scatter",
     "broadcast",
+    "broadcast_object_list",
     "barrier",
     "gather",
     "scatter",

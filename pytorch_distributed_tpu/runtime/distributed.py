@@ -489,3 +489,103 @@ def barrier() -> None:
     x = jnp.ones((n,), jnp.int32)
     out = all_reduce(x.reshape(n, 1), ReduceOp.SUM)
     jax.block_until_ready(out)
+
+
+# --------------------------------------------------------------------------
+# Object collectives (torch.distributed.all_gather_object /
+# broadcast_object_list). Objects live on HOSTS, so the participant set is
+# the PROCESS world, not the device mesh: hostring ranks, pod controllers,
+# or the single controller (for which these are identities — there is one
+# process, so its object list is already "every process's objects").
+# --------------------------------------------------------------------------
+
+
+def _pickle_bytes(obj) -> np.ndarray:
+    import pickle
+
+    return np.frombuffer(
+        pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL), dtype=np.uint8
+    )
+
+
+def _unpickle(buf: np.ndarray):
+    import pickle
+
+    return pickle.loads(buf.tobytes())
+
+
+def all_gather_object(obj) -> list:
+    """Gather one picklable object per process; returns the rank-ordered list.
+
+    Two-phase exchange (lengths, then max-padded payloads) so ranks may
+    contribute different-sized objects.
+    """
+    g = _group()
+    payload = _pickle_bytes(obj)
+    if g.ring is not None:
+        w = g.ring.world_size
+        lens = g.ring.all_gather(np.array([len(payload)], np.int64))
+        lens = np.asarray(lens).reshape(w)
+        buf = np.zeros(int(lens.max()), np.uint8)
+        buf[: len(payload)] = payload
+        rows = np.asarray(g.ring.all_gather(buf)).reshape(w, -1)
+        return [_unpickle(rows[r, : int(lens[r])]) for r in range(w)]
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        lens = np.asarray(
+            multihost_utils.process_allgather(
+                np.array([len(payload)], np.int64)
+            )
+        ).reshape(jax.process_count())
+        buf = np.zeros(int(lens.max()), np.uint8)
+        buf[: len(payload)] = payload
+        rows = np.asarray(multihost_utils.process_allgather(buf)).reshape(
+            jax.process_count(), -1
+        )
+        return [_unpickle(rows[r, : int(lens[r])]) for r in range(len(lens))]
+    return [obj]
+
+
+def _process_world_size(g) -> int:
+    if g.ring is not None:
+        return g.ring.world_size
+    return jax.process_count()
+
+
+def broadcast_object_list(objs: list, src: int = 0) -> list:
+    """Replace every element with process ``src``'s list (torch semantics,
+    but returned rather than mutated in place)."""
+    g = _group()
+    world = _process_world_size(g)
+    if not 0 <= src < world:
+        raise ValueError(
+            f"src {src} out of range for {world}-process world"
+        )
+    if g.ring is not None:
+        payload = _pickle_bytes(objs)
+        n = g.ring.broadcast(np.array([len(payload)], np.int64), src=src)
+        buf = np.zeros(int(np.asarray(n)[0]), np.uint8)
+        buf[: len(payload)] = payload[: len(buf)]
+        out = g.ring.broadcast(buf, src=src)
+        return _unpickle(np.asarray(out))
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        # broadcast_one_to_all ships process 0's value; for src != 0 route
+        # through an allgather and pick the source's row
+        if src == 0:
+            payload = _pickle_bytes(objs)
+            n = int(
+                np.asarray(
+                    multihost_utils.broadcast_one_to_all(
+                        np.array([len(payload)], np.int64)
+                    )
+                )[0]
+            )
+            buf = np.zeros(n, np.uint8)
+            buf[: len(payload)] = payload[:n]
+            out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+            return _unpickle(out)
+        return all_gather_object(objs)[src]
+    return list(objs)

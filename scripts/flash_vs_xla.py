@@ -51,14 +51,14 @@ def timed(fn, q, k, v, label, flops):
 
     @jax.jit
     def loop(q, k, v):
-        def body(carry, _):
-            o = fn(q + carry, k, v)
-            # feed a scalar of the output back in so iterations chain
-            return o[0, 0, 0, 0].astype(jnp.bfloat16) * 0, o
-        carry, outs = jax.lax.scan(
-            body, jnp.bfloat16(0), None, length=ITERS
-        )
-        return outs[-1]
+        # carry the output (not a stacked history) so the timed loop holds
+        # one buffer; feed a scalar back into q so iterations chain
+        def body(o, _):
+            o = fn(q + o[0, 0, 0, 0].astype(jnp.bfloat16) * 0, k, v)
+            return o, None
+        o0 = jnp.zeros_like(q)
+        o, _ = jax.lax.scan(body, o0, None, length=ITERS)
+        return o
 
     t = time.time()
     out = loop(q, k, v)
@@ -81,7 +81,11 @@ def grad_of(fn):
 
     def fwdbwd(q, k, v):
         dq, dk, dv = g(q, k, v)
-        return dq  # same rank as fwd out for the chaining scalar
+        # keep ALL THREE grads live: returning dq alone lets XLA
+        # dead-code-eliminate the dk/dv backward matmuls, which would time
+        # ~1/3 of a real backward for the XLA path while the fused flash
+        # VJP kernel can't be partially eliminated — biasing the decision
+        return dq + (dk.sum() + dv.sum()).astype(dq.dtype)
 
     return fwdbwd
 

@@ -208,6 +208,12 @@ def facade_worker(rank: int, world: int, name: str, q) -> None:
         assert list(np.asarray(g).ravel()) == list(range(world))
         b = ptd.broadcast(np.array([rank * 10.0], np.float32), src=2)
         assert float(np.asarray(b)[0]) == 20.0
+        # object collectives: variable-size payloads per rank
+        objs = ptd.all_gather_object({"rank": rank, "pad": "x" * (rank * 37)})
+        assert [o["rank"] for o in objs] == list(range(world)), objs
+        assert all(len(o["pad"]) == r * 37 for r, o in enumerate(objs))
+        got = ptd.broadcast_object_list(["from", rank], src=1)
+        assert got == ["from", 1], got
         ptd.barrier()
         ptd.destroy_process_group()
         q.put((rank, "ok"))
@@ -282,6 +288,12 @@ def multihost_worker(rank: int, world: int, port: int, q) -> None:
         # replicated output: this process's addressable shard IS the value
         got = np.asarray(total.addressable_shards[0].data)
         assert np.all(got == want), (got, want)
+
+        # object collectives over the pod (process_allgather transport)
+        objs = ptd.all_gather_object({"proc": rank, "pad": "y" * (rank * 13)})
+        assert [o["proc"] for o in objs] == list(range(world)), objs
+        got = ptd.broadcast_object_list([rank, "meta"], src=0)
+        assert got == [0, "meta"], got
 
         # DataLoader pod assembly: shard=True fetches only this process's
         # contiguous block; shard=False fetches the FULL batch on every

@@ -156,6 +156,17 @@ class TestProcessGroupFacade:
         out = ptd.all_reduce(x, axis="dp")
         np.testing.assert_allclose(np.asarray(out), [6.0])
 
+    def test_object_collectives_single_controller(self):
+        # one process drives the whole mesh, so the process world is 1:
+        # all_gather_object returns this process's object alone and
+        # broadcast is the identity
+        ptd.init_process_group()
+        obj = {"step": 7, "name": "rn50"}
+        assert ptd.all_gather_object(obj) == [obj]
+        assert ptd.broadcast_object_list([obj, 3], src=0) == [obj, 3]
+        with pytest.raises(ValueError):
+            ptd.broadcast_object_list([1], src=2)
+
 
 class TestPrecision:
     def test_default_policy(self):
