@@ -50,7 +50,7 @@ def _emit(obj, primary=False):
     sys.stderr.flush()
 
 
-def _resnet50_train_setup(image: int):
+def _resnet50_train_setup(image: int, stem: str = "imagenet"):
     """(strategy, compiled step, placed state) for the ResNet-50 benches."""
     from pytorch_distributed_tpu.models import ResNet50
     from pytorch_distributed_tpu.parallel import DataParallel
@@ -60,7 +60,7 @@ def _resnet50_train_setup(image: int):
         classification_loss_fn,
     )
 
-    model = ResNet50(num_classes=1000)
+    model = ResNet50(num_classes=1000, stem=stem)
     variables = model.init(
         jax.random.key(0), jnp.zeros((1, image, image, 3)), train=False
     )

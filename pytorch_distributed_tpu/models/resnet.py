@@ -11,6 +11,13 @@ TPU-first choices:
   stats are the SPMD-natural equivalent and match or beat its accuracy.
 * CIFAR stem (3x3, no maxpool) vs ImageNet stem (7x7/2 + maxpool) selected
   by ``stem``.
+* ``stem="s2d"`` — the MLPerf-style space-to-depth stem: the 7x7/2 conv
+  on 3-channel input keeps only 3 of the (padded) minor-dim lanes busy on
+  the MXU; rearranging 2x2 pixel blocks into channels first
+  ([N,224,224,3] -> [N,112,112,12]) and convolving 4x4/1 over 12 channels
+  computes a function space that CONTAINS the original conv (pad the 7x7
+  kernel to 8 taps with one zero row/col and reshuffle — see
+  ``s2d_stem_kernel_from_conv7``) with 4x the lane utilization.
 """
 
 from __future__ import annotations
@@ -24,6 +31,38 @@ import jax.numpy as jnp
 from pytorch_distributed_tpu.runtime.precision import current_policy
 
 ModuleDef = Any
+
+
+def space_to_depth(x, block: int):
+    """[N, H, W, C] -> [N, H/b, W/b, b*b*C]; channel index = (di*b+dj)*C+c."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    #                 i     di      j      dj      c  ->  i j (di dj c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // block, w // block, block * block * c)
+
+
+def s2d_stem_kernel_from_conv7(k7):
+    """Rewrite a [7,7,C,F] stride-2 conv kernel as the exactly-equivalent
+    [4,4,4*C,F] kernel over space_to_depth(x, 2) input.
+
+    Original tap offset u in [-3,3] maps to (du, di) with u = 2*du + di - 4
+    (du in [0,4), di in {0,1}); the u=-4 tap is identically zero. Proof of
+    equivalence is the unit test ``test_s2d_stem_exactly_matches_conv7``.
+    """
+    import numpy as np
+
+    k7 = np.asarray(k7)
+    c, f = k7.shape[2], k7.shape[3]
+    out = np.zeros((4, 4, 4 * c, f), k7.dtype)
+    for u in range(-3, 4):
+        du, di = (u + 4) // 2, (u + 4) % 2
+        for v in range(-3, 4):
+            dv, dj = (v + 4) // 2, (v + 4) % 2
+            out[du, dv, (di * 2 + dj) * c:(di * 2 + dj + 1) * c, :] = k7[
+                u + 3, v + 3
+            ]
+    return out
 
 
 class BasicBlock(nn.Module):
@@ -110,6 +149,17 @@ class ResNet(nn.Module):
         x = x.astype(dtype)
         if self.stem == "imagenet":
             x = conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="stem")(x)
+            x = norm(name="stem_bn")(x)
+            x = act(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        elif self.stem == "s2d":
+            x = space_to_depth(x, 2)
+            # 4x4/1 over the 2x-downsampled grid == 8-tap/2 over pixels;
+            # pad (2,1) puts the zero eighth tap at original offset -4
+            x = conv(
+                self.width, (4, 4), (1, 1), padding=[(2, 1), (2, 1)],
+                name="stem",
+            )(x)
             x = norm(name="stem_bn")(x)
             x = act(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

@@ -514,36 +514,35 @@ def _unpickle(buf: np.ndarray):
     return pickle.loads(buf.tobytes())
 
 
+def _gather_padded(gather_fn, world: int, payload: np.ndarray) -> list:
+    """Two-phase variable-size object gather over a fixed-size transport:
+    gather lengths, max-pad payloads, gather, unpickle each row."""
+    lens = np.asarray(gather_fn(np.array([len(payload)], np.int64)))
+    lens = lens.reshape(world)
+    buf = np.zeros(int(lens.max()), np.uint8)
+    buf[: len(payload)] = payload
+    rows = np.asarray(gather_fn(buf)).reshape(world, -1)
+    return [_unpickle(rows[r, : int(lens[r])]) for r in range(world)]
+
+
 def all_gather_object(obj) -> list:
     """Gather one picklable object per process; returns the rank-ordered list.
 
-    Two-phase exchange (lengths, then max-padded payloads) so ranks may
-    contribute different-sized objects.
+    Ranks may contribute different-sized (or different-typed) objects.
     """
     g = _group()
-    payload = _pickle_bytes(obj)
     if g.ring is not None:
-        w = g.ring.world_size
-        lens = g.ring.all_gather(np.array([len(payload)], np.int64))
-        lens = np.asarray(lens).reshape(w)
-        buf = np.zeros(int(lens.max()), np.uint8)
-        buf[: len(payload)] = payload
-        rows = np.asarray(g.ring.all_gather(buf)).reshape(w, -1)
-        return [_unpickle(rows[r, : int(lens[r])]) for r in range(w)]
+        return _gather_padded(
+            g.ring.all_gather, g.ring.world_size, _pickle_bytes(obj)
+        )
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        lens = np.asarray(
-            multihost_utils.process_allgather(
-                np.array([len(payload)], np.int64)
-            )
-        ).reshape(jax.process_count())
-        buf = np.zeros(int(lens.max()), np.uint8)
-        buf[: len(payload)] = payload
-        rows = np.asarray(multihost_utils.process_allgather(buf)).reshape(
-            jax.process_count(), -1
+        return _gather_padded(
+            multihost_utils.process_allgather,
+            jax.process_count(),
+            _pickle_bytes(obj),
         )
-        return [_unpickle(rows[r, : int(lens[r])]) for r in range(len(lens))]
     return [obj]
 
 
@@ -562,20 +561,31 @@ def broadcast_object_list(objs: list, src: int = 0) -> list:
         raise ValueError(
             f"src {src} out of range for {world}-process world"
         )
+    # only src serializes (torch semantics): non-src ranks may hold
+    # unpicklable placeholders and still participate
     if g.ring is not None:
-        payload = _pickle_bytes(objs)
-        n = g.ring.broadcast(np.array([len(payload)], np.int64), src=src)
-        buf = np.zeros(int(np.asarray(n)[0]), np.uint8)
-        buf[: len(payload)] = payload[: len(buf)]
-        out = g.ring.broadcast(buf, src=src)
-        return _unpickle(np.asarray(out))
+        is_src = g.ring.rank == src
+        payload = (
+            _pickle_bytes(objs) if is_src else np.zeros(0, np.uint8)
+        )
+        n = int(
+            np.asarray(
+                g.ring.broadcast(np.array([len(payload)], np.int64), src=src)
+            )[0]
+        )
+        buf = payload if is_src else np.zeros(n, np.uint8)
+        return _unpickle(np.asarray(g.ring.broadcast(buf, src=src)))
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
         # broadcast_one_to_all ships process 0's value; for src != 0 route
-        # through an allgather and pick the source's row
+        # through an allgather (non-src contributes None, so only src's
+        # payload is ever pickled) and pick the source's row
+        is_src = jax.process_index() == src
         if src == 0:
-            payload = _pickle_bytes(objs)
+            payload = (
+                _pickle_bytes(objs) if is_src else np.zeros(0, np.uint8)
+            )
             n = int(
                 np.asarray(
                     multihost_utils.broadcast_one_to_all(
@@ -584,8 +594,9 @@ def broadcast_object_list(objs: list, src: int = 0) -> list:
                 )[0]
             )
             buf = np.zeros(n, np.uint8)
-            buf[: len(payload)] = payload[:n]
+            if is_src:
+                buf[:] = payload
             out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
             return _unpickle(out)
-        return all_gather_object(objs)[src]
+        return all_gather_object(objs if is_src else None)[src]
     return list(objs)

@@ -61,6 +61,7 @@ class Config(RecipeConfig):
     train_samples: int = 1_281_167  # doc: synthetic train-set size
     eval_samples: int = 50_000  # doc: synthetic eval-set size
     flip_augment: bool = True  # doc: random horizontal flip on host
+    stem: str = "imagenet"  # doc: stem variant: imagenet | s2d (MXU-friendly)
 
 
 def _flip_transform(seed: int):
@@ -126,17 +127,21 @@ def main(argv=None):
             n=n_eval, image_shape=shape, num_classes=1000, seed=cfg.seed + 1
         )
 
-    model = ResNet50(num_classes=1000)
+    model = ResNet50(num_classes=1000, stem=cfg.stem)
     variables = model.init(
         jax.random.key(cfg.seed), jnp.zeros((1,) + shape), train=False
     )
 
     steps_per_epoch = max(n_train // cfg.batch_size, 1)
+    total_steps = max(cfg.epochs * steps_per_epoch, 1)
+    # smoke runs can be shorter than the nominal warmup; clamp so the
+    # cosine phase keeps at least one step (optax rejects decay <= warmup)
+    warmup_steps = min(cfg.warmup_epochs * steps_per_epoch, total_steps - 1)
     schedule = optax.warmup_cosine_decay_schedule(
         init_value=0.0,
         peak_value=cfg.lr,
-        warmup_steps=cfg.warmup_epochs * steps_per_epoch,
-        decay_steps=max(cfg.epochs * steps_per_epoch, 1),
+        warmup_steps=warmup_steps,
+        decay_steps=total_steps,
     )
     tx = optax.sgd(schedule, momentum=cfg.momentum, nesterov=True)
     state = TrainState.create(

@@ -29,10 +29,11 @@ import pytorch_distributed_tpu as ptd
 from pytorch_distributed_tpu.runtime.device import compiled_flops, peak_flops
 
 
-def bench_batch(batch: int, image: int = 224, iters: int = 50):
+def bench_batch(batch: int, image: int = 224, iters: int = 50,
+                stem: str = "imagenet"):
     from bench import _resnet50_train_setup
 
-    strategy, step, state = _resnet50_train_setup(image)
+    strategy, step, state = _resnet50_train_setup(image, stem=stem)
     rng = np.random.default_rng(0)
     dev_batch = strategy.shard_batch(
         {
@@ -42,7 +43,7 @@ def bench_batch(batch: int, image: int = 224, iters: int = 50):
             "label": rng.integers(1000, size=(batch,)).astype(np.int32),
         }
     )
-    log(f"batch={batch} compiling...")
+    log(f"stem={stem} batch={batch} compiling...")
     compiled = step.lower(state, dev_batch).compile()
     flops = compiled_flops(compiled)
     for _ in range(5):
@@ -61,7 +62,7 @@ def bench_batch(batch: int, image: int = 224, iters: int = 50):
             f" tflops={flops / dt / 1e12:.1f}"
             f" mfu={flops / dt / peak * 100:.1f}%"
         )
-    log(f"batch={batch} {rate:.0f} img/s step={dt * 1e3:.1f}ms{note}")
+    log(f"stem={stem} batch={batch} {rate:.0f} img/s step={dt * 1e3:.1f}ms{note}")
     return rate, state, step, dev_batch
 
 
@@ -69,6 +70,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batches", type=int, nargs="+",
                     default=[128, 256, 512])
+    ap.add_argument("--stems", type=str, nargs="+",
+                    default=["imagenet", "s2d"])
     ap.add_argument("--trace", type=str, default=None)
     args = ap.parse_args()
 
@@ -77,10 +80,11 @@ def main():
     log(f"platform={ptd.platform()} kind={jax.devices()[0].device_kind}")
 
     best = (0.0, None)
-    for b in args.batches:
-        rate, state, step, dev_batch = bench_batch(b)
-        if rate > best[0]:
-            best = (rate, (b, state, step, dev_batch))
+    for stem in args.stems:
+        for b in args.batches:
+            rate, state, step, dev_batch = bench_batch(b, stem=stem)
+            if rate > best[0]:
+                best = (rate, (b, state, step, dev_batch))
 
     if args.trace and best[1]:
         b, state, step, dev_batch = best[1]

@@ -212,7 +212,9 @@ def facade_worker(rank: int, world: int, name: str, q) -> None:
         objs = ptd.all_gather_object({"rank": rank, "pad": "x" * (rank * 37)})
         assert [o["rank"] for o in objs] == list(range(world)), objs
         assert all(len(o["pad"]) == r * 37 for r, o in enumerate(objs))
-        got = ptd.broadcast_object_list(["from", rank], src=1)
+        # non-src ranks may hold unpicklable locals — only src serializes
+        local = ["from", rank] if rank == 1 else [lambda: None]
+        got = ptd.broadcast_object_list(local, src=1)
         assert got == ["from", 1], got
         ptd.barrier()
         ptd.destroy_process_group()

@@ -15,6 +15,46 @@ def count_params(tree):
 
 
 class TestResNet:
+    def test_s2d_stem_exactly_matches_conv7(self):
+        # the s2d stem's function space contains the 7x7/2 conv: rewriting
+        # any 7x7 kernel via s2d_stem_kernel_from_conv7 must reproduce the
+        # original conv's output exactly (same arithmetic, relaid out)
+        from pytorch_distributed_tpu.models.resnet import (
+            s2d_stem_kernel_from_conv7,
+            space_to_depth,
+        )
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)).astype(np.float32))
+        k7 = jnp.asarray(rng.normal(size=(7, 7, 3, 8)).astype(np.float32))
+
+        want = jax.lax.conv_general_dilated(
+            x, k7, window_strides=(2, 2), padding=[(3, 3), (3, 3)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        got = jax.lax.conv_general_dilated(
+            space_to_depth(x, 2), jnp.asarray(s2d_stem_kernel_from_conv7(k7)),
+            window_strides=(1, 1), padding=[(2, 1), (2, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        assert want.shape == got.shape == (2, 16, 16, 8)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_s2d_stem_resnet_runs_and_downsamples_like_imagenet(self):
+        a = ResNet(stage_sizes=[1, 1], block_cls=BasicBlock, num_classes=5,
+                   width=8, stem="imagenet")
+        b = ResNet(stage_sizes=[1, 1], block_cls=BasicBlock, num_classes=5,
+                   width=8, stem="s2d")
+        x = jnp.zeros((2, 64, 64, 3))
+        va = a.init(jax.random.key(0), x, train=False)
+        vb = b.init(jax.random.key(0), x, train=False)
+        oa = a.apply(va, x, train=False)
+        ob = b.apply(vb, x, train=False)
+        assert oa.shape == ob.shape == (2, 5)
+        # same downsampling schedule: stem kernel sees the s2d grid
+        assert vb["params"]["stem"]["kernel"].shape == (4, 4, 12, 8)
+
     @pytest.mark.slow
     def test_resnet18_param_count(self):
         # torch resnet18 (CIFAR stem, 10 classes) ~= 11.17M
