@@ -129,7 +129,8 @@ class GPT2LMHead(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, *, train: bool = False,
-                 decode: bool = False, cache_len: Optional[int] = None):
+                 decode: bool = False, cache_len: Optional[int] = None,
+                 return_hidden: bool = False):
         cfg = self.config
         policy = current_policy()
         B, S = input_ids.shape
@@ -172,6 +173,10 @@ class GPT2LMHead(nn.Module):
             epsilon=cfg.layer_norm_eps, dtype=policy.compute_dtype,
             param_dtype=policy.param_dtype, name="ln_f",
         )(x)
+        if return_hidden:
+            # [B, S, D] for the chunked-vocab loss (ops/lm_loss.py); the
+            # tied projection weight is params['wte']['embedding']
+            return x.astype(policy.output_dtype)
         # tied head in compute dtype (bf16 MXU path for the largest matmul),
         # f32 accumulation
         logits = jnp.einsum(

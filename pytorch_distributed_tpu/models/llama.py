@@ -123,6 +123,7 @@ class LlamaForCausalLM(nn.Module):
         train: bool = False,
         decode: bool = False,
         cache_len: Optional[int] = None,
+        return_hidden: bool = False,
     ):
         cfg = self.config
         policy = current_policy()
@@ -156,6 +157,10 @@ class LlamaForCausalLM(nn.Module):
                     decode=decode, cache_len=cache_len,
                 )
         x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
+        if return_hidden:
+            # [B, S, D] for the chunked-vocab loss (ops/lm_loss.py); the
+            # untied projection is params['lm_head']['kernel'] ([D, V])
+            return x.astype(policy.output_dtype)
         logits = nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=policy.compute_dtype,
             param_dtype=policy.param_dtype, name="lm_head",

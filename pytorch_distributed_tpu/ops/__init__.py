@@ -16,6 +16,10 @@ from pytorch_distributed_tpu.ops.attention import (
     rope_frequencies,
 )
 from pytorch_distributed_tpu.ops.flash_attention import flash_attention
+from pytorch_distributed_tpu.ops.lm_loss import (
+    causal_lm_chunked_loss,
+    chunked_softmax_cross_entropy,
+)
 from pytorch_distributed_tpu.ops.moe import (
     MoEMLP,
     collect_aux_loss,
@@ -24,6 +28,8 @@ from pytorch_distributed_tpu.ops.moe import (
 
 __all__ = [
     "MoEMLP",
+    "causal_lm_chunked_loss",
+    "chunked_softmax_cross_entropy",
     "collect_aux_loss",
     "moe_partition_rules",
     "scaled_dot_product_attention",

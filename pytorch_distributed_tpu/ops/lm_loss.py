@@ -1,0 +1,135 @@
+"""Chunked-vocab softmax cross-entropy — the large-vocab LM memory fix.
+
+The standard causal-LM loss materializes ``[B, S, V]`` logits: at Llama-3
+scale (V=128,256, B=8, S=2048) that is ~4 GB in f32 *plus* the same again
+as the softmax grad in the backward — often more HBM than the whole model
+shard. The reference's torch recipes pay exactly this (F.cross_entropy on
+full logits, BASELINE.json:10).
+
+The TPU-native fix never forms the full logits: scan over vocab chunks,
+maintaining a numerically-stable ONLINE logsumexp (the flash-attention
+trick applied to the classifier axis) plus the label's logit. Each chunk
+is an ``[N, D] @ [D, C]`` matmul — MXU-shaped — and ``jax.checkpoint`` on
+the chunk body keeps the backward at one chunk of logits live at a time
+(recomputed, exactly like flash attention's backward).
+
+Peak extra memory: ``O(N * C)`` instead of ``O(N * V)``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def chunked_softmax_cross_entropy(
+    hidden,
+    embedding,
+    labels,
+    *,
+    chunk_size: int = 8192,
+    label_smoothing: float = 0.0,
+):
+    """Mean CE of ``softmax(hidden @ embedding.T)`` vs integer ``labels``.
+
+    ``hidden``: [N, D] final hidden states (any float dtype; matmuls run
+    in the input dtype with f32 accumulation).
+    ``embedding``: [V, D] vocab-major projection — GPT-2's tied ``wte``
+    directly, or an untied lm_head kernel transposed.
+    ``labels``: [N] int32/int64 in [0, V).
+
+    Equivalent (to f32 numerics) to
+    ``optax.softmax_cross_entropy_with_integer_labels(h @ E.T, labels)``
+    — pinned by tests/test_lm_loss.py — but never materializes [N, V].
+
+    With ``label_smoothing``, the smoothed loss needs the mean logit over
+    the vocab as well; it is accumulated in the same pass.
+    """
+    if hidden.ndim != 2:
+        raise ValueError(f"hidden must be [N, D], got {hidden.shape}")
+    if chunk_size < 1:
+        raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
+    v, d = embedding.shape
+    n = hidden.shape[0]
+    chunk_size = min(chunk_size, v)
+    n_chunks = -(-v // chunk_size)
+    labels = labels.astype(jnp.int32)
+
+    def body(carry, idx):
+        m, s, lab, tot = carry
+        # slice the UNPADDED embedding (padding the vocab axis would keep a
+        # second full [V, D] copy live for the whole scan); the final
+        # ragged chunk clamps its start back, and the re-covered overlap
+        # columns are masked out below
+        base = idx * chunk_size
+        start = jnp.minimum(base, v - chunk_size)
+        emb_c = jax.lax.dynamic_slice(
+            embedding, (start, 0), (chunk_size, d)
+        )  # [C, D]
+        logits = jax.lax.dot_general(
+            hidden,
+            emb_c,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [N, C]
+        col = start + jax.lax.iota(jnp.int32, chunk_size)  # [C] global ids
+        fresh = col >= base  # False on tail-overlap columns already seen
+        logits = jnp.where(fresh[None, :], logits, -jnp.inf)
+        # online logsumexp update (first chunk always has fresh columns,
+        # so m_new is finite from iteration 0 — no nan path)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        scale = jnp.exp(jnp.minimum(m - m_new, 0.0))
+        s = s * scale + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1
+        )
+        # label logit: each label matches exactly one fresh column overall
+        match = fresh[None, :] & (labels[:, None] == col[None, :])
+        lab = lab + jnp.sum(jnp.where(match, logits, 0.0), axis=-1)
+        if label_smoothing:
+            tot = tot + jnp.sum(
+                jnp.where(fresh[None, :], logits, 0.0), axis=-1
+            )
+        return (m_new, s, lab, tot), None
+
+    init = (
+        jnp.full((n,), -jnp.inf, jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+    )
+    (m, s, lab, tot), _ = jax.lax.scan(
+        jax.checkpoint(body),
+        init,
+        jnp.arange(n_chunks, dtype=jnp.int32),
+    )
+    lse = m + jnp.log(s)
+    if label_smoothing:
+        # smoothed CE = (1-eps) * (lse - label) + eps * (lse - mean_logit)
+        eps = label_smoothing
+        per_token = lse - (1.0 - eps) * lab - eps * tot / v
+    else:
+        per_token = lse - lab
+    return jnp.mean(per_token)
+
+
+def causal_lm_chunked_loss(
+    hidden,
+    embedding,
+    input_ids,
+    *,
+    chunk_size: int = 8192,
+    label_smoothing: float = 0.0,
+):
+    """Next-token chunked CE on [B, S, D] hiddens (shift-by-one)."""
+    b, s, d = hidden.shape
+    h = hidden[:, :-1].reshape(b * (s - 1), d)
+    labels = input_ids[:, 1:].reshape(b * (s - 1))
+    return chunked_softmax_cross_entropy(
+        h,
+        embedding,
+        labels,
+        chunk_size=chunk_size,
+        label_smoothing=label_smoothing,
+    )

@@ -81,8 +81,25 @@ def classification_loss_fn(
     return loss_fn
 
 
+def _lm_projection_weight(params):
+    """[V, D] vocab-major projection from an LM's param tree: GPT-2's tied
+    ``wte`` embedding directly, or an untied ``lm_head`` kernel transposed."""
+    if "wte" in params:
+        return params["wte"]["embedding"]
+    if "lm_head" in params:
+        return params["lm_head"]["kernel"].T
+    raise ValueError(
+        "model has neither a tied 'wte' embedding nor an 'lm_head' kernel; "
+        "pass vocab_chunk_size=None or add its head to _lm_projection_weight"
+    )
+
+
 def causal_lm_loss_fn(
-    model, *, ids_key: str = "input_ids", moe_aux_weight: float = 0.0
+    model,
+    *,
+    ids_key: str = "input_ids",
+    moe_aux_weight: float = 0.0,
+    vocab_chunk_size: Optional[int] = None,
 ) -> Callable:
     """Trainer-contract loss for decoder LMs: next-token CE (shift-by-one).
 
@@ -92,7 +109,40 @@ def causal_lm_loss_fn(
     ``moe_aux_weight > 0`` collects the MoE load-balance auxiliary losses
     sown by expert layers (ops/moe.py) and adds their weighted sum — set
     it whenever the model has ``moe_experts > 0``.
+
+    ``vocab_chunk_size`` switches to the chunked-vocab loss
+    (ops/lm_loss.py): the model is applied with ``return_hidden=True`` and
+    the [B,S,V] logits are never materialized — the large-vocab (Llama-3)
+    memory fix. Requires moe_aux_weight == 0 for now.
     """
+    if vocab_chunk_size is not None and moe_aux_weight > 0.0:
+        raise NotImplementedError(
+            "chunked loss + MoE aux collection not combined yet"
+        )
+
+    def chunked_loss_fn(params, batch_stats, batch, rng):
+        from pytorch_distributed_tpu.ops.lm_loss import causal_lm_chunked_loss
+
+        ids = batch[ids_key]
+        hidden = model.apply(
+            {"params": params}, ids, train=True, rngs={"dropout": rng},
+            return_hidden=True,
+        )
+        from pytorch_distributed_tpu.runtime.precision import current_policy
+
+        policy = current_policy()
+        loss = causal_lm_chunked_loss(
+            # matmuls in compute dtype (bf16 MXU) with f32 accumulation
+            # inside the op — same numerics as the full-logits path
+            hidden.astype(policy.compute_dtype),
+            _lm_projection_weight(params).astype(policy.compute_dtype),
+            ids,
+            chunk_size=vocab_chunk_size,
+        )
+        return loss, {"metrics": {"loss": loss}, "batch_stats": batch_stats}
+
+    if vocab_chunk_size is not None:
+        return chunked_loss_fn
 
     def loss_fn(params, batch_stats, batch, rng):
         ids = batch[ids_key]

@@ -67,6 +67,9 @@ def parse_args(argv=None):
     p.add_argument("--sp-mode", choices=("ring", "ulysses"), default="ring")
     p.add_argument("--remat", action="store_true",
                    help="recompute block activations in backward")
+    p.add_argument("--vocab-chunk", type=int, default=None,
+                   help="chunked-vocab loss: never materialize [B,S,V] "
+                        "logits (ops/lm_loss.py; try 8192 at 128K vocab)")
     p.add_argument("--steps-per-epoch", type=int, default=None)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--seed", type=int, default=0)
@@ -117,7 +120,10 @@ def main(argv=None):
     trainer = Trainer(
         state,
         strategy,
-        build_train_step(causal_lm_loss_fn(model), accum_steps=args.accum_steps),
+        build_train_step(
+            causal_lm_loss_fn(model, vocab_chunk_size=args.vocab_chunk),
+            accum_steps=args.accum_steps,
+        ),
         DataLoader(
             ds, args.batch_size, seed=args.seed,
             sharding=strategy.batch_sharding(),

@@ -84,11 +84,13 @@ def main():
         for b in args.batches:
             rate, state, step, dev_batch = bench_batch(b, stem=stem)
             if rate > best[0]:
-                best = (rate, (b, state, step, dev_batch))
+                best = (rate, (stem, b, state, step, dev_batch))
+    if best[1]:
+        log(f"best: stem={best[1][0]} batch={best[1][1]} {best[0]:.0f} img/s")
 
     if args.trace and best[1]:
-        b, state, step, dev_batch = best[1]
-        log(f"tracing batch={b} -> {args.trace}")
+        stem, b, state, step, dev_batch = best[1]
+        log(f"tracing stem={stem} batch={b} -> {args.trace}")
         with jax.profiler.trace(args.trace):
             for _ in range(10):
                 state, metrics = step(state, dev_batch)
