@@ -15,8 +15,8 @@ complete framework, and on TPU it has one idiomatic shape:
 * Works with any model that takes ``decode=True`` and maintains flax
   ``cache`` collection state (GPT2LMHead, LlamaForCausalLM).
 
-Sampling: greedy (``temperature=0``), temperature, and top-k — enough to
-smoke-test every recipe's model family offline.
+Sampling: greedy (``temperature=0``), temperature, top-k, and top-p
+(nucleus) — enough to smoke-test every recipe's model family offline.
 """
 
 from __future__ import annotations
@@ -34,16 +34,44 @@ def sample_logits(
     *,
     temperature: float = 1.0,
     top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
 ) -> jnp.ndarray:
-    """[B, vocab] logits -> [B] token ids."""
+    """[B, vocab] logits -> [B] token ids.
+
+    ``top_k`` and ``top_p`` (nucleus) filters compose like the HF
+    sampler: k-filter first, then keep the smallest prefix of the
+    probability-sorted vocab whose mass reaches ``top_p``.
+    """
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if rng is None:
         raise ValueError("sampling with temperature > 0 needs an rng key")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    neg_inf = jnp.finfo(jnp.float32).min
     logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None or top_p is not None:
+        # one descending sort serves both filters (this runs inside the
+        # decode scan — at 128K vocab a second sort per token is real money)
+        sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
     if top_k is not None:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-        logits = jnp.where(logits < kth, jnp.finfo(jnp.float32).min, logits)
+        kth = sorted_desc[:, top_k - 1][:, None]
+        logits = jnp.where(logits < kth, neg_inf, logits)
+        sorted_desc = jnp.where(
+            jnp.arange(sorted_desc.shape[-1])[None, :] < top_k,
+            sorted_desc, neg_inf,
+        )
+    if top_p is not None:
+        # a token survives if the cumulative probability BEFORE it is
+        # still < top_p (so the top token always survives)
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum_before = jnp.cumsum(probs, axis=-1) - probs
+        keep = cum_before < top_p
+        # threshold = smallest surviving logit per row
+        thresh = jnp.min(
+            jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < thresh, neg_inf, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -55,6 +83,7 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     rng: Optional[jax.Array] = None,
     eos_id: Optional[int] = None,
     pad_id: int = 0,
@@ -95,7 +124,8 @@ def generate(
     cache = state["cache"]
     rng, sub = jax.random.split(rng)
     tok = sample_logits(
-        logits[:, -1], sub, temperature=temperature, top_k=top_k
+        logits[:, -1], sub, temperature=temperature, top_k=top_k,
+        top_p=top_p,
     )
     done = (
         tok == eos_id if eos_id is not None
@@ -113,7 +143,8 @@ def generate(
         )
         rng, sub = jax.random.split(rng)
         nxt = sample_logits(
-            logits[:, -1], sub, temperature=temperature, top_k=top_k
+            logits[:, -1], sub, temperature=temperature, top_k=top_k,
+            top_p=top_p,
         )
         nxt = jnp.where(done, jnp.int32(pad_id), nxt)
         if eos_id is not None:

@@ -31,13 +31,18 @@ def chunked_softmax_cross_entropy(
     *,
     chunk_size: int = 8192,
     label_smoothing: float = 0.0,
+    vocab_axis: int = 0,
 ):
-    """Mean CE of ``softmax(hidden @ embedding.T)`` vs integer ``labels``.
+    """Mean CE of the projected logits vs integer ``labels``.
 
     ``hidden``: [N, D] final hidden states (any float dtype; matmuls run
     in the input dtype with f32 accumulation).
-    ``embedding``: [V, D] vocab-major projection — GPT-2's tied ``wte``
-    directly, or an untied lm_head kernel transposed.
+    ``embedding``: the output projection in ITS OWN layout — [V, D]
+    (``vocab_axis=0``: GPT-2's tied ``wte``) or [D, V] (``vocab_axis=1``:
+    an untied lm_head kernel). Passing the native layout matters: a
+    transpose (or a whole-weight dtype cast) would materialize a second
+    full-size copy held live across the scan — only per-chunk slices are
+    ever formed, and they are cast to ``hidden.dtype`` chunk-wise.
     ``labels``: [N] int32/int64 in [0, V).
 
     Equivalent (to f32 numerics) to
@@ -51,7 +56,10 @@ def chunked_softmax_cross_entropy(
         raise ValueError(f"hidden must be [N, D], got {hidden.shape}")
     if chunk_size < 1:
         raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
-    v, d = embedding.shape
+    if vocab_axis not in (0, 1):
+        raise ValueError(f"vocab_axis must be 0 or 1, got {vocab_axis}")
+    v = embedding.shape[vocab_axis]
+    d = embedding.shape[1 - vocab_axis]
     n = hidden.shape[0]
     chunk_size = min(chunk_size, v)
     n_chunks = -(-v // chunk_size)
@@ -60,18 +68,25 @@ def chunked_softmax_cross_entropy(
     def body(carry, idx):
         m, s, lab, tot = carry
         # slice the UNPADDED embedding (padding the vocab axis would keep a
-        # second full [V, D] copy live for the whole scan); the final
+        # second full-size copy live for the whole scan); the final
         # ragged chunk clamps its start back, and the re-covered overlap
         # columns are masked out below
         base = idx * chunk_size
         start = jnp.minimum(base, v - chunk_size)
-        emb_c = jax.lax.dynamic_slice(
-            embedding, (start, 0), (chunk_size, d)
-        )  # [C, D]
+        if vocab_axis == 0:
+            emb_c = jax.lax.dynamic_slice(
+                embedding, (start, 0), (chunk_size, d)
+            ).astype(hidden.dtype)  # [C, D]
+            contract = (((1,), (1,)), ((), ()))
+        else:
+            emb_c = jax.lax.dynamic_slice(
+                embedding, (0, start), (d, chunk_size)
+            ).astype(hidden.dtype)  # [D, C]
+            contract = (((1,), (0,)), ((), ()))
         logits = jax.lax.dot_general(
             hidden,
             emb_c,
-            (((1,), (1,)), ((), ())),
+            contract,
             preferred_element_type=jnp.float32,
         )  # [N, C]
         col = start + jax.lax.iota(jnp.int32, chunk_size)  # [C] global ids
@@ -121,6 +136,7 @@ def causal_lm_chunked_loss(
     *,
     chunk_size: int = 8192,
     label_smoothing: float = 0.0,
+    vocab_axis: int = 0,
 ):
     """Next-token chunked CE on [B, S, D] hiddens (shift-by-one)."""
     b, s, d = hidden.shape
@@ -132,4 +148,5 @@ def causal_lm_chunked_loss(
         labels,
         chunk_size=chunk_size,
         label_smoothing=label_smoothing,
+        vocab_axis=vocab_axis,
     )

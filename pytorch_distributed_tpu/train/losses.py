@@ -82,12 +82,14 @@ def classification_loss_fn(
 
 
 def _lm_projection_weight(params):
-    """[V, D] vocab-major projection from an LM's param tree: GPT-2's tied
-    ``wte`` embedding directly, or an untied ``lm_head`` kernel transposed."""
+    """(projection, vocab_axis) from an LM's param tree, in the weight's
+    NATIVE layout (transposing/casting up front would materialize a second
+    full [V, D] copy — the chunked op slices per chunk instead): GPT-2's
+    tied ``wte`` embedding [V, D], or an untied ``lm_head`` kernel [D, V]."""
     if "wte" in params:
-        return params["wte"]["embedding"]
+        return params["wte"]["embedding"], 0
     if "lm_head" in params:
-        return params["lm_head"]["kernel"].T
+        return params["lm_head"]["kernel"], 1
     raise ValueError(
         "model has neither a tied 'wte' embedding nor an 'lm_head' kernel; "
         "pass vocab_chunk_size=None or add its head to _lm_projection_weight"
@@ -131,13 +133,16 @@ def causal_lm_loss_fn(
         from pytorch_distributed_tpu.runtime.precision import current_policy
 
         policy = current_policy()
+        weight, vocab_axis = _lm_projection_weight(params)
         loss = causal_lm_chunked_loss(
-            # matmuls in compute dtype (bf16 MXU) with f32 accumulation
-            # inside the op — same numerics as the full-logits path
+            # hidden in compute dtype (bf16 MXU) with f32 accumulation in
+            # the op; the projection stays in its native layout/dtype and
+            # is sliced+cast per chunk — same numerics as the full path
             hidden.astype(policy.compute_dtype),
-            _lm_projection_weight(params).astype(policy.compute_dtype),
+            weight,
             ids,
             chunk_size=vocab_chunk_size,
+            vocab_axis=vocab_axis,
         )
         return loss, {"metrics": {"loss": loss}, "batch_stats": batch_stats}
 

@@ -143,6 +143,51 @@ def test_sampling_respects_top_k():
     assert int(greedy[0]) == 4
 
 
+def test_sampling_respects_top_p():
+    # softmax of [0,0,0,0,10] puts ~99.99% mass on token 4: with top_p=0.9
+    # the nucleus is {4} alone, so sampling must always return 4
+    logits = jnp.asarray([[0.0, 0.0, 0.0, 0.0, 10.0]])
+    for seed in range(8):
+        tok = sample_logits(
+            logits, jax.random.key(seed), temperature=1.0, top_p=0.9
+        )
+        assert int(tok[0]) == 4
+    # near-uniform pair dominating the rest: nucleus of mass 0.9 is {3, 4}
+    logits = jnp.asarray([[0.0, 0.0, 0.0, 9.9, 10.0]])
+    seen = set()
+    for seed in range(16):
+        tok = sample_logits(
+            logits, jax.random.key(seed), temperature=1.0, top_p=0.9
+        )
+        seen.add(int(tok[0]))
+    assert seen <= {3, 4} and len(seen) == 2, seen
+    # top_p=1.0 keeps everything (smoke: no crash, valid index)
+    tok = sample_logits(logits, jax.random.key(0), temperature=1.0, top_p=1.0)
+    assert 0 <= int(tok[0]) < 5
+    # composed k-then-p (HF order): k=3 keeps {2,3,4}, renormalized p=0.9
+    # nucleus of the kept set is {3,4}
+    logits = jnp.asarray([[0.0, 0.0, 1.0, 9.9, 10.0]])
+    for seed in range(8):
+        tok = sample_logits(
+            logits, jax.random.key(seed), temperature=1.0, top_k=3, top_p=0.9
+        )
+        assert int(tok[0]) in (3, 4)
+    # out-of-range top_p is a loud error, not silent uniform sampling
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        sample_logits(logits, jax.random.key(0), temperature=1.0, top_p=0.0)
+
+
+def test_generate_with_top_p(gpt2):
+    model, params, ids = gpt2
+    out = generate(
+        model, params, ids, max_new_tokens=3, temperature=0.8, top_p=0.95,
+        rng=jax.random.key(3),
+    )
+    assert out.shape == (2, ids.shape[1] + 3)
+
+
 def test_temperature_zero_needs_no_rng(gpt2):
     model, params, ids = gpt2
     out = generate(model, params, ids, max_new_tokens=3, temperature=0.0)

@@ -136,9 +136,7 @@ def test_8b_decode_cache_bytes_bounded_by_cache_len(abstract_8b_state):
     assert naive > 20 * bounded  # the cache_len bound is load-bearing
 
 
-@pytest.mark.slow
-def test_8b_fsdp_train_step_lowers_for_tpu(abstract_8b_state):
-    cfg, model, abstract = abstract_8b_state
+def _lower_8b_step(model, abstract, loss_fn):
     mesh = AbstractMesh((4, 16), ("dp", "fsdp"))
     strategy = FSDP(mesh)
     shardings = strategy.state_shardings(abstract)
@@ -152,12 +150,38 @@ def test_8b_fsdp_train_step_lowers_for_tpu(abstract_8b_state):
             (GLOBAL_BATCH, SEQ), jnp.int32, sharding=strategy.batch_sharding()
         )
     }
-    step = build_train_step(causal_lm_loss_fn(model))
-    lowered = (
+    step = build_train_step(loss_fn)
+    return (
         jax.jit(step, donate_argnums=(0,))
         .trace(state_shapes, batch_shapes)
         .lower(lowering_platforms=("tpu",))
     )
+
+
+@pytest.mark.slow
+def test_8b_chunked_loss_step_lowers_and_sheds_the_logits(abstract_8b_state):
+    """The chunked-vocab loss (ops/lm_loss.py) lowers for the same 8B FSDP
+    mesh, and its HLO carries no [tokens, V] logits-sized buffer — the
+    full-logits step provably does."""
+    cfg, model, abstract = abstract_8b_state
+    tokens_per_shard = GLOBAL_BATCH * (SEQ - 1) // 64  # dp*fsdp shards
+    logits_marker = f"{tokens_per_shard}x{cfg.vocab_size}"
+    full = _lower_8b_step(
+        model, abstract, causal_lm_loss_fn(model)
+    ).as_text()
+    chunked = _lower_8b_step(
+        model, abstract, causal_lm_loss_fn(model, vocab_chunk_size=8192)
+    ).as_text()
+    assert logits_marker in full  # sanity: the marker detects full logits
+    assert logits_marker not in chunked, (
+        "chunked-loss HLO still materializes per-shard full logits"
+    )
+
+
+@pytest.mark.slow
+def test_8b_fsdp_train_step_lowers_for_tpu(abstract_8b_state):
+    cfg, model, abstract = abstract_8b_state
+    lowered = _lower_8b_step(model, abstract, causal_lm_loss_fn(model))
     # the lowered module exists and is genuinely the sharded 8B program
     text = lowered.as_text()
     assert "stablehlo" in text or "module" in text
