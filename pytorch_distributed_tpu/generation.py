@@ -42,12 +42,14 @@ def sample_logits(
     sampler: k-filter first, then keep the smallest prefix of the
     probability-sorted vocab whose mass reaches ``top_p``.
     """
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        # validate before the greedy early-return so a bad config is loud
+        # even while smoke-testing with temperature=0
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if rng is None:
         raise ValueError("sampling with temperature > 0 needs an rng key")
-    if top_p is not None and not 0.0 < top_p <= 1.0:
-        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     neg_inf = jnp.finfo(jnp.float32).min
     logits = logits.astype(jnp.float32) / temperature
     if top_k is not None or top_p is not None:

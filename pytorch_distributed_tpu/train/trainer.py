@@ -175,6 +175,9 @@ class TrainerConfig:
     # failure detection / elastic recovery (train/elastic.py):
     handle_preemption: bool = True  # SIGTERM -> checkpoint -> Preempted
     stall_timeout_s: Optional[float] = None  # watchdog hang detection
+    log_mfu: bool = False  # append achieved TFLOP/s + MFU to step logs
+    # (costs one AOT lower+compile of the train step on the first batch —
+    # a disk hit when the persistent compilation cache is enabled)
 
 
 class Trainer:
@@ -225,6 +228,7 @@ class Trainer:
         self._preemption = None
         self._watchdog = None
         self._async_ckpt = None
+        self._step_flops = None  # per-step FLOPs (log_mfu), set lazily
         if self.config.async_checkpoint:
             from pytorch_distributed_tpu.train.checkpoint import (
                 AsyncCheckpointer,
@@ -331,6 +335,23 @@ class Trainer:
             )
             raise elastic.Preempted(step)
 
+    def _measure_step_flops(self, batch) -> float:
+        """Per-step FLOPs from XLA's own cost analysis (log_mfu).
+
+        AOT-lowers the train step against the live (state, batch) — with
+        the persistent compilation cache on, the second compile of the
+        identical program is a disk hit. Any failure degrades to 0
+        (feature off) rather than interrupting training.
+        """
+        from pytorch_distributed_tpu.runtime.device import compiled_flops
+
+        try:
+            compiled = self.train_step.lower(self.state, batch).compile()
+            return compiled_flops(compiled) or 0.0
+        except Exception as e:  # pragma: no cover - backend-specific
+            logger.info("log_mfu disabled (cost analysis failed: %s)", e)
+            return 0.0
+
     def _train_epoch(self, epoch: int) -> None:
         cfg = self.config
         t_last = time.perf_counter()
@@ -343,6 +364,10 @@ class Trainer:
                 skip -= 1
                 continue
             n = self._batch_samples(batch)
+            if cfg.log_mfu and self._step_flops is None:
+                self._step_flops = self._measure_step_flops(batch)
+                t_last = time.perf_counter()  # don't bill the AOT compile
+                # to the first logging window's step-time/MFU numbers
             self.state, metrics = self.train_step(self.state, batch)
             self.host_step += 1
             step = self.host_step
@@ -368,19 +393,34 @@ class Trainer:
                 steps_since_log = 0
                 steps_since_sync = 0  # the host_scalar()s above just synced
                 self.meter.update(MeterState(step_time=dt, samples_per_sec=n / dt))
+                mfu_note = ""
+                if self._step_flops:
+                    from pytorch_distributed_tpu.runtime.device import (
+                        peak_flops,
+                    )
+
+                    achieved = self._step_flops / dt
+                    mfu_note = f" {achieved / 1e12:.1f} TFLOP/s"
+                    peak = peak_flops()
+                    if peak:
+                        mfu_note += f" (mfu {achieved / peak * 100:.1f}%)"
                 logger.info(
-                    "epoch %d step %d %s %.1f samples/s (%.1f ms/step)",
+                    "epoch %d step %d %s %.1f samples/s (%.1f ms/step)%s",
                     epoch,
                     step,
                     " ".join(f"{k}={v:.4f}" for k, v in metrics.items()),
                     n / dt,
                     dt * 1e3,
+                    mfu_note,
                 )
                 if self.metrics_writer is not None:
+                    extra = {}
+                    if self._step_flops:
+                        extra["tflops"] = self._step_flops / dt / 1e12
                     self.metrics_writer.write(
                         step,
                         {**metrics, "samples_per_sec": n / dt,
-                         "step_time_ms": dt * 1e3, "epoch": epoch},
+                         "step_time_ms": dt * 1e3, "epoch": epoch, **extra},
                     )
             if cfg.ckpt_every_steps and step % cfg.ckpt_every_steps == 0:
                 self.save_checkpoint()

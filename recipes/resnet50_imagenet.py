@@ -62,6 +62,7 @@ class Config(RecipeConfig):
     eval_samples: int = 50_000  # doc: synthetic eval-set size
     flip_augment: bool = True  # doc: random horizontal flip on host
     stem: str = "imagenet"  # doc: stem variant: imagenet | s2d (MXU-friendly)
+    log_mfu: bool = False  # doc: append achieved TFLOP/s + MFU to step logs
 
 
 def _flip_transform(seed: int):
@@ -185,6 +186,7 @@ def main(argv=None):
             log_every=cfg.log_every,
             ckpt_dir=cfg.ckpt_dir,
             metrics_path=cfg.metrics_path,
+            log_mfu=cfg.log_mfu,
         ),
     )
     trainer.restore_checkpoint()

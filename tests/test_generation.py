@@ -172,11 +172,12 @@ def test_sampling_respects_top_p():
             logits, jax.random.key(seed), temperature=1.0, top_k=3, top_p=0.9
         )
         assert int(tok[0]) in (3, 4)
-    # out-of-range top_p is a loud error, not silent uniform sampling
-    import pytest as _pytest
-
-    with _pytest.raises(ValueError):
+    # out-of-range top_p is a loud error, not silent uniform sampling —
+    # on the greedy path too (where the filter would otherwise be unused)
+    with pytest.raises(ValueError):
         sample_logits(logits, jax.random.key(0), temperature=1.0, top_p=0.0)
+    with pytest.raises(ValueError):
+        sample_logits(logits, None, temperature=0.0, top_p=0.0)
 
 
 def test_generate_with_top_p(gpt2):

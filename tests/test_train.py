@@ -137,6 +137,23 @@ class TestTrainerLoop:
         bn_after = np.asarray(jax.tree_util.tree_leaves(out.batch_stats)[0])
         assert not np.array_equal(bn_before, bn_after)  # stats really update
 
+    def test_log_mfu_measures_step_flops(self, dp8):
+        model = tiny_resnet()
+        state = tiny_image_state(model)
+        ds = SyntheticImageDataset(n=32, image_shape=(16, 16, 3), seed=0)
+        loader = DataLoader(ds, 16, sharding=dp8.batch_sharding())
+        trainer = Trainer(
+            state,
+            dp8,
+            build_train_step(classification_loss_fn(model)),
+            loader,
+            config=TrainerConfig(epochs=1, log_every=1, log_mfu=True),
+        )
+        trainer.fit()
+        # XLA's cost analysis priced the step; a tiny CNN fwd+bwd on a
+        # 16-sample batch is at least a few MFLOPs
+        assert trainer._step_flops and trainer._step_flops > 1e6
+
     @pytest.mark.slow
     def test_evaluate_runs(self, dp8):
         model = tiny_resnet()
