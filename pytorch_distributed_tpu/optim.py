@@ -24,20 +24,70 @@ import optax
 ScalarOrSchedule = Union[float, optax.Schedule]
 
 
+#: param-path patterns torch/HF recipes conventionally exempt from weight
+#: decay ("no_decay groups"): biases and normalization scales. Flax norm
+#: layers name their affine params 'scale'/'bias'.
+DEFAULT_NO_DECAY = (r"(^|/)bias$", r"(^|/)scale$")
+
+
+def no_decay_mask(patterns: Sequence[str] = DEFAULT_NO_DECAY):
+    """The torch "param groups" decay split, functionally.
+
+    torch recipes build two optimizer groups — decayed weights and a
+    no_decay list (biases, LayerNorm) — at parameter-registration time.
+    The functional analogue is a MASK over the param pytree: returns a
+    callable usable as ``optax.add_decayed_weights(..., mask=...)`` /
+    ``optax.adamw(..., mask=...)`` that is True (decay) for every param
+    whose 'a/b/c' path matches none of ``patterns`` (re.search).
+    """
+    import re
+
+    import jax
+
+    regs = [re.compile(p) for p in patterns]
+
+    def mask(params):
+        from pytorch_distributed_tpu.parallel.sharding import path_str
+
+        def keep(path, leaf):
+            p = path_str(path)
+            return not any(r.search(p) for r in regs)
+
+        return jax.tree_util.tree_map_with_path(keep, params)
+
+    return mask
+
+
+def _decay_mask_arg(no_decay):
+    """None -> decay everything (torch default); patterns -> mask fn."""
+    if no_decay is None:
+        return None
+    return no_decay_mask(no_decay)
+
+
 def SGD(
     lr: ScalarOrSchedule,
     momentum: float = 0.0,
     weight_decay: float = 0.0,
     nesterov: bool = False,
     dampening: float = 0.0,
+    no_decay: Optional[Sequence[str]] = None,
 ) -> optax.GradientTransformation:
     """``torch.optim.SGD`` semantics (incl. decoupled-from-loss L2 as torch
-    does it: weight decay added to the gradient before momentum)."""
+    does it: weight decay added to the gradient before momentum).
+
+    ``no_decay``: path patterns to exempt from decay (the torch
+    two-param-group idiom) — e.g. ``optim.DEFAULT_NO_DECAY``.
+    """
     if dampening != 0.0:
         raise NotImplementedError("dampening != 0 is not supported")
     chain = []
     if weight_decay:
-        chain.append(optax.add_decayed_weights(weight_decay))
+        chain.append(
+            optax.add_decayed_weights(
+                weight_decay, mask=_decay_mask_arg(no_decay)
+            )
+        )
     chain.append(
         optax.sgd(lr, momentum=momentum or None, nesterov=nesterov)
     )
@@ -49,11 +99,16 @@ def Adam(
     betas: Sequence[float] = (0.9, 0.999),
     eps: float = 1e-8,
     weight_decay: float = 0.0,
+    no_decay: Optional[Sequence[str]] = None,
 ) -> optax.GradientTransformation:
     """``torch.optim.Adam`` (L2 folded into grads, NOT AdamW decoupling)."""
     chain = []
     if weight_decay:
-        chain.append(optax.add_decayed_weights(weight_decay))
+        chain.append(
+            optax.add_decayed_weights(
+                weight_decay, mask=_decay_mask_arg(no_decay)
+            )
+        )
     chain.append(optax.adam(lr, b1=betas[0], b2=betas[1], eps=eps))
     return optax.chain(*chain)
 
@@ -63,9 +118,14 @@ def AdamW(
     betas: Sequence[float] = (0.9, 0.999),
     eps: float = 1e-8,
     weight_decay: float = 0.01,
+    no_decay: Optional[Sequence[str]] = None,
 ) -> optax.GradientTransformation:
+    """``torch.optim.AdamW``; ``no_decay`` exempts matching param paths
+    (the HF fine-tuning 'bias + LayerNorm' convention —
+    ``optim.DEFAULT_NO_DECAY``)."""
     return optax.adamw(
-        lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay
+        lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay,
+        mask=_decay_mask_arg(no_decay),
     )
 
 

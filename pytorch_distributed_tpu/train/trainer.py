@@ -338,16 +338,21 @@ class Trainer:
     def _measure_step_flops(self, batch) -> float:
         """Per-step FLOPs from XLA's own cost analysis (log_mfu).
 
-        AOT-lowers the train step against the live (state, batch) — with
-        the persistent compilation cache on, the second compile of the
-        identical program is a disk hit. Any failure degrades to 0
-        (feature off) rather than interrupting training.
+        Lowering (a trace, no compile) is enough: ``Lowered.cost_analysis``
+        prices the HLO without building an executable. Only if the backend
+        can't price unoptimized HLO do we fall back to a real compile —
+        which the persistent compilation cache (when enabled) turns into a
+        disk hit. Any failure degrades to 0 (feature off) rather than
+        interrupting training.
         """
         from pytorch_distributed_tpu.runtime.device import compiled_flops
 
         try:
-            compiled = self.train_step.lower(self.state, batch).compile()
-            return compiled_flops(compiled) or 0.0
+            lowered = self.train_step.lower(self.state, batch)
+            flops = compiled_flops(lowered)
+            if not flops:
+                flops = compiled_flops(lowered.compile())
+            return flops or 0.0
         except Exception as e:  # pragma: no cover - backend-specific
             logger.info("log_mfu disabled (cost analysis failed: %s)", e)
             return 0.0
@@ -364,9 +369,13 @@ class Trainer:
                 skip -= 1
                 continue
             n = self._batch_samples(batch)
-            if cfg.log_mfu and self._step_flops is None:
+            if (
+                cfg.log_mfu
+                and self._step_flops is None
+                and (cfg.log_every or self.metrics_writer is not None)
+            ):  # don't price the step when nothing would report it
                 self._step_flops = self._measure_step_flops(batch)
-                t_last = time.perf_counter()  # don't bill the AOT compile
+                t_last = time.perf_counter()  # don't bill the measurement
                 # to the first logging window's step-time/MFU numbers
             self.state, metrics = self.train_step(self.state, batch)
             self.host_step += 1

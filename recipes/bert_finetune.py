@@ -83,7 +83,12 @@ def main(argv=None):
         state = TrainState.create(
             apply_fn=model.apply,
             params=variables["params"],
-            tx=optax.adamw(args.lr),
+            # HF fine-tuning convention: biases + LayerNorm exempt from
+            # weight decay (the reference's two-param-group AdamW)
+            tx=ptd.optim.AdamW(
+                args.lr, weight_decay=0.01,
+                no_decay=ptd.optim.DEFAULT_NO_DECAY,
+            ),
             scaler_state=scaler.init_state(),
         )
         strategy = DataParallel(extra_rules=bert_partition_rules())

@@ -240,6 +240,31 @@ def test_optim_adamw_trains():
     assert float(loss(params)) < 0.2
 
 
+def test_optim_no_decay_mask_exempts_bias_and_scale():
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu import optim as po
+
+    params = {
+        "dense": {"kernel": jnp.ones((2, 2)), "bias": jnp.ones((2,))},
+        "ln": {"scale": jnp.ones((2,)), "bias": jnp.ones((2,))},
+    }
+    mask = po.no_decay_mask()(params)
+    assert mask["dense"]["kernel"] is True
+    assert mask["dense"]["bias"] is False
+    assert mask["ln"]["scale"] is False and mask["ln"]["bias"] is False
+
+    # with zero grads, one AdamW step moves ONLY decayed params
+    tx = po.AdamW(lr=0.1, weight_decay=0.5, no_decay=po.DEFAULT_NO_DECAY)
+    state = tx.init(params)
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    updates, _ = tx.update(zeros, state, params)
+    assert float(jnp.abs(updates["dense"]["kernel"]).sum()) > 0
+    assert float(jnp.abs(updates["dense"]["bias"]).sum()) == 0
+    assert float(jnp.abs(updates["ln"]["scale"]).sum()) == 0
+
+
 @pytest.mark.slow
 def test_bert_recipe_smoke_fp16_scaler():
     """Recipe 3 end-to-end with the REAL fp16 dynamic loss scaling path
