@@ -48,10 +48,12 @@ from pytorch_distributed_tpu.runtime.distributed import (
     all_gather,
     all_gather_object,
     all_to_all,
+    reduce,
     reduce_scatter,
     broadcast,
     broadcast_object_list,
     barrier,
+    monitored_barrier,
     gather,
     scatter,
     permute,
@@ -94,10 +96,12 @@ __all__ = [
     "all_gather",
     "all_gather_object",
     "all_to_all",
+    "reduce",
     "reduce_scatter",
     "broadcast",
     "broadcast_object_list",
     "barrier",
+    "monitored_barrier",
     "gather",
     "scatter",
     "permute",

@@ -42,6 +42,7 @@ from pytorch_distributed_tpu.parallel.pipeline import (
 )
 from pytorch_distributed_tpu.parallel.ddp import (
     is_multiprocess,
+    no_sync,
     sync_grads,
 )
 
@@ -66,5 +67,6 @@ __all__ = [
     "split_microbatches",
     "merge_microbatches",
     "is_multiprocess",
+    "no_sync",
     "sync_grads",
 ]

@@ -22,6 +22,8 @@ identical across ranks.
 
 from __future__ import annotations
 
+import contextlib
+
 import jax
 import numpy as np
 from jax import tree_util
@@ -33,6 +35,21 @@ def is_multiprocess() -> bool:
 
     ring = dist.multiprocess_ring()
     return ring is not None and ring.world_size > 1
+
+
+@contextlib.contextmanager
+def no_sync():
+    """torch DDP's ``model.no_sync()`` — a documented no-op here.
+
+    torch needs it because DDP's backward hooks allreduce EVERY backward;
+    accumulation must suppress them on non-boundary microbatches. In this
+    framework gradient accumulation runs inside the jitted step
+    (``build_train_step(accum_steps=...)``) and ``sync_grads`` is invoked
+    exactly once per optimizer step, after accumulation — there is no
+    per-microbatch sync to suppress. Provided so ported scripts keep
+    their shape.
+    """
+    yield
 
 
 def sync_grads(grads):

@@ -461,6 +461,42 @@ def gather(x, dst: int = 0, *, axis=None):
     return all_gather(x, axis=axis)
 
 
+def reduce(x, dst: int = 0, op: ReduceOp = ReduceOp.SUM, *, axis=None):
+    """Reduce to ``dst`` (torch.distributed.reduce).
+
+    In torch only rank ``dst``'s output is defined; under single-controller
+    SPMD (and over the hostring, where the shm ring computes the full
+    reduction anyway) producing the reduced value everywhere costs nothing
+    extra, so this is ``all_reduce`` with the torch call shape.
+    """
+    del dst
+    return all_reduce(x, op=op, axis=axis)
+
+
+def monitored_barrier(timeout_s: Optional[float] = None) -> None:
+    """torch.distributed.monitored_barrier: a barrier that fails loudly.
+
+    Under the hostring backend the native barrier already enforces the
+    group's init-time deadline and poisons the group with a timeout error
+    when a rank never arrives — exactly monitored_barrier's job, so this
+    is that barrier; a per-call ``timeout_s`` cannot tighten the compiled
+    group deadline and is rejected rather than silently ignored. Under
+    single-controller SPMD there are no peer processes to straggle.
+    """
+    g = _group()
+    if (
+        timeout_s is not None
+        and g.ring is not None
+        and timeout_s < g.ring.timeout_s
+    ):
+        raise NotImplementedError(
+            "per-call timeout tighter than the group deadline "
+            f"({g.ring.timeout_s}s) is not supported; pass timeout_s at "
+            "init_process_group instead"
+        )
+    barrier()
+
+
 def scatter(x, src: int = 0, *, axis=None):
     """Scatter ``src``'s per-participant slices (torch.distributed.scatter).
 

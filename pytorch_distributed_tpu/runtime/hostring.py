@@ -161,6 +161,7 @@ class HostRingGroup:
         self._h = handle
         self.rank = rank
         self.world_size = world_size
+        self.timeout_s = timeout_s
         if debug is None:
             # DETAIL turns on cross-rank call verification, the analogue
             # of TORCH_DISTRIBUTED_DEBUG=DETAIL (SURVEY.md §5: collective

@@ -372,8 +372,9 @@ class Trainer:
             if (
                 cfg.log_mfu
                 and self._step_flops is None
-                and (cfg.log_every or self.metrics_writer is not None)
-            ):  # don't price the step when nothing would report it
+                and cfg.log_every
+            ):  # all reporting (log line AND metrics-writer tflops) lives
+                # inside the log_every block — never price an unused number
                 self._step_flops = self._measure_step_flops(batch)
                 t_last = time.perf_counter()  # don't bill the measurement
                 # to the first logging window's step-time/MFU numbers

@@ -1,0 +1,114 @@
+"""GPT-2-medium train-step variant timing on the real chip.
+
+Decides bench.py's transformer configuration from measurements, not
+guesses: times the train step across {xla, flash} attention x {full,
+chunked} loss at the bench shape (batch 8, seq 1024). Run ON THE CHIP
+ONLY, never under an external kill timer (BASELINE.md relay-wedge rule);
+budgets its own wall clock via PTD_PROBE_BUDGET_S (default 1500s).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+t0 = time.time()
+BUDGET_S = float(os.environ.get("PTD_PROBE_BUDGET_S", "1500"))
+
+
+def log(msg):
+    print(f"[{time.time() - t0:8.1f}s] {msg}", flush=True)
+
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import pytorch_distributed_tpu as ptd
+from pytorch_distributed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from pytorch_distributed_tpu.ops.attention import set_attention_impl
+from pytorch_distributed_tpu.parallel import DataParallel
+from pytorch_distributed_tpu.train import (
+    TrainState,
+    build_train_step,
+    causal_lm_loss_fn,
+)
+
+BATCH, SEQ = 8, 1024
+WARMUP, ITERS = 3, 20
+
+
+def time_variant(attn: str, vocab_chunk, model, params, batch):
+    set_attention_impl(attn)
+    try:
+        state = TrainState.create(
+            apply_fn=model.apply, params=params, tx=optax.adamw(3e-4)
+        )
+        strategy = DataParallel()
+        state = strategy.place(state)
+        step = strategy.compile(
+            build_train_step(
+                causal_lm_loss_fn(model, vocab_chunk_size=vocab_chunk)
+            ),
+            state,
+        )
+        t = time.time()
+        for _ in range(WARMUP):
+            state, metrics = step(state, batch)
+        loss = float(metrics["loss"])
+        compile_s = time.time() - t
+        t = time.time()
+        for _ in range(ITERS):
+            state, metrics = step(state, batch)
+        loss = float(metrics["loss"])
+        dt = (time.time() - t) / ITERS
+        tok = BATCH * SEQ / dt
+        log(
+            f"attn={attn:5s} chunk={str(vocab_chunk):5s} "
+            f"{dt * 1e3:7.1f}ms/step {tok:9.0f} tok/s loss={loss:.3f} "
+            f"(compile+warmup {compile_s:.0f}s)"
+        )
+        del state, step
+    finally:
+        set_attention_impl("auto")
+
+
+def main():
+    ptd.enable_compilation_cache()
+    ptd.init_process_group()
+    log(f"platform={ptd.platform()} kind={jax.devices()[0].device_kind}")
+    cfg = GPT2Config.medium()
+    model = GPT2LMHead(cfg)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, SEQ), jnp.int32)
+    )["params"]
+    strategy = DataParallel()
+    rng = np.random.default_rng(0)
+    batch = strategy.shard_batch(
+        {
+            "input_ids": rng.integers(
+                cfg.vocab_size, size=(BATCH, SEQ)
+            ).astype(np.int32)
+        }
+    )
+    variants = [
+        ("xla", None),
+        ("xla", 8192),
+        ("flash", None),
+        ("flash", 8192),
+    ]
+    for attn, chunk in variants:
+        if time.time() - t0 > BUDGET_S:
+            log(f"budget {BUDGET_S:.0f}s spent — skipping remaining")
+            break
+        try:
+            time_variant(attn, chunk, model, params, batch)
+        except Exception as e:
+            log(f"attn={attn} chunk={chunk} FAILED: {type(e).__name__}: {e}")
+    log("DONE")
+
+
+if __name__ == "__main__":
+    main()

@@ -216,6 +216,14 @@ def facade_worker(rank: int, world: int, name: str, q) -> None:
         local = ["from", rank] if rank == 1 else [lambda: None]
         got = ptd.broadcast_object_list(local, src=1)
         assert got == ["from", 1], got
+        rd = ptd.reduce(np.full(4, float(rank), np.float32), dst=0)
+        assert np.all(np.asarray(rd) == sum(range(world))), rd
+        ptd.monitored_barrier()  # group deadline applies
+        try:  # tighter-than-group per-call timeout is a loud refusal
+            ptd.monitored_barrier(timeout_s=0.001)
+            raise AssertionError("tight monitored_barrier did not raise")
+        except NotImplementedError:
+            pass
         ptd.barrier()
         ptd.destroy_process_group()
         q.put((rank, "ok"))

@@ -156,6 +156,16 @@ class TestProcessGroupFacade:
         out = ptd.all_reduce(x, axis="dp")
         np.testing.assert_allclose(np.asarray(out), [6.0])
 
+    def test_reduce_and_monitored_barrier(self):
+        ptd.init_process_group()
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        out = np.asarray(ptd.reduce(x, dst=3))
+        np.testing.assert_allclose(out, [28.0])
+        out = np.asarray(ptd.reduce(x, dst=0, op=ptd.ReduceOp.MAX))
+        np.testing.assert_allclose(out, [7.0])
+        ptd.monitored_barrier()  # no peers to straggle; must not raise
+        ptd.monitored_barrier(timeout_s=1.0)
+
     def test_object_collectives_single_controller(self):
         # one process drives the whole mesh, so the process world is 1:
         # all_gather_object returns this process's object alone and
