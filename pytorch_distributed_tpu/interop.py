@@ -335,3 +335,62 @@ def load_bert_weights(sd: StateDict, cfg, *, num_labels: int | None = None) -> D
     if num_labels is None:
         return trunk
     return {"bert": trunk, "classifier": lin("classifier")}
+
+
+def export_bert_weights(params, cfg) -> Dict[str, Array]:
+    """Our BertModel / BertForSequenceClassification params -> HF
+    state_dict arrays — the exact inverse of :func:`load_bert_weights`
+    (roundtrip-pinned by tests/test_interop.py).
+
+    A classification tree (``{"bert": trunk, "classifier": ...}``)
+    exports with HF's ``bert.`` prefix + ``classifier.*``; a bare trunk
+    exports ``BertModel``-style with no prefix.
+    """
+    classifier = params.get("classifier") if "bert" in params else None
+    trunk = params["bert"] if "bert" in params else params
+    pre = "bert." if classifier is not None else ""
+    D = cfg.hidden_size
+    sd: Dict[str, Array] = {}
+
+    def lin(key, p):  # flax Dense -> torch Linear
+        sd[key + ".weight"] = np.asarray(p["kernel"]).T
+        sd[key + ".bias"] = np.asarray(p["bias"])
+
+    def ln(key, p):
+        sd[key + ".weight"] = np.asarray(p["scale"])
+        sd[key + ".bias"] = np.asarray(p["bias"])
+
+    def head_proj(key, p):  # [D, H, hd] DenseGeneral -> [D, D] Linear
+        sd[key + ".weight"] = np.asarray(p["kernel"]).reshape(D, D).T
+        sd[key + ".bias"] = np.asarray(p["bias"]).reshape(D)
+
+    sd[pre + "embeddings.word_embeddings.weight"] = np.asarray(
+        trunk["word_embeddings"]["embedding"]
+    )
+    sd[pre + "embeddings.position_embeddings.weight"] = np.asarray(
+        trunk["position_embeddings"]["embedding"]
+    )
+    sd[pre + "embeddings.token_type_embeddings.weight"] = np.asarray(
+        trunk["token_type_embeddings"]["embedding"]
+    )
+    ln(pre + "embeddings.LayerNorm", trunk["embed_ln"])
+    lin(pre + "pooler.dense", trunk["pooler"])
+    for i in range(cfg.num_layers):
+        p = f"{pre}encoder.layer.{i}."
+        lyr = trunk[f"layer{i}"]
+        head_proj(p + "attention.self.query", lyr["attn"]["query"])
+        head_proj(p + "attention.self.key", lyr["attn"]["key"])
+        head_proj(p + "attention.self.value", lyr["attn"]["value"])
+        sd[p + "attention.output.dense.weight"] = (
+            np.asarray(lyr["attn"]["out"]["kernel"]).reshape(D, D).T
+        )
+        sd[p + "attention.output.dense.bias"] = np.asarray(
+            lyr["attn"]["out"]["bias"]
+        )
+        ln(p + "attention.output.LayerNorm", lyr["attn_ln"])
+        lin(p + "intermediate.dense", lyr["mlp_up"])
+        lin(p + "output.dense", lyr["mlp_down"])
+        ln(p + "output.LayerNorm", lyr["mlp_ln"])
+    if classifier is not None:
+        lin("classifier", classifier)
+    return sd

@@ -479,19 +479,19 @@ def monitored_barrier(timeout_s: Optional[float] = None) -> None:
     Under the hostring backend the native barrier already enforces the
     group's init-time deadline and poisons the group with a timeout error
     when a rank never arrives — exactly monitored_barrier's job, so this
-    is that barrier; a per-call ``timeout_s`` cannot tighten the compiled
-    group deadline and is rejected rather than silently ignored. Under
-    single-controller SPMD there are no peer processes to straggle.
+    is that barrier; a per-call ``timeout_s`` differing from the compiled
+    group deadline (tighter OR looser) cannot be honored and is rejected
+    rather than silently ignored. Under single-controller SPMD there are
+    no peer processes to straggle.
     """
     g = _group()
-    if (
-        timeout_s is not None
-        and g.ring is not None
-        and timeout_s < g.ring.timeout_s
+    if timeout_s is not None and g.ring is not None and (
+        timeout_s != g.ring.timeout_s
     ):
         raise NotImplementedError(
-            "per-call timeout tighter than the group deadline "
-            f"({g.ring.timeout_s}s) is not supported; pass timeout_s at "
+            f"per-call timeout {timeout_s}s differs from the compiled "
+            f"group deadline ({g.ring.timeout_s}s), which cannot be "
+            "overridden per call in either direction; pass timeout_s at "
             "init_process_group instead"
         )
     barrier()
