@@ -50,7 +50,9 @@ def _emit(obj, primary=False):
     sys.stderr.flush()
 
 
-def _resnet50_train_setup(image: int, stem: str = "imagenet"):
+def _resnet50_train_setup(
+    image: int, stem: str = "imagenet", batch_transform=None
+):
     """(strategy, compiled step, placed state) for the ResNet-50 benches."""
     from pytorch_distributed_tpu.models import ResNet50
     from pytorch_distributed_tpu.parallel import DataParallel
@@ -73,7 +75,10 @@ def _resnet50_train_setup(image: int, stem: str = "imagenet"):
     strategy = DataParallel()
     state = strategy.place(state)
     step = strategy.compile(
-        build_train_step(classification_loss_fn(model)), state
+        build_train_step(
+            classification_loss_fn(model), batch_transform=batch_transform
+        ),
+        state,
     )
     return strategy, step, state
 
@@ -181,49 +186,64 @@ def bench_input_pipeline(on_tpu: bool) -> None:
             fetch=pipe, prefetch=4,
         )
 
+    def timed_epochs(loader, consume, finish):
+        """Drive ``steps`` batches through ``consume``; returns seconds.
+
+        sync discipline: block_until_ready doesn't block on the axon
+        relay, so ``finish()`` must end with a host value fetch — all
+        work must have landed, and the per-fetch relay RTT is paid once.
+        """
+        done, epoch = 0, 0
+        t0 = time.perf_counter()
+        while done < steps:
+            loader.set_epoch(epoch)
+            for b in loader:
+                consume(b)
+                done += 1
+                if done >= steps:
+                    break
+            epoch += 1
+        finish()
+        return time.perf_counter() - t0
+
     # -- host-feed rate alone (assemble + device_put, no compute) ----------
-    # sync discipline: block_until_ready doesn't block on the axon relay,
-    # so chain one element of every batch into a device-side scalar and
-    # fetch it ONCE at the end — all transfers must have landed, and the
-    # per-fetch relay RTT isn't paid per batch
     loader = make_loader()
-    done = 0
-    chain = jnp.float32(0)
-    t0 = time.perf_counter()
-    epoch = 0
-    while done < steps:
-        loader.set_epoch(epoch)
-        for b in loader:
-            # scalar element reads (NOT ravel()[0] — that materializes a
-            # flattened copy of the whole batch)
-            chain = chain + b["image"][0, 0, 0, 0] + b["label"][0]
-            done += 1
-            if done >= steps:
-                break
-        epoch += 1
-    float(chain)
-    feed_dt = time.perf_counter() - t0
+    chain = [jnp.float32(0)]
+
+    def feed(b):
+        # scalar element reads (NOT ravel()[0] — that materializes a
+        # flattened copy of the whole batch); chaining them makes the
+        # final fetch wait on every transfer
+        chain[0] = chain[0] + b["image"][0, 0, 0, 0] + b["label"][0]
+
+    feed_dt = timed_epochs(loader, feed, lambda: float(chain[0]))
     feed_rate = batch * steps / feed_dt
 
-    # -- end-to-end: loader feeding the jitted train step ------------------
-    warm = next(iter(make_loader()))
-    state, metrics = step(state, warm)  # compile outside the timed loop
-    float(metrics["loss"])
+    def run_train(loader, step, state):
+        """(rate_per_chip, final_loss) of the loader feeding the step."""
+        box = [state, None]
+        box[0], metrics = step(box[0], next(iter(loader)))  # compile out
+        float(metrics["loss"])  # of the timed loop
 
-    done = 0
-    epoch = 0
-    t0 = time.perf_counter()
-    while done < steps:
-        loader.set_epoch(epoch)
-        for b in loader:
-            state, metrics = step(state, b)
-            done += 1
-            if done >= steps:
-                break
-        epoch += 1
-    final_loss = float(metrics["loss"])  # sync the whole chain
-    e2e_dt = time.perf_counter() - t0
-    e2e_rate = batch * steps / e2e_dt / n_chips
+        def consume(b):
+            box[0], box[1] = step(box[0], b)
+
+        dt = timed_epochs(loader, consume, lambda: float(box[1]["loss"]))
+        return batch * steps / dt / n_chips, float(box[1]["loss"])
+
+    # -- end-to-end: loader feeding the jitted train step ------------------
+    e2e_rate, final_loss = run_train(make_loader(), step, state)
+
+    # -- u8 ship + on-device normalize: 1/4 the host->device bytes ---------
+    pipe_u8 = ImageBatchPipeline(crop, train=True, device_normalize=True)
+    strategy8, step8, state8 = _resnet50_train_setup(
+        crop, batch_transform=pipe_u8.device_normalizer()
+    )
+    loader8 = DataLoader(
+        ds, batch, shuffle=True, sharding=strategy8.batch_sharding(),
+        fetch=pipe_u8, prefetch=4,
+    )
+    u8_rate, u8_loss = run_train(loader8, step8, state8)
 
     _emit(
         {
@@ -241,9 +261,18 @@ def bench_input_pipeline(on_tpu: bool) -> None:
             "vs_baseline": round(e2e_rate / A100_TARGET_IMG_PER_SEC, 4),
         }
     )
+    _emit(
+        {
+            "metric": "resnet50_e2e_u8_device_normalize_images_per_sec_per_chip",
+            "value": round(u8_rate, 2),
+            "unit": "images/sec/chip (uint8 ship, on-device normalize)",
+            "vs_baseline": round(u8_rate / A100_TARGET_IMG_PER_SEC, 4),
+        }
+    )
     print(
         f"# input_pipeline: feed={feed_rate:.0f} img/s e2e={e2e_rate:.0f} "
-        f"img/s/chip steps={steps} loss={final_loss:.3f}",
+        f"img/s/chip e2e_u8={u8_rate:.0f} img/s/chip steps={steps} "
+        f"loss={final_loss:.3f}/{u8_loss:.3f}",
         file=sys.stderr,
     )
 
@@ -267,7 +296,13 @@ def bench_gpt2(on_tpu: bool) -> None:
         cfg, batch, seq = GPT2Config.medium(), 8, 1024
         warmup, iters = 3, 20
     else:
-        cfg, batch, seq = GPT2Config.tiny(), 4, 64
+        import math
+
+        # batch must divide over however many virtual devices the host
+        # exposes (the 8-device CPU test mesh included)
+        cfg, batch, seq = (
+            GPT2Config.tiny(), math.lcm(8, ptd.get_world_size()), 64,
+        )
         warmup, iters = 1, 3
 
     model = GPT2LMHead(cfg)

@@ -125,4 +125,47 @@ int pf_image_batch(const uint8_t* src, int64_t n_src, int H, int W, int C,
   return 0;
 }
 
+// u8-output variant of pf_image_batch: same gather/crop/flip pass but NO
+// normalization — the batch ships to the accelerator as uint8 (1/4 the
+// host->device bytes of f32) and the (px/255 - mean) * stdinv arithmetic
+// runs on-device, where XLA fuses it into the first conv.
+int pf_image_batch_u8(const uint8_t* src, int64_t n_src, int H, int W,
+                      int C, const int64_t* indices, int64_t n,
+                      const int32_t* crop_y, const int32_t* crop_x,
+                      const uint8_t* flip, uint8_t* out, int outH, int outW,
+                      int num_threads) {
+  if (!src || !indices || !out) return kErrInval;
+  if (outH <= 0 || outW <= 0 || outH > H || outW > W || C <= 0 || C > 16)
+    return kErrInval;
+  for (int64_t i = 0; i < n; ++i) {
+    if (indices[i] < 0 || indices[i] >= n_src) return kErrInval;
+    if (crop_y && (crop_y[i] < 0 || crop_y[i] > H - outH)) return kErrInval;
+    if (crop_x && (crop_x[i] < 0 || crop_x[i] > W - outW)) return kErrInval;
+  }
+  const uint64_t src_img = uint64_t(H) * W * C;
+  const uint64_t out_img = uint64_t(outH) * outW * C;
+  const uint64_t row_bytes = uint64_t(outW) * C;
+  parallel_for(n, num_threads, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const uint8_t* img = src + uint64_t(indices[i]) * src_img;
+      uint8_t* dst = out + uint64_t(i) * out_img;
+      const int cy = crop_y ? crop_y[i] : (H - outH) / 2;
+      const int cx = crop_x ? crop_x[i] : (W - outW) / 2;
+      const bool fl = flip && flip[i];
+      for (int y = 0; y < outH; ++y) {
+        const uint8_t* row = img + (uint64_t(cy + y) * W + cx) * C;
+        uint8_t* drow = dst + uint64_t(y) * row_bytes;
+        if (!fl) {
+          memcpy(drow, row, row_bytes);
+        } else {
+          for (int x = 0; x < outW; ++x)
+            memcpy(drow + uint64_t(x) * C,
+                   row + uint64_t(outW - 1 - x) * C, C);
+        }
+      }
+    }
+  });
+  return 0;
+}
+
 }  // extern "C"

@@ -56,6 +56,13 @@ def _load() -> ctypes.CDLL:
             ctypes.c_int,
         ]
         lib.pf_image_batch.restype = ctypes.c_int
+        lib.pf_image_batch_u8.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
+        ]
+        lib.pf_image_batch_u8.restype = ctypes.c_int
         _lib = lib
     return _lib
 
@@ -89,12 +96,20 @@ class ImageBatchPipeline:
 
     Expects the dataset to expose uint8 images ``[N, H, W, C]`` and int
     labels via ``dataset.arrays`` (ArrayDataset layout). Produces
-    ``{"image": f32 [B, crop, crop, C], "label": i32 [B]}``.
+    ``{"image": [B, crop, crop, C], "label": i32 [B]}`` — image f32
+    normalized by default, raw uint8 with ``device_normalize=True``.
 
     train=True: random crop (after ``pad`` reflected/zero padding is NOT
     applied — crops sample within the source frame, ImageNet-style; for
     CIFAR pass ``pad`` to pre-pad once) + horizontal flip.
     train=False: deterministic center crop, no flip.
+
+    ``device_normalize=True`` ships the batch as **uint8** (1/4 the
+    host->device bytes — the relay/PCIe link is the input pipeline's
+    scarcest resource) and defers the ``(px/255 - mean) * stdinv``
+    arithmetic to the accelerator: apply ``self.device_normalizer()``
+    inside the jitted step (``build_train_step(batch_transform=...)``),
+    where XLA fuses it into the first conv's input.
     """
 
     def __init__(
@@ -110,6 +125,7 @@ class ImageBatchPipeline:
         num_threads: int = 0,
         image_key: str = "image",
         label_key: str = "label",
+        device_normalize: bool = False,
     ):
         self.crop = crop
         self.train = train
@@ -121,8 +137,35 @@ class ImageBatchPipeline:
         self.num_threads = num_threads
         self.image_key = image_key
         self.label_key = label_key
+        self.device_normalize = device_normalize
         self.epoch = 0
         self._padded: Optional[np.ndarray] = None
+
+    def device_normalizer(self):
+        """Jittable batch transform applying this pipeline's normalization
+        on-device (use with ``device_normalize=True``)."""
+        import jax.numpy as jnp
+
+        mean = self.mean
+        stdinv = self.stdinv
+        key = self.image_key
+
+        def normalize(batch):
+            img = batch[key]
+            if img.dtype == jnp.uint8:
+                c = img.shape[-1]
+                if mean.size not in (1, c) or stdinv.size not in (1, c):
+                    # the host f32 path fails its broadcast_to loudly for
+                    # this mismatch; match that instead of silently
+                    # broadcasting [..., 1] against (3,) into 3 channels
+                    raise ValueError(
+                        f"normalizer mean/std have {mean.size} channels "
+                        f"but the image has {c}"
+                    )
+                img = (img.astype(jnp.float32) / 255.0 - mean) * stdinv
+            return {**batch, key: img}
+
+        return normalize
 
     def set_epoch(self, epoch: int) -> None:
         """Advance the augmentation stream (DataLoader forwards this)."""
@@ -173,25 +216,38 @@ class ImageBatchPipeline:
             cy = np.full(n, (H - crop) // 2, np.int32)
             cx = np.full(n, (W - crop) // 2, np.int32)
             fl = np.zeros(n, np.uint8)
-        out = np.empty((n, crop, crop, C), np.float32)
-        mean = np.ascontiguousarray(
-            np.broadcast_to(self.mean, (C,)), np.float32
-        )
-        stdinv = np.ascontiguousarray(
-            np.broadcast_to(self.stdinv, (C,)), np.float32
-        )
-        rc = _load().pf_image_batch(
-            imgs.ctypes.data_as(ctypes.c_void_p), N, H, W, C,
-            idx.ctypes.data_as(ctypes.c_void_p), n,
-            cy.ctypes.data_as(ctypes.c_void_p),
-            cx.ctypes.data_as(ctypes.c_void_p),
-            fl.ctypes.data_as(ctypes.c_void_p),
-            mean.ctypes.data_as(ctypes.c_void_p),
-            stdinv.ctypes.data_as(ctypes.c_void_p),
-            out.ctypes.data_as(ctypes.c_void_p), crop, crop,
-            self.num_threads,
-        )
-        _check(rc, "image_batch")
+        if self.device_normalize:
+            out = np.empty((n, crop, crop, C), np.uint8)
+            rc = _load().pf_image_batch_u8(
+                imgs.ctypes.data_as(ctypes.c_void_p), N, H, W, C,
+                idx.ctypes.data_as(ctypes.c_void_p), n,
+                cy.ctypes.data_as(ctypes.c_void_p),
+                cx.ctypes.data_as(ctypes.c_void_p),
+                fl.ctypes.data_as(ctypes.c_void_p),
+                out.ctypes.data_as(ctypes.c_void_p), crop, crop,
+                self.num_threads,
+            )
+            _check(rc, "image_batch_u8")
+        else:
+            out = np.empty((n, crop, crop, C), np.float32)
+            mean = np.ascontiguousarray(
+                np.broadcast_to(self.mean, (C,)), np.float32
+            )
+            stdinv = np.ascontiguousarray(
+                np.broadcast_to(self.stdinv, (C,)), np.float32
+            )
+            rc = _load().pf_image_batch(
+                imgs.ctypes.data_as(ctypes.c_void_p), N, H, W, C,
+                idx.ctypes.data_as(ctypes.c_void_p), n,
+                cy.ctypes.data_as(ctypes.c_void_p),
+                cx.ctypes.data_as(ctypes.c_void_p),
+                fl.ctypes.data_as(ctypes.c_void_p),
+                mean.ctypes.data_as(ctypes.c_void_p),
+                stdinv.ctypes.data_as(ctypes.c_void_p),
+                out.ctypes.data_as(ctypes.c_void_p), crop, crop,
+                self.num_threads,
+            )
+            _check(rc, "image_batch")
         batch = {self.image_key: out}
         labels = dataset.arrays.get(self.label_key)
         if labels is not None:

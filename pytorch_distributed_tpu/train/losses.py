@@ -227,11 +227,20 @@ def causal_lm_eval_step(model, *, ids_key: str = "input_ids") -> Callable:
 
 
 def classification_eval_step(
-    model, *, image_key: str = "image", label_key: str = "label"
+    model,
+    *,
+    image_key: str = "image",
+    label_key: str = "label",
+    batch_transform: Optional[Callable] = None,
 ) -> Callable:
-    """``eval_step(state, batch) -> metrics`` using running BN stats."""
+    """``eval_step(state, batch) -> metrics`` using running BN stats.
+
+    ``batch_transform`` mirrors build_train_step's: an on-device transform
+    (e.g. uint8 -> normalized f32) applied inside the jitted eval."""
 
     def eval_step(state, batch) -> Dict[str, jax.Array]:
+        if batch_transform is not None:
+            batch = batch_transform(batch)
         variables = {"params": state.params}
         if state.batch_stats is not None:
             variables["batch_stats"] = state.batch_stats

@@ -62,6 +62,7 @@ def build_train_step(
     *,
     accum_steps: int = 1,
     scaler: Optional[GradScaler] = None,
+    batch_transform: Optional[Callable[[Any], Any]] = None,
 ) -> Callable[[TrainState, Any], Tuple[TrainState, Dict[str, jax.Array]]]:
     """Build ``step(state, batch) -> (state, metrics)`` for jit/Strategy.compile.
 
@@ -69,6 +70,10 @@ def build_train_step(
     sequentially — the ZeRO-1/GPT-2 recipe shape (BASELINE.json:10) — giving
     the memory profile of small batches with the optimizer math of the full
     batch.
+
+    ``batch_transform`` runs ON-DEVICE inside the jitted step, before
+    microbatch splitting — e.g. ``ImageBatchPipeline.device_normalizer()``
+    so uint8 batches ship over the host link and normalize on-chip.
     """
     scaling = scaler is not None and scaler.enabled
 
@@ -86,6 +91,8 @@ def build_train_step(
 
     def step(state: TrainState, batch):
         rng = key_for(state.step)
+        if batch_transform is not None:
+            batch = batch_transform(batch)
 
         if accum_steps == 1:
             grads, aux = grad_fn(

@@ -101,6 +101,74 @@ def test_padded_cifar_style_crop():
     assert np.isfinite(batch["image"]).all()
 
 
+def test_device_normalize_u8_path_matches_f32_path():
+    """uint8 ship + on-device normalize == host-LUT f32, exactly the same
+    crops/flips (same (seed, epoch, indices) augmentation stream)."""
+    import jax
+
+    ds = _dataset(3)
+    idx = np.arange(16)
+    f32 = ImageBatchPipeline(crop=8, train=True, seed=7)
+    u8 = ImageBatchPipeline(crop=8, train=True, seed=7, device_normalize=True)
+    a = f32(ds, idx)
+    b = u8(ds, idx)
+    assert b["image"].dtype == np.uint8
+    normalized = jax.jit(u8.device_normalizer())(
+        {k: np.asarray(v) for k, v in b.items()}
+    )
+    np.testing.assert_allclose(
+        np.asarray(normalized["image"]), a["image"], atol=1e-6
+    )
+    np.testing.assert_array_equal(np.asarray(normalized["label"]), a["label"])
+
+
+def test_device_normalize_through_train_step():
+    """u8 batches flow through build_train_step(batch_transform=...) and
+    train the same model the f32 path does."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import pytorch_distributed_tpu as ptd
+    from pytorch_distributed_tpu.models.resnet import BasicBlock, ResNet
+    from pytorch_distributed_tpu.parallel import DataParallel
+    from pytorch_distributed_tpu.train import (
+        TrainState,
+        build_train_step,
+        classification_loss_fn,
+    )
+
+    ptd.init_process_group()
+    model = ResNet(stage_sizes=[1], block_cls=BasicBlock, num_classes=4,
+                   width=8, stem="cifar")
+    v = model.init(jax.random.key(0), jnp.zeros((1, 8, 8, 3)), train=False)
+    state = TrainState.create(
+        apply_fn=model.apply, params=v["params"], tx=optax.sgd(0.1),
+        batch_stats=v["batch_stats"],
+    )
+    pipe = ImageBatchPipeline(crop=8, train=True, device_normalize=True)
+    ds = ArrayDataset(
+        image=np.random.default_rng(0).integers(
+            0, 256, size=(32, 10, 10, 3)
+        ).astype(np.uint8),
+        label=np.random.default_rng(1).integers(4, size=(32,)).astype(np.int64),
+    )
+    strategy = DataParallel()
+    state = strategy.place(state)
+    step = strategy.compile(
+        build_train_step(
+            classification_loss_fn(model),
+            batch_transform=pipe.device_normalizer(),
+        ),
+        state,
+    )
+    loader = DataLoader(ds, 16, sharding=strategy.batch_sharding(), fetch=pipe)
+    for batch in loader:
+        assert batch["image"].dtype == jnp.uint8
+        state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
 def test_dataloader_fetch_integration():
     ds = _dataset()
     pipe = ImageBatchPipeline(8, train=True, seed=1)
