@@ -21,9 +21,12 @@ from pytorch_distributed_tpu.data.native_pipeline import (
 )
 from pytorch_distributed_tpu.data.datasets import (
     ArrayDataset,
+    ConcatDataset,
+    Subset,
     SyntheticImageDataset,
     SyntheticTextDataset,
     load_cifar10,
+    random_split,
 )
 from pytorch_distributed_tpu.data.image_folder import (
     FolderImagePipeline,
@@ -45,7 +48,10 @@ __all__ = [
     "ImageBatchPipeline",
     "gather_rows",
     "ArrayDataset",
+    "ConcatDataset",
+    "Subset",
     "SyntheticImageDataset",
     "SyntheticTextDataset",
     "load_cifar10",
+    "random_split",
 ]

@@ -34,6 +34,129 @@ class ArrayDataset:
         return {k: v[i] for k, v in self.arrays.items()}
 
 
+def stack_items(items):
+    """Merge per-sample items into one batch (dict/tuple/array layouts) —
+    the same contract DataLoader's default fetch produces."""
+    first = items[0]
+    if isinstance(first, dict):
+        return {k: np.stack([it[k] for it in items]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return tuple(np.stack(col) for col in zip(*items))
+    return np.stack(items)
+
+
+class Subset:
+    """``torch.utils.data.Subset``: a dataset view over fixed indices."""
+
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = np.asarray(indices, np.int64)
+        n = len(dataset)
+        if len(self.indices) and (
+            self.indices.min() < -n or self.indices.max() >= n
+        ):
+            raise IndexError(
+                f"subset indices out of range for dataset of {n}"
+            )
+
+    def __len__(self) -> int:
+        return len(self.indices)
+
+    def __getitem__(self, i):
+        if isinstance(i, (int, np.integer)):
+            return self.dataset[int(self.indices[i])]
+        return self.dataset[self.indices[np.asarray(i)]]
+
+
+class ConcatDataset:
+    """``torch.utils.data.ConcatDataset``: chain datasets end to end."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("ConcatDataset needs at least one dataset")
+        self._offsets = np.cumsum([0] + [len(d) for d in self.datasets])
+
+    def __len__(self) -> int:
+        return int(self._offsets[-1])
+
+    def _locate(self, i: int):
+        n = len(self)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(f"index {i} out of range for {n}")
+        d = int(np.searchsorted(self._offsets, i, side="right")) - 1
+        return d, i - int(self._offsets[d])
+
+    def __getitem__(self, i):
+        if isinstance(i, (int, np.integer)):
+            d, j = self._locate(int(i))
+            return self.datasets[d][j]
+        # fancy indexing: this is DataLoader's per-batch hot path, so
+        # segment the indices per source and use each source's own
+        # vectorized gather, then restitch in request order; stack_items
+        # keeps the batch layout (a list would silently break batching)
+        idx = np.asarray(i, np.int64)
+        n = len(self)
+        idx = np.where(idx < 0, idx + n, idx)
+        if len(idx) and (idx.min() < 0 or idx.max() >= n):
+            raise IndexError(f"indices out of range for {n}")
+        which = np.searchsorted(self._offsets, idx, side="right") - 1
+        parts = []  # (request positions, gathered batch) per source
+        for d in np.unique(which):
+            pos = np.nonzero(which == d)[0]
+            local = idx[pos] - int(self._offsets[d])
+            try:
+                got = self.datasets[d][local]
+            except (TypeError, IndexError, KeyError):
+                got = stack_items(
+                    [self.datasets[d][int(j)] for j in local]
+                )
+            parts.append((pos, got))
+        order = np.concatenate([pos for pos, _ in parts])
+        inv = np.argsort(order, kind="stable")
+
+        def restitch(*arrs):
+            return np.concatenate(arrs, axis=0)[inv]
+
+        first = parts[0][1]
+        if isinstance(first, dict):
+            return {
+                k: restitch(*(got[k] for _, got in parts)) for k in first
+            }
+        if isinstance(first, (tuple, list)):
+            return tuple(
+                restitch(*(got[c] for _, got in parts))
+                for c in range(len(first))
+            )
+        return restitch(*(got for _, got in parts))
+
+
+def random_split(dataset, lengths, *, seed: int = 0):
+    """``torch.utils.data.random_split``: disjoint random Subsets.
+
+    ``lengths`` are absolute sizes summing to ``len(dataset)`` (fractions
+    summing to 1.0 also accepted, remainder going to the first split —
+    torch's convention rounds similarly).
+    """
+    n = len(dataset)
+    lengths = list(lengths)
+    if all(0.0 < l < 1.0 for l in lengths) and abs(sum(lengths) - 1.0) < 1e-6:
+        sizes = [int(l * n) for l in lengths]
+        sizes[0] += n - sum(sizes)
+        lengths = sizes
+    lengths = [int(l) for l in lengths]  # 15.0 is a valid absolute size
+    if sum(lengths) != n:
+        raise ValueError(f"split lengths {lengths} do not sum to {n}")
+    perm = np.random.default_rng(seed).permutation(n)
+    out, start = [], 0
+    for l in lengths:
+        out.append(Subset(dataset, perm[start:start + l]))
+        start += l
+    return out
+
+
 class SyntheticImageDataset:
     """Deterministic random images+labels with real-recipe shapes.
 

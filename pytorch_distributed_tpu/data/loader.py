@@ -23,16 +23,12 @@ _SENTINEL = object()
 
 def _default_fetch(dataset, indices: np.ndarray):
     """Batch-fetch: use the dataset's fancy indexing when it has it."""
+    from pytorch_distributed_tpu.data.datasets import stack_items
+
     try:
         return dataset[indices]
     except (TypeError, IndexError, KeyError):
-        items = [dataset[int(i)] for i in indices]
-        first = items[0]
-        if isinstance(first, dict):
-            return {k: np.stack([it[k] for it in items]) for k in first}
-        if isinstance(first, (tuple, list)):
-            return tuple(np.stack(col) for col in zip(*items))
-        return np.stack(items)
+        return stack_items([dataset[int(i)] for i in indices])
 
 
 class DataLoader:

@@ -171,3 +171,71 @@ class TestDataLoader:
         e0_again = [b["x"].copy() for b in dl]
         for a, b in zip(e0, e0_again):
             np.testing.assert_array_equal(a, b)
+
+
+class TestDatasetUtilities:
+    def test_subset_view_and_fancy_index(self):
+        from pytorch_distributed_tpu.data import Subset
+
+        ds = ArrayDataset(x=np.arange(10, dtype=np.float32))
+        sub = Subset(ds, [7, 2, 5])
+        assert len(sub) == 3
+        assert sub[0]["x"] == 7.0 and sub[2]["x"] == 5.0
+        np.testing.assert_array_equal(sub[[0, 2]]["x"], [7.0, 5.0])
+        import pytest
+
+        with pytest.raises(IndexError):
+            Subset(ds, [10])
+
+    def test_concat_chains_and_locates(self):
+        from pytorch_distributed_tpu.data import ConcatDataset
+
+        a = ArrayDataset(x=np.arange(4, dtype=np.float32))
+        b = ArrayDataset(x=np.arange(100, 103, dtype=np.float32))
+        cat = ConcatDataset([a, b])
+        assert len(cat) == 7
+        assert cat[3]["x"] == 3.0
+        assert cat[4]["x"] == 100.0
+        assert cat[-1]["x"] == 102.0
+        # fancy indexing crosses the source boundary and yields a stacked
+        # batch dict (the DataLoader fetch contract), not a list
+        got = cat[[3, 4, 6]]
+        np.testing.assert_array_equal(got["x"], [3.0, 100.0, 102.0])
+        dl = DataLoader(cat, batch_size=4, shuffle=False, drop_last=False)
+        batches = list(dl)
+        # the sampler pads the tail batch (lockstep contract), so every
+        # source element appears and batch shapes stay uniform
+        assert [len(b["x"]) for b in batches] == [4, 4]
+        seen = set(np.concatenate([b["x"] for b in batches]).tolist())
+        assert seen == {0.0, 1.0, 2.0, 3.0, 100.0, 101.0, 102.0}
+        import pytest
+
+        with pytest.raises(IndexError):
+            cat[7]
+
+    def test_random_split_disjoint_and_loadable(self):
+        from pytorch_distributed_tpu.data import random_split
+
+        ds = ArrayDataset(x=np.arange(20, dtype=np.float32))
+        tr, va = random_split(ds, [15, 5], seed=3)
+        assert len(tr) == 15 and len(va) == 5
+        seen = sorted(
+            float(tr[i]["x"]) for i in range(15)
+        ) + sorted(float(va[i]["x"]) for i in range(5))
+        assert sorted(seen) == list(np.arange(20.0))
+        # fractional spec with rounding remainder to the first split
+        tr, va = random_split(ds, [0.7, 0.3], seed=3)
+        assert len(tr) == 14 and len(va) == 6
+        # splits feed the DataLoader like any dataset
+        dl = DataLoader(va, batch_size=3, shuffle=False, drop_last=False)
+        got = np.concatenate([b["x"] for b in dl])
+        assert len(got) == 6
+
+    def test_random_split_bad_lengths(self):
+        import pytest
+
+        from pytorch_distributed_tpu.data import random_split
+
+        ds = ArrayDataset(x=np.arange(10, dtype=np.float32))
+        with pytest.raises(ValueError):
+            random_split(ds, [4, 4])
