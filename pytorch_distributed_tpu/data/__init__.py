@@ -13,7 +13,11 @@ TPU-native counterpart of the reference recipes' ``DistributedSampler`` +
   pinned-memory + non-blocking H2D copies in the CUDA recipes).
 """
 
-from pytorch_distributed_tpu.data.sampler import DistributedSampler, GlobalBatchSampler
+from pytorch_distributed_tpu.data.sampler import (
+    DistributedSampler,
+    GlobalBatchSampler,
+    WeightedRandomSampler,
+)
 from pytorch_distributed_tpu.data.loader import DataLoader
 from pytorch_distributed_tpu.data.native_pipeline import (
     ImageBatchPipeline,
@@ -44,6 +48,7 @@ __all__ = [
     "TokenizedTextDataset",
     "DistributedSampler",
     "GlobalBatchSampler",
+    "WeightedRandomSampler",
     "DataLoader",
     "ImageBatchPipeline",
     "gather_rows",

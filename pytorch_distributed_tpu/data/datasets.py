@@ -99,8 +99,11 @@ class ConcatDataset:
         # keeps the batch layout (a list would silently break batching)
         idx = np.asarray(i, np.int64)
         n = len(self)
+        if len(idx) == 0:
+            # empty selection: delegate so structure/dtypes are preserved
+            return self.datasets[0][idx]
         idx = np.where(idx < 0, idx + n, idx)
-        if len(idx) and (idx.min() < 0 or idx.max() >= n):
+        if idx.min() < 0 or idx.max() >= n:
             raise IndexError(f"indices out of range for {n}")
         which = np.searchsorted(self._offsets, idx, side="right") - 1
         parts = []  # (request positions, gathered batch) per source

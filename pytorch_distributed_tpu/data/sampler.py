@@ -127,19 +127,88 @@ class GlobalBatchSampler:
             idx = g.permutation(self.dataset_len)
         else:
             idx = np.arange(self.dataset_len)
-        n_full = len(idx) // self.batch_size
-        for i in range(n_full):
-            yield idx[i * self.batch_size : (i + 1) * self.batch_size]
-        rem = len(idx) - n_full * self.batch_size
-        if rem and not self.drop_last:
-            # pad the tail batch by cyclic wrapping so the batch shape is
-            # static — a ragged final batch would trigger an XLA recompile
-            # (np.resize tiles, covering datasets smaller than one batch).
-            tail = idx[n_full * self.batch_size :]
-            pad = np.resize(idx, self.batch_size - rem)
-            yield np.concatenate([tail, pad])
+        yield from _iter_global_batches(idx, self.batch_size, self.drop_last)
 
     def __len__(self) -> int:
         if self.drop_last:
             return self.dataset_len // self.batch_size
         return math.ceil(self.dataset_len / self.batch_size)
+
+
+def _iter_global_batches(
+    idx: np.ndarray, batch_size: int, drop_last: bool
+) -> Iterator[np.ndarray]:
+    """Chunk an epoch's index vector into fixed-size global batches.
+
+    The tail batch is padded by cyclic wrapping so the batch shape is
+    static — a ragged final batch would trigger an XLA recompile
+    (np.resize tiles, covering index sets smaller than one batch).
+    """
+    n_full = len(idx) // batch_size
+    for i in range(n_full):
+        yield idx[i * batch_size : (i + 1) * batch_size]
+    rem = len(idx) - n_full * batch_size
+    if rem and not drop_last:
+        tail = idx[n_full * batch_size :]
+        pad = np.resize(idx, batch_size - rem)
+        yield np.concatenate([tail, pad])
+
+
+class WeightedRandomSampler:
+    """``torch.utils.data.WeightedRandomSampler``, global-batch shaped.
+
+    Draws ``num_samples`` indices per epoch with probability proportional
+    to ``weights`` (with or without replacement), yielding whole global
+    batches like :class:`GlobalBatchSampler` (drop-in for DataLoader's
+    ``sampler=``). Epoch-seeded like every sampler here: same
+    (seed, epoch) -> same draws, so resumes replay identical data order.
+    """
+
+    def __init__(
+        self,
+        weights,
+        num_samples: int,
+        batch_size: int,
+        *,
+        replacement: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+    ):
+        self.weights = np.asarray(weights, np.float64)
+        if self.weights.ndim != 1 or len(self.weights) == 0:
+            raise ValueError("weights must be a non-empty 1-D sequence")
+        if np.any(self.weights < 0) or self.weights.sum() == 0:
+            raise ValueError("weights must be non-negative and not all zero")
+        if not replacement:
+            drawable = int(np.count_nonzero(self.weights))
+            if num_samples > drawable:
+                raise ValueError(
+                    f"cannot draw {num_samples} without replacement from "
+                    f"{drawable} nonzero-weight entries "
+                    f"({len(self.weights)} total)"
+                )
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got {batch_size}")
+        self.p = self.weights / self.weights.sum()
+        self.num_samples = num_samples
+        self.batch_size = batch_size
+        self.replacement = replacement
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        g = np.random.default_rng(self.seed + self.epoch)
+        idx = g.choice(
+            len(self.p), size=self.num_samples, replace=self.replacement,
+            p=self.p,
+        ).astype(np.int64)
+        yield from _iter_global_batches(idx, self.batch_size, self.drop_last)
+
+    def __len__(self) -> int:
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return math.ceil(self.num_samples / self.batch_size)

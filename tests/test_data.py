@@ -239,3 +239,59 @@ class TestDatasetUtilities:
         ds = ArrayDataset(x=np.arange(10, dtype=np.float32))
         with pytest.raises(ValueError):
             random_split(ds, [4, 4])
+
+
+class TestWeightedRandomSampler:
+    def test_zero_weight_never_drawn_heavy_dominates(self):
+        from pytorch_distributed_tpu.data import WeightedRandomSampler
+
+        w = np.array([0.0, 1.0, 8.0, 1.0])
+        s = WeightedRandomSampler(w, num_samples=400, batch_size=40, seed=1)
+        idx = np.concatenate(list(s))
+        assert len(idx) == 400
+        counts = np.bincount(idx, minlength=4)
+        assert counts[0] == 0
+        assert counts[2] > counts[1] and counts[2] > counts[3]
+        assert counts[2] > 200  # ~80% expected mass
+
+    def test_epoch_seeded_determinism(self):
+        from pytorch_distributed_tpu.data import WeightedRandomSampler
+
+        s = WeightedRandomSampler(
+            np.ones(16), num_samples=32, batch_size=8, seed=5
+        )
+        e0 = [b.copy() for b in s]
+        s.set_epoch(0)
+        again = [b.copy() for b in s]
+        for a, b in zip(e0, again):
+            np.testing.assert_array_equal(a, b)
+        s.set_epoch(1)
+        assert any(
+            not np.array_equal(a, b) for a, b in zip(e0, list(s))
+        )
+
+    def test_without_replacement_and_validation(self):
+        import pytest
+
+        from pytorch_distributed_tpu.data import WeightedRandomSampler
+
+        s = WeightedRandomSampler(
+            np.ones(10), num_samples=10, batch_size=5, replacement=False,
+        )
+        idx = np.concatenate(list(s))
+        assert sorted(idx.tolist()) == list(range(10))
+        with pytest.raises(ValueError):
+            WeightedRandomSampler(np.ones(4), 8, 4, replacement=False)
+        with pytest.raises(ValueError):
+            WeightedRandomSampler(np.zeros(4), 2, 2)
+
+    def test_feeds_dataloader(self):
+        from pytorch_distributed_tpu.data import WeightedRandomSampler
+
+        ds = ArrayDataset(x=np.arange(10, dtype=np.float32))
+        s = WeightedRandomSampler(
+            np.r_[np.zeros(5), np.ones(5)], num_samples=12, batch_size=4,
+        )
+        dl = DataLoader(ds, 4, sampler=s)
+        got = np.concatenate([b["x"] for b in dl])
+        assert len(got) == 12 and got.min() >= 5.0
