@@ -65,7 +65,8 @@ class ImageFolderDataset:
 
 class FolderImagePipeline:
     """DataLoader ``fetch=``: decode -> resize-shorter-side -> crop ->
-    flip -> fused normalize, ImageNet-style.
+    flip -> fused normalize, ImageNet-style (``device_normalize=True``
+    ships uint8 and defers normalization to the device).
 
     train=True: RandomResizedCrop-equivalent (random scale/area crop then
     resize to ``crop``) + horizontal flip. train=False: resize shorter
@@ -83,6 +84,7 @@ class FolderImagePipeline:
         seed: int = 0,
         scale: tuple = (0.08, 1.0),
         ratio: tuple = (3 / 4, 4 / 3),
+        device_normalize: bool = False,
     ):
         self.crop = crop
         self.train = train
@@ -92,6 +94,7 @@ class FolderImagePipeline:
         self.seed = seed
         self.scale = scale
         self.ratio = ratio
+        self.device_normalize = device_normalize
         self.epoch = 0
 
     def set_epoch(self, epoch: int) -> None:
@@ -155,5 +158,19 @@ class FolderImagePipeline:
                 )
             out[j] = np.asarray(im)
             labels[j] = label
+        if self.device_normalize:
+            # ship uint8 (1/4 the host->device bytes); apply
+            # self.device_normalizer() inside the jitted step
+            return {"image": out, "label": labels}
         images = (out.astype(np.float32) - self.mean) * self.stdinv
         return {"image": images, "label": labels}
+
+    def device_normalizer(self):
+        """Jittable on-device (px - mean)*stdinv transform (u8 mode) —
+        same contract as ImageBatchPipeline.device_normalizer."""
+        from pytorch_distributed_tpu.data.native_pipeline import (
+            make_device_normalizer,
+        )
+
+        # this pipeline's mean/stdinv are pre-scaled to the 0..255 domain
+        return make_device_normalizer(self.mean, self.stdinv, scale=1.0)

@@ -91,6 +91,37 @@ def gather_rows(src: np.ndarray, indices, num_threads: int = 0) -> np.ndarray:
     return out
 
 
+def make_device_normalizer(mean, stdinv, *, key: str = "image",
+                           scale: float = 1.0):
+    """Jittable ``(img * scale - mean) * stdinv`` batch transform for u8
+    batches (the on-device half of a pipeline's ``device_normalize`` mode).
+
+    Shared by the native and PIL/folder pipelines so the contract — u8
+    pass-through detection, channel-count validation — lives once.
+    """
+    import jax.numpy as jnp
+
+    mean = np.asarray(mean, np.float32)
+    stdinv = np.asarray(stdinv, np.float32)
+
+    def normalize(batch):
+        img = batch[key]
+        if img.dtype == jnp.uint8:
+            c = img.shape[-1]
+            if mean.size not in (1, c) or stdinv.size not in (1, c):
+                # the host f32 paths fail their broadcast_to loudly for
+                # this mismatch; match that instead of silently
+                # broadcasting [..., 1] against (3,) into 3 channels
+                raise ValueError(
+                    f"normalizer mean/std have {mean.size} channels "
+                    f"but the image has {c}"
+                )
+            img = (img.astype(jnp.float32) * scale - mean) * stdinv
+        return {**batch, key: img}
+
+    return normalize
+
+
 class ImageBatchPipeline:
     """Fetch callable for :class:`DataLoader`: native augmenting assembly.
 
@@ -144,28 +175,9 @@ class ImageBatchPipeline:
     def device_normalizer(self):
         """Jittable batch transform applying this pipeline's normalization
         on-device (use with ``device_normalize=True``)."""
-        import jax.numpy as jnp
-
-        mean = self.mean
-        stdinv = self.stdinv
-        key = self.image_key
-
-        def normalize(batch):
-            img = batch[key]
-            if img.dtype == jnp.uint8:
-                c = img.shape[-1]
-                if mean.size not in (1, c) or stdinv.size not in (1, c):
-                    # the host f32 path fails its broadcast_to loudly for
-                    # this mismatch; match that instead of silently
-                    # broadcasting [..., 1] against (3,) into 3 channels
-                    raise ValueError(
-                        f"normalizer mean/std have {mean.size} channels "
-                        f"but the image has {c}"
-                    )
-                img = (img.astype(jnp.float32) / 255.0 - mean) * stdinv
-            return {**batch, key: img}
-
-        return normalize
+        return make_device_normalizer(
+            self.mean, self.stdinv, key=self.image_key, scale=1.0 / 255.0
+        )
 
     def set_epoch(self, epoch: int) -> None:
         """Advance the augmentation stream (DataLoader forwards this)."""

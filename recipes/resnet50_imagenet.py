@@ -63,6 +63,7 @@ class Config(RecipeConfig):
     flip_augment: bool = True  # doc: random horizontal flip on host
     stem: str = "imagenet"  # doc: stem variant: imagenet | s2d (MXU-friendly)
     log_mfu: bool = False  # doc: append achieved TFLOP/s + MFU to step logs
+    device_normalize: bool = False  # doc: ship uint8 batches, normalize on-chip (real-data path)
 
 
 def _flip_transform(seed: int):
@@ -107,9 +108,13 @@ def main(argv=None):
         train_ds = ImageFolderDataset(os.path.join(real_root, "train"))
         eval_ds = ImageFolderDataset(os.path.join(real_root, "val"))
         train_fetch = FolderImagePipeline(
-            cfg.image_size, train=True, seed=cfg.seed
+            cfg.image_size, train=True, seed=cfg.seed,
+            device_normalize=cfg.device_normalize,
         )
-        eval_fetch = FolderImagePipeline(cfg.image_size, train=False)
+        eval_fetch = FolderImagePipeline(
+            cfg.image_size, train=False,
+            device_normalize=cfg.device_normalize,
+        )
         n_train = len(train_ds)
         log_rank0(
             "real data: %d train / %d eval images, %d classes",
@@ -168,6 +173,16 @@ def main(argv=None):
         fetch=eval_fetch,
     )
 
+    normalizer = None
+    if cfg.device_normalize:
+        if train_fetch is None:
+            log_rank0(
+                "WARNING: --device-normalize only applies to the on-disk "
+                "ImageFolder path; synthetic batches are already f32 — "
+                "flag ignored"
+            )
+        else:
+            normalizer = train_fetch.device_normalizer()
     trainer = Trainer(
         state,
         strategy,
@@ -176,10 +191,11 @@ def main(argv=None):
                 model,
                 weight_decay=cfg.weight_decay,
                 label_smoothing=cfg.label_smoothing,
-            )
+            ),
+            batch_transform=normalizer,
         ),
         train_loader,
-        eval_step=classification_eval_step(model),
+        eval_step=classification_eval_step(model, batch_transform=normalizer),
         eval_loader=eval_loader,
         config=TrainerConfig(
             epochs=cfg.epochs,

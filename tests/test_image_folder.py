@@ -83,6 +83,47 @@ def test_dataloader_end_to_end(image_root):
         assert b["image"].shape == (4, 24, 24, 3)
 
 
+def test_device_normalize_matches_host_path(image_root):
+    import jax
+
+    ds = ImageFolderDataset(str(image_root / "val"))
+    host = FolderImagePipeline(32, train=False, resize=48)
+    dev = FolderImagePipeline(
+        32, train=False, resize=48, device_normalize=True
+    )
+    a = host(ds, np.arange(6))
+    b = dev(ds, np.arange(6))
+    assert b["image"].dtype == np.uint8
+    normed = jax.jit(dev.device_normalizer())(
+        {k: np.asarray(v) for k, v in b.items()}
+    )
+    np.testing.assert_allclose(
+        np.asarray(normed["image"]), a["image"], atol=1e-4
+    )
+    np.testing.assert_array_equal(np.asarray(normed["label"]), a["label"])
+
+
+@pytest.mark.slow
+def test_resnet50_recipe_trains_on_image_folder_device_normalize(image_root):
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "recipes")
+    )
+    import resnet50_imagenet
+
+    metrics = resnet50_imagenet.main(
+        [
+            "--data-dir", str(image_root), "--epochs", "1",
+            "--batch-size", "8", "--image-size", "32", "--dp", "-1",
+            "--log-every", "1", "--warmup-epochs", "0",
+            "--device-normalize",
+        ]
+    )
+    assert "accuracy" in metrics
+
+
 @pytest.mark.slow
 def test_resnet50_recipe_trains_on_image_folder(image_root):
     import os
