@@ -62,6 +62,9 @@ def parse_args(argv=None):
     p.add_argument("--dp", type=int, default=-1)
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--pp", type=int, default=1, help="pipeline stages")
+    p.add_argument("--vocab-chunk", type=int, default=None,
+                   help="chunked-vocab loss: never materialize [B,S,V] "
+                        "logits (ops/lm_loss.py); ZeRO-1 path only")
     p.add_argument("--steps-per-epoch", type=int, default=None)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--seed", type=int, default=0)
@@ -134,6 +137,12 @@ def main(argv=None):
         ),
     )
     if args.pp > 1:
+        if args.vocab_chunk is not None:
+            raise SystemExit(
+                "--vocab-chunk is not supported with --pp > 1: the "
+                "pipelined loss builds its own head projection; drop one "
+                "of the flags"
+            )
         from pytorch_distributed_tpu.parallel.pipeline_lm import (
             PipelineParallel,
             pipelined_causal_lm_loss_fn,
@@ -147,7 +156,9 @@ def main(argv=None):
         accum_steps = 1
     else:
         strategy = ZeRO1(extra_rules=gpt2_partition_rules())
-        loss_fn = causal_lm_loss_fn(model)
+        loss_fn = causal_lm_loss_fn(
+            model, vocab_chunk_size=args.vocab_chunk
+        )
         accum_steps = args.accum_steps
     if tokenizer is not None:
         eval_ds = ds  # token-level held-out split is the user's concern;
