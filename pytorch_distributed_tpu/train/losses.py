@@ -206,15 +206,45 @@ def text_classification_loss_fn(model) -> Callable:
     return loss_fn
 
 
-def causal_lm_eval_step(model, *, ids_key: str = "input_ids") -> Callable:
+def causal_lm_eval_step(
+    model,
+    *,
+    ids_key: str = "input_ids",
+    vocab_chunk_size: Optional[int] = None,
+) -> Callable:
     """``eval_step(state, batch) -> metrics`` for decoder LMs.
 
     Reports mean next-token loss and perplexity (the LM recipes' standard
     eval, e.g. GPT-2 validation) — exp of the f32 token-mean CE.
+
+    ``vocab_chunk_size`` mirrors the train loss: eval through the chunked
+    op so the periodic eval pass never allocates the [B,S,V] logits the
+    chunked TRAIN step was chosen to avoid.
     """
 
     def eval_step(state, batch) -> Dict[str, jax.Array]:
         ids = batch[ids_key]
+        if vocab_chunk_size is not None:
+            from pytorch_distributed_tpu.ops.lm_loss import (
+                causal_lm_chunked_loss,
+            )
+            from pytorch_distributed_tpu.runtime.precision import (
+                current_policy,
+            )
+
+            hidden = model.apply(
+                {"params": state.params}, ids, train=False,
+                return_hidden=True,
+            )
+            weight, vocab_axis = _lm_projection_weight(state.params)
+            loss = causal_lm_chunked_loss(
+                hidden.astype(current_policy().compute_dtype),
+                weight,
+                ids,
+                chunk_size=vocab_chunk_size,
+                vocab_axis=vocab_axis,
+            )
+            return {"loss": loss, "perplexity": jnp.exp(loss)}
         logits = model.apply({"params": state.params}, ids, train=False)
         loss = jnp.mean(
             optax.softmax_cross_entropy_with_integer_labels(

@@ -83,6 +83,13 @@ def parse_args(argv=None):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.pp > 1 and args.vocab_chunk is not None:
+        # fail BEFORE corpus/tokenizer/model setup burns minutes
+        raise SystemExit(
+            "--vocab-chunk is not supported with --pp > 1: the "
+            "pipelined loss builds its own head projection; drop one "
+            "of the flags"
+        )
     ptd.seed_all(args.seed)
     ptd.init_process_group(
         args.backend,
@@ -137,12 +144,6 @@ def main(argv=None):
         ),
     )
     if args.pp > 1:
-        if args.vocab_chunk is not None:
-            raise SystemExit(
-                "--vocab-chunk is not supported with --pp > 1: the "
-                "pipelined loss builds its own head projection; drop one "
-                "of the flags"
-            )
         from pytorch_distributed_tpu.parallel.pipeline_lm import (
             PipelineParallel,
             pipelined_causal_lm_loss_fn,
@@ -176,7 +177,9 @@ def main(argv=None):
             ds, args.batch_size, seed=args.seed,
             sharding=strategy.batch_sharding(),
         ),
-        eval_step=causal_lm_eval_step(model),
+        eval_step=causal_lm_eval_step(
+            model, vocab_chunk_size=args.vocab_chunk
+        ),
         eval_loader=DataLoader(
             eval_ds, args.batch_size, shuffle=False,
             sharding=strategy.batch_sharding(),
