@@ -129,6 +129,40 @@ def AdamW(
     )
 
 
+def Adafactor(
+    lr: ScalarOrSchedule,
+    weight_decay: float = 0.0,
+    *,
+    min_dim_size_to_factor: int = 128,
+    no_decay: Optional[Sequence[str]] = None,
+) -> optax.GradientTransformation:
+    """Adafactor (Shazeer & Stern) — the TPU-era memory-efficient choice.
+
+    Adam keeps two f32 moments per parameter (+8 GB per billion params);
+    Adafactor factors the second moment into row/column statistics, so an
+    8B model's optimizer state drops from ~3x params to ~2x. The torch
+    ecosystem reaches it via transformers.Adafactor; here it is a
+    first-class facade over optax with the same call shape (and the same
+    ``no_decay`` masking) as the other constructors. ``lr`` is required:
+    optax's ``learning_rate=None`` would silently skip lr scaling
+    altogether, not fall back to the paper's relative-step schedule —
+    pass e.g. ``WarmupCosine(...)`` or a constant.
+    """
+    if lr is None:
+        raise ValueError(
+            "Adafactor needs an explicit lr (optax would otherwise skip "
+            "lr scaling entirely, not use the paper's relative steps)"
+        )
+    return optax.adafactor(
+        learning_rate=lr,
+        min_dim_size_to_factor=min_dim_size_to_factor,
+        weight_decay_rate=weight_decay if weight_decay else None,
+        weight_decay_mask=(
+            _decay_mask_arg(no_decay) if weight_decay else None
+        ),
+    )
+
+
 # -- lr "schedulers": schedules you pass AS the lr -------------------------
 
 

@@ -130,6 +130,12 @@ def infer_sharding(
 ) -> NamedSharding:
     mesh = mesh or current_mesh()
     spec = rules.spec_for(path, shape, mesh)
+    if spec is not None and len(spec) > len(shape):
+        # path-matched a higher-rank rule: optimizer states can carry a
+        # param's path at REDUCED rank (adafactor's factored v_row/v_col
+        # drop a dimension) — replicate those rather than mis-apply the
+        # param's spec; they are O(rows+cols), not worth sharding anyway
+        spec = None
     return NamedSharding(mesh, spec if spec is not None else P())
 
 
@@ -146,6 +152,46 @@ def infer_tree_shardings(tree, rules: PartitionRules, mesh: Optional[Mesh] = Non
         return infer_sharding(rules, path_str(path), shape, mesh)
 
     return jax.tree_util.tree_map_with_path(leaf_sharding, tree)
+
+
+def infer_opt_tree_shardings(
+    opt_state,
+    params,
+    rules: PartitionRules,
+    mesh: Optional[Mesh] = None,
+    *,
+    mismatch_rules: Optional[PartitionRules] = None,
+):
+    """Shardings for optimizer state, validated against the PARAM shapes.
+
+    Optimizer-state leaves carry their parameter's path (``mu/embed/
+    embedding``), so path rules written for params match them — correct
+    exactly when the state leaf is param-shaped (Adam moments). States at
+    a DIFFERENT shape (adafactor's factored ``v_row``/``v_col``) must NOT
+    inherit the param's path rules: the dims a TP spec names are gone,
+    and a ``stacked()`` rule can even mis-apply cleanly when ranks
+    collide. Those leaves fall back to ``mismatch_rules`` — typically the
+    strategy's shape-generic ``shard_along`` fallback, which is safe on
+    any rank — or replicate.
+    """
+    mesh = mesh or current_mesh()
+    param_shapes = {
+        path_str(p): tuple(l.shape)
+        for p, l in jax.tree_util.tree_leaves_with_path(params)
+        if hasattr(l, "shape")
+    }
+
+    def leaf_sharding(path, leaf):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        p = path_str(path)
+        for param_path, param_shape in param_shapes.items():
+            if p.endswith(param_path) and shape != param_shape:
+                if mismatch_rules is None:
+                    return NamedSharding(mesh, P())
+                return infer_sharding(mismatch_rules, p, shape, mesh)
+        return infer_sharding(rules, p, shape, mesh)
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, opt_state)
 
 
 REPLICATED = PartitionRules([(".*", None)])

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pytorch_distributed_tpu.parallel.sharding import (
     PartitionRules,
+    infer_opt_tree_shardings,
     infer_tree_shardings,
     place_global_batch,
     shard_along,
@@ -131,7 +132,14 @@ class Strategy:
         """TrainState-of-NamedShardings matching ``state``'s structure."""
         repl = NamedSharding(self.mesh, P())
         params = infer_tree_shardings(state.params, self.param_rules(), self.mesh)
-        opt = infer_tree_shardings(state.opt_state, self.opt_rules(), self.mesh)
+        opt = infer_opt_tree_shardings(
+            state.opt_state, state.params, self.opt_rules(), self.mesh,
+            # shape-mismatched states (factored stats) skip the TP path
+            # rules and take the shape-generic fallback, safe on any rank
+            mismatch_rules=PartitionRules(
+                [(".*", self._fallback_opt_spec())]
+            ),
+        )
         aux = jax.tree_util.tree_map(lambda _: repl, state.batch_stats)
         scaler = jax.tree_util.tree_map(lambda _: repl, state.scaler_state)
         return state.replace(

@@ -81,6 +81,30 @@ def classification_loss_fn(
     return loss_fn
 
 
+def _chunked_lm_loss(model, params, ids, chunk_size, *, train, rng=None):
+    """Shared train/eval body of the chunked-vocab LM loss: apply with
+    return_hidden, project through the native-layout head chunk-wise.
+
+    hidden runs in compute dtype (bf16 MXU) with f32 accumulation in the
+    op; the projection stays in its native layout/dtype and is sliced+cast
+    per chunk — same numerics as the full-logits path."""
+    from pytorch_distributed_tpu.ops.lm_loss import causal_lm_chunked_loss
+    from pytorch_distributed_tpu.runtime.precision import current_policy
+
+    kwargs = {"rngs": {"dropout": rng}} if train else {}
+    hidden = model.apply(
+        {"params": params}, ids, train=train, return_hidden=True, **kwargs
+    )
+    weight, vocab_axis = _lm_projection_weight(params)
+    return causal_lm_chunked_loss(
+        hidden.astype(current_policy().compute_dtype),
+        weight,
+        ids,
+        chunk_size=chunk_size,
+        vocab_axis=vocab_axis,
+    )
+
+
 def _lm_projection_weight(params):
     """(projection, vocab_axis) from an LM's param tree, in the weight's
     NATIVE layout (transposing/casting up front would materialize a second
@@ -123,26 +147,9 @@ def causal_lm_loss_fn(
         )
 
     def chunked_loss_fn(params, batch_stats, batch, rng):
-        from pytorch_distributed_tpu.ops.lm_loss import causal_lm_chunked_loss
-
-        ids = batch[ids_key]
-        hidden = model.apply(
-            {"params": params}, ids, train=True, rngs={"dropout": rng},
-            return_hidden=True,
-        )
-        from pytorch_distributed_tpu.runtime.precision import current_policy
-
-        policy = current_policy()
-        weight, vocab_axis = _lm_projection_weight(params)
-        loss = causal_lm_chunked_loss(
-            # hidden in compute dtype (bf16 MXU) with f32 accumulation in
-            # the op; the projection stays in its native layout/dtype and
-            # is sliced+cast per chunk — same numerics as the full path
-            hidden.astype(policy.compute_dtype),
-            weight,
-            ids,
-            chunk_size=vocab_chunk_size,
-            vocab_axis=vocab_axis,
+        loss = _chunked_lm_loss(
+            model, params, batch[ids_key], vocab_chunk_size,
+            train=True, rng=rng,
         )
         return loss, {"metrics": {"loss": loss}, "batch_stats": batch_stats}
 
@@ -225,24 +232,8 @@ def causal_lm_eval_step(
     def eval_step(state, batch) -> Dict[str, jax.Array]:
         ids = batch[ids_key]
         if vocab_chunk_size is not None:
-            from pytorch_distributed_tpu.ops.lm_loss import (
-                causal_lm_chunked_loss,
-            )
-            from pytorch_distributed_tpu.runtime.precision import (
-                current_policy,
-            )
-
-            hidden = model.apply(
-                {"params": state.params}, ids, train=False,
-                return_hidden=True,
-            )
-            weight, vocab_axis = _lm_projection_weight(state.params)
-            loss = causal_lm_chunked_loss(
-                hidden.astype(current_policy().compute_dtype),
-                weight,
-                ids,
-                chunk_size=vocab_chunk_size,
-                vocab_axis=vocab_axis,
+            loss = _chunked_lm_loss(
+                model, state.params, ids, vocab_chunk_size, train=False
             )
             return {"loss": loss, "perplexity": jnp.exp(loss)}
         logits = model.apply({"params": state.params}, ids, train=False)

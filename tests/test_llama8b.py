@@ -104,6 +104,45 @@ def test_8b_fsdp_state_fits_v5p64(abstract_8b_state):
     assert per_device < V4_HBM_BYTES / 3
 
 
+def test_8b_adafactor_halves_optimizer_state(abstract_8b_state):
+    """Adafactor's factored second moment: the 8B TrainState's total bytes
+    drop from ~3x params (AdamW m+v) to ~2x (one momentum-free factored
+    state) — the difference that fits 8B training on fewer chips."""
+    cfg, model, adamw_abstract = abstract_8b_state
+    from pytorch_distributed_tpu import optim as po
+
+    def make_state(key):
+        params = model.init(key, jnp.zeros((1, SEQ), jnp.int32))["params"]
+        return TrainState.create(
+            apply_fn=model.apply, params=params, tx=po.Adafactor(1e-4)
+        )
+
+    abstract = jax.eval_shape(make_state, jax.random.key(0))
+
+    def total_bytes(a):
+        return sum(
+            int(np.prod(l.shape)) * l.dtype.itemsize
+            for l in jax.tree_util.tree_leaves(a)
+            if hasattr(l, "shape")
+        )
+
+    params_b = total_bytes(adamw_abstract.params)
+    adamw_b = total_bytes(adamw_abstract)
+    adafactor_b = total_bytes(abstract)
+    assert adamw_b > 2.9 * params_b  # params + m + v
+    # factored stats are O(rows+cols); whole state well under 2.2x params
+    assert adafactor_b < 2.2 * params_b, (
+        f"adafactor state {adafactor_b/1e9:.1f} GB vs params "
+        f"{params_b/1e9:.1f} GB"
+    )
+    # and it still shards under FSDP without leaving big replicas
+    per_device, replicated_big = _per_device_bytes(
+        abstract, FSDP(AbstractMesh((1, 64), ("dp", "fsdp")))
+    )
+    assert not replicated_big, replicated_big[:5]
+    assert per_device < 1.5e9, f"{per_device/1e9:.2f} GB/device"
+
+
 def test_8b_decode_cache_bytes_bounded_by_cache_len(abstract_8b_state):
     """8B KV-cache decode traces via eval_shape, and the generation-sized
     cache (generation.py passes cache_len = prompt+new) is ~27x smaller
