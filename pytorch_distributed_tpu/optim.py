@@ -219,6 +219,50 @@ def LinearLR(
     )
 
 
+def ExponentialLR(lr: float, gamma: float) -> optax.Schedule:
+    """Decay by ``gamma`` every optimizer step."""
+
+    def schedule(count):
+        return lr * gamma ** count
+
+    return schedule
+
+
+def LambdaLR(lr: float, lr_lambda) -> optax.Schedule:
+    """``lr * lr_lambda(step)`` — the reference recipes' warmup hand-rolls.
+
+    ``lr_lambda`` must be jax-traceable (it is called with a traced step
+    count inside the jitted update): jnp ops and arithmetic, no Python
+    branching on the count.
+    """
+
+    def schedule(count):
+        return lr * lr_lambda(count)
+
+    return schedule
+
+
+def OneCycleLR(
+    max_lr: float,
+    total_steps: int,
+    pct_start: float = 0.3,
+    div_factor: float = 25.0,
+    final_div_factor: float = 1e4,
+) -> optax.Schedule:
+    """torch's one-cycle policy: linear ramp to ``max_lr`` over
+    ``pct_start`` of the run, cosine anneal to ``max_lr/final_div_factor``.
+    """
+    warmup = max(int(total_steps * pct_start), 1)
+    return optax.warmup_cosine_decay_schedule(
+        init_value=max_lr / div_factor,
+        peak_value=max_lr,
+        warmup_steps=warmup,
+        decay_steps=max(total_steps, warmup + 1),
+        # torch ends at initial_lr/final_div_factor, NOT max_lr/final_div
+        end_value=max_lr / div_factor / final_div_factor,
+    )
+
+
 def clip_grad_norm(
     tx: optax.GradientTransformation, max_norm: float
 ) -> optax.GradientTransformation:

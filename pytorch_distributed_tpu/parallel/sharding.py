@@ -130,12 +130,10 @@ def infer_sharding(
 ) -> NamedSharding:
     mesh = mesh or current_mesh()
     spec = rules.spec_for(path, shape, mesh)
-    if spec is not None and len(spec) > len(shape):
-        # path-matched a higher-rank rule: optimizer states can carry a
-        # param's path at REDUCED rank (adafactor's factored v_row/v_col
-        # drop a dimension) — replicate those rather than mis-apply the
-        # param's spec; they are O(rows+cols), not worth sharding anyway
-        spec = None
+    # NOTE: a spec whose rank exceeds the leaf's is NOT silently dropped
+    # here — for params that is a bad rule and must fail loudly at
+    # NamedSharding/jit; rank-reduced OPTIMIZER states are routed around
+    # the path rules by infer_opt_tree_shardings' shape validation.
     return NamedSharding(mesh, spec if spec is not None else P())
 
 
@@ -184,11 +182,19 @@ def infer_opt_tree_shardings(
     def leaf_sharding(path, leaf):
         shape = tuple(getattr(leaf, "shape", ()) or ())
         p = path_str(path)
-        for param_path, param_shape in param_shapes.items():
-            if p.endswith(param_path) and shape != param_shape:
-                if mismatch_rules is None:
-                    return NamedSharding(mesh, P())
-                return infer_sharding(mismatch_rules, p, shape, mesh)
+        # segment-aligned suffix match, LONGEST param path wins: plain
+        # endswith would let 'dense/kernel' claim '.../decoder/dense/
+        # kernel' (or even 'cond_dense/kernel') and mis-classify an
+        # exactly-param-shaped moment as a mismatch
+        best = None
+        for param_path in param_shapes:
+            if p == param_path or p.endswith("/" + param_path):
+                if best is None or len(param_path) > len(best):
+                    best = param_path
+        if best is not None and shape != param_shapes[best]:
+            if mismatch_rules is None:
+                return NamedSharding(mesh, P())
+            return infer_sharding(mismatch_rules, p, shape, mesh)
         return infer_sharding(rules, p, shape, mesh)
 
     return jax.tree_util.tree_map_with_path(leaf_sharding, opt_state)

