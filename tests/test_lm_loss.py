@@ -72,6 +72,7 @@ def test_gradients_match_full_loss():
                                atol=1e-6)
 
 
+@pytest.mark.slow
 def test_gpt2_chunked_loss_fn_matches_full():
     from pytorch_distributed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
     from pytorch_distributed_tpu.train import causal_lm_loss_fn
@@ -91,6 +92,7 @@ def test_gpt2_chunked_loss_fn_matches_full():
     np.testing.assert_allclose(float(lc), float(lf), rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_llama_chunked_loss_fn_matches_full():
     from pytorch_distributed_tpu.models.llama import (
         LlamaConfig,

@@ -41,6 +41,7 @@ class TestResNet:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow
     def test_s2d_stem_resnet_runs_and_downsamples_like_imagenet(self):
         a = ResNet(stage_sizes=[1, 1], block_cls=BasicBlock, num_classes=5,
                    width=8, stem="imagenet")

@@ -137,6 +137,7 @@ class TestTrainerLoop:
         bn_after = np.asarray(jax.tree_util.tree_leaves(out.batch_stats)[0])
         assert not np.array_equal(bn_before, bn_after)  # stats really update
 
+    @pytest.mark.slow
     def test_log_mfu_measures_step_flops(self, dp8):
         model = tiny_resnet()
         state = tiny_image_state(model)
