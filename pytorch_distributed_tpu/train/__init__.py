@@ -25,6 +25,9 @@ from pytorch_distributed_tpu.train.checkpoint import (
     restore_checkpoint,
     checkpoint_exists,
     checkpoint_step,
+    prune_checkpoints,
+    resolve_tag,
+    step_tags,
 )
 from pytorch_distributed_tpu.train.elastic import (
     EX_TEMPFAIL,
@@ -55,4 +58,7 @@ __all__ = [
     "Watchdog",
     "fit_elastic",
     "checkpoint_step",
+    "prune_checkpoints",
+    "resolve_tag",
+    "step_tags",
 ]

@@ -221,6 +221,70 @@ def save_checkpoint(ckpt_dir: str, state: TrainState, *, tag: str = "latest") ->
     return _save_sync(ckpt_dir, tag, _snapshot(state), _host_int(state.step))
 
 
+def step_tags(ckpt_dir: str) -> List[int]:
+    """Sorted step numbers of the ``step-<N>`` checkpoints present."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    out = []
+    for name in os.listdir(ckpt_dir):
+        if name.startswith("step-") and not name.endswith(".old"):
+            try:
+                out.append(int(name[len("step-"):]))
+            except ValueError:
+                continue
+    return sorted(out)
+
+
+def prune_checkpoints(ckpt_dir: str, *, keep: int) -> List[str]:
+    """Delete the oldest ``step-<N>`` checkpoints beyond ``keep``.
+
+    Only step-tagged directories participate; ``latest``/``best``/custom
+    tags are never pruned. Returns the removed paths. Multi-host: call on
+    process 0 only (the commit owner). ``keep=0`` is allowed for the
+    prune-before-save pattern (the imminent save provides the survivor).
+    """
+    if keep < 0:
+        raise ValueError(f"keep must be >= 0, got {keep}")
+    steps = step_tags(ckpt_dir)
+    removed = []
+    for step in (steps if keep == 0 else steps[:-keep]):
+        path = os.path.join(ckpt_dir, f"step-{step}")
+        shutil.rmtree(path, ignore_errors=True)
+        removed.append(path)
+    # orphaned partial writes: a kill mid-save leaves step-<N>.tmp, and a
+    # step tag is never saved twice, so nothing else ever cleans them —
+    # they would accumulate full-size dirs across preempted restarts.
+    # Only LIVE tags' tmps are spared (their own next save owns them).
+    live = {f"step-{s}" for s in step_tags(ckpt_dir)}
+    if os.path.isdir(ckpt_dir):
+        for name in os.listdir(ckpt_dir):
+            if (
+                name.startswith("step-")
+                and name.endswith(".tmp")
+                and name[: -len(".tmp")] not in live
+            ):
+                path = os.path.join(ckpt_dir, name)
+                shutil.rmtree(path, ignore_errors=True)
+                removed.append(path)
+    return removed
+
+
+def resolve_tag(ckpt_dir: str, tag: str = "latest") -> Optional[str]:
+    """The tag to restore: the requested one if present; only the DEFAULT
+    ``latest`` falls back to the highest ``step-<N>`` (retention-style
+    runs may have no ``latest``). An explicit tag that is absent resolves
+    to None — silently substituting a different checkpoint for a named
+    request would hand back the wrong weights."""
+    if checkpoint_exists(ckpt_dir, tag):
+        return tag
+    if tag != "latest":
+        return None
+    steps = step_tags(ckpt_dir)
+    if steps and checkpoint_exists(ckpt_dir, f"step-{steps[-1]}"):
+        return f"step-{steps[-1]}"
+    return None
+
+
 class AsyncCheckpointer:
     """Overlap checkpoint IO with training.
 

@@ -17,6 +17,7 @@ BASELINE.json:5,9,10) with one jit-compiled function:
 from __future__ import annotations
 
 import dataclasses
+import math
 import os
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -185,6 +186,11 @@ class TrainerConfig:
     log_mfu: bool = False  # append achieved TFLOP/s + MFU to step logs
     # (costs one AOT lower+compile of the train step on the first batch —
     # a disk hit when the persistent compilation cache is enabled)
+    keep_checkpoints: Optional[int] = None  # with ckpt_every_steps: save
+    # step-<N> tags and retain only the newest N (latest/best untouched)
+    keep_best: Optional[str] = None  # eval metric name: save tag 'best'
+    # whenever it improves
+    best_mode: str = "max"  # 'max' (accuracy-like) or 'min' (loss-like)
 
 
 class Trainer:
@@ -236,6 +242,21 @@ class Trainer:
         self._watchdog = None
         self._async_ckpt = None
         self._step_flops = None  # per-step FLOPs (log_mfu), set lazily
+        self._best_value: Optional[float] = None  # keep_best tracking
+        # (resets on resume: a restored run re-establishes its best)
+        if self.config.best_mode not in ("max", "min"):
+            raise ValueError(
+                f"best_mode must be 'max' or 'min', "
+                f"got {self.config.best_mode!r}"
+            )
+        if (
+            self.config.keep_checkpoints is not None
+            and self.config.keep_checkpoints < 1
+        ):  # fail at construction, not at the first mid-training prune
+            raise ValueError(
+                f"keep_checkpoints must be >= 1, "
+                f"got {self.config.keep_checkpoints}"
+            )
         if self.config.async_checkpoint:
             from pytorch_distributed_tpu.train.checkpoint import (
                 AsyncCheckpointer,
@@ -264,16 +285,45 @@ class Trainer:
             self._watchdog.tick()  # a slow (sharded) save is not a hang
         return path
 
+    def _prune_checkpoints(self, extra_slot: bool = False) -> None:
+        """``extra_slot=True`` prunes to keep-1 (an imminent save supplies
+        the survivor) — the prune-before-save pattern that keeps async
+        saves overlapped with training."""
+        cfg = self.config
+        if not (cfg.keep_checkpoints and cfg.ckpt_dir):
+            return
+        # only the commit owner prunes (matches who swings the renames)
+        if dist.multiprocess_ring() is not None and dist.get_rank() != 0:
+            return
+        if jax.process_index() != 0:
+            return
+        from pytorch_distributed_tpu.train.checkpoint import (
+            prune_checkpoints,
+        )
+
+        if self._async_ckpt is not None:
+            # join the PREVIOUS save (started a ckpt interval ago, all but
+            # certainly landed — near-zero block) so pruning can't race an
+            # in-flight write; the UPCOMING save still overlaps training
+            self._async_ckpt.wait()
+        keep = cfg.keep_checkpoints - (1 if extra_slot else 0)
+        for path in prune_checkpoints(cfg.ckpt_dir, keep=keep):
+            logger.info("pruned checkpoint: %s", path)
+
     def restore_checkpoint(self, tag: str = "latest") -> bool:
         if self.config.ckpt_dir is None:
             return False
         from pytorch_distributed_tpu.train.checkpoint import (
-            checkpoint_exists,
+            resolve_tag,
             restore_checkpoint,
         )
 
-        if not checkpoint_exists(self.config.ckpt_dir, tag):
+        # retention-style runs may hold only step-<N> tags; resolve to the
+        # newest one when the requested tag is absent
+        resolved = resolve_tag(self.config.ckpt_dir, tag)
+        if resolved is None:
             return False
+        tag = resolved
         self.state = restore_checkpoint(
             self.config.ckpt_dir,
             self.state,
@@ -440,7 +490,16 @@ class Trainer:
                          "step_time_ms": dt * 1e3, "epoch": epoch, **extra},
                     )
             if cfg.ckpt_every_steps and step % cfg.ckpt_every_steps == 0:
-                self.save_checkpoint()
+                if cfg.keep_checkpoints:
+                    # prune BEFORE saving: the previous async save has
+                    # landed by now (AsyncCheckpointer.save waits), so
+                    # pruning first keeps the new save overlapped with
+                    # training instead of joining it immediately — at the
+                    # cost of one transient extra checkpoint on disk
+                    self._prune_checkpoints(extra_slot=True)
+                    self.save_checkpoint(tag=f"step-{step}")
+                else:
+                    self.save_checkpoint()
 
     def evaluate(self, epoch: int) -> Dict[str, float]:
         sums: Dict[str, float] = {}
@@ -476,7 +535,38 @@ class Trainer:
             self.metrics_writer.write(
                 self.host_step, {**means, "epoch": epoch}, split="eval"
             )
+        self._maybe_save_best(means)
         return means
+
+    def _maybe_save_best(self, means: Dict[str, float]) -> None:
+        """Save tag 'best' whenever the watched eval metric improves."""
+        cfg = self.config
+        if cfg.keep_best is None or cfg.ckpt_dir is None:
+            return
+        if cfg.keep_best not in means:
+            logger.warning(
+                "keep_best metric %r not in eval metrics %s — skipping",
+                cfg.keep_best, sorted(means),
+            )
+            return
+        value = means[cfg.keep_best]
+        if not math.isfinite(value):
+            # a NaN 'best' would win the first comparison and then beat
+            # every later value (NaN compares False both ways), freezing
+            # diverged weights under the 'best' tag forever
+            return
+        better = (
+            self._best_value is None
+            or (cfg.best_mode == "max" and value > self._best_value)
+            or (cfg.best_mode == "min" and value < self._best_value)
+        )
+        if better:
+            self._best_value = value
+            self.save_checkpoint(tag="best")
+            logger.info(
+                "new best %s=%.4f (step %d)",
+                cfg.keep_best, value, self.host_step,
+            )
 
     def _batch_samples(self, batch) -> int:
         key = self.config.samples_axis

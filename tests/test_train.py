@@ -467,3 +467,101 @@ class TestMetricsWriter:
         )
         trainer2.fit()
         assert len(read_metrics(path)) > len(recs)
+
+
+class TestCheckpointRetention:
+    def test_step_tagged_saves_pruned_and_resumable(self, dp8, tmp_path):
+        from pytorch_distributed_tpu.train import resolve_tag, step_tags
+
+        model = tiny_resnet()
+        state = tiny_image_state(model)
+        ds = SyntheticImageDataset(n=64, image_shape=(16, 16, 3), seed=0)
+        loader = DataLoader(ds, 16, sharding=dp8.batch_sharding())
+        trainer = Trainer(
+            state,
+            dp8,
+            build_train_step(classification_loss_fn(model)),
+            loader,
+            config=TrainerConfig(
+                epochs=2, log_every=0, ckpt_dir=str(tmp_path),
+                ckpt_every_steps=2, keep_checkpoints=2,
+            ),
+        )
+        trainer.fit()  # 8 steps -> saves at 2,4,6,8, pruned to newest 2
+        assert step_tags(str(tmp_path)) == [6, 8]
+        # 'latest' is also written at epoch end; remove it to prove the
+        # resolver falls back to the newest step tag
+        import shutil
+
+        shutil.rmtree(tmp_path / "latest")
+        assert resolve_tag(str(tmp_path)) == "step-8"
+        trainer2 = Trainer(
+            tiny_image_state(model),
+            dp8,
+            build_train_step(classification_loss_fn(model)),
+            loader,
+            config=TrainerConfig(
+                epochs=2, log_every=0, ckpt_dir=str(tmp_path),
+            ),
+        )
+        assert trainer2.restore_checkpoint()
+        assert trainer2.host_step == 8
+        # an EXPLICIT absent tag must not silently substitute a step tag
+        from pytorch_distributed_tpu.train import resolve_tag as rt
+
+        assert rt(str(tmp_path), "best") is None
+        # orphaned partial writes are swept by prune
+        import os
+
+        from pytorch_distributed_tpu.train import prune_checkpoints
+
+        os.makedirs(tmp_path / "step-99.tmp" / "junk")
+        removed = prune_checkpoints(str(tmp_path), keep=2)
+        assert str(tmp_path / "step-99.tmp") in removed
+        assert not (tmp_path / "step-99.tmp").exists()
+
+    def test_keep_best_tracks_metric(self, dp8, tmp_path):
+        from pytorch_distributed_tpu.train import checkpoint_step
+
+        model = tiny_resnet()
+        state = tiny_image_state(model)
+        ds = SyntheticImageDataset(n=32, image_shape=(16, 16, 3), seed=0)
+        loader = DataLoader(ds, 16, sharding=dp8.batch_sharding())
+        trainer = Trainer(
+            state,
+            dp8,
+            build_train_step(classification_loss_fn(model)),
+            loader,
+            eval_step=classification_eval_step(model),
+            eval_loader=DataLoader(
+                ds, 16, shuffle=False, sharding=dp8.batch_sharding()
+            ),
+            config=TrainerConfig(
+                epochs=1, log_every=0, ckpt_dir=str(tmp_path),
+                keep_best="loss", best_mode="min",
+            ),
+        )
+        trainer.fit()
+        assert (tmp_path / "best").is_dir()
+        assert checkpoint_step(str(tmp_path), tag="best") >= 1
+        # a WORSE metric must not overwrite best
+        best_before = trainer._best_value
+        trainer._maybe_save_best({"loss": best_before + 1.0})
+        assert trainer._best_value == best_before
+        # NaN never becomes (or displaces) best
+        trainer._maybe_save_best({"loss": float("nan")})
+        assert trainer._best_value == best_before
+
+    def test_bad_best_mode_raises(self, dp8):
+        model = tiny_resnet()
+        with pytest.raises(ValueError, match="best_mode"):
+            Trainer(
+                tiny_image_state(model),
+                dp8,
+                build_train_step(classification_loss_fn(model)),
+                DataLoader(
+                    SyntheticImageDataset(n=16, image_shape=(16, 16, 3)),
+                    16, sharding=dp8.batch_sharding(),
+                ),
+                config=TrainerConfig(best_mode="sideways"),
+            )
