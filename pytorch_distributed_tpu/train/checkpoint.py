@@ -270,19 +270,24 @@ def prune_checkpoints(ckpt_dir: str, *, keep: int) -> List[str]:
 
 
 def resolve_tag(ckpt_dir: str, tag: str = "latest") -> Optional[str]:
-    """The tag to restore: the requested one if present; only the DEFAULT
-    ``latest`` falls back to the highest ``step-<N>`` (retention-style
-    runs may have no ``latest``). An explicit tag that is absent resolves
-    to None — silently substituting a different checkpoint for a named
-    request would hand back the wrong weights."""
-    if checkpoint_exists(ckpt_dir, tag):
-        return tag
+    """The tag to restore. An explicitly-requested absent tag resolves to
+    None — silently substituting a different checkpoint for a named
+    request would hand back the wrong weights. The DEFAULT ``latest``
+    resolves to whichever checkpoint is NEWEST by step: a hard kill can
+    leave a stale ``latest`` (written at the last epoch boundary) beside
+    newer mid-epoch ``step-<N>`` tags, and resuming the stale one would
+    silently redo up to an epoch of training."""
     if tag != "latest":
-        return None
-    steps = step_tags(ckpt_dir)
-    if steps and checkpoint_exists(ckpt_dir, f"step-{steps[-1]}"):
-        return f"step-{steps[-1]}"
-    return None
+        return tag if checkpoint_exists(ckpt_dir, tag) else None
+    best_tag = None
+    best_step = -1
+    candidates = ["latest"] + [f"step-{s}" for s in step_tags(ckpt_dir)]
+    for cand in candidates:
+        if checkpoint_exists(ckpt_dir, cand):
+            step = checkpoint_step(ckpt_dir, cand)
+            if step is not None and step > best_step:
+                best_tag, best_step = cand, step
+    return best_tag
 
 
 class AsyncCheckpointer:

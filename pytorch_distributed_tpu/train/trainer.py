@@ -285,10 +285,13 @@ class Trainer:
             self._watchdog.tick()  # a slow (sharded) save is not a hang
         return path
 
-    def _prune_checkpoints(self, extra_slot: bool = False) -> None:
-        """``extra_slot=True`` prunes to keep-1 (an imminent save supplies
-        the survivor) — the prune-before-save pattern that keeps async
-        saves overlapped with training."""
+    def _prune_checkpoints(self) -> None:
+        """Prune-before-save: trims to keep-1 (the imminent save supplies
+        the newest survivor) so async saves stay overlapped with training,
+        but NEVER below one — deleting the last step checkpoint before its
+        replacement lands would leave a hard-kill window with nothing to
+        resume from. Steady state holds keep checkpoints (keep+1 briefly
+        for keep=1)."""
         cfg = self.config
         if not (cfg.keep_checkpoints and cfg.ckpt_dir):
             return
@@ -306,7 +309,7 @@ class Trainer:
             # certainly landed — near-zero block) so pruning can't race an
             # in-flight write; the UPCOMING save still overlaps training
             self._async_ckpt.wait()
-        keep = cfg.keep_checkpoints - (1 if extra_slot else 0)
+        keep = max(cfg.keep_checkpoints - 1, 1)
         for path in prune_checkpoints(cfg.ckpt_dir, keep=keep):
             logger.info("pruned checkpoint: %s", path)
 
@@ -338,6 +341,7 @@ class Trainer:
         # already consumed, so no batch trains twice and total step count
         # stays epochs * steps_per_epoch (LR schedules depend on it)
         self._resume_skip_batches = step % steps_per_epoch
+        self._load_best_record()  # the pre-crash best must not be demoted
         logger.info(
             "resumed from step %d (epoch %d, skipping %d batches)",
             step, self._first_epoch, self._resume_skip_batches,
@@ -491,12 +495,7 @@ class Trainer:
                     )
             if cfg.ckpt_every_steps and step % cfg.ckpt_every_steps == 0:
                 if cfg.keep_checkpoints:
-                    # prune BEFORE saving: the previous async save has
-                    # landed by now (AsyncCheckpointer.save waits), so
-                    # pruning first keeps the new save overlapped with
-                    # training instead of joining it immediately — at the
-                    # cost of one transient extra checkpoint on disk
-                    self._prune_checkpoints(extra_slot=True)
+                    self._prune_checkpoints()  # before the save: overlap
                     self.save_checkpoint(tag=f"step-{step}")
                 else:
                     self.save_checkpoint()
@@ -563,9 +562,52 @@ class Trainer:
         if better:
             self._best_value = value
             self.save_checkpoint(tag="best")
+            self._write_best_record(value)
             logger.info(
                 "new best %s=%.4f (step %d)",
                 cfg.keep_best, value, self.host_step,
+            )
+
+    def _best_record_path(self) -> str:
+        return os.path.join(self.config.ckpt_dir, "best_metric.json")
+
+    def _write_best_record(self, value: float) -> None:
+        """Persist the best value so a resumed run can't demote 'best'."""
+        if dist.multiprocess_ring() is not None and dist.get_rank() != 0:
+            return
+        if jax.process_index() != 0:
+            return
+        import json
+
+        tmp = self._best_record_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "metric": self.config.keep_best,
+                    "mode": self.config.best_mode,
+                    "value": value,
+                    "step": self.host_step,
+                },
+                f,
+            )
+        os.replace(tmp, self._best_record_path())
+
+    def _load_best_record(self) -> None:
+        cfg = self.config
+        if cfg.keep_best is None or cfg.ckpt_dir is None:
+            return
+        import json
+
+        try:
+            with open(self._best_record_path()) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            return
+        if rec.get("metric") == cfg.keep_best and rec.get("mode") == cfg.best_mode:
+            self._best_value = rec.get("value")
+            logger.info(
+                "resumed best %s=%.4f (step %s)",
+                cfg.keep_best, self._best_value, rec.get("step"),
             )
 
     def _batch_samples(self, batch) -> int:

@@ -44,6 +44,11 @@ class RecipeConfig:
     synthetic: bool = False  # doc: force synthetic data
     steps_per_epoch: Optional[int] = None  # doc: truncate epochs (smoke tests)
     ckpt_dir: Optional[str] = None  # doc: checkpoint directory (enables resume)
+    ckpt_every_steps: Optional[int] = None  # doc: mid-epoch checkpoint cadence
+    keep_checkpoints: Optional[int] = None  # doc: retain newest N step-tagged checkpoints
+    keep_best: Optional[str] = None  # doc: eval metric to track as the 'best' checkpoint
+    best_mode: str = "max"  # doc: 'max' (accuracy-like) or 'min' (loss-like)
+    async_checkpoint: bool = False  # doc: overlap checkpoint IO with training
     log_every: int = 50  # doc: steps between metric logs
     profile_dir: Optional[str] = None  # doc: write JAX profiler traces here
     metrics_path: Optional[str] = None  # doc: JSONL scalar metrics log

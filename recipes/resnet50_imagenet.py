@@ -201,6 +201,11 @@ def main(argv=None):
             epochs=cfg.epochs,
             log_every=cfg.log_every,
             ckpt_dir=cfg.ckpt_dir,
+            ckpt_every_steps=cfg.ckpt_every_steps,
+            keep_checkpoints=cfg.keep_checkpoints,
+            keep_best=cfg.keep_best,
+            best_mode=cfg.best_mode,
+            async_checkpoint=cfg.async_checkpoint,
             metrics_path=cfg.metrics_path,
             log_mfu=cfg.log_mfu,
         ),

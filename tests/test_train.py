@@ -551,6 +551,36 @@ class TestCheckpointRetention:
         # NaN never becomes (or displaces) best
         trainer._maybe_save_best({"loss": float("nan")})
         assert trainer._best_value == best_before
+        # the best value survives a resume: a fresh trainer restoring this
+        # dir must NOT let a worse first eval demote 'best'
+        trainer2 = Trainer(
+            tiny_image_state(tiny_resnet()),
+            dp8,
+            build_train_step(classification_loss_fn(tiny_resnet())),
+            DataLoader(
+                SyntheticImageDataset(n=32, image_shape=(16, 16, 3)),
+                16, sharding=dp8.batch_sharding(),
+            ),
+            config=TrainerConfig(
+                ckpt_dir=str(tmp_path), keep_best="loss", best_mode="min",
+            ),
+        )
+        assert trainer2.restore_checkpoint()
+        assert trainer2._best_value == pytest.approx(best_before)
+        trainer2._maybe_save_best({"loss": best_before + 5.0})
+        assert trainer2._best_value == pytest.approx(best_before)
+
+    def test_resolve_latest_prefers_newest_step(self, dp8, tmp_path):
+        # a stale 'latest' (earlier step) beside newer step tags must lose
+        from pytorch_distributed_tpu.train import resolve_tag
+
+        state = dp8.place(linear_state())
+        step = dp8.compile(build_train_step(linear_loss_fn), state)
+        save_checkpoint(str(tmp_path), state, tag="latest")  # step 0
+        state, _ = step(state, dp8.shard_batch(linear_batch()))
+        state, _ = step(state, dp8.shard_batch(linear_batch()))
+        save_checkpoint(str(tmp_path), state, tag="step-2")
+        assert resolve_tag(str(tmp_path)) == "step-2"
 
     def test_bad_best_mode_raises(self, dp8):
         model = tiny_resnet()
