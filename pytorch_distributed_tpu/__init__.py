@@ -52,6 +52,7 @@ from pytorch_distributed_tpu.runtime.distributed import (
     reduce_scatter,
     broadcast,
     broadcast_object_list,
+    scatter_object_list,
     barrier,
     monitored_barrier,
     gather,
@@ -100,6 +101,7 @@ __all__ = [
     "reduce_scatter",
     "broadcast",
     "broadcast_object_list",
+    "scatter_object_list",
     "barrier",
     "monitored_barrier",
     "gather",

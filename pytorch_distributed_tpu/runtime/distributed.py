@@ -588,6 +588,34 @@ def _process_world_size(g) -> int:
     return jax.process_count()
 
 
+def scatter_object_list(objs: Optional[list], src: int = 0):
+    """torch.distributed.scatter_object_list: process ``src`` supplies one
+    object per process; each process receives its own. Non-src ranks may
+    pass None. Single controller: returns ``objs[0]`` (a one-process
+    world's scatter is the identity on its own slot).
+    """
+    g = _group()
+    world = _process_world_size(g)
+    if not 0 <= src < world:
+        raise ValueError(f"src {src} out of range for {world}-process world")
+    rank = get_rank()  # ring rank under hostring, process index otherwise
+    is_src = rank == src
+    if is_src:
+        if objs is None or len(objs) != world:
+            raise ValueError(
+                f"src must pass exactly {world} objects, got "
+                f"{None if objs is None else len(objs)}"
+            )
+    if world == 1:
+        return objs[0]
+    # route through the object broadcast: src ships the whole list once
+    # (object payloads are small control-plane data by contract; a
+    # byte-exact per-rank scatter would save bandwidth, not semantics)
+    return broadcast_object_list(
+        objs if is_src else [None] * world, src=src
+    )[rank]
+
+
 def broadcast_object_list(objs: list, src: int = 0) -> list:
     """Replace every element with process ``src``'s list (torch semantics,
     but returned rather than mutated in place)."""

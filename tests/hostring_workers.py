@@ -218,6 +218,11 @@ def facade_worker(rank: int, world: int, name: str, q) -> None:
         assert got == ["from", 1], got
         rd = ptd.reduce(np.full(4, float(rank), np.float32), dst=0)
         assert np.all(np.asarray(rd) == sum(range(world))), rd
+        mine = ptd.scatter_object_list(
+            [f"obj-{r}" for r in range(world)] if rank == 2 else None,
+            src=2,
+        )
+        assert mine == f"obj-{rank}", mine
         ptd.monitored_barrier()  # group deadline applies
         try:  # tighter-than-group per-call timeout is a loud refusal
             ptd.monitored_barrier(timeout_s=0.001)

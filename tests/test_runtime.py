@@ -174,8 +174,11 @@ class TestProcessGroupFacade:
         obj = {"step": 7, "name": "rn50"}
         assert ptd.all_gather_object(obj) == [obj]
         assert ptd.broadcast_object_list([obj, 3], src=0) == [obj, 3]
+        assert ptd.scatter_object_list([obj], src=0) == obj
         with pytest.raises(ValueError):
             ptd.broadcast_object_list([1], src=2)
+        with pytest.raises(ValueError):
+            ptd.scatter_object_list([1, 2], src=0)  # wrong length
 
 
 class TestPrecision:
