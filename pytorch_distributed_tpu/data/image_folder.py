@@ -85,7 +85,10 @@ class FolderImagePipeline:
         scale: tuple = (0.08, 1.0),
         ratio: tuple = (3 / 4, 4 / 3),
         device_normalize: bool = False,
+        num_threads: int = 0,
     ):
+        """``num_threads``: decode/resize pool width (0 = one per core,
+        1 = sequential)."""
         self.crop = crop
         self.train = train
         self.resize = resize
@@ -95,6 +98,7 @@ class FolderImagePipeline:
         self.scale = scale
         self.ratio = ratio
         self.device_normalize = device_normalize
+        self.num_threads = num_threads
         self.epoch = 0
 
     def set_epoch(self, epoch: int) -> None:
@@ -149,15 +153,33 @@ class FolderImagePipeline:
         rng = np.random.default_rng(
             [self.seed, self.epoch, zlib.crc32(idx.tobytes()), n]
         )
-        for j, i in enumerate(idx):
-            path, label = dataset.samples[int(i)]
+        # one child generator per sample, spawned SEQUENTIALLY up front:
+        # same (seed, epoch, indices) -> same augmentation regardless of
+        # decode thread interleaving. The decode+resize work then fans out
+        # across a thread pool — PIL's C decoders release the GIL, so this
+        # scales with host cores like the native u8 pipeline does.
+        rngs = rng.spawn(n) if self.train else [None] * n
+
+        def work(j):
+            path, label = dataset.samples[int(idx[j])]
             with Image.open(path) as im:
                 im = im.convert("RGB")
-                im = self._train_crop(im, rng) if self.train else (
-                    self._eval_crop(im)
+                im = (
+                    self._train_crop(im, rngs[j])
+                    if self.train else self._eval_crop(im)
                 )
             out[j] = np.asarray(im)
             labels[j] = label
+
+        if self.num_threads == 1 or n <= 1:  # n==0: empty batch, no pool
+            for j in range(n):
+                work(j)
+        else:
+            import concurrent.futures
+
+            workers = self.num_threads or min(n, os.cpu_count() or 1)
+            with concurrent.futures.ThreadPoolExecutor(workers) as ex:
+                list(ex.map(work, range(n)))  # list() propagates errors
         if self.device_normalize:
             # ship uint8 (1/4 the host->device bytes); apply
             # self.device_normalizer() inside the jitted step

@@ -83,6 +83,19 @@ def test_dataloader_end_to_end(image_root):
         assert b["image"].shape == (4, 24, 24, 3)
 
 
+def test_threaded_decode_matches_sequential(image_root):
+    # thread-pool decode must be bit-identical to sequential: per-sample
+    # spawned generators make augmentation independent of thread order
+    ds = ImageFolderDataset(str(image_root / "train"))
+    seq = FolderImagePipeline(24, train=True, seed=3, num_threads=1)
+    par = FolderImagePipeline(24, train=True, seed=3, num_threads=4)
+    idx = np.arange(len(ds))
+    a = seq(ds, idx)
+    b = par(ds, idx)
+    np.testing.assert_array_equal(a["image"], b["image"])
+    np.testing.assert_array_equal(a["label"], b["label"])
+
+
 def test_device_normalize_matches_host_path(image_root):
     import jax
 
