@@ -100,9 +100,23 @@ class FolderImagePipeline:
         self.device_normalize = device_normalize
         self.num_threads = num_threads
         self.epoch = 0
+        self._executor = None  # lazy; joined by concurrent.futures' own
+        # atexit hook (idle workers wake and exit at interpreter shutdown)
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
+
+    def _pool(self):
+        """Lazily-created decode pool, reused across batches (spawning and
+        joining cpu_count threads per fetch would tax every batch)."""
+        if self._executor is None:
+            import concurrent.futures
+
+            workers = self.num_threads or (os.cpu_count() or 1)
+            self._executor = concurrent.futures.ThreadPoolExecutor(
+                workers, thread_name_prefix="folder-decode"
+            )
+        return self._executor
 
     def _train_crop(self, im, rng):
         from PIL import Image
@@ -175,11 +189,7 @@ class FolderImagePipeline:
             for j in range(n):
                 work(j)
         else:
-            import concurrent.futures
-
-            workers = self.num_threads or min(n, os.cpu_count() or 1)
-            with concurrent.futures.ThreadPoolExecutor(workers) as ex:
-                list(ex.map(work, range(n)))  # list() propagates errors
+            list(self._pool().map(work, range(n)))  # list() raises errors
         if self.device_normalize:
             # ship uint8 (1/4 the host->device bytes); apply
             # self.device_normalizer() inside the jitted step
