@@ -26,6 +26,7 @@ inventing anchors for them would be folklore-on-folklore.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -524,6 +525,8 @@ def _backend_is_reachable(deadline_s: float = 600.0) -> bool:
 
 
 def main():
+    t0 = time.perf_counter()
+    budget_s = float(os.environ.get("PTD_BENCH_BUDGET_S", "3000"))
     if not _backend_is_reachable():
         print(
             "# accelerator backend unreachable — falling back to CPU",
@@ -538,17 +541,42 @@ def main():
     on_tpu = ptd.is_tpu()
     ptd.init_process_group()
     bench_resnet50(on_tpu)
-    bench_input_pipeline(on_tpu)
-    bench_allreduce_device(on_tpu)
-    try:
-        bench_allreduce_hostring()
-    except Exception as e:
-        print(f"# hostring bench skipped: {e}", file=sys.stderr)
+
+    def spent():
+        return time.perf_counter() - t0
+
+    failures = []
+
+    def run_if_budget(name, fn, *args):
+        # each phase starts only with wall clock in hand: the axon
+        # remote compiles are unbounded when the relay misbehaves, and a
+        # bench that never returns erases every later metric. A budget
+        # skip is loud but NOT a failure; a crashed phase keeps later
+        # phases running and fails the process at the end (rc matters).
+        if spent() > budget_s:
+            print(
+                f"# {name} skipped: bench budget {budget_s:.0f}s spent "
+                f"({spent():.0f}s elapsed)", file=sys.stderr,
+            )
+            return
+        try:
+            fn(*args)
+        except Exception as e:
+            failures.append(name)
+            print(f"# {name} FAILED: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
+    run_if_budget("input_pipeline", bench_input_pipeline, on_tpu)
+    run_if_budget("allreduce_device", bench_allreduce_device, on_tpu)
+    run_if_budget("allreduce_hostring", bench_allreduce_hostring)
     # LAST: the transformer compiles are the largest on the axon
     # remote-compile path (>10 min cold); if one wedges, every metric
     # above has already been emitted
-    bench_generate(on_tpu)
-    bench_gpt2(on_tpu)
+    run_if_budget("generate", bench_generate, on_tpu)
+    run_if_budget("gpt2", bench_gpt2, on_tpu)
+    if failures:
+        print(f"# bench phases FAILED: {failures}", file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

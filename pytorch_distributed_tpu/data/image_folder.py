@@ -100,23 +100,37 @@ class FolderImagePipeline:
         self.device_normalize = device_normalize
         self.num_threads = num_threads
         self.epoch = 0
-        self._executor = None  # lazy; joined by concurrent.futures' own
-        # atexit hook (idle workers wake and exit at interpreter shutdown)
+        self._executor = None  # lazy; close() releases, else joined by
+        # concurrent.futures' own atexit hook at interpreter shutdown
+        import threading
+
+        self._executor_lock = threading.Lock()
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
 
     def _pool(self):
         """Lazily-created decode pool, reused across batches (spawning and
-        joining cpu_count threads per fetch would tax every batch)."""
+        joining cpu_count threads per fetch would tax every batch).
+        Creation is locked: one pipeline can feed two DataLoaders whose
+        background threads race the first fetch."""
         if self._executor is None:
             import concurrent.futures
 
-            workers = self.num_threads or (os.cpu_count() or 1)
-            self._executor = concurrent.futures.ThreadPoolExecutor(
-                workers, thread_name_prefix="folder-decode"
-            )
+            with self._executor_lock:
+                if self._executor is None:
+                    workers = self.num_threads or (os.cpu_count() or 1)
+                    self._executor = concurrent.futures.ThreadPoolExecutor(
+                        workers, thread_name_prefix="folder-decode"
+                    )
         return self._executor
+
+    def close(self) -> None:
+        """Release the decode pool's threads (idempotent; the pipeline
+        recreates it if used again)."""
+        ex, self._executor = self._executor, None
+        if ex is not None:
+            ex.shutdown(wait=True)
 
     def _train_crop(self, im, rng):
         from PIL import Image

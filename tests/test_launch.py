@@ -151,14 +151,14 @@ def test_init_multihost_env_mapping(monkeypatch):
 
 
 @pytest.mark.slow
-def test_init_multihost_real_two_process_world():
-    """REAL jax.distributed rendezvous: 2 controller processes form one
-    global device world and run a cross-process (DCN-story) collective.
-    The strongest offline evidence for the pod path — not a mock."""
+
+def _run_multihost(worker, world, *extra_args, timeout=300):
+    """Shared pod-test scaffolding: pick a free port, spawn ``world``
+    jax.distributed controller processes, collect one queue result per
+    rank (workers put (rank, "ok", ...) or (rank, error)), tear down, and
+    assert every rank reported ok. Returns the results list."""
     import multiprocessing as mp
     import socket
-
-    multihost_worker = hostring_workers.multihost_worker
 
     with socket.socket() as s:
         s.bind(("", 0))
@@ -166,20 +166,29 @@ def test_init_multihost_real_two_process_world():
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
     procs = [
-        ctx.Process(target=multihost_worker, args=(r, 2, port, q))
-        for r in range(2)
+        ctx.Process(target=worker, args=(r, world, port, *extra_args, q))
+        for r in range(world)
     ]
     for p in procs:
         p.start()
     try:
-        results = [q.get(timeout=180) for _ in range(2)]
+        results = [q.get(timeout=timeout) for _ in range(world)]
     finally:
         for p in procs:
             p.join(timeout=30)
             if p.is_alive():
                 p.terminate()
+                p.join(timeout=10)  # reap, no zombies until pytest exits
     bad = [r for r in results if r[1] != "ok"]
     assert not bad, bad
+    return results
+
+
+def test_init_multihost_real_two_process_world():
+    """REAL jax.distributed rendezvous: 2 controller processes form one
+    global device world and run a cross-process (DCN-story) collective.
+    The strongest offline evidence for the pod path — not a mock."""
+    _run_multihost(hostring_workers.multihost_worker, 2, timeout=180)
 
 
 @pytest.mark.slow
@@ -187,31 +196,9 @@ def test_multihost_ddp_training_lockstep():
     """2-host DDP over jax.distributed: per-host batch slices assemble
     into the global batch (make_array_from_process_local_data path in
     Strategy.shard_batch); losses and params stay identical across hosts."""
-    import multiprocessing as mp
-    import socket
-
-    with socket.socket() as s:
-        s.bind(("", 0))
-        port = s.getsockname()[1]
-    ctx = mp.get_context("spawn")
-    q = ctx.Queue()
-    procs = [
-        ctx.Process(
-            target=hostring_workers.multihost_ddp_worker, args=(r, 2, port, q)
-        )
-        for r in range(2)
-    ]
-    for p in procs:
-        p.start()
-    try:
-        results = [q.get(timeout=240) for _ in range(2)]
-    finally:
-        for p in procs:
-            p.join(timeout=30)
-            if p.is_alive():
-                p.terminate()
-    bad = [r for r in results if r[1] != "ok"]
-    assert not bad, bad
+    results = _run_multihost(
+        hostring_workers.multihost_ddp_worker, 2, timeout=240
+    )
     (r0, _, losses0, w0), (r1, _, losses1, w1) = sorted(results)
     assert losses0 == losses1, (losses0, losses1)
     assert w0 == w1  # bit-identical params across hosts
@@ -222,32 +209,10 @@ def test_multihost_ddp_training_lockstep():
 def test_multihost_sharded_checkpoint_roundtrip(tmp_path):
     """2-host checkpoint: each process writes its own dp-shard files,
     process 0 merges+commits, restore reassembles per-host slices."""
-    import multiprocessing as mp
-    import socket
-
-    with socket.socket() as s:
-        s.bind(("", 0))
-        port = s.getsockname()[1]
-    ctx = mp.get_context("spawn")
-    q = ctx.Queue()
-    procs = [
-        ctx.Process(
-            target=hostring_workers.multihost_ckpt_worker,
-            args=(r, 2, port, str(tmp_path), q),
-        )
-        for r in range(2)
-    ]
-    for p in procs:
-        p.start()
-    try:
-        results = [q.get(timeout=240) for _ in range(2)]
-    finally:
-        for p in procs:
-            p.join(timeout=30)
-            if p.is_alive():
-                p.terminate()
-    bad = [r for r in results if r[1] != "ok"]
-    assert not bad, bad
+    results = _run_multihost(
+        hostring_workers.multihost_ckpt_worker, 2, str(tmp_path),
+        timeout=240,
+    )
     for _, _, procs_seen in results:
         assert procs_seen == [0, 1], procs_seen  # BOTH hosts wrote shards
 
@@ -258,32 +223,10 @@ def test_multihost_trainer_full_stack(tmp_path):
     jax.distributed controller processes — the pod path end to end with
     stock components and no recipe-code changes."""
     import json
-    import multiprocessing as mp
-    import socket
 
-    with socket.socket() as s:
-        s.bind(("", 0))
-        port = s.getsockname()[1]
-    ctx = mp.get_context("spawn")
-    q = ctx.Queue()
-    procs = [
-        ctx.Process(
-            target=hostring_workers.multihost_trainer_worker,
-            args=(r, 2, port, str(tmp_path), q),
-        )
-        for r in range(2)
-    ]
-    for p in procs:
-        p.start()
-    try:
-        results = [q.get(timeout=300) for _ in range(2)]
-    finally:
-        for p in procs:
-            p.join(timeout=30)
-            if p.is_alive():
-                p.terminate()
-    bad = [r for r in results if r[1] != "ok"]
-    assert not bad, bad
+    results = _run_multihost(
+        hostring_workers.multihost_trainer_worker, 2, str(tmp_path),
+    )
     (_, _, l0, s0, w0), (_, _, l1, s1, w1) = sorted(results)
     assert s0 == s1 == 32  # 8 epochs x 4 steps
     assert l0 == l1  # identical eval loss on both hosts
@@ -306,32 +249,7 @@ def test_multihost_2d_fsdp_mesh_across_4_processes():
     jitted step), batch sharded over dp x fsdp, two lockstep train steps,
     and every host's param-shard view assembles into ONE consistent
     global array (same loss everywhere; mirror-shard pairs identical)."""
-    import multiprocessing as mp
-    import socket
-
-    with socket.socket() as s:
-        s.bind(("", 0))
-        port = s.getsockname()[1]
-    ctx = mp.get_context("spawn")
-    q = ctx.Queue()
-    procs = [
-        ctx.Process(
-            target=hostring_workers.multihost_2d_fsdp_worker,
-            args=(r, 4, port, q),
-        )
-        for r in range(4)
-    ]
-    for p in procs:
-        p.start()
-    try:
-        results = [q.get(timeout=300) for _ in range(4)]
-    finally:
-        for p in procs:
-            p.join(timeout=30)
-            if p.is_alive():
-                p.terminate()
-    bad = [r for r in results if r[1] != "ok"]
-    assert not bad, bad
+    results = _run_multihost(hostring_workers.multihost_2d_fsdp_worker, 4)
     by_rank = {r[0]: r for r in results}
     losses = {r: by_rank[r][2] for r in by_rank}
     assert len({round(v, 6) for v in losses.values()}) == 1, losses
