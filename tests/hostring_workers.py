@@ -648,3 +648,32 @@ def multihost_2d_fsdp_worker(rank: int, world: int, port: int, q) -> None:
 
         q.put((rank, f"{type(e).__name__}: {e}\n{traceback.format_exc()}",
                None, None, None))
+
+
+def reinit_worker(rank: int, world: int, name: str, q) -> None:
+    """Rapid destroy + re-init cycles on the SAME group name: the
+    per-init generation suffix must give every rendezvous a fresh shm
+    segment (ADVICE r1 #2 — without it, a fast peer could attach the old
+    segment before rank 0 unlinks it and split the group)."""
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["RANK"] = str(rank)
+        os.environ["WORLD_SIZE"] = str(world)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import pytorch_distributed_tpu as ptd
+
+        for cycle in range(3):
+            ptd.init_process_group(
+                "gloo", group_name=name, timeout_s=60.0
+            )
+            out = ptd.all_reduce(np.array([float(cycle + rank)], np.float32))
+            want = world * cycle + sum(range(world))
+            assert float(np.asarray(out)[0]) == want, (cycle, out)
+            # NO barrier between cycles: destroy+init immediately, the
+            # exact window the generation suffix exists for
+            ptd.destroy_process_group()
+        q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover - reported via queue
+        q.put((rank, f"{type(e).__name__}: {e}"))

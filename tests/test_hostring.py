@@ -71,6 +71,15 @@ def test_facade_multiprocess():
     assert results == [(r, "ok") for r in range(4)], results
 
 
+@pytest.mark.slow
+def test_rapid_reinit_same_group_name():
+    """destroy + immediate re-init on the SAME group name, three cycles,
+    no inter-cycle barrier: the per-init generation suffix must keep each
+    rendezvous on a fresh shm segment (ADVICE r1 #2 re-init race)."""
+    results = _run(3, hostring_workers.reinit_worker, timeout=300.0)
+    assert results == [(r, "ok") for r in range(3)], results
+
+
 def test_p2p_send_recv_with_bystanders():
     """send/recv between two ranks must complete while other ranks do
     nothing (true P2P mailbox, not a barrier-gated group collective)."""
