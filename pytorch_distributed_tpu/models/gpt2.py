@@ -35,6 +35,7 @@ class GPT2Config:
     # stages consume directly). False restores the unrolled per-layer tree.
     scan_layers: bool = True
     remat: bool = False  # rematerialize each block in backward (saves HBM)
+    remat_policy: str = "full"  # full | dots | dots_no_batch (models/scan.py)
     # > 0 turns every block's FFN into a mixture-of-experts (ops/moe.py):
     # experts shard over the ep mesh axis. Uniform across layers so the
     # scanned stack stays homogeneous.

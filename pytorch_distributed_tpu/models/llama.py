@@ -40,6 +40,7 @@ class LlamaConfig:
     # stacked params. False restores the unrolled per-layer tree.
     scan_layers: bool = True
     remat: bool = False  # recompute block activations in backward
+    remat_policy: str = "full"  # full | dots | dots_no_batch (models/scan.py)
 
     @property
     def head_dim(self) -> int:

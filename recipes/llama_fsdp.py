@@ -67,6 +67,10 @@ def parse_args(argv=None):
     p.add_argument("--sp-mode", choices=("ring", "ulysses"), default="ring")
     p.add_argument("--remat", action="store_true",
                    help="recompute block activations in backward")
+    p.add_argument("--remat-policy", choices=("full", "dots",
+                   "dots_no_batch"), default="full",
+                   help="what remat saves: full recompute, or keep matmul "
+                        "results and recompute only cheap elementwise work")
     p.add_argument("--vocab-chunk", type=int, default=None,
                    help="chunked-vocab loss: never materialize [B,S,V] "
                         "logits (ops/lm_loss.py; try 8192 at 128K vocab)")
@@ -96,8 +100,12 @@ def main(argv=None):
     log_rank0("world=%d backend=%s", ptd.get_world_size(), ptd.get_backend())
 
     cfg = SIZES[args.size]()
-    if args.remat:
-        cfg = dataclasses.replace(cfg, remat=True)
+    if args.remat or args.remat_policy != "full":
+        # a non-default policy implies remat: silently ignoring
+        # --remat-policy without --remat would train unrematerialized
+        cfg = dataclasses.replace(
+            cfg, remat=True, remat_policy=args.remat_policy
+        )
     sp_ctx = contextlib.nullcontext()
     if args.sp > 1:
         from pytorch_distributed_tpu.parallel import sequence_parallel

@@ -235,3 +235,60 @@ class TestTrainSteps:
         state, m = step(state, strategy.shard_batch(batch))
         assert np.isfinite(float(m["loss"]))
         assert 0.0 <= float(m["accuracy"]) <= 1.0
+
+
+@pytest.mark.slow
+def test_remat_policies_are_numerically_identical():
+    """remat changes WHEN activations are computed, never WHAT: loss and
+    grads must match the no-remat baseline bitwise-closely for every
+    policy (full recompute, save-dots, save-dots-no-batch)."""
+    import dataclasses
+
+    import optax
+
+    from pytorch_distributed_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+    )
+    from pytorch_distributed_tpu.train import (
+        build_train_step,
+        causal_lm_loss_fn,
+        TrainState,
+    )
+
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(512, size=(2, 16)).astype(np.int32)
+    )
+    results = {}
+    for label, kw in {
+        "none": dict(remat=False),
+        "full": dict(remat=True, remat_policy="full"),
+        "dots": dict(remat=True, remat_policy="dots"),
+        "dots_no_batch": dict(remat=True, remat_policy="dots_no_batch"),
+    }.items():
+        cfg = dataclasses.replace(LlamaConfig.tiny(), **kw)
+        model = LlamaForCausalLM(cfg)
+        params = model.init(jax.random.key(0), ids)["params"]
+        state = TrainState.create(
+            apply_fn=model.apply, params=params, tx=optax.sgd(0.1)
+        )
+        step = jax.jit(build_train_step(causal_lm_loss_fn(model)))
+        new_state, metrics = step(state, {"input_ids": ids})
+        results[label] = (
+            float(metrics["loss"]),
+            np.asarray(jax.tree_util.tree_leaves(new_state.params)[0]),
+        )
+    base_loss, base_w = results["none"]
+    for label, (loss, w) in results.items():
+        assert loss == pytest.approx(base_loss, rel=1e-5), label
+        np.testing.assert_allclose(w, base_w, rtol=1e-5, atol=1e-6,
+                                   err_msg=label)
+
+
+def test_bad_remat_policy_raises():
+    from pytorch_distributed_tpu.models.scan import remat_policy
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        remat_policy("everything")
+    assert remat_policy("full") is None
+    assert remat_policy("dots") is not None
