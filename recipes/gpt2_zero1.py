@@ -62,6 +62,11 @@ def parse_args(argv=None):
     p.add_argument("--dp", type=int, default=-1)
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--pp", type=int, default=1, help="pipeline stages")
+    p.add_argument("--remat", action="store_true",
+                   help="recompute block activations in backward")
+    p.add_argument("--remat-policy", choices=("full", "dots",
+                   "dots_no_batch"), default="full",
+                   help="what remat saves (implies --remat when not full)")
     p.add_argument("--vocab-chunk", type=int, default=None,
                    help="chunked-vocab loss: never materialize [B,S,V] "
                         "logits (ops/lm_loss.py); ZeRO-1 path only")
@@ -98,6 +103,12 @@ def main(argv=None):
     log_rank0("world=%d backend=%s", ptd.get_world_size(), ptd.get_backend())
 
     cfg = SIZES[args.size]()
+    if args.remat or args.remat_policy != "full":
+        import dataclasses as _dc
+
+        cfg = _dc.replace(
+            cfg, remat=True, remat_policy=args.remat_policy
+        )
     seq_len = min(args.seq_len, cfg.n_positions)
     tokenizer = None
     if args.text_file:
