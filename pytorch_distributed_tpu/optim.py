@@ -30,6 +30,23 @@ ScalarOrSchedule = Union[float, optax.Schedule]
 DEFAULT_NO_DECAY = (r"(^|/)bias$", r"(^|/)scale$")
 
 
+def _compile_patterns(patterns):
+    """str | sequence-of-str -> compiled regex list (shared matcher for
+    every path-pattern API in this module)."""
+    import re
+
+    if isinstance(patterns, str):
+        patterns = (patterns,)
+    return [re.compile(p) for p in patterns]
+
+
+def _path_matches(path, regs) -> bool:
+    from pytorch_distributed_tpu.parallel.sharding import path_str
+
+    p = path_str(path)
+    return any(r.search(p) for r in regs)
+
+
 def no_decay_mask(patterns: Sequence[str] = DEFAULT_NO_DECAY):
     """The torch "param groups" decay split, functionally.
 
@@ -40,20 +57,14 @@ def no_decay_mask(patterns: Sequence[str] = DEFAULT_NO_DECAY):
     ``optax.adamw(..., mask=...)`` that is True (decay) for every param
     whose 'a/b/c' path matches none of ``patterns`` (re.search).
     """
-    import re
-
     import jax
 
-    regs = [re.compile(p) for p in patterns]
+    regs = _compile_patterns(patterns)
 
     def mask(params):
-        from pytorch_distributed_tpu.parallel.sharding import path_str
-
-        def keep(path, leaf):
-            p = path_str(path)
-            return not any(r.search(p) for r in regs)
-
-        return jax.tree_util.tree_map_with_path(keep, params)
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: not _path_matches(path, regs), params
+        )
 
     return mask
 
@@ -127,6 +138,49 @@ def AdamW(
         lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay,
         mask=_decay_mask_arg(no_decay),
     )
+
+
+def param_groups(groups, default=None) -> optax.GradientTransformation:
+    """torch's optimizer param-groups, functionally.
+
+    torch recipes pass ``[{"params": decay, "lr": ...}, {"params":
+    no_decay, ...}]``; the functional analogue labels each param BY PATH
+    and runs one transformation per group via ``optax.multi_transform``
+    (note the anchored patterns — ``DEFAULT_NO_DECAY``'s ``(^|/)bias$``
+    shape — so e.g. a ``rel_pos_bias`` kernel can't suffix-match):
+
+        tx = optim.param_groups([
+            (optim.DEFAULT_NO_DECAY, optim.AdamW(1e-3, weight_decay=0.0)),
+            ((r".*",),               optim.AdamW(1e-3, weight_decay=0.01)),
+        ])
+
+    First matching group wins (write the catch-all last). Params matching
+    NO group get ``default`` — and torch's semantics for params not handed
+    to the optimizer is "never updated", so the default default FREEZES
+    them (``optax.set_to_zero``); pass an explicit transformation to
+    change that. Freezing a trunk while fine-tuning a head is the
+    two-line special case:
+
+        tx = optim.param_groups([((r"classifier/",), optim.AdamW(1e-4))])
+    """
+    import jax
+
+    regs = [(_compile_patterns(pats), tx) for pats, tx in groups]
+
+    def labels(params):
+        def label(path, leaf):
+            for i, (rs, _) in enumerate(regs):
+                if _path_matches(path, rs):
+                    return str(i)
+            return "default"
+
+        return jax.tree_util.tree_map_with_path(label, params)
+
+    transforms = {str(i): tx for i, (_, tx) in enumerate(regs)}
+    transforms["default"] = (
+        default if default is not None else optax.set_to_zero()
+    )
+    return optax.multi_transform(transforms, labels)
 
 
 def Adafactor(

@@ -296,6 +296,42 @@ def test_lr_schedules_match_torch():
     assert np.argmax(vals) == 5  # peak ends the pct_start warmup
 
 
+def test_optim_param_groups_and_freezing():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_tpu import optim as po
+
+    params = {
+        "trunk": {"kernel": jnp.ones((2, 2))},
+        "head": {"kernel": jnp.ones((2, 3)), "bias": jnp.ones((3,))},
+    }
+    ones = jax.tree_util.tree_map(jnp.ones_like, params)
+
+    # two groups, different lrs; catch-all last
+    tx = po.param_groups([
+        ((r"head/",), po.SGD(0.5)),
+        ((r".*",), po.SGD(0.1)),
+    ])
+    state = tx.init(params)
+    updates, _ = tx.update(ones, state, params)
+    np.testing.assert_allclose(np.asarray(updates["head"]["kernel"]), -0.5)
+    np.testing.assert_allclose(np.asarray(updates["head"]["bias"]), -0.5)
+    np.testing.assert_allclose(np.asarray(updates["trunk"]["kernel"]), -0.1)
+
+    # torch semantics: params in NO group are never updated (frozen trunk)
+    tx = po.param_groups([((r"head/",), po.SGD(0.5))])
+    state = tx.init(params)
+    updates, _ = tx.update(ones, state, params)
+    np.testing.assert_allclose(np.asarray(updates["trunk"]["kernel"]), 0.0)
+    np.testing.assert_allclose(np.asarray(updates["head"]["kernel"]), -0.5)
+
+    # a single pattern string is accepted (common call shape)
+    tx = po.param_groups([("head/", po.SGD(1.0))])
+    tx.init(params)
+
+
 def test_optim_no_decay_mask_exempts_bias_and_scale():
     import jax
     import jax.numpy as jnp
