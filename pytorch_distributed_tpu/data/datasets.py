@@ -140,14 +140,19 @@ def random_split(dataset, lengths, *, seed: int = 0):
     """``torch.utils.data.random_split``: disjoint random Subsets.
 
     ``lengths`` are absolute sizes summing to ``len(dataset)`` (fractions
-    summing to 1.0 also accepted, remainder going to the first split —
-    torch's convention rounds similarly).
+    summing to 1.0 also accepted; the rounding remainder is distributed
+    one element at a time round-robin across the leading splits, matching
+    torch — e.g. n=23, [1/3,1/3,1/3] -> 8/8/7).
     """
     n = len(dataset)
     lengths = list(lengths)
     if all(0.0 < l < 1.0 for l in lengths) and abs(sum(lengths) - 1.0) < 1e-6:
         sizes = [int(l * n) for l in lengths]
-        sizes[0] += n - sum(sizes)
+        # fractions summing to 1±1e-6 can floor to a total a few off from n
+        # in either direction at large n; spread the correction round-robin
+        rem = n - sum(sizes)
+        for i in range(abs(rem)):
+            sizes[i % len(sizes)] += 1 if rem > 0 else -1
         lengths = sizes
     lengths = [int(l) for l in lengths]  # 15.0 is a valid absolute size
     if sum(lengths) != n:

@@ -46,6 +46,12 @@ def sample_logits(
         # validate before the greedy early-return so a bad config is loud
         # even while smoke-testing with temperature=0
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k is not None:
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        # HF clamps k to the vocab size; without this, k >= vocab fails
+        # with an opaque out-of-bounds index at trace time
+        top_k = min(top_k, logits.shape[-1])
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if rng is None:

@@ -593,6 +593,12 @@ def scatter_object_list(objs: Optional[list], src: int = 0):
     object per process; each process receives its own. Non-src ranks may
     pass None. Single controller: returns ``objs[0]`` (a one-process
     world's scatter is the identity on its own slot).
+
+    Failure mode (same as torch): the src-side length check below raises
+    only on ``src`` — by then non-src ranks are already waiting in the
+    broadcast, and they sit there until the group deadline poisons the
+    group. A malformed src list is therefore an immediate error on src
+    but a delayed group-timeout on its peers.
     """
     g = _group()
     world = _process_world_size(g)

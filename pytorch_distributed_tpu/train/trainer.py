@@ -257,6 +257,16 @@ class Trainer:
                 f"keep_checkpoints must be >= 1, "
                 f"got {self.config.keep_checkpoints}"
             )
+        if (
+            self.config.keep_checkpoints is not None
+            and not self.config.ckpt_every_steps
+        ):  # retention only acts on step-<N> tags, which only
+            # ckpt_every_steps produces — otherwise it is silently inert
+            raise ValueError(
+                "keep_checkpoints requires ckpt_every_steps: retention "
+                "prunes step-tagged checkpoints, which are only written "
+                "on the ckpt_every_steps cadence"
+            )
         if self.config.async_checkpoint:
             from pytorch_distributed_tpu.train.checkpoint import (
                 AsyncCheckpointer,
@@ -405,13 +415,20 @@ class Trainer:
         which the persistent compilation cache (when enabled) turns into a
         disk hit. Any failure degrades to 0 (feature off) rather than
         interrupting training.
+
+        Returns PER-DEVICE FLOPs (the MFU denominator ``peak_flops()`` is
+        per-chip): the lowered path prices the unpartitioned global-shape
+        HLO — whole-mesh work — so it is divided by device_count; the
+        compiled path prices the per-device partitioned executable as-is.
         """
         from pytorch_distributed_tpu.runtime.device import compiled_flops
 
         try:
             lowered = self.train_step.lower(self.state, batch)
             flops = compiled_flops(lowered)
-            if not flops:
+            if flops:
+                flops /= jax.device_count()
+            else:
                 flops = compiled_flops(lowered.compile())
             return flops or 0.0
         except Exception as e:  # pragma: no cover - backend-specific

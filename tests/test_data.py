@@ -231,6 +231,27 @@ class TestDatasetUtilities:
         got = np.concatenate([b["x"] for b in dl])
         assert len(got) == 6
 
+    def test_random_split_fractional_remainder_matches_torch(self):
+        """Rounding remainder is distributed round-robin like torch
+        (ADVICE r2: first-split-takes-all gave 9/7/7 where torch gives
+        8/8/7)."""
+        import torch
+        from pytorch_distributed_tpu.data import random_split
+
+        for n, fracs in [(23, [1 / 3, 1 / 3, 1 / 3]), (10, [0.55, 0.45]),
+                         (17, [0.25, 0.25, 0.25, 0.25])]:
+            ds = ArrayDataset(x=np.arange(n, dtype=np.float32))
+            ours = [len(s) for s in random_split(ds, fracs, seed=0)]
+            theirs = [
+                len(s) for s in torch.utils.data.random_split(range(n), fracs)
+            ]
+            assert ours == theirs, (n, fracs, ours, theirs)
+        # fractions that floor to a total ABOVE n (sum = 1 + ~5e-7, inside
+        # the 1e-6 tolerance) must still yield valid splits, not raise
+        ds = ArrayDataset(x=np.zeros(10_000_000, dtype=np.float32))
+        parts = random_split(ds, [0.3 + 5e-7, 0.7 + 5e-7])
+        assert sum(len(p) for p in parts) == 10_000_000
+
     def test_random_split_bad_lengths(self):
         import pytest
 

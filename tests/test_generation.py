@@ -143,6 +143,21 @@ def test_sampling_respects_top_k():
     assert int(greedy[0]) == 4
 
 
+def test_sampling_top_k_clamped_to_vocab():
+    """top_k >= vocab_size is clamped (HF behavior) instead of raising an
+    opaque out-of-bounds index at trace time (ADVICE r2)."""
+    logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0, 10.0]])
+    tok = sample_logits(logits, jax.random.key(0), temperature=1.0, top_k=99)
+    assert 0 <= int(tok[0]) < 5
+    import pytest
+
+    with pytest.raises(ValueError):
+        sample_logits(logits, jax.random.key(0), temperature=1.0, top_k=0)
+    with pytest.raises(ValueError):
+        # validated before the greedy early-return, like top_p
+        sample_logits(logits, None, temperature=0.0, top_k=0)
+
+
 def test_sampling_respects_top_p():
     # softmax of [0,0,0,0,10] puts ~99.99% mass on token 4: with top_p=0.9
     # the nucleus is {4} alone, so sampling must always return 4

@@ -595,3 +595,20 @@ class TestCheckpointRetention:
                 ),
                 config=TrainerConfig(best_mode="sideways"),
             )
+
+    def test_keep_checkpoints_without_cadence_raises(self, dp8):
+        """keep_checkpoints without ckpt_every_steps would be silently
+        inert (no step tags are ever written to prune) — fail loudly at
+        construction instead (ADVICE r2)."""
+        model = tiny_resnet()
+        with pytest.raises(ValueError, match="ckpt_every_steps"):
+            Trainer(
+                tiny_image_state(model),
+                dp8,
+                build_train_step(classification_loss_fn(model)),
+                DataLoader(
+                    SyntheticImageDataset(n=16, image_shape=(16, 16, 3)),
+                    16, sharding=dp8.batch_sharding(),
+                ),
+                config=TrainerConfig(keep_checkpoints=2),
+            )
