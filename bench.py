@@ -11,18 +11,31 @@ step time") and VERDICT r1 #2:
   (scanned blocks, XLA attention; the Pallas flash kernel is opt-in until
   its remote-compile time is bounded — see ops/attention.py).
 * ``dp_allreduce_step_ms`` — jitted psum of a ResNet-50-gradient-sized
-  (25.6M f32) buffer over the dp mesh axis. On a pod this times the real
-  ICI allreduce; on one chip it times the degenerate single-participant
-  path (reported honestly with the mesh size).
+  (25.6M f32) buffer over the dp mesh axis; emitted only at world > 1
+  (a real collective). At world == 1 it is replaced by
+  ``dp_step_overhead_ms``: the DP-strategy step minus the identical
+  plainly-jitted step — the honest 1-chip statement of DP cost.
 * ``hostring_allreduce_ms`` — the native shm-ring (gloo-equivalent) backend
-  allreducing the same payload across 4 host processes.
+  allreducing the same payload across 4 host processes, scored against the
+  host's own measured 1-core memcpy bandwidth.
+
+On one chip the device "allreduce" is compiler-eliminated, so the metric
+becomes ``dp_step_overhead_ms`` (DP-strategy step minus plain jitted step)
+— the honest 1-chip statement of DP cost. When the accelerator is
+unreachable the run degrades to HOST-meaningful metrics only: the
+input-pipeline feed rate at real shapes (primary) and the hostring
+collective; consumption-bound metrics are suppressed rather than emitted
+as CPU noise wearing TPU metric names (VERDICT r2 #7).
 
 Baseline anchor: no published numbers exist for the reference
 (BASELINE.json:13, BASELINE.md). The resnet target is ">= 0.8x per-chip
 A100 images/sec" (BASELINE.json:5); with the widely used A100 ResNet-50
 mixed-precision figure of ~2500 images/sec/GPU, target = 2000 and
-vs_baseline = value / 2000. Secondary metrics carry vs_baseline null —
-inventing anchors for them would be folklore-on-folklore.
+vs_baseline = value / 2000. Most secondary metrics carry vs_baseline
+null — inventing anchors for them would be folklore-on-folklore. The
+one exception is ``hostring_allreduce_ms``, whose vs_baseline is the
+ratio of its moved bytes/s to this host's own measured 1-core memcpy
+bandwidth (a self-calibrated target, not a throughput-vs-A100 fraction).
 """
 
 import json
@@ -153,7 +166,7 @@ def bench_resnet50(on_tpu: bool) -> None:
     )
 
 
-def bench_input_pipeline(on_tpu: bool) -> None:
+def bench_input_pipeline(on_tpu: bool, feed_only: bool = False) -> None:
     """ResNet-50 with the REAL input pipeline in the measured loop.
 
     VERDICT r1 missing #4: the synthetic-batch number above re-feeds one
@@ -162,29 +175,50 @@ def bench_input_pipeline(on_tpu: bool) -> None:
     random-crop/flip/u8->f32-normalize) — and device_puts it each step,
     like the reference's DataLoader+pinned-memory path. Reports the
     host-feed rate alone and the end-to-end training rate.
+
+    ``feed_only`` (the CPU-fallback mode, VERDICT r2 #7): measure ONLY the
+    host-side feed rate — at the REAL shapes (src 256 -> crop 224) — and
+    emit it as the primary metric. The e2e training rates are consumption-
+    bound and on a CPU model measure nothing but CPU model speed, so they
+    are suppressed rather than wearing the north-star metric names.
     """
     from pytorch_distributed_tpu.data import ArrayDataset, DataLoader
     from pytorch_distributed_tpu.data.native_pipeline import ImageBatchPipeline
+    from pytorch_distributed_tpu.parallel import DataParallel
 
+    n_chips = ptd.get_world_size()
     if on_tpu:
         n_img, src, crop, batch_per_chip, steps = 1024, 256, 224, 128, 40
+    elif feed_only:
+        # real shapes: the host-side question ("can the loader assemble
+        # 224x224 batches fast enough?") is shape-dependent, so the
+        # fallback measures the same shapes the chip run would. The
+        # global batch is capped at the dataset size: a larger world
+        # (e.g. the 8-device CPU test mesh) would otherwise ask the
+        # drop_last sampler for more images than exist — zero batches
+        # per epoch, and the epoch loop below would spin forever
+        n_img, src, crop, steps = 256, 256, 224, 6
+        batch_per_chip = min(128, n_img // n_chips)
     else:
         n_img, src, crop, batch_per_chip, steps = 64, 40, 32, 8, 3
 
-    n_chips = ptd.get_world_size()
     batch = batch_per_chip * n_chips
     rng = np.random.default_rng(0)
     ds = ArrayDataset(
         image=rng.integers(0, 256, size=(n_img, src, src, 3), dtype=np.uint8),
         label=rng.integers(1000, size=(n_img,)).astype(np.int32),
     )
-    strategy, step, state = _resnet50_train_setup(crop)
+    if feed_only:
+        strategy = DataParallel()  # sharding for device_put; no model
+    else:
+        strategy, step, state = _resnet50_train_setup(crop)
     pipe = ImageBatchPipeline(crop, train=True)
 
-    def make_loader():
+    def make_loader(fetch=pipe, strat=None):
         return DataLoader(
-            ds, batch, shuffle=True, sharding=strategy.batch_sharding(),
-            fetch=pipe, prefetch=4,
+            ds, batch, shuffle=True,
+            sharding=(strat or strategy).batch_sharding(),
+            fetch=fetch, prefetch=4,
         )
 
     def timed_epochs(loader, consume, finish):
@@ -219,6 +253,39 @@ def bench_input_pipeline(on_tpu: bool) -> None:
 
     feed_dt = timed_epochs(loader, feed, lambda: float(chain[0]))
     feed_rate = batch * steps / feed_dt
+
+    if feed_only:
+        _emit(
+            {
+                "metric": "input_pipeline_feed_images_per_sec",
+                "value": round(feed_rate, 1),
+                "unit": f"images/sec host->device, src={src} crop={crop}",
+                "vs_baseline": None,
+            },
+            primary=True,
+        )
+        # u8-ship feed: same loader shipping uint8 (1/4 the bytes), the
+        # normalize deferred to the device — still a pure host measurement
+        pipe_u8 = ImageBatchPipeline(crop, train=True, device_normalize=True)
+        loader8 = make_loader(fetch=pipe_u8)
+        chain[0] = jnp.float32(0)
+        u8_feed_dt = timed_epochs(loader8, feed, lambda: float(chain[0]))
+        u8_feed_rate = batch * steps / u8_feed_dt
+        _emit(
+            {
+                "metric": "input_pipeline_u8_feed_images_per_sec",
+                "value": round(u8_feed_rate, 1),
+                "unit": f"images/sec host->device uint8, src={src} "
+                f"crop={crop}",
+                "vs_baseline": None,
+            }
+        )
+        print(
+            f"# input_pipeline (feed only): f32={feed_rate:.0f} img/s "
+            f"u8={u8_feed_rate:.0f} img/s batch={batch} steps={steps}",
+            file=sys.stderr,
+        )
+        return
 
     def run_train(loader, step, state):
         """(rate_per_chip, final_loss) of the loader feeding the step."""
@@ -403,7 +470,12 @@ def bench_generate(on_tpu: bool) -> None:
 
 
 def bench_allreduce_device(on_tpu: bool) -> None:
-    """Grad-sized allreduce over the dp mesh axis (BASELINE.json:2)."""
+    """Grad-sized allreduce over the dp mesh axis (BASELINE.json:2).
+
+    Only meaningful at world > 1 — on one device the collective is a
+    no-op the compiler eliminates, so main() routes world == 1 to
+    ``bench_dp_step_overhead`` instead (VERDICT r2 weak #6).
+    """
     from pytorch_distributed_tpu.runtime.distributed import ReduceOp
 
     n = ALLREDUCE_ELEMS if on_tpu else 1_000_000
@@ -432,6 +504,83 @@ def bench_allreduce_device(on_tpu: bool) -> None:
             "metric": "dp_allreduce_step_ms",
             "value": round(dt / iters * 1e3, 3),
             "unit": f"ms per {n * 4 / 1e6:.0f}MB allreduce, world={world}",
+            "vs_baseline": None,
+        }
+    )
+
+
+def bench_dp_step_overhead(on_tpu: bool) -> None:
+    """What DP machinery costs on ONE chip: strategy step minus plain step.
+
+    An "allreduce time" at world=1 is not a measurement — the collective
+    is compiler-eliminated. What CAN be measured on one chip is the full
+    overhead the DataParallel strategy adds to a train step (sharding
+    constraints, facade collective plumbing, donation wiring) over the
+    identical step plainly jitted. Expected ~0 — reported so the claim
+    "SPMD DP is free at world=1" is a number, not folklore.
+    """
+    from pytorch_distributed_tpu.models.resnet import BasicBlock, ResNet
+    from pytorch_distributed_tpu.parallel import DataParallel
+    from pytorch_distributed_tpu.train import (
+        TrainState,
+        build_train_step,
+        classification_loss_fn,
+    )
+
+    image, batch = (64, 64) if on_tpu else (16, 16)
+    warmup, iters = (5, 40) if on_tpu else (1, 5)
+    model = ResNet(
+        stage_sizes=[2, 2], block_cls=BasicBlock, num_classes=100,
+        width=32, stem="cifar",
+    )
+    variables = model.init(
+        jax.random.key(0), jnp.zeros((1, image, image, 3)), train=False
+    )
+
+    def mkstate():
+        return TrainState.create(
+            apply_fn=model.apply,
+            params=variables["params"],
+            tx=optax.sgd(0.1, momentum=0.9),
+            batch_stats=variables["batch_stats"],
+        )
+
+    step_fn = build_train_step(classification_loss_fn(model))
+    rng = np.random.default_rng(0)
+    host_batch = {
+        "image": rng.normal(size=(batch, image, image, 3)).astype(np.float32),
+        "label": rng.integers(100, size=(batch,)).astype(np.int32),
+    }
+
+    def timed(step, state, dev_batch):
+        for _ in range(warmup):
+            state, metrics = step(state, dev_batch)
+        float(metrics["loss"])  # sync (relay ignores block_until_ready)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, metrics = step(state, dev_batch)
+        float(metrics["loss"])
+        return (time.perf_counter() - t0) / iters
+
+    strategy = DataParallel()
+    placed = strategy.place(mkstate())
+    dp_dt = timed(
+        strategy.compile(step_fn, placed),  # compile only traces: safe to
+        placed,                             # reuse the same placed state
+        strategy.shard_batch(host_batch),
+    )
+    plain_dt = timed(
+        jax.jit(step_fn, donate_argnums=(0,)),
+        mkstate(),
+        jax.device_put(host_batch),
+    )
+    _emit(
+        {
+            "metric": "dp_step_overhead_ms",
+            "value": round((dp_dt - plain_dt) * 1e3, 3),
+            "unit": f"ms, DP-strategy step minus plain jitted step, "
+            f"world=1 (collective compiler-eliminated); plain="
+            f"{plain_dt * 1e3:.3f}ms",
             "vs_baseline": None,
         }
     )
@@ -487,13 +636,26 @@ def bench_allreduce_hostring() -> None:
     if bad:
         raise RuntimeError(f"hostring bench failed: {bad}")
     ms = max(r[1] for r in results)
+    # honest target: the ring is shm-memcpy-bound, so compare its moved
+    # bytes/s against this host's own measured 1-core memcpy bandwidth
+    # (ring allreduce moves 2*(w-1)/w * payload per process)
+    n = ALLREDUCE_ELEMS // 4
+    a, b = np.ones(n, np.float32), np.empty(n, np.float32)
+    np.copyto(b, a)  # fault the pages
+    t0 = time.perf_counter()
+    for _ in range(5):
+        np.copyto(b, a)
+    memcpy_gbs = 5 * n * 4 / (time.perf_counter() - t0) / 1e9
+    moved_gb = 2 * (world - 1) / world * n * 4 / 1e9
+    achieved_gbs = moved_gb / (ms / 1e3)
     _emit(
         {
             "metric": "hostring_allreduce_ms",
             "value": round(ms, 2),
-            "unit": f"ms per {ALLREDUCE_ELEMS // 4 / 1e6:.1f}M-elem f32 "
-            f"allreduce, 4 procs",
-            "vs_baseline": None,
+            "unit": f"ms per {n / 1e6:.1f}M-elem f32 allreduce, 4 procs; "
+            f"{achieved_gbs:.2f} GB/s moved vs {memcpy_gbs:.2f} GB/s "
+            f"1-core memcpy bound",
+            "vs_baseline": round(achieved_gbs / memcpy_gbs, 4),
         }
     )
 
@@ -540,14 +702,13 @@ def main():
     ptd.enable_compilation_cache()
     on_tpu = ptd.is_tpu()
     ptd.init_process_group()
-    bench_resnet50(on_tpu)
 
     def spent():
         return time.perf_counter() - t0
 
     failures = []
 
-    def run_if_budget(name, fn, *args):
+    def run_if_budget(name, fn, *args, **kw):
         # each phase starts only with wall clock in hand: the axon
         # remote compiles are unbounded when the relay misbehaves, and a
         # bench that never returns erases every later metric. A budget
@@ -560,20 +721,43 @@ def main():
             )
             return
         try:
-            fn(*args)
+            fn(*args, **kw)
         except Exception as e:
             failures.append(name)
             print(f"# {name} FAILED: {type(e).__name__}: {e}",
                   file=sys.stderr)
 
-    run_if_budget("input_pipeline", bench_input_pipeline, on_tpu)
-    run_if_budget("allreduce_device", bench_allreduce_device, on_tpu)
-    run_if_budget("allreduce_hostring", bench_allreduce_hostring)
-    # LAST: the transformer compiles are the largest on the axon
-    # remote-compile path (>10 min cold); if one wedges, every metric
-    # above has already been emitted
-    run_if_budget("generate", bench_generate, on_tpu)
-    run_if_budget("gpt2", bench_gpt2, on_tpu)
+    if not on_tpu:
+        # CPU fallback (VERDICT r2 #7): every emitted line must be a real
+        # measurement of what its name claims. Model-consumption metrics
+        # (resnet50/gpt2/decode throughput) on a CPU measure only CPU
+        # model speed wearing TPU metric names — suppressed. What IS
+        # host-meaningful: the input-pipeline feed rate at real shapes
+        # (primary) and the shm-ring collective vs this host's memcpy
+        # bound.
+        print(
+            "# CPU fallback: consumption-bound metrics (resnet50, gpt2, "
+            "decode, dp step) suppressed — emitting host-side "
+            "measurements only", file=sys.stderr,
+        )
+        run_if_budget(
+            "input_pipeline_feed", bench_input_pipeline, False,
+            feed_only=True,
+        )
+        run_if_budget("allreduce_hostring", bench_allreduce_hostring)
+    else:
+        bench_resnet50(on_tpu)
+        run_if_budget("input_pipeline", bench_input_pipeline, on_tpu)
+        if ptd.get_world_size() > 1:
+            run_if_budget("allreduce_device", bench_allreduce_device, on_tpu)
+        else:
+            run_if_budget("dp_step_overhead", bench_dp_step_overhead, on_tpu)
+        run_if_budget("allreduce_hostring", bench_allreduce_hostring)
+        # LAST: the transformer compiles are the largest on the axon
+        # remote-compile path (>10 min cold); if one wedges, every metric
+        # above has already been emitted
+        run_if_budget("generate", bench_generate, on_tpu)
+        run_if_budget("gpt2", bench_gpt2, on_tpu)
     if failures:
         print(f"# bench phases FAILED: {failures}", file=sys.stderr)
         sys.exit(1)

@@ -1,0 +1,62 @@
+"""Driver-contract test for bench.py's CPU-fallback mode.
+
+VERDICT r2 #7: a fallback run must emit only host-meaningful metrics —
+stdout carries exactly one JSON line (the driver contract) whose metric is
+a real host measurement, and the consumption-bound TPU metric names must
+not appear anywhere in the output.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# metrics whose value on a CPU is only "how fast is this CPU at running
+# the model" — must be suppressed in fallback runs
+CONSUMPTION_BOUND = [
+    "resnet50_imagenet_images_per_sec_per_chip",
+    "resnet50_e2e_dataloader_images_per_sec_per_chip",
+    "resnet50_e2e_u8_device_normalize_images_per_sec_per_chip",
+    "gpt2_medium_tokens_per_sec_per_chip",
+    "gpt2_decode_tokens_per_sec",
+    "dp_allreduce_step_ms",
+    "dp_step_overhead_ms",
+]
+
+
+@pytest.mark.slow
+def test_bench_cpu_fallback_is_host_meaningful():
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""  # no relay plugin registration
+    env["JAX_PLATFORMS"] = "cpu"
+    # the driver runs bench with a 1-device env; the test-suite conftest
+    # exports an 8-device XLA_FLAGS that would inflate the child's world
+    # (8x the batch on a CPU) — strip it
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    # stdout: exactly one JSON line, a host-side measurement, platform cpu
+    stdout_lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(stdout_lines) == 1, stdout_lines
+    primary = json.loads(stdout_lines[0])
+    assert primary["metric"] == "input_pipeline_feed_images_per_sec"
+    assert primary["platform"] == "cpu"
+    assert primary["value"] > 0
+
+    # stderr secondary metrics: all host-meaningful, none consumption-bound
+    for line in proc.stderr.splitlines():
+        if not line.startswith("{"):
+            continue
+        rec = json.loads(line)
+        assert rec["metric"] not in CONSUMPTION_BOUND, rec
+        assert rec["platform"] == "cpu"
+    assert "hostring_allreduce_ms" in proc.stderr
+    assert "input_pipeline_u8_feed_images_per_sec" in proc.stderr
