@@ -7,12 +7,13 @@
 // POSIX shared-memory segment and run collectives through per-rank data
 // slots guarded by a process-shared sense-reversing barrier.
 //
-// Algorithm per collective (flat, bandwidth-fine for the smoke path):
+// Algorithm per collective (slot-array exchanges, chunked by slot size):
+// copy-shaped collectives (gather/broadcast) are flat —
 //   barrier -> each rank writes its contribution to its slot
-//   barrier -> each rank reads the slots it needs and combines locally
+//   barrier -> each rank reads the slots it needs
 //   barrier -> (write-after-read hazard fence before the next collective)
-// Data larger than the slot size is processed in slot-sized chunks inside
-// the C library.
+// — while allreduce is a segmented reduce-scatter + allgather (4 barriers
+// per chunk; rank r owns segment r, partial publishes — see hr_allreduce).
 //
 // Exposed as a C ABI for ctypes (no pybind11 in this image). All entry
 // points return 0 on success, a negative errno-style code on failure;
@@ -265,6 +266,14 @@ int hr_rank(void* h) { return ((Group*)h)->rank; }
 int hr_world(void* h) { return ((Group*)h)->world; }
 
 // In-place allreduce over `count` elements of `data`, chunked by slot size.
+//
+// Segmented reduce-scatter + allgather over the slot array: rank r reduces
+// only segment r of each chunk (its 1/world share) and republishes the
+// reduced segment; everyone then gathers the other owners' segments. Total
+// combine work across ranks is (world-1)*n adds — the flat all-ranks-
+// combine-everything scheme did world*(world-1)*n — and the dead self-copy
+// is gone. On a single-core host (all ranks timeshared) this halves wall
+// time; on real multi-core it also fixes the O(world) scaling.
 int hr_allreduce(void* h, void* data, uint64_t count, int32_t dtype,
                  int32_t op) {
   Group* g = (Group*)h;
@@ -272,19 +281,45 @@ int hr_allreduce(void* h, void* data, uint64_t count, int32_t dtype,
   if (esize == 0) return kErrInval;
   const size_t chunk_elems = g->slot_bytes / esize;
   if (chunk_elems == 0) return kErrInval;
+  if (g->world == 1) return 0;  // identity
   uint8_t* p = (uint8_t*)data;
   for (uint64_t off = 0; off < count; off += chunk_elems) {
     const size_t n = size_t(count - off < chunk_elems ? count - off : chunk_elems);
+    uint8_t* base = p + off * esize;
+    const size_t seg = n / size_t(g->world);  // elements per owner segment
+    const size_t s0 = size_t(g->rank) * seg;
+    const size_t sn = (g->rank == g->world - 1) ? n - s0 : seg;
     int rc = barrier_wait(g);
     if (rc != 0) return rc;
-    memcpy(slot(g, g->rank), p + off * esize, n * esize);
+    // publish contribution — EXCEPT our own segment, which only this rank
+    // would ever read (it reduces straight out of `base` instead)
+    if (s0) memcpy(slot(g, g->rank), base, s0 * esize);
+    if (s0 + sn < n)
+      memcpy(slot(g, g->rank) + (s0 + sn) * esize, base + (s0 + sn) * esize,
+             (n - s0 - sn) * esize);
     rc = barrier_wait(g);
     if (rc != 0) return rc;
-    // Local combine of all slots, starting from our own contribution.
-    memcpy(p + off * esize, slot(g, g->rank), n * esize);
+    if (sn) {
+      // reduce own segment across all ranks into the destination buffer
+      // (base already holds our own contribution), then republish it in
+      // our slot. Writing slot(rank)[seg rank] is race-free: only this
+      // rank ever touches segment `rank` after the publish barrier.
+      for (int r = 1; r < g->world; ++r) {
+        const int src = (g->rank + r) % g->world;
+        combine_dispatch(base + s0 * esize, slot(g, src) + s0 * esize, sn,
+                         dtype, op);
+      }
+      memcpy(slot(g, g->rank) + s0 * esize, base + s0 * esize, sn * esize);
+    }
+    rc = barrier_wait(g);
+    if (rc != 0) return rc;
+    // allgather the other owners' reduced segments
     for (int r = 1; r < g->world; ++r) {
-      const int src = (g->rank + r) % g->world;
-      combine_dispatch(p + off * esize, slot(g, src), n, dtype, op);
+      const int owner = (g->rank + r) % g->world;
+      const size_t o0 = size_t(owner) * seg;
+      const size_t on = (owner == g->world - 1) ? n - o0 : seg;
+      if (on)
+        memcpy(base + o0 * esize, slot(g, owner) + o0 * esize, on * esize);
     }
     rc = barrier_wait(g);
     if (rc != 0) return rc;
