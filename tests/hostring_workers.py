@@ -48,6 +48,14 @@ def raw_worker(rank: int, world: int, name: str, q) -> None:
             # big payload: crosses the chunking path
             big = g.all_reduce(np.ones(3_000_000, np.float32))
             assert np.all(big == world)
+            # segmented-allreduce edges: n < world (all segments empty but
+            # the last rank's) and a ragged n = world + 1 tail
+            tiny = g.all_reduce(np.array([rank + 1.0], np.float32))
+            assert tiny[0] == world * (world + 1) / 2, tiny
+            ragged = g.all_reduce(
+                np.full(world + 1, rank + 1.0, np.float32)
+            )
+            assert np.all(ragged == world * (world + 1) / 2), ragged
         q.put((rank, "ok"))
     except Exception as e:  # pragma: no cover - reported via queue
         q.put((rank, f"{type(e).__name__}: {e}"))
