@@ -174,6 +174,62 @@ class TestStrategyNumerics:
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5, err_msg=str(pa)
             )
 
+    def test_batchnorm_is_sync_batchnorm_under_dp(self):
+        """torch's DDP recipes need SyncBatchNorm to normalize over the
+        GLOBAL batch; under single-controller SPMD a BatchNorm mean over a
+        dp-sharded batch axis IS a global mean (the compiler inserts the
+        cross-replica reduction). Pin that: batch_stats after a DP step on
+        a dp=8 mesh equal the single-device stats for the same global
+        batch — cross-replica sync by construction, no wrapper needed."""
+        from pytorch_distributed_tpu.models.resnet import BasicBlock, ResNet
+        from pytorch_distributed_tpu.train import (
+            build_train_step,
+            classification_loss_fn,
+        )
+
+        model = ResNet(
+            stage_sizes=[1], block_cls=BasicBlock, num_classes=4, width=8,
+            stem="cifar",
+        )
+        rng = np.random.default_rng(0)
+        batch = {
+            # batch entries are all DIFFERENT, so per-shard means differ
+            # from the global mean unless the reduction is cross-replica
+            "image": rng.normal(size=(16, 8, 8, 3)).astype(np.float32) * 3,
+            "label": rng.integers(4, size=(16,)).astype(np.int32),
+        }
+        variables = model.init(
+            jax.random.key(0), jnp.zeros((1, 8, 8, 3)), train=False
+        )
+
+        def mkstate():
+            return TrainState.create(
+                apply_fn=model.apply,
+                params=variables["params"],
+                tx=optax.sgd(0.1),
+                batch_stats=variables["batch_stats"],
+            )
+
+        step_fn = build_train_step(classification_loss_fn(model))
+
+        make_mesh(MeshSpec(dp=1), devices=jax.devices()[:1])
+        ref, _ = jax.jit(step_fn)(mkstate(), batch)
+
+        mesh = make_mesh(MeshSpec(dp=8))
+        strategy = DataParallel(mesh)
+        state = strategy.place(mkstate())
+        state, _ = strategy.compile(step_fn, state)(
+            state, strategy.shard_batch(batch)
+        )
+        for (path, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(state.batch_stats),
+            jax.tree_util.tree_leaves_with_path(ref.batch_stats),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6,
+                err_msg=str(path),
+            )
+
     def test_zero1_opt_state_is_sharded(self):
         mesh = make_mesh(MeshSpec(dp=4, fsdp=1, tp=2))
         state = ZeRO1(mesh).place(make_state())
