@@ -21,6 +21,18 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import pytest
 
+# Persistent executable cache — the SAME helper recipes/bench use, so the
+# suite and production runs share one cache policy. The suite is
+# compile-dominated on this 1-core box; a warm cache cuts re-runs ~30%.
+# Best-effort: an unwritable cache dir (read-only $HOME CI) must not stop
+# the suite from collecting.
+try:
+    from pytorch_distributed_tpu.runtime.device import enable_compilation_cache
+
+    enable_compilation_cache()
+except OSError:
+    pass
+
 
 @pytest.fixture(autouse=True)
 def _reset_global_state():
