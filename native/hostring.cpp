@@ -89,6 +89,7 @@ struct Group {
   size_t p2p_bytes;  // mailbox payload per channel
   char name[256];
   double timeout_s;
+  float* red_scratch = nullptr;  // f32 accumulator for half allreduce
 };
 
 double now_s() {
@@ -122,21 +123,136 @@ int barrier_wait(Group* g) {
 
 // U8 is the raw-byte dtype for copy-shaped collectives (gather/broadcast);
 // reductions over it are bytewise and only meaningful for MAX/MIN.
-enum Dtype : int32_t { F32 = 0, F64 = 1, I32 = 2, I64 = 3, U8 = 4 };
-enum Op : int32_t { SUM = 0, PROD = 1, MAX = 2, MIN = 3 };
+// BF16/F16 are the TPU compute dtypes: allreduce ships them at native
+// 2-byte bandwidth and accumulates in f32 (NCCL's half-precision design).
+enum Dtype : int32_t {
+  F32 = 0, F64 = 1, I32 = 2, I64 = 3, U8 = 4, BF16 = 5, F16 = 6
+};
+// AVG exists so half-precision averaging can divide in f32 BEFORE the
+// single rounding (a post-hoc divide of the rounded half sum overflows —
+// e.g. f16 world=4 avg of 30000.0). Only hr_allreduce accepts it.
+enum Op : int32_t { SUM = 0, PROD = 1, MAX = 2, MIN = 3, AVG = 4 };
 
 size_t dtype_size(int32_t d) {
   switch (d) {
     case F32: case I32: return 4;
     case F64: case I64: return 8;
     case U8: return 1;
+    case BF16: case F16: return 2;
     default: return 0;
+  }
+}
+
+bool is_half(int32_t d) { return d == BF16 || d == F16; }
+
+inline float bf16_to_f32(uint16_t h) {
+  uint32_t u = uint32_t(h) << 16;
+  float f;
+  memcpy(&f, &u, 4);
+  return f;
+}
+
+inline uint16_t f32_to_bf16(float f) {
+  uint32_t u;
+  memcpy(&u, &f, 4);
+  u += 0x7fffu + ((u >> 16) & 1);  // round to nearest even
+  return uint16_t(u >> 16);
+}
+
+// Portable software fp16<->f32 (round-to-nearest-even, subnormals, inf/
+// nan) — the _Float16 extension needs GCC>=12 on x86-64 and would fail
+// the whole library build on older toolchains.
+inline float f16_to_f32(uint16_t h) {
+  const uint32_t sign = uint32_t(h & 0x8000) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t man = h & 0x3ff;
+  uint32_t u;
+  if (exp == 0) {
+    if (man == 0) {
+      u = sign;  // +-0
+    } else {  // subnormal: renormalize
+      int shift = 0;
+      while (!(man & 0x400)) {
+        man <<= 1;
+        ++shift;
+      }
+      man &= 0x3ff;
+      u = sign | (uint32_t(127 - 15 - shift + 1) << 23) | (man << 13);
+    }
+  } else if (exp == 31) {
+    u = sign | 0x7f800000u | (man << 13);  // inf / nan
+  } else {
+    u = sign | ((exp - 15 + 127) << 23) | (man << 13);
+  }
+  float f;
+  memcpy(&f, &u, 4);
+  return f;
+}
+
+inline uint16_t f32_to_f16(float f) {
+  uint32_t u;
+  memcpy(&u, &f, 4);
+  const uint16_t sign = uint16_t((u >> 16) & 0x8000);
+  const uint32_t fexp = (u >> 23) & 0xff;
+  uint32_t man = u & 0x7fffff;
+  if (fexp == 0xff)  // inf / nan (nan keeps a payload bit set)
+    return sign | 0x7c00 | (man ? 0x200 | uint16_t(man >> 13) : 0);
+  const int32_t exp = int32_t(fexp) - 127 + 15;
+  if (exp >= 31) return sign | 0x7c00;  // overflow -> inf
+  if (exp <= 0) {                       // subnormal or zero
+    if (exp < -10) return sign;         // underflows to zero
+    man |= 0x800000;                    // implicit bit
+    const uint32_t shift = uint32_t(14 - exp);  // in [14, 24]
+    uint32_t half = man >> shift;
+    const uint32_t rem = man & ((1u << shift) - 1);
+    const uint32_t halfway = 1u << (shift - 1);
+    if (rem > halfway || (rem == halfway && (half & 1))) ++half;
+    return sign | uint16_t(half);
+  }
+  uint32_t half = (uint32_t(exp) << 10) | (man >> 13);
+  const uint32_t rem = man & 0x1fff;
+  if (rem > 0x1000 || (rem == 0x1000 && (half & 1)))
+    ++half;  // RNE; a mantissa carry bumps the exponent (incl. to inf)
+  return sign | uint16_t(half);
+}
+
+inline float half_to_f32(uint16_t h, int32_t d) {
+  return d == BF16 ? bf16_to_f32(h) : f16_to_f32(h);
+}
+
+inline uint16_t f32_to_half(float f, int32_t d) {
+  return d == BF16 ? f32_to_bf16(f) : f32_to_f16(f);
+}
+
+void combine_f32(float* acc, const uint16_t* src, size_t n, int32_t dtype,
+                 int32_t op) {
+  switch (op) {
+    case AVG:  // accumulate like SUM; hr_allreduce divides pre-rounding
+    case SUM:
+      for (size_t i = 0; i < n; ++i) acc[i] += half_to_f32(src[i], dtype);
+      break;
+    case PROD:
+      for (size_t i = 0; i < n; ++i) acc[i] *= half_to_f32(src[i], dtype);
+      break;
+    case MAX:
+      for (size_t i = 0; i < n; ++i) {
+        const float v = half_to_f32(src[i], dtype);
+        acc[i] = acc[i] < v ? v : acc[i];
+      }
+      break;
+    case MIN:
+      for (size_t i = 0; i < n; ++i) {
+        const float v = half_to_f32(src[i], dtype);
+        acc[i] = v < acc[i] ? v : acc[i];
+      }
+      break;
   }
 }
 
 template <typename T>
 void combine(T* acc, const T* src, size_t n, int32_t op) {
   switch (op) {
+    case AVG:  // accumulate like SUM; the caller divides after
     case SUM:  for (size_t i = 0; i < n; ++i) acc[i] += src[i]; break;
     case PROD: for (size_t i = 0; i < n; ++i) acc[i] *= src[i]; break;
     case MAX:
@@ -156,6 +272,18 @@ void combine_dispatch(void* acc, const void* src, size_t n, int32_t dtype,
     case I32: combine((int32_t*)acc, (const int32_t*)src, n, op); break;
     case I64: combine((int64_t*)acc, (const int64_t*)src, n, op); break;
     case U8: combine((uint8_t*)acc, (const uint8_t*)src, n, op); break;
+    case BF16: case F16: {
+      // pairwise path (rounds per step) — hr_allreduce's segment reduce
+      // uses the single-rounding f32-scratch path instead
+      uint16_t* a = (uint16_t*)acc;
+      const uint16_t* s = (const uint16_t*)src;
+      for (size_t i = 0; i < n; ++i) {
+        float x = half_to_f32(a[i], dtype);
+        combine_f32(&x, &s[i], 1, dtype, op);
+        a[i] = f32_to_half(x, dtype);
+      }
+      break;
+    }
   }
 }
 
@@ -281,7 +409,11 @@ int hr_allreduce(void* h, void* data, uint64_t count, int32_t dtype,
   if (esize == 0) return kErrInval;
   const size_t chunk_elems = g->slot_bytes / esize;
   if (chunk_elems == 0) return kErrInval;
-  if (g->world == 1) return 0;  // identity
+  // AVG divides in the element domain — only meaningful for floats; the
+  // ctypes layer floor-divides integers host-side after a SUM instead
+  if (op == AVG && !(dtype == F32 || dtype == F64 || is_half(dtype)))
+    return kErrInval;
+  if (g->world == 1) return 0;  // identity (avg of one value is itself)
   uint8_t* p = (uint8_t*)data;
   for (uint64_t off = 0; off < count; off += chunk_elems) {
     const size_t n = size_t(count - off < chunk_elems ? count - off : chunk_elems);
@@ -304,10 +436,38 @@ int hr_allreduce(void* h, void* data, uint64_t count, int32_t dtype,
       // (base already holds our own contribution), then republish it in
       // our slot. Writing slot(rank)[seg rank] is race-free: only this
       // rank ever touches segment `rank` after the publish barrier.
-      for (int r = 1; r < g->world; ++r) {
-        const int src = (g->rank + r) % g->world;
-        combine_dispatch(base + s0 * esize, slot(g, src) + s0 * esize, sn,
-                         dtype, op);
+      if (is_half(dtype)) {
+        // halves accumulate in an f32 scratch — data ships at 2-byte
+        // bandwidth but the sum rounds ONCE, like NCCL's half allreduce
+        if (!g->red_scratch) g->red_scratch = new float[g->slot_bytes / 2];
+        uint16_t* hbase = (uint16_t*)base;
+        float* acc = g->red_scratch;
+        for (size_t i = 0; i < sn; ++i)
+          acc[i] = half_to_f32(hbase[s0 + i], dtype);
+        for (int r = 1; r < g->world; ++r) {
+          const int src = (g->rank + r) % g->world;
+          combine_f32(acc, (const uint16_t*)slot(g, src) + s0, sn, dtype, op);
+        }
+        if (op == AVG)  // divide BEFORE the single rounding: a rounded
+          for (size_t i = 0; i < sn; ++i)  // half sum can overflow to inf
+            acc[i] /= float(g->world);
+        for (size_t i = 0; i < sn; ++i)
+          hbase[s0 + i] = f32_to_half(acc[i], dtype);
+      } else {
+        for (int r = 1; r < g->world; ++r) {
+          const int src = (g->rank + r) % g->world;
+          combine_dispatch(base + s0 * esize, slot(g, src) + s0 * esize, sn,
+                           dtype, op);
+        }
+        if (op == AVG) {
+          if (dtype == F32) {
+            float* fb = (float*)base + s0;
+            for (size_t i = 0; i < sn; ++i) fb[i] /= float(g->world);
+          } else {  // F64 (gated above)
+            double* db = (double*)base + s0;
+            for (size_t i = 0; i < sn; ++i) db[i] /= double(g->world);
+          }
+        }
       }
       memcpy(slot(g, g->rank) + s0 * esize, base + s0 * esize, sn * esize);
     }
@@ -460,6 +620,7 @@ int hr_finalize(void* h) {
   const uint32_t left = g->hdr->attached.fetch_sub(1) - 1;
   if (left == 0 || g->rank == 0) shm_unlink(g->name);
   munmap((void*)g->hdr, g->map_bytes);
+  delete[] g->red_scratch;
   delete g;
   return 0;
 }

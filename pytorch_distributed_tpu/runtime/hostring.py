@@ -31,18 +31,24 @@ _DTYPES = {
     np.dtype(np.float64): 1,
     np.dtype(np.int32): 2,
     np.dtype(np.int64): 3,
+    np.dtype(np.float16): 6,  # F16: native 2-byte collectives
 }
 _U8 = 4  # raw-byte dtype: copy-shaped collectives on arbitrary dtypes
-_OPS = {"sum": 0, "prod": 1, "product": 1, "max": 2, "min": 3}
+# "avg" is a REAL C-side op for float dtypes: the division happens in the
+# f32 accumulator before the final rounding, so half averages can't
+# overflow the way divide-after-rounded-sum would (f16 avg of 30000.0 x4)
+_OPS = {"sum": 0, "prod": 1, "product": 1, "max": 2, "min": 3, "avg": 4}
 
-# Half dtypes (the TPU compute dtypes) reduce via an f32 round trip: the
-# host ring is a smoke/CPU path, so the upcast bandwidth is irrelevant and
-# f32 accumulation is strictly more accurate than native-half combines.
+# Half dtypes (the TPU compute dtypes): ``all_reduce`` ships them NATIVELY
+# at 2-byte bandwidth — the C side accumulates each segment in f32 and
+# rounds once, NCCL's half-allreduce design. The remaining reduction
+# (reduce_scatter) still takes the f32 round trip below.
 _HALF = {np.dtype(np.float16)}
 try:  # ml_dtypes ships with jax
     import ml_dtypes
 
     _HALF.add(np.dtype(ml_dtypes.bfloat16))
+    _DTYPES[np.dtype(ml_dtypes.bfloat16)] = 5  # BF16
 except ImportError:  # pragma: no cover
     pass
 
@@ -198,21 +204,20 @@ class HostRingGroup:
         _check(_load().hr_barrier(self._h), "barrier")
 
     def all_reduce(self, x, op: str = "sum") -> np.ndarray:
-        avg = op == "avg"
-        half = np.asarray(x).dtype if np.asarray(x).dtype in _HALF else None
-        if half is not None:
-            x = np.asarray(x).astype(np.float32)
         a = _as_contig(x).copy()
         if self.debug:
             self._verify_uniform("all_reduce", a, op)
+        # floats average natively (divide-then-round in the C f32
+        # accumulator); integers sum natively and floor-divide here
+        int_avg = op == "avg" and a.dtype.kind in "iu"
         rc = _load().hr_allreduce(
             self._h, a.ctypes.data_as(ctypes.c_void_p), a.size,
-            _DTYPES[a.dtype], _OPS["sum" if avg else op],
+            _DTYPES[a.dtype], _OPS["sum" if int_avg else op],
         )
         _check(rc, "all_reduce")
-        if avg:
-            a = a / self.world_size if a.dtype.kind == "f" else a // self.world_size
-        return a.astype(half) if half is not None else a
+        if int_avg:
+            a //= self.world_size
+        return a
 
     def all_gather(self, x) -> np.ndarray:
         a = _as_contig(x, dtype_required=False)
@@ -232,6 +237,8 @@ class HostRingGroup:
 
     def reduce_scatter(self, x, op: str = "sum") -> np.ndarray:
         """x: [world_size, ...] — returns this rank's reduced chunk x[rank]."""
+        if op == "avg":  # the C AVG op divides only in hr_allreduce
+            raise ValueError("op='avg' is only supported for all_reduce")
         half = np.asarray(x).dtype if np.asarray(x).dtype in _HALF else None
         if half is not None:
             x = np.asarray(x).astype(np.float32)

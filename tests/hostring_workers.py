@@ -56,6 +56,46 @@ def raw_worker(rank: int, world: int, name: str, q) -> None:
                 np.full(world + 1, rank + 1.0, np.float32)
             )
             assert np.all(ragged == world * (world + 1) / 2), ragged
+            # native half allreduce: ships 2-byte, accumulates f32, rounds
+            # ONCE — the result must equal f32-sum-then-round exactly
+            import ml_dtypes
+
+            allh = (
+                np.arange(world * 3, dtype=np.float32).reshape(world, 3)
+                + 0.33
+            ).astype(ml_dtypes.bfloat16)
+            got = g.all_reduce(allh[rank].copy())
+            want = allh.astype(np.float32).sum(axis=0).astype(
+                ml_dtypes.bfloat16
+            )
+            assert got.dtype == allh.dtype, got.dtype
+            assert np.array_equal(
+                got.astype(np.float32), want.astype(np.float32)
+            ), (got, want)
+            hm = g.all_reduce(np.array([rank], np.float16), op="max")
+            assert hm.dtype == np.float16 and hm[0] == world - 1, hm
+            # avg on halves divides BEFORE the single rounding: an f16 sum
+            # of 30000.0 x world overflows f16, the average must not
+            ha = g.all_reduce(np.array([30000.0, -2.5], np.float16),
+                              op="avg")
+            assert ha[0] == np.float16(30000.0), ha
+            assert ha[1] == np.float16(-2.5), ha
+            ba = g.all_reduce(
+                np.full(5, rank + 1.0, np.float32).astype(ml_dtypes.bfloat16),
+                op="avg",
+            )
+            want_avg = np.float32((world + 1) / 2).astype(ml_dtypes.bfloat16)
+            assert np.all(ba == want_avg), (ba, want_avg)
+            # f16 software conversions agree with numpy's, including
+            # subnormals and values that round up across an exponent
+            probe = np.array(
+                [6e-8, 6.1e-5, 65504.0, 65520.0, 2048.2, -0.0],
+                np.float32,
+            ).astype(np.float16)
+            conv = g.all_reduce(probe, op="max")  # world-identical: the
+            assert np.array_equal(                # round trip is the test
+                conv, probe, equal_nan=True
+            ), (conv, probe)
         q.put((rank, "ok"))
     except Exception as e:  # pragma: no cover - reported via queue
         q.put((rank, f"{type(e).__name__}: {e}"))
