@@ -516,6 +516,7 @@ int hr_allgather(void* h, const void* in, void* out, uint64_t count,
 int hr_reduce_scatter(void* h, const void* in, void* out, uint64_t chunk,
                       int32_t dtype, int32_t op) {
   Group* g = (Group*)h;
+  if (op == AVG) return kErrInval;  // AVG divides only in hr_allreduce
   const size_t esize = dtype_size(dtype);
   if (esize == 0) return kErrInval;
   const size_t chunk_elems = g->slot_bytes / esize;

@@ -64,6 +64,7 @@ def build_train_step(
     accum_steps: int = 1,
     scaler: Optional[GradScaler] = None,
     batch_transform: Optional[Callable[[Any], Any]] = None,
+    grad_compression: Optional[str] = None,
 ) -> Callable[[TrainState, Any], Tuple[TrainState, Dict[str, jax.Array]]]:
     """Build ``step(state, batch) -> (state, metrics)`` for jit/Strategy.compile.
 
@@ -75,6 +76,11 @@ def build_train_step(
     ``batch_transform`` runs ON-DEVICE inside the jitted step, before
     microbatch splitting — e.g. ``ImageBatchPipeline.device_normalizer()``
     so uint8 batches ship over the host link and normalize on-chip.
+
+    ``grad_compression`` ("bf16"/"fp16") compresses the multi-process
+    gradient sync on the wire (see ``parallel.ddp.sync_grads``); it has no
+    effect in single-controller SPMD mode, where grad reduction is a
+    compiler-inserted collective.
     """
     scaling = scaler is not None and scaler.enabled
 
@@ -145,7 +151,7 @@ def build_train_step(
         from pytorch_distributed_tpu.parallel import ddp
 
         if ddp.is_multiprocess():
-            grads = ddp.sync_grads(grads)
+            grads = ddp.sync_grads(grads, compress=grad_compression)
 
         if scaling:
             new_scaler_state, grads_ok = scaler.functional_update(

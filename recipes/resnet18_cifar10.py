@@ -46,6 +46,9 @@ from pytorch_distributed_tpu.utils import log_rank0
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--backend", default=None, help="ici|gloo (default: auto)")
+    p.add_argument("--grad-compress", default=None,
+                   choices=("bf16", "fp16"),
+                   help="compress multi-process gradient sync on the wire")
     p.add_argument("--epochs", type=int, default=2)
     p.add_argument("--batch-size", type=int, default=128, help="global batch")
     p.add_argument("--lr", type=float, default=0.1)
@@ -132,7 +135,8 @@ def main(argv=None):
         state,
         strategy,
         build_train_step(
-            classification_loss_fn(model, weight_decay=args.weight_decay)
+            classification_loss_fn(model, weight_decay=args.weight_decay),
+            grad_compression=args.grad_compress,
         ),
         train_loader,
         eval_step=classification_eval_step(model),

@@ -175,6 +175,50 @@ def ddp_train_worker(rank: int, path: str) -> None:
     ptd.destroy_process_group()
 
 
+def grad_compress_worker(rank: int, path: str) -> None:
+    """sync_grads(compress='bf16') ships bf16 and must equal the exact
+    reference: bf16(mean_f32(bf16(g_r))) upcast back to f32."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    import pytorch_distributed_tpu as ptd
+    from pytorch_distributed_tpu.parallel.ddp import sync_grads
+
+    ptd.init_process_group("gloo")
+    world = ptd.get_world_size()
+    rng = np.random.default_rng(42)
+    allg = (rng.normal(size=(world, 33)) * 100).astype(np.float32)
+
+    @jax.jit
+    def compressed(g):
+        return sync_grads(g, compress="bf16")
+
+    @jax.jit
+    def plain(g):
+        return sync_grads(g)
+
+    out = np.asarray(compressed({"w": jnp.asarray(allg[rank])})["w"])
+    assert out.dtype == np.float32, out.dtype
+    cast = allg.astype(ml_dtypes.bfloat16).astype(np.float32)
+    want = (
+        (cast.sum(axis=0) / world)
+        .astype(ml_dtypes.bfloat16)
+        .astype(np.float32)
+    )
+    np.testing.assert_array_equal(out, want)
+    # uncompressed stays the exact f32 mean
+    out32 = np.asarray(plain({"w": jnp.asarray(allg[rank])})["w"])
+    np.testing.assert_allclose(out32, allg.mean(axis=0), rtol=1e-6)
+    # and the compressed result is close to it (bf16 has ~3 decimal digits)
+    np.testing.assert_allclose(out, out32, rtol=1e-2)
+    with open(os.path.join(path, f"gc{rank}.ok"), "w") as f:
+        f.write("ok")
+    ptd.destroy_process_group()
+
+
 def mismatch_worker(rank: int, world: int, name: str, q) -> None:
     """Debug mode must catch ranks issuing different collectives."""
     try:

@@ -49,6 +49,18 @@ def test_ddp_invariant_across_ranks(tmp_path):
         assert (tmp_path / f"ddp{r}.ok").read_text() == "ok"
 
 
+@pytest.mark.slow
+def test_grad_compression_bf16_across_ranks(tmp_path):
+    """bf16-compressed gradient sync: exact single-rounding semantics on
+    the wire, f32 results back in the step."""
+    from pytorch_distributed_tpu.launch import spawn
+
+    spawn(hostring_workers.grad_compress_worker, args=(str(tmp_path),),
+          nprocs=2, timeout_s=300)
+    for r in range(2):
+        assert (tmp_path / f"gc{r}.ok").read_text() == "ok"
+
+
 def test_spawn_propagates_failure():
     from pytorch_distributed_tpu.launch import spawn
 
