@@ -88,6 +88,22 @@ class MetricsWriter:
             self._f = None
 
 
+class TeeWriter:
+    """Fan a MetricsWriter-protocol stream out to several writers (e.g.
+    JSONL + TensorBoard)."""
+
+    def __init__(self, writers):
+        self.writers = list(writers)
+
+    def write(self, step, metrics, *, split: str = "train") -> None:
+        for w in self.writers:
+            w.write(step, metrics, split=split)
+
+    def close(self) -> None:
+        for w in self.writers:
+            w.close()
+
+
 def read_metrics(path: str) -> List[Dict[str, float]]:
     """Load a MetricsWriter JSONL back into a list of records."""
     out = []

@@ -35,6 +35,7 @@ from pytorch_distributed_tpu.train.metrics import (
     MeterState,
     MetricsWriter,
     ScalarMeter,
+    TeeWriter,
 )
 from pytorch_distributed_tpu.utils.logging import get_logger
 
@@ -186,6 +187,7 @@ class TrainerConfig:
     samples_axis: str = "image"  # batch leaf whose dim0 counts samples
     async_checkpoint: bool = False  # overlap ckpt IO with training
     metrics_path: Optional[str] = None  # JSONL scalar log (rank 0)
+    tensorboard_dir: Optional[str] = None  # TB event files (rank 0)
     # failure detection / elastic recovery (train/elastic.py):
     handle_preemption: bool = True  # SIGTERM -> checkpoint -> Preempted
     stall_timeout_s: Optional[float] = None  # watchdog hang detection
@@ -229,10 +231,20 @@ class Trainer:
         self.eval_loader = eval_loader
         self.meter = ScalarMeter()
         self.metrics_writer = None
-        if self.config.metrics_path and (
-            dist.multiprocess_ring() is None or dist.get_rank() == 0
-        ):
-            self.metrics_writer = MetricsWriter(self.config.metrics_path)
+        if dist.multiprocess_ring() is None or dist.get_rank() == 0:
+            writers = []
+            if self.config.metrics_path:
+                writers.append(MetricsWriter(self.config.metrics_path))
+            if self.config.tensorboard_dir:
+                from pytorch_distributed_tpu.utils.tensorboard import (
+                    TensorBoardWriter,
+                )
+
+                writers.append(TensorBoardWriter(self.config.tensorboard_dir))
+            if len(writers) == 1:
+                self.metrics_writer = writers[0]
+            elif writers:
+                self.metrics_writer = TeeWriter(writers)
         self.last_eval_metrics: Dict[str, float] = {}
         # Host-side mirror of state.step (monotonic Python int, +1 per
         # train_step call — apply_gradients increments exactly once per

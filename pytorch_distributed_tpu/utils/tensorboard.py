@@ -1,0 +1,117 @@
+"""TensorBoard scalar logging — torch.utils.tensorboard parity.
+
+The reference's recipe genre logs through
+``torch.utils.tensorboard.SummaryWriter`` (SURVEY.md §5 metrics/logging).
+Two surfaces here:
+
+* :class:`SummaryWriter` — the torch-shaped API (``add_scalar`` /
+  ``add_scalars`` / ``flush`` / ``close``) for ported scripts;
+* :class:`TensorBoardWriter` — the framework's ``MetricsWriter`` protocol
+  (``write(step, metrics, split=...)``), pluggable into the Trainer next
+  to the JSONL writer via ``TrainerConfig(tensorboard_dir=...)``.
+
+Both emit real TensorBoard event files through the installed
+``tensorboard`` package's own record writer and protos (no TF needed), so
+``tensorboard --logdir`` works directly on training runs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+
+def _event_writer(logdir: str):
+    from tensorboard.summary.writer.event_file_writer import EventFileWriter
+
+    return EventFileWriter(logdir)
+
+
+def _scalar_event(step: int, scalars: Dict[str, float], wall_time=None):
+    from tensorboard.compat.proto.event_pb2 import Event
+    from tensorboard.compat.proto.summary_pb2 import Summary
+
+    values = [
+        Summary.Value(tag=tag, simple_value=val)
+        for tag, val in scalars.items()
+    ]
+    return Event(
+        wall_time=wall_time if wall_time is not None else time.time(),
+        step=int(step),
+        summary=Summary(value=values),
+    )
+
+
+class SummaryWriter:
+    """torch.utils.tensorboard.SummaryWriter-shaped scalar writer."""
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+        self._w = _event_writer(log_dir)
+        self._closed = False
+
+    def _writer(self):
+        if self._closed:  # torch's SummaryWriter reopens after close()
+            self._w = _event_writer(self.log_dir)
+            self._closed = False
+        return self._w
+
+    def add_scalar(self, tag: str, value, global_step: int = 0) -> None:
+        self._writer().add_event(
+            _scalar_event(global_step, {tag: float(value)})
+        )
+
+    def add_scalars(
+        self, main_tag: str, tag_scalar_dict: Dict[str, float],
+        global_step: int = 0,
+    ) -> None:
+        self._writer().add_event(
+            _scalar_event(
+                global_step,
+                {
+                    f"{main_tag}/{k}": float(v)
+                    for k, v in tag_scalar_dict.items()
+                },
+            )
+        )
+
+    def flush(self) -> None:
+        if not self._closed:
+            self._w.flush()
+
+    def close(self) -> None:
+        if not self._closed:
+            self._w.close()
+            self._closed = True
+
+
+class TensorBoardWriter:
+    """``MetricsWriter``-protocol adapter: one event per (step, metrics).
+
+    Non-numeric values are skipped (TensorBoard scalars only); the split
+    becomes the usual ``train/``/``eval/`` tag prefix.
+    """
+
+    def __init__(self, logdir: str):
+        self.logdir = logdir
+        self._w: Optional[object] = _event_writer(logdir)
+
+    def write(
+        self, step: int, metrics: Dict[str, float], *, split: str = "train"
+    ) -> None:
+        if self._w is None:  # closed (end of a fit()) — reopen on reuse
+            self._w = _event_writer(self.logdir)
+        scalars = {}
+        for k, v in metrics.items():
+            try:
+                scalars[f"{split}/{k}"] = float(v)
+            except (TypeError, ValueError):
+                continue
+        if scalars:
+            self._w.add_event(_scalar_event(step, scalars))
+
+    def close(self) -> None:
+        if self._w is not None:
+            self._w.flush()
+            self._w.close()
+            self._w = None

@@ -425,6 +425,13 @@ class TestCheckpoint:
         assert int(out.step) == 4
 
 
+def _scalar_of(v):
+    """TB 2.x writers migrate simple_value scalars to rank-0 tensors."""
+    if v.HasField("simple_value"):
+        return v.simple_value
+    return v.tensor.float_val[0]
+
+
 class TestMetricsWriter:
     def test_jsonl_train_and_eval_records(self, dp8, tmp_path):
         from pytorch_distributed_tpu.train.metrics import read_metrics
@@ -467,6 +474,72 @@ class TestMetricsWriter:
         )
         trainer2.fit()
         assert len(read_metrics(path)) > len(recs)
+
+    def test_tensorboard_events_written_and_teed(self, dp8, tmp_path):
+        """TrainerConfig(tensorboard_dir=...) writes real TensorBoard event
+        files (readable by tensorboard's own loader) alongside the JSONL."""
+        import glob
+
+        pytest.importorskip("tensorboard")
+        from tensorboard.backend.event_processing.event_file_loader import (
+            EventFileLoader,
+        )
+
+        model = tiny_resnet()
+        state = tiny_image_state(model)
+        ds = SyntheticImageDataset(n=32, image_shape=(16, 16, 3), seed=0)
+        jsonl = str(tmp_path / "metrics.jsonl")
+        tb_dir = str(tmp_path / "tb")
+        trainer = Trainer(
+            state,
+            dp8,
+            build_train_step(classification_loss_fn(model)),
+            DataLoader(ds, 16, sharding=dp8.batch_sharding()),
+            eval_step=classification_eval_step(model),
+            eval_loader=DataLoader(
+                ds, 16, shuffle=False, sharding=dp8.batch_sharding()
+            ),
+            config=TrainerConfig(
+                epochs=1, log_every=1, metrics_path=jsonl,
+                tensorboard_dir=tb_dir, handle_preemption=False,
+            ),
+        )
+        trainer.fit()
+        files = glob.glob(tb_dir + "/events.out.tfevents.*")
+        assert files, "no event file written"
+        tags = {}
+        for ev in EventFileLoader(files[0]).Load():
+            for v in ev.summary.value:
+                tags.setdefault(v.tag, []).append((ev.step, _scalar_of(v)))
+        assert "train/loss" in tags and "eval/accuracy" in tags, tags.keys()
+        assert len(tags["train/loss"]) == 2  # 2 logged steps
+        # the tee kept the JSONL stream intact too
+        from pytorch_distributed_tpu.train.metrics import read_metrics
+
+        assert any(r["split"] == "train" for r in read_metrics(jsonl))
+
+    def test_summary_writer_torch_shape(self, tmp_path):
+        import glob
+
+        pytest.importorskip("tensorboard")
+        from tensorboard.backend.event_processing.event_file_loader import (
+            EventFileLoader,
+        )
+
+        from pytorch_distributed_tpu.utils.tensorboard import SummaryWriter
+
+        w = SummaryWriter(str(tmp_path))
+        w.add_scalar("lr", 0.1, global_step=3)
+        w.add_scalars("ab", {"a": 1.0, "b": 2.0}, global_step=4)
+        w.close()
+        files = glob.glob(str(tmp_path) + "/events.out.tfevents.*")
+        assert files
+        got = {}
+        for ev in EventFileLoader(files[0]).Load():
+            for v in ev.summary.value:
+                got[v.tag] = (ev.step, round(_scalar_of(v), 4))
+        assert got["lr"] == (3, 0.1)
+        assert got["ab/a"] == (4, 1.0) and got["ab/b"] == (4, 2.0)
 
 
 class TestCheckpointRetention:
