@@ -487,6 +487,159 @@ int hr_allreduce(void* h, void* data, uint64_t count, int32_t dtype,
   return 0;
 }
 
+// Block-quantized f32 allreduce (EQuARX-style): each rank publishes its
+// chunk as int8 with one f32 scale per `block` elements (~4x fewer shm
+// bytes), segment owners dequantize+accumulate in f32, requantize the
+// reduced segment, and EVERY rank — owner included — takes the
+// dequantized requantized value, so results are bit-identical across
+// ranks (the DDP lockstep invariant). SUM and AVG only.
+//
+// Slot layout per chunk of n elems: [int8 q[n]][f32 scales[ceil(n/block)]].
+namespace {
+
+constexpr size_t kQBlock = 256;  // elements per quantization scale
+
+size_t q_chunk_elems(size_t slot_bytes) {
+  // n (padded to 4) + 4*ceil(n/kQBlock) <= slot_bytes, conservatively
+  size_t n = slot_bytes * kQBlock / (kQBlock + 4);
+  return n > 8 ? n - 8 : n;
+}
+
+// f32 scales live right after the int8 payload, 4-byte aligned (the
+// payload length is arbitrary on tail chunks)
+float* q_scales(uint8_t* slot_base, size_t n) {
+  return (float*)(slot_base + ((n + 3) & ~size_t(3)));
+}
+
+void quantize_block(const float* x, size_t n, int8_t* q, float* scale) {
+  float amax = 0.f;
+  bool bad = false;  // NaN/inf: NaN escapes max-comparisons entirely
+  for (size_t i = 0; i < n; ++i) {
+    const float a = x[i] < 0 ? -x[i] : x[i];
+    if (!(a <= 3.4e38f)) bad = true;  // false for NaN and +inf
+    amax = a > amax ? a : amax;
+  }
+  if (bad) {
+    // propagate non-finiteness loudly: the whole block dequantizes to
+    // NaN instead of casting NaN to int8 (UB) or silently zeroing
+    *scale = __builtin_nanf("");
+    memset(q, 1, n);
+    return;
+  }
+  const float s = amax / 127.0f;
+  *scale = s;
+  if (s == 0.f) {
+    memset(q, 0, n);
+    return;
+  }
+  const float inv = 1.0f / s;
+  for (size_t i = 0; i < n; ++i) {
+    float v = x[i] * inv;
+    v = v < -127.f ? -127.f : (v > 127.f ? 127.f : v);
+    q[i] = (int8_t)(v < 0 ? v - 0.5f : v + 0.5f);  // round half away
+  }
+}
+
+void quantize(const float* x, size_t n, int8_t* q, float* scales) {
+  for (size_t off = 0; off < n; off += kQBlock) {
+    const size_t b = n - off < kQBlock ? n - off : kQBlock;
+    quantize_block(x + off, b, q + off, scales + off / kQBlock);
+  }
+}
+
+// acc[i] += q[i] * scale(block of i)
+void dequant_add(float* acc, const int8_t* q, const float* scales, size_t n) {
+  for (size_t off = 0; off < n; off += kQBlock) {
+    const size_t b = n - off < kQBlock ? n - off : kQBlock;
+    const float s = scales[off / kQBlock];
+    for (size_t i = 0; i < b; ++i) acc[off + i] += float(q[off + i]) * s;
+  }
+}
+
+void dequant_copy(float* dst, const int8_t* q, const float* scales,
+                  size_t n) {
+  for (size_t off = 0; off < n; off += kQBlock) {
+    const size_t b = n - off < kQBlock ? n - off : kQBlock;
+    const float s = scales[off / kQBlock];
+    for (size_t i = 0; i < b; ++i) dst[off + i] = float(q[off + i]) * s;
+  }
+}
+
+}  // namespace
+
+extern "C" int hr_allreduce_q8(void* h, float* data, uint64_t count,
+                               int32_t op) {
+  Group* g = (Group*)h;
+  if (op != SUM && op != AVG) return kErrInval;
+  if (g->world == 1) return 0;
+  // chunk cap: the q8 layout fits ~slot_bytes elems, but the reduce
+  // scratch (shared with the half-dtype path) holds slot_bytes/2 floats —
+  // and a segment can span the whole chunk (small tail chunks), so the
+  // chunk must fit the scratch
+  size_t chunk_elems = q_chunk_elems(g->slot_bytes);
+  if (chunk_elems > g->slot_bytes / 2) chunk_elems = g->slot_bytes / 2;
+  if (chunk_elems < kQBlock * size_t(g->world)) return kErrInval;
+  if (!g->red_scratch) g->red_scratch = new float[g->slot_bytes / 2];
+  for (uint64_t off = 0; off < count; off += chunk_elems) {
+    const size_t n =
+        size_t(count - off < chunk_elems ? count - off : chunk_elems);
+    float* base = data + off;
+    // BLOCK-ALIGNED segments: scale blocks then never straddle a segment
+    // boundary, so the in-phase "peers read my original data while I
+    // overwrite my own reduced segment" accesses touch disjoint q/scale
+    // regions. The last rank owns the (possibly unaligned) tail.
+    const size_t seg = (n / size_t(g->world)) & ~(kQBlock - 1);
+    const size_t s0 = size_t(g->rank) * seg;
+    const size_t sn = (g->rank == g->world - 1) ? n - s0 : seg;
+    int8_t* myq = (int8_t*)slot(g, g->rank);
+    float* myscales = q_scales(slot(g, g->rank), n);
+    int rc = barrier_wait(g);
+    if (rc != 0) return rc;
+    // publish — EXCEPT our own segment: no peer ever reads it (peers
+    // read only THEIR segments of our slot), and we reduce our own data
+    // straight from `base`. Both sub-ranges start block-aligned.
+    quantize(base, s0, myq, myscales);
+    if (s0 + sn < n)
+      quantize(base + s0 + sn, n - s0 - sn, myq + s0 + sn,
+               myscales + (s0 + sn) / kQBlock);
+    rc = barrier_wait(g);
+    if (rc != 0) return rc;
+    if (sn) {
+      float* acc = g->red_scratch;
+      // own contribution from the exact f32 base, peers dequantized
+      memcpy(acc, base + s0, sn * sizeof(float));
+      for (int r = 1; r < g->world; ++r) {
+        const int src = (g->rank + r) % g->world;
+        const int8_t* q = (const int8_t*)slot(g, src);
+        const float* sc = q_scales(slot(g, src), n);
+        dequant_add(acc, q + s0, sc + s0 / kQBlock, sn);
+      }
+      if (op == AVG)
+        for (size_t i = 0; i < sn; ++i) acc[i] /= float(g->world);
+      // requantize the reduced segment over our own published segment
+      // (disjoint from everything peers still read this phase), and take
+      // the dequantized value ourselves — every rank must see the SAME
+      // result (DDP lockstep), so the owner cannot keep its exact f32
+      quantize(acc, sn, myq + s0, myscales + s0 / kQBlock);
+      dequant_copy(base + s0, myq + s0, myscales + s0 / kQBlock, sn);
+    }
+    rc = barrier_wait(g);
+    if (rc != 0) return rc;
+    for (int r = 1; r < g->world; ++r) {
+      const int owner = (g->rank + r) % g->world;
+      const size_t o0 = size_t(owner) * seg;
+      const size_t on = (owner == g->world - 1) ? n - o0 : seg;
+      if (!on) continue;
+      const int8_t* q = (const int8_t*)slot(g, owner);
+      const float* sc = q_scales(slot(g, owner), n);
+      dequant_copy(base + o0, q + o0, sc + o0 / kQBlock, on);
+    }
+    rc = barrier_wait(g);
+    if (rc != 0) return rc;
+  }
+  return 0;
+}
+
 // Gather each rank's `count` elements into out[world * count].
 int hr_allgather(void* h, const void* in, void* out, uint64_t count,
                  int32_t dtype) {

@@ -65,13 +65,15 @@ def sync_grads(grads, compress: str | None = None):
     and ``pure_callback`` is documented as freely elidable/duplicable,
     either of which would desync the ring and hang the other ranks.
 
-    ``compress`` ("bf16"/"fp16"): gradient compression for the wire —
-    f32/f64 leaves are cast to the half dtype before the collective and
-    back after, halving (quartering for f64) the shm/network bytes. The
-    ring ships halves natively and still accumulates each element in f32,
-    dividing before the single rounding (native/hostring.cpp), so the
-    only precision loss is the initial per-rank cast — the same contract
-    as NCCL fp16/bf16 gradient allreduce.
+    ``compress`` ("bf16"/"fp16"/"int8"): gradient compression for the
+    wire. Halves: f32/f64 leaves are cast to the half dtype before the
+    collective and back after, halving (quartering for f64) the
+    shm/network bytes; the ring ships halves natively and still
+    accumulates each element in f32, dividing before the single rounding
+    (native/hostring.cpp) — the NCCL fp16/bf16 contract. "int8":
+    EQuARX-style block quantization in the ring itself (~4x fewer bytes,
+    one f32 scale per 256 elements, f32 accumulation); leaves too small
+    to amortize the scales (< 4096 elems) go exact-f32.
     """
     import jax.numpy as jnp
     from jax.experimental import io_callback
@@ -85,11 +87,14 @@ def sync_grads(grads, compress: str | None = None):
     if not leaves:
         return grads
     orig_dtypes = None
-    if compress is not None:
+    quantize = False
+    if compress == "int8":
+        quantize = True  # in-ring block quantization; dtypes unchanged
+    elif compress is not None:
         if compress not in _COMPRESS_DTYPES:
             raise ValueError(
                 f"unknown grad compression {compress!r}; "
-                f"one of {sorted(set(_COMPRESS_DTYPES))}"
+                f"one of {sorted(set(_COMPRESS_DTYPES)) + ['int8']}"
             )
         cdt = jnp.dtype(_COMPRESS_DTYPES[compress])
         orig_dtypes = tuple(l.dtype for l in leaves)
@@ -102,7 +107,14 @@ def sync_grads(grads, compress: str | None = None):
     )
 
     def _allreduce_all(*arrs):
-        return tuple(ring.all_reduce(np.asarray(a), op="avg") for a in arrs)
+        out = []
+        for a in arrs:
+            a = np.asarray(a)
+            if quantize and a.dtype == np.float32 and a.size >= 4096:
+                out.append(ring.all_reduce_q8(a, op="avg"))
+            else:
+                out.append(ring.all_reduce(a, op="avg"))
+        return tuple(out)
 
     synced = io_callback(_allreduce_all, shapes, *leaves, ordered=True)
     if orig_dtypes is not None:

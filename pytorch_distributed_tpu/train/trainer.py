@@ -78,10 +78,10 @@ def build_train_step(
     microbatch splitting — e.g. ``ImageBatchPipeline.device_normalizer()``
     so uint8 batches ship over the host link and normalize on-chip.
 
-    ``grad_compression`` ("bf16"/"fp16") compresses the multi-process
-    gradient sync on the wire (see ``parallel.ddp.sync_grads``); it has no
-    effect in single-controller SPMD mode, where grad reduction is a
-    compiler-inserted collective.
+    ``grad_compression`` ("bf16"/"fp16"/"int8") compresses the
+    multi-process gradient sync on the wire (see
+    ``parallel.ddp.sync_grads``); it has no effect in single-controller
+    SPMD mode, where grad reduction is a compiler-inserted collective.
     """
     scaling = scaler is not None and scaler.enabled
 

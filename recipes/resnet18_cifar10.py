@@ -47,7 +47,7 @@ def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--backend", default=None, help="ici|gloo (default: auto)")
     p.add_argument("--grad-compress", default=None,
-                   choices=("bf16", "fp16"),
+                   choices=("bf16", "fp16", "int8"),
                    help="compress multi-process gradient sync on the wire")
     p.add_argument("--epochs", type=int, default=2)
     p.add_argument("--batch-size", type=int, default=128, help="global batch")

@@ -86,6 +86,29 @@ def raw_worker(rank: int, world: int, name: str, q) -> None:
             )
             want_avg = np.float32((world + 1) / 2).astype(ml_dtypes.bfloat16)
             assert np.all(ba == want_avg), (ba, want_avg)
+            # int8 block-quantized allreduce: bounded error vs the exact
+            # mean, and bit-identical results on every rank (lockstep)
+            rng_q = np.random.default_rng(5)
+            allq = (rng_q.normal(size=(world, 10_000)) * 7).astype(
+                np.float32
+            )
+            got_q = g.all_reduce_q8(allq[rank].copy(), op="avg")
+            exact = allq.mean(axis=0)
+            atol = (world + 1) * np.abs(allq).max() / 127
+            assert np.all(np.abs(got_q - exact) <= atol), (
+                np.abs(got_q - exact).max(), atol
+            )
+            rows = g.all_gather(got_q)
+            assert all(
+                np.array_equal(rows[0], rows[i]) for i in range(world)
+            ), "q8 results diverged across ranks"
+            # non-finite gradients must propagate loudly, not quantize
+            # to garbage or silently zero
+            bad = np.ones(6000, np.float32)
+            if rank == 0:
+                bad[100] = np.inf
+            got_bad = g.all_reduce_q8(bad, op="sum")
+            assert not np.all(np.isfinite(got_bad)), "inf was swallowed"
             # f16 software conversions agree with numpy's, including
             # subnormals and values that round up across an exponent
             probe = np.array(
