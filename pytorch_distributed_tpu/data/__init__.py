@@ -26,6 +26,7 @@ from pytorch_distributed_tpu.data.native_pipeline import (
 from pytorch_distributed_tpu.data.datasets import (
     ArrayDataset,
     ConcatDataset,
+    IterableDataset,
     Subset,
     SyntheticImageDataset,
     SyntheticTextDataset,
@@ -54,6 +55,7 @@ __all__ = [
     "gather_rows",
     "ArrayDataset",
     "ConcatDataset",
+    "IterableDataset",
     "Subset",
     "SyntheticImageDataset",
     "SyntheticTextDataset",

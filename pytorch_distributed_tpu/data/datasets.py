@@ -15,6 +15,22 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 
+class IterableDataset:
+    """torch.utils.data.IterableDataset parity: a STREAMING dataset.
+
+    Subclasses implement ``__iter__`` yielding samples (dicts/tuples/
+    arrays); there is no ``__len__``/``__getitem__``. ``DataLoader``
+    detects the shape and groups the stream into global batches itself —
+    under a multi-process world each rank keeps its strided share of
+    every group, so ranks stay in lockstep by construction. Optional
+    ``set_epoch(epoch)`` on the subclass is forwarded by the loader
+    (e.g. to reshuffle a shard order between epochs).
+    """
+
+    def __iter__(self):
+        raise NotImplementedError
+
+
 class ArrayDataset:
     """Dict-of-arrays dataset; leading dim indexes samples."""
 

@@ -47,7 +47,7 @@ class DataLoader:
         dataset,
         batch_size: int,
         *,
-        shuffle: bool = True,
+        shuffle: Optional[bool] = None,  # default: True (map-style only)
         seed: int = 0,
         drop_last: bool = True,
         sharding=None,
@@ -67,9 +67,44 @@ class DataLoader:
         implicit slice would silently double-shard to 1/world^2 per rank.
         Pass True/False to force."""
         self.dataset = dataset
-        self.sampler = sampler or GlobalBatchSampler(
-            len(dataset), batch_size, shuffle=shuffle, seed=seed, drop_last=drop_last
+        # torch IterableDataset parity: a dataset with __iter__ but no
+        # __getitem__ streams samples; batches are grouped off the stream
+        # and there is no sampler/shuffle (order is the stream's own)
+        self.iterable = (
+            hasattr(dataset, "__iter__") and not hasattr(dataset, "__getitem__")
         )
+        if self.iterable:
+            if sampler is not None:
+                raise ValueError(
+                    "sampler is meaningless for an iterable dataset"
+                )
+            if fetch is not None:
+                raise ValueError(
+                    "fetch (index-based) does not apply to an iterable "
+                    "dataset; use transform"
+                )
+            if shuffle:
+                # torch raises here too: a stream has no index space
+                raise ValueError(
+                    "shuffle is not supported for an iterable dataset — "
+                    "shuffle inside the stream source instead"
+                )
+            if iter(dataset) is dataset:
+                # a generator/one-shot iterator would silently yield a
+                # zero-batch second epoch
+                raise ValueError(
+                    "iterable dataset must be re-iterable (each __iter__ "
+                    "a fresh pass); got a one-shot iterator/generator"
+                )
+            self.sampler = None
+            self.batch_size = int(batch_size)
+            self.drop_last = drop_last
+        else:
+            self.sampler = sampler or GlobalBatchSampler(
+                len(dataset), batch_size,
+                shuffle=True if shuffle is None else shuffle, seed=seed,
+                drop_last=drop_last,
+            )
         if shard is None:
             shard = sampler is None or not hasattr(sampler, "num_replicas")
         self.shard = shard
@@ -80,11 +115,18 @@ class DataLoader:
         self._warned_remainder = False
 
     def set_epoch(self, epoch: int) -> None:
-        self.sampler.set_epoch(epoch)
+        if self.sampler is not None:
+            self.sampler.set_epoch(epoch)
+        if self.iterable and hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)  # e.g. reshuffle a stream source
         if self.fetch is not None and hasattr(self.fetch, "set_epoch"):
             self.fetch.set_epoch(epoch)  # e.g. ImageBatchPipeline aug stream
 
     def __len__(self) -> int:
+        if self.iterable:
+            raise TypeError(
+                "an iterable-dataset loader has no length (torch semantics)"
+            )
         return len(self.sampler)
 
     def _rank_slice(self, indices: np.ndarray) -> np.ndarray:
@@ -139,35 +181,84 @@ class DataLoader:
             )
         return n
 
+    def _place(self, batch):
+        if self.transform is not None:
+            batch = self.transform(batch)
+        if self.sharding is not None:
+            from pytorch_distributed_tpu.parallel.sharding import (
+                place_global_batch,
+            )
+
+            # on a pod the fetched batch is this process's LOCAL block iff
+            # somebody rank-sliced it (this loader or a rank-aware
+            # sampler); otherwise it is the full global batch and must be
+            # deduplicated by the helper
+            batch = place_global_batch(
+                self.sharding,
+                batch,
+                local=self.shard
+                or hasattr(self.sampler, "num_replicas"),
+            )
+        return batch
+
     def _produce(self, out_q: queue.Queue, stop: threading.Event) -> None:
         try:
+            if self.iterable:
+                self._produce_iterable(out_q, stop)
+                return
             for indices in self.sampler:
                 if stop.is_set():
                     return
                 batch = (self.fetch or _default_fetch)(
                     self.dataset, self._rank_slice(indices)
                 )
-                if self.transform is not None:
-                    batch = self.transform(batch)
-                if self.sharding is not None:
-                    from pytorch_distributed_tpu.parallel.sharding import (
-                        place_global_batch,
-                    )
-
-                    # on a pod the fetched batch is this process's LOCAL
-                    # block iff somebody rank-sliced it (this loader or a
-                    # rank-aware sampler); otherwise it is the full global
-                    # batch and must be deduplicated by the helper
-                    batch = place_global_batch(
-                        self.sharding,
-                        batch,
-                        local=self.shard
-                        or hasattr(self.sampler, "num_replicas"),
-                    )
-                out_q.put(batch)
+                out_q.put(self._place(batch))
             out_q.put(_SENTINEL)
         except BaseException as e:  # surface worker errors to the consumer
             out_q.put(e)
+
+    def _produce_iterable(
+        self, out_q: queue.Queue, stop: threading.Event
+    ) -> None:
+        """Group the sample stream into global batches; every rank reads
+        the SAME stream and keeps its ``_rank_slice`` share of each group,
+        so multi-process worlds stay in lockstep by construction (ranks
+        agree on the number of batches because they see the same stream
+        — the same contract a torch IterableDataset user gets from
+        islice-by-rank sharding)."""
+        from pytorch_distributed_tpu.data.datasets import stack_items
+
+        buf = []
+
+        def emit(group):
+            idx = self._rank_slice(np.arange(len(group)))
+            batch = stack_items([group[int(i)] for i in idx])
+            out_q.put(self._place(batch))
+
+        for sample in self.dataset:
+            if stop.is_set():
+                return
+            buf.append(sample)
+            if len(buf) == self.batch_size:
+                emit(buf)
+                buf = []
+        if buf and not self.drop_last:
+            # _rank_slice sheds a non-divisible remainder; a tail smaller
+            # than the whole world can't be sharded at all — drop it (all
+            # ranks see the same stream, so all drop it: lockstep holds)
+            try:
+                idx = self._rank_slice(np.arange(len(buf)))
+            except ValueError:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "dropping %d-sample stream tail: smaller than the "
+                    "rank count", len(buf),
+                )
+            else:
+                batch = stack_items([buf[int(i)] for i in idx])
+                out_q.put(self._place(batch))
+        out_q.put(_SENTINEL)
 
     def __iter__(self) -> Iterator[Any]:
         out_q: queue.Queue = queue.Queue(maxsize=self.prefetch)

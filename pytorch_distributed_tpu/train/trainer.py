@@ -361,9 +361,23 @@ class Trainer:
             self.strategy.state_shardings(self.state),
             tag=tag,
         )
-        steps_per_epoch = max(len(self.train_loader), 1)
         step = int(host_scalar(self.state.step))
         self.host_step = step
+        try:
+            steps_per_epoch = max(len(self.train_loader), 1)
+        except TypeError:
+            # streaming (iterable-dataset) loader: epoch length unknown,
+            # so the epoch/offset position can't be reconstructed — resume
+            # from the restored optimizer step at a fresh stream (the
+            # torch IterableDataset resume story is the same)
+            logger.warning(
+                "resumed a streaming loader at step %d: epoch position "
+                "unknown, restarting the stream from its beginning", step,
+            )
+            self._first_epoch = 0
+            self._resume_skip_batches = 0
+            self._load_best_record()
+            return True
         self._first_epoch = step // steps_per_epoch
         # mid-epoch checkpoint: fast-forward past the batches this epoch
         # already consumed, so no batch trains twice and total step count

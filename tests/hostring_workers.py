@@ -198,6 +198,40 @@ def ddp_train_worker(rank: int, path: str) -> None:
     ptd.destroy_process_group()
 
 
+class _Stream:
+    """Module-level (picklable) sample stream for iterable-loader tests."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def __iter__(self):
+        for i in range(self.n):
+            yield {"x": np.float32(i)}
+
+
+def iterable_loader_worker(rank: int, path: str) -> None:
+    """Streaming loader under the 2-proc hostring world: each rank gets
+    the strided half of every global batch, in lockstep."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import pytorch_distributed_tpu as ptd
+    from pytorch_distributed_tpu.data import DataLoader
+
+    ptd.init_process_group("gloo")
+    world = ptd.get_world_size()
+    dl = DataLoader(_Stream(12), 4, drop_last=False)
+    got = [b["x"].tolist() for b in dl]
+    # global groups [0..3] [4..7] [8..11]; rank r keeps indices r::world
+    want = [
+        [float(g * 4 + i) for i in range(rank, 4, world)] for g in range(3)
+    ]
+    assert got == want, (got, want)
+    with open(os.path.join(path, f"it{rank}.ok"), "w") as f:
+        f.write("ok")
+    ptd.destroy_process_group()
+
+
 def grad_compress_worker(rank: int, path: str) -> None:
     """sync_grads(compress='bf16') ships bf16 and must equal the exact
     reference: bf16(mean_f32(bf16(g_r))) upcast back to f32."""

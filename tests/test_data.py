@@ -262,6 +262,93 @@ class TestDatasetUtilities:
             random_split(ds, [4, 4])
 
 
+class TestIterableDataset:
+    class Stream(object):
+        """Yields n dict samples; counts epochs via set_epoch."""
+
+        def __init__(self, n):
+            self.n = n
+            self.epoch = 0
+
+        def set_epoch(self, epoch):
+            self.epoch = epoch
+
+        def __iter__(self):
+            base = self.epoch * 1000
+            for i in range(self.n):
+                yield {"x": np.float32(base + i), "y": np.int32(i % 3)}
+
+    def test_stream_batches_and_tail(self):
+        from pytorch_distributed_tpu.data import DataLoader
+
+        dl = DataLoader(self.Stream(10), 4, drop_last=False, shard=False)
+        batches = list(dl)
+        assert [len(b["x"]) for b in batches] == [4, 4, 2]
+        assert [float(v) for v in batches[0]["x"]] == [0, 1, 2, 3]
+        dl2 = DataLoader(self.Stream(10), 4, drop_last=True, shard=False)
+        assert [len(b["x"]) for b in list(dl2)] == [4, 4]
+
+    def test_no_len_and_set_epoch_forwarded(self):
+        import pytest
+
+        from pytorch_distributed_tpu.data import DataLoader
+
+        ds = self.Stream(8)
+        dl = DataLoader(ds, 4, shard=False)
+        with pytest.raises(TypeError):
+            len(dl)
+        dl.set_epoch(3)
+        assert ds.epoch == 3
+        batches = list(dl)
+        assert float(batches[0]["x"][0]) == 3000.0  # epoch reshuffle seen
+
+    def test_sampler_and_fetch_rejected(self):
+        import pytest
+
+        from pytorch_distributed_tpu.data import DataLoader, GlobalBatchSampler
+
+        with pytest.raises(ValueError, match="sampler"):
+            DataLoader(
+                self.Stream(8), 4,
+                sampler=GlobalBatchSampler(8, 4),
+            )
+        with pytest.raises(ValueError, match="fetch"):
+            DataLoader(self.Stream(8), 4, fetch=lambda d, i: None)
+
+    def test_shuffle_and_one_shot_iterators_rejected(self):
+        import pytest
+
+        from pytorch_distributed_tpu.data import DataLoader
+
+        with pytest.raises(ValueError, match="shuffle"):
+            DataLoader(self.Stream(8), 4, shuffle=True)
+        gen = ({"x": np.float32(i)} for i in range(8))
+        with pytest.raises(ValueError, match="re-iterable"):
+            DataLoader(gen, 4)
+
+    def test_streamed_batches_place_on_mesh(self, mesh8):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from pytorch_distributed_tpu.data import DataLoader
+
+        sharding = NamedSharding(mesh8, P(("dp", "fsdp", "tp")))
+        dl = DataLoader(self.Stream(16), 8, sharding=sharding)
+        batches = list(dl)
+        assert len(batches) == 2
+        assert batches[0]["x"].sharding.is_equivalent_to(sharding, 1)
+        np.testing.assert_array_equal(
+            np.asarray(batches[0]["x"]), np.arange(8, dtype=np.float32)
+        )
+
+    def test_base_class_is_abstract(self):
+        import pytest
+
+        from pytorch_distributed_tpu.data import IterableDataset
+
+        with pytest.raises(NotImplementedError):
+            iter(IterableDataset()).__next__()
+
+
 class TestWeightedRandomSampler:
     def test_zero_weight_never_drawn_heavy_dominates(self):
         from pytorch_distributed_tpu.data import WeightedRandomSampler

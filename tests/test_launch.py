@@ -50,6 +50,16 @@ def test_ddp_invariant_across_ranks(tmp_path):
 
 
 @pytest.mark.slow
+def test_iterable_loader_lockstep_across_ranks(tmp_path):
+    from pytorch_distributed_tpu.launch import spawn
+
+    spawn(hostring_workers.iterable_loader_worker, args=(str(tmp_path),),
+          nprocs=2, timeout_s=300)
+    for r in range(2):
+        assert (tmp_path / f"it{r}.ok").read_text() == "ok"
+
+
+@pytest.mark.slow
 def test_grad_compression_bf16_across_ranks(tmp_path):
     """bf16-compressed gradient sync: exact single-rounding semantics on
     the wire, f32 results back in the step."""
