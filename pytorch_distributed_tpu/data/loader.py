@@ -89,9 +89,11 @@ class DataLoader:
                     "shuffle is not supported for an iterable dataset — "
                     "shuffle inside the stream source instead"
                 )
-            if iter(dataset) is dataset:
+            if hasattr(dataset, "__next__"):
                 # a generator/one-shot iterator would silently yield a
-                # zero-batch second epoch
+                # zero-batch second epoch. (Checked via __next__ — calling
+                # iter() here could run user __iter__ side effects and
+                # discard the result.)
                 raise ValueError(
                     "iterable dataset must be re-iterable (each __iter__ "
                     "a fresh pass); got a one-shot iterator/generator"
@@ -247,7 +249,7 @@ class DataLoader:
             # than the whole world can't be sharded at all — drop it (all
             # ranks see the same stream, so all drop it: lockstep holds)
             try:
-                idx = self._rank_slice(np.arange(len(buf)))
+                emit(buf)
             except ValueError:
                 import logging
 
@@ -255,9 +257,6 @@ class DataLoader:
                     "dropping %d-sample stream tail: smaller than the "
                     "rank count", len(buf),
                 )
-            else:
-                batch = stack_items([buf[int(i)] for i in idx])
-                out_q.put(self._place(batch))
         out_q.put(_SENTINEL)
 
     def __iter__(self) -> Iterator[Any]:

@@ -217,6 +217,94 @@ def Adafactor(
     )
 
 
+def RMSprop(
+    lr: ScalarOrSchedule = 1e-2,
+    alpha: float = 0.99,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    momentum: float = 0.0,
+    centered: bool = False,
+    no_decay: Optional[Sequence[str]] = None,
+) -> optax.GradientTransformation:
+    """``torch.optim.RMSprop`` semantics (eps added OUTSIDE the sqrt, v
+    initialized to zero, L2 added to the gradient before the moment
+    update), with the same ``no_decay`` masking as the other facades."""
+    chain = []
+    if weight_decay:
+        chain.append(
+            optax.add_decayed_weights(
+                weight_decay, mask=_decay_mask_arg(no_decay)
+            )
+        )
+    chain.append(
+        optax.rmsprop(
+            lr, decay=alpha, eps=eps, momentum=momentum or None,
+            centered=centered, eps_in_sqrt=False, initial_scale=0.0,
+        )
+    )
+    return optax.chain(*chain)
+
+
+def ReduceLROnPlateau(
+    base: optax.GradientTransformation,
+    *,
+    mode: str = "min",
+    factor: float = 0.1,
+    patience: int = 10,
+    threshold: float = 1e-4,
+    cooldown: int = 0,
+    min_scale: float = 0.0,
+    accumulation_size: int = 1,
+) -> optax.GradientTransformation:
+    """``lr_scheduler.ReduceLROnPlateau`` as an optimizer wrapper.
+
+    torch's version watches a metric the user feeds via ``step(metric)``;
+    under jit the equivalent signal is the loss value threaded into the
+    optimizer update — ``build_train_step`` passes it automatically, so
+
+        tx = optim.ReduceLROnPlateau(optim.SGD(0.1), factor=0.5,
+                                     patience=10, accumulation_size=100)
+
+    scales the updates by ``factor`` whenever the (averaged over
+    ``accumulation_size`` steps) train loss stops improving for
+    ``patience`` windows. Driving it from an EVAL metric instead is the
+    one torch behavior with no jit-side analogue; set
+    ``accumulation_size`` to roughly an epoch of steps for the closest
+    equivalent.
+
+    ``mode="max"`` (a metric that should increase) is for custom update
+    loops where YOU pass ``value=``: under ``build_train_step`` the
+    threaded value is always the train loss, which should decrease — use
+    the default ``mode="min"`` there. Because the underlying optax test
+    is min-oriented, max mode uses an ABSOLUTE improvement threshold
+    (torch's ``threshold_mode="abs"``): a relative threshold on a negated
+    metric would invert, treating slightly-worse values as improvements.
+    """
+    if mode not in ("min", "max"):
+        raise ValueError(f"mode must be 'min'/'max', got {mode!r}")
+    inner = optax.contrib.reduce_on_plateau(
+        factor=factor, patience=patience,
+        rtol=threshold if mode == "min" else 0.0,
+        atol=0.0 if mode == "min" else threshold,
+        cooldown=cooldown, min_scale=min_scale,
+        accumulation_size=accumulation_size,
+    )
+    sign = -1.0 if mode == "max" else 1.0
+
+    def update(updates, state, params=None, *, value=None, **extra):
+        if value is None:
+            raise ValueError(
+                "ReduceLROnPlateau needs the metric: pass value=... to "
+                "tx.update, or (under build_train_step) make the loss_fn "
+                "report a 'loss' metric — it is threaded automatically"
+            )
+        return inner.update(updates, state, params, value=sign * value,
+                            **extra)
+
+    plateau = optax.GradientTransformationExtraArgs(inner.init, update)
+    return optax.chain(optax.with_extra_args_support(base), plateau)
+
+
 # -- lr "schedulers": schedules you pass AS the lr -------------------------
 
 

@@ -54,8 +54,21 @@ class TrainState:
             tx=tx,
         )
 
-    def apply_gradients(self, grads, **updates) -> "TrainState":
-        updates_tx, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+    def apply_gradients(self, grads, *, loss_value=None, **updates) -> "TrainState":
+        if isinstance(self.tx, optax.GradientTransformationExtraArgs):
+            # metric-driven transforms (optim.ReduceLROnPlateau) read the
+            # loss through optax's extra-args channel; ExtraArgs
+            # transforms ignore kwargs they don't use, so this is safe
+            # for every wrapped optimizer. Passed even when None so a
+            # metric-requiring transform can raise a CLEAR error instead
+            # of a missing-kwarg TypeError mid-trace.
+            updates_tx, new_opt_state = self.tx.update(
+                grads, self.opt_state, self.params, value=loss_value
+            )
+        else:
+            updates_tx, new_opt_state = self.tx.update(
+                grads, self.opt_state, self.params
+            )
         new_params = optax.apply_updates(self.params, updates_tx)
         return dataclasses.replace(
             self,

@@ -154,12 +154,17 @@ def build_train_step(
         if ddp.is_multiprocess():
             grads = ddp.sync_grads(grads, compress=grad_compression)
 
+        # metric-driven optimizers (optim.ReduceLROnPlateau) read the loss
+        # through the extra-args channel; None when the loss_fn reports no
+        # "loss" metric
+        loss_value = metrics.get("loss")
         if scaling:
             new_scaler_state, grads_ok = scaler.functional_update(
                 grads, state.scaler_state
             )
             candidate = state.apply_gradients(
-                grads, batch_stats=new_stats, scaler_state=new_scaler_state
+                grads, batch_stats=new_stats, scaler_state=new_scaler_state,
+                loss_value=loss_value,
             )
             skipped = state.replace(
                 scaler_state=new_scaler_state, step=state.step + 1
@@ -170,7 +175,9 @@ def build_train_step(
             metrics["loss_scale"] = new_scaler_state.scale
             metrics["grads_finite"] = grads_ok.astype(jnp.float32)
         else:
-            new_state = state.apply_gradients(grads, batch_stats=new_stats)
+            new_state = state.apply_gradients(
+                grads, batch_stats=new_stats, loss_value=loss_value
+            )
 
         return new_state, metrics
 

@@ -202,6 +202,119 @@ def test_optim_facade_matches_torch_sgd():
     )
 
 
+def test_optim_rmsprop_matches_torch():
+    """RMSprop (centered + momentum + weight_decay): trajectories match
+    torch — incl. torch's eps-outside-sqrt and zero-initialized v."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import torch
+
+    from pytorch_distributed_tpu import optim as po
+
+    w0 = np.random.default_rng(3).normal(size=(7,)).astype(np.float32)
+    grads = [
+        np.random.default_rng(i + 10).normal(size=(7,)).astype(np.float32)
+        for i in range(8)
+    ]
+    tw = torch.nn.Parameter(torch.tensor(w0.copy()))
+    opt = torch.optim.RMSprop(
+        [tw], lr=0.05, alpha=0.95, eps=1e-7, weight_decay=0.02,
+        momentum=0.8, centered=True,
+    )
+    for g in grads:
+        opt.zero_grad()
+        tw.grad = torch.tensor(g.copy())
+        opt.step()
+
+    tx = po.RMSprop(
+        lr=0.05, alpha=0.95, eps=1e-7, weight_decay=0.02, momentum=0.8,
+        centered=True,
+    )
+    params = {"w": jnp.asarray(w0)}
+    state = tx.init(params)
+    for g in grads:
+        updates, state = tx.update({"w": jnp.asarray(g)}, state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+    np.testing.assert_allclose(
+        np.asarray(params["w"]), tw.detach().numpy(), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_optim_reduce_lr_on_plateau():
+    """Stalled loss scales updates by factor after patience; an improving
+    metric (mode='max') does not."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_tpu import optim as po
+
+    tx = po.ReduceLROnPlateau(
+        po.SGD(lr=0.1), factor=0.5, patience=2, accumulation_size=1
+    )
+    params = {"w": jnp.ones(3)}
+    state = tx.init(params)
+    mags = []
+    for _ in range(8):
+        updates, state = tx.update(
+            {"w": jnp.ones(3)}, state, params, value=jnp.float32(1.0)
+        )
+        mags.append(abs(float(updates["w"][0])))
+    np.testing.assert_allclose(mags[0], 0.1, rtol=1e-5)
+    assert mags[-1] < 0.02, mags  # halved >= 3 times
+
+    txm = po.ReduceLROnPlateau(
+        po.SGD(lr=0.1), mode="max", factor=0.5, patience=2,
+        accumulation_size=1,
+    )
+    state = txm.init(params)
+    for i in range(8):  # steadily improving accuracy: never reduce
+        updates, state = txm.update(
+            {"w": jnp.ones(3)}, state, params, value=jnp.float32(i)
+        )
+    np.testing.assert_allclose(abs(float(updates["w"][0])), 0.1, rtol=1e-5)
+    # a PLATEAUED max-metric must reduce (the abs-threshold max mode —
+    # a negated rel threshold would misread near-constant as improving)
+    state = txm.init(params)
+    for _ in range(8):
+        updates, state = txm.update(
+            {"w": jnp.ones(3)}, state, params, value=jnp.float32(0.9)
+        )
+    assert abs(float(updates["w"][0])) < 0.05
+
+    with np.testing.assert_raises(Exception):
+        po.ReduceLROnPlateau(po.SGD(lr=0.1), mode="sideways")
+    with np.testing.assert_raises_regex(ValueError, "loss"):
+        tx.update({"w": jnp.ones(3)}, tx.init(params), params)
+
+
+def test_plateau_loss_threads_through_train_step():
+    """build_train_step feeds the loss into metric-driven optimizers: a
+    constant-loss objective shrinks update magnitudes mid-training."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_tpu import optim as po
+    from pytorch_distributed_tpu.train import TrainState
+
+    tx = po.ReduceLROnPlateau(
+        po.SGD(lr=0.1), factor=0.5, patience=1, accumulation_size=1
+    )
+    state = TrainState.create(
+        apply_fn=None, params={"w": jnp.ones(3)}, tx=tx
+    )
+    deltas = []
+    for _ in range(8):
+        prev = np.asarray(state.params["w"]).copy()
+        state = state.apply_gradients(
+            {"w": jnp.ones(3)}, loss_value=jnp.float32(2.5)
+        )
+        deltas.append(abs(float(np.asarray(state.params["w"])[0] - prev[0])))
+    np.testing.assert_allclose(deltas[0], 0.1, rtol=1e-5)
+    assert deltas[-1] < 0.05, deltas
+
+
 def test_optim_schedules_shapes():
     from pytorch_distributed_tpu import optim as po
 
