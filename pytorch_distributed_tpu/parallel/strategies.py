@@ -142,9 +142,13 @@ class Strategy:
         )
         aux = jax.tree_util.tree_map(lambda _: repl, state.batch_stats)
         scaler = jax.tree_util.tree_map(lambda _: repl, state.scaler_state)
+        # EMA shadow params: identical tree and rules — reuse the params
+        # shardings so "the shadow shards exactly like params" holds by
+        # construction (FSDP memory would double otherwise)
+        ema = params if state.ema_params is not None else None
         return state.replace(
             step=repl, params=params, opt_state=opt,
-            batch_stats=aux, scaler_state=scaler,
+            batch_stats=aux, scaler_state=scaler, ema_params=ema,
         )
 
     def batch_sharding(self) -> NamedSharding:

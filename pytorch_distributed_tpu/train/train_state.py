@@ -21,7 +21,10 @@ import optax
 
 @partial(
     jax.tree_util.register_dataclass,
-    data_fields=["step", "params", "opt_state", "batch_stats", "scaler_state"],
+    data_fields=[
+        "step", "params", "opt_state", "batch_stats", "scaler_state",
+        "ema_params",
+    ],
     meta_fields=["apply_fn", "tx"],
 )
 @dataclasses.dataclass
@@ -33,6 +36,7 @@ class TrainState:
     scaler_state: Any  # None unless fp16 dynamic scaling
     apply_fn: Callable = dataclasses.field(compare=False)
     tx: optax.GradientTransformation = dataclasses.field(compare=False)
+    ema_params: Any = None  # shadow params (build_train_step(ema_decay=))
 
     @classmethod
     def create(
@@ -43,7 +47,14 @@ class TrainState:
         tx: optax.GradientTransformation,
         batch_stats: Any = None,
         scaler_state: Any = None,
+        ema: bool = False,
     ) -> "TrainState":
+        """``ema=True`` seeds shadow params (a copy of ``params``) for the
+        timm/torchvision ModelEMA idiom — pair with
+        ``build_train_step(ema_decay=...)`` and, for evaluation,
+        ``TrainerConfig(eval_with_ema=True)``. The shadow tree shards
+        exactly like params under every strategy and rides checkpoints
+        automatically (it is part of this pytree)."""
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -52,6 +63,16 @@ class TrainState:
             scaler_state=scaler_state,
             apply_fn=apply_fn,
             tx=tx,
+            # a REAL copy (aliasing the param buffers would double-donate
+            # them when the jitted step donates the state), held in f32:
+            # with half-precision params and a typical decay of ~0.999 the
+            # (1-d)*p increment is below the half ulp and a half shadow
+            # would never move (timm keeps its EMA in fp32 for the same
+            # reason)
+            ema_params=jax.tree_util.tree_map(
+                lambda x: jnp.array(x, dtype=jnp.float32, copy=True), params
+            )
+            if ema else None,
         )
 
     def apply_gradients(self, grads, *, loss_value=None, **updates) -> "TrainState":

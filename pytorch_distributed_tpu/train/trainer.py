@@ -66,6 +66,7 @@ def build_train_step(
     scaler: Optional[GradScaler] = None,
     batch_transform: Optional[Callable[[Any], Any]] = None,
     grad_compression: Optional[str] = None,
+    ema_decay: Optional[float] = None,
 ) -> Callable[[TrainState, Any], Tuple[TrainState, Dict[str, jax.Array]]]:
     """Build ``step(state, batch) -> (state, metrics)`` for jit/Strategy.compile.
 
@@ -82,6 +83,11 @@ def build_train_step(
     multi-process gradient sync on the wire (see
     ``parallel.ddp.sync_grads``); it has no effect in single-controller
     SPMD mode, where grad reduction is a compiler-inserted collective.
+
+    ``ema_decay`` maintains shadow parameters (the ModelEMA idiom:
+    ``ema = d*ema + (1-d)*params`` after every optimizer update) — create
+    the state with ``TrainState.create(..., ema=True)``; evaluate the
+    shadow via ``TrainerConfig(eval_with_ema=True)``.
     """
     scaling = scaler is not None and scaler.enabled
 
@@ -179,8 +185,27 @@ def build_train_step(
                 grads, batch_stats=new_stats, loss_value=loss_value
             )
 
+        if ema_decay is not None:
+            if state.ema_params is None:
+                raise ValueError(
+                    "ema_decay set but the state has no shadow params — "
+                    "create it with TrainState.create(..., ema=True)"
+                )
+            d = ema_decay
+            new_state = new_state.replace(
+                ema_params=jax.tree_util.tree_map(
+                    # accumulate in the shadow's dtype (f32): see
+                    # TrainState.create's half-ulp note
+                    lambda e, p: d * e + (1.0 - d) * p.astype(e.dtype),
+                    new_state.ema_params, new_state.params,
+                )
+            )
+
         return new_state, metrics
 
+    # introspection for Trainer guards: distinguishes "built by this
+    # factory without EMA" (attr None) from a user's custom step (absent)
+    step._ptd_ema_decay = ema_decay
     return step
 
 
@@ -191,6 +216,7 @@ class TrainerConfig:
     ckpt_dir: Optional[str] = None
     ckpt_every_steps: Optional[int] = None  # None -> end of epoch only
     eval_every_epochs: int = 1
+    eval_with_ema: bool = False  # evaluate shadow (EMA) params instead
     samples_axis: str = "image"  # batch leaf whose dim0 counts samples
     async_checkpoint: bool = False  # overlap ckpt IO with training
     metrics_path: Optional[str] = None  # JSONL scalar log (rank 0)
@@ -229,6 +255,15 @@ class Trainer:
     ):
         self.config = config or TrainerConfig()
         self.strategy = strategy
+        if (
+            self.config.eval_with_ema
+            and getattr(train_step, "_ptd_ema_decay", "custom") is None
+        ):  # ema=True state + a builder step that never updates the
+            # shadow would silently evaluate frozen init weights
+            raise ValueError(
+                "eval_with_ema=True but the train step was built without "
+                "ema_decay — pass build_train_step(..., ema_decay=...)"
+            )
         self.state = strategy.place(state)
         self.train_step = strategy.compile(train_step, self.state)
         self.eval_step = (
@@ -362,12 +397,36 @@ class Trainer:
         if resolved is None:
             return False
         tag = resolved
-        self.state = restore_checkpoint(
-            self.config.ckpt_dir,
-            self.state,
-            self.strategy.state_shardings(self.state),
-            tag=tag,
-        )
+        try:
+            self.state = restore_checkpoint(
+                self.config.ckpt_dir,
+                self.state,
+                self.strategy.state_shardings(self.state),
+                tag=tag,
+            )
+        except Exception as e:
+            if self.state.ema_params is None or "ema_params" not in str(e):
+                raise
+            # checkpoint predates EMA: restore everything else, then seed
+            # the shadow from the RESTORED params (seeding from the fresh
+            # init template would track from random weights)
+            template = self.state.replace(ema_params=None)
+            restored = restore_checkpoint(
+                self.config.ckpt_dir,
+                template,
+                self.strategy.state_shardings(template),
+                tag=tag,
+            )
+            logger.warning(
+                "checkpoint has no ema_params (pre-EMA run) — reseeding "
+                "the shadow from the restored params"
+            )
+            self.state = restored.replace(
+                ema_params=jax.tree_util.tree_map(
+                    lambda x: jnp.array(x, dtype=jnp.float32, copy=True),
+                    restored.params,
+                )
+            )
         step = int(host_scalar(self.state.step))
         self.host_step = step
         try:
@@ -559,8 +618,17 @@ class Trainer:
     def evaluate(self, epoch: int) -> Dict[str, float]:
         sums: Dict[str, float] = {}
         count = 0
+        eval_state = self.state
+        if self.config.eval_with_ema:
+            if self.state.ema_params is None:
+                raise ValueError(
+                    "eval_with_ema needs shadow params: create the state "
+                    "with TrainState.create(..., ema=True) and train with "
+                    "build_train_step(ema_decay=...)"
+                )
+            eval_state = self.state.replace(params=self.state.ema_params)
         for batch in self.eval_loader:
-            metrics = self.eval_step(self.state, batch)
+            metrics = self.eval_step(eval_state, batch)
             if self._watchdog is not None:
                 self._watchdog.tick()  # eval progress is progress
             n = self._batch_samples(batch)

@@ -103,7 +103,7 @@ def tiny_resnet():
     )
 
 
-def tiny_image_state(model, seed=0):
+def tiny_image_state(model, seed=0, ema=False):
     v = model.init(
         jax.random.key(seed), jnp.zeros((1, 16, 16, 3)), train=False
     )
@@ -112,6 +112,7 @@ def tiny_image_state(model, seed=0):
         params=v["params"],
         tx=optax.sgd(0.1, momentum=0.9),
         batch_stats=v["batch_stats"],
+        ema=ema,
     )
 
 
@@ -423,6 +424,138 @@ class TestCheckpoint:
         assert int(t2.state.step) == 4
         out = t2.fit()  # resumed at epoch 2 == done; no extra steps
         assert int(out.step) == 4
+
+
+class TestModelEMA:
+    def _fit(self, dp8, decay, tmp_path=None, **cfg_kw):
+        model = tiny_resnet()
+        state = tiny_image_state(model, ema=True)
+        ds = SyntheticImageDataset(n=32, image_shape=(16, 16, 3), seed=0)
+        trainer = Trainer(
+            state,
+            dp8,
+            build_train_step(
+                classification_loss_fn(model), ema_decay=decay
+            ),
+            DataLoader(ds, 16, sharding=dp8.batch_sharding()),
+            eval_step=classification_eval_step(model),
+            eval_loader=DataLoader(
+                ds, 16, shuffle=False, sharding=dp8.batch_sharding()
+            ),
+            config=TrainerConfig(
+                epochs=1, log_every=0, handle_preemption=False, **cfg_kw
+            ),
+        )
+        trainer.fit()
+        return trainer
+
+    def test_ema_edge_decays(self, dp8):
+        import jax
+
+        # decay=0: shadow tracks params exactly
+        tr = self._fit(dp8, 0.0)
+        for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(tr.state.ema_params),
+            jax.tree_util.tree_leaves_with_path(tr.state.params),
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=str(pa)
+            )
+        # decay=1: shadow frozen at init while params moved
+        tr = self._fit(dp8, 1.0)
+        init = tiny_image_state(tiny_resnet(), ema=True)
+        leaf = jax.tree_util.tree_leaves(tr.state.ema_params)[0]
+        leaf0 = jax.tree_util.tree_leaves(init.ema_params)[0]
+        np.testing.assert_array_equal(np.asarray(leaf), np.asarray(leaf0))
+        p = jax.tree_util.tree_leaves(tr.state.params)[0]
+        assert not np.array_equal(np.asarray(p), np.asarray(leaf0))
+
+    def test_eval_with_ema_and_guards(self, dp8):
+        tr = self._fit(dp8, 0.9, eval_with_ema=True)
+        assert tr.last_eval_metrics  # evaluated the shadow without error
+        # missing shadow params fail loudly at both entry points
+        model = tiny_resnet()
+        state = tiny_image_state(model)  # no ema
+        with pytest.raises(ValueError, match="ema"):
+            step = jax.jit(
+                build_train_step(
+                    classification_loss_fn(model), ema_decay=0.9
+                )
+            )
+            ds = SyntheticImageDataset(n=16, image_shape=(16, 16, 3))
+            batch = next(iter(DataLoader(ds, 16)))
+            step(state, batch)
+
+    def test_eval_with_ema_requires_ema_step(self, dp8):
+        """A builder step without ema_decay + eval_with_ema would silently
+        evaluate the frozen init shadow — rejected at construction."""
+        model = tiny_resnet()
+        with pytest.raises(ValueError, match="ema_decay"):
+            Trainer(
+                tiny_image_state(model, ema=True),
+                dp8,
+                build_train_step(classification_loss_fn(model)),
+                DataLoader(
+                    SyntheticImageDataset(n=16, image_shape=(16, 16, 3)),
+                    16, sharding=dp8.batch_sharding(),
+                ),
+                config=TrainerConfig(eval_with_ema=True),
+            )
+
+    def test_pre_ema_checkpoint_reseeds_shadow(self, dp8, tmp_path):
+        """Restoring a checkpoint written WITHOUT ema into an EMA-enabled
+        trainer reseeds the shadow from the restored params."""
+        model = tiny_resnet()
+        ds = SyntheticImageDataset(n=32, image_shape=(16, 16, 3), seed=0)
+        pre = Trainer(
+            tiny_image_state(model),
+            dp8,
+            build_train_step(classification_loss_fn(model)),
+            DataLoader(ds, 16, sharding=dp8.batch_sharding()),
+            config=TrainerConfig(
+                epochs=1, log_every=0, ckpt_dir=str(tmp_path),
+                handle_preemption=False,
+            ),
+        )
+        pre.fit()
+        post = Trainer(
+            tiny_image_state(model, ema=True),
+            dp8,
+            build_train_step(
+                classification_loss_fn(model), ema_decay=0.9
+            ),
+            DataLoader(ds, 16, sharding=dp8.batch_sharding()),
+            config=TrainerConfig(
+                epochs=2, log_every=0, ckpt_dir=str(tmp_path),
+                handle_preemption=False,
+            ),
+        )
+        assert post.restore_checkpoint()
+        for (path, e), (_, p) in zip(
+            jax.tree_util.tree_leaves_with_path(post.state.ema_params),
+            jax.tree_util.tree_leaves_with_path(post.state.params),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(e), np.asarray(p, dtype=np.float32),
+                rtol=1e-6, err_msg=str(path),
+            )
+
+    def test_ema_shards_like_params_under_fsdp(self):
+        from pytorch_distributed_tpu.parallel import FSDP
+        from pytorch_distributed_tpu.runtime.mesh import MeshSpec, make_mesh
+
+        mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+        model = tiny_resnet()
+        state = tiny_image_state(model, ema=True)
+        strategy = FSDP(mesh)
+        placed = strategy.place(state)
+        import jax
+
+        for (path, p), (_, e) in zip(
+            jax.tree_util.tree_leaves_with_path(placed.params),
+            jax.tree_util.tree_leaves_with_path(placed.ema_params),
+        ):
+            assert p.sharding == e.sharding, (path, p.sharding, e.sharding)
 
 
 def _scalar_of(v):
