@@ -17,6 +17,7 @@ Example, reference-texture:
 
 from __future__ import annotations
 
+import math
 from typing import Optional, Sequence, Union
 
 import optax
@@ -332,6 +333,33 @@ def CosineAnnealingLR(
     )
 
 
+def CosineAnnealingWarmRestarts(
+    lr: float, T_0: int, T_mult: int = 1, eta_min: float = 0.0
+) -> optax.Schedule:
+    """torch's SGDR schedule: cosine anneal over ``T_0`` steps, then
+    restart at full lr with the period scaled by ``T_mult`` each cycle."""
+    if T_0 < 1 or T_mult < 1:
+        raise ValueError(f"T_0 and T_mult must be >= 1, got {T_0}, {T_mult}")
+    import jax.numpy as _jnp
+
+    def schedule(count):
+        count = _jnp.asarray(count, _jnp.float32)
+        if T_mult == 1:
+            t_cur = _jnp.mod(count, T_0)
+            t_i = float(T_0)
+        else:
+            # cycle index n satisfies count >= T_0*(T_mult^n - 1)/(T_mult-1)
+            q = count * (T_mult - 1) / T_0 + 1.0
+            n = _jnp.floor(_jnp.log(q) / math.log(T_mult))
+            start = T_0 * (T_mult ** n - 1.0) / (T_mult - 1.0)
+            t_cur = count - start
+            t_i = T_0 * T_mult ** n
+        cos = 0.5 * (1.0 + _jnp.cos(math.pi * t_cur / t_i))
+        return eta_min + (lr - eta_min) * cos
+
+    return schedule
+
+
 def WarmupCosine(
     lr: float,
     warmup_steps: int,
@@ -410,3 +438,11 @@ def clip_grad_norm(
 ) -> optax.GradientTransformation:
     """``torch.nn.utils.clip_grad_norm_`` as a transformation prefix."""
     return optax.chain(optax.clip_by_global_norm(max_norm), tx)
+
+
+def clip_grad_value(
+    tx: optax.GradientTransformation, clip_value: float
+) -> optax.GradientTransformation:
+    """``torch.nn.utils.clip_grad_value_``: elementwise clamp to
+    ``[-clip_value, clip_value]`` before the optimizer."""
+    return optax.chain(optax.clip(clip_value), tx)

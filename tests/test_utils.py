@@ -315,6 +315,51 @@ def test_plateau_loss_threads_through_train_step():
     assert deltas[-1] < 0.05, deltas
 
 
+def test_optim_warm_restarts_matches_torch():
+    """SGDR (T_mult 1 and 2) pinned against torch's scheduler."""
+    import numpy as np
+    import torch
+
+    from pytorch_distributed_tpu import optim as po
+
+    for t_mult in (1, 2):
+        p = torch.nn.Parameter(torch.zeros(1))
+        opt = torch.optim.SGD([p], lr=0.3)
+        sch = torch.optim.lr_scheduler.CosineAnnealingWarmRestarts(
+            opt, T_0=4, T_mult=t_mult, eta_min=0.01
+        )
+        torch_lrs = []
+        for _ in range(20):
+            torch_lrs.append(opt.param_groups[0]["lr"])
+            opt.step()
+            sch.step()
+        ours = po.CosineAnnealingWarmRestarts(
+            0.3, T_0=4, T_mult=t_mult, eta_min=0.01
+        )
+        our_lrs = [float(ours(i)) for i in range(20)]
+        np.testing.assert_allclose(
+            our_lrs, torch_lrs, rtol=1e-5, atol=1e-7,
+            err_msg=f"T_mult={t_mult}",
+        )
+
+
+def test_optim_clip_grad_value():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_tpu import optim as po
+
+    tx = po.clip_grad_value(po.SGD(lr=1.0), 0.5)
+    params = {"w": jnp.zeros(3)}
+    state = tx.init(params)
+    updates, _ = tx.update(
+        {"w": jnp.asarray([2.0, -3.0, 0.1])}, state, params
+    )
+    np.testing.assert_allclose(
+        np.asarray(updates["w"]), [-0.5, 0.5, -0.1], rtol=1e-6
+    )
+
+
 def test_optim_schedules_shapes():
     from pytorch_distributed_tpu import optim as po
 
