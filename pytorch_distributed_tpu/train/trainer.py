@@ -89,6 +89,10 @@ def build_train_step(
     the state with ``TrainState.create(..., ema=True)``; evaluate the
     shadow via ``TrainerConfig(eval_with_ema=True)``.
     """
+    if ema_decay is not None and not 0.0 <= ema_decay < 1.0:
+        # d=1 freezes the shadow at init (eval_with_ema then silently
+        # scores random weights); d>1 diverges
+        raise ValueError(f"ema_decay must be in [0, 1), got {ema_decay}")
     scaling = scaler is not None and scaler.enabled
 
     def grad_fn(params, batch_stats, mb, rng, scaler_state):

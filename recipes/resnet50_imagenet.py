@@ -64,6 +64,8 @@ class Config(RecipeConfig):
     stem: str = "imagenet"  # doc: stem variant: imagenet | s2d (MXU-friendly)
     log_mfu: bool = False  # doc: append achieved TFLOP/s + MFU to step logs
     device_normalize: bool = False  # doc: ship uint8 batches, normalize on-chip (real-data path)
+    ema_decay: float = 0.0  # doc: ModelEMA decay (0 disables); evals use the shadow
+    tensorboard_dir: str = ""  # doc: TensorBoard event-file dir (rank 0)
 
 
 def _flip_transform(seed: int):
@@ -155,6 +157,7 @@ def main(argv=None):
         params=variables["params"],
         tx=tx,
         batch_stats=variables["batch_stats"],
+        ema=cfg.ema_decay > 0,
     )
 
     strategy = DataParallel()
@@ -193,6 +196,7 @@ def main(argv=None):
                 label_smoothing=cfg.label_smoothing,
             ),
             batch_transform=normalizer,
+            ema_decay=cfg.ema_decay if cfg.ema_decay > 0 else None,
         ),
         train_loader,
         eval_step=classification_eval_step(model, batch_transform=normalizer),
@@ -207,6 +211,8 @@ def main(argv=None):
             best_mode=cfg.best_mode,
             async_checkpoint=cfg.async_checkpoint,
             metrics_path=cfg.metrics_path,
+            tensorboard_dir=cfg.tensorboard_dir or None,
+            eval_with_ema=cfg.ema_decay > 0,
             log_mfu=cfg.log_mfu,
         ),
     )
