@@ -55,6 +55,7 @@ from pytorch_distributed_tpu.runtime.distributed import (
     scatter_object_list,
     barrier,
     monitored_barrier,
+    new_group,
     gather,
     scatter,
     permute,
@@ -104,6 +105,7 @@ __all__ = [
     "scatter_object_list",
     "barrier",
     "monitored_barrier",
+    "new_group",
     "gather",
     "scatter",
     "permute",

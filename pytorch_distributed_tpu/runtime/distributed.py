@@ -61,6 +61,7 @@ class ProcessGroup:
     mesh: Mesh
     backend: str
     ring: Optional[object] = None  # HostRingGroup in multi-process mode
+    ring_name: Optional[str] = None  # the ring's shm name (subgroup prefix)
 
     @property
     def size(self) -> int:
@@ -143,16 +144,18 @@ def init_process_group(
         # are group-wide), so the counter stays in step across processes.
         global _INIT_GENERATION
         _INIT_GENERATION += 1
+        ring_name = f"{group_name}_g{_INIT_GENERATION}"
         ring = HostRingGroup(
-            f"{group_name}_g{_INIT_GENERATION}", rank, world_size,
-            timeout_s=timeout_s,
+            ring_name, rank, world_size, timeout_s=timeout_s,
         )
         # Each rank still gets a local 1-device mesh so jit/sharding code
         # paths work unchanged within the rank.
         mesh = _mesh.make_mesh(
             _mesh.MeshSpec(dp=1), devices=jax.devices("cpu")[:1]
         )
-        _GROUP = ProcessGroup(mesh=mesh, backend="hostring", ring=ring)
+        _GROUP = ProcessGroup(
+            mesh=mesh, backend="hostring", ring=ring, ring_name=ring_name
+        )
         return _GROUP
     if backend is None:
         backend = "ici" if _device.is_tpu() else "cpu"
@@ -208,6 +211,9 @@ def multiprocess_ring():
 
 def destroy_process_group() -> None:
     global _GROUP
+    for sub in _SUBGROUPS:  # torch destroys all groups, not just the world
+        sub.close()
+    _SUBGROUPS.clear()
     if _GROUP is not None and _GROUP.ring is not None:
         _GROUP.ring.close()
     _GROUP = None
@@ -223,6 +229,122 @@ def _group() -> ProcessGroup:
     if _GROUP is None:
         init_process_group()
     return _GROUP  # type: ignore[return-value]
+
+
+_SUBGROUP_SEQ = 0
+_SUBGROUPS: list = []  # open subgroups; destroy_process_group closes them
+
+
+class Subgroup:
+    """Handle from :func:`new_group` — collectives over a rank subset.
+
+    ``ring`` is a member-only dedicated shm ring under the hostring
+    backend; single-controller SPMD needs no extra state (subgroup
+    collectives select the member rows of the participant dim).
+    """
+
+    def __init__(self, ranks, *, ring=None, member: bool):
+        self.ranks = ranks
+        self.ring = ring
+        self.is_member = member
+
+    @property
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def close(self) -> None:
+        if self.ring is not None:
+            self.ring.close()
+            self.ring = None
+
+
+def new_group(ranks, *, timeout_s: float = 60.0) -> Subgroup:
+    """``torch.distributed.new_group``: a subgroup of the world.
+
+    torch's contract carries over: EVERY process must call ``new_group``
+    with the same ``ranks`` in the same order (bystanders included —
+    under the hostring backend the call sequence number names the
+    subgroup's shm segment, so out-of-order creation would cross-wire
+    groups). Member ranks of a hostring world rendezvous a dedicated shm
+    ring; bystanders get a handle whose collectives refuse loudly. Under
+    single-controller SPMD any process may use the handle — a subgroup
+    collective reduces/gathers only the member rows of the leading
+    participant dim.
+    """
+    global _SUBGROUP_SEQ
+    g = _group()
+    rs = tuple(sorted(int(r) for r in ranks))
+    if not rs:
+        raise ValueError("new_group needs at least one rank")
+    if len(set(rs)) != len(rs):
+        raise ValueError(f"ranks must be unique, got {rs}")  # like torch —
+        # silently deduplicating would mask a buggy rank list (AVG would
+        # divide by the wrong size)
+    if rs[0] < 0 or rs[-1] >= g.size:
+        raise ValueError(f"ranks {rs} out of range for world size {g.size}")
+    _SUBGROUP_SEQ += 1
+    if g.ring is not None:
+        member = g.ring.rank in rs
+        ring = None
+        if member:
+            from pytorch_distributed_tpu.runtime.hostring import (
+                HostRingGroup,
+            )
+
+            # prefixed with the WORLD ring's per-launch/per-generation shm
+            # name: concurrent launches can't cross-wire, and the
+            # launcher's teardown glob ('<name>_g*') reaps crashed
+            # subgroup segments along with the world's
+            name = (
+                f"{g.ring_name}_sub{_SUBGROUP_SEQ}_"
+                + "_".join(map(str, rs))
+            )
+            ring = HostRingGroup(
+                name, rs.index(g.ring.rank), len(rs), timeout_s=timeout_s
+            )
+        sub = Subgroup(rs, ring=ring, member=member)
+        _SUBGROUPS.append(sub)
+        return sub
+    sub = Subgroup(rs, member=True)
+    _SUBGROUPS.append(sub)
+    return sub
+
+
+def _subgroup_rows(x, group: Subgroup):
+    x = jnp.asarray(x)
+    if x.shape[0] != _group().size:
+        raise ValueError(
+            f"subgroup collectives take the FULL participant dim "
+            f"(world={_group().size}), got leading dim {x.shape[0]}"
+        )
+    return x[jnp.asarray(group.ranks)]
+
+
+def _require_member(group: Subgroup, what: str):
+    if not group.is_member:
+        raise RuntimeError(
+            f"{what} on a subgroup this rank is not a member of "
+            f"(ranks={group.ranks})"
+        )
+    if group.ring is None:
+        raise RuntimeError(f"{what} on a closed subgroup")
+
+
+def _no_axis_with_group(axis):
+    if axis is not None:
+        raise ValueError(
+            "axis and group are mutually exclusive: subgroup ranks index "
+            "the flattened world, not a mesh axis"
+        )
+
+
+_SUB_REDUCE = {
+    ReduceOp.SUM: jnp.sum,
+    ReduceOp.AVG: jnp.mean,
+    ReduceOp.MAX: jnp.max,
+    ReduceOp.MIN: jnp.min,
+    ReduceOp.PRODUCT: jnp.prod,
+}
 
 
 def get_world_size() -> int:
@@ -337,14 +459,23 @@ def _check_leading(x, axes, mesh) -> int:
     return size
 
 
-def all_reduce(x, op: ReduceOp = ReduceOp.SUM, *, axis=None):
+def all_reduce(x, op: ReduceOp = ReduceOp.SUM, *, axis=None, group=None):
     """Reduce across the leading (participant) dim; returns shape x[0].
 
     ``axis=None`` reduces over the whole mesh. Under the hostring backend
     ``x`` is this rank's local tensor (torch semantics) and the result has
-    the same shape.
+    the same shape. ``group`` (from :func:`new_group`) restricts the
+    collective to a rank subset.
     """
     g = _group()
+    if group is not None:
+        _no_axis_with_group(axis)
+        if g.ring is not None:
+            _require_member(group, "all_reduce")
+            return jnp.asarray(
+                group.ring.all_reduce(np.asarray(x), op=op.value)
+            )
+        return _SUB_REDUCE[op](_subgroup_rows(x, group), axis=0)
     if g.ring is not None:
         return jnp.asarray(g.ring.all_reduce(np.asarray(x), op=op.value))
     axes = _participant_axes(axis)
@@ -354,11 +485,18 @@ def all_reduce(x, op: ReduceOp = ReduceOp.SUM, *, axis=None):
     return fn(jax.device_put(x, NamedSharding(g.mesh, P(axes))))
 
 
-def all_gather(x, *, axis=None):
+def all_gather(x, *, axis=None, group=None):
     """Gather participant slices; identity values, replicated layout.
 
-    Under hostring: gathers each rank's local tensor into [world, ...]."""
+    Under hostring: gathers each rank's local tensor into [world, ...].
+    With ``group``: [len(group.ranks), ...] in member order."""
     g = _group()
+    if group is not None:
+        _no_axis_with_group(axis)
+        if g.ring is not None:
+            _require_member(group, "all_gather")
+            return jnp.asarray(group.ring.all_gather(np.asarray(x)))
+        return _subgroup_rows(x, group)
     if g.ring is not None:
         return jnp.asarray(g.ring.all_gather(np.asarray(x)))
     axes = _participant_axes(axis)
@@ -386,11 +524,24 @@ def reduce_scatter(x, op: ReduceOp = ReduceOp.SUM, *, axis=None):
     return fn(jax.device_put(x, NamedSharding(g.mesh, P(axes))))
 
 
-def broadcast(x, src: int = 0, *, axis=None):
+def broadcast(x, src: int = 0, *, axis=None, group=None):
     """Replicate participant ``src``'s slice to everyone (shape x[0]).
 
-    Under hostring: replicates rank ``src``'s local tensor (torch shape)."""
+    Under hostring: replicates rank ``src``'s local tensor (torch shape).
+    With ``group``: ``src`` is a GLOBAL rank and must be a member."""
     g = _group()
+    if group is not None:
+        _no_axis_with_group(axis)
+        if src not in group.ranks:
+            raise ValueError(f"src {src} not in group ranks {group.ranks}")
+        if g.ring is not None:
+            _require_member(group, "broadcast")
+            return jnp.asarray(
+                group.ring.broadcast(
+                    np.asarray(x), src=group.ranks.index(src)
+                )
+            )
+        return _subgroup_rows(x, group)[group.ranks.index(src)]
     if g.ring is not None:
         return jnp.asarray(g.ring.broadcast(np.asarray(x), src=src))
     axes = _participant_axes(axis)
@@ -515,9 +666,17 @@ def scatter(x, src: int = 0, *, axis=None):
     return jax.device_put(x, NamedSharding(g.mesh, P(axes)))
 
 
-def barrier() -> None:
-    """Synchronize: run a whole-mesh psum and block on the result."""
+def barrier(group=None) -> None:
+    """Synchronize: run a whole-mesh psum and block on the result.
+
+    With ``group``: only the member ranks synchronize (hostring); a
+    single controller is trivially synchronized already."""
     g = _group()
+    if group is not None:
+        if g.ring is not None:
+            _require_member(group, "barrier")
+            group.ring.barrier()
+        return
     if g.ring is not None:
         g.ring.barrier()
         return

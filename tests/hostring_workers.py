@@ -232,6 +232,37 @@ def iterable_loader_worker(rank: int, path: str) -> None:
     ptd.destroy_process_group()
 
 
+def subgroup_worker(rank: int, path: str) -> None:
+    """new_group over a 3-proc hostring world: members {0, 2} allreduce on
+    a dedicated ring, the bystander (1) is refused, everyone stays live."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import pytorch_distributed_tpu as ptd
+
+    ptd.init_process_group("gloo")
+    sub = ptd.new_group([0, 2])
+    if rank in (0, 2):
+        out = ptd.all_reduce(
+            np.array([rank + 1.0], np.float32), group=sub
+        )
+        assert out[0] == 4.0, out  # 1 + 3
+        ptd.barrier(group=sub)
+    else:
+        try:
+            ptd.all_reduce(np.array([0.0], np.float32), group=sub)
+            raise AssertionError("bystander collective must refuse")
+        except RuntimeError:
+            pass
+    # the WORLD still works after subgroup traffic
+    world_sum = ptd.all_reduce(np.array([rank + 1.0], np.float32))
+    assert world_sum[0] == 6.0, world_sum
+    sub.close()
+    with open(os.path.join(path, f"sg{rank}.ok"), "w") as f:
+        f.write("ok")
+    ptd.destroy_process_group()
+
+
 def grad_compress_worker(rank: int, path: str) -> None:
     """sync_grads(compress='bf16') ships bf16 and must equal the exact
     reference: bf16(mean_f32(bf16(g_r))) upcast back to f32."""

@@ -50,6 +50,16 @@ def test_ddp_invariant_across_ranks(tmp_path):
 
 
 @pytest.mark.slow
+def test_new_group_across_ranks(tmp_path):
+    from pytorch_distributed_tpu.launch import spawn
+
+    spawn(hostring_workers.subgroup_worker, args=(str(tmp_path),),
+          nprocs=3, timeout_s=300)
+    for r in range(3):
+        assert (tmp_path / f"sg{r}.ok").read_text() == "ok"
+
+
+@pytest.mark.slow
 def test_iterable_loader_lockstep_across_ranks(tmp_path):
     from pytorch_distributed_tpu.launch import spawn
 

@@ -64,6 +64,40 @@ class TestProcessGroupFacade:
         out = ptd.all_reduce(x)
         np.testing.assert_allclose(np.asarray(out), [36.0])
 
+    def test_new_group_subset_collectives(self):
+        """torch.distributed.new_group: collectives over a rank subset
+        (single-controller semantics: member rows of the participant dim)."""
+        ptd.init_process_group()
+        g = ptd.new_group([1, 3, 5])
+        assert g.size == 3
+        x = np.arange(8, dtype=np.float32).reshape(8, 1) + 1.0
+        np.testing.assert_allclose(
+            np.asarray(ptd.all_reduce(x, group=g)), [2.0 + 4.0 + 6.0]
+        )
+        np.testing.assert_allclose(
+            np.asarray(
+                ptd.all_reduce(x, ptd.ReduceOp.MAX, group=g)
+            ), [6.0],
+        )
+        gathered = ptd.all_gather(x, group=g)
+        np.testing.assert_allclose(
+            np.asarray(gathered), [[2.0], [4.0], [6.0]]
+        )
+        np.testing.assert_allclose(
+            np.asarray(ptd.broadcast(x, src=3, group=g)), [4.0]
+        )
+        ptd.barrier(group=g)  # trivially synchronized, must not raise
+        with pytest.raises(ValueError, match="not in group"):
+            ptd.broadcast(x, src=0, group=g)
+        with pytest.raises(ValueError, match="out of range"):
+            ptd.new_group([0, 99])
+        with pytest.raises(ValueError, match="at least one"):
+            ptd.new_group([])
+        with pytest.raises(ValueError, match="unique"):
+            ptd.new_group([0, 0, 1])
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            ptd.all_reduce(x, axis="dp", group=g)
+
     def test_all_reduce_ops(self):
         ptd.init_process_group()
         x = np.arange(1, 9, dtype=np.float32).reshape(8, 1)
