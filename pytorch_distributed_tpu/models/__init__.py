@@ -16,6 +16,11 @@ from pytorch_distributed_tpu.models.gpt2 import (
     GPT2LMHead,
     gpt2_partition_rules,
 )
+from pytorch_distributed_tpu.models.vit import (
+    ViT,
+    ViTConfig,
+    vit_partition_rules,
+)
 from pytorch_distributed_tpu.models.llama import (
     LlamaConfig,
     LlamaForCausalLM,
@@ -36,4 +41,7 @@ __all__ = [
     "LlamaConfig",
     "LlamaForCausalLM",
     "llama_partition_rules",
+    "ViT",
+    "ViTConfig",
+    "vit_partition_rules",
 ]

@@ -1,0 +1,168 @@
+"""Vision Transformer (ViT) — image classification, TPU-first.
+
+Beyond the reference's recipe matrix (its vision workloads are ResNets),
+but the natural stretch for a framework claiming model-family breadth: the
+encoder reuses the same attention dispatch (``ops.attention``) every other
+transformer here uses — so flash/sequence-parallel dispatch applies — and
+the same Megatron-style TP rule shapes as BERT.
+
+TPU notes:
+* patch embedding is a single strided conv — one big MXU matmul per image
+  rather than a host-side unfold;
+* encoder blocks are pre-LN (ViT standard), GELU MLP;
+* pooling: "cls" token (paper) or "mean" (common for small data).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.ops.attention import attention
+from pytorch_distributed_tpu.runtime.precision import current_policy
+
+try:  # shared spec alias
+    from jax.sharding import PartitionSpec as P
+except ImportError:  # pragma: no cover
+    P = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dropout_rate: float = 0.0
+    pooling: str = "cls"  # cls | mean
+
+    @classmethod
+    def base(cls) -> "ViTConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "ViTConfig":  # test/smoke configuration
+        return cls(
+            image_size=32, patch_size=8, num_classes=10, hidden_size=64,
+            num_layers=2, num_heads=4, mlp_dim=128,
+        )
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+class ViTBlock(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool):
+        cfg = self.config
+        policy = current_policy()
+        ln = lambda name: nn.LayerNorm(  # noqa: E731
+            dtype=policy.compute_dtype, param_dtype=policy.param_dtype,
+            name=name,
+        )
+        h = ln("attn_ln")(x)  # pre-LN
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (cfg.num_heads, cfg.hidden_size // cfg.num_heads),
+            dtype=policy.compute_dtype, param_dtype=policy.param_dtype,
+            name=name,
+        )
+        q, k, v = dense("query")(h), dense("key")(h), dense("value")(h)
+        attn = attention(q, k, v)  # bidirectional, no mask
+        attn = nn.DenseGeneral(
+            cfg.hidden_size, axis=(-2, -1),
+            dtype=policy.compute_dtype, param_dtype=policy.param_dtype,
+            name="out",
+        )(attn)
+        attn = nn.Dropout(cfg.dropout_rate)(attn, deterministic=deterministic)
+        x = x + attn
+        h = ln("mlp_ln")(x)
+        h = nn.Dense(
+            cfg.mlp_dim, dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype, name="mlp_up",
+        )(h)
+        h = nn.gelu(h)
+        h = nn.Dense(
+            cfg.hidden_size, dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype, name="mlp_down",
+        )(h)
+        h = nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
+        return x + h
+
+
+class ViT(nn.Module):
+    """ViT classifier: [B, H, W, 3] images -> [B, num_classes] logits."""
+
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, *, train: bool = False):
+        cfg = self.config
+        policy = current_policy()
+        B, H, W, _ = images.shape
+        if H != cfg.image_size or W != cfg.image_size:
+            raise ValueError(
+                f"expected {cfg.image_size}x{cfg.image_size} images, "
+                f"got {H}x{W}"
+            )
+        x = nn.Conv(
+            cfg.hidden_size,
+            kernel_size=(cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            padding="VALID",
+            dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype,
+            name="patch_embed",
+        )(images.astype(policy.compute_dtype))
+        x = x.reshape(B, -1, cfg.hidden_size)  # [B, patches, D]
+        n_tokens = cfg.num_patches + (1 if cfg.pooling == "cls" else 0)
+        if cfg.pooling == "cls":
+            cls = self.param(
+                "cls_token", nn.initializers.zeros,
+                (1, 1, cfg.hidden_size), policy.param_dtype,
+            )
+            x = jnp.concatenate(
+                [jnp.broadcast_to(cls, (B, 1, cfg.hidden_size)).astype(
+                    x.dtype
+                ), x], axis=1,
+            )
+        pos = self.param(
+            "pos_embedding",
+            nn.initializers.normal(stddev=0.02),
+            (1, n_tokens, cfg.hidden_size),
+            policy.param_dtype,
+        )
+        x = x + pos.astype(x.dtype)
+        x = nn.Dropout(cfg.dropout_rate)(x, deterministic=not train)
+        for i in range(cfg.num_layers):
+            x = ViTBlock(cfg, name=f"block_{i}")(x, deterministic=not train)
+        x = nn.LayerNorm(
+            dtype=policy.compute_dtype, param_dtype=policy.param_dtype,
+            name="final_ln",
+        )(x)
+        pooled = x[:, 0] if cfg.pooling == "cls" else x.mean(axis=1)
+        return nn.Dense(
+            cfg.num_classes, dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype, name="head",
+        )(pooled)
+
+
+def vit_partition_rules():
+    """Megatron-style TP, same shapes as BERT's encoder rules."""
+    return [
+        (r"(query|key|value)/kernel", P(None, "tp", None)),
+        (r"(query|key|value)/bias", P("tp", None)),
+        (r"out/kernel", P("tp", None, None)),
+        (r"mlp_up/kernel", P(None, "tp")),
+        (r"mlp_up/bias", P("tp")),
+        (r"mlp_down/kernel", P("tp", None)),
+        (r"head/kernel", P(None, "tp")),
+    ]
