@@ -24,10 +24,7 @@ import jax.numpy as jnp
 from pytorch_distributed_tpu.ops.attention import attention
 from pytorch_distributed_tpu.runtime.precision import current_policy
 
-try:  # shared spec alias
-    from jax.sharding import PartitionSpec as P
-except ImportError:  # pragma: no cover
-    P = None
+from jax.sharding import PartitionSpec as P
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,6 +49,12 @@ class ViTConfig:
             image_size=32, patch_size=8, num_classes=10, hidden_size=64,
             num_layers=2, num_heads=4, mlp_dim=128,
         )
+
+    def __post_init__(self):
+        if self.pooling not in ("cls", "mean"):
+            raise ValueError(
+                f"pooling must be 'cls' or 'mean', got {self.pooling!r}"
+            )
 
     @property
     def num_patches(self) -> int:
@@ -149,10 +152,13 @@ class ViT(nn.Module):
             name="final_ln",
         )(x)
         pooled = x[:, 0] if cfg.pooling == "cls" else x.mean(axis=1)
-        return nn.Dense(
+        logits = nn.Dense(
             cfg.num_classes, dtype=policy.compute_dtype,
             param_dtype=policy.param_dtype, name="head",
         )(pooled)
+        # the AMP contract every model family here follows: logits leave
+        # in output_dtype (f32) so loss/metrics don't reduce in bf16
+        return logits.astype(policy.output_dtype)
 
 
 def vit_partition_rules():
