@@ -8,9 +8,11 @@ framework's parameter trees so those weights keep working:
 * :func:`load_llama_weights` — ``transformers.LlamaForCausalLM``
 * :func:`load_bert_weights`  — ``transformers.BertModel`` /
   ``BertForSequenceClassification``
+* :func:`load_vit_weights`   — ``transformers.ViTForImageClassification``
 
 and the inverse direction (:func:`export_gpt2_weights`,
-:func:`export_llama_weights`) so models trained here can be evaluated or
+:func:`export_llama_weights`, :func:`export_bert_weights`,
+:func:`export_vit_weights`) so models trained here can be evaluated or
 served by the torch ecosystem.
 
 Orientation notes (the whole difficulty lives here):
@@ -48,6 +50,44 @@ def _np(sd: StateDict, key: str) -> Array:
             f"{list(sd)[:4]}...)"
         )
     return np.asarray(sd[key])
+
+
+def _lin_in(sd: StateDict, key: str) -> Dict:
+    """torch ``nn.Linear`` -> flax ``Dense`` params."""
+    return {
+        "kernel": _np(sd, key + ".weight").T,
+        "bias": _np(sd, key + ".bias"),
+    }
+
+
+def _ln_in(sd: StateDict, key: str) -> Dict:
+    return {
+        "scale": _np(sd, key + ".weight"),
+        "bias": _np(sd, key + ".bias"),
+    }
+
+
+def _headproj_in(sd: StateDict, key: str, D: int, H: int, hd: int) -> Dict:
+    """[D, D] torch Linear -> [D, H, hd] flax DenseGeneral."""
+    return {
+        "kernel": _np(sd, key + ".weight").T.reshape(D, H, hd),
+        "bias": _np(sd, key + ".bias").reshape(H, hd),
+    }
+
+
+def _lin_out(sd: Dict, key: str, p) -> None:
+    sd[key + ".weight"] = np.asarray(p["kernel"]).T
+    sd[key + ".bias"] = np.asarray(p["bias"])
+
+
+def _ln_out(sd: Dict, key: str, p) -> None:
+    sd[key + ".weight"] = np.asarray(p["scale"])
+    sd[key + ".bias"] = np.asarray(p["bias"])
+
+
+def _headproj_out(sd: Dict, key: str, p, D: int) -> None:
+    sd[key + ".weight"] = np.asarray(p["kernel"]).reshape(D, D).T
+    sd[key + ".bias"] = np.asarray(p["bias"]).reshape(D)
 
 
 def _maybe_stack(layers, scan: bool, container: str, unroll_prefix: str):
@@ -280,24 +320,9 @@ def load_bert_weights(sd: StateDict, cfg, *, num_labels: int | None = None) -> D
     pre = "bert." if any(k.startswith("bert.") for k in sd) else ""
     H, D = cfg.num_heads, cfg.hidden_size
     hd = D // H
-
-    def lin(key):  # torch Linear -> flax Dense
-        return {
-            "kernel": _np(sd, key + ".weight").T,
-            "bias": _np(sd, key + ".bias"),
-        }
-
-    def ln(key):
-        return {
-            "scale": _np(sd, key + ".weight"),
-            "bias": _np(sd, key + ".bias"),
-        }
-
-    def head_proj(key):  # [D, D] Linear -> [D, H, hd] DenseGeneral
-        return {
-            "kernel": _np(sd, key + ".weight").T.reshape(D, H, hd),
-            "bias": _np(sd, key + ".bias").reshape(H, hd),
-        }
+    lin = lambda key: _lin_in(sd, key)  # noqa: E731
+    ln = lambda key: _ln_in(sd, key)  # noqa: E731
+    head_proj = lambda key: _headproj_in(sd, key, D, H, hd)  # noqa: E731
 
     trunk = {
         "word_embeddings": {
@@ -351,18 +376,9 @@ def export_bert_weights(params, cfg) -> Dict[str, Array]:
     pre = "bert." if classifier is not None else ""
     D = cfg.hidden_size
     sd: Dict[str, Array] = {}
-
-    def lin(key, p):  # flax Dense -> torch Linear
-        sd[key + ".weight"] = np.asarray(p["kernel"]).T
-        sd[key + ".bias"] = np.asarray(p["bias"])
-
-    def ln(key, p):
-        sd[key + ".weight"] = np.asarray(p["scale"])
-        sd[key + ".bias"] = np.asarray(p["bias"])
-
-    def head_proj(key, p):  # [D, H, hd] DenseGeneral -> [D, D] Linear
-        sd[key + ".weight"] = np.asarray(p["kernel"]).reshape(D, D).T
-        sd[key + ".bias"] = np.asarray(p["bias"]).reshape(D)
+    lin = lambda key, p: _lin_out(sd, key, p)  # noqa: E731
+    ln = lambda key, p: _ln_out(sd, key, p)  # noqa: E731
+    head_proj = lambda key, p: _headproj_out(sd, key, p, D)  # noqa: E731
 
     sd[pre + "embeddings.word_embeddings.weight"] = np.asarray(
         trunk["word_embeddings"]["embedding"]
@@ -393,4 +409,99 @@ def export_bert_weights(params, cfg) -> Dict[str, Array]:
         ln(p + "output.LayerNorm", lyr["mlp_ln"])
     if classifier is not None:
         lin("classifier", classifier)
+    return sd
+
+
+def load_vit_weights(sd: StateDict, cfg) -> Dict:
+    """HF ``ViTForImageClassification`` state_dict -> params for
+    :class:`~pytorch_distributed_tpu.models.vit.ViT` (cls pooling).
+
+    Layout notes: HF ViT is pre-LN, matching ``ViTBlock``
+    (``layernorm_before`` -> attn_ln, ``layernorm_after`` -> mlp_ln);
+    the patch conv transposes torch's [D, 3, ps, ps] into flax's
+    [ps, ps, 3, D]; QKV reshape to the DenseGeneral head layout like the
+    other transformer families.
+    """
+    if cfg.pooling != "cls":
+        raise ValueError(
+            "the HF ViT layout carries a cls token; convert with "
+            "pooling='cls' (mean-pooling trees have no cls_token and a "
+            "shorter position table)"
+        )
+    H, D = cfg.num_heads, cfg.hidden_size
+    hd = D // H
+    lin = lambda key: _lin_in(sd, key)  # noqa: E731
+    ln = lambda key: _ln_in(sd, key)  # noqa: E731
+    head_proj = lambda key: _headproj_in(sd, key, D, H, hd)  # noqa: E731
+
+    params = {
+        "patch_embed": {
+            "kernel": _np(
+                sd, "vit.embeddings.patch_embeddings.projection.weight"
+            ).transpose(2, 3, 1, 0),
+            "bias": _np(
+                sd, "vit.embeddings.patch_embeddings.projection.bias"
+            ),
+        },
+        "cls_token": _np(sd, "vit.embeddings.cls_token"),
+        "pos_embedding": _np(sd, "vit.embeddings.position_embeddings"),
+        "final_ln": ln("vit.layernorm"),
+        "head": lin("classifier"),
+    }
+    for i in range(cfg.num_layers):
+        p = f"vit.encoder.layer.{i}."
+        a_out = _np(sd, p + "attention.output.dense.weight")  # [D, D]
+        params[f"block_{i}"] = {
+            "attn_ln": ln(p + "layernorm_before"),
+            "query": head_proj(p + "attention.attention.query"),
+            "key": head_proj(p + "attention.attention.key"),
+            "value": head_proj(p + "attention.attention.value"),
+            "out": {
+                "kernel": a_out.T.reshape(H, hd, D),
+                "bias": _np(sd, p + "attention.output.dense.bias"),
+            },
+            "mlp_ln": ln(p + "layernorm_after"),
+            "mlp_up": lin(p + "intermediate.dense"),
+            "mlp_down": lin(p + "output.dense"),
+        }
+    return params
+
+
+def export_vit_weights(params, cfg) -> Dict[str, Array]:
+    """Our ViT params -> HF ``ViTForImageClassification`` state_dict
+    arrays — the exact inverse of :func:`load_vit_weights`."""
+    D = cfg.hidden_size
+    sd: Dict[str, Array] = {}
+    lin = lambda key, p: _lin_out(sd, key, p)  # noqa: E731
+    ln = lambda key, p: _ln_out(sd, key, p)  # noqa: E731
+    head_proj = lambda key, p: _headproj_out(sd, key, p, D)  # noqa: E731
+
+    sd["vit.embeddings.patch_embeddings.projection.weight"] = np.asarray(
+        params["patch_embed"]["kernel"]
+    ).transpose(3, 2, 0, 1)
+    sd["vit.embeddings.patch_embeddings.projection.bias"] = np.asarray(
+        params["patch_embed"]["bias"]
+    )
+    sd["vit.embeddings.cls_token"] = np.asarray(params["cls_token"])
+    sd["vit.embeddings.position_embeddings"] = np.asarray(
+        params["pos_embedding"]
+    )
+    ln("vit.layernorm", params["final_ln"])
+    lin("classifier", params["head"])
+    for i in range(cfg.num_layers):
+        p = f"vit.encoder.layer.{i}."
+        blk = params[f"block_{i}"]
+        ln(p + "layernorm_before", blk["attn_ln"])
+        head_proj(p + "attention.attention.query", blk["query"])
+        head_proj(p + "attention.attention.key", blk["key"])
+        head_proj(p + "attention.attention.value", blk["value"])
+        sd[p + "attention.output.dense.weight"] = (
+            np.asarray(blk["out"]["kernel"]).reshape(D, D).T
+        )
+        sd[p + "attention.output.dense.bias"] = np.asarray(
+            blk["out"]["bias"]
+        )
+        ln(p + "layernorm_after", blk["mlp_ln"])
+        lin(p + "intermediate.dense", blk["mlp_up"])
+        lin(p + "output.dense", blk["mlp_down"])
     return sd

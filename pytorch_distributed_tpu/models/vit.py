@@ -38,6 +38,7 @@ class ViTConfig:
     mlp_dim: int = 3072
     dropout_rate: float = 0.0
     pooling: str = "cls"  # cls | mean
+    layer_norm_eps: float = 1e-6  # HF ViT uses 1e-12
 
     @classmethod
     def base(cls) -> "ViTConfig":
@@ -69,6 +70,7 @@ class ViTBlock(nn.Module):
         cfg = self.config
         policy = current_policy()
         ln = lambda name: nn.LayerNorm(  # noqa: E731
+            epsilon=cfg.layer_norm_eps,
             dtype=policy.compute_dtype, param_dtype=policy.param_dtype,
             name=name,
         )
@@ -92,7 +94,7 @@ class ViTBlock(nn.Module):
             cfg.mlp_dim, dtype=policy.compute_dtype,
             param_dtype=policy.param_dtype, name="mlp_up",
         )(h)
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=False)  # HF ViT uses exact-erf gelu
         h = nn.Dense(
             cfg.hidden_size, dtype=policy.compute_dtype,
             param_dtype=policy.param_dtype, name="mlp_down",
@@ -148,6 +150,7 @@ class ViT(nn.Module):
         for i in range(cfg.num_layers):
             x = ViTBlock(cfg, name=f"block_{i}")(x, deterministic=not train)
         x = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps,
             dtype=policy.compute_dtype, param_dtype=policy.param_dtype,
             name="final_ln",
         )(x)

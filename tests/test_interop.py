@@ -10,6 +10,7 @@ import numpy as np
 import pytest
 
 import jax
+import jax.numpy as jnp
 
 from pytorch_distributed_tpu.interop import (
     load_bert_weights,
@@ -319,3 +320,56 @@ def test_converted_tree_structure_matches_init():
         for p, v in jax.tree_util.tree_leaves_with_path(params)
     }
     assert ref_paths == got_paths
+
+
+def test_vit_logits_match_hf():
+    """Converted HF ViT weights produce the same logits as HF's forward."""
+    from pytorch_distributed_tpu.interop import load_vit_weights
+    from pytorch_distributed_tpu.models.vit import ViT, ViTConfig
+
+    hf_cfg = transformers.ViTConfig(
+        image_size=32, patch_size=8, num_labels=7, hidden_size=48,
+        num_hidden_layers=2, num_attention_heads=4, intermediate_size=96,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+    torch.manual_seed(0)
+    hf = transformers.ViTForImageClassification(hf_cfg).eval()
+    images = np.random.default_rng(0).normal(size=(2, 32, 32, 3)).astype(
+        np.float32
+    )
+    with torch.no_grad():
+        want = hf(
+            torch.tensor(images.transpose(0, 3, 1, 2))
+        ).logits.numpy()
+
+    cfg = ViTConfig(
+        image_size=32, patch_size=8, num_classes=7, hidden_size=48,
+        num_layers=2, num_heads=4, mlp_dim=96,
+        layer_norm_eps=hf_cfg.layer_norm_eps,
+    )
+    params = load_vit_weights(_sd(hf), cfg)
+    with autocast(enabled=False):
+        got = ViT(cfg).apply({"params": params}, jnp.asarray(images))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-5)
+
+
+def test_vit_export_import_roundtrip():
+    from pytorch_distributed_tpu.interop import (
+        export_vit_weights,
+        load_vit_weights,
+    )
+    from pytorch_distributed_tpu.models.vit import ViT, ViTConfig
+
+    cfg = ViTConfig.tiny()
+    params = ViT(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 32, 32, 3))
+    )["params"]
+    back = load_vit_weights(export_vit_weights(params, cfg), cfg)
+    for (pa, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(params),
+        jax.tree_util.tree_leaves_with_path(back),
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            err_msg=str(pa),
+        )
