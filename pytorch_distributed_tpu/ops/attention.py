@@ -212,12 +212,17 @@ def attention(
             )
         return sequence_parallel_attention(q, k, v, causal=causal)
     use_flash = False
-    if mask is None and static_zero_offset:
+    # the kernel covers full, causal, and [B, T] key-padding masks; only
+    # full 4-D masks force the XLA einsum path
+    flash_ok_mask = mask is None or (
+        hasattr(mask, "ndim") and mask.ndim == 2
+    )
+    if flash_ok_mask and static_zero_offset:
         if _IMPL == "flash":
             use_flash = True
         # _IMPL == "auto": XLA path — see set_attention_impl docstring.
     if use_flash:
         from pytorch_distributed_tpu.ops.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, causal=causal, kv_mask=mask)
     return dot_product_attention(q, k, v, causal=causal, mask=mask, q_offset=q_offset)

@@ -72,8 +72,17 @@ def _pick_block(seq: int, want: int) -> int:
 # --------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, sm_scale: float, causal: bool, block_q: int, block_k: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
+                sm_scale: float, causal: bool, block_q: int, block_k: int,
+                has_bias: bool):
+    # bias is a STATIC specialization: the dominant unmasked (causal-LM)
+    # path carries no bias input at all — no HBM zeros, no per-block DMA,
+    # no dead VPU add
+    if has_bias:
+        bias_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+        bias_ref = None
     qi, ki = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -96,6 +105,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale  # [bq, bk]
+        if bias_ref is not None:  # kv padding: additive [bk] bias row
+            s = s + bias_ref[0][None, :]
 
         if causal:
             s = _causal_mask(s, q_start, k_start, block_q, block_k)
@@ -131,8 +142,10 @@ def _kv_head_map(bh, hq: int, hkv: int):
     return (bh // hq) * hkv + (bh % hq) * hkv // hq
 
 
-def _flash_forward(q, k, v, *, hq, hkv, sm_scale, causal, block_q, block_k):
-    """q: [B*Hq, S, D]; k, v: [B*Hkv, T, D] -> (out [B*Hq, S, D], lse)."""
+def _flash_forward(q, k, v, bias, *, hq, hkv, sm_scale, causal, block_q,
+                   block_k):
+    """q: [B*Hq, S, D]; k, v: [B*Hkv, T, D]; bias: [B, T] f32 additive
+    or None -> (out [B*Hq, S, D], lse)."""
     BH, S, D = q.shape
     _, T, _ = k.shape
     bq = _pick_block(S, block_q)
@@ -140,17 +153,24 @@ def _flash_forward(q, k, v, *, hq, hkv, sm_scale, causal, block_q, block_k):
     grid = (BH, S // bq, T // bk)
 
     kv_map = lambda bh, qi, ki: (_kv_head_map(bh, hq, hkv), ki, 0)
+    bias_map = lambda bh, qi, ki: (bh // hq, ki)
     kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=bq, block_k=bk
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
+        block_k=bk, has_bias=bias is not None,
     )
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, bk, D), kv_map),
+        pl.BlockSpec((1, bk, D), kv_map),
+    ]
+    inputs = [q, k, v]
+    if bias is not None:
+        in_specs.append(pl.BlockSpec((1, bk), bias_map))
+        inputs.append(bias)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, D), kv_map),
-            pl.BlockSpec((1, bk, D), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, bq, _LANES), lambda bh, qi, ki: (bh, qi, 0)),
@@ -162,7 +182,7 @@ def _flash_forward(q, k, v, *, hq, hkv, sm_scale, causal, block_q, block_k):
         scratch_shapes=_fwd_scratch(bq, bk, D),
         compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(q, k, v)
+    )(*inputs)
     return out, lse
 
 
@@ -189,8 +209,13 @@ def _compiler_params():
 # --------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               acc_ref, *, sm_scale, causal, block_q, block_k):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+               sm_scale, causal, block_q, block_k, has_bias):
+    if has_bias:
+        bias_ref, dq_ref, acc_ref = rest
+    else:
+        dq_ref, acc_ref = rest
+        bias_ref = None
     qi, ki = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -214,6 +239,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale
+        if bias_ref is not None:
+            s = s + bias_ref[0][None, :]
         if causal:
             s = _causal_mask(s, q_start, k_start, block_q, block_k)
         p = jnp.exp(s - lse)  # [bq, bk]
@@ -232,9 +259,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc,
-                *, sm_scale, causal, block_q, block_k):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                sm_scale, causal, block_q, block_k, has_bias):
+    if has_bias:
+        bias_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
+        bias_ref = None
     ki, qi = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
 
@@ -259,6 +290,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale
+        if bias_ref is not None:
+            s = s + bias_ref[0][None, :]
         if causal:
             s = _causal_mask(s, q_start, k_start, block_q, block_k)
         p = jnp.exp(s - lse)  # [bq, bk]
@@ -288,33 +321,33 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7)
 )
-def _flash(q, k, v, sm_scale, causal, block_q, block_k):
-    out, _lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+def _flash(q, k, v, bias, sm_scale, causal, block_q, block_k):
+    out, _lse = _fwd(q, k, v, bias, sm_scale, causal, block_q, block_k)
     return out
 
 
-def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
+def _fwd(q, k, v, bias, sm_scale, causal, block_q, block_k):
     B, S, Hq, D = q.shape
     _, T, Hkv, _ = k.shape
     qf = q.transpose(0, 2, 1, 3).reshape(B * Hq, S, D)
     kf = k.transpose(0, 2, 1, 3).reshape(B * Hkv, T, D)
     vf = v.transpose(0, 2, 1, 3).reshape(B * Hkv, T, D)
     out, lse = _flash_forward(
-        qf, kf, vf, hq=Hq, hkv=Hkv, sm_scale=sm_scale, causal=causal,
+        qf, kf, vf, bias, hq=Hq, hkv=Hkv, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k,
     )
     return out.reshape(B, Hq, S, D).transpose(0, 2, 1, 3), lse
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
-    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
-    return out, (q, k, v, out, lse)
+def _flash_fwd(q, k, v, bias, sm_scale, causal, block_q, block_k):
+    out, lse = _fwd(q, k, v, bias, sm_scale, causal, block_q, block_k)
+    return out, (q, k, v, bias, out, lse)
 
 
 def _flash_bwd(sm_scale, causal, block_q, block_k, res, dout):
-    q, k, v, out, lse = res
+    q, k, v, bias, out, lse = res
     B, S, Hq, D = q.shape
     _, T, Hkv, _ = k.shape
     G = Hq // Hkv
@@ -340,43 +373,56 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, res, dout):
     q_map = lambda bh, qi, ki: (bh, qi, 0)
     lse_map = lambda bh, qi, ki: (bh, qi, 0)
 
+    has_bias = bias is not None
+    dq_specs = [
+        pl.BlockSpec((1, bq, D), q_map),
+        pl.BlockSpec((1, bk, D), kv_map),
+        pl.BlockSpec((1, bk, D), kv_map),
+        pl.BlockSpec((1, bq, D), q_map),
+        pl.BlockSpec((1, bq, _LANES), lse_map),
+        pl.BlockSpec((1, bq, _LANES), lse_map),
+    ]
+    dq_inputs = [qf, kf, vf, dof, lse, delta]
+    if has_bias:
+        dq_specs.append(pl.BlockSpec((1, bk), lambda bh, qi, ki: (bh // Hq, ki)))
+        dq_inputs.append(bias)
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=bq, block_k=bk,
+            block_q=bq, block_k=bk, has_bias=has_bias,
         ),
         grid=(BH, S // bq, T // bk),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), q_map),
-            pl.BlockSpec((1, bk, D), kv_map),
-            pl.BlockSpec((1, bk, D), kv_map),
-            pl.BlockSpec((1, bq, D), q_map),
-            pl.BlockSpec((1, bq, _LANES), lse_map),
-            pl.BlockSpec((1, bq, _LANES), lse_map),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, bq, D), q_map),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         scratch_shapes=_bwd_scratch(bq, D, n=1),
         compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(qf, kf, vf, dof, lse, delta)
+    )(*dq_inputs)
 
     # dk/dv per *query* head (race-free), group-summed to kv heads after
     kv_q_map = lambda bh, ki, qi: (_kv_head_map(bh, Hq, Hkv), ki, 0)
+    dkv_specs = [
+        pl.BlockSpec((1, bq, D), lambda bh, ki, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, bk, D), kv_q_map),
+        pl.BlockSpec((1, bk, D), kv_q_map),
+        pl.BlockSpec((1, bq, D), lambda bh, ki, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, bq, _LANES), lambda bh, ki, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, bq, _LANES), lambda bh, ki, qi: (bh, qi, 0)),
+    ]
+    dkv_inputs = [qf, kf, vf, dof, lse, delta]
+    if has_bias:
+        dkv_specs.append(
+            pl.BlockSpec((1, bk), lambda bh, ki, qi: (bh // Hq, ki))
+        )
+        dkv_inputs.append(bias)
     dk_per_q, dv_per_q = pl.pallas_call(
         functools.partial(
             _dkv_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=bq, block_k=bk,
+            block_q=bq, block_k=bk, has_bias=has_bias,
         ),
         grid=(BH, T // bk, S // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda bh, ki, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, D), kv_q_map),
-            pl.BlockSpec((1, bk, D), kv_q_map),
-            pl.BlockSpec((1, bq, D), lambda bh, ki, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, bq, _LANES), lambda bh, ki, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, bq, _LANES), lambda bh, ki, qi: (bh, qi, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda bh, ki, qi: (bh, ki, 0)),
             pl.BlockSpec((1, bk, D), lambda bh, ki, qi: (bh, ki, 0)),
@@ -388,7 +434,7 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, res, dout):
         scratch_shapes=_bwd_scratch(bk, D, n=2),
         compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(qf, kf, vf, dof, lse, delta)
+    )(*dkv_inputs)
 
     dq = dq.reshape(B, Hq, S, D).transpose(0, 2, 1, 3)
     dk = (
@@ -399,7 +445,9 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, res, dout):
         dv_per_q.reshape(B, Hkv, G, T, D).sum(axis=2)
         .transpose(0, 2, 1, 3)
     )
-    return dq, dk, dv
+    # bias comes from a boolean padding mask (non-differentiable source);
+    # a zero cotangent is correct for every real caller
+    return dq, dk, dv, None if bias is None else jnp.zeros_like(bias)
 
 
 def _bwd_scratch(rows, d, n):
@@ -417,17 +465,33 @@ def flash_attention(
     v: jnp.ndarray,  # [B, T, Hkv, D]
     *,
     causal: bool = False,
+    kv_mask: Optional[jnp.ndarray] = None,  # [B, T] bool, True = attend
     sm_scale: Optional[float] = None,
     block_q: int = 128,
     block_k: int = 128,
 ) -> jnp.ndarray:
     """Blocked flash attention; drop-in for
     :func:`~pytorch_distributed_tpu.ops.attention.dot_product_attention`
-    when there is no padding mask. Returns [B, S, Hq, D] in q.dtype."""
+    for full, causal, and key-padding-masked attention (``kv_mask``, the
+    BERT-style [B, T] mask). Returns [B, S, Hq, D] in q.dtype.
+
+    Rows whose keys are ENTIRELY masked produce finite but undefined
+    outputs (so does the XLA path: softmax over all -inf is uniform);
+    real padding always leaves >= 1 valid token per sequence."""
     B, S, Hq, D = q.shape
     _, T, Hkv, _ = k.shape
     if Hq % Hkv:
         raise ValueError(f"query heads {Hq} not a multiple of kv heads {Hkv}")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
-    return _flash(q, k, v, sm_scale, causal, block_q, block_k)
+    bias = None
+    if kv_mask is not None:
+        if kv_mask.shape != (B, T):
+            raise ValueError(
+                f"kv_mask must be [batch, kv_len] = {(B, T)}, "
+                f"got {kv_mask.shape}"
+            )
+        bias = jnp.where(kv_mask.astype(jnp.bool_), 0.0, _NEG_INF).astype(
+            jnp.float32
+        )
+    return _flash(q, k, v, bias, sm_scale, causal, block_q, block_k)

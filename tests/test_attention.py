@@ -205,6 +205,60 @@ class TestFlashAttention:
                 np.asarray(b), np.asarray(a), rtol=1e-4, atol=1e-4
             )
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_padding_mask_matches_xla_fwd_and_grads(self, causal):
+        """kv_mask (BERT padding) in-kernel: forward AND grads match the
+        einsum path, including ragged lengths crossing block boundaries
+        and a fully-masked k-block."""
+        from pytorch_distributed_tpu.ops.flash_attention import flash_attention
+
+        q, k, v = self._qkv(S=64, D=32)
+        B = q.shape[0]
+        # lengths start at exactly one block (32): sequence 0's block
+        # [32, 64) is FULLY masked, exercising the online-softmax carry
+        # for all-masked blocks; later lengths cross block boundaries
+        lengths = np.linspace(32, 64, B).astype(np.int64)
+        mask = jnp.asarray(np.arange(64)[None, :] < lengths[:, None])
+
+        want = dot_product_attention(q, k, v, causal=causal, mask=mask)
+        got = flash_attention(
+            q, k, v, causal=causal, kv_mask=mask, block_q=32, block_k=32
+        )
+        valid = np.asarray(mask)[:, :, None, None]  # padded q rows are
+        np.testing.assert_allclose(       # undefined on both paths
+            np.asarray(got) * valid, np.asarray(want) * valid,
+            rtol=2e-5, atol=2e-6,
+        )
+
+        def loss(fn):
+            def f(q, k, v):
+                out = fn(q, k, v) * valid  # grade only defined rows
+                return (out ** 2).sum()
+
+            return f
+
+        ref = jax.grad(
+            loss(
+                lambda q, k, v: dot_product_attention(
+                    q, k, v, causal=causal, mask=mask
+                )
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        gotg = jax.grad(
+            loss(
+                lambda q, k, v: flash_attention(
+                    q, k, v, causal=causal, kv_mask=mask,
+                    block_q=32, block_k=32,
+                )
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(ref, gotg):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), rtol=1e-4, atol=1e-4
+            )
+
     def test_mqa_single_kv_head(self):
         from pytorch_distributed_tpu.ops.flash_attention import flash_attention
 
@@ -250,17 +304,37 @@ class TestAttentionDispatch:
         finally:
             A.set_attention_impl("auto")
 
-    def test_mask_falls_back_to_xla(self):
+    def test_4d_mask_falls_back_to_xla(self):
         import pytorch_distributed_tpu.ops.attention as A
 
         A.set_attention_impl("flash")
         try:
             q = jnp.ones((2, 8, 2, 16))
-            mask = jnp.ones((2, 8), bool)
+            mask = jnp.ones((2, 1, 8, 8), bool)
             out = A.attention(q, q, q, mask=mask)  # must not hit the kernel
             assert out.shape == q.shape
         finally:
             A.set_attention_impl("auto")
+
+    def test_2d_padding_mask_dispatches_to_flash(self):
+        """BERT-style [B, T] masks are in-kernel now: forced-flash output
+        with a padding mask matches the XLA path."""
+        import pytorch_distributed_tpu.ops.attention as A
+
+        rng = np.random.default_rng(3)
+        q = jnp.asarray(rng.normal(size=(2, 16, 2, 16)).astype(np.float32))
+        mask = jnp.asarray(
+            np.arange(16)[None, :] < np.array([[11], [16]])
+        )
+        want = A.dot_product_attention(q, q, q, mask=mask)
+        A.set_attention_impl("flash")
+        try:
+            got = A.attention(q, q, q, mask=mask)
+        finally:
+            A.set_attention_impl("auto")
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6
+        )
 
     def test_bad_impl_rejected(self):
         import pytorch_distributed_tpu.ops.attention as A
