@@ -37,6 +37,10 @@ from pytorch_distributed_tpu.data.image_folder import (
     FolderImagePipeline,
     ImageFolderDataset,
 )
+from pytorch_distributed_tpu.data.packing import (
+    pack_documents,
+    packed_loss_mask,
+)
 from pytorch_distributed_tpu.data.tokenizer import (
     TokenizedTextDataset,
     Tokenizer,
@@ -60,5 +64,7 @@ __all__ = [
     "SyntheticImageDataset",
     "SyntheticTextDataset",
     "load_cifar10",
+    "pack_documents",
+    "packed_loss_mask",
     "random_split",
 ]

@@ -57,6 +57,7 @@ def dot_product_attention(
     *,
     causal: bool = False,
     mask: Optional[jnp.ndarray] = None,  # [B, 1|Hq, S, T] or [B, T] padding
+    segment_ids: Optional[jnp.ndarray] = None,  # [B, S] packing ids
     q_offset: int = 0,
     softmax_dtype=jnp.float32,
 ) -> jnp.ndarray:
@@ -64,6 +65,8 @@ def dot_product_attention(
 
     ``q_offset`` shifts query positions for the causal mask — used by
     sequence-parallel shards where the local block starts mid-sequence.
+    ``segment_ids`` restricts attention to within-segment pairs (packed
+    fixed-shape sequences; self-attention only).
     """
     B, S, Hq, D = q.shape
     _, T, Hkv, _ = k.shape
@@ -82,6 +85,11 @@ def dot_product_attention(
     )
 
     neg = jnp.finfo(softmax_dtype).min
+    if segment_ids is not None:
+        if S != T:
+            raise ValueError("segment_ids requires self-attention (S == T)")
+        same = segment_ids[:, :, None] == segment_ids[:, None, :]  # [B,S,T]
+        logits = jnp.where(same[:, None, None], logits, neg)
     if causal:
         qpos = jnp.arange(S) + q_offset
         kpos = jnp.arange(T)
@@ -190,6 +198,7 @@ def attention(
     *,
     causal: bool = False,
     mask: Optional[jnp.ndarray] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
     q_offset: int = 0,
 ) -> jnp.ndarray:
     """Dispatching attention: models call this instead of an impl directly."""
@@ -210,10 +219,18 @@ def attention(
                 "KV-cache decode is not supported inside sequence-parallel "
                 "mode; disable_sequence_parallel() around generation"
             )
+        if segment_ids is not None:
+            # sharded ring/all-to-all attention would need the segment
+            # table of REMOTE shards; silently ignoring it would leak
+            # attention across documents
+            raise NotImplementedError(
+                "packed (segment_ids) attention is not supported inside "
+                "sequence-parallel mode"
+            )
         return sequence_parallel_attention(q, k, v, causal=causal)
     use_flash = False
-    # the kernel covers full, causal, and [B, T] key-padding masks; only
-    # full 4-D masks force the XLA einsum path
+    # the kernel covers full, causal, [B, T] key-padding masks, and
+    # packed segment ids; only full 4-D masks force the XLA einsum path
     flash_ok_mask = mask is None or (
         hasattr(mask, "ndim") and mask.ndim == 2
     )
@@ -224,5 +241,10 @@ def attention(
     if use_flash:
         from pytorch_distributed_tpu.ops.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal, kv_mask=mask)
-    return dot_product_attention(q, k, v, causal=causal, mask=mask, q_offset=q_offset)
+        return flash_attention(
+            q, k, v, causal=causal, kv_mask=mask, segment_ids=segment_ids
+        )
+    return dot_product_attention(
+        q, k, v, causal=causal, mask=mask, segment_ids=segment_ids,
+        q_offset=q_offset,
+    )

@@ -72,17 +72,24 @@ def _pick_block(seq: int, want: int) -> int:
 # --------------------------------------------------------------------------
 
 
+def _seg_mask(s, qseg, kseg):
+    """Mask scores across segment boundaries (packed sequences)."""
+    return jnp.where(qseg[:, None] == kseg[None, :], s, _NEG_INF)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
                 sm_scale: float, causal: bool, block_q: int, block_k: int,
-                has_bias: bool):
-    # bias is a STATIC specialization: the dominant unmasked (causal-LM)
-    # path carries no bias input at all — no HBM zeros, no per-block DMA,
-    # no dead VPU add
-    if has_bias:
-        bias_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+                has_bias: bool, has_segments: bool):
+    # bias/segments are STATIC specializations: the dominant unmasked
+    # (causal-LM) path carries neither input — no HBM zeros, no per-block
+    # DMA, no dead VPU work
+    rest = list(rest)
+    bias_ref = rest.pop(0) if has_bias else None
+    if has_segments:
+        qseg_ref, kseg_ref = rest.pop(0), rest.pop(0)
     else:
-        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
-        bias_ref = None
+        qseg_ref = kseg_ref = None
+    o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     qi, ki = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -107,6 +114,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest,
         ) * sm_scale  # [bq, bk]
         if bias_ref is not None:  # kv padding: additive [bk] bias row
             s = s + bias_ref[0][None, :]
+        if qseg_ref is not None:  # packed sequences: block-diagonal mask
+            s = _seg_mask(s, qseg_ref[0], kseg_ref[0])
 
         if causal:
             s = _causal_mask(s, q_start, k_start, block_q, block_k)
@@ -142,10 +151,11 @@ def _kv_head_map(bh, hq: int, hkv: int):
     return (bh // hq) * hkv + (bh % hq) * hkv // hq
 
 
-def _flash_forward(q, k, v, bias, *, hq, hkv, sm_scale, causal, block_q,
-                   block_k):
+def _flash_forward(q, k, v, bias, segments, *, hq, hkv, sm_scale, causal,
+                   block_q, block_k):
     """q: [B*Hq, S, D]; k, v: [B*Hkv, T, D]; bias: [B, T] f32 additive
-    or None -> (out [B*Hq, S, D], lse)."""
+    or None; segments: [B, S] i32 or None (self-attention packing)
+    -> (out [B*Hq, S, D], lse)."""
     BH, S, D = q.shape
     _, T, _ = k.shape
     bq = _pick_block(S, block_q)
@@ -157,6 +167,7 @@ def _flash_forward(q, k, v, bias, *, hq, hkv, sm_scale, causal, block_q,
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=bq,
         block_k=bk, has_bias=bias is not None,
+        has_segments=segments is not None,
     )
     in_specs = [
         pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
@@ -167,6 +178,14 @@ def _flash_forward(q, k, v, bias, *, hq, hkv, sm_scale, causal, block_q,
     if bias is not None:
         in_specs.append(pl.BlockSpec((1, bk), bias_map))
         inputs.append(bias)
+    if segments is not None:
+        in_specs.append(
+            pl.BlockSpec((1, bq), lambda bh, qi, ki: (bh // hq, qi))
+        )
+        in_specs.append(
+            pl.BlockSpec((1, bk), lambda bh, qi, ki: (bh // hq, ki))
+        )
+        inputs.extend([segments, segments])
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -210,12 +229,14 @@ def _compiler_params():
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-               sm_scale, causal, block_q, block_k, has_bias):
-    if has_bias:
-        bias_ref, dq_ref, acc_ref = rest
+               sm_scale, causal, block_q, block_k, has_bias, has_segments):
+    rest = list(rest)
+    bias_ref = rest.pop(0) if has_bias else None
+    if has_segments:
+        qseg_ref, kseg_ref = rest.pop(0), rest.pop(0)
     else:
-        dq_ref, acc_ref = rest
-        bias_ref = None
+        qseg_ref = kseg_ref = None
+    dq_ref, acc_ref = rest
     qi, ki = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -241,6 +262,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         ) * sm_scale
         if bias_ref is not None:
             s = s + bias_ref[0][None, :]
+        if qseg_ref is not None:
+            s = _seg_mask(s, qseg_ref[0], kseg_ref[0])
         if causal:
             s = _causal_mask(s, q_start, k_start, block_q, block_k)
         p = jnp.exp(s - lse)  # [bq, bk]
@@ -260,12 +283,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-                sm_scale, causal, block_q, block_k, has_bias):
-    if has_bias:
-        bias_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+                sm_scale, causal, block_q, block_k, has_bias, has_segments):
+    rest = list(rest)
+    bias_ref = rest.pop(0) if has_bias else None
+    if has_segments:
+        qseg_ref, kseg_ref = rest.pop(0), rest.pop(0)
     else:
-        dk_ref, dv_ref, dk_acc, dv_acc = rest
-        bias_ref = None
+        qseg_ref = kseg_ref = None
+    dk_ref, dv_ref, dk_acc, dv_acc = rest
     ki, qi = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
 
@@ -292,6 +317,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         ) * sm_scale
         if bias_ref is not None:
             s = s + bias_ref[0][None, :]
+        if qseg_ref is not None:
+            s = _seg_mask(s, qseg_ref[0], kseg_ref[0])
         if causal:
             s = _causal_mask(s, q_start, k_start, block_q, block_k)
         p = jnp.exp(s - lse)  # [bq, bk]
@@ -321,33 +348,37 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7)
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8)
 )
-def _flash(q, k, v, bias, sm_scale, causal, block_q, block_k):
-    out, _lse = _fwd(q, k, v, bias, sm_scale, causal, block_q, block_k)
+def _flash(q, k, v, bias, segments, sm_scale, causal, block_q, block_k):
+    out, _lse = _fwd(
+        q, k, v, bias, segments, sm_scale, causal, block_q, block_k
+    )
     return out
 
 
-def _fwd(q, k, v, bias, sm_scale, causal, block_q, block_k):
+def _fwd(q, k, v, bias, segments, sm_scale, causal, block_q, block_k):
     B, S, Hq, D = q.shape
     _, T, Hkv, _ = k.shape
     qf = q.transpose(0, 2, 1, 3).reshape(B * Hq, S, D)
     kf = k.transpose(0, 2, 1, 3).reshape(B * Hkv, T, D)
     vf = v.transpose(0, 2, 1, 3).reshape(B * Hkv, T, D)
     out, lse = _flash_forward(
-        qf, kf, vf, bias, hq=Hq, hkv=Hkv, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k,
+        qf, kf, vf, bias, segments, hq=Hq, hkv=Hkv, sm_scale=sm_scale,
+        causal=causal, block_q=block_q, block_k=block_k,
     )
     return out.reshape(B, Hq, S, D).transpose(0, 2, 1, 3), lse
 
 
-def _flash_fwd(q, k, v, bias, sm_scale, causal, block_q, block_k):
-    out, lse = _fwd(q, k, v, bias, sm_scale, causal, block_q, block_k)
-    return out, (q, k, v, bias, out, lse)
+def _flash_fwd(q, k, v, bias, segments, sm_scale, causal, block_q, block_k):
+    out, lse = _fwd(
+        q, k, v, bias, segments, sm_scale, causal, block_q, block_k
+    )
+    return out, (q, k, v, bias, segments, out, lse)
 
 
 def _flash_bwd(sm_scale, causal, block_q, block_k, res, dout):
-    q, k, v, bias, out, lse = res
+    q, k, v, bias, segments, out, lse = res
     B, S, Hq, D = q.shape
     _, T, Hkv, _ = k.shape
     G = Hq // Hkv
@@ -386,10 +417,16 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, res, dout):
     if has_bias:
         dq_specs.append(pl.BlockSpec((1, bk), lambda bh, qi, ki: (bh // Hq, ki)))
         dq_inputs.append(bias)
+    has_segments = segments is not None
+    if has_segments:
+        dq_specs.append(pl.BlockSpec((1, bq), lambda bh, qi, ki: (bh // Hq, qi)))
+        dq_specs.append(pl.BlockSpec((1, bk), lambda bh, qi, ki: (bh // Hq, ki)))
+        dq_inputs.extend([segments, segments])
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, sm_scale=sm_scale, causal=causal,
             block_q=bq, block_k=bk, has_bias=has_bias,
+            has_segments=has_segments,
         ),
         grid=(BH, S // bq, T // bk),
         in_specs=dq_specs,
@@ -416,10 +453,19 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, res, dout):
             pl.BlockSpec((1, bk), lambda bh, ki, qi: (bh // Hq, ki))
         )
         dkv_inputs.append(bias)
+    if has_segments:
+        dkv_specs.append(
+            pl.BlockSpec((1, bq), lambda bh, ki, qi: (bh // Hq, qi))
+        )
+        dkv_specs.append(
+            pl.BlockSpec((1, bk), lambda bh, ki, qi: (bh // Hq, ki))
+        )
+        dkv_inputs.extend([segments, segments])
     dk_per_q, dv_per_q = pl.pallas_call(
         functools.partial(
             _dkv_kernel, sm_scale=sm_scale, causal=causal,
             block_q=bq, block_k=bk, has_bias=has_bias,
+            has_segments=has_segments,
         ),
         grid=(BH, T // bk, S // bq),
         in_specs=dkv_specs,
@@ -445,9 +491,13 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, res, dout):
         dv_per_q.reshape(B, Hkv, G, T, D).sum(axis=2)
         .transpose(0, 2, 1, 3)
     )
-    # bias comes from a boolean padding mask (non-differentiable source);
-    # a zero cotangent is correct for every real caller
-    return dq, dk, dv, None if bias is None else jnp.zeros_like(bias)
+    # bias comes from a boolean padding mask and segments are ids — both
+    # non-differentiable sources; zero/None cotangents are correct
+    return (
+        dq, dk, dv,
+        None if bias is None else jnp.zeros_like(bias),
+        None,
+    )
 
 
 def _bwd_scratch(rows, d, n):
@@ -466,14 +516,18 @@ def flash_attention(
     *,
     causal: bool = False,
     kv_mask: Optional[jnp.ndarray] = None,  # [B, T] bool, True = attend
+    segment_ids: Optional[jnp.ndarray] = None,  # [B, S] i32, packing
     sm_scale: Optional[float] = None,
     block_q: int = 128,
     block_k: int = 128,
 ) -> jnp.ndarray:
     """Blocked flash attention; drop-in for
     :func:`~pytorch_distributed_tpu.ops.attention.dot_product_attention`
-    for full, causal, and key-padding-masked attention (``kv_mask``, the
-    BERT-style [B, T] mask). Returns [B, S, Hq, D] in q.dtype.
+    for full, causal, key-padding-masked (``kv_mask``, the BERT-style
+    [B, T] mask), and PACKED attention (``segment_ids``: tokens attend
+    only within their own segment — the MaxText-style fixed-shape
+    document packing; self-attention only). Returns [B, S, Hq, D] in
+    q.dtype.
 
     Rows whose keys are ENTIRELY masked produce finite but undefined
     outputs (so does the XLA path: softmax over all -inf is uniform);
@@ -494,4 +548,15 @@ def flash_attention(
         bias = jnp.where(kv_mask.astype(jnp.bool_), 0.0, _NEG_INF).astype(
             jnp.float32
         )
-    return _flash(q, k, v, bias, sm_scale, causal, block_q, block_k)
+    if segment_ids is not None:
+        if S != T:
+            raise ValueError("segment_ids requires self-attention (S == T)")
+        if segment_ids.shape != (B, S):
+            raise ValueError(
+                f"segment_ids must be [batch, seq] = {(B, S)}, "
+                f"got {segment_ids.shape}"
+            )
+        segment_ids = segment_ids.astype(jnp.int32)
+    return _flash(
+        q, k, v, bias, segment_ids, sm_scale, causal, block_q, block_k
+    )

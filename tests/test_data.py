@@ -349,6 +349,57 @@ class TestIterableDataset:
             iter(IterableDataset()).__next__()
 
 
+class TestPacking:
+    def test_pack_documents_invariants(self):
+        from pytorch_distributed_tpu.data import pack_documents
+
+        docs = [[1, 2, 3], [4, 5, 6, 7, 8], [9], [10, 11, 12, 13]]
+        out = pack_documents(docs, 8, pad_id=0)
+        ids, seg, pos = (
+            out["input_ids"], out["segment_ids"], out["positions"]
+        )
+        assert ids.shape == seg.shape == pos.shape
+        assert ids.shape[1] == 8
+        # every token survives, in order, under its own segment
+        recovered = []
+        for r in range(ids.shape[0]):
+            for s in range(1, seg[r].max() + 1):
+                recovered.append(list(ids[r][seg[r] == s]))
+        assert sorted(map(tuple, recovered)) == sorted(
+            map(tuple, docs)
+        )
+        # positions restart per document
+        for r in range(ids.shape[0]):
+            for s in range(1, seg[r].max() + 1):
+                p = pos[r][seg[r] == s]
+                assert list(p) == list(range(len(p)))
+        # padding is segment 0 / pad_id
+        assert np.all(ids[seg == 0] == 0)
+
+    def test_pack_long_document_splits(self):
+        from pytorch_distributed_tpu.data import pack_documents
+
+        out = pack_documents([list(range(1, 20))], 8)
+        seg = out["segment_ids"]
+        # 19 tokens -> pieces of 8, 8, 3; all tokens kept
+        total = int((seg != 0).sum())
+        assert total == 19
+
+    def test_packed_loss_mask(self):
+        from pytorch_distributed_tpu.data import (
+            pack_documents,
+            packed_loss_mask,
+        )
+
+        out = pack_documents([[1, 2, 3], [4, 5]], 8)
+        m = packed_loss_mask(out["segment_ids"])
+        seg = out["segment_ids"][0]
+        # boundary (seg 1 -> seg 2) and pad targets are masked out
+        for t in range(7):
+            expect = seg[t + 1] == seg[t] and seg[t + 1] != 0
+            assert m[0, t] == expect, (t, seg)
+
+
 class TestWeightedRandomSampler:
     def test_zero_weight_never_drawn_heavy_dominates(self):
         from pytorch_distributed_tpu.data import WeightedRandomSampler
