@@ -74,11 +74,12 @@ def pack_documents(
     }
 
 
-def packed_loss_mask(segment_ids: np.ndarray) -> np.ndarray:
+def packed_loss_mask(segment_ids):
     """Next-token loss mask for packed rows: position t trains iff its
     target t+1 exists, is not padding, and belongs to the SAME document
     (a document's last token must not predict the next document's
     first). Shape in: [B, S]; out: [B, S-1] bool aligned with
-    ``targets = input_ids[:, 1:]``."""
-    seg = np.asarray(segment_ids)
+    ``targets = input_ids[:, 1:]``. Backend-agnostic: works on numpy
+    arrays AND traced jax arrays (the jitted loss uses it too)."""
+    seg = segment_ids
     return (seg[:, 1:] == seg[:, :-1]) & (seg[:, 1:] != 0)

@@ -76,8 +76,9 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin, positions, deterministic: bool,
-                 decode: bool = False, cache_len: Optional[int] = None):
+    def __call__(self, x, cos, sin, positions, segment_ids,
+                 deterministic: bool, decode: bool = False,
+                 cache_len: Optional[int] = None):
         cfg = self.config
         policy = current_policy()
         dense = lambda feats, name, axis=-1: nn.DenseGeneral(  # noqa: E731
@@ -98,7 +99,9 @@ class LlamaBlock(nn.Module):
             )
             attn = attention(q, k, v, causal=True, q_offset=offset)
         else:
-            attn = attention(q, k, v, causal=True)
+            attn = attention(
+                q, k, v, causal=True, segment_ids=segment_ids
+            )
         attn = dense(cfg.hidden_size, "o", axis=(-2, -1))(attn)
         x = x + attn
 
@@ -121,6 +124,7 @@ class LlamaForCausalLM(nn.Module):
         input_ids,
         positions: Optional[jnp.ndarray] = None,
         *,
+        segment_ids: Optional[jnp.ndarray] = None,
         train: bool = False,
         decode: bool = False,
         cache_len: Optional[int] = None,
@@ -145,16 +149,23 @@ class LlamaForCausalLM(nn.Module):
             positions = jnp.broadcast_to(
                 decode_positions(self, S)[None, :], (B, S)
             )
+        if segment_ids is not None and decode:
+            raise ValueError(
+                "segment_ids (packed training) and decode (KV cache) are "
+                "mutually exclusive"
+            )
         if cfg.scan_layers:
             from pytorch_distributed_tpu.models.scan import scan_stack
 
             x = scan_stack(
-                LlamaBlock, cfg, static_argnums=(4, 5, 6), name="layers"
-            )(x, cos, sin, positions, not train, decode, cache_len)
+                LlamaBlock, cfg, static_argnums=(5, 6, 7), name="layers"
+            )(x, cos, sin, positions, segment_ids, not train, decode,
+              cache_len)
         else:
             for i in range(cfg.num_layers):
                 x = LlamaBlock(cfg, name=f"layer{i}")(
-                    x, cos, sin, positions, deterministic=not train,
+                    x, cos, sin, positions, segment_ids,
+                    deterministic=not train,
                     decode=decode, cache_len=cache_len,
                 )
         x = RMSNorm(cfg.rms_eps, name="final_norm")(x)

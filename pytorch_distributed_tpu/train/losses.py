@@ -140,6 +140,12 @@ def causal_lm_loss_fn(
     (ops/lm_loss.py): the model is applied with ``return_hidden=True`` and
     the [B,S,V] logits are never materialized — the large-vocab (Llama-3)
     memory fix. Requires moe_aux_weight == 0 for now.
+
+    Packed batches: when the batch carries ``segment_ids`` (and
+    optionally ``positions``, both from ``data.pack_documents``) they are
+    forwarded to the model (Llama supports them) and the next-token loss
+    is masked at document boundaries and padding, averaged over valid
+    targets only.
     """
     if vocab_chunk_size is not None and moe_aux_weight > 0.0:
         raise NotImplementedError(
@@ -147,6 +153,12 @@ def causal_lm_loss_fn(
         )
 
     def chunked_loss_fn(params, batch_stats, batch, rng):
+        if "segment_ids" in batch:
+            raise NotImplementedError(
+                "packed batches (segment_ids) + chunked-vocab loss not "
+                "combined yet — silently ignoring the segments would "
+                "train across document boundaries"
+            )
         loss = _chunked_lm_loss(
             model, params, batch[ids_key], vocab_chunk_size,
             train=True, rng=rng,
@@ -158,29 +170,47 @@ def causal_lm_loss_fn(
 
     def loss_fn(params, batch_stats, batch, rng):
         ids = batch[ids_key]
+        # packed batches (data/packing.py): per-document attention +
+        # per-document positions + boundary/pad loss masking
+        seg = batch.get("segment_ids")
+        extra = {}
+        if seg is not None:
+            extra["segment_ids"] = seg
+            if "positions" in batch:
+                extra["positions"] = batch["positions"]
         if moe_aux_weight > 0.0:
             from pytorch_distributed_tpu.ops.moe import collect_aux_loss
 
             logits, inter = model.apply(
                 {"params": params}, ids, train=True,
-                rngs={"dropout": rng}, mutable=["intermediates"],
+                rngs={"dropout": rng}, mutable=["intermediates"], **extra,
             )
             aux = collect_aux_loss(
                 inter["intermediates"], weight=moe_aux_weight
             )
         else:
             logits = model.apply(
-                {"params": params}, ids, train=True, rngs={"dropout": rng}
+                {"params": params}, ids, train=True, rngs={"dropout": rng},
+                **extra,
             )
             aux = None
         # predict token t+1 from prefix..t
         shift_logits = logits[:, :-1].astype(jnp.float32)
         shift_labels = ids[:, 1:]
-        loss = jnp.mean(
-            optax.softmax_cross_entropy_with_integer_labels(
-                shift_logits, shift_labels
-            )
+        tok_loss = optax.softmax_cross_entropy_with_integer_labels(
+            shift_logits, shift_labels
         )
+        if seg is not None:
+            from pytorch_distributed_tpu.data.packing import (
+                packed_loss_mask,
+            )
+
+            valid = packed_loss_mask(seg).astype(tok_loss.dtype)
+            loss = jnp.sum(tok_loss * valid) / jnp.maximum(
+                jnp.sum(valid), 1.0
+            )
+        else:
+            loss = jnp.mean(tok_loss)
         metrics = {"loss": loss}
         if aux is not None:
             metrics["moe_aux_loss"] = aux
@@ -231,17 +261,39 @@ def causal_lm_eval_step(
 
     def eval_step(state, batch) -> Dict[str, jax.Array]:
         ids = batch[ids_key]
+        seg = batch.get("segment_ids")
         if vocab_chunk_size is not None:
+            if seg is not None:
+                raise NotImplementedError(
+                    "packed batches (segment_ids) + chunked-vocab eval "
+                    "not combined yet"
+                )
             loss = _chunked_lm_loss(
                 model, state.params, ids, vocab_chunk_size, train=False
             )
             return {"loss": loss, "perplexity": jnp.exp(loss)}
-        logits = model.apply({"params": state.params}, ids, train=False)
-        loss = jnp.mean(
-            optax.softmax_cross_entropy_with_integer_labels(
-                logits[:, :-1].astype(jnp.float32), ids[:, 1:]
-            )
+        extra = {}
+        if seg is not None:  # packed eval mirrors the packed train loss
+            extra["segment_ids"] = seg
+            if "positions" in batch:
+                extra["positions"] = batch["positions"]
+        logits = model.apply(
+            {"params": state.params}, ids, train=False, **extra
         )
+        tok_loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1].astype(jnp.float32), ids[:, 1:]
+        )
+        if seg is not None:
+            from pytorch_distributed_tpu.data.packing import (
+                packed_loss_mask,
+            )
+
+            valid = packed_loss_mask(seg).astype(tok_loss.dtype)
+            loss = jnp.sum(tok_loss * valid) / jnp.maximum(
+                jnp.sum(valid), 1.0
+            )
+        else:
+            loss = jnp.mean(tok_loss)
         return {"loss": loss, "perplexity": jnp.exp(loss)}
 
     return eval_step
