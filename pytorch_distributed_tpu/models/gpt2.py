@@ -71,8 +71,8 @@ class GPT2Block(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, deterministic: bool, decode: bool = False,
-                 cache_len: Optional[int] = None):
+    def __call__(self, x, segment_ids, deterministic: bool,
+                 decode: bool = False, cache_len: Optional[int] = None):
         cfg = self.config
         policy = current_policy()
         ln = lambda name: nn.LayerNorm(  # noqa: E731
@@ -94,7 +94,7 @@ class GPT2Block(nn.Module):
             )
             attn = attention(q, k, v, causal=True, q_offset=offset)
         else:
-            attn = attention(q, k, v, causal=True)
+            attn = attention(q, k, v, causal=True, segment_ids=segment_ids)
         attn = nn.DenseGeneral(
             cfg.hidden_size, axis=(-2, -1), dtype=policy.compute_dtype,
             param_dtype=policy.param_dtype, name="attn_out",
@@ -129,7 +129,8 @@ class GPT2LMHead(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, input_ids, *, train: bool = False,
+    def __call__(self, input_ids, positions=None, *,
+                 segment_ids=None, train: bool = False,
                  decode: bool = False, cache_len: Optional[int] = None,
                  return_hidden: bool = False):
         cfg = self.config
@@ -149,25 +150,38 @@ class GPT2LMHead(nn.Module):
             cfg.n_positions, cfg.hidden_size, param_dtype=policy.param_dtype,
             name="wpe",
         )
+        if segment_ids is not None and decode:
+            raise ValueError(
+                "segment_ids (packed training) and decode (KV cache) are "
+                "mutually exclusive"
+            )
         if decode:
-            from pytorch_distributed_tpu.ops.attention import decode_positions
+            from pytorch_distributed_tpu.ops.attention import (
+                decode_positions,
+            )
 
-            positions = decode_positions(self, S)
-        else:
-            positions = jnp.arange(S)
-        x = wte(input_ids) + wpe(positions[None, :])
+            # ALWAYS advance the cache position counter in decode mode —
+            # a caller prefilling with explicit positions (left padding)
+            # must not desync later positions=None decode steps from the
+            # separately-advancing KV cache_index
+            auto = decode_positions(self, S)[None, :]
+            if positions is None:
+                positions = auto
+        elif positions is None:
+            positions = jnp.arange(S)[None, :]
+        x = wte(input_ids) + wpe(positions)
         x = nn.Dropout(cfg.dropout_rate)(x, deterministic=not train)
         x = x.astype(policy.compute_dtype)
         if cfg.scan_layers:
             from pytorch_distributed_tpu.models.scan import scan_stack
 
             x = scan_stack(
-                GPT2Block, cfg, static_argnums=(1, 2, 3), name="blocks"
-            )(x, not train, decode, cache_len)
+                GPT2Block, cfg, static_argnums=(2, 3, 4), name="blocks"
+            )(x, segment_ids, not train, decode, cache_len)
         else:
             for i in range(cfg.num_layers):
                 x = GPT2Block(cfg, name=f"block{i}")(
-                    x, deterministic=not train, decode=decode,
+                    x, segment_ids, deterministic=not train, decode=decode,
                     cache_len=cache_len,
                 )
         x = nn.LayerNorm(

@@ -142,13 +142,18 @@ class LlamaForCausalLM(nn.Module):
             name="embed",
         )(input_ids).astype(policy.compute_dtype)
         cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
-        if decode and positions is None:
+        if decode:
             from pytorch_distributed_tpu.ops.attention import decode_positions
 
-            # rotary positions continue from the decode offset
-            positions = jnp.broadcast_to(
+            # rotary positions continue from the decode offset; the
+            # counter advances EVEN with explicit positions, so a
+            # padded-prefill caller's later positions=None steps stay in
+            # sync with the KV cache_index
+            auto = jnp.broadcast_to(
                 decode_positions(self, S)[None, :], (B, S)
             )
+            if positions is None:
+                positions = auto
         if segment_ids is not None and decode:
             raise ValueError(
                 "segment_ids (packed training) and decode (KV cache) are "

@@ -45,7 +45,8 @@ def _block_stage_fn(block_module) -> Callable:
 
     def stage_fn(stage_params, x):
         def body(c, p):
-            return block_module.apply({"params": p}, c, True), None
+            # (x, segment_ids=None, deterministic=True)
+            return block_module.apply({"params": p}, c, None, True), None
 
         y, _ = lax.scan(body, x, stage_params)
         return y
@@ -126,6 +127,12 @@ def pipelined_causal_lm_loss_fn(
     """
 
     def loss_fn(params, batch_stats, batch, rng):
+        if "segment_ids" in batch:
+            raise NotImplementedError(
+                "packed batches (segment_ids) are not supported through "
+                "the pipelined loss yet — silently ignoring them would "
+                "attend across document boundaries"
+            )
         ids = batch[ids_key]
         logits = gpt2_pipeline_logits(
             cfg, params, ids, num_microbatches=num_microbatches, axis=axis

@@ -227,3 +227,44 @@ def test_packed_guards_and_eval():
     np.testing.assert_allclose(
         float(ev["loss"]), float(train_loss), rtol=1e-5
     )
+
+
+def test_gpt2_packed_loss_equals_per_document_losses():
+    """Same packed ≡ per-document invariant for GPT-2 (learned positions
+    must reset per document via the positions table)."""
+    import dataclasses
+
+    from pytorch_distributed_tpu.data import pack_documents
+    from pytorch_distributed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from pytorch_distributed_tpu.train import causal_lm_loss_fn
+
+    # dropout off: packed and unpacked runs draw different mask shapes
+    # from the same key, which is noise, not a packing discrepancy
+    cfg = dataclasses.replace(GPT2Config.tiny(), dropout_rate=0.0)
+    model = GPT2LMHead(cfg)
+    rng = np.random.default_rng(2)
+    docs = [
+        list(rng.integers(1, cfg.vocab_size, size=n)) for n in (14, 17)
+    ]
+    packed = pack_documents(docs, 32)
+    assert packed["input_ids"].shape[0] == 1
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 32), jnp.int32)
+    )["params"]
+    loss_fn = causal_lm_loss_fn(model)
+    packed_loss, _ = loss_fn(
+        params, None,
+        {
+            "input_ids": jnp.asarray(packed["input_ids"]),
+            "segment_ids": jnp.asarray(packed["segment_ids"]),
+            "positions": jnp.asarray(packed["positions"]),
+        },
+        jax.random.key(1),
+    )
+    tot, n_tok = 0.0, 0
+    for doc in docs:
+        ids = jnp.asarray(np.asarray(doc, np.int32)[None, :])
+        l, _ = loss_fn(params, None, {"input_ids": ids}, jax.random.key(1))
+        tot += float(l) * (len(doc) - 1)
+        n_tok += len(doc) - 1
+    np.testing.assert_allclose(float(packed_loss), tot / n_tok, rtol=2e-5)
