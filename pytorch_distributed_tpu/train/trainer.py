@@ -225,6 +225,7 @@ class TrainerConfig:
     async_checkpoint: bool = False  # overlap ckpt IO with training
     metrics_path: Optional[str] = None  # JSONL scalar log (rank 0)
     tensorboard_dir: Optional[str] = None  # TB event files (rank 0)
+    max_steps_per_epoch: Optional[int] = None  # bound endless streams
     # failure detection / elastic recovery (train/elastic.py):
     handle_preemption: bool = True  # SIGTERM -> checkpoint -> Preempted
     stall_timeout_s: Optional[float] = None  # watchdog hang detection
@@ -435,19 +436,39 @@ class Trainer:
         self.host_step = step
         try:
             steps_per_epoch = max(len(self.train_loader), 1)
+            if self.config.max_steps_per_epoch:
+                steps_per_epoch = min(
+                    steps_per_epoch, self.config.max_steps_per_epoch
+                )
         except TypeError:
-            # streaming (iterable-dataset) loader: epoch length unknown,
-            # so the epoch/offset position can't be reconstructed — resume
-            # from the restored optimizer step at a fresh stream (the
-            # torch IterableDataset resume story is the same)
-            logger.warning(
-                "resumed a streaming loader at step %d: epoch position "
-                "unknown, restarting the stream from its beginning", step,
-            )
-            self._first_epoch = 0
-            self._resume_skip_batches = 0
-            self._load_best_record()
-            return True
+            if self.config.max_steps_per_epoch:
+                # bounded stream: epochs are exactly max_steps_per_epoch
+                # batches off a fresh pass, so the position IS
+                # reconstructible — for a DETERMINISTIC stream that
+                # yields at least that many batches per pass
+                steps_per_epoch = self.config.max_steps_per_epoch
+                if step % steps_per_epoch:
+                    logger.warning(
+                        "resuming a bounded stream mid-epoch: skipping "
+                        "%d batches assumes the stream replays "
+                        "deterministically — a reshuffling/live source "
+                        "would lose that much fresh data",
+                        step % steps_per_epoch,
+                    )
+            else:
+                # streaming loader with unknown epoch length: the
+                # epoch/offset position can't be reconstructed — resume
+                # from the restored optimizer step at a fresh stream (the
+                # torch IterableDataset resume story is the same)
+                logger.warning(
+                    "resumed a streaming loader at step %d: epoch "
+                    "position unknown, restarting the stream from its "
+                    "beginning", step,
+                )
+                self._first_epoch = 0
+                self._resume_skip_batches = 0
+                self._load_best_record()
+                return True
         self._first_epoch = step // steps_per_epoch
         # mid-epoch checkpoint: fast-forward past the batches this epoch
         # already consumed, so no batch trains twice and total step count
@@ -542,9 +563,18 @@ class Trainer:
         t_last = time.perf_counter()
         steps_since_log = 0
         steps_since_sync = 0
+        taken = 0
+        capped = False
         skip = self._resume_skip_batches
         self._resume_skip_batches = 0
         for batch in self.train_loader:
+            if (
+                cfg.max_steps_per_epoch
+                and taken >= cfg.max_steps_per_epoch
+            ):  # bounds an epoch over an endless stream (IterableDataset)
+                capped = True
+                break
+            taken += 1
             if skip > 0:
                 skip -= 1
                 continue
@@ -618,6 +648,18 @@ class Trainer:
                     self.save_checkpoint(tag=f"step-{step}")
                 else:
                     self.save_checkpoint()
+        if (
+            cfg.max_steps_per_epoch
+            and not capped
+            and taken < cfg.max_steps_per_epoch
+            and getattr(self.train_loader, "iterable", False)
+        ):
+            logger.warning(
+                "stream yielded only %d batches (< max_steps_per_epoch="
+                "%d): resume epoch math assumes FULL epochs and would "
+                "drift for this source",
+                taken, cfg.max_steps_per_epoch,
+            )
 
     def evaluate(self, epoch: int) -> Dict[str, float]:
         sums: Dict[str, float] = {}

@@ -557,6 +557,51 @@ class TestModelEMA:
             assert p.sharding == e.sharding, (path, p.sharding, e.sharding)
 
 
+class TestMaxStepsPerEpoch:
+    class Stream:
+        """Endless deterministic sample stream."""
+
+        def __iter__(self):
+            rng = np.random.default_rng(0)
+            i = 0
+            while True:
+                yield {
+                    "image": rng.normal(size=(16, 16, 3)).astype(
+                        np.float32
+                    ),
+                    "label": np.int32(i % 4),
+                }
+                i += 1
+
+    def _trainer(self, dp8, tmp_path=None, epochs=2):
+        model = tiny_resnet()
+        return Trainer(
+            tiny_image_state(model),
+            dp8,
+            build_train_step(classification_loss_fn(model)),
+            DataLoader(self.Stream(), 16, sharding=dp8.batch_sharding()),
+            config=TrainerConfig(
+                epochs=epochs, log_every=0, max_steps_per_epoch=3,
+                handle_preemption=False,
+                ckpt_dir=str(tmp_path) if tmp_path else None,
+            ),
+        )
+
+    def test_endless_stream_bounded_epochs(self, dp8):
+        tr = self._trainer(dp8)
+        tr.fit()  # must RETURN (3 steps x 2 epochs), not spin forever
+        assert tr.host_step == 6
+
+    def test_resume_position_reconstructed(self, dp8, tmp_path):
+        tr = self._trainer(dp8, tmp_path, epochs=1)
+        tr.fit()  # saves at epoch end, step 3
+        tr2 = self._trainer(dp8, tmp_path, epochs=2)
+        assert tr2.restore_checkpoint()
+        assert tr2._first_epoch == 1 and tr2._resume_skip_batches == 0
+        tr2.fit()
+        assert tr2.host_step == 6
+
+
 def _scalar_of(v):
     """TB 2.x writers migrate simple_value scalars to rank-0 tensors."""
     if v.HasField("simple_value"):
