@@ -67,6 +67,9 @@ def parse_args(argv=None):
     p.add_argument("--remat-policy", choices=("full", "dots",
                    "dots_no_batch"), default="full",
                    help="what remat saves (implies --remat when not full)")
+    p.add_argument("--pack", action="store_true",
+                   help="pack paragraph documents into fixed rows with "
+                        "segment-masked attention (needs --text-file)")
     p.add_argument("--vocab-chunk", type=int, default=None,
                    help="chunked-vocab loss: never materialize [B,S,V] "
                         "logits (ops/lm_loss.py); ZeRO-1 path only")
@@ -94,6 +97,13 @@ def main(argv=None):
             "--vocab-chunk is not supported with --pp > 1: the "
             "pipelined loss builds its own head projection; drop one "
             "of the flags"
+        )
+    if args.pack and not args.text_file:
+        raise SystemExit("--pack needs --text-file (documents to pack)")
+    if args.pack and (args.vocab_chunk is not None or args.pp > 1):
+        raise SystemExit(
+            "--pack is not combinable with --vocab-chunk or --pp yet "
+            "(the chunked and pipelined losses refuse packed batches)"
         )
     ptd.seed_all(args.seed)
     ptd.init_process_group(
@@ -126,17 +136,50 @@ def main(argv=None):
         )
         # shrink the model's vocab to what the corpus actually needs
         cfg = dataclasses.replace(cfg, vocab_size=tokenizer.vocab_size)
-        ds = TokenizedTextDataset(
-            corpus, tokenizer, seq_len, stride=seq_len // 2,
-            max_windows=(
-                args.steps_per_epoch * args.batch_size
-                if args.steps_per_epoch else None
-            ),
-        )
-        log_rank0(
-            "text corpus: %d tokens vocab=%d windows=%d",
-            ds.num_tokens, tokenizer.vocab_size, len(ds),
-        )
+        if args.pack:
+            # paragraph-level documents packed into fixed rows with
+            # segment-masked attention — no FLOPs on sliding-window
+            # overlap, no cross-document attention (data/packing.py)
+            from pytorch_distributed_tpu.data import (
+                ArrayDataset,
+                pack_documents,
+            )
+
+            docs = [
+                tokenizer.encode(p)
+                for p in corpus.split("\n\n") if p.strip()
+            ]
+            packed = pack_documents(docs, seq_len)
+            if args.steps_per_epoch:  # same data cap as the window path
+                keep = args.steps_per_epoch * args.batch_size
+                packed = {k: v[:keep] for k, v in packed.items()}
+            n_rows = packed["input_ids"].shape[0]
+            if n_rows < args.batch_size:
+                raise SystemExit(
+                    f"corpus packs into only {n_rows} row(s) of "
+                    f"{seq_len} — fewer than --batch-size "
+                    f"{args.batch_size}, so the drop-last loader would "
+                    f"train zero steps; use a larger corpus or smaller "
+                    f"batch/seq-len"
+                )
+            ds = ArrayDataset(**packed)
+            log_rank0(
+                "packed corpus: %d documents into %d rows of %d "
+                "(vocab=%d)", len(docs), n_rows,
+                seq_len, tokenizer.vocab_size,
+            )
+        else:
+            ds = TokenizedTextDataset(
+                corpus, tokenizer, seq_len, stride=seq_len // 2,
+                max_windows=(
+                    args.steps_per_epoch * args.batch_size
+                    if args.steps_per_epoch else None
+                ),
+            )
+            log_rank0(
+                "text corpus: %d tokens vocab=%d windows=%d",
+                ds.num_tokens, tokenizer.vocab_size, len(ds),
+            )
     else:
         n = (args.steps_per_epoch or 100) * args.batch_size
         ds = SyntheticTextDataset(
