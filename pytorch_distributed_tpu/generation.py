@@ -25,6 +25,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -95,6 +96,7 @@ def generate(
     rng: Optional[jax.Array] = None,
     eos_id: Optional[int] = None,
     pad_id: int = 0,
+    prompt_mask: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Generate ``max_new_tokens`` continuations of ``prompt_ids`` [B, P].
 
@@ -102,6 +104,13 @@ def generate(
     padded with ``pad_id`` after it. Jit-compatible end to end — wrap in
     ``jax.jit(..., static_argnums=...)`` or call inside a jitted fn; the
     decode loop is a single ``lax.scan`` either way.
+
+    ``prompt_mask`` [B, P] (True = real token) enables RAGGED batches via
+    LEFT padding — the HF ``generate(attention_mask=...)`` idiom: pads
+    occupy the leading slots, every row's last real token sits at slot
+    P-1, positions count real tokens only, and cache slots holding pads
+    are masked out of every attention step. Continuations match the
+    unpadded per-prompt results.
     """
     B, P = prompt_ids.shape
     if max_new_tokens < 1:
@@ -124,10 +133,47 @@ def generate(
     # HBM and a proportionally wider attention every step)
     cache_len = P + max_new_tokens
 
+    extra = {}
+    prompt_lens = None
+    if prompt_mask is not None:
+        if prompt_mask.shape != (B, P):
+            raise ValueError(
+                f"prompt_mask must be {(B, P)}, got {prompt_mask.shape}"
+            )
+        prompt_mask = prompt_mask.astype(jnp.bool_)
+        if not isinstance(prompt_mask, jax.core.Tracer):
+            # eager-mode upfront refusal (this function's style): a
+            # RIGHT-padded mask would silently sample from a pad-token
+            # query — real tokens must be one contiguous right-aligned run
+            m = np.asarray(prompt_mask).astype(np.int8)
+            if not (np.diff(m, axis=1) >= 0).all():
+                raise ValueError(
+                    "prompt_mask must be LEFT-padded: each row one "
+                    "contiguous run of real tokens ending at the last "
+                    "slot (HF left-padding for decoder-only generation)"
+                )
+        # left padding contract: every real token is RIGHT-aligned, so
+        # each row's final slot holds its last real token (where the
+        # first sampled logits come from)
+        # positions count real tokens only: pads share position 0 (their
+        # K/V are masked out of attention, so their rope/wpe is inert)
+        positions = jnp.maximum(
+            jnp.cumsum(prompt_mask.astype(jnp.int32), axis=1) - 1, 0
+        )
+        prompt_lens = positions[:, -1] + 1  # real tokens per row
+        # cache-slot validity for the WHOLE generation: prompt slots
+        # follow the mask; future decode slots are valid (the causal
+        # q_offset masking hides the not-yet-written tail)
+        kv_mask = jnp.concatenate(
+            [prompt_mask,
+             jnp.ones((B, cache_len - P), jnp.bool_)], axis=1,
+        )
+        extra = {"positions": positions, "kv_mask": kv_mask}
+
     # prefill: one full-width pass fills every layer's cache
     logits, state = model.apply(
         {"params": params}, prompt_ids, decode=True, cache_len=cache_len,
-        mutable=["cache"],
+        mutable=["cache"], **extra,
     )
     cache = state["cache"]
     rng, sub = jax.random.split(rng)
@@ -140,14 +186,21 @@ def generate(
         else jnp.zeros((B,), jnp.bool_)
     )
 
-    def step(carry, _):
+    def step(carry, t):
         cache, tok, rng, done = carry
+        dec_extra = {}
+        if prompt_lens is not None:
+            # per-row positions continue each row's REAL length, not the
+            # padded slot index
+            dec_extra["positions"] = (prompt_lens + t)[:, None]
+            dec_extra["kv_mask"] = extra["kv_mask"]
         logits, state = model.apply(
             {"params": params, "cache": cache},
             tok[:, None],
             decode=True,
             cache_len=cache_len,
             mutable=["cache"],
+            **dec_extra,
         )
         rng, sub = jax.random.split(rng)
         nxt = sample_logits(
@@ -159,8 +212,11 @@ def generate(
             done = done | (nxt == eos_id)
         return (state["cache"], nxt, rng, done), nxt
 
+    # scan step t consumes continuation token #t+1, whose position is
+    # (real length) + t
     (cache, _, _, _), rest = lax.scan(
-        step, (cache, tok, rng, done), None, length=max_new_tokens - 1
+        step, (cache, tok, rng, done),
+        jnp.arange(max_new_tokens - 1), length=max_new_tokens - 1,
     )
     out = jnp.concatenate(
         [prompt_ids, tok[:, None], rest.T.astype(prompt_ids.dtype)], axis=1

@@ -71,7 +71,7 @@ class GPT2Block(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, segment_ids, deterministic: bool,
+    def __call__(self, x, segment_ids, kv_mask, deterministic: bool,
                  decode: bool = False, cache_len: Optional[int] = None):
         cfg = self.config
         policy = current_policy()
@@ -92,7 +92,9 @@ class GPT2Block(nn.Module):
             k, v, offset = decode_cache(
                 self, k, v, cache_len or cfg.n_positions
             )
-            attn = attention(q, k, v, causal=True, q_offset=offset)
+            attn = attention(
+                q, k, v, causal=True, q_offset=offset, mask=kv_mask
+            )
         else:
             attn = attention(q, k, v, causal=True, segment_ids=segment_ids)
         attn = nn.DenseGeneral(
@@ -130,7 +132,7 @@ class GPT2LMHead(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, *,
-                 segment_ids=None, train: bool = False,
+                 segment_ids=None, kv_mask=None, train: bool = False,
                  decode: bool = False, cache_len: Optional[int] = None,
                  return_hidden: bool = False):
         cfg = self.config
@@ -155,6 +157,11 @@ class GPT2LMHead(nn.Module):
                 "segment_ids (packed training) and decode (KV cache) are "
                 "mutually exclusive"
             )
+        if kv_mask is not None and not decode:
+            raise ValueError(
+                "kv_mask is for KV-cache decode (left-padded prompts); "
+                "training masks go through the loss/segment machinery"
+            )
         if decode:
             from pytorch_distributed_tpu.ops.attention import (
                 decode_positions,
@@ -176,13 +183,13 @@ class GPT2LMHead(nn.Module):
             from pytorch_distributed_tpu.models.scan import scan_stack
 
             x = scan_stack(
-                GPT2Block, cfg, static_argnums=(2, 3, 4), name="blocks"
-            )(x, segment_ids, not train, decode, cache_len)
+                GPT2Block, cfg, static_argnums=(3, 4, 5), name="blocks"
+            )(x, segment_ids, kv_mask, not train, decode, cache_len)
         else:
             for i in range(cfg.num_layers):
                 x = GPT2Block(cfg, name=f"block{i}")(
-                    x, segment_ids, deterministic=not train, decode=decode,
-                    cache_len=cache_len,
+                    x, segment_ids, kv_mask, deterministic=not train,
+                    decode=decode, cache_len=cache_len,
                 )
         x = nn.LayerNorm(
             epsilon=cfg.layer_norm_eps, dtype=policy.compute_dtype,

@@ -76,7 +76,7 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin, positions, segment_ids,
+    def __call__(self, x, cos, sin, positions, segment_ids, kv_mask,
                  deterministic: bool, decode: bool = False,
                  cache_len: Optional[int] = None):
         cfg = self.config
@@ -97,7 +97,9 @@ class LlamaBlock(nn.Module):
             k, v, offset = decode_cache(
                 self, k, v, cache_len or cfg.max_seq_len
             )
-            attn = attention(q, k, v, causal=True, q_offset=offset)
+            attn = attention(
+                q, k, v, causal=True, q_offset=offset, mask=kv_mask
+            )
         else:
             attn = attention(
                 q, k, v, causal=True, segment_ids=segment_ids
@@ -125,6 +127,7 @@ class LlamaForCausalLM(nn.Module):
         positions: Optional[jnp.ndarray] = None,
         *,
         segment_ids: Optional[jnp.ndarray] = None,
+        kv_mask: Optional[jnp.ndarray] = None,
         train: bool = False,
         decode: bool = False,
         cache_len: Optional[int] = None,
@@ -159,17 +162,22 @@ class LlamaForCausalLM(nn.Module):
                 "segment_ids (packed training) and decode (KV cache) are "
                 "mutually exclusive"
             )
+        if kv_mask is not None and not decode:
+            raise ValueError(
+                "kv_mask is for KV-cache decode (left-padded prompts); "
+                "training masks go through the loss/segment machinery"
+            )
         if cfg.scan_layers:
             from pytorch_distributed_tpu.models.scan import scan_stack
 
             x = scan_stack(
-                LlamaBlock, cfg, static_argnums=(5, 6, 7), name="layers"
-            )(x, cos, sin, positions, segment_ids, not train, decode,
-              cache_len)
+                LlamaBlock, cfg, static_argnums=(6, 7, 8), name="layers"
+            )(x, cos, sin, positions, segment_ids, kv_mask, not train,
+              decode, cache_len)
         else:
             for i in range(cfg.num_layers):
                 x = LlamaBlock(cfg, name=f"layer{i}")(
-                    x, cos, sin, positions, segment_ids,
+                    x, cos, sin, positions, segment_ids, kv_mask,
                     deterministic=not train,
                     decode=decode, cache_len=cache_len,
                 )

@@ -211,14 +211,15 @@ def attention(
     # python 0 qualifies for the flash / sequence-parallel fast paths
     static_zero_offset = isinstance(q_offset, int) and q_offset == 0
     seq_axis, _ = sequence_parallel_mode()
+    if seq_axis is not None and not static_zero_offset:
+        # decode (traced offset) under sequence parallelism would
+        # silently attend only to the local KV shard — fail loudly,
+        # masked (kv_mask/prompt_mask) or not
+        raise NotImplementedError(
+            "KV-cache decode is not supported inside sequence-parallel "
+            "mode; disable_sequence_parallel() around generation"
+        )
     if seq_axis is not None and mask is None:
-        if not static_zero_offset:
-            # decode (traced offset) under sequence parallelism would
-            # silently attend only to the local KV shard — fail loudly
-            raise NotImplementedError(
-                "KV-cache decode is not supported inside sequence-parallel "
-                "mode; disable_sequence_parallel() around generation"
-            )
         if segment_ids is not None:
             # sharded ring/all-to-all attention would need the segment
             # table of REMOTE shards; silently ignoring it would leak

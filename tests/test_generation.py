@@ -236,3 +236,55 @@ def test_overflowing_max_positions_raises(gpt2):
         generate(model, params, ids, max_new_tokens=42, temperature=0.0)
     with pytest.raises(ValueError, match=">= 1"):
         generate(model, params, ids, max_new_tokens=0, temperature=0.0)
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_left_padded_ragged_batch_matches_unpadded(family):
+    """prompt_mask (HF attention_mask idiom): a left-padded ragged batch
+    must produce exactly the continuations each prompt gets alone —
+    positions, cache masking, and prefill-logit selection all in one."""
+    import numpy as np
+
+    if family == "gpt2":
+        from pytorch_distributed_tpu.models.gpt2 import (
+            GPT2Config as Cfg, GPT2LMHead as Model,
+        )
+    else:
+        from pytorch_distributed_tpu.models.llama import (
+            LlamaConfig as Cfg, LlamaForCausalLM as Model,
+        )
+    cfg = Cfg.tiny()
+    model = Model(cfg)
+    rng = np.random.default_rng(5)
+    p1 = rng.integers(1, cfg.vocab_size, size=5).astype(np.int32)
+    p2 = rng.integers(1, cfg.vocab_size, size=9).astype(np.int32)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 9), jnp.int32)
+    )["params"]
+
+    NEW = 6
+    solo = [
+        np.asarray(
+            generate(
+                model, params, jnp.asarray(p[None, :]),
+                max_new_tokens=NEW, temperature=0.0,
+            )
+        )[0, len(p):]
+        for p in (p1, p2)
+    ]
+
+    P = 9
+    ids = np.zeros((2, P), np.int32)
+    mask = np.zeros((2, P), bool)
+    ids[0, P - 5:] = p1
+    mask[0, P - 5:] = True
+    ids[1, :] = p2
+    mask[1, :] = True
+    out = np.asarray(
+        generate(
+            model, params, jnp.asarray(ids), max_new_tokens=NEW,
+            temperature=0.0, prompt_mask=jnp.asarray(mask),
+        )
+    )
+    np.testing.assert_array_equal(out[0, P:], solo[0])
+    np.testing.assert_array_equal(out[1, P:], solo[1])
