@@ -32,6 +32,7 @@ def chunked_softmax_cross_entropy(
     chunk_size: int = 8192,
     label_smoothing: float = 0.0,
     vocab_axis: int = 0,
+    weights=None,
 ):
     """Mean CE of the projected logits vs integer ``labels``.
 
@@ -44,6 +45,9 @@ def chunked_softmax_cross_entropy(
     full-size copy held live across the scan — only per-chunk slices are
     ever formed, and they are cast to ``hidden.dtype`` chunk-wise.
     ``labels``: [N] int32/int64 in [0, V).
+    ``weights``: optional [N] per-token loss weights (e.g. a packed-batch
+    validity mask) — the result becomes the weighted mean
+    ``sum(w*ce)/max(sum(w), 1)``.
 
     Equivalent (to f32 numerics) to
     ``optax.softmax_cross_entropy_with_integer_labels(h @ E.T, labels)``
@@ -126,6 +130,9 @@ def chunked_softmax_cross_entropy(
         per_token = lse - (1.0 - eps) * lab - eps * tot / v
     else:
         per_token = lse - lab
+    if weights is not None:
+        w = weights.astype(per_token.dtype)
+        return jnp.sum(per_token * w) / jnp.maximum(jnp.sum(w), 1.0)
     return jnp.mean(per_token)
 
 
@@ -137,11 +144,22 @@ def causal_lm_chunked_loss(
     chunk_size: int = 8192,
     label_smoothing: float = 0.0,
     vocab_axis: int = 0,
+    segment_ids=None,
 ):
-    """Next-token chunked CE on [B, S, D] hiddens (shift-by-one)."""
+    """Next-token chunked CE on [B, S, D] hiddens (shift-by-one).
+
+    ``segment_ids`` (packed batches, data/packing.py): targets crossing a
+    document boundary or landing on padding are masked out and the mean
+    runs over valid targets only — matching the full-logits packed loss.
+    """
     b, s, d = hidden.shape
     h = hidden[:, :-1].reshape(b * (s - 1), d)
     labels = input_ids[:, 1:].reshape(b * (s - 1))
+    weights = None
+    if segment_ids is not None:
+        from pytorch_distributed_tpu.data.packing import packed_loss_mask
+
+        weights = packed_loss_mask(segment_ids).reshape(b * (s - 1))
     return chunked_softmax_cross_entropy(
         h,
         embedding,
@@ -149,4 +167,5 @@ def causal_lm_chunked_loss(
         chunk_size=chunk_size,
         label_smoothing=label_smoothing,
         vocab_axis=vocab_axis,
+        weights=weights,
     )

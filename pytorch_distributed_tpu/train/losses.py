@@ -81,7 +81,8 @@ def classification_loss_fn(
     return loss_fn
 
 
-def _chunked_lm_loss(model, params, ids, chunk_size, *, train, rng=None):
+def _chunked_lm_loss(model, params, ids, chunk_size, *, train, rng=None,
+                     segment_ids=None, positions=None):
     """Shared train/eval body of the chunked-vocab LM loss: apply with
     return_hidden, project through the native-layout head chunk-wise.
 
@@ -92,6 +93,10 @@ def _chunked_lm_loss(model, params, ids, chunk_size, *, train, rng=None):
     from pytorch_distributed_tpu.runtime.precision import current_policy
 
     kwargs = {"rngs": {"dropout": rng}} if train else {}
+    if segment_ids is not None:
+        kwargs["segment_ids"] = segment_ids
+        if positions is not None:
+            kwargs["positions"] = positions
     hidden = model.apply(
         {"params": params}, ids, train=train, return_hidden=True, **kwargs
     )
@@ -102,6 +107,7 @@ def _chunked_lm_loss(model, params, ids, chunk_size, *, train, rng=None):
         ids,
         chunk_size=chunk_size,
         vocab_axis=vocab_axis,
+        segment_ids=segment_ids,
     )
 
 
@@ -153,15 +159,11 @@ def causal_lm_loss_fn(
         )
 
     def chunked_loss_fn(params, batch_stats, batch, rng):
-        if "segment_ids" in batch:
-            raise NotImplementedError(
-                "packed batches (segment_ids) + chunked-vocab loss not "
-                "combined yet — silently ignoring the segments would "
-                "train across document boundaries"
-            )
         loss = _chunked_lm_loss(
             model, params, batch[ids_key], vocab_chunk_size,
             train=True, rng=rng,
+            segment_ids=batch.get("segment_ids"),
+            positions=batch.get("positions"),
         )
         return loss, {"metrics": {"loss": loss}, "batch_stats": batch_stats}
 
@@ -263,13 +265,9 @@ def causal_lm_eval_step(
         ids = batch[ids_key]
         seg = batch.get("segment_ids")
         if vocab_chunk_size is not None:
-            if seg is not None:
-                raise NotImplementedError(
-                    "packed batches (segment_ids) + chunked-vocab eval "
-                    "not combined yet"
-                )
             loss = _chunked_lm_loss(
-                model, state.params, ids, vocab_chunk_size, train=False
+                model, state.params, ids, vocab_chunk_size, train=False,
+                segment_ids=seg, positions=batch.get("positions"),
             )
             return {"loss": loss, "perplexity": jnp.exp(loss)}
         extra = {}

@@ -100,10 +100,10 @@ def main(argv=None):
         )
     if args.pack and not args.text_file:
         raise SystemExit("--pack needs --text-file (documents to pack)")
-    if args.pack and (args.vocab_chunk is not None or args.pp > 1):
+    if args.pack and args.pp > 1:
         raise SystemExit(
-            "--pack is not combinable with --vocab-chunk or --pp yet "
-            "(the chunked and pipelined losses refuse packed batches)"
+            "--pack is not combinable with --pp yet (the pipelined loss "
+            "refuses packed batches); --pack + --vocab-chunk is supported"
         )
     ptd.seed_all(args.seed)
     ptd.init_process_group(

@@ -174,9 +174,10 @@ def test_packed_loss_equals_per_document_losses():
     )
 
 
-def test_packed_guards_and_eval():
-    """Chunked paths refuse packed batches; packed eval matches packed
-    train loss on the same batch (no dropout in tiny config eval)."""
+def test_packed_eval_and_chunked_equivalence():
+    """Packed eval matches packed train loss, and the chunked-vocab path
+    (the 8B memory configuration) reproduces the full-logits packed loss
+    exactly."""
     import numpy as np
 
     from pytorch_distributed_tpu.data import pack_documents
@@ -205,17 +206,6 @@ def test_packed_guards_and_eval():
         jax.random.key(0), jnp.zeros((1, 32), jnp.int32)
     )["params"]
 
-    with pytest.raises(NotImplementedError, match="segment_ids"):
-        causal_lm_loss_fn(model, vocab_chunk_size=64)(
-            params, None, batch, jax.random.key(0)
-        )
-    with pytest.raises(NotImplementedError, match="segment_ids"):
-        import types
-
-        causal_lm_eval_step(model, vocab_chunk_size=64)(
-            types.SimpleNamespace(params=params), batch
-        )
-
     train_loss, _ = causal_lm_loss_fn(model)(
         params, None, batch, jax.random.key(0)
     )
@@ -226,6 +216,20 @@ def test_packed_guards_and_eval():
     )
     np.testing.assert_allclose(
         float(ev["loss"]), float(train_loss), rtol=1e-5
+    )
+    # chunked-vocab path handles packed batches too (the real 8B config):
+    # must equal the full-logits packed loss to f32 numerics
+    chunked_loss, _ = causal_lm_loss_fn(model, vocab_chunk_size=64)(
+        params, None, batch, jax.random.key(0)
+    )
+    np.testing.assert_allclose(
+        float(chunked_loss), float(train_loss), rtol=2e-5
+    )
+    ev_c = causal_lm_eval_step(model, vocab_chunk_size=64)(
+        types.SimpleNamespace(params=params), batch
+    )
+    np.testing.assert_allclose(
+        float(ev_c["loss"]), float(train_loss), rtol=2e-5
     )
 
 
