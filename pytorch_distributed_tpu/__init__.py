@@ -53,6 +53,8 @@ from pytorch_distributed_tpu.runtime.distributed import (
     broadcast,
     broadcast_object_list,
     scatter_object_list,
+    all_gather_into_tensor,
+    reduce_scatter_tensor,
     barrier,
     monitored_barrier,
     new_group,
@@ -103,6 +105,8 @@ __all__ = [
     "broadcast",
     "broadcast_object_list",
     "scatter_object_list",
+    "all_gather_into_tensor",
+    "reduce_scatter_tensor",
     "barrier",
     "monitored_barrier",
     "new_group",

@@ -524,6 +524,19 @@ def reduce_scatter(x, op: ReduceOp = ReduceOp.SUM, *, axis=None):
     return fn(jax.device_put(x, NamedSharding(g.mesh, P(axes))))
 
 
+def all_gather_into_tensor(x, *, axis=None, group=None):
+    """torch >= 1.13 spelling of :func:`all_gather` (the flat-tensor
+    variant); the SPMD facade's all_gather already returns one stacked
+    array, so they coincide."""
+    return all_gather(x, axis=axis, group=group)
+
+
+def reduce_scatter_tensor(x, op: ReduceOp = ReduceOp.SUM, *, axis=None):
+    """torch >= 1.13 spelling of :func:`reduce_scatter` (the flat-tensor
+    variant)."""
+    return reduce_scatter(x, op, axis=axis)
+
+
 def broadcast(x, src: int = 0, *, axis=None, group=None):
     """Replicate participant ``src``'s slice to everyone (shape x[0]).
 
