@@ -525,15 +525,40 @@ def reduce_scatter(x, op: ReduceOp = ReduceOp.SUM, *, axis=None):
 
 
 def all_gather_into_tensor(x, *, axis=None, group=None):
-    """torch >= 1.13 spelling of :func:`all_gather` (the flat-tensor
-    variant); the SPMD facade's all_gather already returns one stacked
-    array, so they coincide."""
-    return all_gather(x, axis=axis, group=group)
+    """torch >= 1.13 flat-tensor all_gather: participants' tensors are
+    CONCATENATED along dim 0 — :func:`all_gather` stacks them on a new
+    leading dim; this flattens the first two dims to match torch."""
+    g = all_gather(x, axis=axis, group=group)
+    if g.ndim <= 1:
+        return g  # scalar participants: stacked == concatenated
+    return g.reshape((-1,) + tuple(g.shape[2:]))
 
 
 def reduce_scatter_tensor(x, op: ReduceOp = ReduceOp.SUM, *, axis=None):
-    """torch >= 1.13 spelling of :func:`reduce_scatter` (the flat-tensor
-    variant)."""
+    """torch >= 1.13 flat-tensor reduce_scatter.
+
+    Under hostring (real multi-process ranks) this is torch-exact: this
+    rank's flat ``[world*n, ...]`` input returns its reduced ``[n, ...]``
+    chunk. Under single-controller SPMD it reduces to
+    :func:`reduce_scatter`'s facade semantics — the returned array holds
+    EVERY chunk (reduced, sharded over the axis), this module's usual
+    "SPMD produces the value everywhere" convention.
+    """
+    g = _group()
+    if g.ring is not None:
+        arr = np.asarray(x)
+        w = g.ring.world_size
+        if arr.shape[0] % w:
+            raise ValueError(
+                f"reduce_scatter_tensor input dim 0 ({arr.shape[0]}) must "
+                f"divide by world_size {w}"
+            )
+        return jnp.asarray(
+            g.ring.reduce_scatter(
+                arr.reshape((w, arr.shape[0] // w) + arr.shape[1:]),
+                op=op.value,
+            )
+        )
     return reduce_scatter(x, op, axis=axis)
 
 

@@ -386,6 +386,16 @@ def facade_worker(rank: int, world: int, name: str, q) -> None:
         assert np.all(np.asarray(out) == expect), out
         g = ptd.all_gather(np.array([rank], np.int32))
         assert list(np.asarray(g).ravel()) == list(range(world))
+        # torch>=1.13 flat variants: concatenation / per-rank chunk
+        flat = ptd.all_gather_into_tensor(
+            np.array([rank * 2, rank * 2 + 1], np.int32)
+        )
+        assert list(np.asarray(flat)) == list(range(2 * world)), flat
+        rs = ptd.reduce_scatter_tensor(
+            np.arange(world * 2, dtype=np.float32)
+        )
+        want = np.array([rank * 2, rank * 2 + 1], np.float32) * world
+        assert np.array_equal(np.asarray(rs), want), (rs, want)
         b = ptd.broadcast(np.array([rank * 10.0], np.float32), src=2)
         assert float(np.asarray(b)[0]) == 20.0
         # object collectives: variable-size payloads per rank

@@ -64,6 +64,18 @@ class TestProcessGroupFacade:
         out = ptd.all_reduce(x)
         np.testing.assert_allclose(np.asarray(out), [36.0])
 
+    def test_flat_tensor_collective_variants(self):
+        """torch>=1.13 all_gather_into_tensor (concat, not stack) and
+        reduce_scatter_tensor under single-controller SPMD."""
+        ptd.init_process_group()
+        x = np.arange(16, dtype=np.float32).reshape(8, 2)
+        flat = np.asarray(ptd.all_gather_into_tensor(x))
+        assert flat.shape == (16,)  # 8 participants x 2 elems concatenated
+        np.testing.assert_array_equal(flat, np.arange(16, dtype=np.float32))
+        rs = ptd.reduce_scatter_tensor(np.ones((8, 8), np.float32))
+        assert np.asarray(rs).shape == (8,)
+        np.testing.assert_array_equal(np.asarray(rs), np.full(8, 8.0))
+
     def test_new_group_subset_collectives(self):
         """torch.distributed.new_group: collectives over a rank subset
         (single-controller semantics: member rows of the participant dim)."""
