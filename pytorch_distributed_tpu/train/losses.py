@@ -82,13 +82,17 @@ def classification_loss_fn(
 
 
 def _chunked_lm_loss(model, params, ids, chunk_size, *, train, rng=None,
-                     segment_ids=None, positions=None):
+                     segment_ids=None, positions=None,
+                     moe_aux_weight: float = 0.0):
     """Shared train/eval body of the chunked-vocab LM loss: apply with
     return_hidden, project through the native-layout head chunk-wise.
 
     hidden runs in compute dtype (bf16 MXU) with f32 accumulation in the
     op; the projection stays in its native layout/dtype and is sliced+cast
-    per chunk — same numerics as the full-logits path."""
+    per chunk — same numerics as the full-logits path.
+
+    Returns ``(ce_loss, aux_loss_or_None)`` — aux is the weighted MoE
+    load-balance sum when ``moe_aux_weight > 0``."""
     from pytorch_distributed_tpu.ops.lm_loss import causal_lm_chunked_loss
     from pytorch_distributed_tpu.runtime.precision import current_policy
 
@@ -97,11 +101,22 @@ def _chunked_lm_loss(model, params, ids, chunk_size, *, train, rng=None,
         kwargs["segment_ids"] = segment_ids
         if positions is not None:
             kwargs["positions"] = positions
-    hidden = model.apply(
-        {"params": params}, ids, train=train, return_hidden=True, **kwargs
-    )
+    aux = None
+    if moe_aux_weight > 0.0:
+        from pytorch_distributed_tpu.ops.moe import collect_aux_loss
+
+        hidden, inter = model.apply(
+            {"params": params}, ids, train=train, return_hidden=True,
+            mutable=["intermediates"], **kwargs,
+        )
+        aux = collect_aux_loss(inter["intermediates"], weight=moe_aux_weight)
+    else:
+        hidden = model.apply(
+            {"params": params}, ids, train=train, return_hidden=True,
+            **kwargs,
+        )
     weight, vocab_axis = _lm_projection_weight(params)
-    return causal_lm_chunked_loss(
+    ce = causal_lm_chunked_loss(
         hidden.astype(current_policy().compute_dtype),
         weight,
         ids,
@@ -109,6 +124,7 @@ def _chunked_lm_loss(model, params, ids, chunk_size, *, train, rng=None,
         vocab_axis=vocab_axis,
         segment_ids=segment_ids,
     )
+    return ce, aux
 
 
 def _lm_projection_weight(params):
@@ -145,7 +161,7 @@ def causal_lm_loss_fn(
     ``vocab_chunk_size`` switches to the chunked-vocab loss
     (ops/lm_loss.py): the model is applied with ``return_hidden=True`` and
     the [B,S,V] logits are never materialized — the large-vocab (Llama-3)
-    memory fix. Requires moe_aux_weight == 0 for now.
+    memory fix; composes with ``moe_aux_weight`` and packed batches.
 
     Packed batches: when the batch carries ``segment_ids`` (and
     optionally ``positions``, both from ``data.pack_documents``) they are
@@ -153,19 +169,20 @@ def causal_lm_loss_fn(
     is masked at document boundaries and padding, averaged over valid
     targets only.
     """
-    if vocab_chunk_size is not None and moe_aux_weight > 0.0:
-        raise NotImplementedError(
-            "chunked loss + MoE aux collection not combined yet"
-        )
-
     def chunked_loss_fn(params, batch_stats, batch, rng):
-        loss = _chunked_lm_loss(
+        ce, aux = _chunked_lm_loss(
             model, params, batch[ids_key], vocab_chunk_size,
             train=True, rng=rng,
             segment_ids=batch.get("segment_ids"),
             positions=batch.get("positions"),
+            moe_aux_weight=moe_aux_weight,
         )
-        return loss, {"metrics": {"loss": loss}, "batch_stats": batch_stats}
+        metrics = {"loss": ce}
+        loss = ce
+        if aux is not None:
+            metrics["moe_aux_loss"] = aux
+            loss = ce + aux
+        return loss, {"metrics": metrics, "batch_stats": batch_stats}
 
     if vocab_chunk_size is not None:
         return chunked_loss_fn
@@ -265,7 +282,7 @@ def causal_lm_eval_step(
         ids = batch[ids_key]
         seg = batch.get("segment_ids")
         if vocab_chunk_size is not None:
-            loss = _chunked_lm_loss(
+            loss, _ = _chunked_lm_loss(
                 model, state.params, ids, vocab_chunk_size, train=False,
                 segment_ids=seg, positions=batch.get("positions"),
             )

@@ -98,3 +98,25 @@ def test_moe_gpt2_decode_generates():
         nxt = jnp.argmax(logits[:, -1], axis=-1).astype(cur.dtype)
         cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
+
+
+def test_moe_chunked_loss_matches_full():
+    """MoE aux + chunked-vocab loss combined: CE and aux must both equal
+    the full-logits MoE path."""
+    from pytorch_distributed_tpu.train import causal_lm_loss_fn
+
+    ptd.init_process_group(mesh_spec=MeshSpec(dp=-1))
+    model, params, ids = _init()
+    batch = {"input_ids": np.asarray(ids)}
+    full, faux = causal_lm_loss_fn(model, moe_aux_weight=0.01)(
+        params, None, batch, jax.random.key(0)
+    )
+    chunked, caux = causal_lm_loss_fn(
+        model, moe_aux_weight=0.01, vocab_chunk_size=32
+    )(params, None, batch, jax.random.key(0))
+    np.testing.assert_allclose(float(chunked), float(full), rtol=2e-5)
+    np.testing.assert_allclose(
+        float(caux["metrics"]["moe_aux_loss"]),
+        float(faux["metrics"]["moe_aux_loss"]),
+        rtol=2e-5,
+    )
