@@ -81,6 +81,31 @@ def classification_loss_fn(
     return loss_fn
 
 
+def _apply_with_moe_aux(model, params, ids, *, train, rng=None,
+                        moe_aux_weight: float = 0.0, return_hidden=False,
+                        extra=None):
+    """Apply an LM, collecting the weighted MoE load-balance aux when
+    requested. Returns ``(output, aux_or_None)`` — the single definition
+    both the full-logits and chunked loss paths share, so they cannot
+    diverge."""
+    kwargs = dict(extra or {})
+    if train:
+        kwargs["rngs"] = {"dropout": rng}
+    if return_hidden:
+        kwargs["return_hidden"] = True
+    if moe_aux_weight > 0.0:
+        from pytorch_distributed_tpu.ops.moe import collect_aux_loss
+
+        out, inter = model.apply(
+            {"params": params}, ids, train=train,
+            mutable=["intermediates"], **kwargs,
+        )
+        return out, collect_aux_loss(
+            inter["intermediates"], weight=moe_aux_weight
+        )
+    return model.apply({"params": params}, ids, train=train, **kwargs), None
+
+
 def _chunked_lm_loss(model, params, ids, chunk_size, *, train, rng=None,
                      segment_ids=None, positions=None,
                      moe_aux_weight: float = 0.0):
@@ -96,25 +121,15 @@ def _chunked_lm_loss(model, params, ids, chunk_size, *, train, rng=None,
     from pytorch_distributed_tpu.ops.lm_loss import causal_lm_chunked_loss
     from pytorch_distributed_tpu.runtime.precision import current_policy
 
-    kwargs = {"rngs": {"dropout": rng}} if train else {}
+    extra = {}
     if segment_ids is not None:
-        kwargs["segment_ids"] = segment_ids
+        extra["segment_ids"] = segment_ids
         if positions is not None:
-            kwargs["positions"] = positions
-    aux = None
-    if moe_aux_weight > 0.0:
-        from pytorch_distributed_tpu.ops.moe import collect_aux_loss
-
-        hidden, inter = model.apply(
-            {"params": params}, ids, train=train, return_hidden=True,
-            mutable=["intermediates"], **kwargs,
-        )
-        aux = collect_aux_loss(inter["intermediates"], weight=moe_aux_weight)
-    else:
-        hidden = model.apply(
-            {"params": params}, ids, train=train, return_hidden=True,
-            **kwargs,
-        )
+            extra["positions"] = positions
+    hidden, aux = _apply_with_moe_aux(
+        model, params, ids, train=train, rng=rng,
+        moe_aux_weight=moe_aux_weight, return_hidden=True, extra=extra,
+    )
     weight, vocab_axis = _lm_projection_weight(params)
     ce = causal_lm_chunked_loss(
         hidden.astype(current_policy().compute_dtype),
@@ -197,22 +212,10 @@ def causal_lm_loss_fn(
             extra["segment_ids"] = seg
             if "positions" in batch:
                 extra["positions"] = batch["positions"]
-        if moe_aux_weight > 0.0:
-            from pytorch_distributed_tpu.ops.moe import collect_aux_loss
-
-            logits, inter = model.apply(
-                {"params": params}, ids, train=True,
-                rngs={"dropout": rng}, mutable=["intermediates"], **extra,
-            )
-            aux = collect_aux_loss(
-                inter["intermediates"], weight=moe_aux_weight
-            )
-        else:
-            logits = model.apply(
-                {"params": params}, ids, train=True, rngs={"dropout": rng},
-                **extra,
-            )
-            aux = None
+        logits, aux = _apply_with_moe_aux(
+            model, params, ids, train=True, rng=rng,
+            moe_aux_weight=moe_aux_weight, extra=extra,
+        )
         # predict token t+1 from prefix..t
         shift_logits = logits[:, :-1].astype(jnp.float32)
         shift_labels = ids[:, 1:]
