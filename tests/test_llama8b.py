@@ -175,7 +175,7 @@ def test_8b_decode_cache_bytes_bounded_by_cache_len(abstract_8b_state):
     assert naive > 20 * bounded  # the cache_len bound is load-bearing
 
 
-def _lower_8b_step(model, abstract, loss_fn):
+def _lower_8b_step(model, abstract, loss_fn, *, packed=False):
     mesh = AbstractMesh((4, 16), ("dp", "fsdp"))
     strategy = FSDP(mesh)
     shardings = strategy.state_shardings(abstract)
@@ -184,11 +184,19 @@ def _lower_8b_step(model, abstract, loss_fn):
         abstract,
         shardings,
     )
+    bsh = strategy.batch_sharding()
     batch_shapes = {
         "input_ids": jax.ShapeDtypeStruct(
-            (GLOBAL_BATCH, SEQ), jnp.int32, sharding=strategy.batch_sharding()
+            (GLOBAL_BATCH, SEQ), jnp.int32, sharding=bsh
         )
     }
+    if packed:
+        batch_shapes["segment_ids"] = jax.ShapeDtypeStruct(
+            (GLOBAL_BATCH, SEQ), jnp.int32, sharding=bsh
+        )
+        batch_shapes["positions"] = jax.ShapeDtypeStruct(
+            (GLOBAL_BATCH, SEQ), jnp.int32, sharding=bsh
+        )
     step = build_train_step(loss_fn)
     return (
         jax.jit(step, donate_argnums=(0,))
@@ -293,6 +301,25 @@ def test_8b_projected_step_time_v5p64(abstract_8b_state):
     # pin the projection so BASELINE.md's row can't silently drift from
     # the program it describes (tok/s/chip = 2048/step_s is implied)
     assert 0.4 < step_s < 0.8, f"step_s={step_s:.3f}"
+
+
+@pytest.mark.slow
+def test_8b_packed_chunked_step_lowers_for_tpu(abstract_8b_state):
+    """The full round-3 training configuration at the stretch-goal scale:
+    packed sequences (segment-masked attention + per-document positions)
+    + chunked-vocab loss + FSDP on the v5p-64 mesh — traces and lowers
+    end to end for TPU."""
+    cfg, model, abstract = abstract_8b_state
+    lowered = _lower_8b_step(
+        model, abstract,
+        causal_lm_loss_fn(model, vocab_chunk_size=8192),
+        packed=True,
+    )
+    text = lowered.as_text()
+    assert "stablehlo" in text or "module" in text
+    # still sheds the [tokens, V] logits with packing in play
+    tokens_per_shard = GLOBAL_BATCH * (SEQ - 1) // 64
+    assert f"{tokens_per_shard}x{cfg.vocab_size}" not in text
 
 
 @pytest.mark.slow
