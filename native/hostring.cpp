@@ -533,10 +533,10 @@ void quantize_block(const float* x, size_t n, int8_t* q, float* scale) {
     return;
   }
   const float inv = 1.0f / s;
-  for (size_t i = 0; i < n; ++i) {
+  for (size_t i = 0; i < n; ++i) {  // branchless: auto-vectorizes
     float v = x[i] * inv;
     v = v < -127.f ? -127.f : (v > 127.f ? 127.f : v);
-    q[i] = (int8_t)(v < 0 ? v - 0.5f : v + 0.5f);  // round half away
+    q[i] = (int8_t)(v + __builtin_copysignf(0.5f, v));  // round half away
   }
 }
 
