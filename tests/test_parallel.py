@@ -274,3 +274,12 @@ class TestStrategyNumerics:
         batch = strategy.shard_batch(make_batches(1)[0])
         new_state, _ = step(state, batch)
         assert int(new_state.step) == 1
+
+
+def test_no_sync_is_a_documented_noop():
+    """torch's model.no_sync() shape: a context manager that exists, runs,
+    and changes nothing (accumulation lives inside the jitted step)."""
+    from pytorch_distributed_tpu.parallel import no_sync
+
+    with no_sync():
+        pass
