@@ -70,7 +70,7 @@ from pytorch_distributed_tpu.runtime.precision import (
     current_policy,
 )
 from pytorch_distributed_tpu.runtime.prng import RngSeq, seed_all
-from pytorch_distributed_tpu.generation import generate, sample_logits
+from pytorch_distributed_tpu.generation import generate, generate_beam, sample_logits
 from pytorch_distributed_tpu import optim
 from pytorch_distributed_tpu.launch import (
     ElasticAgent,
@@ -116,6 +116,7 @@ __all__ = [
     "ReduceOp",
     "enable_compilation_cache",
     "generate",
+    "generate_beam",
     "optim",
     "sample_logits",
     "Policy",

@@ -84,6 +84,26 @@ def sample_logits(
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def _generation_limits(model, P, max_new_tokens):
+    """Shared validation for generate/generate_beam: positive token count
+    and prompt+new within the model's position/cache capacity. Returns
+    the cache length."""
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    cfg = getattr(model, "config", None)
+    limit = getattr(cfg, "n_positions", None) or getattr(
+        cfg, "max_seq_len", None
+    )
+    if limit is not None and P + max_new_tokens > limit:
+        # past the cache/position table the dynamic_update_slice clamps
+        # and gathers clamp — silent garbage, so refuse up front
+        raise ValueError(
+            f"prompt ({P}) + max_new_tokens ({max_new_tokens}) exceeds the "
+            f"model's maximum sequence length {limit}"
+        )
+    return P + max_new_tokens
+
+
 def generate(
     model,
     params,
@@ -113,25 +133,12 @@ def generate(
     unpadded per-prompt results.
     """
     B, P = prompt_ids.shape
-    if max_new_tokens < 1:
-        raise ValueError("max_new_tokens must be >= 1")
-    cfg = getattr(model, "config", None)
-    limit = getattr(cfg, "n_positions", None) or getattr(
-        cfg, "max_seq_len", None
-    )
-    if limit is not None and P + max_new_tokens > limit:
-        # past the cache/position table the dynamic_update_slice clamps
-        # and gathers clamp — silent garbage, so refuse up front
-        raise ValueError(
-            f"prompt ({P}) + max_new_tokens ({max_new_tokens}) exceeds the "
-            f"model's maximum sequence length {limit}"
-        )
-    if rng is None:
-        rng = jax.random.key(0)
-    # size the KV cache to exactly what this generation needs — NOT the
+    # the cache is sized to exactly what this generation needs — NOT the
     # model's max positions (at 8B scale that difference is gigabytes of
     # HBM and a proportionally wider attention every step)
-    cache_len = P + max_new_tokens
+    cache_len = _generation_limits(model, P, max_new_tokens)
+    if rng is None:
+        rng = jax.random.key(0)
 
     extra = {}
     prompt_lens = None
@@ -221,4 +228,135 @@ def generate(
     out = jnp.concatenate(
         [prompt_ids, tok[:, None], rest.T.astype(prompt_ids.dtype)], axis=1
     )
+    return out
+
+
+def generate_beam(
+    model,
+    params,
+    prompt_ids: jnp.ndarray,
+    *,
+    max_new_tokens: int,
+    num_beams: int,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+    length_penalty: float = 1.0,
+    return_scores: bool = False,
+):
+    """Beam search over the same static-cache decode loop as ``generate``.
+
+    Deterministic (no sampling): keeps the ``num_beams`` highest
+    log-probability continuations per row, finishing beams at ``eos_id``
+    and ranking finished beams by ``sum(logp) / len**length_penalty``
+    (HF's convention). Returns the best sequence [B, P + max_new_tokens]
+    (finished beams padded with ``pad_id``), or ``(sequences, scores)``
+    with ``return_scores``.
+
+    TPU shape discipline: beams are a batch dimension — the cache is
+    replicated to [B*num_beams, ...] once after prefill, and every scan
+    step reorders it with one gather; all shapes static, one compile.
+    """
+    B, P = prompt_ids.shape
+    K = num_beams
+    if K < 2:
+        raise ValueError("num_beams must be >= 2 (use generate for greedy)")
+    cache_len = _generation_limits(model, P, max_new_tokens)
+    NEG = jnp.float32(-1e30)
+
+    # prefill once at [B, P]; expand to beams afterwards
+    logits, state = model.apply(
+        {"params": params}, prompt_ids, decode=True, cache_len=cache_len,
+        mutable=["cache"],
+    )
+    logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))  # [B, V]
+    V = logp0.shape[-1]
+    scores, tok = lax.top_k(logp0, K)  # [B, K] initial beams
+    # replicate every layer's cache K times along its BATCH axis. KV
+    # buffers are [..., B, T, H, D] (a leading [L] when layers are
+    # scanned), so the batch axis is ndim-4; index/position counters have
+    # no batch dim and stay shared.
+    def _cache_batch_axis(path, x):
+        name = getattr(path[-1], "key", None) or str(path[-1])
+        if name in ("cached_key", "cached_value"):
+            return x.ndim - 4
+        return None
+
+    def _rep(path, x):
+        ax = _cache_batch_axis(path, x)
+        return x if ax is None else jnp.repeat(x, K, axis=ax)
+
+    cache = jax.tree_util.tree_map_with_path(_rep, state["cache"])
+    tokens = jnp.full((B, K, max_new_tokens), pad_id, jnp.int32)
+    tokens = tokens.at[:, :, 0].set(tok)
+    finished = (
+        tok == eos_id if eos_id is not None
+        else jnp.zeros((B, K), jnp.bool_)
+    )
+
+    def step(carry, t):
+        cache, tokens, scores, finished, prev = carry
+        logits, state = model.apply(
+            {"params": params, "cache": cache},
+            prev.reshape(B * K)[:, None],
+            decode=True,
+            cache_len=cache_len,
+            mutable=["cache"],
+        )
+        cache = state["cache"]
+        logp = jax.nn.log_softmax(
+            logits[:, -1].astype(jnp.float32)
+        ).reshape(B, K, V)
+        # finished beams may only extend with pad, at unchanged score
+        pad_only = jnp.full((V,), NEG).at[pad_id].set(0.0)
+        logp = jnp.where(finished[:, :, None], pad_only[None, None, :], logp)
+        total = scores[:, :, None] + logp  # [B, K, V]
+        flat = total.reshape(B, K * V)
+        scores, idx = lax.top_k(flat, K)  # [B, K]
+        beam_idx = idx // V  # which parent beam
+        tok = (idx % V).astype(jnp.int32)
+        # reorder histories and caches to the surviving parents
+        tokens = jnp.take_along_axis(
+            tokens, beam_idx[:, :, None], axis=1
+        )
+        tokens = tokens.at[:, :, t].set(tok)
+        finished = jnp.take_along_axis(finished, beam_idx, axis=1)
+        if eos_id is not None:
+            finished = finished | (tok == eos_id)
+        gather = (
+            jnp.arange(B)[:, None] * K + beam_idx
+        ).reshape(B * K)  # global cache rows
+
+        def _take(path, x):
+            ax = _cache_batch_axis(path, x)
+            return x if ax is None else jnp.take(x, gather, axis=ax)
+
+        cache = jax.tree_util.tree_map_with_path(_take, cache)
+        return (cache, tokens, scores, finished, tok), None
+
+    (cache, tokens, scores, finished, _), _ = lax.scan(
+        step,
+        (cache, tokens, scores, finished, tok),
+        jnp.arange(1, max_new_tokens),
+        length=max_new_tokens - 1,
+    )
+
+    # rank by length-penalized score: finished beams use tokens-to-eos,
+    # unfinished use the full length
+    if eos_id is not None:
+        is_eos = tokens == eos_id
+        eos_pos = jnp.argmax(is_eos, axis=-1)  # first eos (0 if none)
+        has_eos = jnp.any(is_eos, axis=-1)
+        lengths = jnp.where(has_eos, eos_pos + 1, max_new_tokens)
+    else:
+        lengths = jnp.full((B, K), max_new_tokens)
+    final = scores / (lengths.astype(jnp.float32) ** length_penalty)
+    best = jnp.argmax(final, axis=1)  # [B]
+    seq = jnp.take_along_axis(
+        tokens, best[:, None, None], axis=1
+    )[:, 0]  # [B, max_new_tokens]
+    out = jnp.concatenate(
+        [prompt_ids, seq.astype(prompt_ids.dtype)], axis=1
+    )
+    if return_scores:
+        return out, jnp.take_along_axis(final, best[:, None], axis=1)[:, 0]
     return out
