@@ -117,6 +117,7 @@ def generate(
     eos_id: Optional[int] = None,
     pad_id: int = 0,
     prompt_mask: Optional[jnp.ndarray] = None,
+    repetition_penalty: float = 1.0,
 ) -> jnp.ndarray:
     """Generate ``max_new_tokens`` continuations of ``prompt_ids`` [B, P].
 
@@ -124,6 +125,15 @@ def generate(
     padded with ``pad_id`` after it. Jit-compatible end to end — wrap in
     ``jax.jit(..., static_argnums=...)`` or call inside a jitted fn; the
     decode loop is a single ``lax.scan`` either way.
+
+    ``repetition_penalty`` (> 1.0 discourages) matches HF's
+    ``RepetitionPenaltyLogitsProcessor``: logits of every token already in
+    the row (prompt + generated so far) are divided by the penalty when
+    positive and multiplied when negative, before sampling. One deliberate
+    divergence: with ``prompt_mask``, PAD slots are not counted as seen —
+    HF penalizes them because they sit in input_ids; padding is not
+    content, and this keeps ragged-batch outputs equal to the unpadded
+    per-prompt runs.
 
     ``prompt_mask`` [B, P] (True = real token) enables RAGGED batches via
     LEFT padding — the HF ``generate(attention_mask=...)`` idiom: pads
@@ -177,24 +187,55 @@ def generate(
         )
         extra = {"positions": positions, "kv_mask": kv_mask}
 
+    if repetition_penalty <= 0.0:
+        raise ValueError(
+            f"repetition_penalty must be > 0, got {repetition_penalty}"
+        )
+
     # prefill: one full-width pass fills every layer's cache
     logits, state = model.apply(
         {"params": params}, prompt_ids, decode=True, cache_len=cache_len,
         mutable=["cache"], **extra,
     )
     cache = state["cache"]
+
+    presence = None
+    if repetition_penalty != 1.0:
+        # [B, V] token-presence mask (prompt tokens; pads excluded when a
+        # prompt_mask is given), updated as tokens are emitted
+        V = logits.shape[-1]
+        presence = jnp.zeros((B, V), jnp.bool_)
+        rows = jnp.broadcast_to(jnp.arange(B)[:, None], (B, P))
+        if prompt_mask is not None:
+            # masked slots contribute a False update — a no-op under .max
+            safe_ids = jnp.where(prompt_mask, prompt_ids, 0)
+            presence = presence.at[rows, safe_ids].max(prompt_mask)
+        else:
+            presence = presence.at[rows, prompt_ids].set(True)
+
+    def _penalize(logits, presence):
+        if presence is None:
+            return logits
+        l32 = logits.astype(jnp.float32)
+        pen = jnp.where(
+            l32 > 0, l32 / repetition_penalty, l32 * repetition_penalty
+        )
+        return jnp.where(presence, pen, l32)
+
     rng, sub = jax.random.split(rng)
     tok = sample_logits(
-        logits[:, -1], sub, temperature=temperature, top_k=top_k,
-        top_p=top_p,
+        _penalize(logits[:, -1], presence), sub, temperature=temperature,
+        top_k=top_k, top_p=top_p,
     )
+    if presence is not None:
+        presence = presence.at[jnp.arange(B), tok].set(True)
     done = (
         tok == eos_id if eos_id is not None
         else jnp.zeros((B,), jnp.bool_)
     )
 
     def step(carry, t):
-        cache, tok, rng, done = carry
+        cache, tok, rng, done, presence = carry
         dec_extra = {}
         if prompt_lens is not None:
             # per-row positions continue each row's REAL length, not the
@@ -211,18 +252,20 @@ def generate(
         )
         rng, sub = jax.random.split(rng)
         nxt = sample_logits(
-            logits[:, -1], sub, temperature=temperature, top_k=top_k,
-            top_p=top_p,
+            _penalize(logits[:, -1], presence), sub,
+            temperature=temperature, top_k=top_k, top_p=top_p,
         )
         nxt = jnp.where(done, jnp.int32(pad_id), nxt)
         if eos_id is not None:
             done = done | (nxt == eos_id)
-        return (state["cache"], nxt, rng, done), nxt
+        if presence is not None:
+            presence = presence.at[jnp.arange(B), nxt].set(True)
+        return (state["cache"], nxt, rng, done, presence), nxt
 
     # scan step t consumes continuation token #t+1, whose position is
     # (real length) + t
-    (cache, _, _, _), rest = lax.scan(
-        step, (cache, tok, rng, done),
+    (cache, _, _, _, _), rest = lax.scan(
+        step, (cache, tok, rng, done, presence),
         jnp.arange(max_new_tokens - 1), length=max_new_tokens - 1,
     )
     out = jnp.concatenate(

@@ -378,3 +378,40 @@ def test_beam_scores_are_self_consistent(gpt2):
         np.testing.assert_allclose(
             scores[b], seq_logprob(beams[b]) / NEW, rtol=1e-4,
         )
+
+
+def test_ragged_batch_with_repetition_penalty_matches_solo(gpt2):
+    """prompt_mask + repetition_penalty compose: the left-padded batch
+    still equals each prompt generated alone (pads are NOT counted as
+    'seen' tokens — the invariant documented in generate())."""
+    model, params, _ = gpt2
+    rng = np.random.default_rng(11)
+    p1 = rng.integers(1, 97, size=4).astype(np.int32)
+    p2 = rng.integers(1, 97, size=7).astype(np.int32)
+    NEW = 6
+    solo = [
+        np.asarray(
+            generate(
+                model, params, jnp.asarray(p[None, :]),
+                max_new_tokens=NEW, temperature=0.0,
+                repetition_penalty=1.6,
+            )
+        )[0, len(p):]
+        for p in (p1, p2)
+    ]
+    P = 7
+    ids = np.zeros((2, P), np.int32)
+    mask = np.zeros((2, P), bool)
+    ids[0, P - 4:] = p1
+    mask[0, P - 4:] = True
+    ids[1] = p2
+    mask[1] = True
+    out = np.asarray(
+        generate(
+            model, params, jnp.asarray(ids), max_new_tokens=NEW,
+            temperature=0.0, prompt_mask=jnp.asarray(mask),
+            repetition_penalty=1.6,
+        )
+    )
+    np.testing.assert_array_equal(out[0, P:], solo[0])
+    np.testing.assert_array_equal(out[1, P:], solo[1])

@@ -373,3 +373,43 @@ def test_vit_export_import_roundtrip():
             np.asarray(a, np.float32), np.asarray(b, np.float32),
             err_msg=str(pa),
         )
+
+
+def test_gpt2_generate_matches_hf_token_for_token():
+    """Greedy decode through converted weights equals transformers' own
+    ``generate`` — plain AND with repetition_penalty (our presence-mask
+    implementation vs HF's RepetitionPenaltyLogitsProcessor)."""
+    from pytorch_distributed_tpu.generation import generate
+    from pytorch_distributed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=211, n_positions=64, n_embd=48, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    torch.manual_seed(0)
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    ids = np.random.default_rng(0).integers(
+        1, 211, size=(2, 7)
+    ).astype(np.int64)
+    cfg = GPT2Config(
+        vocab_size=211, n_positions=64, hidden_size=48, num_layers=2,
+        num_heads=4, dropout_rate=0.0,
+    )
+    params = load_gpt2_weights(_sd(hf), cfg)
+    model = GPT2LMHead(cfg)
+
+    for pen in (1.0, 1.7):
+        with torch.no_grad():
+            want = hf.generate(
+                torch.tensor(ids), max_new_tokens=8, do_sample=False,
+                repetition_penalty=pen, pad_token_id=0,
+            ).numpy()
+        with autocast(enabled=False):
+            got = np.asarray(
+                generate(
+                    model, params, jnp.asarray(ids.astype(np.int32)),
+                    max_new_tokens=8, temperature=0.0,
+                    repetition_penalty=pen,
+                )
+            )
+        np.testing.assert_array_equal(got, want, err_msg=f"penalty={pen}")
