@@ -325,6 +325,7 @@ def _naive_beam(model, params, ids_row, n, K, eos_id=None, pad_id=0,
     return max(beams, key=final)[1]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("eos", [None, "auto"])
 def test_beam_search_matches_naive_reference(gpt2, eos):
     from pytorch_distributed_tpu.generation import generate_beam
@@ -380,6 +381,7 @@ def test_beam_scores_are_self_consistent(gpt2):
         )
 
 
+@pytest.mark.slow
 def test_ragged_batch_with_repetition_penalty_matches_solo(gpt2):
     """prompt_mask + repetition_penalty compose: the left-padded batch
     still equals each prompt generated alone (pads are NOT counted as
