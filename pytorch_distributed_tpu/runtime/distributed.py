@@ -639,7 +639,7 @@ def permute(x, perm, *, axis=None):
     return fn(jax.device_put(x, NamedSharding(g.mesh, P(axes))))
 
 
-def gather(x, dst: int = 0, *, axis=None):
+def gather(x, dst: int = 0, *, axis=None, group=None):
     """Gather participant slices to ``dst`` (torch.distributed.gather).
 
     Single-controller SPMD has no per-rank host to collect *to* — the
@@ -647,10 +647,11 @@ def gather(x, dst: int = 0, *, axis=None):
     torch call shape; ``dst`` is accepted for recipe-script parity.
     """
     del dst
-    return all_gather(x, axis=axis)
+    return all_gather(x, axis=axis, group=group)
 
 
-def reduce(x, dst: int = 0, op: ReduceOp = ReduceOp.SUM, *, axis=None):
+def reduce(x, dst: int = 0, op: ReduceOp = ReduceOp.SUM, *, axis=None,
+           group=None):
     """Reduce to ``dst`` (torch.distributed.reduce).
 
     In torch only rank ``dst``'s output is defined; under single-controller
@@ -659,7 +660,7 @@ def reduce(x, dst: int = 0, op: ReduceOp = ReduceOp.SUM, *, axis=None):
     extra, so this is ``all_reduce`` with the torch call shape.
     """
     del dst
-    return all_reduce(x, op=op, axis=axis)
+    return all_reduce(x, op=op, axis=axis, group=group)
 
 
 def monitored_barrier(timeout_s: Optional[float] = None) -> None:

@@ -99,6 +99,14 @@ class TestProcessGroupFacade:
             np.asarray(ptd.broadcast(x, src=3, group=g)), [4.0]
         )
         ptd.barrier(group=g)  # trivially synchronized, must not raise
+        # torch-shaped wrappers forward the group too
+        np.testing.assert_allclose(
+            np.asarray(ptd.reduce(x, dst=1, group=g)), [12.0]
+        )
+        np.testing.assert_allclose(
+            np.asarray(ptd.gather(x, dst=3, group=g)),
+            [[2.0], [4.0], [6.0]],
+        )
         with pytest.raises(ValueError, match="not in group"):
             ptd.broadcast(x, src=0, group=g)
         with pytest.raises(ValueError, match="out of range"):
