@@ -118,6 +118,7 @@ def generate(
     pad_id: int = 0,
     prompt_mask: Optional[jnp.ndarray] = None,
     repetition_penalty: float = 1.0,
+    no_repeat_ngram_size: int = 0,
 ) -> jnp.ndarray:
     """Generate ``max_new_tokens`` continuations of ``prompt_ids`` [B, P].
 
@@ -125,6 +126,15 @@ def generate(
     padded with ``pad_id`` after it. Jit-compatible end to end — wrap in
     ``jax.jit(..., static_argnums=...)`` or call inside a jitted fn; the
     decode loop is a single ``lax.scan`` either way.
+
+    ``no_repeat_ngram_size`` matches HF's ``NoRepeatNGramLogitsProcessor``
+    token-for-token for unpadded prompts (n=1 bans every seen token;
+    n larger than the sequence is a no-op, like HF). Static shapes: the
+    token history lives in a fixed [B, P + max_new_tokens] buffer and
+    each step scans its sliding n-gram windows. With ``prompt_mask``,
+    PAD slots are excluded from grams (HF scans raw input_ids, pads
+    included) — the same deliberate divergence as repetition_penalty,
+    keeping ragged batches equal to unpadded per-prompt runs.
 
     ``repetition_penalty`` (> 1.0 discourages) matches HF's
     ``RepetitionPenaltyLogitsProcessor``: logits of every token already in
@@ -191,6 +201,10 @@ def generate(
         raise ValueError(
             f"repetition_penalty must be > 0, got {repetition_penalty}"
         )
+    if no_repeat_ngram_size < 0:
+        raise ValueError(
+            f"no_repeat_ngram_size must be >= 0, got {no_repeat_ngram_size}"
+        )
 
     # prefill: one full-width pass fills every layer's cache
     logits, state = model.apply(
@@ -222,20 +236,86 @@ def generate(
         )
         return jnp.where(presence, pen, l32)
 
+    n = no_repeat_ngram_size
+    if n > cache_len:
+        n = 0  # no n-gram can ever complete — a no-op, like HF
+    history = None
+    if n > 0:
+        # fixed-size token history; slots >= cur_len are not yet written
+        history = jnp.zeros((B, cache_len), jnp.int32)
+        history = history.at[:, :P].set(prompt_ids.astype(jnp.int32))
+        # slot validity: with a prompt_mask, PAD slots never participate
+        # in grams (unlike HF's raw-input_ids scan) so ragged batches
+        # keep matching the unpadded per-prompt runs — the same
+        # deliberate divergence repetition_penalty documents
+        if prompt_mask is not None:
+            hist_valid = jnp.concatenate(
+                [prompt_mask,
+                 jnp.ones((B, cache_len - P), jnp.bool_)], axis=1,
+            )
+        else:
+            hist_valid = jnp.ones((B, cache_len), jnp.bool_)
+        if n >= 2:
+            # sliding (n-1)-gram window start indices, built once
+            win = (
+                jnp.arange(cache_len - n + 1)[:, None] + jnp.arange(n - 1)
+            )  # [W, n-1]
+
+    def _ban_ngrams(logits, history, cur_len):
+        """-inf on tokens that would complete a seen n-gram (HF
+        semantics; n=1 bans every seen token). ``cur_len`` = tokens
+        written so far; candidates extend history[cur_len-(n-1):cur_len]."""
+        if history is None:
+            return logits
+        l32 = logits.astype(jnp.float32)
+        V = l32.shape[-1]
+        rows_full = jnp.arange(B)[:, None]
+        if n == 1:  # every already-seen (valid) token is banned
+            seen = (
+                jnp.arange(cache_len)[None, :] < cur_len
+            ) & hist_valid
+            banned = jnp.where(seen, history, V)
+            return l32.at[
+                jnp.broadcast_to(rows_full, banned.shape), banned
+            ].set(-jnp.inf, mode="drop")
+        grams = history[:, win]  # [B, W, n-1]
+        suffix = lax.dynamic_slice_in_dim(
+            history, cur_len - (n - 1), n - 1, axis=1
+        )  # [B, n-1]
+        match = jnp.all(grams == suffix[:, None, :], axis=-1)  # [B, W]
+        # a window is a real, completed n-gram iff it ends before cur_len
+        ends = jnp.arange(cache_len - n + 1) + n  # window's full-gram end
+        match = match & (ends[None, :] <= cur_len)
+        # every slot of the gram AND its follower must be a real token
+        follower_idx = jnp.arange(cache_len - n + 1) + (n - 1)
+        gram_valid = jnp.all(hist_valid[:, win], axis=-1) & hist_valid[
+            :, follower_idx
+        ]
+        match = match & gram_valid
+        follower = history[:, follower_idx]
+        banned = jnp.where(match, follower, V)  # V = dropped by scatter
+        rows = jnp.broadcast_to(rows_full, banned.shape)
+        return l32.at[rows, banned].set(-jnp.inf, mode="drop")
+
     rng, sub = jax.random.split(rng)
+    first_logits = _penalize(logits[:, -1], presence)
+    if history is not None:
+        first_logits = _ban_ngrams(first_logits, history, P)
     tok = sample_logits(
-        _penalize(logits[:, -1], presence), sub, temperature=temperature,
+        first_logits, sub, temperature=temperature,
         top_k=top_k, top_p=top_p,
     )
     if presence is not None:
         presence = presence.at[jnp.arange(B), tok].set(True)
+    if history is not None:
+        history = history.at[:, P].set(tok)
     done = (
         tok == eos_id if eos_id is not None
         else jnp.zeros((B,), jnp.bool_)
     )
 
     def step(carry, t):
-        cache, tok, rng, done, presence = carry
+        cache, tok, rng, done, presence, history = carry
         dec_extra = {}
         if prompt_lens is not None:
             # per-row positions continue each row's REAL length, not the
@@ -251,8 +331,13 @@ def generate(
             **dec_extra,
         )
         rng, sub = jax.random.split(rng)
+        step_logits = _penalize(logits[:, -1], presence)
+        if history is not None:
+            # t counts from 0; the prefill token is already written, so
+            # the history holds P + t + 1 tokens at this point
+            step_logits = _ban_ngrams(step_logits, history, P + t + 1)
         nxt = sample_logits(
-            _penalize(logits[:, -1], presence), sub,
+            step_logits, sub,
             temperature=temperature, top_k=top_k, top_p=top_p,
         )
         nxt = jnp.where(done, jnp.int32(pad_id), nxt)
@@ -260,12 +345,18 @@ def generate(
             done = done | (nxt == eos_id)
         if presence is not None:
             presence = presence.at[jnp.arange(B), nxt].set(True)
-        return (state["cache"], nxt, rng, done, presence), nxt
+        if history is not None:  # traced column index -> scatter form;
+            # this step's token is sequence index P + t + 1 (prefill
+            # already wrote index P)
+            history = history.at[
+                jnp.arange(B), jnp.full((B,), P + t + 1)
+            ].set(nxt)
+        return (state["cache"], nxt, rng, done, presence, history), nxt
 
     # scan step t consumes continuation token #t+1, whose position is
     # (real length) + t
-    (cache, _, _, _, _), rest = lax.scan(
-        step, (cache, tok, rng, done, presence),
+    (cache, _, _, _, _, _), rest = lax.scan(
+        step, (cache, tok, rng, done, presence, history),
         jnp.arange(max_new_tokens - 1), length=max_new_tokens - 1,
     )
     out = jnp.concatenate(

@@ -417,3 +417,43 @@ def test_ragged_batch_with_repetition_penalty_matches_solo(gpt2):
     )
     np.testing.assert_array_equal(out[0, P:], solo[0])
     np.testing.assert_array_equal(out[1, P:], solo[1])
+
+
+def test_ngram_oversized_is_noop_and_ragged_composes(gpt2):
+    """n > sequence length is a harmless no-op (HF behavior), and
+    prompt_mask + no_repeat_ngram keeps ragged rows equal to solo runs
+    (pads excluded from grams)."""
+    model, params, ids = gpt2
+    plain = np.asarray(
+        generate(model, params, ids, max_new_tokens=4, temperature=0.0)
+    )
+    noop = np.asarray(
+        generate(
+            model, params, ids, max_new_tokens=4, temperature=0.0,
+            no_repeat_ngram_size=99,
+        )
+    )
+    np.testing.assert_array_equal(noop, plain)
+
+    rng = np.random.default_rng(13)
+    p1 = rng.integers(1, 97, size=4).astype(np.int32)
+    NEW = 6
+    solo = np.asarray(
+        generate(
+            model, params, jnp.asarray(p1[None]), max_new_tokens=NEW,
+            temperature=0.0, no_repeat_ngram_size=2,
+        )
+    )[0, 4:]
+    P = 7
+    padded = np.zeros((1, P), np.int32)
+    mask = np.zeros((1, P), bool)
+    padded[0, P - 4:] = p1
+    mask[0, P - 4:] = True
+    out = np.asarray(
+        generate(
+            model, params, jnp.asarray(padded), max_new_tokens=NEW,
+            temperature=0.0, prompt_mask=jnp.asarray(mask),
+            no_repeat_ngram_size=2,
+        )
+    )
+    np.testing.assert_array_equal(out[0, P:], solo)

@@ -413,3 +413,42 @@ def test_gpt2_generate_matches_hf_token_for_token():
                 )
             )
         np.testing.assert_array_equal(got, want, err_msg=f"penalty={pen}")
+
+
+def test_gpt2_no_repeat_ngram_matches_hf():
+    """no_repeat_ngram_size bans match HF's NoRepeatNGramLogitsProcessor
+    token-for-token through converted weights (greedy)."""
+    from pytorch_distributed_tpu.generation import generate
+    from pytorch_distributed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=53, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    torch.manual_seed(1)
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    # tiny vocab forces repeats quickly, so the ban path actually fires
+    ids = np.random.default_rng(2).integers(
+        1, 53, size=(2, 6)
+    ).astype(np.int64)
+    cfg = GPT2Config(
+        vocab_size=53, n_positions=64, hidden_size=32, num_layers=2,
+        num_heads=4, dropout_rate=0.0,
+    )
+    params = load_gpt2_weights(_sd(hf), cfg)
+    model = GPT2LMHead(cfg)
+    for ngram in (1, 2, 3):
+        with torch.no_grad():
+            want = hf.generate(
+                torch.tensor(ids), max_new_tokens=16, do_sample=False,
+                no_repeat_ngram_size=ngram, pad_token_id=0,
+            ).numpy()
+        with autocast(enabled=False):
+            got = np.asarray(
+                generate(
+                    model, params, jnp.asarray(ids.astype(np.int32)),
+                    max_new_tokens=16, temperature=0.0,
+                    no_repeat_ngram_size=ngram,
+                )
+            )
+        np.testing.assert_array_equal(got, want, err_msg=f"ngram={ngram}")
