@@ -1,0 +1,117 @@
+"""Diagnose the flash-attention compile blowup (VERDICT r2 ask #5).
+
+Round 2 observed >9 min cold compiles at the bench shape (8,1024,16,64)
+while the probe shapes compiled in 2-7 s — with no evidence whether the
+cost scales with the GRID (program count: B*H * S/bq * S/bk), the BLOCK
+(Mosaic per-kernel work / vmem pressure), the BATCH, or is mostly
+remote-compile RTT. This script separates the axes:
+
+* ``jit(...).lower()``   — local tracing + Pallas lowering (no relay)
+* ``lowered.compile()``  — the remote XLA+Mosaic backend compile
+
+and walks one axis at a time from a baseline (1,512,4,64) bq=bk=128:
+sequence only, batch*heads only, block only, then fwd+bwd at the winner.
+The persistent compile cache is deliberately NOT enabled, so every
+compile in the sweep is cold.
+
+Chip protocol: internal budget (PTD_PROBE_BUDGET_S, default 1200 s),
+checked BETWEEN compiles; never kill this process externally
+(docs/CHIP_PROTOCOL.md).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+t0 = time.time()
+BUDGET_S = float(os.environ.get("PTD_PROBE_BUDGET_S", "1200"))
+
+
+def log(msg):
+    print(f"[{time.time() - t0:8.1f}s] {msg}", flush=True)
+
+
+def over_budget():
+    return time.time() - t0 > BUDGET_S
+
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_tpu.ops.flash_attention import flash_attention
+
+# deliberately NOT enabling the persistent cache: cold numbers only
+
+CASES = [
+    # label, (B, S, H, D), block
+    ("base           ", (1, 512, 4, 64), 128),
+    ("seq 2x         ", (1, 1024, 4, 64), 128),
+    ("seq 4x         ", (1, 2048, 4, 64), 128),
+    ("batch*heads 8x ", (8, 512, 4, 64), 128),
+    ("heads 4x       ", (1, 512, 16, 64), 128),
+    ("block 256      ", (1, 512, 4, 64), 256),
+    ("block 512      ", (1, 1024, 4, 64), 512),
+    ("bench shape    ", (8, 1024, 16, 64), 128),
+    ("bench blk 256  ", (8, 1024, 16, 64), 256),
+]
+
+
+def main():
+    log(f"platform={jax.devices()[0].platform} "
+        f"kind={jax.devices()[0].device_kind}")
+    results = []
+    for label, (B, S, H, D), blk in CASES:
+        if over_budget():
+            log(f"budget spent — skipping from {label!r} on")
+            break
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(
+            rng.normal(size=(B, S, H, D)).astype(np.float32)
+        ).astype(jnp.bfloat16)
+
+        def fn(q, k, v):
+            return flash_attention(q, k, v, causal=True,
+                                   block_q=blk, block_k=blk)
+
+        t = time.time()
+        lowered = jax.jit(fn).lower(q, q, q)
+        lower_s = time.time() - t
+        t = time.time()
+        compiled = lowered.compile()
+        compile_s = time.time() - t
+        grid = B * H * (S // min(blk, S)) ** 2
+        log(f"{label} B{B} S{S} H{H} blk{blk} grid={grid:6d} "
+            f"lower={lower_s:6.2f}s compile={compile_s:7.2f}s")
+        results.append((label.strip(), grid, lower_s, compile_s))
+        del compiled
+
+    # fwd+bwd at the bench shape only if the budget survived the sweep
+    if not over_budget():
+        B, S, H, D = 8, 1024, 16, 64
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(
+            rng.normal(size=(B, S, H, D)).astype(np.float32)
+        ).astype(jnp.bfloat16)
+
+        def loss(q, k, v):
+            return flash_attention(q, k, v, causal=True).astype(
+                jnp.float32).sum()
+
+        t = time.time()
+        lowered = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(q, q, q)
+        lower_s = time.time() - t
+        t = time.time()
+        lowered.compile()
+        compile_s = time.time() - t
+        log(f"bench fwd+bwd   lower={lower_s:6.2f}s "
+            f"compile={compile_s:7.2f}s")
+
+    log("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
