@@ -4,11 +4,20 @@ Llama-3-8B. All NHWC / bf16-compute / f32-params by default, written
 against the framework's precision policy and partition-rule system.
 """
 
-from pytorch_distributed_tpu.models.resnet import ResNet, ResNet18, ResNet50
+from pytorch_distributed_tpu.models.resnet import (
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
 from pytorch_distributed_tpu.models.bert import (
     BertConfig,
     BertModel,
+    BertForMaskedLM,
     BertForSequenceClassification,
+    mask_tokens,
     bert_partition_rules,
 )
 from pytorch_distributed_tpu.models.gpt2 import (
@@ -30,10 +39,15 @@ from pytorch_distributed_tpu.models.llama import (
 __all__ = [
     "ResNet",
     "ResNet18",
+    "ResNet34",
     "ResNet50",
+    "ResNet101",
+    "ResNet152",
     "BertConfig",
     "BertModel",
+    "BertForMaskedLM",
     "BertForSequenceClassification",
+    "mask_tokens",
     "bert_partition_rules",
     "GPT2Config",
     "GPT2LMHead",

@@ -14,6 +14,7 @@ import dataclasses
 from typing import Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -119,6 +120,7 @@ class BertModel(nn.Module):
         token_type_ids: Optional[jnp.ndarray] = None,
         *,
         train: bool = False,
+        return_embed_table: bool = False,
     ):
         cfg = self.config
         policy = current_policy()
@@ -137,8 +139,9 @@ class BertModel(nn.Module):
         embed = lambda n, num: nn.Embed(  # noqa: E731
             num, cfg.hidden_size, param_dtype=policy.param_dtype, name=n
         )
+        word_embed = embed("word_embeddings", cfg.vocab_size)
         x = (
-            embed("word_embeddings", cfg.vocab_size)(input_ids)
+            word_embed(input_ids)
             + embed("position_embeddings", cfg.max_position_embeddings)(
                 jnp.arange(S)[None, :]
             )
@@ -164,6 +167,12 @@ class BertModel(nn.Module):
                 name="pooler",
             )(x[:, 0])
         )
+        if return_embed_table:
+            return (
+                x.astype(policy.output_dtype),
+                pooled.astype(policy.output_dtype),
+                word_embed.embedding,
+            )
         return x.astype(policy.output_dtype), pooled.astype(policy.output_dtype)
 
 
@@ -190,6 +199,76 @@ class BertForSequenceClassification(nn.Module):
             name="classifier",
         )(pooled)
         return logits.astype(policy.output_dtype)
+
+
+class BertForMaskedLM(nn.Module):
+    """MLM pretraining head (HF ``BertForMaskedLM`` shape): transform
+    Dense + GELU + LayerNorm, then a decoder TIED to the word-embedding
+    table (one [V, H] matrix serves embed and un-embed, the standard BERT
+    tying) plus a free output bias. Logits return in f32 (policy output
+    dtype) for a stable softmax over the 30k vocab."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 *, train: bool = False):
+        policy = current_policy()
+        cfg = self.config
+        x, _, table = BertModel(cfg, name="bert")(
+            input_ids, attention_mask, token_type_ids, train=train,
+            return_embed_table=True,
+        )
+        h = nn.Dense(
+            cfg.hidden_size,
+            dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype,
+            name="mlm_dense",
+        )(x.astype(policy.compute_dtype))
+        h = nn.gelu(h, approximate=False)
+        h = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, param_dtype=policy.param_dtype,
+            dtype=policy.compute_dtype, name="mlm_ln",
+        )(h)
+        logits = h @ table.astype(policy.compute_dtype).T
+        bias = self.param(
+            "mlm_bias", nn.initializers.zeros, (cfg.vocab_size,),
+            policy.param_dtype,
+        )
+        return (logits + bias).astype(jnp.float32)
+
+
+def mask_tokens(
+    rng,
+    input_ids,
+    *,
+    mask_token_id: int,
+    vocab_size: int,
+    mask_prob: float = 0.15,
+    special_mask=None,
+):
+    """BERT's 80/10/10 dynamic masking, ON DEVICE (jit-safe, static
+    shapes) — the host ships raw token ids and every step draws a fresh
+    masking from the step rng (RoBERTa-style dynamic masking, free on
+    TPU where the alternative is host-side preprocessing).
+
+    Returns ``(masked_ids, labels)`` with ``labels == -100`` (the HF
+    ignore index) at unselected positions. ``special_mask`` ([B, S]
+    bool, True = never mask) protects CLS/SEP/PAD.
+    """
+    k_sel, k_op, k_rand = jax.random.split(rng, 3)
+    sel = jax.random.uniform(k_sel, input_ids.shape) < mask_prob
+    if special_mask is not None:
+        sel = sel & ~special_mask
+    labels = jnp.where(sel, input_ids, -100)
+    op = jax.random.uniform(k_op, input_ids.shape)
+    random_ids = jax.random.randint(
+        k_rand, input_ids.shape, 0, vocab_size, dtype=input_ids.dtype
+    )
+    masked = jnp.where(op < 0.8, jnp.asarray(mask_token_id,
+                                             input_ids.dtype),
+                       jnp.where(op < 0.9, random_ids, input_ids))
+    return jnp.where(sel, masked, input_ids), labels
 
 
 def bert_partition_rules():

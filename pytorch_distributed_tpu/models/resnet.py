@@ -212,3 +212,34 @@ def ResNet50(num_classes: int = 1000, stem: str = "imagenet", **kw) -> ResNet:
         stem=stem,
         **kw,
     )
+
+
+def ResNet34(num_classes: int = 1000, stem: str = "imagenet", **kw) -> ResNet:
+    """torchvision-family completeness (param counts pinned in tests)."""
+    return ResNet(
+        stage_sizes=[3, 4, 6, 3],
+        block_cls=BasicBlock,
+        num_classes=num_classes,
+        stem=stem,
+        **kw,
+    )
+
+
+def ResNet101(num_classes: int = 1000, stem: str = "imagenet", **kw) -> ResNet:
+    return ResNet(
+        stage_sizes=[3, 4, 23, 3],
+        block_cls=Bottleneck,
+        num_classes=num_classes,
+        stem=stem,
+        **kw,
+    )
+
+
+def ResNet152(num_classes: int = 1000, stem: str = "imagenet", **kw) -> ResNet:
+    return ResNet(
+        stage_sizes=[3, 8, 36, 3],
+        block_cls=Bottleneck,
+        num_classes=num_classes,
+        stem=stem,
+        **kw,
+    )

@@ -16,6 +16,8 @@ from pytorch_distributed_tpu.train.losses import (
     classification_eval_step,
     classification_loss_fn,
     causal_lm_loss_fn,
+    masked_lm_loss_fn,
+    mixup_classification_loss_fn,
     text_classification_loss_fn,
     cross_entropy,
     accuracy,
@@ -45,6 +47,8 @@ __all__ = [
     "causal_lm_eval_step",
     "classification_eval_step",
     "classification_loss_fn",
+    "masked_lm_loss_fn",
+    "mixup_classification_loss_fn",
     "causal_lm_loss_fn",
     "text_classification_loss_fn",
     "cross_entropy",

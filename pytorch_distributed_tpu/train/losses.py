@@ -31,6 +31,33 @@ def accuracy(logits, labels):
     return jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
 
 
+def _classifier_forward(model, params, batch_stats, imgs, rng):
+    """Train-mode forward with optional mutable BatchNorm state — the
+    single definition every classifier loss shares. Returns
+    ``(logits, new_batch_stats_or_None)``."""
+    variables = {"params": params}
+    if batch_stats is not None:
+        variables["batch_stats"] = batch_stats
+        logits, mutated = model.apply(
+            variables, imgs, train=True, mutable=["batch_stats"],
+            rngs={"dropout": rng},
+        )
+        return logits, mutated["batch_stats"]
+    logits = model.apply(variables, imgs, train=True, rngs={"dropout": rng})
+    return logits, None
+
+
+def _l2_penalty(params, weight_decay):
+    """Classic L2-in-the-loss over kernels only (not biases/BN scales) —
+    the reference recipes' SGD-style decay."""
+    l2 = sum(
+        jnp.sum(jnp.square(p))
+        for p in jax.tree_util.tree_leaves(params)
+        if p.ndim > 1
+    )
+    return 0.5 * weight_decay * l2
+
+
 def classification_loss_fn(
     model,
     *,
@@ -46,36 +73,212 @@ def classification_loss_fn(
     """
 
     def loss_fn(params, batch_stats, batch, rng):
-        variables = {"params": params}
-        if batch_stats is not None:
-            variables["batch_stats"] = batch_stats
-            logits, mutated = model.apply(
-                variables,
-                batch[image_key],
-                train=True,
-                mutable=["batch_stats"],
-                rngs={"dropout": rng},
-            )
-            new_stats = mutated["batch_stats"]
-        else:
-            logits = model.apply(
-                variables, batch[image_key], train=True, rngs={"dropout": rng}
-            )
-            new_stats = None
+        logits, new_stats = _classifier_forward(
+            model, params, batch_stats, batch[image_key], rng
+        )
         loss = cross_entropy(logits, batch[label_key], label_smoothing)
         if weight_decay:
-            l2 = sum(
-                jnp.sum(jnp.square(p))
-                for p in jax.tree_util.tree_leaves(params)
-                if p.ndim > 1  # decay kernels, not biases/BN scales
-            )
-            loss = loss + 0.5 * weight_decay * l2
+            loss = loss + _l2_penalty(params, weight_decay)
         return loss, {
             "metrics": {
                 "loss": loss,
                 "accuracy": accuracy(logits, batch[label_key]),
             },
             "batch_stats": new_stats,
+        }
+
+    return loss_fn
+
+
+def mixup_cutmix(
+    rng,
+    imgs,
+    *,
+    mixup_alpha: float = 0.2,
+    cutmix_alpha: float = 0.0,
+    switch_prob: float = 0.5,
+):
+    """Batch-level MixUp/CutMix draw: ``(mixed, perm, lam)``.
+
+    One lam ~ Beta(alpha, alpha) and one partner permutation per call;
+    with both alphas > 0 the call picks CutMix with probability
+    ``switch_prob``, else MixUp. MixUp returns exactly
+    ``lam*imgs + (1-lam)*imgs[perm]``; CutMix pastes the partner's
+    pixels inside a box of ratio ``sqrt(1-lam)`` (clamped to the image)
+    and returns lam recomputed from the clamped area — all static
+    shapes (iota masks, no dynamic slicing), safe under jit.
+    """
+    if mixup_alpha <= 0.0 and cutmix_alpha <= 0.0:
+        raise ValueError(
+            "mixup_cutmix needs mixup_alpha > 0 or cutmix_alpha > 0 "
+            "(both zero would still mix with an implicit Beta(1,1) lam)"
+        )
+    k_pair, k_lam, k_switch, k_box = jax.random.split(rng, 4)
+    b, h, w = imgs.shape[0], imgs.shape[1], imgs.shape[2]
+    perm = jax.random.permutation(k_pair, b)
+    partner = imgs[perm]
+
+    use_cutmix = (
+        jax.random.uniform(k_switch) < switch_prob
+        if (mixup_alpha > 0.0 and cutmix_alpha > 0.0)
+        else jnp.asarray(cutmix_alpha > 0.0)
+    )
+    alpha = jnp.where(use_cutmix, cutmix_alpha or 1.0,
+                      mixup_alpha or 1.0).astype(jnp.float32)
+    lam = jax.random.beta(k_lam, alpha, alpha)
+
+    # MixUp branch
+    mixed_up = lam * imgs + (1.0 - lam) * partner
+
+    # CutMix branch: box at ratio sqrt(1-lam), clamped; lam from area
+    cut = jnp.sqrt(1.0 - lam)
+    bh, bw = cut * h, cut * w
+    cy = jax.random.uniform(k_box, minval=0.0, maxval=1.0) * h
+    cx = jax.random.uniform(
+        jax.random.fold_in(k_box, 1), minval=0.0, maxval=1.0
+    ) * w
+    y0 = jnp.clip(cy - bh / 2, 0, h)
+    y1 = jnp.clip(cy + bh / 2, 0, h)
+    x0 = jnp.clip(cx - bw / 2, 0, w)
+    x1 = jnp.clip(cx + bw / 2, 0, w)
+    rows = jnp.arange(h, dtype=jnp.float32)[:, None]
+    cols = jnp.arange(w, dtype=jnp.float32)[None, :]
+    in_box = (rows >= y0) & (rows < y1) & (cols >= x0) & (cols < x1)
+    box = in_box[None, :, :, None]  # [1, H, W, 1]
+    cut_mixed = jnp.where(box, partner, imgs)
+    lam_cut = 1.0 - jnp.mean(in_box.astype(jnp.float32))
+
+    mixed = jnp.where(use_cutmix, cut_mixed.astype(imgs.dtype),
+                      mixed_up.astype(imgs.dtype))
+    lam_out = jnp.where(use_cutmix, lam_cut, lam)
+    return mixed, perm, lam_out
+
+
+def mixup_classification_loss_fn(
+    model,
+    *,
+    mixup_alpha: float = 0.2,
+    cutmix_alpha: float = 0.0,
+    switch_prob: float = 0.5,
+    image_key: str = "image",
+    label_key: str = "label",
+    label_smoothing: float = 0.0,
+    weight_decay: float = 0.0,
+) -> Callable:
+    """``classification_loss_fn`` with on-device MixUp / CutMix.
+
+    The reference-era ImageNet recipes reach these through timm's
+    ``Mixup``, applied on the host per batch; here the augmentation runs
+    INSIDE the jitted step — lam ~ Beta(alpha, alpha) and the pairing
+    permutation are drawn from the step rng on device, so the host ships
+    the same clean batches and the mixing fuses into the forward pass.
+    Under SPMD the permutation is over the GLOBAL batch (XLA inserts the
+    cross-chip shuffle for ``imgs[perm]``); the math is mesh-invariant.
+
+    Batch-level semantics (timm ``mode='batch'``): one lam and one
+    partner permutation per step. With both alphas > 0, each step picks
+    CutMix with probability ``switch_prob``, else MixUp. The loss is the
+    lam-weighted pair of cross-entropies (identical to soft-target CE);
+    the reported accuracy scores the PRIMARY (unmixed) labels, which is
+    what the torch recipes log while mixing.
+
+    CutMix's box is sampled at ratio ``sqrt(1-lam)`` centered uniformly,
+    clamped to the image, and lam is recomputed from the clamped area
+    (the paper's adjustment) — all with static shapes (iota masks, no
+    dynamic slicing).
+    """
+    if mixup_alpha <= 0.0 and cutmix_alpha <= 0.0:
+        raise ValueError(
+            "mixup_classification_loss_fn needs mixup_alpha > 0 or "
+            "cutmix_alpha > 0; for neither, use classification_loss_fn"
+        )
+
+    def loss_fn(params, batch_stats, batch, rng):
+        k_mix, k_model = jax.random.split(rng)
+        imgs = batch[image_key]
+        labels = batch[label_key]
+        mixed, perm, lam = mixup_cutmix(
+            k_mix, imgs, mixup_alpha=mixup_alpha,
+            cutmix_alpha=cutmix_alpha, switch_prob=switch_prob,
+        )
+        logits, new_stats = _classifier_forward(
+            model, params, batch_stats, mixed, k_model
+        )
+        loss = lam * cross_entropy(logits, labels, label_smoothing) + (
+            1.0 - lam
+        ) * cross_entropy(logits, labels[perm], label_smoothing)
+        if weight_decay:
+            loss = loss + _l2_penalty(params, weight_decay)
+        return loss, {
+            "metrics": {
+                "loss": loss,
+                "accuracy": accuracy(logits, labels),
+                "lam": lam,
+            },
+            "batch_stats": new_stats,
+        }
+
+    return loss_fn
+
+
+def masked_lm_loss_fn(
+    model,
+    *,
+    mask_token_id: int,
+    vocab_size: int,
+    mask_prob: float = 0.15,
+    ids_key: str = "input_ids",
+    attention_mask_key: str = "attention_mask",
+) -> Callable:
+    """BERT MLM pretraining loss with RoBERTa-style DYNAMIC masking: the
+    host ships raw token ids; every step draws a fresh 80/10/10 masking
+    from the step rng on device (``models.mask_tokens``) and scores
+    cross-entropy over the selected positions only. Special positions
+    are protected via the batch's optional ``special_mask`` ([B, S]
+    bool, True = never mask); padding (attention_mask False) is always
+    protected. Reports ``loss``, masked-position ``accuracy``, and the
+    realized ``mask_frac``."""
+    from pytorch_distributed_tpu.models.bert import mask_tokens
+
+    def loss_fn(params, batch_stats, batch, rng):
+        del batch_stats
+        k_mask, k_model = jax.random.split(rng)
+        ids = batch[ids_key]
+        attn = batch.get(attention_mask_key)
+        special = batch.get("special_mask")
+        protect = None
+        if special is not None:
+            protect = special.astype(jnp.bool_)
+        if attn is not None:
+            pad = ~attn.astype(jnp.bool_)
+            protect = pad if protect is None else (protect | pad)
+        masked_ids, labels = mask_tokens(
+            k_mask, ids, mask_token_id=mask_token_id,
+            vocab_size=vocab_size, mask_prob=mask_prob,
+            special_mask=protect,
+        )
+        logits = model.apply(
+            {"params": params}, masked_ids, attn,
+            batch.get("token_type_ids"), train=True,
+            rngs={"dropout": k_model},
+        )
+        sel = labels != -100
+        w = sel.astype(jnp.float32)
+        denom = jnp.maximum(jnp.sum(w), 1.0)
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), jnp.maximum(labels, 0)
+        )
+        loss = jnp.sum(per_tok * w) / denom
+        acc = jnp.sum(
+            (jnp.argmax(logits, -1) == labels).astype(jnp.float32) * w
+        ) / denom
+        return loss, {
+            "metrics": {
+                "loss": loss,
+                "accuracy": acc,
+                "mask_frac": jnp.mean(w),
+            },
+            "batch_stats": None,
         }
 
     return loss_fn

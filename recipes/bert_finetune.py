@@ -49,6 +49,11 @@ def parse_args(argv=None):
     p.add_argument("--num-labels", type=int, default=2)
     p.add_argument("--dp", type=int, default=-1)
     p.add_argument("--tiny", action="store_true", help="tiny config (smoke)")
+    p.add_argument("--mlm", action="store_true",
+                   help="masked-LM pretraining objective instead of the "
+                        "classification fine-tune (dynamic 80/10/10 "
+                        "masking on device)")
+    p.add_argument("--mask-prob", type=float, default=0.15)
     p.add_argument("--fp16", action="store_true",
                    help="fp16 + real dynamic loss scaling instead of bf16")
     p.add_argument("--steps-per-epoch", type=int, default=None)
@@ -75,7 +80,20 @@ def main(argv=None):
     amp_dtype = jnp.float16 if args.fp16 else jnp.bfloat16
     scaler = ptd.GradScaler(dtype=amp_dtype)
     with ptd.autocast(dtype=amp_dtype):
-        model = BertForSequenceClassification(cfg, num_labels=args.num_labels)
+        if args.mlm:
+            from pytorch_distributed_tpu.models import BertForMaskedLM
+            from pytorch_distributed_tpu.train import masked_lm_loss_fn
+
+            model = BertForMaskedLM(cfg)
+            loss_fn = masked_lm_loss_fn(
+                model, mask_token_id=min(103, cfg.vocab_size - 1),
+                vocab_size=cfg.vocab_size, mask_prob=args.mask_prob,
+            )
+        else:
+            model = BertForSequenceClassification(
+                cfg, num_labels=args.num_labels
+            )
+            loss_fn = text_classification_loss_fn(model)
         variables = model.init(
             jax.random.key(args.seed),
             jnp.zeros((1, seq_len), jnp.int32),
@@ -92,9 +110,7 @@ def main(argv=None):
             scaler_state=scaler.init_state(),
         )
         strategy = DataParallel(extra_rules=bert_partition_rules())
-        train_step = build_train_step(
-            text_classification_loss_fn(model), scaler=scaler
-        )
+        train_step = build_train_step(loss_fn, scaler=scaler)
         trainer = Trainer(
             state,
             strategy,

@@ -161,6 +161,29 @@ class TestResNet:
         assert 25.3e6 < n < 25.8e6, n
 
     @pytest.mark.slow
+    def test_resnet_family_param_counts(self):
+        # torchvision: resnet34 21.80M, resnet101 44.55M, resnet152 60.19M
+        from pytorch_distributed_tpu.models import (
+            ResNet34, ResNet101, ResNet152,
+        )
+
+        for ctor, lo, hi in [
+            (ResNet34, 21.5e6, 22.1e6),
+            (ResNet101, 44.2e6, 44.9e6),
+            (ResNet152, 59.8e6, 60.6e6),
+        ]:
+            model = ctor()
+            v = model.init(
+                jax.random.key(0), jnp.zeros((1, 64, 64, 3)), train=False
+            )
+            n = count_params(v["params"])
+            assert lo < n < hi, (ctor.__name__, n)
+            logits = model.apply(
+                v, jnp.zeros((2, 64, 64, 3)), train=False
+            )
+            assert logits.shape == (2, 1000)
+
+    @pytest.mark.slow
     def test_forward_shapes_and_output_dtype(self):
         model = ResNet18(num_classes=10, stem="cifar")
         v = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False)

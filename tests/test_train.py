@@ -862,3 +862,95 @@ class TestCheckpointRetention:
                 ),
                 config=TrainerConfig(keep_checkpoints=2),
             )
+
+
+class TestMixupCutmix:
+    def test_mixup_is_exact_convex_combination(self):
+        from pytorch_distributed_tpu.train.losses import mixup_cutmix
+
+        rng = jax.random.key(3)
+        imgs = jnp.asarray(
+            np.random.default_rng(0).normal(size=(8, 6, 6, 3))
+        ).astype(jnp.float32)
+        mixed, perm, lam = jax.jit(
+            lambda r, x: mixup_cutmix(r, x, mixup_alpha=0.4,
+                                      cutmix_alpha=0.0)
+        )(rng, imgs)
+        lam_f = float(lam)
+        assert 0.0 <= lam_f <= 1.0
+        np.testing.assert_allclose(
+            np.asarray(mixed),
+            lam_f * np.asarray(imgs) + (1 - lam_f) * np.asarray(imgs)[np.asarray(perm)],
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_cutmix_pixels_come_from_exactly_one_source(self):
+        from pytorch_distributed_tpu.train.losses import mixup_cutmix
+
+        imgs = jnp.asarray(
+            np.random.default_rng(1).normal(size=(8, 12, 12, 3))
+        ).astype(jnp.float32)
+        saw_box = False
+        for seed in range(6):
+            mixed, perm, lam = mixup_cutmix(
+                jax.random.key(seed), imgs, mixup_alpha=0.0,
+                cutmix_alpha=1.0,
+            )
+            a = np.asarray(imgs)
+            b = a[np.asarray(perm)]
+            m = np.asarray(mixed)
+            from_a = np.isclose(m, a).all(axis=-1)
+            from_b = np.isclose(m, b).all(axis=-1)
+            assert (from_a | from_b).all()
+            # lam == fraction NOT replaced (paper's area adjustment);
+            # verify against the actual box for a non-self-paired row
+            frac_b = from_b[0].mean() if np.asarray(perm)[0] != 0 else None
+            if frac_b is not None and 0.0 < float(lam) < 1.0:
+                assert abs((1.0 - float(lam)) - frac_b) < 0.35, (
+                    lam, frac_b,
+                )  # loose: from_a/from_b overlap where a==b coincidentally
+                saw_box = True
+        assert saw_box
+
+    def test_loss_fn_trains_and_reports_lam(self):
+        import flax.linen as nn
+        from pytorch_distributed_tpu.train import (
+            mixup_classification_loss_fn,
+        )
+
+        class Tiny(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=False):
+                return nn.Dense(4)(x.mean(axis=(1, 2)))
+
+        m = Tiny()
+        imgs = jnp.asarray(
+            np.random.default_rng(0).normal(size=(16, 8, 8, 3))
+        ).astype(jnp.float32)
+        labels = jnp.asarray(np.random.default_rng(1).integers(4, size=16))
+        v = m.init(jax.random.key(0), imgs[:1])
+        state = TrainState.create(
+            apply_fn=m.apply, params=v["params"], tx=optax.adam(5e-3)
+        )
+        step = jax.jit(build_train_step(mixup_classification_loss_fn(
+            m, mixup_alpha=0.3, cutmix_alpha=1.0, switch_prob=0.5
+        )))
+        losses, lams = [], []
+        batch = {"image": imgs, "label": labels}
+        for _ in range(30):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+            lams.append(float(metrics["lam"]))
+        assert min(lams) >= 0.0 and max(lams) <= 1.0
+        assert len(set(round(x, 6) for x in lams)) > 5  # lam varies by step
+        assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+    def test_requires_some_alpha(self):
+        from pytorch_distributed_tpu.train import (
+            mixup_classification_loss_fn,
+        )
+
+        with pytest.raises(ValueError):
+            mixup_classification_loss_fn(
+                object(), mixup_alpha=0.0, cutmix_alpha=0.0
+            )
