@@ -7,7 +7,7 @@ framework's parameter trees so those weights keep working:
 * :func:`load_gpt2_weights`  — ``transformers.GPT2LMHeadModel``
 * :func:`load_llama_weights` — ``transformers.LlamaForCausalLM``
 * :func:`load_bert_weights`  — ``transformers.BertModel`` /
-  ``BertForSequenceClassification``
+  ``BertForSequenceClassification`` / ``BertForMaskedLM`` (tied decoder)
 * :func:`load_vit_weights`   — ``transformers.ViTForImageClassification``
 
 and the inverse direction (:func:`export_gpt2_weights`,
@@ -316,6 +316,10 @@ def load_bert_weights(sd: StateDict, cfg, *, num_labels: int | None = None) -> D
     With ``num_labels`` (and a ``classifier.*`` in ``sd``, i.e. an HF
     ``BertForSequenceClassification``), returns the tree for
     :class:`BertForSequenceClassification` instead (trunk under "bert").
+    An HF ``BertForMaskedLM`` state_dict (detected by its
+    ``cls.predictions.transform.*`` keys) yields the
+    :class:`BertForMaskedLM` tree — the tied decoder weight transfers
+    via the trunk's embedding table; only the free bias is extra.
     """
     pre = "bert." if any(k.startswith("bert.") for k in sd) else ""
     H, D = cfg.num_heads, cfg.hidden_size
@@ -324,6 +328,20 @@ def load_bert_weights(sd: StateDict, cfg, *, num_labels: int | None = None) -> D
     ln = lambda key: _ln_in(sd, key)  # noqa: E731
     head_proj = lambda key: _headproj_in(sd, key, D, H, hd)  # noqa: E731
 
+    is_mlm = "cls.predictions.transform.dense.weight" in sd
+    if pre + "pooler.dense.weight" in sd:
+        pooler = lin(pre + "pooler.dense")
+    elif is_mlm:
+        # HF BertForMaskedLM ships add_pooling_layer=False; our trunk
+        # always materializes the pooler (the MLM head never reads it) —
+        # zeros keep shapes valid without inventing weights. Any OTHER
+        # poolerless state_dict still fails loudly below.
+        pooler = {
+            "kernel": np.zeros((D, D), np.float32),
+            "bias": np.zeros((D,), np.float32),
+        }
+    else:
+        pooler = lin(pre + "pooler.dense")  # raises with a clear KeyError
     trunk = {
         "word_embeddings": {
             "embedding": _np(sd, pre + "embeddings.word_embeddings.weight")
@@ -337,7 +355,7 @@ def load_bert_weights(sd: StateDict, cfg, *, num_labels: int | None = None) -> D
             )
         },
         "embed_ln": ln(pre + "embeddings.LayerNorm"),
-        "pooler": lin(pre + "pooler.dense"),
+        "pooler": pooler,
     }
     for i in range(cfg.num_layers):
         p = f"{pre}encoder.layer.{i}."
@@ -357,9 +375,19 @@ def load_bert_weights(sd: StateDict, cfg, *, num_labels: int | None = None) -> D
             "mlp_down": lin(p + "output.dense"),
             "mlp_ln": ln(p + "output.LayerNorm"),
         }
-    if num_labels is None:
-        return trunk
-    return {"bert": trunk, "classifier": lin("classifier")}
+    if num_labels is not None:
+        return {"bert": trunk, "classifier": lin("classifier")}
+    if is_mlm:
+        # HF BertForMaskedLM: transform + LayerNorm + tied decoder. The
+        # decoder.weight is the embedding table (tying) — our model reads
+        # it from the trunk, so only the free bias transfers.
+        return {
+            "bert": trunk,
+            "mlm_dense": lin("cls.predictions.transform.dense"),
+            "mlm_ln": ln("cls.predictions.transform.LayerNorm"),
+            "mlm_bias": _np(sd, "cls.predictions.bias"),
+        }
+    return trunk
 
 
 def export_bert_weights(params, cfg) -> Dict[str, Array]:
@@ -372,8 +400,9 @@ def export_bert_weights(params, cfg) -> Dict[str, Array]:
     exports ``BertModel``-style with no prefix.
     """
     classifier = params.get("classifier") if "bert" in params else None
+    mlm = "mlm_dense" in params
     trunk = params["bert"] if "bert" in params else params
-    pre = "bert." if classifier is not None else ""
+    pre = "bert." if (classifier is not None or mlm) else ""
     D = cfg.hidden_size
     sd: Dict[str, Array] = {}
     lin = lambda key, p: _lin_out(sd, key, p)  # noqa: E731
@@ -390,6 +419,10 @@ def export_bert_weights(params, cfg) -> Dict[str, Array]:
         trunk["token_type_embeddings"]["embedding"]
     )
     ln(pre + "embeddings.LayerNorm", trunk["embed_ln"])
+    # always emitted, MLM trees included, so export->import is the exact
+    # inverse for natively-trained params too; HF BertForMaskedLM is
+    # poolerless (add_pooling_layer=False), so load there with
+    # strict=False (the only ignored keys are these two)
     lin(pre + "pooler.dense", trunk["pooler"])
     for i in range(cfg.num_layers):
         p = f"{pre}encoder.layer.{i}."
@@ -409,6 +442,16 @@ def export_bert_weights(params, cfg) -> Dict[str, Array]:
         ln(p + "output.LayerNorm", lyr["mlp_ln"])
     if classifier is not None:
         lin("classifier", classifier)
+    if mlm:
+        lin("cls.predictions.transform.dense", params["mlm_dense"])
+        ln("cls.predictions.transform.LayerNorm", params["mlm_ln"])
+        sd["cls.predictions.bias"] = np.asarray(params["mlm_bias"])
+        # HF materializes the tied decoder (plus its bias alias) in the
+        # state_dict; emit both so sd loads into HF without missing keys
+        sd["cls.predictions.decoder.weight"] = np.asarray(
+            trunk["word_embeddings"]["embedding"]
+        )
+        sd["cls.predictions.decoder.bias"] = np.asarray(params["mlm_bias"])
     return sd
 
 

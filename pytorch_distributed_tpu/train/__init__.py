@@ -20,6 +20,7 @@ from pytorch_distributed_tpu.train.losses import (
     mixup_classification_loss_fn,
     text_classification_loss_fn,
     cross_entropy,
+    topk_accuracy,
     accuracy,
 )
 from pytorch_distributed_tpu.train.checkpoint import (
@@ -52,6 +53,7 @@ __all__ = [
     "causal_lm_loss_fn",
     "text_classification_loss_fn",
     "cross_entropy",
+    "topk_accuracy",
     "accuracy",
     "save_checkpoint",
     "restore_checkpoint",

@@ -31,6 +31,16 @@ def accuracy(logits, labels):
     return jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
 
 
+def topk_accuracy(logits, labels, k: int = 5):
+    """Top-k accuracy (the ImageNet top-5 companion metric to
+    BASELINE.json:5's top-1). ``k`` clamps to the class count."""
+    k = min(k, logits.shape[-1])
+    _, idx = jax.lax.top_k(logits, k)
+    return jnp.mean(
+        jnp.any(idx == labels[..., None], axis=-1).astype(jnp.float32)
+    )
+
+
 def _classifier_forward(model, params, batch_stats, imgs, rng):
     """Train-mode forward with optional mutable BatchNorm state — the
     single definition every classifier loss shares. Returns
@@ -539,9 +549,14 @@ def classification_eval_step(
         if state.batch_stats is not None:
             variables["batch_stats"] = state.batch_stats
         logits = model.apply(variables, batch[image_key], train=False)
-        return {
+        out = {
             "loss": cross_entropy(logits, batch[label_key]),
             "accuracy": accuracy(logits, batch[label_key]),
         }
+        if logits.shape[-1] > 5:
+            out["top5_accuracy"] = topk_accuracy(
+                logits, batch[label_key], k=5
+            )
+        return out
 
     return eval_step

@@ -452,3 +452,85 @@ def test_gpt2_no_repeat_ngram_matches_hf():
                 )
             )
         np.testing.assert_array_equal(got, want, err_msg=f"ngram={ngram}")
+
+
+def test_bert_mlm_matches_hf_and_roundtrips():
+    """HF BertForMaskedLM import: logit parity (tied decoder via the
+    trunk embedding), and export -> import is the identity."""
+    from pytorch_distributed_tpu.interop import (
+        export_bert_weights,
+        load_bert_weights,
+    )
+    from pytorch_distributed_tpu.models.bert import BertConfig, BertForMaskedLM
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=119, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=96,
+        max_position_embeddings=32, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+    hf = transformers.BertForMaskedLM(hf_cfg).eval()
+    cfg = BertConfig(
+        vocab_size=119, hidden_size=48, num_layers=2, num_heads=4,
+        intermediate_size=96, max_position_embeddings=32,
+        dropout_rate=0.0,
+    )
+    params = load_bert_weights(_sd(hf), cfg)
+    assert "mlm_dense" in params and "mlm_bias" in params
+
+    rng = np.random.default_rng(5)
+    ids = rng.integers(119, size=(2, 11)).astype(np.int32)
+    mask = np.ones((2, 11), np.int64)
+    mask[0, 8:] = 0
+    with torch.no_grad():
+        want = hf(
+            torch.tensor(ids.astype(np.int64)),
+            attention_mask=torch.tensor(mask),
+        ).logits.numpy()
+    with autocast(enabled=False):
+        model = BertForMaskedLM(cfg)
+        got = model.apply(
+            {"params": params}, jnp.asarray(ids),
+            jnp.asarray(mask.astype(np.int32)),
+        )
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+    sd2 = export_bert_weights(params, cfg)
+    # loads into HF (strict=False: HF's MLM is poolerless, so the two
+    # pooler keys are the ONLY unexpected ones; tied decoder + alias
+    # emitted so nothing is missing), and re-import is the identity
+    result = hf.load_state_dict(
+        {k: torch.tensor(v) for k, v in sd2.items()}, strict=False
+    )
+    assert not result.missing_keys, result.missing_keys
+    assert all("pooler" in k for k in result.unexpected_keys), (
+        result.unexpected_keys
+    )
+    params2 = load_bert_weights(sd2, cfg)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        params, params2,
+    )
+
+    # natively-initialized MLM params (real random pooler) roundtrip
+    # exactly too — the pooler is carried, not zeroed
+    native = BertForMaskedLM(cfg).init(
+        jax.random.key(1), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    native3 = load_bert_weights(export_bert_weights(native, cfg), cfg)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=1e-6, atol=0,
+        ),
+        native, native3,
+    )
+    assert np.abs(
+        np.asarray(native3["bert"]["pooler"]["kernel"])
+    ).max() > 0  # the roundtripped pooler is the real one, not zeros
+
+    # a NON-MLM poolerless state_dict still fails loudly
+    bad = {k: v for k, v in _sd(hf).items()
+           if "pooler" not in k and "cls.predictions" not in k}
+    with pytest.raises(KeyError, match="pooler"):
+        load_bert_weights(bad, cfg)

@@ -954,3 +954,17 @@ class TestMixupCutmix:
             mixup_classification_loss_fn(
                 object(), mixup_alpha=0.0, cutmix_alpha=0.0
             )
+
+
+def test_topk_accuracy():
+    from pytorch_distributed_tpu.train.losses import topk_accuracy
+
+    logits = jnp.asarray([
+        [9.0, 5.0, 4.0, 3.0, 2.0, 1.0, 0.0],   # top5 = {0,1,2,3,4}
+        [0.0, 1.0, 2.0, 3.0, 4.0, 5.0, 9.0],   # top5 = {6,5,4,3,2}
+    ])
+    labels = jnp.asarray([4, 1])
+    assert float(topk_accuracy(logits, labels, k=5)) == 0.5
+    assert float(topk_accuracy(logits, labels, k=7)) == 1.0
+    assert float(topk_accuracy(logits, labels, k=99)) == 1.0  # clamps
+    assert float(topk_accuracy(logits, jnp.asarray([0, 6]), k=1)) == 1.0
