@@ -20,6 +20,12 @@ from pytorch_distributed_tpu.ops.lm_loss import (
     causal_lm_chunked_loss,
     chunked_softmax_cross_entropy,
 )
+from pytorch_distributed_tpu.ops.quant import (
+    dequantize_tree,
+    quantize_tree_int8,
+    quantized_apply_fn,
+    quantized_bytes,
+)
 from pytorch_distributed_tpu.ops.moe import (
     MoEMLP,
     collect_aux_loss,
@@ -27,6 +33,10 @@ from pytorch_distributed_tpu.ops.moe import (
 )
 
 __all__ = [
+    "dequantize_tree",
+    "quantize_tree_int8",
+    "quantized_apply_fn",
+    "quantized_bytes",
     "MoEMLP",
     "causal_lm_chunked_loss",
     "chunked_softmax_cross_entropy",

@@ -1,0 +1,123 @@
+"""Weight-only int8 quantization (the bitsandbytes-int8 / GPTQ-lite
+serving idiom, TPU-first).
+
+The torch ecosystem reaches int8 serving through module surgery
+(`bnb.nn.Linear8bitLt` swaps). Under jax the parameters are data, so the
+whole feature is two pure functions over the params pytree:
+
+* :func:`quantize_tree_int8` — symmetric per-output-channel int8 for
+  every >=2-D kernel whose path matches ``include`` (default: all);
+  1-D leaves (biases, norm scales) and embeddings below ``min_size``
+  stay untouched. Each quantized leaf becomes a ``{"q8", "scale"}``
+  subtree, so the result is still one checkpointable pytree.
+* :func:`dequantize_tree` — the inverse (up to quantization error
+  <= scale/2 per element).
+
+``quantized_apply_fn`` wraps a model's apply so the dequantize runs
+INSIDE the jitted step: params rest in HBM at 1 byte/weight (2x smaller
+than bf16, 4x than f32 — an 8B fits a single v5e's 16 GB), and XLA
+fuses the int8->bf16 convert into the consumer where it can. This is a
+STORAGE/capacity feature first; step-time wins depend on XLA fusing the
+dequant, which varies by op — measure before claiming speed.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+_QKEYS = frozenset({"q8", "scale"})
+
+
+def _is_qleaf(x) -> bool:
+    return isinstance(x, dict) and set(x.keys()) == _QKEYS
+
+
+def quantize_tree_int8(
+    params,
+    *,
+    include: Optional[Sequence[str]] = None,
+    min_size: int = 4096,
+):
+    """Quantize matching >=2-D leaves to symmetric per-channel int8.
+
+    ``include``: path regexes (re.search over 'a/b/c' paths); None = all.
+    ``min_size``: leaves with fewer elements stay full precision (tiny
+    kernels don't pay for their scales).
+
+    The scale is per OUTPUT channel (last axis), shaped [1, ..., n]: the
+    flax kernel convention is [in..., out], and per-out-channel scales
+    track the variance structure weight matrices actually have.
+    """
+    regs = [re.compile(p) for p in include] if include is not None else None
+
+    def quant(path, leaf):
+        from pytorch_distributed_tpu.parallel.sharding import path_str
+
+        if _is_qleaf(leaf):
+            return leaf  # idempotent: re-quantizing passes through
+        if leaf.ndim < 2 or leaf.size < min_size:
+            return leaf
+        if regs is not None and not any(
+            r.search(path_str(path)) for r in regs
+        ):
+            return leaf
+        f = leaf.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(f), axis=tuple(range(leaf.ndim - 1)),
+                       keepdims=True)
+        scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+        # symmetric, no zero-point. jnp.round is IEEE half-to-even —
+        # ties break differently from the hostring collective's
+        # half-away-from-zero; irrelevant to the <= scale/2 error bound
+        q = jnp.clip(jnp.round(f / scale), -127, 127).astype(jnp.int8)
+        return {"q8": q, "scale": scale.astype(jnp.float32)}
+
+    return jax.tree_util.tree_map_with_path(quant, params,
+                                            is_leaf=lambda x: _is_qleaf(x))
+
+
+def dequantize_tree(qparams, dtype=None):
+    """Inverse of :func:`quantize_tree_int8`; untouched leaves pass
+    through. ``dtype`` overrides the reconstructed dtype (default f32;
+    pass the model's compute dtype when calling inside a jitted step)."""
+
+    def dq(leaf):
+        if _is_qleaf(leaf):
+            out = leaf["q8"].astype(jnp.float32) * leaf["scale"]
+            return out.astype(dtype or jnp.float32)
+        return leaf
+
+    return jax.tree_util.tree_map(dq, qparams, is_leaf=_is_qleaf)
+
+
+def quantized_bytes(qparams) -> int:
+    """Resident bytes of the (possibly partially) quantized tree."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(
+        qparams, is_leaf=_is_qleaf
+    ):
+        if _is_qleaf(leaf):
+            total += leaf["q8"].size + leaf["scale"].size * 4
+        else:
+            total += leaf.size * leaf.dtype.itemsize
+    return total
+
+
+def quantized_apply_fn(model, dtype=None):
+    """An ``apply_fn(variables, *args, **kw)`` that dequantizes inside
+    the traced computation — drop-in wherever a model's ``.apply`` goes
+    (generation, eval steps). Keeps the int8 tree as the resident
+    arrays; the bf16 kernels exist only transiently inside the step."""
+
+    def apply_fn(variables, *args, **kwargs):
+        variables = dict(variables)
+        variables["params"] = dequantize_tree(
+            variables["params"], dtype=dtype
+        )
+        return model.apply(variables, *args, **kwargs)
+
+    return apply_fn
