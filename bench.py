@@ -38,6 +38,7 @@ ratio of its moved bytes/s to this host's own measured 1-core memcpy
 bandwidth (a self-calibrated target, not a throughput-vs-A100 fraction).
 """
 
+import dataclasses
 import json
 import os
 import sys
@@ -366,7 +367,15 @@ def bench_gpt2(on_tpu: bool) -> None:
     )
 
     if on_tpu:
-        cfg, batch, seq = GPT2Config.medium(), 8, 1024
+        # remat is mandatory at this shape: without it the scanned
+        # 24-layer backward saves the [L,B,S,S] attention activations —
+        # 37 GB against v5e's 15.75 GB HBM (measured OOM, r3). Full-block
+        # remat trades ~1/3 extra forward FLOPs for an ~0.4 GB activation
+        # footprint; scripts/gpt2_variants.py times the policy choices.
+        cfg = dataclasses.replace(
+            GPT2Config.medium(), remat=True, remat_policy="full"
+        )
+        batch, seq = 8, 1024
         warmup, iters = 3, 20
     else:
         import math
@@ -543,11 +552,16 @@ def bench_dp_step_overhead(on_tpu: bool) -> None:
     )
 
     def mkstate():
+        # private copies: both timed() runs donate their state buffers,
+        # and at world=1 strategy.place() is placement-only (no copy) —
+        # sharing `variables` across runs means the second one feeds
+        # already-deleted arrays (the r3 on-chip failure mode)
+        fresh = jax.tree_util.tree_map(jnp.array, variables)
         return TrainState.create(
             apply_fn=model.apply,
-            params=variables["params"],
+            params=fresh["params"],
             tx=optax.sgd(0.1, momentum=0.9),
-            batch_stats=variables["batch_stats"],
+            batch_stats=fresh["batch_stats"],
         )
 
     step_fn = build_train_step(classification_loss_fn(model))

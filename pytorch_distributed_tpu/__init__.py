@@ -71,6 +71,7 @@ from pytorch_distributed_tpu.runtime.precision import (
 )
 from pytorch_distributed_tpu.runtime.prng import RngSeq, seed_all
 from pytorch_distributed_tpu.generation import generate, generate_beam, sample_logits
+from pytorch_distributed_tpu.speculative import generate_speculative
 from pytorch_distributed_tpu import optim
 from pytorch_distributed_tpu.launch import (
     ElasticAgent,
@@ -117,6 +118,7 @@ __all__ = [
     "enable_compilation_cache",
     "generate",
     "generate_beam",
+    "generate_speculative",
     "optim",
     "sample_logits",
     "Policy",

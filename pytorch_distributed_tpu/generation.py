@@ -84,16 +84,24 @@ def sample_logits(
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def model_max_len(model):
+    """The model's position/cache capacity, or None when untyped —
+    one extraction point shared by generate/generate_beam/
+    generate_speculative so a new model family's limit attribute only
+    needs teaching here."""
+    cfg = getattr(model, "config", None)
+    return getattr(cfg, "n_positions", None) or getattr(
+        cfg, "max_seq_len", None
+    )
+
+
 def _generation_limits(model, P, max_new_tokens):
     """Shared validation for generate/generate_beam: positive token count
     and prompt+new within the model's position/cache capacity. Returns
     the cache length."""
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
-    cfg = getattr(model, "config", None)
-    limit = getattr(cfg, "n_positions", None) or getattr(
-        cfg, "max_seq_len", None
-    )
+    limit = model_max_len(model)
     if limit is not None and P + max_new_tokens > limit:
         # past the cache/position table the dynamic_update_slice clamps
         # and gathers clamp — silent garbage, so refuse up front

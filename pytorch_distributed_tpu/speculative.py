@@ -1,0 +1,268 @@
+"""Speculative decoding — draft proposes, target verifies, TPU-static.
+
+Decode throughput on TPU is HBM-bandwidth-bound: every generated token
+re-reads the full parameter set to do a [B,1]-width matmul the MXU
+mostly idles through. Speculative decoding (Leviathan et al. 2023)
+converts that bandwidth into tokens: a small DRAFT model proposes
+``num_draft_tokens`` continuations one token at a time (cheap weights),
+then the TARGET model scores the whole proposal in ONE chunked forward
+([B, k+1] width rides the MXU for roughly the cost of a single decode
+step). The longest prefix where the target's own greedy choice agrees
+with the draft is accepted, plus the target's correction token — so
+each target pass emits between 1 and k+1 tokens, and the output is
+**exactly** the target model's greedy decode, whatever the draft does.
+
+TPU shape discipline (the part that differs from CUDA engines):
+
+* **No cache rewind.** Rejected draft tokens are never erased from the
+  KV cache — their slots are marked invalid in a per-row ``kv_mask``
+  and every later query masks them out. Cache slots are append-only
+  (``dynamic_update_slice`` at a monotone offset), which keeps every
+  shape static and the whole loop one compile. The cost is slot
+  "bubbles": the cache must be sized for the worst case of one
+  accepted token per round, ``P + (max_new - 1) * (k+1)`` slots.
+  Serving engines compact; we trade HBM for static shapes.
+* **Per-row progress, lockstep slots.** Rows accept different prefix
+  lengths but write the same slot range every round (the ragged
+  left-padding machinery generalized to interior bubbles): positions
+  are per-row REAL token counts (RoPE/wpe stay exact), the slot-index
+  causal mask orders within-round queries, and the kv_mask carries
+  per-row validity of everything before.
+* **``lax.while_loop`` over rounds** (trip count is data-dependent:
+  high acceptance finishes in ``~max_new/(k+1)`` rounds), with a
+  ``lax.scan`` of single-token draft steps inside.
+
+Greedy only (``temperature=0``): greedy acceptance is the case with an
+exact-equality guarantee, which the tests pin token-for-token against
+``generate``. Sampled speculative decoding (rejection sampling against
+the draft distribution) is a semantic superset left unimplemented
+rather than approximated — it would be *distributionally* correct but
+not comparable token-for-token, and silently switching equality classes
+is how serving bugs hide.
+
+Works with any pair of models sharing the ``generate`` decode contract
+(``decode=True``, ``cache_len``, ``positions``, ``kv_mask`` — GPT2LMHead,
+LlamaForCausalLM) and one vocabulary.
+
+The reference repo (a training-recipes collection, BASELINE.json:5) has
+no inference engine; this is a beyond-parity capability like
+generation.py itself.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pytorch_distributed_tpu.generation import model_max_len
+
+
+def generate_speculative(
+    target_model,
+    target_params,
+    draft_model,
+    draft_params,
+    prompt_ids: jnp.ndarray,
+    *,
+    max_new_tokens: int,
+    num_draft_tokens: int = 4,
+    temperature: float = 0.0,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
+    return_stats: bool = False,
+):
+    """Greedy-decode ``max_new_tokens`` from ``target_model``, accelerated
+    by ``draft_model`` proposals. Returns [B, P + max_new_tokens], equal
+    token-for-token to ``generate(target_model, ..., temperature=0)``;
+    sequences that hit ``eos_id`` are padded with ``pad_id`` after it.
+
+    ``return_stats`` additionally returns ``{"rounds": R, "drafted": D,
+    "accepted": A}`` (host ints): R target passes emitted the sequence
+    (R == max_new - 1 means the draft never helped; R ~= max_new/(k+1)
+    means it nearly always did), A of D proposed draft tokens were
+    accepted.
+    """
+    if temperature != 0.0:
+        raise NotImplementedError(
+            "speculative decoding is greedy-only (temperature=0): sampled "
+            "acceptance needs draft-distribution rejection sampling, which "
+            "is distribution-equal but not token-for-token comparable — "
+            "use generate() for sampling"
+        )
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    k = num_draft_tokens
+    if k < 1:
+        raise ValueError(f"num_draft_tokens must be >= 1, got {k}")
+
+    B, P = prompt_ids.shape
+    # worst case (one accepted token per round): the prefill emits the
+    # first token, so at most max_new-1 rounds run, each appending k+1
+    # slots to BOTH caches (the draft's (k+1)-th feed INPUTS its final
+    # proposal purely to cache that token's K/V — without it, a fully
+    # accepted round leaves a context hole in the draft's cache and
+    # acceptance quietly degrades). Bubbles are the static-shape tax —
+    # see module docstring.
+    cache_t = cache_d = P + (max_new_tokens - 1) * (k + 1)
+    for name, model in (("target", target_model), ("draft", draft_model)):
+        limit = model_max_len(model)
+        if limit is not None and cache_t > limit:
+            raise ValueError(
+                f"{name} model needs {cache_t} cache slots in the worst "
+                f"case (prompt {P} + {max_new_tokens - 1} rounds x "
+                f"{k + 1} append-only slots) but its maximum length is "
+                f"{limit}; shrink max_new_tokens or num_draft_tokens — "
+                f"rejected-slot bubbles are the price of static shapes "
+                f"(module docstring)"
+            )
+
+    N = P + max_new_tokens
+    idx = jnp.arange(k + 1)[None, :]  # [1, k+1] chunk-slot indices
+
+    # ---- prefill both models on the (unpadded) prompt -------------------
+    t_logits, t_state = target_model.apply(
+        {"params": target_params}, prompt_ids, decode=True,
+        cache_len=cache_t, mutable=["cache"],
+    )
+    _, d_state = draft_model.apply(
+        {"params": draft_params}, prompt_ids, decode=True,
+        cache_len=cache_d, mutable=["cache"],
+    )
+    tok0 = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
+
+    out = jnp.full((B, N), pad_id, jnp.int32)
+    out = out.at[:, :P].set(prompt_ids.astype(jnp.int32))
+    out = out.at[:, P].set(tok0)
+    emitted = jnp.ones((B,), jnp.int32)
+    done = (
+        (tok0 == eos_id) if eos_id is not None
+        else jnp.zeros((B,), jnp.bool_)
+    ) | (emitted >= max_new_tokens)
+    # slot validity; future slots stay True (the slot-causal q_offset mask
+    # hides the unwritten tail — same convention as generate's ragged path)
+    mask_t = jnp.ones((B, cache_t), jnp.bool_)
+    mask_d = jnp.ones((B, cache_d), jnp.bool_)
+
+    carry = dict(
+        out=out, emitted=emitted, done=done, x_last=tok0,
+        cache_t=t_state["cache"], cache_d=d_state["cache"],
+        mask_t=mask_t, mask_d=mask_d,
+        c_t=jnp.int32(P), c_d=jnp.int32(P),  # next write slot per cache
+        rounds=jnp.int32(0), drafted=jnp.int32(0), accepted=jnp.int32(0),
+    )
+
+    def cond(c):
+        return jnp.any(~c["done"])
+
+    def body(c):
+        # position of x_last = its index in `out` (real tokens only; slot
+        # bubbles never shift positions)
+        base_pos = P + c["emitted"] - 1  # [B]
+
+        # ---- draft: k+1 sequential single-token greedy steps ------------
+        # the first k OUTPUTS are the proposals; the final step inputs
+        # the last proposal so its K/V lands in the cache (mirroring the
+        # target's slot layout) and its own output is discarded
+        def dstep(dc, j):
+            dcache, tok = dc
+            logits, st = draft_model.apply(
+                {"params": draft_params, "cache": dcache},
+                tok[:, None], decode=True, cache_len=cache_d,
+                positions=(base_pos + j)[:, None], kv_mask=c["mask_d"],
+                mutable=["cache"],
+            )
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return (st["cache"], nxt), nxt
+
+        (cache_d_new, _), drafts = lax.scan(
+            dstep, (c["cache_d"], c["x_last"]), jnp.arange(k + 1),
+            length=k + 1,
+        )
+        drafts = drafts.T[:, :k]  # [B, k]
+
+        # ---- target: one chunked pass scores the whole proposal ---------
+        chunk = jnp.concatenate([c["x_last"][:, None], drafts], axis=1)
+        logits, t_st = target_model.apply(
+            {"params": target_params, "cache": c["cache_t"]},
+            chunk, decode=True, cache_len=cache_t,
+            positions=base_pos[:, None] + idx, kv_mask=c["mask_t"],
+            mutable=["cache"],
+        )
+        preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, k+1]
+        # preds[:, j] = target's greedy choice after chunk[:, :j+1] —
+        # compare with the draft's j-th proposal; accept the agreeing
+        # prefix, then take the target's own token as the correction
+        match = drafts == preds[:, :k]
+        a = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        corr = jnp.take_along_axis(preds, a[:, None], axis=1)  # [B, 1]
+        drafts_ext = jnp.concatenate(
+            [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1
+        )
+        emit_tok = jnp.where(idx < a[:, None], drafts_ext, corr)
+
+        # emission count: a+1 target-exact tokens, truncated at eos and at
+        # the max_new horizon — both truncations finish the row, so the
+        # "newest token's K/V is not yet cached" invariant survives for
+        # every row that keeps decoding
+        n_emit = a + 1
+        if eos_id is not None:
+            is_eos = (emit_tok == eos_id) & (idx < n_emit[:, None])
+            hit = jnp.any(is_eos, axis=1)
+            first = jnp.argmax(is_eos, axis=1)
+            n_emit = jnp.where(hit, first + 1, n_emit)
+        remaining = max_new_tokens - c["emitted"]
+        n_emit = jnp.minimum(n_emit, remaining)
+        n_emit = jnp.where(c["done"], 0, n_emit)
+        live = idx < n_emit[:, None]  # [B, k+1]
+
+        rows = jnp.broadcast_to(jnp.arange(B)[:, None], (B, k + 1))
+        cols = P + c["emitted"][:, None] + idx
+        out = c["out"].at[
+            rows, jnp.where(live, cols, N)
+        ].set(emit_tok, mode="drop")
+
+        # ---- slot validity for this round's appended K/V ----------------
+        # valid = x_last (slot 0; already-emitted context) + accepted
+        # drafts; the correction token was an OUTPUT, its K/V enters next
+        # round as x_last. Already-done rows keep full history valid and
+        # their (discarded) round writes valid too — never all-masked, so
+        # no NaN softmax rows.
+        ok = (idx == 0) | (idx - 1 < a[:, None])  # [B, k+1]
+        mask_t = lax.dynamic_update_slice(c["mask_t"], ok, (0, c["c_t"]))
+        mask_d = lax.dynamic_update_slice(c["mask_d"], ok, (0, c["c_d"]))
+
+        emitted = c["emitted"] + n_emit
+        done = c["done"] | (emitted >= max_new_tokens)
+        if eos_id is not None:
+            done = done | jnp.any(
+                (emit_tok == eos_id) & live, axis=1
+            )
+        last = jnp.take_along_axis(
+            emit_tok, jnp.maximum(n_emit - 1, 0)[:, None], axis=1
+        )[:, 0]
+        x_last = jnp.where(c["done"], c["x_last"], last)
+
+        active = (~c["done"]).astype(jnp.int32)
+        return dict(
+            out=out, emitted=emitted, done=done, x_last=x_last,
+            cache_t=t_st["cache"], cache_d=cache_d_new,
+            mask_t=mask_t, mask_d=mask_d,
+            c_t=c["c_t"] + (k + 1), c_d=c["c_d"] + (k + 1),
+            rounds=c["rounds"] + 1,
+            drafted=c["drafted"] + k * jnp.sum(active),
+            accepted=c["accepted"] + jnp.sum(a * active),
+        )
+
+    final = lax.while_loop(cond, body, carry)
+    out = final["out"].astype(prompt_ids.dtype)
+    if return_stats:
+        stats = {
+            "rounds": int(final["rounds"]),
+            "drafted": int(final["drafted"]),
+            "accepted": int(final["accepted"]),
+        }
+        return out, stats
+    return out

@@ -1,8 +1,12 @@
 """GPT-2-medium train-step variant timing on the real chip.
 
 Decides bench.py's transformer configuration from measurements, not
-guesses: times the train step across {xla, flash} attention x {full,
-chunked} loss at the bench shape (batch 8, seq 1024). Run ON THE CHIP
+guesses: times the train step across remat policies x {xla, flash}
+attention x {full, chunked} loss at the bench shape (batch 8, seq 1024).
+Every variant runs remat=True: without remat the scanned 24-layer
+backward saves [L,B,S,S] attention activations — 37 GB against v5e's
+15.75 GB HBM (measured OOM, r3 bench). Flash attention runs LAST (its
+remote compile is the documented relay-wedge hazard). Run ON THE CHIP
 ONLY, never under an external kill timer (BASELINE.md relay-wedge rule);
 budgets its own wall clock via PTD_PROBE_BUDGET_S (default 1500s).
 """
@@ -43,6 +47,10 @@ WARMUP, ITERS = 3, 20
 def time_variant(attn: str, vocab_chunk, model, params, batch):
     set_attention_impl(attn)
     try:
+        # private param copy: the step donates its state, and at world=1
+        # place() is placement-only — sharing the init tree across
+        # variants would feed variant 2 already-deleted arrays
+        params = jax.tree_util.tree_map(jnp.array, params)
         state = TrainState.create(
             apply_fn=model.apply, params=params, tx=optax.adamw(3e-4)
         )
@@ -79,11 +87,18 @@ def main():
     ptd.enable_compilation_cache()
     ptd.init_process_group()
     log(f"platform={ptd.platform()} kind={jax.devices()[0].device_kind}")
+    import dataclasses
+
     cfg = GPT2Config.medium()
-    model = GPT2LMHead(cfg)
-    params = model.init(
+    params = GPT2LMHead(cfg).init(
         jax.random.key(0), jnp.zeros((1, SEQ), jnp.int32)
     )["params"]
+
+    def mkmodel(policy):
+        # remat changes no parameters — one init serves every variant
+        return GPT2LMHead(dataclasses.replace(
+            cfg, remat=True, remat_policy=policy
+        ))
     strategy = DataParallel()
     rng = np.random.default_rng(0)
     batch = strategy.shard_batch(
@@ -94,19 +109,21 @@ def main():
         }
     )
     variants = [
-        ("xla", None),
-        ("xla", 8192),
-        ("flash", None),
-        ("flash", 8192),
+        ("full", "xla", None),
+        ("dots_no_batch", "xla", None),
+        ("full", "xla", 8192),
+        ("full", "flash", None),  # LAST: compile hazard
     ]
-    for attn, chunk in variants:
+    for policy, attn, chunk in variants:
         if time.time() - t0 > BUDGET_S:
             log(f"budget {BUDGET_S:.0f}s spent — skipping remaining")
             break
         try:
-            time_variant(attn, chunk, model, params, batch)
+            log(f"variant remat={policy} attn={attn} chunk={chunk} ...")
+            time_variant(attn, chunk, mkmodel(policy), params, batch)
         except Exception as e:
-            log(f"attn={attn} chunk={chunk} FAILED: {type(e).__name__}: {e}")
+            log(f"remat={policy} attn={attn} chunk={chunk} FAILED: "
+                f"{type(e).__name__}: {e}")
     log("DONE")
 
 

@@ -1,0 +1,158 @@
+"""Speculative decoding (speculative.py): the self-pinning property.
+
+Greedy speculative decoding is EXACTLY the target model's greedy decode
+— the draft only changes how many target forward passes it takes, never
+which tokens come out. Every test here pins ``generate_speculative``
+token-for-token against ``generate(target, temperature=0)`` (itself
+pinned against full recompute in test_generation.py), across draft
+quality (random independent draft = low acceptance; draft == target =
+full acceptance), eos early exit, batch raggedness over rounds, and
+both model families' decode contracts.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import pytorch_distributed_tpu as ptd
+from pytorch_distributed_tpu.generation import generate
+from pytorch_distributed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from pytorch_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from pytorch_distributed_tpu.runtime.mesh import MeshSpec
+from pytorch_distributed_tpu.speculative import generate_speculative
+
+
+def _gpt2_pair(vocab=97, n_positions=96):
+    ptd.init_process_group(mesh_spec=MeshSpec(dp=-1))
+    tcfg = GPT2Config(
+        vocab_size=vocab, n_positions=n_positions, hidden_size=32,
+        num_layers=2, num_heads=2, dropout_rate=0.0,
+    )
+    dcfg = GPT2Config(
+        vocab_size=vocab, n_positions=n_positions, hidden_size=16,
+        num_layers=1, num_heads=2, dropout_rate=0.0,
+    )
+    target = GPT2LMHead(tcfg)
+    draft = GPT2LMHead(dcfg)
+    rng = np.random.default_rng(7)
+    ids = jnp.asarray(rng.integers(vocab, size=(3, 6)).astype(np.int32))
+    tparams = target.init(jax.random.key(0), ids)["params"]
+    dparams = draft.init(jax.random.key(1), ids)["params"]
+    return target, tparams, draft, dparams, ids
+
+
+def test_speculative_equals_target_greedy():
+    # an independently-initialized draft agrees with the target only by
+    # chance — acceptance is mixed, so rounds exercise partial-accept,
+    # zero-accept, and (occasionally) full-accept slot bookkeeping
+    target, tp, draft, dp, ids = _gpt2_pair()
+    want = generate(target, tp, ids, max_new_tokens=12, temperature=0.0)
+    got, stats = generate_speculative(
+        target, tp, draft, dp, ids,
+        max_new_tokens=12, num_draft_tokens=3, return_stats=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert 1 <= stats["rounds"] <= 11  # prefill emits token 1 of 12
+    assert 0 <= stats["accepted"] <= stats["drafted"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("k", [1, 5])
+def test_speculative_equals_target_greedy_draft_widths(k):
+    target, tp, draft, dp, ids = _gpt2_pair()
+    want = generate(target, tp, ids, max_new_tokens=8, temperature=0.0)
+    got = generate_speculative(
+        target, tp, draft, dp, ids,
+        max_new_tokens=8, num_draft_tokens=k,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.slow
+def test_perfect_draft_accepts_everything():
+    # draft == target: every proposal matches, so each round emits k+1
+    # tokens and the loop finishes in ceil((max_new - 1) / (k + 1))
+    # rounds after the prefill token — the whole point of speculation
+    target, tp, _, _, ids = _gpt2_pair()
+    max_new, k = 13, 3
+    want = generate(target, tp, ids, max_new_tokens=max_new,
+                    temperature=0.0)
+    got, stats = generate_speculative(
+        target, tp, target, tp, ids,
+        max_new_tokens=max_new, num_draft_tokens=k, return_stats=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert stats["rounds"] == -(-(max_new - 1) // (k + 1))  # ceil div
+    assert stats["accepted"] == stats["drafted"]
+
+
+@pytest.mark.slow
+def test_speculative_eos_padding_matches():
+    # pick the eos from the target's own output so at least one row
+    # actually terminates early; both paths must then pad identically
+    target, tp, draft, dp, ids = _gpt2_pair()
+    plain = generate(target, tp, ids, max_new_tokens=10, temperature=0.0)
+    eos = int(np.asarray(plain)[0, ids.shape[1] + 4])  # a token row 0 emits
+    want = generate(target, tp, ids, max_new_tokens=10, temperature=0.0,
+                    eos_id=eos, pad_id=0)
+    got = generate_speculative(
+        target, tp, draft, dp, ids,
+        max_new_tokens=10, num_draft_tokens=3, eos_id=eos, pad_id=0,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.slow
+def test_speculative_single_token():
+    # max_new_tokens=1 never enters the verify loop: prefill emits it
+    target, tp, draft, dp, ids = _gpt2_pair()
+    want = generate(target, tp, ids, max_new_tokens=1, temperature=0.0)
+    got = generate_speculative(
+        target, tp, draft, dp, ids, max_new_tokens=1, num_draft_tokens=4,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.slow
+def test_speculative_llama_pair():
+    ptd.init_process_group(mesh_spec=MeshSpec(dp=-1))
+    vocab = 89
+    tcfg = LlamaConfig(
+        vocab_size=vocab, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=64, max_seq_len=128,
+    )
+    dcfg = LlamaConfig(
+        vocab_size=vocab, hidden_size=16, num_layers=1, num_heads=2,
+        num_kv_heads=1, intermediate_size=32, max_seq_len=128,
+    )
+    target, draft = LlamaForCausalLM(tcfg), LlamaForCausalLM(dcfg)
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(vocab, size=(2, 5)).astype(np.int32))
+    tp = target.init(jax.random.key(0), ids)["params"]
+    dp = draft.init(jax.random.key(1), ids)["params"]
+    want = generate(target, tp, ids, max_new_tokens=9, temperature=0.0)
+    got = generate_speculative(
+        target, tp, draft, dp, ids, max_new_tokens=9, num_draft_tokens=3,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_speculative_validation():
+    target, tp, draft, dp, ids = _gpt2_pair()
+    with pytest.raises(NotImplementedError, match="greedy-only"):
+        generate_speculative(
+            target, tp, draft, dp, ids,
+            max_new_tokens=4, temperature=0.7,
+        )
+    with pytest.raises(ValueError, match="cache slots"):
+        # worst-case append-only sizing exceeds n_positions=96
+        generate_speculative(
+            target, tp, draft, dp, ids,
+            max_new_tokens=40, num_draft_tokens=4,
+        )
+    with pytest.raises(ValueError, match="num_draft_tokens"):
+        generate_speculative(
+            target, tp, draft, dp, ids, max_new_tokens=4,
+            num_draft_tokens=0,
+        )
