@@ -29,6 +29,65 @@ import numpy as np
 from jax import lax
 
 
+def _validate_filters(top_k, top_p) -> None:
+    """One home for the sampler-filter argument checks, shared by
+    sample_logits (which must also raise on the greedy early-return
+    path) and filter_logits (so direct consumers like speculative
+    decoding are guarded without routing through sample_logits)."""
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+
+
+def filter_logits(
+    logits: jnp.ndarray,
+    *,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> jnp.ndarray:
+    """Temperature-scaled, k/p-filtered f32 logits ([..., vocab]).
+
+    The exact distribution ``sample_logits`` draws from, exposed so
+    rejection-sampling consumers (speculative decoding) can compute the
+    same probabilities the sampler uses. ``temperature`` must be > 0
+    (greedy has no distribution to filter).
+    """
+    if temperature <= 0.0:
+        raise ValueError(
+            f"filter_logits needs temperature > 0, got {temperature}"
+        )
+    _validate_filters(top_k, top_p)
+    if top_k is not None:
+        # HF clamps k to the vocab size; without this, k >= vocab fails
+        # with an opaque out-of-bounds index at trace time
+        top_k = min(top_k, logits.shape[-1])
+    neg_inf = jnp.finfo(jnp.float32).min
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None or top_p is not None:
+        # one descending sort serves both filters
+        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    if top_k is not None:
+        kth = sorted_desc[..., top_k - 1][..., None]
+        logits = jnp.where(logits < kth, neg_inf, logits)
+        sorted_desc = jnp.where(
+            jnp.arange(sorted_desc.shape[-1]) < top_k, sorted_desc, neg_inf
+        )
+    if top_p is not None:
+        # a token survives if the cumulative probability BEFORE it is
+        # still < top_p (so the top token always survives)
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum_before = jnp.cumsum(probs, axis=-1) - probs
+        keep = cum_before < top_p
+        # threshold = smallest surviving logit per row
+        thresh = jnp.min(
+            jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < thresh, neg_inf, logits)
+    return logits
+
+
 def sample_logits(
     logits: jnp.ndarray,
     rng: Optional[jax.Array],
@@ -43,44 +102,16 @@ def sample_logits(
     sampler: k-filter first, then keep the smallest prefix of the
     probability-sorted vocab whose mass reaches ``top_p``.
     """
-    if top_p is not None and not 0.0 < top_p <= 1.0:
-        # validate before the greedy early-return so a bad config is loud
-        # even while smoke-testing with temperature=0
-        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-    if top_k is not None:
-        if top_k < 1:
-            raise ValueError(f"top_k must be >= 1, got {top_k}")
-        # HF clamps k to the vocab size; without this, k >= vocab fails
-        # with an opaque out-of-bounds index at trace time
-        top_k = min(top_k, logits.shape[-1])
+    # validate before the greedy early-return so a bad config is loud
+    # even while smoke-testing with temperature=0
+    _validate_filters(top_k, top_p)
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if rng is None:
         raise ValueError("sampling with temperature > 0 needs an rng key")
-    neg_inf = jnp.finfo(jnp.float32).min
-    logits = logits.astype(jnp.float32) / temperature
-    if top_k is not None or top_p is not None:
-        # one descending sort serves both filters (this runs inside the
-        # decode scan — at 128K vocab a second sort per token is real money)
-        sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
-    if top_k is not None:
-        kth = sorted_desc[:, top_k - 1][:, None]
-        logits = jnp.where(logits < kth, neg_inf, logits)
-        sorted_desc = jnp.where(
-            jnp.arange(sorted_desc.shape[-1])[None, :] < top_k,
-            sorted_desc, neg_inf,
-        )
-    if top_p is not None:
-        # a token survives if the cumulative probability BEFORE it is
-        # still < top_p (so the top token always survives)
-        probs = jax.nn.softmax(sorted_desc, axis=-1)
-        cum_before = jnp.cumsum(probs, axis=-1) - probs
-        keep = cum_before < top_p
-        # threshold = smallest surviving logit per row
-        thresh = jnp.min(
-            jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
-        )
-        logits = jnp.where(logits < thresh, neg_inf, logits)
+    logits = filter_logits(
+        logits, temperature=temperature, top_k=top_k, top_p=top_p
+    )
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 

@@ -32,13 +32,21 @@ TPU shape discipline (the part that differs from CUDA engines):
   high acceptance finishes in ``~max_new/(k+1)`` rounds), with a
   ``lax.scan`` of single-token draft steps inside.
 
-Greedy only (``temperature=0``): greedy acceptance is the case with an
-exact-equality guarantee, which the tests pin token-for-token against
-``generate``. Sampled speculative decoding (rejection sampling against
-the draft distribution) is a semantic superset left unimplemented
-rather than approximated — it would be *distributionally* correct but
-not comparable token-for-token, and silently switching equality classes
-is how serving bugs hide.
+Two acceptance modes, two equality classes (never silently mixed):
+
+* ``temperature=0`` — greedy acceptance: accept while the target's own
+  argmax agrees. Output is EXACTLY the target's greedy decode, pinned
+  token-for-token against ``generate`` in the tests.
+* ``temperature>0`` — draft-distribution rejection sampling
+  (Leviathan et al. Algorithm 1): accept proposal ``x ~ q`` with
+  probability ``min(1, p(x)/q(x))``; on rejection resample from the
+  residual ``norm(max(0, p - q))``; after a fully accepted round draw
+  the bonus token from ``p`` directly. Output is *distributed* exactly
+  as the target's own sampling (same ``filter_logits`` distribution
+  ``generate`` draws from) — not token-comparable to any particular
+  ``generate`` run, but marginal-distribution-pinned in the tests, and
+  the acceptance core is Monte-Carlo-verified against the analytic
+  target distribution in isolation.
 
 Works with any pair of models sharing the ``generate`` decode contract
 (``decode=True``, ``cache_len``, ``positions``, ``kv_mask`` — GPT2LMHead,
@@ -57,7 +65,57 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from pytorch_distributed_tpu.generation import model_max_len
+from pytorch_distributed_tpu.generation import (
+    filter_logits,
+    model_max_len,
+    sample_logits,
+)
+
+
+def speculative_accept(
+    p_probs: jnp.ndarray,   # [B, k+1, V] target probs per chunk position
+    q_probs: jnp.ndarray,   # [B, k, V] draft probs per proposal
+    proposals: jnp.ndarray,  # [B, k] draft-sampled tokens
+    rng: jax.Array,
+):
+    """Rejection-sampling acceptance (Leviathan et al. 2023, Alg. 1).
+
+    Returns ``(a, corr)``: per-row accepted-prefix length in [0, k] and
+    the round's final token — drawn from the residual
+    ``norm(max(0, p_a - q_a))`` after a rejection, or from the bonus
+    distribution ``p_k`` after full acceptance. Guarantee (the paper's
+    Theorem 1, Monte-Carlo-pinned in tests): each emitted token
+    ``proposals[:, :a] + corr`` is distributed exactly as a sequential
+    sample from ``p``.
+    """
+    B, k, V = q_probs.shape
+    rng_coin, rng_res = jax.random.split(rng)
+    gather = jnp.take_along_axis
+    px = gather(p_probs[:, :k], proposals[..., None], axis=2)[..., 0]
+    qx = gather(q_probs, proposals[..., None], axis=2)[..., 0]
+    coins = jax.random.uniform(rng_coin, (B, k))
+    # q sampled the proposal, so qx > 0; the guard only shields float
+    # underflow. coins < 1 strictly, so p == q accepts surely.
+    accept = coins < px / jnp.maximum(qx, 1e-30)
+    a = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+    p_a = gather(
+        p_probs, a[:, None, None], axis=1
+    )[:, 0]  # [B, V] target probs at the first-rejected position
+    # residual: subtract q at the rejected position; a == k (bonus draw)
+    # subtracts the zero row, leaving p_k itself
+    q_ext = jnp.concatenate(
+        [q_probs, jnp.zeros((B, 1, V), q_probs.dtype)], axis=1
+    )
+    q_a = gather(q_ext, a[:, None, None], axis=1)[:, 0]
+    res = jnp.maximum(p_a - q_a, 0.0)
+    # normalization is positive whenever this row actually rejected
+    # (total variation p != q); the guard covers the accepted rows whose
+    # residual draw is discarded anyway
+    res = res / jnp.maximum(jnp.sum(res, axis=-1, keepdims=True), 1e-30)
+    corr = jax.random.categorical(
+        rng_res, jnp.log(jnp.maximum(res, 1e-38)), axis=-1
+    ).astype(jnp.int32)
+    return a, corr
 
 
 def generate_speculative(
@@ -70,14 +128,22 @@ def generate_speculative(
     max_new_tokens: int,
     num_draft_tokens: int = 4,
     temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    rng: Optional[jax.Array] = None,
     eos_id: Optional[int] = None,
     pad_id: int = 0,
     return_stats: bool = False,
 ):
-    """Greedy-decode ``max_new_tokens`` from ``target_model``, accelerated
-    by ``draft_model`` proposals. Returns [B, P + max_new_tokens], equal
-    token-for-token to ``generate(target_model, ..., temperature=0)``;
-    sequences that hit ``eos_id`` are padded with ``pad_id`` after it.
+    """Decode ``max_new_tokens`` from ``target_model``, accelerated by
+    ``draft_model`` proposals. Returns [B, P + max_new_tokens]; sequences
+    that hit ``eos_id`` are padded with ``pad_id`` after it.
+
+    ``temperature=0``: equal token-for-token to
+    ``generate(target_model, ..., temperature=0)``. ``temperature>0``:
+    distributed exactly as ``generate(...)`` with the same
+    temperature/top_k/top_p (rejection sampling — module docstring);
+    ``rng`` defaults to ``jax.random.key(0)`` like ``generate``.
 
     ``return_stats`` additionally returns ``{"rounds": R, "drafted": D,
     "accepted": A}`` (host ints): R target passes emitted the sequence
@@ -85,13 +151,16 @@ def generate_speculative(
     means it nearly always did), A of D proposed draft tokens were
     accepted.
     """
-    if temperature != 0.0:
-        raise NotImplementedError(
-            "speculative decoding is greedy-only (temperature=0): sampled "
-            "acceptance needs draft-distribution rejection sampling, which "
-            "is distribution-equal but not token-for-token comparable — "
-            "use generate() for sampling"
+    sampling = temperature != 0.0
+    if sampling and temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if not sampling and (top_k is not None or top_p is not None):
+        raise ValueError(
+            "top_k/top_p filter a sampling distribution; greedy "
+            "(temperature=0) has none — set temperature > 0"
         )
+    if rng is None:
+        rng = jax.random.key(0)
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
     k = num_draft_tokens
@@ -131,7 +200,11 @@ def generate_speculative(
         {"params": draft_params}, prompt_ids, decode=True,
         cache_len=cache_d, mutable=["cache"],
     )
-    tok0 = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
+    rng, sub = jax.random.split(rng)
+    tok0 = sample_logits(
+        t_logits[:, -1], sub, temperature=temperature,
+        top_k=top_k, top_p=top_p,
+    )
 
     out = jnp.full((B, N), pad_id, jnp.int32)
     out = out.at[:, :P].set(prompt_ids.astype(jnp.int32))
@@ -147,7 +220,7 @@ def generate_speculative(
     mask_d = jnp.ones((B, cache_d), jnp.bool_)
 
     carry = dict(
-        out=out, emitted=emitted, done=done, x_last=tok0,
+        out=out, emitted=emitted, done=done, x_last=tok0, rng=rng,
         cache_t=t_state["cache"], cache_d=d_state["cache"],
         mask_t=mask_t, mask_d=mask_d,
         c_t=jnp.int32(P), c_d=jnp.int32(P),  # next write slot per cache
@@ -161,11 +234,16 @@ def generate_speculative(
         # position of x_last = its index in `out` (real tokens only; slot
         # bubbles never shift positions)
         base_pos = P + c["emitted"] - 1  # [B]
+        rng_next, rng_draft, rng_accept = jax.random.split(c["rng"], 3)
 
-        # ---- draft: k+1 sequential single-token greedy steps ------------
-        # the first k OUTPUTS are the proposals; the final step inputs
-        # the last proposal so its K/V lands in the cache (mirroring the
-        # target's slot layout) and its own output is discarded
+        # ---- draft: k sequential single-token steps + one cache fill ----
+        # the k scan OUTPUTS are the proposals; a final sampling-free
+        # feed then inputs the last proposal so its K/V lands in the
+        # cache (mirroring the target's slot layout — without it, a
+        # fully accepted round leaves a context hole in the draft cache
+        # and acceptance quietly degrades). Sampling mode additionally
+        # records each proposal's full filtered distribution q_j — the
+        # rejection test needs q, not just x ~ q.
         def dstep(dc, j):
             dcache, tok = dc
             logits, st = draft_model.apply(
@@ -174,14 +252,31 @@ def generate_speculative(
                 positions=(base_pos + j)[:, None], kv_mask=c["mask_d"],
                 mutable=["cache"],
             )
-            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-            return (st["cache"], nxt), nxt
+            if sampling:
+                filt = filter_logits(
+                    logits[:, -1], temperature=temperature,
+                    top_k=top_k, top_p=top_p,
+                )
+                nxt = jax.random.categorical(
+                    jax.random.fold_in(rng_draft, j), filt, axis=-1
+                ).astype(jnp.int32)
+                q = jax.nn.softmax(filt, axis=-1)
+            else:
+                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                q = jnp.zeros((B, 0), jnp.float32)  # unused
+            return (st["cache"], nxt), (nxt, q)
 
-        (cache_d_new, _), drafts = lax.scan(
-            dstep, (c["cache_d"], c["x_last"]), jnp.arange(k + 1),
-            length=k + 1,
+        (dcache_k, _), (drafts, q_steps) = lax.scan(
+            dstep, (c["cache_d"], c["x_last"]), jnp.arange(k), length=k
         )
-        drafts = drafts.T[:, :k]  # [B, k]
+        drafts = drafts.T  # [B, k]
+        _, dfill = draft_model.apply(
+            {"params": draft_params, "cache": dcache_k},
+            drafts[:, -1:], decode=True, cache_len=cache_d,
+            positions=(base_pos + k)[:, None], kv_mask=c["mask_d"],
+            mutable=["cache"],
+        )
+        cache_d_new = dfill["cache"]
 
         # ---- target: one chunked pass scores the whole proposal ---------
         chunk = jnp.concatenate([c["x_last"][:, None], drafts], axis=1)
@@ -191,13 +286,25 @@ def generate_speculative(
             positions=base_pos[:, None] + idx, kv_mask=c["mask_t"],
             mutable=["cache"],
         )
-        preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, k+1]
-        # preds[:, j] = target's greedy choice after chunk[:, :j+1] —
-        # compare with the draft's j-th proposal; accept the agreeing
-        # prefix, then take the target's own token as the correction
-        match = drafts == preds[:, :k]
-        a = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
-        corr = jnp.take_along_axis(preds, a[:, None], axis=1)  # [B, 1]
+        if sampling:
+            p_probs = jax.nn.softmax(filter_logits(
+                logits, temperature=temperature, top_k=top_k, top_p=top_p,
+            ), axis=-1)  # [B, k+1, V]
+            q_probs = jnp.moveaxis(q_steps, 0, 1)  # [B, k, V]
+            a, corr_tok = speculative_accept(
+                p_probs, q_probs, drafts, rng_accept
+            )
+            corr = corr_tok[:, None]
+        else:
+            preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # preds[:, j] = target's greedy choice after chunk[:, :j+1] —
+            # compare with the draft's j-th proposal; accept the agreeing
+            # prefix, then take the target's own token as the correction
+            match = drafts == preds[:, :k]
+            a = jnp.sum(
+                jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1
+            )
+            corr = jnp.take_along_axis(preds, a[:, None], axis=1)  # [B, 1]
         drafts_ext = jnp.concatenate(
             [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1
         )
@@ -248,6 +355,7 @@ def generate_speculative(
         active = (~c["done"]).astype(jnp.int32)
         return dict(
             out=out, emitted=emitted, done=done, x_last=x_last,
+            rng=rng_next,
             cache_t=t_st["cache"], cache_d=cache_d_new,
             mask_t=mask_t, mask_d=mask_d,
             c_t=c["c_t"] + (k + 1), c_d=c["c_d"] + (k + 1),

@@ -138,12 +138,102 @@ def test_speculative_llama_pair():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_speculative_accept_distribution():
+    """Monte-Carlo pin of the rejection-sampling core (Leviathan Thm 1):
+    with proposals drawn from q, the first emitted token (proposal if
+    accepted, residual draw if not) must be distributed as p — for p and
+    q that genuinely disagree."""
+    from pytorch_distributed_tpu.speculative import speculative_accept
+
+    V, B, k = 12, 16384, 2
+    rng = np.random.default_rng(0)
+    p_row = rng.dirichlet(np.ones(V) * 0.7)
+    q_row = rng.dirichlet(np.ones(V) * 0.7)  # independent => p != q
+    p = jnp.asarray(np.tile(p_row, (B, k + 1, 1)), jnp.float32)
+    q = jnp.asarray(np.tile(q_row, (B, k, 1)), jnp.float32)
+    key = jax.random.key(42)
+    kq, kacc = jax.random.split(key)
+    # proposals ~ q, independently per row/slot
+    proposals = jax.random.categorical(
+        kq, jnp.log(q), axis=-1
+    ).astype(jnp.int32)
+    a, corr = speculative_accept(p, q, proposals, kacc)
+    a, corr, proposals = map(np.asarray, (a, corr, proposals))
+    first = np.where(a >= 1, proposals[:, 0], corr)
+    emp = np.bincount(first, minlength=V) / B
+    tv = 0.5 * np.abs(emp - p_row).sum()
+    # sampling noise at B=16384, V=12 is ~0.01 TV; a wrong residual or
+    # acceptance rule shifts mass by O(TV(p,q)) ~ 0.4
+    assert tv < 0.03, f"TV(emitted, p) = {tv:.4f}"
+    # bonus path: rows that accepted everything draw corr from p_k
+    bonus = corr[a == k]
+    assert len(bonus) > 200  # enough mass to test
+    emp_b = np.bincount(bonus, minlength=V) / len(bonus)
+    assert 0.5 * np.abs(emp_b - p_row).sum() < 0.06
+
+
+@pytest.mark.slow
+def test_sampled_speculative_marginals_match_generate():
+    """End-to-end distribution pin: over many same-prompt rows, each
+    emitted position's marginal under sampled speculative decoding must
+    match generate's (both sample the target's filtered distribution).
+    Deterministic given the fixed seeds."""
+    ptd.init_process_group(mesh_spec=MeshSpec(dp=-1))
+    vocab, B, max_new = 32, 2048, 3
+    tcfg = GPT2Config(
+        vocab_size=vocab, n_positions=32, hidden_size=16, num_layers=1,
+        num_heads=2, dropout_rate=0.0,
+    )
+    dcfg = GPT2Config(
+        vocab_size=vocab, n_positions=32, hidden_size=8, num_layers=1,
+        num_heads=1, dropout_rate=0.0,
+    )
+    target, draft = GPT2LMHead(tcfg), GPT2LMHead(dcfg)
+    prompt = jnp.tile(
+        jnp.asarray([[5, 11, 2]], jnp.int32), (B, 1)
+    )  # identical rows -> each row is an independent sample
+    tp = target.init(jax.random.key(0), prompt[:1])["params"]
+    dp = draft.init(jax.random.key(1), prompt[:1])["params"]
+    ref = np.asarray(generate(
+        target, tp, prompt, max_new_tokens=max_new, temperature=1.0,
+        rng=jax.random.key(7),
+    ))[:, 3:]
+    got = np.asarray(generate_speculative(
+        target, tp, draft, dp, prompt, max_new_tokens=max_new,
+        num_draft_tokens=2, temperature=1.0, rng=jax.random.key(8),
+    ))[:, 3:]
+    for pos in range(max_new):
+        e1 = np.bincount(ref[:, pos], minlength=vocab) / B
+        e2 = np.bincount(got[:, pos], minlength=vocab) / B
+        tv = 0.5 * np.abs(e1 - e2).sum()
+        # two empirical draws of the same law at B=2048, V<=32: ~0.04 TV
+        assert tv < 0.1, f"position {pos}: TV = {tv:.4f}"
+
+
+@pytest.mark.slow
+def test_sampled_perfect_draft_accepts_nearly_everything():
+    # p == q makes the acceptance ratio 1 up to chunk-vs-single-step
+    # float noise; coins ~ U[0,1) then accept (near-)surely
+    target, tp, _, _, ids = _gpt2_pair()
+    _, stats = generate_speculative(
+        target, tp, target, tp, ids, max_new_tokens=10,
+        num_draft_tokens=3, temperature=1.0, rng=jax.random.key(3),
+        return_stats=True,
+    )
+    assert stats["accepted"] >= 0.9 * stats["drafted"]
+
+
 def test_speculative_validation():
     target, tp, draft, dp, ids = _gpt2_pair()
-    with pytest.raises(NotImplementedError, match="greedy-only"):
+    with pytest.raises(ValueError, match="temperature"):
         generate_speculative(
             target, tp, draft, dp, ids,
-            max_new_tokens=4, temperature=0.7,
+            max_new_tokens=4, temperature=-0.5,
+        )
+    with pytest.raises(ValueError, match="top_k/top_p"):
+        generate_speculative(
+            target, tp, draft, dp, ids,
+            max_new_tokens=4, top_k=5,  # greedy has no distribution
         )
     with pytest.raises(ValueError, match="cache slots"):
         # worst-case append-only sizing exceeds n_positions=96
