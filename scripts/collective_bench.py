@@ -1,0 +1,140 @@
+"""Collective microbenchmarks over any mesh — the allreduce-step-time tool.
+
+The reference's secondary north-star metric is "DDP allreduce step time"
+(BASELINE.json:2). On a single chip that collective is compiler-eliminated
+(bench.py measures DP-step *overhead* instead); the moment a multi-chip
+mesh exists — ICI slice or multi-host pod — this script measures the real
+thing: per-collective latency and achieved algorithmic bandwidth for the
+facade's all_reduce / all_gather / reduce_scatter / permute at gradient
+sizes, over whichever mesh axis you give it.
+
+Bus-bandwidth accounting follows the NCCL-tests convention so numbers are
+comparable to the reference's GPU rigs:
+
+    allreduce      moves 2(n-1)/n * bytes   per participant
+    allgather      moves   (n-1)/n * bytes
+    reduce_scatter moves   (n-1)/n * bytes
+    permute        moves             bytes  (one hop on the ring)
+
+On the virtual CPU mesh (XLA_FLAGS=--xla_force_host_platform_device_count=N)
+the "collectives" are shared-memory copies — the run is a harness smoke,
+not a measurement; the banner says which you got.
+
+Run (any env; on the chip follow docs/CHIP_PROTOCOL.md — no kill timers):
+    python scripts/collective_bench.py --sizes 4 32 128
+    python scripts/collective_bench.py --axis dp --iters 50
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import pytorch_distributed_tpu as ptd
+from pytorch_distributed_tpu.runtime.distributed import ReduceOp
+from pytorch_distributed_tpu.runtime.mesh import MeshSpec, mesh_axis_size
+
+
+def _timed(fn, x, iters, warmup=3):
+    y = fn(x)
+    for _ in range(warmup):
+        y = fn(y)
+    float(jnp.sum(y[..., :1]))  # sync via scalar fetch (relay-safe)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        y = fn(y)
+    float(jnp.sum(y[..., :1]))
+    return (time.perf_counter() - t0) / iters
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--sizes", type=float, nargs="+", default=[4.0, 32.0],
+                   help="payload sizes in MB (f32 elements)")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--axis", default=None,
+                   help="mesh axis to run over (default: the whole mesh)")
+    p.add_argument("--dp", type=int, default=-1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--fsdp", type=int, default=1)
+    args = p.parse_args(argv)
+
+    ptd.enable_compilation_cache()
+    if not ptd.is_initialized():
+        # guarded: embedding callers (tests, notebooks) keep their mesh
+        ptd.init_process_group(
+            mesh_spec=MeshSpec(dp=args.dp, tp=args.tp, fsdp=args.fsdp)
+        )
+    plat = ptd.platform()
+    # participant count follows the requested axis, not the whole mesh —
+    # the leading dim of every facade collective input must match it
+    parts = (
+        mesh_axis_size(args.axis) if args.axis else ptd.get_world_size()
+    )
+    print(f"# platform={plat} participants={parts} "
+          f"axis={args.axis or '<all>'} "
+          f"({'REAL collectives' if plat == 'tpu' and parts > 1 else 'smoke only: single device or shared-memory mesh'})",
+          flush=True)
+    if parts == 1:
+        print("# 1 participant: collectives are identity; nothing to measure")
+        return
+
+    kw = {"axis": args.axis} if args.axis else {}
+    colls = {
+        # facade semantics: leading dim = participants. Every fn is
+        # shape-preserving so the timed loop can chain output -> input
+        # (one compile, real data dependencies between iterations).
+        "all_reduce": (
+            lambda x: jnp.broadcast_to(
+                ptd.all_reduce(x, op=ReduceOp.AVG, **kw), x.shape
+            ),
+            lambda n, b: 2 * (n - 1) / n * b,
+        ),
+        "reduce_scatter": (
+            lambda x: jnp.broadcast_to(
+                ptd.reduce_scatter(x, op=ReduceOp.SUM, **kw), x.shape
+            ),
+            lambda n, b: (n - 1) / n * b,
+        ),
+        "all_gather": (
+            # [parts, per] in -> [parts, per] replicated out: each
+            # participant contributes its row
+            lambda x: ptd.all_gather(x, **kw),
+            lambda n, b: (n - 1) / n * b,
+        ),
+        "permute": (
+            lambda x: ptd.permute(
+                x, [(i, (i + 1) % parts) for i in range(parts)], **kw
+            ),
+            lambda n, b: b,
+        ),
+    }
+    for mb in args.sizes:
+        n_elem = int(mb * 1e6 / 4)
+        # per-participant rows sized divisibly by parts so reduce_scatter's
+        # tiled scatter dimension splits evenly
+        per = max(n_elem // parts // parts, 1) * parts
+        x = jnp.ones((parts, per), jnp.float32)
+        payload = per * parts * 4
+        for name, (fn, moved) in colls.items():
+            try:
+                dt = _timed(fn, x, args.iters)
+                bw = moved(parts, payload) / dt / 1e9
+                print(
+                    f"{name:15s} {payload / 1e6:8.1f}MB "
+                    f"{dt * 1e3:8.3f}ms  {bw:7.2f} GB/s busbw",
+                    flush=True,
+                )
+            except Exception as e:  # keep later collectives running
+                print(f"{name:15s} {payload / 1e6:8.1f}MB FAILED: "
+                      f"{type(e).__name__}: {e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
