@@ -72,6 +72,12 @@ from pytorch_distributed_tpu.runtime.precision import (
 from pytorch_distributed_tpu.runtime.prng import RngSeq, seed_all
 from pytorch_distributed_tpu.generation import generate, generate_beam, sample_logits
 from pytorch_distributed_tpu.speculative import generate_speculative
+from pytorch_distributed_tpu.lora import (
+    LoRAModel,
+    lora_init,
+    lora_merge,
+    lora_param_count,
+)
 from pytorch_distributed_tpu import optim
 from pytorch_distributed_tpu.launch import (
     ElasticAgent,
@@ -119,6 +125,10 @@ __all__ = [
     "generate",
     "generate_beam",
     "generate_speculative",
+    "LoRAModel",
+    "lora_init",
+    "lora_merge",
+    "lora_param_count",
     "optim",
     "sample_logits",
     "Policy",

@@ -1,0 +1,232 @@
+"""LoRA fine-tuning — low-rank adapters as a pure params-pytree transform.
+
+The peft/`LoraConfig` idiom without module surgery (same philosophy as
+``ops/quant.py``): the base checkpoint stays a frozen pytree, the
+trainable state is a tiny adapter tree mirroring the matched kernels,
+and a duck-typed wrapper merges ``W + (alpha/r) * A @ B`` inside the
+jitted step. Because the wrapper exposes the same ``.apply`` surface the
+framework's loss functions, Trainer, and ``generate`` already consume,
+LoRA composes with everything — DP/FSDP sharding, grad accumulation,
+checkpointing (the checkpoint is just the adapter tree), KV-cache
+decode — with no special cases.
+
+Why this is the TPU shape:
+
+* **Gradients only flow to the adapters.** The loss closes over the
+  frozen base tree, so ``jax.grad`` w.r.t. the adapter tree prices the
+  backward at adapter size and the optimizer state (Adam moments) drops
+  from O(params) to O(r * (in+out)) — the reason LoRA exists. An 8B
+  base in bf16 plus full-rank Adam state does not fit one v5e; base +
+  r=16 adapters + their moments does.
+* **Merge-inside-jit, not hooked matmuls.** Computing ``x@W + (x@A)@B``
+  needs per-layer forward hooks; merging materializes ``W_eff`` as a
+  transient XLA buffer but keeps the model untouched and lets XLA fuse
+  the rank-r update into the surrounding graph. For serving, merge once
+  with :func:`lora_merge` and drop the wrapper entirely.
+* **Scanned stacks get per-layer adapters.** Kernels under a scanned
+  block carry a leading layer axis ([L, ...]); the adapters carry it
+  too ([L, in, r] / [L, r, out]) so each layer trains its own subspace
+  and the merge is one batched einsum under the same ``lax.scan``.
+
+Kernel geometry: flax ``DenseGeneral`` kernels split dims as
+``[scan?][*in][*out]`` with layer-type-specific arity (GPT-2's fused
+qkv kernel is [L, D, 3, H, hd]; its attention-out is [L, H, hd, D]).
+Target patterns therefore name their trailing out-axis count; the
+defaults cover both model families' attention + MLP projections.
+
+The reference is a training-recipes repo with no adapter-tuning story;
+this is a beyond-parity capability (BASELINE.json:5).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+# pattern -> number of trailing OUT axes in the matched kernel.
+# GPT-2: fused qkv [.., D, 3, H, hd] (out=3), attn_out [.., H, hd, D]
+# (out=1), mlp_{up,down} [.., in, out] (out=1).
+# Llama: q/k/v [.., D, H, hd] (out=2), o [.., H, hd, D] (out=1),
+# gate/up/down [.., in, out] (out=1).
+DEFAULT_TARGETS: Dict[str, int] = {
+    r"attn_qkv/kernel$": 3,
+    r"attn_out/kernel$": 1,
+    r"mlp_(up|down)/kernel$": 1,
+    r"/(q|k|v)/kernel$": 2,
+    r"/o/kernel$": 1,
+    r"/(gate|up|down)/kernel$": 1,
+}
+
+# kernels whose path contains this segment belong to a scanned layer
+# stack and carry one leading layer axis (models/scan.py names the
+# scanned module "block" in both families)
+_SCAN_SEGMENT = "block"
+
+
+def _walk(tree, prefix=""):
+    for k in sorted(tree):
+        v = tree[k]
+        path = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict):
+            yield from _walk(v, path)
+        else:
+            yield path, v
+
+
+def _match(path: str, targets: Dict[str, int]) -> Optional[int]:
+    hits = [n for pat, n in targets.items() if re.search(pat, "/" + path)]
+    if len(hits) > 1:
+        raise ValueError(
+            f"kernel {path} matched {len(hits)} LoRA target patterns — "
+            "make the patterns disjoint"
+        )
+    return hits[0] if hits else None
+
+
+def _geometry(path: str, shape, n_out: int):
+    """(scan_dims, in_dims, out_dims) for a matched kernel."""
+    scan = 1 if f"/{_SCAN_SEGMENT}/" in f"/{path}/" else 0
+    if len(shape) < scan + 1 + n_out:
+        raise ValueError(
+            f"kernel {path} has shape {shape} — too few axes for "
+            f"{scan} scan + >=1 in + {n_out} out"
+        )
+    return shape[:scan], shape[scan:len(shape) - n_out], shape[
+        len(shape) - n_out:
+    ]
+
+
+def lora_init(
+    rng: jax.Array,
+    params,
+    rank: int,
+    targets: Optional[Dict[str, int]] = None,
+):
+    """Build the trainable adapter tree for ``params``.
+
+    Returns a pytree whose structure mirrors the matched kernels, each
+    leaf replaced by ``{"a": [*scan, in, r], "b": [*scan, r, out]}`` —
+    ``a`` fan-in-scaled normal, ``b`` zeros (the peft convention: the
+    model starts EXACTLY at the base checkpoint; tests pin it).
+    Raises if no kernel matches (a typo'd pattern should be loud).
+    """
+    if rank < 1:
+        raise ValueError(f"rank must be >= 1, got {rank}")
+    targets = DEFAULT_TARGETS if targets is None else targets
+    adapters = {}
+    n_matched = 0
+    for path, leaf in _walk(params):
+        n_out = _match(path, targets)
+        if n_out is None:
+            continue
+        n_matched += 1
+        scan_d, in_d, out_d = _geometry(path, leaf.shape, n_out)
+        fan_in = math.prod(in_d)
+        rng, sub = jax.random.split(rng)
+        a = jax.random.normal(
+            sub, (*scan_d, fan_in, rank), jnp.float32
+        ) / math.sqrt(fan_in)
+        b = jnp.zeros((*scan_d, rank, math.prod(out_d)), jnp.float32)
+        node = adapters
+        parts = path.split("/")
+        for seg in parts[:-1]:
+            node = node.setdefault(seg, {})
+        node[parts[-1]] = {"a": a, "b": b}
+    if n_matched == 0:
+        raise ValueError(
+            "no kernel matched any LoRA target pattern — patterns "
+            f"{list(targets)} against paths like "
+            f"{[p for p, _ in list(_walk(params))[:4]]}"
+        )
+    return adapters
+
+
+def lora_merge(params, adapters, *, alpha: Optional[float] = None):
+    """``W + (alpha/r) * A @ B`` for every adapted kernel; other leaves
+    pass through untouched. ``alpha`` defaults to the rank (scaling 1,
+    the common starting point; peft's ``lora_alpha`` maps directly).
+
+    Every adapter entry MUST find its kernel: an adapter tree built
+    against a different param layout (e.g. scanned adapters onto an
+    unrolled checkpoint) would otherwise merge into nothing and train
+    as a silent no-op — that mismatch raises instead.
+    """
+    n_adapters = sum(1 for p, _ in _walk(adapters) if p.endswith("/a"))
+    consumed = []
+
+    def merge(path, leaf, node):
+        sub = node.get("a") if isinstance(node, dict) else None
+        if sub is None:
+            return leaf
+        consumed.append(path)
+        a, b = node["a"], node["b"]
+        r = a.shape[-1]
+        scale = (alpha if alpha is not None else r) / r
+        delta = jnp.einsum("...ir,...ro->...io", a, b) * scale
+        return (leaf + delta.reshape(leaf.shape).astype(leaf.dtype))
+
+    def rec(ptree, atree, prefix=""):
+        out = {}
+        for k, v in ptree.items():
+            node = atree.get(k, {}) if isinstance(atree, dict) else {}
+            if isinstance(v, dict):
+                out[k] = rec(v, node, f"{prefix}/{k}")
+            else:
+                out[k] = merge(f"{prefix}/{k}", v, node)
+        return out
+
+    merged = rec(params, adapters)
+    if len(consumed) != n_adapters:
+        raise ValueError(
+            f"adapter tree has {n_adapters} adapted kernels but only "
+            f"{len(consumed)} found a matching param leaf — the adapter "
+            "and param layouts disagree (scanned vs unrolled checkpoint, "
+            "renamed modules?); merging would silently train nothing"
+        )
+    return merged
+
+
+class LoRAModel:
+    """Duck-typed model whose trainable params ARE the adapter tree.
+
+    ``LoRAModel(model, base_params).apply({"params": adapters}, ...)``
+    merges and forwards — signature-compatible with every consumer of
+    the flax ``.apply`` surface in this framework (loss functions,
+    ``build_train_step``, Trainer, ``generate``/``generate_beam``/
+    ``generate_speculative``), so the adapter tree slots in anywhere a
+    params tree does. The base tree is closed over and never receives
+    gradients.
+    """
+
+    def __init__(self, model, base_params, *, alpha=None):
+        self.model = model
+        self.base_params = base_params
+        self.alpha = alpha
+
+    @property
+    def config(self):  # generation length checks read model.config
+        return getattr(self.model, "config", None)
+
+    def apply(self, variables, *args, **kwargs):
+        merged = lora_merge(
+            self.base_params, variables["params"], alpha=self.alpha
+        )
+        rest = {k: v for k, v in variables.items() if k != "params"}
+        return self.model.apply(
+            {"params": merged, **rest}, *args, **kwargs
+        )
+
+    def init(self, *a, **k):  # pragma: no cover - explicit guard
+        raise TypeError(
+            "LoRAModel wraps an already-initialized base; build adapters "
+            "with lora_init(rng, base_params, rank)"
+        )
+
+
+def lora_param_count(adapters) -> int:
+    """Trainable parameter count of an adapter tree."""
+    return sum(x.size for _, x in _walk(adapters))
