@@ -56,6 +56,10 @@ def parse_args(argv=None):
     p.add_argument("--mask-prob", type=float, default=0.15)
     p.add_argument("--fp16", action="store_true",
                    help="fp16 + real dynamic loss scaling instead of bf16")
+    p.add_argument("--lora", type=int, default=0, metavar="RANK",
+                   help="LoRA fine-tune at this rank: base weights frozen, "
+                        "only rank-R adapters (attention + MLP) train — "
+                        "optimizer state shrinks to adapter size")
     p.add_argument("--steps-per-epoch", type=int, default=None)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--seed", type=int, default=0)
@@ -82,25 +86,46 @@ def main(argv=None):
     with ptd.autocast(dtype=amp_dtype):
         if args.mlm:
             from pytorch_distributed_tpu.models import BertForMaskedLM
-            from pytorch_distributed_tpu.train import masked_lm_loss_fn
 
             model = BertForMaskedLM(cfg)
+        else:
+            model = BertForSequenceClassification(
+                cfg, num_labels=args.num_labels
+            )
+        variables = model.init(
+            jax.random.key(args.seed),
+            jnp.zeros((1, seq_len), jnp.int32),
+        )
+        train_params = variables["params"]
+        if args.lora:
+            # freeze the base; the trainable tree (and therefore the
+            # optimizer state, the grads, the checkpoints) is the
+            # adapter tree. The wrapped .apply slots into loss_fn
+            # construction below unchanged.
+            train_params = ptd.lora_init(
+                jax.random.key(args.seed + 1), variables["params"],
+                rank=args.lora,
+            )
+            model = ptd.LoRAModel(model, variables["params"])
+            log_rank0(
+                "lora rank=%d: %d trainable / %d frozen params",
+                args.lora, ptd.lora_param_count(train_params),
+                sum(x.size
+                    for x in jax.tree_util.tree_leaves(variables["params"])),
+            )
+        # loss_fn built exactly once, from the (possibly wrapped) model
+        if args.mlm:
+            from pytorch_distributed_tpu.train import masked_lm_loss_fn
+
             loss_fn = masked_lm_loss_fn(
                 model, mask_token_id=min(103, cfg.vocab_size - 1),
                 vocab_size=cfg.vocab_size, mask_prob=args.mask_prob,
             )
         else:
-            model = BertForSequenceClassification(
-                cfg, num_labels=args.num_labels
-            )
             loss_fn = text_classification_loss_fn(model)
-        variables = model.init(
-            jax.random.key(args.seed),
-            jnp.zeros((1, seq_len), jnp.int32),
-        )
         state = TrainState.create(
             apply_fn=model.apply,
-            params=variables["params"],
+            params=train_params,
             # HF fine-tuning convention: biases + LayerNorm exempt from
             # weight decay (the reference's two-param-group AdamW)
             tx=ptd.optim.AdamW(
@@ -109,7 +134,13 @@ def main(argv=None):
             ),
             scaler_state=scaler.init_state(),
         )
-        strategy = DataParallel(extra_rules=bert_partition_rules())
+        # LoRA: the trainable tree is adapters whose array ranks differ
+        # from the kernels the BERT TP rules target — and at ~0.1% of
+        # model size they replicate for free
+        strategy = (
+            DataParallel() if args.lora
+            else DataParallel(extra_rules=bert_partition_rules())
+        )
         train_step = build_train_step(loss_fn, scaler=scaler)
         trainer = Trainer(
             state,

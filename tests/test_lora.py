@@ -151,3 +151,38 @@ def test_lora_merge_rejects_layout_mismatch():
     renamed = {"prefix": params}  # every adapter path now misses
     with pytest.raises(ValueError, match="layouts disagree"):
         lora_merge(renamed, adapters)
+
+
+def test_identity_at_init_bert():
+    # unrolled (layer{i}) stack: no scan axis; query/key/value out=2 and
+    # attn/out multi-dim in are covered by the BERT default targets
+    from pytorch_distributed_tpu.models.bert import (
+        BertConfig,
+        BertForSequenceClassification,
+    )
+
+    ptd.init_process_group(mesh_spec=MeshSpec(dp=-1))
+    model = BertForSequenceClassification(BertConfig.tiny(), num_labels=2)
+    ids = jnp.asarray(
+        np.random.default_rng(2).integers(
+            1024, size=(2, 10)
+        ).astype(np.int32)
+    )
+    params = model.init(jax.random.key(0), ids)["params"]
+    adapters = lora_init(jax.random.key(1), params, rank=2)
+    # every layer's attention (q/k/v/out) and MLP matched
+    n_layers = BertConfig.tiny().num_layers
+    n_adapted = sum(1 for _ in _adapter_leaves(adapters))
+    assert n_adapted == n_layers * 6  # q,k,v,out,mlp_up,mlp_down
+    got = LoRAModel(model, params).apply({"params": adapters}, ids)
+    want = model.apply({"params": params}, ids)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def _adapter_leaves(tree):
+    for v in tree.values():
+        if isinstance(v, dict):
+            if "a" in v and not isinstance(v["a"], dict):
+                yield v
+            else:
+                yield from _adapter_leaves(v)
