@@ -52,8 +52,9 @@ import jax.numpy as jnp
 # (out=1), mlp_{up,down} [.., in, out] (out=1).
 # Llama: q/k/v [.., D, H, hd] (out=2), o [.., H, hd, D] (out=1),
 # gate/up/down [.., in, out] (out=1).
-# BERT (unrolled layers): query/key/value [D, H, hd] (out=2),
-# attn/out [H, hd, D] (out=1); its mlp_{up,down} share the GPT-2 row.
+# BERT / ViT (unrolled layers): query/key/value [D, H, hd] (out=2),
+# the attention out projection [H, hd, D] (out=1 — "attn/out" in BERT,
+# bare "out" in ViT); their mlp_{up,down} share the GPT-2 row.
 DEFAULT_TARGETS: Dict[str, int] = {
     r"attn_qkv/kernel$": 3,
     r"attn_out/kernel$": 1,
@@ -62,7 +63,7 @@ DEFAULT_TARGETS: Dict[str, int] = {
     r"/o/kernel$": 1,
     r"/(gate|up|down)/kernel$": 1,
     r"/(query|key|value)/kernel$": 2,
-    r"attn/out/kernel$": 1,
+    r"/out/kernel$": 1,
 }
 
 # kernels whose path contains this segment belong to a scanned layer

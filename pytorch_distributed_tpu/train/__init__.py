@@ -9,6 +9,7 @@ from pytorch_distributed_tpu.train.train_state import TrainState
 from pytorch_distributed_tpu.train.trainer import (
     Trainer,
     TrainerConfig,
+    TrainingDiverged,
     build_train_step,
 )
 from pytorch_distributed_tpu.train.losses import (
@@ -44,6 +45,7 @@ __all__ = [
     "TrainState",
     "Trainer",
     "TrainerConfig",
+    "TrainingDiverged",
     "build_train_step",
     "causal_lm_eval_step",
     "classification_eval_step",

@@ -237,6 +237,20 @@ class TrainerConfig:
     keep_best: Optional[str] = None  # eval metric name: save tag 'best'
     # whenever it improves
     best_mode: str = "max"  # 'max' (accuracy-like) or 'min' (loss-like)
+    halt_on_nonfinite: int = 3  # consecutive non-finite LOGGED losses
+    # before raising TrainingDiverged (0 disables). NaN weights never
+    # recover, so persistent NaN means every later step is wasted chip
+    # time; the threshold tolerates fp16's transient overflow-and-skip
+    # window (GradScaler keeps params finite while the scale decays).
+    early_stop_patience: Optional[int] = None  # evals without improvement
+    # in the keep_best metric (same best_mode) before fit() stops early —
+    # the HF EarlyStoppingCallback idiom; requires keep_best + eval_step
+
+
+class TrainingDiverged(RuntimeError):
+    """Raised when the logged training loss stays non-finite — the run is
+    producing garbage and burning accelerator time; restart from the last
+    finite checkpoint with a lower LR / different seed."""
 
 
 class Trainer:
@@ -309,6 +323,9 @@ class Trainer:
         self._step_flops = None  # per-step FLOPs (log_mfu), set lazily
         self._best_value: Optional[float] = None  # keep_best tracking
         # (resets on resume: a restored run re-establishes its best)
+        self._nonfinite_logs = 0  # consecutive non-finite logged losses
+        self._es_best: Optional[float] = None  # early-stop tracking
+        self._es_stale = 0
         if self.config.best_mode not in ("max", "min"):
             raise ValueError(
                 f"best_mode must be 'max' or 'min', "
@@ -331,6 +348,24 @@ class Trainer:
                 "keep_checkpoints requires ckpt_every_steps: retention "
                 "prunes step-tagged checkpoints, which are only written "
                 "on the ckpt_every_steps cadence"
+            )
+        if self.config.early_stop_patience is not None:
+            if self.config.early_stop_patience < 1:
+                raise ValueError(
+                    f"early_stop_patience must be >= 1, "
+                    f"got {self.config.early_stop_patience}"
+                )
+            if self.config.keep_best is None or eval_step is None:
+                # the stop condition is "the keep_best eval metric
+                # stopped improving" — without both it can never trigger
+                raise ValueError(
+                    "early_stop_patience requires keep_best (the watched "
+                    "metric name) and an eval_step"
+                )
+        if self.config.halt_on_nonfinite < 0:
+            raise ValueError(
+                f"halt_on_nonfinite must be >= 0 (0 disables), "
+                f"got {self.config.halt_on_nonfinite}"
             )
         if self.config.async_checkpoint:
             from pytorch_distributed_tpu.train.checkpoint import (
@@ -501,7 +536,15 @@ class Trainer:
                 if self.eval_step is not None and (
                     (epoch + 1) % cfg.eval_every_epochs == 0
                 ):
-                    self.evaluate(epoch)
+                    means = self.evaluate(epoch)
+                    if self._early_stop_triggered(means):
+                        self.save_checkpoint()
+                        logger.info(
+                            "early stop at epoch %d: %s has not improved "
+                            "for %d evals (best %s)", epoch,
+                            cfg.keep_best, self._es_stale, self._es_best,
+                        )
+                        break
                 self.save_checkpoint()
         finally:
             if self._async_ckpt is not None:
@@ -607,6 +650,7 @@ class Trainer:
             if cfg.log_every and step % cfg.log_every == 0:
                 # sync point: pull metrics (blocks on the step's result)
                 metrics = {k: host_scalar(v) for k, v in metrics.items()}
+                self._check_finite(metrics, step)
                 now = time.perf_counter()
                 dt = (now - t_last) / steps_since_log
                 t_last = now
@@ -707,6 +751,67 @@ class Trainer:
         self._maybe_save_best(means)
         return means
 
+    def _check_finite(self, metrics: Dict[str, float], step: int) -> None:
+        """Halt on persistently non-finite loss (halt_on_nonfinite).
+
+        Checked only at the logging sync (no extra device fetches). The
+        threshold is CONSECUTIVE logged occurrences: fp16's scaler can
+        show transient inf while it searches for a scale, but NaN weights
+        never heal — once the loss stays non-finite, every further step
+        is wasted.
+        """
+        n = self.config.halt_on_nonfinite
+        if not n or "loss" not in metrics:
+            return
+        if math.isfinite(metrics["loss"]):
+            self._nonfinite_logs = 0
+            return
+        self._nonfinite_logs += 1
+        logger.warning(
+            "non-finite loss %s at step %d (%d/%d consecutive logs)",
+            metrics["loss"], step, self._nonfinite_logs, n,
+        )
+        if self._nonfinite_logs >= n:
+            raise TrainingDiverged(
+                f"loss has been non-finite for {self._nonfinite_logs} "
+                f"consecutive logging windows (last step {step}) — "
+                "restart from the last finite checkpoint with a lower "
+                "LR (set TrainerConfig(halt_on_nonfinite=0) to disable)"
+            )
+
+    def _improved(self, value: float, best: Optional[float]) -> bool:
+        """One comparator for 'did the watched metric improve' — shared
+        by best-checkpoint saving and early stopping so the two can
+        never disagree about what counts as progress."""
+        return (
+            best is None
+            or (self.config.best_mode == "max" and value > best)
+            or (self.config.best_mode == "min" and value < best)
+        )
+
+    def _early_stop_triggered(self, means: Dict[str, float]) -> bool:
+        cfg = self.config
+        if cfg.early_stop_patience is None:
+            return False
+        value = means.get(cfg.keep_best)
+        if value is None:
+            # a metric evals never produce can never improve — stopping
+            # "patiently" on a typo would silently truncate training
+            raise ValueError(
+                f"early-stop metric {cfg.keep_best!r} not in eval "
+                f"metrics {sorted(means)}"
+            )
+        if not math.isfinite(value):
+            # NaN cannot demonstrate improvement; count it as stale
+            self._es_stale += 1
+            return self._es_stale >= cfg.early_stop_patience
+        if self._improved(value, self._es_best):
+            self._es_best = value
+            self._es_stale = 0
+            return False
+        self._es_stale += 1
+        return self._es_stale >= cfg.early_stop_patience
+
     def _maybe_save_best(self, means: Dict[str, float]) -> None:
         """Save tag 'best' whenever the watched eval metric improves."""
         cfg = self.config
@@ -724,12 +829,7 @@ class Trainer:
             # every later value (NaN compares False both ways), freezing
             # diverged weights under the 'best' tag forever
             return
-        better = (
-            self._best_value is None
-            or (cfg.best_mode == "max" and value > self._best_value)
-            or (cfg.best_mode == "min" and value < self._best_value)
-        )
-        if better:
+        if self._improved(value, self._best_value):
             self._best_value = value
             self.save_checkpoint(tag="best")
             self._write_best_record(value)

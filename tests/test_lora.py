@@ -186,3 +186,40 @@ def _adapter_leaves(tree):
                 yield v
             else:
                 yield from _adapter_leaves(v)
+
+
+def test_identity_at_init_vit():
+    # ViT names its projections query/key/value/out directly in the
+    # block (no attn parent): the out-projection must be adapted too —
+    # a 3-of-4-attention-matrices LoRA would train silently crippled
+    from pytorch_distributed_tpu.models.vit import ViT, ViTConfig
+
+    ptd.init_process_group(mesh_spec=MeshSpec(dp=-1))
+    model = ViT(ViTConfig.tiny())
+    x = jnp.asarray(
+        np.random.default_rng(3).normal(size=(2, 32, 32, 3)).astype(
+            np.float32
+        )
+    )
+    params = model.init(jax.random.key(0), x)["params"]
+    adapters = lora_init(jax.random.key(1), params, rank=2)
+    adapted_paths = []
+
+    def collect(tree, pre=""):
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                if "a" in v and not isinstance(v["a"], dict):
+                    adapted_paths.append(pre + k)
+                else:
+                    collect(v, pre + k + "/")
+
+    collect(adapters)
+    per_block = [p for p in adapted_paths if p.startswith("block_0/")]
+    assert sorted(per_block) == [
+        "block_0/key/kernel", "block_0/mlp_down/kernel",
+        "block_0/mlp_up/kernel", "block_0/out/kernel",
+        "block_0/query/kernel", "block_0/value/kernel",
+    ]
+    got = LoRAModel(model, params).apply({"params": adapters}, x)
+    want = model.apply({"params": params}, x)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -2,6 +2,8 @@
 
 import jax
 import jax.numpy as jnp
+import tempfile
+
 import numpy as np
 import optax
 import pytest
@@ -968,3 +970,94 @@ def test_topk_accuracy():
     assert float(topk_accuracy(logits, labels, k=7)) == 1.0
     assert float(topk_accuracy(logits, labels, k=99)) == 1.0  # clamps
     assert float(topk_accuracy(logits, jnp.asarray([0, 6]), k=1)) == 1.0
+
+
+class TestDivergenceAndEarlyStop:
+    def test_halt_on_persistent_nonfinite_loss(self, dp8):
+        from pytorch_distributed_tpu.train import TrainingDiverged
+
+        state = linear_state()
+
+        def nan_step(state, batch):
+            # weights are already NaN in spirit: loss never heals
+            return state.apply_gradients(
+                grads=jax.tree_util.tree_map(jnp.zeros_like, state.params)
+            ), {"loss": jnp.float32(jnp.nan)}
+
+        ds = ArrayDataset(
+            x=np.zeros((64, 4), np.float32), y=np.zeros((64,), np.float32)
+        )
+        trainer = Trainer(
+            dp8.place(state), dp8, nan_step,
+            DataLoader(ds, 8, sharding=dp8.batch_sharding()),
+            config=TrainerConfig(
+                epochs=1, log_every=1, halt_on_nonfinite=3
+            ),
+        )
+        with pytest.raises(TrainingDiverged, match="3 consecutive"):
+            trainer.fit()
+        assert trainer.host_step == 3  # halted, not end-of-data
+
+    def test_transient_nonfinite_tolerated(self, dp8):
+        state = linear_state()
+        calls = {"n": 0}
+
+        def flaky_step(state, batch):
+            calls["n"] += 1  # trace-time only; use step count on device
+            loss = jnp.where(
+                state.step == 1, jnp.float32(jnp.inf), jnp.float32(0.5)
+            )
+            return state.apply_gradients(
+                grads=jax.tree_util.tree_map(jnp.zeros_like, state.params)
+            ), {"loss": loss}
+
+        ds = ArrayDataset(
+            x=np.zeros((64, 4), np.float32), y=np.zeros((64,), np.float32)
+        )
+        trainer = Trainer(
+            dp8.place(state), dp8, flaky_step,
+            DataLoader(ds, 8, sharding=dp8.batch_sharding()),
+            config=TrainerConfig(
+                epochs=1, log_every=1, halt_on_nonfinite=2
+            ),
+        )
+        trainer.fit()  # one inf log (step 2), then finite: no halt
+        assert trainer.host_step == 8
+        assert trainer._nonfinite_logs == 0  # reset by the finite logs
+
+    def test_early_stop_on_stale_eval(self, dp8):
+        model = tiny_resnet()
+        state = tiny_image_state(model)
+        ds = SyntheticImageDataset(n=16, image_shape=(16, 16, 3), seed=0)
+        loader = DataLoader(ds, 8, sharding=dp8.batch_sharding())
+
+        def constant_eval(state, batch):
+            return {"accuracy": jnp.float32(0.5), "n": jnp.float32(1.0)}
+
+        with tempfile.TemporaryDirectory() as d:
+            trainer = Trainer(
+                state, dp8,
+                build_train_step(classification_loss_fn(model)), loader,
+                eval_step=constant_eval, eval_loader=loader,
+                config=TrainerConfig(
+                    epochs=10, log_every=0, ckpt_dir=d,
+                    keep_best="accuracy", early_stop_patience=2,
+                ),
+            )
+            trainer.fit()
+        # epoch 0 sets the best; epochs 1-2 are stale; stop after epoch 2
+        assert trainer.host_step == 3 * 2  # 3 epochs x 2 steps/epoch
+        assert trainer._es_stale == 2
+
+    def test_early_stop_requires_watched_metric(self, dp8):
+        state = linear_state()
+        ds = ArrayDataset(
+            x=np.zeros((8, 4), np.float32), y=np.zeros((8,), np.float32)
+        )
+        with pytest.raises(ValueError, match="early_stop_patience requires"):
+            Trainer(
+                dp8.place(state), dp8,
+                build_train_step(linear_loss_fn),
+                DataLoader(ds, 8, sharding=dp8.batch_sharding()),
+                config=TrainerConfig(early_stop_patience=2),
+            )
