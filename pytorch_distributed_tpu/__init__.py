@@ -27,6 +27,10 @@ from pytorch_distributed_tpu.runtime.device import (
     device_count,
     enable_compilation_cache,
     local_device_count,
+    max_memory_allocated,
+    memory_allocated,
+    memory_stats,
+    memory_summary,
     platform,
     is_tpu,
 )
@@ -90,6 +94,10 @@ __version__ = "0.1.0"
 __all__ = [
     "device_count",
     "local_device_count",
+    "max_memory_allocated",
+    "memory_allocated",
+    "memory_stats",
+    "memory_summary",
     "platform",
     "is_tpu",
     "MeshSpec",

@@ -10,6 +10,7 @@ driving", which the mesh layer turns into a ``jax.sharding.Mesh``.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 
@@ -137,3 +138,45 @@ def memory_stats() -> dict:
         except Exception:  # pragma: no cover - backend-dependent
             stats[str(d)] = None
     return stats
+
+
+def _device_stat(key: str, device: Optional[int]) -> int:
+    # one backend-quirk guard: memory_stats() already wraps the
+    # per-device call; insertion order follows jax.local_devices()
+    stats = list(memory_stats().values())
+    picked = stats if device is None else [stats[device]]
+    return sum(int((s or {}).get(key, 0)) for s in picked)
+
+
+def memory_allocated(device: Optional[int] = None) -> int:
+    """Live HBM bytes (torch.cuda.memory_allocated call shape): one
+    device's, or summed over local devices when ``device`` is None."""
+    return _device_stat("bytes_in_use", device)
+
+
+def max_memory_allocated(device: Optional[int] = None) -> int:
+    """Peak HBM bytes since process start (torch.cuda.max_memory_allocated
+    call shape). TPU backends report ``peak_bytes_in_use``; backends
+    without it return 0 rather than raising."""
+    return _device_stat("peak_bytes_in_use", device)
+
+
+def memory_summary() -> str:
+    """Human-readable per-device HBM table (torch.cuda.memory_summary
+    call shape) — the first tool to reach for on an XLA OOM: it shows
+    live/peak/limit per chip so you can see which of params, optimizer
+    state, or saved activations is eating the budget before reading an
+    allocation dump."""
+    lines = ["device                     in_use      peak     limit"]
+    for name, s in memory_stats().items():
+        s = s or {}
+
+        def gb(key):
+            v = s.get(key)
+            return f"{v / 1e9:8.2f}G" if v is not None else "       ?"
+
+        lines.append(
+            f"{name:24s} {gb('bytes_in_use')} {gb('peak_bytes_in_use')} "
+            f"{gb('bytes_limit')}"
+        )
+    return "\n".join(lines)
