@@ -22,6 +22,7 @@ from pytorch_distributed_tpu.ops.lm_loss import (
 )
 from pytorch_distributed_tpu.ops.quant import (
     dequantize_tree,
+    quantize_tree_int4,
     quantize_tree_int8,
     quantized_apply_fn,
     quantized_bytes,
@@ -34,6 +35,7 @@ from pytorch_distributed_tpu.ops.moe import (
 
 __all__ = [
     "dequantize_tree",
+    "quantize_tree_int4",
     "quantize_tree_int8",
     "quantized_apply_fn",
     "quantized_bytes",

@@ -1,4 +1,4 @@
-"""Weight-only int8 quantization (the bitsandbytes-int8 / GPTQ-lite
+"""Weight-only int8/int4 quantization (the bitsandbytes / GPTQ-lite
 serving idiom, TPU-first).
 
 The torch ecosystem reaches int8 serving through module surgery
@@ -19,6 +19,16 @@ than bf16, 4x than f32 — an 8B fits a single v5e's 16 GB), and XLA
 fuses the int8->bf16 convert into the consumer where it can. This is a
 STORAGE/capacity feature first; step-time wins depend on XLA fusing the
 dequant, which varies by op — measure before claiming speed.
+
+:func:`quantize_tree_int4` halves the at-rest bytes again (GPTQ/AWQ's
+0.5 byte/weight regime, ~8x vs f32): two 4-bit values pack into each
+int8 byte along the OUTPUT axis, and scales are per (input-group, out
+channel) — groupwise scaling is what keeps 4-bit usable, since one
+outlier no longer stretches a whole channel's quantization step. The
+packing is chosen so every shape is derivable from the packed arrays
+themselves (no side metadata): the tree stays a plain checkpointable
+pytree of arrays, and unpack is two shifts + an interleave that XLA
+fuses into the dequant consumer.
 """
 
 from __future__ import annotations
@@ -31,10 +41,32 @@ import jax.numpy as jnp
 
 
 _QKEYS = frozenset({"q8", "scale"})
+_Q4KEYS = frozenset({"q4", "scale"})
 
 
 def _is_qleaf(x) -> bool:
-    return isinstance(x, dict) and set(x.keys()) == _QKEYS
+    return isinstance(x, dict) and set(x.keys()) in (_QKEYS, _Q4KEYS)
+
+
+def _compile_includes(include):
+    return (
+        [re.compile(p) for p in include] if include is not None else None
+    )
+
+
+def _skip_leaf(path, leaf, regs, min_size) -> bool:
+    """Shared quantizer gate: already-quantized leaves pass through
+    untouched, sub-matrix/small leaves stay full precision, and the
+    include regexes (when given) must match the path."""
+    from pytorch_distributed_tpu.parallel.sharding import path_str
+
+    if _is_qleaf(leaf):
+        return True
+    if leaf.ndim < 2 or leaf.size < min_size:
+        return True
+    return regs is not None and not any(
+        r.search(path_str(path)) for r in regs
+    )
 
 
 def quantize_tree_int8(
@@ -53,18 +85,10 @@ def quantize_tree_int8(
     flax kernel convention is [in..., out], and per-out-channel scales
     track the variance structure weight matrices actually have.
     """
-    regs = [re.compile(p) for p in include] if include is not None else None
+    regs = _compile_includes(include)
 
     def quant(path, leaf):
-        from pytorch_distributed_tpu.parallel.sharding import path_str
-
-        if _is_qleaf(leaf):
-            return leaf  # idempotent: re-quantizing passes through
-        if leaf.ndim < 2 or leaf.size < min_size:
-            return leaf
-        if regs is not None and not any(
-            r.search(path_str(path)) for r in regs
-        ):
+        if _is_qleaf(leaf) or _skip_leaf(path, leaf, regs, min_size):
             return leaf
         f = leaf.astype(jnp.float32)
         amax = jnp.max(jnp.abs(f), axis=tuple(range(leaf.ndim - 1)),
@@ -80,12 +104,86 @@ def quantize_tree_int8(
                                             is_leaf=lambda x: _is_qleaf(x))
 
 
+def quantize_tree_int4(
+    params,
+    *,
+    group_size: int = 128,
+    include: Optional[Sequence[str]] = None,
+    min_size: int = 4096,
+):
+    """Quantize matching >=2-D leaves to symmetric groupwise int4,
+    packed two values per byte.
+
+    Layout (all static-shape-derivable, no side metadata):
+
+    * the kernel's last axis is OUT; adjacent out pairs (2j, 2j+1) pack
+      into one byte -> ``q4`` shaped ``[..., in_last, out/2]`` uint8;
+    * groups run along the LAST INPUT axis (axis -2), ``group_size``
+      rows per scale -> ``scale`` shaped ``[..., in_last/g, 1, out]``.
+      When ``group_size`` does not divide ``in_last`` the whole axis is
+      one group (per-out-channel int4, still valid, just coarser).
+
+    Leaves with an odd out axis, <2 dims, or < ``min_size`` elements
+    stay full precision (the pack needs out pairs; tiny kernels don't
+    pay for scales). Symmetric range is ±7 — int4 keeps no -8 so the
+    scheme stays zero-point-free like the int8 path.
+    """
+    regs = _compile_includes(include)
+
+    def quant(path, leaf):
+        if (
+            _is_qleaf(leaf)
+            or _skip_leaf(path, leaf, regs, min_size)
+            or leaf.shape[-1] % 2  # the pack needs out pairs
+        ):
+            return leaf
+        f = leaf.astype(jnp.float32)
+        in_last, out = f.shape[-2], f.shape[-1]
+        g = group_size if in_last % group_size == 0 else in_last
+        grouped = f.reshape(*f.shape[:-2], in_last // g, g, out)
+        amax = jnp.max(jnp.abs(grouped), axis=-2, keepdims=True)
+        scale = jnp.where(amax > 0, amax / 7.0, 1.0)
+        q = jnp.clip(jnp.round(grouped / scale), -7, 7).astype(jnp.int8)
+        q = q.reshape(f.shape)
+        # pack out pairs: byte = low(2j) | high(2j+1) on the nibbles
+        lo = q[..., 0::2] & 0xF
+        hi = q[..., 1::2] & 0xF
+        packed = (lo | (hi << 4)).astype(jnp.uint8)
+        return {"q4": packed, "scale": scale.astype(jnp.float32)}
+
+    return jax.tree_util.tree_map_with_path(
+        quant, params, is_leaf=_is_qleaf
+    )
+
+
+def _dq4(leaf, dtype):
+    packed, scale = leaf["q4"], leaf["scale"]
+    # sign-extend each nibble: shift into the high bits of an int8 and
+    # arithmetic-shift back down
+    as_i8 = packed.astype(jnp.int8)
+    lo = ((as_i8 << 4).astype(jnp.int8) >> 4).astype(jnp.float32)
+    hi = (as_i8 >> 4).astype(jnp.float32)
+    half = packed.shape[-1]
+    # interleave back to [..., out]: pairs were (2j, 2j+1)
+    q = jnp.stack([lo, hi], axis=-1).reshape(*packed.shape[:-1], 2 * half)
+    in_last = q.shape[-2]
+    groups = scale.shape[-3]
+    grouped = q.reshape(
+        *q.shape[:-2], groups, in_last // groups, q.shape[-1]
+    )
+    out = (grouped * scale).reshape(q.shape)
+    return out.astype(dtype or jnp.float32)
+
+
 def dequantize_tree(qparams, dtype=None):
-    """Inverse of :func:`quantize_tree_int8`; untouched leaves pass
-    through. ``dtype`` overrides the reconstructed dtype (default f32;
-    pass the model's compute dtype when calling inside a jitted step)."""
+    """Inverse of :func:`quantize_tree_int8` / :func:`quantize_tree_int4`
+    (up to quantization error); untouched leaves pass through. ``dtype``
+    overrides the reconstructed dtype (default f32; pass the model's
+    compute dtype when calling inside a jitted step)."""
 
     def dq(leaf):
+        if isinstance(leaf, dict) and "q4" in leaf:
+            return _dq4(leaf, dtype)
         if _is_qleaf(leaf):
             out = leaf["q8"].astype(jnp.float32) * leaf["scale"]
             return out.astype(dtype or jnp.float32)
@@ -101,7 +199,8 @@ def quantized_bytes(qparams) -> int:
         qparams, is_leaf=_is_qleaf
     ):
         if _is_qleaf(leaf):
-            total += leaf["q8"].size + leaf["scale"].size * 4
+            qarr = leaf.get("q8") if "q8" in leaf else leaf["q4"]
+            total += qarr.size + leaf["scale"].size * 4
         else:
             total += leaf.size * leaf.dtype.itemsize
     return total
