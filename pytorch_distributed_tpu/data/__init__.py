@@ -27,6 +27,7 @@ from pytorch_distributed_tpu.data.datasets import (
     ArrayDataset,
     ConcatDataset,
     IterableDataset,
+    ShuffleBuffer,
     Subset,
     SyntheticImageDataset,
     SyntheticTextDataset,
@@ -60,6 +61,7 @@ __all__ = [
     "ArrayDataset",
     "ConcatDataset",
     "IterableDataset",
+    "ShuffleBuffer",
     "Subset",
     "SyntheticImageDataset",
     "SyntheticTextDataset",

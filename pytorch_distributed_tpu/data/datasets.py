@@ -31,6 +31,51 @@ class IterableDataset:
         raise NotImplementedError
 
 
+class ShuffleBuffer(IterableDataset):
+    """Windowed shuffle over a stream (the tf.data / torchdata idiom).
+
+    An IterableDataset cannot be index-shuffled (no random access), so
+    the loader refuses ``shuffle=True`` for streams; this wrapper is the
+    standard answer: hold ``buffer_size`` items, emit a uniformly random
+    one, refill from the stream. Randomness quality is the buffer size —
+    a buffer >= one shard gives a full shuffle, smaller buffers trade
+    memory for locality (items can move at most ~buffer_size positions
+    early, arbitrarily late).
+
+    Deterministic per (seed, epoch): ``set_epoch`` reseeds (and forwards
+    to the source for re-sharding), matching DistributedSampler's epoch
+    contract so multi-process worlds stay in lockstep.
+    """
+
+    def __init__(self, source, buffer_size: int, seed: int = 0):
+        if buffer_size < 1:
+            raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
+        self.source = source
+        self.buffer_size = buffer_size
+        self.seed = seed
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+        if hasattr(self.source, "set_epoch"):
+            self.source.set_epoch(epoch)
+
+    def __iter__(self):
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, self._epoch])
+        )
+        buf = []
+        for item in self.source:
+            if len(buf) < self.buffer_size:
+                buf.append(item)
+                continue
+            i = int(rng.integers(self.buffer_size))
+            out, buf[i] = buf[i], item
+            yield out
+        rng.shuffle(buf)
+        yield from buf
+
+
 class ArrayDataset:
     """Dict-of-arrays dataset; leading dim indexes samples."""
 

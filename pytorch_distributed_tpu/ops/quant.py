@@ -88,7 +88,7 @@ def quantize_tree_int8(
     regs = _compile_includes(include)
 
     def quant(path, leaf):
-        if _is_qleaf(leaf) or _skip_leaf(path, leaf, regs, min_size):
+        if _skip_leaf(path, leaf, regs, min_size):
             return leaf
         f = leaf.astype(jnp.float32)
         amax = jnp.max(jnp.abs(f), axis=tuple(range(leaf.ndim - 1)),
@@ -132,8 +132,7 @@ def quantize_tree_int4(
 
     def quant(path, leaf):
         if (
-            _is_qleaf(leaf)
-            or _skip_leaf(path, leaf, regs, min_size)
+            _skip_leaf(path, leaf, regs, min_size)
             or leaf.shape[-1] % 2  # the pack needs out pairs
         ):
             return leaf
