@@ -126,6 +126,52 @@ def model_max_len(model):
     )
 
 
+def ragged_prompt_state(prompt_mask, B: int, P: int, cache_len: int):
+    """Validated per-row state for a LEFT-padded (HF-style) prompt batch.
+
+    Returns ``(prompt_mask, positions, prompt_lens, kv_mask)`` — the one
+    construction of the ragged-prompt contract, shared by ``generate``
+    and ``generate_speculative`` so the two can never diverge. Eager
+    (non-traced) masks are refused upfront when RIGHT-padded or when a
+    row has no real token at all: both would silently sample from a
+    pad-slot query attending to nothing (NaN softmax / garbage tokens).
+    """
+    if prompt_mask.shape != (B, P):
+        raise ValueError(
+            f"prompt_mask must be {(B, P)}, got {prompt_mask.shape}"
+        )
+    prompt_mask = prompt_mask.astype(jnp.bool_)
+    if not isinstance(prompt_mask, jax.core.Tracer):
+        m = np.asarray(prompt_mask).astype(np.int8)
+        if not (np.diff(m, axis=1) >= 0).all():
+            raise ValueError(
+                "prompt_mask must be LEFT-padded: each row one "
+                "contiguous run of real tokens ending at the last "
+                "slot (HF left-padding for decoder-only generation)"
+            )
+        if not m[:, -1].all():
+            # left-padded + nonempty <=> last slot real; an all-pad row
+            # would clamp to prompt_lens=1 and decode from a fully
+            # masked attention row
+            raise ValueError(
+                "prompt_mask has a row with no real tokens — every row "
+                "must contain at least one real (last-slot) token"
+            )
+    # positions count real tokens only: pads share position 0 (their
+    # K/V are masked out of attention, so their rope/wpe is inert)
+    positions = jnp.maximum(
+        jnp.cumsum(prompt_mask.astype(jnp.int32), axis=1) - 1, 0
+    )
+    prompt_lens = positions[:, -1] + 1  # real tokens per row
+    # cache-slot validity for the WHOLE generation: prompt slots follow
+    # the mask; future decode slots are valid (the causal q_offset
+    # masking hides the not-yet-written tail)
+    kv_mask = jnp.concatenate(
+        [prompt_mask, jnp.ones((B, cache_len - P), jnp.bool_)], axis=1
+    )
+    return prompt_mask, positions, prompt_lens, kv_mask
+
+
 def _generation_limits(model, P, max_new_tokens):
     """Shared validation for generate/generate_beam: positive token count
     and prompt+new within the model's position/cache capacity. Returns
@@ -202,37 +248,8 @@ def generate(
     extra = {}
     prompt_lens = None
     if prompt_mask is not None:
-        if prompt_mask.shape != (B, P):
-            raise ValueError(
-                f"prompt_mask must be {(B, P)}, got {prompt_mask.shape}"
-            )
-        prompt_mask = prompt_mask.astype(jnp.bool_)
-        if not isinstance(prompt_mask, jax.core.Tracer):
-            # eager-mode upfront refusal (this function's style): a
-            # RIGHT-padded mask would silently sample from a pad-token
-            # query — real tokens must be one contiguous right-aligned run
-            m = np.asarray(prompt_mask).astype(np.int8)
-            if not (np.diff(m, axis=1) >= 0).all():
-                raise ValueError(
-                    "prompt_mask must be LEFT-padded: each row one "
-                    "contiguous run of real tokens ending at the last "
-                    "slot (HF left-padding for decoder-only generation)"
-                )
-        # left padding contract: every real token is RIGHT-aligned, so
-        # each row's final slot holds its last real token (where the
-        # first sampled logits come from)
-        # positions count real tokens only: pads share position 0 (their
-        # K/V are masked out of attention, so their rope/wpe is inert)
-        positions = jnp.maximum(
-            jnp.cumsum(prompt_mask.astype(jnp.int32), axis=1) - 1, 0
-        )
-        prompt_lens = positions[:, -1] + 1  # real tokens per row
-        # cache-slot validity for the WHOLE generation: prompt slots
-        # follow the mask; future decode slots are valid (the causal
-        # q_offset masking hides the not-yet-written tail)
-        kv_mask = jnp.concatenate(
-            [prompt_mask,
-             jnp.ones((B, cache_len - P), jnp.bool_)], axis=1,
+        prompt_mask, positions, prompt_lens, kv_mask = ragged_prompt_state(
+            prompt_mask, B, P, cache_len
         )
         extra = {"positions": positions, "kv_mask": kv_mask}
 

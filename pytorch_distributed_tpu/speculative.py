@@ -68,6 +68,7 @@ from jax import lax
 from pytorch_distributed_tpu.generation import (
     filter_logits,
     model_max_len,
+    ragged_prompt_state,
     sample_logits,
 )
 
@@ -133,6 +134,7 @@ def generate_speculative(
     rng: Optional[jax.Array] = None,
     eos_id: Optional[int] = None,
     pad_id: int = 0,
+    prompt_mask: Optional[jnp.ndarray] = None,
     return_stats: bool = False,
 ):
     """Decode ``max_new_tokens`` from ``target_model``, accelerated by
@@ -144,6 +146,12 @@ def generate_speculative(
     distributed exactly as ``generate(...)`` with the same
     temperature/top_k/top_p (rejection sampling — module docstring);
     ``rng`` defaults to ``jax.random.key(0)`` like ``generate``.
+
+    ``prompt_mask`` [B, P] (True = real token) enables RAGGED batches
+    via LEFT padding, exactly as in ``generate``: positions count real
+    tokens, pad slots stay masked out of every round, and rows match
+    their unpadded solo runs. The bubble machinery makes this nearly
+    free — prompt pads are just pre-existing invalid slots.
 
     ``return_stats`` additionally returns ``{"rounds": R, "drafted": D,
     "accepted": A}`` (host ints): R target passes emitted the sequence
@@ -191,14 +199,24 @@ def generate_speculative(
     N = P + max_new_tokens
     idx = jnp.arange(k + 1)[None, :]  # [1, k+1] chunk-slot indices
 
-    # ---- prefill both models on the (unpadded) prompt -------------------
+    # ---- ragged prompts: the ONE shared contract with generate ----------
+    prompt_extra = {}
+    prompt_lens = jnp.full((B,), P, jnp.int32)
+    prompt_valid = jnp.ones((B, P), jnp.bool_)
+    if prompt_mask is not None:
+        prompt_valid, positions, prompt_lens, kv_mask = (
+            ragged_prompt_state(prompt_mask, B, P, cache_t)
+        )
+        prompt_extra = {"positions": positions, "kv_mask": kv_mask}
+
+    # ---- prefill both models on the prompt ------------------------------
     t_logits, t_state = target_model.apply(
         {"params": target_params}, prompt_ids, decode=True,
-        cache_len=cache_t, mutable=["cache"],
+        cache_len=cache_t, mutable=["cache"], **prompt_extra,
     )
     _, d_state = draft_model.apply(
         {"params": draft_params}, prompt_ids, decode=True,
-        cache_len=cache_d, mutable=["cache"],
+        cache_len=cache_d, mutable=["cache"], **prompt_extra,
     )
     rng, sub = jax.random.split(rng)
     tok0 = sample_logits(
@@ -214,10 +232,11 @@ def generate_speculative(
         (tok0 == eos_id) if eos_id is not None
         else jnp.zeros((B,), jnp.bool_)
     ) | (emitted >= max_new_tokens)
-    # slot validity; future slots stay True (the slot-causal q_offset mask
+    # slot validity; prompt slots carry the (possibly ragged) prompt's
+    # validity, future slots stay True (the slot-causal q_offset mask
     # hides the unwritten tail — same convention as generate's ragged path)
-    mask_t = jnp.ones((B, cache_t), jnp.bool_)
-    mask_d = jnp.ones((B, cache_d), jnp.bool_)
+    mask_t = jnp.ones((B, cache_t), jnp.bool_).at[:, :P].set(prompt_valid)
+    mask_d = jnp.ones((B, cache_d), jnp.bool_).at[:, :P].set(prompt_valid)
 
     carry = dict(
         out=out, emitted=emitted, done=done, x_last=tok0, rng=rng,
@@ -231,9 +250,9 @@ def generate_speculative(
         return jnp.any(~c["done"])
 
     def body(c):
-        # position of x_last = its index in `out` (real tokens only; slot
-        # bubbles never shift positions)
-        base_pos = P + c["emitted"] - 1  # [B]
+        # position of x_last = per-row REAL token count minus one (slot
+        # bubbles and prompt pads never shift positions)
+        base_pos = prompt_lens + c["emitted"] - 1  # [B]
         rng_next, rng_draft, rng_accept = jax.random.split(c["rng"], 3)
 
         # ---- draft: k sequential single-token steps + one cache fill ----

@@ -492,3 +492,14 @@ def test_generate_with_tp_sharded_params():
         model, state.params, ids, max_new_tokens=8, temperature=0.0
     )
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_prompt_mask_rejects_all_pad_row(gpt2):
+    # an all-False row would clamp to prompt_lens=1 and decode from a
+    # fully-masked attention row (NaN softmax) — refused upfront, in the
+    # shared helper both generate and generate_speculative use
+    model, params, ids = gpt2
+    bad = jnp.asarray([[True] * 7, [False] * 7])
+    with pytest.raises(ValueError, match="no real tokens"):
+        generate(model, params, ids, max_new_tokens=3, temperature=0.0,
+                 prompt_mask=bad)

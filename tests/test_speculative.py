@@ -246,3 +246,34 @@ def test_speculative_validation():
             target, tp, draft, dp, ids, max_new_tokens=4,
             num_draft_tokens=0,
         )
+
+
+def test_ragged_prompts_match_ragged_generate():
+    """Left-padded batches decode identically to generate's ragged path
+    (itself pinned equal to unpadded solo runs) — prompt pads are just
+    pre-existing invalid slots to the bubble machinery."""
+    target, tp, draft, dp, _ = _gpt2_pair()
+    # rows with real lengths 6, 4, 2, left-padded to width 6
+    rng = np.random.default_rng(11)
+    ids = jnp.asarray(rng.integers(1, 97, size=(3, 6)).astype(np.int32))
+    mask = jnp.asarray(
+        [[True] * 6, [False] * 2 + [True] * 4, [False] * 4 + [True] * 2]
+    )
+    ids = jnp.where(mask, ids, 0)
+    want = generate(target, tp, ids, max_new_tokens=8, temperature=0.0,
+                    prompt_mask=mask)
+    got = generate_speculative(
+        target, tp, draft, dp, ids, max_new_tokens=8,
+        num_draft_tokens=3, prompt_mask=mask,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_ragged_rejects_right_padding():
+    target, tp, draft, dp, ids = _gpt2_pair()
+    bad = jnp.asarray([[True, True, True, True, False, False]] * 3)
+    with pytest.raises(ValueError, match="LEFT-padded"):
+        generate_speculative(
+            target, tp, draft, dp, ids, max_new_tokens=4,
+            num_draft_tokens=2, prompt_mask=bad,
+        )
