@@ -17,6 +17,7 @@ from pytorch_distributed_tpu.train.losses import (
     classification_eval_step,
     classification_loss_fn,
     causal_lm_loss_fn,
+    distillation_loss_fn,
     masked_lm_loss_fn,
     mixup_classification_loss_fn,
     text_classification_loss_fn,
@@ -53,6 +54,7 @@ __all__ = [
     "masked_lm_loss_fn",
     "mixup_classification_loss_fn",
     "causal_lm_loss_fn",
+    "distillation_loss_fn",
     "text_classification_loss_fn",
     "cross_entropy",
     "topk_accuracy",

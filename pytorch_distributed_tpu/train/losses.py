@@ -560,3 +560,90 @@ def classification_eval_step(
         return out
 
     return eval_step
+
+
+def distillation_loss_fn(
+    student,
+    teacher,
+    teacher_params,
+    *,
+    temperature: float = 2.0,
+    alpha: float = 0.5,
+    ids_key: str = "input_ids",
+    moe_aux_weight: float = 0.0,
+) -> Callable:
+    """Knowledge distillation for causal LMs (Hinton et al.; the
+    DistilBERT recipe shape): ``alpha * CE(student, labels) +
+    (1 - alpha) * T^2 * KL(teacher_T || student_T)`` over shifted
+    next-token positions.
+
+    The teacher forwards INSIDE the same jitted step with its params
+    closed over — they are constants to ``jax.grad`` (no stop-gradient
+    bookkeeping to get wrong) and the teacher's logits never leave the
+    device. The ``T^2`` factor keeps the soft-target gradient magnitude
+    comparable across temperatures (the original paper's correction).
+
+    Packed batches (``segment_ids``/``positions`` from
+    ``data.pack_documents``) follow ``causal_lm_loss_fn``'s semantics:
+    both forwards are segment-aware and CE AND KL are masked at document
+    boundaries and padding. ``moe_aux_weight`` collects the STUDENT's
+    load-balance aux (the teacher is frozen; its routing is its own
+    business).
+
+    This is also how you make :func:`~pytorch_distributed_tpu.
+    generate_speculative` fast: distill the serving model into a small
+    draft and acceptance follows agreement — pinned end-to-end in
+    tests/test_distill.py.
+    """
+    if not 0.0 <= alpha <= 1.0:
+        raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+    if temperature <= 0.0:
+        raise ValueError(f"temperature must be > 0, got {temperature}")
+
+    def loss_fn(params, batch_stats, batch, rng):
+        ids = batch[ids_key]
+        seg = batch.get("segment_ids")
+        extra = {}
+        if seg is not None:
+            extra["segment_ids"] = seg
+            if "positions" in batch:
+                extra["positions"] = batch["positions"]
+        s_logits, moe_aux = _apply_with_moe_aux(
+            student, params, ids, train=True, rng=rng,
+            moe_aux_weight=moe_aux_weight, extra=extra,
+        )
+        t_logits, _ = _apply_with_moe_aux(
+            teacher, teacher_params, ids, train=False, extra=extra,
+        )
+        s_shift = s_logits[:, :-1].astype(jnp.float32)
+        t_shift = t_logits[:, :-1].astype(jnp.float32)
+        labels = ids[:, 1:]
+        tok_ce = optax.softmax_cross_entropy_with_integer_labels(
+            s_shift, labels
+        )
+        t_logp = jax.nn.log_softmax(t_shift / temperature)
+        s_logp = jax.nn.log_softmax(s_shift / temperature)
+        tok_kl = jnp.sum(jnp.exp(t_logp) * (t_logp - s_logp), axis=-1)
+        if seg is not None:
+            from pytorch_distributed_tpu.data.packing import (
+                packed_loss_mask,
+            )
+
+            valid = packed_loss_mask(seg).astype(tok_ce.dtype)
+            denom = jnp.maximum(jnp.sum(valid), 1.0)
+            ce = jnp.sum(tok_ce * valid) / denom
+            kl = jnp.sum(tok_kl * valid) / denom
+        else:
+            ce = jnp.mean(tok_ce)
+            kl = jnp.mean(tok_kl)
+        loss = alpha * ce + (1.0 - alpha) * (temperature ** 2) * kl
+        metrics = {"loss": loss, "ce": ce, "kl": kl}
+        if moe_aux is not None:
+            metrics["moe_aux_loss"] = moe_aux
+            loss = loss + moe_aux
+        return loss, {
+            "metrics": metrics,
+            "batch_stats": batch_stats,
+        }
+
+    return loss_fn
