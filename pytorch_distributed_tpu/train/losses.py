@@ -294,6 +294,33 @@ def masked_lm_loss_fn(
     return loss_fn
 
 
+def _packed_extra(batch) -> dict:
+    """Model kwargs for a (possibly packed) LM batch: forward
+    ``segment_ids`` (and ``positions`` when present) from
+    ``data.pack_documents``. One builder shared by every LM loss so the
+    packed contract cannot diverge between them."""
+    seg = batch.get("segment_ids")
+    if seg is None:
+        return {}
+    extra = {"segment_ids": seg}
+    if "positions" in batch:
+        extra["positions"] = batch["positions"]
+    return extra
+
+
+def _masked_token_mean(tok_loss, segment_ids):
+    """Mean of per-token losses; packed batches average over valid
+    targets only (document boundaries and padding excluded via
+    ``packed_loss_mask``). The single definition of the packed
+    denominator, shared by the CE and distillation losses."""
+    if segment_ids is None:
+        return jnp.mean(tok_loss)
+    from pytorch_distributed_tpu.data.packing import packed_loss_mask
+
+    valid = packed_loss_mask(segment_ids).astype(tok_loss.dtype)
+    return jnp.sum(tok_loss * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
 def _apply_with_moe_aux(model, params, ids, *, train, rng=None,
                         moe_aux_weight: float = 0.0, return_hidden=False,
                         extra=None):
@@ -420,14 +447,9 @@ def causal_lm_loss_fn(
         # packed batches (data/packing.py): per-document attention +
         # per-document positions + boundary/pad loss masking
         seg = batch.get("segment_ids")
-        extra = {}
-        if seg is not None:
-            extra["segment_ids"] = seg
-            if "positions" in batch:
-                extra["positions"] = batch["positions"]
         logits, aux = _apply_with_moe_aux(
             model, params, ids, train=True, rng=rng,
-            moe_aux_weight=moe_aux_weight, extra=extra,
+            moe_aux_weight=moe_aux_weight, extra=_packed_extra(batch),
         )
         # predict token t+1 from prefix..t
         shift_logits = logits[:, :-1].astype(jnp.float32)
@@ -435,17 +457,7 @@ def causal_lm_loss_fn(
         tok_loss = optax.softmax_cross_entropy_with_integer_labels(
             shift_logits, shift_labels
         )
-        if seg is not None:
-            from pytorch_distributed_tpu.data.packing import (
-                packed_loss_mask,
-            )
-
-            valid = packed_loss_mask(seg).astype(tok_loss.dtype)
-            loss = jnp.sum(tok_loss * valid) / jnp.maximum(
-                jnp.sum(valid), 1.0
-            )
-        else:
-            loss = jnp.mean(tok_loss)
+        loss = _masked_token_mean(tok_loss, seg)
         metrics = {"loss": loss}
         if aux is not None:
             metrics["moe_aux_loss"] = aux
@@ -603,11 +615,7 @@ def distillation_loss_fn(
     def loss_fn(params, batch_stats, batch, rng):
         ids = batch[ids_key]
         seg = batch.get("segment_ids")
-        extra = {}
-        if seg is not None:
-            extra["segment_ids"] = seg
-            if "positions" in batch:
-                extra["positions"] = batch["positions"]
+        extra = _packed_extra(batch)
         s_logits, moe_aux = _apply_with_moe_aux(
             student, params, ids, train=True, rng=rng,
             moe_aux_weight=moe_aux_weight, extra=extra,
@@ -624,18 +632,8 @@ def distillation_loss_fn(
         t_logp = jax.nn.log_softmax(t_shift / temperature)
         s_logp = jax.nn.log_softmax(s_shift / temperature)
         tok_kl = jnp.sum(jnp.exp(t_logp) * (t_logp - s_logp), axis=-1)
-        if seg is not None:
-            from pytorch_distributed_tpu.data.packing import (
-                packed_loss_mask,
-            )
-
-            valid = packed_loss_mask(seg).astype(tok_ce.dtype)
-            denom = jnp.maximum(jnp.sum(valid), 1.0)
-            ce = jnp.sum(tok_ce * valid) / denom
-            kl = jnp.sum(tok_kl * valid) / denom
-        else:
-            ce = jnp.mean(tok_ce)
-            kl = jnp.mean(tok_kl)
+        ce = _masked_token_mean(tok_ce, seg)
+        kl = _masked_token_mean(tok_kl, seg)
         loss = alpha * ce + (1.0 - alpha) * (temperature ** 2) * kl
         metrics = {"loss": loss, "ce": ce, "kl": kl}
         if moe_aux is not None:

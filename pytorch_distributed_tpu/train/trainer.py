@@ -245,6 +245,11 @@ class TrainerConfig:
     early_stop_patience: Optional[int] = None  # evals without improvement
     # in the keep_best metric (same best_mode) before fit() stops early —
     # the HF EarlyStoppingCallback idiom; requires keep_best + eval_step
+    trace_dir: Optional[str] = None  # with trace_steps: profiler output
+    trace_steps: Optional[tuple] = None  # (start, stop) host steps to
+    # trace — the torch.profiler schedule(wait/active) idiom: capture a
+    # small mid-training window (past compiles and warmup) instead of
+    # wrapping the whole run in maybe_trace
 
 
 class TrainingDiverged(RuntimeError):
@@ -362,6 +367,21 @@ class Trainer:
                     "early_stop_patience requires keep_best (the watched "
                     "metric name) and an eval_step"
                 )
+        if (self.config.trace_steps is not None) != (
+            self.config.trace_dir is not None
+        ):
+            raise ValueError(
+                "trace_dir and trace_steps come together: the pair "
+                "means 'profile host steps [start, stop) into this dir'"
+            )
+        if self.config.trace_steps is not None:
+            a, b = self.config.trace_steps
+            if not 0 <= a < b:
+                raise ValueError(
+                    f"trace_steps must be (start, stop) with "
+                    f"0 <= start < stop, got {self.config.trace_steps}"
+                )
+        self._tracing = False
         if self.config.halt_on_nonfinite < 0:
             raise ValueError(
                 f"halt_on_nonfinite must be >= 0 (0 disables), "
@@ -547,6 +567,18 @@ class Trainer:
                         break
                 self.save_checkpoint()
         finally:
+            if getattr(self, "_tracing", False):
+                # window ran past end of data: drain before stopping so
+                # the trace holds execution (same contract as the
+                # in-window stop edge), then say what happened
+                host_scalar(self.state.step)
+                jax.profiler.stop_trace()
+                self._tracing = False
+                logger.warning(
+                    "trace window %s outlived training (last step %d) — "
+                    "trace includes end-of-epoch eval/checkpoint work",
+                    cfg.trace_steps, self.host_step,
+                )
             if self._async_ckpt is not None:
                 self._async_ckpt.wait()  # last save must land before exit
             if self._preemption is not None:
@@ -631,6 +663,7 @@ class Trainer:
                 self._step_flops = self._measure_step_flops(batch)
                 t_last = time.perf_counter()  # don't bill the measurement
                 # to the first logging window's step-time/MFU numbers
+            self._trace_tick()
             self.state, metrics = self.train_step(self.state, batch)
             self.host_step += 1
             step = self.host_step
@@ -750,6 +783,32 @@ class Trainer:
             )
         self._maybe_save_best(means)
         return means
+
+    def _trace_tick(self) -> None:
+        """Start/stop the profiler at the configured host-step window.
+
+        Runs BEFORE the step whose index matches, so [start, stop)
+        captures exactly stop-start steps; the stop edge also syncs on
+        the last traced step's result (stop_trace flushes only what has
+        executed — without the sync the trace would be mostly dispatch).
+        """
+        cfg = self.config
+        if cfg.trace_steps is None:
+            return
+        start, stop = cfg.trace_steps
+        if not self._tracing and start <= self.host_step < stop:
+            # range (not equality) so a resumed run landing inside the
+            # window still captures its remainder
+            jax.profiler.start_trace(cfg.trace_dir)
+            self._tracing = True
+        elif self._tracing and self.host_step >= stop:
+            host_scalar(self.state.step)  # drain the traced steps
+            jax.profiler.stop_trace()
+            self._tracing = False
+            logger.info(
+                "profiler trace of steps [%d, %d) written to %s",
+                start, stop, cfg.trace_dir,
+            )
 
     def _check_finite(self, metrics: Dict[str, float], step: int) -> None:
         """Halt on persistently non-finite loss (halt_on_nonfinite).

@@ -1061,3 +1061,49 @@ class TestDivergenceAndEarlyStop:
                 DataLoader(ds, 8, sharding=dp8.batch_sharding()),
                 config=TrainerConfig(early_stop_patience=2),
             )
+
+
+class TestTraceWindow:
+    def test_trace_steps_capture_window(self, dp8, tmp_path):
+        state = linear_state()
+
+        def step_fn(state, batch):
+            return state.apply_gradients(
+                grads=jax.tree_util.tree_map(jnp.zeros_like, state.params)
+            ), {"loss": jnp.float32(1.0)}
+
+        ds = ArrayDataset(
+            x=np.zeros((64, 4), np.float32), y=np.zeros((64,), np.float32)
+        )
+        trainer = Trainer(
+            dp8.place(state), dp8, step_fn,
+            DataLoader(ds, 8, sharding=dp8.batch_sharding()),
+            config=TrainerConfig(
+                epochs=1, log_every=0,
+                trace_dir=str(tmp_path), trace_steps=(2, 4),
+            ),
+        )
+        trainer.fit()
+        assert not trainer._tracing  # window closed mid-epoch
+        # the profiler wrote a plugin dir with at least one trace file
+        files = list(tmp_path.rglob("*"))
+        assert any(f.is_file() for f in files), files
+
+    def test_trace_config_validation(self, dp8):
+        state = linear_state()
+        ds = ArrayDataset(
+            x=np.zeros((8, 4), np.float32), y=np.zeros((8,), np.float32)
+        )
+        loader = DataLoader(ds, 8, sharding=dp8.batch_sharding())
+        with pytest.raises(ValueError, match="come together"):
+            Trainer(
+                dp8.place(linear_state()), dp8,
+                build_train_step(linear_loss_fn), loader,
+                config=TrainerConfig(trace_steps=(1, 2)),
+            )
+        with pytest.raises(ValueError, match="start < stop"):
+            Trainer(
+                dp8.place(linear_state()), dp8,
+                build_train_step(linear_loss_fn), loader,
+                config=TrainerConfig(trace_dir="/tmp/x", trace_steps=(4, 2)),
+            )
