@@ -515,28 +515,15 @@ def causal_lm_eval_step(
                 segment_ids=seg, positions=batch.get("positions"),
             )
             return {"loss": loss, "perplexity": jnp.exp(loss)}
-        extra = {}
-        if seg is not None:  # packed eval mirrors the packed train loss
-            extra["segment_ids"] = seg
-            if "positions" in batch:
-                extra["positions"] = batch["positions"]
+        # packed eval mirrors the packed train loss via the SAME helpers
         logits = model.apply(
-            {"params": state.params}, ids, train=False, **extra
+            {"params": state.params}, ids, train=False,
+            **_packed_extra(batch),
         )
         tok_loss = optax.softmax_cross_entropy_with_integer_labels(
             logits[:, :-1].astype(jnp.float32), ids[:, 1:]
         )
-        if seg is not None:
-            from pytorch_distributed_tpu.data.packing import (
-                packed_loss_mask,
-            )
-
-            valid = packed_loss_mask(seg).astype(tok_loss.dtype)
-            loss = jnp.sum(tok_loss * valid) / jnp.maximum(
-                jnp.sum(valid), 1.0
-            )
-        else:
-            loss = jnp.mean(tok_loss)
+        loss = _masked_token_mean(tok_loss, seg)
         return {"loss": loss, "perplexity": jnp.exp(loss)}
 
     return eval_step

@@ -568,11 +568,18 @@ class Trainer:
                 self.save_checkpoint()
         finally:
             if getattr(self, "_tracing", False):
-                # window ran past end of data: drain before stopping so
-                # the trace holds execution (same contract as the
-                # in-window stop edge), then say what happened
-                host_scalar(self.state.step)
-                jax.profiler.stop_trace()
+                # window ran past end of data (or training died inside
+                # it). Best-effort: the drain touches device results and
+                # re-raises a device failure — it must never mask the
+                # original exception or starve the cleanups below.
+                try:
+                    host_scalar(self.state.step)
+                except Exception:  # failed step: stop with what we have
+                    pass
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:  # a broken trace must not mask the
+                    pass           # original failure either
                 self._tracing = False
                 logger.warning(
                     "trace window %s outlived training (last step %d) — "
