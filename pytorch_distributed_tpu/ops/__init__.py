@@ -22,6 +22,7 @@ from pytorch_distributed_tpu.ops.lm_loss import (
 )
 from pytorch_distributed_tpu.ops.quant import (
     dequantize_tree,
+    QuantizedModel,
     quantize_tree_int4,
     quantize_tree_int8,
     quantized_apply_fn,
@@ -35,6 +36,7 @@ from pytorch_distributed_tpu.ops.moe import (
 
 __all__ = [
     "dequantize_tree",
+    "QuantizedModel",
     "quantize_tree_int4",
     "quantize_tree_int8",
     "quantized_apply_fn",

@@ -219,3 +219,26 @@ def quantized_apply_fn(model, dtype=None):
         return model.apply(variables, *args, **kwargs)
 
     return apply_fn
+
+
+class QuantizedModel:
+    """Duck-typed model over a quantized params tree (int8 or int4) —
+    the same ``.apply`` surface trick as ``LoRAModel``, so a quantized
+    tree slots directly into ``generate``/``generate_beam``/
+    ``generate_speculative``/eval steps::
+
+        q = quantize_tree_int4(params)
+        out = generate(QuantizedModel(model), q, ids, ...)
+
+    Dequantization runs inside the traced computation (the quantized
+    tree stays the resident HBM copy); ``dtype`` selects the transient
+    reconstruction dtype (pass the compute dtype, e.g. ``jnp.bfloat16``).
+    """
+
+    def __init__(self, model, dtype=None):
+        self.model = model
+        self.apply = quantized_apply_fn(model, dtype)
+
+    @property
+    def config(self):  # generation length checks read model.config
+        return getattr(self.model, "config", None)

@@ -26,6 +26,7 @@ from pytorch_distributed_tpu.train.losses import (
     accuracy,
 )
 from pytorch_distributed_tpu.train.checkpoint import (
+    average_checkpoints,
     save_checkpoint,
     restore_checkpoint,
     checkpoint_exists,
@@ -59,6 +60,7 @@ __all__ = [
     "cross_entropy",
     "topk_accuracy",
     "accuracy",
+    "average_checkpoints",
     "save_checkpoint",
     "restore_checkpoint",
     "checkpoint_exists",

@@ -183,8 +183,10 @@ class TestInt4:
     def test_gpt2_int4_decode_mostly_agrees(self):
         from pytorch_distributed_tpu.generation import generate
         from pytorch_distributed_tpu.models import GPT2Config, GPT2LMHead
-        from pytorch_distributed_tpu.ops import quantize_tree_int4
-        from pytorch_distributed_tpu.ops.quant import quantized_apply_fn
+        from pytorch_distributed_tpu.ops import (
+            QuantizedModel,
+            quantize_tree_int4,
+        )
 
         cfg = GPT2Config.tiny()
         model = GPT2LMHead(cfg)
@@ -195,14 +197,9 @@ class TestInt4:
         ).astype(jnp.int32)
         params = model.init(jax.random.key(0), ids)["params"]
         q = quantize_tree_int4(params, group_size=32, min_size=512)
-
-        class QModel:
-            config = model.config
-            apply = staticmethod(quantized_apply_fn(model))
-
         full = generate(model, params, ids, max_new_tokens=12,
                         temperature=0.0)
-        quant = generate(QModel(), q, ids, max_new_tokens=12,
+        quant = generate(QuantizedModel(model), q, ids, max_new_tokens=12,
                          temperature=0.0)
         agree = (
             np.asarray(full)[:, ids.shape[1]:]

@@ -1107,3 +1107,30 @@ class TestTraceWindow:
                 build_train_step(linear_loss_fn), loader,
                 config=TrainerConfig(trace_dir="/tmp/x", trace_steps=(4, 2)),
             )
+
+
+def test_average_checkpoints(dp8, tmp_path):
+    from pytorch_distributed_tpu.train import (
+        average_checkpoints,
+        save_checkpoint,
+    )
+
+    # three checkpoints whose params are the constants 1, 2, 3
+    for i, val in enumerate([1.0, 2.0, 3.0]):
+        state = linear_state()
+        state = state.replace(
+            params=jax.tree_util.tree_map(
+                lambda x: jnp.full_like(x, val), state.params
+            ),
+            step=jnp.int32(10 * (i + 1)),
+        )
+        save_checkpoint(str(tmp_path), state, tag=f"step-{10 * (i + 1)}")
+    avg = average_checkpoints(
+        str(tmp_path), linear_state(),
+        [f"step-{s}" for s in (10, 20, 30)],
+    )
+    for leaf in jax.tree_util.tree_leaves(avg.params):
+        np.testing.assert_allclose(np.asarray(leaf), 2.0, rtol=1e-6)
+    assert int(avg.step) == 30  # everything else from the newest tag
+    with pytest.raises(ValueError, match="at least one"):
+        average_checkpoints(str(tmp_path), linear_state(), [])
