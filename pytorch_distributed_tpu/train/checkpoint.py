@@ -490,6 +490,7 @@ def average_checkpoints(
     ckpt_dir: str,
     state_template: TrainState,
     tags: Sequence[str],
+    shardings: Optional[Any] = None,
 ) -> TrainState:
     """Equal-weight parameter average over checkpoints (the fairseq
     ``average_checkpoints.py`` / torch ``swa_utils.AveragedModel`` idiom
@@ -528,8 +529,14 @@ def average_checkpoints(
             acc = jax.tree_util.tree_map(
                 lambda a, x, n=float(i): a + (x - a) / n, acc, p32
             )
-    newest = restore_checkpoint(ckpt_dir, state_template, tag=newest_tag)
-    avg = jax.tree_util.tree_map(
-        lambda a, ref: a.astype(ref.dtype), acc, newest.params
+    newest = restore_checkpoint(
+        ckpt_dir, state_template, shardings, tag=newest_tag
     )
+    avg = jax.tree_util.tree_map(
+        lambda a, ref: np.asarray(a).astype(ref.dtype), acc, newest.params
+    )
+    if shardings is not None:
+        # the rest of `newest` is already mesh-placed; give the averaged
+        # params the same placement instead of handing back host numpy
+        avg = jax.device_put(avg, shardings.params)
     return newest.replace(params=avg)

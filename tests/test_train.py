@@ -1134,3 +1134,28 @@ def test_average_checkpoints(dp8, tmp_path):
     assert int(avg.step) == 30  # everything else from the newest tag
     with pytest.raises(ValueError, match="at least one"):
         average_checkpoints(str(tmp_path), linear_state(), [])
+
+
+def test_average_checkpoints_sharded_restore(dp8, tmp_path):
+    from pytorch_distributed_tpu.train import (
+        average_checkpoints,
+        save_checkpoint,
+    )
+
+    for i, val in enumerate([1.0, 3.0]):
+        state = linear_state()
+        state = state.replace(
+            params=jax.tree_util.tree_map(
+                lambda x: jnp.full_like(x, val), state.params
+            ),
+            step=jnp.int32(i + 1),
+        )
+        save_checkpoint(str(tmp_path), state, tag=f"step-{i + 1}")
+    template = dp8.place(linear_state())
+    avg = average_checkpoints(
+        str(tmp_path), linear_state(), ["step-1", "step-2"],
+        shardings=dp8.state_shardings(template),
+    )
+    leaf = jax.tree_util.tree_leaves(avg.params)[0]
+    assert hasattr(leaf, "sharding")  # mesh-placed, not host numpy
+    np.testing.assert_allclose(np.asarray(leaf), 2.0, rtol=1e-6)
