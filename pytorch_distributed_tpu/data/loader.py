@@ -55,10 +55,18 @@ class DataLoader:
         sampler: Optional[GlobalBatchSampler] = None,
         transform: Optional[Callable[[Any], Any]] = None,
         fetch: Optional[Callable[[Any, np.ndarray], Any]] = None,
+        collate_fn: Optional[Callable[[list], Any]] = None,
         shard: Optional[bool] = None,
     ):
         """``fetch(dataset, indices) -> batch`` overrides the default
         gather — e.g. the native augmenting ImageBatchPipeline.
+
+        ``collate_fn(list_of_samples) -> batch`` is torch's hook for
+        datasets whose samples need custom assembly (nested structures,
+        variable-length fields to pad, non-array types). Map-style: it
+        replaces the stack step of the per-sample gather. Streams: it
+        assembles each rank's group slice. Mutually exclusive with
+        ``fetch`` (a fetch already owns the whole batch assembly).
 
         ``shard``: whether to rank-slice each batch under the multi-process
         (hostring) backend. Default (None) auto-detects: slice unless the
@@ -66,6 +74,11 @@ class DataLoader:
         like DistributedSampler) — feeding per-rank batches through the
         implicit slice would silently double-shard to 1/world^2 per rank.
         Pass True/False to force."""
+        if collate_fn is not None and fetch is not None:
+            raise ValueError(
+                "collate_fn and fetch both own batch assembly — pass one"
+            )
+        self.collate_fn = collate_fn
         self.dataset = dataset
         # torch IterableDataset parity: a dataset with __iter__ but no
         # __getitem__ streams samples; batches are grouped off the stream
@@ -73,6 +86,12 @@ class DataLoader:
         self.iterable = (
             hasattr(dataset, "__iter__") and not hasattr(dataset, "__getitem__")
         )
+        if collate_fn is not None and not self.iterable:
+            # map-style: collate replaces the stack step of the default
+            # per-sample gather (streams collate in their own grouping)
+            fetch = lambda ds, idx: collate_fn(  # noqa: E731
+                [ds[int(i)] for i in idx]
+            )
         if self.iterable:
             if sampler is not None:
                 raise ValueError(
@@ -232,10 +251,16 @@ class DataLoader:
 
         buf = []
 
-        def emit(group):
-            idx = self._rank_slice(np.arange(len(group)))
-            batch = stack_items([group[int(i)] for i in idx])
+        def assemble(group, idx):
+            picked = [group[int(i)] for i in idx]
+            batch = (
+                self.collate_fn(picked) if self.collate_fn is not None
+                else stack_items(picked)
+            )
             out_q.put(self._place(batch))
+
+        def emit(group):
+            assemble(group, self._rank_slice(np.arange(len(group))))
 
         for sample in self.dataset:
             if stop.is_set():
@@ -247,9 +272,11 @@ class DataLoader:
         if buf and not self.drop_last:
             # _rank_slice sheds a non-divisible remainder; a tail smaller
             # than the whole world can't be sharded at all — drop it (all
-            # ranks see the same stream, so all drop it: lockstep holds)
+            # ranks see the same stream, so all drop it: lockstep holds).
+            # ONLY the slice is guarded: a collate/stack error is the
+            # user's bug and must surface, not read as a dropped tail.
             try:
-                emit(buf)
+                idx = self._rank_slice(np.arange(len(buf)))
             except ValueError:
                 import logging
 
@@ -257,6 +284,8 @@ class DataLoader:
                     "dropping %d-sample stream tail: smaller than the "
                     "rank count", len(buf),
                 )
+            else:
+                assemble(buf, idx)
         out_q.put(_SENTINEL)
 
     def __iter__(self) -> Iterator[Any]:

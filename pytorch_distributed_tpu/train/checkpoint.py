@@ -529,14 +529,27 @@ def average_checkpoints(
             acc = jax.tree_util.tree_map(
                 lambda a, x, n=float(i): a + (x - a) / n, acc, p32
             )
-    newest = restore_checkpoint(
-        ckpt_dir, state_template, shardings, tag=newest_tag
-    )
+    # host restore even when shardings are given: a sharded param restore
+    # here would read+place a full param set only to discard it for the
+    # average — placement happens once, on the final assembled state
+    newest = restore_checkpoint(ckpt_dir, state_template, tag=newest_tag)
     avg = jax.tree_util.tree_map(
         lambda a, ref: np.asarray(a).astype(ref.dtype), acc, newest.params
     )
+    out = newest.replace(params=avg)
     if shardings is not None:
-        # the rest of `newest` is already mesh-placed; give the averaged
-        # params the same placement instead of handing back host numpy
-        avg = jax.device_put(avg, shardings.params)
-    return newest.replace(params=avg)
+        # zip flattened leaves (restore_checkpoint's own pattern): a
+        # structural tree_map would compare the states' STATIC fields
+        # (apply_fn/tx function identities differ per instance). Plain
+        # flattening drops None fields from both trees identically.
+        leaves, treedef = jax.tree_util.tree_flatten(out)
+        sh = jax.tree_util.tree_leaves(shardings)
+        if len(sh) != len(leaves):
+            raise ValueError(
+                f"shardings tree has {len(sh)} leaves, averaged state "
+                f"has {len(leaves)}"
+            )
+        out = treedef.unflatten(
+            [jax.device_put(x, s) for x, s in zip(leaves, sh)]
+        )
+    return out

@@ -119,6 +119,9 @@ def main():
     kl = float(m["kl"])
     dparams = jax.device_get(state.params)
     log(f"distilled {DISTILL_STEPS} steps, final kl={kl:.4f}")
+    if over_budget():
+        log("budget spent after distillation — skipping speculative phase")
+        return
 
     # ---- speculative decode throughput ----------------------------------
     def spec(p, dpms, ids):

@@ -495,3 +495,63 @@ class TestShuffleBuffer:
             assert batch["x"].shape == (8,)
             seen.extend(np.asarray(batch["x"]).tolist())
         assert sorted(seen) == list(range(48))
+
+
+class TestCollateFn:
+    def test_map_style_custom_collate(self):
+        from pytorch_distributed_tpu.data import DataLoader
+
+        class VarLen:
+            lengths = [2, 4, 3, 5, 1, 2, 4, 3]
+
+            def __len__(self):
+                return len(self.lengths)
+
+            def __getitem__(self, i):
+                return np.arange(self.lengths[i], dtype=np.int32)
+
+        def pad_collate(samples):
+            width = max(len(s) for s in samples)
+            out = np.zeros((len(samples), width), np.int32)
+            mask = np.zeros((len(samples), width), bool)
+            for j, s in enumerate(samples):
+                out[j, : len(s)] = s
+                mask[j, : len(s)] = True
+            return {"tokens": out, "mask": mask}
+
+        loader = DataLoader(
+            VarLen(), 4, shuffle=False, collate_fn=pad_collate
+        )
+        batches = list(loader)
+        assert len(batches) == 2
+        assert batches[0]["tokens"].shape[0] == 4
+        # first batch holds lengths 2,4,3,5 -> padded to 5
+        assert batches[0]["tokens"].shape[1] == 5
+        assert batches[0]["mask"].sum() == 2 + 4 + 3 + 5
+
+    def test_stream_collate(self):
+        from pytorch_distributed_tpu.data import DataLoader, IterableDataset
+
+        class S(IterableDataset):
+            def __iter__(self):
+                for i in range(8):
+                    yield [i] * (i % 3 + 1)  # ragged python lists
+
+        def pad(samples):
+            w = max(len(s) for s in samples)
+            return np.asarray(
+                [s + [0] * (w - len(s)) for s in samples], np.int32
+            )
+
+        loader = DataLoader(S(), 4, collate_fn=pad)
+        batches = list(loader)
+        assert len(batches) == 2
+        assert all(b.shape[0] == 4 for b in batches)
+
+    def test_collate_and_fetch_exclusive(self):
+        from pytorch_distributed_tpu.data import ArrayDataset, DataLoader
+
+        ds = ArrayDataset(x=np.zeros((8, 2), np.float32))
+        with pytest.raises(ValueError, match="own batch assembly"):
+            DataLoader(ds, 4, collate_fn=lambda s: s,
+                       fetch=lambda d, i: d[i])
