@@ -20,6 +20,8 @@ from pytorch_distributed_tpu.train.losses import (
     distillation_loss_fn,
     masked_lm_loss_fn,
     mixup_classification_loss_fn,
+    f1_finalize,
+    text_classification_eval_step,
     text_classification_loss_fn,
     cross_entropy,
     topk_accuracy,
@@ -56,6 +58,8 @@ __all__ = [
     "mixup_classification_loss_fn",
     "causal_lm_loss_fn",
     "distillation_loss_fn",
+    "f1_finalize",
+    "text_classification_eval_step",
     "text_classification_loss_fn",
     "cross_entropy",
     "topk_accuracy",

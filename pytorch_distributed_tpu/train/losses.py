@@ -632,3 +632,76 @@ def distillation_loss_fn(
         }
 
     return loss_fn
+
+
+def text_classification_eval_step(
+    model,
+    *,
+    binary_metrics: bool = False,
+    ids_key: str = "input_ids",
+    label_key: str = "label",
+) -> Callable:
+    """``eval_step(state, batch) -> metrics`` for sequence classification.
+
+    Reports accuracy; with ``binary_metrics`` (the GLUE MRPC/QQP recipe
+    shape) it additionally emits per-sample confusion RATES (tp/fp/fn/tn
+    fractions of the batch). Rates average linearly under the Trainer's
+    sample-weighted eval mean, so dataset-level F1/MCC — which do NOT
+    average batchwise — are derived afterwards from the aggregated rates
+    via :func:`f1_finalize` (pass it as ``TrainerConfig(eval_finalize=
+    f1_finalize)``; positive class = label 1, HF's convention).
+    """
+
+    def eval_step(state, batch) -> Dict[str, jax.Array]:
+        # forward EXACTLY what the training loss forwards: attending
+        # over pads (or dropping token types) would score a different
+        # model than the one being trained
+        logits = model.apply(
+            {"params": state.params},
+            batch[ids_key],
+            batch.get("attention_mask"),
+            batch.get("token_type_ids"),
+            train=False,
+        )
+        labels = batch[label_key]
+        pred = jnp.argmax(logits, axis=-1)
+        out = {"accuracy": accuracy(logits, labels)}
+        if binary_metrics:
+            p, y = pred == 1, labels == 1
+            f32 = jnp.float32
+            out["tp_rate"] = jnp.mean((p & y).astype(f32))
+            out["fp_rate"] = jnp.mean((p & ~y).astype(f32))
+            out["fn_rate"] = jnp.mean((~p & y).astype(f32))
+            out["tn_rate"] = jnp.mean((~p & ~y).astype(f32))
+        return out
+
+    return eval_step
+
+
+def f1_finalize(means: Dict[str, float]) -> Dict[str, float]:
+    """Derive precision/recall/F1/MCC from aggregated confusion rates.
+
+    Ratio metrics are scale-invariant, so dataset-level values follow
+    from the sample-weighted MEAN rates exactly as from raw counts.
+    Zero-denominator conventions match sklearn: 0.0 (with no warning
+    machinery — a 0 where nothing was predicted positive is the honest
+    value).
+    """
+    out = dict(means)
+    try:
+        tp, fp = means["tp_rate"], means["fp_rate"]
+        fn, tn = means["fn_rate"], means["tn_rate"]
+    except KeyError:
+        return out  # nothing to finalize (plain accuracy eval)
+    prec = tp / (tp + fp) if tp + fp > 0 else 0.0
+    rec = tp / (tp + fn) if tp + fn > 0 else 0.0
+    out["precision"] = prec
+    out["recall"] = rec
+    out["f1"] = (
+        2 * prec * rec / (prec + rec) if prec + rec > 0 else 0.0
+    )
+    denom = (
+        (tp + fp) * (tp + fn) * (tn + fp) * (tn + fn)
+    ) ** 0.5
+    out["mcc"] = (tp * tn - fp * fn) / denom if denom > 0 else 0.0
+    return out

@@ -245,6 +245,10 @@ class TrainerConfig:
     early_stop_patience: Optional[int] = None  # evals without improvement
     # in the keep_best metric (same best_mode) before fit() stops early —
     # the HF EarlyStoppingCallback idiom; requires keep_best + eval_step
+    eval_finalize: Optional[Callable] = None  # means -> means transform
+    # after eval aggregation (derive ratio metrics like F1/MCC from
+    # aggregated confusion rates — train.f1_finalize); keep_best and
+    # early stopping see the finalized names
     trace_dir: Optional[str] = None  # with trace_steps: profiler output
     trace_steps: Optional[tuple] = None  # (start, stop) host steps to
     # trace — the torch.profiler schedule(wait/active) idiom: capture a
@@ -778,6 +782,8 @@ class Trainer:
             sums = dict(zip(keys, vec[:-1]))
             count = int(vec[-1])
         means = {k: v / max(count, 1) for k, v in sums.items()}
+        if self.config.eval_finalize is not None:
+            means = self.config.eval_finalize(means)
         self.last_eval_metrics = means
         logger.info(
             "eval epoch %d: %s",

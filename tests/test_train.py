@@ -1159,3 +1159,85 @@ def test_average_checkpoints_sharded_restore(dp8, tmp_path):
     leaf = jax.tree_util.tree_leaves(avg.params)[0]
     assert hasattr(leaf, "sharding")  # mesh-placed, not host numpy
     np.testing.assert_allclose(np.asarray(leaf), 2.0, rtol=1e-6)
+
+
+class TestF1Eval:
+    def test_f1_finalize_hand_case(self):
+        from pytorch_distributed_tpu.train import f1_finalize
+
+        # 10 samples: tp=3 fp=1 fn=2 tn=4 -> prec .75, rec .6, f1 ~.667
+        means = {"tp_rate": 0.3, "fp_rate": 0.1, "fn_rate": 0.2,
+                 "tn_rate": 0.4, "accuracy": 0.7}
+        out = f1_finalize(means)
+        assert out["precision"] == pytest.approx(0.75)
+        assert out["recall"] == pytest.approx(0.6)
+        assert out["f1"] == pytest.approx(2 * 0.75 * 0.6 / 1.35)
+        # MCC by the book: (tp*tn - fp*fn)/sqrt(...)
+        import math
+        want = (0.3 * 0.4 - 0.1 * 0.2) / math.sqrt(
+            0.4 * 0.5 * 0.5 * 0.6
+        )
+        assert out["mcc"] == pytest.approx(want)
+        # degenerate: never predicted positive -> sklearn's 0 convention
+        z = f1_finalize({"tp_rate": 0.0, "fp_rate": 0.0,
+                         "fn_rate": 0.5, "tn_rate": 0.5})
+        assert z["precision"] == 0.0 and z["f1"] == 0.0
+        # plain accuracy dict passes through untouched
+        assert f1_finalize({"accuracy": 0.9}) == {"accuracy": 0.9}
+
+    def test_trainer_eval_reports_f1(self, dp8):
+        from pytorch_distributed_tpu.models.bert import (
+            BertConfig,
+            BertForSequenceClassification,
+        )
+        from pytorch_distributed_tpu.train import (
+            f1_finalize,
+            text_classification_eval_step,
+            text_classification_loss_fn,
+        )
+
+        cfg = BertConfig(
+            vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+            intermediate_size=64, max_position_embeddings=32,
+            dropout_rate=0.0,
+        )
+        model = BertForSequenceClassification(cfg, num_labels=2)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(64, size=(32, 8)).astype(np.int32)
+        labels = rng.integers(2, size=(32,)).astype(np.int32)
+        params = model.init(
+            jax.random.key(0), jnp.asarray(ids[:1])
+        )["params"]
+        state = TrainState.create(
+            apply_fn=model.apply, params=params, tx=optax.sgd(0.0)
+        )
+        ds = ArrayDataset(input_ids=ids, label=labels)
+        loader = DataLoader(
+            ds, 16, shuffle=False, sharding=dp8.batch_sharding(),
+            drop_last=False,
+        )
+        trainer = Trainer(
+            dp8.place(state), dp8,
+            build_train_step(text_classification_loss_fn(model)),
+            loader,
+            eval_step=text_classification_eval_step(
+                model, binary_metrics=True
+            ),
+            eval_loader=loader,
+            config=TrainerConfig(
+                epochs=1, log_every=0, eval_finalize=f1_finalize,
+                samples_axis="input_ids",
+            ),
+        )
+        means = trainer.evaluate(0)
+        for k in ("accuracy", "precision", "recall", "f1", "mcc"):
+            assert k in means
+        # the finalized f1 from aggregated rates equals the f1 computed
+        # directly over the whole set with the same params
+        logits = model.apply({"params": params}, jnp.asarray(ids))
+        pred = np.asarray(jnp.argmax(logits, -1))
+        tp = ((pred == 1) & (labels == 1)).sum()
+        fp = ((pred == 1) & (labels == 0)).sum()
+        fn = ((pred == 0) & (labels == 1)).sum()
+        want = 2 * tp / max(2 * tp + fp + fn, 1)
+        assert means["f1"] == pytest.approx(float(want), abs=1e-6)
