@@ -29,6 +29,14 @@ packing is chosen so every shape is derivable from the packed arrays
 themselves (no side metadata): the tree stays a plain checkpointable
 pytree of arrays, and unpack is two shifts + an interleave that XLA
 fuses into the dequant consumer.
+
+Scale honesty (tests/test_llama8b.py::test_8b_int4_tree_fits_one_v5e):
+the 8B int4 tree rests in ~4.5 GB — but ``quantized_apply_fn``
+dequantizes the WHOLE tree inside the step, transiently materializing
+the bf16 weights (~16 GB at 8B). Single-chip 8B *serving* therefore
+needs per-layer dequantization under the scan (a model-level follow-up);
+today the at-rest win is real for models up to ~half HBM after
+reconstruction, and for 8B with 2+ chips.
 """
 
 from __future__ import annotations

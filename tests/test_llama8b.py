@@ -335,3 +335,36 @@ def test_8b_fsdp_train_step_lowers_for_tpu(abstract_8b_state):
         for l in jax.tree_util.tree_leaves(out_state.params)
     )
     assert n_out > 7.9e9
+
+
+def test_8b_int4_tree_fits_one_v5e(abstract_8b_state):
+    """The serving-capacity claim behind ops/quant.py, made concrete at
+    8B scale from abstract shapes: the groupwise-int4 tree (packed q4
+    bytes + f32 scales, computed by the quantizer's own sizing rules
+    over the real 8B param shapes) rests well inside ONE v5e's 15.75 GB
+    HBM. Scope stated honestly: this is the AT-REST footprint —
+    `quantized_apply_fn` dequantizes the whole tree inside the step, so
+    a full 8B decode additionally materializes the bf16 weights
+    (~16 GB) transiently; single-chip 8B *serving* therefore needs
+    per-layer dequantization under the scan (a known follow-up), while
+    2 chips clear it today."""
+    GROUP = 128
+    V5E_HBM = 15.75e9  # usable, from the measured XLA OOM report (r3)
+    total = 0
+    skipped = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+        abstract_8b_state[2].params
+    )[0]:
+        shape = leaf.shape
+        if len(shape) < 2 or int(np.prod(shape)) < 4096 or shape[-1] % 2:
+            skipped += int(np.prod(shape)) * 4  # stays f32
+            continue
+        in_last, out = shape[-2], shape[-1]
+        g = GROUP if in_last % GROUP == 0 else in_last
+        lead = int(np.prod(shape[:-2], dtype=np.int64))
+        total += lead * in_last * (out // 2)          # packed q4 bytes
+        total += lead * (in_last // g) * out * 4      # f32 scales
+    int4_bytes = total + skipped
+    # ~8B params at ~0.56 byte/weight incl. scales and f32 stragglers
+    assert 4.0e9 < int4_bytes < 6.0e9, int4_bytes / 1e9
+    assert int4_bytes < V5E_HBM / 3  # at rest: fits with 3x headroom
