@@ -84,7 +84,10 @@ def compiled_flops(compiled) -> float | None:
 
 
 def enable_compilation_cache(
-    path: str | None = None, *, min_compile_time_secs: float | None = None
+    path: str | None = None,
+    *,
+    min_compile_time_secs: float | None = None,
+    best_effort: bool = False,
 ) -> str:
     """Persistent XLA executable cache — compile once, reuse across runs.
 
@@ -97,22 +100,34 @@ def enable_compilation_cache(
     Default dir: ``$PTD_COMPILATION_CACHE`` or ``~/.cache/ptd_xla``. A
     backend whose executables can't be serialized simply never populates
     the cache — enabling is always safe. Returns the directory used.
+
+    ``best_effort``: swallow ANY failure (unwritable dir, renamed jax
+    config keys) and return "" — for callers where the cache is an
+    optimization and must never fail the surrounding contract (the test
+    conftest, the driver dryrun child).
     """
     import os
 
-    path = path or os.environ.get("PTD_COMPILATION_CACHE") or os.path.join(
-        os.path.expanduser("~"), ".cache", "ptd_xla"
-    )
-    os.makedirs(path, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", path)
-    # cache everything that took meaningful compile time; the default
-    # (1s) already skips trivial fusions
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    if min_compile_time_secs is not None:
-        jax.config.update(
-            "jax_persistent_cache_min_compile_time_secs", min_compile_time_secs
+    try:
+        path = (
+            path or os.environ.get("PTD_COMPILATION_CACHE")
+            or os.path.join(os.path.expanduser("~"), ".cache", "ptd_xla")
         )
-    return path
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache everything that took meaningful compile time; the default
+        # (1s) already skips trivial fusions
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        if min_compile_time_secs is not None:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs",
+                min_compile_time_secs,
+            )
+        return path
+    except Exception:
+        if best_effort:
+            return ""
+        raise
 
 
 def host_scalar(x) -> float:
