@@ -40,6 +40,16 @@ class LlamaConfig:
     # stacked params. False restores the unrolled per-layer tree.
     scan_layers: bool = True
     remat: bool = False  # recompute block activations in backward
+    scan_dequant: bool = False  # per-layer dequant of quantized block params
+    # inside the scan (models/scan.py) — the single-chip big-model serving path
+
+    def __post_init__(self):
+        if self.scan_dequant and not self.scan_layers:
+            raise ValueError(
+                "scan_dequant dequantizes inside the layer scan — it "
+                "requires scan_layers=True (an unrolled stack would hand "
+                "raw quantized dicts to the blocks)"
+            )
     remat_policy: str = "full"  # full | dots | dots_no_batch (models/scan.py)
 
     @property

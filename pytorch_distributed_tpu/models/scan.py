@@ -62,6 +62,18 @@ def scan_stack(
     first; every further argument is broadcast unchanged to all layers.
     Under ``remat``, pass ``static_argnums`` (0 = ``x``) marking python-bool
     args like ``deterministic`` so they stay static.
+
+    ``cfg.scan_dequant`` wraps the block in ``nn.map_variables`` so a
+    QUANTIZED stacked param tree (ops/quant.py int8/int4 leaf dicts,
+    leading ``[L]`` axis — exactly what ``quantize_tree_int8/int4``
+    produce on the stacked kernels) dequantizes PER LAYER inside each
+    scan iteration, never materializing the whole reconstructed stack:
+    peak weight residency is quantized-tree + ONE layer's bf16 weights.
+    This is what lets an int4 8B (~4.5 GB at rest) decode on a single
+    16 GB chip — whole-tree ``quantized_apply_fn`` would transiently
+    need the full ~16 GB bf16 reconstruction. Plain (unquantized)
+    leaves pass through untouched, so initializing with the flag on
+    still works and quantization stays a post-training transform.
     """
     use_remat = cfg.remat if remat is None else remat
 
@@ -69,6 +81,25 @@ def scan_stack(
         @nn.compact
         def __call__(self, x, *bcast):
             return block_cls(cfg, name="block")(x, *bcast), None
+
+    if getattr(cfg, "scan_dequant", False):
+        from pytorch_distributed_tpu.ops.quant import dequantize_tree
+        from pytorch_distributed_tpu.runtime.precision import (
+            current_policy,
+        )
+
+        def _dequant_in(vars_in):
+            policy = current_policy()
+            return dequantize_tree(vars_in, dtype=policy.param_dtype)
+
+        Body = nn.map_variables(
+            Body, "params",
+            trans_in_fn=_dequant_in,
+            # init path: params created inside are plain arrays; store
+            # them unchanged (quantization happens outside, later)
+            trans_out_fn=lambda v: v,
+            mutable=True,
+        )
 
     body = (
         nn.remat(

@@ -23,6 +23,7 @@ from pytorch_distributed_tpu.ops.lm_loss import (
 from pytorch_distributed_tpu.ops.quant import (
     dequantize_tree,
     QuantizedModel,
+    quantize_for_scan_dequant,
     quantize_tree_int4,
     quantize_tree_int8,
     quantized_apply_fn,
@@ -37,6 +38,7 @@ from pytorch_distributed_tpu.ops.moe import (
 __all__ = [
     "dequantize_tree",
     "QuantizedModel",
+    "quantize_for_scan_dequant",
     "quantize_tree_int4",
     "quantize_tree_int8",
     "quantized_apply_fn",

@@ -5,8 +5,11 @@ The torch ecosystem reaches int8 serving through module surgery
 (`bnb.nn.Linear8bitLt` swaps). Under jax the parameters are data, so the
 whole feature is two pure functions over the params pytree:
 
-* :func:`quantize_tree_int8` — symmetric per-output-channel int8 for
-  every >=2-D kernel whose path matches ``include`` (default: all);
+* :func:`quantize_tree_int8` — symmetric int8 with axis(-2)-reduced
+  scales (exactly per-output-channel for 2-D kernels; multi-dim
+  DenseGeneral kernels keep finer per-slice scales — a few % extra
+  scale bytes, tighter error) for every >=2-D leaf whose path matches
+  ``include`` (default: all);
   1-D leaves (biases, norm scales) and embeddings below ``min_size``
   stay untouched. Each quantized leaf becomes a ``{"q8", "scale"}``
   subtree, so the result is still one checkpointable pytree.
@@ -33,10 +36,11 @@ fuses into the dequant consumer.
 Scale honesty (tests/test_llama8b.py::test_8b_int4_tree_fits_one_v5e):
 the 8B int4 tree rests in ~4.5 GB — but ``quantized_apply_fn``
 dequantizes the WHOLE tree inside the step, transiently materializing
-the bf16 weights (~16 GB at 8B). Single-chip 8B *serving* therefore
-needs per-layer dequantization under the scan (a model-level follow-up);
-today the at-rest win is real for models up to ~half HBM after
-reconstruction, and for 8B with 2+ chips.
+the bf16 weights (~16 GB at 8B). For single-chip big-model serving use
+``scan_dequant`` (models/scan.py + the model configs): the scanned
+blocks' quantized kernels dequantize PER LAYER inside each scan tick
+(peak weight residency = quantized tree + one layer's bf16), pinned
+bitwise-equal to the whole-tree path in tests/test_quant.py.
 """
 
 from __future__ import annotations
@@ -89,9 +93,13 @@ def quantize_tree_int8(
     ``min_size``: leaves with fewer elements stay full precision (tiny
     kernels don't pay for their scales).
 
-    The scale is per OUTPUT channel (last axis), shaped [1, ..., n]: the
-    flax kernel convention is [in..., out], and per-out-channel scales
-    track the variance structure weight matrices actually have.
+    The scale reduces the second-to-last axis only, shaped
+    [..., 1, out]: for the common 2-D [in, out] kernel that is exactly
+    per-output-channel (the variance structure weight matrices actually
+    have); for N-D kernels — including SCANNED stacks whose leading axis
+    is the layer — every other axis keeps its own scales, so the layer
+    axis survives and ``scan_dequant`` (models/scan.py) can slice the
+    quantized tree per layer. Same axis convention as the int4 grouping.
     """
     regs = _compile_includes(include)
 
@@ -99,8 +107,7 @@ def quantize_tree_int8(
         if _skip_leaf(path, leaf, regs, min_size):
             return leaf
         f = leaf.astype(jnp.float32)
-        amax = jnp.max(jnp.abs(f), axis=tuple(range(leaf.ndim - 1)),
-                       keepdims=True)
+        amax = jnp.max(jnp.abs(f), axis=leaf.ndim - 2, keepdims=True)
         scale = jnp.where(amax > 0, amax / 127.0, 1.0)
         # symmetric, no zero-point. jnp.round is IEEE half-to-even —
         # ties break differently from the hostring collective's
@@ -165,6 +172,14 @@ def quantize_tree_int4(
 
 def _dq4(leaf, dtype):
     packed, scale = leaf["q4"], leaf["scale"]
+    if packed.ndim < 2:
+        raise ValueError(
+            "1-D int4 leaf: this is a quantized STACKED BIAS sliced per "
+            "layer (scan_dequant) — a stacked [L, n] bias looks like a "
+            "2-D matrix to the quantizer. Restrict quantization to "
+            "kernels, e.g. quantize_tree_int4(params, "
+            "include=(r'blocks/.*/kernel$',))"
+        )
     # sign-extend each nibble: shift into the high bits of an int8 and
     # arithmetic-shift back down
     as_i8 = packed.astype(jnp.int8)
@@ -227,6 +242,31 @@ def quantized_apply_fn(model, dtype=None):
         return model.apply(variables, *args, **kwargs)
 
     return apply_fn
+
+
+def quantize_for_scan_dequant(params, kind: str = "int4", **kw):
+    """Quantize a SCANNED model's params for the ``scan_dequant``
+    serving path — the only quantization layout that path accepts.
+
+    Restricts quantization to kernels INSIDE the scanned stack
+    (paths containing the scan segment, ``.../block/.../kernel``):
+
+    * stacked biases ([L, n]) look like 2-D matrices to the generic
+      quantizers but their scales collapse the layer axis, which the
+      scan's per-layer split rejects with an opaque shape error;
+    * leaves OUTSIDE the scan (embeddings, final norms, an untied
+      lm_head) are never seen by the scan's dequant hook and would hit
+      the model as raw quantized dicts.
+
+    Everything else stays full precision. ``kind``: "int4" (groupwise,
+    the 8x path) or "int8"; extra kwargs forward to the quantizer.
+    """
+    include = (r"/block/.*/kernel$",)
+    if kind == "int4":
+        return quantize_tree_int4(params, include=include, **kw)
+    if kind == "int8":
+        return quantize_tree_int8(params, include=include, **kw)
+    raise ValueError(f"kind must be 'int4' or 'int8', got {kind!r}")
 
 
 class QuantizedModel:

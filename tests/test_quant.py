@@ -208,3 +208,127 @@ class TestInt4:
         # int4 is lossier than int8; random tiny weights are the worst
         # case, yet the argmax chain should still mostly hold
         assert agree > 0.4, agree
+
+
+class TestScanDequant:
+    """Per-layer dequantization inside the scan (models/scan.py): the
+    single-chip big-model serving path. The stored tree is the ordinary
+    quantizer output on the stacked kernels; map_variables dequantizes
+    one layer's slice per scan tick, so peak weight residency is
+    quantized-tree + one layer — and the result is BITWISE the
+    whole-tree dequant wrapper's."""
+
+    def _gpt2(self):
+        import dataclasses
+
+        from pytorch_distributed_tpu.models import GPT2Config, GPT2LMHead
+
+        cfg = GPT2Config(
+            vocab_size=128, n_positions=64, hidden_size=64, num_layers=3,
+            num_heads=4, dropout_rate=0.0,
+        )
+        model = GPT2LMHead(cfg)
+        ids = jnp.asarray(
+            np.random.default_rng(0).integers(
+                128, size=(2, 10)
+            ).astype(np.int32)
+        )
+        params = model.init(jax.random.key(0), ids)["params"]
+        qmodel = GPT2LMHead(dataclasses.replace(cfg, scan_dequant=True))
+        return model, qmodel, params, ids
+
+    def test_gpt2_per_layer_equals_whole_tree(self):
+        from pytorch_distributed_tpu.ops import (
+            QuantizedModel,
+            quantize_tree_int4,
+        )
+
+        model, qmodel, params, ids = self._gpt2()
+        from pytorch_distributed_tpu.ops import quantize_for_scan_dequant
+
+        q = quantize_for_scan_dequant(params, "int4", min_size=512)
+        a = QuantizedModel(model).apply({"params": q}, ids)
+        b = qmodel.apply({"params": q}, ids)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # plain trees pass through the mapped scan unchanged
+        c = qmodel.apply({"params": params}, ids)
+        d = model.apply({"params": params}, ids)
+        np.testing.assert_array_equal(np.asarray(c), np.asarray(d))
+
+    @pytest.mark.slow
+    def test_gpt2_decode_through_per_layer_dequant(self):
+        from pytorch_distributed_tpu import generation
+        from pytorch_distributed_tpu.ops import (
+            QuantizedModel,
+            quantize_tree_int8,
+        )
+
+        model, qmodel, params, ids = self._gpt2()
+        from pytorch_distributed_tpu.ops import quantize_for_scan_dequant
+
+        q = quantize_for_scan_dequant(params, "int8", min_size=512)
+        a = generation.generate(
+            qmodel, q, ids[:, :5], max_new_tokens=6, temperature=0.0
+        )
+        b = generation.generate(
+            QuantizedModel(model), q, ids[:, :5],
+            max_new_tokens=6, temperature=0.0,
+        )
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.slow
+    def test_llama_per_layer_equals_whole_tree(self):
+        import dataclasses
+
+        from pytorch_distributed_tpu.models.llama import (
+            LlamaConfig,
+            LlamaForCausalLM,
+        )
+        from pytorch_distributed_tpu.ops import (
+            QuantizedModel,
+            quantize_tree_int4,
+        )
+
+        cfg = LlamaConfig(
+            vocab_size=96, hidden_size=64, num_layers=3, num_heads=4,
+            num_kv_heads=2, intermediate_size=128, max_seq_len=64,
+        )
+        model = LlamaForCausalLM(cfg)
+        ids = jnp.asarray(
+            np.random.default_rng(1).integers(
+                96, size=(2, 8)
+            ).astype(np.int32)
+        )
+        params = model.init(jax.random.key(0), ids)["params"]
+        from pytorch_distributed_tpu.ops import quantize_for_scan_dequant
+
+        q = quantize_for_scan_dequant(params, "int4", min_size=512)
+        a = QuantizedModel(model).apply({"params": q}, ids)
+        qmodel = LlamaForCausalLM(
+            dataclasses.replace(cfg, scan_dequant=True)
+        )
+        b = qmodel.apply({"params": q}, ids)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_scan_dequant_requires_scan_layers(self):
+        import dataclasses
+
+        from pytorch_distributed_tpu.models import GPT2Config
+
+        with pytest.raises(ValueError, match="requires scan_layers"):
+            GPT2Config(scan_layers=False, scan_dequant=True)
+
+    def test_stacked_bias_quantization_is_loud(self):
+        from pytorch_distributed_tpu.ops import (
+            dequantize_tree,
+            quantize_tree_int4,
+        )
+
+        # a stacked [L, n] bias is indistinguishable from a matrix at
+        # quantize time; slicing it per layer must fail with guidance,
+        # not an opaque index error
+        stacked_bias = {"b": jnp.ones((4, 512), jnp.float32)}
+        q = quantize_tree_int4(stacked_bias, min_size=256)
+        sliced = {"b": jax.tree_util.tree_map(lambda x: x[0], q["b"])}
+        with pytest.raises(ValueError, match="STACKED BIAS"):
+            dequantize_tree(sliced)
