@@ -460,18 +460,21 @@ def bench_generate(on_tpu: bool) -> None:
         )
     )
 
-    def timed(params):
-        out = run(params, ids)
+    iters = 5 if on_tpu else 2
+
+    def timed(run_fn, params):
+        # ONE methodology for every decode variant, so the vs_baseline
+        # ratios can never drift apart
+        out = run_fn(params, ids)
         int(out[0, -1])  # compile + sync
-        iters = 5 if on_tpu else 2
         t0 = time.perf_counter()
         for _ in range(iters):
-            out = run(params, ids)
+            out = run_fn(params, ids)
         int(out[0, -1])
         dt = (time.perf_counter() - t0) / iters
         return B * NEW / dt, dt
 
-    tok_per_sec, dt = timed(params)
+    tok_per_sec, dt = timed(run, params)
     _emit(
         {
             "metric": "gpt2_decode_tokens_per_sec",
@@ -489,7 +492,7 @@ def bench_generate(on_tpu: bool) -> None:
         if x.dtype == jnp.float32 else x,
         params,
     )
-    tok_bf16, dt_bf16 = timed(bf16_params)
+    tok_bf16, dt_bf16 = timed(run, bf16_params)
     _emit(
         {
             "metric": "gpt2_decode_bf16_params_tokens_per_sec",
@@ -499,9 +502,33 @@ def bench_generate(on_tpu: bool) -> None:
             "vs_baseline": round(tok_bf16 / tok_per_sec, 3),
         }
     )
+    # int4 at rest + per-layer dequant in the scan: quarter the weight
+    # reads of f32 per decoded token at the cost of the unpack arithmetic
+    # — the quantized-serving datapoint (models/scan.py scan_dequant)
+    from pytorch_distributed_tpu.ops import quantize_for_scan_dequant
+
+    qcfg = dataclasses.replace(cfg, scan_dequant=True)
+    qmodel = GPT2LMHead(qcfg)
+    qparams = quantize_for_scan_dequant(params, "int4")
+    run_q = jax.jit(
+        lambda p, ids: ptd.generate(
+            qmodel, p, ids, max_new_tokens=NEW, temperature=0.0
+        )
+    )
+    tok_q, dt_q = timed(run_q, qparams)
+    _emit(
+        {
+            "metric": "gpt2_decode_int4_scan_tokens_per_sec",
+            "value": round(tok_q, 1),
+            "unit": f"tokens/sec, int4 at rest + per-layer dequant, "
+            f"batch={B} prompt={P} new={NEW}",
+            "vs_baseline": round(tok_q / tok_per_sec, 3),
+        }
+    )
     print(
         f"# generate: kv-cache decode {NEW} tokens x batch {B} in "
-        f"{dt * 1e3:.0f}ms/call f32 / {dt_bf16 * 1e3:.0f}ms/call bf16",
+        f"{dt * 1e3:.0f}ms/call f32 / {dt_bf16 * 1e3:.0f}ms/call bf16 / "
+        f"{dt_q * 1e3:.0f}ms/call int4-scan",
         file=sys.stderr,
     )
 

@@ -6,10 +6,12 @@ The torch ecosystem reaches int8 serving through module surgery
 whole feature is two pure functions over the params pytree:
 
 * :func:`quantize_tree_int8` — symmetric int8 with axis(-2)-reduced
-  scales (exactly per-output-channel for 2-D kernels; multi-dim
-  DenseGeneral kernels keep finer per-slice scales — a few % extra
-  scale bytes, tighter error) for every >=2-D leaf whose path matches
-  ``include`` (default: all);
+  scales (exactly per-output-channel for 2-D kernels). Multi-dim
+  DenseGeneral kernels keep finer per-slice scales whose f32 storage is
+  ``4 / size(axis -2)`` of the int8 payload — negligible when axis -2
+  is an input/feature dim, but ~33% on a 12-head attention qkv kernel
+  where axis -2 is ``heads``; budget with :func:`quantized_bytes`, not
+  the nominal 1 byte/weight;
   1-D leaves (biases, norm scales) and embeddings below ``min_size``
   stay untouched. Each quantized leaf becomes a ``{"q8", "scale"}``
   subtree, so the result is still one checkpointable pytree.
@@ -176,9 +178,9 @@ def _dq4(leaf, dtype):
         raise ValueError(
             "1-D int4 leaf: this is a quantized STACKED BIAS sliced per "
             "layer (scan_dequant) — a stacked [L, n] bias looks like a "
-            "2-D matrix to the quantizer. Restrict quantization to "
-            "kernels, e.g. quantize_tree_int4(params, "
-            "include=(r'blocks/.*/kernel$',))"
+            "2-D matrix to the quantizer. Build scan_dequant trees with "
+            "quantize_for_scan_dequant(params, kind), which restricts "
+            "quantization to the scanned kernels"
         )
     # sign-extend each nibble: shift into the high bits of an int8 and
     # arithmetic-shift back down

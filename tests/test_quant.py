@@ -210,6 +210,15 @@ class TestInt4:
         assert agree > 0.4, agree
 
 
+def _n_quantized(tree):
+    from pytorch_distributed_tpu.ops.quant import _is_qleaf
+
+    return sum(
+        1 for leaf in jax.tree_util.tree_leaves(tree, is_leaf=_is_qleaf)
+        if _is_qleaf(leaf)
+    )
+
+
 class TestScanDequant:
     """Per-layer dequantization inside the scan (models/scan.py): the
     single-chip big-model serving path. The stored tree is the ordinary
@@ -247,6 +256,8 @@ class TestScanDequant:
         from pytorch_distributed_tpu.ops import quantize_for_scan_dequant
 
         q = quantize_for_scan_dequant(params, "int4", min_size=512)
+        assert _n_quantized(q) > 0  # a stale include regex would make
+        # every equality below vacuous (unquantized == unquantized)
         a = QuantizedModel(model).apply({"params": q}, ids)
         b = qmodel.apply({"params": q}, ids)
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
@@ -267,6 +278,7 @@ class TestScanDequant:
         from pytorch_distributed_tpu.ops import quantize_for_scan_dequant
 
         q = quantize_for_scan_dequant(params, "int8", min_size=512)
+        assert _n_quantized(q) > 0
         a = generation.generate(
             qmodel, q, ids[:, :5], max_new_tokens=6, temperature=0.0
         )
@@ -303,6 +315,7 @@ class TestScanDequant:
         from pytorch_distributed_tpu.ops import quantize_for_scan_dequant
 
         q = quantize_for_scan_dequant(params, "int4", min_size=512)
+        assert _n_quantized(q) > 0
         a = QuantizedModel(model).apply({"params": q}, ids)
         qmodel = LlamaForCausalLM(
             dataclasses.replace(cfg, scan_dequant=True)
