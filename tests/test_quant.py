@@ -345,3 +345,42 @@ class TestScanDequant:
         sliced = {"b": jax.tree_util.tree_map(lambda x: x[0], q["b"])}
         with pytest.raises(ValueError, match="STACKED BIAS"):
             dequantize_tree(sliced)
+
+
+@pytest.mark.slow
+def test_scan_dequant_peak_memory_is_per_layer():
+    """The residency claim, MEASURED: XLA's own memory analysis shows the
+    per-layer path's temp allocation is a small fraction of the
+    whole-tree dequant's (which materializes every reconstructed layer at
+    once). At L=8 the measured ratio is ~8.5x; the pin at 4x leaves
+    headroom for scheduler changes while still proving the mechanism."""
+    import dataclasses
+
+    from pytorch_distributed_tpu.models import GPT2Config, GPT2LMHead
+    from pytorch_distributed_tpu.ops import (
+        QuantizedModel,
+        quantize_for_scan_dequant,
+    )
+
+    cfg = GPT2Config(
+        vocab_size=256, n_positions=64, hidden_size=256, num_layers=8,
+        num_heads=4, dropout_rate=0.0,
+    )
+    model = GPT2LMHead(cfg)
+    ids = jnp.zeros((1, 16), jnp.int32)
+    params = model.init(jax.random.key(0), ids)["params"]
+    q = quantize_for_scan_dequant(params, "int4")
+    assert _n_quantized(q) > 0
+    qmodel = GPT2LMHead(dataclasses.replace(cfg, scan_dequant=True))
+
+    def temp_bytes(f):
+        stats = jax.jit(f).lower(q).compile().memory_analysis()
+        if stats is None:  # backend without analysis: nothing to pin
+            pytest.skip("backend exposes no memory analysis")
+        return stats.temp_size_in_bytes
+
+    per_layer = temp_bytes(lambda p: qmodel.apply({"params": p}, ids))
+    whole = temp_bytes(
+        lambda p: QuantizedModel(model).apply({"params": p}, ids)
+    )
+    assert per_layer * 4 < whole, (per_layer, whole)
