@@ -1,7 +1,10 @@
 """LoRA fine-tuning — low-rank adapters as a pure params-pytree transform.
 
 The peft/`LoraConfig` idiom without module surgery (same philosophy as
-``ops/quant.py``): the base checkpoint stays a frozen pytree, the
+``ops/quant.py``), INCLUDING the QLoRA composition: the frozen base may
+be an int8/int4 quantized tree — adapters init from the reconstructed
+kernel shapes, and the merge dequantizes transiently before adding the
+full-precision delta (Dettmers et al.'s recipe shape). Otherwise: the
 trainable state is a tiny adapter tree mirroring the matched kernels,
 and a duck-typed wrapper merges ``W + (alpha/r) * A @ B`` inside the
 jitted step. Because the wrapper exposes the same ``.apply`` surface the
@@ -47,6 +50,12 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from pytorch_distributed_tpu.ops.quant import (
+    _is_qleaf,
+    dequantize_tree,
+    original_shape,
+)
+
 # pattern -> number of trailing OUT axes in the matched kernel.
 # GPT-2: fused qkv [.., D, 3, H, hd] (out=3), attn_out [.., H, hd, D]
 # (out=1), mlp_{up,down} [.., in, out] (out=1).
@@ -76,10 +85,13 @@ def _walk(tree, prefix=""):
     for k in sorted(tree):
         v = tree[k]
         path = f"{prefix}/{k}" if prefix else k
-        if isinstance(v, dict):
+        if isinstance(v, dict) and not _is_qleaf(v):
             yield from _walk(v, path)
         else:
             yield path, v
+
+
+
 
 
 def _match(path: str, targets: Dict[str, int]) -> Optional[int]:
@@ -129,7 +141,7 @@ def lora_init(
         if n_out is None:
             continue
         n_matched += 1
-        scan_d, in_d, out_d = _geometry(path, leaf.shape, n_out)
+        scan_d, in_d, out_d = _geometry(path, original_shape(leaf), n_out)
         fan_in = math.prod(in_d)
         rng, sub = jax.random.split(rng)
         a = jax.random.normal(
@@ -150,7 +162,9 @@ def lora_init(
     return adapters
 
 
-def lora_merge(params, adapters, *, alpha: Optional[float] = None):
+def lora_merge(
+    params, adapters, *, alpha: Optional[float] = None, dtype=None
+):
     """``W + (alpha/r) * A @ B`` for every adapted kernel; other leaves
     pass through untouched. ``alpha`` defaults to the rank (scaling 1,
     the common starting point; peft's ``lora_alpha`` maps directly).
@@ -165,6 +179,14 @@ def lora_merge(params, adapters, *, alpha: Optional[float] = None):
 
     def merge(path, leaf, node):
         sub = node.get("a") if isinstance(node, dict) else None
+        if _is_qleaf(leaf):
+            # QLoRA: the frozen base is int8/int4 at rest; reconstruct
+            # transiently — EVERY quantized leaf, adapted or not (an
+            # unadapted quantized embedding must still reach the model
+            # as an array), then add the delta where one exists.
+            # ``dtype`` bounds the transient cost: bf16 reconstruction
+            # halves peak HBM vs the f32 default at 8B scale.
+            leaf = dequantize_tree(leaf, dtype=dtype)
         if sub is None:
             return leaf
         consumed.append(path)
@@ -178,7 +200,7 @@ def lora_merge(params, adapters, *, alpha: Optional[float] = None):
         out = {}
         for k, v in ptree.items():
             node = atree.get(k, {}) if isinstance(atree, dict) else {}
-            if isinstance(v, dict):
+            if isinstance(v, dict) and not _is_qleaf(v):
                 out[k] = rec(v, node, f"{prefix}/{k}")
             else:
                 out[k] = merge(f"{prefix}/{k}", v, node)
@@ -207,10 +229,13 @@ class LoRAModel:
     gradients.
     """
 
-    def __init__(self, model, base_params, *, alpha=None):
+    def __init__(self, model, base_params, *, alpha=None, dtype=None):
         self.model = model
         self.base_params = base_params
         self.alpha = alpha
+        self.dtype = dtype  # quantized-base reconstruction dtype
+        # (pass the compute dtype, e.g. jnp.bfloat16, to halve the
+        # transient merged tree vs f32 — the QuantizedModel precedent)
 
     @property
     def config(self):  # generation length checks read model.config
@@ -218,7 +243,8 @@ class LoRAModel:
 
     def apply(self, variables, *args, **kwargs):
         merged = lora_merge(
-            self.base_params, variables["params"], alpha=self.alpha
+            self.base_params, variables["params"],
+            alpha=self.alpha, dtype=self.dtype,
         )
         rest = {k: v for k, v in variables.items() if k != "params"}
         return self.model.apply(

@@ -246,6 +246,20 @@ def quantized_apply_fn(model, dtype=None):
     return apply_fn
 
 
+def original_shape(leaf):
+    """The pre-quantization shape of a quantized leaf (or any array's
+    own shape) — the ONE place that knows int4 packs out pairs along
+    the last axis. Consumers sizing adapters/buffers against quantized
+    trees (lora.py) read shapes through this instead of re-encoding the
+    packing."""
+    if not _is_qleaf(leaf):
+        return leaf.shape
+    if "q8" in leaf:
+        return leaf["q8"].shape
+    q4 = leaf["q4"]
+    return (*q4.shape[:-1], q4.shape[-1] * 2)
+
+
 def quantize_for_scan_dequant(params, kind: str = "int4", **kw):
     """Quantize a SCANNED model's params for the ``scan_dequant``
     serving path — the only quantization layout that path accepts.
