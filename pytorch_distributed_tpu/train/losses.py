@@ -470,8 +470,11 @@ def causal_lm_loss_fn(
     return loss_fn
 
 
-def text_classification_loss_fn(model) -> Callable:
-    """Trainer-contract loss for BERT-style sequence classification."""
+def text_classification_loss_fn(
+    model, *, label_smoothing: float = 0.0
+) -> Callable:
+    """Trainer-contract loss for BERT-style sequence classification.
+    ``label_smoothing`` matches torch ``CrossEntropyLoss``'s kwarg."""
 
     def loss_fn(params, batch_stats, batch, rng):
         logits = model.apply(
@@ -481,7 +484,9 @@ def text_classification_loss_fn(model) -> Callable:
             train=True,
             rngs={"dropout": rng},
         )
-        loss = cross_entropy(logits, batch["label"])
+        loss = cross_entropy(
+            logits, batch["label"], label_smoothing=label_smoothing
+        )
         return loss, {
             "metrics": {"loss": loss, "accuracy": accuracy(logits, batch["label"])},
             "batch_stats": batch_stats,
