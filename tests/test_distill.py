@@ -142,3 +142,33 @@ def test_packed_distillation_masks_boundaries():
     assert float(loss) != pytest.approx(float(loss_nomask), rel=1e-6)
     valid = packed_loss_mask(seg)
     assert int(valid.sum()) < seg.size - seg.shape[0]  # boundaries masked
+
+
+def test_distillation_from_quantized_teacher():
+    # distilling FROM a deployed int8 model: the teacher slot takes any
+    # .apply surface, so QuantizedModel drops in — pinned against
+    # distilling from the explicitly-dequantized tree
+    from pytorch_distributed_tpu.ops import QuantizedModel
+    from pytorch_distributed_tpu.ops.quant import (
+        dequantize_tree,
+        quantize_tree_int8,
+    )
+
+    teacher, tp, student, sp, ids = _pair()
+    q = quantize_tree_int8(tp, min_size=512)
+    key = jax.random.key(0)
+    kd_q = distillation_loss_fn(
+        student, QuantizedModel(teacher), q, alpha=0.3
+    )
+    loss_q, out_q = kd_q(sp, None, {"input_ids": ids}, key)
+    kd_deq = distillation_loss_fn(
+        student, teacher, dequantize_tree(q), alpha=0.3
+    )
+    loss_d, out_d = kd_deq(sp, None, {"input_ids": ids}, key)
+    np.testing.assert_allclose(
+        float(loss_q), float(loss_d), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(out_q["metrics"]["kl"]), float(out_d["metrics"]["kl"]),
+        rtol=1e-5,
+    )
