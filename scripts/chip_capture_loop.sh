@@ -1,0 +1,57 @@
+#!/bin/bash
+# Persistent chip-evidence capture loop (docs/CHIP_PROTOCOL.md rules:
+# one relay client at a time, NO external kill timers, capture order
+# cheap-first). Run detached at session start:
+#
+#   setsid scripts/chip_capture_loop.sh < /dev/null > /dev/null 2>&1 &
+#
+# Cycles bench.py (internal budgets; CPU-fallback is harmless and keeps
+# the driver-contract path exercised) until a platform:tpu capture
+# lands, then runs the post-capture chain once and exits. Poll
+# chip_evidence/capture_loop.log; artifacts land in chip_evidence/ for
+# committing as they appear.
+cd "$(dirname "$0")/.."
+EV=chip_evidence
+TAG=${1:-loop}
+log() { echo "[$TAG $(date -u +%H:%M:%S)] $*" >> $EV/capture_loop.log; }
+
+log "=== capture loop start ==="
+attempt=0
+while true; do
+  attempt=$((attempt+1))
+  log "attempt $attempt: bench.py"
+  PTD_BENCH_BUDGET_S=4200 python bench.py \
+    > $EV/bench_${TAG}_$attempt.out 2> $EV/bench_${TAG}_$attempt.err
+  log "attempt $attempt bench rc=$?"
+  if grep -q '"platform": "tpu"' $EV/bench_${TAG}_$attempt.out; then
+    log "TPU capture landed — running the post-capture chain"
+    python scripts/gpt2_variants.py > $EV/gpt2_variants_${TAG}.log 2>&1
+    log "variants rc=$?"
+    python scripts/accuracy_proxy.py > $EV/accuracy_proxy_${TAG}.log 2>&1
+    log "accuracy rc=$?"
+    python scripts/resnet_sweep.py --stems imagenet s2d \
+      > $EV/resnet_sweep_${TAG}.log 2>&1
+    log "sweep rc=$?"
+    PTD_PROBE_BUDGET_S=1500 python scripts/speculative_bench.py \
+      > $EV/speculative_bench_${TAG}.log 2>&1
+    log "speculative rc=$?"
+    # experimental kernels LAST (the documented relay-wedge hazard)
+    PTD_PROBE_BUDGET_S=1200 python scripts/flash_compile_diag.py \
+      > $EV/flash_diag_${TAG}.log 2>&1
+    log "flash diag rc=$?"
+    PTD_PROBE_BUDGET_S=1200 python scripts/flash_vs_xla.py \
+      > $EV/flash_vs_xla_${TAG}.log 2>&1
+    log "flash vs xla rc=$?"
+    log "=== chain complete ==="
+    break
+  fi
+  # a failed probe already burned its internal retry; short gap, retry.
+  # Prune the repetitive fallback logs so the evidence dir stays legible
+  # (keep attempt 1 and the latest).
+  if [ "$attempt" -gt 2 ]; then
+    prev=$((attempt-1))
+    [ "$prev" -gt 1 ] && rm -f $EV/bench_${TAG}_$prev.out $EV/bench_${TAG}_$prev.err
+  fi
+  sleep 300
+done
+log "=== capture loop exit ==="
