@@ -38,13 +38,6 @@ class GPT2Config:
     scan_dequant: bool = False  # per-layer dequant of quantized block params
     # inside the scan (models/scan.py) — the single-chip big-model serving path
 
-    def __post_init__(self):
-        if self.scan_dequant and not self.scan_layers:
-            raise ValueError(
-                "scan_dequant dequantizes inside the layer scan — it "
-                "requires scan_layers=True (an unrolled stack would hand "
-                "raw quantized dicts to the blocks)"
-            )
     remat_policy: str = "full"  # full | dots | dots_no_batch (models/scan.py)
     # > 0 turns every block's FFN into a mixture-of-experts (ops/moe.py):
     # experts shard over the ep mesh axis. Uniform across layers so the
@@ -56,6 +49,14 @@ class GPT2Config:
     # at full-batch width may survive at decode width — so outputs are
     # only decode-vs-recompute identical when capacity is ample.
     moe_capacity_factor: float = 1.25
+
+    def __post_init__(self):
+        if self.scan_dequant and not self.scan_layers:
+            raise ValueError(
+                "scan_dequant dequantizes inside the layer scan — it "
+                "requires scan_layers=True (an unrolled stack would hand "
+                "raw quantized dicts to the blocks)"
+            )
 
     @property
     def intermediate_size(self) -> int:

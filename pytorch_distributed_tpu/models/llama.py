@@ -43,6 +43,8 @@ class LlamaConfig:
     scan_dequant: bool = False  # per-layer dequant of quantized block params
     # inside the scan (models/scan.py) — the single-chip big-model serving path
 
+    remat_policy: str = "full"  # full | dots | dots_no_batch (models/scan.py)
+
     def __post_init__(self):
         if self.scan_dequant and not self.scan_layers:
             raise ValueError(
@@ -50,7 +52,6 @@ class LlamaConfig:
                 "requires scan_layers=True (an unrolled stack would hand "
                 "raw quantized dicts to the blocks)"
             )
-    remat_policy: str = "full"  # full | dots | dots_no_batch (models/scan.py)
 
     @property
     def head_dim(self) -> int:

@@ -78,8 +78,10 @@ def _skip_leaf(path, leaf, regs, min_size) -> bool:
         return True
     if leaf.ndim < 2 or leaf.size < min_size:
         return True
+    # Match against '/'-prefixed paths (lora.py's _match convention) so
+    # '/block/...' patterns hit a root-level scan segment too.
     return regs is not None and not any(
-        r.search(path_str(path)) for r in regs
+        r.search("/" + path_str(path)) for r in regs
     )
 
 
@@ -91,7 +93,8 @@ def quantize_tree_int8(
 ):
     """Quantize matching >=2-D leaves to symmetric per-channel int8.
 
-    ``include``: path regexes (re.search over 'a/b/c' paths); None = all.
+    ``include``: path regexes (re.search over '/'-prefixed '/a/b/c'
+    paths, lora.py's convention); None = all.
     ``min_size``: leaves with fewer elements stay full precision (tiny
     kernels don't pay for their scales).
 

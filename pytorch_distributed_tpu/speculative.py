@@ -156,8 +156,11 @@ def generate_speculative(
     ``return_stats`` additionally returns ``{"rounds": R, "drafted": D,
     "accepted": A}`` (host ints): R target passes emitted the sequence
     (R == max_new - 1 means the draft never helped; R ~= max_new/(k+1)
-    means it nearly always did), A of D proposed draft tokens were
-    accepted.
+    means it nearly always did). D counts proposals the row could
+    actually consume (min(k, tokens left before max_new)) and A the
+    accepted drafts that landed inside the emitted window — so A/D is
+    useful-acceptance, not raw proposal-acceptance, and short or
+    eos-truncated generations don't overstate it.
     """
     sampling = temperature != 0.0
     if sampling and temperature < 0.0:
@@ -372,6 +375,14 @@ def generate_speculative(
         x_last = jnp.where(c["done"], c["x_last"], last)
 
         active = (~c["done"]).astype(jnp.int32)
+        # Stats count USEFUL work, clamped by the emitted budget: a row
+        # near its max_new horizon can only consume min(k, remaining)
+        # proposals, and of the `a` accepted drafts only the ones inside
+        # the emitted window (positions 0..a-1 of emit_tok are drafts,
+        # position a is the correction) actually landed — min(a, n_emit).
+        # Raw a/k would overstate acceptance for short or eos-heavy runs.
+        consumable = jnp.minimum(k, remaining)
+        landed = jnp.minimum(a, n_emit)
         return dict(
             out=out, emitted=emitted, done=done, x_last=x_last,
             rng=rng_next,
@@ -379,8 +390,8 @@ def generate_speculative(
             mask_t=mask_t, mask_d=mask_d,
             c_t=c["c_t"] + (k + 1), c_d=c["c_d"] + (k + 1),
             rounds=c["rounds"] + 1,
-            drafted=c["drafted"] + k * jnp.sum(active),
-            accepted=c["accepted"] + jnp.sum(a * active),
+            drafted=c["drafted"] + jnp.sum(consumable * active),
+            accepted=c["accepted"] + jnp.sum(landed * active),
         )
 
     final = lax.while_loop(cond, body, carry)
