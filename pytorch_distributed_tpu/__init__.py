@@ -70,6 +70,7 @@ from pytorch_distributed_tpu.runtime.distributed import (
 from pytorch_distributed_tpu.runtime.precision import (
     Policy,
     autocast,
+    use_policy,
     GradScaler,
     current_policy,
 )
@@ -141,6 +142,7 @@ __all__ = [
     "sample_logits",
     "Policy",
     "autocast",
+    "use_policy",
     "GradScaler",
     "current_policy",
     "RngSeq",

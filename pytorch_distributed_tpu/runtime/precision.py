@@ -64,7 +64,6 @@ def current_policy() -> Policy:
     return getattr(_STATE, "policy", Policy())
 
 
-@contextlib.contextmanager
 def autocast(enabled: bool = True, dtype=jnp.bfloat16):
     """AMP-shaped context manager selecting the compute dtype.
 
@@ -72,10 +71,21 @@ def autocast(enabled: bool = True, dtype=jnp.bfloat16):
     ``current_policy()`` at *trace* time, so wrap the jit/trace site
     (building the train step), not the runtime step call.
     """
+    return use_policy(Policy(compute_dtype=dtype) if enabled else _FULL)
+
+
+@contextlib.contextmanager
+def use_policy(policy: Policy):
+    """Install an explicit dtype :class:`Policy` at trace time —
+    ``autocast``'s general form. The serving case that needs it:
+    ``scan_dequant`` reconstructs each quantized layer at
+    ``current_policy().param_dtype`` (models/scan.py), so decoding a
+    big model under ``Policy(param_dtype=bfloat16)`` halves both the
+    per-layer transient and the HBM reads vs the f32 default."""
     prev = getattr(_STATE, "policy", None)
-    _STATE.policy = Policy(compute_dtype=dtype) if enabled else _FULL
+    _STATE.policy = policy
     try:
-        yield _STATE.policy
+        yield policy
     finally:
         if prev is None:
             del _STATE.policy

@@ -27,6 +27,11 @@ while true; do
     log "TPU capture landed — running the post-capture chain"
     python scripts/gpt2_variants.py > $EV/gpt2_variants_${TAG}.log 2>&1
     log "variants rc=$?"
+    # the first-ever executed 8B step (VERDICT r3 #2) — early in the
+    # chain: if the relay dies mid-chain this is the evidence to have
+    PTD_PROBE_BUDGET_S=2400 python scripts/llama8b_decode.py \
+      > $EV/llama8b_decode_${TAG}.log 2>&1
+    log "llama8b rc=$?"
     python scripts/accuracy_proxy.py > $EV/accuracy_proxy_${TAG}.log 2>&1
     log "accuracy rc=$?"
     python scripts/resnet_sweep.py --stems imagenet s2d \

@@ -1,0 +1,287 @@
+"""First EXECUTED Llama-3-8B step: on-device int4 build + scan_dequant decode.
+
+Recipe 5 (BASELINE.json:11, SURVEY.md §7 hard part c) is the one
+blueprint row that has only ever been proven abstractly (AOT lowering,
+v5p-64 fit, XLA-cost-analysis step projection — tests/test_llama8b.py,
+BASELINE.md). This script turns it into an executed fact on the ONE
+real chip: a full-architecture Llama-3-8B (128256 vocab, 32 scanned
+layers, GQA 32/8, 14336 FFN) decoding real tokens through the
+int4 + per-layer-scan-dequant serving path (ops/quant.py,
+models/scan.py).
+
+Why random weights are the honest play here: there is no egress to
+fetch real checkpoints, and throughput/memory do not depend on weight
+values. The weights are built DIRECTLY on device in the exact layout
+``quantize_for_scan_dequant`` produces — never materializing a bf16/f32
+8B tree anywhere (host RAM or HBM):
+
+* scanned block kernels: per LAYER, generate one layer's f32 kernel on
+  device, int4-quantize it there, free the float transient, stack the
+  32 quantized slices. Groupwise int4 math is slice-invariant (scales
+  reduce axis -2 per layer), so per-layer-quantize+stack is bitwise
+  the layout the whole-tree quantizer emits on a stacked kernel — the
+  tiny preset asserts exactly that against the real pipeline.
+* everything else (embed, lm_head, norm scales) rests in bf16.
+
+Memory budget on a 16 GB v5e: ~3.5 GB int4 payload + ~0.2 GB scales
++ ~2.1 GB bf16 embed+lm_head at rest; decode transiently reconstructs
+ONE layer (~0.44 GB bf16 under Policy(param_dtype=bf16)) per scan tick.
+
+Chip rules (docs/CHIP_PROTOCOL.md): no external kill timers; the script
+budgets itself between phases/leaves via PTD_PROBE_BUDGET_S and exits
+cleanly when over. The 8b preset refuses to run on CPU (a consumption
+metric on the host would be noise wearing a TPU name); --preset tiny is
+the CPU rehearsal path and is exercised by tests/test_llama8b.py.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+t0 = time.time()
+BUDGET_S = float(os.environ.get("PTD_PROBE_BUDGET_S", "2400"))
+
+
+def log(msg):
+    print(f"[{time.time() - t0:7.1f}s] {msg}", flush=True)
+
+
+def over_budget():
+    return time.time() - t0 > BUDGET_S
+
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import pytorch_distributed_tpu as ptd
+from pytorch_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from pytorch_distributed_tpu.ops.quant import (
+    quantize_tree_int4,
+    quantized_bytes,
+)
+from pytorch_distributed_tpu.parallel.sharding import path_str
+from pytorch_distributed_tpu.runtime.precision import Policy, use_policy
+
+# mirror quantize_for_scan_dequant's gate: only kernels inside the
+# scanned stack, judged on the STACKED leaf (that is what the real
+# pipeline quantizes)
+_INCLUDE = re.compile(r"/block/.*/kernel$")
+_MIN_SIZE = 4096
+
+
+def _quantizable(path: str, sds) -> bool:
+    return (
+        _INCLUDE.search("/" + path) is not None
+        and sds.ndim >= 2
+        and sds.size >= _MIN_SIZE
+        and sds.shape[-1] % 2 == 0
+    )
+
+
+def build_int4_params(model, ids0, seed=0, log_fn=lambda m: None):
+    """The model's params tree in quantize_for_scan_dequant's int4
+    layout, built leaf-by-leaf ON DEVICE — peak float transient is one
+    LAYER's largest kernel, never the whole tree."""
+    shapes = jax.eval_shape(
+        lambda k: model.init(k, ids0), jax.random.key(seed)
+    )["params"]
+    flat, treedef = jax.tree_util.tree_flatten_with_path(shapes)
+    key = jax.random.key(seed + 1)
+    leaves = []
+    for i, (path, sds) in enumerate(flat):
+        p = path_str(path)
+        key, sub = jax.random.split(key)
+        if _quantizable(p, sds):
+            L, per = sds.shape[0], sds.shape[1:]
+            fan_in = int(np.prod(per[:-1]))
+            std = 1.0 / np.sqrt(fan_in)
+
+            @jax.jit
+            def one_layer(k, _per=per, _std=std):
+                w = jax.random.normal(k, _per, jnp.float32) * _std
+                q = quantize_tree_int4({"w": w}, min_size=1)["w"]
+                return q["q4"], q["scale"]
+
+            subkeys = jax.random.split(sub, L)
+            q4s, scales = [], []
+            for l in range(L):
+                if over_budget():
+                    raise TimeoutError(
+                        f"budget {BUDGET_S:.0f}s spent mid-build "
+                        f"(leaf {i}/{len(flat)}, layer {l}/{L})"
+                    )
+                a, b = one_layer(subkeys[l])
+                q4s.append(a)
+                scales.append(b)
+            leaves.append(
+                {"q4": jnp.stack(q4s), "scale": jnp.stack(scales)}
+            )
+            log_fn(
+                f"leaf {p}: int4 {sds.shape} -> q4 "
+                f"{leaves[-1]['q4'].shape}"
+            )
+        elif p.endswith("scale"):  # norm scales
+            leaves.append(jnp.ones(sds.shape, jnp.bfloat16))
+        elif p.endswith("bias"):
+            leaves.append(jnp.zeros(sds.shape, jnp.bfloat16))
+        else:  # embed / lm_head / unquantized kernels
+            fan_in = sds.shape[-2] if sds.ndim >= 2 else sds.shape[-1]
+            std = 0.02 if p.endswith("embedding") else 1.0 / np.sqrt(fan_in)
+            gen = jax.jit(
+                lambda k, _s=sds.shape, _std=std: (
+                    jax.random.normal(k, _s, jnp.float32) * _std
+                ).astype(jnp.bfloat16)
+            )
+            leaves.append(gen(sub))
+            log_fn(f"leaf {p}: bf16 {sds.shape}")
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def check_layout_matches_pipeline(cfg_cls, model_cls):
+    """Tiny-model pin: the on-device builder's tree must be structurally
+    identical (paths, shapes, dtypes) to init + quantize_for_scan_dequant
+    — the layout contract that makes the 8b run representative."""
+    from pytorch_distributed_tpu.ops.quant import quantize_for_scan_dequant
+
+    cfg = cfg_cls.tiny()
+    cfg = __import__("dataclasses").replace(cfg, scan_dequant=True)
+    model = model_cls(cfg)
+    ids0 = jnp.zeros((1, 8), jnp.int32)
+    built = build_int4_params(model, ids0)
+    ref_params = model.init(jax.random.key(0), ids0)["params"]
+    ref = quantize_for_scan_dequant(ref_params, "int4")
+
+    b_flat = jax.tree_util.tree_flatten_with_path(built)[0]
+    r_flat = jax.tree_util.tree_flatten_with_path(ref)[0]
+    assert len(b_flat) == len(r_flat), (len(b_flat), len(r_flat))
+    for (bp, bl), (rp, rl) in zip(b_flat, r_flat):
+        assert bp == rp, (bp, rp)
+        assert bl.shape == rl.shape, (path_str(bp), bl.shape, rl.shape)
+        # quantized payloads/scales must match the pipeline's dtypes
+        # exactly; full-precision leaves rest in bf16 here vs the init
+        # tree's f32 (the at-rest choice, not a layout difference)
+        if path_str(bp).endswith(("q4", "scale")) and bl.dtype != jnp.bfloat16:
+            assert bl.dtype == rl.dtype, (path_str(bp), bl.dtype, rl.dtype)
+    return built, model, cfg
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", choices=("8b", "tiny"), default="8b")
+    ap.add_argument("--prompt-len", type=int, default=64)
+    ap.add_argument("--new-tokens", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=1)
+    args = ap.parse_args()
+
+    ptd.enable_compilation_cache()
+    ptd.init_process_group()
+    on_tpu = ptd.is_tpu()
+    log(f"platform={ptd.platform()} preset={args.preset}")
+
+    if args.preset == "8b" and not on_tpu:
+        log(
+            "8b preset needs the real chip (an 8B CPU decode is noise "
+            "wearing a TPU metric name) — nothing to do"
+        )
+        return
+
+    log("layout pin: builder tree == init+quantize_for_scan_dequant tree")
+    built_tiny, tiny_model, tiny_cfg = check_layout_matches_pipeline(
+        LlamaConfig, LlamaForCausalLM
+    )
+    log("layout pin OK")
+
+    if args.preset == "tiny":
+        cfg, model, params = tiny_cfg, tiny_model, built_tiny
+        B, P, NEW = 2, 8, 8
+        iters = 2
+    else:
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            LlamaConfig.llama3_8b(), scan_dequant=True
+        )
+        model = LlamaForCausalLM(cfg)
+        B, P, NEW = args.batch, args.prompt_len, args.new_tokens
+        iters = 3
+        log("building 8B int4 tree on device, layer by layer...")
+        params = build_int4_params(
+            model, jnp.zeros((1, 8), jnp.int32), log_fn=log
+        )
+
+    at_rest = quantized_bytes(params)
+    log(f"params at rest: {at_rest / 1e9:.2f} GB")
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(
+        rng.integers(cfg.vocab_size, size=(B, P)).astype(np.int32)
+    )
+
+    serving = Policy(
+        param_dtype=jnp.bfloat16,
+        compute_dtype=jnp.bfloat16,
+        output_dtype=jnp.float32,
+    )
+    with use_policy(serving):
+        run = jax.jit(
+            lambda p, i: ptd.generate(
+                model, p, i, max_new_tokens=NEW, temperature=0.0
+            )
+        )
+        log(f"compiling + first decode (B={B} P={P} NEW={NEW})...")
+        out = run(params, ids)
+        int(out[0, -1])  # scalar fetch — the only real sync on the relay
+    log("first decode done")
+
+    if over_budget():
+        log(f"budget spent before timing loop — stopping with compile-only"
+            f" evidence")
+        return
+
+    t = time.perf_counter()
+    for _ in range(iters):
+        out = run(params, ids)
+    int(out[0, -1])
+    dt = (time.perf_counter() - t) / iters
+    tok_per_sec = B * NEW / dt
+
+    peak = ptd.max_memory_allocated()
+    mem_note = ""
+    try:
+        ma = run.lower(params, ids).compile().memory_analysis()
+        mem_note = (
+            f" xla: args={ma.argument_size_in_bytes / 1e9:.2f}GB "
+            f"temps={ma.temp_size_in_bytes / 1e9:.2f}GB "
+            f"out={ma.output_size_in_bytes / 1e9:.2f}GB"
+        )
+    except Exception as e:
+        mem_note = f" (memory_analysis unavailable: {type(e).__name__})"
+
+    rec = {
+        "metric": f"llama8b_int4_scan_decode_tokens_per_sec"
+        if args.preset == "8b"
+        else "llama_tiny_int4_scan_decode_tokens_per_sec",
+        "value": round(tok_per_sec, 2),
+        "unit": f"tokens/sec incl. prefill, int4+scan_dequant bf16, "
+        f"batch={B} prompt={P} new={NEW}",
+        "vs_baseline": None,
+        "platform": ptd.platform(),
+        "at_rest_gb": round(at_rest / 1e9, 3),
+        "hbm_peak_gb": round(peak / 1e9, 3) if peak else None,
+    }
+    print(json.dumps(rec), flush=True)
+    log(
+        f"decode: {tok_per_sec:.2f} tok/s ({dt * 1e3:.0f} ms/call), "
+        f"at-rest {at_rest / 1e9:.2f} GB, peak HBM "
+        f"{peak / 1e9:.2f} GB{mem_note}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -93,5 +93,6 @@ print("PHASES-OK")
         "dp_step_overhead_ms",
         "gpt2_medium_tokens_per_sec_per_chip",
         "gpt2_decode_bf16_params_tokens_per_sec",
+        "gpt2_decode_int4_scan_tokens_per_sec",
     ):
         assert metric in blob, metric

@@ -368,3 +368,31 @@ def test_8b_int4_tree_fits_one_v5e(abstract_8b_state):
     # ~8B params at ~0.56 byte/weight incl. scales and f32 stragglers
     assert 4.0e9 < int4_bytes < 6.0e9, int4_bytes / 1e9
     assert int4_bytes < V5E_HBM / 3  # at rest: fits with 3x headroom
+
+
+@pytest.mark.slow
+def test_llama8b_decode_script_rehearses_on_cpu():
+    """The chip-bound 8B decode script (scripts/llama8b_decode.py) must
+    EXECUTE end to end on the CPU backend at the tiny preset — the same
+    guard class as test_bench_contract's tpu-only-phases test: the r3
+    chip window lost two captures to configs that had never run
+    anywhere, and this script's first real invocation is ON the chip.
+    The tiny preset also asserts the on-device builder's tree is
+    structurally identical to init + quantize_for_scan_dequant (the
+    layout contract that makes the 8b measurement representative)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    env.pop("XLA_FLAGS", None)
+    env.pop("PTD_PROBE_BUDGET_S", None)  # a chip-probe budget exported
+    # in the shell would make the tiny run trip over_budget() spuriously
+    proc = subprocess.run(
+        [sys.executable, "scripts/llama8b_decode.py", "--preset", "tiny"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "layout pin OK" in proc.stdout
+    assert "llama_tiny_int4_scan_decode_tokens_per_sec" in proc.stdout
