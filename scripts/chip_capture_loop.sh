@@ -12,7 +12,9 @@
 # committing as they appear.
 cd "$(dirname "$0")/.."
 EV=chip_evidence
-TAG=${1:-loop}
+# unique per loop START: a restarted loop must never reuse an earlier
+# run's attempt numbering and truncate committed evidence files
+TAG=${1:-loop}_$(date -u +%d%H%M)
 log() { echo "[$TAG $(date -u +%H:%M:%S)] $*" >> $EV/capture_loop.log; }
 
 log "=== capture loop start ==="
