@@ -207,8 +207,26 @@ class HostRingGroup:
     def barrier(self) -> None:
         _check(_load().hr_barrier(self._h), "barrier")
 
-    def all_reduce(self, x, op: str = "sum") -> np.ndarray:
-        a = _as_contig(x).copy()
+    def all_reduce(self, x, op: str = "sum", *, inplace: bool = False) -> np.ndarray:
+        """``inplace=True`` reduces directly into ``x`` (torch
+        ``dist.all_reduce`` semantics) — skipping a full payload copy,
+        which on the 1-core shm topology is a measurable share of the
+        op. ``x`` must then already be a C-contiguous supported-dtype
+        ndarray: anything needing conversion would silently reduce into
+        a private copy while the caller's buffer kept its local values
+        (torch raises here too; divergence must never be quiet)."""
+        a = _as_contig(x)
+        if inplace:
+            if a is not x:
+                raise ValueError(
+                    "all_reduce(inplace=True) needs a C-contiguous "
+                    f"supported-dtype ndarray; got {type(x).__name__}"
+                    " needing conversion — the reduction would land in "
+                    "a copy and the caller's buffer would keep its "
+                    "local values"
+                )
+        else:
+            a = a.copy()
         if self.debug:
             self._verify_uniform("all_reduce", a, op)
         # floats average natively (divide-then-round in the C f32

@@ -19,8 +19,19 @@ def raw_worker(rank: int, world: int, name: str, q) -> None:
         from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
 
         with HostRingGroup(name, rank, world, timeout_s=60) as g:
-            ar = g.all_reduce(np.full(1000, rank + 1.0, np.float32))
+            src = np.full(1000, rank + 1.0, np.float32)
+            ar = g.all_reduce(src)
             assert np.all(ar == world * (world + 1) / 2), ar[:4]
+            assert np.all(src == rank + 1.0)  # functional: input untouched
+            ip = np.full(1000, rank + 1.0, np.float32)
+            out = g.all_reduce(ip, inplace=True)
+            assert out is ip  # torch dist.all_reduce semantics: in place
+            assert np.all(ip == world * (world + 1) / 2), ip[:4]
+            try:  # inplace that can't be honored must raise, not
+                g.all_reduce(ip[::2], inplace=True)  # reduce a copy
+                raise AssertionError("non-contiguous inplace accepted")
+            except ValueError:
+                pass
             ag = g.all_gather(np.array([rank], np.int32))
             assert list(ag.ravel()) == list(range(world))
             rs = g.reduce_scatter(

@@ -238,7 +238,12 @@ def test_overflowing_max_positions_raises(gpt2):
         generate(model, params, ids, max_new_tokens=0, temperature=0.0)
 
 
-@pytest.mark.parametrize("family", ["gpt2", "llama"])
+@pytest.mark.parametrize(
+    "family",
+    # ragged-prompt parity pinned fast on gpt2; the llama variant covers
+    # the same machinery through RoPE/GQA and rides the slow profile
+    ["gpt2", pytest.param("llama", marks=pytest.mark.slow)],
+)
 def test_left_padded_ragged_batch_matches_unpadded(family):
     """prompt_mask (HF attention_mask idiom): a left-padded ragged batch
     must produce exactly the continuations each prompt gets alone —
@@ -349,6 +354,7 @@ def test_beam_search_matches_naive_reference(gpt2, eos):
         np.testing.assert_array_equal(got[b], np.asarray(want), err_msg=f"row {b}")
 
 
+@pytest.mark.slow
 def test_beam_scores_are_self_consistent(gpt2):
     """The returned score must equal the recomputed (length-penalized)
     log-probability of the returned sequence — a property beam search DOES

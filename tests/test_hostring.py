@@ -80,6 +80,7 @@ def test_rapid_reinit_same_group_name():
     assert results == [(r, "ok") for r in range(3)], results
 
 
+@pytest.mark.slow
 def test_p2p_send_recv_with_bystanders():
     """send/recv between two ranks must complete while other ranks do
     nothing (true P2P mailbox, not a barrier-gated group collective)."""

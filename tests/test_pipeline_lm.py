@@ -40,6 +40,7 @@ def _init(seed=0, B=4, S=16):
     return model, params, ids
 
 
+@pytest.mark.slow
 def test_gpt2_pipeline_logits_match_plain_forward():
     ptd.init_process_group(mesh_spec=MeshSpec(dp=-1, pp=2))
     model, params, ids = _init()

@@ -47,6 +47,7 @@ def test_roundtrip_error_bounded_and_selective():
     assert q2["dense"]["kernel"].dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_gpt2_int8_logits_close_and_generates():
     from pytorch_distributed_tpu.models import GPT2Config, GPT2LMHead
     from pytorch_distributed_tpu import generation
