@@ -1,0 +1,75 @@
+"""Staleness/ISA semantics of the build-on-first-use native builder.
+
+The .so travels in three ways — runtime-built here (sidecar recorded),
+`make -C native` ahead-of-time (no sidecar, possibly read-only image),
+or a container migrated to a different-ISA host — and each has a
+distinct correct behavior (utils/native_build.py docstrings).
+"""
+
+import os
+import shutil
+
+from pytorch_distributed_tpu.utils.native_build import (
+    _arch_flags,
+    build_native_library,
+)
+
+
+def _setup(tmp_path):
+    src = tmp_path / "toy.cpp"
+    src.write_text('extern "C" int toy() { return 42; }\n')
+    return str(src), str(tmp_path / "libtoy.so")
+
+
+def test_runtime_build_writes_sidecar_and_caches(tmp_path):
+    src, so = _setup(tmp_path)
+    p = build_native_library(src, so)
+    assert os.path.exists(p)
+    want = open(p + ".flags").read()
+    assert "-O3" in want
+    mt = os.path.getmtime(p)
+    build_native_library(src, so)  # same flags: cached
+    assert os.path.getmtime(p) == mt
+
+
+def test_fresh_sidecarless_so_is_trusted(tmp_path):
+    """make -C native output (no sidecar, maybe read-only dir) must NOT
+    be rebuilt while fresh — the ahead-of-time path this module
+    complements."""
+    src, so = _setup(tmp_path)
+    build_native_library(src, so)
+    os.remove(so + ".flags")
+    mt = os.path.getmtime(so)
+    build_native_library(src, so)
+    assert os.path.getmtime(so) == mt
+    assert not os.path.exists(so + ".flags")  # still make-style
+
+
+def test_flag_mismatch_rebuilds(tmp_path):
+    """A sidecar recording different flags (container migrated to a
+    different-ISA host) forces a rebuild instead of a SIGILL."""
+    src, so = _setup(tmp_path)
+    build_native_library(src, so)
+    open(so + ".flags", "w").write("g++ -O3 -march=from-another-host")
+    mt = os.path.getmtime(so)
+    build_native_library(src, so)
+    assert os.path.getmtime(so) > mt
+    assert "from-another-host" not in open(so + ".flags").read()
+
+
+def test_stale_source_rebuilds(tmp_path):
+    src, so = _setup(tmp_path)
+    build_native_library(src, so)
+    os.utime(src, (os.path.getmtime(so) + 10,) * 2)
+    mt = os.path.getmtime(so)
+    build_native_library(src, so)
+    assert os.path.getmtime(so) >= mt  # rebuilt (mtime advanced or equal
+    # within fs resolution); the real assert is that it didn't raise
+    assert open(so + ".flags").read()
+
+
+def test_arch_flags_all_or_nothing():
+    """Either no -march (unknown/partial host) or the full v3 set gated
+    on the complete cpuinfo feature list — partial gates SIGILL."""
+    flags = _arch_flags()
+    assert flags in ([], ["-march=x86-64-v3"])
