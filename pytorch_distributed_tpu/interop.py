@@ -98,12 +98,13 @@ def _maybe_stack(layers, scan: bool, container: str, unroll_prefix: str):
     (``unroll_prefix{i}``: GPT-2 ``block{i}``, Llama ``layer{i}``).
     """
     if scan:
-        stacked = {}
-        for name in layers[0]:
-            stacked[name] = {
-                p: np.stack([lyr[name][p] for lyr in layers])
-                for p in layers[0][name]
-            }
+        import jax
+
+        # recursive over arbitrarily nested module trees (T5 blocks nest
+        # attention/FFN submodules; GPT-2/Llama are the flat special case)
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: np.stack(xs), *layers
+        )
         return {container: {"block": stacked}}
     return {f"{unroll_prefix}{i}": lyr for i, lyr in enumerate(layers)}
 
@@ -225,12 +226,12 @@ def load_llama_weights(sd: StateDict, cfg) -> Dict:
 def _unstack(params, cfg, container: str, unroll_prefix: str):
     """Per-layer trees from either layout: [{...}, ...] of length L."""
     if cfg.scan_layers:
+        import jax
+
         stacked = params[container]["block"]
         return [
-            {
-                name: {p: np.asarray(v)[i] for p, v in sub.items()}
-                for name, sub in stacked.items()
-            }
+            jax.tree_util.tree_map(lambda v, _i=i: np.asarray(v)[_i],
+                                   stacked)
             for i in range(cfg.num_layers)
         ]
     return [params[f"{unroll_prefix}{i}"] for i in range(cfg.num_layers)]
@@ -547,4 +548,184 @@ def export_vit_weights(params, cfg) -> Dict[str, Array]:
         ln(p + "layernorm_after", blk["mlp_ln"])
         lin(p + "intermediate.dense", blk["mlp_up"])
         lin(p + "output.dense", blk["mlp_down"])
+    return sd
+
+
+# --------------------------------------------------------------------------
+# T5 (encoder-decoder)
+# --------------------------------------------------------------------------
+
+def _t5_attn_in(sd: StateDict, key: str, D: int, H: int, hd: int) -> Dict:
+    """HF ``T5Attention`` (bias-free Linears) -> our T5Attention params."""
+    return {
+        "q": {"kernel": _np(sd, key + ".q.weight").T.reshape(D, H, hd)},
+        "k": {"kernel": _np(sd, key + ".k.weight").T.reshape(D, H, hd)},
+        "v": {"kernel": _np(sd, key + ".v.weight").T.reshape(D, H, hd)},
+        "o": {"kernel": _np(sd, key + ".o.weight").T.reshape(H, hd, D)},
+    }
+
+
+def _t5_ffn_in(sd: StateDict, key: str, gated: bool) -> Dict:
+    if gated:
+        return {
+            "wi_0": {"kernel": _np(sd, key + ".wi_0.weight").T},
+            "wi_1": {"kernel": _np(sd, key + ".wi_1.weight").T},
+            "wo": {"kernel": _np(sd, key + ".wo.weight").T},
+        }
+    return {
+        "wi": {"kernel": _np(sd, key + ".wi.weight").T},
+        "wo": {"kernel": _np(sd, key + ".wo.weight").T},
+    }
+
+
+def load_t5_weights(sd: StateDict, cfg) -> Dict:
+    """HF ``T5ForConditionalGeneration`` state_dict -> params for
+    :class:`~pytorch_distributed_tpu.models.t5.T5ForConditionalGeneration`.
+
+    HF hangs the shared relative-attention-bias table on block 0 of each
+    stack; our layout owns it at the stack level (``rel_bias``) so the
+    scanned layers stay homogeneous — the mapping moves it accordingly.
+    """
+    D, H, hd = cfg.d_model, cfg.num_heads, cfg.d_kv
+    gated = cfg.feed_forward_proj == "gated-gelu"
+
+    def enc_block(i):
+        p = f"encoder.block.{i}."
+        return {
+            "attn_norm": {"scale": _np(sd, p + "layer.0.layer_norm.weight")},
+            "attn": _t5_attn_in(sd, p + "layer.0.SelfAttention", D, H, hd),
+            "ffn_norm": {"scale": _np(sd, p + "layer.1.layer_norm.weight")},
+            "ffn": _t5_ffn_in(sd, p + "layer.1.DenseReluDense", gated),
+        }
+
+    def dec_block(i):
+        p = f"decoder.block.{i}."
+        return {
+            "attn_norm": {"scale": _np(sd, p + "layer.0.layer_norm.weight")},
+            "attn": _t5_attn_in(sd, p + "layer.0.SelfAttention", D, H, hd),
+            "cross_norm": {
+                "scale": _np(sd, p + "layer.1.layer_norm.weight")
+            },
+            "cross_attn": _t5_attn_in(
+                sd, p + "layer.1.EncDecAttention", D, H, hd
+            ),
+            "ffn_norm": {"scale": _np(sd, p + "layer.2.layer_norm.weight")},
+            "ffn": _t5_ffn_in(sd, p + "layer.2.DenseReluDense", gated),
+        }
+
+    L = cfg.num_layers
+    encoder = {
+        "rel_bias": {
+            "embedding": _np(
+                sd,
+                "encoder.block.0.layer.0.SelfAttention."
+                "relative_attention_bias.weight",
+            )
+        },
+        "final_norm": {"scale": _np(sd, "encoder.final_layer_norm.weight")},
+    }
+    encoder.update(_maybe_stack(
+        [enc_block(i) for i in range(L)], cfg.scan_layers,
+        "layers", "layers_",
+    ))
+    decoder = {
+        "rel_bias": {
+            "embedding": _np(
+                sd,
+                "decoder.block.0.layer.0.SelfAttention."
+                "relative_attention_bias.weight",
+            )
+        },
+        "final_norm": {"scale": _np(sd, "decoder.final_layer_norm.weight")},
+    }
+    decoder.update(_maybe_stack(
+        [dec_block(i) for i in range(L)], cfg.scan_layers,
+        "layers", "layers_",
+    ))
+    params = {
+        "shared": {"embedding": _np(sd, "shared.weight")},
+        "encoder": encoder,
+        "decoder": decoder,
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"kernel": _np(sd, "lm_head.weight").T}
+    return params
+
+
+def _t5_attn_out(sd: Dict, key: str, p, D: int) -> None:
+    kq = np.asarray(p["q"]["kernel"])  # [D, H, hd]
+    inner = kq.shape[1] * kq.shape[2]
+    for n in ("q", "k", "v"):
+        sd[key + f".{n}.weight"] = (
+            np.asarray(p[n]["kernel"]).reshape(D, inner).T
+        )
+    sd[key + ".o.weight"] = np.asarray(p["o"]["kernel"]).reshape(inner, D).T
+
+
+def export_t5_weights(params, cfg) -> Dict[str, Array]:
+    """Our T5 params -> HF ``T5ForConditionalGeneration`` state_dict
+    arrays (loadable with ``strict=False`` for buffer-only leftovers)."""
+    D = cfg.d_model
+    gated = cfg.feed_forward_proj == "gated-gelu"
+    sd: Dict[str, Array] = {
+        "shared.weight": np.asarray(params["shared"]["embedding"]),
+        "encoder.embed_tokens.weight": np.asarray(
+            params["shared"]["embedding"]
+        ),
+        "decoder.embed_tokens.weight": np.asarray(
+            params["shared"]["embedding"]
+        ),
+        "encoder.final_layer_norm.weight": np.asarray(
+            params["encoder"]["final_norm"]["scale"]
+        ),
+        "decoder.final_layer_norm.weight": np.asarray(
+            params["decoder"]["final_norm"]["scale"]
+        ),
+        "encoder.block.0.layer.0.SelfAttention."
+        "relative_attention_bias.weight": np.asarray(
+            params["encoder"]["rel_bias"]["embedding"]
+        ),
+        "decoder.block.0.layer.0.SelfAttention."
+        "relative_attention_bias.weight": np.asarray(
+            params["decoder"]["rel_bias"]["embedding"]
+        ),
+    }
+    if cfg.tie_word_embeddings:
+        sd["lm_head.weight"] = np.asarray(params["shared"]["embedding"])
+    else:
+        sd["lm_head.weight"] = np.asarray(
+            params["lm_head"]["kernel"]
+        ).T
+
+    def ffn_out(key, p):
+        names = ("wi_0", "wi_1", "wo") if gated else ("wi", "wo")
+        for n in names:
+            sd[key + f".{n}.weight"] = np.asarray(p[n]["kernel"]).T
+
+    for stack, container in (("encoder", "encoder"), ("decoder", "decoder")):
+        sub = {k: v for k, v in params[stack].items()
+               if k not in ("rel_bias", "final_norm")}
+        layers = _unstack(sub, cfg, "layers", "layers_")
+        for i, blk in enumerate(layers):
+            p = f"{container}.block.{i}."
+            sd[p + "layer.0.layer_norm.weight"] = np.asarray(
+                blk["attn_norm"]["scale"]
+            )
+            _t5_attn_out(sd, p + "layer.0.SelfAttention", blk["attn"], D)
+            if stack == "encoder":
+                sd[p + "layer.1.layer_norm.weight"] = np.asarray(
+                    blk["ffn_norm"]["scale"]
+                )
+                ffn_out(p + "layer.1.DenseReluDense", blk["ffn"])
+            else:
+                sd[p + "layer.1.layer_norm.weight"] = np.asarray(
+                    blk["cross_norm"]["scale"]
+                )
+                _t5_attn_out(
+                    sd, p + "layer.1.EncDecAttention", blk["cross_attn"], D
+                )
+                sd[p + "layer.2.layer_norm.weight"] = np.asarray(
+                    blk["ffn_norm"]["scale"]
+                )
+                ffn_out(p + "layer.2.DenseReluDense", blk["ffn"])
     return sd

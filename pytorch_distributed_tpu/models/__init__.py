@@ -30,6 +30,13 @@ from pytorch_distributed_tpu.models.vit import (
     ViTConfig,
     vit_partition_rules,
 )
+from pytorch_distributed_tpu.models.t5 import (
+    T5Config,
+    T5ForConditionalGeneration,
+    generate_encdec,
+    shift_right,
+    t5_partition_rules,
+)
 from pytorch_distributed_tpu.models.llama import (
     LlamaConfig,
     LlamaForCausalLM,
@@ -55,6 +62,11 @@ __all__ = [
     "LlamaConfig",
     "LlamaForCausalLM",
     "llama_partition_rules",
+    "T5Config",
+    "T5ForConditionalGeneration",
+    "generate_encdec",
+    "shift_right",
+    "t5_partition_rules",
     "ViT",
     "ViTConfig",
     "vit_partition_rules",

@@ -1,0 +1,533 @@
+"""T5 encoder-decoder (Raffel et al. 2020), TPU-first.
+
+The blueprint's recipes are all decoder-only or encoder-only; T5 is the
+beyond-reference family that exercises the remaining generation
+machinery — cross-attention with a once-computed encoder KV cache,
+relative position buckets instead of absolute positions, and seq2seq
+(prefix-LM-style) training. Faithful to HF ``T5ForConditionalGeneration``
+semantics so the interop layer can pin logits both ways:
+
+* **T5LayerNorm** is RMS-only (no mean subtraction, no bias), computed
+  in f32.
+* **No attention scaling** — T5 folds 1/sqrt(d) into its initializers,
+  so QK^T logits go into softmax unscaled (``attention(scale=1.0)``).
+* **Relative position bias**: one learned [num_buckets, heads] table per
+  stack (owned by the stack, not block 0, so the scanned layers stay
+  homogeneous — t5x's layout), bucketed log-distance, bidirectional in
+  the encoder, causal-unidirectional in the decoder, broadcast to every
+  layer. Cross-attention carries NO position bias (as in T5).
+* **Tied embeddings**: one shared table embeds encoder input, decoder
+  input, and (``tie_word_embeddings``) the LM head, with the decoder
+  output scaled by ``d_model**-0.5`` before the tied projection —
+  exactly HF's tying arithmetic.
+
+Decode path: the decoder self-attention uses the same static-buffer
+``decode_cache`` as GPT-2/Llama; cross-attention K/V are projected from
+the encoder output ONCE (first decode call initializes them into the
+flax ``cache`` collection) and reused every token — the t5x decode
+layout. ``T5DecodeWrapper`` duck-types the ``model.apply`` surface
+``generation.generate`` expects, so greedy/sampled/beam decoding reuse
+the existing machinery unchanged (``generate_encdec`` below).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.ops.attention import attention, decode_cache
+from pytorch_distributed_tpu.runtime.precision import current_policy
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32_128
+    d_model: int = 512
+    d_kv: int = 64  # per-head dim (NOT d_model // heads in general!)
+    d_ff: int = 2_048
+    num_layers: int = 6  # encoder layers == decoder layers (HF t5-small)
+    num_heads: int = 8
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    dropout_rate: float = 0.1
+    layer_norm_eps: float = 1e-6
+    feed_forward_proj: str = "relu"  # relu (t5) | gated-gelu (t5 v1.1)
+    tie_word_embeddings: bool = True  # v1.1 unties
+    pad_token_id: int = 0  # doubles as decoder_start_token_id
+    eos_token_id: int = 1
+    scan_layers: bool = True
+    remat: bool = False
+    remat_policy: str = "full"
+
+    def __post_init__(self):
+        if self.feed_forward_proj not in ("relu", "gated-gelu"):
+            raise ValueError(
+                f"feed_forward_proj must be 'relu' or 'gated-gelu', got "
+                f"{self.feed_forward_proj!r}"
+            )
+
+    @classmethod
+    def small(cls) -> "T5Config":
+        return cls()
+
+    @classmethod
+    def base(cls) -> "T5Config":
+        return cls(d_model=768, d_ff=3072, num_layers=12, num_heads=12)
+
+    @classmethod
+    def tiny(cls) -> "T5Config":
+        return cls(
+            vocab_size=512, d_model=64, d_kv=16, d_ff=128, num_layers=2,
+            num_heads=4, relative_attention_num_buckets=8,
+            relative_attention_max_distance=32,
+        )
+
+
+class T5LayerNorm(nn.Module):
+    """RMS-only norm (no mean subtraction, no bias), f32 accumulation."""
+
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        policy = current_policy()
+        scale = self.param(
+            "scale", nn.initializers.ones, (x.shape[-1],), policy.param_dtype
+        )
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        return (x32 * jax.lax.rsqrt(var + self.eps) * scale).astype(x.dtype)
+
+
+def relative_position_bucket(
+    relative_position: jnp.ndarray,
+    *,
+    bidirectional: bool,
+    num_buckets: int,
+    max_distance: int,
+) -> jnp.ndarray:
+    """T5's bucketed log-distance (HF ``_relative_position_bucket``,
+    reimplemented from the paper's description): half the buckets are
+    exact small distances, the other half log-spaced out to
+    ``max_distance``; bidirectional splits the space by sign."""
+    rp = relative_position
+    bucket = jnp.zeros_like(rp)
+    if bidirectional:
+        num_buckets //= 2
+        bucket = bucket + jnp.where(rp > 0, num_buckets, 0)
+        rp = jnp.abs(rp)
+    else:
+        rp = -jnp.minimum(rp, 0)
+    max_exact = num_buckets // 2
+    is_small = rp < max_exact
+    log_big = max_exact + (
+        jnp.log(rp.astype(jnp.float32) / max_exact + 1e-9)
+        / jnp.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(rp.dtype)
+    log_big = jnp.minimum(log_big, num_buckets - 1)
+    return bucket + jnp.where(is_small, rp, log_big)
+
+
+class RelativeBias(nn.Module):
+    """One [num_buckets, heads] table per stack; returns [1, H, S, T]."""
+
+    config: T5Config
+    bidirectional: bool
+
+    @nn.compact
+    def __call__(self, q_positions, k_positions):
+        cfg = self.config
+        policy = current_policy()
+        table = self.param(
+            "embedding",
+            nn.initializers.normal(stddev=1.0),
+            (cfg.relative_attention_num_buckets, cfg.num_heads),
+            policy.param_dtype,
+        )
+        rel = k_positions[None, :] - q_positions[:, None]  # [S, T]
+        bucket = relative_position_bucket(
+            rel,
+            bidirectional=self.bidirectional,
+            num_buckets=cfg.relative_attention_num_buckets,
+            max_distance=cfg.relative_attention_max_distance,
+        )
+        bias = table[bucket]  # [S, T, H]
+        return jnp.transpose(bias, (2, 0, 1))[None].astype(jnp.float32)
+
+
+def _dense(n, name):
+    policy = current_policy()
+    return nn.DenseGeneral(
+        n, use_bias=False, dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype, name=name,
+    )
+
+
+class T5Attention(nn.Module):
+    """Self- or cross-attention, T5 flavor (unscaled logits)."""
+
+    config: T5Config
+    causal: bool = False
+
+    @nn.compact
+    def __call__(
+        self,
+        x,
+        kv_source=None,  # None = self-attention
+        bias=None,
+        mask=None,
+        decode: bool = False,
+        cache_len: Optional[int] = None,
+    ):
+        cfg = self.config
+        H, D = cfg.num_heads, cfg.d_kv
+        q = _dense((H, D), "q")(x)
+        cross = kv_source is not None
+        if cross and decode:
+            # encoder K/V never change during decode: project once (the
+            # prefill call initializes the cache entries), reuse after
+            is_init = not self.has_variable("cache", "cross_key")
+            ck = self.variable(
+                "cache", "cross_key", jnp.zeros,
+                (x.shape[0], kv_source.shape[1], H, D), x.dtype,
+            )
+            cv = self.variable(
+                "cache", "cross_value", jnp.zeros,
+                (x.shape[0], kv_source.shape[1], H, D), x.dtype,
+            )
+            if is_init:
+                ck.value = _dense((H, D), "k")(kv_source)
+                cv.value = _dense((H, D), "v")(kv_source)
+            k, v = ck.value, cv.value
+            attn = attention(q, k, v, mask=mask, scale=1.0)
+        elif cross:
+            k = _dense((H, D), "k")(kv_source)
+            v = _dense((H, D), "v")(kv_source)
+            attn = attention(q, k, v, mask=mask, scale=1.0)
+        elif decode:
+            k = _dense((H, D), "k")(x)
+            v = _dense((H, D), "v")(x)
+            k, v, offset = decode_cache(self, k, v, cache_len)
+            attn = attention(
+                q, k, v, causal=self.causal, q_offset=offset, mask=mask,
+                bias=bias, scale=1.0,
+            )
+        else:
+            k = _dense((H, D), "k")(x)
+            v = _dense((H, D), "v")(x)
+            attn = attention(
+                q, k, v, causal=self.causal, mask=mask, bias=bias,
+                scale=1.0,
+            )
+        return nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), use_bias=False,
+            dtype=current_policy().compute_dtype,
+            param_dtype=current_policy().param_dtype, name="o",
+        )(attn)
+
+
+class T5FFN(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        if cfg.feed_forward_proj == "gated-gelu":
+            # HF's dense_act_fn here is gelu_new == tanh-approximate gelu
+            h = nn.gelu(_dense(cfg.d_ff, "wi_0")(x), approximate=True)
+            h = h * _dense(cfg.d_ff, "wi_1")(x)
+        else:
+            h = nn.relu(_dense(cfg.d_ff, "wi")(x))
+        return _dense(cfg.d_model, "wo")(h)
+
+
+class T5EncoderBlock(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x, bias, enc_mask, deterministic: bool):
+        cfg = self.config
+        drop = lambda h: nn.Dropout(cfg.dropout_rate)(  # noqa: E731
+            h, deterministic=deterministic
+        )
+        h = T5LayerNorm(cfg.layer_norm_eps, name="attn_norm")(x)
+        x = x + drop(
+            T5Attention(cfg, name="attn")(h, bias=bias, mask=enc_mask)
+        )
+        h = T5LayerNorm(cfg.layer_norm_eps, name="ffn_norm")(x)
+        return x + drop(T5FFN(cfg, name="ffn")(h))
+
+
+class T5DecoderBlock(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(
+        self, x, bias, enc_out, enc_mask, deterministic: bool,
+        decode: bool = False, cache_len: Optional[int] = None,
+    ):
+        cfg = self.config
+        drop = lambda h: nn.Dropout(cfg.dropout_rate)(  # noqa: E731
+            h, deterministic=deterministic
+        )
+        h = T5LayerNorm(cfg.layer_norm_eps, name="attn_norm")(x)
+        x = x + drop(
+            T5Attention(cfg, causal=True, name="attn")(
+                h, bias=bias, decode=decode, cache_len=cache_len
+            )
+        )
+        h = T5LayerNorm(cfg.layer_norm_eps, name="cross_norm")(x)
+        x = x + drop(
+            T5Attention(cfg, name="cross_attn")(
+                h, kv_source=enc_out, mask=enc_mask, decode=decode
+            )
+        )
+        h = T5LayerNorm(cfg.layer_norm_eps, name="ffn_norm")(x)
+        return x + drop(T5FFN(cfg, name="ffn")(h))
+
+
+def _stack(block_cls, cfg, name, static_argnums):
+    if cfg.scan_layers:
+        from pytorch_distributed_tpu.models.scan import scan_stack
+
+        return scan_stack(
+            block_cls, cfg, static_argnums=static_argnums, name=name
+        )
+
+    def apply_unrolled(x, *bcast):
+        for i in range(cfg.num_layers):
+            x = block_cls(cfg, name=f"{name}_{i}")(x, *bcast)
+        return x
+
+    return apply_unrolled
+
+
+class T5Encoder(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x, enc_mask, deterministic: bool):
+        cfg = self.config
+        S = x.shape[1]
+        pos = jnp.arange(S)
+        bias = RelativeBias(cfg, bidirectional=True, name="rel_bias")(
+            pos, pos
+        )
+        x = _stack(T5EncoderBlock, cfg, "layers", static_argnums=(3,))(
+            x, bias, enc_mask, deterministic
+        )
+        x = T5LayerNorm(cfg.layer_norm_eps, name="final_norm")(x)
+        return nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
+
+
+class T5Decoder(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(
+        self, x, enc_out, enc_mask, deterministic: bool,
+        decode: bool = False, cache_len: Optional[int] = None,
+    ):
+        cfg = self.config
+        S = x.shape[1]
+        if decode:
+            from pytorch_distributed_tpu.ops.attention import (
+                decode_positions,
+            )
+
+            q_pos = decode_positions(self, S)
+            k_pos = jnp.arange(cache_len)
+        else:
+            q_pos = jnp.arange(S)
+            k_pos = q_pos
+        bias = RelativeBias(cfg, bidirectional=False, name="rel_bias")(
+            q_pos, k_pos
+        )
+        x = _stack(
+            T5DecoderBlock, cfg, "layers", static_argnums=(4, 5, 6)
+        )(x, bias, enc_out, enc_mask, deterministic, decode, cache_len)
+        x = T5LayerNorm(cfg.layer_norm_eps, name="final_norm")(x)
+        return nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
+
+
+class T5ForConditionalGeneration(nn.Module):
+    """Returns [B, S_dec, vocab] logits.
+
+    Train/eval: ``model.apply(vars, input_ids, decoder_input_ids,
+    input_mask=..., train=...)``. Decode: see ``T5DecodeWrapper`` /
+    ``generate_encdec`` — the encoder runs once via ``encode``.
+    """
+
+    config: T5Config
+
+    def setup(self):
+        cfg = self.config
+        policy = current_policy()
+        self.shared = nn.Embed(
+            cfg.vocab_size, cfg.d_model, param_dtype=policy.param_dtype,
+            name="shared",
+        )
+        self.encoder = T5Encoder(cfg, name="encoder")
+        self.decoder = T5Decoder(cfg, name="decoder")
+        self.dropout = nn.Dropout(cfg.dropout_rate)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = nn.Dense(
+                cfg.vocab_size, use_bias=False,
+                dtype=policy.compute_dtype,
+                param_dtype=policy.param_dtype, name="lm_head",
+            )
+
+    def encode(self, input_ids, input_mask=None, train: bool = False):
+        policy = current_policy()
+        x = self.shared(input_ids).astype(policy.compute_dtype)
+        x = self.dropout(x, deterministic=not train)
+        return self.encoder(x, input_mask, not train)
+
+    def decode(
+        self,
+        decoder_input_ids,
+        enc_out,
+        enc_mask=None,
+        train: bool = False,
+        decode: bool = False,
+        cache_len: Optional[int] = None,
+    ):
+        cfg = self.config
+        policy = current_policy()
+        x = self.shared(decoder_input_ids).astype(policy.compute_dtype)
+        x = self.dropout(x, deterministic=not train)
+        x = self.decoder(
+            x, enc_out, enc_mask, not train, decode, cache_len
+        )
+        if cfg.tie_word_embeddings:
+            # HF's tying arithmetic: rescale then project through the
+            # shared table (the train-time scale the init assumed)
+            x = x * (cfg.d_model ** -0.5)
+            logits = self.shared.attend(x.astype(policy.param_dtype))
+        else:
+            logits = self.lm_head(x)
+        return logits.astype(policy.output_dtype)
+
+    def __call__(
+        self,
+        input_ids,
+        decoder_input_ids,
+        *,
+        input_mask=None,
+        train: bool = False,
+    ):
+        enc_out = self.encode(input_ids, input_mask, train)
+        return self.decode(
+            decoder_input_ids, enc_out, input_mask, train=train
+        )
+
+
+class T5DecodeWrapper:
+    """Duck-typed ``model.apply`` surface for ``generation.generate``.
+
+    Closes over the encoder output (tracers are fine — construct it
+    inside the caller's jit), exposes the decoder as a decoder-only LM:
+    prefill initializes the self-attn cache AND the once-projected
+    cross K/V; decode steps reuse both.
+    """
+
+    def __init__(self, model, enc_out, enc_mask=None):
+        self.model = model
+        self.enc_out = enc_out
+        self.enc_mask = enc_mask
+
+    @property
+    def config(self):
+        return None  # no absolute-position cap (relative buckets)
+
+    def apply(self, variables, ids, *, decode=False, cache_len=None,
+              mutable=(), **unexpected):
+        if unexpected:
+            # generate's ragged-prompt path (prompt_mask) hands the model
+            # kv_mask/positions; silently dropping them would decode with
+            # pad cache slots attended — T5 decoding always starts from
+            # the 1-token start prompt, so refuse rather than mis-decode
+            raise NotImplementedError(
+                f"T5DecodeWrapper does not support {sorted(unexpected)} "
+                "(ragged prompt_mask decoding is a decoder-only-LM "
+                "feature; seq2seq raggedness lives in the encoder "
+                "input_mask)"
+            )
+        return self.model.apply(
+            variables, ids, self.enc_out, self.enc_mask,
+            False, decode, cache_len,
+            method=self.model.decode, mutable=mutable,
+        )
+
+
+def shift_right(labels: jnp.ndarray, start_id: int = 0) -> jnp.ndarray:
+    """Teacher-forcing decoder input: [start, y0, y1, ...] (HF
+    ``_shift_right``)."""
+    return jnp.concatenate(
+        [jnp.full_like(labels[:, :1], start_id), labels[:, :-1]], axis=1
+    )
+
+
+def generate_encdec(
+    model: T5ForConditionalGeneration,
+    params,
+    input_ids: jnp.ndarray,
+    *,
+    max_new_tokens: int,
+    input_mask: Optional[jnp.ndarray] = None,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    rng: Optional[jax.Array] = None,
+    eos_id: Optional[int] = None,
+) -> jnp.ndarray:
+    """Seq2seq generation: encode once, decode autoregressively.
+
+    Returns [B, max_new_tokens] (the decoder start token is stripped,
+    matching HF ``generate`` output minus the leading pad). ``eos_id``
+    defaults to the config's ``eos_token_id``; pass ``eos_id=-1`` to
+    disable stopping.
+    """
+    from pytorch_distributed_tpu.generation import generate
+
+    cfg = model.config
+    if eos_id is None:
+        eos_id = cfg.eos_token_id
+    elif eos_id == -1:
+        eos_id = None
+    enc_out = model.apply(
+        {"params": params}, input_ids, input_mask, False,
+        method=model.encode,
+    )
+    dec = T5DecodeWrapper(model, enc_out, input_mask)
+    start = jnp.full(
+        (input_ids.shape[0], 1), cfg.pad_token_id, jnp.int32
+    )
+    out = generate(
+        dec, params, start, max_new_tokens=max_new_tokens,
+        temperature=temperature, top_k=top_k, top_p=top_p, rng=rng,
+        eos_id=eos_id, pad_id=cfg.pad_token_id,
+    )
+    return out[:, 1:]
+
+
+def t5_partition_rules():
+    """Megatron TP for both stacks: column-parallel q/k/v/wi, row-parallel
+    o/wo; the shared embedding sharded on the model dim."""
+    from jax.sharding import PartitionSpec as P
+
+    from pytorch_distributed_tpu.parallel.sharding import stacked
+
+    return [
+        (r"/(q|k|v)/kernel", stacked(P(None, "tp", None))),
+        (r"/o/kernel", stacked(P("tp", None, None))),
+        (r"/(wi|wi_0|wi_1)/kernel", stacked(P(None, "tp"))),
+        (r"/wo/kernel", stacked(P("tp", None))),
+        (r"shared/embedding", P(None, "tp")),
+        (r"rel_bias/embedding", P(None, "tp")),
+    ]

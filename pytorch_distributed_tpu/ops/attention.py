@@ -59,6 +59,8 @@ def dot_product_attention(
     mask: Optional[jnp.ndarray] = None,  # [B, 1|Hq, S, T] or [B, T] padding
     segment_ids: Optional[jnp.ndarray] = None,  # [B, S] packing ids
     q_offset: int = 0,
+    bias: Optional[jnp.ndarray] = None,  # [1|B, Hq, S, T] additive
+    scale: Optional[float] = None,
     softmax_dtype=jnp.float32,
 ) -> jnp.ndarray:
     """MXU-friendly grouped attention; returns [B, S, Hq, D] in q.dtype.
@@ -67,6 +69,9 @@ def dot_product_attention(
     sequence-parallel shards where the local block starts mid-sequence.
     ``segment_ids`` restricts attention to within-segment pairs (packed
     fixed-shape sequences; self-attention only).
+    ``bias`` is added to the logits before masking — T5 relative position
+    buckets, ALiBi slopes. ``scale`` overrides the 1/sqrt(D) default
+    (T5 folds the scale into its init and uses 1.0).
     """
     B, S, Hq, D = q.shape
     _, T, Hkv, _ = k.shape
@@ -75,7 +80,8 @@ def dot_product_attention(
     G = Hq // Hkv
 
     qg = q.reshape(B, S, Hkv, G, D)
-    scale = 1.0 / math.sqrt(D)
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
     # [B, Hkv, G, S, T]; accumulate in f32 on the MXU, not post-cast
     logits = (
         jnp.einsum(
@@ -83,6 +89,10 @@ def dot_product_attention(
         )
         * scale
     )
+    if bias is not None:
+        logits = logits + bias.reshape(
+            bias.shape[0], Hkv, G, *bias.shape[-2:]
+        ).astype(softmax_dtype)
 
     neg = jnp.finfo(softmax_dtype).min
     if segment_ids is not None:
@@ -200,6 +210,8 @@ def attention(
     mask: Optional[jnp.ndarray] = None,
     segment_ids: Optional[jnp.ndarray] = None,
     q_offset: int = 0,
+    bias: Optional[jnp.ndarray] = None,
+    scale: Optional[float] = None,
 ) -> jnp.ndarray:
     """Dispatching attention: models call this instead of an impl directly."""
     from pytorch_distributed_tpu.parallel.sequence import (
@@ -228,14 +240,25 @@ def attention(
                 "packed (segment_ids) attention is not supported inside "
                 "sequence-parallel mode"
             )
+        if bias is not None or scale is not None:
+            # a relative-position bias spans the FULL sequence; applying
+            # it to a local ring shard would silently misalign buckets
+            raise NotImplementedError(
+                "additive bias / custom scale attention (T5, ALiBi) is "
+                "not supported inside sequence-parallel mode"
+            )
         return sequence_parallel_attention(q, k, v, causal=causal)
     use_flash = False
     # the kernel covers full, causal, [B, T] key-padding masks, and
-    # packed segment ids; only full 4-D masks force the XLA einsum path
+    # packed segment ids; full 4-D masks, additive bias (T5/ALiBi), and
+    # non-default scales force the XLA einsum path
     flash_ok_mask = mask is None or (
         hasattr(mask, "ndim") and mask.ndim == 2
     )
-    if flash_ok_mask and static_zero_offset:
+    if (
+        flash_ok_mask and static_zero_offset
+        and bias is None and scale is None
+    ):
         if _IMPL == "flash":
             use_flash = True
         # _IMPL == "auto": XLA path — see set_attention_impl docstring.
@@ -247,5 +270,5 @@ def attention(
         )
     return dot_product_attention(
         q, k, v, causal=causal, mask=mask, segment_ids=segment_ids,
-        q_offset=q_offset,
+        q_offset=q_offset, bias=bias, scale=scale,
     )

@@ -14,17 +14,29 @@ import jax.numpy as jnp
 import optax
 
 
-def cross_entropy(logits, labels, label_smoothing: float = 0.0):
-    """Mean softmax cross-entropy over the batch (f32 regardless of policy)."""
+def _token_cross_entropy(logits, labels, label_smoothing: float = 0.0):
+    """Per-position softmax CE (f32 regardless of policy) — the ONE
+    smoothing implementation, shared by every CE-shaped loss."""
     logits = logits.astype(jnp.float32)
-    n = logits.shape[-1]
     if label_smoothing:
+        n = logits.shape[-1]
         oh = jax.nn.one_hot(labels, n)
         oh = oh * (1.0 - label_smoothing) + label_smoothing / n
-        return jnp.mean(optax.softmax_cross_entropy(logits, oh))
-    return jnp.mean(
-        optax.softmax_cross_entropy_with_integer_labels(logits, labels)
-    )
+        return optax.softmax_cross_entropy(logits, oh)
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+
+
+def _masked_mean(tok_loss, mask):
+    """Mean over positions where boolean ``mask`` is True (all, if None)."""
+    if mask is None:
+        return jnp.mean(tok_loss)
+    valid = mask.astype(tok_loss.dtype)
+    return jnp.sum(tok_loss * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+def cross_entropy(logits, labels, label_smoothing: float = 0.0):
+    """Mean softmax cross-entropy over the batch (f32 regardless of policy)."""
+    return jnp.mean(_token_cross_entropy(logits, labels, label_smoothing))
 
 
 def accuracy(logits, labels):
@@ -464,6 +476,49 @@ def causal_lm_loss_fn(
             loss = loss + aux
         return loss, {
             "metrics": metrics,
+            "batch_stats": batch_stats,
+        }
+
+    return loss_fn
+
+
+def seq2seq_lm_loss_fn(
+    model,
+    *,
+    start_id: Optional[int] = None,
+    label_smoothing: float = 0.0,
+) -> Callable:
+    """Trainer-contract loss for encoder-decoder LMs (models/t5.py).
+
+    Teacher forcing: decoder input is ``shift_right(labels)`` (HF
+    ``T5ForConditionalGeneration(labels=...)`` semantics — the start
+    token defaults to the config's pad id), CE is computed against the
+    UNSHIFTED labels, and an optional boolean ``label_mask`` excludes
+    padded target positions from the mean. Batch keys: ``input_ids``,
+    ``labels``, optional ``input_mask`` / ``label_mask``.
+    """
+
+    def loss_fn(params, batch_stats, batch, rng):
+        from pytorch_distributed_tpu.models.t5 import shift_right
+
+        labels = batch["labels"]
+        sid = (
+            start_id
+            if start_id is not None
+            else getattr(model.config, "pad_token_id", 0)
+        )
+        logits = model.apply(
+            {"params": params},
+            batch["input_ids"],
+            shift_right(labels, sid),
+            input_mask=batch.get("input_mask"),
+            train=True,
+            rngs={"dropout": rng},
+        )
+        tok = _token_cross_entropy(logits, labels, label_smoothing)
+        loss = _masked_mean(tok, batch.get("label_mask"))
+        return loss, {
+            "metrics": {"loss": loss},
             "batch_stats": batch_stats,
         }
 
