@@ -17,6 +17,7 @@ from pytorch_distributed_tpu.train.losses import (
     classification_eval_step,
     classification_loss_fn,
     causal_lm_loss_fn,
+    seq2seq_eval_step,
     seq2seq_lm_loss_fn,
     distillation_loss_fn,
     masked_lm_loss_fn,
@@ -58,6 +59,7 @@ __all__ = [
     "masked_lm_loss_fn",
     "mixup_classification_loss_fn",
     "causal_lm_loss_fn",
+    "seq2seq_eval_step",
     "seq2seq_lm_loss_fn",
     "distillation_loss_fn",
     "f1_finalize",

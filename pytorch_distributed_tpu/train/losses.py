@@ -550,6 +550,40 @@ def text_classification_loss_fn(
     return loss_fn
 
 
+def seq2seq_eval_step(model, *, start_id: Optional[int] = None) -> Callable:
+    """``eval_step(state, batch) -> metrics`` for encoder-decoder LMs:
+    teacher-forced masked CE / perplexity / token accuracy over the
+    labels (same batch contract as :func:`seq2seq_lm_loss_fn`)."""
+
+    def eval_step(state, batch) -> Dict[str, jax.Array]:
+        from pytorch_distributed_tpu.models.t5 import shift_right
+
+        labels = batch["labels"]
+        sid = (
+            start_id
+            if start_id is not None
+            else getattr(model.config, "pad_token_id", 0)
+        )
+        logits = model.apply(
+            {"params": state.params},
+            batch["input_ids"],
+            shift_right(labels, sid),
+            input_mask=batch.get("input_mask"),
+            train=False,
+        )
+        tok = _token_cross_entropy(logits, labels)
+        mask = batch.get("label_mask")
+        loss = _masked_mean(tok, mask)
+        correct = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+        return {
+            "loss": loss,
+            "perplexity": jnp.exp(loss),
+            "token_accuracy": _masked_mean(correct, mask),
+        }
+
+    return eval_step
+
+
 def causal_lm_eval_step(
     model,
     *,
