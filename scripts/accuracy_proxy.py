@@ -45,6 +45,12 @@ def make_task(n, seed):
 
 
 def main():
+    global t0
+    from pytorch_distributed_tpu.utils.benchlock import start_measurement
+
+    # lock BEFORE the budget clock starts: queue time behind another
+    # run is not this run's measurement time
+    _lock, t0 = start_measurement()  # noqa: F841 — held for life
     import jax
     import optax
 

@@ -54,6 +54,11 @@ def _timed(fn, x, iters, warmup=3):
 
 
 def main(argv=None):
+    from pytorch_distributed_tpu.utils.benchlock import (
+        acquire_measurement_lock,
+    )
+
+    _lock = acquire_measurement_lock()  # noqa: F841 — held for life
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--sizes", type=float, nargs="+", default=[4.0, 32.0],
                    help="payload sizes in MB (f32 elements)")

@@ -60,6 +60,12 @@ CASES = [
 
 
 def main():
+    global t0
+    from pytorch_distributed_tpu.utils.benchlock import start_measurement
+
+    # lock BEFORE the budget clock starts: queue time behind another
+    # run is not this run's measurement time
+    _lock, t0 = start_measurement()  # noqa: F841 — held for life
     log(f"platform={jax.devices()[0].platform} "
         f"kind={jax.devices()[0].device_kind}")
     results = []

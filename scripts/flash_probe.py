@@ -40,6 +40,12 @@ SHAPES = [  # (B, S, H, D) smallest-first
 
 
 def main():
+    global t0
+    from pytorch_distributed_tpu.utils.benchlock import start_measurement
+
+    # lock BEFORE the budget clock starts: queue time behind another
+    # run is not this run's measurement time
+    _lock, t0 = start_measurement()  # noqa: F841 — held for life
     ptd.enable_compilation_cache()
     log(f"platform={ptd.platform()} kind={jax.devices()[0].device_kind}")
     for shape in SHAPES:

@@ -91,6 +91,12 @@ def grad_of(fn):
 
 
 def main():
+    global t0
+    from pytorch_distributed_tpu.utils.benchlock import start_measurement
+
+    # lock BEFORE the budget clock starts: queue time behind another
+    # run is not this run's measurement time
+    _lock, t0 = start_measurement()  # noqa: F841 — held for life
     ptd.enable_compilation_cache()
     log(f"platform={ptd.platform()} kind={jax.devices()[0].device_kind}")
     xla = lambda q, k, v: dot_product_attention(q, k, v, causal=True)

@@ -67,6 +67,12 @@ def bench_batch(batch: int, image: int = 224, iters: int = 50,
 
 
 def main():
+    global t0
+    from pytorch_distributed_tpu.utils.benchlock import start_measurement
+
+    # lock BEFORE the budget clock starts: queue time behind another
+    # run is not this run's measurement time
+    _lock, t0 = start_measurement()  # noqa: F841 — held for life
     ap = argparse.ArgumentParser()
     ap.add_argument("--batches", type=int, nargs="+",
                     default=[128, 256, 512])

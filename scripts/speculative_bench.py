@@ -52,6 +52,12 @@ DISTILL_STEPS = 200
 
 
 def main():
+    global t0
+    from pytorch_distributed_tpu.utils.benchlock import start_measurement
+
+    # lock BEFORE the budget clock starts: queue time behind another
+    # run is not this run's measurement time
+    _lock, t0 = start_measurement()  # noqa: F841 — held for life
     global B, P, NEW, K
     ptd.enable_compilation_cache()
     ptd.init_process_group()

@@ -63,6 +63,49 @@ def test_bench_cpu_fallback_is_host_meaningful():
 
 
 @pytest.mark.slow
+def test_bench_lock_serializes_runs():
+    """Two benches may never overlap (VERDICT r4 weak #2: the driver's
+    round-end bench contended with the capture loop and halved the feed
+    metric). A second bench must block on the machine-wide flock until
+    the first exits, and say so on stderr."""
+    import fcntl
+
+    from pytorch_distributed_tpu.utils.benchlock import LOCK_PATH
+
+    lock_fd = os.open(LOCK_PATH, os.O_CREAT | os.O_RDWR, 0o666)
+    proc = None
+    try:
+        try:  # impersonate a running bench — but never queue behind one
+            fcntl.flock(lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(lock_fd)
+            pytest.skip("a real bench holds the lock right now")
+        code = (
+            f"import sys; sys.path.insert(0, {REPO!r}); import bench; "
+            "bench._acquire_bench_lock(); print('LOCKED', flush=True)"
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        proc = subprocess.Popen(
+            [sys.executable, "-u", "-c", code], cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        # the waiting line is printed BEFORE the child's wait loop, so
+        # reading it is the race-free "child is now queued" signal (a
+        # fixed sleep loses on this contended 1-core rig)
+        waiting_line = proc.stderr.readline()
+        assert "bench lock held" in waiting_line, waiting_line
+        assert proc.poll() is None, "second bench did not block on the lock"
+        fcntl.flock(lock_fd, fcntl.LOCK_UN)
+        out, err = proc.communicate(timeout=120)
+        assert "LOCKED" in out
+        assert "bench lock acquired" in err, err[-500:]
+    finally:
+        os.close(lock_fd)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+
+
+@pytest.mark.slow
 def test_tpu_only_phases_run_on_cpu_backend():
     """The phases the driver only exercises on the chip (gpt2 train-step
     tokens/s, dp-step overhead, decode incl. bf16-at-rest) must at least
