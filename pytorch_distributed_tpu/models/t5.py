@@ -183,9 +183,16 @@ class T5Attention(nn.Module):
         mask=None,
         decode: bool = False,
         cache_len: Optional[int] = None,
+        deterministic: bool = True,
     ):
         cfg = self.config
         H, D = cfg.num_heads, cfg.d_kv
+        # HF T5 drops attention WEIGHTS (post-softmax) at dropout_rate,
+        # on top of the block-level residual dropout
+        drop_rate = 0.0 if deterministic else cfg.dropout_rate
+        drop_rng = (
+            self.make_rng("dropout") if drop_rate > 0.0 else None
+        )
         q = _dense((H, D), "q")(x)
         cross = kv_source is not None
         if cross and decode:
@@ -204,11 +211,17 @@ class T5Attention(nn.Module):
                 ck.value = _dense((H, D), "k")(kv_source)
                 cv.value = _dense((H, D), "v")(kv_source)
             k, v = ck.value, cv.value
-            attn = attention(q, k, v, mask=mask, scale=1.0)
+            attn = attention(
+                q, k, v, mask=mask, scale=1.0,
+                dropout_rate=drop_rate, dropout_rng=drop_rng,
+            )
         elif cross:
             k = _dense((H, D), "k")(kv_source)
             v = _dense((H, D), "v")(kv_source)
-            attn = attention(q, k, v, mask=mask, scale=1.0)
+            attn = attention(
+                q, k, v, mask=mask, scale=1.0,
+                dropout_rate=drop_rate, dropout_rng=drop_rng,
+            )
         elif decode:
             k = _dense((H, D), "k")(x)
             v = _dense((H, D), "v")(x)
@@ -216,6 +229,7 @@ class T5Attention(nn.Module):
             attn = attention(
                 q, k, v, causal=self.causal, q_offset=offset, mask=mask,
                 bias=bias, scale=1.0,
+                dropout_rate=drop_rate, dropout_rng=drop_rng,
             )
         else:
             k = _dense((H, D), "k")(x)
@@ -223,6 +237,7 @@ class T5Attention(nn.Module):
             attn = attention(
                 q, k, v, causal=self.causal, mask=mask, bias=bias,
                 scale=1.0,
+                dropout_rate=drop_rate, dropout_rng=drop_rng,
             )
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), use_bias=False,
@@ -235,7 +250,7 @@ class T5FFN(nn.Module):
     config: T5Config
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, deterministic: bool = True):
         cfg = self.config
         if cfg.feed_forward_proj == "gated-gelu":
             # HF's dense_act_fn here is gelu_new == tanh-approximate gelu
@@ -243,6 +258,10 @@ class T5FFN(nn.Module):
             h = h * _dense(cfg.d_ff, "wi_1")(x)
         else:
             h = nn.relu(_dense(cfg.d_ff, "wi")(x))
+        # HF DenseActDense/DenseGatedActDense: inner dropout between the
+        # activation (or gate product) and wo, on top of the block-level
+        # residual dropout
+        h = nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
         return _dense(cfg.d_model, "wo")(h)
 
 
@@ -257,10 +276,14 @@ class T5EncoderBlock(nn.Module):
         )
         h = T5LayerNorm(cfg.layer_norm_eps, name="attn_norm")(x)
         x = x + drop(
-            T5Attention(cfg, name="attn")(h, bias=bias, mask=enc_mask)
+            T5Attention(cfg, name="attn")(
+                h, bias=bias, mask=enc_mask, deterministic=deterministic
+            )
         )
         h = T5LayerNorm(cfg.layer_norm_eps, name="ffn_norm")(x)
-        return x + drop(T5FFN(cfg, name="ffn")(h))
+        return x + drop(
+            T5FFN(cfg, name="ffn")(h, deterministic=deterministic)
+        )
 
 
 class T5DecoderBlock(nn.Module):
@@ -278,17 +301,21 @@ class T5DecoderBlock(nn.Module):
         h = T5LayerNorm(cfg.layer_norm_eps, name="attn_norm")(x)
         x = x + drop(
             T5Attention(cfg, causal=True, name="attn")(
-                h, bias=bias, decode=decode, cache_len=cache_len
+                h, bias=bias, decode=decode, cache_len=cache_len,
+                deterministic=deterministic,
             )
         )
         h = T5LayerNorm(cfg.layer_norm_eps, name="cross_norm")(x)
         x = x + drop(
             T5Attention(cfg, name="cross_attn")(
-                h, kv_source=enc_out, mask=enc_mask, decode=decode
+                h, kv_source=enc_out, mask=enc_mask, decode=decode,
+                deterministic=deterministic,
             )
         )
         h = T5LayerNorm(cfg.layer_norm_eps, name="ffn_norm")(x)
-        return x + drop(T5FFN(cfg, name="ffn")(h))
+        return x + drop(
+            T5FFN(cfg, name="ffn")(h, deterministic=deterministic)
+        )
 
 
 def _stack(block_cls, cfg, name, static_argnums):

@@ -62,6 +62,8 @@ def dot_product_attention(
     bias: Optional[jnp.ndarray] = None,  # [1|B, Hq, S, T] additive
     scale: Optional[float] = None,
     softmax_dtype=jnp.float32,
+    dropout_rate: float = 0.0,
+    dropout_rng=None,
 ) -> jnp.ndarray:
     """MXU-friendly grouped attention; returns [B, S, Hq, D] in q.dtype.
 
@@ -72,6 +74,8 @@ def dot_product_attention(
     ``bias`` is added to the logits before masking — T5 relative position
     buckets, ALiBi slopes. ``scale`` overrides the 1/sqrt(D) default
     (T5 folds the scale into its init and uses 1.0).
+    ``dropout_rate``/``dropout_rng`` drop attention WEIGHTS (post-softmax,
+    inverted scaling) — torch's ``attn_dropout`` / HF T5 semantics.
     """
     B, S, Hq, D = q.shape
     _, T, Hkv, _ = k.shape
@@ -118,6 +122,16 @@ def dot_product_attention(
         logits = jnp.where(mask, logits, neg)
 
     weights = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0:
+        if dropout_rng is None:
+            raise ValueError(
+                "dropout_rate > 0 requires dropout_rng (pass the module's "
+                "make_rng('dropout') stream)"
+            )
+        keep = jax.random.bernoulli(
+            dropout_rng, 1.0 - dropout_rate, weights.shape
+        )
+        weights = jnp.where(keep, weights / (1.0 - dropout_rate), 0.0)
     out = jnp.einsum("bkgst,btkd->bskgd", weights.astype(q.dtype), v)
     return out.reshape(B, S, Hq, D)
 
@@ -212,6 +226,8 @@ def attention(
     q_offset: int = 0,
     bias: Optional[jnp.ndarray] = None,
     scale: Optional[float] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng=None,
 ) -> jnp.ndarray:
     """Dispatching attention: models call this instead of an impl directly."""
     from pytorch_distributed_tpu.parallel.sequence import (
@@ -247,17 +263,26 @@ def attention(
                 "additive bias / custom scale attention (T5, ALiBi) is "
                 "not supported inside sequence-parallel mode"
             )
+        if dropout_rate > 0.0:
+            # ring/all-to-all shards would each need a coordinated rng
+            # over the FULL [S, T] weight matrix; dropping locally would
+            # silently decorrelate shards
+            raise NotImplementedError(
+                "attention-weight dropout is not supported inside "
+                "sequence-parallel mode"
+            )
         return sequence_parallel_attention(q, k, v, causal=causal)
     use_flash = False
-    # the kernel covers full, causal, [B, T] key-padding masks, and
-    # packed segment ids; full 4-D masks, additive bias (T5/ALiBi), and
-    # non-default scales force the XLA einsum path
+    # the kernel covers full, causal, [B, T] key-padding masks, packed
+    # segment ids, and custom softmax scales (T5's 1.0 rides through as
+    # sm_scale); full 4-D masks and additive bias (T5 self-attn/ALiBi)
+    # force the XLA einsum path
     flash_ok_mask = mask is None or (
         hasattr(mask, "ndim") and mask.ndim == 2
     )
     if (
-        flash_ok_mask and static_zero_offset
-        and bias is None and scale is None
+        flash_ok_mask and static_zero_offset and bias is None
+        and dropout_rate == 0.0  # weight dropout: einsum path only
     ):
         if _IMPL == "flash":
             use_flash = True
@@ -266,9 +291,11 @@ def attention(
         from pytorch_distributed_tpu.ops.flash_attention import flash_attention
 
         return flash_attention(
-            q, k, v, causal=causal, kv_mask=mask, segment_ids=segment_ids
+            q, k, v, causal=causal, kv_mask=mask, segment_ids=segment_ids,
+            sm_scale=scale,
         )
     return dot_product_attention(
         q, k, v, causal=causal, mask=mask, segment_ids=segment_ids,
         q_offset=q_offset, bias=bias, scale=scale,
+        dropout_rate=dropout_rate, dropout_rng=dropout_rng,
     )

@@ -97,22 +97,42 @@ def enable_compilation_cache(
     many minutes to compile, and the cache turns every later run (e.g. a
     benchmark after a warmup run) into a disk hit.
 
-    Default dir: ``$PTD_COMPILATION_CACHE`` or ``~/.cache/ptd_xla``. A
-    backend whose executables can't be serialized simply never populates
-    the cache — enabling is always safe. Returns the directory used.
+    Default dir: ``$PTD_COMPILATION_CACHE`` or ``~/.cache/ptd_xla``,
+    ALWAYS suffixed with a host-ISA fingerprint subdir (hash of
+    /proc/cpuinfo's feature flags). The cache outlives containers, and
+    a container can come back on a different hypervisor CPU model —
+    XLA:CPU AOT entries compiled under the wider-featured host then
+    load with pages of "could lead to execution errors such as SIGILL"
+    warnings (drowning driver-facing dryrun/bench stderr) or actually
+    SIGILL. Keying the dir by ISA makes a migrated host start a fresh
+    (cold, safe, quiet) cache instead — the same provenance rule the
+    native .so builds enforce via their flags sidecar
+    (utils/native_build.py). A backend whose executables can't be
+    serialized simply never populates the cache — enabling is always
+    safe. Returns the directory used.
 
     ``best_effort``: swallow ANY failure (unwritable dir, renamed jax
     config keys) and return "" — for callers where the cache is an
     optimization and must never fail the surrounding contract (the test
     conftest, the driver dryrun child).
     """
+    import hashlib
     import os
 
     try:
-        path = (
+        from ..utils.native_build import host_cpu_flags
+
+        base = (
             path or os.environ.get("PTD_COMPILATION_CACHE")
             or os.path.join(os.path.expanduser("~"), ".cache", "ptd_xla")
         )
+        flags = host_cpu_flags()
+        fp = (
+            hashlib.sha256(" ".join(sorted(flags)).encode()).hexdigest()[:8]
+            if flags
+            else "generic"
+        )
+        path = os.path.join(base, f"isa-{fp}")
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
         # cache everything that took meaningful compile time; the default

@@ -13,6 +13,26 @@ import tempfile
 from typing import Sequence
 
 
+def host_cpu_flags() -> set:
+    """The HOST's CPU feature flags per /proc/cpuinfo (empty off-Linux).
+
+    Shared ISA ground truth for everything that must not outlive a
+    container migration to a different hypervisor CPU model: the
+    ``-march`` gate below, and the ISA-fingerprinted XLA compilation
+    cache dir (runtime/device.py) whose cross-ISA AOT entries would
+    otherwise load with SIGILL-warning spam — or worse, SIGILL.
+    """
+    try:
+        with open("/proc/cpuinfo") as f:
+            info = f.read()
+    except OSError:
+        return set()
+    for line in info.splitlines():
+        if line.startswith("flags"):
+            return set(line.split(":", 1)[1].split())
+    return set()
+
+
 def _arch_flags() -> list:
     """Vector-ISA flags this HOST supports, decided at build time.
 
@@ -24,16 +44,7 @@ def _arch_flags() -> list:
     expose avx2 while masking others; partial gates SIGILL exactly the
     way this function exists to prevent.
     """
-    try:
-        with open("/proc/cpuinfo") as f:
-            info = f.read()
-    except OSError:
-        return []
-    flags = set()
-    for line in info.splitlines():
-        if line.startswith("flags"):
-            flags.update(line.split(":", 1)[1].split())
-            break
+    flags = host_cpu_flags()
     v3 = {"avx", "avx2", "bmi1", "bmi2", "fma", "f16c", "movbe", "xsave"}
     lzcnt = bool({"lzcnt", "abm"} & flags)  # Intel lists lzcnt, AMD abm
     return ["-march=x86-64-v3"] if (v3 <= flags and lzcnt) else []

@@ -416,3 +416,74 @@ class TestAttentionDispatch:
 
         with pytest.raises(ValueError):
             A.set_attention_impl("cudnn")
+
+
+class TestWeightDropoutAndFlashScale:
+    """Post-softmax weight dropout (HF/torch attn_dropout semantics) and
+    the dispatcher letting custom scales ride the flash kernel."""
+
+    def _qkv(self, seed=0, shape=(2, 8, 4, 16)):
+        rng = np.random.default_rng(seed)
+        return tuple(
+            jnp.asarray(rng.normal(size=shape).astype(np.float32))
+            for _ in range(3)
+        )
+
+    def test_dropout_single_key_is_inverted_bernoulli(self):
+        # T=1: softmax weight is exactly 1, so each output row is either
+        # v/(1-p) (kept) or 0 (dropped) — pins the inverted scaling
+        q = jnp.ones((1, 4, 1, 8))
+        k = jnp.ones((1, 1, 1, 8))
+        v = jnp.full((1, 1, 1, 8), 3.0)
+        p = 0.5
+        out = np.asarray(
+            dot_product_attention(
+                q, k, v, dropout_rate=p, dropout_rng=jax.random.key(0)
+            )
+        )
+        kept = np.isclose(out, 3.0 / (1 - p))
+        dropped = np.isclose(out, 0.0)
+        assert np.all(kept | dropped)
+        assert kept.any() and dropped.any()  # both outcomes at p=0.5
+
+    def test_dropout_requires_rng(self):
+        q, k, v = self._qkv()
+        with pytest.raises(ValueError, match="dropout_rng"):
+            dot_product_attention(q, k, v, dropout_rate=0.1)
+
+    def test_dropout_zero_identical_to_base(self):
+        q, k, v = self._qkv(3)
+        base = dot_product_attention(q, k, v)
+        zero = dot_product_attention(
+            q, k, v, dropout_rate=0.0, dropout_rng=jax.random.key(0)
+        )
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(zero))
+
+    def test_dispatcher_flash_takes_custom_scale(self, monkeypatch):
+        # a non-None scale (T5's 1.0) must ride the flash kernel when
+        # selected, not silently fall back to einsum (ADVICE r4) —
+        # interpret mode on CPU, numerics vs the einsum path
+        import pytorch_distributed_tpu.ops.attention as attn_mod
+
+        q, k, v = self._qkv(4, (1, 64, 2, 16))
+        want = dot_product_attention(q, k, v, scale=1.0)
+        monkeypatch.setattr(attn_mod, "_IMPL", "flash")
+        called = {}
+        import importlib
+
+        fa_mod = importlib.import_module(
+            "pytorch_distributed_tpu.ops.flash_attention"
+        )
+
+        real = fa_mod.flash_attention
+
+        def spy(*a, **kw):
+            called["sm_scale"] = kw.get("sm_scale")
+            return real(*a, **kw)
+
+        monkeypatch.setattr(fa_mod, "flash_attention", spy)
+        got = attn_mod.attention(q, k, v, scale=1.0)
+        assert called["sm_scale"] == 1.0  # flash path actually taken
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )

@@ -248,3 +248,46 @@ def test_t5_generate_with_tp_sharded_params():
         model, state.params, enc, max_new_tokens=6, eos_id=-1
     )
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_t5_dropout_sites_active_in_training_mode():
+    """HF T5 has THREE dropout applications per sublayer family: the
+    block-level residual dropout, the attention-WEIGHT dropout
+    (post-softmax, inside T5Attention), and the FFN inner dropout
+    (between activation and wo). The latter two were missing until
+    ADVICE r4 — this pins them: module-level outputs must move when
+    deterministic=False with a live dropout stream, be reproducible
+    under the same rng, and be untouched when deterministic=True
+    (eval/parity paths)."""
+    from pytorch_distributed_tpu.models.t5 import T5Attention, T5FFN
+
+    cfg = T5Config.tiny()
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(2, 6, cfg.d_model)),
+        jnp.float32,
+    )
+
+    ffn = T5FFN(cfg)
+    fp = ffn.init(jax.random.key(0), x)
+    f_det = ffn.apply(fp, x)
+    f_a = ffn.apply(fp, x, False, rngs={"dropout": jax.random.key(1)})
+    f_b = ffn.apply(fp, x, False, rngs={"dropout": jax.random.key(2)})
+    f_a2 = ffn.apply(fp, x, False, rngs={"dropout": jax.random.key(1)})
+    assert not np.allclose(f_det, f_a)  # inner dropout fires
+    assert not np.allclose(f_a, f_b)  # stream-dependent
+    np.testing.assert_array_equal(f_a, f_a2)  # reproducible
+    np.testing.assert_array_equal(
+        f_det, ffn.apply(fp, x, True)
+    )  # deterministic is a no-op path
+
+    attn = T5Attention(cfg)
+    ap = attn.init(jax.random.key(0), x)
+    a_det = attn.apply(ap, x)
+    a_a = attn.apply(
+        ap, x, deterministic=False, rngs={"dropout": jax.random.key(1)}
+    )
+    a_b = attn.apply(
+        ap, x, deterministic=False, rngs={"dropout": jax.random.key(2)}
+    )
+    assert not np.allclose(a_det, a_a)  # weight dropout fires
+    assert not np.allclose(a_a, a_b)
