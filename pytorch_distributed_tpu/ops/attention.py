@@ -283,6 +283,9 @@ def attention(
     if (
         flash_ok_mask and static_zero_offset and bias is None
         and dropout_rate == 0.0  # weight dropout: einsum path only
+        and q.shape[1] > 1  # single-query decode steps (T5 cross-attn
+        # at S=1): a blocked kernel per token is all launch overhead,
+        # and sub-tile block shapes are a Mosaic compile hazard
     ):
         if _IMPL == "flash":
             use_flash = True

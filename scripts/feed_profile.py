@@ -1,0 +1,161 @@
+"""Decompose the f32 feed path: which stage owns the 1131 img/s ceiling?
+
+VERDICT r4 weak #3: the r4 normalize vectorization won its ~2x microbench
+but moved solo e2e feed only 1097.7 -> 1131.1 img/s (+3%) — so normalize
+was never the feed bottleneck, and nothing names what is. This script
+times each stage of the exact bench.py `feed_only` path in isolation, at
+the same shapes (src=256, crop=224, B=128, world=1):
+
+  rng      — the per-batch crop/flip parameter draw (crc32 + PCG init)
+  assemble — ImageBatchPipeline.__call__ (rng + native crop/flip/
+             normalize pf_image_batch)
+  put      — jax.device_put of a pre-assembled f32 batch + block (the
+             77 MB/batch host->"device" copy on the CPU backend)
+  loader   — the full DataLoader loop (sampler + prefetch threads +
+             assemble + put), i.e. the bench's own number
+
+Run it under the measurement lock (solo core) — it IS a measurement.
+Prints a stage table and the implied bound: if loader ~= assemble + put,
+the prefetch overlap is not overlapping (1 core: it can't), and the
+bigger of the two names the ceiling.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+t0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time() - t0:6.1f}s] {msg}", flush=True)
+
+
+def main():
+    global t0
+    from pytorch_distributed_tpu.utils.benchlock import start_measurement
+
+    _lock, t0 = start_measurement()  # noqa: F841 — held for life
+
+    import jax
+    import numpy as np
+
+    import pytorch_distributed_tpu as ptd
+    from pytorch_distributed_tpu.data import ArrayDataset, DataLoader
+    from pytorch_distributed_tpu.data.native_pipeline import (
+        ImageBatchPipeline,
+    )
+    from pytorch_distributed_tpu.parallel import DataParallel
+
+    ptd.enable_compilation_cache()
+    ptd.init_process_group()
+    log(f"platform={ptd.platform()} world={ptd.get_world_size()}")
+
+    n_img, src, crop, B, steps = 256, 256, 224, 128, 10
+    rng = np.random.default_rng(0)
+    ds = ArrayDataset(
+        image=rng.integers(0, 256, size=(n_img, src, src, 3), dtype=np.uint8),
+        label=rng.integers(1000, size=(n_img,)).astype(np.int32),
+    )
+    pipe = ImageBatchPipeline(crop, train=True)
+    strategy = DataParallel()
+    sharding = strategy.batch_sharding()
+
+    idx = np.arange(B, dtype=np.int64)
+
+    def timeit(fn, warmup=2, iters=steps):
+        for _ in range(warmup):
+            fn()
+        t = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        return (time.perf_counter() - t) / iters
+
+    # -- rng: the python-side param draw only
+    import zlib
+
+    def rng_only():
+        r = np.random.default_rng([0, 0, zlib.crc32(idx.tobytes()), B])
+        r.integers(0, src - crop + 1, size=B, dtype=np.int32)
+        r.integers(0, src - crop + 1, size=B, dtype=np.int32)
+        r.integers(0, 2, size=B, dtype=np.uint8)
+
+    t_rng = timeit(rng_only)
+
+    # -- assemble: the full fetch callable (rng + native pass)
+    t_asm = timeit(lambda: pipe(ds, idx))
+
+    # -- put: ship one pre-assembled f32 batch (NEW buffer each call —
+    # reusing one would let jax short-circuit on a cached committed array)
+    batch = pipe(ds, idx)
+    img = batch["image"]
+
+    def put_once():
+        fresh = img.copy()  # forces a real host->device copy every call
+        out = jax.device_put(fresh, sharding)
+        out.block_until_ready()
+
+    t_put = timeit(put_once)
+    # the copy() itself, to subtract
+    t_copy = timeit(lambda: img.copy())
+
+    # -- u8 put for comparison (1/4 the bytes)
+    pipe_u8 = ImageBatchPipeline(crop, train=True, device_normalize=True)
+    batch_u8 = pipe_u8(ds, idx)
+    img_u8 = batch_u8["image"]
+
+    def put_u8():
+        fresh = img_u8.copy()
+        jax.device_put(fresh, sharding).block_until_ready()
+
+    t_put_u8 = timeit(put_u8)
+
+    # -- loader: the bench's own e2e feed loop
+    loader = DataLoader(
+        ds, B, shuffle=True, sharding=sharding, fetch=pipe, prefetch=4,
+    )
+
+    def one_epoch():
+        n = 0
+        for b in loader:
+            jax.block_until_ready(b["image"])
+            n += b["label"].shape[0]
+        return n
+
+    one_epoch()  # warm
+    t = time.perf_counter()
+    epochs = 5
+    total = sum(one_epoch() for _ in range(epochs))
+    t_loader_img = (time.perf_counter() - t) / total  # s per image
+
+    mb = B * crop * crop * 3 * 4 / 1e6
+    rows = [
+        ("rng param draw", t_rng, B / t_rng),
+        ("assemble (rng+native)", t_asm, B / t_asm),
+        ("device_put f32 (net of copy)", t_put - t_copy,
+         B / (t_put - t_copy)),
+        # raw, not net-of-copy: the u8 put is so cheap (CPU backend can
+        # alias the host buffer) that subtracting the copy estimate
+        # goes negative — report what was measured
+        ("device_put u8  (incl. copy)", t_put_u8, B / t_put_u8),
+        ("loader e2e", t_loader_img * B, 1.0 / t_loader_img),
+    ]
+    log(f"shapes: src={src} crop={crop} B={B} ({mb:.1f} MB f32/batch)")
+    for name, sec, imps in rows:
+        log(f"  {name:<30} {sec * 1e3:8.2f} ms/batch  {imps:8.0f} img/s")
+    ser = t_asm + (t_put - t_copy)
+    log(
+        f"  assemble+put serial bound       {ser * 1e3:8.2f} ms/batch  "
+        f"{B / ser:8.0f} img/s"
+    )
+    overlap = (t_asm + t_put - t_copy) / (t_loader_img * B)
+    log(
+        f"loader/(assemble+put) = {overlap:.2f} "
+        f"(1.0 = no overlap possible on 1 core; <1 = loader overhead)"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -431,8 +431,10 @@ class TestWeightDropoutAndFlashScale:
 
     def test_dropout_single_key_is_inverted_bernoulli(self):
         # T=1: softmax weight is exactly 1, so each output row is either
-        # v/(1-p) (kept) or 0 (dropped) — pins the inverted scaling
-        q = jnp.ones((1, 4, 1, 8))
+        # v/(1-p) (kept) or 0 (dropped) — pins the inverted scaling.
+        # S=64 rows so "both outcomes appear" is robust to PRNG
+        # bit-stream changes (P[all same] ~ 2*0.5^64)
+        q = jnp.ones((1, 64, 1, 8))
         k = jnp.ones((1, 1, 1, 8))
         v = jnp.full((1, 1, 1, 8), 3.0)
         p = 0.5
