@@ -169,15 +169,17 @@ def load_gpt2_weights(sd: StateDict, cfg) -> Dict:
 # Llama
 # --------------------------------------------------------------------------
 
-def load_llama_weights(sd: StateDict, cfg) -> Dict:
-    """HF ``LlamaForCausalLM`` state_dict -> params for
-    :class:`~pytorch_distributed_tpu.models.llama.LlamaForCausalLM`."""
+def _llama_body_import(sd: StateDict, cfg, ffn_fn) -> Dict:
+    """Shared Llama-body mapping (attention, norms, embed, head): every
+    family with a Llama body differs only in the FFN, mirroring the
+    model side's ``block_cls``/``_ffn`` hook — ``ffn_fn(prefix)``
+    returns the per-layer FFN subtree."""
     H, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.hidden_size
     hd = cfg.head_dim
 
     def block(i):
         p = f"model.layers.{i}."
-        return {
+        tree = {
             "attn_norm": {"scale": _np(sd, p + "input_layernorm.weight")},
             # torch Linear [out, in] -> transpose -> head reshape
             "q": {
@@ -203,10 +205,9 @@ def load_llama_weights(sd: StateDict, cfg) -> Dict:
             "mlp_norm": {
                 "scale": _np(sd, p + "post_attention_layernorm.weight")
             },
-            "gate": {"kernel": _np(sd, p + "mlp.gate_proj.weight").T},
-            "up": {"kernel": _np(sd, p + "mlp.up_proj.weight").T},
-            "down": {"kernel": _np(sd, p + "mlp.down_proj.weight").T},
         }
+        tree.update(ffn_fn(p))
+        return tree
 
     layers = [block(i) for i in range(cfg.num_layers)]
     lm_head = (
@@ -221,6 +222,53 @@ def load_llama_weights(sd: StateDict, cfg) -> Dict:
     }
     params.update(_maybe_stack(layers, cfg.scan_layers, "layers", "layer"))
     return params
+
+
+def _llama_body_export(params, cfg, ffn_fn) -> Dict[str, Array]:
+    """Inverse of :func:`_llama_body_import`; ``ffn_fn(sd, prefix, lyr)``
+    writes the per-layer FFN entries."""
+    H, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.hidden_size
+    hd = cfg.head_dim
+    sd = {
+        "model.embed_tokens.weight": np.asarray(params["embed"]["embedding"]),
+        "model.norm.weight": np.asarray(params["final_norm"]["scale"]),
+        "lm_head.weight": np.asarray(params["lm_head"]["kernel"]).T,
+    }
+    for i, lyr in enumerate(_unstack(params, cfg, "layers", "layer")):
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = np.asarray(
+            lyr["attn_norm"]["scale"]
+        )
+        sd[p + "self_attn.q_proj.weight"] = (
+            np.asarray(lyr["q"]["kernel"]).reshape(D, H * hd).T
+        )
+        sd[p + "self_attn.k_proj.weight"] = (
+            np.asarray(lyr["k"]["kernel"]).reshape(D, Hkv * hd).T
+        )
+        sd[p + "self_attn.v_proj.weight"] = (
+            np.asarray(lyr["v"]["kernel"]).reshape(D, Hkv * hd).T
+        )
+        sd[p + "self_attn.o_proj.weight"] = (
+            np.asarray(lyr["o"]["kernel"]).reshape(H * hd, D).T
+        )
+        sd[p + "post_attention_layernorm.weight"] = np.asarray(
+            lyr["mlp_norm"]["scale"]
+        )
+        ffn_fn(sd, p, lyr)
+    return sd
+
+
+def load_llama_weights(sd: StateDict, cfg) -> Dict:
+    """HF ``LlamaForCausalLM`` state_dict -> params for
+    :class:`~pytorch_distributed_tpu.models.llama.LlamaForCausalLM`."""
+    return _llama_body_import(
+        sd, cfg,
+        lambda p: {
+            "gate": {"kernel": _np(sd, p + "mlp.gate_proj.weight").T},
+            "up": {"kernel": _np(sd, p + "mlp.up_proj.weight").T},
+            "down": {"kernel": _np(sd, p + "mlp.down_proj.weight").T},
+        },
+    )
 
 
 def _unstack(params, cfg, container: str, unroll_prefix: str):
@@ -273,37 +321,76 @@ def export_gpt2_weights(params, cfg) -> Dict[str, Array]:
 
 def export_llama_weights(params, cfg) -> Dict[str, Array]:
     """Our LlamaForCausalLM params -> HF ``LlamaForCausalLM`` state_dict."""
-    H, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.hidden_size
-    hd = cfg.head_dim
-    sd = {
-        "model.embed_tokens.weight": np.asarray(params["embed"]["embedding"]),
-        "model.norm.weight": np.asarray(params["final_norm"]["scale"]),
-        "lm_head.weight": np.asarray(params["lm_head"]["kernel"]).T,
-    }
-    for i, lyr in enumerate(_unstack(params, cfg, "layers", "layer")):
-        p = f"model.layers.{i}."
-        sd[p + "input_layernorm.weight"] = np.asarray(
-            lyr["attn_norm"]["scale"]
-        )
-        sd[p + "self_attn.q_proj.weight"] = (
-            np.asarray(lyr["q"]["kernel"]).reshape(D, H * hd).T
-        )
-        sd[p + "self_attn.k_proj.weight"] = (
-            np.asarray(lyr["k"]["kernel"]).reshape(D, Hkv * hd).T
-        )
-        sd[p + "self_attn.v_proj.weight"] = (
-            np.asarray(lyr["v"]["kernel"]).reshape(D, Hkv * hd).T
-        )
-        sd[p + "self_attn.o_proj.weight"] = (
-            np.asarray(lyr["o"]["kernel"]).reshape(H * hd, D).T
-        )
-        sd[p + "post_attention_layernorm.weight"] = np.asarray(
-            lyr["mlp_norm"]["scale"]
-        )
+
+    def ffn(sd, p, lyr):
         sd[p + "mlp.gate_proj.weight"] = np.asarray(lyr["gate"]["kernel"]).T
         sd[p + "mlp.up_proj.weight"] = np.asarray(lyr["up"]["kernel"]).T
         sd[p + "mlp.down_proj.weight"] = np.asarray(lyr["down"]["kernel"]).T
-    return sd
+
+    return _llama_body_export(params, cfg, ffn)
+
+
+# --------------------------------------------------------------------------
+# Mixtral (sparse-MoE decoder; attention layout shared with Llama)
+# --------------------------------------------------------------------------
+
+def load_mixtral_weights(sd: StateDict, cfg) -> Dict:
+    """HF ``MixtralForCausalLM`` state_dict -> params for
+    :class:`~pytorch_distributed_tpu.models.mixtral.MixtralForCausalLM`.
+
+    The Llama body mapping is shared (:func:`_llama_body_import` — the
+    interop mirror of the model's ``block_cls`` hook); the sparse FFN
+    maps HF's per-expert ``w1/w3/w2`` Linears onto the stacked expert
+    tensors ``w_gate/w_in/w_out`` ([E, D, F] / [E, F, D] — transposed
+    from torch's [out, in] and stacked over the expert dim), and the
+    router ``gate`` Linear onto ``moe/router/kernel``.
+    """
+    E = cfg.num_experts
+
+    def ffn(p):
+        moe = p + "block_sparse_moe."
+        return {
+            "moe": {
+                "router": {"kernel": _np(sd, moe + "gate.weight").T},
+                "w_gate": np.stack([
+                    _np(sd, moe + f"experts.{e}.w1.weight").T
+                    for e in range(E)
+                ]),
+                "w_out": np.stack([
+                    _np(sd, moe + f"experts.{e}.w2.weight").T
+                    for e in range(E)
+                ]),
+                "w_in": np.stack([
+                    _np(sd, moe + f"experts.{e}.w3.weight").T
+                    for e in range(E)
+                ]),
+            },
+        }
+
+    return _llama_body_import(sd, cfg, ffn)
+
+
+def export_mixtral_weights(params, cfg) -> Dict[str, Array]:
+    """Our MixtralForCausalLM params -> HF ``MixtralForCausalLM``
+    state_dict (inverse of :func:`load_mixtral_weights`)."""
+
+    def ffn(sd, p, lyr):
+        moe = p + "block_sparse_moe."
+        sd[moe + "gate.weight"] = np.asarray(
+            lyr["moe"]["router"]["kernel"]
+        ).T
+        for e in range(cfg.num_experts):
+            sd[moe + f"experts.{e}.w1.weight"] = np.asarray(
+                lyr["moe"]["w_gate"][e]
+            ).T
+            sd[moe + f"experts.{e}.w2.weight"] = np.asarray(
+                lyr["moe"]["w_out"][e]
+            ).T
+            sd[moe + f"experts.{e}.w3.weight"] = np.asarray(
+                lyr["moe"]["w_in"][e]
+            ).T
+
+    return _llama_body_export(params, cfg, ffn)
 
 
 # --------------------------------------------------------------------------

@@ -42,6 +42,11 @@ from pytorch_distributed_tpu.models.llama import (
     LlamaForCausalLM,
     llama_partition_rules,
 )
+from pytorch_distributed_tpu.models.mixtral import (
+    MixtralConfig,
+    MixtralForCausalLM,
+    mixtral_partition_rules,
+)
 
 __all__ = [
     "ResNet",
@@ -61,6 +66,9 @@ __all__ = [
     "gpt2_partition_rules",
     "LlamaConfig",
     "LlamaForCausalLM",
+    "MixtralConfig",
+    "MixtralForCausalLM",
+    "mixtral_partition_rules",
     "llama_partition_rules",
     "T5Config",
     "T5ForConditionalGeneration",

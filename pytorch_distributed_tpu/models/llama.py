@@ -119,17 +119,24 @@ class LlamaBlock(nn.Module):
         x = x + attn
 
         h = RMSNorm(cfg.rms_eps, name="mlp_norm")(x)
+        return x + self._ffn(h, dense)
+
+    def _ffn(self, h, dense):
+        """SwiGLU MLP — the one piece variant decoders override (the
+        Mixtral family swaps in a sparse-MoE expert layer)."""
+        cfg = self.config
         gate = dense(cfg.intermediate_size, "gate")(h)
         up = dense(cfg.intermediate_size, "up")(h)
-        h = nn.silu(gate) * up
-        h = dense(cfg.hidden_size, "down")(h)
-        return x + h
+        return dense(cfg.hidden_size, "down")(nn.silu(gate) * up)
 
 
 class LlamaForCausalLM(nn.Module):
     """Returns [B, S, vocab] logits. Untied LM head (Llama-3 layout)."""
 
     config: LlamaConfig
+    # subclasses (models/mixtral.py) swap the block while inheriting the
+    # embed/RoPE/scan/decode/LM-head machinery unchanged
+    block_cls = LlamaBlock
 
     @nn.compact
     def __call__(
@@ -178,16 +185,17 @@ class LlamaForCausalLM(nn.Module):
                 "kv_mask is for KV-cache decode (left-padded prompts); "
                 "training masks go through the loss/segment machinery"
             )
+        block_cls = type(self).block_cls
         if cfg.scan_layers:
             from pytorch_distributed_tpu.models.scan import scan_stack
 
             x = scan_stack(
-                LlamaBlock, cfg, static_argnums=(6, 7, 8), name="layers"
+                block_cls, cfg, static_argnums=(6, 7, 8), name="layers"
             )(x, cos, sin, positions, segment_ids, kv_mask, not train,
               decode, cache_len)
         else:
             for i in range(cfg.num_layers):
-                x = LlamaBlock(cfg, name=f"layer{i}")(
+                x = block_cls(cfg, name=f"layer{i}")(
                     x, cos, sin, positions, segment_ids, kv_mask,
                     deterministic=not train,
                     decode=decode, cache_len=cache_len,

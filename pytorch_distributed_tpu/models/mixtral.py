@@ -1,0 +1,115 @@
+"""Mixtral — sparse-MoE decoder LM (Jiang et al. 2024), beyond-reference.
+
+Not in the blueprint (SURVEY.md §2: DDP/ZeRO-1/FSDP recipes only); built
+as the model family that exercises expert parallelism end-to-end: a
+Llama-3 body (RMSNorm, RoPE, GQA — inherited wholesale from
+``models/llama.py``) whose FFN is the expert-parallel ``ops.moe.MoEMLP``
+with Mixtral's per-expert SwiGLU (``w2(silu(w1 x) * w3 x)``) and top-2
+renormalized routing. Faithful to HF ``MixtralForCausalLM`` semantics so
+interop can pin logits (``interop.load_mixtral_weights``):
+
+* router logits and gating in f32, selected gates renormalized to sum 1
+  (HF ``norm_topk_prob`` behavior);
+* ``capacity_factor=None`` (the default) makes dispatch DROP-FREE —
+  HF computes every selected expert exactly, so parity requires no
+  capacity dropping. Training recipes can set a finite factor for the
+  Switch-style bounded-compute dispatch; the Switch load-balance aux
+  loss is sown per layer either way
+  (``train.causal_lm_loss_fn(moe_aux_weight=...)`` collects it through
+  the scan).
+
+Everything else — scan-over-layers, KV-cache decode (``ptd.generate``
+works unchanged), remat, chunked-vocab loss via ``return_hidden``,
+FSDP/TP sharding — is inherited from the Llama machinery through the
+``block_cls`` hook; the only new sharding surface is the expert axis
+(``mixtral_partition_rules``: experts over ``ep``, expert-FFN hidden
+over ``tp``, composing with the attention TP rules).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from pytorch_distributed_tpu.models.llama import (
+    LlamaBlock,
+    LlamaConfig,
+    LlamaForCausalLM,
+    llama_partition_rules,
+)
+from pytorch_distributed_tpu.ops.moe import MoEMLP
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig(LlamaConfig):
+    # Mixtral-8x7B geometry (vocab/theta differ from Llama-3)
+    vocab_size: int = 32_000
+    hidden_size: int = 4_096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    intermediate_size: int = 14_336
+    max_seq_len: int = 32_768
+    rope_theta: float = 1_000_000.0
+    num_experts: int = 8
+    top_k: int = 2
+    # None = drop-free dispatch (HF-exact, serving); finite = Switch
+    # bounded-capacity dispatch for training throughput
+    capacity_factor: Optional[float] = None
+
+    @classmethod
+    def mixtral_8x7b(cls) -> "MixtralConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "MixtralConfig":
+        return cls(
+            vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+            num_kv_heads=2, intermediate_size=96, max_seq_len=128,
+            num_experts=4, top_k=2,
+        )
+
+
+class MixtralBlock(LlamaBlock):
+    """Llama block with the dense SwiGLU MLP swapped for sparse MoE."""
+
+    config: MixtralConfig
+
+    def _ffn(self, h, dense):
+        cfg = self.config
+        return MoEMLP(
+            num_experts=cfg.num_experts,
+            d_ff=cfg.intermediate_size,
+            k=cfg.top_k,
+            capacity_factor=cfg.capacity_factor,
+            activation="swiglu",
+            name="moe",
+        )(h)
+
+
+class MixtralForCausalLM(LlamaForCausalLM):
+    """Returns [B, S, vocab] logits; ``ptd.generate`` works unchanged.
+
+    Training with the load-balance aux loss:
+    ``train.causal_lm_loss_fn(model, moe_aux_weight=0.01)`` — the loss
+    machinery already opens the ``intermediates`` collection and sums
+    the per-layer sown terms (``ops.moe.collect_aux_loss``).
+    """
+
+    config: MixtralConfig
+    block_cls = MixtralBlock
+
+
+def mixtral_partition_rules(ep_axis: str = "ep", tp_axis: str = "tp"):
+    """Attention/embed/head rules from Llama + the expert tensors from
+    ``ops.moe.moe_partition_rules`` (experts over ``ep``, each expert's
+    FFN hidden over ``tp``, router replicated) — derived, not re-listed,
+    so a new MoE param cannot be sharded in one place and missed in the
+    other; ``stacked()`` prepends the scan-layer axis."""
+    from pytorch_distributed_tpu.ops.moe import moe_partition_rules
+    from pytorch_distributed_tpu.parallel.sharding import stacked
+
+    return llama_partition_rules() + [
+        (rf"/moe/{name}", stacked(spec))
+        for name, spec in moe_partition_rules(ep_axis, tp_axis)
+    ]

@@ -17,6 +17,8 @@ The Switch load-balance auxiliary loss is exposed via ``sow`` under
 
 from __future__ import annotations
 
+from typing import Optional
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -25,21 +27,41 @@ from pytorch_distributed_tpu.runtime.precision import current_policy
 
 
 class MoEMLP(nn.Module):
-    """Drop-in replacement for a transformer FFN block."""
+    """Drop-in replacement for a transformer FFN block.
+
+    ``activation="gelu"`` is the Switch-Transformer two-matrix expert;
+    ``"swiglu"`` adds a per-expert gate matrix (``w2(silu(w1 x)*w3 x)``,
+    the Mixtral expert — w_gate/w_in/w_out here map to HF's w1/w3/w2).
+
+    ``capacity_factor=None`` disables token dropping entirely — the
+    serving/HF-parity mode: every token runs through every expert and
+    the non-selected outputs are zeroed by the gate combine (linear in
+    tokens; costs E/k × the routed FLOPs, the static-shape price of
+    exactness). HF Mixtral computes every selected expert exactly, so
+    parity needs this. Finite factors use the Switch bounded-capacity
+    dispatch (overflow tokens dropped to the residual path) — the
+    training-throughput mode. The param tree is identical either way,
+    so one checkpoint serves both.
+    """
 
     num_experts: int
     d_ff: int
     k: int = 2
-    capacity_factor: float = 1.25
+    capacity_factor: Optional[float] = 1.25
+    activation: str = "gelu"  # gelu | swiglu
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        if self.activation not in ("gelu", "swiglu"):
+            raise ValueError(
+                f"activation must be 'gelu' or 'swiglu', got "
+                f"{self.activation!r}"
+            )
         policy = current_policy()
         *batch_dims, D = x.shape
         E, F, K = self.num_experts, self.d_ff, self.k
         tokens = x.reshape(-1, D)
         T = tokens.shape[0]
-        C = max(1, int(K * T * self.capacity_factor / E + 0.999))
 
         # ---- router (f32: tiny, and gate precision matters) -------------
         logits = nn.Dense(
@@ -52,45 +74,87 @@ class MoEMLP(nn.Module):
         gate_vals = gate_vals / jnp.clip(
             jnp.sum(gate_vals, -1, keepdims=True), 1e-9
         )
-
-        # ---- capacity assignment ---------------------------------------
         # one-hot over experts per (token, k): [T, K, E]
         sel = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)
-        # position of each (t, k) within its expert's queue, k-major so
-        # primary assignments win capacity over secondary ones
-        flat_sel = sel.transpose(1, 0, 2).reshape(K * T, E)  # k-major
-        pos_flat = jnp.cumsum(flat_sel, axis=0) - 1.0  # [K*T, E]
-        pos = pos_flat.reshape(K, T, E).transpose(1, 0, 2)  # [T, K, E]
-        in_cap = (pos < C).astype(jnp.float32)
-        kept = sel * in_cap  # [T, K, E]
-        slot = jax.nn.one_hot(
-            jnp.sum(pos * sel, -1).astype(jnp.int32), C, dtype=jnp.float32
-        )  # [T, K, C]
-        # dispatch: does token t occupy (expert e, slot c)?  [T, E, C]
-        dispatch = jnp.einsum("tke,tkc->tec", kept, slot)
-        combine = jnp.einsum(
-            "tke,tkc,tk->tec", kept, slot, gate_vals.astype(jnp.float32)
-        )
 
-        # ---- expert computation (stacked, expert dim shardable) ---------
+        # ---- expert params: ONE tree for both dispatch modes, so a
+        # model trained with a finite capacity_factor serves drop-free
+        # from the same checkpoint ---------------------------------------
         w_in = self.param(
             "w_in", nn.initializers.lecun_normal(), (E, D, F),
             policy.param_dtype,
         )
+        if self.activation == "swiglu":
+            w_gate = self.param(
+                "w_gate", nn.initializers.lecun_normal(), (E, D, F),
+                policy.param_dtype,
+            )
         w_out = self.param(
             "w_out", nn.initializers.lecun_normal(), (E, F, D),
             policy.param_dtype,
         )
         ctype = policy.compute_dtype
-        expert_in = jnp.einsum(
-            "tec,td->ecd", dispatch.astype(ctype), tokens.astype(ctype)
-        )
-        h = jnp.einsum("ecd,edf->ecf", expert_in, w_in.astype(ctype))
-        h = nn.gelu(h)
-        expert_out = jnp.einsum("ecf,efd->ecd", h, w_out.astype(ctype))
-        y = jnp.einsum(
-            "tec,ecd->td", combine.astype(ctype), expert_out
-        )
+
+        if self.capacity_factor is None:
+            # ---- exact drop-free: every token through every expert,
+            # combined with the renormalized top-k gates (zero outside
+            # the selection). LINEAR in T — a capacity-style dispatch
+            # with C=T would build [T, E, T] tensors and pay O(T^2·E·D)
+            # in the dispatch einsums alone. The price here is E/K x the
+            # routed expert FLOPs: the honest cost of exactness under
+            # static shapes (HF gets the same result with
+            # data-dependent gathers jit cannot trace).
+            gate_dense = jnp.einsum("tke,tk->te", sel, gate_vals)  # [T,E]
+            h = jnp.einsum(
+                "td,edf->tef", tokens.astype(ctype), w_in.astype(ctype)
+            )
+            if self.activation == "swiglu":
+                g = jnp.einsum(
+                    "td,edf->tef", tokens.astype(ctype),
+                    w_gate.astype(ctype),
+                )
+                h = nn.silu(g) * h
+            else:
+                h = nn.gelu(h)
+            y = jnp.einsum(
+                "tef,efd,te->td", h, w_out.astype(ctype),
+                gate_dense.astype(ctype),
+            )
+        else:
+            # ---- Switch-style bounded-capacity dispatch (training):
+            # per-expert queue C, overflow dropped to the residual path
+            C = max(1, int(K * T * self.capacity_factor / E + 0.999))
+            # position of each (t, k) within its expert's queue, k-major
+            # so primary assignments win capacity over secondary ones
+            flat_sel = sel.transpose(1, 0, 2).reshape(K * T, E)  # k-major
+            pos_flat = jnp.cumsum(flat_sel, axis=0) - 1.0  # [K*T, E]
+            pos = pos_flat.reshape(K, T, E).transpose(1, 0, 2)  # [T, K, E]
+            in_cap = (pos < C).astype(jnp.float32)
+            kept = sel * in_cap  # [T, K, E]
+            slot = jax.nn.one_hot(
+                jnp.sum(pos * sel, -1).astype(jnp.int32), C,
+                dtype=jnp.float32,
+            )  # [T, K, C]
+            # dispatch: does token t occupy (expert e, slot c)? [T, E, C]
+            dispatch = jnp.einsum("tke,tkc->tec", kept, slot)
+            combine = jnp.einsum(
+                "tke,tkc,tk->tec", kept, slot, gate_vals.astype(jnp.float32)
+            )
+            expert_in = jnp.einsum(
+                "tec,td->ecd", dispatch.astype(ctype), tokens.astype(ctype)
+            )
+            h = jnp.einsum("ecd,edf->ecf", expert_in, w_in.astype(ctype))
+            if self.activation == "swiglu":
+                g = jnp.einsum(
+                    "ecd,edf->ecf", expert_in, w_gate.astype(ctype)
+                )
+                h = nn.silu(g) * h
+            else:
+                h = nn.gelu(h)
+            expert_out = jnp.einsum("ecf,efd->ecd", h, w_out.astype(ctype))
+            y = jnp.einsum(
+                "tec,ecd->td", combine.astype(ctype), expert_out
+            )
 
         # ---- Switch load-balance aux loss ------------------------------
         # fraction of tokens routed to e (primary assignment) x mean router
@@ -113,6 +177,7 @@ def moe_partition_rules(ep_axis: str = "ep", tp_axis: str = "tp"):
     return [
         ("router/kernel", P(None, None)),
         ("w_in", P(ep_axis, None, tp_axis)),
+        ("w_gate", P(ep_axis, None, tp_axis)),
         ("w_out", P(ep_axis, tp_axis, None)),
     ]
 

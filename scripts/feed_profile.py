@@ -150,10 +150,11 @@ def main():
         f"  assemble+put serial bound       {ser * 1e3:8.2f} ms/batch  "
         f"{B / ser:8.0f} img/s"
     )
-    overlap = (t_asm + t_put - t_copy) / (t_loader_img * B)
+    ratio = (t_asm + t_put - t_copy) / (t_loader_img * B)
     log(
-        f"loader/(assemble+put) = {overlap:.2f} "
-        f"(1.0 = no overlap possible on 1 core; <1 = loader overhead)"
+        f"(assemble+put)/loader = {ratio:.2f} "
+        f"(>1 = loader beats the serial sum, cache warmth; "
+        f"<1 = loader overhead on top of the stages)"
     )
 
 

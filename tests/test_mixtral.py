@@ -1,0 +1,242 @@
+"""Mixtral sparse-MoE decoder: HF parity, expert math, decode, sharding.
+
+Correctness pins, strongest first:
+
+* HF ``MixtralForCausalLM`` logit parity through converted weights
+  (scan and unrolled layouts) — routing renormalization, per-expert
+  SwiGLU, and the drop-free dispatch all have to be exact;
+* export -> HF load -> logits match (the mapping is invertible);
+* drop-free MoE output == a per-token dense reference computed straight
+  from the params (dispatch/combine einsums pinned independently of HF);
+* KV-cache greedy decode == full-recompute argmax;
+* the load-balance aux loss flows gradients into the router through the
+  scanned stack.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import pytorch_distributed_tpu as ptd
+from pytorch_distributed_tpu.models import (
+    MixtralConfig,
+    MixtralForCausalLM,
+    mixtral_partition_rules,
+)
+from pytorch_distributed_tpu.runtime.mesh import MeshSpec
+from pytorch_distributed_tpu.runtime.precision import autocast
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _sd(model):
+    return {k: v.detach().numpy() for k, v in model.state_dict().items()}
+
+
+def _pair(scan_layers: bool):
+    torch.manual_seed(0)
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=211, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2, rope_theta=1e6,
+        rms_norm_eps=1e-5, max_position_embeddings=128,
+    )
+    hf = transformers.MixtralForCausalLM(hf_cfg).eval()
+    cfg = MixtralConfig(
+        vocab_size=211, hidden_size=48, intermediate_size=96, num_layers=2,
+        num_heads=4, num_kv_heads=2, num_experts=4, top_k=2,
+        max_seq_len=128, rope_theta=1e6, rms_eps=1e-5,
+        scan_layers=scan_layers,
+    )
+    return hf, cfg
+
+
+def _logits_match(hf, cfg, atol=3e-4):
+    from pytorch_distributed_tpu.interop import load_mixtral_weights
+
+    params = load_mixtral_weights(_sd(hf), cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(2, 211, size=(2, 11)).astype(np.int32)
+    with torch.no_grad():
+        want = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    with autocast(enabled=False):
+        got = MixtralForCausalLM(cfg).apply(
+            {"params": params}, jnp.asarray(ids)
+        )
+    np.testing.assert_allclose(np.asarray(got), want, atol=atol, rtol=2e-4)
+    return params
+
+
+def test_mixtral_logits_match_hf_scan():
+    hf, cfg = _pair(scan_layers=True)
+    _logits_match(hf, cfg)
+
+
+def test_mixtral_logits_match_hf_unrolled():
+    hf, cfg = _pair(scan_layers=False)
+    _logits_match(hf, cfg)
+
+
+def test_mixtral_export_roundtrips_into_hf():
+    from pytorch_distributed_tpu.interop import (
+        export_mixtral_weights,
+        load_mixtral_weights,
+    )
+
+    hf, cfg = _pair(scan_layers=True)
+    params = load_mixtral_weights(_sd(hf), cfg)
+    sd = export_mixtral_weights(params, cfg)
+    hf2 = transformers.MixtralForCausalLM(hf.config).eval()
+    hf2.load_state_dict({k: torch.tensor(v) for k, v in sd.items()})
+    ids = torch.tensor(
+        np.random.default_rng(1).integers(2, 211, size=(1, 9)).astype(
+            np.int64
+        )
+    )
+    with torch.no_grad():
+        np.testing.assert_allclose(
+            hf2(ids).logits.numpy(), hf(ids).logits.numpy(),
+            atol=1e-5, rtol=1e-5,
+        )
+
+
+def test_moe_dropfree_swiglu_matches_dense_reference():
+    """Drop-free top-k dispatch == per-token dense computation straight
+    from the params: y_t = sum_k gate_k * w_out[e_k]^T(silu(w_gate[e_k]
+    x_t) * w_in[e_k] x_t), gates renormalized over the selected k.
+    Pins the one-hot dispatch/combine einsums and the SwiGLU expert
+    independently of HF."""
+    from pytorch_distributed_tpu.ops.moe import MoEMLP
+
+    D, F, E, K, T = 16, 24, 4, 2, 10
+    m = MoEMLP(
+        num_experts=E, d_ff=F, k=K, capacity_factor=None,
+        activation="swiglu",
+    )
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(T, D)), jnp.float32
+    )
+    with autocast(enabled=False):  # f32 compute to match the reference
+        params = m.init(jax.random.key(0), x)["params"]
+        got = np.asarray(m.apply({"params": params}, x))
+
+    router = np.asarray(params["router"]["kernel"])  # [D, E]
+    w_in = np.asarray(params["w_in"])  # [E, D, F]
+    w_gate = np.asarray(params["w_gate"])
+    w_out = np.asarray(params["w_out"])  # [E, F, D]
+    xs = np.asarray(x)
+
+    def silu(a):
+        return a / (1.0 + np.exp(-a))
+
+    probs = np.exp(xs @ router)
+    probs = probs / probs.sum(-1, keepdims=True)
+    want = np.zeros((T, D), np.float32)
+    for t in range(T):
+        top = np.argsort(-probs[t])[:K]
+        gates = probs[t][top]
+        gates = gates / gates.sum()
+        for g, e in zip(gates, top):
+            h = silu(xs[t] @ w_gate[e]) * (xs[t] @ w_in[e])
+            want[t] += g * (h @ w_out[e])
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-4)
+
+
+def test_mixtral_cache_decode_equals_recompute():
+    cfg = MixtralConfig.tiny()
+    model = MixtralForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(2, 500, size=(2, 6)), jnp.int32)
+    params = model.init(jax.random.key(0), ids)["params"]
+    got = ptd.generate(model, params, ids, max_new_tokens=8, temperature=0.0)
+    # full-recompute greedy reference
+    seq = np.asarray(ids)
+    for _ in range(8):
+        logits = model.apply({"params": params}, jnp.asarray(seq))
+        nxt = np.asarray(jnp.argmax(logits[:, -1], -1))
+        seq = np.concatenate([seq, nxt[:, None].astype(seq.dtype)], 1)
+    np.testing.assert_array_equal(np.asarray(got), seq)  # prompt + new
+
+
+def test_mixtral_aux_loss_trains_router():
+    """causal_lm_loss_fn(moe_aux_weight=...) must flow gradients into
+    BOTH the experts and the router through the scanned stack (the
+    router only gets gradient via the gate values / aux loss)."""
+    import optax
+
+    from pytorch_distributed_tpu.train import causal_lm_loss_fn
+
+    cfg = MixtralConfig.tiny()
+    model = MixtralForCausalLM(cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(2, 500, size=(2, 12)), jnp.int32
+    )
+    params = model.init(jax.random.key(0), ids)["params"]
+    loss_fn = causal_lm_loss_fn(model, moe_aux_weight=0.01)
+    (loss, out), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        params, {}, {"input_ids": ids}, jax.random.key(1)
+    )
+    assert np.isfinite(float(loss))
+    assert float(out["metrics"]["moe_aux_loss"]) > 0.0
+    block = grads["layers"]["block"]
+    g_router = np.asarray(block["moe"]["router"]["kernel"])
+    g_expert = np.asarray(block["moe"]["w_gate"])
+    assert np.abs(g_router).max() > 0.0
+    assert np.abs(g_expert).max() > 0.0
+    # and a step applies cleanly
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+    updates, _ = tx.update(grads, opt_state, params)
+    optax.apply_updates(params, updates)
+
+
+def test_mixtral_generate_with_ep_tp_sharded_params():
+    """Expert-parallel serving: params sharded by mixtral_partition_rules
+    (experts over ep, expert hidden over tp) decode token-identically
+    through the same generate call."""
+    import optax
+
+    from pytorch_distributed_tpu.parallel import DataParallel
+    from pytorch_distributed_tpu.train import TrainState
+
+    ptd.init_process_group(mesh_spec=MeshSpec(dp=2, ep=2, tp=2))
+    cfg = MixtralConfig.tiny()
+    model = MixtralForCausalLM(cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(2, 500, size=(2, 5)), jnp.int32
+    )
+    params = model.init(jax.random.key(0), ids)["params"]
+    want = ptd.generate(model, params, ids, max_new_tokens=6, temperature=0.0)
+    strategy = DataParallel(extra_rules=mixtral_partition_rules())
+    state = strategy.place(TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.sgd(0.1)
+    ))
+    w_in = state.params["layers"]["block"]["moe"]["w_in"]
+    spec = str(w_in.sharding.spec)
+    assert "ep" in spec and "tp" in spec  # experts really shard
+    got = ptd.generate(
+        model, state.params, ids, max_new_tokens=6, temperature=0.0
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.slow
+def test_mixtral_recipe_smoke():
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "recipes")
+    )
+    import mixtral_moe
+
+    state = mixtral_moe.main(
+        [
+            "--epochs", "1", "--steps-per-epoch", "2", "--batch-size", "8",
+            "--seq-len", "8", "--eval-rows", "8", "--log-every", "1",
+        ]
+    )
+    assert int(state.step) == 2
