@@ -4,16 +4,19 @@ A user of the reference switches frameworks with trained torch weights in
 hand; these converters map Hugging Face ``state_dict`` layouts onto this
 framework's parameter trees so those weights keep working:
 
-* :func:`load_gpt2_weights`  — ``transformers.GPT2LMHeadModel``
-* :func:`load_llama_weights` — ``transformers.LlamaForCausalLM``
+* :func:`load_gpt2_weights`    — ``transformers.GPT2LMHeadModel``
+* :func:`load_llama_weights`   — ``transformers.LlamaForCausalLM``
+* :func:`load_mistral_weights` — ``transformers.MistralForCausalLM``
+  (the Llama mapping verbatim; the sliding window is config)
+* :func:`load_mixtral_weights` — ``transformers.MixtralForCausalLM``
+  (Llama body + per-expert w1/w3/w2 onto stacked expert tensors)
 * :func:`load_bert_weights`  — ``transformers.BertModel`` /
   ``BertForSequenceClassification`` / ``BertForMaskedLM`` (tied decoder)
 * :func:`load_vit_weights`   — ``transformers.ViTForImageClassification``
+* :func:`load_t5_weights`    — ``transformers.T5ForConditionalGeneration``
 
-and the inverse direction (:func:`export_gpt2_weights`,
-:func:`export_llama_weights`, :func:`export_bert_weights`,
-:func:`export_vit_weights`) so models trained here can be evaluated or
-served by the torch ecosystem.
+and the inverse direction (``export_*`` for every family) so models
+trained here can be evaluated or served by the torch ecosystem.
 
 Orientation notes (the whole difficulty lives here):
 
@@ -328,6 +331,13 @@ def export_llama_weights(params, cfg) -> Dict[str, Array]:
         sd[p + "mlp.down_proj.weight"] = np.asarray(lyr["down"]["kernel"]).T
 
     return _llama_body_export(params, cfg, ffn)
+
+
+# Mistral shares Llama's state_dict layout EXACTLY (same module names,
+# same shapes) — the sliding window is config, not weights — so the
+# mappings are the Llama ones, aliased for discoverability.
+load_mistral_weights = load_llama_weights
+export_mistral_weights = export_llama_weights
 
 
 # --------------------------------------------------------------------------

@@ -40,7 +40,13 @@ from pytorch_distributed_tpu.models.t5 import (
 from pytorch_distributed_tpu.models.llama import (
     LlamaConfig,
     LlamaForCausalLM,
+    RopeScaling,
     llama_partition_rules,
+)
+from pytorch_distributed_tpu.models.mistral import (
+    MistralConfig,
+    MistralForCausalLM,
+    mistral_partition_rules,
 )
 from pytorch_distributed_tpu.models.mixtral import (
     MixtralConfig,
@@ -66,6 +72,10 @@ __all__ = [
     "gpt2_partition_rules",
     "LlamaConfig",
     "LlamaForCausalLM",
+    "RopeScaling",
+    "MistralConfig",
+    "MistralForCausalLM",
+    "mistral_partition_rules",
     "MixtralConfig",
     "MixtralForCausalLM",
     "mixtral_partition_rules",

@@ -26,6 +26,20 @@ from pytorch_distributed_tpu.runtime.precision import current_policy
 
 
 @dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Context-window extension for RoPE (ops/attention.py
+    ``rope_frequencies``). ``type``: "linear" (position interpolation)
+    or "llama3" (HF Llama-3.1 frequency-dependent scheme). Frozen so
+    configs stay hashable."""
+
+    type: str = "llama3"
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8_192
+
+
+@dataclasses.dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 128_256
     hidden_size: int = 4_096
@@ -36,6 +50,11 @@ class LlamaConfig:
     max_seq_len: int = 8_192
     rope_theta: float = 500_000.0
     rms_eps: float = 1e-5
+    # sliding-window (Mistral) attention: position i sees keys in
+    # (i - window, i] only; None = full causal (Llama)
+    sliding_window: Optional[int] = None
+    # context-window extension (Llama-3.1 long context): None = plain RoPE
+    rope_scaling: Optional[RopeScaling] = None
     # scan over layers (models/scan.py): one compiled block, [L, ...]
     # stacked params. False restores the unrolled per-layer tree.
     scan_layers: bool = True
@@ -60,6 +79,20 @@ class LlamaConfig:
     @classmethod
     def llama3_8b(cls) -> "LlamaConfig":
         return cls()
+
+    @classmethod
+    def llama3_1_8b(cls) -> "LlamaConfig":
+        """Llama-3.1-8B: the 3.0 geometry + llama3 rope scaling to 128k.
+        Serve long contexts with an explicit ``cache_len`` — a
+        max_seq_len-sized KV cache is ~16 GB at 128k."""
+        return cls(
+            max_seq_len=131_072,
+            rope_scaling=RopeScaling(
+                type="llama3", factor=8.0, low_freq_factor=1.0,
+                high_freq_factor=4.0,
+                original_max_position_embeddings=8_192,
+            ),
+        )
 
     @classmethod
     def tiny(cls) -> "LlamaConfig":
@@ -109,11 +142,13 @@ class LlamaBlock(nn.Module):
                 self, k, v, cache_len or cfg.max_seq_len
             )
             attn = attention(
-                q, k, v, causal=True, q_offset=offset, mask=kv_mask
+                q, k, v, causal=True, q_offset=offset, mask=kv_mask,
+                window=cfg.sliding_window,
             )
         else:
             attn = attention(
-                q, k, v, causal=True, segment_ids=segment_ids
+                q, k, v, causal=True, segment_ids=segment_ids,
+                window=cfg.sliding_window,
             )
         attn = dense(cfg.hidden_size, "o", axis=(-2, -1))(attn)
         x = x + attn
@@ -162,7 +197,10 @@ class LlamaForCausalLM(nn.Module):
             cfg.vocab_size, cfg.hidden_size, param_dtype=policy.param_dtype,
             name="embed",
         )(input_ids).astype(policy.compute_dtype)
-        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        cos, sin = rope_frequencies(
+            cfg.head_dim, cfg.max_seq_len, cfg.rope_theta,
+            scaling=cfg.rope_scaling,
+        )
         if decode:
             from pytorch_distributed_tpu.ops.attention import decode_positions
 

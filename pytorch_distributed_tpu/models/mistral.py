@@ -1,0 +1,57 @@
+"""Mistral-7B — Llama body + sliding-window attention, beyond-reference.
+
+Architecturally Mistral IS the Llama decoder (RMSNorm, RoPE, GQA,
+SwiGLU) with one semantic change: sliding-window attention — position
+``i`` attends only to keys in ``(i - window, i]`` (Jiang et al. 2023).
+The window lives in the shared attention op (``attention(window=)``,
+a band mask composed with causal, valid under KV-cache decode), so this
+module is exactly a config: the block, decode path, sharding rules, and
+HF weight layout are Llama's, and ``interop.load_mistral_weights`` /
+``export_mistral_weights`` are the Llama mappings verbatim (HF Mistral
+state_dicts use identical names).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from pytorch_distributed_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    llama_partition_rules,
+)
+
+mistral_partition_rules = llama_partition_rules
+
+
+@dataclasses.dataclass(frozen=True)
+class MistralConfig(LlamaConfig):
+    # Mistral-7B-v0.1 geometry
+    vocab_size: int = 32_000
+    hidden_size: int = 4_096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    intermediate_size: int = 14_336
+    max_seq_len: int = 32_768
+    rope_theta: float = 10_000.0
+    sliding_window: Optional[int] = 4_096
+
+    @classmethod
+    def mistral_7b(cls) -> "MistralConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "MistralConfig":
+        return cls(
+            vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+            num_kv_heads=2, intermediate_size=128, max_seq_len=128,
+            sliding_window=8,
+        )
+
+
+class MistralForCausalLM(LlamaForCausalLM):
+    """Llama machinery end to end; the config's window does the work."""
+
+    config: MistralConfig

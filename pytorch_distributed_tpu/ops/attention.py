@@ -22,11 +22,51 @@ import jax.numpy as jnp
 
 
 def rope_frequencies(
-    head_dim: int, max_seq_len: int, theta: float = 10_000.0
+    head_dim: int, max_seq_len: int, theta: float = 10_000.0,
+    scaling=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(cos, sin) tables of shape [max_seq_len, head_dim//2], f32."""
+    """(cos, sin) tables of shape [max_seq_len, head_dim//2], f32.
+
+    ``scaling`` (a ``models.llama.RopeScaling`` or None) extends a
+    pretrained context window:
+
+    * ``"linear"`` — position-interpolation (Chen et al. 2023):
+      positions divided by ``factor``;
+    * ``"llama3"`` — HF's Llama-3.1 frequency-dependent scheme:
+      wavelengths longer than ``original_max_position_embeddings /
+      low_freq_factor`` are slowed by ``factor``, wavelengths shorter
+      than ``original / high_freq_factor`` kept, the band between
+      smoothly interpolated. Matches HF ``_compute_llama3_parameters``
+      so converted Llama-3.1 checkpoints score identically.
+    """
     inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
     t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    if scaling is not None:
+        kind = scaling.type
+        if kind == "linear":
+            t = t / scaling.factor
+        elif kind == "llama3":
+            orig = scaling.original_max_position_embeddings
+            lo_w = orig / scaling.low_freq_factor   # longest kept-ish
+            hi_w = orig / scaling.high_freq_factor  # shortest scaled-ish
+            wavelen = 2.0 * jnp.pi / inv
+            smooth = (
+                orig / wavelen - scaling.low_freq_factor
+            ) / (scaling.high_freq_factor - scaling.low_freq_factor)
+            smoothed = (
+                (1.0 - smooth) * inv / scaling.factor + smooth * inv
+            )
+            inv = jnp.where(
+                wavelen > lo_w,
+                inv / scaling.factor,  # low-freq: fully slowed
+                jnp.where(wavelen < hi_w, inv, smoothed),  # high: kept
+            )
+        else:
+            raise NotImplementedError(
+                f"rope scaling type {kind!r} (supported: linear, llama3; "
+                "'dynamic' NTK rescales per sequence length — a dynamic "
+                "shape under jit — use llama3 or linear instead)"
+            )
     freqs = jnp.outer(t, inv)  # [S, D/2]
     return jnp.cos(freqs), jnp.sin(freqs)
 
@@ -64,6 +104,7 @@ def dot_product_attention(
     softmax_dtype=jnp.float32,
     dropout_rate: float = 0.0,
     dropout_rng=None,
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """MXU-friendly grouped attention; returns [B, S, Hq, D] in q.dtype.
 
@@ -76,6 +117,12 @@ def dot_product_attention(
     (T5 folds the scale into its init and uses 1.0).
     ``dropout_rate``/``dropout_rng`` drop attention WEIGHTS (post-softmax,
     inverted scaling) — torch's ``attn_dropout`` / HF T5 semantics.
+    ``window`` is sliding-window (Mistral) attention: position ``i``
+    sees only keys in ``(i - window, i]`` — HF's convention, where a
+    key exactly ``window`` back is already masked. Composes with the
+    causal mask it implies and with KV-cache decode (traced
+    ``q_offset``): the cache buffer stays full-length, the band mask
+    bounds what each step reads.
     """
     B, S, Hq, D = q.shape
     _, T, Hkv, _ = k.shape
@@ -104,11 +151,14 @@ def dot_product_attention(
             raise ValueError("segment_ids requires self-attention (S == T)")
         same = segment_ids[:, :, None] == segment_ids[:, None, :]  # [B,S,T]
         logits = jnp.where(same[:, None, None], logits, neg)
-    if causal:
+    if causal or window is not None:
         qpos = jnp.arange(S) + q_offset
         kpos = jnp.arange(T)
-        causal_mask = qpos[:, None] >= kpos[None, :]  # [S, T]
-        logits = jnp.where(causal_mask[None, None, None], logits, neg)
+        keep = qpos[:, None] >= kpos[None, :]  # [S, T] causal
+        if window is not None:
+            # band: key strictly within `window` positions back
+            keep = keep & (qpos[:, None] - kpos[None, :] < window)
+        logits = jnp.where(keep[None, None, None], logits, neg)
     if mask is not None:
         if mask.ndim == 2:  # [B, T] key padding mask
             mask = mask[:, None, None, None, :]
@@ -228,6 +278,7 @@ def attention(
     scale: Optional[float] = None,
     dropout_rate: float = 0.0,
     dropout_rng=None,
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Dispatching attention: models call this instead of an impl directly."""
     from pytorch_distributed_tpu.parallel.sequence import (
@@ -271,6 +322,13 @@ def attention(
                 "attention-weight dropout is not supported inside "
                 "sequence-parallel mode"
             )
+        if window is not None:
+            # a band mask spans ring-shard boundaries; applying it per
+            # local shard would silently widen/narrow the window
+            raise NotImplementedError(
+                "sliding-window attention is not supported inside "
+                "sequence-parallel mode"
+            )
         return sequence_parallel_attention(q, k, v, causal=causal)
     use_flash = False
     # the kernel covers full, causal, [B, T] key-padding masks, packed
@@ -283,6 +341,7 @@ def attention(
     if (
         flash_ok_mask and static_zero_offset and bias is None
         and dropout_rate == 0.0  # weight dropout: einsum path only
+        and window is None  # band mask: einsum path only
         and q.shape[1] > 1  # single-query decode steps (T5 cross-attn
         # at S=1): a blocked kernel per token is all launch overhead,
         # and sub-tile block shapes are a Mosaic compile hazard
@@ -301,4 +360,5 @@ def attention(
         q, k, v, causal=causal, mask=mask, segment_ids=segment_ids,
         q_offset=q_offset, bias=bias, scale=scale,
         dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+        window=window,
     )

@@ -68,10 +68,11 @@ def _compile_includes(include):
     )
 
 
-def _skip_leaf(path, leaf, regs, min_size) -> bool:
+def _skip_leaf(path, leaf, regs, min_size, excl=None) -> bool:
     """Shared quantizer gate: already-quantized leaves pass through
-    untouched, sub-matrix/small leaves stay full precision, and the
-    include regexes (when given) must match the path."""
+    untouched, sub-matrix/small leaves stay full precision, the
+    include regexes (when given) must match the path, and the exclude
+    regexes (when given) must not."""
     from pytorch_distributed_tpu.parallel.sharding import path_str
 
     if _is_qleaf(leaf):
@@ -80,15 +81,17 @@ def _skip_leaf(path, leaf, regs, min_size) -> bool:
         return True
     # Match against '/'-prefixed paths (lora.py's _match convention) so
     # '/block/...' patterns hit a root-level scan segment too.
-    return regs is not None and not any(
-        r.search("/" + path_str(path)) for r in regs
-    )
+    p = "/" + path_str(path)
+    if excl is not None and any(r.search(p) for r in excl):
+        return True
+    return regs is not None and not any(r.search(p) for r in regs)
 
 
 def quantize_tree_int8(
     params,
     *,
     include: Optional[Sequence[str]] = None,
+    exclude: Optional[Sequence[str]] = None,
     min_size: int = 4096,
 ):
     """Quantize matching >=2-D leaves to symmetric per-channel int8.
@@ -107,9 +110,10 @@ def quantize_tree_int8(
     quantized tree per layer. Same axis convention as the int4 grouping.
     """
     regs = _compile_includes(include)
+    excl = _compile_includes(exclude)
 
     def quant(path, leaf):
-        if _skip_leaf(path, leaf, regs, min_size):
+        if _skip_leaf(path, leaf, regs, min_size, excl):
             return leaf
         f = leaf.astype(jnp.float32)
         amax = jnp.max(jnp.abs(f), axis=leaf.ndim - 2, keepdims=True)
@@ -129,6 +133,7 @@ def quantize_tree_int4(
     *,
     group_size: int = 128,
     include: Optional[Sequence[str]] = None,
+    exclude: Optional[Sequence[str]] = None,
     min_size: int = 4096,
 ):
     """Quantize matching >=2-D leaves to symmetric groupwise int4,
@@ -149,10 +154,11 @@ def quantize_tree_int4(
     scheme stays zero-point-free like the int8 path.
     """
     regs = _compile_includes(include)
+    excl = _compile_includes(exclude)
 
     def quant(path, leaf):
         if (
-            _skip_leaf(path, leaf, regs, min_size)
+            _skip_leaf(path, leaf, regs, min_size, excl)
             or leaf.shape[-1] % 2  # the pack needs out pairs
         ):
             return leaf
@@ -280,11 +286,26 @@ def quantize_for_scan_dequant(params, kind: str = "int4", **kw):
     Everything else stays full precision. ``kind``: "int4" (groupwise,
     the 8x path) or "int8"; extra kwargs forward to the quantizer.
     """
-    include = (r"/block/.*/kernel$",)
+    include = (
+        r"/block/.*/kernel$",
+        # MoE expert tensors (models/mixtral.py): the dominant payload
+        # of a sparse-MoE model lives in the stacked [L, E, D, F] /
+        # [L, E, F, D] expert weights, not in anything named 'kernel'.
+        # Segment-anchored so only leaves NAMED w_in/w_gate/w_out match
+        # (not e.g. a future 'raw_out')
+        r"/block/.*/w_(in|gate|out)$",
+    )
+    # the router decides WHICH experts run — a handful of KB whose
+    # quantization error flips routing decisions; keep it full precision
+    exclude = (r"/router/",)
     if kind == "int4":
-        return quantize_tree_int4(params, include=include, **kw)
+        return quantize_tree_int4(
+            params, include=include, exclude=exclude, **kw
+        )
     if kind == "int8":
-        return quantize_tree_int8(params, include=include, **kw)
+        return quantize_tree_int8(
+            params, include=include, exclude=exclude, **kw
+        )
     raise ValueError(f"kind must be 'int4' or 'int8', got {kind!r}")
 
 

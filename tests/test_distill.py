@@ -122,6 +122,7 @@ def test_distilled_draft_speeds_up_speculation():
     assert after > before + 0.2, (before, after)
 
 
+@pytest.mark.slow  # r5 profile refit: alpha-one==CE stays fast; packing boundary math pinned in test_lm_loss
 def test_packed_distillation_masks_boundaries():
     # packed semantics follow causal_lm_loss_fn: the loss over a packed
     # row equals the loss over the same tokens with the cross-document
@@ -144,6 +145,7 @@ def test_packed_distillation_masks_boundaries():
     assert int(valid.sum()) < seg.size - seg.shape[0]  # boundaries masked
 
 
+@pytest.mark.slow  # r5 profile refit: alpha-one==CE + quant decode pins stay fast
 def test_distillation_from_quantized_teacher():
     # distilling FROM a deployed int8 model: the teacher slot takes any
     # .apply surface, so QuantizedModel drops in — pinned against

@@ -244,6 +244,7 @@ def test_overflowing_max_positions_raises(gpt2):
     # the same machinery through RoPE/GQA and rides the slow profile
     ["gpt2", pytest.param("llama", marks=pytest.mark.slow)],
 )
+@pytest.mark.slow  # r5 profile refit: speculative ragged-prompts pin stays fast
 def test_left_padded_ragged_batch_matches_unpadded(family):
     """prompt_mask (HF attention_mask idiom): a left-padded ragged batch
     must produce exactly the continuations each prompt gets alone —
@@ -425,6 +426,7 @@ def test_ragged_batch_with_repetition_penalty_matches_solo(gpt2):
     np.testing.assert_array_equal(out[1, P:], solo[1])
 
 
+@pytest.mark.slow  # r5 profile refit: interop no-repeat-ngram HF token pin stays fast
 def test_ngram_oversized_is_noop_and_ragged_composes(gpt2):
     """n > sequence length is a harmless no-op (HF behavior), and
     prompt_mask + no_repeat_ngram keeps ragged rows equal to solo runs

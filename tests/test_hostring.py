@@ -60,6 +60,7 @@ def test_raw_collectives_4proc():
     assert results == [(r, "ok") for r in range(4)], results
 
 
+@pytest.mark.slow  # r5 profile refit: the 4proc variant exercises a strict superset of ring paths
 def test_raw_collectives_2proc():
     results = _run(2, hostring_workers.raw_worker)
     assert results == [(r, "ok") for r in range(2)], results

@@ -232,6 +232,7 @@ def test_llama_export_import_roundtrip():
             )
 
 
+@pytest.mark.slow  # r5 profile refit: bert classifier HF parity stays fast
 def test_bert_export_import_roundtrip():
     """export -> import is the identity on every leaf, trunk and
     classification trees both; exported keys load into HF exactly."""
@@ -353,6 +354,7 @@ def test_vit_logits_match_hf():
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow  # r5 profile refit: vit_logits_match_hf stays fast
 def test_vit_export_import_roundtrip():
     from pytorch_distributed_tpu.interop import (
         export_vit_weights,
@@ -454,6 +456,7 @@ def test_gpt2_no_repeat_ngram_matches_hf():
         np.testing.assert_array_equal(got, want, err_msg=f"ngram={ngram}")
 
 
+@pytest.mark.slow  # r5 profile refit: bert classifier HF parity + bert export roundtrip stay fast
 def test_bert_mlm_matches_hf_and_roundtrips():
     """HF BertForMaskedLM import: logit parity (tied decoder via the
     trunk embedding), and export -> import is the identity."""

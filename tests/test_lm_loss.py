@@ -128,6 +128,7 @@ def test_causal_shift_matches_manual():
     np.testing.assert_allclose(got, want, rtol=1e-5)
 
 
+@pytest.mark.slow  # r5 profile refit: packed_eval_and_chunked_equivalence + gradients_match_full_loss stay fast
 def test_packed_loss_equals_per_document_losses():
     """A packed row's masked loss must equal the token-weighted mean of
     each document trained alone — attention isolation + positions reset +
@@ -233,6 +234,7 @@ def test_packed_eval_and_chunked_equivalence():
     )
 
 
+@pytest.mark.slow  # r5 profile refit: the llama packed==per-document pin stays fast; same semantics
 def test_gpt2_packed_loss_equals_per_document_losses():
     """Same packed ≡ per-document invariant for GPT-2 (learned positions
     must reset per document via the positions table)."""

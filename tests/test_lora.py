@@ -153,6 +153,7 @@ def test_lora_merge_rejects_layout_mismatch():
         lora_merge(renamed, adapters)
 
 
+@pytest.mark.slow  # r5 profile refit: identity_at_init_llama pins the same invariant fast
 def test_identity_at_init_bert():
     # unrolled (layer{i}) stack: no scan axis; query/key/value out=2 and
     # attn/out multi-dim in are covered by the BERT default targets
@@ -188,6 +189,7 @@ def _adapter_leaves(tree):
                 yield from _adapter_leaves(v)
 
 
+@pytest.mark.slow  # r5 profile refit: identity_at_init_llama pins the invariant fast
 def test_identity_at_init_vit():
     # ViT names its projections query/key/value/out directly in the
     # block (no attn parent): the out-projection must be adapted too —
@@ -246,6 +248,7 @@ def test_qlora_int8_base_identity_and_dtype():
     assert kernels  # quantized leaves reconstructed at the asked dtype
 
 
+@pytest.mark.slow  # r5 profile refit: identity-at-init + adapter-only-training pin LoRA fast; quant has its own pins
 def test_qlora_int4_base():
     """QLoRA: adapters over a FROZEN int4 base. Zero-init B means the
     wrapped model starts exactly at the quantized base's outputs, and

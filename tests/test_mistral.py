@@ -1,0 +1,228 @@
+"""Sliding-window attention + the Mistral family.
+
+Pins, strongest first: HF ``MistralForCausalLM`` logit parity with a
+BINDING window (window < sequence length, so the band mask actually
+changes the answer); band-mask semantics against a numpy reference;
+KV-cache greedy decode == full-recompute argmax with the window active
+across the cache boundary.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import pytorch_distributed_tpu as ptd
+from pytorch_distributed_tpu.models import MistralConfig, MistralForCausalLM
+from pytorch_distributed_tpu.ops.attention import dot_product_attention
+from pytorch_distributed_tpu.runtime.precision import autocast
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def test_window_band_mask_matches_reference():
+    """attention(window=w) == softmax over keys j with 0 <= i-j < w."""
+    rng = np.random.default_rng(0)
+    B, S, H, D = 2, 12, 2, 8
+    q = rng.normal(size=(B, S, H, D)).astype(np.float32)
+    k = rng.normal(size=(B, S, H, D)).astype(np.float32)
+    v = rng.normal(size=(B, S, H, D)).astype(np.float32)
+    w = 4
+    got = dot_product_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True,
+        window=w,
+    )
+    logits = np.einsum("bshd,bthd->bhst", q, k) / np.sqrt(D)
+    i = np.arange(S)[:, None]
+    j = np.arange(S)[None, :]
+    keep = (i >= j) & (i - j < w)
+    logits = np.where(keep[None, None], logits, -1e30)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    want = np.einsum("bhst,bthd->bshd", p, v)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-5)
+
+
+def test_window_excludes_key_exactly_window_back():
+    """HF convention: a key exactly `window` positions back is masked.
+    Perturbing it must not move the query's output; perturbing the
+    newest in-window key must."""
+    rng = np.random.default_rng(1)
+    S, w, qi = 10, 3, 9  # query at position 9 sees keys 7, 8, 9
+    q = rng.normal(size=(1, S, 1, 8)).astype(np.float32)
+    k = rng.normal(size=(1, S, 1, 8)).astype(np.float32)
+    v = rng.normal(size=(1, S, 1, 8)).astype(np.float32)
+
+    def out_at(k_arr):
+        return np.asarray(
+            dot_product_attention(
+                jnp.asarray(q), jnp.asarray(k_arr), jnp.asarray(v),
+                causal=True, window=w,
+            )
+        )[0, qi]
+
+    base = out_at(k)
+    k_out = k.copy()
+    k_out[0, qi - w] += 10.0  # position 6: out of window
+    np.testing.assert_array_equal(out_at(k_out), base)
+    k_in = k.copy()
+    k_in[0, qi - w + 1] += 10.0  # position 7: newest masked boundary in
+    assert not np.allclose(out_at(k_in), base)
+
+
+def _pair():
+    torch.manual_seed(0)
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=211, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10_000.0, rms_norm_eps=1e-5,
+        max_position_embeddings=128, sliding_window=5,
+        attn_implementation="eager",
+    )
+    hf = transformers.MistralForCausalLM(hf_cfg).eval()
+    cfg = MistralConfig(
+        vocab_size=211, hidden_size=48, intermediate_size=96, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_seq_len=128,
+        rope_theta=10_000.0, rms_eps=1e-5, sliding_window=5,
+    )
+    return hf, cfg
+
+
+def test_mistral_logits_match_hf_with_binding_window():
+    from pytorch_distributed_tpu.interop import load_mistral_weights
+
+    hf, cfg = _pair()
+    params = load_mistral_weights(
+        {k: v.detach().numpy() for k, v in hf.state_dict().items()}, cfg
+    )
+    ids = np.random.default_rng(0).integers(2, 211, size=(2, 11)).astype(
+        np.int32
+    )  # S=11 > window=5: the band mask is binding
+    with torch.no_grad():
+        want = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    with autocast(enabled=False):
+        got = MistralForCausalLM(cfg).apply(
+            {"params": params}, jnp.asarray(ids)
+        )
+    np.testing.assert_allclose(np.asarray(got), want, atol=3e-4, rtol=2e-4)
+
+
+def test_mistral_cache_decode_equals_recompute_across_window():
+    cfg = MistralConfig.tiny()  # window=8
+    model = MistralForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(2, 500, size=(2, 6)), jnp.int32)
+    params = model.init(jax.random.key(0), ids)["params"]
+    # 4 new tokens cross the window boundary (6+4 > 8), so late steps
+    # must FORGET early keys identically in both paths; each recompute
+    # length is a fresh compile, so the loop stays short
+    new = 4
+    got = ptd.generate(model, params, ids, max_new_tokens=new,
+                       temperature=0.0)
+    seq = np.asarray(ids)
+    for _ in range(new):
+        logits = model.apply({"params": params}, jnp.asarray(seq))
+        nxt = np.asarray(jnp.argmax(logits[:, -1], -1))
+        seq = np.concatenate([seq, nxt[:, None].astype(seq.dtype)], 1)
+    np.testing.assert_array_equal(np.asarray(got), seq)
+
+
+# --------------------------------------------------------------------------
+# RoPE context-window scaling (Llama-3.1 long context) — lives here with
+# the other Llama-body extension semantics
+# --------------------------------------------------------------------------
+
+
+def test_rope_llama3_scaling_matches_hf_inv_freq():
+    """Our llama3 frequency transform == HF's _compute_llama3_parameters
+    (the function Llama-3.1 checkpoints were trained against)."""
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    from pytorch_distributed_tpu.models import LlamaConfig, RopeScaling
+    from pytorch_distributed_tpu.ops.attention import rope_frequencies
+
+    hf_cfg = transformers.LlamaConfig(
+        hidden_size=64, num_attention_heads=4, rope_theta=10_000.0,
+        max_position_embeddings=64,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 4.0,
+            "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 32,
+        },
+    )
+    hf_inv, _ = ROPE_INIT_FUNCTIONS["llama3"](hf_cfg, device=None)
+    scaling = RopeScaling(
+        type="llama3", factor=4.0, low_freq_factor=1.0,
+        high_freq_factor=4.0, original_max_position_embeddings=32,
+    )
+    cos, sin = rope_frequencies(16, 64, 10_000.0, scaling=scaling)
+    # recover inv_freq from the tables: freqs[1] = 1 * inv
+    ours = np.arctan2(np.asarray(sin)[1], np.asarray(cos)[1])
+    np.testing.assert_allclose(ours, hf_inv.numpy(), rtol=1e-6, atol=1e-7)
+
+
+def test_rope_linear_scaling_is_position_interpolation():
+    from pytorch_distributed_tpu.models import RopeScaling
+    from pytorch_distributed_tpu.ops.attention import rope_frequencies
+
+    cos_s, sin_s = rope_frequencies(
+        16, 32, 10_000.0,
+        scaling=RopeScaling(type="linear", factor=2.0),
+    )
+    cos, sin = rope_frequencies(16, 32, 10_000.0)
+    # scaled table at position 2t == unscaled at position t
+    np.testing.assert_allclose(
+        np.asarray(cos_s)[::2], np.asarray(cos)[:16], rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(sin_s)[::2], np.asarray(sin)[:16], rtol=1e-6
+    )
+
+
+def test_llama31_rope_scaling_logits_match_hf():
+    """End-to-end: a converted HF checkpoint with llama3 rope scaling
+    scores identically — positions past original_max included."""
+    from pytorch_distributed_tpu.interop import load_llama_weights
+    from pytorch_distributed_tpu.models import (
+        LlamaConfig,
+        LlamaForCausalLM,
+        RopeScaling,
+    )
+
+    torch.manual_seed(0)
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=211, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10_000.0, rms_norm_eps=1e-5,
+        max_position_embeddings=64,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 4.0,
+            "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 16,
+        },
+        attn_implementation="eager",
+    )
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = LlamaConfig(
+        vocab_size=211, hidden_size=48, intermediate_size=96, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_seq_len=64,
+        rope_theta=10_000.0, rms_eps=1e-5,
+        rope_scaling=RopeScaling(
+            type="llama3", factor=4.0, low_freq_factor=1.0,
+            high_freq_factor=4.0, original_max_position_embeddings=16,
+        ),
+    )
+    params = load_llama_weights(
+        {k: v.detach().numpy() for k, v in hf.state_dict().items()}, cfg
+    )
+    # S=24 > original_max=16: the scaled frequencies are binding
+    ids = np.random.default_rng(0).integers(2, 211, size=(2, 24)).astype(
+        np.int32
+    )
+    with torch.no_grad():
+        want = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    with autocast(enabled=False):
+        got = LlamaForCausalLM(cfg).apply({"params": params}, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(got), want, atol=3e-4, rtol=2e-4)

@@ -75,6 +75,7 @@ def test_mixtral_logits_match_hf_scan():
     _logits_match(hf, cfg)
 
 
+@pytest.mark.slow  # r5 profile refit: scan-layout HF parity + export roundtrip stay fast
 def test_mixtral_logits_match_hf_unrolled():
     hf, cfg = _pair(scan_layers=False)
     _logits_match(hf, cfg)
@@ -145,6 +146,7 @@ def test_moe_dropfree_swiglu_matches_dense_reference():
     np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-4)
 
 
+@pytest.mark.slow  # r5 profile refit: gpt2/t5 cache==recompute pins stay fast; HF parity pins this family
 def test_mixtral_cache_decode_equals_recompute():
     cfg = MixtralConfig.tiny()
     model = MixtralForCausalLM(cfg)
@@ -161,6 +163,7 @@ def test_mixtral_cache_decode_equals_recompute():
     np.testing.assert_array_equal(np.asarray(got), seq)  # prompt + new
 
 
+@pytest.mark.slow  # r5 profile refit: moe aux-sown + HF parity + recipe smoke (slow) cover aux training
 def test_mixtral_aux_loss_trains_router():
     """causal_lm_loss_fn(moe_aux_weight=...) must flow gradients into
     BOTH the experts and the router through the scanned stack (the
@@ -240,3 +243,42 @@ def test_mixtral_recipe_smoke():
         ]
     )
     assert int(state.step) == 2
+
+
+def test_mixtral_int4_scan_dequant_serving():
+    """Quantized MoE serving: quantize_for_scan_dequant now reaches the
+    expert tensors (w_in/w_gate/w_out — a sparse-MoE model's dominant
+    payload, not named 'kernel') while the ROUTER stays full precision
+    (its quantization error flips routing decisions). Per-layer
+    scan-dequant forward must equal the whole-tree dequant forward
+    bitwise — the same pin the dense families carry."""
+    import dataclasses
+
+    from pytorch_distributed_tpu.ops import (
+        QuantizedModel,
+        quantize_for_scan_dequant,
+    )
+
+    cfg = MixtralConfig.tiny()
+    model = MixtralForCausalLM(cfg)
+    qmodel = MixtralForCausalLM(
+        dataclasses.replace(cfg, scan_dequant=True)
+    )
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(2, 500, size=(2, 8)), jnp.int32
+    )
+    params = model.init(jax.random.key(0), ids)["params"]
+    q = quantize_for_scan_dequant(params, "int4", min_size=512)
+
+    block = q["layers"]["block"]
+    # expert tensors quantized...
+    assert set(block["moe"]["w_in"].keys()) == {"q4", "scale"}
+    assert set(block["moe"]["w_gate"].keys()) == {"q4", "scale"}
+    assert set(block["moe"]["w_out"].keys()) == {"q4", "scale"}
+    # ...router (and everything outside the scan) untouched
+    assert hasattr(block["moe"]["router"]["kernel"], "dtype")
+    assert hasattr(q["embed"]["embedding"], "dtype")
+
+    a = QuantizedModel(model).apply({"params": q}, ids)
+    b = qmodel.apply({"params": q}, ids)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -199,6 +199,7 @@ class TestResNet:
         kernels = jax.tree_util.tree_leaves(v["params"])
         assert all(k.dtype == jnp.float32 for k in kernels)
 
+    @pytest.mark.slow  # r5 profile refit: autocast policy semantics pinned in test_runtime
     def test_autocast_full_precision(self):
         with ptd.autocast(enabled=False):
             model = ResNet18(num_classes=10, stem="cifar")

@@ -142,6 +142,7 @@ def test_pipeline_composes_with_tensor_parallel_rules():
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow  # r5 profile refit: the pipeline convergence + schedule tests stay fast
 def test_pipeline_layer_count_mismatch_raises():
     ptd.init_process_group(mesh_spec=MeshSpec(dp=-1, pp=2))
     cfg = GPT2Config(

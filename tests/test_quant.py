@@ -247,6 +247,7 @@ class TestScanDequant:
         qmodel = GPT2LMHead(dataclasses.replace(cfg, scan_dequant=True))
         return model, qmodel, params, ids
 
+    @pytest.mark.slow  # r5 profile refit: llama8b rehearsal (slow) + decode-agreement tests cover scan_dequant
     def test_gpt2_per_layer_equals_whole_tree(self):
         from pytorch_distributed_tpu.ops import (
             QuantizedModel,

@@ -67,6 +67,7 @@ class TestRingAttention:
                 np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
             )
 
+    @pytest.mark.slow  # r5 profile refit: ring matches_reference/with_dp/under_jit stay fast
     def test_mqa(self, sp_mesh, rng):
         q, k, v = _qkv(rng, Hq=4, Hkv=1)
         ref = dot_product_attention(q, k, v, causal=True)
@@ -149,6 +150,7 @@ class TestModelTransparentSP:
             np.asarray(out), np.asarray(ref), rtol=0.08, atol=0.08
         )
 
+    @pytest.mark.slow  # r5 profile refit: ring/ulysses numerics tests pin SP fast; this is the dispatcher ergonomics
     def test_sequence_parallel_context_manager(self):
         from pytorch_distributed_tpu.parallel import sequence_parallel
         from pytorch_distributed_tpu.parallel.sequence import (
@@ -163,6 +165,7 @@ class TestModelTransparentSP:
             assert sequence_parallel_mode() == ("sp", "ring")
         assert sequence_parallel_mode()[0] is None
 
+    @pytest.mark.slow  # r5 profile refit: llama_forward SP/ulysses + ring numerics stay fast
     def test_mode_roundtrip(self):
         from pytorch_distributed_tpu.parallel.sequence import (
             sequence_parallel_mode,

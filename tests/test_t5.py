@@ -114,6 +114,7 @@ def test_t5_export_roundtrips_into_hf():
     np.testing.assert_allclose(a, b, atol=1e-6)
 
 
+@pytest.mark.slow  # r5 profile refit: gpt2 greedy==recompute + t5 HF parity stay fast
 def test_t5_cache_decode_equals_recompute():
     """Greedy generate through the static KV cache + once-projected
     cross K/V must reproduce full-recompute argmax token-for-token."""
@@ -138,6 +139,7 @@ def test_t5_cache_decode_equals_recompute():
     )
 
 
+@pytest.mark.slow  # r5 profile refit: HF logit parity (masked rows included) pins the mask math fast
 def test_t5_encoder_mask_changes_nothing_for_pad_free_rows():
     """A padded encoder row must not perturb an unpadded row's logits
     (the cross-attention mask isolates rows)."""
@@ -204,6 +206,7 @@ def test_t5_seq2seq_loss_trains():
     assert float(ln) < float(l0)
 
 
+@pytest.mark.slow  # r5 profile refit: gpt2 TP-generate + mixtral EP+TP-generate pin sharded decode fast
 def test_t5_generate_with_tp_sharded_params():
     """TP serving for the encoder-decoder: params sharded by
     t5_partition_rules decode through the SAME generate_encdec call,

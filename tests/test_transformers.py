@@ -68,6 +68,7 @@ class TestForward:
         assert logits.shape == (2, 3)
         assert logits.dtype == jnp.float32
 
+    @pytest.mark.slow  # r5 profile refit: bert HF logit parity exercises the mask
     def test_bert_attention_mask_effect(self):
         cfg = BertConfig.tiny()
         model = BertModel(cfg)
@@ -115,6 +116,7 @@ class TestForward:
         with pytest.raises(ValueError, match="n_positions"):
             model.init(jax.random.key(0), ids)
 
+    @pytest.mark.slow  # r5 profile refit: causality pinned by attention + generation suites
     def test_llama_shapes_and_causality(self):
         cfg = LlamaConfig.tiny()
         model = LlamaForCausalLM(cfg)

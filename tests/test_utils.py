@@ -241,6 +241,7 @@ def test_optim_rmsprop_matches_torch():
     )
 
 
+@pytest.mark.slow  # r5 profile refit: the torch-pinned schedule trajectory tests stay fast
 def test_optim_reduce_lr_on_plateau():
     """Stalled loss scales updates by factor after patience; an improving
     metric (mode='max') does not."""
