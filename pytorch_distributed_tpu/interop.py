@@ -179,6 +179,7 @@ def _llama_body_import(sd: StateDict, cfg, ffn_fn) -> Dict:
     returns the per-layer FFN subtree."""
     H, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.hidden_size
     hd = cfg.head_dim
+    attn_bias = getattr(cfg, "attention_bias", False)  # Qwen2: q/k/v only
 
     def block(i):
         p = f"model.layers.{i}."
@@ -209,6 +210,11 @@ def _llama_body_import(sd: StateDict, cfg, ffn_fn) -> Dict:
                 "scale": _np(sd, p + "post_attention_layernorm.weight")
             },
         }
+        if attn_bias:
+            for name, heads in (("q", H), ("k", Hkv), ("v", Hkv)):
+                tree[name]["bias"] = _np(
+                    sd, p + f"self_attn.{name}_proj.bias"
+                ).reshape(heads, hd)
         tree.update(ffn_fn(p))
         return tree
 
@@ -232,6 +238,7 @@ def _llama_body_export(params, cfg, ffn_fn) -> Dict[str, Array]:
     writes the per-layer FFN entries."""
     H, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.hidden_size
     hd = cfg.head_dim
+    attn_bias = getattr(cfg, "attention_bias", False)
     sd = {
         "model.embed_tokens.weight": np.asarray(params["embed"]["embedding"]),
         "model.norm.weight": np.asarray(params["final_norm"]["scale"]),
@@ -254,6 +261,11 @@ def _llama_body_export(params, cfg, ffn_fn) -> Dict[str, Array]:
         sd[p + "self_attn.o_proj.weight"] = (
             np.asarray(lyr["o"]["kernel"]).reshape(H * hd, D).T
         )
+        if attn_bias:
+            for name in ("q", "k", "v"):
+                sd[p + f"self_attn.{name}_proj.bias"] = np.asarray(
+                    lyr[name]["bias"]
+                ).reshape(-1)
         sd[p + "post_attention_layernorm.weight"] = np.asarray(
             lyr["mlp_norm"]["scale"]
         )
@@ -338,6 +350,11 @@ def export_llama_weights(params, cfg) -> Dict[str, Array]:
 # mappings are the Llama ones, aliased for discoverability.
 load_mistral_weights = load_llama_weights
 export_mistral_weights = export_llama_weights
+
+# Qwen2 = the Llama layout + q/k/v biases; the shared body mapper reads
+# cfg.attention_bias, so the Llama functions handle it given a Qwen2Config.
+load_qwen2_weights = load_llama_weights
+export_qwen2_weights = export_llama_weights
 
 
 # --------------------------------------------------------------------------

@@ -48,6 +48,11 @@ from pytorch_distributed_tpu.models.mistral import (
     MistralForCausalLM,
     mistral_partition_rules,
 )
+from pytorch_distributed_tpu.models.qwen2 import (
+    Qwen2Config,
+    Qwen2ForCausalLM,
+    qwen2_partition_rules,
+)
 from pytorch_distributed_tpu.models.mixtral import (
     MixtralConfig,
     MixtralForCausalLM,
@@ -76,6 +81,9 @@ __all__ = [
     "MistralConfig",
     "MistralForCausalLM",
     "mistral_partition_rules",
+    "Qwen2Config",
+    "Qwen2ForCausalLM",
+    "qwen2_partition_rules",
     "MixtralConfig",
     "MixtralForCausalLM",
     "mixtral_partition_rules",

@@ -55,6 +55,9 @@ class LlamaConfig:
     sliding_window: Optional[int] = None
     # context-window extension (Llama-3.1 long context): None = plain RoPE
     rope_scaling: Optional[RopeScaling] = None
+    # biases on the q/k/v projections (Qwen2); o/gate/up/down never
+    # carry biases in any Llama-body family
+    attention_bias: bool = False
     # scan over layers (models/scan.py): one compiled block, [L, ...]
     # stacked params. False restores the unrolled per-layer tree.
     scan_layers: bool = True
@@ -125,14 +128,18 @@ class LlamaBlock(nn.Module):
                  cache_len: Optional[int] = None):
         cfg = self.config
         policy = current_policy()
-        dense = lambda feats, name, axis=-1: nn.DenseGeneral(  # noqa: E731
-            feats, axis=axis, use_bias=False, dtype=policy.compute_dtype,
-            param_dtype=policy.param_dtype, name=name,
+        dense = lambda feats, name, axis=-1, use_bias=False: (  # noqa: E731
+            nn.DenseGeneral(
+                feats, axis=axis, use_bias=use_bias,
+                dtype=policy.compute_dtype,
+                param_dtype=policy.param_dtype, name=name,
+            )
         )
         h = RMSNorm(cfg.rms_eps, name="attn_norm")(x)
-        q = dense((cfg.num_heads, cfg.head_dim), "q")(h)
-        k = dense((cfg.num_kv_heads, cfg.head_dim), "k")(h)
-        v = dense((cfg.num_kv_heads, cfg.head_dim), "v")(h)
+        ab = cfg.attention_bias
+        q = dense((cfg.num_heads, cfg.head_dim), "q", use_bias=ab)(h)
+        k = dense((cfg.num_kv_heads, cfg.head_dim), "k", use_bias=ab)(h)
+        v = dense((cfg.num_kv_heads, cfg.head_dim), "v", use_bias=ab)(h)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
         if decode:
@@ -197,8 +204,19 @@ class LlamaForCausalLM(nn.Module):
             cfg.vocab_size, cfg.hidden_size, param_dtype=policy.param_dtype,
             name="embed",
         )(input_ids).astype(policy.compute_dtype)
+        # size the tables to what this program can actually index — at
+        # 128k max_seq_len (llama3_1_8b) the full table is ~67 MB of
+        # constants that an S=8k step would bake in for nothing
+        if decode:
+            table_len = cache_len or cfg.max_seq_len
+        elif positions is None:
+            table_len = S
+        else:
+            # explicit positions (sequence-parallel shards, packed
+            # batches) may index anywhere in the configured window
+            table_len = cfg.max_seq_len
         cos, sin = rope_frequencies(
-            cfg.head_dim, cfg.max_seq_len, cfg.rope_theta,
+            cfg.head_dim, table_len, cfg.rope_theta,
             scaling=cfg.rope_scaling,
         )
         if decode:

@@ -152,6 +152,10 @@ def dot_product_attention(
         same = segment_ids[:, :, None] == segment_ids[:, None, :]  # [B,S,T]
         logits = jnp.where(same[:, None, None], logits, neg)
     if causal or window is not None:
+        if window is not None and window <= 0:
+            # an all-masked row would softmax to UNIFORM weights over
+            # every key (future included) — garbage, silently
+            raise ValueError(f"window must be positive, got {window}")
         qpos = jnp.arange(S) + q_offset
         kpos = jnp.arange(T)
         keep = qpos[:, None] >= kpos[None, :]  # [S, T] causal
