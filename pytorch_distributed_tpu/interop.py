@@ -219,16 +219,32 @@ def _llama_body_import(sd: StateDict, cfg, ffn_fn) -> Dict:
         return tree
 
     layers = [block(i) for i in range(cfg.num_layers)]
-    lm_head = (
-        _np(sd, "lm_head.weight")
-        if "lm_head.weight" in sd
-        else _np(sd, "model.embed_tokens.weight")  # tied
-    )
     params = {
         "embed": {"embedding": _np(sd, "model.embed_tokens.weight")},
         "final_norm": {"scale": _np(sd, "model.norm.weight")},
-        "lm_head": {"kernel": lm_head.T},
     }
+    if getattr(cfg, "tie_word_embeddings", False):
+        # tied (Llama-3.2-1B/3B, Qwen2-0.5B): the model attends through
+        # the embed table — a separate lm_head leaf must NOT exist.
+        # Refuse, don't drop: a genuinely untied checkpoint loaded with
+        # a tied cfg would silently diverge from HF
+        if "lm_head.weight" in sd and not np.allclose(
+            np.asarray(sd["lm_head.weight"]),
+            params["embed"]["embedding"],
+        ):
+            raise ValueError(
+                "cfg.tie_word_embeddings=True but the checkpoint's "
+                "lm_head.weight differs from its embedding table — an "
+                "UNTIED checkpoint; fix the config instead of losing "
+                "the head weights"
+            )
+    else:
+        lm_head = (
+            _np(sd, "lm_head.weight")
+            if "lm_head.weight" in sd
+            else _np(sd, "model.embed_tokens.weight")  # tied sd, untied cfg
+        )
+        params["lm_head"] = {"kernel": lm_head.T}
     params.update(_maybe_stack(layers, cfg.scan_layers, "layers", "layer"))
     return params
 
@@ -239,10 +255,17 @@ def _llama_body_export(params, cfg, ffn_fn) -> Dict[str, Array]:
     H, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.hidden_size
     hd = cfg.head_dim
     attn_bias = getattr(cfg, "attention_bias", False)
+    emb = np.asarray(params["embed"]["embedding"])
     sd = {
-        "model.embed_tokens.weight": np.asarray(params["embed"]["embedding"]),
+        "model.embed_tokens.weight": emb,
         "model.norm.weight": np.asarray(params["final_norm"]["scale"]),
-        "lm_head.weight": np.asarray(params["lm_head"]["kernel"]).T,
+        # tied models have no lm_head leaf; HF materializes the shared
+        # tensor under both names, so export it as the embedding
+        "lm_head.weight": (
+            emb
+            if getattr(cfg, "tie_word_embeddings", False)
+            else np.asarray(params["lm_head"]["kernel"]).T
+        ),
     }
     for i, lyr in enumerate(_unstack(params, cfg, "layers", "layer")):
         p = f"model.layers.{i}."

@@ -58,6 +58,9 @@ class LlamaConfig:
     # biases on the q/k/v projections (Qwen2); o/gate/up/down never
     # carry biases in any Llama-body family
     attention_bias: bool = False
+    # share the embedding table with the LM head (Llama-3.2-1B/3B,
+    # Qwen2-0.5B/1.5B, Gemma); False = the untied Llama-3 layout
+    tie_word_embeddings: bool = False
     # scan over layers (models/scan.py): one compiled block, [L, ...]
     # stacked params. False restores the unrolled per-layer tree.
     scan_layers: bool = True
@@ -92,6 +95,20 @@ class LlamaConfig:
             max_seq_len=131_072,
             rope_scaling=RopeScaling(
                 type="llama3", factor=8.0, low_freq_factor=1.0,
+                high_freq_factor=4.0,
+                original_max_position_embeddings=8_192,
+            ),
+        )
+
+    @classmethod
+    def llama3_2_1b(cls) -> "LlamaConfig":
+        """Llama-3.2-1B: tied embeddings + factor-32 llama3 scaling."""
+        return cls(
+            hidden_size=2_048, num_layers=16, num_heads=32,
+            num_kv_heads=8, intermediate_size=8_192,
+            max_seq_len=131_072, tie_word_embeddings=True,
+            rope_scaling=RopeScaling(
+                type="llama3", factor=32.0, low_freq_factor=1.0,
                 high_freq_factor=4.0,
                 original_max_position_embeddings=8_192,
             ),
@@ -200,10 +217,11 @@ class LlamaForCausalLM(nn.Module):
             raise ValueError(
                 f"cache_len {cache_len} > max_seq_len {cfg.max_seq_len}"
             )
-        x = nn.Embed(
+        embed = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, param_dtype=policy.param_dtype,
-            name="embed",
-        )(input_ids).astype(policy.compute_dtype)
+            dtype=policy.compute_dtype, name="embed",
+        )
+        x = embed(input_ids)  # dtype= already yields compute_dtype
         # size the tables to what this program can actually index — at
         # 128k max_seq_len (llama3_1_8b) the full table is ~67 MB of
         # constants that an S=8k step would bake in for nothing
@@ -259,12 +277,17 @@ class LlamaForCausalLM(nn.Module):
         x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
         if return_hidden:
             # [B, S, D] for the chunked-vocab loss (ops/lm_loss.py); the
-            # untied projection is params['lm_head']['kernel'] ([D, V])
+            # projection is params['lm_head']['kernel'] ([D, V]) untied,
+            # or params['embed']['embedding'] ([V, D]) tied — the loss's
+            # _lm_projection_weight resolves both
             return x.astype(policy.output_dtype)
-        logits = nn.Dense(
-            cfg.vocab_size, use_bias=False, dtype=policy.compute_dtype,
-            param_dtype=policy.param_dtype, name="lm_head",
-        )(x)
+        if cfg.tie_word_embeddings:
+            logits = embed.attend(x)  # x is already compute_dtype
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=policy.compute_dtype,
+                param_dtype=policy.param_dtype, name="lm_head",
+            )(x)
         return logits.astype(policy.output_dtype)
 
 

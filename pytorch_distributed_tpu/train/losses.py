@@ -403,9 +403,12 @@ def _lm_projection_weight(params):
         return params["wte"]["embedding"], 0
     if "lm_head" in params:
         return params["lm_head"]["kernel"], 1
+    if "embed" in params:  # tied Llama-body (tie_word_embeddings=True)
+        return params["embed"]["embedding"], 0
     raise ValueError(
-        "model has neither a tied 'wte' embedding nor an 'lm_head' kernel; "
-        "pass vocab_chunk_size=None or add its head to _lm_projection_weight"
+        "model has neither a tied 'wte'/'embed' embedding nor an "
+        "'lm_head' kernel; pass vocab_chunk_size=None or add its head "
+        "to _lm_projection_weight"
     )
 
 

@@ -99,3 +99,126 @@ def test_qwen2_cache_decode_equals_recompute():
         nxt = np.asarray(jnp.argmax(logits[:, -1], -1))
         seq = np.concatenate([seq, nxt[:, None].astype(seq.dtype)], 1)
     np.testing.assert_array_equal(np.asarray(got), seq)
+
+
+def test_qwen2_tied_embeddings_logits_match_hf():
+    """Qwen2-0.5B-style tying: the LM head attends through the embed
+    table (no lm_head leaf exists) and still matches HF exactly."""
+    from pytorch_distributed_tpu.interop import load_qwen2_weights
+
+    torch.manual_seed(1)
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=211, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=1e6, rms_norm_eps=1e-5, max_position_embeddings=128,
+        tie_word_embeddings=True, attn_implementation="eager",
+    )
+    hf = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        Qwen2Config(
+            vocab_size=211, hidden_size=48, intermediate_size=96,
+            num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128,
+            rope_theta=1e6, rms_eps=1e-5,
+        ),
+        tie_word_embeddings=True,
+    )
+    params = load_qwen2_weights(_sd(hf), cfg)
+    assert "lm_head" not in params  # tied: the leaf must not exist
+    ids = np.random.default_rng(2).integers(2, 211, size=(2, 9)).astype(
+        np.int32
+    )
+    with torch.no_grad():
+        want = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    with autocast(enabled=False):
+        got = Qwen2ForCausalLM(cfg).apply({"params": params}, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(got), want, atol=3e-4, rtol=2e-4)
+
+
+def test_tied_llama_chunked_loss_equals_full():
+    """vocab_chunk_size must work on a TIED Llama body: the chunked loss
+    resolves the projection from the embed table and equals the full
+    [B,S,V] loss."""
+    import dataclasses
+
+    from pytorch_distributed_tpu.models import LlamaConfig, LlamaForCausalLM
+    from pytorch_distributed_tpu.train import causal_lm_loss_fn
+
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny(), tie_word_embeddings=True
+    )
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(2, 500, size=(2, 12)), jnp.int32
+    )
+    params = model.init(jax.random.key(0), ids)["params"]
+    assert "lm_head" not in params
+    batch = {"input_ids": ids}
+    full = causal_lm_loss_fn(model)(
+        params, {}, batch, jax.random.key(1)
+    )[0]
+    chunked = causal_lm_loss_fn(model, vocab_chunk_size=128)(
+        params, {}, batch, jax.random.key(1)
+    )[0]
+    np.testing.assert_allclose(
+        float(full), float(chunked), rtol=2e-5, atol=2e-6
+    )
+
+
+def test_tied_export_roundtrips_into_hf():
+    """The tied export branch (lm_head.weight emitted as the embedding,
+    untransposed) must roundtrip — and a tied cfg must REFUSE a
+    genuinely untied checkpoint instead of dropping its head."""
+    import dataclasses
+
+    from pytorch_distributed_tpu.interop import (
+        export_qwen2_weights,
+        load_qwen2_weights,
+    )
+
+    torch.manual_seed(3)
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=211, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=1e6, rms_norm_eps=1e-5, max_position_embeddings=128,
+        tie_word_embeddings=True, attn_implementation="eager",
+    )
+    hf = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    cfg = dataclasses.replace(
+        Qwen2Config(
+            vocab_size=211, hidden_size=48, intermediate_size=96,
+            num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128,
+            rope_theta=1e6, rms_eps=1e-5,
+        ),
+        tie_word_embeddings=True,
+    )
+    params = load_qwen2_weights(_sd(hf), cfg)
+    sd = export_qwen2_weights(params, cfg)
+    np.testing.assert_array_equal(
+        sd["lm_head.weight"], sd["model.embed_tokens.weight"]
+    )
+    hf2 = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    hf2.load_state_dict({k: torch.tensor(v) for k, v in sd.items()})
+    ids = torch.tensor(
+        np.random.default_rng(3).integers(2, 211, size=(1, 7)).astype(
+            np.int64
+        )
+    )
+    with torch.no_grad():
+        np.testing.assert_allclose(
+            hf2(ids).logits.numpy(), hf(ids).logits.numpy(),
+            atol=1e-5, rtol=1e-5,
+        )
+    # untied checkpoint + tied cfg: refused, not dropped
+    torch.manual_seed(4)
+    untied = transformers.Qwen2ForCausalLM(
+        transformers.Qwen2Config(
+            vocab_size=211, hidden_size=48, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, rope_theta=1e6,
+            tie_word_embeddings=False,
+        )
+    ).eval()
+    with pytest.raises(ValueError, match="UNTIED"):
+        load_qwen2_weights(_sd(untied), cfg)
