@@ -379,6 +379,12 @@ export_mistral_weights = export_llama_weights
 load_qwen2_weights = load_llama_weights
 export_qwen2_weights = export_llama_weights
 
+# Gemma's state_dict layout is also Llama's (the norm offset, gelu gate,
+# embed scaling, and explicit head_dim are semantics, not weights); tied
+# configs produce no lm_head leaf and export the shared tensor.
+load_gemma_weights = load_llama_weights
+export_gemma_weights = export_llama_weights
+
 
 # --------------------------------------------------------------------------
 # Mixtral (sparse-MoE decoder; attention layout shared with Llama)

@@ -48,6 +48,11 @@ from pytorch_distributed_tpu.models.mistral import (
     MistralForCausalLM,
     mistral_partition_rules,
 )
+from pytorch_distributed_tpu.models.gemma import (
+    GemmaConfig,
+    GemmaForCausalLM,
+    gemma_partition_rules,
+)
 from pytorch_distributed_tpu.models.qwen2 import (
     Qwen2Config,
     Qwen2ForCausalLM,
@@ -81,6 +86,9 @@ __all__ = [
     "MistralConfig",
     "MistralForCausalLM",
     "mistral_partition_rules",
+    "GemmaConfig",
+    "GemmaForCausalLM",
+    "gemma_partition_rules",
     "Qwen2Config",
     "Qwen2ForCausalLM",
     "qwen2_partition_rules",

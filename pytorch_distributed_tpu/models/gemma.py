@@ -1,0 +1,75 @@
+"""Gemma — Llama body with Google's four deviations, beyond-reference.
+
+Gemma (Mesnard et al. 2024) is the Llama decoder with: a zero-centered
+RMSNorm scale applied as ``(1 + scale)``, a tanh-approximate-gelu gate
+in the MLP (GeGLU), embeddings multiplied by ``sqrt(hidden)`` after
+lookup, an explicit per-head dim decoupled from ``hidden/heads`` (256),
+and an always-tied LM head. Every one of those is a config flag on the
+shared Llama machinery (``rms_offset``, ``hidden_act``,
+``scale_embedding``, ``override_head_dim``, ``tie_word_embeddings``),
+so this module is pure configuration; the HF state_dict layout is
+Llama's, and ``interop.load_gemma_weights`` is the Llama mapping (tied:
+no lm_head leaf is produced).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from pytorch_distributed_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    llama_partition_rules,
+)
+
+def gemma_partition_rules(num_kv_heads: int = 1):
+    """Llama TP rules, defaulting to the MQA-safe form: the headline
+    gemma_2b has ONE kv head, whose size-1 axis cannot shard over tp —
+    k/v replicate. Pass ``num_kv_heads=16`` for gemma_7b to restore
+    kv-head sharding."""
+    return llama_partition_rules(num_kv_heads=num_kv_heads)
+
+
+@dataclasses.dataclass(frozen=True)
+class GemmaConfig(LlamaConfig):
+    # Gemma-2B geometry (the MQA variant: 1 kv head)
+    vocab_size: int = 256_000
+    hidden_size: int = 2_048
+    num_layers: int = 18
+    num_heads: int = 8
+    num_kv_heads: int = 1
+    intermediate_size: int = 16_384
+    max_seq_len: int = 8_192
+    rope_theta: float = 10_000.0
+    rms_eps: float = 1e-6
+    override_head_dim: Optional[int] = 256
+    rms_offset: bool = True
+    hidden_act: str = "gelu"
+    scale_embedding: bool = True
+    tie_word_embeddings: bool = True
+
+    @classmethod
+    def gemma_2b(cls) -> "GemmaConfig":
+        return cls()
+
+    @classmethod
+    def gemma_7b(cls) -> "GemmaConfig":
+        return cls(
+            hidden_size=3_072, num_layers=28, num_heads=16,
+            num_kv_heads=16, intermediate_size=24_576,
+        )
+
+    @classmethod
+    def tiny(cls) -> "GemmaConfig":
+        return cls(
+            vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+            num_kv_heads=1, intermediate_size=128, max_seq_len=128,
+            override_head_dim=16,
+        )
+
+
+class GemmaForCausalLM(LlamaForCausalLM):
+    """Llama machinery end to end; the config flags do the work."""
+
+    config: GemmaConfig

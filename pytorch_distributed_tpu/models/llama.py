@@ -61,6 +61,16 @@ class LlamaConfig:
     # share the embedding table with the LM head (Llama-3.2-1B/3B,
     # Qwen2-0.5B/1.5B, Gemma); False = the untied Llama-3 layout
     tie_word_embeddings: bool = False
+    # Gemma-isms, all defaulting to the Llama behavior:
+    # explicit per-head dim (Gemma: 256, decoupled from hidden/heads)
+    override_head_dim: Optional[int] = None
+    # RMSNorm multiplies by (1 + scale) — zero-centered scale init
+    rms_offset: bool = False
+    # FFN gate activation: silu (Llama/Mistral/Qwen) | gelu (Gemma's
+    # tanh-approximate gelu_pytorch_tanh)
+    hidden_act: str = "silu"
+    # multiply embeddings by sqrt(hidden_size) after lookup
+    scale_embedding: bool = False
     # scan over layers (models/scan.py): one compiled block, [L, ...]
     # stacked params. False restores the unrolled per-layer tree.
     scan_layers: bool = True
@@ -77,9 +87,16 @@ class LlamaConfig:
                 "requires scan_layers=True (an unrolled stack would hand "
                 "raw quantized dicts to the blocks)"
             )
+        if self.hidden_act not in ("silu", "gelu"):
+            raise ValueError(
+                f"hidden_act must be 'silu' or 'gelu', got "
+                f"{self.hidden_act!r}"
+            )
 
     @property
     def head_dim(self) -> int:
+        if self.override_head_dim is not None:
+            return self.override_head_dim
         return self.hidden_size // self.num_heads
 
     @classmethod
@@ -124,16 +141,24 @@ class LlamaConfig:
 
 class RMSNorm(nn.Module):
     eps: float = 1e-5
+    # Gemma stores a ZERO-centered scale and multiplies by (1 + scale);
+    # init stays zeros so a fresh tied-Gemma init is the identity norm
+    offset: bool = False
 
     @nn.compact
     def __call__(self, x):
         policy = current_policy()
         scale = self.param(
-            "scale", nn.initializers.ones, (x.shape[-1],), policy.param_dtype
+            "scale",
+            nn.initializers.zeros if self.offset else nn.initializers.ones,
+            (x.shape[-1],), policy.param_dtype,
         )
         x32 = x.astype(jnp.float32)
         rms = jnp.sqrt(jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + self.eps)
-        return (x32 / rms * scale).astype(x.dtype)
+        mult = scale.astype(jnp.float32)
+        if self.offset:
+            mult = 1.0 + mult
+        return (x32 / rms * mult).astype(x.dtype)
 
 
 class LlamaBlock(nn.Module):
@@ -152,7 +177,7 @@ class LlamaBlock(nn.Module):
                 param_dtype=policy.param_dtype, name=name,
             )
         )
-        h = RMSNorm(cfg.rms_eps, name="attn_norm")(x)
+        h = RMSNorm(cfg.rms_eps, cfg.rms_offset, name="attn_norm")(x)
         ab = cfg.attention_bias
         q = dense((cfg.num_heads, cfg.head_dim), "q", use_bias=ab)(h)
         k = dense((cfg.num_kv_heads, cfg.head_dim), "k", use_bias=ab)(h)
@@ -177,16 +202,22 @@ class LlamaBlock(nn.Module):
         attn = dense(cfg.hidden_size, "o", axis=(-2, -1))(attn)
         x = x + attn
 
-        h = RMSNorm(cfg.rms_eps, name="mlp_norm")(x)
+        h = RMSNorm(cfg.rms_eps, cfg.rms_offset, name="mlp_norm")(x)
         return x + self._ffn(h, dense)
 
     def _ffn(self, h, dense):
-        """SwiGLU MLP — the one piece variant decoders override (the
-        Mixtral family swaps in a sparse-MoE expert layer)."""
+        """Gated MLP — the one piece variant decoders override (the
+        Mixtral family swaps in a sparse-MoE expert layer). The gate
+        activation is silu (Llama/Mistral/Qwen) or Gemma's
+        tanh-approximate gelu per ``cfg.hidden_act``."""
         cfg = self.config
+        if cfg.hidden_act == "silu":  # validated at config construction
+            act = nn.silu
+        else:  # "gelu": Gemma's tanh-approximate gate
+            act = lambda a: nn.gelu(a, approximate=True)  # noqa: E731
         gate = dense(cfg.intermediate_size, "gate")(h)
         up = dense(cfg.intermediate_size, "up")(h)
-        return dense(cfg.hidden_size, "down")(nn.silu(gate) * up)
+        return dense(cfg.hidden_size, "down")(act(gate) * up)
 
 
 class LlamaForCausalLM(nn.Module):
@@ -222,6 +253,10 @@ class LlamaForCausalLM(nn.Module):
             dtype=policy.compute_dtype, name="embed",
         )
         x = embed(input_ids)  # dtype= already yields compute_dtype
+        if cfg.scale_embedding:  # Gemma: sqrt(hidden) after lookup
+            x = x * jnp.asarray(
+                cfg.hidden_size ** 0.5, policy.compute_dtype
+            )
         # size the tables to what this program can actually index — at
         # 128k max_seq_len (llama3_1_8b) the full table is ~67 MB of
         # constants that an S=8k step would bake in for nothing
@@ -274,7 +309,7 @@ class LlamaForCausalLM(nn.Module):
                     deterministic=not train,
                     decode=decode, cache_len=cache_len,
                 )
-        x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
+        x = RMSNorm(cfg.rms_eps, cfg.rms_offset, name="final_norm")(x)
         if return_hidden:
             # [B, S, D] for the chunked-vocab loss (ops/lm_loss.py); the
             # projection is params['lm_head']['kernel'] ([D, V]) untied,
@@ -291,13 +326,24 @@ class LlamaForCausalLM(nn.Module):
         return logits.astype(policy.output_dtype)
 
 
-def llama_partition_rules():
+def llama_partition_rules(num_kv_heads: Optional[int] = None):
     """Megatron TP: column-parallel q/k/v/gate/up, row-parallel o/down;
-    embedding sharded on hidden, lm_head kernel on vocab (its dim 1)."""
+    embedding sharded on hidden, lm_head kernel on vocab (its dim 1).
+
+    ``num_kv_heads``: pass the config's value for MQA models (Gemma-2B,
+    ``num_kv_heads=1``) — a size-1 kv-head axis cannot shard over tp,
+    so k/v replicate instead (they are the smallest projections; q/o
+    and the MLP still shard)."""
     from pytorch_distributed_tpu.parallel.sharding import stacked
 
+    kv_spec = (
+        stacked(P(None, None, None))
+        if num_kv_heads == 1
+        else stacked(P(None, "tp", None))
+    )
     return [
-        (r"/(q|k|v)/kernel", stacked(P(None, "tp", None))),
+        (r"/q/kernel", stacked(P(None, "tp", None))),
+        (r"/(k|v)/kernel", kv_spec),
         (r"/o/kernel", stacked(P("tp", None, None))),
         (r"/(gate|up)/kernel", stacked(P(None, "tp"))),
         (r"/down/kernel", stacked(P("tp", None))),
