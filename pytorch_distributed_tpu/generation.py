@@ -467,7 +467,14 @@ def generate_beam(
     # no batch dim and stay shared.
     def _cache_batch_axis(path, x):
         name = getattr(path[-1], "key", None) or str(path[-1])
-        if name in ("cached_key", "cached_value"):
+        # int8 KV caches carry per-token scale buffers with the SAME
+        # [..., B, T, H, 1] layout — they must replicate and reorder in
+        # lockstep with their payloads or the scales decode the wrong
+        # beam's entries
+        if name in (
+            "cached_key", "cached_value",
+            "cached_key_scale", "cached_value_scale",
+        ):
             return x.ndim - 4
         return None
 

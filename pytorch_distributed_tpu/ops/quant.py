@@ -87,6 +87,22 @@ def _skip_leaf(path, leaf, regs, min_size, excl=None) -> bool:
     return regs is not None and not any(r.search(p) for r in regs)
 
 
+def symmetric_int8(x, axis):
+    """Symmetric per-slice int8 core: ``(q8, scale)`` with
+    ``scale = amax/127`` reduced over ``axis`` (keepdims). Shared by the
+    weight-tree quantizer (axis = ndim-2, per-out-channel) and the
+    KV-cache path (axis = -1, per-token) so the rounding/clamp semantics
+    cannot drift between them. Symmetric, no zero-point; jnp.round is
+    IEEE half-to-even — ties break differently from the hostring
+    collective's half-away-from-zero, irrelevant to the <= scale/2
+    error bound."""
+    f = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(f), axis=axis, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(f / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
 def quantize_tree_int8(
     params,
     *,
@@ -115,14 +131,8 @@ def quantize_tree_int8(
     def quant(path, leaf):
         if _skip_leaf(path, leaf, regs, min_size, excl):
             return leaf
-        f = leaf.astype(jnp.float32)
-        amax = jnp.max(jnp.abs(f), axis=leaf.ndim - 2, keepdims=True)
-        scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-        # symmetric, no zero-point. jnp.round is IEEE half-to-even —
-        # ties break differently from the hostring collective's
-        # half-away-from-zero; irrelevant to the <= scale/2 error bound
-        q = jnp.clip(jnp.round(f / scale), -127, 127).astype(jnp.int8)
-        return {"q8": q, "scale": scale.astype(jnp.float32)}
+        q, scale = symmetric_int8(leaf, leaf.ndim - 2)
+        return {"q8": q, "scale": scale}
 
     return jax.tree_util.tree_map_with_path(quant, params,
                                             is_leaf=lambda x: _is_qleaf(x))
