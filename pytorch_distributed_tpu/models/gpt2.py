@@ -39,6 +39,9 @@ class GPT2Config:
     # inside the scan (models/scan.py) — the single-chip big-model serving path
 
     remat_policy: str = "full"  # full | dots | dots_no_batch (models/scan.py)
+    # "int8" rests the decode KV cache quantized (~2x less HBM than a
+    # bf16 cache; lossy — see ops/attention.decode_cache); None = exact
+    kv_cache_quantize: "str | None" = None
     # > 0 turns every block's FFN into a mixture-of-experts (ops/moe.py):
     # experts shard over the ep mesh axis. Uniform across layers so the
     # scanned stack stays homogeneous.
@@ -101,7 +104,8 @@ class GPT2Block(nn.Module):
             from pytorch_distributed_tpu.ops.attention import decode_cache
 
             k, v, offset = decode_cache(
-                self, k, v, cache_len or cfg.n_positions
+                self, k, v, cache_len or cfg.n_positions,
+                quantize=cfg.kv_cache_quantize,
             )
             attn = attention(
                 q, k, v, causal=True, q_offset=offset, mask=kv_mask
