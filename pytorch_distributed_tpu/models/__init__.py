@@ -1,7 +1,11 @@
 """Model zoo: native TPU-first implementations of the reference's recipe
 models (BASELINE.json:6-12) — ResNet-18/50, BERT-base, GPT-2-medium,
-Llama-3-8B. All NHWC / bf16-compute / f32-params by default, written
-against the framework's precision policy and partition-rule system.
+Llama-3-8B — plus beyond-reference families sharing the same machinery:
+ViT, T5, and the Llama-body config variants (Llama-3.1/3.2, Mistral,
+Qwen2, Gemma, sparse-MoE Mixtral; see docs/MIGRATION.md "Model zoo").
+All NHWC / bf16-compute / f32-params by default, written against the
+framework's precision policy and partition-rule system; every family is
+HF-logit-parity pinned with import AND export (interop.py).
 """
 
 from pytorch_distributed_tpu.models.resnet import (

@@ -29,6 +29,14 @@ LOCK_PATH = "/tmp/ptd_bench.lock"
 _CONTENTION_ERRNOS = (errno.EWOULDBLOCK, errno.EAGAIN)
 
 
+def default_lock_path() -> str:
+    """``PTD_BENCH_LOCK_PATH`` or the machine-wide default. The override
+    exists for TESTS of the lock machinery and for suite runners that
+    themselves hold the real lock (a bench.py child spawned inside such
+    a run must not deadlock against its grandparent's flock)."""
+    return os.environ.get("PTD_BENCH_LOCK_PATH", LOCK_PATH)
+
+
 def _open_lock(lock_path):
     """Open the lock file usably by ANY uid.
 
@@ -48,7 +56,7 @@ def _open_lock(lock_path):
     return fd
 
 
-def acquire_measurement_lock(wait_s=None, lock_path=LOCK_PATH):
+def acquire_measurement_lock(wait_s=None, lock_path=None):
     """Serialize this process behind every other measuring run.
 
     Returns the open lock fd; the caller must keep it referenced — the
@@ -58,6 +66,8 @@ def acquire_measurement_lock(wait_s=None, lock_path=LOCK_PATH):
     """
     if wait_s is None:
         wait_s = float(os.environ.get("PTD_BENCH_LOCK_WAIT_S", "5400"))
+    if lock_path is None:
+        lock_path = default_lock_path()
     fd = _open_lock(lock_path)
     try:
         fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
@@ -101,7 +111,7 @@ def acquire_measurement_lock(wait_s=None, lock_path=LOCK_PATH):
         return fd
 
 
-def start_measurement(wait_s=None, lock_path=LOCK_PATH):
+def start_measurement(wait_s=None, lock_path=None):
     """Acquire the lock, THEN start the budget clock: ``(fd, t0)``.
 
     Every measuring entrypoint keeps an internal wall-clock budget

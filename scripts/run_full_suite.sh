@@ -1,0 +1,34 @@
+#!/bin/bash
+# Full (-m "") suite in per-batch processes.
+#
+# A single pytest process running all ~470 tests (fast + slow) has
+# segfaulted twice on this rig inside XLA:CPU (jax 0.9.0) — once in
+# backend_compile_and_load, once executing a shard_map program — at
+# DIFFERENT tests that both pass in isolation, after 25-35 min of
+# accumulated jit state. The fast profile (~350 tests, ~8 min) has
+# never crashed. Until the upstream flakiness is root-caused, the
+# authoritative full validation runs in file batches, one fresh
+# interpreter each: a crash is isolated to its batch and retried solo
+# logic can follow up, and no process accumulates more than a few
+# hundred executables.
+#
+# Usage:  flock /tmp/ptd_bench.lock scripts/run_full_suite.sh
+set -u
+cd "$(dirname "$0")/.."
+mapfile -t FILES < <(ls tests/test_*.py | sort)
+BATCH=5
+total_rc=0
+i=0
+while [ $i -lt ${#FILES[@]} ]; do
+  chunk=("${FILES[@]:$i:$BATCH}")
+  echo "=== batch: ${chunk[*]}"
+  python -m pytest "${chunk[@]}" -q -m "" --no-header
+  rc=$?
+  if [ $rc -ne 0 ]; then
+    echo "=== batch FAILED rc=$rc: ${chunk[*]}"
+    total_rc=1
+  fi
+  i=$((i + BATCH))
+done
+echo "=== full suite chunked run done, rc=$total_rc"
+exit $total_rc

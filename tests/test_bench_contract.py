@@ -29,10 +29,13 @@ CONSUMPTION_BOUND = [
 
 
 @pytest.mark.slow
-def test_bench_cpu_fallback_is_host_meaningful():
+def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
     env = dict(os.environ)
     env["PALLAS_AXON_POOL_IPS"] = ""  # no relay plugin registration
     env["JAX_PLATFORMS"] = "cpu"
+    # private lock: a suite runner may HOLD the real machine-wide lock
+    # around this very test — the child must not deadlock against it
+    env["PTD_BENCH_LOCK_PATH"] = str(tmp_path / "bench.lock")
     # the driver runs bench with a 1-device env; the test-suite conftest
     # exports an 8-device XLA_FLAGS that would inflate the child's world
     # (8x the batch on a CPU) — strip it
@@ -63,28 +66,28 @@ def test_bench_cpu_fallback_is_host_meaningful():
 
 
 @pytest.mark.slow
-def test_bench_lock_serializes_runs():
+def test_bench_lock_serializes_runs(tmp_path):
     """Two benches may never overlap (VERDICT r4 weak #2: the driver's
     round-end bench contended with the capture loop and halved the feed
-    metric). A second bench must block on the machine-wide flock until
-    the first exits, and say so on stderr."""
+    metric). A second bench must block on the flock until the first
+    exits, and say so on stderr. Runs on a PRIVATE lock path (env
+    override) so the test neither queues behind a real bench nor
+    deadlocks when a suite runner holds the machine-wide lock."""
     import fcntl
 
-    from pytorch_distributed_tpu.utils.benchlock import LOCK_PATH
-
-    lock_fd = os.open(LOCK_PATH, os.O_CREAT | os.O_RDWR, 0o666)
+    lock_path = str(tmp_path / "bench.lock")
+    lock_fd = os.open(lock_path, os.O_CREAT | os.O_RDWR, 0o666)
     proc = None
     try:
-        try:  # impersonate a running bench — but never queue behind one
-            fcntl.flock(lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
-        except OSError:
-            os.close(lock_fd)
-            pytest.skip("a real bench holds the lock right now")
+        fcntl.flock(lock_fd, fcntl.LOCK_EX)  # impersonate a running bench
         code = (
             f"import sys; sys.path.insert(0, {REPO!r}); import bench; "
             "bench._acquire_bench_lock(); print('LOCKED', flush=True)"
         )
-        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+            PTD_BENCH_LOCK_PATH=lock_path,
+        )
         proc = subprocess.Popen(
             [sys.executable, "-u", "-c", code], cwd=REPO, env=env,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
