@@ -450,6 +450,87 @@ def export_mixtral_weights(params, cfg) -> Dict[str, Array]:
 
 
 # --------------------------------------------------------------------------
+# GPT-NeoX / Pythia
+# --------------------------------------------------------------------------
+
+def load_neox_weights(sd: StateDict, cfg) -> Dict:
+    """HF ``GPTNeoXForCausalLM`` state_dict -> params for
+    :class:`~pytorch_distributed_tpu.models.neox.NeoXForCausalLM`.
+
+    The fused ``query_key_value`` packs [head, (q,k,v), head_dim] along
+    its output axis — exactly our DenseGeneral features ``(H, 3, hd)``,
+    so the mapping is the usual transpose + reshape.
+    """
+    H, D = cfg.num_heads, cfg.hidden_size
+    hd = cfg.head_dim
+
+    def block(i):
+        p = f"gpt_neox.layers.{i}."
+        return {
+            "ln1": _ln_in(sd, p + "input_layernorm"),
+            "ln2": _ln_in(sd, p + "post_attention_layernorm"),
+            "qkv": {
+                "kernel": _np(
+                    sd, p + "attention.query_key_value.weight"
+                ).T.reshape(D, H, 3, hd),
+                "bias": _np(
+                    sd, p + "attention.query_key_value.bias"
+                ).reshape(H, 3, hd),
+            },
+            "attn_out": {
+                "kernel": _np(sd, p + "attention.dense.weight").T.reshape(
+                    H, hd, D
+                ),
+                "bias": _np(sd, p + "attention.dense.bias"),
+            },
+            "mlp_up": _lin_in(sd, p + "mlp.dense_h_to_4h"),
+            "mlp_down": _lin_in(sd, p + "mlp.dense_4h_to_h"),
+        }
+
+    layers = [block(i) for i in range(cfg.num_layers)]
+    params = {
+        "embed": {"embedding": _np(sd, "gpt_neox.embed_in.weight")},
+        "final_norm": _ln_in(sd, "gpt_neox.final_layer_norm"),
+        "embed_out": {"kernel": _np(sd, "embed_out.weight").T},
+    }
+    params.update(_maybe_stack(layers, cfg.scan_layers, "layers", "layer"))
+    return params
+
+
+def export_neox_weights(params, cfg) -> Dict[str, Array]:
+    """Our NeoXForCausalLM params -> HF ``GPTNeoXForCausalLM``
+    state_dict (inverse of :func:`load_neox_weights`)."""
+    H, D = cfg.num_heads, cfg.hidden_size
+    hd = cfg.head_dim
+    sd = {
+        "gpt_neox.embed_in.weight": np.asarray(
+            params["embed"]["embedding"]
+        ),
+        "embed_out.weight": np.asarray(params["embed_out"]["kernel"]).T,
+    }
+    _ln_out(sd, "gpt_neox.final_layer_norm", params["final_norm"])
+    for i, lyr in enumerate(_unstack(params, cfg, "layers", "layer")):
+        p = f"gpt_neox.layers.{i}."
+        _ln_out(sd, p + "input_layernorm", lyr["ln1"])
+        _ln_out(sd, p + "post_attention_layernorm", lyr["ln2"])
+        sd[p + "attention.query_key_value.weight"] = (
+            np.asarray(lyr["qkv"]["kernel"]).reshape(D, 3 * H * hd).T
+        )
+        sd[p + "attention.query_key_value.bias"] = np.asarray(
+            lyr["qkv"]["bias"]
+        ).reshape(3 * H * hd)
+        sd[p + "attention.dense.weight"] = (
+            np.asarray(lyr["attn_out"]["kernel"]).reshape(H * hd, D).T
+        )
+        sd[p + "attention.dense.bias"] = np.asarray(
+            lyr["attn_out"]["bias"]
+        )
+        _lin_out(sd, p + "mlp.dense_h_to_4h", lyr["mlp_up"])
+        _lin_out(sd, p + "mlp.dense_4h_to_h", lyr["mlp_down"])
+    return sd
+
+
+# --------------------------------------------------------------------------
 # BERT
 # --------------------------------------------------------------------------
 

@@ -57,6 +57,11 @@ from pytorch_distributed_tpu.models.gemma import (
     GemmaForCausalLM,
     gemma_partition_rules,
 )
+from pytorch_distributed_tpu.models.neox import (
+    NeoXConfig,
+    NeoXForCausalLM,
+    neox_partition_rules,
+)
 from pytorch_distributed_tpu.models.qwen2 import (
     Qwen2Config,
     Qwen2ForCausalLM,
@@ -93,6 +98,9 @@ __all__ = [
     "GemmaConfig",
     "GemmaForCausalLM",
     "gemma_partition_rules",
+    "NeoXConfig",
+    "NeoXForCausalLM",
+    "neox_partition_rules",
     "Qwen2Config",
     "Qwen2ForCausalLM",
     "qwen2_partition_rules",

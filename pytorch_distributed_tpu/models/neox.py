@@ -1,0 +1,253 @@
+"""GPT-NeoX / Pythia — parallel-residual decoder, beyond-reference.
+
+The Pythia suite (Biderman et al. 2023) is the research ecosystem's
+standard scaling ladder; its GPT-NeoX architecture differs from both
+GPT-2 and the Llama bodies, so it is a real third decoder block rather
+than a config variant:
+
+* **parallel residual**: ``x + attn(ln1(x)) + mlp(ln2(x))`` — attention
+  and MLP read the SAME input and their outputs add (one residual
+  junction per layer instead of two); ``use_parallel_residual=False``
+  restores the sequential form (used by the smallest NeoX models);
+* **partial rotary**: only the first ``rotary_pct`` of each head's dims
+  rotate, the tail passes through position-free;
+* **fused QKV in HF's per-head layout**: ``query_key_value`` packs
+  [head, (q,k,v), head_dim] along its output axis — the DenseGeneral
+  features ``(H, 3, hd)`` mirror it so interop is a reshape;
+* LayerNorm (with bias) everywhere, exact (erf) gelu MLP, untied
+  ``embed_out``.
+
+Decode, scan-over-layers, remat, and sharding ride the same shared
+machinery as every other family (``ops.attention``, ``models.scan``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_tpu.ops.attention import (
+    apply_rope,
+    attention,
+    rope_frequencies,
+)
+from pytorch_distributed_tpu.runtime.precision import current_policy
+
+
+@dataclasses.dataclass(frozen=True)
+class NeoXConfig:
+    vocab_size: int = 50_304
+    hidden_size: int = 2_048
+    num_layers: int = 16
+    num_heads: int = 8
+    intermediate_size: int = 8_192
+    max_seq_len: int = 2_048
+    rope_theta: float = 10_000.0
+    rotary_pct: float = 0.25
+    layer_norm_eps: float = 1e-5
+    use_parallel_residual: bool = True
+    scan_layers: bool = True
+    remat: bool = False
+    remat_policy: str = "full"
+
+    def __post_init__(self):
+        rot = int(self.head_dim * self.rotary_pct)
+        if rot < 2 or rot % 2:
+            raise ValueError(
+                f"rotary_pct {self.rotary_pct} gives rotary dim {rot} "
+                f"of head_dim {self.head_dim}; need an even dim >= 2"
+            )
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def rotary_dim(self) -> int:
+        return int(self.head_dim * self.rotary_pct)
+
+    @classmethod
+    def pythia_1b(cls) -> "NeoXConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "NeoXConfig":
+        return cls(
+            vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+            intermediate_size=128, max_seq_len=128, rotary_pct=0.5,
+        )
+
+
+def _partial_rope(x, cos, sin, positions):
+    """Rotate the first ``rot`` dims (the tables' width), pass the rest."""
+    rot = cos.shape[-1] * 2
+    if rot == x.shape[-1]:
+        return apply_rope(x, cos, sin, positions)
+    rotated = apply_rope(x[..., :rot], cos, sin, positions)
+    return jnp.concatenate([rotated, x[..., rot:]], axis=-1)
+
+
+class NeoXBlock(nn.Module):
+    config: NeoXConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin, positions, segment_ids, kv_mask,
+                 deterministic: bool, decode: bool = False,
+                 cache_len: Optional[int] = None):
+        cfg = self.config
+        policy = current_policy()
+        H, hd = cfg.num_heads, cfg.head_dim
+        ln = lambda name: nn.LayerNorm(  # noqa: E731
+            epsilon=cfg.layer_norm_eps, dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype, name=name,
+        )
+        h_attn = ln("ln1")(x)
+        qkv = nn.DenseGeneral(
+            (H, 3, hd), use_bias=True, dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype, name="qkv",
+        )(h_attn)  # HF per-head (q, k, v) packing
+        q, k, v = (qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :])
+        q = _partial_rope(q, cos, sin, positions)
+        k = _partial_rope(k, cos, sin, positions)
+        if decode:
+            from pytorch_distributed_tpu.ops.attention import decode_cache
+
+            k, v, offset = decode_cache(
+                self, k, v, cache_len or cfg.max_seq_len
+            )
+            attn = attention(
+                q, k, v, causal=True, q_offset=offset, mask=kv_mask
+            )
+        else:
+            attn = attention(
+                q, k, v, causal=True, segment_ids=segment_ids
+            )
+        attn = nn.DenseGeneral(
+            cfg.hidden_size, axis=(-2, -1), use_bias=True,
+            dtype=policy.compute_dtype, param_dtype=policy.param_dtype,
+            name="attn_out",
+        )(attn)
+
+        def mlp(h):
+            h = nn.Dense(
+                cfg.intermediate_size, use_bias=True,
+                dtype=policy.compute_dtype,
+                param_dtype=policy.param_dtype, name="mlp_up",
+            )(h)
+            h = nn.gelu(h, approximate=False)  # HF NeoX: exact gelu
+            return nn.Dense(
+                cfg.hidden_size, use_bias=True,
+                dtype=policy.compute_dtype,
+                param_dtype=policy.param_dtype, name="mlp_down",
+            )(h)
+
+        if cfg.use_parallel_residual:
+            # attention and MLP both read x (through their own norms);
+            # ONE residual junction: x + attn + mlp
+            return x + attn + mlp(ln("ln2")(x))
+        x = x + attn
+        return x + mlp(ln("ln2")(x))
+
+
+class NeoXForCausalLM(nn.Module):
+    """Returns [B, S, vocab] logits; untied ``embed_out`` (Pythia)."""
+
+    config: NeoXConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids,
+        positions: Optional[jnp.ndarray] = None,
+        *,
+        segment_ids: Optional[jnp.ndarray] = None,
+        kv_mask: Optional[jnp.ndarray] = None,
+        train: bool = False,
+        decode: bool = False,
+        cache_len: Optional[int] = None,
+    ):
+        cfg = self.config
+        policy = current_policy()
+        B, S = input_ids.shape
+        if cache_len is not None and cache_len > cfg.max_seq_len:
+            raise ValueError(
+                f"cache_len {cache_len} > max_seq_len {cfg.max_seq_len}"
+            )
+        x = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size,
+            param_dtype=policy.param_dtype, dtype=policy.compute_dtype,
+            name="embed",
+        )(input_ids)
+        if decode:
+            from pytorch_distributed_tpu.ops.attention import (
+                decode_positions,
+            )
+
+            auto = jnp.broadcast_to(
+                decode_positions(self, S)[None, :], (B, S)
+            )
+            if positions is None:
+                positions = auto
+        if segment_ids is not None and decode:
+            raise ValueError(
+                "segment_ids (packed training) and decode (KV cache) are "
+                "mutually exclusive"
+            )
+        if kv_mask is not None and not decode:
+            raise ValueError(
+                "kv_mask is for KV-cache decode (left-padded prompts); "
+                "training masks go through the loss/segment machinery"
+            )
+        if decode:
+            table_len = cache_len or cfg.max_seq_len
+        elif positions is None:
+            table_len = S
+        else:
+            table_len = cfg.max_seq_len
+        cos, sin = rope_frequencies(
+            cfg.rotary_dim, table_len, cfg.rope_theta
+        )
+        if cfg.scan_layers:
+            from pytorch_distributed_tpu.models.scan import scan_stack
+
+            x = scan_stack(
+                NeoXBlock, cfg, static_argnums=(6, 7, 8), name="layers"
+            )(x, cos, sin, positions, segment_ids, kv_mask, not train,
+              decode, cache_len)
+        else:
+            for i in range(cfg.num_layers):
+                x = NeoXBlock(cfg, name=f"layer{i}")(
+                    x, cos, sin, positions, segment_ids, kv_mask,
+                    deterministic=not train,
+                    decode=decode, cache_len=cache_len,
+                )
+        x = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype, name="final_norm",
+        )(x)
+        logits = nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype, name="embed_out",
+        )(x)
+        return logits.astype(policy.output_dtype)
+
+
+def neox_partition_rules():
+    """Megatron TP: the fused qkv shards on its head axis, attn_out on
+    the same axis (its input side), the MLP on its hidden dim."""
+    from pytorch_distributed_tpu.parallel.sharding import stacked
+
+    return [
+        (r"/qkv/kernel", stacked(P(None, "tp", None, None))),
+        (r"/qkv/bias", stacked(P("tp", None, None))),
+        (r"/attn_out/kernel", stacked(P("tp", None, None))),
+        (r"/mlp_up/kernel", stacked(P(None, "tp"))),
+        (r"/mlp_up/bias", stacked(P("tp"))),
+        (r"/mlp_down/kernel", stacked(P("tp", None))),
+        (r"embed/embedding", P(None, "tp")),
+        (r"embed_out/kernel", P(None, "tp")),
+    ]
