@@ -450,6 +450,51 @@ def export_mixtral_weights(params, cfg) -> Dict[str, Array]:
 
 
 # --------------------------------------------------------------------------
+# Phi-3 (Llama body; HF fuses qkv_proj and gate_up_proj)
+# --------------------------------------------------------------------------
+
+def load_phi3_weights(sd: StateDict, cfg) -> Dict:
+    """HF ``Phi3ForCausalLM`` state_dict -> params for
+    :class:`~pytorch_distributed_tpu.models.phi3.Phi3ForCausalLM`.
+
+    Splits the fused ``qkv_proj`` ([q | k | v] along the out axis) and
+    ``gate_up_proj`` ([gate | up]) into the per-projection keys the
+    shared Llama body mapper expects, then delegates to it."""
+    qd = cfg.num_heads * cfg.head_dim
+    kd = cfg.num_kv_heads * cfg.head_dim
+    F = cfg.intermediate_size
+    virt = dict(sd)
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        qkv = _np(sd, p + "self_attn.qkv_proj.weight")  # [qd+2kd, D]
+        virt[p + "self_attn.q_proj.weight"] = qkv[:qd]
+        virt[p + "self_attn.k_proj.weight"] = qkv[qd:qd + kd]
+        virt[p + "self_attn.v_proj.weight"] = qkv[qd + kd:]
+        gu = _np(sd, p + "mlp.gate_up_proj.weight")  # [2F, D]
+        virt[p + "mlp.gate_proj.weight"] = gu[:F]
+        virt[p + "mlp.up_proj.weight"] = gu[F:]
+    return load_llama_weights(virt, cfg)
+
+
+def export_phi3_weights(params, cfg) -> Dict[str, Array]:
+    """Our Phi3ForCausalLM params -> HF ``Phi3ForCausalLM`` state_dict
+    (re-fuses what :func:`load_phi3_weights` split)."""
+    sd = export_llama_weights(params, cfg)
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        sd[p + "self_attn.qkv_proj.weight"] = np.concatenate([
+            sd.pop(p + "self_attn.q_proj.weight"),
+            sd.pop(p + "self_attn.k_proj.weight"),
+            sd.pop(p + "self_attn.v_proj.weight"),
+        ])
+        sd[p + "mlp.gate_up_proj.weight"] = np.concatenate([
+            sd.pop(p + "mlp.gate_proj.weight"),
+            sd.pop(p + "mlp.up_proj.weight"),
+        ])
+    return sd
+
+
+# --------------------------------------------------------------------------
 # GPT-NeoX / Pythia
 # --------------------------------------------------------------------------
 

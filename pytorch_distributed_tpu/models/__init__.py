@@ -62,6 +62,11 @@ from pytorch_distributed_tpu.models.neox import (
     NeoXForCausalLM,
     neox_partition_rules,
 )
+from pytorch_distributed_tpu.models.phi3 import (
+    Phi3Config,
+    Phi3ForCausalLM,
+    phi3_partition_rules,
+)
 from pytorch_distributed_tpu.models.qwen2 import (
     Qwen2Config,
     Qwen2ForCausalLM,
@@ -101,6 +106,9 @@ __all__ = [
     "NeoXConfig",
     "NeoXForCausalLM",
     "neox_partition_rules",
+    "Phi3Config",
+    "Phi3ForCausalLM",
+    "phi3_partition_rules",
     "Qwen2Config",
     "Qwen2ForCausalLM",
     "qwen2_partition_rules",
