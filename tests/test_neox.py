@@ -64,6 +64,7 @@ def test_neox_logits_match_hf_sequential_residual():
     _logits_match(hf, cfg)
 
 
+@pytest.mark.slow  # budget: parity (both topologies) pins the mapping fast
 def test_neox_export_roundtrips_into_hf():
     from pytorch_distributed_tpu.interop import (
         export_neox_weights,

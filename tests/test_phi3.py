@@ -49,6 +49,7 @@ def test_phi3_logits_match_hf():
     np.testing.assert_allclose(np.asarray(got), want, atol=3e-4, rtol=2e-4)
 
 
+@pytest.mark.slow  # budget: parity pins the split mapping fast
 def test_phi3_export_refuses_nothing_and_roundtrips():
     from pytorch_distributed_tpu.interop import (
         export_phi3_weights,
