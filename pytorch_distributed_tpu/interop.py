@@ -180,6 +180,25 @@ def _llama_body_import(sd: StateDict, cfg, ffn_fn) -> Dict:
     H, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.hidden_size
     hd = cfg.head_dim
     attn_bias = getattr(cfg, "attention_bias", False)  # Qwen2: q/k/v only
+    qk_norm = getattr(cfg, "qk_norm", False)  # Qwen3: per-head q/k RMSNorm
+
+    # refuse, don't drop: a checkpoint whose attention carries structure
+    # the cfg doesn't enable (biases, QK norms) would load "fine" and
+    # silently diverge from HF — same invariant as the tied/untied
+    # lm_head guard below
+    p0 = "model.layers.0.self_attn."
+    if not attn_bias and p0 + "q_proj.bias" in sd:
+        raise ValueError(
+            "checkpoint has attention projection biases but the config "
+            "has attention_bias=False — a Qwen2-style checkpoint; fix "
+            "the config instead of losing the biases"
+        )
+    if not qk_norm and p0 + "q_norm.weight" in sd:
+        raise ValueError(
+            "checkpoint has q_norm/k_norm weights but the config has "
+            "qk_norm=False — a Qwen3-style checkpoint; fix the config "
+            "instead of losing the norms"
+        )
 
     def block(i):
         p = f"model.layers.{i}."
@@ -215,6 +234,13 @@ def _llama_body_import(sd: StateDict, cfg, ffn_fn) -> Dict:
                 tree[name]["bias"] = _np(
                     sd, p + f"self_attn.{name}_proj.bias"
                 ).reshape(heads, hd)
+        if qk_norm:
+            tree["q_norm"] = {
+                "scale": _np(sd, p + "self_attn.q_norm.weight")
+            }
+            tree["k_norm"] = {
+                "scale": _np(sd, p + "self_attn.k_norm.weight")
+            }
         tree.update(ffn_fn(p))
         return tree
 
@@ -289,6 +315,13 @@ def _llama_body_export(params, cfg, ffn_fn) -> Dict[str, Array]:
                 sd[p + f"self_attn.{name}_proj.bias"] = np.asarray(
                     lyr[name]["bias"]
                 ).reshape(-1)
+        if getattr(cfg, "qk_norm", False):
+            sd[p + "self_attn.q_norm.weight"] = np.asarray(
+                lyr["q_norm"]["scale"]
+            )
+            sd[p + "self_attn.k_norm.weight"] = np.asarray(
+                lyr["k_norm"]["scale"]
+            )
         sd[p + "post_attention_layernorm.weight"] = np.asarray(
             lyr["mlp_norm"]["scale"]
         )
@@ -378,6 +411,11 @@ export_mistral_weights = export_llama_weights
 # cfg.attention_bias, so the Llama functions handle it given a Qwen2Config.
 load_qwen2_weights = load_llama_weights
 export_qwen2_weights = export_llama_weights
+
+# Qwen3 = Llama layout + per-layer q_norm/k_norm scales; the shared body
+# mapper reads cfg.qk_norm, so the Llama functions handle it.
+load_qwen3_weights = load_llama_weights
+export_qwen3_weights = export_llama_weights
 
 # Gemma's state_dict layout is also Llama's (the norm offset, gelu gate,
 # embed scaling, and explicit head_dim are semantics, not weights); tied

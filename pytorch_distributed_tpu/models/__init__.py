@@ -72,6 +72,11 @@ from pytorch_distributed_tpu.models.qwen2 import (
     Qwen2ForCausalLM,
     qwen2_partition_rules,
 )
+from pytorch_distributed_tpu.models.qwen3 import (
+    Qwen3Config,
+    Qwen3ForCausalLM,
+    qwen3_partition_rules,
+)
 from pytorch_distributed_tpu.models.mixtral import (
     MixtralConfig,
     MixtralForCausalLM,
@@ -112,6 +117,9 @@ __all__ = [
     "Qwen2Config",
     "Qwen2ForCausalLM",
     "qwen2_partition_rules",
+    "Qwen3Config",
+    "Qwen3ForCausalLM",
+    "qwen3_partition_rules",
     "MixtralConfig",
     "MixtralForCausalLM",
     "mixtral_partition_rules",

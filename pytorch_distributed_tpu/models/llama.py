@@ -77,6 +77,9 @@ class LlamaConfig:
     # Lossy: greedy decode agrees with the exact cache on most tokens
     # but is not bitwise identical.
     kv_cache_quantize: Optional[str] = None
+    # per-head RMSNorm on q and k before RoPE (Qwen3 / OLMo-2 /
+    # Gemma-3 idiom) — stabilizes attention logits at scale
+    qk_norm: bool = False
     # scan over layers (models/scan.py): one compiled block, [L, ...]
     # stacked params. False restores the unrolled per-layer tree.
     scan_layers: bool = True
@@ -193,6 +196,11 @@ class LlamaBlock(nn.Module):
         q = dense((cfg.num_heads, cfg.head_dim), "q", use_bias=ab)(h)
         k = dense((cfg.num_kv_heads, cfg.head_dim), "k", use_bias=ab)(h)
         v = dense((cfg.num_kv_heads, cfg.head_dim), "v", use_bias=ab)(h)
+        if cfg.qk_norm:
+            # per-head RMSNorm over head_dim, BEFORE rotary (Qwen3's
+            # q_norm/k_norm: one [head_dim] scale shared across heads)
+            q = RMSNorm(cfg.rms_eps, cfg.rms_offset, name="q_norm")(q)
+            k = RMSNorm(cfg.rms_eps, cfg.rms_offset, name="k_norm")(k)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
         if decode:

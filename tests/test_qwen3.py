@@ -1,0 +1,136 @@
+"""Qwen3: HF logit parity with the QK norms made BINDING (HF inits the
+norm scales to ones — identity — so they are randomized first; a
+mis-wired norm then fails parity), roundtrip, decode."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import pytorch_distributed_tpu as ptd
+from pytorch_distributed_tpu.models import Qwen3Config, Qwen3ForCausalLM
+from pytorch_distributed_tpu.runtime.precision import autocast
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _sd(model):
+    return {k: v.detach().numpy() for k, v in model.state_dict().items()}
+
+
+def _pair():
+    torch.manual_seed(0)
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=211, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16,  # != hidden/heads = 12: the decoupling is binding
+        rope_theta=1e6, rms_norm_eps=1e-6, max_position_embeddings=128,
+        tie_word_embeddings=False, attn_implementation="eager",
+    )
+    hf = transformers.Qwen3ForCausalLM(hf_cfg).eval()
+    # the q/k norm scales init to ONES (identity) — randomize so the
+    # parity check actually exercises the normalization wiring
+    with torch.no_grad():
+        for n, p in hf.named_parameters():
+            if "q_norm" in n or "k_norm" in n:
+                p.normal_(1.0, 0.5)
+    cfg = Qwen3Config(
+        vocab_size=211, hidden_size=48, intermediate_size=96, num_layers=2,
+        num_heads=4, num_kv_heads=2, override_head_dim=16,
+        max_seq_len=128, rope_theta=1e6, rms_eps=1e-6,
+    )
+    return hf, cfg
+
+
+def test_qwen3_logits_match_hf():
+    from pytorch_distributed_tpu.interop import load_qwen3_weights
+
+    hf, cfg = _pair()
+    params = load_qwen3_weights(_sd(hf), cfg)
+    block = params["layers"]["block"]
+    assert block["q_norm"]["scale"].shape == (2, 16)  # [L, head_dim]
+    ids = np.random.default_rng(0).integers(2, 211, size=(2, 10)).astype(
+        np.int32
+    )
+    with torch.no_grad():
+        want = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    with autocast(enabled=False):
+        got = Qwen3ForCausalLM(cfg).apply({"params": params}, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(got), want, atol=3e-4, rtol=2e-4)
+
+
+@pytest.mark.slow  # budget: parity pins the mapping fast
+def test_qwen3_export_roundtrips_into_hf():
+    from pytorch_distributed_tpu.interop import (
+        export_qwen3_weights,
+        load_qwen3_weights,
+    )
+
+    hf, cfg = _pair()
+    params = load_qwen3_weights(_sd(hf), cfg)
+    sd = export_qwen3_weights(params, cfg)
+    hf2 = transformers.Qwen3ForCausalLM(hf.config).eval()
+    hf2.load_state_dict({k: torch.tensor(v) for k, v in sd.items()})
+    ids = torch.tensor(
+        np.random.default_rng(1).integers(2, 211, size=(1, 8)).astype(
+            np.int64
+        )
+    )
+    with torch.no_grad():
+        np.testing.assert_allclose(
+            hf2(ids).logits.numpy(), hf(ids).logits.numpy(),
+            atol=1e-5, rtol=1e-5,
+        )
+
+
+@pytest.mark.slow  # the gpt2/mistral decode pins cover the machinery fast
+def test_qwen3_cache_decode_equals_recompute():
+    cfg = Qwen3Config.tiny()
+    model = Qwen3ForCausalLM(cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(2, 500, size=(2, 6)), jnp.int32
+    )
+    params = model.init(jax.random.key(0), ids)["params"]
+    got = ptd.generate(model, params, ids, max_new_tokens=4, temperature=0.0)
+    seq = np.asarray(ids)
+    for _ in range(4):
+        logits = model.apply({"params": params}, jnp.asarray(seq))
+        nxt = np.asarray(jnp.argmax(logits[:, -1], -1))
+        seq = np.concatenate([seq, nxt[:, None].astype(seq.dtype)], 1)
+    np.testing.assert_array_equal(np.asarray(got), seq)
+
+
+def test_mismatched_config_refused_not_dropped():
+    """A Qwen3 checkpoint under qk_norm=False (and a Qwen2 one under
+    attention_bias=False) must refuse loudly — silently dropping the
+    extra attention structure diverges from HF."""
+    import dataclasses
+
+    from pytorch_distributed_tpu.interop import load_llama_weights
+
+    hf, cfg = _pair()
+    sd = _sd(hf)
+    with pytest.raises(ValueError, match="qk_norm"):
+        load_llama_weights(sd, dataclasses.replace(cfg, qk_norm=False))
+
+    torch.manual_seed(1)
+    q2 = transformers.Qwen2ForCausalLM(
+        transformers.Qwen2Config(
+            vocab_size=211, hidden_size=48, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, tie_word_embeddings=False,
+        )
+    ).eval()
+    from pytorch_distributed_tpu.models import Qwen2Config as OurQwen2
+
+    bad = dataclasses.replace(
+        OurQwen2(
+            vocab_size=211, hidden_size=48, intermediate_size=96,
+            num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128,
+        ),
+        attention_bias=False,
+    )
+    with pytest.raises(ValueError, match="attention_bias"):
+        load_llama_weights(_sd(q2), bad)
