@@ -177,6 +177,20 @@ def generate_speculative(
     k = num_draft_tokens
     if k < 1:
         raise ValueError(f"num_draft_tokens must be >= 1, got {k}")
+    for name, model in (("target", target_model), ("draft", draft_model)):
+        if getattr(getattr(model, "config", None), "sliding_window", None):
+            # the band mask measures distance in cache SLOTS; these
+            # append-only caches contain rejected-proposal bubbles, so
+            # slot distance != token distance and the window would
+            # silently clip/admit the wrong keys (measured: tokens
+            # diverge from target-only greedy exactly when the sequence
+            # crosses the window boundary)
+            raise NotImplementedError(
+                f"speculative decoding over a sliding-window {name} "
+                "model: banding the bubbled append-only cache needs "
+                "true-token-position banding (not implemented) — decode "
+                "non-speculatively, or serve with the window disabled"
+            )
 
     B, P = prompt_ids.shape
     # worst case (one accepted token per round): the prefill emits the

@@ -1,0 +1,72 @@
+"""r5 feature-composition pins: the new families ride the EXISTING
+serving machinery without special cases — speculative decoding over a
+sliding-window target, LoRA adapters over a sparse-MoE base."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import pytorch_distributed_tpu as ptd
+from pytorch_distributed_tpu.models import (
+    MistralConfig,
+    MistralForCausalLM,
+    MixtralConfig,
+    MixtralForCausalLM,
+)
+
+
+@pytest.mark.slow  # composition pin; each side's own suite runs fast
+def test_speculative_refuses_windowed_models():
+    """Speculative decoding over a sliding-window model must REFUSE:
+    the band mask measures distance in cache slots, and the bubbled
+    append-only caches make slot distance != token distance — writing
+    this test against equality first PROVED the silent divergence
+    (tokens split from target-only greedy exactly at the window
+    boundary), so the guard exists because of a measured wrong answer,
+    not caution."""
+    from pytorch_distributed_tpu.speculative import generate_speculative
+
+    t_cfg = MistralConfig.tiny()  # window=8
+    target = MistralForCausalLM(t_cfg)
+    draft = MistralForCausalLM(t_cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(2, 500, size=(2, 5)), jnp.int32
+    )
+    tp = target.init(jax.random.key(0), ids)["params"]
+    dp = draft.init(jax.random.key(1), ids)["params"]
+    with pytest.raises(NotImplementedError, match="sliding-window"):
+        generate_speculative(
+            target, tp, draft, dp, ids, max_new_tokens=8,
+            num_draft_tokens=3,
+        )
+
+
+@pytest.mark.slow  # composition pin
+def test_lora_identity_at_init_on_moe_base():
+    """LoRA over a Mixtral base: adapters attach to the attention/router
+    DenseGeneral kernels (expert tensors are not kernels and stay
+    frozen), and zero-init B keeps the wrapped model bitwise identical
+    at init — the invariant every dense family pins, now on sparse."""
+    from pytorch_distributed_tpu.lora import LoRAModel, lora_init
+
+    cfg = MixtralConfig.tiny()
+    model = MixtralForCausalLM(cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(2, 500, size=(2, 8)), jnp.int32
+    )
+    params = model.init(jax.random.key(0), ids)["params"]
+    adapters = lora_init(jax.random.key(1), params, rank=4)
+    assert len(jax.tree_util.tree_leaves(adapters)) > 0
+    # expert tensors are untouched by the adapter tree
+    flat = {
+        "/".join(str(getattr(k, "key", k)) for k in path)
+        for path, _ in jax.tree_util.tree_flatten_with_path(adapters)[0]
+    }
+    assert not any("w_in" in p or "w_out" in p or "w_gate" in p
+                   for p in flat), sorted(flat)[:5]
+    wrapped = LoRAModel(model, params)
+    base = model.apply({"params": params}, ids)
+    lora_out = wrapped.apply({"params": adapters}, ids)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(lora_out))
