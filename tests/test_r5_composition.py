@@ -70,3 +70,46 @@ def test_lora_identity_at_init_on_moe_base():
     base = model.apply({"params": params}, ids)
     lora_out = wrapped.apply({"params": adapters}, ids)
     np.testing.assert_array_equal(np.asarray(base), np.asarray(lora_out))
+
+
+@pytest.mark.slow  # composition pin
+def test_ragged_windowed_generate_matches_solo_rows():
+    """Left-padded ragged batches under a BINDING sliding window: the
+    band mask measures slot distance, and with left padding every
+    real token's slot is its true position plus a per-row constant —
+    so slot differences equal token differences and each row must
+    reproduce its unpadded solo continuation exactly, window included."""
+    cfg = MistralConfig.tiny()  # window=8
+    model = MistralForCausalLM(cfg)
+    rng = np.random.default_rng(5)
+    p1 = rng.integers(1, cfg.vocab_size, size=4).astype(np.int32)
+    p2 = rng.integers(1, cfg.vocab_size, size=7).astype(np.int32)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 7), jnp.int32)
+    )["params"]
+
+    NEW = 6  # 7 + 6 > window=8: the band is binding for the long row
+    solo = [
+        np.asarray(
+            ptd.generate(
+                model, params, jnp.asarray(p[None, :]),
+                max_new_tokens=NEW, temperature=0.0,
+            )
+        )[0, len(p):]
+        for p in (p1, p2)
+    ]
+    P = 7
+    ids = np.zeros((2, P), np.int32)
+    mask = np.zeros((2, P), bool)
+    ids[0, P - 4:] = p1
+    mask[0, P - 4:] = True
+    ids[1, :] = p2
+    mask[1, :] = True
+    out = np.asarray(
+        ptd.generate(
+            model, params, jnp.asarray(ids), max_new_tokens=NEW,
+            temperature=0.0, prompt_mask=jnp.asarray(mask),
+        )
+    )
+    np.testing.assert_array_equal(out[0, P:], solo[0])
+    np.testing.assert_array_equal(out[1, P:], solo[1])
