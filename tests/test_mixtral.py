@@ -282,3 +282,34 @@ def test_mixtral_int4_scan_dequant_serving():
     a = QuantizedModel(model).apply({"params": q}, ids)
     b = qmodel.apply({"params": q}, ids)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow  # serving-path pin; the forward equality runs fast
+def test_mixtral_int4_scan_dequant_decode():
+    """Greedy decode through the per-layer scan-dequant MoE serving
+    path == decode through whole-tree dequant over the SAME quantized
+    tree — the bitwise pin the dense families carry, on sparse."""
+    import dataclasses
+
+    from pytorch_distributed_tpu.ops import (
+        QuantizedModel,
+        quantize_for_scan_dequant,
+    )
+
+    cfg = MixtralConfig.tiny()
+    model = MixtralForCausalLM(cfg)
+    qmodel = MixtralForCausalLM(
+        dataclasses.replace(cfg, scan_dequant=True)
+    )
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(2, 500, size=(2, 5)), jnp.int32
+    )
+    params = model.init(jax.random.key(0), ids)["params"]
+    q = quantize_for_scan_dequant(params, "int4", min_size=512)
+    a = ptd.generate(
+        qmodel, q, ids, max_new_tokens=6, temperature=0.0
+    )
+    b = ptd.generate(
+        QuantizedModel(model), q, ids, max_new_tokens=6, temperature=0.0
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

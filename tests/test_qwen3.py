@@ -134,3 +134,43 @@ def test_mismatched_config_refused_not_dropped():
     )
     with pytest.raises(ValueError, match="attention_bias"):
         load_llama_weights(_sd(q2), bad)
+
+
+@pytest.mark.slow  # sharded-serving pin; parity runs fast
+def test_qwen3_tp_sharded_logits_match():
+    """QK-norm under tensor parallelism: q shards over heads while the
+    [head_dim] norm scales replicate — sharded logits match unsharded
+    to numerical tolerance in f32. (Token-identity is NOT asserted:
+    under the default bf16 compute policy, GSPMD's differently-ordered
+    reductions move logits by ~1e-2 — enough to flip near-tie argmaxes
+    on a random-init 512-vocab model, observed 3/20; in f32 the sharded
+    logits agree to ~1e-5, which is what this pins.)"""
+    import optax
+
+    from pytorch_distributed_tpu.models import qwen3_partition_rules
+    from pytorch_distributed_tpu.parallel import DataParallel
+    from pytorch_distributed_tpu.runtime.mesh import MeshSpec
+    from pytorch_distributed_tpu.train import TrainState
+
+    # tp must divide the 2 kv heads of the tiny config
+    ptd.init_process_group(mesh_spec=MeshSpec(dp=4, tp=2))
+    cfg = Qwen3Config.tiny()
+    model = Qwen3ForCausalLM(cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(2, 500, size=(2, 8)), jnp.int32
+    )
+    params = model.init(jax.random.key(0), ids)["params"]
+    with autocast(enabled=False):  # f32: isolate sharding effects from
+        want = model.apply({"params": params}, ids)  # bf16 reorder noise
+    strategy = DataParallel(extra_rules=qwen3_partition_rules())
+    state = strategy.place(TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.sgd(0.1)
+    ))
+    block = state.params["layers"]["block"]
+    assert "tp" in str(block["q"]["kernel"].sharding.spec)
+    assert "tp" not in str(block["q_norm"]["scale"].sharding.spec)
+    with autocast(enabled=False):
+        got = model.apply({"params": state.params}, ids)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+    )
