@@ -113,3 +113,28 @@ def test_ragged_windowed_generate_matches_solo_rows():
     )
     np.testing.assert_array_equal(out[0, P:], solo[0])
     np.testing.assert_array_equal(out[1, P:], solo[1])
+
+
+@pytest.mark.slow  # composition pin
+def test_beam_over_windowed_model_matches_naive_reference():
+    """Beam search over a sliding-window model: beam caches are
+    CONTIGUOUS (no bubbles — the reorder gathers whole rows), so slot
+    distance == token distance and the band mask is valid; pinned
+    against the exact full-recompute beam reference, crossing the
+    window boundary."""
+    from pytorch_distributed_tpu.generation import generate_beam
+    from tests.test_generation import _naive_beam
+
+    cfg = MistralConfig.tiny()  # window=8
+    model = MistralForCausalLM(cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(2).integers(2, 500, size=(2, 5)), jnp.int32
+    )
+    params = model.init(jax.random.key(0), ids)["params"]
+    NEW, K = 6, 3  # 5 + 6 > 8: the band is binding
+    got = np.asarray(
+        generate_beam(model, params, ids, max_new_tokens=NEW, num_beams=K)
+    )
+    for r in range(2):
+        want = _naive_beam(model, params, np.asarray(ids)[r], NEW, K)
+        np.testing.assert_array_equal(got[r], want)  # prompt + new
