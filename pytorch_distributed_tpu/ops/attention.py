@@ -391,14 +391,12 @@ def attention(
                 "attention-weight dropout is not supported inside "
                 "sequence-parallel mode"
             )
-        if window is not None:
-            # a band mask spans ring-shard boundaries; applying it per
-            # local shard would silently widen/narrow the window
-            raise NotImplementedError(
-                "sliding-window attention is not supported inside "
-                "sequence-parallel mode"
-            )
-        return sequence_parallel_attention(q, k, v, causal=causal)
+        # sliding windows are exact under BOTH impls: the ring carries
+        # true global positions for its band mask, and ulysses holds
+        # the full sequence per head subset after its all-to-all
+        return sequence_parallel_attention(
+            q, k, v, causal=causal, window=window
+        )
     use_flash = False
     # the kernel covers full, causal, [B, T] key-padding masks, packed
     # segment ids, and custom softmax scales (T5's 1.0 rides through as

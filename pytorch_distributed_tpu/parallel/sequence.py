@@ -46,8 +46,14 @@ def _block_attn_parts(
     k_pos: jnp.ndarray,  # [T] global positions of this step's keys
     causal: bool,
     scale: float,
+    window=None,
 ):
-    """Unnormalized block attention: (o=[B,S,Hkv,G,D] f32, m, l=[B,Hkv,G,S,1])."""
+    """Unnormalized block attention: (o=[B,S,Hkv,G,D] f32, m, l=[B,Hkv,G,S,1]).
+
+    ``window``: sliding-window band on top of causal — the ring carries
+    TRUE GLOBAL positions for both sides, so the band is exact across
+    shard boundaries (slot-index banding would be wrong here).
+    """
     B, S, Hq, D = q.shape
     _, T, Hkv, _ = k.shape
     G = Hq // Hkv
@@ -56,12 +62,15 @@ def _block_attn_parts(
         jnp.einsum("bskgd,btkd->bkgst", qg, k, preferred_element_type=jnp.float32)
         * scale
     )  # [B, Hkv, G, S, T]
-    if causal:
+    mask = None
+    if causal or window is not None:
         mask = q_pos[:, None] >= k_pos[None, :]  # [S, T]
+        if window is not None:
+            mask = mask & (q_pos[:, None] - k_pos[None, :] < window)
         logits = jnp.where(mask[None, None, None], logits, _NEG_INF)
     m = jnp.max(logits, axis=-1, keepdims=True)  # [B,Hkv,G,S,1]
     p = jnp.exp(logits - m)
-    if causal:
+    if mask is not None:
         # a fully-masked block has m == -inf and exp(0) == 1 everywhere;
         # re-apply the mask on p so it contributes nothing
         p = jnp.where(mask[None, None, None], p, 0.0)
@@ -71,7 +80,7 @@ def _block_attn_parts(
 
 
 def _ring_attention_local(
-    q, k, v, *, axis_name: str, causal: bool, scale: float
+    q, k, v, *, axis_name: str, causal: bool, scale: float, window=None
 ):
     """Runs inside shard_map: q/k/v are the local sequence shards."""
     B, S, Hq, D = q.shape
@@ -88,7 +97,9 @@ def _ring_attention_local(
         o_acc, m_acc, l_acc = acc
         src = (my - t) % n  # whose K/V shard we hold at step t
         k_pos = src * T + jnp.arange(T)
-        o_t, m_t, l_t = _block_attn_parts(q, k_t, v_t, q_pos, k_pos, causal, scale)
+        o_t, m_t, l_t = _block_attn_parts(
+            q, k_t, v_t, q_pos, k_pos, causal, scale, window
+        )
         m_new = jnp.maximum(m_acc, m_t)
         alpha = jnp.exp(m_acc - m_new)
         beta = jnp.exp(m_t - m_new)
@@ -127,20 +138,26 @@ def ring_attention(
     axis: str = "sp",
     mesh: Optional[Mesh] = None,
     scale: Optional[float] = None,
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Exact attention with K/V rotated around the ``axis`` ring.
 
     Call on *global* arrays under jit; shard_map partitions S over ``axis``
     (batch over the data axes, heads over ``tp``) and the ring keeps every
-    chip's K/V working set at S/sp.
+    chip's K/V working set at S/sp. ``window`` adds the sliding-window
+    band (Mistral) over true global positions — exact across shard
+    boundaries.
     """
     mesh = mesh or current_mesh()
+    if window is not None and window <= 0:
+        raise ValueError(f"window must be positive, got {window}")
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     spec = P(data_axes(), axis, "tp", None)
     fn = shard_map(
         functools.partial(
-            _ring_attention_local, axis_name=axis, causal=causal, scale=scale
+            _ring_attention_local, axis_name=axis, causal=causal,
+            scale=scale, window=window,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -152,7 +169,10 @@ def ring_attention(
 
 def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, inner):
     """all_to_all S<->H re-shard; runs inside shard_map."""
-    # [B, S/sp, H, D] -> [B, S, H/sp, D]
+    # [B, S/sp, H, D] -> [B, S, H/sp, D]: after the re-shard each chip
+    # holds the FULL sequence for its head subset, so any sequence-wise
+    # mask (causal, sliding window) applies exactly as in the unsharded
+    # op — the inner closure carries it
     a2a = lambda x: lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
     inv = lambda x: lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
     out = inner(a2a(q), a2a(k), a2a(v), causal)
@@ -167,10 +187,13 @@ def ulysses_attention(
     causal: bool = False,
     axis: str = "sp",
     mesh: Optional[Mesh] = None,
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """DeepSpeed-Ulysses-style sequence parallelism: two all-to-alls around
     an ordinary full-sequence attention on a head subset. Heads (q and kv)
-    must be divisible by the ``axis`` size."""
+    must be divisible by the ``axis`` size. ``window`` = sliding-window
+    band (each chip sees the full sequence post-re-shard, so the band
+    applies exactly)."""
     mesh = mesh or current_mesh()
     sp = mesh.shape[axis]
     tp = mesh.shape.get("tp", 1)
@@ -194,13 +217,13 @@ def ulysses_attention(
             get_attention_impl,
         )
 
-        if get_attention_impl() == "flash":
+        if window is None and get_attention_impl() == "flash":
             from pytorch_distributed_tpu.ops.flash_attention import (
                 flash_attention,
             )
 
             return flash_attention(q, k, v, causal=causal)
-        return dot_product_attention(q, k, v, causal=causal)
+        return dot_product_attention(q, k, v, causal=causal, window=window)
 
     spec = P(data_axes(), axis, "tp", None)
     fn = shard_map(
@@ -264,9 +287,13 @@ def sequence_parallel_mode() -> Tuple[Optional[str], str]:
     return _SEQ_MODE
 
 
-def sequence_parallel_attention(q, k, v, *, causal: bool) -> jnp.ndarray:
+def sequence_parallel_attention(
+    q, k, v, *, causal: bool, window=None
+) -> jnp.ndarray:
     axis, impl = _SEQ_MODE
     assert axis is not None
     if impl == "ring":
-        return ring_attention(q, k, v, causal=causal, axis=axis)
-    return ulysses_attention(q, k, v, causal=causal, axis=axis)
+        return ring_attention(q, k, v, causal=causal, axis=axis,
+                              window=window)
+    return ulysses_attention(q, k, v, causal=causal, axis=axis,
+                             window=window)
