@@ -134,30 +134,55 @@ def relative_position_bucket(
 
 
 class RelativeBias(nn.Module):
-    """One [num_buckets, heads] table per stack; returns [1, H, S, T]."""
+    """Owns the per-stack [num_buckets, heads] table; returns it.
+
+    The bias itself is computed lazily by
+    :func:`relative_bias_from_table` — as a ``bias_fn(q_pos, k_pos)``
+    handed to the shared attention op, so unsharded paths materialize
+    it over their call's positions (exactly the old eager array) while
+    ring sequence parallelism evaluates it per block from true global
+    positions without anyone holding the full [S, T] bias (r5)."""
 
     config: T5Config
     bidirectional: bool
 
     @nn.compact
-    def __call__(self, q_positions, k_positions):
+    def __call__(self):
         cfg = self.config
         policy = current_policy()
-        table = self.param(
+        return self.param(
             "embedding",
             nn.initializers.normal(stddev=1.0),
             (cfg.relative_attention_num_buckets, cfg.num_heads),
             policy.param_dtype,
         )
-        rel = k_positions[None, :] - q_positions[:, None]  # [S, T]
-        bucket = relative_position_bucket(
-            rel,
-            bidirectional=self.bidirectional,
+
+
+def relative_bias_from_table(
+    table, q_positions, k_positions, *, bidirectional, num_buckets,
+    max_distance,
+):
+    """[num_buckets, H] table + positions -> additive bias [H, S, T]."""
+    rel = k_positions[None, :] - q_positions[:, None]  # [S, T]
+    bucket = relative_position_bucket(
+        rel, bidirectional=bidirectional, num_buckets=num_buckets,
+        max_distance=max_distance,
+    )
+    # interop-loaded trees can carry raw numpy leaves; numpy indexing
+    # with a TRACED bucket would try to concretize it
+    bias = jnp.asarray(table)[bucket]  # [S, T, H]
+    return jnp.transpose(bias, (2, 0, 1)).astype(jnp.float32)
+
+
+def _bias_fn_from_table(cfg, table, bidirectional):
+    def fn(q_pos, k_pos):
+        return relative_bias_from_table(
+            table, q_pos, k_pos, bidirectional=bidirectional,
             num_buckets=cfg.relative_attention_num_buckets,
             max_distance=cfg.relative_attention_max_distance,
         )
-        bias = table[bucket]  # [S, T, H]
-        return jnp.transpose(bias, (2, 0, 1))[None].astype(jnp.float32)
+
+    return fn
 
 
 def _dense(n, name):
@@ -179,7 +204,7 @@ class T5Attention(nn.Module):
         self,
         x,
         kv_source=None,  # None = self-attention
-        bias=None,
+        bias_fn=None,  # position-computed relative bias (stack-owned)
         mask=None,
         decode: bool = False,
         cache_len: Optional[int] = None,
@@ -228,14 +253,14 @@ class T5Attention(nn.Module):
             k, v, offset = decode_cache(self, k, v, cache_len)
             attn = attention(
                 q, k, v, causal=self.causal, q_offset=offset, mask=mask,
-                bias=bias, scale=1.0,
+                bias_fn=bias_fn, scale=1.0,
                 dropout_rate=drop_rate, dropout_rng=drop_rng,
             )
         else:
             k = _dense((H, D), "k")(x)
             v = _dense((H, D), "v")(x)
             attn = attention(
-                q, k, v, causal=self.causal, mask=mask, bias=bias,
+                q, k, v, causal=self.causal, mask=mask, bias_fn=bias_fn,
                 scale=1.0,
                 dropout_rate=drop_rate, dropout_rng=drop_rng,
             )
@@ -269,7 +294,7 @@ class T5EncoderBlock(nn.Module):
     config: T5Config
 
     @nn.compact
-    def __call__(self, x, bias, enc_mask, deterministic: bool):
+    def __call__(self, x, bias_table, enc_mask, deterministic: bool):
         cfg = self.config
         drop = lambda h: nn.Dropout(cfg.dropout_rate)(  # noqa: E731
             h, deterministic=deterministic
@@ -277,7 +302,8 @@ class T5EncoderBlock(nn.Module):
         h = T5LayerNorm(cfg.layer_norm_eps, name="attn_norm")(x)
         x = x + drop(
             T5Attention(cfg, name="attn")(
-                h, bias=bias, mask=enc_mask, deterministic=deterministic
+                h, bias_fn=_bias_fn_from_table(cfg, bias_table, True),
+                mask=enc_mask, deterministic=deterministic,
             )
         )
         h = T5LayerNorm(cfg.layer_norm_eps, name="ffn_norm")(x)
@@ -291,7 +317,7 @@ class T5DecoderBlock(nn.Module):
 
     @nn.compact
     def __call__(
-        self, x, bias, enc_out, enc_mask, deterministic: bool,
+        self, x, bias_table, enc_out, enc_mask, deterministic: bool,
         decode: bool = False, cache_len: Optional[int] = None,
     ):
         cfg = self.config
@@ -301,7 +327,8 @@ class T5DecoderBlock(nn.Module):
         h = T5LayerNorm(cfg.layer_norm_eps, name="attn_norm")(x)
         x = x + drop(
             T5Attention(cfg, causal=True, name="attn")(
-                h, bias=bias, decode=decode, cache_len=cache_len,
+                h, bias_fn=_bias_fn_from_table(cfg, bias_table, False),
+                decode=decode, cache_len=cache_len,
                 deterministic=deterministic,
             )
         )
@@ -340,13 +367,9 @@ class T5Encoder(nn.Module):
     @nn.compact
     def __call__(self, x, enc_mask, deterministic: bool):
         cfg = self.config
-        S = x.shape[1]
-        pos = jnp.arange(S)
-        bias = RelativeBias(cfg, bidirectional=True, name="rel_bias")(
-            pos, pos
-        )
+        table = RelativeBias(cfg, bidirectional=True, name="rel_bias")()
         x = _stack(T5EncoderBlock, cfg, "layers", static_argnums=(3,))(
-            x, bias, enc_mask, deterministic
+            x, table, enc_mask, deterministic
         )
         x = T5LayerNorm(cfg.layer_norm_eps, name="final_norm")(x)
         return nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
@@ -367,17 +390,14 @@ class T5Decoder(nn.Module):
                 decode_positions,
             )
 
-            q_pos = decode_positions(self, S)
-            k_pos = jnp.arange(cache_len)
-        else:
-            q_pos = jnp.arange(S)
-            k_pos = q_pos
-        bias = RelativeBias(cfg, bidirectional=False, name="rel_bias")(
-            q_pos, k_pos
-        )
+            # the counter is kept for cache-layout stability; the bias
+            # positions now come from each block's decode q_offset (the
+            # cache index — the same value), via bias_fn materialization
+            decode_positions(self, S)
+        table = RelativeBias(cfg, bidirectional=False, name="rel_bias")()
         x = _stack(
             T5DecoderBlock, cfg, "layers", static_argnums=(4, 5, 6)
-        )(x, bias, enc_out, enc_mask, deterministic, decode, cache_len)
+        )(x, table, enc_out, enc_mask, deterministic, decode, cache_len)
         x = T5LayerNorm(cfg.layer_norm_eps, name="final_norm")(x)
         return nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
 

@@ -348,8 +348,19 @@ def attention(
     dropout_rate: float = 0.0,
     dropout_rng=None,
     window: Optional[int] = None,
+    bias_fn=None,
 ) -> jnp.ndarray:
-    """Dispatching attention: models call this instead of an impl directly."""
+    """Dispatching attention: models call this instead of an impl directly.
+
+    ``bias_fn(q_pos [S], k_pos [T]) -> [Hq, S, T]`` is the
+    position-COMPUTED form of ``bias`` (T5 buckets, ALiBi slopes):
+    unsharded paths materialize it once over the call's positions, and
+    RING sequence parallelism evaluates it per block from TRUE GLOBAL
+    positions — the form that lets relative-position models (T5, ALiBi)
+    run sequence-parallel without anyone materializing the full [S, T]
+    bias (ulysses refuses it toward ring — see ulysses_attention).
+    Mutually exclusive with ``bias``.
+    """
     from pytorch_distributed_tpu.parallel.sequence import (
         sequence_parallel_attention,
         sequence_parallel_mode,
@@ -376,12 +387,14 @@ def attention(
                 "packed (segment_ids) attention is not supported inside "
                 "sequence-parallel mode"
             )
-        if bias is not None or scale is not None:
-            # a relative-position bias spans the FULL sequence; applying
-            # it to a local ring shard would silently misalign buckets
+        if bias is not None:
+            # a MATERIALIZED bias spans the full sequence; slicing it
+            # per ring shard would misalign buckets. The supported form
+            # is bias_fn, evaluated per block from global positions.
             raise NotImplementedError(
-                "additive bias / custom scale attention (T5, ALiBi) is "
-                "not supported inside sequence-parallel mode"
+                "materialized additive bias is not supported inside "
+                "sequence-parallel mode — pass bias_fn(q_pos, k_pos) "
+                "so each shard computes its own block"
             )
         if dropout_rate > 0.0:
             # ring/all-to-all shards would each need a coordinated rng
@@ -391,12 +404,22 @@ def attention(
                 "attention-weight dropout is not supported inside "
                 "sequence-parallel mode"
             )
-        # sliding windows are exact under BOTH impls: the ring carries
-        # true global positions for its band mask, and ulysses holds
-        # the full sequence per head subset after its all-to-all
+        # sliding windows and bias_fn are exact under BOTH impls: the
+        # ring carries true global positions (band + per-block bias),
+        # and ulysses holds the full sequence per head subset after its
+        # all-to-all; custom scales pass straight through
         return sequence_parallel_attention(
-            q, k, v, causal=causal, window=window
+            q, k, v, causal=causal, window=window, scale=scale,
+            bias_fn=bias_fn,
         )
+    if bias_fn is not None:
+        if bias is not None:
+            raise ValueError("pass bias or bias_fn, not both")
+        # unsharded: materialize once over this call's positions
+        # (traced q_offset included — decode works)
+        q_pos = jnp.arange(q.shape[1]) + q_offset
+        k_pos = jnp.arange(k.shape[1])
+        bias = bias_fn(q_pos, k_pos)[None]  # [1, Hq, S, T]
     use_flash = False
     # the kernel covers full, causal, [B, T] key-padding masks, packed
     # segment ids, and custom softmax scales (T5's 1.0 rides through as

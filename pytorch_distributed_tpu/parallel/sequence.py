@@ -47,6 +47,7 @@ def _block_attn_parts(
     causal: bool,
     scale: float,
     window=None,
+    bias=None,  # [Hkv*G(local), S, T] — already head-sliced by caller
 ):
     """Unnormalized block attention: (o=[B,S,Hkv,G,D] f32, m, l=[B,Hkv,G,S,1]).
 
@@ -62,6 +63,10 @@ def _block_attn_parts(
         jnp.einsum("bskgd,btkd->bkgst", qg, k, preferred_element_type=jnp.float32)
         * scale
     )  # [B, Hkv, G, S, T]
+    if bias is not None:
+        logits = logits + bias.reshape(Hkv, G, S, T)[None].astype(
+            jnp.float32
+        )
     mask = None
     if causal or window is not None:
         mask = q_pos[:, None] >= k_pos[None, :]  # [S, T]
@@ -80,7 +85,8 @@ def _block_attn_parts(
 
 
 def _ring_attention_local(
-    q, k, v, *, axis_name: str, causal: bool, scale: float, window=None
+    q, k, v, *, axis_name: str, causal: bool, scale: float, window=None,
+    bias_fn=None,
 ):
     """Runs inside shard_map: q/k/v are the local sequence shards."""
     B, S, Hq, D = q.shape
@@ -92,13 +98,24 @@ def _ring_attention_local(
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     q_pos = my * S + jnp.arange(S)
+    # heads may additionally be sharded over tp: bias_fn returns GLOBAL
+    # heads, so slice this chip's subset once
+    tp_i = lax.axis_index("tp")
+    h_loc = Hq
+
+    def block_bias(k_pos):
+        if bias_fn is None:
+            return None
+        full = bias_fn(q_pos, k_pos)  # [Hq_global, S, T]
+        return lax.dynamic_slice_in_dim(full, tp_i * h_loc, h_loc, 0)
 
     def accumulate(t, acc, k_t, v_t):
         o_acc, m_acc, l_acc = acc
         src = (my - t) % n  # whose K/V shard we hold at step t
         k_pos = src * T + jnp.arange(T)
         o_t, m_t, l_t = _block_attn_parts(
-            q, k_t, v_t, q_pos, k_pos, causal, scale, window
+            q, k_t, v_t, q_pos, k_pos, causal, scale, window,
+            block_bias(k_pos),
         )
         m_new = jnp.maximum(m_acc, m_t)
         alpha = jnp.exp(m_acc - m_new)
@@ -139,6 +156,7 @@ def ring_attention(
     mesh: Optional[Mesh] = None,
     scale: Optional[float] = None,
     window: Optional[int] = None,
+    bias_fn=None,
 ) -> jnp.ndarray:
     """Exact attention with K/V rotated around the ``axis`` ring.
 
@@ -157,7 +175,7 @@ def ring_attention(
     fn = shard_map(
         functools.partial(
             _ring_attention_local, axis_name=axis, causal=causal,
-            scale=scale, window=window,
+            scale=scale, window=window, bias_fn=bias_fn,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -168,6 +186,8 @@ def ring_attention(
 
 
 def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, inner):
+    # (bias_fn head slicing happens in the inner closure — it needs the
+    # sp index, bound here by shard_map)
     """all_to_all S<->H re-shard; runs inside shard_map."""
     # [B, S/sp, H, D] -> [B, S, H/sp, D]: after the re-shard each chip
     # holds the FULL sequence for its head subset, so any sequence-wise
@@ -188,12 +208,26 @@ def ulysses_attention(
     axis: str = "sp",
     mesh: Optional[Mesh] = None,
     window: Optional[int] = None,
+    scale: Optional[float] = None,
+    bias_fn=None,
 ) -> jnp.ndarray:
     """DeepSpeed-Ulysses-style sequence parallelism: two all-to-alls around
     an ordinary full-sequence attention on a head subset. Heads (q and kv)
     must be divisible by the ``axis`` size. ``window`` = sliding-window
     band (each chip sees the full sequence post-re-shard, so the band
-    applies exactly)."""
+    applies exactly). ``bias_fn`` is REFUSED here: the fn returns
+    GLOBAL heads, so each chip would materialize [Hq_global, S, S]
+    before slicing its subset — a tp*sp-factor memory overshoot in
+    exactly the long-S regime SP exists for; ring evaluates the bias
+    per block at [Hq_local, S/sp, S/sp] instead. Use ``impl="ring"``
+    for relative-bias models."""
+    if bias_fn is not None:
+        raise NotImplementedError(
+            "bias_fn under ulysses would materialize the full "
+            "global-head [S, S] bias on every chip before head-slicing "
+            "— use sequence_parallel(impl='ring'), which evaluates the "
+            "bias per block from global positions"
+        )
     mesh = mesh or current_mesh()
     sp = mesh.shape[axis]
     tp = mesh.shape.get("tp", 1)
@@ -217,13 +251,18 @@ def ulysses_attention(
             get_attention_impl,
         )
 
-        if window is None and get_attention_impl() == "flash":
+        if (
+            window is None and scale is None
+            and get_attention_impl() == "flash"
+        ):
             from pytorch_distributed_tpu.ops.flash_attention import (
                 flash_attention,
             )
 
             return flash_attention(q, k, v, causal=causal)
-        return dot_product_attention(q, k, v, causal=causal, window=window)
+        return dot_product_attention(
+            q, k, v, causal=causal, window=window, scale=scale
+        )
 
     spec = P(data_axes(), axis, "tp", None)
     fn = shard_map(
@@ -288,12 +327,12 @@ def sequence_parallel_mode() -> Tuple[Optional[str], str]:
 
 
 def sequence_parallel_attention(
-    q, k, v, *, causal: bool, window=None
+    q, k, v, *, causal: bool, window=None, scale=None, bias_fn=None
 ) -> jnp.ndarray:
     axis, impl = _SEQ_MODE
     assert axis is not None
     if impl == "ring":
         return ring_attention(q, k, v, causal=causal, axis=axis,
-                              window=window)
+                              window=window, scale=scale, bias_fn=bias_fn)
     return ulysses_attention(q, k, v, causal=causal, axis=axis,
-                             window=window)
+                             window=window, scale=scale, bias_fn=bias_fn)

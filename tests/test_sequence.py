@@ -330,3 +330,115 @@ class TestWindowedSequenceParallel:
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(want), rtol=0.08, atol=0.08
         )
+
+
+class TestBiasFnSequenceParallel:
+    """Position-computed bias (T5 buckets / ALiBi) under sequence
+    parallelism (r5): ``bias_fn(q_pos, k_pos)`` evaluates per ring block
+    from TRUE GLOBAL positions (nobody materializes the full [S, T]
+    bias), and per head-subset under ulysses. Reference: the unsharded
+    op with the same fn materialized over the full positions."""
+
+    def _alibi_like(self, Hq=4):
+        # position-dependent AND head-dependent (slope per head), so a
+        # mis-sliced head subset or misaligned block positions both fail
+        slopes = jnp.asarray([0.25 * (h + 1) for h in range(Hq)])
+
+        def fn(q_pos, k_pos):
+            rel = (k_pos[None, :] - q_pos[:, None]).astype(jnp.float32)
+            return -jnp.abs(rel)[None] * slopes[:, None, None]
+
+        return fn
+
+    def test_ring_bias_fn_matches_reference(self, sp_mesh, rng):
+        q, k, v = _qkv(rng)
+        fn = self._alibi_like()
+        ref = dot_product_attention(
+            q, k, v, causal=True,
+            bias=fn(jnp.arange(64), jnp.arange(64))[None],
+        )
+        out = ring_attention(
+            q, k, v, causal=True, mesh=sp_mesh, bias_fn=fn
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_ulysses_bias_fn_refused_toward_ring(self, rng):
+        # ulysses would materialize the GLOBAL-head [S, S] bias on every
+        # chip before slicing — a tp*sp memory overshoot in the long-S
+        # regime SP exists for; the refusal routes users to ring, which
+        # evaluates per block
+        mesh = make_mesh(MeshSpec(dp=2, fsdp=2, sp=2, tp=1))
+        q, k, v = _qkv(rng, B=4)
+        with pytest.raises(NotImplementedError, match="ring"):
+            ulysses_attention(
+                q, k, v, causal=True, mesh=mesh,
+                bias_fn=self._alibi_like(),
+            )
+
+    def test_ring_bias_fn_with_tp_head_slicing(self, rng):
+        # heads sharded over tp as well: each tp shard must slice ITS
+        # head subset out of the fn's global-head output
+        mesh = make_mesh(MeshSpec(dp=1, sp=4, tp=2))
+        q, k, v = _qkv(rng, Hq=4, Hkv=2)
+        fn = self._alibi_like()
+        ref = dot_product_attention(
+            q, k, v, causal=True,
+            bias=fn(jnp.arange(64), jnp.arange(64))[None],
+        )
+        out = ring_attention(q, k, v, causal=True, mesh=mesh, bias_fn=fn)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_dispatcher_materializes_bias_fn_unsharded(self, rng):
+        from pytorch_distributed_tpu.ops.attention import attention
+
+        q, k, v = _qkv(rng, S=16)
+        fn = self._alibi_like()
+        ref = dot_product_attention(
+            q, k, v, causal=True,
+            bias=fn(jnp.arange(16), jnp.arange(16))[None],
+        )
+        out = attention(q, k, v, causal=True, bias_fn=fn)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+
+@pytest.mark.slow  # model-level compose; op-level bias_fn pins run fast
+def test_t5_forward_sequence_parallel_matches_plain():
+    """The r5 payoff of bias_fn: a full T5 encoder-decoder forward under
+    model-transparent ring SP matches the plain forward — the
+    relative-position bias evaluates per ring block from true global
+    positions (encoder bidirectional, decoder causal), and the
+    bias-free scale=1.0 cross-attention rides the ring with S_dec
+    queries against S_enc keys. Was a loud NotImplementedError from r4
+    until this round."""
+    from pytorch_distributed_tpu.models import (
+        T5Config,
+        T5ForConditionalGeneration,
+    )
+    from pytorch_distributed_tpu.parallel.sequence import sequence_parallel
+
+    cfg = T5Config(
+        vocab_size=256, d_model=64, d_kv=16, d_ff=128, num_layers=2,
+        num_heads=4, relative_attention_num_buckets=8,
+        relative_attention_max_distance=32, dropout_rate=0.0,
+    )
+    model = T5ForConditionalGeneration(cfg)
+    rng_np = np.random.default_rng(0)
+    enc = jnp.asarray(rng_np.integers(2, 256, size=(2, 64)), jnp.int32)
+    dec = jnp.asarray(rng_np.integers(2, 256, size=(2, 64)), jnp.int32)
+    params = model.init(jax.random.key(0), enc, dec)["params"]
+    want = model.apply({"params": params}, enc, dec)
+    make_mesh(MeshSpec(dp=2, sp=4))
+    with sequence_parallel(axis="sp", impl="ring"):
+        got = jax.jit(
+            lambda p, e, d: model.apply({"params": p}, e, d)
+        )(params, enc, dec)
+    # bf16 compute policy: ring accumulation order differs by rounding
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=0.08, atol=0.08
+    )
