@@ -245,6 +245,7 @@ def test_mixtral_recipe_smoke():
     assert int(state.step) == 2
 
 
+@pytest.mark.slow  # r5 final refit: HF parity + dense-ref stay fast; the decode variant is slow-tier
 def test_mixtral_int4_scan_dequant_serving():
     """Quantized MoE serving: quantize_for_scan_dequant now reaches the
     expert tensors (w_in/w_gate/w_out — a sparse-MoE model's dominant

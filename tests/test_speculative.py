@@ -248,6 +248,7 @@ def test_speculative_validation():
         )
 
 
+@pytest.mark.slow  # r5 final refit: speculative greedy==target pin stays fast
 def test_ragged_prompts_match_ragged_generate():
     """Left-padded batches decode identically to generate's ragged path
     (itself pinned equal to unpadded solo runs) — prompt pads are just
