@@ -20,8 +20,12 @@ from pytorch_distributed_tpu.data.sampler import (
 )
 from pytorch_distributed_tpu.data.loader import DataLoader
 from pytorch_distributed_tpu.data.native_pipeline import (
+    HostStagingRing,
     ImageBatchPipeline,
+    device_normalizer_for,
     gather_rows,
+    host_flip_transform,
+    make_device_normalizer,
 )
 from pytorch_distributed_tpu.data.datasets import (
     ArrayDataset,
@@ -56,8 +60,12 @@ __all__ = [
     "GlobalBatchSampler",
     "WeightedRandomSampler",
     "DataLoader",
+    "HostStagingRing",
     "ImageBatchPipeline",
+    "device_normalizer_for",
     "gather_rows",
+    "host_flip_transform",
+    "make_device_normalizer",
     "ArrayDataset",
     "ConcatDataset",
     "IterableDataset",

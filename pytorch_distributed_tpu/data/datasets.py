@@ -231,6 +231,11 @@ class SyntheticImageDataset:
 
     Index-addressable with stable per-index content (hash-seeded), so
     distributed order tests and resume tests behave like a real dataset.
+
+    ``dtype=np.uint8`` yields raw 0..255 pixel bytes — the layout the
+    default device-normalize ingest path ships (1/4 the host->device
+    bytes; normalize fused into the jitted step). The f32 default yields
+    pre-normalized gaussian noise (the legacy host-f32 escape hatch).
     """
 
     def __init__(
@@ -239,11 +244,18 @@ class SyntheticImageDataset:
         image_shape: Tuple[int, int, int] = (32, 32, 3),  # NHWC for TPU
         num_classes: int = 10,
         seed: int = 0,
+        dtype=np.float32,
     ):
         self.n = n
         self.image_shape = image_shape
         self.num_classes = num_classes
         self.seed = seed
+        self.dtype = np.dtype(dtype)
+        if self.dtype not in (np.dtype(np.float32), np.dtype(np.uint8)):
+            raise ValueError(
+                f"SyntheticImageDataset dtype must be float32 or uint8, "
+                f"got {self.dtype}"
+            )
 
     def __len__(self) -> int:
         return self.n
@@ -254,8 +266,14 @@ class SyntheticImageDataset:
         if not 0 <= i < self.n:
             raise IndexError(i)
         g = np.random.default_rng(self.seed * 1_000_003 + i)
+        if self.dtype == np.uint8:
+            image = g.integers(
+                0, 256, size=self.image_shape, dtype=np.uint8
+            )
+        else:
+            image = g.normal(size=self.image_shape).astype(np.float32)
         return {
-            "image": g.normal(size=self.image_shape).astype(np.float32),
+            "image": image,
             "label": np.int32(g.integers(self.num_classes)),
         }
 

@@ -23,6 +23,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from pytorch_distributed_tpu.data.native_pipeline import _StagingMixin
+
 _EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".webp")
 
 
@@ -63,14 +65,21 @@ class ImageFolderDataset:
         return {"image": arr, "label": np.int32(label)}
 
 
-class FolderImagePipeline:
+class FolderImagePipeline(_StagingMixin):
     """DataLoader ``fetch=``: decode -> resize-shorter-side -> crop ->
-    flip -> fused normalize, ImageNet-style (``device_normalize=True``
-    ships uint8 and defers normalization to the device).
+    flip -> fused normalize, ImageNet-style. ``device_normalize`` (the
+    DEFAULT — the ingest fast path, docs/DESIGN.md §3d) ships uint8 and
+    defers normalization to the device; ``False`` restores the host f32
+    normalize.
 
     train=True: RandomResizedCrop-equivalent (random scale/area crop then
     resize to ``crop``) + horizontal flip. train=False: resize shorter
     side to ``resize`` then center crop.
+
+    ``reuse_staging``: rotate the decoded-batch buffers through a
+    :class:`HostStagingRing` instead of allocating per batch; default
+    (None) auto-enables when the consuming DataLoader device-puts every
+    batch (see ``_StagingMixin``).
     """
 
     def __init__(
@@ -84,8 +93,9 @@ class FolderImagePipeline:
         seed: int = 0,
         scale: tuple = (0.08, 1.0),
         ratio: tuple = (3 / 4, 4 / 3),
-        device_normalize: bool = False,
+        device_normalize: bool = True,
         num_threads: int = 0,
+        reuse_staging: Optional[bool] = None,
     ):
         """``num_threads``: decode/resize pool width (0 = one per core,
         1 = sequential)."""
@@ -99,6 +109,7 @@ class FolderImagePipeline:
         self.ratio = ratio
         self.device_normalize = device_normalize
         self.num_threads = num_threads
+        self._init_staging(reuse_staging)
         self.epoch = 0
         self._executor = None  # lazy; close() releases, else joined by
         # concurrent.futures' own atexit hook at interpreter shutdown
@@ -174,8 +185,18 @@ class FolderImagePipeline:
 
         idx = np.asarray(indices, np.int64)
         n = len(idx)
-        out = np.empty((n, self.crop, self.crop, 3), np.uint8)
-        labels = np.empty((n,), np.int32)
+        # staging ring (no per-batch alloc) when the loader device-puts
+        # every batch; fresh arrays otherwise — see _StagingMixin. In f32
+        # mode the u8 decode buffer is an intermediate (the SHIPPED array
+        # is the derived f32), so it must not draw from the ring: the
+        # loader's register_transfer would never see it and the slot
+        # would stay busy forever.
+        out = (
+            self._out_buffer((n, self.crop, self.crop, 3), np.uint8)
+            if self.device_normalize
+            else np.empty((n, self.crop, self.crop, 3), np.uint8)
+        )
+        labels = self._out_buffer((n,), np.int32)
         import zlib
 
         rng = np.random.default_rng(
@@ -207,9 +228,12 @@ class FolderImagePipeline:
         if self.device_normalize:
             # ship uint8 (1/4 the host->device bytes); apply
             # self.device_normalizer() inside the jitted step
-            return {"image": out, "label": labels}
-        images = (out.astype(np.float32) - self.mean) * self.stdinv
-        return {"image": images, "label": labels}
+            batch = {"image": out, "label": labels}
+        else:
+            images = (out.astype(np.float32) - self.mean) * self.stdinv
+            batch = {"image": images, "label": labels}
+        self._finish_staging()
+        return batch
 
     def device_normalizer(self):
         """Jittable on-device (px - mean)*stdinv transform (u8 mode) —

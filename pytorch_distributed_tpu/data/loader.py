@@ -2,9 +2,14 @@
 
 The CUDA recipes overlap H2D copies with compute via pinned memory +
 ``non_blocking=True``; the TPU equivalent is: assemble the global batch on
-the host, ``device_put`` with the data-axis sharding (an async transfer),
-and keep ``prefetch`` batches in flight ahead of the consumer. With
-``jax``'s async dispatch the transfer of batch N+1 overlaps step N on-chip.
+the host, place it shard by shard (one async ``device_put`` per
+addressable shard — ``parallel.sharding.device_put_per_shard``), and keep
+``prefetch`` batches in flight ahead of the consumer. With ``jax``'s
+async dispatch the transfer of batch N+1 overlaps step N on-chip, and the
+default uint8 ingest path assembles batch N+1 into a reused staging
+buffer (double-buffered: the ring's transfer fence guarantees batch N's
+copy-out finished before its slot is rewritten — see
+``native_pipeline.HostStagingRing``).
 """
 
 from __future__ import annotations
@@ -134,6 +139,13 @@ class DataLoader:
         self.prefetch = max(1, prefetch)
         self.transform = transform
         self._warned_remainder = False
+        if sharding is not None and hasattr(fetch, "mark_device_fed"):
+            # device-fed contract: every batch is device_put (copied out
+            # under the staging ring's transfer fence) before the next
+            # fetch starts, so the pipeline may reuse host staging
+            # buffers instead of allocating per batch. Double-buffered:
+            # batch N's transfer overlaps batch N+1's assembly.
+            fetch.mark_device_fed(depth=2)
 
     def set_epoch(self, epoch: int) -> None:
         if self.sampler is not None:
@@ -210,6 +222,7 @@ class DataLoader:
                 place_global_batch,
             )
 
+            host_batch = batch
             # on a pod the fetched batch is this process's LOCAL block iff
             # somebody rank-sliced it (this loader or a rank-aware
             # sampler); otherwise it is the full global batch and must be
@@ -220,6 +233,18 @@ class DataLoader:
                 local=self.shard
                 or hasattr(self.sampler, "num_replicas"),
             )
+            ring = getattr(self.fetch, "staging_ring", None)
+            if ring is not None:
+                # staging-reuse fence: tell the ring which device Arrays
+                # are in-flight copies of its buffers, so a wrap blocks
+                # on the transfer instead of corrupting it. Leaf order is
+                # stable (place_global_batch is a tree_map).
+                for host_leaf, dev_leaf in zip(
+                    jax.tree_util.tree_leaves(host_batch),
+                    jax.tree_util.tree_leaves(batch),
+                ):
+                    if isinstance(host_leaf, np.ndarray):
+                        ring.register_transfer(host_leaf, dev_leaf)
         return batch
 
     def _produce(self, out_q: queue.Queue, stop: threading.Event) -> None:

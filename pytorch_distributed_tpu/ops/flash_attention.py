@@ -218,7 +218,10 @@ def _fwd_scratch(bq, bk, d):
 def _compiler_params():
     from jax.experimental.pallas import tpu as pltpu
 
-    return pltpu.CompilerParams(
+    # renamed TPUCompilerParams -> CompilerParams across jax releases;
+    # accept whichever this container's jax ships (cf. runtime/compat.py)
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(
         dimension_semantics=("parallel", "parallel", "arbitrary"),
     )
 

@@ -218,6 +218,46 @@ def Adafactor(
     )
 
 
+def _torch_scale_by_rms(alpha: float, eps: float, centered: bool):
+    """torch's RMS scaling — eps OUTSIDE the sqrt, v zero-initialized.
+
+    Fallback for optax versions whose ``rmsprop`` predates the
+    ``eps_in_sqrt`` kwarg (there eps lands inside the sqrt, which is NOT
+    torch semantics and fails the trajectory-parity test).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    tree_map = jax.tree_util.tree_map
+
+    def init_fn(params):
+        nu = tree_map(jnp.zeros_like, params)
+        mu = tree_map(jnp.zeros_like, params) if centered else None
+        return (nu, mu)
+
+    def update_fn(updates, state, params=None):
+        del params
+        nu, mu = state
+        nu = tree_map(
+            lambda v, g: alpha * v + (1.0 - alpha) * g * g, nu, updates
+        )
+        if centered:
+            mu = tree_map(
+                lambda m, g: alpha * m + (1.0 - alpha) * g, mu, updates
+            )
+            updates = tree_map(
+                lambda g, v, m: g / (jnp.sqrt(v - m * m) + eps),
+                updates, nu, mu,
+            )
+        else:
+            updates = tree_map(
+                lambda g, v: g / (jnp.sqrt(v) + eps), updates, nu
+            )
+        return updates, (nu, mu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def RMSprop(
     lr: ScalarOrSchedule = 1e-2,
     alpha: float = 0.99,
@@ -237,12 +277,20 @@ def RMSprop(
                 weight_decay, mask=_decay_mask_arg(no_decay)
             )
         )
-    chain.append(
-        optax.rmsprop(
-            lr, decay=alpha, eps=eps, momentum=momentum or None,
-            centered=centered, eps_in_sqrt=False, initial_scale=0.0,
+    try:
+        chain.append(
+            optax.rmsprop(
+                lr, decay=alpha, eps=eps, momentum=momentum or None,
+                centered=centered, eps_in_sqrt=False, initial_scale=0.0,
+            )
         )
-    )
+    except TypeError:
+        # optax < 0.2.4: no eps_in_sqrt kwarg — assemble the torch
+        # update (rms scale -> momentum trace -> -lr) by hand
+        chain.append(_torch_scale_by_rms(alpha, eps, centered))
+        if momentum:
+            chain.append(optax.trace(decay=momentum))
+        chain.append(optax.scale_by_learning_rate(lr))
     return optax.chain(*chain)
 
 

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from pytorch_distributed_tpu.runtime.compat import shard_map
 
 from pytorch_distributed_tpu.runtime.mesh import current_mesh, data_axes
 

@@ -203,6 +203,29 @@ def infer_opt_tree_shardings(
 REPLICATED = PartitionRules([(".*", None)])
 
 
+def device_put_per_shard(sharding: NamedSharding, x) -> jax.Array:
+    """Place one host array as one async ``device_put`` PER addressable
+    shard, stitched into the global Array without waiting.
+
+    The feed analogue of the CUDA recipes' per-GPU pinned-memory copies:
+    each shard's H2D transfer dispatches independently (no global-array
+    staging copy first), so the copies overlap each other — and, driven
+    from the DataLoader's prefetch thread, overlap the previous step's
+    compute. Returns the same committed sharded Array a plain
+    ``jax.device_put(x, sharding)`` would.
+    """
+    x = np.asarray(x)
+    if x.ndim == 0:
+        return jax.device_put(x, sharding)
+    idx_map = sharding.addressable_devices_indices_map(x.shape)
+    if len(idx_map) == 1:
+        # one shard -> nothing to overlap; skip the slice-and-stitch
+        # Python overhead and take the single C call
+        return jax.device_put(x, sharding)
+    shards = [jax.device_put(x[idx], d) for d, idx in idx_map.items()]
+    return jax.make_array_from_single_device_arrays(x.shape, sharding, shards)
+
+
 def place_global_batch(sharding: NamedSharding, batch, *, local: bool = True):
     """Host batch pytree -> jax Arrays placed under ``sharding``.
 
@@ -220,7 +243,12 @@ def place_global_batch(sharding: NamedSharding, batch, *, local: bool = True):
       batch — the one-true-helper exists so every caller gets this right.)
     """
     if jax.process_count() == 1:
-        return jax.device_put(batch, sharding)
+        return jax.tree_util.tree_map(
+            lambda x: device_put_per_shard(sharding, x)
+            if isinstance(x, np.ndarray) and x.ndim
+            else jax.device_put(x, sharding),
+            batch,
+        )
 
     def place(x):
         x = np.asarray(x)

@@ -181,16 +181,27 @@ class Strategy:
         """
         return place_global_batch(self.batch_sharding(), batch, local=True)
 
-    def compile(self, step_fn, state, *, donate: bool = True):
+    def compile(self, step_fn, state, *, donate: bool = True,
+                donate_batch: bool = False):
         """jit ``step_fn(state, batch) -> (state, metrics)`` with this
         strategy's shardings pinned on state in/out (donating the input
-        state buffers, like an in-place optimizer step)."""
+        state buffers, like an in-place optimizer step).
+
+        ``donate_batch`` additionally donates the BATCH buffers — right
+        for the loader-fed hot loop, where every batch is consumed
+        exactly once: the uint8 ingest buffer is released the moment the
+        fused on-device normalize reads it, instead of pinning HBM until
+        the step retires. Leave False when a caller re-feeds the same
+        placed batch (the synthetic-batch benches)."""
         st_sh = self.state_shardings(state)
+        donate_argnums = (0,) if donate else ()
+        if donate_batch:
+            donate_argnums = donate_argnums + (1,)
         return jax.jit(
             step_fn,
             in_shardings=(st_sh, self.batch_sharding()),
             out_shardings=(st_sh, None),
-            donate_argnums=(0,) if donate else (),
+            donate_argnums=donate_argnums,
         )
 
     def describe(self) -> str:

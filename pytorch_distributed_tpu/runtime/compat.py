@@ -1,0 +1,58 @@
+"""Version shims for jax APIs that moved between releases.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+``jax`` namespace, and its replication-check kwarg was renamed
+``check_rep`` -> ``check_vma`` in the same window. The container pins
+whatever jaxlib the accelerator toolchain ships, so both spellings must
+work; every in-repo caller imports the wrapper below instead of picking
+a spelling.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: public API, kwarg is check_vma
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # jax 0.4/0.5: experimental API, kwarg is check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the replication-check flag normalized to
+    the new ``check_vma`` name regardless of the installed jax."""
+    return _shard_map(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        **{_CHECK_KW: check_vma},
+    )
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis inside traced code —
+    ``lax.axis_size`` where it exists (newer jax), else jax 0.4's
+    ``core.axis_frame`` (which returns the int directly there)."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    import jax.core as core
+
+    frame = core.axis_frame(axis_name)
+    return getattr(frame, "size", frame)
+
+
+def abstract_mesh(axis_sizes, axis_names):
+    """``jax.sharding.AbstractMesh`` across its signature change:
+    newer jax takes ``(axis_sizes, axis_names)``, jax 0.4 takes one
+    ``((name, size), ...)`` shape tuple."""
+    from jax.sharding import AbstractMesh
+
+    try:
+        return AbstractMesh(tuple(axis_sizes), tuple(axis_names))
+    except TypeError:
+        return AbstractMesh(tuple(zip(axis_names, axis_sizes)))

@@ -42,7 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from pytorch_distributed_tpu.runtime.compat import shard_map
 
 from pytorch_distributed_tpu.runtime import device as _device
 from pytorch_distributed_tpu.runtime import mesh as _mesh

@@ -46,6 +46,37 @@ LossFn = Callable[[Any, Any, Any, jax.Array], Tuple[jax.Array, Dict[str, Any]]]
 logger = get_logger(__name__)
 
 
+def _accepts_rng(transform) -> bool:
+    """Does ``transform`` take a second positional (rng) argument?
+
+    Deliberately conservative: a pre-existing 1-arg transform must keep
+    being called as ``transform(batch)``. The rng is passed only when
+    the transform says so explicitly (``_ptd_takes_rng`` attribute, set
+    by ``make_device_normalizer(flip=True)``) or its second positional
+    parameter is REQUIRED (no default — such a callable could never have
+    worked under the old 1-arg contract, so this can't change behavior
+    for existing code). Defaulted second params (``lambda b, eps=1e-6``)
+    and ``*args`` wrappers stay on the 1-arg call.
+    """
+    marked = getattr(transform, "_ptd_takes_rng", None)
+    if marked is not None:
+        return bool(marked)
+    import inspect
+
+    try:
+        sig = inspect.signature(transform)
+    except (TypeError, ValueError):  # builtins/callables without a sig
+        return False
+    required_positional = 0
+    for p in sig.parameters.values():
+        if p.kind in (
+            inspect.Parameter.POSITIONAL_ONLY,
+            inspect.Parameter.POSITIONAL_OR_KEYWORD,
+        ) and p.default is inspect.Parameter.empty:
+            required_positional += 1
+    return required_positional >= 2
+
+
 def _split_microbatches(batch, accum_steps: int):
     """[B, ...] -> [accum, B/accum, ...] on every leaf."""
 
@@ -77,7 +108,12 @@ def build_train_step(
 
     ``batch_transform`` runs ON-DEVICE inside the jitted step, before
     microbatch splitting — e.g. ``ImageBatchPipeline.device_normalizer()``
-    so uint8 batches ship over the host link and normalize on-chip.
+    so uint8 batches ship over the host link and normalize on-chip (the
+    default ingest path). A transform that takes TWO positional args is
+    called as ``transform(batch, rng)`` with a PRNG key folded from the
+    step's stream — the hook for fused on-device augmentation (e.g.
+    ``make_device_normalizer(..., flip=True)``); replayed augmentations
+    on resume come free because the key derives from ``state.step``.
 
     ``grad_compression`` ("bf16"/"fp16"/"int8") compresses the
     multi-process gradient sync on the wire (see
@@ -94,6 +130,9 @@ def build_train_step(
         # scores random weights); d>1 diverges
         raise ValueError(f"ema_decay must be in [0, 1), got {ema_decay}")
     scaling = scaler is not None and scaler.enabled
+    transform_takes_rng = (
+        batch_transform is not None and _accepts_rng(batch_transform)
+    )
 
     def grad_fn(params, batch_stats, mb, rng, scaler_state):
         def scaled_loss(p):
@@ -110,7 +149,14 @@ def build_train_step(
     def step(state: TrainState, batch):
         rng = key_for(state.step)
         if batch_transform is not None:
-            batch = batch_transform(batch)
+            if transform_takes_rng:
+                # a key decorrelated from the loss/dropout stream, still
+                # derived from state.step (resume replays augmentation)
+                batch = batch_transform(
+                    batch, jax.random.fold_in(rng, 0x617567)  # "aug"
+                )
+            else:
+                batch = batch_transform(batch)
 
         if accum_steps == 1:
             grads, aux = grad_fn(
@@ -222,6 +268,11 @@ class TrainerConfig:
     eval_every_epochs: int = 1
     eval_with_ema: bool = False  # evaluate shadow (EMA) params instead
     samples_axis: str = "image"  # batch leaf whose dim0 counts samples
+    donate_batch: Optional[bool] = None  # donate batch buffers into the
+    # train step (each loader batch is consumed exactly once, so the
+    # uint8 ingest buffer frees as soon as the fused normalize reads
+    # it). None = auto: on for accelerators, off on the CPU backend
+    # (XLA:CPU rarely aliases them and warns per executable instead)
     async_checkpoint: bool = False  # overlap ckpt IO with training
     metrics_path: Optional[str] = None  # JSONL scalar log (rank 0)
     tensorboard_dir: Optional[str] = None  # TB event files (rank 0)
@@ -293,7 +344,17 @@ class Trainer:
                 "ema_decay — pass build_train_step(..., ema_decay=...)"
             )
         self.state = strategy.place(state)
-        self.train_step = strategy.compile(train_step, self.state)
+        donate_batch = self.config.donate_batch
+        if donate_batch is None:
+            from pytorch_distributed_tpu.runtime.device import platform
+
+            donate_batch = platform() != "cpu"
+        try:
+            self.train_step = strategy.compile(
+                train_step, self.state, donate_batch=donate_batch
+            )
+        except TypeError:  # user strategy predating the donate_batch hook
+            self.train_step = strategy.compile(train_step, self.state)
         self.eval_step = (
             jax.jit(eval_step) if eval_step is not None else None
         )

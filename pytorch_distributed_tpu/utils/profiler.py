@@ -33,9 +33,12 @@ def maybe_trace(logdir: Optional[str], *, host_tracer_level: int = 2):
     if logdir is None:
         yield
         return
-    options = jax.profiler.ProfileOptions()
-    options.host_tracer_level = host_tracer_level
-    jax.profiler.start_trace(logdir, profiler_options=options)
+    if hasattr(jax.profiler, "ProfileOptions"):
+        options = jax.profiler.ProfileOptions()
+        options.host_tracer_level = host_tracer_level
+        jax.profiler.start_trace(logdir, profiler_options=options)
+    else:  # older jax: no per-trace options object; defaults are fine
+        jax.profiler.start_trace(logdir)
     try:
         yield
     finally:

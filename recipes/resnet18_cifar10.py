@@ -19,6 +19,7 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import jax
+import numpy as np
 import optax
 
 import pytorch_distributed_tpu as ptd
@@ -59,6 +60,10 @@ def parse_args(argv=None):
     p.add_argument("--synthetic", action="store_true", help="skip real CIFAR")
     p.add_argument("--steps-per-epoch", type=int, default=None,
                    help="truncate epochs (smoke testing)")
+    p.add_argument("--no-device-normalize", dest="device_normalize",
+                   action="store_false",
+                   help="host f32 normalize instead of the default "
+                   "uint8-over-the-wire + on-device normalize ingest")
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=50)
@@ -80,23 +85,39 @@ def main(argv=None):
         args.data_dir, train=False, raw_uint8=True
     )
     # real data goes through the native augmenting pipeline (pad-4 random
-    # crop + flip + fused normalize — the reference recipe's torchvision
-    # transforms, assembled in C++ threads); synthetic stays on the plain
-    # gather path
+    # crop + flip — the reference recipe's torchvision transforms,
+    # assembled in C++ threads), shipping raw uint8 by default with the
+    # normalize fused into the jitted step; synthetic stays on the plain
+    # gather path (uint8 by default too, same wire profile)
+    cifar_mean, cifar_std = (0.4914, 0.4822, 0.4465), (0.247, 0.243, 0.262)
     train_fetch = eval_fetch = None
+    train_normalizer = eval_normalizer = None
     if train_ds is not None:
-        cifar_mean, cifar_std = (0.4914, 0.4822, 0.4465), (0.247, 0.243, 0.262)
         train_fetch = ImageBatchPipeline(
             32, train=True, pad=4, mean=cifar_mean, std=cifar_std,
-            seed=args.seed,
+            seed=args.seed, device_normalize=args.device_normalize,
         )
         eval_fetch = ImageBatchPipeline(
-            32, train=False, mean=cifar_mean, std=cifar_std
+            32, train=False, mean=cifar_mean, std=cifar_std,
+            device_normalize=args.device_normalize,
         )
+        if args.device_normalize:
+            train_normalizer = train_fetch.device_normalizer()
+            eval_normalizer = eval_fetch.device_normalizer()
     if train_ds is None:
         log_rank0("CIFAR-10 files not found — using synthetic data")
-        train_ds = SyntheticImageDataset(n=50_000, seed=args.seed)
-        eval_ds = SyntheticImageDataset(n=10_000, seed=args.seed + 1)
+        dtype = np.uint8 if args.device_normalize else np.float32
+        train_ds = SyntheticImageDataset(
+            n=50_000, seed=args.seed, dtype=dtype
+        )
+        eval_ds = SyntheticImageDataset(
+            n=10_000, seed=args.seed + 1, dtype=dtype
+        )
+        if args.device_normalize:
+            from pytorch_distributed_tpu.data import device_normalizer_for
+
+            train_normalizer = device_normalizer_for(cifar_mean, cifar_std)
+            eval_normalizer = device_normalizer_for(cifar_mean, cifar_std)
 
     if args.steps_per_epoch:
         n = args.steps_per_epoch * args.batch_size
@@ -137,9 +158,12 @@ def main(argv=None):
         build_train_step(
             classification_loss_fn(model, weight_decay=args.weight_decay),
             grad_compression=args.grad_compress,
+            batch_transform=train_normalizer,
         ),
         train_loader,
-        eval_step=classification_eval_step(model),
+        eval_step=classification_eval_step(
+            model, batch_transform=eval_normalizer
+        ),
         eval_loader=eval_loader,
         config=TrainerConfig(
             epochs=args.epochs,
@@ -159,7 +183,7 @@ def _truncate(ds, n):
 
     if hasattr(ds, "arrays"):
         return ArrayDataset(**{k: v[:n] for k, v in ds.arrays.items()})
-    ds = type(ds)(n=min(n, len(ds)), seed=ds.seed)
+    ds = type(ds)(n=min(n, len(ds)), seed=ds.seed, dtype=ds.dtype)
     return ds
 
 

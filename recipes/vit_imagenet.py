@@ -1,22 +1,18 @@
-"""Recipe 2: ResNet-50 / ImageNet — DDP data-parallel (the north star).
+"""Recipe 2b: ViT-Base / ImageNet — DDP data-parallel, transformer vision.
 
-Mirrors the reference's flagship recipe (BASELINE.json:8: "ResNet-50 /
-ImageNet, DDP 8-way data parallel"; the north-star metric is its
-images/sec/chip, BASELINE.json:2). The TPU-native shape: one process, a
-``dp``-axis mesh over all chips, params replicated, batch sharded — XLA
-emits the fused gradient allreduce the reference gets from DDP's bucketed
-NCCL hooks.
+Same training scaffold as ``resnet50_imagenet.py`` (one ``dp``-axis mesh,
+params replicated, batch sharded) with the transformer classifier — the
+AdamW + cosine schedule the ViT papers use instead of ResNet's SGD.
 
-ImageNet itself is not on disk in this environment (no network); the
-recipe trains on a synthetic ImageNet-shaped stream (224x224x3, 1000
-classes) unless ``--data-dir`` points at preprocessed arrays. Accuracy
-targets therefore only mean something on real data; throughput (the
-benchmark, bench.py) does not care.
+Ingest uses the DEFAULT uint8 fast path (docs/DESIGN.md §3d): raw uint8
+batches over the host->device link, normalization (and the synthetic
+path's horizontal flip) fused into the jitted step. ``--no-device-
+normalize`` restores the host-f32 reference-parity path.
 
 Run:
-    python recipes/resnet50_imagenet.py --dp 8 --batch-size 2048
-    python recipes/resnet50_imagenet.py --backend gloo --synthetic \
-        --steps-per-epoch 3 --batch-size 16 --image-size 64   # smoke
+    python recipes/vit_imagenet.py --dp 8 --batch-size 1024
+    python recipes/vit_imagenet.py --backend gloo --synthetic --variant tiny \
+        --steps-per-epoch 3 --batch-size 16   # smoke
 """
 
 import dataclasses
@@ -37,7 +33,7 @@ from pytorch_distributed_tpu.data import (
     device_normalizer_for,
     host_flip_transform,
 )
-from pytorch_distributed_tpu.models import ResNet50
+from pytorch_distributed_tpu.models import ViT, ViTConfig
 from pytorch_distributed_tpu.parallel import DataParallel
 from pytorch_distributed_tpu.runtime.mesh import MeshSpec
 from pytorch_distributed_tpu.train import (
@@ -52,24 +48,25 @@ from pytorch_distributed_tpu.train import (
 from pytorch_distributed_tpu.utils import log_rank0, maybe_trace
 from pytorch_distributed_tpu.utils.config import RecipeConfig, parse_cli
 
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
 
 @dataclasses.dataclass
 class Config(RecipeConfig):
-    epochs: int = 90  # doc: standard ImageNet schedule
+    epochs: int = 90  # doc: training epochs
     batch_size: int = 1024  # doc: global batch (split over dp)
-    lr: float = 0.4  # doc: peak LR (linear-scaling rule: 0.1 * batch/256)
-    momentum: float = 0.9  # doc: SGD momentum
-    weight_decay: float = 1e-4  # doc: L2 on conv/linear kernels
+    lr: float = 3e-3  # doc: peak AdamW LR
+    weight_decay: float = 0.3  # doc: decoupled AdamW weight decay
     label_smoothing: float = 0.1  # doc: softmax label smoothing
-    warmup_epochs: int = 5  # doc: linear LR warmup epochs
-    image_size: int = 224  # doc: square input resolution
+    warmup_epochs: int = 10  # doc: linear LR warmup epochs
+    variant: str = "base"  # doc: ViT variant: base | tiny (smoke)
+    image_size: int = 0  # doc: square input resolution (0: the variant's default)
+    dropout: float = 0.1  # doc: dropout rate
     train_samples: int = 1_281_167  # doc: synthetic train-set size
     eval_samples: int = 50_000  # doc: synthetic eval-set size
     flip_augment: bool = True  # doc: random horizontal flip augmentation
-    stem: str = "imagenet"  # doc: stem variant: imagenet | s2d (MXU-friendly)
-    log_mfu: bool = False  # doc: append achieved TFLOP/s + MFU to step logs
     device_normalize: bool = True  # doc: ship uint8 batches, normalize on-chip (default ingest path; --no-device-normalize restores host f32)
-    ema_decay: float = 0.0  # doc: ModelEMA decay (0 disables); evals use the shadow
     tensorboard_dir: str = ""  # doc: TensorBoard event-file dir (rank 0)
 
 
@@ -77,12 +74,20 @@ def main(argv=None):
     cfg: Config = parse_cli(Config, argv, description=__doc__)
     ptd.seed_all(cfg.seed)
     ptd.init_process_group(cfg.backend, mesh_spec=MeshSpec(dp=cfg.dp))
+
+    base = {"base": ViTConfig.base, "tiny": ViTConfig.tiny}[cfg.variant]()
+    vcfg = dataclasses.replace(
+        base,
+        dropout_rate=cfg.dropout,
+        **({"image_size": cfg.image_size} if cfg.image_size else {}),
+    )
+    shape = (vcfg.image_size, vcfg.image_size, 3)
     log_rank0(
-        "resnet50/imagenet: world=%d backend=%s batch=%d image=%d",
-        ptd.get_world_size(), ptd.get_backend(), cfg.batch_size, cfg.image_size,
+        "vit/%s: world=%d backend=%s batch=%d image=%d u8_ingest=%s",
+        cfg.variant, ptd.get_world_size(), ptd.get_backend(),
+        cfg.batch_size, vcfg.image_size, cfg.device_normalize,
     )
 
-    shape = (cfg.image_size, cfg.image_size, 3)
     # real ImageNet layout on disk (root/{train,val}/<class>/<img>)?
     real_root = (
         None if cfg.synthetic else
@@ -90,6 +95,7 @@ def main(argv=None):
         else None
     )
     train_fetch = eval_fetch = None
+    train_normalizer = eval_normalizer = None
     if real_root is not None:
         from pytorch_distributed_tpu.data import (
             FolderImagePipeline,
@@ -99,47 +105,55 @@ def main(argv=None):
         train_ds = ImageFolderDataset(os.path.join(real_root, "train"))
         eval_ds = ImageFolderDataset(os.path.join(real_root, "val"))
         train_fetch = FolderImagePipeline(
-            cfg.image_size, train=True, seed=cfg.seed,
+            vcfg.image_size, train=True, seed=cfg.seed,
+            mean=IMAGENET_MEAN, std=IMAGENET_STD,
             device_normalize=cfg.device_normalize,
         )
         eval_fetch = FolderImagePipeline(
-            cfg.image_size, train=False,
+            vcfg.image_size, train=False,
+            mean=IMAGENET_MEAN, std=IMAGENET_STD,
             device_normalize=cfg.device_normalize,
         )
+        if cfg.device_normalize:
+            # the folder pipeline flips/crops at decode; only the
+            # normalize moves on-device
+            train_normalizer = train_fetch.device_normalizer()
+            eval_normalizer = eval_fetch.device_normalizer()
         n_train = len(train_ds)
-        log_rank0(
-            "real data: %d train / %d eval images, %d classes",
-            n_train, len(eval_ds), len(train_ds.classes),
-        )
+        num_classes = len(train_ds.classes)
+        if num_classes != vcfg.num_classes:
+            vcfg = dataclasses.replace(vcfg, num_classes=num_classes)
     else:
         n_train = cfg.train_samples
         n_eval = cfg.eval_samples
         if cfg.steps_per_epoch:
             n_train = cfg.steps_per_epoch * cfg.batch_size
             n_eval = min(n_eval, cfg.batch_size * 2)
-        # default ingest path: raw uint8 over the wire, normalize (and
-        # flip) fused into the jitted step — same bytes-on-the-link
-        # profile as the real-data path, so synthetic throughput numbers
-        # mean something for deployment
         dtype = np.uint8 if cfg.device_normalize else np.float32
         train_ds = SyntheticImageDataset(
-            n=n_train, image_shape=shape, num_classes=1000, seed=cfg.seed,
-            dtype=dtype,
+            n=n_train, image_shape=shape, num_classes=vcfg.num_classes,
+            seed=cfg.seed, dtype=dtype,
         )
         eval_ds = SyntheticImageDataset(
-            n=n_eval, image_shape=shape, num_classes=1000, seed=cfg.seed + 1,
-            dtype=dtype,
+            n=n_eval, image_shape=shape, num_classes=vcfg.num_classes,
+            seed=cfg.seed + 1, dtype=dtype,
         )
+        if cfg.device_normalize:
+            # normalize AND flip fused into the jitted step
+            train_normalizer = device_normalizer_for(
+                IMAGENET_MEAN, IMAGENET_STD, flip=cfg.flip_augment
+            )
+            eval_normalizer = device_normalizer_for(
+                IMAGENET_MEAN, IMAGENET_STD
+            )
 
-    model = ResNet50(num_classes=1000, stem=cfg.stem)
+    model = ViT(vcfg)
     variables = model.init(
         jax.random.key(cfg.seed), jnp.zeros((1,) + shape), train=False
     )
 
     steps_per_epoch = max(n_train // cfg.batch_size, 1)
     total_steps = max(cfg.epochs * steps_per_epoch, 1)
-    # smoke runs can be shorter than the nominal warmup; clamp so the
-    # cosine phase keeps at least one step (optax rejects decay <= warmup)
     warmup_steps = min(cfg.warmup_epochs * steps_per_epoch, total_steps - 1)
     schedule = optax.warmup_cosine_decay_schedule(
         init_value=0.0,
@@ -147,59 +161,34 @@ def main(argv=None):
         warmup_steps=warmup_steps,
         decay_steps=total_steps,
     )
-    tx = optax.sgd(schedule, momentum=cfg.momentum, nesterov=True)
+    tx = optax.adamw(schedule, weight_decay=cfg.weight_decay)
     state = TrainState.create(
-        apply_fn=model.apply,
-        params=variables["params"],
-        tx=tx,
-        batch_stats=variables["batch_stats"],
-        ema=cfg.ema_decay > 0,
+        apply_fn=model.apply, params=variables["params"], tx=tx
     )
 
     strategy = DataParallel()
     train_loader = DataLoader(
         train_ds, cfg.batch_size, seed=cfg.seed,
-        sharding=strategy.batch_sharding(),
-        fetch=train_fetch,
+        sharding=strategy.batch_sharding(), fetch=train_fetch,
         transform=(
             host_flip_transform(cfg.seed)
             if cfg.flip_augment and train_fetch is None
             and not cfg.device_normalize else None
-        ),  # the folder pipeline flips at decode; the u8 synthetic path
-        # flips on-device inside the jitted step (see below)
+        ),  # the folder pipeline flips at decode
     )
     eval_loader = DataLoader(
         eval_ds, cfg.batch_size, shuffle=False, drop_last=False,
-        sharding=strategy.batch_sharding(),
-        fetch=eval_fetch,
+        sharding=strategy.batch_sharding(), fetch=eval_fetch,
     )
 
-    train_normalizer = eval_normalizer = None
-    if cfg.device_normalize:
-        if train_fetch is not None:
-            # folder pipelines flip/crop at decode; only the normalize
-            # moves on-device
-            train_normalizer = train_fetch.device_normalizer()
-            eval_normalizer = eval_fetch.device_normalizer()
-        else:
-            # synthetic u8 path: normalize AND flip fused into the
-            # jitted step (the host never touches the pixels)
-            mean, std = (0.485, 0.456, 0.406), (0.229, 0.224, 0.225)
-            train_normalizer = device_normalizer_for(
-                mean, std, flip=cfg.flip_augment
-            )
-            eval_normalizer = device_normalizer_for(mean, std)
     trainer = Trainer(
         state,
         strategy,
         build_train_step(
             classification_loss_fn(
-                model,
-                weight_decay=cfg.weight_decay,
-                label_smoothing=cfg.label_smoothing,
+                model, label_smoothing=cfg.label_smoothing
             ),
             batch_transform=train_normalizer,
-            ema_decay=cfg.ema_decay if cfg.ema_decay > 0 else None,
         ),
         train_loader,
         eval_step=classification_eval_step(
@@ -217,8 +206,6 @@ def main(argv=None):
             async_checkpoint=cfg.async_checkpoint,
             metrics_path=cfg.metrics_path,
             tensorboard_dir=cfg.tensorboard_dir or None,
-            eval_with_ema=cfg.ema_decay > 0,
-            log_mfu=cfg.log_mfu,
         ),
     )
     trainer.restore_checkpoint()

@@ -59,7 +59,9 @@ def main():
         image=rng.integers(0, 256, size=(n_img, src, src, 3), dtype=np.uint8),
         label=rng.integers(1000, size=(n_img,)).astype(np.int32),
     )
-    pipe = ImageBatchPipeline(crop, train=True)
+    # the f32 escape-hatch path (this script decomposes the HOST f32
+    # ceiling; uint8 is the default ingest since the §3d flip)
+    pipe = ImageBatchPipeline(crop, train=True, device_normalize=False)
     strategy = DataParallel()
     sharding = strategy.batch_sharding()
 

@@ -8,6 +8,7 @@ not appear anywhere in the output.
 
 import json
 import os
+import re
 import subprocess
 import sys
 
@@ -63,6 +64,32 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
         assert rec["platform"] == "cpu"
     assert "hostring_allreduce_ms" in proc.stderr
     assert "input_pipeline_u8_feed_images_per_sec" in proc.stderr
+    # the f32 escape hatch stays tracked as the reference-parity number
+    assert "input_pipeline_f32_feed_images_per_sec" in proc.stderr
+    # the DEFAULT ingest path must also be tracked END TO END (uint8
+    # loader -> fused on-device normalize -> train step), not feed-only
+    e2e = [
+        json.loads(l) for l in proc.stderr.splitlines()
+        if l.startswith("{")
+        and json.loads(l)["metric"] == "input_pipeline_u8_e2e_images_per_sec"
+    ]
+    assert len(e2e) == 1, proc.stderr[-2000:]
+    assert e2e[0]["value"] > 0
+    # CPU fallback: small-shape smoke — must not wear a chip-claim ratio
+    assert e2e[0]["vs_baseline"] is None
+
+    # the input_pipeline phases must stay inside their time budget (the
+    # r3 starvation incident: the feed phase alone ran >25 min and ate
+    # every later phase's budget). Phase durations are printed as
+    # "# phase <name> done in <sec>s".
+    durations = {}
+    for line in proc.stderr.splitlines():
+        m = re.match(r"# phase (\S+) done in ([0-9.]+)s", line)
+        if m:
+            durations[m.group(1)] = float(m.group(2))
+    assert "input_pipeline_feed" in durations, sorted(durations)
+    assert durations["input_pipeline_feed"] < 300, durations
+    assert durations.get("input_pipeline_u8_e2e", 0) < 300, durations
 
 
 @pytest.mark.slow

@@ -42,7 +42,9 @@ def test_index_and_classes(image_root):
 
 def test_train_pipeline_batches(image_root):
     ds = ImageFolderDataset(str(image_root / "train"))
-    pipe = FolderImagePipeline(32, train=True, seed=1)
+    pipe = FolderImagePipeline(
+        32, train=True, seed=1, device_normalize=False
+    )
     batch = pipe(ds, np.arange(12))
     assert batch["image"].shape == (12, 32, 32, 3)
     assert batch["image"].dtype == np.float32
@@ -100,7 +102,9 @@ def test_device_normalize_matches_host_path(image_root):
     import jax
 
     ds = ImageFolderDataset(str(image_root / "val"))
-    host = FolderImagePipeline(32, train=False, resize=48)
+    host = FolderImagePipeline(
+        32, train=False, resize=48, device_normalize=False
+    )
     dev = FolderImagePipeline(
         32, train=False, resize=48, device_normalize=True
     )
@@ -117,7 +121,8 @@ def test_device_normalize_matches_host_path(image_root):
 
 
 @pytest.mark.slow
-def test_resnet50_recipe_trains_on_image_folder_device_normalize(image_root):
+def test_resnet50_recipe_trains_on_image_folder_default_u8(image_root):
+    """Default ingest: uint8 ship + on-device normalize (no flag)."""
     import os
     import sys
 
@@ -131,14 +136,14 @@ def test_resnet50_recipe_trains_on_image_folder_device_normalize(image_root):
             "--data-dir", str(image_root), "--epochs", "1",
             "--batch-size", "8", "--image-size", "32", "--dp", "-1",
             "--log-every", "1", "--warmup-epochs", "0",
-            "--device-normalize",
         ]
     )
     assert "accuracy" in metrics
 
 
 @pytest.mark.slow
-def test_resnet50_recipe_trains_on_image_folder(image_root):
+def test_resnet50_recipe_trains_on_image_folder_host_f32(image_root):
+    """The --no-device-normalize escape hatch still trains."""
     import os
     import sys
 
@@ -152,6 +157,7 @@ def test_resnet50_recipe_trains_on_image_folder(image_root):
             "--data-dir", str(image_root), "--epochs", "1",
             "--batch-size", "8", "--image-size", "32", "--dp", "-1",
             "--log-every", "1", "--warmup-epochs", "0",
+            "--no-device-normalize",
         ]
     )
     assert "accuracy" in metrics

@@ -220,6 +220,13 @@ def test_init_multihost_real_two_process_world():
     """REAL jax.distributed rendezvous: 2 controller processes form one
     global device world and run a cross-process (DCN-story) collective.
     The strongest offline evidence for the pod path — not a mock."""
+    import jax
+
+    if tuple(map(int, jax.__version__.split(".")[:2])) < (0, 5):
+        # this container's jax 0.4 CPU backend raises "Multiprocess
+        # computations aren't implemented on the CPU backend" — the
+        # feature needs a newer jaxlib, nothing the repo can shim
+        pytest.skip("jax < 0.5: no cross-process collectives on CPU")
     _run_multihost(hostring_workers.multihost_worker, 2, timeout=180)
 
 

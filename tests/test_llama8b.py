@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax.sharding import AbstractMesh
+from pytorch_distributed_tpu.runtime.compat import abstract_mesh
 
 from pytorch_distributed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 from pytorch_distributed_tpu.parallel import FSDP
@@ -86,7 +86,7 @@ def test_8b_fsdp_state_fits_v5p64(abstract_8b_state):
     # full-shard over all 64 chips (the reference FSDP full-shard shape):
     # 96 GB / 64 = ~1.5 GB/device
     per_device, replicated_big = _per_device_bytes(
-        abstract, FSDP(AbstractMesh((1, 64), ("dp", "fsdp")))
+        abstract, FSDP(abstract_mesh((1, 64), ("dp", "fsdp")))
     )
     assert not replicated_big, (
         f"large tensors left fully replicated: {replicated_big[:5]}"
@@ -98,7 +98,7 @@ def test_8b_fsdp_state_fits_v5p64(abstract_8b_state):
     # still comfortably inside even v4's 32 GB HBM, leaving >3x headroom
     # for grads + activations at seq 2048
     per_device, _ = _per_device_bytes(
-        abstract, FSDP(AbstractMesh((4, 16), ("dp", "fsdp")))
+        abstract, FSDP(abstract_mesh((4, 16), ("dp", "fsdp")))
     )
     assert per_device < 8e9, f"{per_device/1e9:.2f} GB static state/device"
     assert per_device < V4_HBM_BYTES / 3
@@ -137,7 +137,7 @@ def test_8b_adafactor_halves_optimizer_state(abstract_8b_state):
     )
     # and it still shards under FSDP without leaving big replicas
     per_device, replicated_big = _per_device_bytes(
-        abstract, FSDP(AbstractMesh((1, 64), ("dp", "fsdp")))
+        abstract, FSDP(abstract_mesh((1, 64), ("dp", "fsdp")))
     )
     assert not replicated_big, replicated_big[:5]
     assert per_device < 1.5e9, f"{per_device/1e9:.2f} GB/device"
@@ -176,7 +176,7 @@ def test_8b_decode_cache_bytes_bounded_by_cache_len(abstract_8b_state):
 
 
 def _lower_8b_step(model, abstract, loss_fn, *, packed=False):
-    mesh = AbstractMesh((4, 16), ("dp", "fsdp"))
+    mesh = abstract_mesh((4, 16), ("dp", "fsdp"))
     strategy = FSDP(mesh)
     shardings = strategy.state_shardings(abstract)
     state_shapes = jax.tree_util.tree_map(

@@ -42,7 +42,7 @@ def test_eval_pipeline_center_crop_normalize():
     crop = 8
     mean, std = (0.4, 0.5, 0.6), (0.2, 0.25, 0.3)
     pipe = ImageBatchPipeline(
-        crop, train=False, mean=mean, std=std
+        crop, train=False, mean=mean, std=std, device_normalize=False
     )
     idx = np.arange(10)
     batch = pipe(ds, idx)
@@ -62,7 +62,7 @@ def test_eval_pipeline_center_crop_normalize():
 
 def test_train_pipeline_crops_flips_deterministic():
     ds = _dataset()
-    pipe = ImageBatchPipeline(8, train=True, seed=5)
+    pipe = ImageBatchPipeline(8, train=True, seed=5, device_normalize=False)
     idx = np.arange(16)
     b1, b2 = pipe(ds, idx), pipe(ds, idx)
     # same (seed, indices) -> identical augmentation (resume contract)
@@ -78,7 +78,8 @@ def test_train_flip_is_a_real_flip():
     ds = _dataset()
     # crop == source size (after no pad): only flip varies
     pipe = ImageBatchPipeline(H, train=True, flip=True, seed=0,
-                              mean=(0, 0, 0), std=(1, 1, 1))
+                              mean=(0, 0, 0), std=(1, 1, 1),
+                              device_normalize=False)
     idx = np.arange(32)
     batch = pipe(ds, idx)
     src = ds.arrays["image"].astype(np.float32) / 255.0
@@ -108,7 +109,7 @@ def test_device_normalize_u8_path_matches_f32_path():
 
     ds = _dataset(3)
     idx = np.arange(16)
-    f32 = ImageBatchPipeline(crop=8, train=True, seed=7)
+    f32 = ImageBatchPipeline(crop=8, train=True, seed=7, device_normalize=False)
     u8 = ImageBatchPipeline(crop=8, train=True, seed=7, device_normalize=True)
     a = f32(ds, idx)
     b = u8(ds, idx)

@@ -594,6 +594,12 @@ class TestMaxStepsPerEpoch:
         tr.fit()  # must RETURN (3 steps x 2 epochs), not spin forever
         assert tr.host_step == 6
 
+    @pytest.mark.slow  # demoted on this rig: reproducibly triggers the
+    # XLA:CPU accumulated-jit-state abort when the FULL fast suite runs
+    # in one process (passes solo and in run_full_suite.sh batches,
+    # where it keeps running). Fast siblings:
+    # test_endless_stream_bounded_epochs covers the stream epoch loop;
+    # TestResume/test_* cover checkpoint-resume position math.
     def test_resume_position_reconstructed(self, dp8, tmp_path):
         tr = self._trainer(dp8, tmp_path, epochs=1)
         tr.fit()  # saves at epoch end, step 3
