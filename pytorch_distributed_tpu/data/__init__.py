@@ -20,12 +20,15 @@ from pytorch_distributed_tpu.data.sampler import (
 )
 from pytorch_distributed_tpu.data.loader import DataLoader
 from pytorch_distributed_tpu.data.native_pipeline import (
+    BadSampleBudgetExceeded,
     HostStagingRing,
     ImageBatchPipeline,
+    SampleQuarantine,
     device_normalizer_for,
     gather_rows,
     host_flip_transform,
     make_device_normalizer,
+    read_with_retries,
 )
 from pytorch_distributed_tpu.data.datasets import (
     ArrayDataset,
@@ -60,8 +63,11 @@ __all__ = [
     "GlobalBatchSampler",
     "WeightedRandomSampler",
     "DataLoader",
+    "BadSampleBudgetExceeded",
     "HostStagingRing",
     "ImageBatchPipeline",
+    "SampleQuarantine",
+    "read_with_retries",
     "device_normalizer_for",
     "gather_rows",
     "host_flip_transform",

@@ -23,9 +23,16 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from pytorch_distributed_tpu.data.native_pipeline import _StagingMixin
+from pytorch_distributed_tpu.data.native_pipeline import (
+    SampleQuarantine,
+    _StagingMixin,
+    is_transient_io_error,
+    read_with_retries,
+)
+from pytorch_distributed_tpu.runtime import faults
 
 _EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".webp")
+_PROBE_CAP = 16  # fresh decode attempts per batch slot before giving up
 
 
 class ImageFolderDataset:
@@ -80,6 +87,18 @@ class FolderImagePipeline(_StagingMixin):
     :class:`HostStagingRing` instead of allocating per batch; default
     (None) auto-enables when the consuming DataLoader device-puts every
     batch (see ``_StagingMixin``).
+
+    Fault tolerance (docs/DESIGN.md "failure model"): transient I/O
+    errors are retried ``io_retries`` times with capped exponential
+    backoff; a sample that won't *decode* (rot is permanent; it is never
+    retried) is quarantined; either way the batch slot is filled by the
+    next readable sample of the index space, so one bad file costs a log
+    line instead of the epoch. A transient error that merely outlasts
+    its retries is substituted for that batch but NOT quarantined — the
+    sample stays eligible next epoch (a storage blip must not evict
+    healthy files). More than ``bad_sample_budget`` *quarantined*
+    samples is a hard error: at that point substitution would be
+    silently reshaping the training distribution.
     """
 
     def __init__(
@@ -96,9 +115,14 @@ class FolderImagePipeline(_StagingMixin):
         device_normalize: bool = True,
         num_threads: int = 0,
         reuse_staging: Optional[bool] = None,
+        io_retries: int = 2,
+        retry_backoff_s: float = 0.05,
+        bad_sample_budget: int = 100,
+        quarantine: Optional["SampleQuarantine"] = None,
     ):
         """``num_threads``: decode/resize pool width (0 = one per core,
-        1 = sequential)."""
+        1 = sequential). ``quarantine``: share one registry (and budget)
+        across pipelines — e.g. train and eval over the same disk."""
         self.crop = crop
         self.train = train
         self.resize = resize
@@ -109,6 +133,12 @@ class FolderImagePipeline(_StagingMixin):
         self.ratio = ratio
         self.device_normalize = device_normalize
         self.num_threads = num_threads
+        self.io_retries = int(io_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.quarantine = (
+            quarantine if quarantine is not None
+            else SampleQuarantine(bad_sample_budget)
+        )
         self._init_staging(reuse_staging)
         self.epoch = 0
         self._executor = None  # lazy; close() releases, else joined by
@@ -209,14 +239,67 @@ class FolderImagePipeline(_StagingMixin):
         # scales with host cores like the native u8 pipeline does.
         rngs = rng.spawn(n) if self.train else [None] * n
 
+        def decode(path):
+            def attempt():
+                # fault sites: data.fetch = transient I/O (retried),
+                # data.decode = permanent rot (straight to quarantine)
+                faults.check("data.fetch", path=path)
+                with Image.open(path) as im:
+                    faults.check("data.decode", path=path)
+                    return im.convert("RGB")  # convert() materializes:
+                    # the returned image is safe after the file closes
+
+            return read_with_retries(
+                attempt, retries=self.io_retries,
+                backoff_s=self.retry_backoff_s, what=path,
+            )
+
         def work(j):
-            path, label = dataset.samples[int(idx[j])]
-            with Image.open(path) as im:
-                im = im.convert("RGB")
-                im = (
-                    self._train_crop(im, rngs[j])
-                    if self.train else self._eval_crop(im)
+            # substitution probe: walk forward from the drawn index past
+            # quarantined/bad samples — deterministic given the same
+            # quarantine state, and the batch keeps its shape so one
+            # rotted JPEG can't kill the epoch. At most _PROBE_CAP fresh
+            # decode ATTEMPTS (quarantine skips are free): during a full
+            # storage outage each attempt burns retries + backoff, and
+            # walking a 1.28M-sample index space before erroring would
+            # hang the job for days instead of failing it promptly for
+            # the elastic restart to catch
+            n_samples = len(dataset.samples)
+            im = None
+            attempts = 0
+            for probe in range(n_samples):
+                path, label = dataset.samples[(int(idx[j]) + probe) % n_samples]
+                if path in self.quarantine:
+                    continue
+                if attempts >= _PROBE_CAP:
+                    break
+                attempts += 1
+                try:
+                    im = decode(path)
+                    break
+                except Exception as e:
+                    reason = f"{type(e).__name__}: {e}"
+                    if is_transient_io_error(e):
+                        # retries exhausted on a TRANSIENT error: the
+                        # file is (probably) fine, the storage wasn't —
+                        # substitute this once, don't evict the sample
+                        self.quarantine.note_transient(path, reason)
+                    else:
+                        # permanent rot; may raise BadSampleBudgetExceeded
+                        # — which must propagate: that is the hard stop
+                        self.quarantine.add(path, reason)
+            if im is None:
+                raise RuntimeError(
+                    f"no readable sample found for index {int(idx[j])}: "
+                    f"{attempts} probe(s) failed "
+                    f"({len(self.quarantine)} quarantined, "
+                    f"{self.quarantine.transient_events} transient "
+                    f"substitutions) — storage outage or dataset rot"
                 )
+            im = (
+                self._train_crop(im, rngs[j])
+                if self.train else self._eval_crop(im)
+            )
             out[j] = np.asarray(im)
             labels[j] = label
 

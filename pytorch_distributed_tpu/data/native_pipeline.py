@@ -16,11 +16,16 @@ from __future__ import annotations
 
 import ctypes
 import os
-from typing import Optional, Sequence
+import threading
+import time
+from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from pytorch_distributed_tpu.utils.logging import get_logger
 from pytorch_distributed_tpu.utils.native_build import build_native_library
+
+logger = get_logger(__name__)
 
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
@@ -280,6 +285,162 @@ class HostStagingRing:
         for h in handles:
             jax.block_until_ready(h)
             np.asarray(h)  # value fetch = real sync on the relay
+
+
+class BadSampleBudgetExceeded(RuntimeError):
+    """More samples were quarantined than the pipeline's budget allows —
+    the dataset (or the storage under it) is damaged beyond "a few rotten
+    files", and silently substituting a meaningful fraction of the epoch
+    would corrupt the training distribution."""
+
+
+class SampleQuarantine:
+    """Thread-safe registry of samples that failed to read/decode.
+
+    One bad JPEG three hours into an epoch must cost one log line and one
+    substituted sample, not the job — but *unbounded* substitution would
+    silently train on a different distribution, so crossing ``budget``
+    quarantined samples raises :class:`BadSampleBudgetExceeded`. Decode
+    pool threads share one instance; re-quarantining a known path is free
+    and unlogged (every epoch revisits the same bad files).
+
+    Only PERMANENT rot (undecodable bytes, missing files) is
+    quarantined. A transient error that merely outlasted its retries (a
+    storage blip longer than the backoff window) is recorded as
+    :meth:`note_transient` — the sample is substituted for *this* batch
+    but stays eligible for future epochs and does not join the skip set:
+    a few seconds of NFS outage across a fanned-out decode pool must not
+    permanently evict hundreds of healthy files. Transient substitutions
+    still have their own (much larger) ceiling, ``transient_budget``
+    (default ``10 * budget``): a disk persistently returning EIO looks
+    transient per-event but reshapes the distribution all the same, and
+    must eventually be a hard stop too.
+    """
+
+    def __init__(self, budget: int = 100, transient_budget: Optional[int] = None):
+        if budget < 0:
+            raise ValueError(f"bad-sample budget must be >= 0, got {budget}")
+        self.budget = int(budget)
+        self.transient_budget = (
+            10 * self.budget if transient_budget is None
+            else int(transient_budget)
+        )
+        self._paths: set = set()
+        self._lock = threading.Lock()
+        self.transient_events = 0  # substitutions due to exhausted retries
+
+    def __contains__(self, path: str) -> bool:
+        with self._lock:
+            return path in self._paths
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._paths)
+
+    @property
+    def paths(self) -> list:
+        with self._lock:
+            return sorted(self._paths)
+
+    def note_transient(self, path: str, reason: str) -> None:
+        """A healthy-looking sample failed transiently past its retries:
+        substituted this once, retried next epoch, never quarantined."""
+        with self._lock:
+            self.transient_events += 1
+            count = self.transient_events
+        logger.warning(
+            "substituting sample %s for this batch after exhausted "
+            "transient-I/O retries (%s) — %d transient substitution(s) "
+            "so far; the sample stays eligible", path, reason, count,
+        )
+        if count > self.transient_budget:
+            raise BadSampleBudgetExceeded(
+                f"{count} transient-substitution events (ceiling "
+                f"{self.transient_budget}) — the storage is persistently "
+                f"failing, not blinking; latest: {path} ({reason})"
+            )
+
+    def add(self, path: str, reason: str) -> None:
+        with self._lock:
+            if path in self._paths:
+                return
+            self._paths.add(path)
+            count = len(self._paths)
+        logger.warning(
+            "quarantined unreadable/undecodable sample %s (%s) — "
+            "%d bad sample(s) so far (budget %d)",
+            path, reason, count, self.budget,
+        )
+        if count > self.budget:
+            raise BadSampleBudgetExceeded(
+                f"{count} samples quarantined (budget {self.budget}) — "
+                f"latest: {path} ({reason}); the dataset needs repair, "
+                f"not more substitution"
+            )
+
+
+def is_transient_io_error(e: BaseException) -> bool:
+    """Is retrying this read plausibly useful? Transient: OS-level I/O
+    errors (NFS hiccup, EMFILE under pressure) and the ``data.fetch``
+    injection site. Permanent: decode failures — a rotted JPEG does not
+    get better on the third read, nor does the ``data.decode`` site.
+
+    PIL muddies the classes by raising plain ``OSError`` for damaged
+    image DATA too (``UnidentifiedImageError`` for junk headers, bare
+    ``OSError("image file is truncated...")`` from the decoder). The
+    discriminator is ``errno``: a real I/O failure from the OS carries
+    one (EIO, EMFILE, ...); PIL's synthetic decode errors are
+    constructed from a message alone and have ``errno is None``. A
+    MISSING file (ENOENT/ENOTDIR) is the exception: it carries an errno
+    but is permanent damage — a dataset that lost files after indexing
+    must hit the quarantine budget, not be silently substituted (and
+    retried) forever."""
+    import errno as _errno
+
+    from pytorch_distributed_tpu.runtime import faults
+
+    if isinstance(e, faults.InjectedFault):
+        return e.site == "data.fetch"
+    try:
+        from PIL import UnidentifiedImageError
+    except Exception:  # pragma: no cover - PIL always present here
+        UnidentifiedImageError = ()
+    if isinstance(e, UnidentifiedImageError):
+        return False
+    return (
+        isinstance(e, OSError)
+        and e.errno is not None
+        and e.errno not in (_errno.ENOENT, _errno.ENOTDIR)
+    )
+
+
+def read_with_retries(
+    fn: Callable[[], "object"],
+    *,
+    retries: int = 2,
+    backoff_s: float = 0.05,
+    max_backoff_s: float = 1.0,
+    what: str = "",
+):
+    """``fn()`` with capped exponential backoff on *transient* errors.
+
+    Permanent errors (undecodable bytes) and exhausted retries propagate
+    to the caller — quarantine/substitution policy lives there, not here.
+    """
+    delay = backoff_s
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except Exception as e:
+            if attempt >= retries or not is_transient_io_error(e):
+                raise
+            logger.warning(
+                "transient read error on %s (attempt %d/%d): %s — "
+                "retrying in %.2fs", what or "<sample>", attempt + 1,
+                retries + 1, e, delay,
+            )
+            time.sleep(delay)
+            delay = min(delay * 2.0, max_backoff_s)
 
 
 def _accelerator_backend() -> bool:

@@ -1,0 +1,307 @@
+"""Deterministic, seeded fault injection at named sites.
+
+veScale's argument (PAPERS.md) is that single-controller SPMD only earns
+its simplicity if the runtime guarantees consistency end to end — which
+means the recovery paths (checkpoint fallback, ingest quarantine, elastic
+restart) need a way to be *proven*, not just written. This module is that
+proof harness: production code calls :func:`check` at named sites, and a
+chaos run arms a subset of them with seeded probability/count budgets.
+
+Unarmed (the production default — ``PTD_FAULTS`` unset, no
+:func:`configure` call) every site check is a single module-global
+``is None`` test and an immediate return: no RNG draw, no allocation,
+nothing measurable on the ingest or checkpoint hot paths.
+
+Arming::
+
+    PTD_FAULTS="ckpt.write_shard:count=1;data.decode:p=0.3" python train.py
+    PTD_FAULTS_SEED=7 ...                     # decision stream seed
+
+or programmatically (tests)::
+
+    with faults.injected("ckpt.swing:mode=raise,count=1"):
+        ...
+
+Grammar: ``site[:key=value,...]`` joined by ``;``. Options per site:
+
+* ``p``     — firing probability per eligible check (default 1.0)
+* ``count`` — total firing budget (default unlimited)
+* ``after`` — skip the first N eligible checks before arming (default 0)
+* ``mode``  — what a firing does (default ``raise``):
+    * ``raise``    — raise :class:`InjectedFault` at the site
+    * ``kill``     — ``os._exit`` immediately (a SIGKILL-grade crash: no
+      atexit handlers, no flushes — the mid-write torture test)
+    * ``truncate`` — silently truncate the site's file to half (requires
+      the site to pass ``path=``; models a torn write)
+    * ``bitflip``  — silently flip one byte mid-file (models bit rot)
+* ``match`` — only checks whose ``path`` contains this substring are
+  eligible (e.g. corrupt one specific shard)
+
+Decisions are deterministic: each site draws from its own generator
+seeded by ``(seed, crc32(site))``, so arming additional sites never
+perturbs an existing site's decision stream, and the same seed + the
+same call sequence reproduces the same failures.
+
+Known sites (grep for ``faults.check`` to find the exact spots):
+
+================== ====================================================
+``ckpt.write_shard`` after each shard file is written (+checksummed) in
+                     ``train/checkpoint.py`` — raise/kill abort the save
+                     mid-write; truncate/bitflip corrupt silently
+``ckpt.swing``       inside the atomic-rename window of ``_swing``
+                     (between ``final -> old`` and ``tmp -> final``)
+``ckpt.read_shard``  before each shard ``np.load`` on restore
+``data.fetch``       before opening a sample file (transient I/O; the
+                     ingest retry path treats it as retryable)
+``data.decode``      after open, before decode (permanent rot; the
+                     ingest path quarantines it)
+``step.nan``         at the Trainer's logging sync — forces the logged
+                     loss to NaN (drives ``halt_on_nonfinite``)
+================== ====================================================
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import zlib
+from typing import Dict, Optional
+
+import numpy as np
+
+from pytorch_distributed_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+ENV_SPEC = "PTD_FAULTS"
+ENV_SEED = "PTD_FAULTS_SEED"
+
+#: exit status used by ``mode=kill`` — distinct from EX_TEMPFAIL(75) so a
+#: drill can tell an injected crash from a clean preemption exit
+KILLED_EXIT = 113
+
+KNOWN_SITES = (
+    "ckpt.write_shard",
+    "ckpt.swing",
+    "ckpt.read_shard",
+    "data.fetch",
+    "data.decode",
+    "step.nan",
+)
+_MODES = ("raise", "kill", "truncate", "bitflip")
+
+
+class InjectedFault(RuntimeError):
+    """Raised at an armed fault site (``mode=raise``)."""
+
+    def __init__(self, site: str, path: Optional[str] = None):
+        msg = f"injected fault at {site}"
+        if path:
+            msg += f" ({path})"
+        super().__init__(msg)
+        self.site = site
+        self.path = path
+
+
+class _Site:
+    """One armed site: its budgets and its private decision stream."""
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        p: float = 1.0,
+        count: Optional[int] = None,
+        after: int = 0,
+        mode: str = "raise",
+        match: Optional[str] = None,
+        seed: int = 0,
+    ):
+        if mode not in _MODES:
+            raise ValueError(
+                f"fault site {name!r}: unknown mode {mode!r} "
+                f"(one of {_MODES})"
+            )
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"fault site {name!r}: p={p} not in [0, 1]")
+        if count is not None and count < 0:
+            raise ValueError(f"fault site {name!r}: count must be >= 0")
+        if after < 0:
+            raise ValueError(f"fault site {name!r}: after must be >= 0")
+        self.name = name
+        self.p = float(p)
+        self.count = count
+        self.after = int(after)
+        self.mode = mode
+        self.match = match
+        self.fired = 0  # times this site actually fired
+        self.seen = 0  # eligible checks observed
+        # per-site stream keyed by (seed, site name): arming another site
+        # never shifts this one's decisions
+        self._rng = np.random.default_rng(
+            [int(seed), zlib.crc32(name.encode())]
+        )
+        self._lock = threading.Lock()
+
+    def decide(self, path: Optional[str]) -> bool:
+        """Should this check fire? Thread-safe (shard writers are pooled)."""
+        with self._lock:
+            if self.match is not None and (
+                path is None or self.match not in str(path)
+            ):
+                return False
+            self.seen += 1
+            if self.seen <= self.after:
+                return False
+            if self.count is not None and self.fired >= self.count:
+                return False
+            if self.p < 1.0 and float(self._rng.random()) >= self.p:
+                return False
+            self.fired += 1
+            return True
+
+
+class FaultPlan:
+    """The armed sites of one chaos run."""
+
+    def __init__(self, sites: Dict[str, _Site]):
+        self.sites = sites
+
+    @classmethod
+    def parse(cls, spec: str, *, seed: int = 0) -> "FaultPlan":
+        sites: Dict[str, _Site] = {}
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, opts_str = part.partition(":")
+            name = name.strip()
+            if name not in KNOWN_SITES:
+                # a typo'd site would silently test nothing — refuse
+                raise ValueError(
+                    f"unknown fault site {name!r} (known: {KNOWN_SITES})"
+                )
+            kw: dict = {}
+            for opt in filter(None, opts_str.split(",")):
+                key, _, value = opt.partition("=")
+                key = key.strip()
+                value = value.strip()
+                if key == "p":
+                    kw["p"] = float(value)
+                elif key in ("count", "after"):
+                    kw[key] = int(value)
+                elif key in ("mode", "match"):
+                    kw[key] = value
+                else:
+                    raise ValueError(
+                        f"fault site {name!r}: unknown option {key!r}"
+                    )
+            sites[name] = _Site(name, seed=seed, **kw)
+        if not sites:
+            raise ValueError(f"empty fault spec {spec!r}")
+        return cls(sites)
+
+
+_plan: Optional[FaultPlan] = None
+
+
+def configure(spec: str, *, seed: Optional[int] = None) -> FaultPlan:
+    """Arm a fault plan (replacing any active one); returns it."""
+    global _plan
+    if seed is None:
+        seed = int(os.environ.get(ENV_SEED, "0"))
+    _plan = FaultPlan.parse(spec, seed=seed)
+    logger.warning(
+        "fault injection ARMED (seed %d): %s", seed, sorted(_plan.sites)
+    )
+    return _plan
+
+
+def clear() -> None:
+    """Disarm: every later check is a no-op again."""
+    global _plan
+    _plan = None
+
+
+def active() -> bool:
+    return _plan is not None
+
+
+def fire_count(site: str) -> int:
+    """How many times ``site`` has fired (0 when unarmed/unknown)."""
+    if _plan is None:
+        return 0
+    s = _plan.sites.get(site)
+    return s.fired if s is not None else 0
+
+
+@contextlib.contextmanager
+def injected(spec: str, *, seed: int = 0):
+    """Scoped arming for tests; restores the previous plan on exit."""
+    global _plan
+    prev = _plan
+    configure(spec, seed=seed)
+    try:
+        yield _plan
+    finally:
+        _plan = prev
+
+
+def fires(site: str, path: Optional[str] = None) -> bool:
+    """Decision only — for sites that apply their own effect (e.g. the
+    Trainer's ``step.nan``). No-op False when unarmed."""
+    if _plan is None:
+        return False
+    s = _plan.sites.get(site)
+    return s is not None and s.decide(path)
+
+
+def check(site: str, path: Optional[str] = None) -> None:
+    """The production fault site: no-op unless this site is armed and its
+    budgets elect this check. ``path`` (when the site touches a file)
+    feeds ``match`` filters and the corrupting modes."""
+    if _plan is None:
+        return
+    s = _plan.sites.get(site)
+    if s is None or not s.decide(path):
+        return
+    logger.warning(
+        "fault injection: firing %s (mode=%s, %d/%s) at %s",
+        site, s.mode, s.fired, s.count if s.count is not None else "inf",
+        path or "<no path>",
+    )
+    if s.mode == "raise":
+        raise InjectedFault(site, path)
+    if s.mode == "kill":
+        os._exit(KILLED_EXIT)  # SIGKILL-grade: no cleanup, no flush
+    _corrupt(path, s.mode)
+
+
+def _corrupt(path: Optional[str], mode: str) -> None:
+    """Silently damage ``path`` (truncate / bitflip) — the site reports
+    success, so only checksum verification can catch it."""
+    if not path or not os.path.isfile(path):
+        return
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    with open(path, "r+b") as f:
+        if mode == "truncate":
+            f.truncate(max(size // 2, 1))
+        else:  # bitflip: one byte mid-file, deterministic offset
+            off = size // 2
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0xFF]))
+
+
+# env arming at import: the instrumented modules import this one, so a
+# PTD_FAULTS run is armed before any site can be reached. A malformed
+# spec raises here — a chaos drill whose spec silently parsed to nothing
+# would "pass" while testing nothing.
+_env_spec = os.environ.get(ENV_SPEC)
+if _env_spec:
+    configure(_env_spec)
+del _env_spec

@@ -30,14 +30,18 @@ from pytorch_distributed_tpu.train.losses import (
     accuracy,
 )
 from pytorch_distributed_tpu.train.checkpoint import (
+    CheckpointCorrupted,
     average_checkpoints,
     save_checkpoint,
     restore_checkpoint,
     checkpoint_exists,
     checkpoint_step,
     prune_checkpoints,
+    recover_stranded_checkpoints,
     resolve_tag,
+    restore_candidates,
     step_tags,
+    verify_checkpoint,
 )
 from pytorch_distributed_tpu.train.elastic import (
     EX_TEMPFAIL,
@@ -78,7 +82,11 @@ __all__ = [
     "Watchdog",
     "fit_elastic",
     "checkpoint_step",
+    "CheckpointCorrupted",
     "prune_checkpoints",
+    "recover_stranded_checkpoints",
     "resolve_tag",
+    "restore_candidates",
     "step_tags",
+    "verify_checkpoint",
 ]

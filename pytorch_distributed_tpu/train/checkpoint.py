@@ -27,6 +27,19 @@ orbax is:
 Writes are atomic (tmp dir + rename) so a preemption mid-save never
 corrupts the latest checkpoint — preemption-safety is the TPU-pod
 equivalent of torchrun's elastic restart (SURVEY.md §5).
+
+* **Integrity + self-healing restore.** Every shard file's byte length
+  and CRC32C land in the manifest, and a ``COMMIT`` marker (recording the
+  manifest's own checksum) is written last — so truncation, bit rot, and
+  torn manifests are *detectable* (:func:`verify_checkpoint`), not
+  opaque crashes three hours into a resume. The restore side walks
+  candidates newest→oldest (:func:`restore_candidates`), recovers the
+  ``.old``/``.tmp`` directories a kill inside ``_swing``'s rename window
+  can strand (:func:`recover_stranded_checkpoints`), and skips candidates
+  whose manifest is unreadable or whose shards fail checksum. The save
+  and restore paths carry ``runtime/faults.py`` injection sites
+  (``ckpt.write_shard``/``ckpt.swing``/``ckpt.read_shard``) so
+  ``tests/test_chaos.py`` can prove all of the above with seeded kills.
 """
 
 from __future__ import annotations
@@ -41,13 +54,26 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from pytorch_distributed_tpu.runtime import faults
 from pytorch_distributed_tpu.train.train_state import TrainState
+from pytorch_distributed_tpu.utils.integrity import (
+    PREFERRED_ALGO,
+    algo_supported,
+    checksum_file,
+)
 from pytorch_distributed_tpu.utils.logging import get_logger
 
 _MANIFEST = "manifest.json"
+_COMMIT = "COMMIT"  # written last: its presence means the dir is complete
 _IO_THREADS = 8
 
 logger = get_logger(__name__)
+
+
+class CheckpointCorrupted(RuntimeError):
+    """Checkpoints exist on disk but none survived integrity checks —
+    resuming fresh would silently discard (and eventually overwrite) the
+    run's only remaining state."""
 
 
 def _leaf_files(tree) -> list:
@@ -118,27 +144,40 @@ def _host_int(x) -> int:
 
 
 def _write_files(tmp: str, snap: list, step: int) -> None:
-    """Write this process's shard files + its per-process manifest."""
+    """Write this process's shard files + its per-process manifest.
+
+    Each shard file's byte length and checksum are recorded next to its
+    box in the manifest; the checksum is of the bytes as written (before
+    the ``ckpt.write_shard`` fault site can corrupt them), so injected —
+    or real — post-write damage is detectable by :func:`verify_checkpoint`.
+    """
     proc = jax.process_index()
     entries = []
-    jobs = []  # (fname, host_array)
+    jobs = []  # (fname, host_array, shard_entry)
     for i, (name, boxes, shape, dtype) in enumerate(snap):
         shards = []
         for j, (start, stop, data) in enumerate(boxes):
             fname = f"{i:05d}_{name[:72]}.p{proc}s{j}.npy"
-            shards.append(
-                {"file": fname, "start": list(start), "stop": list(stop)}
-            )
-            jobs.append((fname, data))
+            entry = {"file": fname, "start": list(start), "stop": list(stop)}
+            shards.append(entry)
+            jobs.append((fname, data, entry))
         entries.append(
             {"path": name, "shape": shape, "dtype": dtype, "shards": shards}
         )
+
+    def _write_one(job):
+        fname, data, entry = job
+        path = os.path.join(tmp, fname)
+        np.save(path, data)
+        value, nbytes = checksum_file(path)
+        entry["bytes"] = nbytes
+        if value is not None:
+            entry["checksum"] = value
+            entry["checksum_algo"] = PREFERRED_ALGO
+        faults.check("ckpt.write_shard", path=path)
+
     with _futures.ThreadPoolExecutor(max_workers=_IO_THREADS) as pool:
-        list(
-            pool.map(
-                lambda job: np.save(os.path.join(tmp, job[0]), job[1]), jobs
-            )
-        )
+        list(pool.map(_write_one, jobs))
     with open(os.path.join(tmp, f"manifest-p{proc}.json"), "w") as f:
         json.dump({"version": 2, "step": step, "leaves": entries}, f)
 
@@ -189,8 +228,20 @@ def _save_sync(ckpt_dir: str, tag: str, snap: list, step: int) -> str:
     _barrier("ptd_ckpt_shards_written")
     if jax.process_index() == 0:
         manifest = _merge_manifests(tmp, step)
-        with open(os.path.join(tmp, _MANIFEST), "w") as f:
+        manifest_path = os.path.join(tmp, _MANIFEST)
+        with open(manifest_path, "w") as f:
             json.dump(manifest, f, indent=1)
+        # COMMIT is written LAST: a dir carrying it holds a fully-written
+        # manifest (checked against the recorded checksum) and therefore
+        # a complete set of shard records — recover_stranded_checkpoints
+        # uses it to decide whether a stranded .tmp can finish its swing
+        value, nbytes = checksum_file(manifest_path)
+        commit = {"step": step, "manifest_bytes": nbytes}
+        if value is not None:
+            commit["manifest_checksum"] = value
+            commit["checksum_algo"] = PREFERRED_ALGO
+        with open(os.path.join(tmp, _COMMIT), "w") as f:
+            json.dump(commit, f)
         _swing(ckpt_dir, tag, tmp)
     _barrier("ptd_ckpt_committed")
     return final
@@ -204,6 +255,9 @@ def _swing(ckpt_dir: str, tag: str, tmp: str) -> str:
         shutil.rmtree(old)
     if os.path.exists(final):
         os.replace(final, old)
+    # the crash window: a kill here leaves no <tag>, only <tag>.old (and
+    # the complete <tag>.tmp) — recover_stranded_checkpoints undoes it
+    faults.check("ckpt.swing", path=final)
     os.replace(tmp, final)
     if os.path.exists(old):
         shutil.rmtree(old)
@@ -276,9 +330,11 @@ def resolve_tag(ckpt_dir: str, tag: str = "latest") -> Optional[str]:
     resolves to whichever checkpoint is NEWEST by step: a hard kill can
     leave a stale ``latest`` (written at the last epoch boundary) beside
     newer mid-epoch ``step-<N>`` tags, and resuming the stale one would
-    silently redo up to an epoch of training."""
+    silently redo up to an epoch of training. A candidate whose manifest
+    is corrupt/truncated reads as absent (``checkpoint_step`` is None)
+    on BOTH paths — never hand back a tag that cannot be restored."""
     if tag != "latest":
-        return tag if checkpoint_exists(ckpt_dir, tag) else None
+        return tag if checkpoint_step(ckpt_dir, tag) is not None else None
     best_tag = None
     best_step = -1
     candidates = ["latest"] + [f"step-{s}" for s in step_tags(ckpt_dir)]
@@ -340,12 +396,203 @@ def checkpoint_exists(ckpt_dir: str, tag: str = "latest") -> bool:
     return os.path.exists(os.path.join(ckpt_dir, tag, _MANIFEST))
 
 
-def checkpoint_step(ckpt_dir: str, tag: str = "latest") -> Optional[int]:
-    path = os.path.join(ckpt_dir, tag, _MANIFEST)
-    if not os.path.exists(path):
+def _read_manifest(final: str) -> Optional[dict]:
+    """The manifest of checkpoint dir ``final``, or None when it is
+    missing, truncated, or not a manifest — a corrupt candidate must read
+    as ABSENT to the tag-resolution/fallback machinery, not crash it."""
+    path = os.path.join(final, _MANIFEST)
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+        if not isinstance(manifest, dict) or "leaves" not in manifest:
+            raise ValueError("not a checkpoint manifest")
+        int(manifest["step"])
+    except (OSError, ValueError, TypeError, KeyError) as e:
+        if os.path.exists(path):
+            logger.warning(
+                "unreadable checkpoint manifest %s (%s) — treating the "
+                "checkpoint as absent", path, e,
+            )
         return None
-    with open(path) as f:
-        return int(json.load(f)["step"])
+    return manifest
+
+
+def _read_commit(final: str) -> Optional[dict]:
+    """The COMMIT marker of ``final`` — None when absent/unreadable
+    (pre-integrity checkpoints have none; that alone is not corruption)."""
+    try:
+        with open(os.path.join(final, _COMMIT)) as f:
+            commit = json.load(f)
+        return commit if isinstance(commit, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def checkpoint_step(ckpt_dir: str, tag: str = "latest") -> Optional[int]:
+    """Step of ``tag``, or None when absent OR its manifest is corrupt —
+    callers scanning for the newest checkpoint keep scanning either way."""
+    manifest = _read_manifest(os.path.join(ckpt_dir, tag))
+    return None if manifest is None else int(manifest["step"])
+
+
+def verify_checkpoint(
+    ckpt_dir: str, tag: str = "latest", *, deep: bool = True
+) -> List[str]:
+    """Integrity problems of checkpoint ``tag`` ([] == intact).
+
+    Checks, in order of cost: manifest readability; the COMMIT marker
+    (when present) against the manifest's actual bytes; every shard
+    file's existence and recorded byte length; and — with ``deep`` — the
+    recorded per-shard checksums (a full read of the checkpoint; page
+    cache makes the verify-then-restore pattern roughly one read).
+    Checkpoints written before the integrity fields only get the
+    existence checks, not false corruption reports.
+    """
+    final = os.path.join(ckpt_dir, tag)
+    manifest = _read_manifest(final)
+    if manifest is None:
+        return [f"manifest missing or unreadable in {final}"]
+    problems = []
+    commit = _read_commit(final)
+    if commit is not None:
+        algo = commit.get("checksum_algo", "")
+        try:
+            value, nbytes = checksum_file(
+                os.path.join(final, _MANIFEST),
+                algo if algo_supported(algo) else PREFERRED_ALGO,
+            )
+        except OSError as e:  # raced a concurrent delete
+            return [f"manifest unreadable in {final}: {e}"]
+        if nbytes != commit.get("manifest_bytes"):
+            problems.append("manifest length does not match COMMIT marker")
+        elif (
+            algo_supported(algo)
+            and value != commit.get("manifest_checksum")
+        ):
+            problems.append("manifest checksum does not match COMMIT marker")
+        if int(commit.get("step", -1)) != int(manifest["step"]):
+            problems.append("COMMIT step does not match manifest step")
+    for entry in manifest["leaves"]:
+        for shard in _entry_shards(entry):
+            path = os.path.join(final, shard["file"])
+            if not os.path.isfile(path):
+                problems.append(f"shard {shard['file']} missing")
+                continue
+            nbytes = os.path.getsize(path)
+            if "bytes" in shard and nbytes != shard["bytes"]:
+                problems.append(
+                    f"shard {shard['file']} truncated "
+                    f"({nbytes} bytes, manifest says {shard['bytes']})"
+                )
+                continue
+            if deep and "checksum" in shard:
+                algo = shard.get("checksum_algo", "crc32c")
+                if not algo_supported(algo):
+                    continue  # length already checked; can't do better
+                value, _ = checksum_file(path, algo)
+                if value != shard["checksum"]:
+                    problems.append(
+                        f"shard {shard['file']} {algo} mismatch"
+                    )
+    return problems
+
+
+def _tag_names(ckpt_dir: str, tag: str) -> List[str]:
+    """Directory names that could satisfy a restore of ``tag``, including
+    the ``.old`` leftovers of an interrupted swing. ``latest`` (the
+    resume default) widens to every step-tagged checkpoint."""
+    if tag != "latest":
+        return [tag, tag + ".old"]
+    names = ["latest", "latest.old"]
+    if os.path.isdir(ckpt_dir):
+        for name in sorted(os.listdir(ckpt_dir)):
+            base = name[:-len(".old")] if name.endswith(".old") else name
+            if base.startswith("step-") and not base.endswith(".tmp"):
+                names.append(name)
+    return names
+
+
+def restore_candidates(ckpt_dir: str, tag: str = "latest") -> List[str]:
+    """Restorable checkpoint dirs for ``tag``, newest step first.
+
+    Candidates with unreadable manifests are dropped (they cannot be
+    restored, whatever else is wrong with them); ``.old`` dirs rank
+    after a same-step non-old sibling. This is the fallback order
+    ``Trainer.restore_checkpoint`` walks.
+    """
+    ranked = []
+    for name in _tag_names(ckpt_dir, tag):
+        if not os.path.isdir(os.path.join(ckpt_dir, name)):
+            continue
+        step = checkpoint_step(ckpt_dir, name)
+        if step is None:
+            continue
+        ranked.append((step, 0 if name.endswith(".old") else 1, name))
+    return [name for _, _, name in sorted(ranked, reverse=True)]
+
+
+def recover_stranded_checkpoints(ckpt_dir: str) -> List[str]:
+    """Undo what a kill inside the save/swing window left behind.
+
+    Two stranded shapes exist (see ``_swing``):
+
+    * ``<tag>.tmp`` with a COMMIT marker AND shards that pass deep
+      verification — the checkpoint was fully written but the rename
+      never ran (or ran halfway). Finish the swing: it is the NEWEST
+      state on disk. Verification first is load-bearing: ``_swing``
+      deletes ``<tag>.old``, so promoting a COMMIT-complete tmp whose
+      shards rotted after checksumming would destroy the only intact
+      fallback.
+    * ``<tag>.old`` without ``<tag>`` — the kill landed between
+      ``final -> old`` and ``tmp -> final`` and the tmp is unusable.
+      Promote the old dir back; it is the previous complete checkpoint.
+
+    Returns the recovered tags. Call only when no save can be in flight
+    (job start / restore time) — a live AsyncCheckpointer owns its tmp.
+    """
+    if not os.path.isdir(ckpt_dir):
+        return []
+    recovered = []
+    for name in sorted(os.listdir(ckpt_dir)):
+        if not name.endswith(".tmp"):
+            continue
+        tag = name[:-len(".tmp")]
+        tmp = os.path.join(ckpt_dir, name)
+        commit = _read_commit(tmp)
+        if commit is None or _read_manifest(tmp) is None:
+            continue  # an aborted write; prune_checkpoints cleans it
+        problems = verify_checkpoint(ckpt_dir, name)
+        if problems:
+            logger.warning(
+                "stranded checkpoint write %s is COMMIT-complete but "
+                "fails verification (%s) — not promoting it (an intact "
+                "%s.old can still be recovered)",
+                tmp, "; ".join(problems[:3]), tag,
+            )
+            continue
+        logger.warning(
+            "recovering stranded checkpoint write %s (step %s): "
+            "finishing the interrupted commit", tmp, commit.get("step"),
+        )
+        _swing(ckpt_dir, tag, tmp)
+        recovered.append(tag)
+    for name in sorted(os.listdir(ckpt_dir)):
+        if not name.endswith(".old"):
+            continue
+        tag = name[:-len(".old")]
+        final = os.path.join(ckpt_dir, tag)
+        old = os.path.join(ckpt_dir, name)
+        if os.path.exists(final):
+            continue  # normal swing debris or already recovered above
+        if _read_manifest(old) is None:
+            continue  # junk; never promote what cannot be restored
+        logger.warning(
+            "recovering stranded checkpoint %s: the swing's rename "
+            "window was interrupted — restoring it as %r", old, tag,
+        )
+        os.replace(old, final)
+        recovered.append(tag)
+    return recovered
 
 
 def _entry_shards(entry: dict) -> List[dict]:
@@ -356,6 +603,15 @@ def _entry_shards(entry: dict) -> List[dict]:
     return [
         {"file": entry["file"], "start": [0] * len(shape), "stop": shape}
     ]
+
+
+def _load_shard(final: str, fname: str, **kw) -> np.ndarray:
+    """``np.load`` of one shard file, with the ``ckpt.read_shard`` fault
+    site in front (chaos runs fail reads here to drive the fallback
+    chain; unarmed it is a no-op)."""
+    path = os.path.join(final, fname)
+    faults.check("ckpt.read_shard", path=path)
+    return np.load(path, **kw)
 
 
 def _assemble(
@@ -371,7 +627,7 @@ def _assemble(
     # Fast path: one shard covering exactly the requested box.
     for s in shards:
         if tuple(s["start"]) == box_start and tuple(s["stop"]) == box_stop:
-            return np.load(os.path.join(final, s["file"])).astype(dtype, copy=False)
+            return _load_shard(final, s["file"]).astype(dtype, copy=False)
     out = np.empty(out_shape, dtype)
     filled = 0
     for s in shards:
@@ -380,7 +636,7 @@ def _assemble(
         hi = tuple(min(a, b) for a, b in zip(box_stop, s_stop))
         if any(l >= h for l, h in zip(lo, hi)) and out.ndim > 0:
             continue
-        src = np.load(os.path.join(final, s["file"]), mmap_mode="r")
+        src = _load_shard(final, s["file"], mmap_mode="r")
         src_sel = tuple(
             slice(l - a, h - a) for l, h, a in zip(lo, hi, s_start)
         )
@@ -390,7 +646,7 @@ def _assemble(
         out[dst_sel] = src[src_sel]
         filled += int(np.prod([h - l for l, h in zip(lo, hi)])) if out.ndim else 1
     if out.ndim == 0 and shards:
-        out[()] = np.load(os.path.join(final, shards[0]["file"]))
+        out[()] = _load_shard(final, shards[0]["file"])
     elif filled < int(np.prod(out_shape)):
         raise ValueError(
             f"checkpoint shards for {entry['path']!r} do not cover the "

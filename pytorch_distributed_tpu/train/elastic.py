@@ -154,14 +154,23 @@ class Watchdog:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.stalled = False
+        self.last_step: Optional[int] = None  # last completed train step
         # until the first tick the threshold is the (long) grace window:
         # the first train step includes XLA compilation, which can dwarf
         # the steady-state step time by orders of magnitude
         self.first_grace_s = max(float(first_grace_s), self.stall_timeout_s)
         self._armed = False
 
-    def tick(self) -> None:
+    def tick(self, step: Optional[int] = None) -> None:
+        """Progress heartbeat. ``step`` (when the caller knows it) makes a
+        later stall report attributable — the restart investigation
+        starts from "it hung after step N", not a bare timestamp. A tick
+        after a stall re-arms the watchdog AND clears ``stalled``: the
+        flag means "currently stalled", not "ever stalled"."""
         self._armed = True
+        if step is not None:
+            self.last_step = step
+        self.stalled = False
         self._last = time.monotonic()
 
     def _watch(self) -> None:
@@ -171,8 +180,11 @@ class Watchdog:
             if idle > limit:
                 self.stalled = True
                 logger.error(
-                    "watchdog: no train step for %.1fs (limit %.1fs) — "
-                    "dumping stacks", idle, self.stall_timeout_s,
+                    "watchdog: no train step for %.1fs (limit %.1fs; "
+                    "last completed step %s) — dumping stacks",
+                    idle, self.stall_timeout_s,
+                    self.last_step if self.last_step is not None
+                    else "<none>",
                 )
                 try:
                     faulthandler.dump_traceback(file=sys.stderr)

@@ -509,19 +509,165 @@ class Trainer:
             logger.info("pruned checkpoint: %s", path)
 
     def restore_checkpoint(self, tag: str = "latest") -> bool:
+        """Restore the newest *intact* checkpoint for ``tag``.
+
+        Walks ``restore_candidates`` newest→oldest — after recovering any
+        directory a mid-swing kill stranded — skipping candidates whose
+        manifest is unreadable or whose shards fail their recorded
+        checksums, instead of crashing on the first bad one. Returns
+        False when nothing checkpoint-shaped is on disk; raises
+        ``CheckpointCorrupted`` when checkpoints exist for the default
+        ``latest`` resume but every one of them is damaged (silently
+        training from scratch would eventually overwrite the evidence).
+        """
         if self.config.ckpt_dir is None:
             return False
         from pytorch_distributed_tpu.train.checkpoint import (
-            resolve_tag,
+            CheckpointCorrupted,
+            recover_stranded_checkpoints,
+            restore_candidates,
+        )
+
+        ckpt_dir = self.config.ckpt_dir
+        # recovery renames directories: only the commit owner (who also
+        # swings saves) may do it, and everyone else must not scan until
+        # it is done — concurrent os.replace of the same dirs would race
+        ring = dist.multiprocess_ring()
+        if (
+            ring is None or dist.get_rank() == 0
+        ) and jax.process_index() == 0:
+            recovered = recover_stranded_checkpoints(ckpt_dir)
+            if recovered:
+                logger.warning(
+                    "recovered interrupted checkpoint commit(s): %s",
+                    recovered,
+                )
+        if ring is not None and ring.world_size > 1:
+            ring.barrier()
+        from pytorch_distributed_tpu.train.checkpoint import _barrier
+
+        _barrier("ptd_ckpt_recover")  # SPMD multi-host counterpart
+        candidates = restore_candidates(ckpt_dir, tag)
+        multi_ring = ring is not None and ring.world_size > 1
+        multi_spmd = jax.process_count() > 1
+        load_errors = []
+        for cand in candidates:
+            if not self._candidate_ok(
+                ckpt_dir, cand, ring, multi_ring, multi_spmd
+            ):
+                continue  # verification failure, logged by the owner
+            try:
+                self._restore_state(cand)
+            except Exception as e:
+                if multi_ring or multi_spmd:
+                    # a load failure only THIS process saw: falling back
+                    # alone would split the world across two different
+                    # checkpoints. Fail the whole job instead — the
+                    # elastic restart retries every process consistently.
+                    raise
+                load_errors.append(e)
+                logger.warning(
+                    "restoring checkpoint %r failed (%s: %s) — falling "
+                    "back to the next candidate",
+                    cand, type(e).__name__, e,
+                )
+                continue
+            self._resume_bookkeeping(cand)
+            return True
+        if load_errors:
+            # every candidate that PASSED verification failed to load
+            # into this state: a template/shape mismatch, not corruption
+            # — surface the real error rather than quietly training fresh
+            raise load_errors[0]
+        if candidates:
+            # candidates existed and every one was corrupt/skipped
+            raise CheckpointCorrupted(
+                f"checkpoints exist under {ckpt_dir!r} but none is "
+                f"restorable — refusing to silently train from scratch"
+            )
+        # no readable candidates at all: distinguish 'nothing saved yet'
+        # (clean fresh start / absent explicit tag) from 'the requested
+        # checkpoints exist on disk with unreadable manifests'
+        if tag == "latest":
+            damaged = self._corrupt_checkpoints_present(ckpt_dir)
+        else:
+            damaged = any(
+                os.path.isdir(os.path.join(ckpt_dir, n))
+                for n in (tag, tag + ".old")
+            )
+        if damaged:
+            raise CheckpointCorrupted(
+                f"checkpoint directories for tag {tag!r} under "
+                f"{ckpt_dir!r} exist but have unreadable manifests — "
+                f"refusing to silently train from scratch"
+            )
+        return False
+
+    def _candidate_ok(
+        self, ckpt_dir, cand, ring, multi_ring, multi_spmd
+    ) -> bool:
+        """One candidate's intact/corrupt verdict, agreed across processes.
+
+        Deep verification reads every shard — so in a multi-process
+        world only the commit owner does it, and the verdict is
+        broadcast: N hosts must NOT each re-read a multi-GB checkpoint,
+        and (more importantly) all processes must skip the SAME
+        candidates — a checksum failure only the owner noticed would
+        otherwise split the world across two different checkpoints.
+        Called lazily per fallback-loop iteration, so a clean resume
+        verifies only the newest candidate, not the whole retention
+        window.
+        """
+        from pytorch_distributed_tpu.train.checkpoint import (
+            verify_checkpoint,
+        )
+
+        owner = (
+            not multi_ring or dist.get_rank() == 0
+        ) and jax.process_index() == 0
+        ok = True
+        if owner:
+            problems = verify_checkpoint(ckpt_dir, cand)
+            if problems:
+                logger.warning(
+                    "checkpoint %r failed verification (%s) — falling "
+                    "back to the next candidate",
+                    cand, "; ".join(problems[:3]),
+                )
+                ok = False
+        vec = np.asarray([1.0 if ok else 0.0], np.float32)
+        if multi_ring:
+            ok = bool(ring.broadcast(vec, src=0)[0])
+        elif multi_spmd:  # pragma: no cover - needs a real pod
+            from jax.experimental import multihost_utils
+
+            ok = bool(multihost_utils.broadcast_one_to_all(vec)[0])
+        return ok
+
+    @staticmethod
+    def _corrupt_checkpoints_present(ckpt_dir: str) -> bool:
+        """Any resume-shaped checkpoint dir (latest/step-*) on disk, even
+        with an unreadable manifest? Distinguishes 'nothing saved yet'
+        (fresh start is right) from 'everything saved is damaged' (fresh
+        start destroys the evidence). ``.tmp`` dirs — an aborted FIRST
+        save — do not count: there was never a complete checkpoint."""
+        if not os.path.isdir(ckpt_dir):
+            return False
+        for name in os.listdir(ckpt_dir):
+            base = name[:-len(".old")] if name.endswith(".old") else name
+            if name.endswith(".tmp"):
+                continue
+            if base == "latest" or base.startswith("step-"):
+                if os.path.isdir(os.path.join(ckpt_dir, name)):
+                    return True
+        return False
+
+    def _restore_state(self, tag: str) -> None:
+        """Load checkpoint ``tag`` into ``self.state`` (EMA-compatible)."""
+        from pytorch_distributed_tpu.train.checkpoint import (
             restore_checkpoint,
         )
 
-        # retention-style runs may hold only step-<N> tags; resolve to the
-        # newest one when the requested tag is absent
-        resolved = resolve_tag(self.config.ckpt_dir, tag)
-        if resolved is None:
-            return False
-        tag = resolved
         try:
             self.state = restore_checkpoint(
                 self.config.ckpt_dir,
@@ -552,6 +698,8 @@ class Trainer:
                     restored.params,
                 )
             )
+
+    def _resume_bookkeeping(self, tag: str) -> None:
         step = int(host_scalar(self.state.step))
         self.host_step = step
         try:
@@ -588,7 +736,7 @@ class Trainer:
                 self._first_epoch = 0
                 self._resume_skip_batches = 0
                 self._load_best_record()
-                return True
+                return
         self._first_epoch = step // steps_per_epoch
         # mid-epoch checkpoint: fast-forward past the batches this epoch
         # already consumed, so no batch trains twice and total step count
@@ -596,10 +744,9 @@ class Trainer:
         self._resume_skip_batches = step % steps_per_epoch
         self._load_best_record()  # the pre-crash best must not be demoted
         logger.info(
-            "resumed from step %d (epoch %d, skipping %d batches)",
-            step, self._first_epoch, self._resume_skip_batches,
+            "resumed %r at step %d (epoch %d, skipping %d batches)",
+            tag, step, self._first_epoch, self._resume_skip_batches,
         )
-        return True
 
     # -- loops --------------------------------------------------------------
     def fit(self) -> TrainState:
@@ -740,7 +887,7 @@ class Trainer:
             self.host_step += 1
             step = self.host_step
             if self._watchdog is not None:
-                self._watchdog.tick()
+                self._watchdog.tick(step)
             self._check_preemption()
             steps_since_log += 1
             steps_since_sync += 1
@@ -893,6 +1040,12 @@ class Trainer:
         never heal — once the loss stays non-finite, every further step
         is wasted.
         """
+        from pytorch_distributed_tpu.runtime import faults
+
+        if faults.fires("step.nan"):
+            # chaos site: divergence-on-demand, so halt_on_nonfinite's
+            # restart path is provable without finding a real NaN recipe
+            metrics["loss"] = float("nan")
         n = self.config.halt_on_nonfinite
         if not n or "loss" not in metrics:
             return

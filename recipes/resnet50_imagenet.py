@@ -71,6 +71,8 @@ class Config(RecipeConfig):
     device_normalize: bool = True  # doc: ship uint8 batches, normalize on-chip (default ingest path; --no-device-normalize restores host f32)
     ema_decay: float = 0.0  # doc: ModelEMA decay (0 disables); evals use the shadow
     tensorboard_dir: str = ""  # doc: TensorBoard event-file dir (rank 0)
+    io_retries: int = 2  # doc: transient read retries per sample (real-data path)
+    bad_sample_budget: int = 100  # doc: max quarantined (undecodable) samples before hard error
 
 
 def main(argv=None):
@@ -98,13 +100,20 @@ def main(argv=None):
 
         train_ds = ImageFolderDataset(os.path.join(real_root, "train"))
         eval_ds = ImageFolderDataset(os.path.join(real_root, "val"))
+        # one quarantine (and one bad-sample budget) across train+eval:
+        # both pipelines read the same disk
+        from pytorch_distributed_tpu.data import SampleQuarantine
+
+        quarantine = SampleQuarantine(cfg.bad_sample_budget)
         train_fetch = FolderImagePipeline(
             cfg.image_size, train=True, seed=cfg.seed,
             device_normalize=cfg.device_normalize,
+            io_retries=cfg.io_retries, quarantine=quarantine,
         )
         eval_fetch = FolderImagePipeline(
             cfg.image_size, train=False,
             device_normalize=cfg.device_normalize,
+            io_retries=cfg.io_retries, quarantine=quarantine,
         )
         n_train = len(train_ds)
         log_rank0(

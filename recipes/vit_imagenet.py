@@ -68,6 +68,8 @@ class Config(RecipeConfig):
     flip_augment: bool = True  # doc: random horizontal flip augmentation
     device_normalize: bool = True  # doc: ship uint8 batches, normalize on-chip (default ingest path; --no-device-normalize restores host f32)
     tensorboard_dir: str = ""  # doc: TensorBoard event-file dir (rank 0)
+    io_retries: int = 2  # doc: transient read retries per sample (real-data path)
+    bad_sample_budget: int = 100  # doc: max quarantined (undecodable) samples before hard error
 
 
 def main(argv=None):
@@ -104,15 +106,22 @@ def main(argv=None):
 
         train_ds = ImageFolderDataset(os.path.join(real_root, "train"))
         eval_ds = ImageFolderDataset(os.path.join(real_root, "val"))
+        # one quarantine (and one bad-sample budget) across train+eval:
+        # both pipelines read the same disk
+        from pytorch_distributed_tpu.data import SampleQuarantine
+
+        quarantine = SampleQuarantine(cfg.bad_sample_budget)
         train_fetch = FolderImagePipeline(
             vcfg.image_size, train=True, seed=cfg.seed,
             mean=IMAGENET_MEAN, std=IMAGENET_STD,
             device_normalize=cfg.device_normalize,
+            io_retries=cfg.io_retries, quarantine=quarantine,
         )
         eval_fetch = FolderImagePipeline(
             vcfg.image_size, train=False,
             mean=IMAGENET_MEAN, std=IMAGENET_STD,
             device_normalize=cfg.device_normalize,
+            io_retries=cfg.io_retries, quarantine=quarantine,
         )
         if cfg.device_normalize:
             # the folder pipeline flips/crops at decode; only the

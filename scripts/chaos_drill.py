@@ -1,0 +1,159 @@
+"""Kill-resume drill: crash a real recipe mid-run, resume it, prove it.
+
+The in-process chaos suite (tests/test_chaos.py) injects faults around
+library calls; this drill does the thing no unit test can — it KILLS the
+whole training process (SIGKILL, or ``PTD_FAULTS`` ``mode=kill`` which is
+``os._exit`` mid-save) at seeded-random moments, restarts it the way an
+elastic agent would, and asserts the run still converges to its expected
+final step with an intact, verifiable checkpoint.
+
+Usage (CPU smoke, ~a minute warm):
+
+    python scripts/chaos_drill.py --kills 2
+    python scripts/chaos_drill.py --faults "ckpt.write_shard:mode=kill,after=2,count=1"
+    python scripts/chaos_drill.py --recipe recipes/resnet18_cifar10.py \\
+        --epochs 4 --steps-per-epoch 4 --batch-size 16
+
+Exit code 0 = drill passed. Any recipe exposing ``--synthetic
+--steps-per-epoch --epochs --batch-size --ckpt-dir --seed`` works
+(resnet18_cifar10 is the default because it is the fastest smoke).
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("--recipe", default="recipes/resnet18_cifar10.py")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="default: a fresh temp dir, removed on success")
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--steps-per-epoch", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--kills", type=int, default=2,
+                   help="SIGKILLs at seeded-random moments before the "
+                   "final undisturbed attempt")
+    p.add_argument("--kill-window", type=float, nargs=2,
+                   default=(3.0, 20.0), metavar=("MIN_S", "MAX_S"),
+                   help="seconds after launch to fire each SIGKILL")
+    p.add_argument("--faults", default=None,
+                   help="PTD_FAULTS spec armed in the killed attempts "
+                   "instead of parent-side SIGKILL (e.g. "
+                   "'ckpt.write_shard:mode=kill,after=2,count=1')")
+    p.add_argument("--max-attempts", type=int, default=8)
+    return p.parse_args(argv)
+
+
+def _child_cmd(args, ckpt_dir):
+    return [
+        sys.executable, os.path.join(REPO, args.recipe),
+        "--synthetic",
+        "--epochs", str(args.epochs),
+        "--steps-per-epoch", str(args.steps_per_epoch),
+        "--batch-size", str(args.batch_size),
+        "--ckpt-dir", ckpt_dir,
+        "--seed", str(args.seed),
+        "--log-every", "1",
+    ]
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    import numpy as np
+
+    rng = np.random.default_rng(args.seed)
+    ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="chaos_drill_")
+    owns_dir = args.ckpt_dir is None
+    cmd = _child_cmd(args, ckpt_dir)
+    expected_final = args.epochs * args.steps_per_epoch
+    kills_left = args.kills
+    print(f"# drill: {' '.join(cmd)}", file=sys.stderr)
+
+    ok = False
+    for attempt in range(1, args.max_attempts + 1):
+        env = dict(os.environ)
+        kill_this_attempt = kills_left > 0
+        delay = None
+        if kill_this_attempt:
+            if args.faults:
+                env["PTD_FAULTS"] = args.faults
+                env["PTD_FAULTS_SEED"] = str(args.seed + attempt)
+            else:
+                delay = float(rng.uniform(*args.kill_window))
+        child = subprocess.Popen(
+            cmd, env=env, cwd=REPO,
+            stdout=sys.stderr, stderr=subprocess.STDOUT,
+        )
+        if delay is not None:
+            try:
+                child.wait(timeout=delay)
+            except subprocess.TimeoutExpired:
+                print(
+                    f"# attempt {attempt}: SIGKILL after {delay:.1f}s",
+                    file=sys.stderr,
+                )
+                child.send_signal(signal.SIGKILL)
+        rc = child.wait()
+        if kill_this_attempt:
+            kills_left -= 1
+            print(
+                f"# attempt {attempt}: crashed as planned (rc={rc})",
+                file=sys.stderr,
+            )
+            continue
+        print(f"# attempt {attempt}: rc={rc}", file=sys.stderr)
+        if rc == 0:
+            ok = True
+            break
+        # EX_TEMPFAIL (preemption path) or a crash: restart like an agent
+        time.sleep(1.0)
+
+    from pytorch_distributed_tpu.train.checkpoint import (
+        checkpoint_step,
+        recover_stranded_checkpoints,
+        verify_checkpoint,
+    )
+
+    recovered = recover_stranded_checkpoints(ckpt_dir)
+    final_step = checkpoint_step(ckpt_dir)
+    problems = verify_checkpoint(ckpt_dir)
+    passed = (
+        ok and final_step == expected_final and not problems
+    )
+    print(json.dumps({
+        "drill": "kill_resume",
+        "recipe": args.recipe,
+        "kills": args.kills,
+        "faults": args.faults,
+        "completed": ok,
+        "final_checkpoint_step": final_step,
+        "expected_final_step": expected_final,
+        "verify_problems": problems,
+        "post_recovered_tags": recovered,
+        "passed": passed,
+    }))
+    if passed and owns_dir:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    elif not passed:
+        print(f"# checkpoint dir kept for autopsy: {ckpt_dir}",
+              file=sys.stderr)
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,6 +77,16 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
     assert e2e[0]["value"] > 0
     # CPU fallback: small-shape smoke — must not wear a chip-claim ratio
     assert e2e[0]["vs_baseline"] is None
+    # the checkpoint save path (now carrying per-shard CRC + COMMIT) is
+    # tracked so an integrity-layer regression shows up as a number, not
+    # a mystery slowdown in a production preemption window
+    ckpt = [
+        json.loads(l) for l in proc.stderr.splitlines()
+        if l.startswith("{")
+        and json.loads(l)["metric"] == "checkpoint_save_mb_per_sec"
+    ]
+    assert len(ckpt) == 1, proc.stderr[-2000:]
+    assert ckpt[0]["value"] > 0 and ckpt[0]["integrity"] == "crc+commit"
 
     # the input_pipeline phases must stay inside their time budget (the
     # r3 starvation incident: the feed phase alone ran >25 min and ate
