@@ -172,6 +172,67 @@ def ragged_prompt_state(prompt_mask, B: int, P: int, cache_len: int):
     return prompt_mask, positions, prompt_lens, kv_mask
 
 
+def cache_batch_axis(path, leaf) -> Optional[int]:
+    """Batch axis of a decode-cache leaf, or None for shared counters.
+
+    KV payload buffers are ``[..., B, T, H, D]`` (a leading ``[L]`` when
+    layers are scanned), so the batch axis is ``ndim - 4``; the int8
+    cache's per-token scale buffers carry the SAME layout and must move
+    in lockstep with their payloads. Index/position counters have no
+    batch dim and return None. Shared by ``generate_beam`` (beam
+    replicate/reorder) and the serving engine's slot pool (per-slot
+    insert/extract) so the two can never disagree about which leaves
+    are per-sequence state.
+    """
+    name = getattr(path[-1], "key", None) or str(path[-1])
+    if name in (
+        "cached_key", "cached_value",
+        "cached_key_scale", "cached_value_scale",
+    ):
+        return leaf.ndim - 4
+    return None
+
+
+def decode_step_body(
+    model,
+    params,
+    cache,
+    tok: jnp.ndarray,
+    *,
+    cache_len: int,
+    positions: Optional[jnp.ndarray] = None,
+    kv_mask: Optional[jnp.ndarray] = None,
+    write_pos: Optional[jnp.ndarray] = None,
+):
+    """One KV-cache decode tick: ``[B]`` tokens -> ``([B, V] logits, cache)``.
+
+    The single implementation of the per-token decode body, shared by
+    the offline batch path (``generate``'s scan step, ``generate_beam``)
+    and the serving engine's continuous-batching tick
+    (``serve/engine.py``) — the two must stay one code path so engine
+    output can be pinned bit-identical to offline ``generate``.
+    ``write_pos`` is the slot-pool contract (per-row KV writes at each
+    row's own length, ``ops.attention.decode_cache``); the lockstep
+    paths leave it None and let the model's scalar cache_index advance.
+    """
+    extra = {}
+    if positions is not None:
+        extra["positions"] = positions
+    if kv_mask is not None:
+        extra["kv_mask"] = kv_mask
+    if write_pos is not None:
+        extra["write_pos"] = write_pos
+    logits, state = model.apply(
+        {"params": params, "cache": cache},
+        tok[:, None],
+        decode=True,
+        cache_len=cache_len,
+        mutable=["cache"],
+        **extra,
+    )
+    return logits[:, -1], state["cache"]
+
+
 def _generation_limits(model, P, max_new_tokens):
     """Shared validation for generate/generate_beam: positive token count
     and prompt+new within the model's position/cache capacity. Returns
@@ -378,16 +439,11 @@ def generate(
             # padded slot index
             dec_extra["positions"] = (prompt_lens + t)[:, None]
             dec_extra["kv_mask"] = extra["kv_mask"]
-        logits, state = model.apply(
-            {"params": params, "cache": cache},
-            tok[:, None],
-            decode=True,
-            cache_len=cache_len,
-            mutable=["cache"],
-            **dec_extra,
+        last, cache = decode_step_body(
+            model, params, cache, tok, cache_len=cache_len, **dec_extra
         )
         rng, sub = jax.random.split(rng)
-        step_logits = _penalize(logits[:, -1], presence)
+        step_logits = _penalize(last, presence)
         if history is not None:
             # t counts from 0; the prefill token is already written, so
             # the history holds P + t + 1 tokens at this point
@@ -407,7 +463,7 @@ def generate(
             history = history.at[
                 jnp.arange(B), jnp.full((B,), P + t + 1)
             ].set(nxt)
-        return (state["cache"], nxt, rng, done, presence, history), nxt
+        return (cache, nxt, rng, done, presence, history), nxt
 
     # scan step t consumes continuation token #t+1, whose position is
     # (real length) + t
@@ -461,25 +517,11 @@ def generate_beam(
     logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))  # [B, V]
     V = logp0.shape[-1]
     scores, tok = lax.top_k(logp0, K)  # [B, K] initial beams
-    # replicate every layer's cache K times along its BATCH axis. KV
-    # buffers are [..., B, T, H, D] (a leading [L] when layers are
-    # scanned), so the batch axis is ndim-4; index/position counters have
-    # no batch dim and stay shared.
-    def _cache_batch_axis(path, x):
-        name = getattr(path[-1], "key", None) or str(path[-1])
-        # int8 KV caches carry per-token scale buffers with the SAME
-        # [..., B, T, H, 1] layout — they must replicate and reorder in
-        # lockstep with their payloads or the scales decode the wrong
-        # beam's entries
-        if name in (
-            "cached_key", "cached_value",
-            "cached_key_scale", "cached_value_scale",
-        ):
-            return x.ndim - 4
-        return None
-
+    # replicate every layer's cache K times along its BATCH axis
+    # (``cache_batch_axis``: KV payloads AND their int8 scale buffers
+    # move together; counters stay shared)
     def _rep(path, x):
-        ax = _cache_batch_axis(path, x)
+        ax = cache_batch_axis(path, x)
         return x if ax is None else jnp.repeat(x, K, axis=ax)
 
     cache = jax.tree_util.tree_map_with_path(_rep, state["cache"])
@@ -492,16 +534,12 @@ def generate_beam(
 
     def step(carry, t):
         cache, tokens, scores, finished, prev = carry
-        logits, state = model.apply(
-            {"params": params, "cache": cache},
-            prev.reshape(B * K)[:, None],
-            decode=True,
+        last, cache = decode_step_body(
+            model, params, cache, prev.reshape(B * K),
             cache_len=cache_len,
-            mutable=["cache"],
         )
-        cache = state["cache"]
         logp = jax.nn.log_softmax(
-            logits[:, -1].astype(jnp.float32)
+            last.astype(jnp.float32)
         ).reshape(B, K, V)
         # finished beams may only extend with pad, at unchanged score
         pad_only = jnp.full((V,), NEG).at[pad_id].set(0.0)
@@ -524,7 +562,7 @@ def generate_beam(
         ).reshape(B * K)  # global cache rows
 
         def _take(path, x):
-            ax = _cache_batch_axis(path, x)
+            ax = cache_batch_axis(path, x)
             return x if ax is None else jnp.take(x, gather, axis=ax)
 
         cache = jax.tree_util.tree_map_with_path(_take, cache)

@@ -37,6 +37,7 @@ numerical parity against the torch forward in tests/test_interop.py.
 
 from __future__ import annotations
 
+import re
 from typing import Dict, Mapping
 
 import numpy as np
@@ -185,20 +186,37 @@ def _llama_body_import(sd: StateDict, cfg, ffn_fn) -> Dict:
     # refuse, don't drop: a checkpoint whose attention carries structure
     # the cfg doesn't enable (biases, QK norms) would load "fine" and
     # silently diverge from HF — same invariant as the tied/untied
-    # lm_head guard below
-    p0 = "model.layers.0.self_attn."
-    if not attn_bias and p0 + "q_proj.bias" in sd:
-        raise ValueError(
-            "checkpoint has attention projection biases but the config "
-            "has attention_bias=False — a Qwen2-style checkpoint; fix "
-            "the config instead of losing the biases"
-        )
-    if not qk_norm and p0 + "q_norm.weight" in sd:
-        raise ValueError(
-            "checkpoint has q_norm/k_norm weights but the config has "
-            "qk_norm=False — a Qwen3-style checkpoint; fix the config "
-            "instead of losing the norms"
-        )
+    # lm_head guard below. Scan EVERY layer prefix, not just layer 0: a
+    # malformed checkpoint carrying biases/norms only on later layers
+    # must refuse just as loudly
+    if not attn_bias:
+        bias_keys = [
+            k for k in sd
+            if re.fullmatch(
+                r"model\.layers\.\d+\.self_attn\.[qkv]_proj\.bias", k
+            )
+        ]
+        if bias_keys:
+            raise ValueError(
+                "checkpoint has attention projection biases (e.g. "
+                f"{min(bias_keys)}) but the config has "
+                "attention_bias=False — a Qwen2-style checkpoint; fix "
+                "the config instead of losing the biases"
+            )
+    if not qk_norm:
+        norm_keys = [
+            k for k in sd
+            if re.fullmatch(
+                r"model\.layers\.\d+\.self_attn\.[qk]_norm\.weight", k
+            )
+        ]
+        if norm_keys:
+            raise ValueError(
+                "checkpoint has q_norm/k_norm weights (e.g. "
+                f"{min(norm_keys)}) but the config has qk_norm=False — "
+                "a Qwen3-style checkpoint; fix the config instead of "
+                "losing the norms"
+            )
 
     def block(i):
         p = f"model.layers.{i}."

@@ -23,11 +23,25 @@ from pytorch_distributed_tpu.models.llama import (
     llama_partition_rules,
 )
 
-def gemma_partition_rules(num_kv_heads: int = 1):
-    """Llama TP rules, defaulting to the MQA-safe form: the headline
-    gemma_2b has ONE kv head, whose size-1 axis cannot shard over tp —
-    k/v replicate. Pass ``num_kv_heads=16`` for gemma_7b to restore
-    kv-head sharding."""
+def gemma_partition_rules(config=None, num_kv_heads=None):
+    """Llama TP rules with the kv-head count taken from the CONFIG.
+
+    The old loose ``num_kv_heads=1`` int default silently replicated
+    gemma_7b's 16 kv heads (a throughput footgun); now the rules derive
+    the decision from the config when given — ``GemmaConfig.gemma_2b``
+    (MQA) replicates k/v, ``gemma_7b`` shards them — and with NO
+    arguments defer to the shape/mesh-aware llama rules, which read the
+    kv-head axis off the kernel itself at placement time (so even the
+    bare call places both variants correctly). ``num_kv_heads`` stays
+    for back-compat callers."""
+    if isinstance(config, int):
+        # the pre-r6 signature was gemma_partition_rules(num_kv_heads=1)
+        # — a positional int caller still means the kv-head count
+        config, num_kv_heads = None, config
+    if config is not None and num_kv_heads is not None:
+        raise ValueError("pass config or num_kv_heads, not both")
+    if config is not None:
+        num_kv_heads = config.num_kv_heads
     return llama_partition_rules(num_kv_heads=num_kv_heads)
 
 

@@ -16,7 +16,10 @@ import flax.linen as nn
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from pytorch_distributed_tpu.ops.attention import attention
+from pytorch_distributed_tpu.ops.attention import (
+    attention,
+    validate_write_pos,
+)
 from pytorch_distributed_tpu.runtime.precision import current_policy
 
 
@@ -85,8 +88,9 @@ class GPT2Block(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, x, segment_ids, kv_mask, deterministic: bool,
-                 decode: bool = False, cache_len: Optional[int] = None):
+    def __call__(self, x, segment_ids, kv_mask, write_pos,
+                 deterministic: bool, decode: bool = False,
+                 cache_len: Optional[int] = None):
         cfg = self.config
         policy = current_policy()
         ln = lambda name: nn.LayerNorm(  # noqa: E731
@@ -105,7 +109,7 @@ class GPT2Block(nn.Module):
 
             k, v, offset = decode_cache(
                 self, k, v, cache_len or cfg.n_positions,
-                quantize=cfg.kv_cache_quantize,
+                quantize=cfg.kv_cache_quantize, write_pos=write_pos,
             )
             attn = attention(
                 q, k, v, causal=True, q_offset=offset, mask=kv_mask
@@ -147,7 +151,8 @@ class GPT2LMHead(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, *,
-                 segment_ids=None, kv_mask=None, train: bool = False,
+                 segment_ids=None, kv_mask=None, write_pos=None,
+                 train: bool = False,
                  decode: bool = False, cache_len: Optional[int] = None,
                  return_hidden: bool = False):
         cfg = self.config
@@ -177,6 +182,7 @@ class GPT2LMHead(nn.Module):
                 "kv_mask is for KV-cache decode (left-padded prompts); "
                 "training masks go through the loss/segment machinery"
             )
+        validate_write_pos(write_pos, decode, positions)
         if decode:
             from pytorch_distributed_tpu.ops.attention import (
                 decode_positions,
@@ -198,12 +204,14 @@ class GPT2LMHead(nn.Module):
             from pytorch_distributed_tpu.models.scan import scan_stack
 
             x = scan_stack(
-                GPT2Block, cfg, static_argnums=(3, 4, 5), name="blocks"
-            )(x, segment_ids, kv_mask, not train, decode, cache_len)
+                GPT2Block, cfg, static_argnums=(4, 5, 6), name="blocks"
+            )(x, segment_ids, kv_mask, write_pos, not train, decode,
+              cache_len)
         else:
             for i in range(cfg.num_layers):
                 x = GPT2Block(cfg, name=f"block{i}")(
-                    x, segment_ids, kv_mask, deterministic=not train,
+                    x, segment_ids, kv_mask, write_pos,
+                    deterministic=not train,
                     decode=decode, cache_len=cache_len,
                 )
         x = nn.LayerNorm(

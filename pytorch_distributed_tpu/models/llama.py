@@ -21,8 +21,17 @@ from pytorch_distributed_tpu.ops.attention import (
     apply_rope,
     attention,
     rope_frequencies,
+    validate_write_pos,
 )
 from pytorch_distributed_tpu.runtime.precision import current_policy
+from pytorch_distributed_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+#: (kv_heads, tp) pairs already warned about by the TP-rule replication
+#: fallback — placement passes visit every kernel leaf, and the signal
+#: is one fact, not one line per leaf
+_warned_kv_replication = set()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -180,7 +189,7 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, cos, sin, positions, segment_ids, kv_mask,
-                 deterministic: bool, decode: bool = False,
+                 write_pos, deterministic: bool, decode: bool = False,
                  cache_len: Optional[int] = None):
         cfg = self.config
         policy = current_policy()
@@ -208,7 +217,7 @@ class LlamaBlock(nn.Module):
 
             k, v, offset = decode_cache(
                 self, k, v, cache_len or cfg.max_seq_len,
-                quantize=cfg.kv_cache_quantize,
+                quantize=cfg.kv_cache_quantize, write_pos=write_pos,
             )
             attn = attention(
                 q, k, v, causal=True, q_offset=offset, mask=kv_mask,
@@ -256,6 +265,7 @@ class LlamaForCausalLM(nn.Module):
         *,
         segment_ids: Optional[jnp.ndarray] = None,
         kv_mask: Optional[jnp.ndarray] = None,
+        write_pos: Optional[jnp.ndarray] = None,
         train: bool = False,
         decode: bool = False,
         cache_len: Optional[int] = None,
@@ -268,6 +278,7 @@ class LlamaForCausalLM(nn.Module):
             raise ValueError(
                 f"cache_len {cache_len} > max_seq_len {cfg.max_seq_len}"
             )
+        validate_write_pos(write_pos, decode, positions)
         embed = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, param_dtype=policy.param_dtype,
             dtype=policy.compute_dtype, name="embed",
@@ -319,14 +330,14 @@ class LlamaForCausalLM(nn.Module):
             from pytorch_distributed_tpu.models.scan import scan_stack
 
             x = scan_stack(
-                block_cls, cfg, static_argnums=(6, 7, 8), name="layers"
-            )(x, cos, sin, positions, segment_ids, kv_mask, not train,
-              decode, cache_len)
+                block_cls, cfg, static_argnums=(7, 8, 9), name="layers"
+            )(x, cos, sin, positions, segment_ids, kv_mask, write_pos,
+              not train, decode, cache_len)
         else:
             for i in range(cfg.num_layers):
                 x = block_cls(cfg, name=f"layer{i}")(
                     x, cos, sin, positions, segment_ids, kv_mask,
-                    deterministic=not train,
+                    write_pos, deterministic=not train,
                     decode=decode, cache_len=cache_len,
                 )
         x = RMSNorm(cfg.rms_eps, cfg.rms_offset, name="final_norm")(x)
@@ -350,17 +361,43 @@ def llama_partition_rules(num_kv_heads: Optional[int] = None):
     """Megatron TP: column-parallel q/k/v/gate/up, row-parallel o/down;
     embedding sharded on hidden, lm_head kernel on vocab (its dim 1).
 
-    ``num_kv_heads``: pass the config's value for MQA models (Gemma-2B,
-    ``num_kv_heads=1``) — a size-1 kv-head axis cannot shard over tp,
-    so k/v replicate instead (they are the smallest projections; q/o
-    and the MLP still shard)."""
+    The k/v kernels shard their kv-head axis over ``tp`` only when it
+    divides the mesh's tp size — decided from the KERNEL'S OWN SHAPE at
+    placement time, so MQA (Gemma-2B's 1 kv head) and ragged GQA
+    (Qwen2-7B's 4 kv heads on tp=8) both replicate k/v (the smallest
+    projections; q/o and the MLP still shard) instead of crashing on an
+    unshardable axis. A replication fallback on a >1-way tp mesh logs a
+    warning so the throughput cost is visible, not silent.
+
+    ``num_kv_heads`` is retained for back-compat: an explicit ``1``
+    forces the MQA replicate form without consulting shapes; other
+    values defer to the shape-based decision."""
     from pytorch_distributed_tpu.parallel.sharding import stacked
 
-    kv_spec = (
-        stacked(P(None, None, None))
-        if num_kv_heads == 1
-        else stacked(P(None, "tp", None))
-    )
+    if num_kv_heads == 1:
+        kv_spec = stacked(P(None, None, None))
+    else:
+
+        def kv_spec(shape, mesh):
+            # [D, Hkv, hd] kernel, with a leading [L] when scan-stacked:
+            # the kv-head axis is always shape[-2]
+            tp = dict(mesh.shape).get("tp", 1)
+            heads = shape[-2]
+            if tp > 1 and heads % tp != 0:
+                if (heads, tp) not in _warned_kv_replication:
+                    # once per (heads, tp): spec_for runs per LEAF per
+                    # placement pass — an unrolled 32-layer model would
+                    # otherwise repeat this 64+ times
+                    _warned_kv_replication.add((heads, tp))
+                    logger.warning(
+                        "llama TP rules: %d kv heads do not divide "
+                        "tp=%d — replicating k/v (kernel shape %s); "
+                        "q/o and the MLP still shard",
+                        heads, tp, tuple(shape),
+                    )
+                return stacked(P(None, None, None))(shape, mesh)
+            return stacked(P(None, "tp", None))(shape, mesh)
+
     return [
         (r"/q/kernel", stacked(P(None, "tp", None))),
         (r"/(k|v)/kernel", kv_spec),

@@ -34,6 +34,7 @@ from pytorch_distributed_tpu.ops.attention import (
     apply_rope,
     attention,
     rope_frequencies,
+    validate_write_pos,
 )
 from pytorch_distributed_tpu.runtime.precision import current_policy
 
@@ -96,7 +97,7 @@ class NeoXBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, cos, sin, positions, segment_ids, kv_mask,
-                 deterministic: bool, decode: bool = False,
+                 write_pos, deterministic: bool, decode: bool = False,
                  cache_len: Optional[int] = None):
         cfg = self.config
         policy = current_policy()
@@ -117,7 +118,8 @@ class NeoXBlock(nn.Module):
             from pytorch_distributed_tpu.ops.attention import decode_cache
 
             k, v, offset = decode_cache(
-                self, k, v, cache_len or cfg.max_seq_len
+                self, k, v, cache_len or cfg.max_seq_len,
+                write_pos=write_pos,
             )
             attn = attention(
                 q, k, v, causal=True, q_offset=offset, mask=kv_mask
@@ -166,6 +168,7 @@ class NeoXForCausalLM(nn.Module):
         *,
         segment_ids: Optional[jnp.ndarray] = None,
         kv_mask: Optional[jnp.ndarray] = None,
+        write_pos: Optional[jnp.ndarray] = None,
         train: bool = False,
         decode: bool = False,
         cache_len: Optional[int] = None,
@@ -177,6 +180,7 @@ class NeoXForCausalLM(nn.Module):
             raise ValueError(
                 f"cache_len {cache_len} > max_seq_len {cfg.max_seq_len}"
             )
+        validate_write_pos(write_pos, decode, positions)
         x = nn.Embed(
             cfg.vocab_size, cfg.hidden_size,
             param_dtype=policy.param_dtype, dtype=policy.compute_dtype,
@@ -215,14 +219,14 @@ class NeoXForCausalLM(nn.Module):
             from pytorch_distributed_tpu.models.scan import scan_stack
 
             x = scan_stack(
-                NeoXBlock, cfg, static_argnums=(6, 7, 8), name="layers"
-            )(x, cos, sin, positions, segment_ids, kv_mask, not train,
-              decode, cache_len)
+                NeoXBlock, cfg, static_argnums=(7, 8, 9), name="layers"
+            )(x, cos, sin, positions, segment_ids, kv_mask, write_pos,
+              not train, decode, cache_len)
         else:
             for i in range(cfg.num_layers):
                 x = NeoXBlock(cfg, name=f"layer{i}")(
                     x, cos, sin, positions, segment_ids, kv_mask,
-                    deterministic=not train,
+                    write_pos, deterministic=not train,
                     decode=decode, cache_len=cache_len,
                 )
         x = nn.LayerNorm(

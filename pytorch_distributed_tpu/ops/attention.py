@@ -110,6 +110,10 @@ def dot_product_attention(
 
     ``q_offset`` shifts query positions for the causal mask — used by
     sequence-parallel shards where the local block starts mid-sequence.
+    A ``[B]`` array gives every batch row its OWN offset (the serving
+    engine's slot pool, where each slot's sequence has a different
+    length); the causal mask then hides each row's unwritten cache tail
+    independently.
     ``segment_ids`` restricts attention to within-segment pairs (packed
     fixed-shape sequences; self-attention only).
     ``bias`` is added to the logits before masking — T5 relative position
@@ -156,13 +160,20 @@ def dot_product_attention(
             # an all-masked row would softmax to UNIFORM weights over
             # every key (future included) — garbage, silently
             raise ValueError(f"window must be positive, got {window}")
-        qpos = jnp.arange(S) + q_offset
+        if getattr(q_offset, "ndim", 0) == 1:  # per-row offsets [B]
+            qpos = q_offset[:, None] + jnp.arange(S)[None, :]  # [B, S]
+        else:
+            qpos = jnp.arange(S) + q_offset  # [S]
         kpos = jnp.arange(T)
-        keep = qpos[:, None] >= kpos[None, :]  # [S, T] causal
+        keep = qpos[..., :, None] >= kpos  # [S, T] or [B, S, T]
         if window is not None:
             # band: key strictly within `window` positions back
-            keep = keep & (qpos[:, None] - kpos[None, :] < window)
-        logits = jnp.where(keep[None, None, None], logits, neg)
+            keep = keep & (qpos[..., :, None] - kpos < window)
+        # broadcast into the [B, Hkv, G, S, T] logits layout
+        keep = (
+            keep[:, None, None] if keep.ndim == 3 else keep[None, None, None]
+        )
+        logits = jnp.where(keep, logits, neg)
     if mask is not None:
         if mask.ndim == 2:  # [B, T] key padding mask
             mask = mask[:, None, None, None, :]
@@ -218,7 +229,28 @@ def _q8_rows(x):
     return symmetric_int8(x, -1)
 
 
-def decode_cache(module, k, v, max_len: int, quantize: Optional[str] = None):
+def validate_write_pos(write_pos, decode: bool, positions) -> None:
+    """The model-level precondition of per-row KV writes, in ONE place
+    (gpt2/llama/neox forwards all call it): ``write_pos`` comes with
+    ``decode=True`` AND explicit per-row positions or not at all — the
+    shared ``decode_positions`` counter would embed every slot at one
+    drifting position while its KV lands at its own offset, silent
+    garbage. Must run BEFORE the model's auto-positions fallback."""
+    if write_pos is not None and (not decode or positions is None):
+        raise ValueError(
+            "write_pos (slot-pool decode) requires decode=True AND "
+            "explicit per-row positions"
+        )
+
+
+def decode_cache(
+    module,
+    k,
+    v,
+    max_len: int,
+    quantize: Optional[str] = None,
+    write_pos=None,
+):
     """Append k/v to this block's KV cache (flax ``cache`` collection).
 
     TPU-first decode: the cache is a STATIC [B, max_len, H, D] buffer
@@ -227,6 +259,18 @@ def decode_cache(module, k, v, max_len: int, quantize: Optional[str] = None):
     loop. Returns ``(k_all, v_all, offset)`` where offset is the (traced)
     number of tokens already cached; attend with ``q_offset=offset`` so
     the causal mask hides both the future and the unwritten tail.
+
+    ``write_pos`` (a ``[B]`` int32 array) switches to PER-ROW writes —
+    the serving engine's slot-pool contract, where each batch row is an
+    independent request whose sequence occupies buffer slots
+    ``[0, write_pos[b])``: row ``b``'s ``S`` new entries land at
+    ``write_pos[b] .. write_pos[b]+S-1`` (a vmapped
+    ``dynamic_update_slice``), the shared scalar ``cache_index`` is
+    neither consulted nor advanced (slots don't move in lockstep), and
+    the returned offset is ``write_pos`` itself — feeding attention's
+    per-row ``q_offset`` form so each row's causal mask ends at its own
+    length. The caller owns position accounting (pass explicit
+    ``positions`` at the model level).
 
     ``quantize="int8"`` stores the cache as int8 payloads + per-token
     f32 scales (~2x less HBM at rest vs a bf16 cache, ~4x vs f32 — the
@@ -246,7 +290,27 @@ def decode_cache(module, k, v, max_len: int, quantize: Optional[str] = None):
     ci = module.variable(
         "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
     )
-    offset = ci.value
+    if write_pos is not None:
+        offset = write_pos
+        advance = None  # per-row mode: the scalar counter stays untouched
+
+        def _write(buf, new):
+            # row b's [S, H, D] update lands at its own buffer position
+            return jax.vmap(
+                lambda row, upd, pos: jax.lax.dynamic_update_slice(
+                    row, upd, (pos, 0, 0)
+                )
+            )(buf, new.astype(buf.dtype), write_pos)
+
+    else:
+        offset = ci.value
+        advance = offset + S
+
+        def _write(buf, new):
+            return jax.lax.dynamic_update_slice(
+                buf, new.astype(buf.dtype), (0, offset, 0, 0)
+            )
+
     if quantize == "int8":
         ck = module.variable(
             "cache", "cached_key", jnp.zeros, (B, max_len, H, D), jnp.int8
@@ -265,19 +329,12 @@ def decode_cache(module, k, v, max_len: int, quantize: Optional[str] = None):
         )
         qk, sk = _q8_rows(k)
         qv, sv = _q8_rows(v)
-        ck.value = jax.lax.dynamic_update_slice(
-            ck.value, qk, (0, offset, 0, 0)
-        )
-        cks.value = jax.lax.dynamic_update_slice(
-            cks.value, sk, (0, offset, 0, 0)
-        )
-        cv.value = jax.lax.dynamic_update_slice(
-            cv.value, qv, (0, offset, 0, 0)
-        )
-        cvs.value = jax.lax.dynamic_update_slice(
-            cvs.value, sv, (0, offset, 0, 0)
-        )
-        ci.value = offset + S
+        ck.value = _write(ck.value, qk)
+        cks.value = _write(cks.value, sk)
+        cv.value = _write(cv.value, qv)
+        cvs.value = _write(cvs.value, sv)
+        if advance is not None:
+            ci.value = advance
         k_all = (
             ck.value.astype(jnp.float32) * cks.value
         ).astype(k.dtype)
@@ -291,13 +348,10 @@ def decode_cache(module, k, v, max_len: int, quantize: Optional[str] = None):
     cv = module.variable(
         "cache", "cached_value", jnp.zeros, (B, max_len, H, D), v.dtype
     )
-    ck.value = jax.lax.dynamic_update_slice(
-        ck.value, k.astype(ck.value.dtype), (0, offset, 0, 0)
-    )
-    cv.value = jax.lax.dynamic_update_slice(
-        cv.value, v.astype(cv.value.dtype), (0, offset, 0, 0)
-    )
-    ci.value = offset + S
+    ck.value = _write(ck.value, k)
+    cv.value = _write(cv.value, v)
+    if advance is not None:
+        ci.value = advance
     return ck.value, cv.value, offset
 
 
@@ -415,6 +469,14 @@ def attention(
     if bias_fn is not None:
         if bias is not None:
             raise ValueError("pass bias or bias_fn, not both")
+        if getattr(q_offset, "ndim", 0) == 1:
+            # bias_fn materializes ONE [Hq, S, T] block shared by the
+            # batch; per-row offsets would need a per-row bias — no
+            # relative-position model is in the serve zoo, so refuse
+            raise NotImplementedError(
+                "bias_fn does not compose with per-row q_offset "
+                "(slot-pool decode)"
+            )
         # unsharded: materialize once over this call's positions
         # (traced q_offset included — decode works)
         q_pos = jnp.arange(q.shape[1]) + q_offset

@@ -45,8 +45,12 @@ def _block_stage_fn(block_module) -> Callable:
 
     def stage_fn(stage_params, x):
         def body(c, p):
-            # (x, segment_ids=None, kv_mask=None, deterministic=True)
-            return block_module.apply({"params": p}, c, None, None, True), None
+            # (x, segment_ids=None, kv_mask=None, write_pos=None,
+            #  deterministic=True)
+            return (
+                block_module.apply({"params": p}, c, None, None, None, True),
+                None,
+            )
 
         y, _ = lax.scan(body, x, stage_params)
         return y

@@ -57,6 +57,12 @@ Known sites (grep for ``faults.check`` to find the exact spots):
                      ingest path quarantines it)
 ``step.nan``         at the Trainer's logging sync — forces the logged
                      loss to NaN (drives ``halt_on_nonfinite``)
+``serve.prefill``    before a serve-engine prefill chunk runs; ``path``
+                     is the request id — the poisoned request is
+                     evicted (FAILED), the engine keeps serving
+``serve.decode``     per request per decode tick, before its sampled
+                     token is accepted — same evict-and-continue
+                     contract (``match=<request_id>`` poisons one)
 ================== ====================================================
 """
 
@@ -88,6 +94,8 @@ KNOWN_SITES = (
     "data.fetch",
     "data.decode",
     "step.nan",
+    "serve.prefill",
+    "serve.decode",
 )
 _MODES = ("raise", "kill", "truncate", "bitflip")
 

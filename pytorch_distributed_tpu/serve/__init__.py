@@ -1,0 +1,62 @@
+"""serve/ — continuous-batching inference engine (slot-based KV cache).
+
+The online counterpart of ``generation.generate``: requests arrive,
+start, and retire independently while ONE compiled decode step serves
+every mix of in-flight work (docs/DESIGN.md §11). Quickstart::
+
+    from pytorch_distributed_tpu.serve import (
+        EngineConfig, Request, ServeEngine,
+    )
+
+    engine = ServeEngine(model, params, EngineConfig(num_slots=4,
+                                                     max_len=256))
+    h = engine.submit(Request(prompt_ids, max_new_tokens=64,
+                              temperature=0.8, top_p=0.95, seed=7))
+    engine.run_until_drained()
+    print(h.tokens)   # bit-identical to the solo generate() call
+"""
+
+from pytorch_distributed_tpu.serve.engine import EngineConfig, ServeEngine
+from pytorch_distributed_tpu.serve.loadgen import (
+    drive,
+    uniform_arrivals,
+    warm_up,
+)
+from pytorch_distributed_tpu.serve.kv_slots import (
+    KVSlotPool,
+    init_slot_cache,
+    put_slot,
+    take_slot,
+)
+from pytorch_distributed_tpu.serve.sampling import (
+    filter_logits_rows,
+    sample_logits_rows,
+)
+from pytorch_distributed_tpu.serve.scheduler import (
+    PrefillChunk,
+    Request,
+    RequestHandle,
+    RequestStatus,
+    Scheduler,
+)
+from pytorch_distributed_tpu.serve.telemetry import ServeTelemetry
+
+__all__ = [
+    "EngineConfig",
+    "KVSlotPool",
+    "PrefillChunk",
+    "Request",
+    "RequestHandle",
+    "RequestStatus",
+    "Scheduler",
+    "ServeEngine",
+    "ServeTelemetry",
+    "drive",
+    "filter_logits_rows",
+    "init_slot_cache",
+    "put_slot",
+    "sample_logits_rows",
+    "take_slot",
+    "uniform_arrivals",
+    "warm_up",
+]

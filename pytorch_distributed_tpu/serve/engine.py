@@ -1,0 +1,465 @@
+"""Continuous-batching serve loop: admit/retire mid-flight, compile once.
+
+The engine composes the pieces: a ``KVSlotPool`` (static device state),
+a ``Scheduler`` (host dynamism), per-row sampling, and TWO jitted
+programs that are each compiled exactly once for the engine's lifetime:
+
+* ``prefill``: one ``[1, prefill_chunk]`` model pass writing a chunk of
+  one request's prompt into its slot (``write_pos`` per-row KV writes),
+  sampling the first token on the final chunk;
+* ``decode``: one ``[S, 1]`` tick over ALL slots — occupied, mid-
+  prefill, or free — through the SAME ``generation.decode_step_body``
+  the offline ``generate`` scan uses, then per-row sampling with each
+  slot's own (temperature, top_k, top_p, rng).
+
+Static-shape invariant: neither program's input shapes depend on which
+requests are in flight. Rows without a decoding request still compute —
+their sampled tokens are discarded on the host and their KV write lands
+at the row's current length, a position that is either masked (free
+slots, garbage until reuse overwrites from 0) or overwritten by the
+next prefill chunk (mid-prefill slots). Compile counts are exposed
+(``prefill_compiles``/``decode_compiles``) so tests can PIN "one
+compile per program for a whole mixed workload".
+
+Parity invariant: every request's emitted token stream is bit-identical
+to a solo ``generate(prompt, ..., rng=jax.random.PRNGKey(seed))`` —
+regardless of batch composition, slot reuse, chunked prefill splits, or
+neighboring evictions. The load-bearing facts: batch rows are
+independent under XLA, masked cache tails contribute exact zeros, the
+per-row sampler is a bitwise transcript of ``generation.sample_logits``
+(serve/sampling.py), and each request's rng chain splits exactly when
+``generate``'s would (once at prefill, once per decode tick).
+
+Failure model (degrade, don't crash): ``serve.prefill``/``serve.decode``
+fault sites (runtime/faults.py) fire per-request — a poisoned request
+is evicted as FAILED with the exception on its handle, its slot frees,
+and the engine keeps serving everyone else.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_tpu.generation import (
+    decode_step_body,
+    model_max_len,
+)
+from pytorch_distributed_tpu.runtime import faults
+from pytorch_distributed_tpu.serve.kv_slots import (
+    KVSlotPool,
+    put_slot,
+    take_slot,
+)
+from pytorch_distributed_tpu.serve.sampling import (
+    TOP_K_OFF,
+    TOP_P_OFF,
+    sample_logits_rows,
+)
+from pytorch_distributed_tpu.serve.scheduler import (
+    Request,
+    RequestHandle,
+    RequestStatus,
+    Scheduler,
+)
+from pytorch_distributed_tpu.serve.telemetry import ServeTelemetry
+from pytorch_distributed_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    num_slots: int = 4          # S: max concurrent in-flight requests
+    max_len: int = 256          # per-slot KV capacity (prompt + new)
+    prefill_chunk: int = 32     # static prompt-chunk width
+    prefill_chunks_per_step: int = 1  # prefill/decode interleave ratio
+    telemetry_every: int = 32   # engine steps between occupancy snapshots
+
+    def __post_init__(self):
+        if self.num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        if self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        if self.prefill_chunks_per_step < 1:
+            raise ValueError("prefill_chunks_per_step must be >= 1")
+        if self.max_len < 2:
+            raise ValueError("max_len must be >= 2 (1 prompt + 1 new)")
+        if self.prefill_chunk > self.max_len:
+            # every prompt rounds up to at least one chunk of KV slots,
+            # so this config could never admit ANY request — fail at
+            # construction naming the real culprit, not per-submit
+            # blaming the prompt
+            raise ValueError(
+                f"prefill_chunk {self.prefill_chunk} > max_len "
+                f"{self.max_len}: no request could ever be admitted"
+            )
+
+
+class ServeEngine:
+    """Single-threaded, deterministic serve loop.
+
+    Drive it with ``submit()`` + ``step()`` (one scheduler iteration:
+    deadline sweep -> cancellations -> admission -> prefill chunks ->
+    decode tick), or ``run_until_drained()``. Tokens stream into each
+    ``RequestHandle.tokens`` as they are emitted (or via
+    ``handle.on_token``).
+
+    ``params`` may be placed by any ``parallel/strategies.py`` strategy
+    — the jitted programs follow the committed shardings (TP rules
+    shard the per-slot compute exactly as they shard ``generate``).
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        config: EngineConfig = EngineConfig(),
+        *,
+        telemetry: Optional[ServeTelemetry] = None,
+        clock=time.monotonic,
+    ):
+        self.model = model
+        self.params = params
+        self.config = config
+        self.telemetry = telemetry or ServeTelemetry(clock=clock)
+        self._clock = clock
+        limit = model_max_len(model)
+        if limit is not None and config.max_len > limit:
+            raise ValueError(
+                f"max_len {config.max_len} exceeds the model's maximum "
+                f"sequence length {limit}"
+            )
+        self.pool = KVSlotPool(
+            model, params, config.num_slots, config.max_len
+        )
+        self.scheduler = Scheduler(config.num_slots, config.prefill_chunk)
+        S = config.num_slots
+        # per-slot sampling/decode state lives ON DEVICE and is updated
+        # in place: rows change only at request transitions (admission,
+        # prefill-final, eviction), and the decode tick advances the
+        # continuing rows inside the jitted program — so a steady-state
+        # tick is ONE jit call plus one token fetch, no per-tick
+        # host->device re-uploads (measured 2ms/tick of pure host
+        # overhead before this). Stale rows of freed/mid-prefill slots
+        # are harmless: their sampled tokens are discarded and their KV
+        # writes land at positions that are overwritten before any mask
+        # lets attention read them.
+        self._toks = jnp.zeros(S, jnp.int32)
+        self._lengths = jnp.zeros(S, jnp.int32)
+        self._temps = jnp.zeros(S, jnp.float32)
+        self._top_ks = jnp.full(S, TOP_K_OFF, jnp.int32)
+        self._top_ps = jnp.full(S, TOP_P_OFF, jnp.float32)
+        # old-style uint32 [2] keys: stackable/vmappable plain arrays
+        # with the same threefry streams as jax.random.key
+        self._keys = jnp.tile(jax.random.PRNGKey(0)[None, :], (S, 1))
+        self._n_deadlines = 0  # live requests carrying a deadline
+        self._any_cancel = False
+        # the decoding set only changes at request transitions — cache
+        # the (slot, handle) list and the device-side active mask so a
+        # steady-state tick rebuilds neither
+        self._decoding_dirty = True
+        self._decoding_cached = []
+        self._active_cached = None
+        self._steps = 0
+        self._decode_ticks = 0
+        self.prefill_compiles = 0
+        self.decode_compiles = 0
+        # donation lets XLA update the pool cache in place; XLA:CPU
+        # cannot alias and would warn every call, so gate on backend
+        donate = jax.default_backend() != "cpu"
+        self._prefill = jax.jit(
+            self._prefill_fn, donate_argnums=(1,) if donate else ()
+        )
+        # cache + the in-program-advanced rows (toks/lengths/keys) are
+        # donated: each is replaced by its returned successor every tick
+        self._decode = jax.jit(
+            self._decode_fn, donate_argnums=(1, 2, 3, 4) if donate else ()
+        )
+        # admission-time row setup as ONE jitted program: eager
+        # .at[].set dispatches cost ~2.4ms EACH on this backend
+        # (measured under cProfile — per-request transitions were half
+        # the serving wall-clock), a fused compiled update is ~0.1ms
+        self._admit_rows = jax.jit(self._admit_rows_fn)
+
+    # -- jitted programs ---------------------------------------------------
+    def _prefill_fn(self, params, cache, ids, slot, start, last_idx,
+                    final, toks, lengths, keys, temps, top_ks, top_ps):
+        # traced once per engine lifetime — python side effect counts
+        # compiles (the static-shape invariant, pinned by tests)
+        self.prefill_compiles += 1
+        C = self.config.prefill_chunk
+        row = take_slot(cache, slot)
+        positions = (start + jnp.arange(C))[None, :]
+        logits, state = self.model.apply(
+            {"params": params, "cache": row},
+            ids,
+            decode=True,
+            cache_len=self.config.max_len,
+            mutable=["cache"],
+            positions=positions,
+            write_pos=jnp.asarray(start, jnp.int32)[None],
+        )
+        cache = put_slot(cache, state["cache"], slot)
+        # the device length cursor advances with EVERY chunk, not just
+        # the final one: a decode tick between chunks writes this
+        # inactive row's K/V at its cursor, and only a cursor at the
+        # NEXT chunk's start keeps that garbage in a range the next
+        # chunk overwrites — a stale cursor lands it on already-
+        # prefilled positions (a measured corruption, caught by the
+        # mixed-workload parity test)
+        lengths = lengths.at[slot].set(start + last_idx + 1)
+        # rng discipline mirrors generate(): ONE split before the first
+        # token, persisted (with the token) only on the final chunk
+        pair = jax.random.split(keys[slot])
+        last = jax.lax.dynamic_index_in_dim(
+            logits, last_idx, axis=1, keepdims=False
+        )  # [1, V] — the chunk's last REAL prompt column
+        tok = sample_logits_rows(
+            last, pair[1][None], temps[slot][None],
+            top_ks[slot][None], top_ps[slot][None],
+        )[0]
+        keys = jnp.where(final, keys.at[slot].set(pair[0]), keys)
+        toks = jnp.where(final, toks.at[slot].set(tok), toks)
+        return cache, tok, toks, lengths, keys
+
+    def _admit_rows_fn(self, temps, top_ks, top_ps, keys, lengths, slot,
+                       temp, top_k, top_p, seed):
+        # the write cursor parks at 0 so any tick before the first
+        # chunk drops its garbage where that chunk will overwrite it
+        return (
+            temps.at[slot].set(temp),
+            top_ks.at[slot].set(top_k),
+            top_ps.at[slot].set(top_p),
+            keys.at[slot].set(jax.random.PRNGKey(seed)),
+            lengths.at[slot].set(0),
+        )
+
+    def _decode_fn(self, params, cache, toks, lengths, keys, temps,
+                   top_ks, top_ps, active):
+        self.decode_compiles += 1
+        last, cache = decode_step_body(
+            self.model, params, cache, toks,
+            cache_len=self.config.max_len,
+            positions=lengths[:, None],
+            write_pos=lengths,
+        )
+        pair = jax.vmap(jax.random.split)(keys)  # [S, 2, 2]
+        nxt = sample_logits_rows(last, pair[:, 1], temps, top_ks, top_ps)
+        # advance ONLY the decoding rows in place: the continuing token
+        # becomes next tick's input, the rng chain splits once, the
+        # length grows one — inactive rows (free / mid-prefill) keep
+        # their state so their request transitions stay host-authored
+        toks_out = jnp.where(active, nxt, toks)
+        lengths_out = lengths + active.astype(jnp.int32)
+        keys_out = jnp.where(active[:, None], pair[:, 0], keys)
+        return cache, nxt, toks_out, lengths_out, keys_out
+
+    # -- intake ------------------------------------------------------------
+    def submit(self, request: Request) -> RequestHandle:
+        """Validate + enqueue; returns the streaming handle."""
+        cfg = self.config
+        P = request.prompt_len
+        chunks = -(-P // cfg.prefill_chunk)  # ceil
+        if chunks * cfg.prefill_chunk > cfg.max_len:
+            # the final chunk's [C]-wide write would clamp at the buffer
+            # edge and corrupt earlier positions — refuse up front
+            raise ValueError(
+                f"prompt ({P} tokens) rounds up to "
+                f"{chunks * cfg.prefill_chunk} chunked-prefill slots, "
+                f"exceeding max_len {cfg.max_len}"
+            )
+        if P + request.max_new_tokens > cfg.max_len:
+            raise ValueError(
+                f"prompt ({P}) + max_new_tokens "
+                f"({request.max_new_tokens}) exceeds the engine's "
+                f"max_len {cfg.max_len}"
+            )
+        handle = RequestHandle(request, submitted_at=self._clock())
+        if request.deadline_s is not None:
+            self._n_deadlines += 1
+        self.scheduler.enqueue(handle)
+        self.telemetry.record_submit(handle)
+        return handle
+
+    def cancel(self, request_id: str) -> bool:
+        """Flag a live request for eviction at the next step."""
+        h = self.scheduler.find(request_id)
+        if h is None:
+            return False
+        h._cancel = True
+        self._any_cancel = True
+        return True
+
+    # -- the loop ----------------------------------------------------------
+    def has_work(self) -> bool:
+        # O(1): the drive loop asks once per step — no live-handle list
+        return bool(self.scheduler.queue or self.scheduler.by_slot)
+
+    def step(self) -> bool:
+        """One scheduler iteration; returns True when any device work
+        ran (a prefill chunk or a decode tick)."""
+        self._steps += 1
+        # the sweeps scan every live handle — skip them entirely on the
+        # (typical) ticks where no deadline or cancellation exists
+        if self._n_deadlines:
+            now = self._clock()
+            for h in self.scheduler.sweep_expired(now):
+                self._finish(h, RequestStatus.EXPIRED)
+        if self._any_cancel:
+            self._any_cancel = False
+            for h in self.scheduler.sweep_cancelled():
+                self._finish(h, RequestStatus.CANCELLED)
+        for h in self.scheduler.admit(self.pool):
+            self._configure_slot(h)
+        did = self._run_prefill()
+        did = self._run_decode() or did
+        if self.config.telemetry_every and (
+            self._steps % self.config.telemetry_every == 0
+        ):
+            self.telemetry.record_snapshot(
+                queue_depth=self.scheduler.queue_depth(),
+                slots_occupied=self.pool.num_occupied,
+                slots_total=self.pool.num_slots,
+                decode_ticks=self._decode_ticks,
+            )
+        return did
+
+    def run_until_drained(self, max_steps: int = 1_000_000) -> None:
+        """Step until every submitted request reaches a terminal state."""
+        for _ in range(max_steps):
+            if not self.has_work():
+                return
+            self.step()
+        raise RuntimeError(
+            f"engine did not drain within {max_steps} steps "
+            f"({len(self.scheduler.live_handles())} requests live)"
+        )
+
+    # -- phase bodies ------------------------------------------------------
+    def _run_prefill(self) -> bool:
+        cfg = self.config
+        plans = self.scheduler.plan_prefill(cfg.prefill_chunks_per_step)
+        did = False
+        for plan in plans:
+            h = plan.handle
+            if h.done:  # evicted earlier in this very step's plan list
+                continue
+            if faults.active():
+                try:
+                    faults.check("serve.prefill", path=h.request.request_id)
+                except faults.InjectedFault as e:
+                    self._finish(h, RequestStatus.FAILED, error=e)
+                    continue
+            ids = np.zeros((1, cfg.prefill_chunk), np.int32)
+            ids[0, :plan.chunk_len] = plan.ids
+            slot = h.slot
+            # scalars pass as plain python values (weak-typed, no
+            # retrace); ALL slot-row updates — per-chunk length cursor,
+            # final-chunk key/token persist — happen inside the one
+            # compiled program (eager .at[].set is ms-scale here)
+            (
+                cache, tok, self._toks, self._lengths, self._keys,
+            ) = self._prefill(
+                self.params, self.pool.cache, ids, slot, plan.start,
+                plan.chunk_len - 1, plan.final,
+                self._toks, self._lengths, self._keys,
+                self._temps, self._top_ks, self._top_ps,
+            )
+            self.pool.cache = cache
+            self.pool.lengths[slot] = plan.start + plan.chunk_len
+            did = True
+            if plan.final:
+                self.scheduler.prefill_finished(h)
+                self._decoding_dirty = True
+                self._emit(h, int(tok))
+        return did
+
+    def _run_decode(self) -> bool:
+        if self._decoding_dirty:
+            self._decoding_cached = self.scheduler.decoding()
+            active = np.zeros(self.config.num_slots, bool)
+            for slot, _ in self._decoding_cached:
+                active[slot] = True
+            self._active_cached = jnp.asarray(active)
+            self._decoding_dirty = False
+        decoding = self._decoding_cached
+        if not decoding:
+            return False
+        self._decode_ticks += 1
+        # one jit call; toks/lengths/keys advance in-program for the
+        # active rows, so the only per-tick host traffic is the sampled
+        # tokens coming down
+        (
+            self.pool.cache, nxt, self._toks, self._lengths, self._keys,
+        ) = self._decode(
+            self.params, self.pool.cache, self._toks, self._lengths,
+            self._keys, self._temps, self._top_ks, self._top_ps,
+            self._active_cached,
+        )
+        nxt = np.asarray(nxt)
+        fault_armed = faults.active()
+        for slot, h in decoding:
+            # the tick wrote this slot's token at lengths[slot]; mirror
+            # the in-program length advance, then judge the token
+            self.pool.lengths[slot] += 1
+            if fault_armed:
+                try:
+                    faults.check("serve.decode", path=h.request.request_id)
+                except faults.InjectedFault as e:
+                    self._finish(h, RequestStatus.FAILED, error=e)
+                    continue
+            self._emit(h, int(nxt[slot]))
+        return True
+
+    # -- emission / retirement ---------------------------------------------
+    def _emit(self, h: RequestHandle, token: int) -> None:
+        now = self._clock()
+        h.emit(token, now)
+        req = h.request
+        # continuing requests need no device write here: the decode tick
+        # already advanced the slot's token/length/key rows in-program
+        if req.eos_id is not None and token == req.eos_id:
+            self._finish(h, RequestStatus.COMPLETED)
+        elif len(h.tokens) >= req.max_new_tokens:
+            self._finish(h, RequestStatus.COMPLETED)
+
+    def _finish(
+        self,
+        h: RequestHandle,
+        status: RequestStatus,
+        error: Optional[BaseException] = None,
+    ) -> None:
+        h.status = status
+        h.error = error
+        h.finished_at = self._clock()
+        if h.request.deadline_s is not None:
+            self._n_deadlines -= 1
+        self._decoding_dirty = True
+        self.scheduler.release(h, self.pool)
+        self.telemetry.record_done(h)
+        if status is RequestStatus.FAILED:
+            logger.warning(
+                "serve: evicted request %s after fault: %s",
+                h.request.request_id, error,
+            )
+
+    # -- admission-time slot setup ----------------------------------------
+    def _configure_slot(self, h: RequestHandle) -> None:
+        req = h.request
+        (
+            self._temps, self._top_ks, self._top_ps, self._keys,
+            self._lengths,
+        ) = self._admit_rows(
+            self._temps, self._top_ks, self._top_ps, self._keys,
+            self._lengths, h.slot,
+            req.temperature,
+            TOP_K_OFF if req.top_k is None else req.top_k,
+            TOP_P_OFF if req.top_p is None else req.top_p,
+            req.seed,
+        )

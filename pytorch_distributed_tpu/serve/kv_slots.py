@@ -1,0 +1,164 @@
+"""Slot-based KV-cache pool — the static-shape heart of the serve engine.
+
+One fixed ``[S, max_len, H, D]`` buffer set per layer (the model's own
+flax ``cache`` collection, materialized once via ``jax.eval_shape`` —
+no throwaway compile) plus host-side slot bookkeeping. All request
+dynamism — admissions, retirements, ragged lengths — is expressed as
+which slot a request owns and how many buffer positions it has filled;
+the jitted prefill/decode programs see ONE static shape forever.
+
+Key invariants:
+
+* **Free is O(1) and write-free.** Retiring a request only returns its
+  slot index to the free list; the stale KV bytes stay in HBM. They are
+  harmless because every read is masked by the row's length (attention's
+  per-row ``q_offset`` causal mask ends at ``lengths[slot]``) and every
+  reuse overwrites from position 0 before anything reads.
+* **Per-slot sequences are LEFT-ALIGNED**: a slot's tokens occupy buffer
+  positions ``[0, lengths[slot])`` and buffer position == sequence
+  position — so ``lengths`` doubles as the rope/wpe position vector AND
+  the per-row KV write cursor (``write_pos``), with no translation
+  table between the two.
+* **Allocation is deterministic** (lowest free index first) so seeded
+  workloads replay exactly.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_tpu.generation import cache_batch_axis
+
+
+def init_slot_cache(model, params, num_slots: int, max_len: int):
+    """Zeroed decode-cache pytree for ``num_slots`` slots of ``max_len``.
+
+    Shapes come from ``jax.eval_shape`` over the model's own decode
+    apply, so the pool is EXACTLY the tree the model mutates — scan
+    layouts, int8 KV scale buffers, position counters and all — without
+    tracing a compile or touching device memory until the zeros
+    materialize.
+    """
+
+    def shape_fn(p):
+        _, state = model.apply(
+            {"params": p},
+            jnp.zeros((num_slots, 1), jnp.int32),
+            decode=True,
+            cache_len=max_len,
+            mutable=["cache"],
+        )
+        return state["cache"]
+
+    shapes = jax.eval_shape(shape_fn, params)
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes
+    )
+
+
+def take_slot(cache, slot):
+    """Extract slot ``slot`` as a batch-1 cache (traced ``slot`` ok).
+
+    Only leaves with a batch axis (``generation.cache_batch_axis``) are
+    sliced; shared counters pass through — the result is a valid cache
+    for a batch-1 ``model.apply`` whose per-row ``write_pos`` ignores
+    those counters anyway.
+    """
+
+    def f(path, x):
+        ax = cache_batch_axis(path, x)
+        if ax is None:
+            return x
+        return jax.lax.dynamic_slice_in_dim(x, slot, 1, axis=ax)
+
+    return jax.tree_util.tree_map_with_path(f, cache)
+
+
+def put_slot(cache, row_cache, slot):
+    """Write a batch-1 cache back into slot ``slot`` of the pool.
+
+    The pool keeps its own shared counters (they are meaningless under
+    per-row ``write_pos`` but must stay structurally consistent); only
+    batch-carrying leaves are updated.
+    """
+
+    def f(path, x, r):
+        ax = cache_batch_axis(path, x)
+        if ax is None:
+            return x
+        return jax.lax.dynamic_update_slice_in_dim(
+            x, r.astype(x.dtype), slot, axis=ax
+        )
+
+    return jax.tree_util.tree_map_with_path(f, cache, row_cache)
+
+
+class KVSlotPool:
+    """The pool: device cache pytree + host slot/length bookkeeping.
+
+    ``lengths[i]`` is slot ``i``'s filled prefix — the number of buffer
+    positions holding real (written, valid) KV entries. It is the single
+    source of truth the engine turns into ``positions`` (rope/wpe),
+    ``write_pos`` (KV write cursor) and the implicit attention mask
+    (per-row causal ``q_offset``) each tick.
+    """
+
+    def __init__(self, model, params, num_slots: int, max_len: int):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2, got {max_len}")
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.cache = init_slot_cache(model, params, num_slots, max_len)
+        self.lengths = np.zeros(num_slots, np.int32)
+        self._free: List[int] = list(range(num_slots))
+
+    # -- slot lifecycle ----------------------------------------------------
+    def allocate(self) -> Optional[int]:
+        """Claim the lowest free slot (deterministic), or None when full.
+        The slot starts at length 0; its stale bytes are dead until the
+        first prefill chunk overwrites them."""
+        if not self._free:
+            return None
+        self._free.sort()
+        slot = self._free.pop(0)
+        self.lengths[slot] = 0
+        return slot
+
+    def free(self, slot: int) -> None:
+        """Return ``slot`` to the pool. O(1): no device writes — masks
+        make the stale KV unreachable and reuse overwrites it."""
+        if slot in self._free:
+            raise ValueError(f"slot {slot} is already free")
+        if not 0 <= slot < self.num_slots:
+            raise ValueError(f"slot {slot} out of range")
+        self.lengths[slot] = 0
+        self._free.append(slot)
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_occupied(self) -> int:
+        return self.num_slots - len(self._free)
+
+    def occupied_slots(self) -> List[int]:
+        free = set(self._free)
+        return [i for i in range(self.num_slots) if i not in free]
+
+    # -- masks (introspection / tests; the jitted step derives its own) ----
+    def valid_mask(self) -> np.ndarray:
+        """[S, max_len] bool: True where a buffer position holds a live
+        token of an occupied slot — the host-visible statement of what
+        the per-row causal mask lets attention read."""
+        mask = (
+            np.arange(self.max_len)[None, :] < self.lengths[:, None]
+        )
+        mask[list(self._free)] = False
+        return mask

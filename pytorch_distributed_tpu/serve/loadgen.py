@@ -1,0 +1,82 @@
+"""Offered-load driving shared by bench.py's ``serving`` phase and
+``scripts/serve_loadgen.py`` — ONE warm-up and pacing discipline, so the
+bench phase and its CLI twin can never silently measure different
+things (they already diverged once: a 1-token warm-up retires at
+prefill and leaves the decode compile inside the measured window).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Sequence
+
+from pytorch_distributed_tpu.serve.scheduler import Request, RequestStatus
+from pytorch_distributed_tpu.serve.telemetry import ServeTelemetry
+
+
+def warm_up(engine, prompt_ids, telemetry: ServeTelemetry = None) -> None:
+    """Compile BOTH jitted programs outside any measured window.
+
+    A 2-token request is the minimum that reaches a decode tick — a
+    1-token request emits its only token from the prefill program and
+    retires without ever compiling decode, so the first measured tick
+    would pay the full jit compile (checked here, loudly). Afterwards
+    the engine's telemetry is replaced (``telemetry`` or a fresh one)
+    so the warm-up's compile-sized TTFT stays out of every reported
+    stream and percentile. The engine's ``max_len`` must fit
+    ``roundup(len(prompt_ids), prefill_chunk) + 2``.
+    """
+    h = engine.submit(Request(prompt_ids, max_new_tokens=2))
+    engine.run_until_drained()
+    if h.status is not RequestStatus.COMPLETED:
+        raise RuntimeError(f"warm-up request failed: {h.status.value}")
+    if engine.decode_compiles < 1:
+        raise RuntimeError(
+            "warm-up drained without a decode tick — the decode compile "
+            "would land inside the measured window"
+        )
+    engine.telemetry = telemetry or ServeTelemetry(
+        # keep the engine's writer/clock: replacing a writer-backed
+        # telemetry with a writer-less one would silently drop the
+        # JSONL stream the caller wired up
+        writer=engine.telemetry.writer,
+        clock=engine.telemetry.clock,
+    )
+    # a caller-built telemetry was stamped BEFORE this warm-up ran —
+    # restart its wall clock or summary() throughput eats the compile
+    engine.telemetry.started_at = engine.telemetry.clock()
+
+
+def drive(
+    engine,
+    requests: Sequence[Request],
+    arrivals: Sequence[float],
+    *,
+    clock=time.perf_counter,
+) -> float:
+    """Submit ``requests[i]`` at ``arrivals[i]`` seconds from start and
+    step the engine until everything drains; returns the wall seconds.
+
+    Between steps with no work and a pending arrival, sleeps at most
+    2 ms so pacing stays accurate without busy-burning the host core.
+    """
+    if len(requests) != len(arrivals):
+        raise ValueError("requests and arrivals must pair up")
+    t0 = clock()
+    i, n = 0, len(requests)
+    while i < n or engine.has_work():
+        now = clock() - t0
+        while i < n and now >= arrivals[i]:
+            engine.submit(requests[i])
+            i += 1
+        if not engine.step() and i < n:
+            time.sleep(min(max(arrivals[i] - now, 0.0), 0.002))
+    return clock() - t0
+
+
+def uniform_arrivals(n: int, rate: float) -> List[float]:
+    """Fixed-rate arrival offsets: request i at ``i / rate`` (all at 0
+    when ``rate`` is 0 — closed-loop saturation)."""
+    if rate <= 0:
+        return [0.0] * n
+    return [i / rate for i in range(n)]

@@ -1,0 +1,259 @@
+"""Request lifecycle + FIFO admission scheduling for the serve engine.
+
+All of continuous batching's dynamism lives here, on the host: which
+request owns which slot, how far its prompt has prefilled, when its
+deadline passes, whether it was cancelled. The device never sees any of
+it — the engine turns this bookkeeping into fixed-shape array arguments
+every tick.
+
+Scheduling policy (deliberately simple, deterministic, and fair):
+
+* **FIFO admission**: queued requests claim freed slots in arrival
+  order; the free list hands out the lowest slot index first, so a
+  seeded workload replays bit-exactly.
+* **Chunked prefill**: a prompt prefills ``prefill_chunk`` tokens at a
+  time, oldest admitted request first, at most
+  ``prefill_chunks_per_step`` chunks per engine step — a 10k-token
+  prompt cannot stall the decode tick of the requests already flowing
+  (the vLLM/Sarathi chunked-prefill argument, restated for static
+  shapes: the chunk IS the static shape).
+* **Deadlines** are absolute wall-clock points checked every step:
+  queued requests expire in place, in-flight requests are evicted and
+  their slot freed. Cancellation follows the same eviction path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class RequestStatus(enum.Enum):
+    QUEUED = "queued"
+    PREFILLING = "prefilling"
+    DECODING = "decoding"
+    COMPLETED = "completed"
+    CANCELLED = "cancelled"
+    EXPIRED = "expired"
+    FAILED = "failed"
+
+
+#: statuses a request can still make progress from
+LIVE_STATUSES = (
+    RequestStatus.QUEUED, RequestStatus.PREFILLING, RequestStatus.DECODING,
+)
+
+_req_counter = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request — the engine-facing analogue of a solo
+    ``generate(prompt, max_new_tokens, temperature, top_k, top_p,
+    eos_id, rng=PRNGKey(seed))`` call. The engine guarantees the token
+    stream is bit-identical to that call, whatever else shares the
+    batch."""
+
+    prompt_ids: np.ndarray
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_id: Optional[int] = None
+    seed: int = 0
+    #: seconds from submit() until the request is abandoned (queued OR
+    #: mid-flight); None = no deadline
+    deadline_s: Optional[float] = None
+    request_id: str = ""
+
+    def __post_init__(self):
+        self.prompt_ids = np.asarray(self.prompt_ids, np.int32).reshape(-1)
+        if self.prompt_ids.size < 1:
+            raise ValueError("prompt_ids must hold at least one token")
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if self.temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0, got {self.temperature}"
+            )
+        if self.top_p is not None and not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if self.top_k is not None and self.top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {self.top_k}")
+        if not self.request_id:
+            self.request_id = f"req-{next(_req_counter)}"
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt_ids.size)
+
+
+class RequestHandle:
+    """Live view of a submitted request: streamed tokens + status.
+
+    ``tokens`` grows as the engine emits (the streaming surface — read
+    it live or attach ``on_token``); terminal ``status`` plus
+    ``error``/timestamps tell the rest of the story.
+    """
+
+    def __init__(self, request: Request, submitted_at: float):
+        self.request = request
+        self.status = RequestStatus.QUEUED
+        self.tokens: List[int] = []
+        self.error: Optional[BaseException] = None
+        self.slot: Optional[int] = None
+        self.submitted_at = submitted_at
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.on_token = None  # optional callable(handle, token)
+        # -- scheduler internals --
+        self._prefill_done = 0  # prompt tokens written into the slot
+        self._cancel = False
+
+    @property
+    def done(self) -> bool:
+        return self.status not in LIVE_STATUSES
+
+    @property
+    def deadline_at(self) -> Optional[float]:
+        d = self.request.deadline_s
+        return None if d is None else self.submitted_at + d
+
+    def emit(self, token: int, now: float) -> None:
+        if self.first_token_at is None:
+            self.first_token_at = now
+        self.tokens.append(int(token))
+        if self.on_token is not None:
+            self.on_token(self, int(token))
+
+    def __repr__(self):  # pragma: no cover - debugging nicety
+        return (
+            f"RequestHandle({self.request.request_id}, "
+            f"{self.status.value}, tokens={len(self.tokens)})"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class PrefillChunk:
+    """One planned prefill step: write ``ids[:chunk_len]`` (right-padded
+    to the static chunk width by the engine) at buffer position
+    ``start`` of ``handle.slot``; ``final`` chunks sample the request's
+    first token from the chunk's last real logit column."""
+
+    handle: RequestHandle
+    start: int
+    ids: np.ndarray  # [chunk_len] real prompt tokens (unpadded)
+    final: bool
+
+    @property
+    def chunk_len(self) -> int:
+        return int(self.ids.size)
+
+
+class Scheduler:
+    """FIFO queue + slot admission + chunk planning (host-only state)."""
+
+    def __init__(self, num_slots: int, prefill_chunk: int):
+        if prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        self.prefill_chunk = prefill_chunk
+        self.queue: Deque[RequestHandle] = deque()
+        self.by_slot: Dict[int, RequestHandle] = {}
+        self._prefilling: List[RequestHandle] = []  # admission order
+
+    # -- intake ------------------------------------------------------------
+    def enqueue(self, handle: RequestHandle) -> None:
+        self.queue.append(handle)
+
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    def live_handles(self) -> List[RequestHandle]:
+        return list(self.queue) + list(self.by_slot.values())
+
+    def find(self, request_id: str) -> Optional[RequestHandle]:
+        for h in self.live_handles():
+            if h.request.request_id == request_id:
+                return h
+        return None
+
+    # -- admission ---------------------------------------------------------
+    def admit(self, pool) -> List[RequestHandle]:
+        """Move queued requests into freed slots (FIFO); returns the
+        newly admitted handles, already marked PREFILLING."""
+        admitted = []
+        while self.queue and pool.num_free:
+            h = self.queue.popleft()
+            slot = pool.allocate()
+            assert slot is not None
+            h.slot = slot
+            h.status = RequestStatus.PREFILLING
+            h._prefill_done = 0
+            self.by_slot[slot] = h
+            self._prefilling.append(h)
+            admitted.append(h)
+        return admitted
+
+    # -- prefill planning --------------------------------------------------
+    def plan_prefill(self, budget: int) -> List[PrefillChunk]:
+        """Up to ``budget`` chunks, oldest admitted request first (finish
+        one request's prompt before starting the next — it is the one
+        whose TTFT clock has been running longest)."""
+        plans: List[PrefillChunk] = []
+        for h in self._prefilling:
+            if len(plans) >= budget:
+                break
+            p = h.request.prompt_ids
+            while h._prefill_done < p.size and len(plans) < budget:
+                start = h._prefill_done
+                ids = p[start:start + self.prefill_chunk]
+                # plan positions advance locally so one handle can get
+                # several chunks within one budget
+                plans.append(PrefillChunk(
+                    handle=h, start=start, ids=ids,
+                    final=start + ids.size >= p.size,
+                ))
+                h._prefill_done = start + ids.size
+        return plans
+
+    def prefill_finished(self, handle: RequestHandle) -> None:
+        """The final chunk ran and the first token was emitted."""
+        handle.status = RequestStatus.DECODING
+        if handle in self._prefilling:
+            self._prefilling.remove(handle)
+
+    # -- decode view -------------------------------------------------------
+    def decoding(self) -> List[Tuple[int, RequestHandle]]:
+        return sorted(
+            (s, h) for s, h in self.by_slot.items()
+            if h.status is RequestStatus.DECODING
+        )
+
+    # -- retirement --------------------------------------------------------
+    def release(self, handle: RequestHandle, pool) -> None:
+        """Detach a handle from its slot (terminal status already set by
+        the engine) and return the slot to the pool."""
+        if handle.slot is not None:
+            self.by_slot.pop(handle.slot, None)
+            pool.free(handle.slot)
+            handle.slot = None
+        if handle in self._prefilling:
+            self._prefilling.remove(handle)
+        if handle in self.queue:
+            self.queue.remove(handle)
+
+    # -- deadline / cancellation sweeps ------------------------------------
+    def sweep_expired(self, now: float) -> List[RequestHandle]:
+        out = [
+            h for h in self.live_handles()
+            if h.deadline_at is not None and now >= h.deadline_at
+        ]
+        return out
+
+    def sweep_cancelled(self) -> List[RequestHandle]:
+        return [h for h in self.live_handles() if h._cancel]

@@ -1,0 +1,124 @@
+"""SLO telemetry for the serve engine — TTFT / throughput / occupancy.
+
+Counters flow through the existing ``MetricsWriter`` JSONL protocol
+(train/metrics.py): any object with ``write(step, metrics, split=...)``
+works, so serve telemetry lands in the same durable, pandas/jq-loadable
+stream as training scalars (TeeWriter fans it to TensorBoard too). Two
+record kinds, both under ``split="serve"``:
+
+* ``event="request"`` — one per finished request: status, prompt/new
+  token counts, TTFT (submit -> first token, the user-facing latency
+  SLO) and decode tokens/sec.
+* ``event="snapshot"`` — periodic gauges: queue depth, slot occupancy,
+  decode ticks so far — the saturation picture.
+
+``summary()`` aggregates the run: p50/p99 TTFT (the two SLO percentiles
+every serving paper reports), completed-token throughput, and terminal
+status counts.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class ServeTelemetry:
+    """Collects per-request timings; optionally streams via a
+    MetricsWriter-protocol ``writer``. ``clock`` is injectable so tests
+    drive deterministic time."""
+
+    def __init__(self, writer=None, clock=time.monotonic):
+        self.writer = writer
+        self.clock = clock
+        self.started_at = clock()
+        self.ttfts_s: List[float] = []
+        self.status_counts: Dict[str, int] = {}
+        self.completed_tokens = 0
+        self.total_tokens = 0
+        self.submitted = 0
+        self._events = 0
+
+    # -- per-request lifecycle --------------------------------------------
+    def record_submit(self, handle) -> None:
+        self.submitted += 1
+
+    def record_done(self, handle) -> None:
+        """Called once, after the handle reaches a terminal status."""
+        status = handle.status.value
+        self.status_counts[status] = self.status_counts.get(status, 0) + 1
+        n = len(handle.tokens)
+        self.total_tokens += n
+        metrics = {
+            "event": "request",
+            "request_id": handle.request.request_id,
+            "status": status,
+            "prompt_tokens": handle.request.prompt_len,
+            "new_tokens": n,
+        }
+        if handle.first_token_at is not None:
+            ttft = handle.first_token_at - handle.submitted_at
+            metrics["ttft_ms"] = ttft * 1e3
+            # every request that GOT a first token counts toward the
+            # TTFT percentiles, whatever happened to it afterwards —
+            # under overload the slowest-to-first-token requests are
+            # exactly the ones that later expire, and dropping them
+            # would survivorship-bias the headline p99
+            self.ttfts_s.append(ttft)
+        if status == "completed":
+            self.completed_tokens += n
+            end = handle.finished_at or self.clock()
+            # decode throughput: the clock starts at the FIRST token,
+            # not at submit — a deep queue must inflate TTFT, not
+            # deflate this number into an arrival-rate artifact
+            start = handle.first_token_at or handle.submitted_at
+            dt = end - start
+            if n > 1 and dt > 0:
+                metrics["tokens_per_sec"] = (n - 1) / dt
+        self._write(metrics)
+
+    # -- periodic gauges ---------------------------------------------------
+    def record_snapshot(
+        self, *, queue_depth: int, slots_occupied: int, slots_total: int,
+        decode_ticks: int,
+    ) -> None:
+        self._write({
+            "event": "snapshot",
+            "queue_depth": queue_depth,
+            "slots_occupied": slots_occupied,
+            "slots_total": slots_total,
+            "slot_occupancy": (
+                slots_occupied / slots_total if slots_total else 0.0
+            ),
+            "decode_ticks": decode_ticks,
+        })
+
+    def _write(self, metrics: Dict) -> None:
+        if self.writer is not None:
+            self._events += 1
+            self.writer.write(self._events, metrics, split="serve")
+
+    # -- aggregates --------------------------------------------------------
+    def ttft_percentile_ms(self, q: float) -> Optional[float]:
+        if not self.ttfts_s:
+            return None
+        return float(np.percentile(np.asarray(self.ttfts_s), q) * 1e3)
+
+    def summary(self) -> Dict[str, float]:
+        wall = max(self.clock() - self.started_at, 1e-9)
+        out = {
+            "submitted": self.submitted,
+            "total_tokens": self.total_tokens,
+            "completed_tokens": self.completed_tokens,
+            "tokens_per_sec": self.completed_tokens / wall,
+            "wall_s": wall,
+        }
+        for status, n in sorted(self.status_counts.items()):
+            out[status] = n
+        for q in (50, 99):
+            p = self.ttft_percentile_ms(q)
+            if p is not None:
+                out[f"ttft_ms_p{q}"] = p
+        return out

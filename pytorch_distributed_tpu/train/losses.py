@@ -382,7 +382,12 @@ def _chunked_lm_loss(model, params, ids, chunk_size, *, train, rng=None,
         model, params, ids, train=train, rng=rng,
         moe_aux_weight=moe_aux_weight, return_hidden=True, extra=extra,
     )
-    weight, vocab_axis = _lm_projection_weight(params)
+    weight, vocab_axis = _lm_projection_weight(
+        params,
+        tied=getattr(
+            getattr(model, "config", None), "tie_word_embeddings", None
+        ),
+    )
     ce = causal_lm_chunked_loss(
         hidden.astype(current_policy().compute_dtype),
         weight,
@@ -394,16 +399,49 @@ def _chunked_lm_loss(model, params, ids, chunk_size, *, train, rng=None,
     return ce, aux
 
 
-def _lm_projection_weight(params):
+#: top-level leaves that LOOK like an untied LM head under a name this
+#: resolver doesn't know how to project through — their presence means
+#: the 'embed' tied fallback would silently compute tied-embedding
+#: logits for an untied model (embed_out, the live example, IS known
+#: and resolves below)
+_HEAD_LIKE_KEYS = ("head", "lm_out", "output_projection")
+
+
+def _lm_projection_weight(params, tied=None):
     """(projection, vocab_axis) from an LM's param tree, in the weight's
     NATIVE layout (transposing/casting up front would materialize a second
     full [V, D] copy — the chunked op slices per chunk instead): GPT-2's
-    tied ``wte`` embedding [V, D], or an untied ``lm_head`` kernel [D, V]."""
+    tied ``wte`` embedding [V, D], or an untied ``lm_head`` kernel [D, V].
+
+    ``tied`` is the model's ``tie_word_embeddings`` flag when the caller
+    knows it (None = unknown). The bare-``embed`` fallback is only valid
+    for genuinely tied models, so it refuses when the flag says untied
+    OR when a head-like leaf under another name exists — silently
+    projecting through the embedding would train against the wrong
+    logits and never error."""
     if "wte" in params:
         return params["wte"]["embedding"], 0
     if "lm_head" in params:
         return params["lm_head"]["kernel"], 1
+    if "embed_out" in params:  # NeoX/Pythia: untied Dense, kernel [D, V]
+        return params["embed_out"]["kernel"], 1
     if "embed" in params:  # tied Llama-body (tie_word_embeddings=True)
+        head_like = [k for k in _HEAD_LIKE_KEYS if k in params]
+        # an explicit tied=True is authoritative — the head-like scan
+        # only guards the UNKNOWN case (an auxiliary 'head' leaf on a
+        # genuinely tied model must not block the correct projection)
+        if tied is False or (tied is None and head_like):
+            reason = (
+                f"head-like leaves {head_like} exist" if head_like
+                else "the model reports tie_word_embeddings=False"
+            )
+            raise ValueError(
+                "refusing the tied-'embed' projection fallback: "
+                f"{reason} — the chunked-vocab loss would silently use "
+                "tied-embedding logits for an untied model; teach "
+                "_lm_projection_weight this model's head or pass "
+                "vocab_chunk_size=None"
+            )
         return params["embed"]["embedding"], 0
     raise ValueError(
         "model has neither a tied 'wte'/'embed' embedding nor an "

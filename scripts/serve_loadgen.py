@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Offered-load generator for the continuous-batching serve engine.
+
+Drives ``serve.ServeEngine`` with a seeded stream of requests at a fixed
+arrival rate (uniform or Poisson), streams SLO telemetry through the
+MetricsWriter JSONL protocol, and prints the run summary — the
+command-line twin of bench.py's ``serving`` phase, for interactive
+profiling and capacity probing::
+
+    python scripts/serve_loadgen.py --model gpt2-tiny --requests 32 \\
+        --rate 30 --slots 8 --prompt-len 4,16 --new-tokens 8,32 \\
+        --temperature 0.8 --top-p 0.95 --log /tmp/serve.jsonl
+
+``--rate 0`` submits everything up front (closed-loop saturation).
+Params are randomly initialized — the workload numbers (tokens/sec,
+TTFT percentiles, occupancy) measure the ENGINE, not any checkpoint.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def build_model(name: str):
+    if name == "gpt2-tiny":
+        from pytorch_distributed_tpu.models.gpt2 import (
+            GPT2Config, GPT2LMHead,
+        )
+        return GPT2LMHead(GPT2Config.tiny())
+    if name == "gpt2-small":
+        from pytorch_distributed_tpu.models.gpt2 import (
+            GPT2Config, GPT2LMHead,
+        )
+        return GPT2LMHead(GPT2Config.small())
+    if name == "llama-tiny":
+        from pytorch_distributed_tpu.models.llama import (
+            LlamaConfig, LlamaForCausalLM,
+        )
+        return LlamaForCausalLM(LlamaConfig.tiny())
+    if name == "qwen2-tiny":
+        from pytorch_distributed_tpu.models.qwen2 import (
+            Qwen2Config, Qwen2ForCausalLM,
+        )
+        return Qwen2ForCausalLM(Qwen2Config.tiny())
+    raise SystemExit(f"unknown --model {name!r}")
+
+
+def parse_range(s: str):
+    lo, _, hi = s.partition(",")
+    lo = int(lo)
+    return (lo, int(hi) if hi else lo)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--model", default="gpt2-tiny",
+                    help="gpt2-tiny | gpt2-small | llama-tiny | qwen2-tiny")
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="offered requests/sec (0 = submit all up front)")
+    ap.add_argument("--poisson", action="store_true",
+                    help="Poisson arrivals instead of uniform spacing")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--max-len", type=int, default=0,
+                    help="per-slot KV capacity (0 = fit the workload)")
+    ap.add_argument("--prefill-chunk", type=int, default=16)
+    ap.add_argument("--prompt-len", type=parse_range, default=(4, 16),
+                    metavar="LO[,HI]")
+    ap.add_argument("--new-tokens", type=parse_range, default=(8, 32),
+                    metavar="LO[,HI]")
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-k", type=int, default=None)
+    ap.add_argument("--top-p", type=float, default=None)
+    ap.add_argument("--deadline-s", type=float, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--log", default=None,
+                    help="telemetry JSONL path (MetricsWriter stream)")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from pytorch_distributed_tpu.serve import (
+        EngineConfig, Request, ServeEngine, ServeTelemetry, drive,
+        uniform_arrivals, warm_up,
+    )
+
+    model = build_model(args.model)
+    vocab = model.config.vocab_size
+    rng = np.random.default_rng(args.seed)
+    p_lo, p_hi = args.prompt_len
+    n_lo, n_hi = args.new_tokens
+    reqs = [
+        Request(
+            prompt_ids=rng.integers(
+                1, vocab, size=rng.integers(p_lo, p_hi + 1)
+            ).astype(np.int32),
+            max_new_tokens=int(rng.integers(n_lo, n_hi + 1)),
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, deadline_s=args.deadline_s,
+            seed=int(rng.integers(0, 2**31)),
+        )
+        for _ in range(args.requests)
+    ]
+    if args.rate > 0 and args.poisson:
+        gaps = rng.exponential(1.0 / args.rate, size=args.requests)
+        arrivals = list(np.cumsum(gaps) - gaps[0])
+    else:
+        arrivals = uniform_arrivals(args.requests, args.rate)
+
+    # auto max_len fits the workload AND the shared warm-up (a 1-token
+    # prompt rounds to one chunk + the 2 tokens that force the decode
+    # compile); an EXPLICIT --max-len is never silently rewritten — if
+    # it can't hold the warm-up, warm_up's submit fails loudly
+    max_len = args.max_len or max(
+        [
+            -(-r.prompt_len // args.prefill_chunk) * args.prefill_chunk
+            + r.max_new_tokens
+            for r in reqs
+        ] + [args.prefill_chunk + 2]
+    )
+    writer = None
+    if args.log:
+        from pytorch_distributed_tpu.train.metrics import MetricsWriter
+        writer = MetricsWriter(args.log)
+
+    import jax.numpy as jnp  # noqa: F401 — backend init before timing
+
+    params = model.init(
+        jax.random.key(0),
+        np.zeros((1, min(8, max_len - 1)), np.int32),
+    )["params"]
+    engine = ServeEngine(
+        model, params,
+        EngineConfig(num_slots=args.slots, max_len=max_len,
+                     prefill_chunk=args.prefill_chunk),
+    )
+    # serve.loadgen's shared warm-up/pacing: both programs compile
+    # outside the measured window, the JSONL stream starts clean, and
+    # the pacing matches bench.py's serving phase exactly
+    warm_up(engine, np.ones(1, np.int32),
+            telemetry=ServeTelemetry(writer=writer))
+    dt = drive(engine, reqs, arrivals)
+
+    if writer is not None:
+        writer.close()
+    s = engine.telemetry.summary()
+    print(f"model={args.model} slots={args.slots} max_len={max_len} "
+          f"requests={args.requests} rate="
+          f"{args.rate or 'closed-loop'} wall={dt:.2f}s")
+    for k in sorted(s):
+        v = s[k]
+        print(f"  {k:>18} = {v:.2f}" if isinstance(v, float)
+              else f"  {k:>18} = {v}")
+    print(f"  decode compiles    = {engine.decode_compiles} "
+          f"(static-shape invariant: must be 1)")
+    if args.log:
+        print(f"telemetry JSONL -> {args.log}")
+
+
+if __name__ == "__main__":
+    main()

@@ -88,6 +88,25 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
     assert len(ckpt) == 1, proc.stderr[-2000:]
     assert ckpt[0]["value"] > 0 and ckpt[0]["integrity"] == "crc+commit"
 
+    # serving: the continuous-batching engine must BEAT the naive
+    # sequential-generate baseline on the same offered workload —
+    # vs_baseline carries the engine/sequential tokens-per-sec ratio
+    # (the one relative metric that stays honest on a CPU), and the SLO
+    # percentiles must be present
+    srv = [
+        json.loads(l) for l in proc.stderr.splitlines()
+        if l.startswith("{")
+        and json.loads(l)["metric"] == "serving_tokens_per_sec"
+    ]
+    assert len(srv) == 1, proc.stderr[-2000:]
+    assert srv[0]["value"] > 0
+    assert srv[0]["vs_baseline"] is not None, srv[0]
+    assert srv[0]["vs_baseline"] > 1.0, (
+        f"continuous batching lost to sequential generate: {srv[0]}"
+    )
+    assert "serving_ttft_ms_p50" in proc.stderr
+    assert "serving_ttft_ms_p99" in proc.stderr
+
     # the input_pipeline phases must stay inside their time budget (the
     # r3 starvation incident: the feed phase alone ran >25 min and ate
     # every later phase's budget). Phase durations are printed as
@@ -100,6 +119,8 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
     assert "input_pipeline_feed" in durations, sorted(durations)
     assert durations["input_pipeline_feed"] < 300, durations
     assert durations.get("input_pipeline_u8_e2e", 0) < 300, durations
+    assert "serving" in durations, sorted(durations)
+    assert durations["serving"] < 300, durations
 
 
 @pytest.mark.slow

@@ -511,3 +511,37 @@ def test_prompt_mask_rejects_all_pad_row(gpt2):
     with pytest.raises(ValueError, match="no real tokens"):
         generate(model, params, ids, max_new_tokens=3, temperature=0.0,
                  prompt_mask=bad)
+
+
+def test_ragged_prompt_state_edge_cases():
+    """The serve engine's chunked prefill leans on these edges: the
+    full-length prompt (every slot real), the zero-decode-tail cache
+    (cache_len == P), and the refusals that keep garbage out."""
+    from pytorch_distributed_tpu.generation import ragged_prompt_state
+
+    B, P = 2, 4
+    full = jnp.ones((B, P), jnp.bool_)
+    # full-length prompt: positions count 0..P-1, every slot valid
+    _, pos, lens, kv = ragged_prompt_state(full, B, P, P + 2)
+    assert np.asarray(pos).tolist() == [list(range(P))] * B
+    assert np.asarray(lens).tolist() == [P, P]
+    assert np.asarray(kv).all() and kv.shape == (B, P + 2)
+    # cache_len == P: the zero-width decode-tail concat stays valid
+    _, pos, lens, kv = ragged_prompt_state(full, B, P, P)
+    assert kv.shape == (B, P) and np.asarray(kv).all()
+    # ragged row: pads share position 0 and are masked out of the cache
+    m = jnp.asarray([[False, True, True, True], [True] * 4])
+    _, pos, lens, kv = ragged_prompt_state(m, B, P, P + 1)
+    assert np.asarray(lens).tolist() == [3, 4]
+    assert np.asarray(pos)[0].tolist() == [0, 0, 1, 2]
+    assert np.asarray(kv)[0].tolist() == [False, True, True, True, True]
+    # an all-pad row would decode from a fully masked attention row
+    bad = jnp.asarray([[False] * 4, [True] * 4])
+    with pytest.raises(ValueError, match="no real tokens"):
+        ragged_prompt_state(bad, B, P, P + 1)
+    # right padding would sample from a pad-slot query
+    rp = jnp.asarray([[True, True, False, False], [True] * 4])
+    with pytest.raises(ValueError, match="LEFT-padded"):
+        ragged_prompt_state(rp, B, P, P + 1)
+    with pytest.raises(ValueError, match="prompt_mask must be"):
+        ragged_prompt_state(jnp.ones((B, P + 1), jnp.bool_), B, P, P + 2)

@@ -537,3 +537,20 @@ def test_bert_mlm_matches_hf_and_roundtrips():
            if "pooler" not in k and "cls.predictions" not in k}
     with pytest.raises(KeyError, match="pooler"):
         load_bert_weights(bad, cfg)
+
+
+def test_attention_extras_on_later_layers_still_refuse():
+    """ADVICE r5: the refuse-don't-drop guards must scan EVERY layer
+    prefix — a checkpoint carrying biases/norms only on layer 1 used to
+    slip past the layer-0-only check into silent HF divergence."""
+    from pytorch_distributed_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig.tiny()  # attention_bias=False, qk_norm=False
+    with pytest.raises(ValueError, match="attention projection biases"):
+        load_llama_weights(
+            {"model.layers.1.self_attn.q_proj.bias": np.zeros(16)}, cfg
+        )
+    with pytest.raises(ValueError, match="q_norm/k_norm"):
+        load_llama_weights(
+            {"model.layers.1.self_attn.k_norm.weight": np.zeros(16)}, cfg
+        )

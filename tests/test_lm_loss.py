@@ -277,3 +277,43 @@ def test_gpt2_packed_loss_equals_per_document_losses():
         tot += float(l) * (len(doc) - 1)
         n_tok += len(doc) - 1
     np.testing.assert_allclose(float(packed_loss), tot / n_tok, rtol=2e-5)
+
+
+def test_lm_projection_refuses_untied_embed_fallback():
+    """ADVICE r5: the bare-'embed' tied fallback must not silently
+    produce tied-embedding logits for untied models — refuse when a
+    head-like leaf exists (NeoX's embed_out) or the tie flag says no."""
+    from pytorch_distributed_tpu.train.losses import _lm_projection_weight
+
+    emb = np.zeros((8, 4), np.float32)
+    tied = {"embed": {"embedding": emb}}
+    w, axis = _lm_projection_weight(tied, tied=True)
+    assert w is emb and axis == 0
+    # unknown tie flag, no competing head: the fallback stays usable
+    w, axis = _lm_projection_weight(tied)
+    assert w is emb and axis == 0
+    # NeoX's embed_out IS a known untied head (Dense kernel [D, V]) —
+    # resolved, not refused
+    neoxish = {"embed": {"embedding": emb},
+               "embed_out": {"kernel": np.zeros((4, 8), np.float32)}}
+    w, axis = _lm_projection_weight(neoxish)
+    assert w is neoxish["embed_out"]["kernel"] and axis == 1
+    # an UNKNOWN head-like leaf still refuses the embed fallback...
+    headish = {"embed": {"embedding": emb},
+               "head": {"kernel": np.zeros((4, 8), np.float32)}}
+    with pytest.raises(ValueError, match="head-like"):
+        _lm_projection_weight(headish)
+    # ...but an explicit tied=True is authoritative: an auxiliary head
+    # leaf (e.g. a finetuning classifier) must not block the projection
+    w, axis = _lm_projection_weight(headish, tied=True)
+    assert w is emb and axis == 0
+    # explicit untied flag: refuse even without a competing leaf
+    with pytest.raises(ValueError, match="tie_word_embeddings=False"):
+        _lm_projection_weight(tied, tied=False)
+    # an untied model WITH its lm_head never hits the gate
+    w, axis = _lm_projection_weight(
+        {"embed": {"embedding": emb},
+         "lm_head": {"kernel": np.zeros((4, 8), np.float32)}},
+        tied=False,
+    )
+    assert axis == 1

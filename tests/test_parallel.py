@@ -283,3 +283,98 @@ def test_no_sync_is_a_documented_noop():
 
     with no_sync():
         pass
+
+
+def test_llama_partition_rules_replicate_ragged_gqa_kv():
+    """ADVICE r5: a GQA model whose kv heads don't divide tp (Qwen2-7B:
+    4 kv heads, tp=8) must REPLICATE k/v instead of crashing on an
+    unshardable axis — and kv counts that do divide keep sharding.
+    Torch-free on purpose: the HF-parity qwen2/gemma modules importorskip
+    torch, and this placement logic must stay covered without it."""
+    from pytorch_distributed_tpu.models.qwen2 import qwen2_partition_rules
+    from pytorch_distributed_tpu.parallel.sharding import PartitionRules
+
+    mesh = make_mesh(MeshSpec(dp=1, tp=8), set_current=False)
+    rules = PartitionRules(qwen2_partition_rules())
+    path = "layers/block/k/kernel"
+    # Qwen2-7B-shaped stacked kernel: [L, D, 4 kv heads, hd] -> replicate
+    assert rules.spec_for(path, (2, 64, 4, 16), mesh) == P(
+        None, None, None, None
+    )
+    # unrolled layout too
+    assert rules.spec_for(path, (64, 4, 16), mesh) == P(None, None, None)
+    # a divisible kv count still shards
+    assert rules.spec_for(path, (2, 64, 8, 16), mesh) == P(
+        None, None, "tp", None
+    )
+    # q is untouched by the kv fallback
+    assert rules.spec_for("layers/block/q/kernel", (2, 64, 8, 16), mesh) \
+        == P(None, None, "tp", None)
+
+
+def test_ragged_gqa_places_on_tp8_mesh():
+    """End to end: a 4-kv-head model PLACES on a tp=8 mesh (the advice's
+    crash repro) with q sharded and k/v replicated."""
+    from pytorch_distributed_tpu.models.qwen2 import (
+        Qwen2Config,
+        Qwen2ForCausalLM,
+        qwen2_partition_rules,
+    )
+    from pytorch_distributed_tpu.train import TrainState
+
+    ptd.init_process_group(mesh_spec=MeshSpec(dp=1, tp=8))
+    cfg = Qwen2Config(
+        vocab_size=512, hidden_size=64, num_layers=2, num_heads=8,
+        num_kv_heads=4, intermediate_size=128, max_seq_len=64,
+    )
+    model = Qwen2ForCausalLM(cfg)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    strategy = DataParallel(extra_rules=qwen2_partition_rules())
+    state = strategy.place(TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.sgd(0.1)
+    ))
+    block = state.params["layers"]["block"]
+    assert "tp" in str(block["q"]["kernel"].sharding.spec)
+    assert "tp" not in str(block["k"]["kernel"].sharding.spec)
+    assert "tp" not in str(block["v"]["kernel"].sharding.spec)
+
+
+def test_gemma_partition_rules_derive_from_config():
+    """ADVICE r5: the rules take the CONFIG now — gemma_7b's 16 kv heads
+    shard (the old =1 int default silently replicated them), gemma_2b's
+    MQA replicates, and the bare call decides from the kernel shape."""
+    from pytorch_distributed_tpu.models.gemma import (
+        GemmaConfig,
+        gemma_partition_rules,
+    )
+    from pytorch_distributed_tpu.parallel.sharding import PartitionRules
+
+    mesh = make_mesh(MeshSpec(dp=1, tp=8), set_current=False)
+    path = "layers/block/k/kernel"
+    kv7b = (2, 3072, 16, 256)  # gemma_7b stacked k kernel
+    kv2b = (2, 2048, 1, 256)   # gemma_2b (MQA)
+    shard = P(None, None, "tp", None)
+    repl = P(None, None, None, None)
+
+    r7 = PartitionRules(
+        gemma_partition_rules(config=GemmaConfig.gemma_7b())
+    )
+    assert r7.spec_for(path, kv7b, mesh) == shard
+    r2 = PartitionRules(
+        gemma_partition_rules(config=GemmaConfig.gemma_2b())
+    )
+    assert r2.spec_for(path, kv2b, mesh) == repl
+    # bare call: shape-derived — BOTH variants place correctly
+    rb = PartitionRules(gemma_partition_rules())
+    assert rb.spec_for(path, kv7b, mesh) == shard
+    assert rb.spec_for(path, kv2b, mesh) == repl
+    with pytest.raises(ValueError, match="not both"):
+        gemma_partition_rules(config=GemmaConfig.gemma_2b(),
+                              num_kv_heads=1)
+    # pre-r6 positional-int callers still mean the kv-head count
+    r_old = PartitionRules(gemma_partition_rules(16))
+    assert r_old.spec_for(path, kv7b, mesh) == shard
+    r_mqa = PartitionRules(gemma_partition_rules(1))
+    assert r_mqa.spec_for(path, kv7b, mesh) == repl  # forced MQA form
