@@ -856,6 +856,96 @@ def bench_serving(on_tpu: bool) -> None:
     )
 
 
+def bench_observability() -> None:
+    """Traced-vs-untraced hot-loop overhead: the tracer's near-zero-cost
+    claim as a number, pinned by test_bench_contract (< 2% budget).
+
+    Subtracting two whole-loop wall clocks cannot resolve a 2% budget
+    on this box — identical untraced loops vary 2-6x run to run
+    (backend scheduling noise, measured), which once produced a -35%
+    "overhead". So the two stable quantities are measured separately
+    and composed: (a) the MARGINAL cost of one armed span minus one
+    disarmed is-None site, from tight host loops (min over windows:
+    ~4.4us vs ~0.4us, reproducible to ~10%); (b) the per-step floor of
+    a realistic jitted step loop with the Trainer's per-step span set
+    (data_wait / step / metric_fetch), min over iterations. Overhead =
+    spans-per-step x marginal span cost / step floor — conservative on
+    both ends (floor denominator, recording-tracer numerator).
+    """
+    import tempfile
+
+    from pytorch_distributed_tpu.runtime import tracing
+
+    rng = np.random.default_rng(0)
+    # 512^3 matmul: a ~2-4ms step on this box — still far SMALLER than
+    # any real model step here (resnet18 synthetic ~1s/step), so the
+    # %-overhead denominator stays conservative
+    x0 = jnp.asarray(rng.normal(size=(512, 512)).astype(np.float32))
+
+    @jax.jit
+    def stepfn(x):
+        y = jnp.tanh(x @ x)
+        return y / (jnp.abs(y).max() + 1.0)  # keep values loop-stable
+
+    spans_per_step, iters = 3, 60
+    y = stepfn(x0)
+    float(y[0, 0])  # compile + sync out of every timed window
+
+    def span_cost(n=20_000, windows=5):
+        best = float("inf")
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                with tracing.span("bench.step"):
+                    pass
+            best = min(best, (time.perf_counter() - t0) / n)
+        return best
+
+    tracing.clear()
+    disarmed = span_cost()
+    # the realistic step loop, spans disarmed: per-step floor
+    step_floor = float("inf")
+    yv = x0
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        with tracing.span("bench.data_wait"):
+            pass
+        with tracing.span("bench.step"):
+            yv = stepfn(yv)
+        with tracing.span("bench.metric_fetch"):
+            float(yv[0, 0])
+        step_floor = min(step_floor, time.perf_counter() - t0)
+    tmp = tempfile.mkdtemp(prefix="ptd_bench_obs_")
+    tracer = tracing.configure(tmp, max_events=150_000)
+    try:
+        armed = span_cost()
+        path = tracer.export()
+    finally:
+        tracing.clear()
+    n_events = len(tracer._events)
+    if n_events < 20_000:  # the phase must measure a RECORDING tracer
+        raise RuntimeError(f"tracer recorded only {n_events} events")
+    overhead_pct = (
+        spans_per_step * max(armed - disarmed, 0.0) / step_floor * 100.0
+    )
+    _emit(
+        {
+            "metric": "observability_trace_overhead_pct",
+            "value": round(overhead_pct, 3),
+            "unit": f"% of per-step floor ({step_floor * 1e3:.2f}ms): "
+            f"{spans_per_step} spans/step x marginal armed-span cost "
+            f"(budget < 2%)",
+            "vs_baseline": None,
+        }
+    )
+    print(
+        f"# observability: span disarmed={disarmed * 1e9:.0f}ns "
+        f"armed={armed * 1e6:.2f}us step_floor={step_floor * 1e3:.2f}ms "
+        f"overhead={overhead_pct:.3f}% events={n_events} trace={path}",
+        file=sys.stderr,
+    )
+
+
 def bench_allreduce_device(on_tpu: bool) -> None:
     """Grad-sized allreduce over the dp mesh axis (BASELINE.json:2).
 
@@ -1129,6 +1219,7 @@ def main():
         return time.perf_counter() - t0
 
     failures = []
+    phase_durations = {}
 
     def run_if_budget(name, fn, *args, **kw):
         # each phase starts only with wall clock in hand: the axon
@@ -1156,9 +1247,11 @@ def main():
             # (input_pipeline alone ate >25 min) must show up in the
             # tail, and tests/test_bench_contract.py bounds the
             # input_pipeline phase with it
+            phase_durations[name] = round(
+                time.perf_counter() - t_phase, 3
+            )
             print(
-                f"# phase {name} done in "
-                f"{time.perf_counter() - t_phase:.1f}s",
+                f"# phase {name} done in {phase_durations[name]:.1f}s",
                 file=sys.stderr, flush=True,
             )
 
@@ -1190,6 +1283,9 @@ def main():
         # honest on a CPU — the ratio is the claim, the unit says the
         # shapes
         run_if_budget("serving", bench_serving, False)
+        # so is the tracing-overhead ratio: traced vs untraced on the
+        # same loop, same box
+        run_if_budget("observability", bench_observability)
     else:
         bench_resnet50(on_tpu)
         run_if_budget("input_pipeline", bench_input_pipeline, on_tpu)
@@ -1205,6 +1301,18 @@ def main():
         run_if_budget("generate", bench_generate, on_tpu)
         run_if_budget("gpt2", bench_gpt2, on_tpu)
         run_if_budget("serving", bench_serving, on_tpu)
+        run_if_budget("observability", bench_observability)
+    # the per-phase wall clocks as DATA (the stderr "# phase ... done"
+    # notes were print-only): one record the driver's BENCH tail and
+    # test_bench_contract can both parse
+    _emit(
+        {
+            "metric": "phase_durations_s",
+            "value": phase_durations,
+            "unit": "seconds per bench phase (budget-gated phases only)",
+            "vs_baseline": None,
+        }
+    )
     if failures:
         print(f"# bench phases FAILED: {failures}", file=sys.stderr)
         sys.exit(1)

@@ -22,6 +22,7 @@ import jax
 import numpy as np
 
 from pytorch_distributed_tpu.data.sampler import GlobalBatchSampler
+from pytorch_distributed_tpu.runtime import tracing
 
 _SENTINEL = object()
 
@@ -215,6 +216,13 @@ class DataLoader:
         return n
 
     def _place(self, batch):
+        # spans land on the producer thread's own trace track (per-tid),
+        # so assembly/H2D visibly overlaps (or fails to overlap) the
+        # consumer's train.step spans in the exported timeline
+        with tracing.span("ingest.place"):
+            return self._place_inner(batch)
+
+    def _place_inner(self, batch):
         if self.transform is not None:
             batch = self.transform(batch)
         if self.sharding is not None:
@@ -255,9 +263,10 @@ class DataLoader:
             for indices in self.sampler:
                 if stop.is_set():
                     return
-                batch = (self.fetch or _default_fetch)(
-                    self.dataset, self._rank_slice(indices)
-                )
+                with tracing.span("ingest.fetch", n=len(indices)):
+                    batch = (self.fetch or _default_fetch)(
+                        self.dataset, self._rank_slice(indices)
+                    )
                 out_q.put(self._place(batch))
             out_q.put(_SENTINEL)
         except BaseException as e:  # surface worker errors to the consumer

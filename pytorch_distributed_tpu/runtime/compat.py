@@ -56,3 +56,54 @@ def abstract_mesh(axis_sizes, axis_names):
         return AbstractMesh(tuple(axis_sizes), tuple(axis_names))
     except TypeError:
         return AbstractMesh(tuple(zip(axis_names, axis_sizes)))
+
+
+def jit_cache_size(fn):
+    """Compiled-specialization count of a jitted callable, or None.
+
+    The recompile sentinel (runtime/tracing.py) polls this after each
+    step: a steady-state loop whose count grows is silently recompiling.
+    ``_cache_size`` is private jax API present on PjitFunction across
+    the 0.4–0.6 window this repo supports; any absence/failure degrades
+    to None (sentinel off for that callable) rather than raising in the
+    hot loop.
+    """
+    f = getattr(fn, "_cache_size", None)
+    if not callable(f):
+        return None
+    try:
+        return int(f())
+    except Exception:  # pragma: no cover - backend/version specific
+        return None
+
+
+def live_buffer_bytes():
+    """Live device-buffer bytes, or None when nothing can report them.
+
+    TPU/GPU backends expose per-device ``memory_stats()['bytes_in_use']``
+    — the allocator's own number, preferred. XLA:CPU reports no memory
+    stats, so the fallback sums ``nbytes`` over ``jax.live_arrays()``
+    (committed arrays only — it cannot see donated/internal scratch, but
+    it tracks the leak shapes that matter: caches, states, stale
+    references). Sampled at log cadence only; never on the step path.
+    """
+    import jax
+
+    total, saw = 0, False
+    for d in jax.local_devices():
+        try:
+            s = d.memory_stats()
+        except Exception:  # pragma: no cover - backend-dependent
+            s = None
+        if s and "bytes_in_use" in s:
+            total += int(s["bytes_in_use"])
+            saw = True
+    if saw:
+        return total
+    live = getattr(jax, "live_arrays", None)
+    if live is None:  # pragma: no cover - very old jax
+        return None
+    try:
+        return int(sum(getattr(a, "nbytes", 0) or 0 for a in live()))
+    except Exception:  # pragma: no cover - defensive: gauge must not kill
+        return None

@@ -51,6 +51,7 @@ from pytorch_distributed_tpu.generation import (
     model_max_len,
 )
 from pytorch_distributed_tpu.runtime import faults
+from pytorch_distributed_tpu.runtime import tracing
 from pytorch_distributed_tpu.serve.kv_slots import (
     KVSlotPool,
     put_slot,
@@ -316,7 +317,10 @@ class ServeEngine:
             for h in self.scheduler.sweep_cancelled():
                 self._finish(h, RequestStatus.CANCELLED)
         for h in self.scheduler.admit(self.pool):
-            self._configure_slot(h)
+            with tracing.span(
+                "serve.admit", request=h.request.request_id
+            ):
+                self._configure_slot(h)
         did = self._run_prefill()
         did = self._run_decode() or did
         if self.config.telemetry_every and (
@@ -363,14 +367,18 @@ class ServeEngine:
             # retrace); ALL slot-row updates — per-chunk length cursor,
             # final-chunk key/token persist — happen inside the one
             # compiled program (eager .at[].set is ms-scale here)
-            (
-                cache, tok, self._toks, self._lengths, self._keys,
-            ) = self._prefill(
-                self.params, self.pool.cache, ids, slot, plan.start,
-                plan.chunk_len - 1, plan.final,
-                self._toks, self._lengths, self._keys,
-                self._temps, self._top_ks, self._top_ps,
-            )
+            with tracing.span(
+                "serve.prefill_chunk", request=h.request.request_id
+            ):
+                (
+                    cache, tok, self._toks, self._lengths, self._keys,
+                ) = self._prefill(
+                    self.params, self.pool.cache, ids, slot, plan.start,
+                    plan.chunk_len - 1, plan.final,
+                    self._toks, self._lengths, self._keys,
+                    self._temps, self._top_ks, self._top_ps,
+                )
+            tracing.note_compiles("serve.prefill", self.prefill_compiles)
             self.pool.cache = cache
             self.pool.lengths[slot] = plan.start + plan.chunk_len
             did = True
@@ -395,14 +403,19 @@ class ServeEngine:
         # one jit call; toks/lengths/keys advance in-program for the
         # active rows, so the only per-tick host traffic is the sampled
         # tokens coming down
-        (
-            self.pool.cache, nxt, self._toks, self._lengths, self._keys,
-        ) = self._decode(
-            self.params, self.pool.cache, self._toks, self._lengths,
-            self._keys, self._temps, self._top_ks, self._top_ps,
-            self._active_cached,
-        )
-        nxt = np.asarray(nxt)
+        with tracing.span("serve.decode_tick", active=len(decoding)):
+            (
+                self.pool.cache, nxt, self._toks, self._lengths,
+                self._keys,
+            ) = self._decode(
+                self.params, self.pool.cache, self._toks, self._lengths,
+                self._keys, self._temps, self._top_ks, self._top_ps,
+                self._active_cached,
+            )
+        tracing.note_compiles("serve.decode", self.decode_compiles)
+        with tracing.span("serve.token_fetch"):
+            # the one per-tick device sync: every sampled token comes down
+            nxt = np.asarray(nxt)
         fault_armed = faults.active()
         for slot, h in decoding:
             # the tick wrote this slot's token at lengths[slot]; mirror
@@ -441,7 +454,11 @@ class ServeEngine:
         if h.request.deadline_s is not None:
             self._n_deadlines -= 1
         self._decoding_dirty = True
-        self.scheduler.release(h, self.pool)
+        with tracing.span(
+            "serve.evict",
+            request=h.request.request_id, status=status.value,
+        ):
+            self.scheduler.release(h, self.pool)
         self.telemetry.record_done(h)
         if status is RequestStatus.FAILED:
             logger.warning(

@@ -22,7 +22,7 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional
 
-import numpy as np
+from pytorch_distributed_tpu.utils.timing import percentile
 
 
 class ServeTelemetry:
@@ -104,7 +104,10 @@ class ServeTelemetry:
     def ttft_percentile_ms(self, q: float) -> Optional[float]:
         if not self.ttfts_s:
             return None
-        return float(np.percentile(np.asarray(self.ttfts_s), q) * 1e3)
+        # the shared linear-interpolated helper (utils/timing.py) — same
+        # numbers the old private np.percentile path produced, same
+        # computation every other percentile in the repo reports
+        return percentile(self.ttfts_s, q) * 1e3
 
     def summary(self) -> Dict[str, float]:
         wall = max(self.clock() - self.started_at, 1e-9)

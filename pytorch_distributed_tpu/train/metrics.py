@@ -5,6 +5,7 @@ equivalent that works with zero extra dependencies)."""
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
 import os
@@ -12,6 +13,10 @@ import time
 from typing import Dict, List
 
 from pytorch_distributed_tpu.runtime import device as _device
+from pytorch_distributed_tpu.utils.logging import get_logger
+from pytorch_distributed_tpu.utils.timing import WindowTimer
+
+logger = get_logger(__name__)
 
 
 @dataclasses.dataclass
@@ -21,28 +26,31 @@ class MeterState:
 
 
 class ScalarMeter:
-    """Running window over step timings; reports per-chip throughput."""
+    """Running window over step timings; reports per-chip throughput.
+
+    A thin shape over :class:`utils.timing.WindowTimer` — the one
+    windowed timer shared with ``utils.profiler.StepTimer`` — so "p95
+    step time" is the same computation wherever it is reported.
+    """
 
     def __init__(self, window: int = 50):
         self.window = window
-        self._states: List[MeterState] = []
+        self._timer = WindowTimer(window)
+        self._sps = collections.deque(maxlen=window)
 
     def update(self, s: MeterState) -> None:
-        self._states.append(s)
-        if len(self._states) > self.window:
-            self._states.pop(0)
+        self._timer.add(s.step_time)
+        self._sps.append(s.samples_per_sec)
 
     @property
     def samples_per_sec(self) -> float:
-        if not self._states:
+        if not self._sps:
             return 0.0
-        return sum(s.samples_per_sec for s in self._states) / len(self._states)
+        return sum(self._sps) / len(self._sps)
 
     @property
     def step_time(self) -> float:
-        if not self._states:
-            return 0.0
-        return sum(s.step_time for s in self._states) / len(self._states)
+        return self._timer.mean
 
     @property
     def samples_per_sec_per_chip(self) -> float:
@@ -53,6 +61,8 @@ class ScalarMeter:
             "samples_per_sec": self.samples_per_sec,
             "samples_per_sec_per_chip": self.samples_per_sec_per_chip,
             "step_time_ms": self.step_time * 1e3,
+            "step_time_p50_ms": self._timer.percentile(50) * 1e3,
+            "step_time_p95_ms": self._timer.percentile(95) * 1e3,
         }
 
 
@@ -82,10 +92,23 @@ class MetricsWriter:
                 rec[k] = str(v)
         self._f.write(json.dumps(rec) + "\n")
 
+    def flush(self) -> None:
+        """Force buffered records to disk (line buffering already flushes
+        per record; this is the explicit barrier before a kill window)."""
+        if self._f is not None:
+            self._f.flush()
+
     def close(self) -> None:
         if self._f is not None:
             self._f.close()
             self._f = None
+
+    def __enter__(self) -> "MetricsWriter":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
 
 
 class TeeWriter:
@@ -99,17 +122,46 @@ class TeeWriter:
         for w in self.writers:
             w.write(step, metrics, split=split)
 
+    def flush(self) -> None:
+        for w in self.writers:
+            if hasattr(w, "flush"):
+                w.flush()
+
     def close(self) -> None:
         for w in self.writers:
             w.close()
 
+    def __enter__(self) -> "TeeWriter":
+        return self
 
-def read_metrics(path: str) -> List[Dict[str, float]]:
-    """Load a MetricsWriter JSONL back into a list of records."""
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+def read_metrics(path: str, *, strict: bool = False) -> List[Dict[str, float]]:
+    """Load a MetricsWriter JSONL back into a list of records.
+
+    A mid-write SIGKILL (exactly the chaos-drill scenario) leaves a
+    truncated final record; a torn line is skipped with a warning
+    instead of raising, so a post-crash analysis tool can read
+    everything the run DID durably log. ``strict=True`` restores the
+    raise for callers that want torn evidence to be loud.
+    """
     out = []
     with open(path) as f:
-        for line in f:
+        for lineno, line in enumerate(f, 1):
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 out.append(json.loads(line))
+            except ValueError:
+                if strict:
+                    raise
+                logger.warning(
+                    "skipping torn metrics record at %s:%d (%d bytes) — "
+                    "a mid-write kill truncates the final line",
+                    path, lineno, len(line),
+                )
     return out

@@ -16,6 +16,7 @@ BASELINE.json:5,9,10) with one jit-compiled function:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import math
 import os
@@ -27,6 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from pytorch_distributed_tpu.runtime import distributed as dist
+from pytorch_distributed_tpu.runtime import tracing
+from pytorch_distributed_tpu.runtime.compat import jit_cache_size
 from pytorch_distributed_tpu.runtime.device import host_scalar
 from pytorch_distributed_tpu.runtime.precision import GradScaler
 from pytorch_distributed_tpu.runtime.prng import key_for
@@ -44,6 +47,8 @@ from pytorch_distributed_tpu.utils.logging import get_logger
 LossFn = Callable[[Any, Any, Any, jax.Array], Tuple[jax.Array, Dict[str, Any]]]
 
 logger = get_logger(__name__)
+
+_EPOCH_END = object()  # loader-exhausted sentinel for the spanned fetch
 
 
 def _accepts_rng(transform) -> bool:
@@ -305,6 +310,15 @@ class TrainerConfig:
     # trace — the torch.profiler schedule(wait/active) idiom: capture a
     # small mid-training window (past compiles and warmup) instead of
     # wrapping the whole run in maybe_trace
+    trace: Optional[str] = None  # span-tracer output dir (runtime/
+    # tracing.py): Trainer construction arms the process-wide recorder
+    # (so the pre-fit restore_checkpoint() lands too), every
+    # instrumented site (trainer step loop, ingest producer threads,
+    # a serve engine sharing the process) lands on one timeline, and
+    # fit() teardown writes <trace>/trace.json (Perfetto-loadable) plus
+    # per-span rollups into the metrics stream. Distinct from
+    # trace_dir/trace_steps, which drive the XLA device profiler —
+    # this one is the always-cheap host-side span timeline.
 
 
 class TrainingDiverged(RuntimeError):
@@ -390,6 +404,16 @@ class Trainer:
         self._preemption = None
         self._watchdog = None
         self._async_ckpt = None
+        # goodput clock starts at construction: setup/compile before the
+        # first step is honestly "other", not productive time
+        self._goodput = tracing.GoodputAccount()
+        # arm the span tracer HERE, not in fit(): every recipe calls
+        # restore_checkpoint() first, and its train.restore span must
+        # land on the timeline (fit teardown exports and disarms)
+        self._own_tracer = (
+            tracing.configure(self.config.trace)
+            if self.config.trace else None
+        )
         self._step_flops = None  # per-step FLOPs (log_mfu), set lazily
         self._best_value: Optional[float] = None  # keep_best tracking
         # (resets on resume: a restored run re-establishes its best)
@@ -470,11 +494,16 @@ class Trainer:
             return None
         from pytorch_distributed_tpu.train.checkpoint import save_checkpoint
 
-        if self._async_ckpt is not None:
-            self._async_ckpt.save(self.config.ckpt_dir, self.state, tag=tag)
-            path = os.path.join(self.config.ckpt_dir, tag)
-        else:
-            path = save_checkpoint(self.config.ckpt_dir, self.state, tag=tag)
+        with self._accounted("train.checkpoint", "checkpoint", tag=tag):
+            if self._async_ckpt is not None:
+                self._async_ckpt.save(
+                    self.config.ckpt_dir, self.state, tag=tag
+                )
+                path = os.path.join(self.config.ckpt_dir, tag)
+            else:
+                path = save_checkpoint(
+                    self.config.ckpt_dir, self.state, tag=tag
+                )
         logger.info("checkpoint saved: %s (step %d)", path, self.host_step)
         if self._watchdog is not None:
             self._watchdog.tick()  # a slow (sharded) save is not a hang
@@ -520,6 +549,10 @@ class Trainer:
         ``latest`` resume but every one of them is damaged (silently
         training from scratch would eventually overwrite the evidence).
         """
+        with self._accounted("train.restore", "recovering", tag=tag):
+            return self._restore_checkpoint_timed(tag)
+
+    def _restore_checkpoint_timed(self, tag: str) -> bool:
         if self.config.ckpt_dir is None:
             return False
         from pytorch_distributed_tpu.train.checkpoint import (
@@ -758,9 +791,14 @@ class Trainer:
             if cfg.handle_preemption else None
         )
         self._watchdog = (
-            elastic.Watchdog(cfg.stall_timeout_s).start()
+            elastic.Watchdog(
+                cfg.stall_timeout_s, on_stall=self._note_stall
+            ).start()
             if cfg.stall_timeout_s else None
         )
+        if cfg.trace and self._own_tracer is None:
+            # re-arm for a second fit() — teardown disarmed the first
+            self._own_tracer = tracing.configure(cfg.trace)
         try:
             for epoch in range(self._first_epoch, cfg.epochs):
                 self.train_loader.set_epoch(epoch)
@@ -804,9 +842,71 @@ class Trainer:
                 self._preemption.uninstall()
             if self._watchdog is not None:
                 self._watchdog.stop()
+            self._finish_observability()
             if self.metrics_writer is not None:
                 self.metrics_writer.close()
         return self.state
+
+    def _note_stall(self, idle_s: float) -> None:
+        """Watchdog stall callback: the idle window is goodput-stalled
+        time, and the stall lands on the trace timeline."""
+        self._goodput.add("stalled", idle_s)
+        tracing.instant(
+            "watchdog.stall", idle_s=idle_s, step=self.host_step
+        )
+
+    @contextlib.contextmanager
+    def _accounted(self, span_name: str, bucket: str, **span_args):
+        """One shape for every attributed section: trace span + goodput
+        bucket. A watchdog 'stall' that RESOLVES inside the section was
+        a slow op, not a hang — its wall time is already covered by this
+        section's own attribution, so the stalled seconds it accrued are
+        retracted (buckets must keep summing to wall). A stall with no
+        enclosing section (truly wedged loop) stands."""
+        t0 = time.perf_counter()
+        stalled0 = self._goodput.buckets.get("stalled", 0.0)
+        try:
+            with tracing.span(span_name, **span_args):
+                yield
+        finally:
+            self._goodput.add(bucket, time.perf_counter() - t0)
+            self._goodput.retract(
+                "stalled",
+                self._goodput.buckets.get("stalled", 0.0) - stalled0,
+            )
+
+    def _finish_observability(self) -> None:
+        """End-of-fit accounting: goodput record + span rollups into the
+        metrics stream, trace.json to cfg.trace. Best-effort — a broken
+        export must never mask the original training exception."""
+        try:
+            if self.metrics_writer is not None:
+                self.metrics_writer.write(
+                    self.host_step,
+                    {"event": "goodput", **self._goodput.summary()},
+                    split="goodput",
+                )
+            if self._own_tracer is None:
+                return
+            if self.metrics_writer is not None:
+                self._own_tracer.write_rollups(
+                    self.metrics_writer, self.host_step
+                )
+            # one file per process: concurrent ranks writing one shared
+            # trace dir must not swing over each other's export
+            ring = dist.multiprocess_ring()
+            rank = dist.get_rank() if ring is not None else jax.process_index()
+            name = "trace.json" if rank == 0 else f"trace-rank{rank}.json"
+            path = self._own_tracer.export(
+                os.path.join(self.config.trace, name)
+            )
+            logger.info("span trace written to %s", path)
+        except Exception:
+            logger.exception("observability teardown failed (ignored)")
+        finally:
+            if self._own_tracer is not None:
+                self._own_tracer = None
+                tracing.clear()
 
     def _check_preemption(self) -> None:
         """Step-boundary poll: checkpoint and bail out on SIGTERM/SIGINT."""
@@ -861,7 +961,13 @@ class Trainer:
         capped = False
         skip = self._resume_skip_batches
         self._resume_skip_batches = 0
-        for batch in self.train_loader:
+        it = iter(self.train_loader)
+        while True:
+            t_wait = time.perf_counter()
+            with tracing.span("train.data_wait"):
+                batch = next(it, _EPOCH_END)
+            if batch is _EPOCH_END:
+                break
             if (
                 cfg.max_steps_per_epoch
                 and taken >= cfg.max_steps_per_epoch
@@ -871,6 +977,11 @@ class Trainer:
             taken += 1
             if skip > 0:
                 skip -= 1
+                # resume replay: consuming already-trained batches to
+                # reach the checkpointed position is recovery time
+                self._goodput.add(
+                    "recovering", time.perf_counter() - t_wait
+                )
                 continue
             n = self._batch_samples(batch)
             if (
@@ -883,7 +994,14 @@ class Trainer:
                 t_last = time.perf_counter()  # don't bill the measurement
                 # to the first logging window's step-time/MFU numbers
             self._trace_tick()
-            self.state, metrics = self.train_step(self.state, batch)
+            with self._accounted("train.step", "productive"):
+                self.state, metrics = self.train_step(self.state, batch)
+            if tracing.active():
+                # recompile sentinel: the jit cache of a steady-state
+                # step must stop growing after warm-up
+                tracing.note_compiles(
+                    "train.step", jit_cache_size(self.train_step)
+                )
             self.host_step += 1
             step = self.host_step
             if self._watchdog is not None:
@@ -897,11 +1015,16 @@ class Trainer:
                 # donated steps queued unsynced abort the XLA runtime.
                 # A value fetch (not block_until_ready, which the axon
                 # relay backend doesn't honor) drains the queue.
-                host_scalar(jax.tree_util.tree_leaves(metrics)[0])
+                # the drain blocks on queued step execution: productive
+                with self._accounted("train.drain", "productive"):
+                    host_scalar(jax.tree_util.tree_leaves(metrics)[0])
                 steps_since_sync = 0
             if cfg.log_every and step % cfg.log_every == 0:
                 # sync point: pull metrics (blocks on the step's result)
-                metrics = {k: host_scalar(v) for k, v in metrics.items()}
+                with self._accounted("train.metric_fetch", "productive"):
+                    metrics = {
+                        k: host_scalar(v) for k, v in metrics.items()
+                    }
                 self._check_finite(metrics, step)
                 now = time.perf_counter()
                 dt = (now - t_last) / steps_since_log
@@ -933,6 +1056,21 @@ class Trainer:
                     extra = {}
                     if self._step_flops:
                         extra["tflops"] = self._step_flops / dt / 1e12
+                    extra["goodput_pct"] = round(
+                        self._goodput.goodput_pct(), 2
+                    )
+                    if tracing.active():
+                        # device memory gauge at log cadence (never on
+                        # the step path): allocator stats where the
+                        # backend has them, live-array sum otherwise
+                        from pytorch_distributed_tpu.runtime.compat import (
+                            live_buffer_bytes,
+                        )
+
+                        mem = live_buffer_bytes()
+                        if mem is not None:
+                            extra["device_bytes_in_use"] = mem
+                            tracing.counter("device_bytes_in_use", mem)
                     self.metrics_writer.write(
                         step,
                         {**metrics, "samples_per_sec": n / dt,
@@ -969,14 +1107,18 @@ class Trainer:
                     "build_train_step(ema_decay=...)"
                 )
             eval_state = self.state.replace(params=self.state.ema_params)
-        for batch in self.eval_loader:
-            metrics = self.eval_step(eval_state, batch)
-            if self._watchdog is not None:
-                self._watchdog.tick()  # eval progress is progress
-            n = self._batch_samples(batch)
-            for k, v in metrics.items():
-                sums[k] = sums.get(k, 0.0) + host_scalar(v) * n
-            count += n
+        # eval is useful work, not overhead: productive in the goodput
+        # account (its data wait rides along — the per-batch fetch syncs
+        # dominate and already block on compute)
+        with self._accounted("train.eval", "productive", epoch=epoch):
+            for batch in self.eval_loader:
+                metrics = self.eval_step(eval_state, batch)
+                if self._watchdog is not None:
+                    self._watchdog.tick()  # eval progress is progress
+                n = self._batch_samples(batch)
+                for k, v in metrics.items():
+                    sums[k] = sums.get(k, 0.0) + host_scalar(v) * n
+                count += n
         # multi-process mode: each rank saw 1/world of the eval set; sum
         # the weighted sums and counts over the ring so every rank reports
         # full-set metrics (reference DDP evals the full set too)

@@ -52,6 +52,7 @@ class RecipeConfig:
     log_every: int = 50  # doc: steps between metric logs
     profile_dir: Optional[str] = None  # doc: write JAX profiler traces here
     metrics_path: Optional[str] = None  # doc: JSONL scalar metrics log
+    trace_dir: Optional[str] = None  # doc: span-tracer output dir (trace.json + JSONL rollups; runtime/tracing.py)
 
 
 def _field_docs(cls: type) -> dict:

@@ -16,12 +16,12 @@ usage, and collective overlap. This module wraps it with:
 
 from __future__ import annotations
 
-import collections
 import contextlib
-import time
 from typing import Optional
 
 import jax
+
+from pytorch_distributed_tpu.utils.timing import WindowTimer
 
 
 @contextlib.contextmanager
@@ -50,43 +50,20 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
-class StepTimer:
+class StepTimer(WindowTimer):
     """Rolling-window step timer: mean/p50/p95 step time + rate.
+
+    Thin alias over :class:`utils.timing.WindowTimer` — the one windowed
+    timer shared with ``train.metrics.ScalarMeter`` — kept under its
+    original name and call shape. Historical quirk preserved: this
+    class's :meth:`percentile` takes a FRACTION (``0.95``), the shared
+    timer takes a percent (``95``).
 
     Call :meth:`tick` once per step *after* a sync point (metric fetch).
     """
 
-    def __init__(self, window: int = 100):
-        self.times = collections.deque(maxlen=window)
-        self._last: Optional[float] = None
-
-    def tick(self) -> Optional[float]:
-        now = time.perf_counter()
-        dt = None
-        if self._last is not None:
-            dt = now - self._last
-            self.times.append(dt)
-        self._last = now
-        return dt
-
-    def reset(self) -> None:
-        self._last = None
-
-    @property
-    def mean(self) -> float:
-        return sum(self.times) / len(self.times) if self.times else 0.0
-
     def percentile(self, q: float) -> float:
-        if not self.times:
-            return 0.0
-        s = sorted(self.times)
-        i = min(int(q * len(s)), len(s) - 1)
-        return s[i]
-
-    def rate(self, samples_per_step: int) -> float:
-        """Samples/sec over the window."""
-        m = self.mean
-        return samples_per_step / m if m else 0.0
+        return super().percentile(q * 100.0)
 
     def summary(self) -> dict:
         return {

@@ -1,0 +1,94 @@
+"""ONE windowed interval timer + ONE percentile helper.
+
+Before this module the repo had three step-timing/percentile
+implementations that could (and did) drift: ``train/metrics.ScalarMeter``
+(plain mean over a list), ``utils/profiler.StepTimer`` (deque window,
+nearest-rank percentiles), and ``serve/telemetry.ServeTelemetry`` (a
+private ``np.percentile`` path). They now all route through here, so
+"p95 step time" means the same computation wherever it is reported —
+and the observability rollups (runtime/tracing.py) share it too.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import time
+from typing import Iterable, Optional, Sequence
+
+
+def percentile(values: Iterable[float], q: float) -> float:
+    """Linearly-interpolated percentile, ``q`` in [0, 100].
+
+    Matches numpy's default (``interpolation='linear'``) semantics so the
+    serve-telemetry numbers did not move when its private numpy path was
+    replaced — without importing numpy for a 10-element list.
+    """
+    vals = sorted(values)
+    if not vals:
+        return 0.0
+    if not 0.0 <= q <= 100.0:
+        raise ValueError(f"q={q} not in [0, 100]")
+    if len(vals) == 1:
+        return float(vals[0])
+    pos = (len(vals) - 1) * (q / 100.0)
+    lo = int(math.floor(pos))
+    hi = min(lo + 1, len(vals) - 1)
+    frac = pos - lo
+    return float(vals[lo] * (1.0 - frac) + vals[hi] * frac)
+
+
+class WindowTimer:
+    """Rolling window of interval durations: mean / p50 / p95 / p99 / rate.
+
+    Feed it either with :meth:`tick` (interval = time between consecutive
+    calls — the step-loop shape) or :meth:`add` (an explicitly measured
+    duration — the meter shape). ``percentile`` takes ``q`` in [0, 100].
+    """
+
+    def __init__(self, window: int = 100):
+        self.window = window
+        self.times = collections.deque(maxlen=window)
+        self._last: Optional[float] = None
+
+    def tick(self) -> Optional[float]:
+        """Record the interval since the previous tick; returns it."""
+        now = time.perf_counter()
+        dt = None
+        if self._last is not None:
+            dt = now - self._last
+            self.times.append(dt)
+        self._last = now
+        return dt
+
+    def add(self, dt: float) -> None:
+        """Record an externally measured duration (seconds)."""
+        self.times.append(float(dt))
+
+    def reset(self) -> None:
+        self._last = None
+
+    def __len__(self) -> int:
+        return len(self.times)
+
+    @property
+    def mean(self) -> float:
+        return sum(self.times) / len(self.times) if self.times else 0.0
+
+    def percentile(self, q: float) -> float:
+        """Windowed percentile, ``q`` in [0, 100]."""
+        return percentile(self.times, q)
+
+    def rate(self, samples_per_interval: float) -> float:
+        """Samples/sec over the window."""
+        m = self.mean
+        return samples_per_interval / m if m else 0.0
+
+    def summary(self, prefix: str = "step_time_") -> dict:
+        return {
+            f"{prefix}mean_s": self.mean,
+            f"{prefix}p50_s": self.percentile(50),
+            f"{prefix}p95_s": self.percentile(95),
+            f"{prefix}p99_s": self.percentile(99),
+            f"{prefix}count": len(self.times),
+        }

@@ -67,6 +67,11 @@ def parse_args(argv=None):
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=50)
+    p.add_argument("--metrics-path", default=None,
+                   help="JSONL scalar metrics log (rank 0)")
+    p.add_argument("--trace-dir", default=None,
+                   help="span-tracer output dir: Perfetto-loadable "
+                   "trace.json + JSONL rollups (runtime/tracing.py)")
     return p.parse_args(argv)
 
 
@@ -169,6 +174,8 @@ def main(argv=None):
             epochs=args.epochs,
             log_every=args.log_every,
             ckpt_dir=args.ckpt_dir,
+            metrics_path=args.metrics_path,
+            trace=args.trace_dir,
         ),
     )
     trainer.restore_checkpoint()

@@ -228,6 +228,7 @@ def main(argv=None):
             tensorboard_dir=cfg.tensorboard_dir or None,
             eval_with_ema=cfg.ema_decay > 0,
             log_mfu=cfg.log_mfu,
+            trace=cfg.trace_dir,
         ),
     )
     trainer.restore_checkpoint()

@@ -215,6 +215,7 @@ def main(argv=None):
             async_checkpoint=cfg.async_checkpoint,
             metrics_path=cfg.metrics_path,
             tensorboard_dir=cfg.tensorboard_dir or None,
+            trace=cfg.trace_dir,
         ),
     )
     trainer.restore_checkpoint()

@@ -59,7 +59,7 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def _child_cmd(args, ckpt_dir):
+def _child_cmd(args, ckpt_dir, metrics_path):
     return [
         sys.executable, os.path.join(REPO, args.recipe),
         "--synthetic",
@@ -69,6 +69,15 @@ def _child_cmd(args, ckpt_dir):
         "--ckpt-dir", ckpt_dir,
         "--seed", str(args.seed),
         "--log-every", "1",
+        # every attempt appends goodput/step records to ONE stream (the
+        # MetricsWriter opens in append mode), so the drill can account
+        # productive-vs-recovery seconds across kills and restarts
+        "--metrics-path", metrics_path,
+        # arm the span tracer too: the last surviving attempt's
+        # trace.json (atomic export — a killed attempt can't tear it)
+        # plus per-attempt span rollups in the same stream give
+        # scripts/obs_report.py a step-phase breakdown for the drill
+        "--trace-dir", ckpt_dir,
     ]
 
 
@@ -79,10 +88,12 @@ def main(argv=None):
     rng = np.random.default_rng(args.seed)
     ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="chaos_drill_")
     owns_dir = args.ckpt_dir is None
-    cmd = _child_cmd(args, ckpt_dir)
+    metrics_path = os.path.join(ckpt_dir, "drill_metrics.jsonl")
+    cmd = _child_cmd(args, ckpt_dir, metrics_path)
     expected_final = args.epochs * args.steps_per_epoch
     kills_left = args.kills
     print(f"# drill: {' '.join(cmd)}", file=sys.stderr)
+    t_drill0 = time.monotonic()
 
     ok = False
     for attempt in range(1, args.max_attempts + 1):
@@ -135,6 +146,22 @@ def main(argv=None):
     passed = (
         ok and final_step == expected_final and not problems
     )
+    # goodput over the WHOLE drill wall clock: productive seconds come
+    # from the surviving attempts' split="goodput" records (a killed
+    # attempt's unflushed account is honestly lost — undercounting, not
+    # inflating), the denominator charges restart gaps and killed
+    # attempts too. read_metrics tolerates the torn final line the
+    # mode=kill attempts leave behind.
+    from pytorch_distributed_tpu.runtime.tracing import summarize_goodput
+    from pytorch_distributed_tpu.train.metrics import read_metrics
+
+    try:
+        records = read_metrics(metrics_path)
+    except OSError:
+        records = []
+    goodput = summarize_goodput(
+        records, wall_s=time.monotonic() - t_drill0
+    )
     print(json.dumps({
         "drill": "kill_resume",
         "recipe": args.recipe,
@@ -145,6 +172,7 @@ def main(argv=None):
         "expected_final_step": expected_final,
         "verify_problems": problems,
         "post_recovered_tags": recovered,
+        "goodput": goodput,
         "passed": passed,
     }))
     if passed and owns_dir:

@@ -1,0 +1,261 @@
+"""Render a run directory into a human-readable observability report.
+
+Input: what one ``--trace-dir`` / ``TrainerConfig.trace`` run leaves
+behind — a Chrome-format ``trace.json`` (runtime/tracing.py) and/or any
+MetricsWriter JSONL streams (step records with ``goodput_pct``,
+``split="trace"`` span rollups, ``split="goodput"`` accounts,
+``split="serve"`` telemetry). Output: the tables a slow-step
+investigation starts from —
+
+* step-phase breakdown: per-span count / total / mean / p50 / p95 /
+  p99 / max and share of traced wall time,
+* top-N widest individual spans (the outliers percentiles hide),
+* recompile sentinel summary (anything after warm-up is a finding),
+* goodput summary (productive / stalled / recovering / checkpoint /
+  other seconds; buckets sum to wall).
+
+Usage::
+
+    python scripts/obs_report.py RUN_DIR [--top 10]
+    python scripts/obs_report.py --trace trace.json --metrics m.jsonl
+
+Works with either input alone: a chaos-drill dir usually has only the
+JSONL (rollups + goodput), a bench dir maybe only the trace.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pytorch_distributed_tpu.runtime.tracing import summarize_goodput  # noqa: E402
+from pytorch_distributed_tpu.train.metrics import read_metrics  # noqa: E402
+from pytorch_distributed_tpu.utils.timing import percentile  # noqa: E402
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("run_dir", nargs="?", default=None,
+                   help="directory holding trace.json and/or *.jsonl")
+    p.add_argument("--trace", default=None, help="explicit trace.json path")
+    p.add_argument("--metrics", action="append", default=None,
+                   help="explicit metrics JSONL path (repeatable)")
+    p.add_argument("--top", type=int, default=10,
+                   help="how many widest spans to list")
+    return p.parse_args(argv)
+
+
+def _discover(args):
+    trace_path, metric_paths = args.trace, list(args.metrics or [])
+    if args.run_dir:
+        if trace_path is None:
+            cand = os.path.join(args.run_dir, "trace.json")
+            trace_path = cand if os.path.isfile(cand) else None
+        if not metric_paths:
+            metric_paths = sorted(
+                glob.glob(os.path.join(args.run_dir, "*.jsonl"))
+            )
+    return trace_path, metric_paths
+
+
+def load_trace(path):
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):  # bare-array trace_event form
+        return {"traceEvents": doc, "otherData": {}}
+    return doc
+
+
+def span_stats_from_events(events):
+    """Aggregate ``X`` events by name -> duration lists (seconds)."""
+    durs = {}
+    for ev in events:
+        if ev.get("ph") == "X":
+            durs.setdefault(ev["name"], []).append(
+                float(ev.get("dur", 0.0)) / 1e6
+            )
+    return durs
+
+
+def span_stats_from_rollups(records):
+    """Rebuild the breakdown rows from ``split="trace"`` rollup records
+    (the no-trace.json fallback); values are already aggregated."""
+    rows = {}
+    for r in records:
+        if r.get("split") == "trace" and r.get("event") == "span_rollup":
+            rows[r["span"]] = {
+                k: r[k] for k in (
+                    "count", "total_ms", "mean_ms", "p50_ms", "p95_ms",
+                    "p99_ms", "max_ms",
+                ) if k in r
+            }
+    return rows
+
+
+def _fmt_row(cols, widths):
+    return "  ".join(str(c).rjust(w) for c, w in zip(cols, widths))
+
+
+def phase_table(rows, wall_ms):
+    header = ("span", "count", "total_ms", "mean_ms", "p50_ms",
+              "p95_ms", "p99_ms", "max_ms", "%wall")
+    widths = [max(28, *(len(n) for n in rows))] + [8] * 8 if rows else []
+    if not rows:
+        return ["  (no spans)"]
+    out = [_fmt_row(header, widths)]
+    for name in sorted(rows, key=lambda n: -rows[n].get("total_ms", 0.0)):
+        r = rows[name]
+        pct = (
+            100.0 * r.get("total_ms", 0.0) / wall_ms if wall_ms else 0.0
+        )
+        out.append(_fmt_row(
+            (name, int(r.get("count", 0)),
+             f"{r.get('total_ms', 0.0):.1f}",
+             f"{r.get('mean_ms', 0.0):.2f}",
+             f"{r.get('p50_ms', 0.0):.2f}",
+             f"{r.get('p95_ms', 0.0):.2f}",
+             f"{r.get('p99_ms', 0.0):.2f}",
+             f"{r.get('max_ms', 0.0):.2f}",
+             f"{pct:.1f}"),
+            widths,
+        ))
+    return out
+
+
+def report(trace_path, metric_paths, top_n=10, out=sys.stdout):
+    records = []
+    for mp in metric_paths:
+        try:
+            records.extend(read_metrics(mp))
+        except OSError as e:
+            print(f"(metrics {mp} unreadable: {e})", file=out)
+
+    events, other = [], {}
+    if trace_path:
+        try:
+            doc = load_trace(trace_path)
+            events = doc.get("traceEvents", [])
+            other = doc.get("otherData", {}) or {}
+        except (OSError, ValueError) as e:
+            print(f"(trace {trace_path} unreadable: {e})", file=out)
+
+    # -- step-phase breakdown ---------------------------------------------
+    print("== Step-phase breakdown ==", file=out)
+    if events:
+        durs = span_stats_from_events(events)
+        xs = [e for e in events if e.get("ph") == "X"]
+        wall_ms = (
+            (max(e["ts"] + e.get("dur", 0.0) for e in xs)
+             - min(e["ts"] for e in xs)) / 1e3 if xs else 0.0
+        )
+        rows = {
+            name: {
+                "count": len(d),
+                "total_ms": sum(d) * 1e3,
+                "mean_ms": sum(d) / len(d) * 1e3,
+                "p50_ms": percentile(d, 50) * 1e3,
+                "p95_ms": percentile(d, 95) * 1e3,
+                "p99_ms": percentile(d, 99) * 1e3,
+                "max_ms": max(d) * 1e3,
+            }
+            for name, d in durs.items()
+        }
+        src = f"trace: {trace_path}, wall {wall_ms / 1e3:.2f}s"
+    else:
+        rows = span_stats_from_rollups(records)
+        wall_ms = sum(r.get("total_ms", 0.0) for r in rows.values())
+        src = "JSONL span rollups (no trace.json; %wall = share of traced time)"
+    print(f"  source: {src}", file=out)
+    for line in phase_table(rows, wall_ms):
+        print("  " + line, file=out)
+
+    # -- widest spans ------------------------------------------------------
+    if events:
+        print(f"\n== Top {top_n} widest spans ==", file=out)
+        widest = sorted(
+            (e for e in events if e.get("ph") == "X"),
+            key=lambda e: -e.get("dur", 0.0),
+        )[:top_n]
+        for e in widest:
+            args_note = f"  args={e['args']}" if e.get("args") else ""
+            print(
+                f"  {e.get('dur', 0.0) / 1e3:10.2f} ms  {e['name']:<28}"
+                f" @ t={e['ts'] / 1e6:.3f}s tid={e.get('tid')}{args_note}",
+                file=out,
+            )
+
+    # -- recompile sentinel ------------------------------------------------
+    print("\n== Recompiles (after warm-up) ==", file=out)
+    # one event="recompiles" record per attempt (each fit() has a fresh
+    # tracer), so SUM across records; the trace.json duplicates the last
+    # surviving attempt's counts, so merge it by max, not by adding
+    jsonl_rec = {}
+    for r in records:
+        if r.get("split") == "trace" and r.get("event") == "recompiles":
+            for k, v in r.items():
+                if k.startswith("recompiles."):
+                    name = k[len("recompiles."):]
+                    jsonl_rec[name] = jsonl_rec.get(name, 0) + int(v)
+    recompiles = dict(other.get("recompiles") or {})
+    for name, n in jsonl_rec.items():
+        recompiles[name] = max(recompiles.get(name, 0), n)
+    if recompiles:
+        for name, n in sorted(recompiles.items()):
+            print(f"  {name}: {n} steady-state recompile(s)  <-- "
+                  f"INVESTIGATE (silent 100x regression shape)", file=out)
+    else:
+        print("  none — every jitted callable compiled once", file=out)
+
+    # -- goodput -----------------------------------------------------------
+    print("\n== Goodput ==", file=out)
+    g = summarize_goodput(records)
+    if g["attempts_recorded"]:
+        print(
+            f"  goodput {g['goodput_pct']:.1f}% over "
+            f"{g['wall_s']:.1f}s wall ({g['attempts_recorded']} "
+            f"attempt(s) recorded)", file=out,
+        )
+        for k in sorted(k for k in g if k.endswith("_s") and k != "wall_s"):
+            print(f"    {k:<16} {g[k]:10.2f}", file=out)
+    else:
+        print("  no goodput records in the metrics stream", file=out)
+
+    # -- serve telemetry, if present --------------------------------------
+    ttfts = [
+        r["ttft_ms"] for r in records
+        if r.get("split") == "serve" and "ttft_ms" in r
+    ]
+    if ttfts:
+        print("\n== Serve TTFT ==", file=out)
+        print(
+            f"  n={len(ttfts)} p50={percentile(ttfts, 50):.1f}ms "
+            f"p95={percentile(ttfts, 95):.1f}ms "
+            f"p99={percentile(ttfts, 99):.1f}ms", file=out,
+        )
+    return {"spans": rows, "recompiles": recompiles, "goodput": g}
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if not args.run_dir and not args.trace and not args.metrics:
+        print("nothing to report: pass RUN_DIR or --trace/--metrics",
+              file=sys.stderr)
+        return 2
+    trace_path, metric_paths = _discover(args)
+    if not trace_path and not metric_paths:
+        print(f"no trace.json or *.jsonl found under {args.run_dir!r}",
+              file=sys.stderr)
+        return 2
+    report(trace_path, metric_paths, top_n=args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,6 +122,29 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
     assert "serving" in durations, sorted(durations)
     assert durations["serving"] < 300, durations
 
+    # ...and the same numbers must land as DATA: one phase_durations_s
+    # record (the print-only stderr notes were unparseable by the
+    # driver's JSON tail)
+    pd = [
+        json.loads(l) for l in proc.stderr.splitlines()
+        if l.startswith("{")
+        and json.loads(l)["metric"] == "phase_durations_s"
+    ]
+    assert len(pd) == 1, proc.stderr[-2000:]
+    for phase in ("input_pipeline_feed", "serving", "observability"):
+        assert phase in pd[0]["value"], pd[0]
+    assert pd[0]["value"] == pytest.approx(durations, abs=0.2)
+
+    # the observability micro-phase: tracing a hot loop must cost < 2%
+    # vs the untraced loop (the tracer's zero-overhead claim, measured)
+    obs = [
+        json.loads(l) for l in proc.stderr.splitlines()
+        if l.startswith("{")
+        and json.loads(l)["metric"] == "observability_trace_overhead_pct"
+    ]
+    assert len(obs) == 1, proc.stderr[-2000:]
+    assert obs[0]["value"] < 2.0, obs[0]
+
 
 @pytest.mark.slow
 def test_bench_lock_serializes_runs(tmp_path):
