@@ -1087,13 +1087,14 @@ def _hostring_ar_worker(rank: int, world: int, name: str, q) -> None:
         q.put((rank, f"{type(e).__name__}: {e}"))
 
 
-def bench_allreduce_hostring() -> None:
-    """Native shm-ring (gloo-equivalent) allreduce across 4 host procs."""
+def _spawn_ring_workers(world: int, target, timeout: float = 300.0):
+    """Spawn one (rank, world, name, q)-shaped worker per rank on the
+    CPU backend and collect one queue result per rank. Join/terminate
+    runs even when a rank dies without reporting (a native-lib crash
+    would otherwise leave the survivors unjoined behind a queue.Empty)."""
     import multiprocessing as mp
-    import os
     import uuid
 
-    world = 4
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
     name = f"ptdbench_{uuid.uuid4().hex[:8]}"
@@ -1101,7 +1102,7 @@ def bench_allreduce_hostring() -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"  # children must not touch the chip
     try:
         procs = [
-            ctx.Process(target=_hostring_ar_worker, args=(r, world, name, q))
+            ctx.Process(target=target, args=(r, world, name, q))
             for r in range(world)
         ]
         for p in procs:
@@ -1111,11 +1112,19 @@ def bench_allreduce_hostring() -> None:
             os.environ.pop("JAX_PLATFORMS", None)
         else:
             os.environ["JAX_PLATFORMS"] = old
-    results = [q.get(timeout=300) for _ in range(world)]
-    for p in procs:
-        p.join(timeout=30)
-        if p.is_alive():
-            p.terminate()
+    try:
+        return [q.get(timeout=timeout) for _ in range(world)]
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+
+
+def bench_allreduce_hostring() -> None:
+    """Native shm-ring (gloo-equivalent) allreduce across 4 host procs."""
+    world = 4
+    results = _spawn_ring_workers(world, _hostring_ar_worker)
     bad = [r for r in results if not isinstance(r[1], float)]
     if bad:
         raise RuntimeError(f"hostring bench failed: {bad}")
@@ -1154,6 +1163,86 @@ def bench_allreduce_hostring() -> None:
             f"not a floor — slot-granular cache reuse can beat it)",
             "vs_baseline": round(bound_ms / ms, 4),
         }
+    )
+
+
+def _comms_worker(rank: int, world: int, name: str, q) -> None:
+    """Traced f32-vs-q8 allreduce at gradient size: the wire-byte
+    accounting (comm.* spans) is the measurement, not a docstring."""
+    try:
+        from pytorch_distributed_tpu.runtime import hostring, tracing
+
+        n, iters = 1_600_000, 3  # 6.4 MB f32 grads — q8 is ~2x slower
+        # on this shm transport, so the phase stays seconds-scale
+        tracing.configure(None)  # in-memory: the rollups are the output
+        with hostring.HostRingGroup(name, rank, world, timeout_s=120) as g:
+            buf = np.ones(n, np.float32)
+            g.all_reduce(buf, inplace=True)  # warm both paths, then
+            g.all_reduce_q8(np.ones(n, np.float32))  # measure on a
+            tracer = tracing.configure(None)  # fresh tracer window
+            for _ in range(iters):
+                g.all_reduce(buf, inplace=True)
+            for _ in range(iters):
+                g.all_reduce_q8(np.ones(n, np.float32))
+            cum = {
+                op: [int(r["count"]), int(r["bytes_total"]),
+                     r["total_ms"] / 1e3]
+                for op, r in tracer.rollups().items()
+                if op.startswith("comm.all_reduce")
+            }
+        tracing.clear()
+        q.put((rank, cum))
+    except Exception as e:  # reported via queue
+        q.put((rank, f"{type(e).__name__}: {e}"))
+
+
+def bench_comms() -> None:
+    """Wire-level collective accounting: the RECORDED wire bytes of a
+    q8 allreduce vs the f32 allreduce at gradient size, plus achieved
+    bus bandwidth for both, straight from the ``comm.*`` span counters
+    (runtime/hostring.py) over a real 4-process ring. The bytes ratio
+    (~0.254: int8 payload + one f32 scale per 256 elems, same
+    2(n-1)/n algorithmic factor) is ROADMAP item 1's pinned
+    bytes-moved-reduction number — a fact on the wire, not a docstring
+    claim — and the (op, size, seconds) pairs are exactly what the α–β
+    cost model calibrates from."""
+    world = 4
+    results = _spawn_ring_workers(world, _comms_worker)
+    bad = [r for r in results if not isinstance(r[1], dict)]
+    if bad:
+        raise RuntimeError(f"comms bench failed: {bad}")
+    # wire bytes are identical on every rank (same ops, same sizes);
+    # seconds: charge the slowest rank, like the hostring phase
+    cums = {rank: cum for rank, cum in results}
+    f32 = [c["comm.all_reduce"] for c in cums.values()]
+    q8 = [c["comm.all_reduce_q8"] for c in cums.values()]
+    f32_bytes, q8_bytes = f32[0][1], q8[0][1]
+    f32_s = max(c[2] for c in f32)
+    q8_s = max(c[2] for c in q8)
+    ratio = q8_bytes / f32_bytes
+    _emit(
+        {
+            "metric": "comms_q8_wire_bytes_ratio",
+            "value": round(ratio, 4),
+            "unit": f"q8/f32 recorded wire bytes, {f32[0][0]}x6.4MB-grad "
+            f"allreduce over a 4-proc hostring (int8 + one f32 scale "
+            f"per 256 elems; ~0.254 expected)",
+            "vs_baseline": None,
+            "f32_busbw_gbps": round(f32_bytes / f32_s / 1e9, 3),
+            "q8_busbw_gbps": round(q8_bytes / q8_s / 1e9, 3),
+            "f32_ms_per_call": round(f32_s / f32[0][0] * 1e3, 3),
+            "q8_ms_per_call": round(q8_s / q8[0][0] * 1e3, 3),
+            "world": world,
+        }
+    )
+    print(
+        f"# comms: q8/f32 wire bytes {ratio:.4f} "
+        f"(f32 {f32_bytes / 1e6:.1f}MB @ {f32_bytes / f32_s / 1e9:.2f} "
+        f"GB/s, q8 {q8_bytes / 1e6:.1f}MB @ {q8_bytes / q8_s / 1e9:.2f} "
+        f"GB/s busbw; q8 {q8_s / q8[0][0] * 1e3:.1f}ms/call vs f32 "
+        f"{f32_s / f32[0][0] * 1e3:.1f}ms/call — byte savings pay on "
+        f"network transports, not this memcpy)",
+        file=sys.stderr,
     )
 
 
@@ -1278,6 +1367,9 @@ def main():
         run_if_budget("input_pipeline_u8_e2e", bench_u8_e2e_smoke)
         run_if_budget("checkpoint", bench_checkpoint, False)
         run_if_budget("allreduce_hostring", bench_allreduce_hostring)
+        # wire-level accounting is host-side truth on any platform: the
+        # recorded q8-vs-f32 bytes ratio is a property of the encoding
+        run_if_budget("comms", bench_comms)
         # serving is RELATIVE (engine vs sequential on the same box), so
         # unlike the suppressed absolute consumption metrics it stays
         # honest on a CPU — the ratio is the claim, the unit says the
@@ -1295,6 +1387,7 @@ def main():
         else:
             run_if_budget("dp_step_overhead", bench_dp_step_overhead, on_tpu)
         run_if_budget("allreduce_hostring", bench_allreduce_hostring)
+        run_if_budget("comms", bench_comms)
         # LAST: the transformer compiles are the largest on the axon
         # remote-compile path (>10 min cold); if one wedges, every metric
         # above has already been emitted

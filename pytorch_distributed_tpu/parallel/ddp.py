@@ -55,6 +55,22 @@ def no_sync():
 _COMPRESS_DTYPES = {"bf16": "bfloat16", "bfloat16": "bfloat16",
                     "fp16": "float16", "float16": "float16"}
 
+#: float leaves below this element count coalesce into one flat
+#: allreduce per wire dtype (also the q8 path's exact-f32 threshold —
+#: one number, one meaning)
+_COALESCE_MAX_ELEMS = 4096
+
+#: wire dtypes eligible for coalescing: the ring's native float set
+#: (halves ship 2-byte and accumulate f32 — native/hostring.cpp)
+_COALESCE_DTYPES = [np.dtype(np.float32), np.dtype(np.float64),
+                    np.dtype(np.float16)]
+try:
+    import ml_dtypes as _ml_dtypes
+
+    _COALESCE_DTYPES.append(np.dtype(_ml_dtypes.bfloat16))
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    pass
+
 
 def sync_grads(grads, compress: str | None = None):
     """Average gradient pytree across ranks (no-op unless multi-process).
@@ -74,11 +90,32 @@ def sync_grads(grads, compress: str | None = None):
     EQuARX-style block quantization in the ring itself (~4x fewer bytes,
     one f32 scale per 256 elements, f32 accumulation); leaves too small
     to amortize the scales (< 4096 elems) go exact-f32.
+
+    Sub-4096-element float leaves are COALESCED — grouped by their
+    on-the-wire dtype (after any ``compress`` cast, so bf16-compressed
+    runs coalesce too) into one flat allreduce per dtype: a
+    transformer's dozens of tiny bias/norm leaves each paid the ring's
+    full barrier cadence; one collective now moves them all (the
+    ``comm.all_reduce`` span counts prove the drop). Per-element
+    reduction semantics are unchanged — the ring reduces element-wise
+    (halves still accumulate in f32 and round once) — but an element's
+    position picks which rank's segment accumulates it, so the
+    summation ORDER can rotate: bit-identical to per-leaf at world 2
+    (two-operand fp addition commutes), last-ulp differences possible
+    at world > 2. Cross-rank bit-identity (the DDP invariant) holds
+    regardless. The whole callback runs under a ``comm.sync_grads``
+    span recording leaf count and pre-/post-compression wire bytes
+    when tracing is armed.
     """
     import jax.numpy as jnp
     from jax.experimental import io_callback
 
     from pytorch_distributed_tpu.runtime import distributed as dist
+    from pytorch_distributed_tpu.runtime import tracing
+    from pytorch_distributed_tpu.runtime.hostring import (
+        algo_wire_bytes,
+        q8_wire_payload,
+    )
 
     ring = dist.multiprocess_ring()
     if ring is None or ring.world_size == 1:
@@ -86,6 +123,12 @@ def sync_grads(grads, compress: str | None = None):
     leaves, treedef = tree_util.tree_flatten(grads)
     if not leaves:
         return grads
+    n_leaves = len(leaves)
+    pre_bytes = sum(
+        int(np.prod(np.shape(l), dtype=np.int64))
+        * jnp.dtype(l.dtype).itemsize
+        for l in leaves
+    )
     orig_dtypes = None
     quantize = False
     if compress == "int8":
@@ -102,21 +145,91 @@ def sync_grads(grads, compress: str | None = None):
             l.astype(cdt) if l.dtype in (jnp.float32, jnp.float64) else l
             for l in leaves
         ]
-    shapes = tuple(
-        jax.ShapeDtypeStruct(np.shape(l), l.dtype) for l in leaves
+
+    sizes = [int(np.prod(np.shape(l), dtype=np.int64)) for l in leaves]
+    # group small float leaves by their ON-THE-WIRE dtype (post any
+    # compress cast, so bf16-compressed runs coalesce too); a group
+    # needs >= 2 members to be worth a concatenate
+    by_dtype: dict = {}
+    for i, l in enumerate(leaves):
+        if sizes[i] < _COALESCE_MAX_ELEMS and any(
+            l.dtype == d for d in _COALESCE_DTYPES
+        ):
+            by_dtype.setdefault(np.dtype(l.dtype).name, []).append(i)
+    groups = [
+        idxs for _, idxs in sorted(by_dtype.items())
+        if len(idxs) >= 2
+    ]
+    coalesced = {i for g in groups for i in g}
+    solo = [i for i in range(n_leaves) if i not in coalesced]
+    flats = [
+        jnp.concatenate([leaves[i].reshape(-1) for i in g])
+        for g in groups
+    ]
+    ship = [leaves[i] for i in solo] + flats
+    # flat buffers stay exact (never q8, even when >= 4096 elems): they
+    # replace leaves the q8 path already kept exact — too small to
+    # amortize the block scales
+    q_flags = tuple(
+        quantize and leaves[i].dtype == jnp.float32
+        and sizes[i] >= _COALESCE_MAX_ELEMS
+        for i in solo
+    ) + (False,) * len(flats)
+    ship_shapes = tuple(
+        jax.ShapeDtypeStruct(np.shape(l), l.dtype) for l in ship
     )
+    wire_bytes = sum(
+        algo_wire_bytes(
+            "all_reduce_q8" if qf else "all_reduce",
+            q8_wire_payload(int(np.prod(s.shape, dtype=np.int64)))
+            if qf else int(np.prod(s.shape, dtype=np.int64))
+            * np.dtype(s.dtype).itemsize,
+            ring.world_size,
+        )
+        for s, qf in zip(ship_shapes, q_flags)
+    )
+    span_args = {
+        "leaves": n_leaves,
+        "collectives": len(ship),
+        "coalesced_leaves": len(coalesced),
+        "pre_bytes": int(pre_bytes),
+        "wire_bytes": int(wire_bytes),
+        "world": ring.world_size,
+    }
 
     def _allreduce_all(*arrs):
-        out = []
-        for a in arrs:
-            a = np.asarray(a)
-            if quantize and a.dtype == np.float32 and a.size >= 4096:
-                out.append(ring.all_reduce_q8(a, op="avg"))
-            else:
-                out.append(ring.all_reduce(a, op="avg"))
-        return tuple(out)
+        tr = tracing._tracer
+        span = (
+            tracing._NULL_SPAN if tr is None
+            else tracing._Span(tr, "comm.sync_grads", span_args)
+        )
+        with span:
+            out = []
+            for a, qf in zip(arrs, q_flags):
+                a = np.asarray(a)
+                if qf:
+                    out.append(ring.all_reduce_q8(a, op="avg"))
+                else:
+                    out.append(ring.all_reduce(a, op="avg"))
+            return tuple(out)
 
-    synced = io_callback(_allreduce_all, shapes, *leaves, ordered=True)
+    shipped = io_callback(
+        _allreduce_all, ship_shapes, *ship, ordered=True
+    )
+    if coalesced:
+        synced = [None] * n_leaves
+        for j, i in enumerate(solo):
+            synced[i] = shipped[j]
+        for k, g in enumerate(groups):
+            flat_synced, off = shipped[len(solo) + k], 0
+            for i in g:
+                synced[i] = flat_synced[off:off + sizes[i]].reshape(
+                    np.shape(leaves[i])
+                )
+                off += sizes[i]
+        synced = tuple(synced)
+    else:
+        synced = shipped
     if orig_dtypes is not None:
         synced = tuple(
             s.astype(d) if s.dtype != d else s

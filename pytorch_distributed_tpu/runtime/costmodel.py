@@ -1,0 +1,261 @@
+"""Calibrated α–β cost model for collectives.
+
+The auto-parallel planner (ROADMAP open item 4, AMP-style strategy
+search) needs to PRICE a candidate sharding before running it, which
+needs a transport model calibrated from this machine's own measurements
+rather than folklore constants. The classic α–β model is exactly that:
+
+    time(op, payload, world) = α  +  β · wire_bytes(op, payload, world)
+
+with α the per-call latency floor (barriers, dispatch, rendezvous) and
+β the per-byte cost of the transport. ``wire_bytes`` is the
+NCCL-convention algorithmic bytes per participant
+(``runtime/hostring.algo_wire_bytes``), so one β is comparable across
+collectives and the fitted models compose with the ``comm.*`` span
+accounting — the bytes the tracer records are the bytes the model
+prices.
+
+Calibration sources, in order of fidelity:
+
+* ``scripts/collective_bench.py --fit`` — a live size sweep on the
+  current mesh, written to ``costmodel.json``;
+* past ``--metrics-path`` JSONL records (``split="comm_bench"``) via
+  :func:`fit_from_metrics` — bench history becomes a model without
+  re-running anything.
+
+Fits are per (op, world_size): α genuinely varies with the participant
+count (a ring pays world barrier phases), so folding worlds together
+would smear it. ``predict`` at an unbenched world reuses the nearest
+fitted world's β (a per-byte property of the transport) and scales its
+α by the barrier-phase ratio ``(w-1)/(w_fit-1)`` — flagged as
+``extrapolated`` in the result, because honesty about model reach is
+the difference between a planner and a guesser.
+
+This module is deliberately jax-free (a planner or report tool must be
+able to load a costmodel.json without a runtime).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from pytorch_distributed_tpu.runtime.hostring import algo_wire_bytes
+
+#: current costmodel.json schema version
+FORMAT_VERSION = 1
+
+
+@dataclasses.dataclass
+class OpFit:
+    """One collective's fitted α–β line at one world size."""
+
+    op: str
+    world_size: int
+    alpha_s: float  # per-call latency floor (seconds)
+    beta_s_per_byte: float  # per-wire-byte cost (seconds/byte)
+    r2: float  # goodness of fit on the calibration points
+    n_samples: int
+    wire_bytes_min: int  # calibrated range: predictions outside it
+    wire_bytes_max: int  # are extrapolations
+
+    @property
+    def bandwidth_gb_s(self) -> float:
+        """The β term as an achievable-bandwidth number (GB/s)."""
+        return (
+            1.0 / self.beta_s_per_byte / 1e9
+            if self.beta_s_per_byte > 0 else float("inf")
+        )
+
+
+@dataclasses.dataclass
+class Prediction:
+    seconds: float
+    wire_bytes: int
+    fit: OpFit
+    extrapolated: bool  # off the calibrated (op, world, size) range
+
+
+class CostModel:
+    """A set of per-(op, world) α–β fits for one transport."""
+
+    def __init__(self, transport: str,
+                 fits: Optional[Dict[Tuple[str, int], OpFit]] = None):
+        self.transport = transport
+        self.fits: Dict[Tuple[str, int], OpFit] = dict(fits or {})
+
+    def ops(self) -> List[str]:
+        return sorted({op for op, _ in self.fits})
+
+    def predict(self, op: str, nbytes: int,
+                world_size: int) -> Prediction:
+        """Predicted seconds for ``op`` moving a ``nbytes`` payload over
+        ``world_size`` participants (payload per the NCCL conventions of
+        ``algo_wire_bytes``). Raises ``KeyError`` for an op the model
+        was never calibrated on — a planner must know what it cannot
+        price."""
+        worlds = sorted(w for o, w in self.fits if o == op)
+        if not worlds:
+            raise KeyError(
+                f"cost model ({self.transport}) has no fit for {op!r}; "
+                f"calibrated ops: {self.ops()}"
+            )
+        wire = algo_wire_bytes(op, nbytes, world_size)
+        if world_size in worlds:
+            fit = self.fits[(op, world_size)]
+            alpha = fit.alpha_s
+            extrapolated = False
+        else:  # nearest calibrated world: β carries over, α scales with
+            # the number of barrier phases a ring pays (~world - 1)
+            nearest = min(worlds, key=lambda w: abs(w - world_size))
+            fit = self.fits[(op, nearest)]
+            alpha = fit.alpha_s * max(world_size - 1, 0) / max(
+                nearest - 1, 1
+            )
+            extrapolated = True
+        if not fit.wire_bytes_min <= wire <= fit.wire_bytes_max:
+            extrapolated = True
+        return Prediction(
+            seconds=alpha + fit.beta_s_per_byte * wire,
+            wire_bytes=wire, fit=fit, extrapolated=extrapolated,
+        )
+
+    # -- persistence -------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "format_version": FORMAT_VERSION,
+            "transport": self.transport,
+            "fits": [dataclasses.asdict(f) for f in self.fits.values()],
+        }
+
+    def save(self, path: str) -> str:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+        os.replace(tmp, path)  # atomic: a killed writer leaves no torn model
+        return path
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "CostModel":
+        if doc.get("format_version") != FORMAT_VERSION:
+            raise ValueError(
+                f"costmodel format {doc.get('format_version')!r} != "
+                f"{FORMAT_VERSION} — refit rather than misread"
+            )
+        fits = {}
+        for fd in doc["fits"]:
+            f = OpFit(**fd)
+            fits[(f.op, f.world_size)] = f
+        return cls(doc["transport"], fits)
+
+    @classmethod
+    def load(cls, path: str) -> "CostModel":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+
+def _fit_line(xs: List[float], ys: List[float]) -> Tuple[float, float, float]:
+    """Least-squares y = α + βx with both clamped non-negative (a
+    transport cannot have negative latency or negative per-byte cost;
+    a tiny-noise fit CAN produce either). Returns (α, β, r²) with r²
+    computed on the clamped line — the honesty metric reflects the
+    model actually shipped."""
+    n = len(xs)
+    mx = sum(xs) / n
+    my = sum(ys) / n
+    var = sum((x - mx) ** 2 for x in xs)
+    if var > 0:
+        beta = sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / var
+    else:  # one distinct size: all bytes, no intercept information
+        beta = my / mx if mx > 0 else 0.0
+    beta = max(beta, 0.0)
+    alpha = max(my - beta * mx, 0.0)
+    ss_res = sum((y - (alpha + beta * x)) ** 2 for x, y in zip(xs, ys))
+    ss_tot = sum((y - my) ** 2 for y in ys)
+    r2 = 1.0 - ss_res / ss_tot if ss_tot > 0 else (
+        1.0 if ss_res == 0 else 0.0
+    )
+    return alpha, beta, r2
+
+
+def fit(records: Iterable[dict], transport: str) -> CostModel:
+    """Fit a :class:`CostModel` from measurement records.
+
+    Each record needs ``op``, ``payload_bytes``, ``world``, and
+    ``seconds`` (one timed collective at one size — exactly what
+    ``collective_bench --metrics-path`` writes and what the bench's
+    in-memory sweep holds). Records with non-positive wire bytes (one
+    participant, a barrier) are skipped: there is no line to fit
+    through zero-byte points.
+    """
+    groups: Dict[Tuple[str, int], List[Tuple[float, float]]] = {}
+    for r in records:
+        op, world = str(r["op"]), int(r["world"])
+        wire = algo_wire_bytes(op, int(r["payload_bytes"]), world)
+        if wire <= 0:
+            continue
+        groups.setdefault((op, world), []).append(
+            (float(wire), float(r["seconds"]))
+        )
+    fits: Dict[Tuple[str, int], OpFit] = {}
+    for (op, world), pts in groups.items():
+        xs = [p[0] for p in pts]
+        ys = [p[1] for p in pts]
+        alpha, beta, r2 = _fit_line(xs, ys)
+        fits[(op, world)] = OpFit(
+            op=op, world_size=world, alpha_s=alpha,
+            beta_s_per_byte=beta, r2=r2, n_samples=len(pts),
+            wire_bytes_min=int(min(xs)), wire_bytes_max=int(max(xs)),
+        )
+    return CostModel(transport, fits)
+
+
+def fit_from_metrics(records: Iterable[dict],
+                     transport: Optional[str] = None) -> CostModel:
+    """Fit from a MetricsWriter JSONL stream (``train.metrics
+    .read_metrics`` output): consumes the ``split="comm_bench"``
+    records ``collective_bench --metrics-path`` writes, so bench
+    history calibrates a model without re-running the sweep."""
+    rows = [
+        r for r in records
+        if r.get("split") == "comm_bench" and r.get("event") == "collective"
+    ]
+    if transport is None:
+        transports = {r.get("transport") for r in rows} - {None}
+        if len(transports) > 1:
+            raise ValueError(
+                f"records span transports {sorted(transports)}; pass "
+                "transport= to pick one — mixing them would average "
+                "a memcpy with a network"
+            )
+        transport = next(iter(transports), "unknown")
+    return fit(
+        [r for r in rows if r.get("transport", transport) == transport],
+        transport,
+    )
+
+
+def validate(model: CostModel, records: Iterable[dict]) -> Dict[str, float]:
+    """Max |predicted/measured| ratio-error per op over ``records``
+    (same schema as :func:`fit`) — the "within 2x" acceptance number.
+    Returns ``{op: max(pred/meas, meas/pred)}``."""
+    worst: Dict[str, float] = {}
+    for r in records:
+        op, world = str(r["op"]), int(r["world"])
+        if algo_wire_bytes(op, int(r["payload_bytes"]), world) <= 0:
+            continue
+        try:
+            pred = model.predict(op, int(r["payload_bytes"]), world)
+        except KeyError:
+            continue
+        meas = float(r["seconds"])
+        if meas <= 0 or pred.seconds <= 0:
+            ratio = math.inf
+        else:
+            ratio = max(pred.seconds / meas, meas / pred.seconds)
+        worst[op] = max(worst.get(op, 0.0), ratio)
+    return worst

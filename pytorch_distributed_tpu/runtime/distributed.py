@@ -145,8 +145,14 @@ def init_process_group(
         global _INIT_GENERATION
         _INIT_GENERATION += 1
         ring_name = f"{group_name}_g{_INIT_GENERATION}"
+        # clock_sync: the WORLD ring measures per-rank wall-clock offsets
+        # at init (barrier handshake) and stamps them into the trace
+        # metadata so scripts/trace_merge.py can align per-rank
+        # timelines. Subgroups skip it — their ranks are renumbered and
+        # the world's offsets already cover every process.
         ring = HostRingGroup(
             ring_name, rank, world_size, timeout_s=timeout_s,
+            clock_sync=True,
         )
         # Each rank still gets a local 1-device mesh so jit/sharding code
         # paths work unchanged within the rank.

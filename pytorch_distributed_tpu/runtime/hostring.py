@@ -15,9 +15,14 @@ from __future__ import annotations
 
 import ctypes
 import os
+import time
 from typing import Optional
 
 import numpy as np
+
+# tracing is deliberately jax-free too, so instrumenting the collectives
+# keeps this module importable from spawned workers without a TPU runtime
+from pytorch_distributed_tpu.runtime import tracing
 
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
@@ -146,6 +151,119 @@ def unlink_segment(name: str) -> None:
             pass
 
 
+# --------------------------------------------------------------------------
+# Wire-byte accounting (the ``comm.*`` observability spans).
+#
+# Conventions follow NCCL-tests so numbers are comparable to GPU rigs and
+# to scripts/collective_bench.py's busbw lines. ``payload`` is the op's
+# FULL data size: the local tensor for all_reduce/broadcast/send/recv, the
+# gathered output for all_gather, the [world, ...] input for
+# reduce_scatter. ``algo_wire_bytes`` is the per-participant bytes a
+# bandwidth-optimal ring moves for that payload — what "bytes on the wire"
+# means everywhere in this repo (spans, rollups, the cost model).
+# --------------------------------------------------------------------------
+
+#: elements per q8 scale block — must match kQBlock in native/hostring.cpp
+Q8_BLOCK = 256
+
+
+def q8_wire_payload(n_elems: int) -> int:
+    """Bytes one rank's q8-quantized f32 payload occupies on the wire:
+    one int8 per element plus one f32 scale per 256-element block — the
+    REAL bytes `hr_allreduce_q8` ships (~0.254x the f32 payload at
+    >= 4096 elements), so the ~4x reduction is a recorded fact."""
+    return int(n_elems) + 4 * ((int(n_elems) + Q8_BLOCK - 1) // Q8_BLOCK)
+
+
+def algo_wire_bytes(kind: str, payload_bytes: int, world: int) -> int:
+    """NCCL-convention algorithmic bytes moved per participant.
+
+    all_reduce 2(n-1)/n x payload; all_gather / reduce_scatter
+    (n-1)/n x payload; broadcast / send / recv / permute: payload;
+    barrier: 0. A one-rank world moves nothing.
+    """
+    payload_bytes, world = int(payload_bytes), int(world)
+    if world <= 1:
+        return 0
+    if kind in ("all_reduce", "all_reduce_q8"):
+        return 2 * (world - 1) * payload_bytes // world
+    if kind in ("all_gather", "reduce_scatter"):
+        return (world - 1) * payload_bytes // world
+    if kind in ("broadcast", "send", "recv", "permute"):
+        return payload_bytes
+    if kind == "barrier":
+        return 0
+    raise ValueError(f"unknown collective kind {kind!r}")
+
+
+#: cumulative per-op accounting, fed to the Chrome ``counter()`` tracks:
+#: span name -> [calls, wire_bytes_moved, seconds]. Module-level so a
+#: whole process's rings share one set of tracks (torch's comms logger
+#: shape); armed-only — disarmed collectives never touch it — and
+#: scoped to ONE tracer: a re-armed window starts from zero rather
+#: than exporting the previous window's totals.
+_COMM_CUM: dict = {}
+_COMM_CUM_OWNER = None  # the Tracer the running totals belong to
+
+
+def reset_comm_counters() -> None:
+    """Zero the cumulative ``comm.<op>.*`` counter tracks — for callers
+    measuring a window narrower than the tracer's lifetime (bench.py's
+    comms phase: warm-up calls must not pollute the measured totals)."""
+    global _COMM_CUM_OWNER
+    _COMM_CUM.clear()
+    _COMM_CUM_OWNER = None
+
+
+class _CommSpan:
+    """Armed-only span around one collective: records the ``comm.*``
+    trace event (op/dtype/count/payload/wire bytes) and advances the
+    cumulative per-op counter tracks on exit."""
+
+    __slots__ = ("_t", "_name", "_args", "_t0")
+
+    def __init__(self, t, name: str, args: dict):
+        self._t = t
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        self._t0 = self._t._clock()
+        return self
+
+    def __exit__(self, *exc):
+        global _COMM_CUM_OWNER
+        t1 = self._t._clock()
+        self._t.complete(self._name, self._args, self._t0, t1)
+        if _COMM_CUM_OWNER is not self._t:  # fresh tracer, fresh totals
+            _COMM_CUM.clear()
+            _COMM_CUM_OWNER = self._t
+        cum = _COMM_CUM.setdefault(self._name, [0, 0, 0.0])
+        cum[0] += 1
+        cum[1] += self._args["wire_bytes"]
+        cum[2] += t1 - self._t0
+        self._t.counter(self._name + ".calls", cum[0])
+        self._t.counter(self._name + ".bytes_moved", cum[1])
+        self._t.counter(self._name + ".seconds", round(cum[2], 6))
+        return False
+
+
+def _comm_span(tracer, kind: str, op: str, count: int, dtype,
+               payload_bytes: int, world: int):
+    """Build the armed comm span. Call sites gate on the module-global
+    ``tracing._tracer is None`` test FIRST (the faults.py discipline), so
+    the disarmed path never reaches this function — no arg evaluation,
+    no dict build, nothing but the is-None test and the shared no-op."""
+    return _CommSpan(tracer, "comm." + kind, {
+        "op": op,
+        "dtype": str(dtype),
+        "count": int(count),
+        "payload_bytes": int(payload_bytes),
+        "wire_bytes": algo_wire_bytes(kind, payload_bytes, world),
+        "world": world,
+    })
+
+
 class HostRingGroup:
     """One process's membership in a shared-memory collectives group."""
 
@@ -158,6 +276,7 @@ class HostRingGroup:
         slot_bytes: int = 4 << 20,
         timeout_s: float = 120.0,
         debug: Optional[bool] = None,
+        clock_sync: bool = False,
     ):
         lib = _load()
         handle = ctypes.c_void_p()
@@ -180,6 +299,53 @@ class HostRingGroup:
                 "PTD_DISTRIBUTED_DEBUG", ""
             ).upper() == "DETAIL"
         self.debug = debug
+        #: this rank's wall-clock offset vs rank 0 (seconds); measured by
+        #: the barrier handshake below when ``clock_sync=True`` (the WORLD
+        #: ring — subgroups skip it, their ranks are renumbered)
+        self.clock_offset_s = 0.0
+        self.clock_offsets_s = [0.0] * world_size
+        self._clock_synced = bool(clock_sync) and world_size > 1
+        if self._clock_synced:
+            self._measure_clock_offsets()
+
+    def _measure_clock_offsets(self, rounds: int = 5) -> None:
+        """Barrier-based clock handshake: every rank reads ``time.time()``
+        immediately after a shared barrier release and allgathers the
+        readings; rank r's offset is the per-round median of
+        ``t_r - t_0``. On one host the clocks are literally the same, so
+        the offsets bound the barrier-exit jitter (~us-ms here) — the
+        alignment error budget ``scripts/trace_merge.py`` inherits. The
+        readings ride raw lib calls so the handshake itself never lands
+        on the ``comm.*`` tracks. Stamped into the trace metadata
+        (:func:`tracing.set_meta`) at init AND at :meth:`close`, so a
+        tracer armed between the two still exports aligned ranks.
+        """
+        lib = _load()
+        offsets = np.empty((rounds, self.world_size), np.float64)
+        t = np.empty(1, np.float64)
+        out = np.empty((self.world_size, 1), np.float64)
+        for i in range(rounds):
+            _check(lib.hr_barrier(self._h), "clock-sync barrier")
+            t[0] = time.time()
+            rc = lib.hr_allgather(
+                self._h, t.ctypes.data_as(ctypes.c_void_p),
+                out.ctypes.data_as(ctypes.c_void_p), 1,
+                _DTYPES[np.dtype(np.float64)],
+            )
+            _check(rc, "clock-sync allgather")
+            offsets[i] = out[:, 0] - out[0, 0]
+        per_rank = np.median(offsets, axis=0)
+        self.clock_offsets_s = [float(o) for o in per_rank]
+        self.clock_offset_s = self.clock_offsets_s[self.rank]
+        self._stamp_clock_meta()
+
+    def _stamp_clock_meta(self) -> None:
+        tracing.set_meta(
+            rank=self.rank,
+            world_size=self.world_size,
+            clock_offset_s=self.clock_offset_s,
+            clock_offsets_s=self.clock_offsets_s,
+        )
 
     _FP_BYTES = 96
 
@@ -205,7 +371,18 @@ class HostRingGroup:
             )
 
     def barrier(self) -> None:
-        _check(_load().hr_barrier(self._h), "barrier")
+        if self.debug:
+            # a rank calling barrier() while peers issue a data collective
+            # used to hang until the group deadline; the fingerprint
+            # allgather meets the peers' _verify_uniform allgather and
+            # both sides raise naming the divergent rank instead
+            self._verify_uniform("barrier", np.zeros(0, np.uint8))
+        tr = tracing._tracer
+        span = tracing._NULL_SPAN if tr is None else _comm_span(
+            tr, "barrier", "", 0, "", 0, self.world_size
+        )
+        with span:
+            _check(_load().hr_barrier(self._h), "barrier")
 
     def all_reduce(self, x, op: str = "sum", *, inplace: bool = False) -> np.ndarray:
         """``inplace=True`` reduces directly into ``x`` (torch
@@ -232,11 +409,17 @@ class HostRingGroup:
         # floats average natively (divide-then-round in the C f32
         # accumulator); integers sum natively and floor-divide here
         int_avg = op == "avg" and a.dtype.kind in "iu"
-        rc = _load().hr_allreduce(
-            self._h, a.ctypes.data_as(ctypes.c_void_p), a.size,
-            _DTYPES[a.dtype], _OPS["sum" if int_avg else op],
+        tr = tracing._tracer
+        span = tracing._NULL_SPAN if tr is None else _comm_span(
+            tr, "all_reduce", op, a.size, a.dtype, a.nbytes,
+            self.world_size,
         )
-        _check(rc, "all_reduce")
+        with span:
+            rc = _load().hr_allreduce(
+                self._h, a.ctypes.data_as(ctypes.c_void_p), a.size,
+                _DTYPES[a.dtype], _OPS["sum" if int_avg else op],
+            )
+            _check(rc, "all_reduce")
         if int_avg:
             a //= self.world_size
         return a
@@ -263,11 +446,29 @@ class HostRingGroup:
         a = np.ascontiguousarray(x, dtype=np.float32).copy()
         if self.debug:
             self._verify_uniform("all_reduce_q8", a, op)
-        rc = _load().hr_allreduce_q8(
-            self._h, a.ctypes.data_as(ctypes.c_void_p), a.size,
-            _OPS[op],
+        tr = tracing._tracer
+        # payload = the REAL wire occupancy of the quantized form (int8 +
+        # one f32 scale per 256-elem block), NOT the f32 nbytes — the
+        # recorded wire_bytes prove the ~4x reduction; f32_bytes rides
+        # along so the ratio is computable from one span
+        span = tracing._NULL_SPAN if tr is None else _CommSpan(
+            tr, "comm.all_reduce_q8", {
+                "op": op, "dtype": "float32(q8)", "count": int(a.size),
+                "payload_bytes": q8_wire_payload(a.size),
+                "f32_bytes": int(a.nbytes),
+                "wire_bytes": algo_wire_bytes(
+                    "all_reduce_q8", q8_wire_payload(a.size),
+                    self.world_size,
+                ),
+                "world": self.world_size,
+            },
         )
-        _check(rc, "all_reduce_q8")
+        with span:
+            rc = _load().hr_allreduce_q8(
+                self._h, a.ctypes.data_as(ctypes.c_void_p), a.size,
+                _OPS[op],
+            )
+            _check(rc, "all_reduce_q8")
         return a
 
     def all_gather(self, x) -> np.ndarray:
@@ -279,11 +480,17 @@ class HostRingGroup:
             count, dt = a.size, _DTYPES[a.dtype]
         else:  # any other dtype gathers as raw bytes
             count, dt = a.nbytes, _U8
-        rc = _load().hr_allgather(
-            self._h, a.ctypes.data_as(ctypes.c_void_p),
-            out.ctypes.data_as(ctypes.c_void_p), count, dt,
+        tr = tracing._tracer
+        span = tracing._NULL_SPAN if tr is None else _comm_span(
+            tr, "all_gather", "", a.size, a.dtype, out.nbytes,
+            self.world_size,
         )
-        _check(rc, "all_gather")
+        with span:
+            rc = _load().hr_allgather(
+                self._h, a.ctypes.data_as(ctypes.c_void_p),
+                out.ctypes.data_as(ctypes.c_void_p), count, dt,
+            )
+            _check(rc, "all_gather")
         return out
 
     def reduce_scatter(self, x, op: str = "sum") -> np.ndarray:
@@ -302,22 +509,34 @@ class HostRingGroup:
             self._verify_uniform("reduce_scatter", a, op)
         out = np.empty(a.shape[1:], a.dtype)
         chunk = int(np.prod(a.shape[1:], dtype=np.int64))
-        rc = _load().hr_reduce_scatter(
-            self._h, a.ctypes.data_as(ctypes.c_void_p),
-            out.ctypes.data_as(ctypes.c_void_p), chunk, _DTYPES[a.dtype],
-            _OPS[op],
+        tr = tracing._tracer
+        span = tracing._NULL_SPAN if tr is None else _comm_span(
+            tr, "reduce_scatter", op, a.size, a.dtype, a.nbytes,
+            self.world_size,
         )
-        _check(rc, "reduce_scatter")
+        with span:
+            rc = _load().hr_reduce_scatter(
+                self._h, a.ctypes.data_as(ctypes.c_void_p),
+                out.ctypes.data_as(ctypes.c_void_p), chunk,
+                _DTYPES[a.dtype], _OPS[op],
+            )
+            _check(rc, "reduce_scatter")
         return out.astype(half) if half is not None else out
 
     def broadcast(self, x, src: int = 0) -> np.ndarray:
         a = _as_contig(x, dtype_required=False).copy()
         if self.debug:
             self._verify_uniform("broadcast", a, str(src))
-        rc = _load().hr_broadcast(
-            self._h, a.ctypes.data_as(ctypes.c_void_p), a.nbytes, src
+        tr = tracing._tracer
+        span = tracing._NULL_SPAN if tr is None else _comm_span(
+            tr, "broadcast", str(src), a.size, a.dtype, a.nbytes,
+            self.world_size,
         )
-        _check(rc, "broadcast")
+        with span:
+            rc = _load().hr_broadcast(
+                self._h, a.ctypes.data_as(ctypes.c_void_p), a.nbytes, src
+            )
+            _check(rc, "broadcast")
         return a
 
     def all_to_all(self, x) -> np.ndarray:
@@ -346,30 +565,97 @@ class HostRingGroup:
             )
         return self.broadcast(a, src=src)[self.rank]
 
+    def _verify_p2p(self, a: np.ndarray, src: int, dst: int) -> None:
+        """Debug mode for the P2P pair: both endpoints describe the
+        transfer (``shape|dtype|src->dst``) and exchange the 96-byte
+        fingerprints over the same mailbox pair BEFORE the payload — a
+        shape/dtype/peer mismatch raises on BOTH ranks naming both
+        descriptions, instead of a silently short/corrupt copy or a
+        mailbox hang. Debug mode must be uniform across ranks (true for
+        the env-var arming): a lone debug endpoint would ship its
+        fingerprint into a peer expecting payload."""
+        sig = f"p2p|{a.shape}|{a.dtype}|{src}->{dst}".encode()
+        mine = np.zeros(self._FP_BYTES, np.uint8)
+        mine[: len(sig[: self._FP_BYTES])] = np.frombuffer(
+            sig[: self._FP_BYTES], np.uint8
+        )
+        theirs = np.zeros(self._FP_BYTES, np.uint8)
+        lib = _load()
+        if self.rank == src:  # fingerprint ahead of payload, echo back
+            rc = lib.hr_sendrecv(
+                self._h, mine.ctypes.data_as(ctypes.c_void_p),
+                self._FP_BYTES, src, dst,
+            )
+            _check(rc, "debug p2p fingerprint send")
+            rc = lib.hr_sendrecv(
+                self._h, theirs.ctypes.data_as(ctypes.c_void_p),
+                self._FP_BYTES, dst, src,
+            )
+            _check(rc, "debug p2p fingerprint echo recv")
+        else:
+            rc = lib.hr_sendrecv(
+                self._h, theirs.ctypes.data_as(ctypes.c_void_p),
+                self._FP_BYTES, src, dst,
+            )
+            _check(rc, "debug p2p fingerprint recv")
+            rc = lib.hr_sendrecv(
+                self._h, mine.ctypes.data_as(ctypes.c_void_p),
+                self._FP_BYTES, dst, src,
+            )
+            _check(rc, "debug p2p fingerprint echo send")
+        if bytes(mine) != bytes(theirs):
+            me = bytes(mine).rstrip(b"\x00").decode()
+            peer = bytes(theirs).rstrip(b"\x00").decode()
+            raise RuntimeError(
+                f"P2P mismatch (PTD_DISTRIBUTED_DEBUG=DETAIL): rank"
+                f"{self.rank} expects {me}; peer sees {peer}"
+            )
+
     def send(self, x, dst: int) -> None:
         """True point-to-point send: only this rank and ``dst`` participate
         (per-pair shm mailbox — no group barrier, bystander ranks are free
         to run other collectives or nothing at all)."""
         a = _as_contig(x, dtype_required=False).copy()
-        rc = _load().hr_sendrecv(
-            self._h, a.ctypes.data_as(ctypes.c_void_p), a.nbytes,
-            self.rank, dst,
+        if self.debug:
+            self._verify_p2p(a, self.rank, dst)
+        tr = tracing._tracer
+        span = tracing._NULL_SPAN if tr is None else _comm_span(
+            tr, "send", f"->{dst}", a.size, a.dtype, a.nbytes,
+            self.world_size,
         )
-        _check(rc, "send")
+        with span:
+            rc = _load().hr_sendrecv(
+                self._h, a.ctypes.data_as(ctypes.c_void_p), a.nbytes,
+                self.rank, dst,
+            )
+            _check(rc, "send")
 
     def recv(self, x, src: int) -> np.ndarray:
         """x supplies shape/dtype; returns the received array. True P2P —
         see :meth:`send`."""
         a = _as_contig(x, dtype_required=False).copy()
-        rc = _load().hr_sendrecv(
-            self._h, a.ctypes.data_as(ctypes.c_void_p), a.nbytes,
-            src, self.rank,
+        if self.debug:
+            self._verify_p2p(a, src, self.rank)
+        tr = tracing._tracer
+        span = tracing._NULL_SPAN if tr is None else _comm_span(
+            tr, "recv", f"<-{src}", a.size, a.dtype, a.nbytes,
+            self.world_size,
         )
-        _check(rc, "recv")
+        with span:
+            rc = _load().hr_sendrecv(
+                self._h, a.ctypes.data_as(ctypes.c_void_p), a.nbytes,
+                src, self.rank,
+            )
+            _check(rc, "recv")
         return a
 
     def close(self) -> None:
         if self._h:
+            if self._clock_synced:
+                # re-stamp (no re-measure: close() isn't barrier-safe —
+                # a lone closing rank must not block on absent peers): a
+                # tracer armed AFTER init still exports aligned metadata
+                self._stamp_clock_meta()
             _load().hr_finalize(self._h)
             self._h = None
 

@@ -23,7 +23,14 @@ chaos-drill's wall clock into productive-vs-recovery seconds.
   not attributed is ``other_s``, so the buckets always sum to wall.
 * ``Tracer.write_rollups`` — per-span count/total/mean/p50/p95/p99
   through the existing MetricsWriter JSONL protocol
-  (``split="trace"``), consumed by ``scripts/obs_report.py``.
+  (``split="trace"``), consumed by ``scripts/obs_report.py``. Spans
+  whose args carry ``wire_bytes`` (the ``comm.*`` collective spans,
+  runtime/hostring.py) additionally accumulate an exact byte total, so
+  rollups report achieved GB/s per op.
+* :func:`set_meta` — process-level trace metadata (rank, world size,
+  measured clock offset). Lives at module scope, NOT on the tracer, so
+  a group initialised before the tracer is armed still stamps the
+  export; ``scripts/trace_merge.py`` aligns per-rank traces with it.
 
 Overhead discipline (same as runtime/faults.py): unarmed — the
 production default — every instrumentation site is a single
@@ -86,6 +93,20 @@ _NULL_SPAN = _NullSpan()
 
 _tracer: Optional["Tracer"] = None
 
+# process-level trace metadata (rank / world_size / clock_offset_s, ...):
+# survives configure()/clear() cycles and is snapshotted into every
+# export's otherData, whichever side of the arming it was stamped on
+_meta: Dict[str, Any] = {}
+
+
+def set_meta(**kv) -> None:
+    """Stamp process-level metadata into every later trace export."""
+    _meta.update(kv)
+
+
+def get_meta() -> Dict[str, Any]:
+    return dict(_meta)
+
 
 class _Span:
     """One live span: clock read on enter, record appended on exit."""
@@ -141,6 +162,7 @@ class Tracer:
         self.dropped = 0
         self._stats: Dict[str, list] = {}  # name -> [count, total_s, max_s]
         self._samples: Dict[str, Any] = {}  # name -> bounded recent durations
+        self._bytes: Dict[str, int] = {}  # name -> exact wire-byte total
         self._compiles: Dict[str, int] = {}  # last observed compile count
         self.recompiles: Dict[str, int] = {}  # compiles AFTER warm-up
 
@@ -189,6 +211,10 @@ class Tracer:
             if dur > st[2]:
                 st[2] = dur
             self._samples[name].append(dur)
+            if args:
+                wb = args.get("wire_bytes")
+                if wb:  # exact like count/total: scalars, never sampled
+                    self._bytes[name] = self._bytes.get(name, 0) + int(wb)
             self._append(ev)
 
     def instant(self, name: str, args: Optional[dict] = None) -> None:
@@ -247,12 +273,15 @@ class Tracer:
 
         count/total/mean/max are exact over the whole run; percentiles
         come from the ``sample_cap`` most recent durations per name.
+        Spans that recorded ``wire_bytes`` args (the ``comm.*`` sites)
+        also report the exact byte total and achieved GB/s.
         """
         with self._lock:
             items = {
                 k: (list(st), list(self._samples[k]))
                 for k, st in self._stats.items()
             }
+            byte_totals = dict(self._bytes)
         out: Dict[str, Dict[str, float]] = {}
         for name in sorted(items):
             (count, total, mx), sample = items[name]
@@ -265,6 +294,11 @@ class Tracer:
                 "p99_ms": percentile(sample, 99) * 1e3,
                 "max_ms": mx * 1e3,
             }
+            nbytes = byte_totals.get(name)
+            if nbytes:
+                out[name]["bytes_total"] = nbytes
+                if total > 0:
+                    out[name]["gb_per_s"] = nbytes / total / 1e9
         return out
 
     def write_rollups(self, writer, step: int = 0) -> None:
@@ -305,6 +339,7 @@ class Tracer:
                 "pid": self._pid,
                 "dropped_events": dropped,
                 "recompiles": recompiles,
+                "meta": dict(_meta),
             },
         }
         tmp = path + ".tmp"

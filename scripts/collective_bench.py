@@ -20,9 +20,21 @@ On the virtual CPU mesh (XLA_FLAGS=--xla_force_host_platform_device_count=N)
 the "collectives" are shared-memory copies — the run is a harness smoke,
 not a measurement; the banner says which you got.
 
+``--metrics-path`` writes every (op, size, world) measurement through
+the MetricsWriter JSONL protocol (``split="comm_bench"``,
+``event="collective"``) so cost-model fits and bench history can
+consume past runs instead of re-parsing stdout prose. ``--fit PATH``
+calibrates the α–β comms cost model (runtime/costmodel.py) from this
+run's sweep and writes the ``costmodel.json`` artifact the
+auto-parallel planner (ROADMAP item 4) consumes; the fit summary
+prints each op's α/β/R² and the worst predicted-vs-measured ratio over
+the sweep (the "within 2x" self-check).
+
 Run (any env; on the chip follow docs/CHIP_PROTOCOL.md — no kill timers):
     python scripts/collective_bench.py --sizes 4 32 128
     python scripts/collective_bench.py --axis dp --iters 50
+    python scripts/collective_bench.py --sizes 1 4 16 64 \
+        --metrics-path runs/comm.jsonl --fit runs/costmodel.json
 """
 
 import argparse
@@ -68,6 +80,12 @@ def main(argv=None):
     p.add_argument("--dp", type=int, default=-1)
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--fsdp", type=int, default=1)
+    p.add_argument("--metrics-path", default=None,
+                   help="append per-(op, size, world) records as "
+                   "MetricsWriter JSONL (split=comm_bench)")
+    p.add_argument("--fit", default=None, metavar="COSTMODEL_JSON",
+                   help="fit the α–β comms cost model from this sweep "
+                   "and write it here")
     args = p.parse_args(argv)
 
     ptd.enable_compilation_cache()
@@ -89,6 +107,17 @@ def main(argv=None):
     if parts == 1:
         print("# 1 participant: collectives are identity; nothing to measure")
         return
+    # transport label for records/model: the facade's XLA collectives on
+    # this platform, or the native shm ring under a one-proc-per-rank
+    # launch — a model fitted on one must not silently price the other
+    from pytorch_distributed_tpu.runtime.distributed import (
+        multiprocess_ring,
+    )
+
+    transport = (
+        "hostring" if multiprocess_ring() is not None else f"spmd:{plat}"
+    )
+    records = []
 
     kw = {"axis": args.axis} if args.axis else {}
     colls = {
@@ -136,10 +165,56 @@ def main(argv=None):
                     f"{dt * 1e3:8.3f}ms  {bw:7.2f} GB/s busbw",
                     flush=True,
                 )
+                records.append({
+                    "op": name,
+                    "payload_bytes": payload,
+                    "wire_bytes": int(moved(parts, payload)),
+                    "seconds": dt,
+                    "gb_per_s": bw,
+                    "world": parts,
+                    "transport": transport,
+                    "iters": args.iters,
+                })
             except Exception as e:  # keep later collectives running
                 print(f"{name:15s} {payload / 1e6:8.1f}MB FAILED: "
                       f"{type(e).__name__}: {e}", flush=True)
 
+    if args.metrics_path:
+        from pytorch_distributed_tpu.train.metrics import MetricsWriter
+
+        with MetricsWriter(args.metrics_path) as w:
+            for i, r in enumerate(records):
+                w.write(i, {"event": "collective", **r},
+                        split="comm_bench")
+        print(f"# {len(records)} records -> {args.metrics_path}",
+              flush=True)
+
+    if args.fit:
+        from pytorch_distributed_tpu.runtime import costmodel
+
+        model = costmodel.fit(records, transport)
+        if not model.fits:
+            print("# --fit: no fittable measurements (all failed or "
+                  "1 participant)", file=sys.stderr)
+            return 1
+        path = model.save(args.fit)
+        worst = costmodel.validate(model, records)
+        print(f"# cost model ({transport}) -> {path}", flush=True)
+        for (op, world), f in sorted(model.fits.items()):
+            print(
+                f"# fit {op:15s} world={world} "
+                f"alpha={f.alpha_s * 1e6:9.1f}us "
+                f"beta={f.beta_s_per_byte * 1e9:8.4f}ns/B "
+                f"({f.bandwidth_gb_s:6.2f} GB/s) r2={f.r2:.3f} "
+                f"n={f.n_samples} worst_ratio={worst.get(op, 0.0):.2f}x",
+                flush=True,
+            )
+        bad = {op: r for op, r in worst.items() if r > 2.0}
+        if bad:
+            print(f"# WARNING: predictions off by >2x on the calibration "
+                  f"sweep itself: {bad} — more sizes or more iters",
+                  file=sys.stderr)
+
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

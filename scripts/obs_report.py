@@ -12,7 +12,12 @@ investigation starts from —
 * top-N widest individual spans (the outliers percentiles hide),
 * recompile sentinel summary (anything after warm-up is a finding),
 * goodput summary (productive / stalled / recovering / checkpoint /
-  other seconds; buckets sum to wall).
+  other seconds; buckets sum to wall),
+* comms: per-op calls / wire bytes / wall and achieved GB/s from the
+  ``comm.*`` spans (runtime/hostring.py), predicted-vs-achieved
+  latency when a calibrated ``costmodel.json`` sits in the run dir,
+  and per-rank straggler skew when the trace is a
+  ``scripts/trace_merge.py`` merge of several ranks.
 
 Usage::
 
@@ -20,7 +25,9 @@ Usage::
     python scripts/obs_report.py --trace trace.json --metrics m.jsonl
 
 Works with either input alone: a chaos-drill dir usually has only the
-JSONL (rollups + goodput), a bench dir maybe only the trace.
+JSONL (rollups + goodput), a bench dir maybe only the trace. In a run
+dir with no ``trace.json``, a ``merged_trace.json`` (trace_merge
+output) is picked up instead.
 """
 
 import argparse
@@ -49,20 +56,31 @@ def parse_args(argv=None):
                    help="explicit metrics JSONL path (repeatable)")
     p.add_argument("--top", type=int, default=10,
                    help="how many widest spans to list")
+    p.add_argument("--costmodel", default=None,
+                   help="calibrated costmodel.json for the "
+                   "achieved-vs-predicted comms comparison (default: "
+                   "<run_dir>/costmodel.json when present)")
     return p.parse_args(argv)
 
 
 def _discover(args):
     trace_path, metric_paths = args.trace, list(args.metrics or [])
+    costmodel_path = args.costmodel
     if args.run_dir:
         if trace_path is None:
-            cand = os.path.join(args.run_dir, "trace.json")
-            trace_path = cand if os.path.isfile(cand) else None
+            for name in ("trace.json", "merged_trace.json"):
+                cand = os.path.join(args.run_dir, name)
+                if os.path.isfile(cand):
+                    trace_path = cand
+                    break
         if not metric_paths:
             metric_paths = sorted(
                 glob.glob(os.path.join(args.run_dir, "*.jsonl"))
             )
-    return trace_path, metric_paths
+        if costmodel_path is None:
+            cand = os.path.join(args.run_dir, "costmodel.json")
+            costmodel_path = cand if os.path.isfile(cand) else None
+    return trace_path, metric_paths, costmodel_path
 
 
 def load_trace(path):
@@ -93,10 +111,111 @@ def span_stats_from_rollups(records):
             rows[r["span"]] = {
                 k: r[k] for k in (
                     "count", "total_ms", "mean_ms", "p50_ms", "p95_ms",
-                    "p99_ms", "max_ms",
+                    "p99_ms", "max_ms", "bytes_total", "gb_per_s",
                 ) if k in r
             }
     return rows
+
+
+def comm_stats_from_events(events):
+    """Per ``comm.*`` span name: calls / wall / exact wire bytes (from
+    the span args) plus the mean payload and world size the cost model
+    needs to predict against."""
+    out = {}
+    for ev in events:
+        if ev.get("ph") != "X" or not str(ev.get("name", "")).startswith(
+            "comm."
+        ):
+            continue
+        a = ev.get("args") or {}
+        st = out.setdefault(ev["name"], {
+            "count": 0, "total_ms": 0.0, "bytes_total": 0,
+            "payload_total": 0, "world": a.get("world", 0),
+        })
+        st["count"] += 1
+        st["total_ms"] += float(ev.get("dur", 0.0)) / 1e3
+        st["bytes_total"] += int(a.get("wire_bytes", 0))
+        st["payload_total"] += int(a.get("payload_bytes", 0))
+    for st in out.values():
+        st["mean_ms"] = st["total_ms"] / st["count"]
+        st["payload_mean"] = st["payload_total"] // max(st["count"], 1)
+        if st["total_ms"] > 0:
+            st["gb_per_s"] = st["bytes_total"] / (
+                st["total_ms"] / 1e3
+            ) / 1e9
+    return out
+
+
+def comms_section(events, rows, other, costmodel_path, out):
+    """Render the per-op comms table (+ model comparison + rank skew)."""
+    stats = comm_stats_from_events(events)
+    if not stats:  # JSONL-rollup fallback: bytes but no payload/world
+        stats = {
+            n: dict(r) for n, r in rows.items()
+            if n.startswith("comm.") and r.get("bytes_total")
+        }
+    skew = (other or {}).get("comm_skew") or {}
+    if not stats and not skew:
+        return
+    print("\n== Comms ==", file=out)
+    model = None
+    if costmodel_path:
+        from pytorch_distributed_tpu.runtime import costmodel as cm
+
+        try:
+            model = cm.CostModel.load(costmodel_path)
+            print(f"  cost model: {costmodel_path} "
+                  f"(transport={model.transport})", file=out)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            print(f"  (costmodel {costmodel_path} unreadable: {e})",
+                  file=out)
+    if stats:
+        header = ("op", "calls", "total_ms", "mean_ms", "moved_MB",
+                  "GB/s", "pred_ms", "meas/pred")
+        widths = [max(24, *(len(n) for n in stats))] + [9] * 7
+        print("  " + _fmt_row(header, widths), file=out)
+        for name in sorted(
+            stats, key=lambda n: -stats[n].get("total_ms", 0.0)
+        ):
+            st = stats[name]
+            pred_ms = ratio = "-"
+            if (model is not None and st.get("payload_mean")
+                    and st.get("world")):
+                try:
+                    p = model.predict(
+                        name[len("comm."):], st["payload_mean"],
+                        int(st["world"]),
+                    )
+                    pred_ms = f"{p.seconds * 1e3:.3f}" + (
+                        "*" if p.extrapolated else ""
+                    )
+                    if p.seconds > 0:
+                        ratio = f"{st['mean_ms'] / 1e3 / p.seconds:.2f}"
+                except KeyError:
+                    pass
+            print("  " + _fmt_row(
+                (name, int(st.get("count", 0)),
+                 f"{st.get('total_ms', 0.0):.1f}",
+                 f"{st.get('mean_ms', 0.0):.3f}",
+                 f"{st.get('bytes_total', 0) / 1e6:.1f}",
+                 f"{st.get('gb_per_s', 0.0):.2f}",
+                 pred_ms, ratio),
+                widths,
+            ), file=out)
+        if model is not None:
+            print("  (pred_ms from the α–β fit at each op's mean "
+                  "payload; * = outside the calibrated range)", file=out)
+    if skew:
+        print("  per-rank straggler skew (merged trace):", file=out)
+        for name, s in sorted(skew.items()):
+            print(
+                f"    {name:<24} x{s['occurrences']:<5} "
+                f"mean={s['skew_ms_mean']:.3f}ms "
+                f"p95={s['skew_ms_p95']:.3f}ms "
+                f"max={s['skew_ms_max']:.3f}ms "
+                f"({s['ranks']} ranks)", file=out,
+            )
+    return stats
 
 
 def _fmt_row(cols, widths):
@@ -129,7 +248,12 @@ def phase_table(rows, wall_ms):
     return out
 
 
-def report(trace_path, metric_paths, top_n=10, out=sys.stdout):
+def report(trace_path, metric_paths, top_n=10, out=None,
+           costmodel_path=None):
+    # resolve the CURRENT sys.stdout, not import-time's: under pytest
+    # capture an import-time default would pin the first importing
+    # test's capture stream and every later caller would print into it
+    out = out if out is not None else sys.stdout
     records = []
     for mp in metric_paths:
         try:
@@ -213,6 +337,9 @@ def report(trace_path, metric_paths, top_n=10, out=sys.stdout):
     else:
         print("  none — every jitted callable compiled once", file=out)
 
+    # -- comms -------------------------------------------------------------
+    comms = comms_section(events, rows, other, costmodel_path, out)
+
     # -- goodput -----------------------------------------------------------
     print("\n== Goodput ==", file=out)
     g = summarize_goodput(records)
@@ -239,7 +366,8 @@ def report(trace_path, metric_paths, top_n=10, out=sys.stdout):
             f"p95={percentile(ttfts, 95):.1f}ms "
             f"p99={percentile(ttfts, 99):.1f}ms", file=out,
         )
-    return {"spans": rows, "recompiles": recompiles, "goodput": g}
+    return {"spans": rows, "recompiles": recompiles, "goodput": g,
+            "comms": comms or {}}
 
 
 def main(argv=None):
@@ -248,12 +376,13 @@ def main(argv=None):
         print("nothing to report: pass RUN_DIR or --trace/--metrics",
               file=sys.stderr)
         return 2
-    trace_path, metric_paths = _discover(args)
+    trace_path, metric_paths, costmodel_path = _discover(args)
     if not trace_path and not metric_paths:
         print(f"no trace.json or *.jsonl found under {args.run_dir!r}",
               file=sys.stderr)
         return 2
-    report(trace_path, metric_paths, top_n=args.top)
+    report(trace_path, metric_paths, top_n=args.top,
+           costmodel_path=costmodel_path)
     return 0
 
 

@@ -1,0 +1,234 @@
+"""Merge N per-rank trace.json files into one Perfetto timeline.
+
+A multi-process (hostring) run with ``--trace-dir`` leaves one Chrome
+trace per rank (``trace.json`` for rank 0, ``trace-rank<r>.json`` for
+the rest — train/trainer.py's export naming), each with its OWN t=0.
+Loaded separately they answer nothing about the RELATIONSHIP between
+ranks; merged onto one clock, ring serialization and straggler skew
+become visible facts instead of inferences.
+
+Alignment: every event's absolute time is the trace's
+``wall_start_unix_s`` plus its relative ``ts``, minus the rank's
+measured ``clock_offset_s`` (the barrier handshake HostRingGroup runs
+at world-ring init stamps it into ``otherData.meta``). On one host the
+offsets bound barrier-exit jitter (~us–ms); across hosts they carry
+the real clock skew. Each rank becomes its own Perfetto process track
+(``pid = rank``, named ``rank<r>``), thread tracks preserved.
+
+The merged ``otherData`` carries per-rank metadata plus a
+``comm_skew`` summary: for every ``comm.*`` span name, the k-th
+occurrence across ranks is the SAME collective (ranks issue
+collectives in lockstep — the hostring contract), so the spread of its
+per-rank start times is the straggler-skew distribution
+``scripts/obs_report.py`` renders.
+
+Usage::
+
+    python scripts/trace_merge.py RUN_DIR [-o merged_trace.json]
+    python scripts/trace_merge.py r0/trace.json r1/trace.json -o m.json
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pytorch_distributed_tpu.utils.timing import percentile  # noqa: E402
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("inputs", nargs="+",
+                   help="a run dir holding trace*.json, or the per-rank "
+                   "trace files themselves")
+    p.add_argument("-o", "--out", default=None,
+                   help="merged trace path (default: "
+                   "<dir>/merged_trace.json)")
+    return p.parse_args(argv)
+
+
+def discover(inputs):
+    """Expand run dirs to their per-rank trace files; keep files as-is."""
+    paths = []
+    for inp in inputs:
+        if os.path.isdir(inp):
+            found = sorted(
+                glob.glob(os.path.join(inp, "trace.json"))
+                + glob.glob(os.path.join(inp, "trace-rank*.json"))
+            )
+            if not found:
+                raise FileNotFoundError(f"no trace*.json under {inp!r}")
+            paths.extend(found)
+        else:
+            paths.append(inp)
+    # stable de-dup, preserving order
+    seen, out = set(), []
+    for p in paths:
+        if p not in seen:
+            seen.add(p)
+            out.append(p)
+    return out
+
+
+def _rank_of(path, doc, fallback):
+    meta = (doc.get("otherData") or {}).get("meta") or {}
+    if "rank" in meta:
+        return int(meta["rank"])
+    m = re.search(r"trace-rank(\d+)\.json$", os.path.basename(path))
+    if m:
+        return int(m.group(1))
+    if os.path.basename(path) == "trace.json":
+        return 0
+    return fallback
+
+
+def merge(paths):
+    """Merge per-rank Chrome traces; returns the merged document."""
+    loaded = []
+    for i, path in enumerate(paths):
+        with open(path) as f:
+            doc = json.load(f)
+        if isinstance(doc, list):  # bare-array trace_event form
+            doc = {"traceEvents": doc, "otherData": {}}
+        other = doc.get("otherData") or {}
+        meta = other.get("meta") or {}
+        rank = _rank_of(path, doc, i)
+        if "wall_start_unix_s" not in other:
+            # a trace with no wall anchor (bare-array exports, foreign
+            # tools) cannot be placed on the shared clock — defaulting
+            # it to 0 would shift real ranks ~55 years apart, silently
+            raise ValueError(
+                f"{path}: no otherData.wall_start_unix_s — only "
+                "runtime/tracing.py exports carry the wall anchor the "
+                "merge aligns on"
+            )
+        # absolute wall time of this trace's t=0, on rank 0's clock
+        base = float(other["wall_start_unix_s"]) - float(
+            meta.get("clock_offset_s", 0.0)
+        )
+        loaded.append({"path": path, "rank": rank, "base_s": base,
+                       "events": doc.get("traceEvents", []),
+                       "other": other})
+    ranks = [t["rank"] for t in loaded]
+    if len(set(ranks)) != len(ranks):
+        raise ValueError(
+            f"duplicate ranks {ranks} across {paths} — merging two "
+            "attempts of the same rank would interleave two runs"
+        )
+    t0 = min(t["base_s"] for t in loaded)
+    events = []
+    for t in loaded:
+        shift_us = (t["base_s"] - t0) * 1e6
+        for ev in t["events"]:
+            ev = dict(ev)
+            ev["ts"] = round(ev.get("ts", 0.0) + shift_us, 3)
+            ev["pid"] = t["rank"]  # one Perfetto process track per rank
+            events.append(ev)
+        events.append({  # named track, sorted by rank
+            "name": "process_name", "ph": "M", "pid": t["rank"],
+            "args": {"name": f"rank{t['rank']}"},
+        })
+        events.append({
+            "name": "process_sort_index", "ph": "M", "pid": t["rank"],
+            "args": {"sort_index": t["rank"]},
+        })
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "merged_from": [t["path"] for t in loaded],
+            "merge_base_unix_s": t0,
+            "ranks": {
+                str(t["rank"]): {
+                    "wall_start_unix_s": t["other"].get(
+                        "wall_start_unix_s"
+                    ),
+                    "clock_offset_s": (t["other"].get("meta") or {}).get(
+                        "clock_offset_s", 0.0
+                    ),
+                    "dropped_events": t["other"].get("dropped_events", 0),
+                }
+                for t in loaded
+            },
+            "comm_skew": comm_skew(events),
+        },
+    }
+
+
+def comm_skew(events):
+    """Per-``comm.*``-op straggler skew across ranks.
+
+    The k-th occurrence of an op on each rank is the same collective
+    (lockstep issue order), so ``max - min`` of its per-rank start
+    times is that collective's straggle. Returns per-op
+    ``{occurrences, ranks, skew_ms_mean/p95/max}`` over the
+    occurrences every rank has."""
+    by_op = {}
+    for ev in events:
+        if ev.get("ph") == "X" and str(ev.get("name", "")).startswith(
+            "comm."
+        ):
+            by_op.setdefault(ev["name"], {}).setdefault(
+                ev["pid"], []
+            ).append(float(ev["ts"]))
+    out = {}
+    for name, per_rank in sorted(by_op.items()):
+        if len(per_rank) < 2:
+            continue  # skew needs at least two ranks
+        starts = {r: sorted(ts) for r, ts in per_rank.items()}
+        n = min(len(ts) for ts in starts.values())
+        skews_ms = [
+            (max(ts[k] for ts in starts.values())
+             - min(ts[k] for ts in starts.values())) / 1e3
+            for k in range(n)
+        ]
+        if not skews_ms:
+            continue
+        out[name] = {
+            "occurrences": n,
+            "ranks": len(per_rank),
+            "skew_ms_mean": sum(skews_ms) / len(skews_ms),
+            "skew_ms_p95": percentile(skews_ms, 95),
+            "skew_ms_max": max(skews_ms),
+        }
+    return out
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    paths = discover(args.inputs)
+    if len(paths) < 2:
+        print(f"need >= 2 per-rank traces to merge, found {paths}",
+              file=sys.stderr)
+        return 2
+    doc = merge(paths)
+    out = args.out
+    if out is None:
+        base = args.inputs[0] if os.path.isdir(args.inputs[0]) else (
+            os.path.dirname(paths[0]) or "."
+        )
+        out = os.path.join(base, "merged_trace.json")
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, out)
+    n_ranks = len(doc["otherData"]["ranks"])
+    print(f"merged {len(paths)} traces ({n_ranks} ranks, "
+          f"{len(doc['traceEvents'])} events) -> {out}")
+    for name, s in doc["otherData"]["comm_skew"].items():
+        print(f"  {name:<24} x{s['occurrences']:<5} skew "
+              f"mean={s['skew_ms_mean']:.3f}ms "
+              f"p95={s['skew_ms_p95']:.3f}ms max={s['skew_ms_max']:.3f}ms")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,47 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def run_ring_workers(world, target, extra_args=(), timeout=180.0):
+    """Spawn one ``(rank, world, name, q, *extra_args)``-shaped worker
+    per rank on the CPU backend and collect one queue result per rank,
+    sorted. THE test-side multi-process harness (test_hostring and
+    test_comms_obs both use it; bench.py carries its own copy because
+    the bench must not import from tests/): env is pinned before
+    spawning since children inherit it at interpreter start, and
+    join/terminate runs even when a rank dies without reporting."""
+    import multiprocessing as mp
+    import uuid
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    name = f"ptdtest_{uuid.uuid4().hex[:8]}"
+    procs = [
+        ctx.Process(target=target,
+                    args=(r, world, name, q) + tuple(extra_args))
+        for r in range(world)
+    ]
+    # Children must never touch the (single, shared) TPU: contending for
+    # it serializes their startup past the collective timeouts.
+    old = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        for p in procs:
+            p.start()
+    finally:
+        if old is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = old
+    try:
+        results = [q.get(timeout=timeout) for _ in range(world)]
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+    return sorted(results)
+
+
 def raw_worker(rank: int, world: int, name: str, q) -> None:
     """Exercise the ctypes layer directly (no JAX in the child)."""
     try:
@@ -375,6 +416,307 @@ def p2p_worker(rank: int, world: int, name: str, q) -> None:
 def failing_worker(rank: int) -> None:
     """Deliberate crash target for failure-propagation tests (no JAX)."""
     raise SystemExit(3)
+
+
+def comm_span_worker(rank: int, world: int, name: str, q) -> None:
+    """Every collective lands a ``comm.*`` span with EXACT wire-byte
+    accounting (NCCL convention; q8 counts its real int8+scales bytes),
+    cumulative counter tracks, GB/s rollups, and clock-sync metadata —
+    all verified in-process, no JAX in the child."""
+    try:
+        from pytorch_distributed_tpu.runtime import tracing
+        from pytorch_distributed_tpu.runtime.hostring import (
+            HostRingGroup,
+            algo_wire_bytes,
+            q8_wire_payload,
+        )
+
+        tracing.configure(None)
+        with HostRingGroup(name, rank, world, timeout_s=60,
+                           clock_sync=True) as g:
+            g.all_reduce(np.ones(1000, np.float32))
+            g.all_reduce_q8(np.ones(5000, np.float32))
+            g.all_gather(np.full(500, rank, np.int32))
+            g.reduce_scatter(np.ones((world, 6), np.float64))
+            g.broadcast(np.ones(7, np.float32), src=0)
+            g.barrier()
+            if rank == 0:
+                g.send(np.ones(16, np.float32), dst=1)
+            elif rank == 1:
+                g.recv(np.empty(16, np.float32), src=0)
+        t = tracing.get()
+        evs = {}
+        for e in t._events:
+            if e["ph"] == "X":
+                evs.setdefault(e["name"], []).append(e)
+        want_wire = {
+            "comm.all_reduce": algo_wire_bytes("all_reduce", 4000, world),
+            "comm.all_reduce_q8": algo_wire_bytes(
+                "all_reduce_q8", q8_wire_payload(5000), world
+            ),
+            "comm.all_gather": algo_wire_bytes(
+                "all_gather", world * 2000, world
+            ),
+            "comm.reduce_scatter": algo_wire_bytes(
+                "reduce_scatter", world * 48, world
+            ),
+            "comm.broadcast": 28,
+            "comm.barrier": 0,
+        }
+        if rank == 0:
+            want_wire["comm.send"] = 64
+        elif rank == 1:
+            want_wire["comm.recv"] = 64
+        for span_name, wire in want_wire.items():
+            assert span_name in evs, (span_name, sorted(evs))
+            a = evs[span_name][0]["args"]
+            assert a["wire_bytes"] == wire, (span_name, a, wire)
+            assert a["world"] == world
+            for key in ("op", "dtype", "count", "payload_bytes"):
+                assert key in a, (span_name, a)
+        # the q8 span records the REAL wire payload AND the f32 bytes it
+        # replaced, so the ~4x reduction is computable from one event
+        q8a = evs["comm.all_reduce_q8"][0]["args"]
+        assert q8a["payload_bytes"] == q8_wire_payload(5000)
+        assert q8a["f32_bytes"] == 20000
+        assert q8a["payload_bytes"] / q8a["f32_bytes"] < 0.26
+        # cumulative counter tracks rode the same stream
+        counters = {
+            e["name"]: e["args"]["value"]
+            for e in t._events if e["ph"] == "C"
+        }
+        assert counters.get("comm.all_reduce.calls") == 1
+        assert counters.get("comm.all_reduce.bytes_moved") == want_wire[
+            "comm.all_reduce"
+        ]
+        assert counters.get("comm.all_reduce.seconds", 0) > 0
+        # rollups report exact bytes and achieved GB/s per op
+        roll = t.rollups()["comm.all_reduce"]
+        assert roll["bytes_total"] == want_wire["comm.all_reduce"]
+        assert roll["gb_per_s"] > 0
+        # clock handshake stamped process-level metadata for trace_merge
+        meta = tracing.get_meta()
+        assert meta["rank"] == rank and meta["world_size"] == world
+        assert len(meta["clock_offsets_s"]) == world
+        assert meta["clock_offsets_s"][0] == 0.0  # offsets are vs rank 0
+        assert abs(meta["clock_offset_s"]) < 5.0  # same host: ~jitter
+        tracing.clear()
+        q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover - reported via queue
+        import traceback
+
+        q.put((rank, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
+
+
+def trace_export_worker(rank: int, world: int, name: str, q,
+                        trace_dir: str) -> None:
+    """Per-rank traced run for the trace_merge test: staggered ranks,
+    lockstep collectives, per-rank trace files (the trainer's naming)."""
+    try:
+        import time as _time
+
+        from pytorch_distributed_tpu.runtime import tracing
+        from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
+
+        tracer = tracing.configure(trace_dir)
+        with HostRingGroup(name, rank, world, timeout_s=60,
+                           clock_sync=True) as g:
+            for i in range(4):
+                _time.sleep(0.002 * rank)  # real straggle, visible skew
+                g.all_reduce(np.ones(2000, np.float32))
+                g.barrier()
+        fname = "trace.json" if rank == 0 else f"trace-rank{rank}.json"
+        tracer.export(os.path.join(trace_dir, fname))
+        tracing.clear()
+        q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover - reported via queue
+        import traceback
+
+        q.put((rank, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
+
+
+def debug_barrier_mismatch_worker(rank: int, world: int, name: str,
+                                  q) -> None:
+    """DETAIL debug mode covers barrier(): a barrier/collective
+    interleave mismatch must RAISE on every rank naming the divergence,
+    not hang until the group deadline."""
+    try:
+        from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
+
+        with HostRingGroup(name, rank, world, timeout_s=60,
+                           debug=True) as g:
+            g.barrier()  # uniform barrier passes
+            try:
+                if rank == 0:
+                    g.barrier()  # rank 0 thinks "barrier"...
+                else:
+                    g.all_reduce(np.ones(4, np.float32))  # ...peers don't
+            except RuntimeError as e:
+                assert "collective mismatch" in str(e), e
+                assert "barrier" in str(e), e
+                q.put((rank, "ok"))
+                return
+            q.put((rank, "no error raised"))
+    except Exception as e:  # pragma: no cover - reported via queue
+        q.put((rank, f"{type(e).__name__}: {e}"))
+
+
+def debug_p2p_worker(rank: int, world: int, name: str, q) -> None:
+    """DETAIL debug mode covers send/recv: matching transfers pass, a
+    shape mismatch raises on BOTH endpoints naming both descriptions."""
+    try:
+        from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
+
+        with HostRingGroup(name, rank, world, timeout_s=60,
+                           debug=True) as g:
+            # matching pair passes, payload intact
+            if rank == 0:
+                g.send(np.arange(8, dtype=np.float32), dst=1)
+            elif rank == 1:
+                got = g.recv(np.empty(8, np.float32), src=0)
+                assert np.array_equal(
+                    got, np.arange(8, dtype=np.float32)
+                ), got
+            # mismatched shapes must raise on both sides
+            if rank in (0, 1):
+                try:
+                    if rank == 0:
+                        g.send(np.ones(4, np.float32), dst=1)
+                    else:
+                        g.recv(np.empty(5, np.float32), src=0)
+                except RuntimeError as e:
+                    assert "P2P mismatch" in str(e), e
+                    q.put((rank, "ok"))
+                    return
+                q.put((rank, "no error raised"))
+                return
+            q.put((rank, "ok"))  # bystander ranks stay untouched
+    except Exception as e:  # pragma: no cover - reported via queue
+        q.put((rank, f"{type(e).__name__}: {e}"))
+
+
+def coalesce_worker(rank: int, world: int, name: str, q) -> None:
+    """sync_grads coalesces sub-4096-elem f32 leaves into ONE flat
+    allreduce: the comm.* spans prove the collective-count drop, and at
+    world 2 the result is bit-identical to the per-leaf reference
+    (two-operand f32 addition commutes, so the segment-rotation of the
+    summation order cannot change a single bit)."""
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["RANK"] = str(rank)
+        os.environ["WORLD_SIZE"] = str(world)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+
+        import pytorch_distributed_tpu as ptd
+        from pytorch_distributed_tpu.parallel.ddp import sync_grads
+        from pytorch_distributed_tpu.runtime import tracing
+        from pytorch_distributed_tpu.runtime.distributed import (
+            multiprocess_ring,
+        )
+
+        ptd.init_process_group("gloo", group_name=name, timeout_s=120.0)
+        ring = multiprocess_ring()
+        rng = np.random.default_rng(11 + rank)  # per-rank gradients
+        # 6 tiny leaves + 1 big: per-leaf would issue 7 collectives,
+        # coalesced issues 2 (the flat + the big)
+        tiny = {
+            f"t{i}": (rng.normal(size=(17 + i,)) * 3).astype(np.float32)
+            for i in range(6)
+        }
+        big = (rng.normal(size=(5000,)) * 3).astype(np.float32)
+        grads = {**tiny, "big": big}
+
+        synced_fn = jax.jit(lambda g: sync_grads(g))
+        tracing.configure(None)
+        out = jax.tree_util.tree_map(np.asarray, synced_fn(grads))
+        t = tracing.get()
+        ar_spans = [
+            e for e in t._events
+            if e["ph"] == "X" and e["name"] == "comm.all_reduce"
+        ]
+        assert len(ar_spans) == 2, [e["args"] for e in ar_spans]
+        sg = [
+            e for e in t._events
+            if e["ph"] == "X" and e["name"] == "comm.sync_grads"
+        ]
+        assert len(sg) == 1, sg
+        assert sg[0]["args"]["leaves"] == 7
+        assert sg[0]["args"]["collectives"] == 2
+        assert sg[0]["args"]["coalesced_leaves"] == 6
+        assert sg[0]["args"]["pre_bytes"] == sum(
+            v.nbytes for v in grads.values()
+        )
+        tracing.clear()
+
+        # bit-identical to the per-leaf reference at world 2: same ring,
+        # one explicit all_reduce per leaf, leaf order (every rank runs
+        # the identical sequence, so the ring stays in lockstep)
+        for key in sorted(grads):
+            ref = ring.all_reduce(grads[key], op="avg")
+            assert np.array_equal(
+                np.asarray(out[key]), ref
+            ), (key, np.asarray(out[key])[:4], ref[:4])
+
+        # ...and under int8 compression the flat buffer stays EXACT f32
+        # while the big leaf takes the q8 path
+        tracing.configure(None)
+        out_q = jax.tree_util.tree_map(
+            np.asarray, jax.jit(lambda g: sync_grads(g, compress="int8"))(grads)
+        )
+        t = tracing.get()
+        names = [
+            e["name"] for e in t._events
+            if e["ph"] == "X" and e["name"].startswith("comm.all_reduce")
+        ]
+        assert sorted(names) == ["comm.all_reduce",
+                                 "comm.all_reduce_q8"], names
+        tracing.clear()
+        for key in sorted(tiny):  # tiny leaves: exact, bit-identical
+            ref = ring.all_reduce(grads[key], op="avg")
+            assert np.array_equal(np.asarray(out_q[key]), ref), key
+        # big leaf went quantized: close, not exact
+        ref_big = ring.all_reduce(grads["big"], op="avg")
+        atol = (world + 1) * np.abs(big).max() / 127
+        assert np.all(np.abs(np.asarray(out_q["big"]) - ref_big) <= atol)
+
+        # ...and under bf16 compression the tiny leaves STILL coalesce
+        # (grouping keys on the ON-THE-WIRE dtype, after the cast):
+        # 7 leaves -> 2 bf16 collectives, bit-identical to the per-leaf
+        # bf16 reference at world 2
+        import ml_dtypes
+
+        tracing.configure(None)
+        out_h = jax.tree_util.tree_map(
+            np.asarray,
+            jax.jit(lambda g: sync_grads(g, compress="bf16"))(grads),
+        )
+        t = tracing.get()
+        ar_h = [
+            e for e in t._events
+            if e["ph"] == "X" and e["name"] == "comm.all_reduce"
+        ]
+        assert len(ar_h) == 2, [e["args"] for e in ar_h]
+        assert all(e["args"]["dtype"] == "bfloat16" for e in ar_h), ar_h
+        sg_h = [
+            e for e in t._events
+            if e["ph"] == "X" and e["name"] == "comm.sync_grads"
+        ]
+        assert sg_h[0]["args"]["coalesced_leaves"] == 6, sg_h[0]["args"]
+        tracing.clear()
+        for key in sorted(grads):
+            cast = grads[key].astype(ml_dtypes.bfloat16)
+            ref = ring.all_reduce(cast, op="avg").astype(np.float32)
+            assert np.array_equal(np.asarray(out_h[key]), ref), key
+
+        ptd.destroy_process_group()
+        q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover - reported via queue
+        import traceback
+
+        q.put((rank, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
 
 
 def facade_worker(rank: int, world: int, name: str, q) -> None:

@@ -137,6 +137,8 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
 
     # the observability micro-phase: tracing a hot loop must cost < 2%
     # vs the untraced loop (the tracer's zero-overhead claim, measured)
+    # — and it must stay green now that the comm sites exist (disarmed
+    # comm collectives pay the same one is-None test as every span site)
     obs = [
         json.loads(l) for l in proc.stderr.splitlines()
         if l.startswith("{")
@@ -144,6 +146,22 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
     ]
     assert len(obs) == 1, proc.stderr[-2000:]
     assert obs[0]["value"] < 2.0, obs[0]
+
+    # the comms phase: q8's RECORDED wire bytes at gradient size must be
+    # <= 0.3x f32 (the encoding is int8 + one f32 scale per 256 elems,
+    # ~0.254 — ROADMAP item 1's bytes-moved-reduction number, measured
+    # off the comm.* span counters over a real 4-proc ring)
+    comms = [
+        json.loads(l) for l in proc.stderr.splitlines()
+        if l.startswith("{")
+        and json.loads(l)["metric"] == "comms_q8_wire_bytes_ratio"
+    ]
+    assert len(comms) == 1, proc.stderr[-2000:]
+    assert 0.2 < comms[0]["value"] <= 0.3, comms[0]
+    assert comms[0]["f32_busbw_gbps"] > 0, comms[0]
+    assert comms[0]["q8_busbw_gbps"] > 0, comms[0]
+    assert "comms" in pd[0]["value"], pd[0]
+    assert durations.get("comms", 999) < 120, durations
 
 
 @pytest.mark.slow

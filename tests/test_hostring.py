@@ -7,7 +7,6 @@ context, no fork of the JAX runtime) and validate both the raw ctypes layer
 and the ``init_process_group`` facade on top of it.
 """
 
-import multiprocessing as mp
 import os
 import uuid
 
@@ -16,35 +15,7 @@ import pytest
 from tests import hostring_workers
 
 
-def _run(world: int, target, timeout: float = 180.0):
-    ctx = mp.get_context("spawn")
-    q = ctx.Queue()
-    name = f"ptdtest_{uuid.uuid4().hex[:8]}"
-    procs = [
-        ctx.Process(target=target, args=(r, world, name, q))
-        for r in range(world)
-    ]
-    # Children must never touch the (single, shared) TPU: contending for it
-    # serializes their startup past the collective timeouts. Env is
-    # inherited at child interpreter start, so set it before spawning.
-    old = os.environ.get("JAX_PLATFORMS")
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    try:
-        for p in procs:
-            p.start()
-    finally:
-        if old is None:
-            os.environ.pop("JAX_PLATFORMS", None)
-        else:
-            os.environ["JAX_PLATFORMS"] = old
-    try:
-        results = [q.get(timeout=timeout) for _ in range(world)]
-    finally:
-        for p in procs:
-            p.join(timeout=30)
-            if p.is_alive():
-                p.terminate()
-    return sorted(results)
+_run = hostring_workers.run_ring_workers  # THE shared spawn harness
 
 
 def test_build_library():
