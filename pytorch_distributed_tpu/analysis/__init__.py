@@ -1,0 +1,39 @@
+"""ptdlint — AST-based static analysis for the repo's distributed-
+correctness invariants.
+
+The repo's hardest-won rules were, until this package, enforced only by
+convention and prose: collectives must be issued in lockstep order
+across ranks (``scripts/trace_merge.py`` and the
+``PTD_DISTRIBUTED_DEBUG=DETAIL`` fingerprints *assume* it), every
+tracing/fault site must be the one-``is None``-test disarmed form
+(the <2% traced-overhead budget depends on it), fault-site names are
+free strings, and eager ``.at[].set`` costs ~2.4 ms/dispatch on this
+box. veScale (PAPERS.md) argues SPMD consistency is a *programming-model
+property worth checking*; this package turns each convention into a
+rule that fails the suite the moment a future PR breaks it.
+
+Usage::
+
+    from pytorch_distributed_tpu.analysis import Analyzer, default_rules
+    findings = Analyzer(root, default_rules()).run(["pytorch_distributed_tpu"])
+
+or the CLI: ``python scripts/ptd_lint.py [--json]``.
+
+This package imports neither jax nor numpy: it must stay runnable as a
+pre-test lint step on any host. Rules that need a runtime registry
+(PTD003 reads ``runtime/faults.KNOWN_SITES``) parse it out of the
+source AST rather than importing the module.
+"""
+
+from pytorch_distributed_tpu.analysis.core import (  # noqa: F401
+    Analyzer,
+    Baseline,
+    BaselineEntry,
+    Finding,
+    ParsedModule,
+    Rule,
+)
+from pytorch_distributed_tpu.analysis.rules import (  # noqa: F401
+    ALL_RULES,
+    default_rules,
+)

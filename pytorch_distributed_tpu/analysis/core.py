@@ -1,0 +1,377 @@
+"""ptdlint framework: parse every file once, run pluggable rules.
+
+Design (mirrors the repo's other subsystems: one substrate, pluggable
+consumers):
+
+* :class:`ParsedModule` — one file parsed once into an AST with parent
+  links, source lines, and the line→rule suppression map from
+  ``# ptdlint: disable=PTD00N`` comments. Every rule reads the same
+  parse; a 40-file run costs 40 parses total, not 40 × rules.
+* :class:`Rule` — ``rule_id`` + ``check(module) -> Iterable[Finding]``.
+  Rules are pure functions of the AST: they never import or execute the
+  code under analysis (a file that crashes on import still lints).
+* :class:`Analyzer` — collects files, parses, runs rules, applies
+  suppressions. An unparseable file is itself a finding (``PTD000``),
+  never a silent skip — a syntax error in a collective-bearing module
+  must not make the lockstep check vacuously pass.
+* :class:`Baseline` — the checked-in grandfather list. Entries match on
+  ``(rule, path, line_text)`` — the *content* of the flagged line, not
+  its number, so unrelated edits above a baselined finding don't
+  un-baseline it. The baseline may only shrink: entries that no longer
+  match any finding are reported stale and fail the run until removed.
+
+Suppression is explicit and auditable, never positional guesswork: a
+``# ptdlint: disable=PTD001`` trailing comment suppresses that line; on
+a line of its own it suppresses the next line. ``disable=all`` exists
+for generated code but should never appear in this repo.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: reserved id for files the analyzer itself could not parse
+PARSE_ERROR_RULE = "PTD000"
+
+_SUPPRESS_RE = re.compile(r"#\s*ptdlint:\s*disable=([A-Za-z0-9,_ ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule_id: str
+    path: str  # repo-root-relative, '/'-separated
+    line: int  # 1-based
+    message: str
+    line_text: str = ""  # stripped source of the flagged line
+
+    def fingerprint(self) -> Tuple[str, str, str]:
+        """Baseline identity: content-addressed, line-number-free."""
+        return (self.rule_id, self.path, self.line_text)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class ParsedModule:
+    """One source file, parsed once and shared by every rule."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # parent links let rules walk outward (enclosing function, guard
+        # expressions) without each re-deriving the spine
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+        self.suppressed = self._suppression_map()
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def enclosing_functions(self, node: ast.AST) -> List[ast.AST]:
+        """Innermost-first chain of enclosing function/lambda nodes."""
+        return [
+            a for a in self.ancestors(node)
+            if isinstance(
+                a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            )
+        ]
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule_id: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule_id=rule_id,
+            path=self.relpath,
+            line=line,
+            message=message,
+            line_text=self.line_text(line),
+        )
+
+    def _suppression_map(self) -> Dict[int, Set[str]]:
+        """line -> rule ids suppressed there (or {'all'}).
+
+        A trailing comment suppresses its own line; a comment alone on a
+        line suppresses the next line (the flake8 convention, so a long
+        flagged expression can carry its suppression above itself).
+        """
+        out: Dict[int, Set[str]] = {}
+        for i, raw in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(raw)
+            if not m:
+                continue
+            ids = {
+                s.strip().upper() if s.strip().lower() != "all" else "all"
+                for s in m.group(1).split(",")
+                if s.strip()
+            }
+            target = i + 1 if raw.strip().startswith("#") else i
+            out.setdefault(target, set()).update(ids)
+        return out
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        ids = self.suppressed.get(finding.line)
+        return bool(ids) and ("all" in ids or finding.rule_id in ids)
+
+
+class Rule:
+    """Base class: subclasses set ``rule_id``/``title`` and implement
+    :meth:`check`. ``path_filter`` (a regex, matched against the
+    '/'-separated relpath) restricts a rule to a subtree — PTD004 only
+    patrols ``serve/`` and ``train/`` hot paths. ``source_hints`` is a
+    sound fast-path filter: an AST pattern built on an identifier can
+    only exist where that identifier appears verbatim in the source, so
+    a module containing none of the hint substrings is skipped without
+    walking its tree (measured ~2-3x on the whole-repo sweep)."""
+
+    rule_id: str = ""
+    title: str = ""
+    path_filter: Optional[str] = None
+    source_hints: Tuple[str, ...] = ()
+
+    def applies_to(self, module: ParsedModule) -> bool:
+        if self.path_filter is not None and re.search(
+            self.path_filter, module.relpath
+        ) is None:
+            return False
+        if self.source_hints and not any(
+            h in module.source for h in self.source_hints
+        ):
+            return False
+        return True
+
+    def check(self, module: ParsedModule) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class BaselineEntry:
+    rule: str
+    path: str
+    line_text: str
+    justification: str
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.line_text)
+
+
+class Baseline:
+    """The grandfather list; shrink-only by construction.
+
+    :meth:`apply` splits findings into (new, baselined) and reports the
+    entries that matched nothing as stale — the caller fails the run on
+    stale entries, so deleting the last instance of a grandfathered
+    pattern forces the baseline entry to be deleted with it.
+    """
+
+    VERSION = 1
+
+    def __init__(self, entries: Sequence[BaselineEntry] = ()):
+        self.entries = list(entries)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.isfile(path):
+            return cls()
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("version") != cls.VERSION:
+            raise ValueError(
+                f"baseline {path!r}: unsupported version "
+                f"{doc.get('version')!r} (expected {cls.VERSION})"
+            )
+        entries = []
+        for e in doc.get("entries", []):
+            missing = {"rule", "path", "line_text", "justification"} - set(e)
+            if missing:
+                raise ValueError(
+                    f"baseline {path!r}: entry {e!r} missing {sorted(missing)}"
+                )
+            just = e["justification"].strip()
+            if not just or just.startswith("FILL-ME"):
+                # an unjustified grandfather is just a hidden bug — the
+                # --write-baseline placeholder counts as unjustified
+                raise ValueError(
+                    f"baseline {path!r}: entry for {e['rule']} at "
+                    f"{e['path']} has an empty or FILL-ME justification"
+                )
+            if e["rule"] == PARSE_ERROR_RULE:
+                # a grandfathered parse error would exempt the whole
+                # file from EVERY rule forever — the one silent skip
+                # this framework exists to refuse
+                raise ValueError(
+                    f"baseline {path!r}: {PARSE_ERROR_RULE} (parse "
+                    f"error) entries cannot be baselined — fix the "
+                    f"file at {e['path']}"
+                )
+            entries.append(BaselineEntry(
+                rule=e["rule"], path=e["path"],
+                line_text=e["line_text"], justification=e["justification"],
+            ))
+        return cls(entries)
+
+    def save(self, path: str) -> None:
+        doc = {
+            "version": self.VERSION,
+            "policy": (
+                "shrink-only: entries are grandfathered findings with a "
+                "one-line justification; stale entries fail the lint run "
+                "and must be removed"
+            ),
+            "entries": [dataclasses.asdict(e) for e in self.entries],
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+
+    def apply(
+        self, findings: Sequence[Finding]
+    ) -> Tuple[List[Finding], List[Finding], List[BaselineEntry]]:
+        """-> (new_findings, baselined_findings, stale_entries)."""
+        by_key: Dict[Tuple[str, str, str], BaselineEntry] = {
+            e.key(): e for e in self.entries
+        }
+        used: Set[Tuple[str, str, str]] = set()
+        new, grandfathered = [], []
+        for f in findings:
+            # parse errors are never grandfathered: an unparseable file
+            # is unchecked by every rule, which must stay loud
+            e = (
+                None if f.rule_id == PARSE_ERROR_RULE
+                else by_key.get(f.fingerprint())
+            )
+            if e is not None:
+                used.add(e.key())
+                grandfathered.append(f)
+            else:
+                new.append(f)
+        stale = [e for e in self.entries if e.key() not in used]
+        return new, grandfathered, stale
+
+
+class Analyzer:
+    """Parse every target file once; run every rule over the shared ASTs."""
+
+    #: directory basenames never descended into
+    SKIP_DIRS = {"__pycache__", ".git", "node_modules"}
+
+    def __init__(self, root: str, rules: Sequence[Rule],
+                 exclude: Sequence[str] = ()):
+        self.root = os.path.abspath(root)
+        self.rules = list(rules)
+        # relpath prefixes to skip (the fixtures corpus is deliberately
+        # full of violations — it must never lint the real tree red)
+        self.exclude = tuple(e.rstrip("/") + "/" for e in exclude)
+
+    def collect_files(self, paths: Sequence[str]) -> List[str]:
+        out: List[str] = []
+        for p in paths:
+            absolute = p if os.path.isabs(p) else os.path.join(self.root, p)
+            if os.path.isfile(absolute):
+                out.append(absolute)
+                continue
+            for dirpath, dirnames, filenames in os.walk(absolute):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d not in self.SKIP_DIRS
+                )
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        out.append(os.path.join(dirpath, name))
+        uniq = sorted(set(out))
+        return [f for f in uniq if not self._excluded(f)]
+
+    def _excluded(self, path: str) -> bool:
+        rel = self._rel(path) + "/"
+        return any(rel.startswith(e) for e in self.exclude)
+
+    def _rel(self, path: str) -> str:
+        return os.path.relpath(path, self.root).replace(os.sep, "/")
+
+    def parse(self, paths: Sequence[str]
+              ) -> Tuple[List[ParsedModule], List[Finding]]:
+        modules, errors = [], []
+        for path in self.collect_files(paths):
+            rel = self._rel(path)
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            try:
+                modules.append(ParsedModule(path, rel, source))
+            except SyntaxError as e:
+                errors.append(Finding(
+                    rule_id=PARSE_ERROR_RULE,
+                    path=rel,
+                    line=e.lineno or 1,
+                    message=f"file does not parse: {e.msg}",
+                    line_text=(e.text or "").strip(),
+                ))
+        return modules, errors
+
+    def run(self, paths: Sequence[str]) -> List[Finding]:
+        """All unsuppressed findings, parse errors included, ordered by
+        (path, line, rule)."""
+        modules, findings = self.parse(paths)
+        for module in modules:
+            for rule in self.rules:
+                if not rule.applies_to(module):
+                    continue
+                for f in rule.check(module):
+                    if not module.is_suppressed(f):
+                        findings.append(f)
+        findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
+        return findings
+
+
+# -- small AST helpers shared by the rules ---------------------------------
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain rooted at a Name, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+def is_trivial_expr(node: ast.AST) -> bool:
+    """Cheap enough to evaluate on the disarmed path: constants, bare
+    names, attribute chains. Anything that *computes* — calls, subscripts,
+    arithmetic, f-strings, displays — is not (runtime/tracing.py's
+    documented kwarg-site discipline)."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Name):
+        return True
+    if isinstance(node, ast.Attribute):
+        return is_trivial_expr(node.value)
+    if isinstance(node, ast.UnaryOp) and isinstance(
+        node.op, (ast.USub, ast.UAdd)
+    ):
+        return is_trivial_expr(node.operand)
+    return False

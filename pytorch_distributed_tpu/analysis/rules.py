@@ -1,0 +1,1004 @@
+"""The ptdlint rule catalog — six invariants with real repo history.
+
+Each rule documents its motivating incident (the convention it freezes)
+and its detection envelope (what it can and cannot see — these are
+syntactic checks over one module's AST, not a whole-program analysis;
+every approximation errs toward silence, so a finding is worth reading
+and a clean run is necessary-not-sufficient). The catalog's prose twin
+is docs/DESIGN.md §14.
+
+PTD001 lockstep-collectives     cross-rank deadlock under rank guards
+PTD002 disarmed-cost-discipline span/fault args evaluated while disarmed
+PTD003 fault-site-registry      free-string site names vs KNOWN_SITES
+PTD004 eager-scatter-hot-path   .at[].set outside jit (2.4 ms/dispatch)
+PTD005 prng-key-reuse           one key, two draws, no split between
+PTD006 donation-after-use       donated buffer read after the call
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from pytorch_distributed_tpu.analysis.core import (
+    Finding,
+    ParsedModule,
+    Rule,
+    call_name,
+    dotted_name,
+    is_trivial_expr,
+)
+
+#: the HostRingGroup surface (runtime/hostring.py) plus its composed
+#: helpers — all of them block until every participant arrives
+COLLECTIVE_OPS = frozenset({
+    "all_reduce", "all_reduce_q8", "all_gather", "reduce_scatter",
+    "broadcast", "send", "recv", "barrier", "all_to_all", "scatter",
+})
+#: send/recv match each other across branches: `if rank == src: send
+#: else: recv` is the correct P2P shape, not a divergence
+_P2P_CANON = {"send": "p2p", "recv": "p2p"}
+
+_RANK_CALL_SUFFIXES = ("get_rank", "process_index", "local_rank")
+
+
+def _canon_op(op: str) -> str:
+    return _P2P_CANON.get(op, op)
+
+
+def _body_terminates(body: Sequence[ast.AST]) -> bool:
+    """Every path through ``body`` leaves the enclosing block (return /
+    raise / break / continue) — the statements after the If are then an
+    implicit else branch (the repo's pervasive early-return style)."""
+    if not body:
+        return False
+    last = body[-1]
+    if isinstance(last, (ast.Return, ast.Raise, ast.Break, ast.Continue)):
+        return True
+    if isinstance(last, ast.If) and last.orelse:
+        return _body_terminates(last.body) and _body_terminates(last.orelse)
+    return False
+
+
+def _block_containing(module: ParsedModule, stmt: ast.AST
+                      ) -> Optional[List[ast.AST]]:
+    """The statement list ``stmt`` sits in (its parent's body/orelse/
+    finalbody), for implicit-else lookups."""
+    parent = module.parent(stmt)
+    for field in ("body", "orelse", "finalbody"):
+        blk = getattr(parent, field, None)
+        if isinstance(blk, list) and any(s is stmt for s in blk):
+            return blk
+    return None
+
+
+def _walk_no_functions(node: ast.AST) -> Iterable[ast.AST]:
+    """ast.walk that does not descend into nested function/lambda/class
+    bodies: defining code is not executing it."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+class LockstepCollectives(Rule):
+    """PTD001 — a collective issued under rank-dependent control flow
+    with no matching collective on the other branch.
+
+    Motivation: every HostRingGroup collective blocks until all ranks
+    arrive; ``scripts/trace_merge.py``'s straggler matching and the
+    ``PTD_DISTRIBUTED_DEBUG=DETAIL`` fingerprints *assume* lockstep
+    issue order. ``if rank == 0: ring.broadcast(...)`` deadlocks the
+    peers until the group deadline. A collective in one branch is
+    matched by the same op (send↔recv pair across branches) in the
+    other; rank-dependence propagates through local assignments
+    (``is_src = rank == src``).
+    """
+
+    rule_id = "PTD001"
+    title = "lockstep-collectives"
+    source_hints = tuple(COLLECTIVE_OPS)
+
+    def check(self, module: ParsedModule) -> Iterable[Finding]:
+        flagged: Set[Tuple[int, int]] = set()
+        taint_cache: Dict[int, Set[str]] = {}
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.If, ast.IfExp)):
+                continue
+            scope = self._scope(module, node)
+            tainted = taint_cache.get(id(scope))
+            if tainted is None:
+                tainted = taint_cache[id(scope)] = self._tainted_names(scope)
+            if not self._rank_dependent(node.test, tainted):
+                continue
+            if isinstance(node, ast.If):
+                # an elif arm of a rank-dependent chain was already
+                # evaluated as its parent's orelse — re-judging it alone
+                # would see an empty other-branch and flag the correct
+                # `if rank == 0: send elif rank == peer: recv` shape.
+                # Only a TRUE elif (same column as the parent `if`)
+                # skips: a rank guard nested under `else:` is indented
+                # deeper and must be judged standalone — its own missing
+                # arm is a real divergence the parent's set-level match
+                # cannot see
+                parent = module.parent(node)
+                if (
+                    isinstance(parent, ast.If)
+                    and any(node is n for n in parent.orelse)
+                    and node.col_offset == parent.col_offset
+                    and self._rank_dependent(parent.test, tainted)
+                ):
+                    continue
+                body, orelse = node.body, node.orelse
+                if not orelse and _body_terminates(body):
+                    # `if rank == 0: return ring.all_reduce(x)` followed
+                    # by a fall-through collective: the trailing
+                    # statements ARE the other branch
+                    blk = _block_containing(module, node)
+                    if blk is not None:
+                        i = next(
+                            j for j, s in enumerate(blk) if s is node
+                        )
+                        orelse = blk[i + 1:]
+            else:
+                body, orelse = [node.body], [node.orelse]
+            body_calls = self._collectives(body)
+            other_calls = self._collectives(orelse)
+            for side, opposite in (
+                (body_calls, other_calls), (other_calls, body_calls)
+            ):
+                opposite_ops = {c for c, _ in opposite}
+                # a guarded group doing a full send+recv exchange among
+                # its own members is pairwise-complete: P2P blocks only
+                # its two endpoints, bystander ranks are free (hostring
+                # send/recv contract), so no opposite-branch op is owed
+                p2p_self_paired = (
+                    "send" in {op for _, c in side
+                               for op in [c.func.attr]}
+                    and "recv" in {op for _, c in side
+                                   for op in [c.func.attr]}
+                )
+                for canon, call in side:
+                    if canon in opposite_ops:
+                        continue
+                    if canon == "p2p" and p2p_self_paired:
+                        continue
+                    key = (call.lineno, call.col_offset)
+                    if key in flagged:
+                        continue
+                    flagged.add(key)
+                    op = call.func.attr  # type: ignore[union-attr]
+                    yield module.finding(
+                        self.rule_id, call,
+                        f"collective '{op}' issued under rank-dependent "
+                        f"control flow with no matching collective on the "
+                        f"other branch — ranks taking the other path never "
+                        f"enter it: cross-rank deadlock (trace_merge and "
+                        f"DETAIL fingerprints assume lockstep issue order)",
+                    )
+
+    @staticmethod
+    def _scope(module: ParsedModule, node: ast.AST) -> ast.AST:
+        fns = module.enclosing_functions(node)
+        return fns[0] if fns else module.tree
+
+    def _tainted_names(self, scope: ast.AST) -> Set[str]:
+        """Names assigned from rank-dependent expressions, to fixpoint
+        (``is_src = rank == src`` then ``owner = is_src and ...``)."""
+        tainted: Set[str] = set()
+        while True:
+            grew = False
+            for node in _walk_no_functions(scope):
+                value = None
+                targets: List[ast.AST] = []
+                if isinstance(node, ast.Assign):
+                    value, targets = node.value, node.targets
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    value, targets = node.value, [node.target]
+                elif isinstance(node, ast.NamedExpr):
+                    value, targets = node.value, [node.target]
+                if value is None or not self._rank_dependent(value, tainted):
+                    continue
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id not in tainted:
+                        tainted.add(t.id)
+                        grew = True
+            if not grew:
+                return tainted
+
+    @staticmethod
+    def _rank_dependent(expr: ast.AST, tainted: Set[str]) -> bool:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and (
+                n.id == "rank" or n.id in tainted
+            ):
+                return True
+            if isinstance(n, ast.Attribute) and n.attr == "rank":
+                return True
+            if isinstance(n, ast.Call):
+                dn = call_name(n)
+                if dn and dn.split(".")[-1] in _RANK_CALL_SUFFIXES:
+                    return True
+        return False
+
+    @staticmethod
+    def _collectives(
+        stmts: Sequence[ast.AST],
+    ) -> List[Tuple[str, ast.Call]]:
+        out = []
+        for stmt in stmts:
+            for n in _walk_no_functions(stmt):
+                if not (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in COLLECTIVE_OPS
+                ):
+                    continue
+                if any(
+                    kw.arg == "group"
+                    and not (
+                        isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None
+                    )
+                    for kw in n.keywords
+                ):
+                    # explicit-subgroup collective: only the group's
+                    # members participate, so selecting them by rank IS
+                    # the contract, not a divergence
+                    continue
+                out.append((_canon_op(n.func.attr), n))
+        return out
+
+
+class DisarmedCostDiscipline(Rule):
+    """PTD002 — span/fault-site args computed before the is-None guard.
+
+    Motivation: the production default is disarmed, and the pinned
+    <2% traced-overhead budget (bench.py ``observability`` phase) holds
+    because every site costs one module-global ``is None`` test. A site
+    like ``tracing.span("x", n=len(batch))`` evaluates ``len(batch)``
+    and builds a kwargs dict on EVERY disarmed pass. Trivial args
+    (constants, names, attribute chains) are accepted on ms-grained
+    sites per runtime/tracing.py's documented discipline; anything that
+    computes must move behind a guard: the
+    ``tracing._NULL_SPAN if tracing._tracer is None else ...`` ternary
+    or an ``if tracing.active():`` / ``is not None`` block.
+    """
+
+    rule_id = "PTD002"
+    title = "disarmed-cost-discipline"
+    source_hints = ("tracing.", "faults.")
+
+    _TRACING_FNS = frozenset(
+        {"span", "instant", "counter", "note_compiles"}
+    )
+    _FAULTS_FNS = frozenset({"check", "fires"})
+    #: the substrate modules implement the guards; they are exempt
+    _EXEMPT = ("runtime/tracing.py", "runtime/faults.py")
+
+    def check(self, module: ParsedModule) -> Iterable[Finding]:
+        if module.relpath.endswith(self._EXEMPT):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            site = self._site_kind(node)
+            if site is None:
+                continue
+            costly = [
+                a for a in self._arg_exprs(node) if not is_trivial_expr(a)
+            ]
+            if not costly or self._guarded(module, node):
+                continue
+            yield module.finding(
+                self.rule_id, node,
+                f"{site} site evaluates non-trivial args while disarmed "
+                f"(e.g. `{ast.unparse(costly[0])}`) — every disarmed "
+                f"pass pays the computation + kwargs dict, breaking the "
+                f"one-is-None-test discipline (<2% traced-overhead "
+                f"budget). Use trivial args, or gate the site: "
+                f"`tracing._NULL_SPAN if tracing._tracer is None else "
+                f"tracing.span(...)`.",
+            )
+
+    def _site_kind(self, call: ast.Call) -> Optional[str]:
+        dn = call_name(call)
+        if dn is None or "." not in dn:
+            return None
+        owner, fn = dn.rsplit(".", 2)[-2:]
+        if owner == "tracing" and fn in self._TRACING_FNS:
+            return f"tracing.{fn}"
+        if owner == "faults" and fn in self._FAULTS_FNS:
+            return f"faults.{fn}"
+        return None
+
+    @staticmethod
+    def _arg_exprs(call: ast.Call) -> Iterable[ast.AST]:
+        for a in call.args:
+            yield a.value if isinstance(a, ast.Starred) else a
+        for kw in call.keywords:
+            yield kw.value
+
+    @staticmethod
+    def _guarded(module: ParsedModule, call: ast.Call) -> bool:
+        child: ast.AST = call
+        for anc in module.ancestors(call):
+            side = None
+            if isinstance(anc, ast.IfExp):
+                if child is anc.body:
+                    side = "body"
+                elif child is anc.orelse:
+                    side = "orelse"
+            elif isinstance(anc, ast.If):
+                if any(child is n for n in anc.body):
+                    side = "body"
+                elif any(child is n for n in anc.orelse):
+                    side = "orelse"
+            if side is not None:
+                if side == "orelse" and _none_compare(anc.test, ast.Is):
+                    return True  # _NULL_SPAN if tr is None else <site>
+                if side == "body" and (
+                    _none_compare(anc.test, ast.IsNot)
+                    or _has_active_call(anc.test)
+                ):
+                    return True  # if tr is not None / if faults.active()
+            child = anc
+        return False
+
+
+def _none_compare(test: ast.AST, op_cls) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Compare) and any(
+            isinstance(o, op_cls) for o in n.ops
+        ) and any(
+            isinstance(c, ast.Constant) and c.value is None
+            for c in n.comparators
+        ):
+            return True
+    return False
+
+
+def _has_active_call(test: ast.AST) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Call):
+            dn = call_name(n)
+            if dn and dn.split(".")[-1] == "active":
+                return True
+    return False
+
+
+class FaultSiteRegistry(Rule):
+    """PTD003 — every fault-site name must be in the canonical registry.
+
+    Motivation: site names are free strings. ``faults.check("ckpt.writ_"
+    "shard")`` at a production call site parses, runs, and never fires —
+    a chaos drill "passes" while testing nothing. The registry is
+    ``KNOWN_SITES`` in runtime/faults.py (the arming parser already
+    refuses unknown names; this rule closes the *call-site* half).
+    Checked literals: ``faults.check("...")`` / ``faults.fires("...")``
+    first args, ``faults.injected("spec")`` / ``faults.configure``
+    specs, and ``PTD_FAULTS`` spec strings in env dicts/assignments —
+    which is how tests and drills name sites, so tests/docs snippets
+    using a dead name fail the lint too.
+    """
+
+    rule_id = "PTD003"
+    title = "fault-site-registry"
+    source_hints = ("faults.", "PTD_FAULTS")
+
+    _registry_cache: Optional[Set[str]] = None
+
+    def __init__(self, registry: Optional[Set[str]] = None):
+        self._registry = registry
+
+    @property
+    def registry(self) -> Set[str]:
+        if self._registry is not None:
+            return self._registry
+        if FaultSiteRegistry._registry_cache is None:
+            FaultSiteRegistry._registry_cache = self._load_registry()
+        return FaultSiteRegistry._registry_cache
+
+    @staticmethod
+    def _load_registry() -> Set[str]:
+        """Parse KNOWN_SITES out of runtime/faults.py's AST — the same
+        source the runtime arms from — without importing it (the
+        analyzer must stay import-free over the code it checks)."""
+        path = os.path.join(
+            os.path.dirname(__file__), os.pardir, "runtime", "faults.py"
+        )
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "KNOWN_SITES"
+                for t in node.targets
+            ):
+                return {
+                    n.value for n in ast.walk(node.value)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str)
+                }
+        raise RuntimeError(
+            "KNOWN_SITES assignment not found in runtime/faults.py"
+        )
+
+    def check(self, module: ParsedModule) -> Iterable[Finding]:
+        registry = self.registry
+        for site, node in self._site_literals(module):
+            if site not in registry:
+                yield module.finding(
+                    self.rule_id, node,
+                    f"unknown fault site {site!r} — not in "
+                    f"runtime/faults.KNOWN_SITES; a typo'd site name "
+                    f"never fires and never tells you. Fix the name or "
+                    f"register the site.",
+                )
+
+    def _site_literals(
+        self, module: ParsedModule
+    ) -> Iterable[Tuple[str, ast.AST]]:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                dn = call_name(node)
+                fn = dn.rsplit(".", 1)[-1] if dn else ""
+                owner = dn.split(".")[-2] if dn and "." in dn else ""
+                first = node.args[0] if node.args else None
+                is_str = (
+                    isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)
+                )
+                if owner == "faults" and fn in ("check", "fires") and is_str:
+                    yield first.value, node
+                elif (
+                    owner == "faults"
+                    and fn in ("injected", "configure")
+                    and is_str
+                ):
+                    for site in self._spec_sites(first.value):
+                        yield site, node
+                elif fn == "setdefault" and len(node.args) >= 2 and (
+                    is_str and first.value == "PTD_FAULTS"
+                    and isinstance(node.args[1], ast.Constant)
+                    and isinstance(node.args[1].value, str)
+                ):
+                    for site in self._spec_sites(node.args[1].value):
+                        yield site, node
+            elif isinstance(node, ast.Assign):
+                # env["PTD_FAULTS"] = "site:..." (drills, test harnesses)
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Subscript)
+                        and isinstance(t.slice, ast.Constant)
+                        and t.slice.value == "PTD_FAULTS"
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)
+                    ):
+                        for site in self._spec_sites(node.value.value):
+                            yield site, node
+            elif isinstance(node, ast.Dict):
+                # {"PTD_FAULTS": "site:..."} env dicts
+                for k, v in zip(node.keys, node.values):
+                    if (
+                        isinstance(k, ast.Constant)
+                        and k.value == "PTD_FAULTS"
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)
+                    ):
+                        for site in self._spec_sites(v.value):
+                            yield site, v
+
+    @staticmethod
+    def _spec_sites(spec: str) -> Iterable[str]:
+        """Site names from the PTD_FAULTS grammar (site[:k=v,...];...)."""
+        for part in spec.split(";"):
+            name = part.partition(":")[0].strip()
+            if name:
+                yield name
+
+
+class EagerScatterHotPath(Rule):
+    """PTD004 — ``.at[...].set()`` on a serve/train hot path outside any
+    jit-compiled function.
+
+    Motivation: an eager scatter dispatch costs ~2.4 ms on this box
+    (measured under cProfile — per-request slot updates were half the
+    serving wall-clock until PR 3 fused them into jitted programs;
+    serve/engine.py documents the incident). Inside jit the same update
+    is a fused ~0.1 ms program. A function counts as jitted when it (or
+    an enclosing function) carries a jit decorator, is wrapped via
+    ``jax.jit(f)`` / ``jax.jit(self._f)`` anywhere in the module, or is
+    called (by bare name or ``self.``) from a jitted function in the
+    same module. Cross-module helpers are out of this envelope —
+    syntactic, per-module, biased toward silence.
+    """
+
+    rule_id = "PTD004"
+    title = "eager-scatter-hot-path"
+    source_hints = (".at[",)
+    path_filter = r"(^|/)(serve|train)/"
+
+    _SCATTER_METHODS = frozenset({
+        "set", "add", "multiply", "mul", "divide", "div", "power",
+        "min", "max", "apply", "get",
+    })
+
+    def check(self, module: ParsedModule) -> Iterable[Finding]:
+        jitted = self._jitted_functions(module)
+        for node in ast.walk(module.tree):
+            if not self._is_scatter_call(node):
+                continue
+            if self._under_jit(module, node, jitted):
+                continue
+            yield module.finding(
+                self.rule_id, node,
+                f"eager `.at[...].{node.func.attr}()` outside any "  # type: ignore[union-attr]
+                f"jit-compiled function — ~2.4 ms per dispatch on this "
+                f"box (the bug class PR 3 fixed by hand: fused row "
+                f"updates are ~0.1 ms). Move the update into a jitted "
+                f"program.",
+            )
+
+    @staticmethod
+    def _is_scatter_call(node: ast.AST) -> bool:
+        # x.at[...].set(...) == Call(Attribute(Subscript(Attribute 'at')))
+        return (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in EagerScatterHotPath._SCATTER_METHODS
+            and isinstance(node.func.value, ast.Subscript)
+            and isinstance(node.func.value.value, ast.Attribute)
+            and node.func.value.value.attr == "at"
+        )
+
+    def _jitted_functions(self, module: ParsedModule) -> Set[str]:
+        """Names of functions/methods the module jit-compiles."""
+        jitted: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and any(self._is_jit_expr(d) for d in node.decorator_list):
+                jitted.add(node.name)
+            elif isinstance(node, ast.Call) and self._is_jit_name(
+                call_name(node)
+            ):
+                for arg in node.args[:1]:
+                    if isinstance(arg, ast.Name):
+                        jitted.add(arg.id)
+                    elif isinstance(arg, ast.Attribute):
+                        jitted.add(arg.attr)  # jax.jit(self._decode_fn)
+        # one-module call-graph closure: helpers called from a jitted
+        # function body are traced under the same jit
+        fns = {
+            n.name: n for n in ast.walk(module.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        while True:
+            grew = False
+            for name in list(jitted):
+                fn = fns.get(name)
+                if fn is None:
+                    continue
+                for n in ast.walk(fn):
+                    if isinstance(n, ast.Call):
+                        dn = call_name(n)
+                        callee = dn.rsplit(".", 1)[-1] if dn else None
+                        if callee in fns and callee not in jitted:
+                            jitted.add(callee)
+                            grew = True
+            if not grew:
+                return jitted
+
+    @staticmethod
+    def _is_jit_name(dn: Optional[str]) -> bool:
+        return bool(dn) and any(
+            seg in ("jit", "pjit") for seg in dn.split(".")
+        )
+
+    @classmethod
+    def _is_jit_expr(cls, expr: ast.AST) -> bool:
+        """Decorator (possibly partial(jax.jit, ...)) mentioning jit."""
+        for n in ast.walk(expr):
+            if isinstance(n, (ast.Name, ast.Attribute)):
+                if cls._is_jit_name(dotted_name(n)):
+                    return True
+        return False
+
+    def _under_jit(
+        self, module: ParsedModule, node: ast.AST, jitted: Set[str]
+    ) -> bool:
+        for fn in module.enclosing_functions(node):
+            if isinstance(fn, ast.Lambda):
+                # jax.jit(lambda ...: x.at[i].set(v)) — the lambda is
+                # the jit call's direct argument
+                parent = module.parent(fn)
+                if isinstance(parent, ast.Call) and self._is_jit_name(
+                    call_name(parent)
+                ):
+                    return True
+                continue
+            if fn.name in jitted:
+                return True
+            if any(self._is_jit_expr(d) for d in fn.decorator_list):
+                return True
+        return False
+
+
+class PrngKeyReuse(Rule):
+    """PTD005 — the same key fed to two ``jax.random`` consumers with no
+    split/reassignment between them.
+
+    Motivation: reusing a key makes two "independent" draws identical —
+    correlated dropout masks, repeated sampling streams; the bug is
+    silent (shapes/dtypes all check out). Consumers are the sampling
+    functions plus ``split`` (after ``k1, k2 = split(key)``, using
+    ``key`` again replays the stream); ``fold_in`` is a derivation, not
+    a consumption (``fold_in(key, i)`` per step is the idiom). Tracked:
+    bare-name keys within one function scope, in source order, killed
+    by reassignment; two uses in mutually exclusive branches of the
+    same ``if``/``try`` don't pair. A consumer inside a loop whose key
+    is never reassigned in that loop is flagged as cross-iteration
+    reuse. Attribute-held keys (``self._key``) are out of envelope.
+    """
+
+    rule_id = "PTD005"
+    title = "prng-key-reuse"
+    source_hints = ("random.",)
+
+    _CONSUMERS = frozenset({
+        "split", "normal", "uniform", "bernoulli", "categorical",
+        "gumbel", "randint", "truncated_normal", "permutation", "choice",
+        "beta", "gamma", "exponential", "laplace", "logistic", "poisson",
+        "dirichlet", "multivariate_normal", "bits", "cauchy", "maxwell",
+        "rademacher", "t", "weibull_min", "ball", "orthogonal", "shuffle",
+        "binomial", "chisquare", "f", "geometric", "loggamma", "pareto",
+        "rayleigh", "triangular", "wald",
+    })
+
+    def check(self, module: ParsedModule) -> Iterable[Finding]:
+        scopes: List[Tuple[ast.AST, Sequence[ast.AST]]] = [
+            (module.tree, module.tree.body)
+        ]
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append((node, node.body))
+        for _, body in scopes:
+            yield from self._check_scope(module, body)
+
+    def _check_scope(
+        self, module: ParsedModule, body: Sequence[ast.AST]
+    ) -> Iterable[Finding]:
+        live: Dict[str, List[Tuple[ast.AST, Tuple]]] = {}
+        findings: List[Finding] = []
+
+        def visit(stmts, branch, loops):
+            for idx, stmt in enumerate(stmts):
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue  # nested scopes are checked on their own
+                if isinstance(stmt, ast.If):
+                    exprs(stmt.test, branch, loops)
+                    visit(stmt.body, branch + ((id(stmt), 0),), loops)
+                    if stmt.orelse:
+                        visit(stmt.orelse, branch + ((id(stmt), 1),), loops)
+                    elif _body_terminates(stmt.body):
+                        # early-return style: the rest of this block is
+                        # the implicit else arm — mutually exclusive
+                        # with the body, not sequential after it
+                        visit(stmts[idx + 1:],
+                              branch + ((id(stmt), 1),), loops)
+                        return
+                    continue
+                if isinstance(stmt, ast.Try):
+                    visit(stmt.body, branch + ((id(stmt), 0),), loops)
+                    for i, h in enumerate(stmt.handlers):
+                        visit(h.body, branch + ((id(stmt), i + 1),), loops)
+                    visit(stmt.orelse, branch + ((id(stmt), 0),), loops)
+                    visit(stmt.finalbody, branch, loops)
+                    continue
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    exprs(stmt.iter, branch, loops)
+                    kill_target(stmt.target)
+                    visit(stmt.body, branch, loops + (stmt,))
+                    visit(stmt.orelse, branch, loops)
+                    continue
+                if isinstance(stmt, ast.While):
+                    exprs(stmt.test, branch, loops + (stmt,))
+                    visit(stmt.body, branch, loops + (stmt,))
+                    visit(stmt.orelse, branch, loops)
+                    continue
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    for item in stmt.items:
+                        exprs(item.context_expr, branch, loops)
+                        if item.optional_vars is not None:
+                            kill_target(item.optional_vars)
+                    visit(stmt.body, branch, loops)
+                    continue
+                # plain statement: uses in its expressions happen before
+                # its own bindings kill (RHS evaluates first)
+                exprs(stmt, branch, loops)
+                if isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        kill_target(t)
+                elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                    kill_target(stmt.target)
+
+        def kill_target(target):
+            for n in ast.walk(target):
+                if isinstance(n, ast.Name):
+                    live.pop(n.id, None)
+
+        def exprs(node, branch, loops):
+            for n in _walk_no_functions(node):
+                if isinstance(n, ast.NamedExpr) and isinstance(
+                    n.target, ast.Name
+                ):
+                    live.pop(n.target.id, None)
+                if not isinstance(n, ast.Call):
+                    continue
+                dn = call_name(n)
+                if not dn:
+                    continue
+                parts = dn.split(".")
+                if not (
+                    len(parts) >= 2
+                    and parts[-2] == "random"
+                    and parts[-1] in self._CONSUMERS
+                    # numpy's Generator API shares the `random` segment
+                    # but takes no key; never pair it
+                    and parts[0] not in ("np", "numpy")
+                ):
+                    continue
+                if not n.args or not isinstance(n.args[0], ast.Name):
+                    continue
+                key = n.args[0].id
+                prior = live.setdefault(key, [])
+                clash = next(
+                    (p for p, pb in prior if not _diverged(pb, branch)),
+                    None,
+                )
+                if clash is not None:
+                    findings.append(module.finding(
+                        self.rule_id, n,
+                        f"key {key!r} already consumed by "
+                        f"`{ast.unparse(clash)[:60]}` (line "
+                        f"{clash.lineno}) and reused here with no "
+                        f"split/reassignment between — the two draws "
+                        f"are identical streams. split() first.",
+                    ))
+                elif loops and not any(
+                    self._loop_kills(lp, key) for lp in loops
+                ):
+                    findings.append(module.finding(
+                        self.rule_id, n,
+                        f"key {key!r} is consumed inside a loop but "
+                        f"never split/reassigned within it — every "
+                        f"iteration replays the same stream. Derive a "
+                        f"per-iteration key (split or fold_in).",
+                    ))
+                prior.append((n, branch))
+
+        visit(body, (), ())
+        return findings
+
+    @staticmethod
+    def _loop_kills(loop: ast.AST, name: str) -> bool:
+        for n in _walk_no_functions(loop):
+            targets: List[ast.AST] = []
+            if isinstance(n, ast.Assign):
+                targets = n.targets
+            elif isinstance(n, (ast.AnnAssign, ast.AugAssign)):
+                targets = [n.target]
+            elif isinstance(n, (ast.For, ast.AsyncFor)):
+                targets = [n.target]
+            elif isinstance(n, ast.NamedExpr):
+                targets = [n.target]
+            for t in targets:
+                if any(
+                    isinstance(x, ast.Name) and x.id == name
+                    for x in ast.walk(t)
+                ):
+                    return True
+        return False
+
+
+def _diverged(bp1: Tuple, bp2: Tuple) -> bool:
+    """True when two branch paths pass through the same If/Try on
+    different arms — the uses are mutually exclusive, not sequential."""
+    for (n1, a1), (n2, a2) in zip(bp1, bp2):
+        if n1 != n2:
+            return False
+        if a1 != a2:
+            return True
+    return False
+
+
+class DonationAfterUse(Rule):
+    """PTD006 — a buffer passed at a donated position, read again later
+    in the same scope.
+
+    Motivation: ``donate_argnums`` lets XLA reuse the input buffer for
+    an output; afterwards the Python-side array is invalid, and reading
+    it is use-after-free that surfaces as garbage values or a runtime
+    error depending on backend (XLA:CPU doesn't alias, so the bug hides
+    on this box and detonates on the chip). Tracked: callables bound in
+    the same module via ``f = jax.jit(g, donate_argnums=(...))`` (or
+    ``self._f = jax.jit(self._g, ...)``), call sites passing a bare
+    name or dotted attribute at a donated index, then a read of that
+    exact expression after the call before any rebinding. Conditional
+    ``donate_argnums=(1,) if donate else ()`` counts its indices —
+    conservative toward the donating configuration.
+    """
+
+    rule_id = "PTD006"
+    title = "donation-after-use"
+    source_hints = ("donate_argnums",)
+
+    def check(self, module: ParsedModule) -> Iterable[Finding]:
+        donating = self._donating_bindings(module)
+        if not donating:
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            idxs = donating.get(callee or "")
+            if not idxs:
+                continue
+            for i in sorted(idxs):
+                if i >= len(node.args):
+                    continue
+                name = dotted_name(node.args[i])
+                if name is None:
+                    continue
+                read = self._read_after(module, node, name)
+                if read is not None:
+                    yield module.finding(
+                        self.rule_id, read,
+                        f"`{name}` was donated to `{callee}` "
+                        f"(donate_argnums includes {i}, line "
+                        f"{node.lineno}) and is read again here — the "
+                        f"donated buffer may already be invalidated "
+                        f"(hidden on XLA:CPU, which never aliases; real "
+                        f"on the chip). Rebind the callee's result "
+                        f"instead.",
+                    )
+
+    @staticmethod
+    def _donating_bindings(module: ParsedModule) -> Dict[str, Set[int]]:
+        out: Dict[str, Set[int]] = {}
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            value = node.value
+            if not (
+                isinstance(value, ast.Call)
+                and EagerScatterHotPath._is_jit_name(call_name(value))
+            ):
+                continue
+            donate_kw = next(
+                (
+                    kw for kw in value.keywords
+                    if kw.arg == "donate_argnums"
+                ),
+                None,
+            )
+            if donate_kw is None:
+                continue
+            idxs = {
+                n.value for n in ast.walk(donate_kw.value)
+                if isinstance(n, ast.Constant) and isinstance(n.value, int)
+                and not isinstance(n.value, bool)
+            }
+            target = dotted_name(node.targets[0])
+            if idxs and target:
+                out[target] = idxs
+        return out
+
+    def _read_after(
+        self, module: ParsedModule, call: ast.Call, name: str
+    ) -> Optional[ast.AST]:
+        """First load of ``name`` after the donating call's statement,
+        before any rebinding — linear source order within the scope."""
+        fns = module.enclosing_functions(call)
+        scope = fns[0] if fns else module.tree
+        call_stmt = self._enclosing_stmt(module, call, scope)
+        if call_stmt is None:
+            return None
+        stmts = self._linear_stmts(scope)
+        try:
+            start = stmts.index(call_stmt)
+        except ValueError:
+            return None
+        # the call's own statement: assignment targets rebind (kill)
+        # before any following statement runs
+        if name in self._stores(call_stmt):
+            return None
+        for stmt in stmts[start + 1:]:
+            read = self._first_load(stmt, name)
+            stored = name in self._stores(stmt)
+            if read is not None:
+                return read  # RHS reads evaluate before the rebinding
+            if stored:
+                return None
+        return None
+
+    @staticmethod
+    def _enclosing_stmt(
+        module: ParsedModule, node: ast.AST, scope: ast.AST
+    ) -> Optional[ast.stmt]:
+        cur: Optional[ast.AST] = node
+        while cur is not None and cur is not scope:
+            if isinstance(cur, ast.stmt):
+                return cur  # innermost: the assignment holding the call
+            cur = module.parent(cur)
+        return None
+
+    @staticmethod
+    def _linear_stmts(scope: ast.AST) -> List[ast.stmt]:
+        out = [
+            n for n in _walk_no_functions(scope)
+            if isinstance(n, ast.stmt) and n is not scope
+        ]
+        out.sort(key=lambda s: (s.lineno, s.col_offset))
+        return out
+
+    @staticmethod
+    def _exprs_of(stmt: ast.stmt) -> Iterable[ast.AST]:
+        """The statement's own expressions, not its nested block bodies
+        (those are separate statements in the linear walk)."""
+        for field, value in ast.iter_fields(stmt):
+            vals = value if isinstance(value, list) else [value]
+            for v in vals:
+                if isinstance(v, ast.expr):
+                    yield v
+                elif isinstance(v, ast.withitem):
+                    yield v.context_expr
+
+    @classmethod
+    def _first_load(cls, stmt: ast.stmt, name: str) -> Optional[ast.AST]:
+        for expr in cls._exprs_of(stmt):
+            for n in _walk_no_functions(expr):
+                if dotted_name(n) == name and isinstance(
+                    getattr(n, "ctx", None), ast.Load
+                ):
+                    return n
+        return None
+
+    @classmethod
+    def _stores(cls, stmt: ast.stmt) -> Set[str]:
+        out: Set[str] = set()
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            targets = [stmt.target]
+        for t in targets:
+            for n in ast.walk(t):
+                dn = dotted_name(n)
+                if dn and isinstance(getattr(n, "ctx", None), ast.Store):
+                    out.add(dn)
+        return out
+
+
+ALL_RULES = (
+    LockstepCollectives,
+    DisarmedCostDiscipline,
+    FaultSiteRegistry,
+    EagerScatterHotPath,
+    PrngKeyReuse,
+    DonationAfterUse,
+)
+
+
+def default_rules() -> List[Rule]:
+    """One instance of every shipped rule, default configuration."""
+    return [cls() for cls in ALL_RULES]

@@ -263,7 +263,13 @@ class DataLoader:
             for indices in self.sampler:
                 if stop.is_set():
                     return
-                with tracing.span("ingest.fetch", n=len(indices)):
+                # armed-only arg evaluation (PTD002): the disarmed
+                # producer loop must stay one is-None test per batch
+                span = (
+                    tracing._NULL_SPAN if tracing._tracer is None
+                    else tracing.span("ingest.fetch", n=len(indices))
+                )
+                with span:
                     batch = (self.fetch or _default_fetch)(
                         self.dataset, self._rank_slice(indices)
                     )

@@ -87,6 +87,13 @@ ENV_SEED = "PTD_FAULTS_SEED"
 #: drill can tell an injected crash from a clean preemption exit
 KILLED_EXIT = 113
 
+#: THE canonical site registry. Arming a name outside it raises
+#: (:meth:`FaultPlan.parse`); a :func:`check`/:func:`fires` call site
+#: naming an unknown site warns loudly once while armed (the typo'd
+#: call site that "never fires and never tells you"); and ptdlint's
+#: PTD003 rule statically checks every site literal in code, tests, and
+#: PTD_FAULTS spec strings against this tuple — add new sites HERE
+#: first, with a row in the table above.
 KNOWN_SITES = (
     "ckpt.write_shard",
     "ckpt.swing",
@@ -98,6 +105,22 @@ KNOWN_SITES = (
     "serve.decode",
 )
 _MODES = ("raise", "kill", "truncate", "bitflip")
+
+# unknown site names already warned about (once per name per process:
+# these sit on hot paths when armed)
+_warned_unknown_sites: set = set()
+
+
+def _warn_unknown_site(site: str) -> None:
+    if site in _warned_unknown_sites:
+        return
+    _warned_unknown_sites.add(site)
+    logger.warning(
+        "fault site %r is not in KNOWN_SITES — this check can NEVER "
+        "fire (a typo'd site name silently tests nothing). Register it "
+        "in runtime/faults.KNOWN_SITES or fix the name. Known: %s",
+        site, KNOWN_SITES,
+    )
 
 
 class InjectedFault(RuntimeError):
@@ -261,6 +284,8 @@ def fires(site: str, path: Optional[str] = None) -> bool:
     Trainer's ``step.nan``). No-op False when unarmed."""
     if _plan is None:
         return False
+    if site not in KNOWN_SITES:  # armed-only: the unarmed path stays
+        _warn_unknown_site(site)  # one is-None test
     s = _plan.sites.get(site)
     return s is not None and s.decide(path)
 
@@ -271,6 +296,8 @@ def check(site: str, path: Optional[str] = None) -> None:
     feeds ``match`` filters and the corrupting modes."""
     if _plan is None:
         return
+    if site not in KNOWN_SITES:  # armed-only: the unarmed path stays
+        _warn_unknown_site(site)  # one is-None test
     s = _plan.sites.get(site)
     if s is None or not s.decide(path):
         return

@@ -403,7 +403,13 @@ class ServeEngine:
         # one jit call; toks/lengths/keys advance in-program for the
         # active rows, so the only per-tick host traffic is the sampled
         # tokens coming down
-        with tracing.span("serve.decode_tick", active=len(decoding)):
+        # armed-only arg evaluation (PTD002): the steady-state tick is
+        # the serving hot path — disarmed cost stays one is-None test
+        span = (
+            tracing._NULL_SPAN if tracing._tracer is None
+            else tracing.span("serve.decode_tick", active=len(decoding))
+        )
+        with span:
             (
                 self.pool.cache, nxt, self._toks, self._lengths,
                 self._keys,

@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""ptdlint CLI — machine-check the repo's distributed-correctness
+invariants (rule catalog: docs/DESIGN.md §14).
+
+Default sweep: ``pytorch_distributed_tpu/`` + ``scripts/`` +
+``bench.py`` + ``tests/`` (minus the deliberately-violating
+``tests/lint_fixtures/`` corpus) against the checked-in baseline. Exit
+status is 0 only when there are zero non-baselined findings, zero
+parse errors, AND zero stale baseline entries — the baseline may only
+shrink, so removing the last instance of a grandfathered pattern
+forces its entry out too.
+
+    python scripts/ptd_lint.py                 # human output
+    python scripts/ptd_lint.py --json          # machine output
+    python scripts/ptd_lint.py recipes/        # explicit path subset
+    python scripts/ptd_lint.py --rules PTD001  # rule subset
+    python scripts/ptd_lint.py --metrics-path runs/x/metrics.jsonl
+                                               # split="lint" JSONL record
+
+Imports only the stdlib + the analysis package on the default path;
+``--metrics-path`` additionally loads the MetricsWriter protocol (which
+pulls the runtime, i.e. jax) so lint counts land in the same JSONL
+stream every other subsystem reports through.
+
+Suppression: ``# ptdlint: disable=PTD00N`` on (or directly above) the
+flagged line. Baseline: ``ptdlint_baseline.json`` at the repo root —
+``--write-baseline`` regenerates it from the current findings (every
+entry then needs a real justification filled in before review).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+from pytorch_distributed_tpu.analysis import (  # noqa: E402
+    Analyzer,
+    Baseline,
+    BaselineEntry,
+    default_rules,
+)
+from pytorch_distributed_tpu.analysis.core import (  # noqa: E402
+    PARSE_ERROR_RULE,
+)
+
+DEFAULT_PATHS = ("pytorch_distributed_tpu", "scripts", "bench.py", "tests")
+#: the fixtures corpus is deliberately full of violations
+DEFAULT_EXCLUDE = ("tests/lint_fixtures",)
+BASELINE_NAME = "ptdlint_baseline.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "paths", nargs="*", default=None,
+        help=f"files/dirs to lint (default: {', '.join(DEFAULT_PATHS)})",
+    )
+    p.add_argument("--root", default=_ROOT, help="repo root")
+    p.add_argument(
+        "--baseline", default=None,
+        help=f"baseline file (default: <root>/{BASELINE_NAME})",
+    )
+    p.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable findings on stdout",
+    )
+    p.add_argument(
+        "--metrics-path", default=None,
+        help="append one split='lint' record through MetricsWriter",
+    )
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="regenerate the baseline from current findings (entries "
+             "get a FILL-ME justification; shrink-only policy applies "
+             "from then on)",
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    rules = default_rules()
+    if args.rules:
+        wanted = {r.strip().upper() for r in args.rules.split(",")}
+        unknown = wanted - {r.rule_id for r in rules}
+        if unknown:
+            print(f"unknown rule id(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.rule_id in wanted]
+    analyzer = Analyzer(args.root, rules, exclude=DEFAULT_EXCLUDE)
+    paths = args.paths or list(DEFAULT_PATHS)
+    findings = analyzer.run(paths)
+
+    baseline_path = args.baseline or os.path.join(args.root, BASELINE_NAME)
+    if args.write_baseline:
+        if args.rules or args.paths:
+            # a scoped run sees only a subset of findings; regenerating
+            # from it would silently delete every out-of-scope entry
+            # (and its hand-written justification)
+            print(
+                "--write-baseline only works on the full default sweep "
+                "(no --rules, no explicit paths): a scoped regeneration "
+                "would drop every out-of-scope entry",
+                file=sys.stderr,
+            )
+            return 2
+        entries = {
+            f.fingerprint(): BaselineEntry(
+                rule=f.rule_id, path=f.path, line_text=f.line_text,
+                justification="FILL-ME: one-line justification",
+            )
+            for f in findings  # one entry per fingerprint: identical
+            if f.rule_id != PARSE_ERROR_RULE  # never baselineable
+        }                      # line texts in one file share it
+        Baseline(list(entries.values())).save(baseline_path)
+        print(
+            f"wrote {len(entries)} entr{'y' if len(entries) == 1 else 'ies'}"
+            f" to {baseline_path} — fill in every justification",
+        )
+        return 0
+    baseline = Baseline.load(baseline_path)
+    if args.rules:
+        # a rule-subset run judges staleness only for entries its rules
+        # could have matched; the rest are out of scope, not stale
+        active = {r.rule_id for r in rules}
+        baseline = Baseline(
+            [e for e in baseline.entries if e.rule in active]
+        )
+    new, grandfathered, stale = baseline.apply(findings)
+    parse_errors = [f for f in new if f.rule_id == PARSE_ERROR_RULE]
+
+    counts: dict = {}
+    for f in new:
+        counts[f.rule_id] = counts.get(f.rule_id, 0) + 1
+    ok = not new and not stale
+    result = {
+        "ok": ok,
+        "paths": paths,
+        "findings": [f.to_dict() for f in new],
+        "baselined": [f.to_dict() for f in grandfathered],
+        "stale_baseline": [
+            {"rule": e.rule, "path": e.path, "line_text": e.line_text}
+            for e in stale
+        ],
+        "counts": {
+            "new": len(new),
+            "baselined": len(grandfathered),
+            "stale_baseline": len(stale),
+            "parse_errors": len(parse_errors),
+            **{f"rule.{k}": v for k, v in sorted(counts.items())},
+        },
+    }
+
+    if args.metrics_path:
+        _write_metrics(args.metrics_path, result)
+
+    if args.as_json:
+        json.dump(result, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        for f in new:
+            print(f"{f.path}:{f.line}: {f.rule_id} {f.message}")
+        if stale:
+            print(
+                f"\n{len(stale)} stale baseline entr"
+                f"{'y' if len(stale) == 1 else 'ies'} (shrink-only policy:"
+                f" remove from {os.path.basename(baseline_path)}):"
+            )
+            for e in stale:
+                print(f"  {e.rule} {e.path}: {e.line_text!r}")
+        print(
+            f"ptdlint: {len(new)} finding(s), "
+            f"{len(grandfathered)} baselined, {len(stale)} stale "
+            f"baseline entr{'y' if len(stale) == 1 else 'ies'}"
+        )
+    return 0 if ok else 1
+
+
+def _write_metrics(path: str, result: dict) -> None:
+    """One split='lint' JSONL record via the MetricsWriter protocol, so
+    finding counts are trackable across PRs in the same stream every
+    other subsystem reports through (lazy import: pulls the runtime)."""
+    from pytorch_distributed_tpu.train.metrics import MetricsWriter
+
+    with MetricsWriter(path) as w:
+        w.write(
+            0,
+            {"event": "ptdlint", **result["counts"]},
+            split="lint",
+        )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

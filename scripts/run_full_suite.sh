@@ -15,9 +15,17 @@
 # Usage:  flock /tmp/ptd_bench.lock scripts/run_full_suite.sh
 set -u
 cd "$(dirname "$0")/.."
+# static analysis first: ptdlint is seconds (no jax import) and a
+# distributed-correctness finding stops the run HERE, before 30 min of
+# batches — nonzero on non-baselined findings or stale baseline entries
+echo "=== ptdlint"
+if ! python scripts/ptd_lint.py; then
+  echo "=== ptdlint FAILED — fix findings (or baseline with a justification) before running the batches"
+  exit 1
+fi
+total_rc=0
 mapfile -t FILES < <(ls tests/test_*.py | sort)
 BATCH=5
-total_rc=0
 i=0
 while [ $i -lt ${#FILES[@]} ]; do
   chunk=("${FILES[@]:$i:$BATCH}")

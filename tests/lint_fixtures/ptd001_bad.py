@@ -1,0 +1,26 @@
+"""PTD001 known-bad: collectives under rank guards with no match."""
+import numpy as np
+
+
+def owner_only_broadcast(ring, rank, vec):
+    if rank == 0:
+        return ring.broadcast(vec, src=0)  # expect: PTD001
+    return vec
+
+
+def tainted_guard(ring, x):
+    is_src = ring.rank == 0
+    if is_src:
+        ring.all_reduce(x)  # expect: PTD001
+
+
+def mismatched_branches(ring, rank):
+    if rank == 0:
+        ring.barrier()  # expect: PTD001
+    else:
+        ring.all_gather(np.ones(4))  # expect: PTD001
+
+
+def lonely_send(ring, rank, x):
+    if rank == 0:
+        ring.send(x, dst=1)  # expect: PTD001

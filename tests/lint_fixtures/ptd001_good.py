@@ -1,0 +1,51 @@
+"""PTD001 known-good twins: lockstep shapes that must stay silent."""
+import numpy as np
+
+
+def uniform_broadcast(ring, rank, vec):
+    # rank-dependent PAYLOAD, rank-independent ISSUE order: every rank
+    # enters the same collective
+    payload = vec if rank == 0 else np.zeros_like(vec)
+    return ring.broadcast(payload, src=0)
+
+
+def p2p_pair(ring, rank, x):
+    # the canonical P2P shape: src sends, the peer receives
+    if rank == 0:
+        ring.send(x, dst=1)
+    elif rank == 1:
+        return ring.recv(x, src=0)
+
+
+def p2p_exchange(ring, rank, x):
+    # a guarded group doing a full exchange among its own members:
+    # bystander ranks are free (P2P blocks only its endpoints)
+    if rank in (0, 1):
+        if rank == 0:
+            ring.send(x, dst=1)
+            return ring.recv(x, src=1)
+        got = ring.recv(x, src=0)
+        ring.send(got, dst=0)
+        return got
+
+
+def matched_branches(ring, rank, x):
+    # both branches issue the SAME collective (different args is fine:
+    # payload may differ, issue order may not)
+    if rank == 0:
+        return ring.all_reduce(x, op="sum")
+    return ring.all_reduce(np.zeros_like(x), op="sum")
+
+
+def world_guard(ring, x):
+    # world-size guards are not rank guards: every rank agrees on them
+    if ring.world_size > 1:
+        ring.barrier()
+    return x
+
+
+def subgroup_members(ptd, sub, rank, x):
+    # explicit-subgroup collective: membership IS rank-dependent by
+    # contract, only the group's ranks participate
+    if rank in (0, 2):
+        return ptd.all_reduce(x, group=sub)

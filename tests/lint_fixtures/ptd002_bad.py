@@ -1,0 +1,29 @@
+"""PTD002 known-bad: span/fault-site args computed while disarmed."""
+from pytorch_distributed_tpu.runtime import faults, tracing
+
+
+def fetch(dataset, indices):
+    with tracing.span("ingest.fetch", n=len(indices)):  # expect: PTD002
+        return [dataset[i] for i in indices]
+
+
+def decode_tick(decoding):
+    tracing.instant("serve.tick", active=len(decoding))  # expect: PTD002
+
+
+def report(meter):
+    tracing.counter("queue_depth", meter.depth() + 1)  # expect: PTD002
+
+
+def guarded_but_wrong_side(tr, indices):
+    # args on the is-None side still evaluate when DISARMED
+    span = (
+        tracing.span("x", n=len(indices))  # expect: PTD002
+        if tr is None
+        else tracing._NULL_SPAN
+    )
+    return span
+
+
+def shard_write(path, shard_id):
+    faults.check("ckpt.write_shard", path=f"{path}/{shard_id}")  # expect: PTD002

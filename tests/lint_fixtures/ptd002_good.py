@@ -1,0 +1,39 @@
+"""PTD002 known-good twins: the disarmed-cost disciplines that pass."""
+from pytorch_distributed_tpu.runtime import faults, tracing
+
+
+def fetch(dataset, indices):
+    # the repo's canonical guarded form: args evaluate only when armed
+    span = (
+        tracing._NULL_SPAN if tracing._tracer is None
+        else tracing.span("ingest.fetch", n=len(indices))
+    )
+    with span:
+        return [dataset[i] for i in indices]
+
+
+def step():
+    # kwarg-free span: one is-None test, the shared no-op
+    with tracing.span("train.step"):
+        pass
+
+
+def trivial_args(h, status):
+    # constants / names / attribute chains are the documented cheap tier
+    with tracing.span("serve.evict", request=h.request_id,
+                      status=status.value, attempt=1):
+        pass
+
+
+def active_gate(decoding):
+    if tracing.active():
+        tracing.instant("serve.tick", active=len(decoding))
+
+
+def not_none_gate(tr, decoding):
+    if tr is not None:
+        tracing.counter("queue_depth", len(decoding) + 1)
+
+
+def shard_write(path):
+    faults.check("ckpt.write_shard", path=path)  # bare name: trivial

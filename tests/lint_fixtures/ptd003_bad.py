@@ -1,0 +1,19 @@
+"""PTD003 known-bad: fault-site names missing from KNOWN_SITES."""
+from pytorch_distributed_tpu.runtime import faults
+
+
+def save_shard(path):
+    faults.check("ckpt.writ_shard", path=path)  # expect: PTD003
+
+
+def poll():
+    return faults.fires("step.nan_typo")  # expect: PTD003
+
+
+def drill_spec():
+    with faults.injected("ckpt.swing:count=1;data.deocde:p=0.5"):  # expect: PTD003
+        pass
+
+
+def env_spec(env):
+    env["PTD_FAULTS"] = "serve.prefil:count=1"  # expect: PTD003

@@ -1,0 +1,25 @@
+"""PTD003 known-good twins: every site name is in the registry."""
+from pytorch_distributed_tpu.runtime import faults
+
+
+def save_shard(path):
+    faults.check("ckpt.write_shard", path=path)
+
+
+def poll():
+    return faults.fires("step.nan")
+
+
+def drill_spec():
+    with faults.injected("ckpt.swing:count=1;data.decode:p=0.5"):
+        pass
+
+
+def env_spec(env):
+    env["PTD_FAULTS"] = "serve.prefill:count=1;serve.decode:p=0.1"
+
+
+def dynamic_site(site, path):
+    # non-literal site names are out of the static envelope — the
+    # runtime's own registry check covers them when armed
+    faults.check(site, path=path)

@@ -1,0 +1,21 @@
+"""PTD005 known-bad: one key, two draws, no split between."""
+import jax
+
+
+def double_draw(key, shape):
+    a = jax.random.normal(key, shape)
+    b = jax.random.uniform(key, shape)  # expect: PTD005
+    return a + b
+
+
+def consumed_by_split(key, shape):
+    k1, k2 = jax.random.split(key)
+    noise = jax.random.normal(key, shape)  # expect: PTD005
+    return k1, k2, noise
+
+
+def loop_reuse(key, xs):
+    out = []
+    for x in xs:
+        out.append(x + jax.random.normal(key, x.shape))  # expect: PTD005
+    return out

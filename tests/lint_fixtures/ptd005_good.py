@@ -1,0 +1,41 @@
+"""PTD005 known-good twins: split/fold_in discipline that must pass."""
+import jax
+
+
+def split_first(key, shape):
+    k_a, k_b = jax.random.split(key)
+    a = jax.random.normal(k_a, shape)
+    b = jax.random.uniform(k_b, shape)
+    return a + b
+
+
+def chain_reassign(key, shape):
+    # the generate() idiom: consume-and-rebind per step
+    key, sub = jax.random.split(key)
+    a = jax.random.normal(sub, shape)
+    key, sub = jax.random.split(key)
+    b = jax.random.uniform(sub, shape)
+    return a + b
+
+
+def fold_in_derivation(key, shape):
+    # fold_in is a derivation, not a consumption — per-index streams
+    # off one base key are the idiom (train/losses.py cutmix boxes)
+    cy = jax.random.uniform(key)
+    cx = jax.random.uniform(jax.random.fold_in(key, 1))
+    return cy, cx, shape
+
+
+def branch_exclusive(key, shape, greedy):
+    # mutually exclusive arms: only one draw executes
+    if greedy:
+        return jax.random.normal(key, shape)
+    return jax.random.uniform(key, shape)
+
+
+def loop_rebind(key, xs):
+    out = []
+    for x in xs:
+        key, sub = jax.random.split(key)
+        out.append(x + jax.random.normal(sub, x.shape))
+    return out

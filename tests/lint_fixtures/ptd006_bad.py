@@ -1,0 +1,21 @@
+"""PTD006 known-bad: donated buffers read after the donating call."""
+import jax
+
+step = jax.jit(lambda state, batch: state, donate_argnums=(0,))
+
+
+def run(state, batch):
+    new_state = step(state, batch)
+    norm = state.sum()  # expect: PTD006
+    return new_state, norm
+
+
+class Engine:
+    def __init__(self, fn):
+        self._decode = jax.jit(fn, donate_argnums=(1, 2))
+
+    def tick(self, params):
+        cache, toks = self._decode(params, self.cache, self.toks)
+        stale = self.toks + 1  # expect: PTD006
+        self.cache, self.toks = cache, toks
+        return stale

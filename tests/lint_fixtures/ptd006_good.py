@@ -1,0 +1,26 @@
+"""PTD006 known-good twins: donated buffers rebound before any read."""
+import jax
+
+step = jax.jit(lambda state, batch: state, donate_argnums=(0,))
+eager_step = jax.jit(lambda state, batch: state)  # no donation
+
+
+def run(state, batch):
+    state = step(state, batch)  # rebind kills the stale reference
+    return state, state.sum()
+
+
+def no_donation(state, batch):
+    out = eager_step(state, batch)
+    return out, state.sum()  # state was not donated
+
+
+class Engine:
+    def __init__(self, fn):
+        self._decode = jax.jit(fn, donate_argnums=(1, 2))
+
+    def tick(self, params):
+        # the engine idiom: every donated row rebinds in the call's own
+        # assignment, reads come after
+        self.cache, self.toks = self._decode(params, self.cache, self.toks)
+        return self.toks + 1

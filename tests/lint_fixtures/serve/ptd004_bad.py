@@ -1,0 +1,14 @@
+"""PTD004 known-bad: eager scatter updates on the serving hot path."""
+import jax.numpy as jnp
+
+
+def configure_slot(temps, slot, temp):
+    # eager dispatch: ~2.4 ms each on this box
+    return temps.at[slot].set(temp)  # expect: PTD004
+
+
+def advance(lengths, slot):
+    return lengths.at[slot].add(1)  # expect: PTD004
+
+
+MODULE_LEVEL = jnp.zeros(8).at[0].set(1.0)  # expect: PTD004

@@ -1,0 +1,40 @@
+"""PTD004 known-good twins: the same updates fused into jit."""
+import functools
+
+import jax
+
+
+@jax.jit
+def configure_slot(temps, slot, temp):
+    return temps.at[slot].set(temp)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def advance(lengths, slot, stride):
+    return lengths.at[slot].add(stride)
+
+
+def _admit_rows_fn(temps, top_ks, slot, temp, top_k):
+    # wrapped below via jax.jit(_admit_rows_fn): the engine.py idiom
+    return temps.at[slot].set(temp), top_ks.at[slot].set(top_k)
+
+
+def _persist_row(keys, slot, pair):
+    # not wrapped itself, but called from a jitted function in this
+    # module: traced under the same jit
+    return keys.at[slot].set(pair)
+
+
+admit_rows = jax.jit(_admit_rows_fn)
+
+
+class Engine:
+    def __init__(self):
+        # the bound-method form the serve engine uses
+        self._decode = jax.jit(self._decode_fn, donate_argnums=())
+
+    def _decode_fn(self, keys, slot, pair):
+        return _persist_row(keys, slot, pair)
+
+
+park_cursor = jax.jit(lambda lengths, slot: lengths.at[slot].set(0))

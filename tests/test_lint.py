@@ -1,0 +1,572 @@
+"""ptdlint: the analyzer is itself tested, not trusted.
+
+Three layers, all tier-1 fast (no jax needed except the one
+MetricsWriter protocol check, which rides the already-imported runtime):
+
+* fixtures corpus — every rule fires on its known-bad snippet at
+  exactly the ``# expect:``-marked lines and stays silent on the
+  known-good twin (tests/lint_fixtures/);
+* the real tree — the default sweep is clean against the baseline, the
+  lockstep rule passes runtime/hostring.py + parallel/ddp.py as-is and
+  catches a rank-guarded collective injected into a copy;
+* the framework — suppression comments, shrink-only baseline,
+  content-addressed matching, CLI exit codes / --json / metrics record,
+  and the faults-registry runtime warning the static rule pairs with.
+"""
+
+import contextlib
+import json
+import logging
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from pytorch_distributed_tpu.analysis import (
+    Analyzer,
+    Baseline,
+    BaselineEntry,
+    Finding,
+    default_rules,
+)
+from pytorch_distributed_tpu.analysis.core import ParsedModule
+from pytorch_distributed_tpu.analysis.rules import (
+    ALL_RULES,
+    DonationAfterUse,
+    EagerScatterHotPath,
+    FaultSiteRegistry,
+    LockstepCollectives,
+    PrngKeyReuse,
+)
+
+pytestmark = pytest.mark.lint
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(ROOT, "tests", "lint_fixtures")
+CLI = os.path.join(ROOT, "scripts", "ptd_lint.py")
+
+RULE_IDS = tuple(cls.rule_id for cls in ALL_RULES)
+
+
+@contextlib.contextmanager
+def ptd_caplog(caplog, level="WARNING"):
+    """Route the repo's namespace logger (propagate=False, own handler)
+    into caplog, which only listens on the root logger."""
+    ns = logging.getLogger("pytorch_distributed_tpu")
+    ns.addHandler(caplog.handler)
+    try:
+        with caplog.at_level(level, logger="pytorch_distributed_tpu"):
+            yield caplog
+    finally:
+        ns.removeHandler(caplog.handler)
+
+
+def lint_paths(paths, root=ROOT, rules=None):
+    return Analyzer(root, rules or default_rules()).run(paths)
+
+
+def lint_source(source, relpath="pytorch_distributed_tpu/mod.py",
+                rules=None):
+    module = ParsedModule("/" + relpath, relpath, source)
+    out = []
+    for rule in rules or default_rules():
+        if rule.applies_to(module):
+            out.extend(
+                f for f in rule.check(module)
+                if not module.is_suppressed(f)
+            )
+    return out
+
+
+def expected_lines(path):
+    """The ``# expect: PTD00N`` markers baked into a bad fixture."""
+    out = {}
+    with open(path) as f:
+        for i, line in enumerate(f, start=1):
+            m = re.search(r"#\s*expect:\s*(PTD\d+)", line)
+            if m:
+                out.setdefault(m.group(1), set()).add(i)
+    return out
+
+
+def _fixture_pairs():
+    pairs = []
+    for dirpath, _, names in os.walk(FIXTURES):
+        for name in sorted(names):
+            if name.endswith("_bad.py"):
+                bad = os.path.join(dirpath, name)
+                good = bad.replace("_bad.py", "_good.py")
+                pairs.append((bad, good))
+    return pairs
+
+
+class TestFixturesCorpus:
+    def test_corpus_covers_every_rule(self):
+        covered = set()
+        for bad, _ in _fixture_pairs():
+            covered.update(expected_lines(bad))
+        assert covered == set(RULE_IDS)
+
+    @pytest.mark.parametrize(
+        "bad,good", _fixture_pairs(),
+        ids=[os.path.basename(b) for b, _ in _fixture_pairs()],
+    )
+    def test_bad_fires_good_silent(self, bad, good):
+        expect = expected_lines(bad)
+        assert expect, f"{bad} carries no # expect markers"
+        found = lint_paths([bad])
+        got = {}
+        for f in found:
+            got.setdefault(f.rule_id, set()).add(f.line)
+        # exactly the marked lines — no misses, no extra noise
+        assert got == expect, (
+            f"{os.path.basename(bad)}: expected {expect}, got {got}"
+        )
+        silent = lint_paths([good])
+        assert silent == [], (
+            f"{os.path.basename(good)} must lint clean, got "
+            f"{[(f.rule_id, f.line) for f in silent]}"
+        )
+
+
+class TestRealTree:
+    def test_default_sweep_clean_against_baseline(self):
+        """The acceptance gate, in-process: zero non-baselined findings
+        over the package + scripts + bench + tests, zero stale baseline
+        entries."""
+        findings = Analyzer(
+            ROOT, default_rules(), exclude=("tests/lint_fixtures",)
+        ).run(["pytorch_distributed_tpu", "scripts", "bench.py", "tests"])
+        # the analyzer itself doesn't apply the baseline; mirror the CLI
+        new, grandfathered, stale = Baseline.load(
+            os.path.join(ROOT, "ptdlint_baseline.json")
+        ).apply(findings)
+        assert new == [], [
+            (f.rule_id, f.path, f.line, f.message) for f in new
+        ]
+        assert stale == [], [(e.rule, e.path) for e in stale]
+        # every grandfathered entry is used AND justified (shrink-only)
+        assert grandfathered, "baseline entries exist, so some must match"
+
+    def test_fixture_corpus_is_excluded_from_sweep(self):
+        a = Analyzer(ROOT, default_rules(),
+                     exclude=("tests/lint_fixtures",))
+        files = a.collect_files(["tests"])
+        assert not any("lint_fixtures" in f for f in files)
+
+    def test_hostring_and_ddp_lockstep_clean(self):
+        """PTD001 regression pin on the two collective-bearing modules:
+        hostring issues the collectives, ddp's sync callback drives
+        them — both must stay rank-uniform as written today."""
+        findings = lint_paths(
+            [
+                "pytorch_distributed_tpu/runtime/hostring.py",
+                "pytorch_distributed_tpu/parallel/ddp.py",
+            ],
+            rules=[LockstepCollectives()],
+        )
+        assert findings == [], [(f.path, f.line) for f in findings]
+
+    def test_injected_rank_guard_is_caught(self, tmp_path):
+        """Injecting a rank-guarded collective into a copy of the real
+        module is caught — the rule defends the file it patrols, not
+        just synthetic fixtures."""
+        src = os.path.join(
+            ROOT, "pytorch_distributed_tpu", "runtime", "hostring.py"
+        )
+        target = tmp_path / "hostring.py"
+        shutil.copy(src, target)
+        with open(target, "a") as f:
+            f.write(
+                "\n\ndef _owner_only_flush(ring, vec):\n"
+                "    if ring.rank == 0:\n"
+                "        ring.broadcast(vec, src=0)\n"
+            )
+        findings = lint_paths(
+            [str(target)], root=str(tmp_path),
+            rules=[LockstepCollectives()],
+        )
+        assert [f.rule_id for f in findings] == ["PTD001"]
+        assert "broadcast" in findings[0].message
+        # and the uninjected copy is clean (the finding IS the injection)
+        clean = tmp_path / "clean.py"
+        shutil.copy(src, clean)
+        assert lint_paths(
+            [str(clean)], root=str(tmp_path),
+            rules=[LockstepCollectives()],
+        ) == []
+
+
+class TestSuppression:
+    SRC = (
+        "from pytorch_distributed_tpu.runtime import tracing\n"
+        "def f(xs):\n"
+        "    tracing.instant('x', n=len(xs)){}\n"
+    )
+
+    def test_unsuppressed_fires(self):
+        assert [f.rule_id for f in lint_source(self.SRC.format(""))] == [
+            "PTD002"
+        ]
+
+    def test_trailing_comment_suppresses(self):
+        src = self.SRC.format("  # ptdlint: disable=PTD002")
+        assert lint_source(src) == []
+
+    def test_comment_above_suppresses(self):
+        src = (
+            "from pytorch_distributed_tpu.runtime import tracing\n"
+            "def f(xs):\n"
+            "    # ptdlint: disable=PTD002\n"
+            "    tracing.instant('x', n=len(xs))\n"
+        )
+        assert lint_source(src) == []
+
+    def test_wrong_rule_id_does_not_suppress(self):
+        src = self.SRC.format("  # ptdlint: disable=PTD001")
+        assert [f.rule_id for f in lint_source(src)] == ["PTD002"]
+
+    def test_disable_all(self):
+        src = self.SRC.format("  # ptdlint: disable=all")
+        assert lint_source(src) == []
+
+
+class TestBaseline:
+    def _finding(self, line_text="tracing.instant('x', n=len(xs))"):
+        return Finding(
+            rule_id="PTD002", path="pkg/mod.py", line=3,
+            message="m", line_text=line_text,
+        )
+
+    def _entry(self, **kw):
+        base = dict(
+            rule="PTD002", path="pkg/mod.py",
+            line_text="tracing.instant('x', n=len(xs))",
+            justification="grandfathered for the test",
+        )
+        base.update(kw)
+        return BaselineEntry(**base)
+
+    def test_content_addressed_match_ignores_line_number(self):
+        new, grandfathered, stale = Baseline([self._entry()]).apply(
+            [self._finding()]
+        )
+        assert new == [] and len(grandfathered) == 1 and stale == []
+
+    def test_one_entry_covers_identical_line_texts(self):
+        f1, f2 = self._finding(), self._finding()
+        new, grandfathered, _ = Baseline([self._entry()]).apply([f1, f2])
+        assert new == [] and len(grandfathered) == 2
+
+    def test_stale_entry_reported(self):
+        new, _, stale = Baseline(
+            [self._entry(line_text="gone_from_the_tree()")]
+        ).apply([self._finding()])
+        assert len(new) == 1 and len(stale) == 1
+
+    def test_roundtrip_and_validation(self, tmp_path):
+        p = tmp_path / "baseline.json"
+        Baseline([self._entry()]).save(str(p))
+        loaded = Baseline.load(str(p))
+        assert [e.key() for e in loaded.entries] == [self._entry().key()]
+        # an unjustified grandfather is refused at load
+        doc = json.loads(p.read_text())
+        doc["entries"][0]["justification"] = "  "
+        p.write_text(json.dumps(doc))
+        with pytest.raises(ValueError, match="justification"):
+            Baseline.load(str(p))
+
+    def test_missing_file_is_empty(self, tmp_path):
+        assert Baseline.load(str(tmp_path / "nope.json")).entries == []
+
+    def test_fill_me_placeholder_is_refused(self, tmp_path):
+        """--write-baseline's placeholder does not count as a
+        justification: committing the file unedited must fail loudly."""
+        p = tmp_path / "baseline.json"
+        Baseline([self._entry(
+            justification="FILL-ME: one-line justification"
+        )]).save(str(p))
+        with pytest.raises(ValueError, match="FILL-ME"):
+            Baseline.load(str(p))
+
+    def test_parse_errors_are_never_grandfathered(self, tmp_path):
+        """A baselined PTD000 would exempt the file from EVERY rule
+        forever — refused at load, and ignored by apply even if an
+        in-memory baseline carries one."""
+        p = tmp_path / "baseline.json"
+        Baseline([self._entry(
+            rule="PTD000", line_text="def f(:"
+        )]).save(str(p))
+        with pytest.raises(ValueError, match="cannot be baselined"):
+            Baseline.load(str(p))
+        parse_finding = Finding(
+            rule_id="PTD000", path="pkg/mod.py", line=1,
+            message="file does not parse", line_text="def f(:",
+        )
+        new, grandfathered, _ = Baseline(
+            [self._entry(rule="PTD000", line_text="def f(:")]
+        ).apply([parse_finding])
+        assert grandfathered == [] and new == [parse_finding]
+
+
+def _run_cli(*args, cwd=ROOT):
+    return subprocess.run(
+        [sys.executable, CLI, *args],
+        capture_output=True, text=True, cwd=cwd,
+    )
+
+
+class TestCLI:
+    def test_default_sweep_exits_zero(self):
+        res = _run_cli("--json")
+        assert res.returncode == 0, res.stdout + res.stderr
+        doc = json.loads(res.stdout)
+        assert doc["ok"] is True
+        assert doc["findings"] == []
+        assert doc["counts"]["stale_baseline"] == 0
+        # the grandfathered entries are visible, not hidden
+        assert doc["counts"]["baselined"] == len(doc["baselined"]) > 0
+
+    def test_findings_exit_nonzero_with_json(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        shutil.copy(
+            os.path.join(FIXTURES, "ptd001_bad.py"), pkg / "bad.py"
+        )
+        res = _run_cli(
+            "--root", str(tmp_path), "--json",
+            "--baseline", str(tmp_path / "baseline.json"), "pkg",
+        )
+        assert res.returncode == 1
+        doc = json.loads(res.stdout)
+        assert doc["ok"] is False
+        assert doc["counts"]["rule.PTD001"] == doc["counts"]["new"] > 0
+        for f in doc["findings"]:
+            assert f["rule_id"] == "PTD001" and f["path"] == "pkg/bad.py"
+
+    def test_stale_baseline_exits_nonzero(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "clean.py").write_text("x = 1\n")
+        baseline = tmp_path / "baseline.json"
+        Baseline([BaselineEntry(
+            rule="PTD001", path="pkg/clean.py",
+            line_text="never_there()", justification="stale on purpose",
+        )]).save(str(baseline))
+        res = _run_cli(
+            "--root", str(tmp_path), "--baseline", str(baseline), "pkg",
+        )
+        assert res.returncode == 1
+        assert "stale baseline" in res.stdout
+
+    def test_parse_error_is_a_finding(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "broken.py").write_text("def f(:\n")
+        res = _run_cli(
+            "--root", str(tmp_path), "--json",
+            "--baseline", str(tmp_path / "baseline.json"), "pkg",
+        )
+        assert res.returncode == 1
+        doc = json.loads(res.stdout)
+        assert doc["counts"]["parse_errors"] == 1
+        assert doc["findings"][0]["rule_id"] == "PTD000"
+
+    def test_rule_filter(self, tmp_path):
+        res = _run_cli("--rules", "PTD999")
+        assert res.returncode == 2
+        res = _run_cli("--rules", "ptd001", "--json")
+        assert res.returncode == 0  # subset of a clean sweep
+
+    def test_write_baseline_refuses_scoped_runs(self, tmp_path):
+        """A scoped regeneration would silently delete every
+        out-of-scope entry (and its hand-written justification)."""
+        baseline = str(tmp_path / "b.json")
+        for scope in (("--rules", "PTD001"), ("tests",)):
+            res = _run_cli("--baseline", baseline, "--write-baseline",
+                           *scope)
+            assert res.returncode == 2, res.stderr
+            assert "scoped" in res.stderr
+            assert not os.path.exists(baseline)
+
+    def test_metrics_record_rides_the_jsonl_protocol(self, tmp_path):
+        """--json output rides MetricsWriter (split='lint') so finding
+        counts are trackable across PRs. In-process: the subprocess
+        route would pay a fresh jax import for one record."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("ptd_lint", CLI)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        path = str(tmp_path / "metrics.jsonl")
+        mod._write_metrics(path, {
+            "counts": {"new": 0, "baselined": 5, "stale_baseline": 0,
+                       "parse_errors": 0},
+        })
+        from pytorch_distributed_tpu.train.metrics import read_metrics
+
+        recs = [
+            r for r in read_metrics(path) if r.get("split") == "lint"
+        ]
+        assert len(recs) == 1
+        assert recs[0]["event"] == "ptdlint"
+        assert recs[0]["baselined"] == 5 and recs[0]["new"] == 0
+
+
+class TestRuleEnvelopes:
+    """Targeted pins on the judgment calls inside individual rules."""
+
+    def test_ptd001_taint_through_assignment(self):
+        src = (
+            "def f(ring, src):\n"
+            "    is_src = ring.rank == src\n"
+            "    owner = is_src and True\n"
+            "    if owner:\n"
+            "        ring.barrier()\n"
+        )
+        fs = lint_source(src, rules=[LockstepCollectives()])
+        assert [f.rule_id for f in fs] == ["PTD001"]
+
+    def test_ptd001_rank_guard_nested_under_else_is_judged(self):
+        """A rank guard indented under `else:` is NOT an elif arm: its
+        own missing branch is a real divergence even when the parent's
+        arms happen to contain matching ops (ranks >= 2 here never
+        issue the collective)."""
+        src = (
+            "def f(ring, rank, x):\n"
+            "    if rank == 0:\n"
+            "        ring.all_reduce(x)\n"
+            "    else:\n"
+            "        if rank == 1:\n"
+            "            ring.all_reduce(x)\n"
+        )
+        fs = lint_source(src, rules=[LockstepCollectives()])
+        assert [f.rule_id for f in fs] == ["PTD001"]
+        # the same chain as a TRUE elif stays clean for P2P pairs
+        src_elif = (
+            "def f(ring, rank, x):\n"
+            "    if rank == 0:\n"
+            "        ring.send(x, dst=1)\n"
+            "    elif rank == 1:\n"
+            "        ring.recv(x, src=0)\n"
+        )
+        assert lint_source(src_elif, rules=[LockstepCollectives()]) == []
+
+    def test_ptd001_early_return_is_implicit_else(self):
+        src = (
+            "def f(ring, rank, x):\n"
+            "    if rank == 0:\n"
+            "        return ring.all_reduce(x)\n"
+            "    return ring.all_reduce(x)\n"
+        )
+        assert lint_source(src, rules=[LockstepCollectives()]) == []
+
+    def test_ptd003_registry_parsed_from_faults_source(self):
+        from pytorch_distributed_tpu.runtime import faults
+
+        assert FaultSiteRegistry().registry == set(faults.KNOWN_SITES)
+
+    def test_ptd004_respects_path_filter(self):
+        src = "import jax.numpy as jnp\nx = jnp.zeros(4).at[0].set(1.0)\n"
+        hot = lint_source(
+            src, relpath="pytorch_distributed_tpu/serve/mod.py",
+            rules=[EagerScatterHotPath()],
+        )
+        assert [f.rule_id for f in hot] == ["PTD004"]
+        cold = lint_source(
+            src, relpath="pytorch_distributed_tpu/models/mod.py",
+            rules=[EagerScatterHotPath()],
+        )
+        assert cold == []
+
+    def test_ptd004_engine_jit_wrap_recognized(self):
+        """The real engine pattern: methods jitted in __init__, row
+        updates inside them — stays clean (the fix PR 3 shipped)."""
+        fs = lint_paths(
+            ["pytorch_distributed_tpu/serve/engine.py"],
+            rules=[EagerScatterHotPath()],
+        )
+        assert fs == []
+
+    def test_ptd005_branches_do_not_pair(self):
+        src = (
+            "import jax\n"
+            "def f(key, g):\n"
+            "    if g:\n"
+            "        return jax.random.normal(key)\n"
+            "    else:\n"
+            "        return jax.random.uniform(key)\n"
+        )
+        assert lint_source(src, rules=[PrngKeyReuse()]) == []
+
+    def test_ptd005_numpy_random_is_ignored(self):
+        src = (
+            "import numpy as np\n"
+            "def f(xs):\n"
+            "    a = np.random.permutation(xs)\n"
+            "    b = np.random.permutation(xs)\n"
+            "    return a, b\n"
+        )
+        assert lint_source(src, rules=[PrngKeyReuse()]) == []
+
+    def test_ptd006_same_statement_rebind_is_clean(self):
+        src = (
+            "import jax\n"
+            "step = jax.jit(lambda s, b: s, donate_argnums=(0,))\n"
+            "def run(state, batch):\n"
+            "    state = step(state, batch)\n"
+            "    return state.sum()\n"
+        )
+        assert lint_source(src, rules=[DonationAfterUse()]) == []
+
+    def test_ptd006_conditional_donation_counts(self):
+        src = (
+            "import jax\n"
+            "step = jax.jit(lambda s, b: s,\n"
+            "               donate_argnums=(0,) if True else ())\n"
+            "def run(state, batch):\n"
+            "    out = step(state, batch)\n"
+            "    return out, state.sum()\n"
+        )
+        fs = lint_source(src, rules=[DonationAfterUse()])
+        assert [f.rule_id for f in fs] == ["PTD006"]
+
+
+class TestFaultsRegistryRuntime:
+    """The runtime half of PTD003: a typo'd site name must be loud."""
+
+    def test_unknown_site_warns_once_when_armed(self, caplog):
+        from pytorch_distributed_tpu.runtime import faults
+
+        faults._warned_unknown_sites.discard("step.typo")
+        with faults.injected("step.nan:count=1"):
+            with ptd_caplog(caplog):
+                # the typo is the point here  # ptdlint: disable=PTD003
+                assert faults.fires("step.typo") is False
+                faults.check("step.typo")  # ptdlint: disable=PTD003
+        warned = [
+            r for r in caplog.records if "not in KNOWN_SITES" in r.message
+        ]
+        assert len(warned) == 1  # once per name, not per check
+        assert "step.typo" in warned[0].getMessage()
+
+    def test_unknown_site_silent_when_disarmed(self, caplog):
+        from pytorch_distributed_tpu.runtime import faults
+
+        faults._warned_unknown_sites.discard("step.other_typo")
+        assert not faults.active()
+        with ptd_caplog(caplog):
+            # ptdlint: disable=PTD003
+            assert faults.fires("step.other_typo") is False
+        assert not any(
+            "not in KNOWN_SITES" in r.message for r in caplog.records
+        )
+
+    def test_arming_unknown_site_still_raises(self):
+        from pytorch_distributed_tpu.runtime import faults
+
+        with pytest.raises(ValueError, match="unknown fault site"):
+            faults.FaultPlan.parse("ckpt.writ_shard:count=1")
