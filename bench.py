@@ -946,6 +946,70 @@ def bench_observability() -> None:
     )
 
 
+def bench_planning() -> None:
+    """Auto-parallel planner wall time over the reference config sweep.
+
+    Planning is pure host-side shape/float arithmetic (eval_shape only
+    — zero compiles by design, the child asserts it by stubbing
+    ``jax.jit``), so its wall time is host-meaningful on any backend.
+    The sweep runs in a CHILD with a virtual 8-device world: candidate
+    enumeration over one device (the bench fallback environment) would
+    time a degenerate single-candidate plan. The child's own
+    perf_counter window covers planning only — interpreter start, jax
+    import and model eval_shape are excluded, because the budget this
+    phase enforces is the planner's marginal cost per `--strategy auto`
+    run, not python's.
+    """
+    import subprocess
+
+    code = (
+        "import json, sys\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from pytorch_distributed_tpu import autoplan\n"
+        "def _no_jit(*a, **k):\n"
+        "    raise RuntimeError('planning must never compile')\n"
+        "jax.jit = _no_jit\n"
+        "res = autoplan.reference_sweep()\n"
+        "print('PLANSWEEP ' + json.dumps(res))\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"planning sweep child failed: {proc.stderr[-2000:]}"
+        )
+    line = next(
+        l for l in proc.stdout.splitlines() if l.startswith("PLANSWEEP ")
+    )
+    res = json.loads(line[len("PLANSWEEP "):])
+    _emit({
+        "metric": "planning_wall_s",
+        "value": res["wall_s"],
+        "unit": "seconds to plan 2 reference configs (gpt2-tiny, "
+        "resnet50) on a virtual 8-device mesh, eval_shape only",
+        "n_devices": res["n_devices"],
+        "chosen": {
+            name: c["chosen"] for name, c in res["configs"].items()
+        },
+        "vs_baseline": None,
+    })
+    for name, c in res["configs"].items():
+        print(
+            f"# planning: {name} -> {c['chosen']} over "
+            f"{c['n_candidates']} candidates"
+            f"{' (uncalibrated)' if c['uncalibrated'] else ''}",
+            file=sys.stderr,
+        )
+
+
 def bench_allreduce_device(on_tpu: bool) -> None:
     """Grad-sized allreduce over the dp mesh axis (BASELINE.json:2).
 
@@ -1378,6 +1442,8 @@ def main():
         # so is the tracing-overhead ratio: traced vs untraced on the
         # same loop, same box
         run_if_budget("observability", bench_observability)
+        # planner wall time is host arithmetic — meaningful anywhere
+        run_if_budget("planning", bench_planning)
     else:
         bench_resnet50(on_tpu)
         run_if_budget("input_pipeline", bench_input_pipeline, on_tpu)
@@ -1395,6 +1461,7 @@ def main():
         run_if_budget("gpt2", bench_gpt2, on_tpu)
         run_if_budget("serving", bench_serving, on_tpu)
         run_if_budget("observability", bench_observability)
+        run_if_budget("planning", bench_planning)
     # the per-phase wall clocks as DATA (the stderr "# phase ... done"
     # notes were print-only): one record the driver's BENCH tail and
     # test_bench_contract can both parse

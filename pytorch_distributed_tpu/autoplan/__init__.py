@@ -1,0 +1,74 @@
+"""Auto-parallel planner: cost-model-driven strategy search.
+
+Given a model's abstract state (``jax.eval_shape`` — planning never
+compiles), a :class:`~pytorch_distributed_tpu.autoplan.pricing.
+ModelProfile` and the device fleet, the planner enumerates
+(mesh shape x strategy class x shape-aware partition rules) candidates,
+filters them against the per-device memory budget, prices each one's
+per-step comms through the calibrated α–β cost model
+(``scripts/collective_bench.py --fit``) plus a compute term, and emits
+a ranked, auditable ``plan.json`` and one chosen strategy — the
+machinery behind ``--strategy auto`` in the recipes.
+
+The rule engine (autoplan/rules.py) is also the production partition-
+rule substrate: ``llama_partition_rules`` / ``gpt2_partition_rules``
+are thin declarative tables over it.
+"""
+
+from pytorch_distributed_tpu.autoplan.candidates import (
+    STRATEGY_CLASSES,
+    CandidateSpec,
+    enumerate_candidates,
+)
+from pytorch_distributed_tpu.autoplan.memory import (
+    MemoryBreakdown,
+    PlanMesh,
+    account_state,
+    device_budget_bytes,
+)
+from pytorch_distributed_tpu.autoplan.planner import (
+    Plan,
+    PlanError,
+    PricedCandidate,
+    format_plan,
+    param_count,
+    plan,
+    reference_sweep,
+)
+from pytorch_distributed_tpu.autoplan.pricing import (
+    CommTerm,
+    ComputeModel,
+    ModelProfile,
+    image_profile,
+    transformer_profile,
+)
+from pytorch_distributed_tpu.autoplan.rules import (
+    TensorRule,
+    engine_rules,
+    max_divisible_tp,
+)
+
+__all__ = [
+    "STRATEGY_CLASSES",
+    "CandidateSpec",
+    "enumerate_candidates",
+    "MemoryBreakdown",
+    "PlanMesh",
+    "account_state",
+    "device_budget_bytes",
+    "Plan",
+    "PlanError",
+    "PricedCandidate",
+    "format_plan",
+    "param_count",
+    "plan",
+    "reference_sweep",
+    "CommTerm",
+    "ComputeModel",
+    "ModelProfile",
+    "image_profile",
+    "transformer_profile",
+    "TensorRule",
+    "engine_rules",
+    "max_divisible_tp",
+]

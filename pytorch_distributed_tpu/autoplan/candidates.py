@@ -1,0 +1,121 @@
+"""Candidate enumeration: mesh shapes x strategy classes.
+
+A candidate is (strategy class, data-parallel ways, tensor-parallel
+ways, optional wire compression). The data axes map onto the mesh the
+way parallel/strategies.py expects them: dp/zero1 put the data ways on
+``dp``, fsdp puts them on ``fsdp`` (so the batch still shards — both
+are batch axes — while params/opt shard over the fsdp axis). tp
+composes with any of the three via the model's TensorRules, which the
+rule engine keeps valid on every enumerated shape.
+
+Enumeration is deterministic (sorted by strategy name, then tp) so two
+runs of the planner on the same inputs produce byte-identical plans.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from pytorch_distributed_tpu.runtime.mesh import AXES, MeshSpec
+
+#: strategy-class names the planner knows how to build and price
+STRATEGY_CLASSES: Tuple[str, ...] = ("dp", "zero1", "fsdp")
+
+
+@dataclasses.dataclass(frozen=True)
+class CandidateSpec:
+    strategy: str  # one of STRATEGY_CLASSES
+    data: int  # data-parallel ways (dp or fsdp axis size)
+    tp: int = 1
+    compress: Optional[str] = None  # None | "int8" (q8 grad wire)
+
+    @property
+    def name(self) -> str:
+        n = f"{self.strategy}/dp{self.data}"
+        if self.tp > 1:
+            n += f"xtp{self.tp}"
+        if self.compress:
+            n += "+q8"
+        return n
+
+    @property
+    def n_devices(self) -> int:
+        return self.data * self.tp
+
+    def mesh_sizes(self) -> dict:
+        sizes = {a: 1 for a in AXES}
+        sizes["fsdp" if self.strategy == "fsdp" else "dp"] = self.data
+        sizes["tp"] = self.tp
+        return sizes
+
+    def mesh_spec(self) -> MeshSpec:
+        return MeshSpec(**{
+            a: s for a, s in self.mesh_sizes().items()
+        })
+
+    def strategy_class(self):
+        from pytorch_distributed_tpu.parallel import (
+            DataParallel,
+            FSDP,
+            ZeRO1,
+        )
+
+        return {"dp": DataParallel, "zero1": ZeRO1, "fsdp": FSDP}[
+            self.strategy
+        ]
+
+    def build_strategy(self, *, extra_rules=(), mesh=None):
+        """Construct the real Strategy — the CURRENT mesh must already
+        match :meth:`mesh_spec` (recipes pass the spec to
+        ``init_process_group`` first)."""
+        if self.compress:
+            # q8 lives on the multiprocess ddp.sync_grads wire path;
+            # the SPMD strategies have no compressed-gradient mode, so
+            # a q8 candidate is price-only — enumerate it only where
+            # the consumer knows that (bench/analysis sweeps)
+            raise ValueError(
+                f"{self.name} prices q8 wire compression (ddp/hostring "
+                "path); it cannot be built as an SPMD strategy"
+            )
+        return self.strategy_class()(mesh, extra_rules=extra_rules)
+
+
+def enumerate_candidates(
+    n_devices: int,
+    *,
+    strategies: Sequence[str] = STRATEGY_CLASSES,
+    tp_candidates: Optional[Sequence[int]] = None,
+    max_tp: Optional[int] = None,
+    include_q8: bool = False,
+) -> List[CandidateSpec]:
+    """All (strategy, mesh shape) candidates for ``n_devices``.
+
+    ``tp_candidates`` restricts tensor-parallel widths (recipes pass
+    the divisors of the model's head count via
+    ``rules.max_divisible_tp``); default is every divisor of the device
+    count. Degenerate duplicates are collapsed: at data==1 the three
+    strategy classes place identically, so only the ``dp`` form is
+    emitted. ``include_q8`` adds an int8-compressed-gradient variant of
+    each dp candidate (the hostring/ddp wire-compression path).
+    """
+    unknown = set(strategies) - set(STRATEGY_CLASSES)
+    if unknown:
+        raise ValueError(f"unknown strategy classes {sorted(unknown)}")
+    tps = [
+        t for t in range(1, n_devices + 1)
+        if n_devices % t == 0
+        and (tp_candidates is None or t in tp_candidates)
+        and (max_tp is None or t <= max_tp)
+    ]
+    out: List[CandidateSpec] = []
+    for strategy in sorted(strategies):
+        for tp in tps:
+            data = n_devices // tp
+            if data == 1 and strategy != "dp":
+                continue  # replicated==sharded-over-1: same placement
+            out.append(CandidateSpec(strategy, data, tp))
+            if include_q8 and strategy == "dp" and data > 1:
+                out.append(CandidateSpec(strategy, data, tp,
+                                         compress="int8"))
+    return out

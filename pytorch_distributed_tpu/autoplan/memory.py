@@ -1,0 +1,211 @@
+"""Per-candidate memory accounting — abstract shapes only, zero compiles.
+
+The planner must price a candidate BEFORE anything is placed, let
+alone compiled, on meshes that may not even be buildable on this host
+(price a v5p-64 fleet from a laptop). So accounting runs on the
+``jax.eval_shape`` state (the kv_slots precedent: shape math, no
+throwaway compiles) against a :class:`PlanMesh` — a duck-typed stand-in
+carrying only the axis-size mapping, which is all the rule callables
+(autoplan/rules.py, ``shard_along``, ``_augment_spec_with_axis``)
+ever read. The per-leaf spec resolution is the STRATEGY'S OWN rule
+assembly (``param_rules()`` / ``opt_rules()`` + the same
+``best_param_suffix`` mismatch routing as ``infer_opt_tree_shardings``),
+so the bytes priced here are the bytes the real placement produces.
+
+Buckets, per device:
+
+* ``param_bytes`` — params + batch_stats (replicated) + the EMA shadow
+  (placed like params, by the same by-construction rule);
+* ``opt_bytes`` — optimizer state, shape-mismatched (factored) leaves
+  routed to the strategy's shape-generic fallback;
+* ``grad_bytes`` — gradients are param-shaped and live at the params'
+  placement inside the step (honest limit: FSDP's transient per-layer
+  full gradient before its reduce-scatter is NOT modeled — this is the
+  steady-state figure, same convention as the torch memory estimators);
+* ``activation_bytes`` — the model profile's per-sample estimate times
+  the per-device batch (honest limit: a coarse proxy; remat shrinks it
+  and is not modeled).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from pytorch_distributed_tpu.autoplan.rules import axes_size
+from pytorch_distributed_tpu.parallel.sharding import (
+    PartitionRules,
+    best_param_suffix,
+    path_str,
+)
+
+
+class PlanMesh:
+    """Duck-typed ``jax.sharding.Mesh`` stand-in for rule evaluation.
+
+    Everything the rule machinery touches is ``mesh.shape`` (a mapping
+    axis -> size); a real Mesh needs that many actual devices, which a
+    planner pricing hypothetical fleets does not have.
+    """
+
+    def __init__(self, sizes: Dict[str, int]):
+        self.shape = dict(sizes)
+
+    def __repr__(self) -> str:  # shows up in candidate reprs/logs
+        return f"PlanMesh({self.shape})"
+
+
+def leaf_device_bytes(shape: Tuple[int, ...], itemsize: int, spec,
+                      sizes: Dict[str, int]) -> int:
+    """Bytes one device holds of a leaf placed under ``spec``.
+
+    Mirrors NamedSharding's shard math for the divisible specs the rule
+    engine guarantees; a non-divisible entry (only reachable through a
+    hand-written rule) conservatively counts the full dim.
+    """
+    elems = 1
+    entries = tuple(spec) if spec is not None else ()
+    for i, dim in enumerate(shape):
+        entry = entries[i] if i < len(entries) else None
+        ways = axes_size(entry, sizes)
+        elems *= dim // ways if ways > 1 and dim % ways == 0 else dim
+    return int(elems) * int(itemsize)
+
+
+def _leaf_meta(leaf) -> Tuple[Tuple[int, ...], int]:
+    shape = tuple(getattr(leaf, "shape", ()) or ())
+    dtype = getattr(leaf, "dtype", None)
+    itemsize = np.dtype(dtype).itemsize if dtype is not None else 4
+    return shape, itemsize
+
+
+def tree_device_bytes(tree, rules: PartitionRules,
+                      mesh_like: PlanMesh) -> Tuple[int, int]:
+    """(global_bytes, per_device_bytes) over a pytree of abstract leaves."""
+    total = dev = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        shape, itemsize = _leaf_meta(leaf)
+        spec = rules.spec_for(path_str(path), shape, mesh_like)
+        total += math.prod(shape) * itemsize if shape else itemsize
+        dev += leaf_device_bytes(shape, itemsize, spec, mesh_like.shape)
+    return total, dev
+
+
+def opt_device_bytes(opt_state, params, rules: PartitionRules,
+                     mismatch_rules: PartitionRules,
+                     mesh_like: PlanMesh) -> int:
+    """Per-device optimizer-state bytes with the mismatch routing of
+    ``infer_opt_tree_shardings``: param-shaped leaves take the path
+    rules, rank-reduced (factored) leaves take the shape-generic
+    fallback — same split, same suffix matcher."""
+    param_shapes = {
+        path_str(p): tuple(l.shape)
+        for p, l in jax.tree_util.tree_leaves_with_path(params)
+        if hasattr(l, "shape")
+    }
+    dev = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(opt_state):
+        shape, itemsize = _leaf_meta(leaf)
+        p = path_str(path)
+        best = best_param_suffix(param_shapes, p)
+        r = (
+            mismatch_rules
+            if best is not None and shape != param_shapes[best]
+            else rules
+        )
+        spec = r.spec_for(p, shape, mesh_like)
+        dev += leaf_device_bytes(shape, itemsize, spec, mesh_like.shape)
+    return dev
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryBreakdown:
+    """Per-device bytes for one candidate (see module docstring)."""
+
+    param_bytes: int
+    opt_bytes: int
+    grad_bytes: int
+    activation_bytes: int
+    params_global_bytes: int  # unsharded model size, for reference
+
+    @property
+    def total_bytes(self) -> int:
+        return (self.param_bytes + self.opt_bytes + self.grad_bytes
+                + self.activation_bytes)
+
+    def to_dict(self) -> dict:
+        return {
+            "param_bytes": self.param_bytes,
+            "opt_bytes": self.opt_bytes,
+            "grad_bytes": self.grad_bytes,
+            "activation_bytes": self.activation_bytes,
+            "total_bytes": self.total_bytes,
+            "params_global_bytes": self.params_global_bytes,
+        }
+
+
+def account_state(abstract_state, strategy, mesh_like: PlanMesh,
+                  activation_bytes: int) -> MemoryBreakdown:
+    """Memory breakdown for ``abstract_state`` under ``strategy``.
+
+    ``strategy`` is a real Strategy instance constructed over
+    ``mesh_like`` — its ``param_rules()``/``opt_rules()`` are the
+    production rule assembly, evaluated here without any placement.
+    """
+    param_rules = strategy.param_rules()
+    opt_rules = strategy.opt_rules()
+    mismatch = PartitionRules([(".*", strategy._fallback_opt_spec())])
+
+    params_total, params_dev = tree_device_bytes(
+        abstract_state.params, param_rules, mesh_like
+    )
+    param_bytes = params_dev
+    # batch_stats / scaler_state replicate under every strategy
+    for aux in (abstract_state.batch_stats, abstract_state.scaler_state):
+        if aux is not None:
+            aux_total, _ = tree_device_bytes(
+                aux, PartitionRules([(".*", None)]), mesh_like
+            )
+            param_bytes += aux_total
+    # the EMA shadow shards exactly like params (strategies.py pins this
+    # by construction) — account it the same way
+    if getattr(abstract_state, "ema_params", None) is not None:
+        _, ema_dev = tree_device_bytes(
+            abstract_state.ema_params, param_rules, mesh_like
+        )
+        param_bytes += ema_dev
+
+    opt_dev = opt_device_bytes(
+        abstract_state.opt_state, abstract_state.params,
+        opt_rules, mismatch, mesh_like,
+    )
+    return MemoryBreakdown(
+        param_bytes=int(param_bytes),
+        opt_bytes=int(opt_dev),
+        grad_bytes=int(params_dev),
+        activation_bytes=int(activation_bytes),
+        params_global_bytes=int(params_total),
+    )
+
+
+def device_budget_bytes() -> Optional[int]:
+    """Per-device memory capacity, or None when no backend reports one.
+
+    TPU/GPU allocators expose ``memory_stats()['bytes_limit']`` (the
+    same source as ``compat.live_buffer_bytes``'s in-use reading);
+    XLA:CPU reports nothing — the planner then skips the feasibility
+    filter unless the caller passes an explicit budget.
+    """
+    limits = []
+    for d in jax.local_devices():
+        try:
+            s = d.memory_stats()
+        except Exception:  # pragma: no cover - backend-dependent
+            s = None
+        if s and "bytes_limit" in s:
+            limits.append(int(s["bytes_limit"]))
+    return min(limits) if limits else None

@@ -1,0 +1,256 @@
+"""Per-step pricing: comms volume x calibrated α–β model + compute term.
+
+The comms side is deliberately the SAME arithmetic the tracer records
+and the bench measures: payloads are priced through
+``CostModel.predict``, which computes NCCL-convention wire bytes via
+``hostring.algo_wire_bytes`` — the bytes the planner prices are the
+bytes a ``comm.*`` span would record for the run it predicts. q8
+gradient compression is priced at its REAL wire occupancy
+(``hostring.q8_wire_payload``: int8 + one f32 scale per 256 elems,
+~0.254x f32), so the candidate table shows the ~4x wire reduction as a
+number, not a slogan.
+
+Per-step collective volume per strategy class, per optimizer step
+(accumulation microbatches share one gradient exchange by construction
+— train/trainer.py scans them inside the jitted step):
+
+=========  ==============================================================
+dp (DDP)   1x all_reduce(grad_bytes) over the data axes
+zero1      reduce_scatter(grads) + all_gather(updated params)
+           (cross-replica weight-update sharding, arxiv 2004.13336)
+fsdp       2x all_gather(params) [fwd + bwd re-gather] +
+           reduce_scatter(grads), over the fsdp axis
+tp (any)   4 x layers x all_reduce(per-device activation slab) over tp
+           (Megatron f/g pairs, forward + backward)
+=========  ==============================================================
+
+With tp>1 the gradient payload is the per-tp-shard slice (each tp group
+reduces only its own shard). Honest limits, also printed on the plan:
+remat, overlap (compute/comms), and FSDP's per-layer pipelining are not
+modeled — this prices serialized collectives, an upper bound that ranks
+candidates correctly when they differ by volume or call count.
+
+The compute term is flops / effective-flops, with effective flops
+either calibrated from a measured step (``ComputeModel.from_measured_
+step`` — the trainer's ``step`` span or bench history) or an assumed
+per-platform default that marks the whole plan ``uncalibrated``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional, Sequence
+
+from pytorch_distributed_tpu.runtime.costmodel import CostModel
+from pytorch_distributed_tpu.runtime.hostring import q8_wire_payload
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelProfile:
+    """What pricing needs to know about the model, beyond its param tree.
+
+    ``flops_per_sample`` is the TRAIN step cost (forward + backward) per
+    sample; ``activation_bytes_per_sample`` feeds the memory filter.
+    ``layers``/``hidden``/``seq_len`` drive the tensor-parallel
+    activation-collective terms; leave them 0 for models without a TP
+    rule set (conv nets) and tp candidates simply price no tp comms.
+    """
+
+    flops_per_sample: float
+    activation_bytes_per_sample: float
+    layers: int = 0
+    hidden: int = 0
+    seq_len: int = 0
+    act_dtype_bytes: int = 4
+
+
+def transformer_profile(*, num_layers: int, hidden_size: int,
+                        seq_len: int, param_count: int,
+                        act_dtype_bytes: int = 4,
+                        act_coeff: float = 16.0) -> ModelProfile:
+    """Decoder-LM profile: 6·N flops per trained token (fwd 2N + bwd 4N,
+    the PaLM/Chinchilla accounting), activations ≈ ``act_coeff`` x
+    hidden slab per layer per token (~16 covers the block's
+    residual/norm/attention/MLP intermediates without remat)."""
+    return ModelProfile(
+        flops_per_sample=6.0 * float(param_count) * seq_len,
+        activation_bytes_per_sample=(
+            float(num_layers) * seq_len * hidden_size
+            * act_coeff * act_dtype_bytes
+        ),
+        layers=num_layers, hidden=hidden_size, seq_len=seq_len,
+        act_dtype_bytes=act_dtype_bytes,
+    )
+
+
+def image_profile(*, flops_per_sample: float,
+                  activation_bytes_per_sample: float) -> ModelProfile:
+    """Conv-net profile: caller supplies the two totals (e.g. ResNet-50
+    at 224²: ~3x4.1 GFLOPs trained, ~64 MB of f32 feature maps)."""
+    return ModelProfile(
+        flops_per_sample=float(flops_per_sample),
+        activation_bytes_per_sample=float(activation_bytes_per_sample),
+    )
+
+
+#: assumed effective per-device flops when nothing measured is available
+#: — deliberately conservative; using one marks the plan `uncalibrated`
+ASSUMED_FLOPS_PER_S = {"cpu": 5e9, "tpu": 100e12, "gpu": 50e12}
+
+
+@dataclasses.dataclass(frozen=True)
+class ComputeModel:
+    flops_per_s_per_device: float
+    source: str  # "measured-step" | "assumed-<platform>"
+
+    @property
+    def calibrated(self) -> bool:
+        return self.source == "measured-step"
+
+    @classmethod
+    def assumed(cls, platform: str) -> "ComputeModel":
+        f = ASSUMED_FLOPS_PER_S.get(platform, ASSUMED_FLOPS_PER_S["cpu"])
+        return cls(f, f"assumed-{platform}")
+
+    @classmethod
+    def from_measured_step(cls, step_seconds: float, flops_per_step: float,
+                           n_devices: int) -> "ComputeModel":
+        """Effective flops from one measured reference step — folds the
+        real MFU of this model on this backend into every candidate."""
+        if step_seconds <= 0 or flops_per_step <= 0 or n_devices <= 0:
+            raise ValueError("need positive step time, flops and devices")
+        return cls(flops_per_step / n_devices / step_seconds,
+                   "measured-step")
+
+
+@dataclasses.dataclass
+class CommTerm:
+    """One collective in a candidate's step, priced."""
+
+    op: str
+    payload_bytes: int
+    world: int
+    count: int  # issues per step
+    seconds: float = 0.0  # count x predicted per-call seconds
+    wire_bytes: int = 0  # count x per-participant wire bytes
+    extrapolated: bool = False
+    note: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def grad_comm_terms(strategy: str, grad_payload_bytes: int,
+                    grad_elems: int, data_world: int, *,
+                    compress: Optional[str] = None) -> List[CommTerm]:
+    """The gradient/param exchange for one optimizer step (table above)."""
+    if data_world <= 1:
+        return []
+    if strategy == "dp":
+        if compress == "int8":
+            return [CommTerm("all_reduce_q8",
+                             q8_wire_payload(grad_elems), data_world, 1,
+                             note="q8 wire occupancy of the f32 grads")]
+        return [CommTerm("all_reduce", grad_payload_bytes, data_world, 1)]
+    if strategy == "zero1":
+        return [
+            CommTerm("reduce_scatter", grad_payload_bytes, data_world, 1),
+            CommTerm("all_gather", grad_payload_bytes, data_world, 1,
+                     note="updated params"),
+        ]
+    if strategy == "fsdp":
+        return [
+            CommTerm("all_gather", grad_payload_bytes, data_world, 2,
+                     note="params, forward + backward re-gather"),
+            CommTerm("reduce_scatter", grad_payload_bytes, data_world, 1),
+        ]
+    raise ValueError(f"unknown strategy class {strategy!r}")
+
+
+def tp_comm_terms(profile: ModelProfile, micro_batch: int,
+                  tp_world: int, accum_steps: int = 1) -> List[CommTerm]:
+    """Megatron activation collectives: 4 all_reduce per layer per
+    microbatch — an accumulating step pays them ``accum_steps`` times
+    (same total volume as the unaccumulated step, more α calls)."""
+    if tp_world <= 1 or profile.layers <= 0 or profile.hidden <= 0:
+        return []
+    slab = (micro_batch * max(profile.seq_len, 1) * profile.hidden
+            * profile.act_dtype_bytes)
+    return [CommTerm("all_reduce", int(slab), tp_world,
+                     4 * profile.layers * max(accum_steps, 1),
+                     note="tp activation slabs")]
+
+
+def price_comm_terms(terms: Sequence[CommTerm], model: CostModel,
+                     fallback: Optional[CostModel] = None) -> List[CommTerm]:
+    """Fill in seconds/wire_bytes/extrapolated from the cost model.
+
+    Two degradation steps, both flagged in the term's note, never
+    silent: q8 falls back to the plain all_reduce fit (β is a
+    per-wire-byte transport property; the payload already carries the
+    compression) when the model was never calibrated on
+    ``all_reduce_q8``; any op the model has NO fit for at all (a
+    partial calibration — ``collective_bench`` keeps later collectives
+    running when one fails, so a model missing e.g. reduce_scatter is
+    reachable) is priced on ``fallback`` (the planner passes the
+    analytic guess) and marked ``extrapolated``. With no fallback the
+    KeyError becomes an actionable :class:`CostModelUnavailable`.
+    """
+    from pytorch_distributed_tpu.runtime.costmodel import (
+        CostModelUnavailable,
+        calibration_command,
+    )
+
+    priced = []
+    for t in terms:
+        op = t.op
+        note = t.note
+        forced_extrapolated = False
+        try:
+            p = model.predict(op, t.payload_bytes, t.world)
+        except KeyError:
+            if op == "all_reduce_q8" and any(
+                o == "all_reduce" for o, _ in model.fits
+            ):
+                p = model.predict("all_reduce", t.payload_bytes, t.world)
+                note = (note + "; " if note else "") + \
+                    "priced on the all_reduce fit (no q8 calibration)"
+            elif fallback is not None:
+                p = fallback.predict(op, t.payload_bytes, t.world)
+                forced_extrapolated = True
+                note = (note + "; " if note else "") + (
+                    f"priced analytically ({op} missing from the "
+                    f"calibrated model)"
+                )
+            else:
+                raise CostModelUnavailable(
+                    f"cost model ({model.transport}) has no fit for "
+                    f"{op!r} and no fallback — recalibrate: "
+                    f"`{calibration_command()}`"
+                ) from None
+        priced.append(dataclasses.replace(
+            t,
+            seconds=p.seconds * t.count,
+            wire_bytes=p.wire_bytes * t.count,
+            extrapolated=p.extrapolated or forced_extrapolated,
+            note=note,
+        ))
+    return priced
+
+
+def compute_seconds(profile: ModelProfile, global_batch: int,
+                    n_devices: int, compute: ComputeModel) -> float:
+    """Per-step compute: total trained flops over the fleet's effective
+    rate (tp/fsdp partition the same flops across devices; their
+    efficiency loss is not modeled — see module docstring)."""
+    flops = profile.flops_per_sample * global_batch
+    return flops / max(n_devices, 1) / compute.flops_per_s_per_device
+
+
+def wire_ratio(terms_a: Sequence[CommTerm],
+               terms_b: Sequence[CommTerm]) -> float:
+    """Total-wire-bytes ratio a/b — the q8-vs-f32 comparison number."""
+    a = sum(t.wire_bytes for t in terms_a)
+    b = sum(t.wire_bytes for t in terms_b)
+    return a / b if b else math.inf

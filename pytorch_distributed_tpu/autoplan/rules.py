@@ -1,0 +1,151 @@
+"""Shape-aware partition-rule engine: per-model rules become data.
+
+Before this module every model family hand-rolled its TP rules, and
+every mesh/shape corner became a per-model patch: the gemma MQA fix,
+the qwen2 ragged-GQA fix (4 kv heads on tp=8 must replicate, not
+crash), the scan-stacked leading layer dim. Those were all the SAME
+rule — "shard THIS dim over THESE mesh axes, but only when the dim
+divides them; otherwise replicate that dim and say so" — applied by
+hand in N places. Here it is applied by an engine, once, so partition
+rules are declarative :class:`TensorRule` rows and divisibility safety
+is a property of the engine rather than of whichever author remembered
+the incident.
+
+The auto-parallel planner (autoplan/planner.py) builds its whole
+candidate space on this: every (mesh shape x strategy) candidate gets
+valid specs by construction, for any model whose rules are expressed
+as TensorRules — no candidate can crash placement on an unshardable
+axis, it can only (warn and) replicate.
+
+Engine semantics, matching the hand-written rules they replace:
+
+* ``spec`` names the TRAILING dims (like the old ``stacked()`` wrap):
+  when ``stacked=True`` and the tensor has exactly one extra leading
+  dim, that dim is the scan layer axis and stays unsharded.
+* An entry naming mesh axes is KEPT when the axes' total size is 1
+  (size-1 axes live in every mesh so specs stay valid) and DROPPED —
+  replicating that dim, with a once-per-shape warning — when the dim
+  does not divide the axes' size. That is the generic form of the
+  gemma/qwen2 kv-head fallback.
+* A rank mismatch that is not the stacked +1 case applies the spec
+  as-is: for params that must fail loudly downstream (bad rule), and
+  rank-reduced optimizer states are routed around path rules by
+  ``infer_opt_tree_shardings`` (parallel/sharding.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+#: one spec entry: unsharded, one mesh axis, or several mesh axes
+Entry = Union[None, str, Tuple[str, ...]]
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorRule:
+    """One declarative partition rule: path pattern -> trailing-dim spec."""
+
+    pattern: str  # path regex (PartitionRules semantics: search, first wins)
+    spec: Tuple[Entry, ...]  # entries for the trailing dims
+    stacked: bool = True  # tolerate one extra leading (scan layer) dim
+    note: str = ""  # appended to the replication warning for context
+
+
+def _axes_of(entry: Entry) -> Tuple[str, ...]:
+    if entry is None:
+        return ()
+    return entry if isinstance(entry, tuple) else (entry,)
+
+
+def axes_size(entry: Entry, sizes) -> int:
+    """Total ways an entry shards over, given mesh axis sizes."""
+    return math.prod(sizes.get(a, 1) for a in _axes_of(entry)) if entry else 1
+
+
+# once per (pattern, dim size, entry, axes size): spec_for runs per LEAF
+# per placement pass — an unrolled 32-layer model would otherwise repeat
+# the same warning 64+ times (the original kv-replication dedup, kept)
+_warned: set = set()
+
+
+def reset_warned() -> None:
+    """Clear the warning dedup set (tests asserting the warning fires)."""
+    _warned.clear()
+
+
+def compile_rule(rule: TensorRule) -> Callable[[Tuple[int, ...], object], P]:
+    """A ``(shape, mesh) -> PartitionSpec`` callable for PartitionRules.
+
+    ``mesh`` only needs a ``.shape`` mapping of axis sizes, so the same
+    compiled rule serves a real ``jax.sharding.Mesh`` at placement time
+    and the planner's :class:`~pytorch_distributed_tpu.autoplan.memory.
+    PlanMesh` stand-in when pricing a mesh that is not built yet.
+    """
+
+    def spec_fn(shape: Tuple[int, ...], mesh) -> P:
+        entries: List[Entry] = list(rule.spec)
+        if rule.stacked and len(shape) == len(entries) + 1:
+            entries = [None] + entries
+        sizes = dict(mesh.shape)
+        out: List[Entry] = []
+        for i, entry in enumerate(entries):
+            size = axes_size(entry, sizes)
+            if (
+                entry is not None
+                and size > 1
+                and i < len(shape)
+                and shape[i] % size != 0
+            ):
+                key = (rule.pattern, entry, shape[i], size)
+                if key not in _warned:
+                    _warned.add(key)
+                    logger.warning(
+                        "partition rule %r: dim %d (size %d) does not "
+                        "divide mesh axes %r (%d ways) — replicating "
+                        "that dim (tensor shape %s)%s",
+                        rule.pattern, i, shape[i], _axes_of(entry), size,
+                        tuple(shape),
+                        f"; {rule.note}" if rule.note else "",
+                    )
+                out.append(None)
+            else:
+                out.append(entry)
+        return P(*out)
+
+    return spec_fn
+
+
+def engine_rules(
+    rules: Sequence[TensorRule],
+) -> List[Tuple[str, Callable[[Tuple[int, ...], object], P]]]:
+    """Compile TensorRules into the ``(pattern, spec)`` pairs every
+    ``extra_rules=`` consumer (parallel/strategies.py) takes."""
+    return [(r.pattern, compile_rule(r)) for r in rules]
+
+
+def replicated_rule(pattern: str, ndim: int, *, stacked: bool = True,
+                    note: str = "") -> TensorRule:
+    """A rule that pins ``pattern`` replicated (the forced-MQA form)."""
+    return TensorRule(pattern, (None,) * ndim, stacked=stacked, note=note)
+
+
+def max_divisible_tp(dims: Sequence[int], n_devices: int) -> List[int]:
+    """Candidate tp widths: divisors of ``n_devices`` that also divide
+    every dim in ``dims`` (e.g. a model's head count) — the enumeration
+    helper candidates.py uses so the candidate space stays inside what
+    the rule engine can shard without falling back to replication."""
+    out = []
+    for t in range(1, n_devices + 1):
+        if n_devices % t:
+            continue
+        if all(d % t == 0 for d in dims if d):
+            out.append(t)
+    return out

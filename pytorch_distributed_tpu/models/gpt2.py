@@ -14,7 +14,6 @@ from typing import Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
 
 from pytorch_distributed_tpu.ops.attention import (
     attention,
@@ -236,21 +235,29 @@ class GPT2LMHead(nn.Module):
 def gpt2_partition_rules():
     """TP rules: qkv kernel [hidden, 3, heads, head_dim] — shard heads.
 
-    ``stacked`` adapts each spec to the scan layout's leading layer dim,
-    so the same rules serve scan_layers=True and the unrolled tree. MoE
-    expert weights (when ``moe_experts > 0``) shard over ``ep`` with the
-    FFN hidden dim over ``tp``.
+    A declarative table over the shape-aware rule engine
+    (autoplan/rules.py): the engine adapts each spec to the scan
+    layout's leading layer dim (so the same rules serve
+    scan_layers=True and the unrolled tree) and replicates — with a
+    warning — any dim that does not divide its mesh axes, so these
+    rules stay valid on every mesh shape the auto-parallel planner
+    enumerates. MoE expert weights (when ``moe_experts > 0``) shard
+    over ``ep`` with the FFN hidden dim over ``tp``.
     """
-    from pytorch_distributed_tpu.parallel.sharding import stacked
+    from pytorch_distributed_tpu.autoplan.rules import (
+        TensorRule,
+        engine_rules,
+    )
 
-    return [
-        (r"attn_qkv/kernel", stacked(P(None, None, "tp", None))),
-        (r"attn_qkv/bias", stacked(P(None, "tp", None))),
-        (r"attn_out/kernel", stacked(P("tp", None, None))),  # [heads, hd, hidden]
-        (r"mlp_up/kernel", stacked(P(None, "tp"))),
-        (r"mlp_up/bias", stacked(P("tp"))),
-        (r"mlp_down/kernel", stacked(P("tp", None))),
-        (r"moe/w_in", stacked(P("ep", None, "tp"))),
-        (r"moe/w_out", stacked(P("ep", "tp", None))),
-        (r"wte/embedding", P(None, "tp")),
-    ]
+    return engine_rules([
+        TensorRule(r"attn_qkv/kernel", (None, None, "tp", None)),
+        TensorRule(r"attn_qkv/bias", (None, "tp", None)),
+        # attn_out kernel is [heads, hd, hidden]
+        TensorRule(r"attn_out/kernel", ("tp", None, None)),
+        TensorRule(r"mlp_up/kernel", (None, "tp")),
+        TensorRule(r"mlp_up/bias", ("tp",)),
+        TensorRule(r"mlp_down/kernel", ("tp", None)),
+        TensorRule(r"moe/w_in", ("ep", None, "tp")),
+        TensorRule(r"moe/w_out", ("ep", "tp", None)),
+        TensorRule(r"wte/embedding", (None, "tp"), stacked=False),
+    ])

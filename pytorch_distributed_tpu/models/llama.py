@@ -15,7 +15,6 @@ from typing import Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
 
 from pytorch_distributed_tpu.ops.attention import (
     apply_rope,
@@ -24,14 +23,6 @@ from pytorch_distributed_tpu.ops.attention import (
     validate_write_pos,
 )
 from pytorch_distributed_tpu.runtime.precision import current_policy
-from pytorch_distributed_tpu.utils.logging import get_logger
-
-logger = get_logger(__name__)
-
-#: (kv_heads, tp) pairs already warned about by the TP-rule replication
-#: fallback — placement passes visit every kernel leaf, and the signal
-#: is one fact, not one line per leaf
-_warned_kv_replication = set()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -361,49 +352,37 @@ def llama_partition_rules(num_kv_heads: Optional[int] = None):
     """Megatron TP: column-parallel q/k/v/gate/up, row-parallel o/down;
     embedding sharded on hidden, lm_head kernel on vocab (its dim 1).
 
-    The k/v kernels shard their kv-head axis over ``tp`` only when it
-    divides the mesh's tp size — decided from the KERNEL'S OWN SHAPE at
-    placement time, so MQA (Gemma-2B's 1 kv head) and ragged GQA
+    A thin declarative table over the shape-aware rule engine
+    (autoplan/rules.py), which supplies the behavior this function used
+    to hand-roll: any dim that does not divide its mesh axes replicates
+    with a once-per-shape warning — decided from the KERNEL'S OWN SHAPE
+    at placement time, so MQA (Gemma-2B's 1 kv head) and ragged GQA
     (Qwen2-7B's 4 kv heads on tp=8) both replicate k/v (the smallest
     projections; q/o and the MLP still shard) instead of crashing on an
-    unshardable axis. A replication fallback on a >1-way tp mesh logs a
-    warning so the throughput cost is visible, not silent.
+    unshardable axis, and the scan-stacked leading layer dim is
+    tolerated everywhere.
 
     ``num_kv_heads`` is retained for back-compat: an explicit ``1``
     forces the MQA replicate form without consulting shapes; other
     values defer to the shape-based decision."""
-    from pytorch_distributed_tpu.parallel.sharding import stacked
+    from pytorch_distributed_tpu.autoplan.rules import (
+        TensorRule,
+        engine_rules,
+        replicated_rule,
+    )
 
-    if num_kv_heads == 1:
-        kv_spec = stacked(P(None, None, None))
-    else:
-
-        def kv_spec(shape, mesh):
-            # [D, Hkv, hd] kernel, with a leading [L] when scan-stacked:
-            # the kv-head axis is always shape[-2]
-            tp = dict(mesh.shape).get("tp", 1)
-            heads = shape[-2]
-            if tp > 1 and heads % tp != 0:
-                if (heads, tp) not in _warned_kv_replication:
-                    # once per (heads, tp): spec_for runs per LEAF per
-                    # placement pass — an unrolled 32-layer model would
-                    # otherwise repeat this 64+ times
-                    _warned_kv_replication.add((heads, tp))
-                    logger.warning(
-                        "llama TP rules: %d kv heads do not divide "
-                        "tp=%d — replicating k/v (kernel shape %s); "
-                        "q/o and the MLP still shard",
-                        heads, tp, tuple(shape),
-                    )
-                return stacked(P(None, None, None))(shape, mesh)
-            return stacked(P(None, "tp", None))(shape, mesh)
-
-    return [
-        (r"/q/kernel", stacked(P(None, "tp", None))),
-        (r"/(k|v)/kernel", kv_spec),
-        (r"/o/kernel", stacked(P("tp", None, None))),
-        (r"/(gate|up)/kernel", stacked(P(None, "tp"))),
-        (r"/down/kernel", stacked(P("tp", None))),
-        (r"embed/embedding", P(None, "tp")),
-        (r"lm_head/kernel", P(None, "tp")),
-    ]
+    kv_note = "q/o and the MLP still shard"
+    kv = (
+        replicated_rule(r"/(k|v)/kernel", 3)
+        if num_kv_heads == 1  # forced MQA form, shapes not consulted
+        else TensorRule(r"/(k|v)/kernel", (None, "tp", None), note=kv_note)
+    )
+    return engine_rules([
+        TensorRule(r"/q/kernel", (None, "tp", None)),
+        kv,
+        TensorRule(r"/o/kernel", ("tp", None, None)),
+        TensorRule(r"/(gate|up)/kernel", (None, "tp")),
+        TensorRule(r"/down/kernel", ("tp", None)),
+        TensorRule(r"embed/embedding", (None, "tp"), stacked=False),
+        TensorRule(r"lm_head/kernel", (None, "tp"), stacked=False),
+    ])

@@ -152,6 +152,25 @@ def infer_tree_shardings(tree, rules: PartitionRules, mesh: Optional[Mesh] = Non
     return jax.tree_util.tree_map_with_path(leaf_sharding, tree)
 
 
+def best_param_suffix(param_paths, path: str) -> Optional[str]:
+    """Segment-aligned suffix match, LONGEST param path wins.
+
+    Optimizer-state leaves carry their parameter's path as a suffix
+    (``mu/embed/embedding``); plain ``endswith`` would let
+    ``dense/kernel`` claim ``.../decoder/dense/kernel`` (or even
+    ``cond_dense/kernel``) and mis-classify an exactly-param-shaped
+    moment. Shared by :func:`infer_opt_tree_shardings` and the
+    planner's memory accounting (autoplan/memory.py), so both route
+    shape-mismatched states identically.
+    """
+    best = None
+    for param_path in param_paths:
+        if path == param_path or path.endswith("/" + param_path):
+            if best is None or len(param_path) > len(best):
+                best = param_path
+    return best
+
+
 def infer_opt_tree_shardings(
     opt_state,
     params,
@@ -182,15 +201,7 @@ def infer_opt_tree_shardings(
     def leaf_sharding(path, leaf):
         shape = tuple(getattr(leaf, "shape", ()) or ())
         p = path_str(path)
-        # segment-aligned suffix match, LONGEST param path wins: plain
-        # endswith would let 'dense/kernel' claim '.../decoder/dense/
-        # kernel' (or even 'cond_dense/kernel') and mis-classify an
-        # exactly-param-shaped moment as a mismatch
-        best = None
-        for param_path in param_shapes:
-            if p == param_path or p.endswith("/" + param_path):
-                if best is None or len(param_path) > len(best):
-                    best = param_path
+        best = best_param_suffix(param_shapes, p)
         if best is not None and shape != param_shapes[best]:
             if mismatch_rules is None:
                 return NamedSharding(mesh, P())

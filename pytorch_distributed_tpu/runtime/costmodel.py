@@ -49,6 +49,23 @@ from pytorch_distributed_tpu.runtime.hostring import algo_wire_bytes
 FORMAT_VERSION = 1
 
 
+def calibration_command(path: str = "costmodel.json") -> str:
+    """The exact command that (re)creates a calibrated model at ``path``
+    — every load/validate failure names it, because "go calibrate" is
+    only actionable when the error says how."""
+    return f"python scripts/collective_bench.py --fit {path}"
+
+
+class CostModelUnavailable(ValueError):
+    """A cost model could not be loaded/used for the requested purpose.
+
+    Raised with a message naming the ``collective_bench --fit`` command
+    to run. Subclasses ValueError so report tooling that treats an
+    unreadable model as a degraded (not fatal) input keeps working;
+    planners catch it explicitly to fall back to an analytic model.
+    """
+
+
 @dataclasses.dataclass
 class OpFit:
     """One collective's fitted α–β line at one world size."""
@@ -153,9 +170,81 @@ class CostModel:
         return cls(doc["transport"], fits)
 
     @classmethod
-    def load(cls, path: str) -> "CostModel":
-        with open(path) as f:
-            return cls.from_dict(json.load(f))
+    def load(cls, path: str, *,
+             expected_transport: Optional[str] = None) -> "CostModel":
+        """Load ``path``, failing ACTIONABLY: a missing, unreadable or
+        transport-mismatched model raises :class:`CostModelUnavailable`
+        naming the exact calibration command, instead of a bare
+        traceback three frames from the actual fix."""
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except FileNotFoundError:
+            raise CostModelUnavailable(
+                f"no cost model at {path!r} — calibrate this machine "
+                f"first: `{calibration_command(path)}`"
+            ) from None
+        except (OSError, ValueError) as e:
+            raise CostModelUnavailable(
+                f"cost model {path!r} is unreadable ({e}) — refit: "
+                f"`{calibration_command(path)}`"
+            ) from e
+        try:
+            model = cls.from_dict(doc)
+        except (ValueError, KeyError, TypeError) as e:
+            raise CostModelUnavailable(
+                f"cost model {path!r} does not parse ({e}) — refit: "
+                f"`{calibration_command(path)}`"
+            ) from e
+        if (expected_transport is not None
+                and model.transport != expected_transport):
+            raise CostModelUnavailable(
+                f"cost model {path!r} was calibrated on transport "
+                f"{model.transport!r} but this run needs "
+                f"{expected_transport!r} — a memcpy fit cannot price a "
+                f"network; refit here: `{calibration_command(path)}`"
+            )
+        return model
+
+
+#: transport label analytic (uncalibrated) models carry — consumers key
+#: their "this is a guess" warnings off it
+ANALYTIC_TRANSPORT = "analytic-guess"
+
+#: every op the planner may need to price
+_ANALYTIC_OPS = ("all_reduce", "all_reduce_q8", "all_gather",
+                 "reduce_scatter", "broadcast")
+
+
+def analytic_cost_model(
+    worlds: Iterable[int],
+    *,
+    bandwidth_gb_s: float = 1.0,
+    alpha_per_phase_s: float = 2e-5,
+    ops: Iterable[str] = _ANALYTIC_OPS,
+) -> CostModel:
+    """A bandwidth-GUESS α–β model for when no calibration exists.
+
+    The planner's degraded mode (never its default): α scales with the
+    ring's barrier phases (``(world-1) x alpha_per_phase_s``), β is one
+    flat per-wire-byte cost. Rankings under it reflect VOLUME and CALL
+    COUNT only — usually the right ordering, but every consumer must
+    surface the ``analytic-guess`` transport as an ``uncalibrated``
+    flag, and the fix is always :func:`calibration_command`.
+    """
+    beta = 1.0 / (bandwidth_gb_s * 1e9)
+    fits: Dict[Tuple[str, int], OpFit] = {}
+    for op in ops:
+        for w in sorted(set(int(w) for w in worlds)):
+            if w <= 1:
+                continue
+            fits[(op, w)] = OpFit(
+                op=op, world_size=w,
+                alpha_s=alpha_per_phase_s * (w - 1),
+                beta_s_per_byte=beta, r2=0.0, n_samples=0,
+                wire_bytes_min=0, wire_bytes_max=1 << 62,
+            )
+    return CostModel(ANALYTIC_TRANSPORT, fits)
 
 
 def _fit_line(xs: List[float], ys: List[float]) -> Tuple[float, float, float]:

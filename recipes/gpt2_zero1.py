@@ -73,6 +73,22 @@ def parse_args(argv=None):
     p.add_argument("--vocab-chunk", type=int, default=None,
                    help="chunked-vocab loss: never materialize [B,S,V] "
                         "logits (ops/lm_loss.py); ZeRO-1 path only")
+    p.add_argument(
+        "--strategy", choices=("zero1", "dp", "auto"), default="zero1",
+        help="parallel strategy; 'auto' runs the cost-model planner "
+             "(pytorch_distributed_tpu/autoplan/) over mesh shapes x "
+             "strategy classes and picks the cheapest feasible one",
+    )
+    p.add_argument(
+        "--plan-path", default="plan.json",
+        help="--strategy auto: write the ranked candidate report here",
+    )
+    p.add_argument(
+        "--costmodel", default="costmodel.json",
+        help="--strategy auto: calibrated comms cost model "
+             "(scripts/collective_bench.py --fit); a missing file "
+             "degrades to an analytic guess, loudly flagged uncalibrated",
+    )
     p.add_argument("--steps-per-epoch", type=int, default=None)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--seed", type=int, default=0)
@@ -106,12 +122,6 @@ def main(argv=None):
             "refuses packed batches); --pack + --vocab-chunk is supported"
         )
     ptd.seed_all(args.seed)
-    ptd.init_process_group(
-        args.backend,
-        mesh_spec=MeshSpec(dp=args.dp, tp=args.tp, pp=args.pp),
-    )
-    log_rank0("world=%d backend=%s", ptd.get_world_size(), ptd.get_backend())
-
     cfg = SIZES[args.size]()
     if args.remat or args.remat_policy != "full":
         import dataclasses as _dc
@@ -120,6 +130,70 @@ def main(argv=None):
             cfg, remat=True, remat_policy=args.remat_policy
         )
     seq_len = min(args.seq_len, cfg.n_positions)
+    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(args.lr))
+
+    mesh_spec = MeshSpec(dp=args.dp, tp=args.tp, pp=args.pp)
+    chosen = None
+    if args.strategy == "auto":
+        # plan BEFORE the group exists: the planner reads only device
+        # count + abstract shapes (eval_shape — zero compiles), and the
+        # chosen candidate's mesh spec is what init_process_group gets
+        if args.pp > 1:
+            raise SystemExit(
+                "--strategy auto does not enumerate pipeline "
+                "candidates; drop --pp or pick a strategy explicitly"
+            )
+        if "RANK" in os.environ:
+            raise SystemExit(
+                "--strategy auto plans the single-controller SPMD "
+                "mesh; it is not supported under a per-rank launch"
+            )
+        if args.dp != -1 or args.tp != 1:
+            raise SystemExit(
+                "--strategy auto chooses the mesh shape itself; drop "
+                "--dp/--tp or pick a strategy explicitly"
+            )
+        from pytorch_distributed_tpu import autoplan
+
+        plan_model = GPT2LMHead(cfg)
+
+        def make_state(key):
+            variables = plan_model.init(
+                key, jnp.zeros((1, seq_len), jnp.int32)
+            )
+            return TrainState.create(
+                apply_fn=plan_model.apply, params=variables["params"],
+                tx=tx,
+            )
+
+        abstract = jax.eval_shape(make_state, jax.random.key(args.seed))
+        plan_report = autoplan.plan(
+            profile=autoplan.transformer_profile(
+                num_layers=cfg.num_layers, hidden_size=cfg.hidden_size,
+                seq_len=seq_len,
+                param_count=autoplan.param_count(abstract.params),
+            ),
+            global_batch=args.batch_size,
+            abstract_state=abstract,
+            extra_rules=gpt2_partition_rules(),
+            tp_candidates=autoplan.max_divisible_tp(
+                [cfg.num_heads], len(jax.devices())
+            ),
+            cost_model_path=args.costmodel,
+            # single-controller SPMD collectives on this platform — a
+            # hostring-calibrated model must not silently price them
+            transport=f"spmd:{ptd.platform()}",
+            accum_steps=args.accum_steps,
+        )
+        chosen = plan_report.best()
+        plan_report.save(args.plan_path)
+        log_rank0(
+            "auto-parallel plan (full report: %s):\n%s",
+            args.plan_path, plan_report.table(),
+        )
+        mesh_spec = chosen.mesh_spec()
+    ptd.init_process_group(args.backend, mesh_spec=mesh_spec)
+    log_rank0("world=%d backend=%s", ptd.get_world_size(), ptd.get_backend())
     tokenizer = None
     if args.text_file:
         import dataclasses
@@ -193,9 +267,7 @@ def main(argv=None):
     state = TrainState.create(
         apply_fn=model.apply,
         params=variables["params"],
-        tx=optax.chain(
-            optax.clip_by_global_norm(1.0), optax.adamw(args.lr)
-        ),
+        tx=tx,
     )
     if args.pp > 1:
         from pytorch_distributed_tpu.parallel.pipeline_lm import (
@@ -210,7 +282,18 @@ def main(argv=None):
         # microbatching lives inside the pipeline schedule here
         accum_steps = 1
     else:
-        strategy = ZeRO1(extra_rules=gpt2_partition_rules())
+        if chosen is not None:  # --strategy auto: the planner's pick
+            strategy = chosen.build_strategy(
+                extra_rules=gpt2_partition_rules()
+            )
+            log_rank0("auto strategy: %s -> %s", chosen.name,
+                      strategy.describe())
+        elif args.strategy == "dp":
+            from pytorch_distributed_tpu.parallel import DataParallel
+
+            strategy = DataParallel(extra_rules=gpt2_partition_rules())
+        else:
+            strategy = ZeRO1(extra_rules=gpt2_partition_rules())
         loss_fn = causal_lm_loss_fn(
             model, vocab_chunk_size=args.vocab_chunk
         )

@@ -78,6 +78,23 @@ def parse_args(argv=None):
                    default="adamw",
                    help="adafactor factors the second moment: ~1/2 the "
                         "optimizer-state HBM at 8B scale")
+    p.add_argument(
+        "--strategy", choices=("fsdp", "dp", "zero1", "auto"),
+        default="fsdp",
+        help="parallel strategy; 'auto' runs the cost-model planner "
+             "(pytorch_distributed_tpu/autoplan/) over mesh shapes x "
+             "strategy classes and picks the cheapest feasible one",
+    )
+    p.add_argument(
+        "--plan-path", default="plan.json",
+        help="--strategy auto: write the ranked candidate report here",
+    )
+    p.add_argument(
+        "--costmodel", default="costmodel.json",
+        help="--strategy auto: calibrated comms cost model "
+             "(scripts/collective_bench.py --fit); a missing file "
+             "degrades to an analytic guess, loudly flagged uncalibrated",
+    )
     p.add_argument("--steps-per-epoch", type=int, default=None)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--seed", type=int, default=0)
@@ -91,14 +108,6 @@ def main(argv=None):
 
     args = parse_args(argv)
     ptd.seed_all(args.seed)
-    ptd.init_process_group(
-        args.backend,
-        mesh_spec=MeshSpec(
-            dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=args.sp
-        ),
-    )
-    log_rank0("world=%d backend=%s", ptd.get_world_size(), ptd.get_backend())
-
     cfg = SIZES[args.size]()
     if args.remat or args.remat_policy != "full":
         # a non-default policy implies remat: silently ignoring
@@ -106,17 +115,7 @@ def main(argv=None):
         cfg = dataclasses.replace(
             cfg, remat=True, remat_policy=args.remat_policy
         )
-    sp_ctx = contextlib.nullcontext()
-    if args.sp > 1:
-        from pytorch_distributed_tpu.parallel import sequence_parallel
-
-        sp_ctx = sequence_parallel("sp", args.sp_mode)
     seq_len = min(args.seq_len, cfg.max_seq_len)
-    n = (args.steps_per_epoch or 50) * args.batch_size
-    ds = SyntheticTextDataset(
-        n=n, seq_len=seq_len, vocab_size=cfg.vocab_size, seed=args.seed
-    )
-
     model = LlamaForCausalLM(cfg)
     if args.optimizer == "adafactor":
         # adafactor clips its own updates; factored second moment halves
@@ -127,7 +126,6 @@ def main(argv=None):
         tx = optax.chain(
             optax.clip_by_global_norm(1.0), optax.adamw(args.lr)
         )
-    strategy = FSDP(extra_rules=llama_partition_rules())
 
     # init directly onto shards — an 8B model never exists replicated
     def make_state(key):
@@ -135,6 +133,87 @@ def main(argv=None):
         return TrainState.create(
             apply_fn=model.apply, params=variables["params"], tx=tx
         )
+
+    mesh_spec = MeshSpec(
+        dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=args.sp
+    )
+    chosen = None
+    if args.strategy == "auto":
+        # plan BEFORE the group exists: device count + abstract shapes
+        # only (one eval_shape, zero compiles); the chosen candidate's
+        # mesh spec is what init_process_group then builds
+        if args.sp > 1:
+            raise SystemExit(
+                "--strategy auto does not enumerate sequence-parallel "
+                "candidates; drop --sp or pick a strategy explicitly"
+            )
+        if "RANK" in os.environ:
+            raise SystemExit(
+                "--strategy auto plans the single-controller SPMD "
+                "mesh; it is not supported under a per-rank launch"
+            )
+        if args.dp != -1 or args.fsdp != 1 or args.tp != 1:
+            raise SystemExit(
+                "--strategy auto chooses the mesh shape itself; drop "
+                "--dp/--fsdp/--tp or pick a strategy explicitly"
+            )
+        from pytorch_distributed_tpu import autoplan
+
+        abstract = jax.eval_shape(make_state, jax.random.key(args.seed))
+        plan_report = autoplan.plan(
+            profile=autoplan.transformer_profile(
+                num_layers=cfg.num_layers, hidden_size=cfg.hidden_size,
+                seq_len=seq_len,
+                param_count=autoplan.param_count(abstract.params),
+            ),
+            global_batch=args.batch_size,
+            abstract_state=abstract,
+            extra_rules=llama_partition_rules(),
+            tp_candidates=autoplan.max_divisible_tp(
+                [cfg.num_heads], len(jax.devices())
+            ),
+            cost_model_path=args.costmodel,
+            # single-controller SPMD collectives on this platform — a
+            # hostring-calibrated model must not silently price them
+            transport=f"spmd:{ptd.platform()}",
+            accum_steps=args.accum_steps,
+        )
+        chosen = plan_report.best()
+        plan_report.save(args.plan_path)
+        log_rank0(
+            "auto-parallel plan (full report: %s):\n%s",
+            args.plan_path, plan_report.table(),
+        )
+        mesh_spec = chosen.mesh_spec()
+    ptd.init_process_group(args.backend, mesh_spec=mesh_spec)
+    log_rank0("world=%d backend=%s", ptd.get_world_size(), ptd.get_backend())
+
+    sp_ctx = contextlib.nullcontext()
+    if args.sp > 1:
+        from pytorch_distributed_tpu.parallel import sequence_parallel
+
+        sp_ctx = sequence_parallel("sp", args.sp_mode)
+    n = (args.steps_per_epoch or 50) * args.batch_size
+    ds = SyntheticTextDataset(
+        n=n, seq_len=seq_len, vocab_size=cfg.vocab_size, seed=args.seed
+    )
+
+    if chosen is not None:  # --strategy auto: the planner's pick
+        strategy = chosen.build_strategy(
+            extra_rules=llama_partition_rules()
+        )
+        log_rank0("auto strategy: %s -> %s", chosen.name,
+                  strategy.describe())
+    elif args.strategy == "dp":
+        from pytorch_distributed_tpu.parallel import DataParallel
+
+        strategy = DataParallel(extra_rules=llama_partition_rules())
+    elif args.strategy == "zero1":
+        from pytorch_distributed_tpu.parallel import ZeRO1
+
+        strategy = ZeRO1(extra_rules=llama_partition_rules())
+    else:
+        strategy = FSDP(extra_rules=llama_partition_rules())
 
     state = strategy.create_sharded(make_state, jax.random.key(args.seed))
     trainer = Trainer(

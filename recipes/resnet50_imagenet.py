@@ -73,12 +73,73 @@ class Config(RecipeConfig):
     tensorboard_dir: str = ""  # doc: TensorBoard event-file dir (rank 0)
     io_retries: int = 2  # doc: transient read retries per sample (real-data path)
     bad_sample_budget: int = 100  # doc: max quarantined (undecodable) samples before hard error
+    strategy: str = "dp"  # doc: parallel strategy: dp | zero1 | auto (cost-model planner, autoplan/)
+    plan_path: str = "plan.json"  # doc: --strategy auto: ranked candidate report output
+    costmodel: str = "costmodel.json"  # doc: --strategy auto: calibrated comms model (collective_bench --fit); missing -> analytic fallback, flagged
 
 
 def main(argv=None):
     cfg: Config = parse_cli(Config, argv, description=__doc__)
     ptd.seed_all(cfg.seed)
-    ptd.init_process_group(cfg.backend, mesh_spec=MeshSpec(dp=cfg.dp))
+    mesh_spec = MeshSpec(dp=cfg.dp)
+    chosen = None
+    if cfg.strategy == "auto":
+        # plan BEFORE the group exists: one eval_shape, zero compiles;
+        # the chosen candidate's mesh spec is what the group builds
+        if "RANK" in os.environ:
+            raise SystemExit(
+                "--strategy auto plans the single-controller SPMD "
+                "mesh; it is not supported under a per-rank launch"
+            )
+        if cfg.dp != -1:
+            raise SystemExit(
+                "--strategy auto chooses the mesh shape itself; drop "
+                "--dp or pick a strategy explicitly"
+            )
+        from pytorch_distributed_tpu import autoplan
+
+        pshape = (cfg.image_size, cfg.image_size, 3)
+        plan_model = ResNet50(num_classes=1000, stem=cfg.stem)
+        # constant-lr stand-in for the scheduled optimizer: the state
+        # SHAPES (the only thing planning reads) are identical
+        plan_tx = optax.sgd(cfg.lr, momentum=cfg.momentum, nesterov=True)
+
+        def make_plan_state(key):
+            variables = plan_model.init(
+                key, jnp.zeros((1,) + pshape), train=False
+            )
+            return TrainState.create(
+                apply_fn=plan_model.apply, params=variables["params"],
+                tx=plan_tx, batch_stats=variables["batch_stats"],
+                ema=cfg.ema_decay > 0,
+            )
+
+        plan_report = autoplan.plan(
+            profile=autoplan.image_profile(
+                # ResNet-50 at 224^2: ~4.1 GFLOPs forward (x3 trained),
+                # ~64 MB of f32 feature maps; both scale with area
+                flops_per_sample=3 * 4.1e9 * (cfg.image_size / 224) ** 2,
+                activation_bytes_per_sample=(
+                    64e6 * (cfg.image_size / 224) ** 2
+                ),
+            ),
+            global_batch=cfg.batch_size,
+            make_state_fn=make_plan_state,
+            state_args=(jax.random.key(cfg.seed),),
+            max_tp=1,  # no TP rule set for the conv net
+            cost_model_path=cfg.costmodel,
+            # single-controller SPMD collectives on this platform — a
+            # hostring-calibrated model must not silently price them
+            transport=f"spmd:{ptd.platform()}",
+        )
+        chosen = plan_report.best()
+        plan_report.save(cfg.plan_path)
+        log_rank0(
+            "auto-parallel plan (full report: %s):\n%s",
+            cfg.plan_path, plan_report.table(),
+        )
+        mesh_spec = chosen.mesh_spec()
+    ptd.init_process_group(cfg.backend, mesh_spec=mesh_spec)
     log_rank0(
         "resnet50/imagenet: world=%d backend=%s batch=%d image=%d",
         ptd.get_world_size(), ptd.get_backend(), cfg.batch_size, cfg.image_size,
@@ -165,7 +226,16 @@ def main(argv=None):
         ema=cfg.ema_decay > 0,
     )
 
-    strategy = DataParallel()
+    if chosen is not None:  # --strategy auto: the planner's pick
+        strategy = chosen.build_strategy()
+        log_rank0("auto strategy: %s -> %s", chosen.name,
+                  strategy.describe())
+    elif cfg.strategy == "zero1":
+        from pytorch_distributed_tpu.parallel import ZeRO1
+
+        strategy = ZeRO1()
+    else:
+        strategy = DataParallel()
     train_loader = DataLoader(
         train_ds, cfg.batch_size, seed=cfg.seed,
         sharding=strategy.batch_sharding(),

@@ -17,7 +17,11 @@ investigation starts from —
   ``comm.*`` spans (runtime/hostring.py), predicted-vs-achieved
   latency when a calibrated ``costmodel.json`` sits in the run dir,
   and per-rank straggler skew when the trace is a
-  ``scripts/trace_merge.py`` merge of several ranks.
+  ``scripts/trace_merge.py`` merge of several ranks,
+* plan: the auto-parallel planner's ranked candidate table when a
+  ``plan.json`` (``--strategy auto`` / autoplan/planner.py) sits in
+  the run dir — the audit trail for why this run's strategy was
+  chosen.
 
 Usage::
 
@@ -60,12 +64,15 @@ def parse_args(argv=None):
                    help="calibrated costmodel.json for the "
                    "achieved-vs-predicted comms comparison (default: "
                    "<run_dir>/costmodel.json when present)")
+    p.add_argument("--plan", default=None,
+                   help="auto-parallel plan.json to render (default: "
+                   "<run_dir>/plan.json when present)")
     return p.parse_args(argv)
 
 
 def _discover(args):
     trace_path, metric_paths = args.trace, list(args.metrics or [])
-    costmodel_path = args.costmodel
+    costmodel_path, plan_path = args.costmodel, args.plan
     if args.run_dir:
         if trace_path is None:
             for name in ("trace.json", "merged_trace.json"):
@@ -80,7 +87,39 @@ def _discover(args):
         if costmodel_path is None:
             cand = os.path.join(args.run_dir, "costmodel.json")
             costmodel_path = cand if os.path.isfile(cand) else None
-    return trace_path, metric_paths, costmodel_path
+        if plan_path is None:
+            cand = os.path.join(args.run_dir, "plan.json")
+            plan_path = cand if os.path.isfile(cand) else None
+    return trace_path, metric_paths, costmodel_path, plan_path
+
+
+def plan_section(plan_path, out):
+    """Render the auto-parallel planner's ranked candidate table."""
+    if not plan_path:
+        return None
+    from pytorch_distributed_tpu.autoplan.planner import format_plan
+
+    try:
+        with open(plan_path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"\n== Plan ==\n  (plan {plan_path} unreadable: {e})",
+              file=out)
+        return None
+    print("\n== Plan ==", file=out)
+    print(f"  source: {plan_path}", file=out)
+    try:
+        lines = format_plan(doc)
+    except (KeyError, TypeError, AttributeError) as e:
+        # a truncated/hand-edited/future-format plan must degrade to a
+        # note, not abort the report's remaining sections (same
+        # convention as an unreadable costmodel.json above)
+        print(f"  (plan {plan_path} does not match the expected "
+              f"schema: {type(e).__name__}: {e})", file=out)
+        return None
+    for line in lines:
+        print("  " + line, file=out)
+    return doc
 
 
 def load_trace(path):
@@ -249,7 +288,7 @@ def phase_table(rows, wall_ms):
 
 
 def report(trace_path, metric_paths, top_n=10, out=None,
-           costmodel_path=None):
+           costmodel_path=None, plan_path=None):
     # resolve the CURRENT sys.stdout, not import-time's: under pytest
     # capture an import-time default would pin the first importing
     # test's capture stream and every later caller would print into it
@@ -340,6 +379,9 @@ def report(trace_path, metric_paths, top_n=10, out=None,
     # -- comms -------------------------------------------------------------
     comms = comms_section(events, rows, other, costmodel_path, out)
 
+    # -- auto-parallel plan ------------------------------------------------
+    plan_doc = plan_section(plan_path, out)
+
     # -- goodput -----------------------------------------------------------
     print("\n== Goodput ==", file=out)
     g = summarize_goodput(records)
@@ -367,7 +409,7 @@ def report(trace_path, metric_paths, top_n=10, out=None,
             f"p99={percentile(ttfts, 99):.1f}ms", file=out,
         )
     return {"spans": rows, "recompiles": recompiles, "goodput": g,
-            "comms": comms or {}}
+            "comms": comms or {}, "plan": plan_doc}
 
 
 def main(argv=None):
@@ -376,13 +418,15 @@ def main(argv=None):
         print("nothing to report: pass RUN_DIR or --trace/--metrics",
               file=sys.stderr)
         return 2
-    trace_path, metric_paths, costmodel_path = _discover(args)
-    if not trace_path and not metric_paths:
-        print(f"no trace.json or *.jsonl found under {args.run_dir!r}",
-              file=sys.stderr)
+    trace_path, metric_paths, costmodel_path, plan_path = _discover(args)
+    if not trace_path and not metric_paths and not plan_path:
+        print(
+            f"no trace.json, *.jsonl or plan.json found under "
+            f"{args.run_dir!r}", file=sys.stderr,
+        )
         return 2
     report(trace_path, metric_paths, top_n=args.top,
-           costmodel_path=costmodel_path)
+           costmodel_path=costmodel_path, plan_path=plan_path)
     return 0
 
 

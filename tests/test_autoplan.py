@@ -1,0 +1,609 @@
+"""Auto-parallel planner tests (marker: plan).
+
+Covers the four planner layers plus their contracts: the shape-aware
+rule engine (the generalized gemma/qwen2 kv-head fallback), eval-shape
+memory accounting, cost-model pricing (synthetic recovery against
+hand-computed prices, q8 wire occupancy), ranking determinism, the
+plan.json schema, the no-compile guarantee, the cost-model failure UX
+(actionable error naming the calibration command, analytic fallback
+flagged uncalibrated) and ``--strategy auto`` end to end in a
+subprocess on the 8-device CPU mesh.
+"""
+
+import contextlib
+import json
+import logging
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+import flax.linen as nn
+
+from pytorch_distributed_tpu import autoplan
+from pytorch_distributed_tpu.autoplan import rules as ap_rules
+from pytorch_distributed_tpu.autoplan.memory import PlanMesh
+from pytorch_distributed_tpu.autoplan.pricing import (
+    grad_comm_terms,
+    price_comm_terms,
+)
+from pytorch_distributed_tpu.parallel.sharding import PartitionRules
+from pytorch_distributed_tpu.runtime import costmodel
+from pytorch_distributed_tpu.runtime.hostring import (
+    algo_wire_bytes,
+    q8_wire_payload,
+)
+from pytorch_distributed_tpu.train import TrainState
+from jax.sharding import PartitionSpec as P
+
+pytestmark = pytest.mark.plan
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@contextlib.contextmanager
+def ptd_caplog(caplog, level="WARNING"):
+    """Package loggers don't propagate to root; attach caplog directly."""
+    ns = logging.getLogger("pytorch_distributed_tpu")
+    ns.addHandler(caplog.handler)
+    try:
+        with caplog.at_level(level, logger="pytorch_distributed_tpu"):
+            yield caplog
+    finally:
+        ns.removeHandler(caplog.handler)
+
+
+# -- fixtures ---------------------------------------------------------------
+class _Tiny(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(64, name="d1")(x)
+        return nn.Dense(8, name="d2")(x)
+
+
+@pytest.fixture(scope="module")
+def abstract_state():
+    model = _Tiny()
+
+    def make(key):
+        params = model.init(key, jnp.zeros((1, 16)))["params"]
+        return TrainState.create(
+            apply_fn=model.apply, params=params, tx=optax.adam(1e-3)
+        )
+
+    return jax.eval_shape(make, jax.random.key(0))
+
+
+def hand_model(ar_beta, rsag_beta, *, alpha=0.0, worlds=(2, 4, 8)):
+    """Hand-built α–β model: prices are exactly computable on paper."""
+    fits = {}
+    for op, beta in (
+        ("all_reduce", ar_beta),
+        ("all_reduce_q8", ar_beta),
+        ("reduce_scatter", rsag_beta),
+        ("all_gather", rsag_beta),
+    ):
+        for w in worlds:
+            fits[(op, w)] = costmodel.OpFit(
+                op=op, world_size=w, alpha_s=alpha,
+                beta_s_per_byte=beta, r2=1.0, n_samples=4,
+                wire_bytes_min=0, wire_bytes_max=1 << 62,
+            )
+    return costmodel.CostModel("test", fits)
+
+
+NO_COMPUTE = autoplan.ModelProfile(
+    flops_per_sample=0.0, activation_bytes_per_sample=0.0
+)
+MEASURED = autoplan.ComputeModel(1e9, "measured-step")
+
+
+def run_plan(abstract_state, model, **kw):
+    kw.setdefault("strategies", ("dp", "zero1"))
+    kw.setdefault("max_tp", 1)
+    kw.setdefault("n_devices", 8)
+    kw.setdefault("budget_bytes", None)
+    return autoplan.plan(
+        profile=NO_COMPUTE, global_batch=8,
+        abstract_state=abstract_state, cost_model=model,
+        compute=MEASURED, **kw,
+    )
+
+
+# -- rule engine ------------------------------------------------------------
+class TestRuleEngine:
+    def test_divisibility_fallback_replicates_and_warns_once(self, caplog):
+        ap_rules.reset_warned()
+        rules = PartitionRules(ap_rules.engine_rules([
+            ap_rules.TensorRule(r"w/kernel", (None, "tp", None),
+                                note="test axis"),
+        ]))
+        mesh = PlanMesh({"tp": 8})
+        with ptd_caplog(caplog):
+            # 4 does not divide tp=8 -> that dim replicates
+            assert rules.spec_for("w/kernel", (64, 4, 16), mesh) == \
+                P(None, None, None)
+            # warned exactly once for repeated identical shapes
+            assert rules.spec_for("w/kernel", (64, 4, 16), mesh) == \
+                P(None, None, None)
+        warns = [r for r in caplog.records if "replicating" in r.message]
+        assert len(warns) == 1
+        assert "test axis" in warns[0].message
+
+    def test_stacked_prepends_exactly_one_layer_dim(self):
+        rules = PartitionRules(ap_rules.engine_rules([
+            ap_rules.TensorRule(r"w", (None, "tp", None)),
+        ]))
+        mesh = PlanMesh({"tp": 2})
+        # +1 rank: scan layer dim prepended
+        assert rules.spec_for("w", (3, 64, 4, 16), mesh) == \
+            P(None, None, "tp", None)
+        # exact rank: applied as-is
+        assert rules.spec_for("w", (64, 4, 16), mesh) == \
+            P(None, "tp", None)
+
+    def test_size_one_axes_stay_in_spec(self):
+        # axes of size 1 are kept (they exist in every mesh; XLA elides
+        # the no-op) — matches the old stacked() passthrough exactly
+        rules = PartitionRules(ap_rules.engine_rules([
+            ap_rules.TensorRule(r"w", (None, "tp")),
+        ]))
+        assert rules.spec_for("w", (8, 4), PlanMesh({"tp": 1})) == \
+            P(None, "tp")
+
+    def test_gpt2_rules_ride_the_engine(self):
+        from pytorch_distributed_tpu.models.gpt2 import (
+            gpt2_partition_rules,
+        )
+
+        rules = PartitionRules(gpt2_partition_rules())
+        mesh = PlanMesh({"tp": 2, "ep": 1})
+        # scan-stacked qkv kernel [L, hidden, 3, heads, hd]
+        assert rules.spec_for(
+            "layers/attn_qkv/kernel", (2, 64, 3, 4, 16), mesh
+        ) == P(None, None, None, "tp", None)
+        # embedding is never stacked
+        assert rules.spec_for("wte/embedding", (512, 64), mesh) == \
+            P(None, "tp")
+
+    def test_max_divisible_tp(self):
+        assert ap_rules.max_divisible_tp([12], 8) == [1, 2, 4]
+        assert ap_rules.max_divisible_tp([], 4) == [1, 2, 4]
+        assert ap_rules.max_divisible_tp([5], 8) == [1]
+
+
+# -- candidates -------------------------------------------------------------
+class TestCandidates:
+    def test_enumeration_deterministic_and_deduped(self):
+        a = autoplan.enumerate_candidates(8)
+        b = autoplan.enumerate_candidates(8)
+        assert [c.name for c in a] == [c.name for c in b]
+        names = [c.name for c in a]
+        assert len(names) == len(set(names))
+        # data==1 (pure tp or single device) collapses to the dp form
+        assert not any(
+            c.data == 1 and c.strategy != "dp" for c in a
+        )
+
+    def test_mesh_spec_matches_axes(self):
+        c = autoplan.CandidateSpec("fsdp", 4, tp=2)
+        spec = c.mesh_spec()
+        assert (spec.fsdp, spec.dp, spec.tp) == (4, 1, 2)
+        assert c.name == "fsdp/dp4xtp2"
+        assert c.n_devices == 8
+
+    def test_q8_variants_only_for_dp(self):
+        cands = autoplan.enumerate_candidates(8, include_q8=True)
+        q8 = [c for c in cands if c.compress]
+        assert q8 and all(c.strategy == "dp" for c in q8)
+
+
+# -- memory accounting ------------------------------------------------------
+class TestMemory:
+    def test_leaf_device_bytes(self):
+        from pytorch_distributed_tpu.autoplan.memory import (
+            leaf_device_bytes,
+        )
+
+        sizes = {"dp": 4, "tp": 2}
+        assert leaf_device_bytes((64, 8), 4, P("dp", None), sizes) == \
+            64 * 8 * 4 // 4
+        assert leaf_device_bytes((64, 8), 4, P(("dp", "tp"), None),
+                                 sizes) == 64 * 8 * 4 // 8
+        # non-divisible dim conservatively counts full size
+        assert leaf_device_bytes((6, 8), 4, P("dp", None), sizes) == \
+            6 * 8 * 4
+
+    def test_strategy_accounting_relationships(self, abstract_state):
+        m = hand_model(1e-9, 1e-9)
+        plan = run_plan(abstract_state, m,
+                        strategies=("dp", "zero1", "fsdp"))
+        by = {c.name: c for c in plan.candidates}
+        dp, z1, fs = by["dp/dp8"], by["zero1/dp8"], by["fsdp/dp8"]
+        # dp replicates everything; zero1 shards only optimizer state;
+        # fsdp shards params and optimizer state
+        assert dp.memory.param_bytes == z1.memory.param_bytes
+        assert z1.memory.opt_bytes < dp.memory.opt_bytes
+        assert fs.memory.param_bytes < dp.memory.param_bytes
+        assert fs.memory.opt_bytes <= z1.memory.opt_bytes
+        # grads mirror the params placement
+        assert dp.memory.grad_bytes == dp.memory.param_bytes
+        assert fs.memory.grad_bytes == fs.memory.param_bytes
+
+    def test_infeasible_filtered_but_reported(self, abstract_state):
+        m = hand_model(1e-9, 1e-9)
+        free = run_plan(abstract_state, m, strategies=("dp", "zero1"))
+        by = {c.name: c for c in free.candidates}
+        # budget between the two candidates' needs
+        budget = (by["zero1/dp8"].memory.total_bytes
+                  + by["dp/dp8"].memory.total_bytes) // 2
+        assert by["zero1/dp8"].memory.total_bytes < budget \
+            < by["dp/dp8"].memory.total_bytes
+        plan = run_plan(abstract_state, m, strategies=("dp", "zero1"),
+                        budget_bytes=budget)
+        assert plan.best().name == "zero1/dp8"
+        dp = next(c for c in plan.candidates if c.name == "dp/dp8")
+        assert not dp.feasible and "budget" in dp.reason
+        assert dp.rank is None
+        # the infeasible candidate still carries its full breakdown
+        assert dp.memory.total_bytes > 0 and dp.comm_seconds > 0
+
+    def test_no_feasible_candidate_raises_actionably(self, abstract_state):
+        plan = run_plan(abstract_state, hand_model(1e-9, 1e-9),
+                        budget_bytes=16)
+        with pytest.raises(autoplan.PlanError, match="no feasible"):
+            plan.best()
+
+    def test_batch_indivisible_is_infeasible(self, abstract_state):
+        plan = autoplan.plan(
+            profile=NO_COMPUTE, global_batch=6,
+            abstract_state=abstract_state,
+            cost_model=hand_model(1e-9, 1e-9), compute=MEASURED,
+            strategies=("dp",), max_tp=1, n_devices=4,
+            budget_bytes=None,
+        )
+        dp4 = next(c for c in plan.candidates if c.name == "dp/dp4")
+        assert not dp4.feasible and "batch" in dp4.reason
+        # the all-rejected error names the REAL reason, not a budget
+        with pytest.raises(autoplan.PlanError) as ei:
+            plan.best()
+        assert "batch" in str(ei.value)
+        assert "budget" not in str(ei.value)
+
+
+# -- pricing ----------------------------------------------------------------
+class TestPricing:
+    def test_synthetic_recovery_picks_hand_computed_cheapest(
+        self, abstract_state
+    ):
+        # expensive all_reduce, cheap reduce_scatter/all_gather:
+        # zero1's two cheap collectives beat dp's one expensive one
+        m = hand_model(ar_beta=10e-9, rsag_beta=1e-9)
+        plan = run_plan(abstract_state, m)
+        assert plan.best().name == "zero1/dp8"
+        # and the winner's price IS the hand-computed prediction
+        z1 = plan.best()
+        payload = z1.memory.params_global_bytes
+        want = (
+            m.predict("reduce_scatter", payload, 8).seconds
+            + m.predict("all_gather", payload, 8).seconds
+        )
+        assert z1.comm_seconds == pytest.approx(want, rel=1e-9)
+        # flipped betas flip the choice
+        plan2 = run_plan(abstract_state,
+                         hand_model(ar_beta=1e-9, rsag_beta=10e-9))
+        assert plan2.best().name == "dp/dp8"
+
+    def test_alpha_breaks_equal_volume_ties(self, abstract_state):
+        # equal betas: dp (1 call) and zero1 (2 calls) move the same
+        # wire bytes; a per-call alpha must rank dp first
+        plan = run_plan(abstract_state,
+                        hand_model(1e-9, 1e-9, alpha=1e-3))
+        assert plan.best().name == "dp/dp8"
+
+    def test_q8_wire_occupancy_priced(self):
+        # gradient-sized payload: q8 moves <= 0.3x the f32 wire bytes
+        # (the EQuARX-direction number the comms phase pins end to end)
+        m = hand_model(1e-9, 1e-9)
+        elems = 6_400_000
+        f32 = price_comm_terms(
+            grad_comm_terms("dp", elems * 4, elems, 8), m
+        )
+        q8 = price_comm_terms(
+            grad_comm_terms("dp", elems * 4, elems, 8, compress="int8"),
+            m,
+        )
+        assert q8[0].op == "all_reduce_q8"
+        ratio = q8[0].wire_bytes / f32[0].wire_bytes
+        assert 0.2 < ratio <= 0.3
+        assert q8[0].wire_bytes == algo_wire_bytes(
+            "all_reduce_q8", q8_wire_payload(elems), 8
+        )
+
+    def test_q8_fallback_to_f32_fit_is_flagged(self):
+        # a model never calibrated on all_reduce_q8 prices the q8
+        # payload on the all_reduce fit and says so
+        fits = {
+            ("all_reduce", 8): costmodel.OpFit(
+                "all_reduce", 8, 0.0, 1e-9, 1.0, 4, 0, 1 << 62
+            )
+        }
+        m = costmodel.CostModel("test", fits)
+        terms = price_comm_terms(
+            grad_comm_terms("dp", 4096 * 4, 4096, 8, compress="int8"), m
+        )
+        assert "no q8 calibration" in terms[0].note
+
+    def test_partially_calibrated_model_degrades_per_term(
+        self, abstract_state
+    ):
+        # collective_bench keeps later ops running when one fails, so a
+        # model missing reduce_scatter is reachable: zero1 pricing must
+        # degrade to the analytic fallback per term, flagged, not crash
+        fits = {
+            ("all_reduce", 8): costmodel.OpFit(
+                "all_reduce", 8, 0.0, 1e-9, 1.0, 4, 0, 1 << 62
+            ),
+            ("all_gather", 8): costmodel.OpFit(
+                "all_gather", 8, 0.0, 1e-9, 1.0, 4, 0, 1 << 62
+            ),
+        }
+        plan = run_plan(abstract_state,
+                        costmodel.CostModel("test", fits))
+        z1 = next(c for c in plan.candidates if c.name == "zero1/dp8")
+        rs = next(t for t in z1.comm_terms if t.op == "reduce_scatter")
+        assert "priced analytically" in rs.note
+        assert rs.extrapolated and z1.extrapolated
+        # ...and with NO fallback available the error is actionable
+        with pytest.raises(costmodel.CostModelUnavailable,
+                           match="collective_bench"):
+            price_comm_terms(
+                [autoplan.CommTerm("reduce_scatter", 1000, 8, 1)],
+                costmodel.CostModel("test", {}),
+            )
+
+    def test_accum_steps_shrinks_activation_memory(self, abstract_state):
+        profile = autoplan.ModelProfile(
+            flops_per_sample=0.0, activation_bytes_per_sample=1000.0
+        )
+        kw = dict(
+            profile=profile, global_batch=64,
+            abstract_state=abstract_state,
+            cost_model=hand_model(1e-9, 1e-9), compute=MEASURED,
+            strategies=("dp",), max_tp=1, n_devices=8,
+            budget_bytes=None,
+        )
+        flat = autoplan.plan(**kw)
+        acc = autoplan.plan(accum_steps=4, **kw)
+        a = flat.best().memory.activation_bytes
+        b = acc.best().memory.activation_bytes
+        assert a == 8 * 1000  # 64/8 samples resident
+        assert b == 2 * 1000  # one 2-sample microbatch resident
+
+    def test_fsdp_term_structure(self):
+        terms = grad_comm_terms("fsdp", 1000, 250, 4)
+        assert [(t.op, t.count) for t in terms] == [
+            ("all_gather", 2), ("reduce_scatter", 1)
+        ]
+
+    def test_extrapolation_flag_propagates(self, abstract_state):
+        # fits exist only at world 2: pricing world 8 extrapolates
+        m = hand_model(1e-9, 1e-9, worlds=(2,))
+        plan = run_plan(abstract_state, m)
+        assert all(c.extrapolated for c in plan.candidates)
+        assert plan.to_dict()["candidates"][0]["extrapolated"] is True
+
+
+# -- plan artifact ----------------------------------------------------------
+class TestPlanArtifact:
+    def test_ranking_deterministic(self, abstract_state):
+        m = hand_model(2e-9, 1e-9)
+        a = run_plan(abstract_state, m,
+                     strategies=("dp", "zero1", "fsdp"),
+                     tp_candidates=(1, 2, 4, 8))
+        b = run_plan(abstract_state, m,
+                     strategies=("dp", "zero1", "fsdp"),
+                     tp_candidates=(1, 2, 4, 8))
+        assert json.dumps(a.to_dict(), sort_keys=True) == \
+            json.dumps(b.to_dict(), sort_keys=True)
+
+    def test_plan_json_schema(self, abstract_state, tmp_path):
+        plan = run_plan(abstract_state, hand_model(1e-9, 1e-9))
+        path = plan.save(str(tmp_path / "plan.json"))
+        doc = json.load(open(path))
+        assert doc["format_version"] == 1
+        assert set(doc) >= {
+            "format_version", "generated_by", "n_devices",
+            "global_batch", "budget_bytes_per_device", "cost_model",
+            "compute_model", "uncalibrated", "chosen", "candidates",
+        }
+        assert doc["chosen"] == plan.best().name
+        assert doc["uncalibrated"] is False  # hand model + measured
+        for c in doc["candidates"]:
+            assert set(c) >= {
+                "name", "strategy", "mesh", "feasible", "rank",
+                "memory", "comms", "compute_seconds", "step_seconds",
+                "extrapolated",
+            }
+            assert set(c["memory"]) >= {
+                "param_bytes", "opt_bytes", "grad_bytes",
+                "activation_bytes", "total_bytes",
+            }
+            for t in c["comms"]["terms"]:
+                assert set(t) >= {"op", "payload_bytes", "world",
+                                  "count", "seconds", "wire_bytes",
+                                  "extrapolated"}
+        # ranked feasible candidates are price-sorted
+        ranked = [c for c in doc["candidates"] if c["rank"]]
+        assert ranked == sorted(ranked, key=lambda c: c["rank"])
+        steps = [c["step_seconds"] for c in ranked]
+        assert steps == sorted(steps)
+        # losers say why they lost
+        assert all(c["why_not"] for c in ranked[1:])
+
+    def test_write_metrics_protocol(self, abstract_state, tmp_path):
+        from pytorch_distributed_tpu.train.metrics import (
+            MetricsWriter,
+            read_metrics,
+        )
+
+        plan = run_plan(abstract_state, hand_model(1e-9, 1e-9))
+        path = str(tmp_path / "m.jsonl")
+        with MetricsWriter(path) as w:
+            plan.write_metrics(w)
+        recs = [r for r in read_metrics(path) if r["split"] == "plan"]
+        cands = [r for r in recs if r["event"] == "candidate"]
+        assert len(cands) == len(plan.candidates)
+        assert sum(int(r["chosen"]) for r in cands) == 1
+        summary = [r for r in recs if r["event"] == "plan_summary"]
+        assert len(summary) == 1
+        assert summary[0]["chosen"] == plan.best().name
+
+    def test_planning_never_compiles(self, abstract_state, monkeypatch):
+        def boom(*a, **k):
+            raise AssertionError("planning must never call jax.jit")
+
+        monkeypatch.setattr(jax, "jit", boom)
+        plan = run_plan(abstract_state, hand_model(1e-9, 1e-9),
+                        strategies=("dp", "zero1", "fsdp"),
+                        tp_candidates=(1, 2, 4, 8))
+        assert plan.best() is not None
+
+
+# -- cost-model failure UX --------------------------------------------------
+class TestCostModelFailureUX:
+    def test_missing_file_names_the_calibration_command(self, tmp_path):
+        with pytest.raises(costmodel.CostModelUnavailable) as ei:
+            costmodel.CostModel.load(str(tmp_path / "nope.json"))
+        assert "collective_bench" in str(ei.value)
+        assert "--fit" in str(ei.value)
+
+    def test_transport_mismatch_names_the_command(self, tmp_path):
+        m = hand_model(1e-9, 1e-9)
+        path = m.save(str(tmp_path / "cm.json"))
+        assert costmodel.CostModel.load(
+            path, expected_transport="test"
+        ).transport == "test"
+        with pytest.raises(costmodel.CostModelUnavailable) as ei:
+            costmodel.CostModel.load(path, expected_transport="hostring")
+        msg = str(ei.value)
+        assert "'test'" in msg and "'hostring'" in msg
+        assert "collective_bench" in msg
+
+    def test_garbage_file_names_the_command(self, tmp_path):
+        p = tmp_path / "cm.json"
+        p.write_text("{not json")
+        with pytest.raises(costmodel.CostModelUnavailable,
+                           match="collective_bench"):
+            costmodel.CostModel.load(str(p))
+
+    def test_planner_degrades_to_analytic_loudly(
+        self, abstract_state, tmp_path, caplog
+    ):
+        with ptd_caplog(caplog):
+            plan = autoplan.plan(
+                profile=NO_COMPUTE, global_batch=8,
+                abstract_state=abstract_state,
+                cost_model_path=str(tmp_path / "missing.json"),
+                compute=MEASURED, strategies=("dp",), max_tp=1,
+                n_devices=8, budget_bytes=None,
+            )
+        assert plan.uncalibrated
+        assert plan.cost_model_transport == costmodel.ANALYTIC_TRANSPORT
+        assert plan.to_dict()["cost_model"]["source"] == "analytic-guess"
+        assert any(
+            "uncalibrated" in r.message for r in caplog.records
+        )
+        # and the rendered table carries the warning + the fix
+        assert "UNCALIBRATED" in plan.table()
+        assert "collective_bench" in plan.table()
+
+    def test_tp_needs_explicit_opt_in(self, abstract_state):
+        # without model-dimension info the planner must not enumerate
+        # tp widths whose grad pricing assumes sharding the rule engine
+        # may not deliver — tp stays 1 unless tp_candidates/max_tp say
+        # otherwise
+        plan = autoplan.plan(
+            profile=NO_COMPUTE, global_batch=8,
+            abstract_state=abstract_state,
+            cost_model=hand_model(1e-9, 1e-9), compute=MEASURED,
+            strategies=("dp",), n_devices=8, budget_bytes=None,
+        )
+        assert [c.name for c in plan.candidates] == ["dp/dp8"]
+
+    def test_fallback_plan_does_not_record_the_unused_path(
+        self, abstract_state, tmp_path
+    ):
+        plan = autoplan.plan(
+            profile=NO_COMPUTE, global_batch=8,
+            abstract_state=abstract_state,
+            cost_model_path=str(tmp_path / "missing.json"),
+            compute=MEASURED, strategies=("dp",), max_tp=1,
+            n_devices=8, budget_bytes=None,
+        )
+        # the audit artifact must not imply the never-read file was used
+        assert plan.to_dict()["cost_model"]["path"] is None
+        assert plan.to_dict()["cost_model"]["source"] == "analytic-guess"
+
+    def test_assumed_compute_marks_uncalibrated(self, abstract_state):
+        plan = autoplan.plan(
+            profile=NO_COMPUTE, global_batch=8,
+            abstract_state=abstract_state,
+            cost_model=hand_model(1e-9, 1e-9),
+            strategies=("dp",), max_tp=1, n_devices=8,
+            budget_bytes=None,  # compute=None -> assumed platform model
+        )
+        assert plan.uncalibrated
+
+
+# -- end to end -------------------------------------------------------------
+def test_strategy_auto_end_to_end(tmp_path):
+    """``--strategy auto`` on the 8-device CPU mesh: the recipe plans,
+    writes plan.json, builds the chosen strategy and trains."""
+    plan_path = str(tmp_path / "plan.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "recipes", "gpt2_zero1.py"),
+         "--strategy", "auto", "--size", "tiny", "--epochs", "1",
+         "--steps-per-epoch", "2", "--batch-size", "8",
+         "--seq-len", "32", "--accum-steps", "1", "--log-every", "1",
+         "--plan-path", plan_path,
+         "--costmodel", str(tmp_path / "absent.json")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    blob = proc.stdout + proc.stderr
+    assert "auto-parallel plan" in blob
+    assert "auto strategy:" in blob
+    doc = json.load(open(plan_path))
+    assert doc["chosen"]
+    assert doc["uncalibrated"] is True  # no costmodel.json supplied
+    assert len(doc["candidates"]) > 1
+    chosen = next(
+        c for c in doc["candidates"] if c["name"] == doc["chosen"]
+    )
+    assert chosen["rank"] == 1 and chosen["feasible"]
+    # the chosen mesh covers all 8 devices
+    import math
+
+    assert math.prod(chosen["mesh"].values()) == 8
+
+
+def test_obs_report_renders_plan_section(abstract_state, tmp_path):
+    plan = run_plan(abstract_state, hand_model(1e-9, 1e-9))
+    plan.save(str(tmp_path / "plan.json"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_report.py"),
+         str(tmp_path)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "== Plan ==" in proc.stdout
+    assert plan.best().name in proc.stdout
+    assert "CHOSEN" in proc.stdout

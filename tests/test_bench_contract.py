@@ -131,7 +131,8 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
         and json.loads(l)["metric"] == "phase_durations_s"
     ]
     assert len(pd) == 1, proc.stderr[-2000:]
-    for phase in ("input_pipeline_feed", "serving", "observability"):
+    for phase in ("input_pipeline_feed", "serving", "observability",
+                  "planning"):
         assert phase in pd[0]["value"], pd[0]
     assert pd[0]["value"] == pytest.approx(durations, abs=0.2)
 
@@ -146,6 +147,21 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
     ]
     assert len(obs) == 1, proc.stderr[-2000:]
     assert obs[0]["value"] < 2.0, obs[0]
+
+    # the planning micro-phase: the auto-parallel planner must sweep
+    # the two reference configs in host-arithmetic time (it is
+    # eval_shape only — the child stubs jax.jit to prove planning never
+    # compiles; a compile would also blow this budget by itself)
+    plan_rec = [
+        json.loads(l) for l in proc.stderr.splitlines()
+        if l.startswith("{")
+        and json.loads(l)["metric"] == "planning_wall_s"
+    ]
+    assert len(plan_rec) == 1, proc.stderr[-2000:]
+    assert 0 < plan_rec[0]["value"] < 30, plan_rec[0]
+    assert set(plan_rec[0]["chosen"]) == {"gpt2_tiny", "resnet50"}
+    assert "planning" in durations, sorted(durations)
+    assert durations["planning"] < 180, durations
 
     # the comms phase: q8's RECORDED wire bytes at gradient size must be
     # <= 0.3x f32 (the encoding is int8 + one f32 scale per 256 elems,
