@@ -1,11 +1,14 @@
-"""serve/ — continuous-batching inference engine (slot-based KV cache).
+"""serve/ — continuous-batching inference engine (paged KV pool).
 
 The online counterpart of ``generation.generate``: requests arrive,
 start, and retire independently while ONE compiled decode step serves
-every mix of in-flight work (docs/DESIGN.md §11). Quickstart::
+every mix of in-flight work (docs/DESIGN.md §11, §16). KV memory is a
+page pool: requests hold page tables, identical prompt prefixes share
+pages copy-free via refcounts, and ``SpecConfig`` folds draft-verify
+speculative decoding into the engine tick. Quickstart::
 
     from pytorch_distributed_tpu.serve import (
-        EngineConfig, Request, ServeEngine,
+        EngineConfig, Request, ServeEngine, SpecConfig,
     )
 
     engine = ServeEngine(model, params, EngineConfig(num_slots=4,
@@ -14,19 +17,32 @@ every mix of in-flight work (docs/DESIGN.md §11). Quickstart::
                               temperature=0.8, top_p=0.95, seed=7))
     engine.run_until_drained()
     print(h.tokens)   # bit-identical to the solo generate() call
+
+    # speculative decoding: 1..k+1 tokens per tick, greedy streams
+    # still bit-identical to the target's own generate()
+    engine = ServeEngine(model, params, cfg,
+                         spec=SpecConfig(draft_model, draft_params,
+                                         num_draft_tokens=4))
 """
 
-from pytorch_distributed_tpu.serve.engine import EngineConfig, ServeEngine
+from pytorch_distributed_tpu.serve.engine import (
+    EngineConfig,
+    ServeEngine,
+    SpecConfig,
+)
 from pytorch_distributed_tpu.serve.loadgen import (
     drive,
+    prefix_shared_requests,
     uniform_arrivals,
     warm_up,
 )
 from pytorch_distributed_tpu.serve.kv_slots import (
-    KVSlotPool,
-    init_slot_cache,
-    put_slot,
-    take_slot,
+    PagedKVPool,
+    SlotLease,
+    auto_page_size,
+    gather_pages,
+    init_page_cache,
+    scatter_kv,
 )
 from pytorch_distributed_tpu.serve.sampling import (
     filter_logits_rows,
@@ -43,7 +59,7 @@ from pytorch_distributed_tpu.serve.telemetry import ServeTelemetry
 
 __all__ = [
     "EngineConfig",
-    "KVSlotPool",
+    "PagedKVPool",
     "PrefillChunk",
     "Request",
     "RequestHandle",
@@ -51,12 +67,16 @@ __all__ = [
     "Scheduler",
     "ServeEngine",
     "ServeTelemetry",
+    "SlotLease",
+    "SpecConfig",
+    "auto_page_size",
     "drive",
     "filter_logits_rows",
-    "init_slot_cache",
-    "put_slot",
+    "gather_pages",
+    "init_page_cache",
+    "prefix_shared_requests",
     "sample_logits_rows",
-    "take_slot",
+    "scatter_kv",
     "uniform_arrivals",
     "warm_up",
 ]

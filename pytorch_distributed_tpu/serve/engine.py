@@ -1,46 +1,56 @@
 """Continuous-batching serve loop: admit/retire mid-flight, compile once.
 
-The engine composes the pieces: a ``KVSlotPool`` (static device state),
-a ``Scheduler`` (host dynamism), per-row sampling, and TWO jitted
-programs that are each compiled exactly once for the engine's lifetime:
+The engine composes the pieces: a ``PagedKVPool`` (page-granular device
+state + host page tables), a ``Scheduler`` (host dynamism), per-row
+sampling, and a FIXED set of jitted programs, each compiled exactly once
+for the engine's lifetime — the bounded-compile-count invariant, pinned
+by tests:
 
 * ``prefill``: one ``[1, prefill_chunk]`` model pass writing a chunk of
-  one request's prompt into its slot (``write_pos`` per-row KV writes),
-  sampling the first token on the final chunk;
-* ``decode``: one ``[S, 1]`` tick over ALL slots — occupied, mid-
-  prefill, or free — through the SAME ``generation.decode_step_body``
-  the offline ``generate`` scan uses, then per-row sampling with each
-  slot's own (temperature, top_k, top_p, rng).
+  one request's prompt into its pages (gather pages -> dense row ->
+  ``write_pos`` chunk write -> scatter the chunk back), sampling the
+  first token on the final chunk. With speculation enabled the SAME
+  program also prefills the draft model's pages — still one program.
+* ``decode``: one ``[S, 1]`` tick over ALL slots through the same
+  ``generation.decode_step_body`` the offline ``generate`` scan uses,
+  on a dense view gathered from each slot's pages; only the decoding
+  rows' single written token is scattered back (free / mid-prefill rows
+  no longer write even garbage — their scatter is dropped).
+* with ``SpecConfig``: the decode tick is replaced by ONE fused
+  speculative program — k sequential draft proposals (a ``lax.scan`` of
+  single-token draft steps) + one ``[S, k+1]`` target verify pass +
+  per-row acceptance — emitting 1..k+1 tokens per request per tick for
+  one host dispatch. Draft and verify could be two programs; fusing
+  them halves dispatches and keeps the count at one, still counted via
+  ``decode_compiles``.
 
-Static-shape invariant: neither program's input shapes depend on which
-requests are in flight. Rows without a decoding request still compute —
-their sampled tokens are discarded on the host and their KV write lands
-at the row's current length, a position that is either masked (free
-slots, garbage until reuse overwrites from 0) or overwritten by the
-next prefill chunk (mid-prefill slots). Compile counts are exposed
-(``prefill_compiles``/``decode_compiles``) so tests can PIN "one
-compile per program for a whole mixed workload".
+Cache-rewind for rejected drafts is FREE here, unlike the offline
+``speculative.generate_speculative`` (whose append-only cache pays
+permanent slot bubbles): the pool's left-aligned position==buffer-slot
+layout means a rejected draft's KV sits at positions >= the row's
+accepted length — exactly where the next tick's chunk writes land
+before anything attends them. No kv_mask, no compaction, no bubbles.
 
-Parity invariant: every request's emitted token stream is bit-identical
-to a solo ``generate(prompt, ..., rng=jax.random.PRNGKey(seed))`` —
-regardless of batch composition, slot reuse, chunked prefill splits, or
-neighboring evictions. The load-bearing facts: batch rows are
-independent under XLA, masked cache tails contribute exact zeros, the
-per-row sampler is a bitwise transcript of ``generation.sample_logits``
-(serve/sampling.py), and each request's rng chain splits exactly when
-``generate``'s would (once at prefill, once per decode tick).
+Static-shape invariant: no program's input shapes depend on which
+requests are in flight. Parity invariant: every COMPLETED greedy or
+sampled (non-speculative) request's token stream is bit-identical to a
+solo ``generate(prompt, ..., rng=jax.random.PRNGKey(seed))``; under
+speculation, greedy streams stay bit-identical (the verify accepts
+exactly the target's own argmax prefix + correction) while sampled rows
+follow Leviathan rejection sampling (distribution-exact, not
+token-comparable — same contract as ``generate_speculative``).
 
 Failure model (degrade, don't crash): ``serve.prefill``/``serve.decode``
-fault sites (runtime/faults.py) fire per-request — a poisoned request
-is evicted as FAILED with the exception on its handle, its slot frees,
-and the engine keeps serving everyone else.
+fault sites fire per-request — a poisoned request is evicted as FAILED
+mid-speculation or not, its slot and page references released (shared
+pages survive for their other holders), and the engine keeps serving.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -53,13 +63,14 @@ from pytorch_distributed_tpu.generation import (
 from pytorch_distributed_tpu.runtime import faults
 from pytorch_distributed_tpu.runtime import tracing
 from pytorch_distributed_tpu.serve.kv_slots import (
-    KVSlotPool,
-    put_slot,
-    take_slot,
+    PagedKVPool,
+    gather_pages,
+    scatter_kv,
 )
 from pytorch_distributed_tpu.serve.sampling import (
     TOP_K_OFF,
     TOP_P_OFF,
+    filter_logits_rows,
     sample_logits_rows,
 )
 from pytorch_distributed_tpu.serve.scheduler import (
@@ -69,18 +80,50 @@ from pytorch_distributed_tpu.serve.scheduler import (
     Scheduler,
 )
 from pytorch_distributed_tpu.serve.telemetry import ServeTelemetry
+from pytorch_distributed_tpu.speculative import speculative_accept
 from pytorch_distributed_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
+class SpecConfig:
+    """Opt-in speculative decoding for the engine tick.
+
+    ``draft_model``/``draft_params`` must share the target's vocabulary
+    and the ``generate`` decode contract; ``num_draft_tokens`` (k) is
+    the static proposal width — every tick drafts k tokens and verifies
+    them in one ``[S, k+1]`` target pass, emitting 1..k+1 tokens per
+    decoding request.
+    """
+
+    draft_model: Any
+    draft_params: Any
+    num_draft_tokens: int = 4
+
+    def __post_init__(self):
+        if self.num_draft_tokens < 1:
+            raise ValueError(
+                f"num_draft_tokens must be >= 1, "
+                f"got {self.num_draft_tokens}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class EngineConfig:
     num_slots: int = 4          # S: max concurrent in-flight requests
-    max_len: int = 256          # per-slot KV capacity (prompt + new)
+    max_len: int = 256          # per-request dense KV capacity
     prefill_chunk: int = 32     # static prompt-chunk width
     prefill_chunks_per_step: int = 1  # prefill/decode interleave ratio
     telemetry_every: int = 32   # engine steps between occupancy snapshots
+    # paged pool knobs: page_size None -> largest power-of-2 divisor of
+    # max_len (<= 32); num_pages None -> memory parity with the old
+    # fixed [S, max_len] pool (size it DOWN to the realistic length mix
+    # for the memory win); prefix_cache shares identical page-aligned
+    # prompt prefixes copy-free via refcounts
+    page_size: Optional[int] = None
+    num_pages: Optional[int] = None
+    prefix_cache: bool = True
 
     def __post_init__(self):
         if self.num_slots < 1:
@@ -99,6 +142,14 @@ class EngineConfig:
             raise ValueError(
                 f"prefill_chunk {self.prefill_chunk} > max_len "
                 f"{self.max_len}: no request could ever be admitted"
+            )
+        if self.page_size is not None and (
+            self.page_size < 1 or self.max_len % self.page_size
+        ):
+            raise ValueError(
+                f"page_size {self.page_size} must divide max_len "
+                f"{self.max_len} (the paged dense view is "
+                f"max_pages * page_size wide)"
             )
 
 
@@ -122,12 +173,14 @@ class ServeEngine:
         params,
         config: EngineConfig = EngineConfig(),
         *,
+        spec: Optional[SpecConfig] = None,
         telemetry: Optional[ServeTelemetry] = None,
         clock=time.monotonic,
     ):
         self.model = model
         self.params = params
         self.config = config
+        self.spec = spec
         self.telemetry = telemetry or ServeTelemetry(clock=clock)
         self._clock = clock
         limit = model_max_len(model)
@@ -136,11 +189,35 @@ class ServeEngine:
                 f"max_len {config.max_len} exceeds the model's maximum "
                 f"sequence length {limit}"
             )
-        self.pool = KVSlotPool(
-            model, params, config.num_slots, config.max_len
+        self.pool = PagedKVPool(
+            model, params, config.num_slots, config.max_len,
+            page_size=config.page_size, num_pages=config.num_pages,
+            prefix_cache=config.prefix_cache,
         )
+        self.draft_pool = None
+        self._spec_tail = 0
+        if spec is not None:
+            dlimit = model_max_len(spec.draft_model)
+            if dlimit is not None and config.max_len > dlimit:
+                raise ValueError(
+                    f"max_len {config.max_len} exceeds the DRAFT "
+                    f"model's maximum sequence length {dlimit}"
+                )
+            # the draft shares the target's page geometry so one chunk
+            # stream and one joint prefix skip drive both caches
+            self.draft_pool = PagedKVPool(
+                spec.draft_model, spec.draft_params,
+                config.num_slots, config.max_len,
+                page_size=self.pool.page_size,
+                num_pages=config.num_pages,
+                prefix_cache=config.prefix_cache,
+            )
+            # verify writes up to k rejected-draft entries past the
+            # emitted horizon — reserved at admission, checked at submit
+            self._spec_tail = spec.num_draft_tokens
         self.scheduler = Scheduler(config.num_slots, config.prefill_chunk)
         S = config.num_slots
+        mp = self.pool.max_pages
         # per-slot sampling/decode state lives ON DEVICE and is updated
         # in place: rows change only at request transitions (admission,
         # prefill-final, eviction), and the decode tick advances the
@@ -148,9 +225,9 @@ class ServeEngine:
         # tick is ONE jit call plus one token fetch, no per-tick
         # host->device re-uploads (measured 2ms/tick of pure host
         # overhead before this). Stale rows of freed/mid-prefill slots
-        # are harmless: their sampled tokens are discarded and their KV
-        # writes land at positions that are overwritten before any mask
-        # lets attention read them.
+        # are harmless: their sampled tokens are discarded and their
+        # pool writes are DROPPED (scatter keep-mask), so stale state
+        # never reaches the persistent pages.
         self._toks = jnp.zeros(S, jnp.int32)
         self._lengths = jnp.zeros(S, jnp.int32)
         self._temps = jnp.zeros(S, jnp.float32)
@@ -159,6 +236,11 @@ class ServeEngine:
         # old-style uint32 [2] keys: stackable/vmappable plain arrays
         # with the same threefry streams as jax.random.key
         self._keys = jnp.tile(jax.random.PRNGKey(0)[None, :], (S, 1))
+        # device page tables (target + draft), updated only at admission
+        self._pt = jnp.zeros((S, mp), jnp.int32)
+        self._dpt = (
+            jnp.zeros((S, mp), jnp.int32) if spec is not None else None
+        )
         self._n_deadlines = 0  # live requests carrying a deadline
         self._any_cancel = False
         # the decoding set only changes at request transitions — cache
@@ -171,17 +253,36 @@ class ServeEngine:
         self._decode_ticks = 0
         self.prefill_compiles = 0
         self.decode_compiles = 0
-        # donation lets XLA update the pool cache in place; XLA:CPU
+        # speculative bookkeeping (raw per-verify acceptance; host ints)
+        self.spec_verifies = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        # donation lets XLA update the page pools in place; XLA:CPU
         # cannot alias and would warn every call, so gate on backend
         donate = jax.default_backend() != "cpu"
-        self._prefill = jax.jit(
-            self._prefill_fn, donate_argnums=(1,) if donate else ()
-        )
-        # cache + the in-program-advanced rows (toks/lengths/keys) are
-        # donated: each is replaced by its returned successor every tick
-        self._decode = jax.jit(
-            self._decode_fn, donate_argnums=(1, 2, 3, 4) if donate else ()
-        )
+        # distinct attributes per program (never rebound to a different
+        # signature) so donation bookkeeping is auditable per call site
+        self._prefill = self._decode = None
+        self._prefill_spec = self._spec_tick = None
+        if spec is None:
+            self._prefill = jax.jit(
+                self._prefill_fn, donate_argnums=(1,) if donate else ()
+            )
+            # pool + the in-program-advanced rows (toks/lengths/keys)
+            # are donated: each is replaced by its returned successor
+            self._decode = jax.jit(
+                self._decode_fn,
+                donate_argnums=(1, 3, 4, 5) if donate else (),
+            )
+        else:
+            self._prefill_spec = jax.jit(
+                self._prefill_spec_fn,
+                donate_argnums=(2, 3) if donate else (),
+            )
+            self._spec_tick = jax.jit(
+                self._spec_fn,
+                donate_argnums=(2, 3, 6, 7, 8) if donate else (),
+            )
         # admission-time row setup as ONE jitted program: eager
         # .at[].set dispatches cost ~2.4ms EACH on this backend
         # (measured under cProfile — per-request transitions were half
@@ -189,15 +290,19 @@ class ServeEngine:
         self._admit_rows = jax.jit(self._admit_rows_fn)
 
     # -- jitted programs ---------------------------------------------------
-    def _prefill_fn(self, params, cache, ids, slot, start, last_idx,
-                    final, toks, lengths, keys, temps, top_ks, top_ps):
-        # traced once per engine lifetime — python side effect counts
-        # compiles (the static-shape invariant, pinned by tests)
-        self.prefill_compiles += 1
+    def _prefill_chunk_body(self, model, params, cache, pt, ids, slot,
+                            start):
+        """One model's chunk prefill over its page pool: gather the
+        slot's pages to a dense row, run the ``[1, C]`` chunk write, and
+        scatter exactly the chunk's positions back (padded final-chunk
+        positions included — they stay inside the slot's reserved
+        private span and are overwritten or masked, as before).
+        Returns (chunk logits, updated pool)."""
         C = self.config.prefill_chunk
-        row = take_slot(cache, slot)
+        row_pt = jax.lax.dynamic_slice_in_dim(pt, slot, 1, axis=0)
+        row = gather_pages(cache, row_pt)
         positions = (start + jnp.arange(C))[None, :]
-        logits, state = self.model.apply(
+        logits, state = model.apply(
             {"params": params, "cache": row},
             ids,
             decode=True,
@@ -206,14 +311,20 @@ class ServeEngine:
             positions=positions,
             write_pos=jnp.asarray(start, jnp.int32)[None],
         )
-        cache = put_slot(cache, state["cache"], slot)
+        cache = scatter_kv(
+            cache, state["cache"], row_pt, positions,
+            jnp.ones((1, C), bool),
+        )
+        return logits, cache
+
+    def _prefill_tail(self, logits, slot, start, last_idx, final, toks,
+                      lengths, keys, temps, top_ks, top_ps):
+        """Shared epilogue: advance the device length cursor and, on the
+        final chunk, sample/persist the first token + rng split."""
         # the device length cursor advances with EVERY chunk, not just
-        # the final one: a decode tick between chunks writes this
-        # inactive row's K/V at its cursor, and only a cursor at the
-        # NEXT chunk's start keeps that garbage in a range the next
-        # chunk overwrites — a stale cursor lands it on already-
-        # prefilled positions (a measured corruption, caught by the
-        # mixed-workload parity test)
+        # the final one — a decode tick between chunks must see the
+        # cursor at the NEXT chunk's start (its write is dropped, but
+        # its positions/mask derive from the cursor)
         lengths = lengths.at[slot].set(start + last_idx + 1)
         # rng discipline mirrors generate(): ONE split before the first
         # token, persisted (with the token) only on the final chunk
@@ -227,28 +338,77 @@ class ServeEngine:
         )[0]
         keys = jnp.where(final, keys.at[slot].set(pair[0]), keys)
         toks = jnp.where(final, toks.at[slot].set(tok), toks)
+        return tok, toks, lengths, keys
+
+    def _prefill_fn(self, params, cache, pt, ids, slot, start, last_idx,
+                    final, toks, lengths, keys, temps, top_ks, top_ps):
+        # traced once per engine lifetime — python side effect counts
+        # compiles (the static-shape invariant, pinned by tests)
+        self.prefill_compiles += 1
+        logits, cache = self._prefill_chunk_body(
+            self.model, params, cache, pt, ids, slot, start
+        )
+        tok, toks, lengths, keys = self._prefill_tail(
+            logits, slot, start, last_idx, final, toks, lengths, keys,
+            temps, top_ks, top_ps,
+        )
         return cache, tok, toks, lengths, keys
 
-    def _admit_rows_fn(self, temps, top_ks, top_ps, keys, lengths, slot,
-                       temp, top_k, top_p, seed):
-        # the write cursor parks at 0 so any tick before the first
-        # chunk drops its garbage where that chunk will overwrite it
-        return (
+    def _prefill_spec_fn(self, params, dparams, cache, dcache, pt, dpt,
+                         ids, slot, start, last_idx, final, toks,
+                         lengths, keys, temps, top_ks, top_ps):
+        """Speculative prefill: the SAME chunk through target AND draft
+        (the draft needs the prompt's KV before it can propose) — one
+        program, one dispatch per chunk."""
+        self.prefill_compiles += 1
+        logits, cache = self._prefill_chunk_body(
+            self.model, params, cache, pt, ids, slot, start
+        )
+        _, dcache = self._prefill_chunk_body(
+            self.spec.draft_model, dparams, dcache, dpt, ids, slot,
+            start,
+        )
+        tok, toks, lengths, keys = self._prefill_tail(
+            logits, slot, start, last_idx, final, toks, lengths, keys,
+            temps, top_ks, top_ps,
+        )
+        return cache, dcache, tok, toks, lengths, keys
+
+    def _admit_rows_fn(self, temps, top_ks, top_ps, keys, lengths, pt,
+                       dpt, slot, temp, top_k, top_p, seed, skip,
+                       pt_row, dpt_row):
+        # the write cursor parks at `skip` — the first position the
+        # request's own prefill will write. Everything before it is
+        # shared-prefix pages (read-only by the CoW discipline); the
+        # decode tick's write for this inactive row is dropped anyway,
+        # but positions/masks derive from the cursor and must never
+        # point inside a shared page.
+        out = (
             temps.at[slot].set(temp),
             top_ks.at[slot].set(top_k),
             top_ps.at[slot].set(top_p),
             keys.at[slot].set(jax.random.PRNGKey(seed)),
-            lengths.at[slot].set(0),
+            lengths.at[slot].set(skip),
+            pt.at[slot].set(pt_row),
         )
+        if dpt is not None:
+            out = out + (dpt.at[slot].set(dpt_row),)
+        return out
 
-    def _decode_fn(self, params, cache, toks, lengths, keys, temps,
+    def _decode_fn(self, params, cache, pt, toks, lengths, keys, temps,
                    top_ks, top_ps, active):
         self.decode_compiles += 1
-        last, cache = decode_step_body(
-            self.model, params, cache, toks,
+        dense = gather_pages(cache, pt)
+        last, dense = decode_step_body(
+            self.model, params, dense, toks,
             cache_len=self.config.max_len,
             positions=lengths[:, None],
             write_pos=lengths,
+        )
+        # persist ONLY the decoding rows' written token; free and
+        # mid-prefill rows drop their write on the floor
+        cache = scatter_kv(
+            cache, dense, pt, lengths[:, None], active[:, None]
         )
         pair = jax.vmap(jax.random.split)(keys)  # [S, 2, 2]
         nxt = sample_logits_rows(last, pair[:, 1], temps, top_ks, top_ps)
@@ -260,6 +420,168 @@ class ServeEngine:
         lengths_out = lengths + active.astype(jnp.int32)
         keys_out = jnp.where(active[:, None], pair[:, 0], keys)
         return cache, nxt, toks_out, lengths_out, keys_out
+
+    def _spec_fn(self, params, dparams, cache, dcache, pt, dpt, toks,
+                 lengths, keys, temps, top_ks, top_ps, active):
+        """The fused speculative tick: k draft proposals -> one [S, k+1]
+        target verify -> per-row acceptance -> page scatters.
+
+        Greedy rows accept the longest prefix where the target's own
+        argmax agrees (output EXACTLY the target's greedy stream);
+        sampled rows run Leviathan rejection sampling per row with that
+        row's filtered distributions. Emits ``a+1`` tokens per active
+        row; the host truncates at eos / max_new (any truncation
+        retires the request, so device/host state never diverges for a
+        row that keeps decoding).
+        """
+        self.decode_compiles += 1
+        k = self.spec.num_draft_tokens
+        S = self.config.num_slots
+        max_len = self.config.max_len
+        idx = jnp.arange(k + 1)[None, :]
+        pair = jax.vmap(jax.random.split)(keys)   # [S, 2, 2]
+        ticket = pair[:, 1]  # per-row key budget for this tick's draws
+        greedy_row = temps <= 0
+        # the sampled machinery (per-row filtered distributions — a
+        # vocab sort per position — plus rejection sampling) is real
+        # compute the all-greedy steady state shouldn't pay: one
+        # runtime branch skips it when no live row samples
+        any_sampled = jnp.any(~greedy_row)
+
+        dense_d = gather_pages(dcache, dpt)
+
+        def dstep(carry, j):
+            dense_d, tok = carry
+            logits, dense_d = decode_step_body(
+                self.spec.draft_model, dparams, dense_d, tok,
+                cache_len=max_len,
+                positions=(lengths + j)[:, None],
+                write_pos=lengths + j,
+            )
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+            def propose_sampled(lg):
+                filt = filter_logits_rows(lg, temps, top_ks, top_ps)
+                sub = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+                    ticket, 1 + j
+                )
+                sampled = jax.vmap(
+                    lambda kk, row: jax.random.categorical(
+                        kk, row, axis=-1
+                    )
+                )(sub, filt).astype(jnp.int32)
+                return (
+                    jnp.where(greedy_row, greedy, sampled),
+                    jax.nn.softmax(filt, axis=-1),
+                )
+
+            nxt, q = jax.lax.cond(
+                any_sampled, propose_sampled,
+                lambda lg: (greedy, jnp.zeros(lg.shape, jnp.float32)),
+                logits,
+            )
+            return (dense_d, nxt), (nxt, q)
+
+        (dense_d, last_prop), (drafts, qs) = jax.lax.scan(
+            dstep, (dense_d, toks), jnp.arange(k), length=k
+        )
+        # one sampling-free feed caches the FINAL proposal's K/V
+        # (speculative.py's dfill, carried over): a fully accepted
+        # round advances past position lengths+k, and without this
+        # write that position would hold a permanent hole the draft
+        # attends forever after — acceptance quietly degrades while
+        # emitted tokens stay correct. For partial acceptance the
+        # entry is rejected-tail garbage the next round overwrites
+        # before any query reaches it, like every other rejected slot.
+        _, dense_d = decode_step_body(
+            self.spec.draft_model, dparams, dense_d, last_prop,
+            cache_len=max_len,
+            positions=(lengths + k)[:, None],
+            write_pos=lengths + k,
+        )
+        drafts = drafts.T                      # [S, k]
+        q_probs = jnp.moveaxis(qs, 0, 1)       # [S, k, V]
+        dpos = lengths[:, None] + jnp.arange(k + 1)[None, :]
+        dcache = scatter_kv(
+            dcache, dense_d, dpt, dpos,
+            active[:, None] & jnp.ones((1, k + 1), bool),
+        )
+
+        # ---- verify: one chunked target pass scores the proposal ----
+        dense_t = gather_pages(cache, pt)
+        chunk = jnp.concatenate([toks[:, None], drafts], axis=1)
+        logits, st = self.model.apply(
+            {"params": params, "cache": dense_t},
+            chunk, decode=True, cache_len=max_len,
+            mutable=["cache"],
+            positions=lengths[:, None] + idx,
+            write_pos=lengths,
+        )
+        vpos = lengths[:, None] + idx
+        cache = scatter_kv(
+            cache, st["cache"], pt, vpos,
+            active[:, None] & jnp.ones((1, k + 1), bool),
+        )
+
+        # ---- acceptance ----
+        # greedy: the longest draft prefix matching the target's own
+        # argmax chain, correction = the target's next choice — the
+        # emitted stream IS target-greedy, token for token
+        preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, k+1]
+        match = drafts == preds[:, :k]
+        a_g = jnp.sum(
+            jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1
+        )
+        corr_g = jnp.take_along_axis(preds, a_g[:, None], axis=1)[:, 0]
+
+        def accept_sampled(lg):
+            # Leviathan rejection sampling per row with the row's own
+            # filtered target/draft distributions and its own key chain
+            p_filt = jax.vmap(
+                lambda col: filter_logits_rows(
+                    col, temps, top_ks, top_ps
+                ),
+                in_axes=1, out_axes=1,
+            )(lg)
+            p_probs = jax.nn.softmax(p_filt, axis=-1)  # [S, k+1, V]
+            acc_keys = jax.vmap(
+                jax.random.fold_in, in_axes=(0, None)
+            )(ticket, 0)
+            a_s, corr_s = jax.vmap(
+                lambda p, q, d, kk: speculative_accept(
+                    p[None], q[None], d[None], kk
+                )
+            )(p_probs, q_probs, drafts, acc_keys)
+            return (
+                jnp.where(greedy_row, a_g, a_s[:, 0]),
+                jnp.where(greedy_row, corr_g, corr_s[:, 0]),
+            )
+
+        a, corr = jax.lax.cond(
+            any_sampled, accept_sampled, lambda lg: (a_g, corr_g),
+            logits,
+        )
+
+        drafts_ext = jnp.concatenate(
+            [drafts, jnp.zeros((S, 1), jnp.int32)], axis=1
+        )
+        emit = jnp.where(idx < a[:, None], drafts_ext, corr[:, None])
+        # the correction is the round's last emitted token — next
+        # tick's input, its KV not yet written (it was an OUTPUT), so
+        # next tick's chunk write at the new length caches it and
+        # overwrites the first rejected entry in the same stroke
+        toks_out = jnp.where(active, corr, toks)
+        lengths_out = lengths + jnp.where(
+            active, a + 1, jnp.zeros_like(a)
+        )
+        keys_out = jnp.where(active[:, None], pair[:, 0], keys)
+        # accepted count rides as one extra column so the host pays a
+        # SINGLE device fetch per tick (two syncs measurably hurt the
+        # dispatch-bound regime speculation targets)
+        emit_acc = jnp.concatenate([emit, a[:, None]], axis=1)
+        return (
+            cache, dcache, emit_acc, toks_out, lengths_out, keys_out,
+        )
 
     # -- intake ------------------------------------------------------------
     def submit(self, request: Request) -> RequestHandle:
@@ -275,11 +597,15 @@ class ServeEngine:
                 f"{chunks * cfg.prefill_chunk} chunked-prefill slots, "
                 f"exceeding max_len {cfg.max_len}"
             )
-        if P + request.max_new_tokens > cfg.max_len:
+        if P + request.max_new_tokens + self._spec_tail > cfg.max_len:
+            tail_note = (
+                f" + {self._spec_tail} speculative-verify slots"
+                if self._spec_tail else ""
+            )
             raise ValueError(
                 f"prompt ({P}) + max_new_tokens "
-                f"({request.max_new_tokens}) exceeds the engine's "
-                f"max_len {cfg.max_len}"
+                f"({request.max_new_tokens}){tail_note} exceeds the "
+                f"engine's max_len {cfg.max_len}"
             )
         handle = RequestHandle(request, submitted_at=self._clock())
         if request.deadline_s is not None:
@@ -316,7 +642,9 @@ class ServeEngine:
             self._any_cancel = False
             for h in self.scheduler.sweep_cancelled():
                 self._finish(h, RequestStatus.CANCELLED)
-        for h in self.scheduler.admit(self.pool):
+        for h in self.scheduler.admit(
+            self.pool, self.draft_pool, tail=self._spec_tail
+        ):
             with tracing.span(
                 "serve.admit", request=h.request.request_id
             ):
@@ -326,13 +654,46 @@ class ServeEngine:
         if self.config.telemetry_every and (
             self._steps % self.config.telemetry_every == 0
         ):
-            self.telemetry.record_snapshot(
-                queue_depth=self.scheduler.queue_depth(),
-                slots_occupied=self.pool.num_occupied,
-                slots_total=self.pool.num_slots,
-                decode_ticks=self._decode_ticks,
-            )
+            self._snapshot()
         return did
+
+    def _snapshot(self) -> None:
+        pool = self.pool
+        gauges = dict(
+            pages_in_use=pool.pages_in_use,
+            pages_total=pool.num_pages,
+            page_occupancy=(
+                pool.pages_in_use / pool.num_pages if pool.num_pages
+                else 0.0
+            ),
+            prefix_hit_rate=pool.prefix_hit_rate,
+        )
+        if self.spec is not None:
+            gauges.update(
+                spec_verifies=self.spec_verifies,
+                spec_drafted=self.spec_drafted,
+                spec_accepted=self.spec_accepted,
+            )
+        self.telemetry.record_snapshot(
+            queue_depth=self.scheduler.queue_depth(),
+            slots_occupied=pool.num_occupied,
+            slots_total=pool.num_slots,
+            decode_ticks=self._decode_ticks,
+            **gauges,
+        )
+        if tracing._tracer is not None:
+            tracing.counter("serve.kv_pages_in_use", pool.pages_in_use)
+            tracing.counter(
+                "serve.kv_page_occupancy", gauges["page_occupancy"]
+            )
+            tracing.counter(
+                "serve.prefix_hit_rate", pool.prefix_hit_rate
+            )
+            if self.spec is not None and self.spec_verifies:
+                tracing.counter(
+                    "serve.spec_accepted_per_verify",
+                    self.spec_accepted / self.spec_verifies,
+                )
 
     def run_until_drained(self, max_steps: int = 1_000_000) -> None:
         """Step until every submitted request reaches a terminal state."""
@@ -370,19 +731,21 @@ class ServeEngine:
             with tracing.span(
                 "serve.prefill_chunk", request=h.request.request_id
             ):
-                (
-                    cache, tok, self._toks, self._lengths, self._keys,
-                ) = self._prefill(
-                    self.params, self.pool.cache, ids, slot, plan.start,
-                    plan.chunk_len - 1, plan.final,
-                    self._toks, self._lengths, self._keys,
-                    self._temps, self._top_ks, self._top_ps,
-                )
+                if self.spec is None:
+                    tok = self._dispatch_prefill(ids, slot, plan)
+                else:
+                    tok = self._dispatch_prefill_spec(ids, slot, plan)
             tracing.note_compiles("serve.prefill", self.prefill_compiles)
-            self.pool.cache = cache
             self.pool.lengths[slot] = plan.start + plan.chunk_len
             did = True
             if plan.final:
+                # the slot's full prompt pages now hold canonical KV —
+                # publish them for copy-free sharing by later admissions
+                self.pool.register_prefix(h._lease, h.request.prompt_ids)
+                if self.draft_pool is not None:
+                    self.draft_pool.register_prefix(
+                        h._dlease, h.request.prompt_ids
+                    )
                 self.scheduler.prefill_finished(h)
                 self._decoding_dirty = True
                 self._emit(h, int(tok))
@@ -400,6 +763,8 @@ class ServeEngine:
         if not decoding:
             return False
         self._decode_ticks += 1
+        if self.spec is not None:
+            return self._run_spec_tick(decoding)
         # one jit call; toks/lengths/keys advance in-program for the
         # active rows, so the only per-tick host traffic is the sampled
         # tokens coming down
@@ -414,9 +779,9 @@ class ServeEngine:
                 self.pool.cache, nxt, self._toks, self._lengths,
                 self._keys,
             ) = self._decode(
-                self.params, self.pool.cache, self._toks, self._lengths,
-                self._keys, self._temps, self._top_ks, self._top_ps,
-                self._active_cached,
+                self.params, self.pool.cache, self._pt, self._toks,
+                self._lengths, self._keys, self._temps, self._top_ks,
+                self._top_ps, self._active_cached,
             )
         tracing.note_compiles("serve.decode", self.decode_compiles)
         with tracing.span("serve.token_fetch"):
@@ -434,6 +799,89 @@ class ServeEngine:
                     self._finish(h, RequestStatus.FAILED, error=e)
                     continue
             self._emit(h, int(nxt[slot]))
+        return True
+
+    def _dispatch_prefill(self, ids, slot, plan):
+        """One plain prefill-chunk dispatch; the donated pool buffer is
+        rebound to its returned successor before anything reads it."""
+        (
+            cache, tok, self._toks, self._lengths, self._keys,
+        ) = self._prefill(
+            self.params, self.pool.cache, self._pt, ids,
+            slot, plan.start, plan.chunk_len - 1, plan.final,
+            self._toks, self._lengths, self._keys,
+            self._temps, self._top_ks, self._top_ps,
+        )
+        self.pool.cache = cache
+        return tok
+
+    def _dispatch_prefill_spec(self, ids, slot, plan):
+        """One fused target+draft prefill-chunk dispatch; both donated
+        pool buffers rebind to their returned successors."""
+        (
+            cache, dcache, tok, self._toks, self._lengths, self._keys,
+        ) = self._prefill_spec(
+            self.params, self.spec.draft_params,
+            self.pool.cache, self.draft_pool.cache,
+            self._pt, self._dpt, ids,
+            slot, plan.start, plan.chunk_len - 1, plan.final,
+            self._toks, self._lengths, self._keys,
+            self._temps, self._top_ks, self._top_ps,
+        )
+        self.pool.cache = cache
+        self.draft_pool.cache = dcache
+        self.draft_pool.lengths[slot] = plan.start + plan.chunk_len
+        return tok
+
+    def _run_spec_tick(self, decoding) -> bool:
+        """One fused draft+verify tick; emits 1..k+1 tokens/request."""
+        span = (
+            tracing._NULL_SPAN if tracing._tracer is None
+            else tracing.span(
+                "serve.spec_tick", active=len(decoding),
+                k=self.spec.num_draft_tokens,
+            )
+        )
+        with span:
+            (
+                self.pool.cache, self.draft_pool.cache, emit_acc,
+                self._toks, self._lengths, self._keys,
+            ) = self._spec_tick(
+                self.params, self.spec.draft_params,
+                self.pool.cache, self.draft_pool.cache,
+                self._pt, self._dpt, self._toks, self._lengths,
+                self._keys, self._temps, self._top_ks, self._top_ps,
+                self._active_cached,
+            )
+        tracing.note_compiles("serve.decode", self.decode_compiles)
+        with tracing.span("serve.token_fetch"):
+            # ONE per-tick device sync: k+1 emit columns + the
+            # accepted count packed into a single [S, k+2] fetch
+            emit_acc = np.asarray(emit_acc)
+        emit, acc = emit_acc[:, :-1], emit_acc[:, -1]
+        k = self.spec.num_draft_tokens
+        self.spec_verifies += 1
+        fault_armed = faults.active()
+        for slot, h in decoding:
+            n = int(acc[slot]) + 1
+            # mirror the in-program advances: the verify wrote k+1
+            # entries but only a+1 became sequence; the rejected tail
+            # sits beyond the accepted length where the next tick's
+            # chunk write lands before anything attends it
+            self.pool.lengths[slot] += n
+            self.draft_pool.lengths[slot] += n
+            self.spec_drafted += k
+            self.spec_accepted += n - 1
+            if fault_armed:
+                try:
+                    faults.check("serve.decode", path=h.request.request_id)
+                except faults.InjectedFault as e:
+                    self._finish(h, RequestStatus.FAILED, error=e)
+                    continue
+            for j in range(n):
+                self._emit(h, int(emit[slot, j]))
+                if h.done:  # eos / max_new truncation retires the row
+                    break
         return True
 
     # -- emission / retirement ---------------------------------------------
@@ -464,7 +912,7 @@ class ServeEngine:
             "serve.evict",
             request=h.request.request_id, status=status.value,
         ):
-            self.scheduler.release(h, self.pool)
+            self.scheduler.release(h, self.pool, self.draft_pool)
         self.telemetry.record_done(h)
         if status is RequestStatus.FAILED:
             logger.warning(
@@ -475,14 +923,23 @@ class ServeEngine:
     # -- admission-time slot setup ----------------------------------------
     def _configure_slot(self, h: RequestHandle) -> None:
         req = h.request
-        (
+        lease = h._lease
+        dpt_row = (
+            h._dlease.page_row if h._dlease is not None
+            else np.zeros(0, np.int32)
+        )
+        out = self._admit_rows(
             self._temps, self._top_ks, self._top_ps, self._keys,
-            self._lengths,
-        ) = self._admit_rows(
-            self._temps, self._top_ks, self._top_ps, self._keys,
-            self._lengths, h.slot,
+            self._lengths, self._pt,
+            self._dpt, h.slot,
             req.temperature,
             TOP_K_OFF if req.top_k is None else req.top_k,
             TOP_P_OFF if req.top_p is None else req.top_p,
-            req.seed,
+            req.seed, lease.skip, lease.page_row, dpt_row,
         )
+        (
+            self._temps, self._top_ks, self._top_ps, self._keys,
+            self._lengths, self._pt,
+        ) = out[:6]
+        if self._dpt is not None:
+            self._dpt = out[6]

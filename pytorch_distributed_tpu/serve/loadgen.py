@@ -10,6 +10,8 @@ from __future__ import annotations
 import time
 from typing import List, Sequence
 
+import numpy as np
+
 from pytorch_distributed_tpu.serve.scheduler import Request, RequestStatus
 from pytorch_distributed_tpu.serve.telemetry import ServeTelemetry
 
@@ -80,3 +82,60 @@ def uniform_arrivals(n: int, rate: float) -> List[float]:
     if rate <= 0:
         return [0.0] * n
     return [i / rate for i in range(n)]
+
+
+def prefix_shared_requests(
+    rng,
+    n: int,
+    vocab: int,
+    *,
+    prompt_len=(4, 16),
+    new_tokens=(8, 32),
+    prefix_share: float = 0.0,
+    shared_prefix_len: int = 0,
+    temperature: float = 0.0,
+    top_k=None,
+    top_p=None,
+    deadline_s=None,
+) -> List[Request]:
+    """Seeded mixed-length workload with a common-system-prompt knob.
+
+    ``prefix_share`` of the requests open with ONE shared
+    ``shared_prefix_len``-token system prompt (drawn once from ``rng``)
+    followed by their own tail; the rest are fully independent. This is
+    the workload shape the paged pool's prefix registry exists for —
+    bench.py's ``serving_paged`` phase and ``scripts/serve_loadgen.py
+    --prefix-share`` both build their request streams here so the two
+    can never exercise different sharing paths. Lengths are inclusive
+    ``(lo, hi)`` ranges; per-request seeds come from ``rng`` so sampled
+    runs replay exactly.
+    """
+    if not 0.0 <= prefix_share <= 1.0:
+        raise ValueError(
+            f"prefix_share must be in [0, 1], got {prefix_share}"
+        )
+    if prefix_share > 0.0 and shared_prefix_len < 1:
+        raise ValueError(
+            "prefix_share > 0 needs shared_prefix_len >= 1 "
+            "(the common system prompt must exist to be shared)"
+        )
+    system = rng.integers(
+        1, vocab, size=shared_prefix_len
+    ).astype(np.int32) if shared_prefix_len else None
+    p_lo, p_hi = prompt_len
+    n_lo, n_hi = new_tokens
+    reqs = []
+    for i in range(n):
+        tail = rng.integers(
+            1, vocab, size=int(rng.integers(p_lo, p_hi + 1))
+        ).astype(np.int32)
+        shared = system is not None and rng.random() < prefix_share
+        ids = np.concatenate([system, tail]) if shared else tail
+        reqs.append(Request(
+            prompt_ids=ids,
+            max_new_tokens=int(rng.integers(n_lo, n_hi + 1)),
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            deadline_s=deadline_s,
+            seed=int(rng.integers(0, 2**31)),
+        ))
+    return reqs

@@ -114,6 +114,13 @@ class RequestHandle:
         # -- scheduler internals --
         self._prefill_done = 0  # prompt tokens written into the slot
         self._cancel = False
+        # page-pool leases (target + optional draft), set at admission
+        self._lease = None
+        self._dlease = None
+        # prompt chain-hash keys, computed ONCE at the first admission
+        # attempt (a page-blocked head retries every engine step; the
+        # prompt never changes, so neither do the keys)
+        self._chain_keys = None
 
     @property
     def done(self) -> bool:
@@ -183,18 +190,61 @@ class Scheduler:
         return None
 
     # -- admission ---------------------------------------------------------
-    def admit(self, pool) -> List[RequestHandle]:
-        """Move queued requests into freed slots (FIFO); returns the
-        newly admitted handles, already marked PREFILLING."""
+    def admit(
+        self, pool, draft_pool=None, *, tail: int = 0
+    ) -> List[RequestHandle]:
+        """Move queued requests into freed slots + pages (strict FIFO —
+        a head-of-line request that doesn't fit blocks the queue rather
+        than being overtaken, so seeded workloads replay exactly and no
+        request starves). Returns the newly admitted handles, already
+        marked PREFILLING with their prefill cursor at the shared-prefix
+        skip. ``draft_pool`` (speculative engines) is allocated in
+        lockstep: one chunk stream drives both caches, so prefill can
+        only skip the prefix BOTH pools can serve from shares."""
         admitted = []
-        while self.queue and pool.num_free:
-            h = self.queue.popleft()
-            slot = pool.allocate()
-            assert slot is not None
-            h.slot = slot
+        while self.queue:
+            h = self.queue[0]
+            req = h.request
+            if h._chain_keys is None:
+                # hash once per request (keys are shared by both pools
+                # — same page geometry — and across blocked retries)
+                h._chain_keys = pool.chain_keys(req.prompt_ids)
+            kw = dict(
+                max_new=req.max_new_tokens, chunk=self.prefill_chunk,
+                tail=tail, keys=h._chain_keys,
+            )
+            if draft_pool is None:
+                lease = pool.allocate(req.prompt_ids, **kw)
+                if lease is None:
+                    break
+                dlease = None
+            else:
+                joint = min(
+                    pool.shareable_skip(req.prompt_ids, **kw),
+                    draft_pool.shareable_skip(req.prompt_ids, **kw),
+                )
+                lease = pool.allocate(
+                    req.prompt_ids, max_skip=joint, **kw
+                )
+                if lease is None:
+                    break
+                dlease = draft_pool.allocate(
+                    req.prompt_ids, max_skip=joint, **kw
+                )
+                if dlease is None:
+                    pool.free(lease.slot)
+                    break
+                # both pools pop their lowest free slot and see the
+                # same admit/free sequence — the ids cannot drift
+                assert dlease.slot == lease.slot
+                assert dlease.skip == lease.skip
+            self.queue.popleft()
+            h.slot = lease.slot
+            h._lease = lease
+            h._dlease = dlease
             h.status = RequestStatus.PREFILLING
-            h._prefill_done = 0
-            self.by_slot[slot] = h
+            h._prefill_done = lease.skip
+            self.by_slot[lease.slot] = h
             self._prefilling.append(h)
             admitted.append(h)
         return admitted
@@ -235,13 +285,19 @@ class Scheduler:
         )
 
     # -- retirement --------------------------------------------------------
-    def release(self, handle: RequestHandle, pool) -> None:
+    def release(self, handle: RequestHandle, pool, draft_pool=None) -> None:
         """Detach a handle from its slot (terminal status already set by
-        the engine) and return the slot to the pool."""
+        the engine) and drop its page references in BOTH pools — shared
+        pages survive for their other holders; private ones return to
+        the free list."""
         if handle.slot is not None:
             self.by_slot.pop(handle.slot, None)
             pool.free(handle.slot)
+            if draft_pool is not None:
+                draft_pool.free(handle.slot)
             handle.slot = None
+            handle._lease = None
+            handle._dlease = None
         if handle in self._prefilling:
             self._prefilling.remove(handle)
         if handle in self.queue:

@@ -82,8 +82,15 @@ class ServeTelemetry:
     # -- periodic gauges ---------------------------------------------------
     def record_snapshot(
         self, *, queue_depth: int, slots_occupied: int, slots_total: int,
-        decode_ticks: int,
+        decode_ticks: int, **gauges,
     ) -> None:
+        """Periodic saturation picture. ``gauges`` carries the paged
+        pool's occupancy (``pages_in_use`` / ``pages_total`` /
+        ``page_occupancy`` / ``prefix_hit_rate``) and, for speculative
+        engines, the cumulative ``spec_verifies`` / ``spec_drafted`` /
+        ``spec_accepted`` counters — all flat keys in the same snapshot
+        record, so existing consumers (jq, obs_report) see them without
+        a schema change."""
         self._write({
             "event": "snapshot",
             "queue_depth": queue_depth,
@@ -93,6 +100,7 @@ class ServeTelemetry:
                 slots_occupied / slots_total if slots_total else 0.0
             ),
             "decode_ticks": decode_ticks,
+            **gauges,
         })
 
     def _write(self, metrics: Dict) -> None:

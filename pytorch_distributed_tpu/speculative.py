@@ -52,6 +52,15 @@ Works with any pair of models sharing the ``generate`` decode contract
 (``decode=True``, ``cache_len``, ``positions``, ``kv_mask`` — GPT2LMHead,
 LlamaForCausalLM) and one vocabulary.
 
+This module is the OFFLINE whole-batch loop. The serving engine folds
+the same draft-verify round into its continuous-batching tick
+(``serve/engine.py`` ``SpecConfig``), reusing ``speculative_accept``
+verbatim for its sampled rows — and pays NO cache bubbles there: the
+slot pool's position==buffer-slot layout lets the next round's chunk
+write overwrite rejected-draft KV before any causal mask can reach it
+(docs/DESIGN.md §16), where this append-only loop must keep them
+masked forever.
+
 The reference repo (a training-recipes collection, BASELINE.json:5) has
 no inference engine; this is a beyond-parity capability like
 generation.py itself.

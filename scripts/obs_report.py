@@ -21,7 +21,11 @@ investigation starts from —
 * plan: the auto-parallel planner's ranked candidate table when a
   ``plan.json`` (``--strategy auto`` / autoplan/planner.py) sits in
   the run dir — the audit trail for why this run's strategy was
-  chosen.
+  chosen,
+* serving: TTFT percentiles plus the paged-KV saturation picture from
+  ``split="serve"`` snapshots — peak pages in use, prefix-cache hit
+  rate, and speculative accepted-tokens-per-verify when the engine ran
+  with ``SpecConfig``.
 
 Usage::
 
@@ -397,19 +401,56 @@ def report(trace_path, metric_paths, top_n=10, out=None,
         print("  no goodput records in the metrics stream", file=out)
 
     # -- serve telemetry, if present --------------------------------------
-    ttfts = [
-        r["ttft_ms"] for r in records
-        if r.get("split") == "serve" and "ttft_ms" in r
-    ]
+    serve_recs = [r for r in records if r.get("split") == "serve"]
+    ttfts = [r["ttft_ms"] for r in serve_recs if "ttft_ms" in r]
+    snaps = [r for r in serve_recs if r.get("event") == "snapshot"]
+    serve = {}
+    if ttfts or snaps:
+        print("\n== Serving ==", file=out)
     if ttfts:
-        print("\n== Serve TTFT ==", file=out)
+        serve["ttft_n"] = len(ttfts)
         print(
-            f"  n={len(ttfts)} p50={percentile(ttfts, 50):.1f}ms "
+            f"  TTFT n={len(ttfts)} p50={percentile(ttfts, 50):.1f}ms "
             f"p95={percentile(ttfts, 95):.1f}ms "
             f"p99={percentile(ttfts, 99):.1f}ms", file=out,
         )
+    if snaps:
+        # the paged-pool / speculation gauges ride the same snapshot
+        # records (serve/telemetry.py): report the saturation picture —
+        # peak across snapshots for occupancy, latest for cumulative
+        # counters
+        last = snaps[-1]
+        peak_slots = max(s.get("slots_occupied", 0) for s in snaps)
+        serve["snapshots"] = len(snaps)
+        print(
+            f"  slots: peak {peak_slots}/{last.get('slots_total', '?')} "
+            f"occupied over {len(snaps)} snapshots, "
+            f"{last.get('decode_ticks', 0)} decode ticks", file=out,
+        )
+        if "pages_in_use" in last:
+            peak_pages = max(s.get("pages_in_use", 0) for s in snaps)
+            serve["peak_pages"] = peak_pages
+            print(
+                f"  kv pool: peak {peak_pages}/"
+                f"{last.get('pages_total', '?')} pages in use "
+                f"({100.0 * peak_pages / max(last.get('pages_total', 1), 1):.0f}"
+                f"% of pool), prefix hit rate "
+                f"{last.get('prefix_hit_rate', 0.0):.3f} "
+                f"(fraction of prompt tokens served from shared pages)",
+                file=out,
+            )
+        if last.get("spec_verifies"):
+            apv = last.get("spec_accepted", 0) / last["spec_verifies"]
+            serve["spec_accepted_per_verify"] = apv
+            print(
+                f"  speculation: {last['spec_verifies']} verifies, "
+                f"{last.get('spec_accepted', 0)}/"
+                f"{last.get('spec_drafted', 0)} drafts accepted "
+                f"({apv:.2f} accepted tokens/verify; each verify also "
+                f"emits its correction token)", file=out,
+            )
     return {"spans": rows, "recompiles": recompiles, "goodput": g,
-            "comms": comms or {}, "plan": plan_doc}
+            "comms": comms or {}, "plan": plan_doc, "serve": serve}
 
 
 def main(argv=None):

@@ -70,6 +70,23 @@ def main():
                     metavar="LO[,HI]")
     ap.add_argument("--new-tokens", type=parse_range, default=(8, 32),
                     metavar="LO[,HI]")
+    ap.add_argument("--prefix-share", type=float, default=0.0,
+                    help="fraction of requests opening with one common "
+                    "system prompt (exercises the paged pool's "
+                    "copy-free prefix sharing)")
+    ap.add_argument("--prefix-len", type=int, default=16,
+                    help="length of the shared system prompt")
+    ap.add_argument("--page-size", type=int, default=None,
+                    help="KV page size (default: auto divisor of max_len)")
+    ap.add_argument("--num-pages", type=int, default=None,
+                    help="KV pool pages (default: parity with the old "
+                    "fixed [slots, max_len] pool)")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="enable speculative decoding with k draft "
+                    "tokens per tick (draft = a randomly initialized "
+                    "1-layer sibling — measures ENGINE mechanics, the "
+                    "acceptance rate of a real trained draft will "
+                    "differ)")
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--top-k", type=int, default=None)
     ap.add_argument("--top-p", type=float, default=None)
@@ -83,27 +100,21 @@ def main():
     import numpy as np
 
     from pytorch_distributed_tpu.serve import (
-        EngineConfig, Request, ServeEngine, ServeTelemetry, drive,
-        uniform_arrivals, warm_up,
+        EngineConfig, ServeEngine, ServeTelemetry, SpecConfig, drive,
+        prefix_shared_requests, uniform_arrivals, warm_up,
     )
 
     model = build_model(args.model)
     vocab = model.config.vocab_size
     rng = np.random.default_rng(args.seed)
-    p_lo, p_hi = args.prompt_len
-    n_lo, n_hi = args.new_tokens
-    reqs = [
-        Request(
-            prompt_ids=rng.integers(
-                1, vocab, size=rng.integers(p_lo, p_hi + 1)
-            ).astype(np.int32),
-            max_new_tokens=int(rng.integers(n_lo, n_hi + 1)),
-            temperature=args.temperature, top_k=args.top_k,
-            top_p=args.top_p, deadline_s=args.deadline_s,
-            seed=int(rng.integers(0, 2**31)),
-        )
-        for _ in range(args.requests)
-    ]
+    reqs = prefix_shared_requests(
+        rng, args.requests, vocab,
+        prompt_len=args.prompt_len, new_tokens=args.new_tokens,
+        prefix_share=args.prefix_share,
+        shared_prefix_len=args.prefix_len if args.prefix_share else 0,
+        temperature=args.temperature, top_k=args.top_k,
+        top_p=args.top_p, deadline_s=args.deadline_s,
+    )
     if args.rate > 0 and args.poisson:
         gaps = rng.exponential(1.0 / args.rate, size=args.requests)
         arrivals = list(np.cumsum(gaps) - gaps[0])
@@ -117,10 +128,15 @@ def main():
     max_len = args.max_len or max(
         [
             -(-r.prompt_len // args.prefill_chunk) * args.prefill_chunk
-            + r.max_new_tokens
+            + r.max_new_tokens + args.spec_k
             for r in reqs
-        ] + [args.prefill_chunk + 2]
+        ] + [args.prefill_chunk + 2 + args.spec_k]
     )
+    if not args.max_len and args.page_size:
+        # only the AUTO-computed fit is rounded up to a page multiple;
+        # an explicit --max-len is never silently rewritten — if it
+        # doesn't divide by --page-size, EngineConfig refuses loudly
+        max_len = -(-max_len // args.page_size) * args.page_size
     writer = None
     if args.log:
         from pytorch_distributed_tpu.train.metrics import MetricsWriter
@@ -132,10 +148,28 @@ def main():
         jax.random.key(0),
         np.zeros((1, min(8, max_len - 1)), np.int32),
     )["params"]
+    spec = None
+    if args.spec_k:
+        import dataclasses as _dc
+
+        dcfg = _dc.replace(
+            model.config, num_layers=1,
+            hidden_size=max(model.config.hidden_size // 2, 16),
+        )
+        draft = type(model)(dcfg)
+        dparams = draft.init(
+            jax.random.key(1),
+            np.zeros((1, min(8, max_len - 1)), np.int32),
+        )["params"]
+        spec = SpecConfig(draft, dparams,
+                          num_draft_tokens=args.spec_k)
     engine = ServeEngine(
         model, params,
         EngineConfig(num_slots=args.slots, max_len=max_len,
-                     prefill_chunk=args.prefill_chunk),
+                     prefill_chunk=args.prefill_chunk,
+                     page_size=args.page_size,
+                     num_pages=args.num_pages),
+        spec=spec,
     )
     # serve.loadgen's shared warm-up/pacing: both programs compile
     # outside the measured window, the JSONL stream starts clean, and
@@ -154,8 +188,21 @@ def main():
         v = s[k]
         print(f"  {k:>18} = {v:.2f}" if isinstance(v, float)
               else f"  {k:>18} = {v}")
+    pool = engine.pool
     print(f"  decode compiles    = {engine.decode_compiles} "
           f"(static-shape invariant: must be 1)")
+    print(f"  kv pages           = {pool.peak_pages} peak / "
+          f"{pool.num_pages} total (page_size={pool.page_size})")
+    print(f"  prefix hit rate    = {pool.prefix_hit_rate:.3f} "
+          f"({pool.prefix_hits}/{pool.prefix_lookups} admissions, "
+          f"{pool.shared_tokens} prompt tokens served copy-free)")
+    if engine.spec is not None and engine.spec_verifies:
+        print(f"  spec accept/verify = "
+              f"{engine.spec_accepted / engine.spec_verifies:.2f} "
+              f"(k={engine.spec.num_draft_tokens}, "
+              f"{engine.spec_verifies} verifies, "
+              f"{engine.spec_accepted}/{engine.spec_drafted} drafts "
+              f"accepted)")
     if args.log:
         print(f"telemetry JSONL -> {args.log}")
 

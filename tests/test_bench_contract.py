@@ -107,6 +107,38 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
     assert "serving_ttft_ms_p50" in proc.stderr
     assert "serving_ttft_ms_p99" in proc.stderr
 
+    def one_metric(name):
+        recs = [
+            json.loads(l) for l in proc.stderr.splitlines()
+            if l.startswith("{") and json.loads(l)["metric"] == name
+        ]
+        assert len(recs) == 1, (name, proc.stderr[-2000:])
+        return recs[0]
+
+    # the paged KV pool must serve the mixed-length prefix-shared
+    # workload (all requests completing — the phase raises otherwise)
+    # at >= 2x concurrent slots per byte of resident KV vs the fixed
+    # [S, max_len] pool it replaced — ROADMAP item 3's memory target
+    kv = one_metric("serving_kv_bytes_ratio")
+    assert kv["value"] >= 2.0, kv
+    assert kv["prefix_hit_rate"] > 0, kv  # the sharing path actually ran
+    # admit cost must stay flat as the pool grows (the old allocate
+    # sorted its free list every call — O(S log S) scaled ~x40 over
+    # this size range; the heap free list measures ~x1 with generous
+    # headroom for a contended 1-core box)
+    flat = one_metric("serving_admit_flatness")
+    assert 0 < flat["value"] < 16, flat
+    # speculative decode must BEAT the plain paged engine on the same
+    # greedy workload — with output parity enforced inside the phase
+    # (it raises on divergence), so this ratio can never come from
+    # wrong tokens
+    spec = one_metric("serving_spec_tokens_per_sec")
+    assert spec["value"] > 0
+    assert spec["vs_baseline"] is not None and spec["vs_baseline"] >= 1.0, (
+        f"speculative decode lost to plain decode: {spec}"
+    )
+    assert spec["accepted_per_verify"] > 0, spec  # drafts actually land
+
     # the input_pipeline phases must stay inside their time budget (the
     # r3 starvation incident: the feed phase alone ran >25 min and ate
     # every later phase's budget). Phase durations are printed as
@@ -121,6 +153,8 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
     assert durations.get("input_pipeline_u8_e2e", 0) < 300, durations
     assert "serving" in durations, sorted(durations)
     assert durations["serving"] < 300, durations
+    assert durations.get("serving_paged", 999) < 300, durations
+    assert durations.get("serving_spec", 999) < 300, durations
 
     # ...and the same numbers must land as DATA: one phase_durations_s
     # record (the print-only stderr notes were unparseable by the
@@ -131,8 +165,8 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
         and json.loads(l)["metric"] == "phase_durations_s"
     ]
     assert len(pd) == 1, proc.stderr[-2000:]
-    for phase in ("input_pipeline_feed", "serving", "observability",
-                  "planning"):
+    for phase in ("input_pipeline_feed", "serving", "serving_paged",
+                  "serving_spec", "observability", "planning"):
         assert phase in pd[0]["value"], pd[0]
     assert pd[0]["value"] == pytest.approx(durations, abs=0.2)
 

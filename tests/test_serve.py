@@ -18,7 +18,7 @@ from pytorch_distributed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
 from pytorch_distributed_tpu.runtime import faults
 from pytorch_distributed_tpu.serve import (
     EngineConfig,
-    KVSlotPool,
+    PagedKVPool,
     Request,
     RequestStatus,
     ServeEngine,
@@ -383,19 +383,31 @@ def test_engine_with_tp_sharded_params():
 
 def test_kv_slot_pool_lifecycle(gpt2):
     model, params = gpt2
-    pool = KVSlotPool(model, params, num_slots=3, max_len=16)
-    a, b = pool.allocate(), pool.allocate()
-    assert (a, b) == (0, 1)  # deterministic lowest-first
-    pool.lengths[a] = 5
-    pool.free(a)
-    assert pool.num_free == 2 and pool.lengths[a] == 0
-    assert pool.allocate() == 0  # lowest free index, reused
+    pool = PagedKVPool(
+        model, params, num_slots=3, max_len=16, page_size=4,
+    )
+    a = pool.allocate(np.ones(5, np.int32), max_new=3, chunk=4)
+    b = pool.allocate(np.ones(3, np.int32), max_new=2, chunk=4)
+    assert (a.slot, b.slot) == (0, 1)  # deterministic lowest-first
+    # pages: a spans max(5+3, 8)=8 -> 2 pages; b spans max(3+2, 4) -> 2
+    # pages (chunk roundup); both from the shared free list, lowest first
+    assert a.n_pages == 2 and list(a.page_row[:2]) == [1, 2]
+    assert b.n_pages == 2 and list(b.page_row[:2]) == [3, 4]
+    assert pool.pages_in_use == 4
+    pool.lengths[a.slot] = 5
+    pool.free(a.slot)
+    assert pool.num_free == 2 and pool.lengths[a.slot] == 0
+    assert pool.pages_in_use == 2  # a's pages returned to the free list
+    c = pool.allocate(np.ones(4, np.int32), max_new=4, chunk=4)
+    assert c.slot == 0  # lowest free slot, reused
+    assert list(c.page_row[:c.n_pages]) == [1, 2]  # lowest pages, reused
     with pytest.raises(ValueError, match="already free"):
         pool.free(2)
     pool.lengths[0] = 3
     mask = pool.valid_mask()
     assert mask[0, :3].all() and not mask[0, 3:].any()
     assert not mask[2].any()  # free slot: nothing valid
+    pool.check_consistency()
 
 
 def test_sample_logits_rows_matches_static_sampler():

@@ -172,6 +172,67 @@ def test_speculative_accept_distribution():
     assert 0.5 * np.abs(emp_b - p_row).sum() < 0.06
 
 
+def test_speculative_accept_all_accepted_edge():
+    """p == q on every proposal position accepts surely (coins < 1
+    strictly), a == k, and the round's token comes from the BONUS
+    distribution p_k — pinned exactly with a one-hot bonus row."""
+    from pytorch_distributed_tpu.speculative import speculative_accept
+
+    B, k, V = 4, 3, 7
+    rng = np.random.default_rng(1)
+    q_rows = rng.dirichlet(np.ones(V), size=(B, k)).astype(np.float32)
+    q = jnp.asarray(q_rows)
+    bonus = np.zeros((B, 1, V), np.float32)
+    bonus[:, 0, 5] = 1.0  # deterministic bonus draw
+    p = jnp.concatenate([q, jnp.asarray(bonus)], axis=1)
+    proposals = jax.random.categorical(
+        jax.random.key(0), jnp.log(q), axis=-1
+    ).astype(jnp.int32)
+    a, corr = speculative_accept(p, q, proposals, jax.random.key(1))
+    assert (np.asarray(a) == k).all()
+    assert (np.asarray(corr) == 5).all()
+
+
+def test_speculative_accept_all_rejected_edge():
+    """p putting ZERO mass on every proposal rejects at position 0
+    (accept prob p(x)/q(x) = 0), and the correction samples the
+    residual norm(max(p - q, 0)) — which, with q one-hot on the
+    proposal, is exactly p_0; pinned with a one-hot p_0."""
+    from pytorch_distributed_tpu.speculative import speculative_accept
+
+    B, k, V = 4, 3, 7
+    proposals = jnp.zeros((B, k), jnp.int32)  # every proposal = token 0
+    q = jnp.zeros((B, k, V)).at[:, :, 0].set(1.0)  # q one-hot on it
+    p_np = np.zeros((B, k + 1, V), np.float32)
+    p_np[:, :, 3] = 1.0  # target mass entirely on token 3 != proposal
+    a, corr = speculative_accept(
+        jnp.asarray(p_np), q, proposals, jax.random.key(2)
+    )
+    assert (np.asarray(a) == 0).all()
+    assert (np.asarray(corr) == 3).all()
+
+
+def test_speculative_accept_partial_prefix_stops_at_first_reject():
+    """Acceptance is a PREFIX: a later agreeing position cannot resurrect
+    a row after its first rejection (the cumprod form)."""
+    from pytorch_distributed_tpu.speculative import speculative_accept
+
+    B, k, V = 1, 3, 5
+    proposals = jnp.asarray([[1, 2, 1]], jnp.int32)
+    q = jnp.zeros((B, k, V))
+    q = q.at[0, 0, 1].set(1.0).at[0, 1, 2].set(1.0).at[0, 2, 1].set(1.0)
+    p_np = np.zeros((B, k + 1, V), np.float32)
+    p_np[0, 0, 1] = 1.0   # position 0: agrees surely
+    p_np[0, 1, 4] = 1.0   # position 1: zero mass on proposal -> reject
+    p_np[0, 2, 1] = 1.0   # position 2 agrees — but must never be reached
+    p_np[0, 3, 0] = 1.0
+    a, corr = speculative_accept(
+        jnp.asarray(p_np), q, proposals, jax.random.key(3)
+    )
+    assert int(a[0]) == 1
+    assert int(corr[0]) == 4  # residual at the REJECTED position = p_1
+
+
 @pytest.mark.slow
 def test_sampled_speculative_marginals_match_generate():
     """End-to-end distribution pin: over many same-prompt rows, each
