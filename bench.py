@@ -987,6 +987,26 @@ def bench_serving_paged(on_tpu: bool) -> None:
     )
 
 
+def _check_bucketed_compiles(engine) -> None:
+    """The round-12 bounded-compile contract, enforced in-phase: one
+    program per length bucket, each compiled EXACTLY once (warm-up
+    precompiles the decode buckets; prefill buckets compile on first
+    occupancy), never more programs than buckets exist."""
+    dec, pre = (
+        engine._decode_bucket_compiles, engine._prefill_bucket_compiles
+    )
+    cap = len(engine._buckets)
+    if (
+        any(v != 1 for v in dec.values())
+        or any(v != 1 for v in pre.values())
+        or not 1 <= len(dec) <= cap or not 1 <= len(pre) <= cap
+    ):
+        raise RuntimeError(
+            f"compile-count invariant broke: decode buckets {dec} "
+            f"prefill buckets {pre} (cap {cap})"
+        )
+
+
 def bench_serving_spec(on_tpu: bool) -> None:
     """Speculative decode in the engine tick: tokens/sec, spec vs plain,
     SAME greedy workload, SAME target weights — output parity asserted
@@ -1090,12 +1110,7 @@ def bench_serving_spec(on_tpu: bool) -> None:
             raise RuntimeError(
                 f"spec serving workload incomplete: {s}"
             )
-        if engine.decode_compiles != 1 or engine.prefill_compiles != 1:
-            raise RuntimeError(
-                f"compile-count invariant broke: prefill="
-                f"{engine.prefill_compiles} decode="
-                f"{engine.decode_compiles}"
-            )
+        _check_bucketed_compiles(engine)
         return engine, n_req * NEW / dt, [h.tokens for h in handles]
 
     plain_engine, plain_tok_s, plain_toks = run(None)
@@ -1134,6 +1149,146 @@ def bench_serving_spec(on_tpu: bool) -> None:
         f"{plain_tok_s:.0f} tok/s ratio="
         f"{spec_tok_s / plain_tok_s:.2f} accept/verify={accept:.2f} "
         f"(k={k}, {spec_engine.spec_verifies} verifies)",
+        file=sys.stderr,
+    )
+
+
+def bench_serving_paged_attn(on_tpu: bool) -> None:
+    """Paged-attention decode vs the dense-gather tick: tokens/sec and
+    analytic HBM bytes/token, SAME greedy workload, parity asserted
+    in-phase — the round-12 claim at the regime it exists for.
+
+    The workload is the long-``max_len``/short-live-length mix: the
+    pool is sized for a 512-token worst case while live requests decode
+    at < 32 tokens, so the dense tick gathers a 16-page ``[S, max_len]``
+    view every token while the paged tick streams the 1-page live
+    bucket. Bytes/token comes off the ``serve.decode_hbm_bytes_per_
+    token`` armed-only tracing counter (the same number the snapshot
+    gauges and obs_report carry), under the impl's analytic model
+    (DESIGN.md §17): ANALYTIC, not a hardware counter — on this CPU the
+    default impl still materializes the bucket-wide slab, and the
+    counter says so honestly (gather term included). Output parity and
+    the per-bucket compile contract are enforced in-phase, so neither
+    ratio can come from wrong tokens or hidden recompiles.
+    """
+    from pytorch_distributed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from pytorch_distributed_tpu.runtime import tracing
+    from pytorch_distributed_tpu.serve import (
+        EngineConfig,
+        Request,
+        ServeEngine,
+        warm_up,
+    )
+
+    if on_tpu:
+        cfg = GPT2Config(
+            vocab_size=GPT2Config.small().vocab_size, n_positions=2048,
+            hidden_size=768, num_layers=12, num_heads=12,
+            dropout_rate=0.0,
+        )
+        slots, max_len, ps, chunk, n_req = 8, 2048, 32, 32, 24
+        p_rng, n_rng = (16, 48), (32, 64)
+    else:
+        cfg = GPT2Config(
+            vocab_size=128, n_positions=512, hidden_size=32,
+            num_layers=2, num_heads=2, dropout_rate=0.0,
+        )
+        slots, max_len, ps, chunk, n_req = 8, 512, 32, 8, 16
+        p_rng, n_rng = (4, 10), (8, 16)
+
+    model = GPT2LMHead(cfg)
+    rng = np.random.default_rng(0)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    protos = [
+        (
+            rng.integers(1, cfg.vocab_size, size=int(
+                rng.integers(p_rng[0], p_rng[1] + 1)
+            )).astype(np.int32),
+            int(rng.integers(n_rng[0], n_rng[1] + 1)),
+        )
+        for _ in range(n_req)
+    ]
+
+    def run(mode):
+        engine = ServeEngine(model, params, EngineConfig(
+            num_slots=slots, max_len=max_len, prefill_chunk=chunk,
+            page_size=ps, telemetry_every=8, decode_mode=mode,
+        ))
+        with tracing.enabled() as t:
+            warm_up(engine, protos[0][0][:2])
+            t0 = time.perf_counter()
+            handles = [
+                engine.submit(Request(p, max_new_tokens=new))
+                for p, new in protos
+            ]
+            engine.run_until_drained()
+            dt = time.perf_counter() - t0
+        s = engine.telemetry.summary()
+        if s.get("completed") != n_req:
+            raise RuntimeError(
+                f"paged-attn workload incomplete ({mode}): {s}"
+            )
+        _check_bucketed_compiles(engine)
+        bpt = [
+            e["args"]["value"] for e in t._events
+            if e.get("ph") == "C"
+            and e["name"] == "serve.decode_hbm_bytes_per_token"
+        ]
+        if not bpt or bpt[-1] <= 0:
+            raise RuntimeError(
+                f"no serve.decode_hbm_bytes_per_token counter recorded "
+                f"({mode}) — the armed-only accounting went dark"
+            )
+        toks = s["completed_tokens"]
+        return engine, toks / dt, bpt[-1], [h.tokens for h in handles]
+
+    dense_e, dense_tok_s, dense_bpt, dense_toks = run("dense")
+    paged_e, paged_tok_s, paged_bpt, paged_toks = run("paged")
+    if paged_toks != dense_toks:
+        bad = sum(a != b for a, b in zip(paged_toks, dense_toks))
+        raise RuntimeError(
+            f"paged-attention output diverged from the dense tick on "
+            f"{bad}/{n_req} requests"
+        )
+    ratio = dense_bpt / max(paged_bpt, 1e-9)
+    _emit(
+        {
+            "metric": "serving_paged_attn_tokens_per_sec",
+            "value": round(paged_tok_s, 1),
+            "unit": f"decode tokens/sec, paged-attention tick "
+            f"(impl={paged_e._attn_impl}, buckets="
+            f"{sorted(paged_e.decode_buckets)} of {max_len // ps} "
+            f"pages), slots={slots} max_len={max_len} page={ps} "
+            f"n={n_req}; dense-gather tick {dense_tok_s:.1f} tok/s on "
+            f"the same workload",
+            "vs_baseline": round(paged_tok_s / dense_tok_s, 3),
+        }
+    )
+    _emit(
+        {
+            "metric": "serving_paged_attn_bytes_per_token_ratio",
+            "value": round(ratio, 3),
+            "unit": f"analytic decode HBM bytes/token, dense-gather "
+            f"({dense_bpt:,.0f}) / paged ({paged_bpt:,.0f}) at the "
+            f"long-context mix (max_len={max_len}, live < "
+            f"{n_rng[1] + p_rng[1]}); recorded off the armed-only "
+            f"serve.decode_* counters under DESIGN.md §17's per-impl "
+            f"model — analytic, not a hardware counter",
+            "vs_baseline": None,
+            "paged_bytes_per_token": round(paged_bpt, 1),
+            "dense_bytes_per_token": round(dense_bpt, 1),
+            "paged_impl": paged_e._attn_impl,
+            "decode_buckets": sorted(paged_e.decode_buckets),
+        }
+    )
+    print(
+        f"# serving_paged_attn: paged={paged_tok_s:.0f} tok/s dense="
+        f"{dense_tok_s:.0f} tok/s speed x"
+        f"{paged_tok_s / dense_tok_s:.2f}, bytes/token "
+        f"{dense_bpt:,.0f} -> {paged_bpt:,.0f} (x{ratio:.1f} less, "
+        f"impl={paged_e._attn_impl})",
         file=sys.stderr,
     )
 
@@ -1725,6 +1880,11 @@ def main():
         # RELATIVE numbers on the same box too — the r11 serving claims
         run_if_budget("serving_paged", bench_serving_paged, False)
         run_if_budget("serving_spec", bench_serving_spec, False)
+        # paged-attention vs dense-gather is relative on the same box
+        # too, with parity enforced in-phase — the r12 serving claims
+        run_if_budget(
+            "serving_paged_attn", bench_serving_paged_attn, False
+        )
         # so is the tracing-overhead ratio: traced vs untraced on the
         # same loop, same box
         run_if_budget("observability", bench_observability)
@@ -1748,6 +1908,9 @@ def main():
         run_if_budget("serving", bench_serving, on_tpu)
         run_if_budget("serving_paged", bench_serving_paged, on_tpu)
         run_if_budget("serving_spec", bench_serving_spec, on_tpu)
+        run_if_budget(
+            "serving_paged_attn", bench_serving_paged_attn, on_tpu
+        )
         run_if_budget("observability", bench_observability)
         run_if_budget("planning", bench_planning)
     # the per-phase wall clocks as DATA (the stderr "# phase ... done"

@@ -522,7 +522,11 @@ class EagerScatterHotPath(Rule):
     rule_id = "PTD004"
     title = "eager-scatter-hot-path"
     source_hints = (".at[",)
-    path_filter = r"(^|/)(serve|train)/"
+    # serve/ + train/ are the hot paths; ops/paged_attention.py joined
+    # them in round 12 — its per-page write helper (paged_write) IS the
+    # serving decode tick's KV write, traced inside the engine's jitted
+    # programs, and an eager copy of it would be the same ~2.4 ms bug
+    path_filter = r"(^|/)(serve|train)/|(^|/)ops/paged_attention\.py$"
 
     _SCATTER_METHODS = frozenset({
         "set", "add", "multiply", "mul", "divide", "div", "power",

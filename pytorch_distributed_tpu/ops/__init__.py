@@ -16,6 +16,15 @@ from pytorch_distributed_tpu.ops.attention import (
     rope_frequencies,
 )
 from pytorch_distributed_tpu.ops.flash_attention import flash_attention
+from pytorch_distributed_tpu.ops.paged_attention import (
+    PagedKVQuant,
+    PagedView,
+    get_paged_attention_impl,
+    paged_attention,
+    paged_attention_reference,
+    paged_write,
+    set_paged_attention_impl,
+)
 from pytorch_distributed_tpu.ops.lm_loss import (
     causal_lm_chunked_loss,
     chunked_softmax_cross_entropy,
@@ -51,6 +60,13 @@ __all__ = [
     "scaled_dot_product_attention",
     "dot_product_attention",
     "flash_attention",
+    "PagedKVQuant",
+    "PagedView",
+    "get_paged_attention_impl",
+    "paged_attention",
+    "paged_attention_reference",
+    "paged_write",
+    "set_paged_attention_impl",
     "get_attention_impl",
     "set_attention_impl",
     "apply_rope",

@@ -281,12 +281,28 @@ def decode_cache(
     reconstruction is a streamed transient. Lossy (~1e-2 relative per
     entry): token agreement with the exact cache is high but not pinned
     bitwise — see tests/test_attention.py.
+
+    Under an active :class:`ops.paged_attention.PagedView` (the serving
+    engine's paged decode programs), the cache variables are the PAGE
+    POOL (``[num_pages + 1, page_size, H, D]`` frames, initialized by
+    ``serve.kv_slots.init_page_cache``): the write narrows to a
+    per-page scatter of only the W deliberately-written positions
+    (``paged_write`` — inactive rows drop theirs entirely, never a
+    dense intermediate), and the returned k/v ARE the pool buffers
+    (int8: a :class:`~.paged_attention.PagedKVQuant` payload+scale
+    pair), which :func:`attention` streams in place. ``write_pos`` is
+    mandatory there — paged decode has no lockstep cache_index form.
     """
     B, S, H, D = k.shape
     if quantize not in (None, "int8"):
         raise ValueError(
             f"quantize must be None or 'int8', got {quantize!r}"
         )
+    from pytorch_distributed_tpu.ops.paged_attention import active_view
+
+    pv = active_view()
+    if pv is not None:
+        return _decode_cache_paged(module, k, v, quantize, write_pos, pv)
     ci = module.variable(
         "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
     )
@@ -355,6 +371,71 @@ def decode_cache(
     return ck.value, cv.value, offset
 
 
+def _decode_cache_paged(module, k, v, quantize, write_pos, pv):
+    """The paged-pool form of ``decode_cache``: per-page writes into the
+    pool frames, pool buffers returned for in-place paged attention.
+
+    The cache variables must already exist with pool geometry (the
+    engine builds them via ``serve.kv_slots.init_page_cache``); a dense
+    ``[B, max_len, ...]`` buffer here means a caller installed a
+    ``PagedView`` around a cache it never paged — refused loudly, since
+    the write arithmetic below would silently corrupt it.
+    """
+    from pytorch_distributed_tpu.ops.paged_attention import (
+        PagedKVQuant,
+        paged_write,
+    )
+
+    if write_pos is None:
+        raise ValueError(
+            "paged decode (an active PagedView) requires write_pos — "
+            "the lockstep cache_index form has no page-table row"
+        )
+    B, S, H, D = k.shape
+    names = (
+        ("cached_key", "cached_value", "cached_key_scale",
+         "cached_value_scale")
+    )
+    if quantize == "int8":
+        ck = module.variable("cache", names[0], None)
+        cks = module.variable("cache", names[2], None)
+        cv = module.variable("cache", names[1], None)
+        cvs = module.variable("cache", names[3], None)
+    else:
+        ck = module.variable("cache", names[0], None)
+        cv = module.variable("cache", names[1], None)
+    if ck.value is None or ck.value.shape[1] != pv.page_size:
+        raise ValueError(
+            f"paged decode needs a page-pool cache "
+            f"([num_pages + 1, page_size={pv.page_size}, H, D], from "
+            f"serve.kv_slots.init_page_cache); found "
+            f"{None if ck.value is None else ck.value.shape}"
+        )
+    if quantize == "int8":
+        qk, sk = _q8_rows(k)
+        qv, sv = _q8_rows(v)
+        ck.value = paged_write(
+            ck.value, qk, pv.page_tables, write_pos, pv.keep
+        )
+        cks.value = paged_write(
+            cks.value, sk, pv.page_tables, write_pos, pv.keep
+        )
+        cv.value = paged_write(
+            cv.value, qv, pv.page_tables, write_pos, pv.keep
+        )
+        cvs.value = paged_write(
+            cvs.value, sv, pv.page_tables, write_pos, pv.keep
+        )
+        return (
+            PagedKVQuant(ck.value, cks.value, k.dtype),
+            PagedKVQuant(cv.value, cvs.value, v.dtype),
+            write_pos,
+        )
+    ck.value = paged_write(ck.value, k, pv.page_tables, write_pos, pv.keep)
+    cv.value = paged_write(cv.value, v, pv.page_tables, write_pos, pv.keep)
+    return ck.value, cv.value, write_pos
+
+
 # --------------------------------------------------------------------------
 # implementation dispatch: XLA einsum path vs Pallas flash kernel
 # --------------------------------------------------------------------------
@@ -419,6 +500,37 @@ def attention(
         sequence_parallel_attention,
         sequence_parallel_mode,
     )
+    from pytorch_distributed_tpu.ops.paged_attention import (
+        active_view as _paged_active_view,
+        paged_attention as _paged_attention,
+    )
+
+    pv = _paged_active_view()
+    if pv is not None:
+        # paged decode (serve engine): k/v are the PAGE POOL buffers
+        # decode_cache just wrote (int8: PagedKVQuant pairs) — stream
+        # them in place, per-row causal masking from write_pos. The
+        # models' call sites stay one implementation; everything the
+        # paged op does not express is refused, not silently dropped.
+        if (
+            mask is not None or segment_ids is not None
+            or bias is not None or bias_fn is not None
+            or dropout_rate > 0.0
+        ):
+            raise NotImplementedError(
+                "paged decode supports plain causal attention only "
+                "(no kv_mask/segment_ids/bias/dropout — the serving "
+                "engine's decode contract)"
+            )
+        if getattr(q_offset, "ndim", 0) != 1:
+            raise ValueError(
+                "paged decode requires the per-row q_offset form "
+                "(decode_cache's write_pos return)"
+            )
+        return _paged_attention(
+            q, k, v, page_tables=pv.page_tables, lengths=q_offset,
+            scale=scale, window=window,
+        )
 
     # q_offset may be a traced value (KV-cache decode); only a static
     # python 0 qualifies for the flash / sequence-parallel fast paths

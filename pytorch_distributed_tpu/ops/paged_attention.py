@@ -1,0 +1,531 @@
+"""Paged-attention decode: attention streams K/V straight from the page
+pool — the compute-side completion of the paged KV pool (serve/kv_slots).
+
+PR 11 made the PAGE the allocation unit but left the compute contract
+dense: every decode tick gathered the live slots' pages into a transient
+``[S, max_len]`` view, ran the unchanged dense decode, and scattered one
+token back — on a bandwidth-bound chip that roughly doubles HBM traffic
+per token (gather + attention read) and sizes the transient peak by
+``max_len``, not by what is live. This module is the PagedAttention
+design (vLLM, arXiv 2309.06180) expressed with the repo's own blocked
+online-softmax machinery (ops/flash_attention.py):
+
+* the decode-attention primitive takes the pooled KV frames
+  ``[num_pages + 1, page_size, Hkv, D]`` (frame 0 the reserved null
+  page), per-request page tables ``[B, n_pages]`` and per-row lengths,
+  and computes ``[B, W, Hq, D]`` attention for W queries per row
+  (W = 1 for the decode tick, W = k+1 for the fused speculative
+  verify) with ragged lengths masked INSIDE the op — no caller-side
+  dense view;
+* the engine installs a :class:`PagedView` (the adapter object) around
+  its jitted decode programs; ``ops.attention.decode_cache`` writes new
+  K/V through :func:`paged_write` (a per-page scatter of only the W
+  deliberately-written positions — dropped entirely for inactive rows)
+  and ``ops.attention.attention`` dispatches here — so ``models/``
+  attention code stays ONE implementation.
+
+Three interchangeable implementations, selected by
+:func:`set_paged_attention_impl` (default ``"auto"``):
+
+* ``"gather"`` — materialize the (bucket-sliced, NOT max_len-wide)
+  pages into a per-row dense slab inside the op and run the UNCHANGED
+  ``dot_product_attention`` math. BIT-IDENTICAL to the pre-paged dense
+  path by the zero-tail argument (masked tail keys contribute exact
+  0.0 to every reduction; live keys occupy the same leading positions
+  — verified empirically per dtype in tests/test_paged_attention.py),
+  so the engine's pinned solo-``generate`` parity survives to the bit.
+* ``"stream"`` — the pure-jnp ``lax.scan``-over-pages reference: one
+  page of K/V gathered per step, an online-softmax carry (m, l, acc)
+  exactly like the flash kernel's VMEM scratch. The documented
+  semantics of the kernel, and the analytic model for the
+  bytes-per-token accounting (each page read ONCE, no dense
+  transient). Online softmax REORDERS the reductions, so parity with
+  the dense path is last-ulp-class, not bitwise — pinned per dtype
+  with explicit tolerances.
+* ``"kernel"`` — the Pallas TPU kernel: grid ``(B * Hq, n_pages)``,
+  page frames resolved through the scalar-prefetched page table
+  (``pltpu.PrefetchScalarGridSpec`` — the index map reads the table,
+  so the DMA streams exactly the pages the row owns), flash-style
+  GQA head mapping (``kv_head = q_head * Hkv // Hq``) and VMEM
+  scratch carry. ``interpret=True`` off-TPU, like every Pallas kernel
+  in this repo.
+
+``"auto"`` resolves to ``"kernel"`` on TPU and ``"gather"`` elsewhere:
+the gather impl is the provably-exact CPU/CI path, and on the chip the
+kernel is the point of this module. The same caveat as
+``ops.attention.set_attention_impl`` applies to the axon remote-compile
+toolchain (unbounded Mosaic compile times have wedged the relay
+before) — ``set_paged_attention_impl("gather")`` is the escape hatch,
+costing the transient slab but never correctness.
+
+int8 KV caches (``kv_cache_quantize="int8"``): payload + per-token
+scale pools ride together (:class:`PagedKVQuant`); gather/stream
+dequantize per page with decode_cache's exact formula. The kernel does
+not take quantized pools — the dispatcher falls back to ``"gather"``
+and says so in its docstring rather than silently dequantizing a whole
+pool.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import functools
+import math
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30  # finite, like flash_attention: no (-inf) - (-inf) NaN
+
+
+# --------------------------------------------------------------------------
+# the engine-facing adapter: a trace-scoped view of the page pool
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedView:
+    """What the attention layers need to decode in place over the pool.
+
+    Installed by the serving engine around the traced body of its
+    decode/verify programs (:func:`paged_view`); consumed by
+    ``ops.attention.decode_cache`` (per-page writes) and
+    ``ops.attention.attention`` (dispatch to :func:`paged_attention`) —
+    the models themselves never see it, which is how ``models/``
+    attention code stays one implementation.
+
+    ``page_tables`` is bucket-sliced to a STATIC width by the caller
+    (serve/engine.py's length buckets); ``keep`` gates writes per row —
+    False rows (free / mid-prefill slots) drop their writes entirely,
+    the same strictly-stronger-than-masking invariant scatter_kv
+    established.
+    """
+
+    page_tables: jnp.ndarray  # [B, n_pages] int32, bucket-sliced
+    keep: jnp.ndarray         # [B] bool — write gate per row
+    page_size: int
+
+
+_VIEW: Optional[PagedView] = None
+
+
+@contextlib.contextmanager
+def paged_view(view: PagedView):
+    """Install ``view`` for the duration of a traced model apply.
+
+    Trace-scoped, not run-scoped: the engine's jitted program bodies
+    wrap exactly the ``model.apply`` that should decode over the pool
+    (the speculative program's draft scan stays dense and runs OUTSIDE
+    the with-block of its verify)."""
+    global _VIEW
+    prev = _VIEW
+    _VIEW = view
+    try:
+        yield view
+    finally:
+        _VIEW = prev
+
+
+def active_view() -> Optional[PagedView]:
+    return _VIEW
+
+
+class PagedKVQuant(NamedTuple):
+    """An int8 page pool + its per-token scale pool, moving as one.
+
+    ``decode_cache`` returns this pair (instead of a dequantized dense
+    buffer) in paged mode; models pass it through to ``attention``
+    untouched, and the dispatcher dequantizes per page with the same
+    ``int8 -> f32 * scale -> dtype`` formula the dense path used.
+    """
+
+    pages: jnp.ndarray   # [P1, ps, H, D] int8
+    scale: jnp.ndarray   # [P1, ps, H, 1] f32
+    dtype: jnp.dtype     # the compute dtype attention should see
+
+
+# --------------------------------------------------------------------------
+# per-page writes
+# --------------------------------------------------------------------------
+
+
+def paged_write(pool, new, page_tables, write_pos, keep):
+    """Scatter ``new`` rows into the page pool through the page table.
+
+    ``pool`` is ``[num_pages + 1, page_size, ...]``; ``new`` is
+    ``[B, W, ...]``: row ``b``'s W entries land at buffer positions
+    ``write_pos[b] .. write_pos[b] + W - 1``, each mapped to
+    ``page_tables[b, pos // page_size] * page_size + pos % page_size``.
+    ``keep[b]`` False redirects the row's destinations out of bounds so
+    ``mode="drop"`` discards them — free and mid-prefill rows never
+    touch the pool, the invariant ``serve.kv_slots.scatter_kv``
+    established (a kept row's positions sit inside its privately-owned
+    span by the pool's CoW admission discipline, so a refcount>1 page
+    can never be written).
+
+    Only ever traced inside the engine's jitted programs (it is called
+    from ``decode_cache`` under the model apply those programs trace) —
+    the eager form would be the exact dispatch-cost bug PTD004 exists
+    for, which is why the lint fixture corpus carries a twin of this
+    helper.
+    """
+    P1, ps = pool.shape[0], pool.shape[1]
+    B, W = new.shape[0], new.shape[1]
+    pos = write_pos[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+    # positions beyond the (bucket-sliced) table clamp; such rows are
+    # always keep=False, so the clamped index is dropped below anyway
+    page = jnp.take_along_axis(page_tables, pos // ps, axis=1)
+    dst = page * ps + pos % ps                         # [B, W]
+    dst = jnp.where(keep[:, None], dst, P1 * ps)       # OOB -> drop
+    flat = pool.reshape((P1 * ps,) + pool.shape[2:])
+    upd = new.astype(pool.dtype).reshape((B * W,) + new.shape[2:])
+    flat = flat.at[dst.reshape(-1)].set(  # ptdlint: disable=PTD004
+        upd, mode="drop",
+    )  # fused scatter: traced only inside the engine's jitted programs
+    # (cross-module, so the per-module lint closure cannot see the jit)
+    return flat.reshape(pool.shape)
+
+
+# --------------------------------------------------------------------------
+# implementation dispatch
+# --------------------------------------------------------------------------
+
+_IMPL = "auto"  # auto | gather | stream | kernel
+
+
+def set_paged_attention_impl(impl: str) -> None:
+    """Select the paged-attention backend (see module docstring).
+
+    Mirrors ``ops.attention.set_attention_impl``: jit caches do not key
+    on this flag, so switching drops them and already-compiled decode
+    programs retrace with the new backend.
+    """
+    if impl not in ("auto", "gather", "stream", "kernel"):
+        raise ValueError(f"unknown paged-attention impl {impl!r}")
+    global _IMPL
+    if impl == _IMPL:
+        return
+    # drop jit caches only when the RESOLVED backend actually changes —
+    # pinning "auto" to the backend it already resolves to must not
+    # force every compiled program (and the serve engine's
+    # compiled-once-per-bucket ledger) through a spurious retrace
+    changed = (
+        resolve_paged_attention_impl(impl)
+        != resolve_paged_attention_impl(_IMPL)
+    )
+    _IMPL = impl
+    if changed:
+        jax.clear_caches()
+
+
+def get_paged_attention_impl() -> str:
+    return _IMPL
+
+
+def resolve_paged_attention_impl(impl: Optional[str] = None) -> str:
+    """The concrete backend an ``impl`` (default: the global flag)
+    resolves to on this backend — the engine consults it once at
+    construction to pick the matching analytic bytes model."""
+    impl = impl or _IMPL
+    if impl != "auto":
+        return impl
+    return "kernel" if jax.default_backend() == "tpu" else "gather"
+
+
+def _unpack(kv):
+    if isinstance(kv, PagedKVQuant):
+        return kv.pages, kv.scale, kv.dtype
+    return kv, None, None
+
+
+def paged_attention(
+    q: jnp.ndarray,   # [B, W, Hq, D]
+    k_pages,          # [P1, ps, Hkv, D] or PagedKVQuant
+    v_pages,          # [P1, ps, Hkv, D] or PagedKVQuant
+    *,
+    page_tables: jnp.ndarray,  # [B, n_pages] int32 (bucket-sliced)
+    lengths: jnp.ndarray,      # [B] int32 — tokens cached BEFORE this call
+    scale: Optional[float] = None,
+    window: Optional[int] = None,
+    impl: Optional[str] = None,
+) -> jnp.ndarray:
+    """Decode attention over the page pool; returns [B, W, Hq, D].
+
+    Query ``j`` of row ``b`` sits at absolute position
+    ``lengths[b] + j`` and attends buffer positions ``<= lengths[b] + j``
+    (``window`` further restricts to the sliding band, HF convention:
+    a key exactly ``window`` back is masked) — the same per-row causal
+    contract ``dot_product_attention``'s ``[B]`` ``q_offset`` form
+    implements, with the new tokens' own K/V expected ALREADY WRITTEN
+    into the pool (``decode_cache`` writes before it attends, as the
+    dense path always did). Unused table entries hold null page 0;
+    they back positions ``>= lengths[b] + W`` and are causally masked,
+    so the null page's contents are unobservable (pinned by test).
+    """
+    k_pages, k_scale, kdt = _unpack(k_pages)
+    v_pages, v_scale, _ = _unpack(v_pages)
+    B, W, Hq, D = q.shape
+    P1, ps, Hkv, Dk = k_pages.shape
+    if D != Dk:
+        raise ValueError(f"head_dim mismatch: q {D} vs pool {Dk}")
+    if Hq % Hkv:
+        raise ValueError(
+            f"query heads {Hq} not a multiple of kv heads {Hkv}"
+        )
+    if page_tables.ndim != 2 or page_tables.shape[0] != B:
+        raise ValueError(
+            f"page_tables must be [batch, n_pages] = [{B}, *], got "
+            f"{page_tables.shape}"
+        )
+    if lengths.shape != (B,):
+        raise ValueError(f"lengths must be [{B}], got {lengths.shape}")
+    if window is not None and window <= 0:
+        raise ValueError(f"window must be positive, got {window}")
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    impl = resolve_paged_attention_impl(impl)
+    if impl == "kernel" and k_scale is not None:
+        impl = "gather"  # the kernel takes fp pools only (see module doc)
+    if impl == "gather":
+        return _paged_gather(
+            q, k_pages, v_pages, page_tables, lengths, scale, window,
+            k_scale, v_scale, kdt,
+        )
+    if impl == "stream":
+        return paged_attention_reference(
+            q, k_pages, v_pages, page_tables=page_tables, lengths=lengths,
+            scale=scale, window=window, k_scale=k_scale, v_scale=v_scale,
+            out_dtype=kdt,
+        )
+    return _paged_kernel_call(
+        q, k_pages, v_pages, page_tables, lengths, scale, window
+    )
+
+
+# --------------------------------------------------------------------------
+# "gather": bucket-wide dense slab + the unchanged dense attention math
+# --------------------------------------------------------------------------
+
+
+def _gather_dense(pages, tables, scale_pages, dtype):
+    """[P1, ps, H, D] + [B, n] tables -> [B, n*ps, H, D] dense slab
+    (dequantized with decode_cache's exact formula when scales ride)."""
+    B, n = tables.shape
+    ps = pages.shape[1]
+    flat = tables.reshape(-1)
+    out = jnp.take(pages, flat, axis=0)
+    if scale_pages is not None:
+        sc = jnp.take(scale_pages, flat, axis=0)
+        out = (out.astype(jnp.float32) * sc).astype(dtype)
+    return out.reshape((B, n * ps) + pages.shape[2:])
+
+
+def _paged_gather(q, k_pages, v_pages, tables, lengths, scale, window,
+                  k_scale, v_scale, kdt):
+    """The exact impl: materialize the bucket slab, run the SAME
+    ``dot_product_attention`` the dense engine path ran. Masked tail
+    keys contribute exact zeros to every reduction (the zero-tail
+    argument), so the output is bitwise the pre-paged path's."""
+    from pytorch_distributed_tpu.ops.attention import dot_product_attention
+
+    kd = _gather_dense(k_pages, tables, k_scale, kdt or q.dtype)
+    vd = _gather_dense(v_pages, tables, v_scale, kdt or q.dtype)
+    return dot_product_attention(
+        q, kd, vd, causal=True, q_offset=lengths, scale=scale,
+        window=window,
+    )
+
+
+# --------------------------------------------------------------------------
+# "stream": the pure-jnp scan-over-pages online-softmax reference
+# --------------------------------------------------------------------------
+
+
+def paged_attention_reference(
+    q, k_pages, v_pages, *, page_tables, lengths,
+    scale: Optional[float] = None, window: Optional[int] = None,
+    k_scale=None, v_scale=None, out_dtype=None,
+):
+    """One page of K/V per ``lax.scan`` step, online-softmax carry.
+
+    The documented semantics of the Pallas kernel and the analytic
+    model behind the bytes-per-token counters: per step it touches ONE
+    page frame per row (a ``[B, ps, Hkv, D]`` transient), never a
+    ``[B, n*ps]`` dense slab. Reductions are reassociated page-by-page
+    (rescale by ``exp(m_prev - m_new)``), so outputs match the dense
+    path to last-ulp tolerance per dtype, not bitwise — the gather impl
+    is the bit-exact one.
+    """
+    B, W, Hq, D = q.shape
+    P1, ps, Hkv, _ = k_pages.shape
+    G = Hq // Hkv
+    n = page_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    dtype = out_dtype or q.dtype
+    qg = q.reshape(B, W, Hkv, G, D)
+    qpos = lengths[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+
+    def page(pages, scales, i):
+        frames = page_tables[:, i]                # [B]
+        out = jnp.take(pages, frames, axis=0)     # [B, ps, Hkv, D]
+        if scales is not None:
+            sc = jnp.take(scales, frames, axis=0)
+            out = (out.astype(jnp.float32) * sc).astype(dtype)
+        return out
+
+    def body(carry, i):
+        m, l, acc = carry
+        k = page(k_pages, k_scale, i)
+        v = page(v_pages, v_scale, i)
+        s = jnp.einsum(
+            "bwkgd,bpkd->bwkgp", qg, k,
+            preferred_element_type=jnp.float32,
+        ) * scale                                  # [B, W, Hkv, G, ps]
+        kpos = i * ps + jnp.arange(ps, dtype=jnp.int32)
+        keep = qpos[:, :, None] >= kpos[None, None, :]   # [B, W, ps]
+        if window is not None:
+            keep = keep & (qpos[:, :, None] - kpos[None, None, :] < window)
+        s = jnp.where(keep[:, :, None, None, :], s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_cur)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bwkgp,bpkd->bwkgd", p.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
+        return (m_new, l_new, acc_new), None
+
+    # page 0 always holds a live key per row (kpos 0 <= qpos), so the
+    # carry's m leaves _NEG_INF on the first step and the masked
+    # exp(_NEG_INF - m) terms underflow to exact 0.0 ever after
+    m0 = jnp.full((B, W, Hkv, G), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, W, Hkv, G), jnp.float32)
+    acc0 = jnp.zeros((B, W, Hkv, G, D), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(
+        body, (m0, l0, acc0), jnp.arange(n), length=n
+    )
+    safe = jnp.where(l > 0, l, 1.0)
+    out = (acc / safe[..., None]).astype(q.dtype)
+    return out.reshape(B, W, Hq, D)
+
+
+# --------------------------------------------------------------------------
+# "kernel": Pallas, pages streamed through the scalar-prefetched table
+# --------------------------------------------------------------------------
+
+
+def _kernel_body(lengths_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
+                 acc_ref, m_ref, l_ref, *, sm_scale, page_size, hq, w,
+                 window):
+    bh = pl.program_id(0)
+    i = pl.program_id(1)
+    n = pl.num_programs(1)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    length = lengths_ref[bh // hq]
+    q = q_ref[0]              # [W, D]
+    k = k_ref[0, :, 0, :]     # [ps, D] — this row's page, this kv head
+    v = v_ref[0, :, 0, :]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * sm_scale              # [W, ps]
+    kpos = i * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, (w, page_size), 1
+    )
+    qpos = length + jax.lax.broadcasted_iota(
+        jnp.int32, (w, page_size), 0
+    )
+    keep = qpos >= kpos
+    if window is not None:
+        keep = jnp.logical_and(keep, qpos - kpos < window)
+    s = jnp.where(keep, s, _NEG_INF)
+    m_prev = m_ref[:, :1]     # [W, 1] (lanes replicated)
+    l_prev = l_ref[:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(i == n - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc_ref[:] / safe).astype(o_ref.dtype)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _paged_kernel_call(q, k_pages, v_pages, tables, lengths, scale,
+                       window):
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, W, Hq, D = q.shape
+    P1, ps, Hkv, _ = k_pages.shape
+    n = tables.shape[1]
+    qf = q.transpose(0, 2, 1, 3).reshape(B * Hq, W, D)
+
+    def kv_map(bh, i, lens, tabs):
+        # the page frame comes from the scalar-prefetched table — the
+        # DMA streams exactly the pages this row owns; the kv head is
+        # the flash-style group map (no KV replication to q heads)
+        return (tabs[bh // Hq, i], 0, (bh % Hq) * Hkv // Hq, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B * Hq, n),
+        in_specs=[
+            pl.BlockSpec((1, W, D), lambda bh, i, lens, tabs: (bh, 0, 0)),
+            pl.BlockSpec((1, ps, 1, D), kv_map),
+            pl.BlockSpec((1, ps, 1, D), kv_map),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, W, D), lambda bh, i, lens, tabs: (bh, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((W, D), jnp.float32),       # acc
+            pltpu.VMEM((W, 128), jnp.float32),     # running max
+            pltpu.VMEM((W, 128), jnp.float32),     # running sum
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel_body, sm_scale=scale, page_size=ps, hq=Hq, w=W,
+            window=window,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * Hq, W, D), q.dtype),
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(lengths.astype(jnp.int32), tables.astype(jnp.int32), qf,
+      k_pages, v_pages)
+    return out.reshape(B, Hq, W, D).transpose(0, 2, 1, 3)
+
+
+def _compiler_params():
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    # the page dimension is sequential ("arbitrary"): the online-softmax
+    # scratch must persist across page steps, like flash's k dimension
+    return cls(dimension_semantics=("parallel", "arbitrary"))

@@ -12,10 +12,24 @@ by tests:
   first token on the final chunk. With speculation enabled the SAME
   program also prefills the draft model's pages — still one program.
 * ``decode``: one ``[S, 1]`` tick over ALL slots through the same
-  ``generation.decode_step_body`` the offline ``generate`` scan uses,
-  on a dense view gathered from each slot's pages; only the decoding
-  rows' single written token is scattered back (free / mid-prefill rows
-  no longer write even garbage — their scatter is dropped).
+  ``generation.decode_step_body`` the offline ``generate`` scan uses —
+  attending IN PLACE over the page pool (``ops/paged_attention``: the
+  engine installs a ``PagedView`` around the traced model apply, new
+  K/V lands via per-page scatters of only the deliberately-written
+  positions, and attention streams the pages — no transient
+  ``[S, max_len]`` dense view, the round-11 gather tax this round
+  removed). ``decode_mode="dense"`` keeps the round-11 dense-gather
+  program as the A/B baseline the bench's ``serving_paged_attn`` phase
+  measures against. Free / mid-prefill rows still never write — the
+  per-page write drops their rows exactly as the dense scatter did.
+* **length buckets** bound what the remaining dense spans (chunked
+  prefill's per-slot row, the speculative draft's short context) and
+  the paged streams actually touch: widths round up to the live
+  maximum's power-of-two page bucket instead of always ``max_len``,
+  with the bucket width a STATIC jit argument — at most one program
+  per occupied bucket (<= log2(max_pages) + 1 decode programs, each
+  compiled exactly once, tracked per bucket in
+  ``decode_buckets``/``prefill_buckets``).
 * with ``SpecConfig``: the decode tick is replaced by ONE fused
   speculative program — k sequential draft proposals (a ``lax.scan`` of
   single-token draft steps) + one ``[S, k+1]`` target verify pass +
@@ -57,8 +71,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from pytorch_distributed_tpu.generation import (
+    cache_batch_axis,
     decode_step_body,
     model_max_len,
+)
+from pytorch_distributed_tpu.ops.paged_attention import (
+    PagedView,
+    paged_view,
+    resolve_paged_attention_impl,
 )
 from pytorch_distributed_tpu.runtime import faults
 from pytorch_distributed_tpu.runtime import tracing
@@ -124,8 +144,18 @@ class EngineConfig:
     page_size: Optional[int] = None
     num_pages: Optional[int] = None
     prefix_cache: bool = True
+    # "paged" (default): the decode tick attends in place over the page
+    # pool (ops/paged_attention) with length-bucketed widths; "dense"
+    # keeps the round-11 full-width gather programs — the A/B baseline
+    # bench.py's serving_paged_attn phase measures the paged path against
+    decode_mode: str = "paged"
 
     def __post_init__(self):
+        if self.decode_mode not in ("paged", "dense"):
+            raise ValueError(
+                f"decode_mode must be 'paged' or 'dense', got "
+                f"{self.decode_mode!r}"
+            )
         if self.num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if self.prefill_chunk < 1:
@@ -253,6 +283,45 @@ class ServeEngine:
         self._decode_ticks = 0
         self.prefill_compiles = 0
         self.decode_compiles = 0
+        # length buckets: the static widths the prefill/decode programs
+        # compile at — powers of two in pages, capped at max_pages
+        # (dense mode has exactly one width, the full table)
+        if config.decode_mode == "paged":
+            self._buckets = self._bucket_list(mp)
+        else:
+            self._buckets = [mp]
+        # per-bucket compile counts (the traced program bodies bump
+        # them): the bounded-compile invariant is now "each occupied
+        # bucket compiled EXACTLY once" — decode_compiles stays the
+        # cumulative total across buckets
+        self._decode_bucket_compiles: dict = {}
+        self._prefill_bucket_compiles: dict = {}
+        # analytic HBM accounting for the decode hot path: bytes one
+        # tick moves under this mode/impl's traffic model (DESIGN.md
+        # §17), accumulated host-side as plain ints so the disarmed
+        # tracing cost stays one is-None test. _attn_impl resolves
+        # ONCE — the accounting follows the backend the programs trace
+        self._resolved_impl = (
+            resolve_paged_attention_impl()
+            if config.decode_mode == "paged" else "dense"
+        )
+        self._attn_impl = self._resolved_impl
+        if self._attn_impl == "kernel" and getattr(
+            getattr(model, "config", None), "kv_cache_quantize", None
+        ) is not None:
+            # the kernel takes fp pools only — paged_attention falls
+            # back to the gather impl for quantized caches, and the
+            # byte accounting must price what actually runs
+            self._attn_impl = "gather"
+        self._frame_bytes_target = self._frame_bytes(self.pool.cache)
+        self._frame_bytes_draft = (
+            self._frame_bytes(self.draft_pool.cache)
+            if self.draft_pool is not None else 0
+        )
+        self._tick_cost_cache: dict = {}
+        self.decode_gather_bytes = 0   # dense-intermediate traffic only
+        self.decode_hbm_bytes = 0      # gather + attention-stream reads
+        self._decode_tokens = 0        # tokens emitted by decode ticks
         # speculative bookkeeping (raw per-verify acceptance; host ints)
         self.spec_verifies = 0
         self.spec_drafted = 0
@@ -264,24 +333,30 @@ class ServeEngine:
         # signature) so donation bookkeeping is auditable per call site
         self._prefill = self._decode = None
         self._prefill_spec = self._spec_tick = None
+        # the bucket width rides as a STATIC argument: one compiled
+        # program per occupied width, each counted by the traced body
         if spec is None:
             self._prefill = jax.jit(
-                self._prefill_fn, donate_argnums=(1,) if donate else ()
+                self._prefill_fn, donate_argnums=(1,) if donate else (),
+                static_argnums=(14,),
             )
             # pool + the in-program-advanced rows (toks/lengths/keys)
             # are donated: each is replaced by its returned successor
             self._decode = jax.jit(
                 self._decode_fn,
                 donate_argnums=(1, 3, 4, 5) if donate else (),
+                static_argnums=(10,),
             )
         else:
             self._prefill_spec = jax.jit(
                 self._prefill_spec_fn,
                 donate_argnums=(2, 3) if donate else (),
+                static_argnums=(17,),
             )
             self._spec_tick = jax.jit(
                 self._spec_fn,
                 donate_argnums=(2, 3, 6, 7, 8) if donate else (),
+                static_argnums=(13,),
             )
         # admission-time row setup as ONE jitted program: eager
         # .at[].set dispatches cost ~2.4ms EACH on this backend
@@ -290,23 +365,58 @@ class ServeEngine:
         self._admit_rows = jax.jit(self._admit_rows_fn)
 
     # -- jitted programs ---------------------------------------------------
+    @staticmethod
+    def _bucket_list(max_pages: int):
+        """Power-of-two page widths up to (and always including) the
+        full table — the static shapes the bucketed programs compile
+        at. <= log2(max_pages) + 1 entries."""
+        out, b = [], 1
+        while b < max_pages:
+            out.append(b)
+            b *= 2
+        out.append(max_pages)
+        return out
+
+    def _bucket_for(self, pages: int) -> int:
+        for b in self._buckets:
+            if b >= pages:
+                return b
+        return self._buckets[-1]
+
+    @staticmethod
+    def _frame_bytes(cache) -> int:
+        """Bytes of ONE page frame across every KV-payload leaf (layer
+        stacking included) — the unit of the analytic HBM accounting."""
+        total = 0
+        for path, leaf in jax.tree_util.tree_leaves_with_path(cache):
+            ax = cache_batch_axis(path, leaf)
+            if ax is not None:
+                total += (
+                    int(leaf.size) // int(leaf.shape[ax])
+                    * leaf.dtype.itemsize
+                )
+        return total
+
     def _prefill_chunk_body(self, model, params, cache, pt, ids, slot,
-                            start):
+                            start, n_pages):
         """One model's chunk prefill over its page pool: gather the
-        slot's pages to a dense row, run the ``[1, C]`` chunk write, and
-        scatter exactly the chunk's positions back (padded final-chunk
-        positions included — they stay inside the slot's reserved
-        private span and are overwritten or masked, as before).
-        Returns (chunk logits, updated pool)."""
+        slot's pages — only the leading ``n_pages`` bucket the chunk
+        can reach, not the full ``max_len`` span — to a dense row, run
+        the ``[1, C]`` chunk write, and scatter exactly the chunk's
+        positions back (padded final-chunk positions included — they
+        stay inside the slot's reserved private span and are
+        overwritten or masked, as before). Returns
+        (chunk logits, updated pool)."""
         C = self.config.prefill_chunk
         row_pt = jax.lax.dynamic_slice_in_dim(pt, slot, 1, axis=0)
+        row_pt = jax.lax.slice_in_dim(row_pt, 0, n_pages, axis=1)
         row = gather_pages(cache, row_pt)
         positions = (start + jnp.arange(C))[None, :]
         logits, state = model.apply(
             {"params": params, "cache": row},
             ids,
             decode=True,
-            cache_len=self.config.max_len,
+            cache_len=n_pages * self.pool.page_size,
             mutable=["cache"],
             positions=positions,
             write_pos=jnp.asarray(start, jnp.int32)[None],
@@ -341,12 +451,17 @@ class ServeEngine:
         return tok, toks, lengths, keys
 
     def _prefill_fn(self, params, cache, pt, ids, slot, start, last_idx,
-                    final, toks, lengths, keys, temps, top_ks, top_ps):
-        # traced once per engine lifetime — python side effect counts
-        # compiles (the static-shape invariant, pinned by tests)
+                    final, toks, lengths, keys, temps, top_ks, top_ps,
+                    n_pages):
+        # traced once per (engine lifetime, bucket width) — python side
+        # effects count compiles, cumulatively and per bucket (the
+        # bounded-compile invariant, pinned by tests)
         self.prefill_compiles += 1
+        self._prefill_bucket_compiles[n_pages] = (
+            self._prefill_bucket_compiles.get(n_pages, 0) + 1
+        )
         logits, cache = self._prefill_chunk_body(
-            self.model, params, cache, pt, ids, slot, start
+            self.model, params, cache, pt, ids, slot, start, n_pages
         )
         tok, toks, lengths, keys = self._prefill_tail(
             logits, slot, start, last_idx, final, toks, lengths, keys,
@@ -356,17 +471,20 @@ class ServeEngine:
 
     def _prefill_spec_fn(self, params, dparams, cache, dcache, pt, dpt,
                          ids, slot, start, last_idx, final, toks,
-                         lengths, keys, temps, top_ks, top_ps):
+                         lengths, keys, temps, top_ks, top_ps, n_pages):
         """Speculative prefill: the SAME chunk through target AND draft
         (the draft needs the prompt's KV before it can propose) — one
-        program, one dispatch per chunk."""
+        program per bucket, one dispatch per chunk."""
         self.prefill_compiles += 1
+        self._prefill_bucket_compiles[n_pages] = (
+            self._prefill_bucket_compiles.get(n_pages, 0) + 1
+        )
         logits, cache = self._prefill_chunk_body(
-            self.model, params, cache, pt, ids, slot, start
+            self.model, params, cache, pt, ids, slot, start, n_pages
         )
         _, dcache = self._prefill_chunk_body(
             self.spec.draft_model, dparams, dcache, dpt, ids, slot,
-            start,
+            start, n_pages,
         )
         tok, toks, lengths, keys = self._prefill_tail(
             logits, slot, start, last_idx, final, toks, lengths, keys,
@@ -396,20 +514,41 @@ class ServeEngine:
         return out
 
     def _decode_fn(self, params, cache, pt, toks, lengths, keys, temps,
-                   top_ks, top_ps, active):
+                   top_ks, top_ps, active, n_pages):
         self.decode_compiles += 1
-        dense = gather_pages(cache, pt)
-        last, dense = decode_step_body(
-            self.model, params, dense, toks,
-            cache_len=self.config.max_len,
-            positions=lengths[:, None],
-            write_pos=lengths,
+        self._decode_bucket_compiles[n_pages] = (
+            self._decode_bucket_compiles.get(n_pages, 0) + 1
         )
-        # persist ONLY the decoding rows' written token; free and
-        # mid-prefill rows drop their write on the floor
-        cache = scatter_kv(
-            cache, dense, pt, lengths[:, None], active[:, None]
-        )
+        if self.config.decode_mode == "paged":
+            # attend in place over the pool: decode_cache writes the
+            # new token through per-page scatters (inactive rows drop
+            # theirs) and attention streams the bucket-sliced tables —
+            # no dense intermediate, no scatter-back; the model's
+            # returned cache IS the updated pool
+            ptb = jax.lax.slice_in_dim(pt, 0, n_pages, axis=1)
+            with paged_view(PagedView(
+                page_tables=ptb, keep=active,
+                page_size=self.pool.page_size,
+            )):
+                last, cache = decode_step_body(
+                    self.model, params, cache, toks,
+                    cache_len=self.config.max_len,
+                    positions=lengths[:, None],
+                    write_pos=lengths,
+                )
+        else:
+            dense = gather_pages(cache, pt)
+            last, dense = decode_step_body(
+                self.model, params, dense, toks,
+                cache_len=self.config.max_len,
+                positions=lengths[:, None],
+                write_pos=lengths,
+            )
+            # persist ONLY the decoding rows' written token; free and
+            # mid-prefill rows drop their write on the floor
+            cache = scatter_kv(
+                cache, dense, pt, lengths[:, None], active[:, None]
+            )
         pair = jax.vmap(jax.random.split)(keys)  # [S, 2, 2]
         nxt = sample_logits_rows(last, pair[:, 1], temps, top_ks, top_ps)
         # advance ONLY the decoding rows in place: the continuing token
@@ -422,7 +561,7 @@ class ServeEngine:
         return cache, nxt, toks_out, lengths_out, keys_out
 
     def _spec_fn(self, params, dparams, cache, dcache, pt, dpt, toks,
-                 lengths, keys, temps, top_ks, top_ps, active):
+                 lengths, keys, temps, top_ks, top_ps, active, n_pages):
         """The fused speculative tick: k draft proposals -> one [S, k+1]
         target verify -> per-row acceptance -> page scatters.
 
@@ -433,11 +572,24 @@ class ServeEngine:
         row; the host truncates at eos / max_new (any truncation
         retires the request, so device/host state never diverges for a
         row that keeps decoding).
+
+        In paged mode the DRAFT keeps a dense view — its k sequential
+        single-token steps re-read the whole live context every step,
+        the one shape a dense span still wins — but bucket-sliced to
+        ``n_pages`` instead of ``max_len``-wide; the target verify
+        attends in place over the pool like the plain tick, with the
+        ``[S, k+1]`` query block riding the same paged primitive.
         """
         self.decode_compiles += 1
+        self._decode_bucket_compiles[n_pages] = (
+            self._decode_bucket_compiles.get(n_pages, 0) + 1
+        )
         k = self.spec.num_draft_tokens
         S = self.config.num_slots
         max_len = self.config.max_len
+        paged = self.config.decode_mode == "paged"
+        width = n_pages * self.pool.page_size
+        dpt = jax.lax.slice_in_dim(dpt, 0, n_pages, axis=1)
         idx = jnp.arange(k + 1)[None, :]
         pair = jax.vmap(jax.random.split)(keys)   # [S, 2, 2]
         ticket = pair[:, 1]  # per-row key budget for this tick's draws
@@ -454,7 +606,7 @@ class ServeEngine:
             dense_d, tok = carry
             logits, dense_d = decode_step_body(
                 self.spec.draft_model, dparams, dense_d, tok,
-                cache_len=max_len,
+                cache_len=width,
                 positions=(lengths + j)[:, None],
                 write_pos=lengths + j,
             )
@@ -495,7 +647,7 @@ class ServeEngine:
         # before any query reaches it, like every other rejected slot.
         _, dense_d = decode_step_body(
             self.spec.draft_model, dparams, dense_d, last_prop,
-            cache_len=max_len,
+            cache_len=width,
             positions=(lengths + k)[:, None],
             write_pos=lengths + k,
         )
@@ -508,20 +660,38 @@ class ServeEngine:
         )
 
         # ---- verify: one chunked target pass scores the proposal ----
-        dense_t = gather_pages(cache, pt)
         chunk = jnp.concatenate([toks[:, None], drafts], axis=1)
-        logits, st = self.model.apply(
-            {"params": params, "cache": dense_t},
-            chunk, decode=True, cache_len=max_len,
-            mutable=["cache"],
-            positions=lengths[:, None] + idx,
-            write_pos=lengths,
-        )
-        vpos = lengths[:, None] + idx
-        cache = scatter_kv(
-            cache, st["cache"], pt, vpos,
-            active[:, None] & jnp.ones((1, k + 1), bool),
-        )
+        if paged:
+            # the [S, k+1] verify attends in place over the pool: the
+            # k+1 K/V entries land via per-page scatters (inactive rows
+            # dropped) and the paged primitive streams the bucket
+            ptb = jax.lax.slice_in_dim(pt, 0, n_pages, axis=1)
+            with paged_view(PagedView(
+                page_tables=ptb, keep=active,
+                page_size=self.pool.page_size,
+            )):
+                logits, st = self.model.apply(
+                    {"params": params, "cache": cache},
+                    chunk, decode=True, cache_len=max_len,
+                    mutable=["cache"],
+                    positions=lengths[:, None] + idx,
+                    write_pos=lengths,
+                )
+            cache = st["cache"]
+        else:
+            dense_t = gather_pages(cache, pt)
+            logits, st = self.model.apply(
+                {"params": params, "cache": dense_t},
+                chunk, decode=True, cache_len=max_len,
+                mutable=["cache"],
+                positions=lengths[:, None] + idx,
+                write_pos=lengths,
+            )
+            vpos = lengths[:, None] + idx
+            cache = scatter_kv(
+                cache, st["cache"], pt, vpos,
+                active[:, None] & jnp.ones((1, k + 1), bool),
+            )
 
         # ---- acceptance ----
         # greedy: the longest draft prefix matching the target's own
@@ -657,6 +827,119 @@ class ServeEngine:
             self._snapshot()
         return did
 
+    # -- length buckets + analytic HBM accounting --------------------------
+    def _compile_note(self, kind: str, n_pages: int) -> str:
+        """Recompile-sentinel key: per bucket when buckets exist (each
+        bucket is its own program with its own once-contract); the
+        round-11 plain name when exactly one width exists."""
+        if len(self._buckets) == 1:
+            return f"serve.{kind}"
+        return f"serve.{kind}[b{n_pages}]"
+
+    def _tick_bucket(self, decoding) -> int:
+        """The static page width this tick's programs run at: the
+        smallest bucket covering every ACTIVE row's reads and writes
+        (max live length + the tick's write span). Inactive rows may
+        point beyond it — their reads are discarded and their writes
+        dropped, so the clamp is harmless by construction."""
+        if self.config.decode_mode == "dense":
+            return self.pool.max_pages
+        if resolve_paged_attention_impl() != self._resolved_impl:
+            # set_paged_attention_impl() cleared the jit caches: the
+            # next dispatch would retrace (breaking the compiled-once-
+            # per-bucket contract) while the analytic byte model kept
+            # pricing the OLD backend — refuse loudly instead of
+            # silently desynchronizing both
+            raise RuntimeError(
+                f"paged-attention impl changed under a live engine "
+                f"(engine resolved {self._resolved_impl!r}, flag now "
+                f"resolves {resolve_paged_attention_impl()!r}) — "
+                f"construct a new ServeEngine after "
+                f"set_paged_attention_impl()"
+            )
+        W = 1 if self.spec is None else self.spec.num_draft_tokens + 1
+        need = max(
+            int(self.pool.lengths[slot]) for slot, _ in decoding
+        ) + W
+        return self._bucket_for(-(-need // self.pool.page_size))
+
+    def _tick_cost(self, n_pages: int):
+        """(gather_bytes, total_hbm_bytes) one decode tick moves under
+        the active mode/impl's analytic traffic model (DESIGN.md §17) —
+        cached per bucket so the per-tick cost is two integer adds."""
+        cost = self._tick_cost_cache.get(n_pages)
+        if cost is None:
+            S = self.config.num_slots
+            fb = self._frame_bytes_target
+            # gather traffic = the dense intermediate (pool read +
+            # dense write); the attention stream reads each page once
+            gather = attn = 0
+            if self._attn_impl in ("dense", "gather"):
+                gather += 2 * S * n_pages * fb
+            attn += S * n_pages * fb
+            if self.spec is not None:
+                # the draft keeps a (bucketed) dense view: one gather,
+                # k+1 proposal steps + the fill feed each re-read it
+                k = self.spec.num_draft_tokens
+                dfb = self._frame_bytes_draft
+                gather += 2 * S * n_pages * dfb
+                attn += (k + 2) * S * n_pages * dfb
+            cost = (gather, gather + attn)
+            self._tick_cost_cache[n_pages] = cost
+        return cost
+
+    @property
+    def decode_buckets(self):
+        """Bucket widths (pages) the decode tick has compiled at."""
+        return set(self._decode_bucket_compiles)
+
+    @property
+    def prefill_buckets(self):
+        return set(self._prefill_bucket_compiles)
+
+    @property
+    def decode_hbm_bytes_per_token(self) -> float:
+        """Analytic decode-path HBM bytes per emitted token — the
+        number the dense-gather path roughly doubled and this round's
+        paged attention removes (serve.decode_hbm_bytes_per_token
+        tracing counter / bench serving_paged_attn phase)."""
+        return self.decode_hbm_bytes / max(self._decode_tokens, 1)
+
+    def precompile_decode_buckets(self) -> None:
+        """Compile every decode-tick bucket with a no-op dispatch so
+        serving never pays a compile mid-measurement.
+
+        All rows ride as INACTIVE: pool writes are dropped by the keep
+        gate, and toks/lengths/keys pass through their ``where(active,
+        ...)`` untouched — device state is semantically unchanged. The
+        analytic byte counters are left alone (nothing was served).
+        ``serve.loadgen.warm_up`` calls this after its warm request; a
+        test driving the engine directly still sees one compile per
+        OCCUPIED bucket.
+        """
+        idle = jnp.zeros(self.config.num_slots, bool)
+        for n in self._buckets:
+            if self.spec is None:
+                (
+                    self.pool.cache, _, self._toks, self._lengths,
+                    self._keys,
+                ) = self._decode(
+                    self.params, self.pool.cache, self._pt, self._toks,
+                    self._lengths, self._keys, self._temps,
+                    self._top_ks, self._top_ps, idle, n,
+                )
+            else:
+                (
+                    self.pool.cache, self.draft_pool.cache, _,
+                    self._toks, self._lengths, self._keys,
+                ) = self._spec_tick(
+                    self.params, self.spec.draft_params,
+                    self.pool.cache, self.draft_pool.cache,
+                    self._pt, self._dpt, self._toks, self._lengths,
+                    self._keys, self._temps, self._top_ks,
+                    self._top_ps, idle, n,
+                )
+
     def _snapshot(self) -> None:
         pool = self.pool
         gauges = dict(
@@ -667,6 +950,10 @@ class ServeEngine:
                 else 0.0
             ),
             prefix_hit_rate=pool.prefix_hit_rate,
+            decode_gather_bytes=self.decode_gather_bytes,
+            decode_hbm_bytes_per_token=round(
+                self.decode_hbm_bytes_per_token, 1
+            ),
         )
         if self.spec is not None:
             gauges.update(
@@ -688,6 +975,15 @@ class ServeEngine:
             )
             tracing.counter(
                 "serve.prefix_hit_rate", pool.prefix_hit_rate
+            )
+            # the decode-path gather tax (and its removal) as recorded
+            # facts — plain precomputed ints, armed-only emission
+            tracing.counter(
+                "serve.decode_gather_bytes", self.decode_gather_bytes
+            )
+            tracing.counter(
+                "serve.decode_hbm_bytes_per_token",
+                gauges["decode_hbm_bytes_per_token"],
             )
             if self.spec is not None and self.spec_verifies:
                 tracing.counter(
@@ -724,6 +1020,12 @@ class ServeEngine:
             ids = np.zeros((1, cfg.prefill_chunk), np.int32)
             ids[0, :plan.chunk_len] = plan.ids
             slot = h.slot
+            # the chunk can reach positions [0, start + C): gather the
+            # smallest bucket covering them, not the max_len-wide row
+            n_pages = self._bucket_for(
+                -(-(plan.start + cfg.prefill_chunk)
+                  // self.pool.page_size)
+            )
             # scalars pass as plain python values (weak-typed, no
             # retrace); ALL slot-row updates — per-chunk length cursor,
             # final-chunk key/token persist — happen inside the one
@@ -732,10 +1034,22 @@ class ServeEngine:
                 "serve.prefill_chunk", request=h.request.request_id
             ):
                 if self.spec is None:
-                    tok = self._dispatch_prefill(ids, slot, plan)
+                    tok = self._dispatch_prefill(ids, slot, plan, n_pages)
                 else:
-                    tok = self._dispatch_prefill_spec(ids, slot, plan)
-            tracing.note_compiles("serve.prefill", self.prefill_compiles)
+                    tok = self._dispatch_prefill_spec(
+                        ids, slot, plan, n_pages
+                    )
+            # the recompile sentinel's once-contract is per PROGRAM —
+            # with buckets, a bucket IS a program, so single-bucket
+            # engines keep the plain name and multi-bucket engines get
+            # one sentinel key per bucket (one shared key would let a
+            # recompile of bucket A mask a later recompile of bucket B)
+            # (armed-only: the lookups are not disarmed-trivial args)
+            if tracing._tracer is not None:
+                tracing.note_compiles(
+                    self._compile_note("prefill", n_pages),
+                    self._prefill_bucket_compiles.get(n_pages),
+                )
             self.pool.lengths[slot] = plan.start + plan.chunk_len
             did = True
             if plan.final:
@@ -763,8 +1077,9 @@ class ServeEngine:
         if not decoding:
             return False
         self._decode_ticks += 1
+        n_pages = self._tick_bucket(decoding)
         if self.spec is not None:
-            return self._run_spec_tick(decoding)
+            return self._run_spec_tick(decoding, n_pages)
         # one jit call; toks/lengths/keys advance in-program for the
         # active rows, so the only per-tick host traffic is the sampled
         # tokens coming down
@@ -781,9 +1096,17 @@ class ServeEngine:
             ) = self._decode(
                 self.params, self.pool.cache, self._pt, self._toks,
                 self._lengths, self._keys, self._temps, self._top_ks,
-                self._top_ps, self._active_cached,
+                self._top_ps, self._active_cached, n_pages,
             )
-        tracing.note_compiles("serve.decode", self.decode_compiles)
+        if tracing._tracer is not None:  # armed-only arg evaluation
+            tracing.note_compiles(
+                self._compile_note("decode", n_pages),
+                self._decode_bucket_compiles.get(n_pages),
+            )
+        gb, hb = self._tick_cost(n_pages)
+        self.decode_gather_bytes += gb
+        self.decode_hbm_bytes += hb
+        self._decode_tokens += len(decoding)
         with tracing.span("serve.token_fetch"):
             # the one per-tick device sync: every sampled token comes down
             nxt = np.asarray(nxt)
@@ -801,7 +1124,7 @@ class ServeEngine:
             self._emit(h, int(nxt[slot]))
         return True
 
-    def _dispatch_prefill(self, ids, slot, plan):
+    def _dispatch_prefill(self, ids, slot, plan, n_pages):
         """One plain prefill-chunk dispatch; the donated pool buffer is
         rebound to its returned successor before anything reads it."""
         (
@@ -810,12 +1133,12 @@ class ServeEngine:
             self.params, self.pool.cache, self._pt, ids,
             slot, plan.start, plan.chunk_len - 1, plan.final,
             self._toks, self._lengths, self._keys,
-            self._temps, self._top_ks, self._top_ps,
+            self._temps, self._top_ks, self._top_ps, n_pages,
         )
         self.pool.cache = cache
         return tok
 
-    def _dispatch_prefill_spec(self, ids, slot, plan):
+    def _dispatch_prefill_spec(self, ids, slot, plan, n_pages):
         """One fused target+draft prefill-chunk dispatch; both donated
         pool buffers rebind to their returned successors."""
         (
@@ -826,14 +1149,14 @@ class ServeEngine:
             self._pt, self._dpt, ids,
             slot, plan.start, plan.chunk_len - 1, plan.final,
             self._toks, self._lengths, self._keys,
-            self._temps, self._top_ks, self._top_ps,
+            self._temps, self._top_ks, self._top_ps, n_pages,
         )
         self.pool.cache = cache
         self.draft_pool.cache = dcache
         self.draft_pool.lengths[slot] = plan.start + plan.chunk_len
         return tok
 
-    def _run_spec_tick(self, decoding) -> bool:
+    def _run_spec_tick(self, decoding, n_pages) -> bool:
         """One fused draft+verify tick; emits 1..k+1 tokens/request."""
         span = (
             tracing._NULL_SPAN if tracing._tracer is None
@@ -851,9 +1174,16 @@ class ServeEngine:
                 self.pool.cache, self.draft_pool.cache,
                 self._pt, self._dpt, self._toks, self._lengths,
                 self._keys, self._temps, self._top_ks, self._top_ps,
-                self._active_cached,
+                self._active_cached, n_pages,
             )
-        tracing.note_compiles("serve.decode", self.decode_compiles)
+        if tracing._tracer is not None:  # armed-only arg evaluation
+            tracing.note_compiles(
+                self._compile_note("decode", n_pages),
+                self._decode_bucket_compiles.get(n_pages),
+            )
+        gb, hb = self._tick_cost(n_pages)
+        self.decode_gather_bytes += gb
+        self.decode_hbm_bytes += hb
         with tracing.span("serve.token_fetch"):
             # ONE per-tick device sync: k+1 emit columns + the
             # accepted count packed into a single [S, k+2] fetch
@@ -864,6 +1194,7 @@ class ServeEngine:
         fault_armed = faults.active()
         for slot, h in decoding:
             n = int(acc[slot]) + 1
+            self._decode_tokens += n
             # mirror the in-program advances: the verify wrote k+1
             # entries but only a+1 became sequence; the rejected tail
             # sits beyond the accepted length where the next tick's

@@ -42,14 +42,20 @@ tokens, same absolute positions, same deterministic program — so the
 gathered dense view is bitwise what the unshared engine computed, and
 the solo-``generate`` parity suite holds with sharing on.
 
-The static-shape tax, stated honestly: every decode tick gathers the
-live slots' pages into a transient dense ``[S, max_len]`` view (XLA
-frees it within the tick; with donation the pool updates in place).
-Resident KV drops to ``pages_in_use × page_size``, but per-tick read
-traffic roughly doubles (gather + attention read) and transient peak
-adds one dense view. At the length mixes this pool exists for, the
-resident win dominates — measured in bench.py's ``serving_paged`` phase
-(``serving_kv_bytes_ratio`` >= 2x pinned by test_bench_contract).
+The static-shape tax, and its round-12 removal: through round 11 every
+decode tick gathered the live slots' pages into a transient dense
+``[S, max_len]`` view — per-tick read traffic roughly doubled (gather +
+attention read) and the transient peak carried a full dense copy. The
+default engine (``decode_mode="paged"``) now attends IN PLACE over the
+pool (``ops/paged_attention``): new-token K/V lands via per-page
+scatters and attention streams the pages, so the remaining dense spans
+(chunked prefill's one row, the speculative draft's short context) are
+bucket-sliced to the live maximum's power-of-two page width, never
+``max_len``. The gather helpers below stay the ``decode_mode="dense"``
+baseline path — bench.py's ``serving_paged_attn`` phase measures the
+paged tick against it (tokens/s and analytic HBM bytes/token, parity
+enforced in-phase). Resident KV is ``pages_in_use × page_size`` either
+way (``serving_kv_bytes_ratio`` >= 2x pinned by test_bench_contract).
 """
 
 from __future__ import annotations
@@ -66,6 +72,18 @@ import jax.numpy as jnp
 import numpy as np
 
 from pytorch_distributed_tpu.generation import cache_batch_axis
+from pytorch_distributed_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# warn-once dedup for degenerate auto page sizes (the rule-engine's
+# replicate-with-warning precedent, autoplan/rules.py)
+_warned_page_sizes: set = set()
+
+
+def reset_page_size_warnings() -> None:
+    """Clear the warn-once dedup (tests asserting the warning fires)."""
+    _warned_page_sizes.clear()
 
 
 def auto_page_size(max_len: int, cap: int = 32) -> int:
@@ -75,11 +93,27 @@ def auto_page_size(max_len: int, cap: int = 32) -> int:
     ``max_pages * page_size`` wide and the engine equates it with
     ``max_len``); powers of two keep the div/mod in the scatter index
     arithmetic cheap. ``max_len`` odd degenerates to 1-token pages —
-    valid, just all bookkeeping and no batching.
+    still VALID, but every token becomes its own page: the page table
+    is ``max_len`` entries per slot, every allocation/refcount walk is
+    per-token, the paged-attention stream pays one page step per
+    token, and prefix sharing hashes per token. That cost used to be
+    silent; now it warns once per ``max_len`` (the rule engine's
+    replicate-with-warning precedent) — pass an even/power-of-two
+    ``max_len`` or an explicit ``page_size`` to opt out knowingly.
     """
     ps = math.gcd(max_len, 1 << 30)  # largest power-of-2 divisor
     while ps > cap:
         ps //= 2
+    if ps == 1 and max_len > 1 and max_len not in _warned_page_sizes:
+        _warned_page_sizes.add(max_len)
+        logger.warning(
+            "auto_page_size(max_len=%d): odd max_len degenerates to "
+            "1-token pages — %d page-table entries per slot, per-token "
+            "bookkeeping and page streaming, per-token prefix hashing. "
+            "Use an even (ideally power-of-two-divisible) max_len or "
+            "pass page_size explicitly.",
+            max_len, max_len,
+        )
     return ps
 
 

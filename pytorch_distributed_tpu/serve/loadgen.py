@@ -16,13 +16,20 @@ from pytorch_distributed_tpu.serve.scheduler import Request, RequestStatus
 from pytorch_distributed_tpu.serve.telemetry import ServeTelemetry
 
 
-def warm_up(engine, prompt_ids, telemetry: ServeTelemetry = None) -> None:
-    """Compile BOTH jitted programs outside any measured window.
+def warm_up(
+    engine, prompt_ids, telemetry: ServeTelemetry = None, *,
+    precompile_buckets: bool = True,
+) -> None:
+    """Compile the jitted programs outside any measured window.
 
     A 2-token request is the minimum that reaches a decode tick — a
     1-token request emits its only token from the prefill program and
     retires without ever compiling decode, so the first measured tick
-    would pay the full jit compile (checked here, loudly). Afterwards
+    would pay the full jit compile (checked here, loudly). With length
+    buckets the decode tick is one program PER OCCUPIED BUCKET;
+    ``precompile_buckets`` (default on) compiles every bucket via the
+    engine's no-op dispatch so a live request crossing a page-bucket
+    boundary mid-measurement never pays a compile either. Afterwards
     the engine's telemetry is replaced (``telemetry`` or a fresh one)
     so the warm-up's compile-sized TTFT stays out of every reported
     stream and percentile. The engine's ``max_len`` must fit
@@ -37,6 +44,8 @@ def warm_up(engine, prompt_ids, telemetry: ServeTelemetry = None) -> None:
             "warm-up drained without a decode tick — the decode compile "
             "would land inside the measured window"
         )
+    if precompile_buckets:
+        engine.precompile_decode_buckets()
     engine.telemetry = telemetry or ServeTelemetry(
         # keep the engine's writer/clock: replacing a writer-backed
         # telemetry with a writer-less one would silently drop the

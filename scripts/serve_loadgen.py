@@ -81,6 +81,17 @@ def main():
     ap.add_argument("--num-pages", type=int, default=None,
                     help="KV pool pages (default: parity with the old "
                     "fixed [slots, max_len] pool)")
+    ap.add_argument("--long-context", action="store_true",
+                    help="preset: size max_len WELL past the live "
+                    "lengths (4x the workload fit, >= 256, capped at "
+                    "the model's position table) — the regime paged "
+                    "attention exists for; the summary's bytes/token "
+                    "shows the decode path streaming the live bucket "
+                    "instead of the max_len-wide gather")
+    ap.add_argument("--decode-mode", choices=("paged", "dense"),
+                    default="paged",
+                    help="'dense' runs the round-11 full-width gather "
+                    "tick (the A/B baseline) instead of paged attention")
     ap.add_argument("--spec-k", type=int, default=0,
                     help="enable speculative decoding with k draft "
                     "tokens per tick (draft = a randomly initialized "
@@ -95,6 +106,13 @@ def main():
     ap.add_argument("--log", default=None,
                     help="telemetry JSONL path (MetricsWriter stream)")
     args = ap.parse_args()
+    if args.long_context and args.max_len:
+        # the preset's whole job is sizing max_len; honoring both would
+        # either silently drop the preset or silently rewrite an
+        # explicit --max-len — refused, like every contradictory-flag
+        # combination in this repo
+        ap.error("--long-context sizes max_len itself — pass one of "
+                 "--long-context / --max-len, not both")
 
     import jax
     import numpy as np
@@ -132,6 +150,21 @@ def main():
             for r in reqs
         ] + [args.prefill_chunk + 2 + args.spec_k]
     )
+    if args.long_context and not args.max_len:
+        # the long-context mix: a pool sized far past the live lengths
+        # (capped at the model's position table) so the decode tick's
+        # bucketed stream, not max_len, sets the bytes/token
+        from pytorch_distributed_tpu.generation import model_max_len
+
+        limit = model_max_len(model) or 1 << 30
+        max_len = min(max(4 * max_len, 256), limit)
+        if args.page_size:
+            # align DOWN while still at the cap — the generic round-UP
+            # below must never push a limit-capped max_len past the
+            # model's position table (engine construction would refuse)
+            max_len = max(
+                max_len - max_len % args.page_size, args.page_size
+            )
     if not args.max_len and args.page_size:
         # only the AUTO-computed fit is rounded up to a page multiple;
         # an explicit --max-len is never silently rewritten — if it
@@ -168,7 +201,8 @@ def main():
         EngineConfig(num_slots=args.slots, max_len=max_len,
                      prefill_chunk=args.prefill_chunk,
                      page_size=args.page_size,
-                     num_pages=args.num_pages),
+                     num_pages=args.num_pages,
+                     decode_mode=args.decode_mode),
         spec=spec,
     )
     # serve.loadgen's shared warm-up/pacing: both programs compile
@@ -190,12 +224,18 @@ def main():
               else f"  {k:>18} = {v}")
     pool = engine.pool
     print(f"  decode compiles    = {engine.decode_compiles} "
-          f"(static-shape invariant: must be 1)")
+          f"(bounded-compile invariant: one per occupied length "
+          f"bucket, buckets={sorted(engine.decode_buckets)} pages)")
     print(f"  kv pages           = {pool.peak_pages} peak / "
           f"{pool.num_pages} total (page_size={pool.page_size})")
     print(f"  prefix hit rate    = {pool.prefix_hit_rate:.3f} "
           f"({pool.prefix_hits}/{pool.prefix_lookups} admissions, "
           f"{pool.shared_tokens} prompt tokens served copy-free)")
+    print(f"  decode bytes/token = "
+          f"{engine.decode_hbm_bytes_per_token:,.0f} analytic HBM "
+          f"(mode={args.decode_mode}, gather "
+          f"{engine.decode_gather_bytes:,d} B total — the dense-"
+          f"intermediate tax paged attention removes)")
     if engine.spec is not None and engine.spec_verifies:
         print(f"  spec accept/verify = "
               f"{engine.spec_accepted / engine.spec_verifies:.2f} "

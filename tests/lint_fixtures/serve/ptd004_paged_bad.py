@@ -1,0 +1,21 @@
+"""PTD004 known-bad: the per-page KV write (round 12's paged_write
+shape — flat pool scatter with drop semantics) run EAGERLY."""
+import jax.numpy as jnp
+
+
+def paged_write_eager(pool, new, page_tables, write_pos, keep):
+    P1, ps = pool.shape[0], pool.shape[1]
+    B, W = new.shape[0], new.shape[1]
+    pos = write_pos[:, None] + jnp.arange(W)[None, :]
+    page = jnp.take_along_axis(page_tables, pos // ps, axis=1)
+    dst = jnp.where(keep[:, None], page * ps + pos % ps, P1 * ps)
+    flat = pool.reshape((P1 * ps,) + pool.shape[2:])
+    flat = flat.at[dst.reshape(-1)].set(  # expect: PTD004
+        new.reshape((B * W,) + new.shape[2:]), mode="drop",
+    )
+    return flat.reshape(pool.shape)
+
+
+def park_rejected_tail(pool_flat, dst):
+    # the spec tick's rewind helper, eagerly: same dispatch-cost bug
+    return pool_flat.at[dst].set(0.0, mode="drop")  # expect: PTD004

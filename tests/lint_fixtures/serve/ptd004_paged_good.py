@@ -1,0 +1,32 @@
+"""PTD004 known-good twins: the per-page write fused under jit — the
+forms the real ops/paged_attention.paged_write reaches production in
+(traced inside the engine's jitted decode programs)."""
+import jax
+import jax.numpy as jnp
+
+
+def _paged_write(pool, new, page_tables, write_pos, keep):
+    # not wrapped itself, but called from the jitted tick below: the
+    # one-module call-graph closure covers it
+    P1, ps = pool.shape[0], pool.shape[1]
+    B, W = new.shape[0], new.shape[1]
+    pos = write_pos[:, None] + jnp.arange(W)[None, :]
+    page = jnp.take_along_axis(page_tables, pos // ps, axis=1)
+    dst = jnp.where(keep[:, None], page * ps + pos % ps, P1 * ps)
+    flat = pool.reshape((P1 * ps,) + pool.shape[2:])
+    flat = flat.at[dst.reshape(-1)].set(
+        new.reshape((B * W,) + new.shape[2:]), mode="drop",
+    )
+    return flat.reshape(pool.shape)
+
+
+def _decode_tick_fn(pool, new, page_tables, write_pos, keep):
+    return _paged_write(pool, new, page_tables, write_pos, keep)
+
+
+decode_tick = jax.jit(_decode_tick_fn)
+
+
+@jax.jit
+def park_rejected_tail(pool_flat, dst):
+    return pool_flat.at[dst].set(0.0, mode="drop")

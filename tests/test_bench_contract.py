@@ -138,6 +138,22 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
         f"speculative decode lost to plain decode: {spec}"
     )
     assert spec["accepted_per_verify"] > 0, spec  # drafts actually land
+    # paged-attention decode (round 12): the decode tick must at least
+    # MATCH the dense-gather tick on tokens/sec (output parity enforced
+    # in-phase — the phase raises on divergence, and on any bucket
+    # compiled more than once) while the analytic decode HBM
+    # bytes/token shrinks >= 1.5x at the long-context mix (in practice
+    # ~16x: a 1-2 page live bucket vs the 16-page max_len gather)
+    pa = one_metric("serving_paged_attn_tokens_per_sec")
+    assert pa["value"] > 0
+    assert pa["vs_baseline"] is not None and pa["vs_baseline"] >= 1.0, (
+        f"paged attention lost to the dense-gather tick: {pa}"
+    )
+    pr = one_metric("serving_paged_attn_bytes_per_token_ratio")
+    assert pr["value"] >= 1.5, pr
+    assert pr["paged_bytes_per_token"] > 0, pr
+    assert pr["dense_bytes_per_token"] > pr["paged_bytes_per_token"], pr
+    assert pr["decode_buckets"], pr
 
     # the input_pipeline phases must stay inside their time budget (the
     # r3 starvation incident: the feed phase alone ran >25 min and ate
@@ -155,6 +171,7 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
     assert durations["serving"] < 300, durations
     assert durations.get("serving_paged", 999) < 300, durations
     assert durations.get("serving_spec", 999) < 300, durations
+    assert durations.get("serving_paged_attn", 999) < 300, durations
 
     # ...and the same numbers must land as DATA: one phase_durations_s
     # record (the print-only stderr notes were unparseable by the
@@ -166,7 +183,8 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
     ]
     assert len(pd) == 1, proc.stderr[-2000:]
     for phase in ("input_pipeline_feed", "serving", "serving_paged",
-                  "serving_spec", "observability", "planning"):
+                  "serving_spec", "serving_paged_attn",
+                  "observability", "planning"):
         assert phase in pd[0]["value"], pd[0]
     assert pd[0]["value"] == pytest.approx(durations, abs=0.2)
 

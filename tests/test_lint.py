@@ -481,6 +481,30 @@ class TestRuleEnvelopes:
             rules=[EagerScatterHotPath()],
         )
         assert cold == []
+        # round 12: the paged-attention module (home of the per-page
+        # KV write the decode tick runs) is a hot path too — the rule
+        # keeps teeth on the new code, but not on the rest of ops/
+        paged = lint_source(
+            src, relpath="pytorch_distributed_tpu/ops/paged_attention.py",
+            rules=[EagerScatterHotPath()],
+        )
+        assert [f.rule_id for f in paged] == ["PTD004"]
+        other_ops = lint_source(
+            src, relpath="pytorch_distributed_tpu/ops/quant.py",
+            rules=[EagerScatterHotPath()],
+        )
+        assert other_ops == []
+
+    def test_ptd004_real_paged_attention_module_is_clean(self):
+        """The real per-page write helper is suppressed explicitly
+        (inline disable naming the jitted-caller contract), like
+        serve/kv_slots.scatter_kv before it — the module lints clean
+        without a baseline entry."""
+        fs = lint_paths(
+            ["pytorch_distributed_tpu/ops/paged_attention.py"],
+            rules=[EagerScatterHotPath()],
+        )
+        assert fs == []
 
     def test_ptd004_engine_jit_wrap_recognized(self):
         """The real engine pattern: methods jitted in __init__, row

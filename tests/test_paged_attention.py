@@ -1,0 +1,586 @@
+"""Paged-attention decode (ops/paged_attention + engine wiring, round 12).
+
+Contracts under test, on top of test_serve_paged.py's parity suite:
+
+* the op: the ``gather`` impl is BIT-IDENTICAL per dtype to
+  ``gather_pages``-style dense materialization + the unchanged
+  ``dot_product_attention`` (the zero-tail argument made executable);
+  the ``stream`` (lax.scan online-softmax) reference and the Pallas
+  ``kernel`` (interpret off-TPU) match the dense path to explicit
+  per-dtype tolerances — online softmax reorders reductions, so their
+  parity is last-ulp-class, pinned, not assumed;
+* null-page frame 0 is unobservable (garbage in frame 0 changes no
+  output), ragged lengths (including 0) and the ``[W > 1]`` verify
+  block's internal causal order mask inside the op, GQA maps kv heads
+  flash-style, sliding windows compose;
+* per-page writes land exactly where the page table says, and dropped
+  rows (keep=False) never touch the pool — the scatter_kv invariant
+  carried to the new write path;
+* the engine: dense-mode vs paged-mode A/B runs emit identical
+  streams while the paged run's analytic HBM bytes shrink; slot reuse
+  across length buckets recompiles AT MOST once per bucket (a second
+  wave of the same shape compiles nothing); CoW-shared pages attend
+  correctly while BOTH sharers are live mid-decode; the ``[k+1]``
+  paged verify stays bit-identical to solo generate; precompiling
+  buckets is bitwise state-neutral; ``auto_page_size`` warns once on
+  the odd-max_len 1-token-page degeneration.
+"""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.generation import generate
+from pytorch_distributed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from pytorch_distributed_tpu.ops.attention import dot_product_attention
+from pytorch_distributed_tpu.ops.paged_attention import (
+    PagedKVQuant,
+    paged_attention,
+    paged_write,
+    set_paged_attention_impl,
+)
+from pytorch_distributed_tpu.runtime import tracing
+from pytorch_distributed_tpu.serve import (
+    EngineConfig,
+    Request,
+    RequestStatus,
+    ServeEngine,
+    SpecConfig,
+    auto_page_size,
+)
+from pytorch_distributed_tpu.serve.kv_slots import (
+    reset_page_size_warnings,
+)
+
+pytestmark = pytest.mark.serve
+
+IMPLS = ("gather", "stream", "kernel")
+
+
+def _pool_case(rng, *, B=4, W=1, Hq=4, Hkv=2, D=16, ps=8, n=4,
+               dtype=jnp.float32, max_length=None):
+    """A random pool + tables + ragged lengths; frame 0 stays zero."""
+    P1 = B * n + 1
+    q = jnp.asarray(rng.standard_normal((B, W, Hq, D)), dtype)
+    kp = jnp.asarray(rng.standard_normal((P1, ps, Hkv, D)), dtype)
+    vp = jnp.asarray(rng.standard_normal((P1, ps, Hkv, D)), dtype)
+    kp = kp.at[0].set(0.0)
+    vp = vp.at[0].set(0.0)
+    tables = jnp.asarray(
+        np.arange(1, B * n + 1).reshape(B, n), jnp.int32
+    )
+    hi = max_length if max_length is not None else n * ps - W
+    lengths = jnp.asarray(
+        rng.integers(0, hi + 1, size=B), jnp.int32
+    )
+    return q, kp, vp, tables, lengths
+
+
+def _dense_ref(q, kp, vp, tables, lengths, **kw):
+    """The pre-paged path: materialize the tables densely, run the
+    unchanged dot_product_attention with per-row offsets."""
+    B, n = tables.shape
+    ps = kp.shape[1]
+    kd = jnp.take(kp, tables.reshape(-1), axis=0).reshape(
+        B, n * ps, kp.shape[2], kp.shape[3]
+    )
+    vd = jnp.take(vp, tables.reshape(-1), axis=0).reshape(
+        B, n * ps, vp.shape[2], vp.shape[3]
+    )
+    return dot_product_attention(
+        q, kd, vd, causal=True, q_offset=lengths, **kw
+    )
+
+
+class TestPagedAttentionOp:
+    def test_gather_impl_bit_exact_per_dtype(self):
+        """The engine-default CPU impl: bitwise the dense path, both
+        dtypes — this is what keeps solo-generate parity pinned."""
+        for dtype in (jnp.float32, jnp.bfloat16):
+            rng = np.random.default_rng(0)
+            q, kp, vp, tables, lengths = _pool_case(
+                rng, W=3, dtype=dtype
+            )
+            ref = _dense_ref(q, kp, vp, tables, lengths)
+            out = paged_attention(
+                q, kp, vp, page_tables=tables, lengths=lengths,
+                impl="gather",
+            )
+            assert out.dtype == ref.dtype
+            assert np.array_equal(
+                np.asarray(out, np.float32), np.asarray(ref, np.float32)
+            ), str(dtype)
+
+    @pytest.mark.parametrize("impl", ["stream", "kernel"])
+    def test_streaming_impls_match_dense_per_dtype(self, impl):
+        """Online softmax reassociates the reductions: parity with the
+        dense path is pinned per dtype at explicit tolerances (f32
+        last-ulp-class; bf16 dominated by its 8-bit mantissa)."""
+        for dtype, tol in ((jnp.float32, 3e-6), (jnp.bfloat16, 3e-2)):
+            rng = np.random.default_rng(1)
+            q, kp, vp, tables, lengths = _pool_case(
+                rng, W=2, dtype=dtype
+            )
+            ref = np.asarray(
+                _dense_ref(q, kp, vp, tables, lengths), np.float32
+            )
+            out = np.asarray(paged_attention(
+                q, kp, vp, page_tables=tables, lengths=lengths,
+                impl=impl,
+            ), np.float32)
+            assert np.max(np.abs(out - ref)) <= tol, str(dtype)
+
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_null_page_contents_unobservable(self, impl):
+        """Unused table entries hold frame 0; poisoning frame 0 with
+        huge finite garbage must change nothing the mask admits."""
+        rng = np.random.default_rng(2)
+        q, kp, vp, tables, lengths = _pool_case(rng, max_length=10)
+        # tail table entries -> null page (lengths <= 10 < 2 pages)
+        tables = tables.at[:, 2:].set(0)
+        clean = paged_attention(
+            q, kp, vp, page_tables=tables, lengths=lengths, impl=impl
+        )
+        dirty = paged_attention(
+            q, kp.at[0].set(1e6), vp.at[0].set(-1e6),
+            page_tables=tables, lengths=lengths, impl=impl,
+        )
+        assert np.array_equal(np.asarray(clean), np.asarray(dirty))
+
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_verify_block_causal_order_and_zero_length(self, impl):
+        """W = k+1 queries: query j sees exactly positions <= len+j
+        (the fused-verify contract), including rows of length 0."""
+        rng = np.random.default_rng(3)
+        q, kp, vp, tables, _ = _pool_case(rng, W=4, Hq=2, Hkv=1)
+        lengths = jnp.asarray([0, 3, 8, 17], jnp.int32)
+        ref = np.asarray(
+            _dense_ref(q, kp, vp, tables, lengths), np.float32
+        )
+        out = np.asarray(paged_attention(
+            q, kp, vp, page_tables=tables, lengths=lengths, impl=impl
+        ), np.float32)
+        tol = 0.0 if impl == "gather" else 3e-6
+        assert np.max(np.abs(out - ref)) <= tol
+
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_gqa_and_window(self, impl):
+        rng = np.random.default_rng(4)
+        q, kp, vp, tables, lengths = _pool_case(rng, Hq=8, Hkv=2)
+        ref = np.asarray(_dense_ref(
+            q, kp, vp, tables, lengths, window=5
+        ), np.float32)
+        out = np.asarray(paged_attention(
+            q, kp, vp, page_tables=tables, lengths=lengths, window=5,
+            impl=impl,
+        ), np.float32)
+        tol = 0.0 if impl == "gather" else 3e-6
+        assert np.max(np.abs(out - ref)) <= tol
+
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_int8_scale_pools(self, impl):
+        """Quantized pools ride as payload+scale pairs; the dequant is
+        decode_cache's exact formula, so the gather impl is bitwise the
+        dense int8 path (the kernel impl falls back to gather — it
+        takes fp pools only, by contract)."""
+        rng = np.random.default_rng(5)
+        q, kp, vp, tables, lengths = _pool_case(rng)
+        k8 = jnp.asarray(
+            rng.integers(-127, 128, size=kp.shape), jnp.int8
+        )
+        v8 = jnp.asarray(
+            rng.integers(-127, 128, size=vp.shape), jnp.int8
+        )
+        ks = jnp.asarray(
+            rng.uniform(0.01, 0.1, size=kp.shape[:3] + (1,)),
+            jnp.float32,
+        )
+        vs = jnp.asarray(
+            rng.uniform(0.01, 0.1, size=vp.shape[:3] + (1,)),
+            jnp.float32,
+        )
+        kd = (k8.astype(jnp.float32) * ks).astype(jnp.float32)
+        vd = (v8.astype(jnp.float32) * vs).astype(jnp.float32)
+        ref = np.asarray(
+            _dense_ref(q, kd, vd, tables, lengths), np.float32
+        )
+        out = np.asarray(paged_attention(
+            q,
+            PagedKVQuant(k8, ks, jnp.float32),
+            PagedKVQuant(v8, vs, jnp.float32),
+            page_tables=tables, lengths=lengths, impl=impl,
+        ), np.float32)
+        tol = 0.0 if impl in ("gather", "kernel") else 3e-6
+        assert np.max(np.abs(out - ref)) <= tol
+
+    def test_paged_write_placement_and_drop(self):
+        rng = np.random.default_rng(6)
+        ps, P1 = 4, 9
+        pool = jnp.zeros((P1, ps, 2, 3), jnp.float32)
+        tables = jnp.asarray(
+            np.arange(1, 9).reshape(4, 2), jnp.int32
+        )
+        new = jnp.asarray(rng.standard_normal((4, 2, 2, 3)), jnp.float32)
+        wp = jnp.asarray([0, 3, 30, 6], jnp.int32)
+        keep = jnp.asarray([True, True, False, True])
+        out = np.asarray(paged_write(pool, new, tables, wp, keep))
+        # row 0: positions 0,1 -> frame tables[0,0] slots 0,1
+        assert np.array_equal(out[1, 0], np.asarray(new[0, 0]))
+        assert np.array_equal(out[1, 1], np.asarray(new[0, 1]))
+        # row 1: positions 3,4 straddle the page boundary
+        assert np.array_equal(out[3, 3], np.asarray(new[1, 0]))
+        assert np.array_equal(out[4, 0], np.asarray(new[1, 1]))
+        # row 2 dropped entirely even though its position (30) clamps
+        # past its 2-page table — the mid-prefill-row contract (rows
+        # beyond the bucket are always keep=False); row 3 lands in its
+        # second page; null frame 0 never written
+        written = {(1, 0), (1, 1), (3, 3), (4, 0), (8, 2), (8, 3)}
+        for f in range(P1):
+            for s in range(ps):
+                if (f, s) not in written:
+                    assert np.abs(out[f, s]).sum() == 0.0, (f, s)
+
+    def test_validation(self):
+        rng = np.random.default_rng(7)
+        q, kp, vp, tables, lengths = _pool_case(rng)
+        with pytest.raises(ValueError, match="kv heads"):
+            paged_attention(
+                q[:, :, :3], kp, vp, page_tables=tables,
+                lengths=lengths,
+            )
+        with pytest.raises(ValueError, match="page_tables"):
+            paged_attention(
+                q, kp, vp, page_tables=tables[:2], lengths=lengths
+            )
+        with pytest.raises(ValueError, match="window"):
+            paged_attention(
+                q, kp, vp, page_tables=tables, lengths=lengths,
+                window=0,
+            )
+        with pytest.raises(ValueError, match="impl"):
+            set_paged_attention_impl("mosaic")
+
+
+# -- engine wiring ----------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def long_ctx():
+    """A tiny model whose position table allows a LONG max_len with
+    short live lengths — the regime paged attention exists for."""
+    cfg = GPT2Config(
+        vocab_size=97, n_positions=256, hidden_size=32, num_layers=2,
+        num_heads=2, dropout_rate=0.0,
+    )
+    model = GPT2LMHead(cfg)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+def _solo(model, params, req: Request):
+    out = np.asarray(generate(
+        model, params, jnp.asarray(req.prompt_ids[None]),
+        max_new_tokens=req.max_new_tokens,
+        temperature=req.temperature, top_k=req.top_k, top_p=req.top_p,
+        rng=jax.random.PRNGKey(req.seed), eos_id=req.eos_id,
+    ))[0, req.prompt_len:]
+    return [int(x) for x in out]
+
+
+def _workload(rng, n, p_rng=(3, 9), n_rng=(4, 12)):
+    return [
+        Request(
+            rng.integers(1, 97, size=int(
+                rng.integers(p_rng[0], p_rng[1] + 1)
+            )).astype(np.int32),
+            max_new_tokens=int(rng.integers(n_rng[0], n_rng[1] + 1)),
+            temperature=(0.0 if i % 2 else 0.8),
+            top_k=(None if i % 2 else 7), seed=i,
+        )
+        for i in range(n)
+    ]
+
+
+class TestPagedEngine:
+    def test_dense_vs_paged_ab_parity_and_bytes(self, long_ctx):
+        """Same seeded workload through decode_mode='dense' (the round
+        11 gather programs) and 'paged': identical token streams, and
+        the paged run's analytic decode HBM bytes/token shrink — the
+        gather tax is a recorded fact, removed."""
+        model, params = long_ctx
+        streams, engines = [], []
+        for mode in ("dense", "paged"):
+            rng = np.random.default_rng(11)
+            engine = ServeEngine(model, params, EngineConfig(
+                num_slots=4, max_len=128, prefill_chunk=4, page_size=8,
+                decode_mode=mode,
+            ))
+            hs = [engine.submit(r) for r in _workload(rng, 8)]
+            engine.run_until_drained()
+            assert all(
+                h.status is RequestStatus.COMPLETED for h in hs
+            )
+            streams.append([h.tokens for h in hs])
+            engines.append(engine)
+        assert streams[0] == streams[1]
+        dense_e, paged_e = engines
+        assert dense_e._decode_tokens == paged_e._decode_tokens > 0
+        # dense gathers [S, max_len] every tick; paged streams at most
+        # the live bucket — live lengths (< 24) sit in 2-4 of 16 pages
+        assert paged_e.decode_hbm_bytes < dense_e.decode_hbm_bytes / 3
+        assert paged_e.decode_gather_bytes < dense_e.decode_gather_bytes
+        assert (
+            paged_e.decode_hbm_bytes_per_token
+            < dense_e.decode_hbm_bytes_per_token / 3
+        )
+        # dense mode is exactly one program per kind
+        assert dense_e.decode_buckets == {dense_e.pool.max_pages}
+        assert dense_e.decode_compiles == 1
+
+    def test_int8_kv_cache_dense_vs_paged_ab_parity(self):
+        """kv_cache_quantize='int8' rides the paged path as payload +
+        scale pools (PagedKVQuant): the per-page dequant is
+        decode_cache's exact formula, so dense-mode and paged-mode
+        engines emit identical streams on the same int8 cache."""
+        cfg = GPT2Config(
+            vocab_size=97, n_positions=96, hidden_size=32,
+            num_layers=2, num_heads=2, dropout_rate=0.0,
+            kv_cache_quantize="int8",
+        )
+        model = GPT2LMHead(cfg)
+        params = model.init(
+            jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        streams = []
+        for mode in ("dense", "paged"):
+            rng = np.random.default_rng(17)
+            engine = ServeEngine(model, params, EngineConfig(
+                num_slots=2, max_len=64, prefill_chunk=4, page_size=8,
+                decode_mode=mode,
+            ))
+            hs = [engine.submit(r) for r in _workload(rng, 4)]
+            engine.run_until_drained()
+            assert all(
+                h.status is RequestStatus.COMPLETED for h in hs
+            )
+            streams.append([h.tokens for h in hs])
+        assert streams[0] == streams[1]
+
+    def test_slot_reuse_recompiles_at_most_once_per_bucket(
+        self, long_ctx
+    ):
+        """Lengths crossing page-bucket boundaries compile each bucket
+        once; a second wave re-occupying the same buckets (slot reuse)
+        compiles NOTHING new."""
+        model, params = long_ctx
+        engine = ServeEngine(model, params, EngineConfig(
+            num_slots=2, max_len=128, prefill_chunk=4, page_size=4,
+        ))
+        rng = np.random.default_rng(12)
+
+        def wave():
+            reqs = [
+                Request(
+                    rng.integers(1, 97, size=5).astype(np.int32),
+                    max_new_tokens=20,
+                ),
+                Request(
+                    rng.integers(1, 97, size=9).astype(np.int32),
+                    max_new_tokens=30,
+                ),
+            ]
+            hs = [engine.submit(r) for r in reqs]
+            engine.run_until_drained()
+            assert all(
+                h.status is RequestStatus.COMPLETED for h in hs
+            )
+            for r, h in zip(reqs, hs):
+                assert h.tokens == _solo(model, params, r)
+
+        wave()
+        # lengths reached ~39 -> buckets {2, 4, 8, 16} of 32 possible
+        assert len(engine.decode_buckets) >= 2
+        assert engine.decode_compiles == len(engine.decode_buckets)
+        compiles = (engine.decode_compiles, engine.prefill_compiles)
+        wave()  # slot reuse over the same length profile
+        assert (
+            engine.decode_compiles, engine.prefill_compiles
+        ) == compiles, "slot reuse recompiled an already-built bucket"
+        assert all(
+            v == 1 for v in engine._decode_bucket_compiles.values()
+        )
+        assert all(
+            v == 1 for v in engine._prefill_bucket_compiles.values()
+        )
+
+    def test_cow_shared_pages_attend_correctly_mid_share(
+        self, long_ctx
+    ):
+        """Two live requests decode over the SAME refcounted prompt
+        pages simultaneously — the paged stream reads shared (read-only)
+        frames for both rows, streams stay solo-exact, and the shared
+        frames' bytes never change while both attend them."""
+        from tests.test_serve_paged import _page_bytes
+
+        model, params = long_ctx
+        rng = np.random.default_rng(13)
+        sys_p = rng.integers(1, 97, size=16).astype(np.int32)
+
+        def mk(new, **kw):
+            return Request(
+                np.concatenate([
+                    sys_p, rng.integers(1, 97, size=3).astype(np.int32)
+                ]),
+                max_new_tokens=new, **kw,
+            )
+
+        engine = ServeEngine(model, params, EngineConfig(
+            num_slots=3, max_len=64, prefill_chunk=4, page_size=4,
+        ))
+        seed_req = mk(2)
+        hs = engine.submit(seed_req)
+        engine.run_until_drained()  # registers the 4-page system prefix
+        assert hs.status is RequestStatus.COMPLETED
+        r1, r2 = mk(12), mk(10, temperature=0.7, top_p=0.9, seed=5)
+        h1, h2 = engine.submit(r1), engine.submit(r2)
+        for _ in range(3):
+            engine.step()
+        # both rows live and decoding over the shared frames
+        assert h1.status is RequestStatus.DECODING
+        assert h2.status is RequestStatus.DECODING
+        shared = list(
+            engine.scheduler.by_slot[h1.slot]._lease.page_row[:4]
+        )
+        assert shared == list(
+            engine.scheduler.by_slot[h2.slot]._lease.page_row[:4]
+        )
+        before = _page_bytes(engine.pool, shared)
+        engine.run_until_drained()
+        assert h1.tokens == _solo(model, params, r1)
+        assert h2.tokens == _solo(model, params, r2)
+        assert _page_bytes(engine.pool, shared) == before
+        engine.pool.check_consistency()
+
+    def test_spec_paged_verify_long_context_parity(self, long_ctx):
+        """The [k+1] verify rides the paged primitive: greedy spec
+        streams stay bit-identical to solo generate at a long max_len
+        with multiple buckets occupied."""
+        model, params = long_ctx
+        dcfg = GPT2Config(
+            vocab_size=97, n_positions=256, hidden_size=16,
+            num_layers=1, num_heads=2, dropout_rate=0.0,
+        )
+        dmodel = GPT2LMHead(dcfg)
+        dparams = dmodel.init(
+            jax.random.key(1), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        engine = ServeEngine(
+            model, params,
+            EngineConfig(num_slots=2, max_len=128, prefill_chunk=4,
+                         page_size=4),
+            spec=SpecConfig(dmodel, dparams, num_draft_tokens=3),
+        )
+        rng = np.random.default_rng(14)
+        reqs = [
+            Request(rng.integers(1, 97, size=6).astype(np.int32),
+                    max_new_tokens=24),
+            Request(rng.integers(1, 97, size=10).astype(np.int32),
+                    max_new_tokens=18),
+        ]
+        hs = [engine.submit(r) for r in reqs]
+        engine.run_until_drained()
+        for r, h in zip(reqs, hs):
+            assert h.status is RequestStatus.COMPLETED
+            assert h.tokens == _solo(model, params, r)
+        assert engine.spec_verifies > 0
+        assert len(engine.decode_buckets) >= 2
+        assert engine.decode_compiles == len(engine.decode_buckets)
+        engine.pool.check_consistency()
+        engine.draft_pool.check_consistency()
+
+    def test_precompile_buckets_is_state_neutral(self, long_ctx):
+        """precompile_decode_buckets compiles every bucket via no-op
+        dispatches: device rows and the pool stay bitwise intact."""
+        model, params = long_ctx
+        engine = ServeEngine(model, params, EngineConfig(
+            num_slots=2, max_len=64, prefill_chunk=4, page_size=8,
+        ))
+        rng = np.random.default_rng(15)
+        r = Request(rng.integers(1, 97, size=5).astype(np.int32),
+                    max_new_tokens=4)
+        h = engine.submit(r)
+        engine.run_until_drained()
+        before = (
+            np.asarray(engine._toks).copy(),
+            np.asarray(engine._lengths).copy(),
+            np.asarray(engine._keys).copy(),
+            [np.asarray(x).copy() for x in
+             jax.tree_util.tree_leaves(engine.pool.cache)
+             if x.ndim >= 2],
+        )
+        engine.precompile_decode_buckets()
+        assert engine.decode_compiles == len(engine._buckets)
+        assert np.array_equal(before[0], np.asarray(engine._toks))
+        assert np.array_equal(before[1], np.asarray(engine._lengths))
+        assert np.array_equal(before[2], np.asarray(engine._keys))
+        after = [
+            np.asarray(x) for x in
+            jax.tree_util.tree_leaves(engine.pool.cache) if x.ndim >= 2
+        ]
+        for a, b in zip(before[3], after):
+            assert np.array_equal(a, b)
+        # ...and a request decoded afterwards is still solo-exact
+        r2 = Request(rng.integers(1, 97, size=4).astype(np.int32),
+                     max_new_tokens=5)
+        h2 = engine.submit(r2)
+        engine.run_until_drained()
+        assert h2.tokens == _solo(model, params, r2)
+        assert h.status is RequestStatus.COMPLETED
+
+    def test_counters_ride_armed_tracing_only(self, long_ctx):
+        """serve.decode_gather_bytes / decode_hbm_bytes_per_token land
+        on an armed tracer's counter track and in snapshot gauges."""
+        model, params = long_ctx
+        rng = np.random.default_rng(16)
+        with tracing.enabled() as t:
+            engine = ServeEngine(model, params, EngineConfig(
+                num_slots=2, max_len=64, prefill_chunk=4, page_size=8,
+                telemetry_every=2,
+            ))
+            hs = [engine.submit(r) for r in _workload(rng, 3)]
+            engine.run_until_drained()
+        assert all(h.status is RequestStatus.COMPLETED for h in hs)
+        names = {
+            e["name"] for e in t._events if e.get("ph") == "C"
+        }
+        assert "serve.decode_gather_bytes" in names
+        assert "serve.decode_hbm_bytes_per_token" in names
+        assert engine.decode_hbm_bytes_per_token > 0
+        # the default CPU impl ("gather") still pays a bucketed dense
+        # slab; the counter records it honestly
+        assert engine.decode_gather_bytes > 0
+
+    def test_auto_page_size_warns_once_on_odd_max_len(self, caplog):
+        reset_page_size_warnings()
+        ns = logging.getLogger("pytorch_distributed_tpu")
+        ns.addHandler(caplog.handler)
+        try:
+            with caplog.at_level(
+                logging.WARNING, logger="pytorch_distributed_tpu"
+            ):
+                assert auto_page_size(63) == 1
+                assert auto_page_size(63) == 1  # deduped
+                assert auto_page_size(64) == 32  # healthy: silent
+        finally:
+            ns.removeHandler(caplog.handler)
+        warns = [
+            r for r in caplog.records
+            if "1-token pages" in r.getMessage()
+        ]
+        assert len(warns) == 1
+        reset_page_size_warnings()

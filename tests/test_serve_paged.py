@@ -10,7 +10,9 @@ Contracts under test, on top of test_serve.py's parity suite:
 * prefix sharing changes memory and compute, never tokens: a request
   admitted onto shared pages emits exactly its solo ``generate`` stream;
 * the bounded-compile-count invariant holds with pages AND speculation:
-  one prefill program, one tick program, for any workload mix;
+  one prefill program and one tick program per OCCUPIED length bucket
+  (round 12's static bucket widths), each compiled exactly once, for
+  any workload mix;
 * greedy speculative output is BIT-IDENTICAL to solo generate (the
   verify accepts exactly the target's own argmax chain), sampled rows
   are deterministic given seeds, and mid-speculation eviction /
@@ -82,6 +84,23 @@ def _solo(model, params, req: Request):
     return toks
 
 
+def _assert_bucketed_compiles(engine):
+    """Round-12 bounded-compile contract: one program per OCCUPIED
+    length bucket, each compiled exactly once, at most
+    log2(max_pages) + 1 buckets per program kind."""
+    assert engine.decode_compiles == len(engine.decode_buckets)
+    assert engine.prefill_compiles == len(engine.prefill_buckets)
+    cap = len(engine._buckets)
+    assert 1 <= len(engine.decode_buckets) <= cap
+    assert 1 <= len(engine.prefill_buckets) <= cap
+    assert all(
+        v == 1 for v in engine._decode_bucket_compiles.values()
+    )
+    assert all(
+        v == 1 for v in engine._prefill_bucket_compiles.values()
+    )
+
+
 def _page_bytes(pool, pages):
     """Concatenated bytes of the given page frames across every
     KV-payload leaf — the read-only checksum for CoW tests."""
@@ -147,7 +166,7 @@ def test_prefix_sharing_is_copy_free_and_exact(gpt2):
     assert engine.pool.shared_tokens == 12
     # copy-on-write discipline: the shared pages were never written
     assert _page_bytes(engine.pool, shared_pages) == before
-    assert engine.decode_compiles == 1 and engine.prefill_compiles == 1
+    _assert_bucketed_compiles(engine)
     engine.pool.check_consistency()
 
 
@@ -301,10 +320,9 @@ def test_spec_greedy_parity_mixed_workload(gpt2, draft):
         assert h.status is RequestStatus.COMPLETED, h
         assert h.tokens == _solo(model, params, r), r.request_id
     # bounded compile count with pages + speculation: one prefill
-    # program (target+draft fused), one tick program (draft scan +
-    # verify fused) — exactly two device programs beyond admit, ever
-    assert engine.prefill_compiles == 1
-    assert engine.decode_compiles == 1
+    # program (target+draft fused) and one tick program (draft scan +
+    # verify fused) per OCCUPIED length bucket, each compiled once
+    _assert_bucketed_compiles(engine)
     assert engine.spec_verifies > 0
     assert 0 <= engine.spec_accepted <= engine.spec_drafted
     engine.pool.check_consistency()
@@ -510,7 +528,8 @@ def test_snapshot_gauges_flow_through_writer(gpt2, draft, tmp_path):
     last = snaps[-1]
     for key in ("pages_in_use", "pages_total", "page_occupancy",
                 "prefix_hit_rate", "spec_verifies", "spec_drafted",
-                "spec_accepted"):
+                "spec_accepted", "decode_gather_bytes",
+                "decode_hbm_bytes_per_token"):
         assert key in last, key
     assert last["pages_total"] == engine.pool.num_pages
     # the last snapshot precedes any ticks after its cadence boundary
@@ -531,6 +550,7 @@ def test_snapshot_gauges_flow_through_writer(gpt2, draft, tmp_path):
     assert "== Serving ==" in text
     assert "kv pool: peak" in text and "prefix hit rate" in text
     assert "speculation:" in text and "accepted" in text
+    assert "decode HBM:" in text and "bytes/token" in text
 
 
 def test_prefix_shared_requests_builder():
