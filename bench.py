@@ -1383,6 +1383,166 @@ def bench_observability() -> None:
     )
 
 
+def _elastic_downtime(metrics_path: str) -> float:
+    """Wall-clock downtime off the engine's progress records: the widest
+    gap between consecutive NEW-HIGH step commits. Steps normally land
+    every ~step_delay; a membership event opens one wide gap — and
+    replayed steps (post-restore re-commits of old step numbers) are not
+    new highs, so the die-and-restore baseline is charged for its replay
+    window exactly as it should be."""
+    highs = []
+    best = -1
+    with open(metrics_path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # a killed writer tears at most the last line
+            if rec.get("split") != "progress":
+                continue
+            if rec["step"] > best:
+                best = rec["step"]
+                highs.append(rec["t"])
+    if len(highs) < 2:
+        raise RuntimeError(f"too few progress records in {metrics_path}")
+    return max(b - a for a, b in zip(highs, highs[1:]))
+
+
+def bench_elastic() -> None:
+    """In-process elastic resize vs the die-and-restore baseline.
+
+    Two drills on the multi-process CPU ring, identical workers and
+    identical victim (one rank SIGKILLed at a fixed step boundary via
+    the ``elastic.peer_lost`` fault site), differing ONLY in recovery
+    policy: ``resize`` re-meshes the survivors in place
+    (train/elastic_world.py), ``exit`` kills the world and a
+    mini-ElasticAgent restarts it from the last checkpoint (torchrun's
+    shape). Downtime is measured the same way for both — the widest gap
+    in new-high step commits — and output correctness is enforced
+    in-phase: every finishing world must land bit-identical to the
+    unresized reference, so the ratio can never come from wrong math.
+    """
+    import shutil
+    import tempfile
+
+    from pytorch_distributed_tpu.launch import ElasticWorldLauncher
+    from pytorch_distributed_tpu.train.elastic_world import (
+        ElasticConfig,
+        reference_run,
+    )
+
+    base = tempfile.mkdtemp(prefix="bench_elastic_")
+    total_steps, kill_after, world = 24, 8, 3
+    step_delay, ring_timeout = 0.1, 2.5
+    ref = reference_run(ElasticConfig(total_steps=total_steps))
+
+    def common_args(mode: str, ckpt: str, metrics: str):
+        return (
+            "--total-steps", str(total_steps),
+            "--ckpt-dir", ckpt, "--ckpt-every", "6",
+            "--step-delay-s", str(step_delay),
+            "--ring-timeout-s", str(ring_timeout),
+            "--on-peer-loss", mode,
+            "--metrics-path", metrics,
+        )
+
+    victim_env = {
+        "PTD_FAULTS": f"elastic.peer_lost:mode=kill,after={kill_after}"
+    }
+    ids = [f"w{i}" for i in range(world)]
+
+    # -- in-process resize -------------------------------------------------
+    inproc_metrics = os.path.join(base, "inproc.jsonl")
+    launcher = ElasticWorldLauncher(
+        os.path.join(base, "rdv_inproc"),
+        worker_args=common_args(
+            "resize", os.path.join(base, "ckpt_inproc"), inproc_metrics
+        ),
+    )
+    launcher.start_world(ids, env_overrides={ids[-1]: victim_env})
+    codes = launcher.wait(180)
+    results = launcher.results()
+    survivors = ids[:-1]
+    for wid in survivors:
+        if codes.get(wid) != 0:
+            raise RuntimeError(f"in-process survivor {wid} rc={codes}")
+        if results[wid]["params_crc"] != ref["params_crc"]:
+            raise RuntimeError(
+                f"in-process resize diverged from reference: {wid}"
+            )
+        if results[wid]["final_step"] != total_steps:
+            raise RuntimeError(f"{wid} stopped early: {results[wid]}")
+    resize_s = max(
+        r["resize_s"]
+        for wid in survivors for r in results[wid]["resizes"]
+    )
+    goodput = results[survivors[0]]["goodput"]
+    bucket_sum = sum(
+        v for k, v in goodput.items()
+        if k.endswith("_s") and k != "wall_s"
+    )
+    if abs(bucket_sum - goodput["wall_s"]) > 0.05 * goodput["wall_s"]:
+        raise RuntimeError(f"goodput buckets do not sum to wall: {goodput}")
+    inproc_downtime = _elastic_downtime(inproc_metrics)
+
+    # -- die-and-restore baseline -----------------------------------------
+    restart_metrics = os.path.join(base, "restart.jsonl")
+    rdv_restart = os.path.join(base, "rdv_restart")
+    restart_args = common_args(
+        "exit", os.path.join(base, "ckpt_restart"), restart_metrics
+    )
+    launcher2 = ElasticWorldLauncher(rdv_restart, worker_args=restart_args)
+    launcher2.start_world(ids, env_overrides={ids[-1]: victim_env})
+    launcher2.wait(180)  # every worker exits (victim killed, peers 75)
+    # the mini elastic agent: re-rendezvous the FULL world from disk
+    launcher3 = ElasticWorldLauncher(rdv_restart, worker_args=restart_args)
+    launcher3.start_world(ids)
+    codes3 = launcher3.wait(180)
+    results3 = launcher3.results()
+    for wid in ids:
+        if codes3.get(wid) != 0:
+            raise RuntimeError(f"restart attempt failed: {codes3}")
+        if results3[wid]["params_crc"] != ref["params_crc"]:
+            raise RuntimeError(
+                f"die-and-restore diverged from reference: {wid}"
+            )
+    restart_downtime = _elastic_downtime(restart_metrics)
+
+    ratio = inproc_downtime / restart_downtime
+    _emit({
+        "metric": "elastic_resize_downtime_s",
+        "value": round(inproc_downtime, 3),
+        "unit": (
+            f"s from last pre-loss step to the next NEW step, {world}-proc"
+            f" CPU ring, 1 rank SIGKILLed, ring deadline {ring_timeout}s"
+        ),
+        "vs_baseline": None,
+        "resize_goodput_s": round(resize_s, 3),
+        "detection_bound_s": ring_timeout,
+    })
+    _emit({
+        "metric": "elastic_vs_restart_ratio",
+        "value": round(ratio, 4),
+        "unit": (
+            "in-process resize downtime / die-and-restore downtime "
+            "(same workers, same victim, same detection deadline; both "
+            "verified bit-identical to the unresized reference)"
+        ),
+        "vs_baseline": None,
+        "restart_downtime_s": round(restart_downtime, 3),
+    })
+    print(
+        f"# elastic: in-process {inproc_downtime:.2f}s vs restart "
+        f"{restart_downtime:.2f}s ({ratio:.2f}x)", file=sys.stderr,
+    )
+    if ratio >= 1.0:
+        raise RuntimeError(
+            f"in-process resize ({inproc_downtime:.2f}s) did not beat "
+            f"die-and-restore ({restart_downtime:.2f}s)"
+        )
+    shutil.rmtree(base, ignore_errors=True)
+
+
 def bench_planning() -> None:
     """Auto-parallel planner wall time over the reference config sweep.
 
@@ -1890,6 +2050,9 @@ def main():
         run_if_budget("observability", bench_observability)
         # planner wall time is host arithmetic — meaningful anywhere
         run_if_budget("planning", bench_planning)
+        # elastic resize vs die-and-restore is a host-process mechanics
+        # ratio over the multi-process CPU ring — meaningful anywhere
+        run_if_budget("elastic", bench_elastic)
     else:
         bench_resnet50(on_tpu)
         run_if_budget("input_pipeline", bench_input_pipeline, on_tpu)
@@ -1913,6 +2076,7 @@ def main():
         )
         run_if_budget("observability", bench_observability)
         run_if_budget("planning", bench_planning)
+        run_if_budget("elastic", bench_elastic)
     # the per-phase wall clocks as DATA (the stderr "# phase ... done"
     # notes were print-only): one record the driver's BENCH tail and
     # test_bench_contract can both parse

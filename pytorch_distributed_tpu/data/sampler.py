@@ -6,24 +6,88 @@ dataset sharding mechanism, BASELINE.json:5): a permutation seeded by
 replica sees the same number of samples, then strided across replicas.
 Determinism is the contract: same (seed, epoch, world) -> same indices,
 so preempted runs resume on identical data order.
+
+Every sampler also carries a **cursor** (``state_dict()`` /
+``load_state_dict()``: epoch + intra-epoch offset) so a resumed — or
+elastically *resized* (``train/elastic_world.py``) — run replays from
+the exact batch, not the epoch boundary. The cursor counts items the
+sampler has YIELDED in the current epoch; ``load_state_dict`` arms a
+one-shot skip on the next iteration, after which iteration semantics
+are exactly what they always were (a fresh ``__iter__`` without a
+loaded cursor starts at 0, so existing same-epoch determinism holds).
 """
 
 from __future__ import annotations
 
 import math
-from typing import Iterator, Optional
+from typing import Dict, Iterator, Optional
 
 import numpy as np
 
 from pytorch_distributed_tpu.runtime import device as _device
 
 
-class DistributedSampler:
+class _CursorMixin:
+    """epoch + intra-epoch offset cursor, shared by every sampler here.
+
+    ``_cursor_offset`` tracks items yielded by the CURRENT epoch's most
+    recent iterator; ``_cursor_skip`` is the one-shot fast-forward armed
+    by :meth:`load_state_dict`. Subclasses route their ``__iter__``
+    output through :meth:`_cursored`.
+    """
+
+    epoch: int
+    _cursor_offset: int = 0
+    _cursor_skip: int = 0
+
+    def state_dict(self) -> Dict[str, int]:
+        """Cursor reproducing the NEXT item this sampler would yield."""
+        return {"epoch": int(self.epoch),
+                "offset": int(self._cursor_offset)}
+
+    def load_state_dict(self, state: Dict[str, int]) -> None:
+        """Arm a one-shot resume: the next ``__iter__`` yields epoch
+        ``state['epoch']``'s sequence starting at item ``offset``."""
+        offset = int(state["offset"])
+        if offset < 0:
+            raise ValueError(f"cursor offset must be >= 0, got {offset}")
+        self.set_epoch(int(state["epoch"]))
+        self._cursor_skip = offset
+        self._cursor_offset = offset
+
+    def _reset_cursor(self) -> None:
+        self._cursor_offset = 0
+        self._cursor_skip = 0
+
+    def _cursored(self, items) -> Iterator:
+        """Apply the armed skip, then track the yield position.
+
+        The skip consumption and offset rebase happen EAGERLY (at
+        ``iter()`` time, not first ``next()``), so ``state_dict()``
+        between the two reads the new iterator's position.
+        """
+        skip, self._cursor_skip = self._cursor_skip, 0
+        self._cursor_offset = skip
+        return self._cursor_iter(items, skip)
+
+    def _cursor_iter(self, items, skip: int) -> Iterator:
+        for i, item in enumerate(items):
+            if i < skip:
+                continue
+            self._cursor_offset += 1
+            yield item
+        # a completed epoch rewinds the cursor: the next fresh __iter__
+        # (same epoch or after set_epoch) starts at 0 as it always did
+        self._cursor_offset = 0
+
+
+class DistributedSampler(_CursorMixin):
     """Per-replica index iterator, torch-shaped.
 
     In single-controller SPMD the natural "replica" is the *host* (each
     host feeds its slice of the global batch), so ``num_replicas`` defaults
-    to the process count — not the chip count.
+    to the process count — not the chip count. The cursor offset counts
+    per-replica SAMPLES yielded this epoch.
     """
 
     def __init__(
@@ -69,6 +133,7 @@ class DistributedSampler:
     def set_epoch(self, epoch: int) -> None:
         """Reseed the shuffle for a new epoch (same contract as torch)."""
         self.epoch = epoch
+        self._reset_cursor()
 
     def _global_indices(self) -> np.ndarray:
         if self.shuffle:
@@ -86,19 +151,24 @@ class DistributedSampler:
         return idx
 
     def __iter__(self) -> Iterator[int]:
-        return iter(self._global_indices()[self.rank :: self.num_replicas].tolist())
+        return self._cursored(
+            self._global_indices()[self.rank :: self.num_replicas].tolist()
+        )
 
     def __len__(self) -> int:
         return self.num_samples
 
 
-class GlobalBatchSampler:
+class GlobalBatchSampler(_CursorMixin):
     """Yields whole global batches of indices — the SPMD-native sampler.
 
     One of these per training run replaces world-size many per-rank
     samplers: the loader materializes the full global batch and the
     sharding split happens at ``device_put``. Keeps the reference's
-    epoch/seed/drop_last semantics so data order is reproducible.
+    epoch/seed/drop_last semantics so data order is reproducible. The
+    cursor offset counts BATCHES yielded this epoch — the global order
+    is world-size-independent by construction, which is what lets an
+    elastically resized run replay the exact stream.
     """
 
     def __init__(
@@ -120,6 +190,7 @@ class GlobalBatchSampler:
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
+        self._reset_cursor()
 
     def __iter__(self) -> Iterator[np.ndarray]:
         if self.shuffle:
@@ -127,7 +198,9 @@ class GlobalBatchSampler:
             idx = g.permutation(self.dataset_len)
         else:
             idx = np.arange(self.dataset_len)
-        yield from _iter_global_batches(idx, self.batch_size, self.drop_last)
+        return self._cursored(
+            _iter_global_batches(idx, self.batch_size, self.drop_last)
+        )
 
     def __len__(self) -> int:
         if self.drop_last:
@@ -154,7 +227,7 @@ def _iter_global_batches(
         yield np.concatenate([tail, pad])
 
 
-class WeightedRandomSampler:
+class WeightedRandomSampler(_CursorMixin):
     """``torch.utils.data.WeightedRandomSampler``, global-batch shaped.
 
     Draws ``num_samples`` indices per epoch with probability proportional
@@ -199,6 +272,7 @@ class WeightedRandomSampler:
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
+        self._reset_cursor()
 
     def __iter__(self) -> Iterator[np.ndarray]:
         g = np.random.default_rng(self.seed + self.epoch)
@@ -206,7 +280,9 @@ class WeightedRandomSampler:
             len(self.p), size=self.num_samples, replace=self.replacement,
             p=self.p,
         ).astype(np.int64)
-        yield from _iter_global_batches(idx, self.batch_size, self.drop_last)
+        return self._cursored(
+            _iter_global_batches(idx, self.batch_size, self.drop_last)
+        )
 
     def __len__(self) -> int:
         if self.drop_last:

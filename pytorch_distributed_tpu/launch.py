@@ -14,6 +14,10 @@ ONE process drives every local chip — so the launcher's three jobs map to:
   *host* runs one controller process; ``jax.distributed.initialize`` is
   the rendezvous (the NCCL TCP-store equivalent). Accepts both JAX-style
   and torchrun-style (MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE) env.
+* ``ElasticWorldLauncher``      — supervisor for the IN-PROCESS elastic
+  path (``train/elastic_world.py``): starts a genesis world and can add
+  joiners mid-run; unlike ``ElasticAgent`` it never restarts anything —
+  membership changes are handled by the workers re-meshing in place.
 
 CLI: ``python -m pytorch_distributed_tpu.run --nproc-per-node 4 script.py``.
 """
@@ -248,6 +252,109 @@ class ElasticAgent:
                     file=sys.stderr,
                 )
         return code
+
+
+@dataclass
+class ElasticWorldLauncher:
+    """Launch / supervise ``train/elastic_world.py`` worker processes.
+
+    The torchrun-agent counterpart for the IN-PROCESS elastic path: it
+    starts the genesis world and can ``add_worker`` (the grow drill) —
+    but unlike :class:`ElasticAgent` it never tears the group down on a
+    failure; membership changes are the workers' own business. One
+    launcher = one rendezvous dir. Shared by ``scripts/chaos_drill.py
+    --drill resize``, bench.py's ``elastic`` phase, and the tests.
+    """
+
+    rendezvous_dir: str
+    worker_args: Sequence[str] = ()  # engine CLI flags, minus identity
+    python: Optional[str] = None
+
+    def __post_init__(self):
+        os.makedirs(self.rendezvous_dir, exist_ok=True)
+        self.procs: dict = {}
+
+    def _cmd(self, worker_id: str, extra: Sequence[str]) -> list:
+        return [
+            self.python or sys.executable, "-m",
+            "pytorch_distributed_tpu.train.elastic_world",
+            "--rendezvous-dir", self.rendezvous_dir,
+            "--worker-id", worker_id,
+            *self.worker_args, *extra,
+        ]
+
+    def start_world(self, worker_ids: Sequence[str],
+                    env_overrides: Optional[dict] = None) -> None:
+        """Genesis: every worker gets ``--expected-world len(ids)``.
+
+        ``env_overrides`` maps worker_id -> extra env (the drill arms
+        one worker's ``PTD_FAULTS`` here to pick the deterministic
+        victim)."""
+        for wid in worker_ids:
+            self.launch_worker(
+                wid, extra=("--expected-world", str(len(worker_ids))),
+                env=(env_overrides or {}).get(wid),
+            )
+
+    def add_worker(self, worker_id: str,
+                   env: Optional[dict] = None) -> None:
+        """The grow path: a fresh process joins the live world."""
+        self.launch_worker(worker_id, extra=("--join",), env=env)
+
+    def launch_worker(self, worker_id: str, *, extra: Sequence[str] = (),
+                      env: Optional[dict] = None) -> None:
+        worker_env = dict(os.environ)
+        # workers never touch the (single, shared) TPU
+        worker_env["JAX_PLATFORMS"] = "cpu"
+        worker_env["PALLAS_AXON_POOL_IPS"] = ""
+        worker_env.pop("XLA_FLAGS", None)
+        # the -m target must resolve regardless of the caller's cwd
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        prev = worker_env.get("PYTHONPATH")
+        worker_env["PYTHONPATH"] = (
+            repo_root if not prev else repo_root + os.pathsep + prev
+        )
+        worker_env.update(env or {})
+        self.procs[worker_id] = subprocess.Popen(
+            self._cmd(worker_id, extra), env=worker_env,
+            stdout=sys.stderr, stderr=subprocess.STDOUT,
+        )
+
+    def wait(self, timeout_s: float = 180.0) -> dict:
+        """Join every worker; returns worker_id -> exit code."""
+        deadline = time.monotonic() + timeout_s
+        codes = {}
+        try:
+            for wid, p in self.procs.items():
+                left = max(0.1, deadline - time.monotonic())
+                try:
+                    codes[wid] = p.wait(timeout=left)
+                except subprocess.TimeoutExpired:
+                    codes[wid] = None
+        finally:
+            for p in self.procs.values():
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        return codes
+
+    def results(self) -> dict:
+        """worker_id -> parsed result-<id>.json (absent workers omitted)."""
+        import json
+
+        out = {}
+        for wid in self.procs:
+            path = os.path.join(
+                self.rendezvous_dir, f"result-{wid}.json"
+            )
+            try:
+                with open(path) as f:
+                    out[wid] = json.load(f)
+            except (OSError, ValueError):
+                pass
+        return out
 
 
 def init_multihost(

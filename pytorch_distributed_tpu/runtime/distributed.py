@@ -205,6 +205,68 @@ def _ensure_multihost_init() -> None:
         _MULTIHOST_DONE = True
 
 
+def rebuild_process_group(
+    *,
+    ring=None,
+    mesh_spec: Optional[_mesh.MeshSpec] = None,
+    world_size: Optional[int] = None,
+) -> ProcessGroup:
+    """Re-mesh the world IN PROCESS — the elastic resize path.
+
+    Where ``destroy_process_group`` + ``init_process_group`` is the
+    die-and-restore shape (everything rebuilt from scratch), this swaps
+    only what a membership change invalidates and keeps the process —
+    its jit caches, host state, and page cache — alive:
+
+    * ``ring=...`` (hostring backend): adopt an already-committed epoch
+      ring from :class:`runtime.membership.WorldMembership` — the old
+      ring is closed, open subgroups (which indexed the OLD rank space)
+      are closed, and the rank-local 1-device mesh is kept.
+    * ``mesh_spec``/``world_size`` (single-controller SPMD): rebuild the
+      mesh over the surviving device set via :func:`runtime.mesh.remesh`
+      (e.g. a pod slice shrank); callers then re-place state through the
+      Strategy / checkpoint machinery.
+
+    Raises unless a group already exists — rebuilding nothing is a
+    caller bug, not a bootstrap path.
+    """
+    global _GROUP
+    if _GROUP is None:
+        raise RuntimeError(
+            "rebuild_process_group needs a live group; call "
+            "init_process_group first"
+        )
+    for sub in _SUBGROUPS:  # subgroup ranks indexed the old world
+        sub.close()
+    _SUBGROUPS.clear()
+    _collective.cache_clear()
+    if ring is not None:
+        if _GROUP.ring is not None and _GROUP.ring is not ring:
+            _GROUP.ring.close()
+        _GROUP = ProcessGroup(
+            mesh=_GROUP.mesh, backend="hostring", ring=ring,
+            ring_name=getattr(ring, "name", None),
+        )
+        return _GROUP
+    if _GROUP.ring is not None:
+        raise ValueError(
+            "hostring groups rebuild around a committed membership "
+            "ring; pass ring=..."
+        )
+    devices = list(_GROUP.mesh.devices.flat)
+    if world_size is not None:
+        if world_size > len(devices):
+            raise ValueError(
+                f"world_size {world_size} > {len(devices)} devices in "
+                "the current mesh — a grown device set needs a fresh "
+                "init_process_group"
+            )
+        devices = devices[:world_size]
+    mesh = _mesh.remesh(mesh_spec, devices=devices)
+    _GROUP = ProcessGroup(mesh=mesh, backend=_GROUP.backend)
+    return _GROUP
+
+
 def multiprocess_ring():
     """The HostRingGroup when running one-process-per-rank, else None.
 

@@ -63,6 +63,16 @@ Known sites (grep for ``faults.check`` to find the exact spots):
 ``serve.decode``     per request per decode tick, before its sampled
                      token is accepted — same evict-and-continue
                      contract (``match=<request_id>`` poisons one)
+``elastic.peer_lost`` at every elastic-world step boundary
+                     (``train/elastic_world.py``) — ``mode=kill`` makes
+                     THIS worker the lost peer at a deterministic step
+                     (``after=N``), the drill's injected departure
+``elastic.resize``   inside the resize path, after peer loss is
+                     detected but before the new view commits — a kill
+                     here proves resize-during-resize convergence
+``elastic.rejoin``   at the top of ``WorldMembership.join`` — a kill
+                     here is a joiner that announced and vanished; the
+                     incumbents must burn the epoch and re-settle
 ================== ====================================================
 """
 
@@ -103,6 +113,9 @@ KNOWN_SITES = (
     "step.nan",
     "serve.prefill",
     "serve.decode",
+    "elastic.peer_lost",
+    "elastic.resize",
+    "elastic.rejoin",
 )
 _MODES = ("raise", "kill", "truncate", "bitflip")
 

@@ -288,6 +288,11 @@ class HostRingGroup:
         )
         _check(rc, "init")
         self._h = handle
+        #: the group's segment name as given (pre-shm mangling): the
+        #: teardown side (``unlink_segment``) and the elastic membership
+        #: layer (which reaps a dead peer's never-finalized segment on
+        #: re-rendezvous) key off it
+        self.name = name
         self.rank = rank
         self.world_size = world_size
         self.timeout_s = timeout_s

@@ -1,0 +1,436 @@
+"""World membership for elastic training: epoch-numbered views over the
+host ring.
+
+The hostring backend (``runtime/hostring.py``) gives a FIXED world: N
+processes rendezvous once and a lost rank poisons every later collective
+until the group deadline. Production fleets lose and gain hosts mid-run
+(ROADMAP item 5), so this module adds the missing layer: a *membership*
+protocol that turns "some process died / a new process wants in" into an
+agreed, epoch-numbered world view — without restarting the surviving
+processes.
+
+Design (single-host, matching the shm transport underneath):
+
+* **The rendezvous channel is a directory.** Every live member keeps one
+  ``member-<worker_id>.json`` record (worker id, pid, and its *bid* — the
+  view epoch it wants next). Writing that record IS the join endpoint: a
+  new process announces itself by dropping its record; incumbents notice
+  at their next step-boundary :meth:`poll_change`. Liveness is the pid
+  (``os.kill(pid, 0)``): a SIGKILLed member's record reads as dead and is
+  garbage-collected by whoever re-rendezvouses next.
+* **Peer loss rides the existing group deadline.** A member that dies
+  mid-step leaves its peers blocked in a collective; the ring's compiled
+  deadline fires (``rc=-110``/``-5``) and the caller routes the error
+  into :meth:`next_view`. There is no extra failure detector to keep
+  honest — the thing that would have hung IS the detector.
+* **Every view change is decided at a collective barrier.** Candidates
+  settle on a member set + epoch through the filesystem (max-bid wins, so
+  epoch counters can never diverge), then rendezvous a FRESH ring whose
+  shm name encodes ``(epoch, world, member-set hash)``. Only processes
+  that computed the *identical* view can attach the same segment — a
+  disagreeing minority targets a different name, times out, and retries
+  at the next epoch — and the commit is the ring's own init barrier plus
+  a digest allgather + barrier on the new ring. All ranks issue the same
+  collectives unconditionally: PTD001-clean by construction.
+* **Epochs are monotonic and agreed.** Rank 0 of a committed view writes
+  ``view-<epoch>.json`` (the audit trail ``obs_report`` renders); the
+  next change starts from ``max(committed, all live bids) + 0/1``, so a
+  joiner that read a stale epoch is pulled forward by the incumbents'
+  bids and vice versa.
+
+Honest limits: pid liveness can alias a recycled pid to a dead member
+(bounded by the settle window; acceptable on the drill scale), and the
+filesystem channel assumes all members share one host — the multi-host
+version of this protocol would put the same records on the coordinator's
+KV store. Both are documented in DESIGN.md §18.
+
+This module deliberately imports no jax (same contract as hostring.py):
+spawned elastic workers must be able to rendezvous without dragging in a
+TPU runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+import zlib
+from typing import List, Optional, Tuple
+
+from pytorch_distributed_tpu.runtime import faults, tracing
+from pytorch_distributed_tpu.runtime.hostring import (
+    HostRingGroup,
+    unlink_segment,
+)
+from pytorch_distributed_tpu.utils.logging import get_logger
+
+import numpy as np
+
+logger = get_logger(__name__)
+
+_MEMBER_PREFIX = "member-"
+_VIEW_PREFIX = "view-"
+
+
+class MembershipError(RuntimeError):
+    """A view change could not be committed within its deadline."""
+
+
+@dataclasses.dataclass(frozen=True)
+class WorldView:
+    """One agreed world: epoch number + sorted member ids + my rank."""
+
+    epoch: int
+    members: Tuple[str, ...]
+    rank: int
+
+    @property
+    def world_size(self) -> int:
+        return len(self.members)
+
+    def describe(self) -> str:
+        return (
+            f"epoch {self.epoch}: world {self.world_size} "
+            f"{list(self.members)} (rank {self.rank})"
+        )
+
+
+def _view_digest(epoch: int, members: Tuple[str, ...]) -> int:
+    """Commit digest of a proposed view — embedded in the ring name (so
+    only identical proposals can share a segment) and cross-checked by
+    allgather after init (belt and braces)."""
+    blob = f"{epoch}|{len(members)}|{'|'.join(members)}".encode()
+    return zlib.crc32(blob)
+
+
+class WorldMembership:
+    """One process's membership in an elastic world.
+
+    Lifecycle::
+
+        m = WorldMembership(rendezvous_dir, worker_id="w0")
+        view, ring = m.establish(world_size=4)   # genesis, or
+        view, ring = m.join()                    # late joiner
+        ...
+        if m.poll_change():                      # step boundary
+            view, ring = m.next_view()           # resize
+        ...
+        m.leave()                                # clean exit
+
+    ``ring`` is a plain :class:`HostRingGroup` over the view's members
+    (ranks = sorted-member index); every view change replaces it.
+    """
+
+    def __init__(
+        self,
+        rendezvous_dir: str,
+        worker_id: str,
+        *,
+        ring_timeout_s: float = 10.0,
+        rendezvous_timeout_s: float = 60.0,
+        settle_s: float = 0.2,
+        poll_s: float = 0.02,
+    ):
+        if "/" in worker_id or not worker_id:
+            raise ValueError(f"bad worker_id {worker_id!r}")
+        self.dir = os.path.abspath(rendezvous_dir)
+        os.makedirs(self.dir, exist_ok=True)
+        self.worker_id = worker_id
+        self.ring_timeout_s = float(ring_timeout_s)
+        self.rendezvous_timeout_s = float(rendezvous_timeout_s)
+        # ONE timeout governs both the rendezvous attach wait and the
+        # committed ring's collectives: the native deadline is compiled
+        # at hr_init and cannot be tightened afterwards. Size it for
+        # peer-loss detection latency (drills use 2-3 s).
+        self.settle_s = float(settle_s)
+        self.poll_s = float(poll_s)
+        # shared shm prefix: every process pointing at this rendezvous
+        # dir derives the same one
+        self._prefix = f"ptdm_{zlib.crc32(self.dir.encode()):08x}"
+        self.view: Optional[WorldView] = None
+        self.ring: Optional[HostRingGroup] = None
+        self._bid = 0  # the epoch this process wants next
+
+    # -- the rendezvous channel (files) ------------------------------------
+    def _member_path(self, worker_id: str) -> str:
+        return os.path.join(self.dir, _MEMBER_PREFIX + worker_id + ".json")
+
+    def _write_member(self) -> None:
+        rec = {
+            "worker_id": self.worker_id,
+            "pid": os.getpid(),
+            "bid": self._bid,
+        }
+        tmp = self._member_path(self.worker_id) + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, self._member_path(self.worker_id))
+
+    def announce(self, bid: Optional[int] = None) -> None:
+        """Publish (or refresh) this process's member record."""
+        if bid is not None and bid > self._bid:
+            self._bid = bid
+        self._write_member()
+
+    def _read_members(self) -> List[dict]:
+        """All live member records; dead-pid records are unlinked."""
+        out = []
+        try:
+            names = sorted(os.listdir(self.dir))
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith(_MEMBER_PREFIX)
+                    and name.endswith(".json")):
+                continue
+            path = os.path.join(self.dir, name)
+            try:
+                with open(path) as f:
+                    rec = json.load(f)
+                pid = int(rec["pid"])
+                wid = str(rec["worker_id"])
+                int(rec["bid"])
+            except (OSError, ValueError, TypeError, KeyError):
+                continue  # torn write: the writer will replace it
+            if not _pid_alive(pid):
+                # the garbage collection of the protocol: any member may
+                # reap a dead peer's record (peer loss becomes visible to
+                # poll_change even before a collective deadline fires)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            out.append(rec)
+        return out
+
+    def last_committed_epoch(self) -> int:
+        best = 0
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return 0
+        for name in names:
+            if name.startswith(_VIEW_PREFIX) and name.endswith(".json"):
+                try:
+                    best = max(best, int(name[len(_VIEW_PREFIX):-5]))
+                except ValueError:
+                    continue
+        return best
+
+    def _write_view_record(self, view: WorldView) -> None:
+        rec = {
+            "epoch": view.epoch,
+            "members": list(view.members),
+            "world_size": view.world_size,
+            "committed_unix_s": time.time(),
+        }
+        path = os.path.join(self.dir, f"{_VIEW_PREFIX}{view.epoch}.json")
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
+
+    # -- change detection --------------------------------------------------
+    def poll_change(self) -> bool:
+        """Step-boundary check: does the live candidate set differ from
+        the committed view (a join request, or a peer whose pid died)?"""
+        if self.view is None:
+            return False
+        recs = self._read_members()
+        cands = tuple(sorted(r["worker_id"] for r in recs))
+        if cands != self.view.members:
+            return True
+        # a peer bidding PAST the committed epoch is mid-resize (e.g. it
+        # detected something this process has not seen yet) — follow it
+        return any(int(r["bid"]) > self.view.epoch for r in recs)
+
+    # -- view changes ------------------------------------------------------
+    def establish(
+        self, world_size: Optional[int] = None
+    ) -> Tuple[WorldView, HostRingGroup]:
+        """Genesis (or post-restart) rendezvous. ``world_size`` blocks
+        until that many candidates have announced — the launcher's
+        "everyone arrives before step 0" contract."""
+        self.announce(bid=max(self._bid, self.last_committed_epoch() + 1))
+        if world_size is not None:
+            deadline = time.monotonic() + self.rendezvous_timeout_s
+            while len(self._read_members()) < world_size:
+                if time.monotonic() > deadline:
+                    raise MembershipError(
+                        f"only {len(self._read_members())} of "
+                        f"{world_size} members announced within "
+                        f"{self.rendezvous_timeout_s:.0f}s"
+                    )
+                time.sleep(self.poll_s)
+        return self.next_view()
+
+    def join(self) -> Tuple[WorldView, HostRingGroup]:
+        """Late join: announce on the rendezvous channel and wait for the
+        incumbents' next view to include this process."""
+        faults.check("elastic.rejoin")
+        self.announce(bid=max(self._bid, self.last_committed_epoch() + 1))
+        return self.next_view()
+
+    def next_view(self) -> Tuple[WorldView, HostRingGroup]:
+        """Drive one membership change to a committed view.
+
+        Closes the current ring (its epoch is over either way), settles
+        the candidate set + epoch through the rendezvous dir, and commits
+        the new view at a collective barrier on the fresh epoch ring.
+        """
+        if self.ring is not None:
+            old_name = self.ring.name
+            self.ring.close()
+            self.ring = None
+            unlink_segment(old_name)  # a dead peer never finalized
+        self._bid = max(self._bid, self.last_committed_epoch() + 1)
+        if self.view is not None:
+            self._bid = max(self._bid, self.view.epoch + 1)
+        self._write_member()  # peers must SEE the bumped bid to follow
+        deadline = time.monotonic() + self.rendezvous_timeout_s
+        while True:
+            if time.monotonic() > deadline:
+                raise MembershipError(
+                    f"{self.worker_id}: no view committed within "
+                    f"{self.rendezvous_timeout_s:.0f}s (last bid "
+                    f"{self._bid})"
+                )
+            members, epoch = self._settle()
+            rank = members.index(self.worker_id)
+            digest = _view_digest(epoch, members)
+            name = f"{self._prefix}_e{epoch}_{digest:08x}"
+            try:
+                ring = HostRingGroup(
+                    name, rank, len(members),
+                    timeout_s=self.ring_timeout_s,
+                )
+            except RuntimeError:
+                # some candidate never arrived (it saw a different view,
+                # or died between settle and init) — burn the epoch
+                unlink_segment(name)
+                self._bid += 1
+                self._write_member()
+                continue
+            try:
+                committed = self._commit(ring, epoch, members, digest)
+            except RuntimeError:
+                committed = False
+            if not committed:
+                ring.close()
+                unlink_segment(name)
+                self._bid += 1
+                self._write_member()
+                continue
+            view = WorldView(epoch=epoch, members=members, rank=rank)
+            self.view, self.ring, self._bid = view, ring, epoch
+            self._write_member()
+            if rank == 0:
+                self._write_view_record(view)
+            logger.info("membership committed %s", view.describe())
+            if tracing._tracer is not None:
+                tracing.instant(
+                    "elastic.view", epoch=epoch, world=len(members)
+                )
+                tracing.counter("elastic.world_size", len(members))
+            return view, ring
+
+    def _settle(self) -> Tuple[Tuple[str, ...], int]:
+        """Wait until the live candidate set and the epoch bid are stable
+        for ``settle_s``; returns (sorted members, agreed epoch)."""
+        deadline = time.monotonic() + self.rendezvous_timeout_s
+        stable_since = None
+        last = None
+        while True:
+            if time.monotonic() > deadline:
+                raise MembershipError(
+                    f"{self.worker_id}: candidate set never settled"
+                )
+            recs = self._read_members()
+            top = max([self._bid] + [int(r["bid"]) for r in recs])
+            if top > self._bid:
+                self._bid = top
+                self._write_member()
+            cands = tuple(sorted(r["worker_id"] for r in recs))
+            if self.worker_id not in cands:
+                # our record was reaped (or never landed) — re-announce
+                self._write_member()
+                stable_since, last = None, None
+                time.sleep(self.poll_s)
+                continue
+            agreed = all(int(r["bid"]) == top for r in recs)
+            snapshot = (cands, top)
+            if agreed and snapshot == last:
+                if stable_since is None:
+                    stable_since = time.monotonic()
+                elif time.monotonic() - stable_since >= self.settle_s:
+                    return cands, top
+            else:
+                stable_since = None
+                last = snapshot
+            time.sleep(self.poll_s)
+
+    def _commit(
+        self,
+        ring: HostRingGroup,
+        epoch: int,
+        members: Tuple[str, ...],
+        digest: int,
+    ) -> bool:
+        """The view-change collective barrier: every member allgathers the
+        proposal digest and barriers on the fresh ring. All ranks issue
+        the identical collective sequence — no rank-dependent branches."""
+        mine = np.array([digest, epoch, len(members)], np.int64)
+        rows = ring.all_gather(mine)
+        ring.barrier()
+        return bool(np.all(rows == rows[0]))
+
+    def leave(self) -> None:
+        """Clean exit: drop the member record so the survivors' next
+        poll sees the departure without waiting for a collective
+        deadline. The ring handle is closed but its segment is left for
+        the survivors' next_view teardown."""
+        try:
+            os.unlink(self._member_path(self.worker_id))
+        except OSError:
+            pass
+        if self.ring is not None:
+            self.ring.close()
+            self.ring = None
+        self.view = None
+
+    def __enter__(self) -> "WorldMembership":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.leave()
+
+
+def _pid_alive(pid: int) -> bool:
+    """Is ``pid`` a live (non-zombie) process?
+
+    ``os.kill(pid, 0)`` alone is wrong here: a SIGKILLed worker stays a
+    ZOMBIE until its launcher reaps it, and kill(0) reports zombies as
+    alive — the survivors' candidate set would never settle. /proc's
+    stat state field distinguishes them (this backend is Linux-only shm
+    already); kill(0) is the fallback when /proc is unreadable.
+    """
+    if pid <= 0:
+        return False
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read()
+        # state is the first field after the parenthesized comm (which
+        # may itself contain spaces/parens — split on the LAST ')')
+        state = stat.rsplit(b")", 1)[1].split()[0]
+        return state not in (b"Z", b"X")
+    except (OSError, IndexError):
+        pass
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:  # pragma: no cover - someone else's pid
+        return True
+    return True

@@ -116,6 +116,24 @@ def make_mesh(
     return mesh
 
 
+def remesh(
+    spec: MeshSpec | None = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Rebuild the process-wide mesh in place — the re-mesh half of the
+    elastic resize path (``runtime/distributed.rebuild_process_group``).
+
+    Unlike tearing down the whole process group, this replaces ONLY the
+    mesh: jitted functions, live arrays, and the rest of process state
+    survive; callables compiled against the OLD mesh keep working on it
+    (meshes are immutable), while new compilations pick up the new
+    shape. Callers re-placing state onto the new mesh do so through the
+    ordinary Strategy.place / checkpoint-restore machinery.
+    """
+    return make_mesh(spec, devices=devices, set_current=True)
+
+
 def set_current_mesh(mesh: Optional[Mesh]) -> None:
     global _CURRENT_MESH
     _CURRENT_MESH = mesh

@@ -73,8 +73,13 @@ from pytorch_distributed_tpu.utils.timing import percentile
 
 logger = get_logger(__name__)
 
-#: goodput bucket names every summary reports (extra buckets are kept too)
-GOODPUT_BUCKETS = ("productive", "stalled", "recovering", "checkpoint")
+#: goodput bucket names every summary reports (extra buckets are kept too).
+#: ``resize`` is the in-process elastic window (train/elastic_world.py):
+#: peer-loss detection -> membership re-rendezvous -> in-memory re-shard —
+#: distinct from ``recovering`` (restore + replay), so the resize cost is
+#: a priced fact the bench's ``elastic`` phase compares against restart.
+GOODPUT_BUCKETS = ("productive", "stalled", "recovering", "checkpoint",
+                   "resize")
 
 
 class _NullSpan:

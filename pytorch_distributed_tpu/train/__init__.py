@@ -45,6 +45,7 @@ from pytorch_distributed_tpu.train.checkpoint import (
 )
 from pytorch_distributed_tpu.train.elastic import (
     EX_TEMPFAIL,
+    PeerLost,
     Preempted,
     PreemptionHandler,
     Watchdog,
@@ -77,6 +78,7 @@ __all__ = [
     "restore_checkpoint",
     "checkpoint_exists",
     "EX_TEMPFAIL",
+    "PeerLost",
     "Preempted",
     "PreemptionHandler",
     "Watchdog",

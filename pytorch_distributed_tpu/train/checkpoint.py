@@ -323,6 +323,46 @@ def prune_checkpoints(ckpt_dir: str, *, keep: int) -> List[str]:
     return removed
 
 
+_SAMPLER_CURSOR = "sampler_cursor.json"
+
+
+def save_sampler_cursor(
+    ckpt_dir: str, *, step: int, epoch: int, offset: int
+) -> str:
+    """Persist the data-stream cursor next to the checkpoints.
+
+    ``epoch`` + ``offset`` name the exact batch the run would consume
+    next (the sampler ``state_dict`` convention, data/sampler.py), and
+    ``step`` binds the cursor to the train step it was written at — a
+    resume only trusts a cursor whose step matches the checkpoint it
+    restored (an older cursor would replay the wrong batches). Written
+    atomically; one file, newest-wins, matching ``best_metric.json``'s
+    lifecycle."""
+    path = os.path.join(ckpt_dir, _SAMPLER_CURSOR)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(
+            {"step": int(step), "epoch": int(epoch),
+             "offset": int(offset)}, f,
+        )
+    os.replace(tmp, path)
+    return path
+
+
+def load_sampler_cursor(ckpt_dir: str) -> Optional[dict]:
+    """The persisted data cursor, or None when absent/unreadable."""
+    try:
+        with open(os.path.join(ckpt_dir, _SAMPLER_CURSOR)) as f:
+            rec = json.load(f)
+        return {
+            "step": int(rec["step"]),
+            "epoch": int(rec["epoch"]),
+            "offset": int(rec["offset"]),
+        }
+    except (OSError, ValueError, TypeError, KeyError):
+        return None
+
+
 def resolve_tag(ckpt_dir: str, tag: str = "latest") -> Optional[str]:
     """The tag to restore. An explicitly-requested absent tag resolves to
     None — silently substituting a different checkpoint for a named

@@ -19,6 +19,14 @@ so the TPU-native subsystem is:
 
 ``EX_TEMPFAIL`` (75) is the conventional "retry me" exit code recipes use
 after a preemption checkpoint.
+
+Round 13 adds the third leg: **in-process elasticity**. Where the
+``ElasticAgent`` path answers membership changes by killing and
+restarting the whole world, ``train/elastic_world.py`` +
+``runtime/membership.py`` re-mesh the surviving processes in place —
+:class:`PeerLost` below is the boundary between the two policies (the
+die-and-restore baseline raises it; the in-process engine absorbs the
+failure and resizes instead).
 """
 
 from __future__ import annotations
@@ -43,6 +51,24 @@ class Preempted(RuntimeError):
     def __init__(self, step: int, message: str = ""):
         super().__init__(message or f"preempted at step {step}")
         self.step = step
+
+
+class PeerLost(RuntimeError):
+    """A world member died (group deadline / membership poll).
+
+    Two recovery policies exist:
+
+    * ``train/elastic_world.py`` (the in-process path, ROADMAP item 5):
+      the engine catches the underlying collective failure itself,
+      re-meshes via ``runtime/membership.py``, re-shards state in
+      memory, and keeps training — this exception never escapes.
+    * the die-and-restore baseline (``on_peer_loss="exit"``): the engine
+      raises PeerLost, the worker exits ``EX_TEMPFAIL``, and a
+      supervising :class:`~pytorch_distributed_tpu.launch.ElasticAgent`
+      (or the bench's mini-supervisor) restarts the whole world from the
+      last checkpoint — torchrun's recovery shape, kept as the measured
+      comparison point.
+    """
 
 
 class PreemptionHandler:

@@ -1,0 +1,1086 @@
+"""In-process elastic training: resize the world without restarting it.
+
+The reference stack gets elasticity from torchrun's agent: kill every
+worker, re-rendezvous, restore from the last checkpoint (this repo's
+``launch.ElasticAgent`` reproduces exactly that). This module is the
+TPU-native alternative ROADMAP item 5 asks for: when membership changes,
+the surviving processes *re-mesh in place* — quiesce at a step boundary,
+commit a new world view (``runtime/membership.py``), re-shard state
+through in-memory transfers over the fresh ring, and resume the data
+stream bit-exactly from the sampler cursor. The processes, their page
+caches, and their warmed state all survive; only the ring is rebuilt.
+
+The headline invariant (proven by ``scripts/chaos_drill.py --drill
+resize`` and pinned by the bench ``elastic`` phase) is *bit-exactness
+across any resize history*: after N steps, surviving ranks' params are
+bit-identical to an unresized reference world trained on the same global
+data order. Three design choices make that provable rather than hoped:
+
+* **World-size-invariant gradient math.** The global batch is split into
+  a FIXED number of virtual microshards (``ElasticConfig.microshards``,
+  independent of the world size); each rank computes per-microshard
+  gradient SUMS for the shards it currently owns (``shard % world ==
+  rank``), the shards are allgathered, and every rank reduces them in
+  microshard order 0..S-1 before dividing by the global batch. The same
+  samples hit the same per-shard kernels and the same summation order at
+  ANY world size, so the update is bitwise identical to the reference —
+  the standard ring allreduce could not promise that (its reduction
+  order depends on the rank count). This trades ``(n-1)/n`` reduce
+  bandwidth for gather bandwidth; honest cost accounting in DESIGN §18.
+* **ZeRO-style owner updates with replicated shards.** Params are
+  replicated (every rank needs them for the forward anyway); optimizer
+  state (momentum) is sharded by leaf with a replication factor
+  (default 2: leaf i lives on ranks ``i % w`` and ``(i+1) % w`` — the
+  cross-replica sharding shape of arxiv 2004.13336). Owners compute the
+  update for their leaves and broadcast the new params; a single lost
+  rank therefore never holds a sole copy, and the resize re-gathers only
+  the shards each survivor NOW owns — zero disk traffic on the happy
+  path.
+* **Deterministic replay from the cursor.** When a lost rank DID hold
+  sole copies (``replication=1``, or a double loss), the world falls
+  back to the last on-disk checkpoint — and then *replays* the lost
+  steps from the sampler cursor. Replay is the same deterministic math,
+  so even the fallback converges to the bit-exact state; the replayed
+  window is priced as ``recovering`` in the goodput account, the resize
+  window as the new ``resize`` bucket.
+
+Everything here is numpy (no jax): elastic workers spawn in ~1 s, the
+math is trivially deterministic, and the subsystem's claims are about
+membership/re-shard/replay mechanics — which are backend-agnostic — not
+about model throughput. Checkpoints are written in the standard manifest
+-v2 + COMMIT format (``train/checkpoint.py``), so ``verify_checkpoint``
+and the drill's integrity audit apply unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import math
+import os
+import pickle
+import shutil
+import sys
+import time
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pytorch_distributed_tpu.data.sampler import GlobalBatchSampler
+from pytorch_distributed_tpu.runtime import faults, tracing
+from pytorch_distributed_tpu.runtime.membership import (
+    MembershipError,
+    WorldMembership,
+    WorldView,
+)
+from pytorch_distributed_tpu.train.elastic import EX_TEMPFAIL, PeerLost
+from pytorch_distributed_tpu.utils.integrity import (
+    PREFERRED_ALGO,
+    checksum_file,
+)
+from pytorch_distributed_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+# --------------------------------------------------------------------------
+# The deterministic task: a small numpy MLP regression. Gradients are
+# computed as per-microshard SUMS so the cross-world summation order is
+# fixed by the engine, not by the world size.
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TaskConfig:
+    features: int = 16
+    hidden: int = 32
+    outputs: int = 4
+    dataset_len: int = 256
+    seed: int = 0
+
+    def digest(self) -> int:
+        blob = json.dumps(dataclasses.asdict(self), sort_keys=True)
+        return zlib.crc32(blob.encode())
+
+
+def init_task_params(task: TaskConfig) -> Dict[str, np.ndarray]:
+    """Deterministic init — every genesis member computes the same."""
+    g = np.random.default_rng(task.seed)
+    return {
+        "b1": np.zeros(task.hidden, np.float32),
+        "b2": np.zeros(task.outputs, np.float32),
+        "w1": (g.normal(size=(task.features, task.hidden)) * 0.3).astype(
+            np.float32
+        ),
+        "w2": (g.normal(size=(task.hidden, task.outputs)) * 0.3).astype(
+            np.float32
+        ),
+    }
+
+
+def task_data(task: TaskConfig) -> Tuple[np.ndarray, np.ndarray]:
+    """The synthetic dataset, derived from the seed alone — every member
+    (joiners included) materializes the identical arrays."""
+    g = np.random.default_rng(task.seed + 0x5EED)
+    x = g.normal(size=(task.dataset_len, task.features)).astype(np.float32)
+    w_true = g.normal(size=(task.features, task.outputs)).astype(np.float32)
+    y = np.tanh(x @ w_true).astype(np.float32)
+    return x, y
+
+
+def grad_sums(
+    params: Dict[str, np.ndarray], x: np.ndarray, y: np.ndarray
+) -> Tuple[Dict[str, np.ndarray], float]:
+    """Hand backprop of ``sum((pred - y)^2)`` over one microshard.
+
+    Returns gradient SUMS (not means): the engine divides once by the
+    global batch after the fixed-order reduction, so the math cannot
+    depend on how many ranks contributed.
+    """
+    h = x @ params["w1"] + params["b1"]
+    z = np.tanh(h)
+    pred = z @ params["w2"] + params["b2"]
+    r = (pred - y).astype(np.float32)
+    loss = float(np.sum(r * r, dtype=np.float32))
+    dp = 2.0 * r
+    gw2 = z.T @ dp
+    gb2 = dp.sum(axis=0)
+    dz = dp @ params["w2"].T
+    dh = dz * (1.0 - z * z)
+    gw1 = x.T @ dh
+    gb1 = dh.sum(axis=0)
+    return (
+        {
+            "b1": gb1.astype(np.float32),
+            "b2": gb2.astype(np.float32),
+            "w1": gw1.astype(np.float32),
+            "w2": gw2.astype(np.float32),
+        },
+        loss,
+    )
+
+
+# --------------------------------------------------------------------------
+# Shard ownership: which ranks hold which optimizer-state leaves.
+# --------------------------------------------------------------------------
+
+
+def leaf_owners(leaf_idx: int, world: int, replication: int) -> Tuple[int, ...]:
+    """Owner ranks of optimizer-state leaf ``leaf_idx``: ``replication``
+    consecutive ranks starting at ``leaf_idx % world``. With the default
+    replication of 2 no single rank ever holds a sole copy, so any
+    single loss re-shards purely in memory."""
+    r = max(1, min(int(replication), int(world)))
+    start = leaf_idx % world
+    return tuple(sorted({(start + j) % world for j in range(r)}))
+
+
+# --------------------------------------------------------------------------
+# Host checkpoints: the standard manifest-v2 + COMMIT format, written and
+# read without jax so elastic workers stay light. verify_checkpoint /
+# restore_candidates in train/checkpoint.py accept these unchanged.
+# --------------------------------------------------------------------------
+
+_MANIFEST = "manifest.json"
+_COMMIT = "COMMIT"
+
+
+def save_host_checkpoint(
+    ckpt_dir: str,
+    leaves: Dict[str, np.ndarray],
+    step: int,
+    tag: str = "latest",
+) -> str:
+    """Atomic single-process checkpoint of flat host arrays, in the same
+    on-disk format as ``train/checkpoint.save_checkpoint`` (manifest v2,
+    per-shard CRC, COMMIT marker, tmp+swing) — ``verify_checkpoint``
+    applies to it unchanged, which is how the resize drill audits its
+    fallback basis."""
+    final = os.path.join(ckpt_dir, tag)
+    tmp = final + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp, exist_ok=True)
+    entries = []
+    for i, name in enumerate(sorted(leaves)):
+        arr = np.ascontiguousarray(leaves[name])
+        fname = f"{i:05d}_{name[:72]}.p0s0.npy"
+        path = os.path.join(tmp, fname)
+        np.save(path, arr)
+        value, nbytes = checksum_file(path)
+        shard = {
+            "file": fname,
+            "start": [0] * arr.ndim,
+            "stop": list(arr.shape),
+            "bytes": nbytes,
+        }
+        if value is not None:
+            shard["checksum"] = value
+            shard["checksum_algo"] = PREFERRED_ALGO
+        faults.check("ckpt.write_shard", path=path)
+        entries.append(
+            {
+                "path": name,
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+                "shards": [shard],
+            }
+        )
+    manifest_path = os.path.join(tmp, _MANIFEST)
+    with open(manifest_path, "w") as f:
+        json.dump({"version": 2, "step": int(step), "leaves": entries}, f,
+                  indent=1)
+    value, nbytes = checksum_file(manifest_path)
+    commit = {"step": int(step), "manifest_bytes": nbytes}
+    if value is not None:
+        commit["manifest_checksum"] = value
+        commit["checksum_algo"] = PREFERRED_ALGO
+    with open(os.path.join(tmp, _COMMIT), "w") as f:
+        json.dump(commit, f)
+    # the swing, same semantics as checkpoint._swing
+    old = final + ".old"
+    if os.path.exists(old):
+        shutil.rmtree(old)
+    if os.path.exists(final):
+        os.replace(final, old)
+    faults.check("ckpt.swing", path=final)
+    os.replace(tmp, final)
+    if os.path.exists(old):
+        shutil.rmtree(old)
+    return final
+
+
+def load_host_checkpoint(
+    ckpt_dir: str, tag: str = "latest"
+) -> Tuple[Dict[str, np.ndarray], int]:
+    """Read a (host-written or single-process) checkpoint back as flat
+    arrays, newest shard layout only — the jax-free counterpart of
+    ``restore_checkpoint`` the disk-fallback path uses."""
+    final = os.path.join(ckpt_dir, tag)
+    with open(os.path.join(final, _MANIFEST)) as f:
+        manifest = json.load(f)
+    out: Dict[str, np.ndarray] = {}
+    for entry in manifest["leaves"]:
+        shards = entry["shards"]
+        if len(shards) != 1:
+            raise ValueError(
+                f"leaf {entry['path']!r} has {len(shards)} shards — the "
+                "host loader reads single-shard checkpoints only"
+            )
+        if faults.active():  # armed-only arg evaluation (PTD002)
+            faults.check(
+                "ckpt.read_shard",
+                path=os.path.join(final, shards[0]["file"]),
+            )
+        out[entry["path"]] = np.load(
+            os.path.join(final, shards[0]["file"])
+        )
+    return out, int(manifest["step"])
+
+
+def host_checkpoint_exists(ckpt_dir: Optional[str], tag: str = "latest") -> bool:
+    return bool(ckpt_dir) and os.path.isfile(
+        os.path.join(ckpt_dir, tag, _MANIFEST)
+    )
+
+
+# --------------------------------------------------------------------------
+# The engine.
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ElasticConfig:
+    total_steps: int = 24
+    global_batch: int = 16
+    microshards: int = 4  # FIXED virtual shard count — the world-size-
+    # invariance anchor; must divide global_batch
+    lr: float = 0.05
+    momentum: float = 0.9
+    replication: int = 2  # optimizer-shard copies; 1 = every loss is a
+    # sole-copy loss and exercises the disk fallback
+    ckpt_dir: Optional[str] = None
+    ckpt_every: int = 8  # steps between checkpoints (0 = genesis +
+    # run-completion saves only; every run ends by refreshing 'latest')
+    data_seed: int = 0
+    task: TaskConfig = dataclasses.field(default_factory=TaskConfig)
+    on_peer_loss: str = "resize"  # "resize" (in-process) | "exit" (the
+    # die-and-restore baseline: raise PeerLost, worker exits EX_TEMPFAIL)
+    metrics_path: Optional[str] = None  # JSONL stream (rank 0 writes)
+    max_resize_attempts: int = 6
+    step_delay_s: float = 0.0  # synthetic per-step compute: the tiny MLP
+    # steps in ~1 ms, far faster than any real model — drills/benches set
+    # this so membership events land MID-run and downtime is measured
+    # against a realistic step cadence, not a degenerate one
+
+    def __post_init__(self):
+        if self.global_batch % self.microshards:
+            raise ValueError(
+                f"global_batch {self.global_batch} must divide into "
+                f"microshards {self.microshards}"
+            )
+        if self.on_peer_loss not in ("resize", "exit"):
+            raise ValueError(
+                f"on_peer_loss must be 'resize' or 'exit', got "
+                f"{self.on_peer_loss!r}"
+            )
+
+
+class _Jsonl:
+    """Append-only JSONL writer speaking the MetricsWriter record shape
+    (``step`` + ``split`` + payload) without importing the jax-backed
+    metrics module; one flushed line per record so a SIGKILLed worker
+    tears at most the final line (``read_metrics`` tolerates that)."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = open(path, "a")
+
+    def write(self, step: int, payload: dict, split: str = "train") -> None:
+        rec = {"step": int(step), "split": split, "t": time.time()}
+        rec.update(payload)
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def params_crc(leaves: Dict[str, np.ndarray]) -> int:
+    """Order-fixed digest of a flat leaf dict — the drill's bit-exactness
+    verdict compares these across ranks and against the reference."""
+    crc = 0
+    for name in sorted(leaves):
+        crc = zlib.crc32(name.encode(), crc)
+        crc = zlib.crc32(np.ascontiguousarray(leaves[name]).tobytes(), crc)
+    return crc
+
+
+class ElasticWorldEngine:
+    """Train over an elastic membership; resize in-process on change.
+
+    ``membership=None`` runs the engine solo (world 1, no ring) — the
+    unresized reference world the drill compares against, and the unit-
+    test entry point.
+    """
+
+    def __init__(
+        self,
+        cfg: ElasticConfig,
+        membership: Optional[WorldMembership] = None,
+        *,
+        expected_world: Optional[int] = None,
+        join: bool = False,
+    ):
+        self.cfg = cfg
+        self.membership = membership
+        self._expected_world = expected_world
+        self._join = join
+        self.goodput = tracing.GoodputAccount()
+        self.view: Optional[WorldView] = None
+        self.ring = None
+        self.params: Dict[str, np.ndarray] = {}
+        self.momentum: Dict[str, np.ndarray] = {}
+        self.step = 0
+        self._replay_until = 0
+        self._has_state = False
+        self.resizes: List[dict] = []
+        self.views: List[dict] = []
+        self._task_x, self._task_y = task_data(cfg.task)
+        self._leaf_names = sorted(init_task_params(cfg.task))
+        self._leaf_shapes = {
+            k: v.shape for k, v in init_task_params(cfg.task).items()
+        }
+        self._sampler = GlobalBatchSampler(
+            cfg.task.dataset_len, cfg.global_batch, shuffle=True,
+            seed=cfg.data_seed, drop_last=True,
+        )
+        self._data_epoch = 0
+        self._batch_iter = None
+        self._pending: Optional[np.ndarray] = None
+        self._pending_cursor: Optional[dict] = None
+        self._writer: Optional[_Jsonl] = None
+        self.losses: List[float] = []
+
+    # -- world plumbing ----------------------------------------------------
+    @property
+    def world_size(self) -> int:
+        return 1 if self.view is None else self.view.world_size
+
+    @property
+    def rank(self) -> int:
+        return 0 if self.view is None else self.view.rank
+
+    def _note_view(self) -> None:
+        v = self.view
+        self.views.append(
+            {"epoch": v.epoch if v else 1,
+             "world_size": self.world_size,
+             "step": self.step}
+        )
+
+    def _open_writer(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        if self.cfg.metrics_path and self.rank == 0:
+            self._writer = _Jsonl(self.cfg.metrics_path)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self.membership is None:
+            self.view, self.ring = None, None
+            self._genesis_or_restore()
+            self._note_view()
+            self._open_writer()
+            return
+        if self._join:
+            self.view, self.ring = self.membership.join()
+        else:
+            self.view, self.ring = self.membership.establish(
+                world_size=self._expected_world
+            )
+        self._sync_after_view()
+        self._note_view()
+        self._open_writer()
+
+    def run(self) -> dict:
+        """Drive to ``total_steps``; returns the result summary."""
+        t0 = time.monotonic()
+        if not self._has_state:
+            self.start()
+        while self.step < self.cfg.total_steps:
+            # the drill's deterministic departure point: mode=kill here
+            # makes THIS worker the lost peer at an exact step boundary
+            faults.check("elastic.peer_lost")
+            if self.membership is not None and self.membership.poll_change():
+                if self.cfg.on_peer_loss == "exit":
+                    # the die-and-restore baseline is a STATIC world:
+                    # any membership change — poll-detected or not — is
+                    # fatal, exactly like a torchrun agent's teardown
+                    raise PeerLost(
+                        f"membership changed at step {self.step}"
+                    )
+                self._resize("membership-change")
+                continue
+            try:
+                self._one_step()
+            except MembershipError:
+                raise
+            except RuntimeError as e:
+                if self.cfg.on_peer_loss == "exit":
+                    raise PeerLost(
+                        f"collective failed at step {self.step}: {e}"
+                    ) from e
+                self._resize(f"collective-failure: {type(e).__name__}")
+        if self.ring is not None:
+            self.ring.barrier()  # drain: everyone reached total_steps
+        self._maybe_checkpoint()
+        summary = self.goodput.summary()
+        if self._writer is not None:
+            self._writer.write(
+                self.step, {"event": "goodput", **summary},
+                split="goodput",
+            )
+        result = {
+            "worker_id": (
+                self.membership.worker_id if self.membership else "solo"
+            ),
+            "final_step": self.step,
+            "params_crc": params_crc(self.params),
+            "loss": self.losses[-1] if self.losses else None,
+            "views": self.views,
+            "resizes": self.resizes,
+            "goodput": summary,
+            "wall_s": time.monotonic() - t0,
+            "ok": True,
+        }
+        return result
+
+    # -- data cursor -------------------------------------------------------
+    def _current_batch(self) -> np.ndarray:
+        """The step's global batch indices; cached (with the cursor that
+        reproduces it) until the step commits, so a failed step replays
+        the identical batch after the resize."""
+        while self._pending is None:
+            if self._batch_iter is None:
+                self._pending_cursor = None
+                self._batch_iter = iter(self._sampler)
+            cursor = self._sampler.state_dict()
+            try:
+                self._pending = next(self._batch_iter)
+                self._pending_cursor = cursor
+            except StopIteration:
+                self._data_epoch += 1
+                self._sampler.set_epoch(self._data_epoch)
+                self._batch_iter = None
+        return self._pending
+
+    def _commit_batch(self) -> None:
+        self._pending = None
+
+    def _restore_cursor(self, cursor: dict, data_epoch: int) -> None:
+        self._data_epoch = int(data_epoch)
+        self._sampler.set_epoch(self._data_epoch)
+        self._sampler.load_state_dict(cursor)
+        self._batch_iter = None
+        self._pending = None
+        self._pending_cursor = None
+
+    def _cursor_state(self) -> Tuple[dict, int]:
+        """(sampler cursor, data epoch) reproducing the NEXT batch: the
+        pending batch's own cursor while one is in flight, else the live
+        sampler position."""
+        if self._pending is not None and self._pending_cursor is not None:
+            return dict(self._pending_cursor), self._data_epoch
+        return self._sampler.state_dict(), self._data_epoch
+
+    # -- the step ----------------------------------------------------------
+    def _one_step(self) -> None:
+        cfg = self.cfg
+        bucket = (
+            "recovering" if self.step < self._replay_until else "productive"
+        )
+        t0 = time.perf_counter()
+        with tracing.span("elastic.step"):
+            if cfg.step_delay_s:
+                time.sleep(cfg.step_delay_s)  # the stand-in compute
+            idx = self._current_batch()
+            w, rank = self.world_size, self.rank
+            S = cfg.microshards
+            msz = cfg.global_batch // S
+            dims = self._flat_dim()
+            owned = list(range(rank, S, w))
+            k = math.ceil(S / w)
+            local = np.zeros((k, dims + 1), np.float32)
+            x, y = self._task_x[idx], self._task_y[idx]
+            for j, s in enumerate(owned):
+                sl = slice(s * msz, (s + 1) * msz)
+                g, loss = grad_sums(self.params, x[sl], y[sl])
+                local[j, :dims] = self._flatten(g)
+                local[j, dims] = loss
+            if w > 1:
+                rows = self.ring.all_gather(local)  # [w, k, dims+1]
+            else:
+                rows = local[None]
+            gsum = np.zeros(dims, np.float32)
+            loss_sum = np.float32(0.0)
+            for s in range(S):  # FIXED order: the invariance argument
+                r, j = s % w, s // w
+                gsum = gsum + rows[r, j, :dims]
+                loss_sum = loss_sum + rows[r, j, dims]
+            grads = self._unflatten(gsum / np.float32(cfg.global_batch))
+            new_params: Dict[str, np.ndarray] = {}
+            new_momentum: Dict[str, np.ndarray] = {}
+            for i, name in enumerate(self._leaf_names):
+                owners = leaf_owners(i, w, cfg.replication)
+                is_owner = rank in owners
+                if is_owner:
+                    m = (
+                        np.float32(cfg.momentum) * self.momentum[name]
+                        + grads[name]
+                    )
+                    p = self.params[name] - np.float32(cfg.lr) * m
+                    new_momentum[name] = m
+                else:
+                    p = np.zeros_like(self.params[name])
+                if w > 1:
+                    # uniform collective: every rank calls it; only the
+                    # src's payload matters
+                    p = self.ring.broadcast(p, src=owners[0])
+                new_params[name] = p
+            # COMMIT: nothing above mutated engine state, so a collective
+            # failure anywhere in this step leaves the world replayable
+            self.params = new_params
+            self.momentum.update(new_momentum)
+            self.step += 1
+            self._commit_batch()
+            self.losses.append(
+                float(loss_sum) / (cfg.global_batch * cfg.task.outputs)
+            )
+        self.goodput.add(bucket, time.perf_counter() - t0)
+        if self._writer is not None:
+            self._writer.write(
+                self.step,
+                {"event": "progress", "loss": self.losses[-1],
+                 "epoch": self.view.epoch if self.view else 1,
+                 "world_size": self.world_size},
+                split="progress",
+            )
+        if cfg.ckpt_every and self.step % cfg.ckpt_every == 0:
+            self._maybe_checkpoint()
+
+    def _flat_dim(self) -> int:
+        return sum(
+            int(np.prod(self._leaf_shapes[n])) for n in self._leaf_names
+        )
+
+    def _flatten(self, tree: Dict[str, np.ndarray]) -> np.ndarray:
+        return np.concatenate(
+            [np.ravel(tree[n]) for n in self._leaf_names]
+        ).astype(np.float32)
+
+    def _unflatten(self, flat: np.ndarray) -> Dict[str, np.ndarray]:
+        out = {}
+        off = 0
+        for n in self._leaf_names:
+            size = int(np.prod(self._leaf_shapes[n]))
+            out[n] = flat[off:off + size].reshape(
+                self._leaf_shapes[n]
+            ).astype(np.float32)
+            off += size
+        return out
+
+    # -- checkpointing -----------------------------------------------------
+    def _checkpoint_leaves(
+        self, full_momentum: Optional[Dict[str, np.ndarray]] = None
+    ) -> Dict[str, np.ndarray]:
+        momentum = full_momentum if full_momentum is not None else self.momentum
+        cursor, data_epoch = self._cursor_state()
+        leaves = {f"params_{n}": self.params[n] for n in self._leaf_names}
+        for n in self._leaf_names:
+            leaves[f"momentum_{n}"] = momentum[n]
+        leaves["elastic_cursor"] = np.array(
+            [cursor.get("epoch", 0), cursor.get("offset", 0),
+             data_epoch, self.step, self._replay_until],
+            np.int64,
+        )
+        return leaves
+
+    def _maybe_checkpoint(self) -> None:
+        """Write 'latest' (cadence gating is the caller's: _one_step's
+        ckpt_every check, plus one unconditional save at genesis and at
+        run completion). Uniform collectives — every rank must call this
+        at the same step."""
+        if not self.cfg.ckpt_dir:
+            return
+        t0 = time.perf_counter()
+        with tracing.span("elastic.checkpoint"):
+            w = self.world_size
+            # gather the momentum shards rank 0 lacks — a uniform
+            # per-leaf broadcast sequence (lockstep: every rank runs the
+            # checkpoint cadence at the same step)
+            full_momentum = {}
+            for i, name in enumerate(self._leaf_names):
+                owners = leaf_owners(i, w, self.cfg.replication)
+                if w > 1:
+                    buf = self.momentum.get(name)
+                    if buf is None:
+                        buf = np.zeros(
+                            self._leaf_shapes[name], np.float32
+                        )
+                    full_momentum[name] = self.ring.broadcast(
+                        buf, src=owners[0]
+                    )
+                else:
+                    full_momentum[name] = self.momentum[name]
+            if self.rank == 0:
+                save_host_checkpoint(
+                    self.cfg.ckpt_dir,
+                    self._checkpoint_leaves(full_momentum),
+                    self.step,
+                )
+        self.goodput.add("checkpoint", time.perf_counter() - t0)
+
+    # -- resize ------------------------------------------------------------
+    def _resize(self, reason: str) -> None:
+        """Quiesce -> new view -> re-shard -> resume. The whole window is
+        priced into the goodput ``resize`` bucket; per-resize wall time
+        is the bench's ``elastic_resize_downtime_s`` numerator."""
+        t0 = time.monotonic()
+        old_epoch = self.view.epoch if self.view else 0
+        last_error: Optional[BaseException] = None
+        with tracing.span("elastic.resize"):
+            for _attempt in range(self.cfg.max_resize_attempts):
+                faults.check("elastic.resize")
+                try:
+                    self.view, self.ring = self.membership.next_view()
+                    self._sync_after_view()
+                    break
+                except MembershipError:
+                    raise
+                except RuntimeError as e:
+                    # a peer died DURING the change — go around again
+                    last_error = e
+                    continue
+            else:
+                raise MembershipError(
+                    f"resize did not converge after "
+                    f"{self.cfg.max_resize_attempts} attempts"
+                ) from last_error
+        dt = time.monotonic() - t0
+        self.goodput.add("resize", dt)
+        self._note_view()
+        self._open_writer()
+        rec = {
+            "from_epoch": old_epoch,
+            "epoch": self.view.epoch,
+            "world_size": self.view.world_size,
+            "step": self.step,
+            "reason": reason,
+            "resize_s": round(dt, 4),
+        }
+        self.resizes.append(rec)
+        logger.warning(
+            "resized in-process: %s -> %s (%.2fs, %s)",
+            old_epoch, self.view.describe(), dt, reason,
+        )
+        if self._writer is not None:
+            self._writer.write(
+                self.step, {"event": "view_change", **rec}, split="elastic"
+            )
+
+    # -- state sync after a committed view ---------------------------------
+    def _sync_after_view(self) -> None:
+        """Re-shard state onto the new view. Every rank issues the same
+        collective sequence, derived from allgathered facts — the
+        PTD001-by-construction discipline."""
+        w, rank = self.world_size, self.rank
+        if w == 1:
+            if not self._has_state:
+                self._genesis_or_restore()
+            else:
+                self._adopt_ownership()
+            return
+        # 1) who has live state, and at which step? The has-checkpoint
+        # bit rides the same allgather: the restore-vs-fresh decision
+        # must be AGREED before anyone acts on it — a per-rank exists()
+        # check races the genesis save (rank 0 can write the fallback
+        # basis before rank 1 looks) and splits the collective sequence.
+        info = self.ring.all_gather(
+            np.array(
+                [1 if self._has_state else 0, self.step,
+                 self.cfg.task.digest(),
+                 1 if host_checkpoint_exists(self.cfg.ckpt_dir) else 0],
+                np.int64,
+            )
+        )
+        if len(set(int(r[2]) for r in info)) != 1:
+            raise MembershipError(
+                "members disagree on the task config — refusing to mix "
+                "worlds (check the worker command lines)"
+            )
+        holders = [r for r in range(w) if int(info[r][0]) == 1]
+        if not holders:
+            # a fresh world (genesis, or a die-and-restore restart):
+            # same deterministic init — or the checkpoint — on every rank
+            self._genesis_or_restore(
+                restore=any(int(r[3]) for r in info)
+            )
+            self._check_agreement()
+            return
+        src = holders[0]
+        # 2) control state (step / cursor / replay watermark) from the
+        # lowest live holder — NOT blindly rank 0: the new rank 0 can be
+        # a state-less joiner. Adoption is DEFERRED to the commit point
+        # below: this whole sync is scratch-only until its last
+        # collective, same discipline as _one_step — a second peer death
+        # mid-sync must leave every survivor exactly as it was, so the
+        # retry starts from consistent inputs instead of committing a
+        # half-adopted world.
+        blob = pickle.dumps(
+            {
+                "step": self.step,
+                "cursor": self._cursor_state()[0],
+                "data_epoch": self._cursor_state()[1],
+                "replay_until": self._replay_until,
+            }
+            if self._has_state
+            else None,
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+        payload = np.frombuffer(blob, np.uint8)
+        n = int(
+            self.ring.broadcast(
+                np.array([len(payload)], np.int64), src=src
+            )[0]
+        )
+        buf = np.zeros(n, np.uint8)
+        buf[: min(len(payload), n)] = payload[:n]
+        control = pickle.loads(
+            self.ring.broadcast(buf, src=src).tobytes()
+        )
+        control_step = int(control["step"])
+        # 3) leaf bitmaps: params + momentum presence per rank. A rank
+        # whose own step DISAGREES with the control step is not a
+        # holder, whatever it has in memory: a prior sync interrupted
+        # after one side adopted (e.g. a disk fallback that lost a peer
+        # right before the agreement check) leaves survivors at
+        # different steps — the stale side must take a full refresh from
+        # the in-sync side, not contribute shards from the wrong step.
+        in_sync = self._has_state and self.step == control_step
+        L = len(self._leaf_names)
+        bits = np.zeros(2 * L, np.uint8)
+        for i, name in enumerate(self._leaf_names):
+            bits[i] = 1 if (in_sync and name in self.params) else 0
+            bits[L + i] = 1 if (in_sync and name in self.momentum) else 0
+        rows = self.ring.all_gather(bits)  # [w, 2L] — identical plan
+        # 4) unrecoverable shard? ALL ranks see the same rows and reach
+        # the same verdict; the fallback is itself a uniform sequence
+        lost = [
+            self._leaf_names[i]
+            for i in range(L)
+            if not np.any(rows[:, i]) or not np.any(rows[:, L + i])
+        ]
+        if lost:
+            logger.warning(
+                "lost sole-copy shards %s — falling back to the last "
+                "checkpoint and replaying from the cursor", lost,
+            )
+            self._disk_fallback()
+            self._check_agreement()
+            return
+        # 5) in-memory re-shard into SCRATCH: per leaf, one broadcast
+        # from the lowest holder whenever anyone is missing it
+        # (receivers that already hold it adopt an identical copy —
+        # uniformity beats cleverness)
+        new_params = dict(self.params) if in_sync else {}
+        new_momentum = dict(self.momentum) if in_sync else {}
+        for i, name in enumerate(self._leaf_names):
+            p_holders = np.flatnonzero(rows[:, i])
+            if len(p_holders) < w:
+                have = bool(rows[rank, i])
+                buf = (
+                    self.params[name]
+                    if have
+                    else np.zeros(self._leaf_shapes[name], np.float32)
+                )
+                new_params[name] = self.ring.broadcast(
+                    buf, src=int(p_holders[0])
+                )
+        for i, name in enumerate(self._leaf_names):
+            owners = leaf_owners(i, w, self.cfg.replication)
+            m_holders = np.flatnonzero(rows[:, L + i])
+            missing_owner = any(
+                not rows[r, L + i] for r in owners
+            )
+            if missing_owner:
+                have = bool(rows[rank, L + i])
+                buf = (
+                    self.momentum[name]
+                    if have
+                    else np.zeros(self._leaf_shapes[name], np.float32)
+                )
+                out = self.ring.broadcast(buf, src=int(m_holders[0]))
+                if rank in owners:
+                    new_momentum[name] = out
+            if rank not in owners:
+                new_momentum.pop(name, None)  # release the old shard
+        # COMMIT: every collective of the sync is behind us
+        self.params = new_params
+        self.momentum = new_momentum
+        self.step = control_step
+        self._replay_until = int(control["replay_until"])
+        self._restore_cursor(control["cursor"], control["data_epoch"])
+        self._has_state = True
+        self._check_agreement()
+
+    def _adopt_ownership(self) -> None:
+        """World shrank to 1: this rank owns everything it still holds;
+        a missing momentum leaf at world 1 means its copies died with
+        the peers — disk fallback."""
+        if all(n in self.momentum for n in self._leaf_names):
+            return
+        self._disk_fallback()
+
+    def _genesis_or_restore(self, restore: Optional[bool] = None) -> None:
+        if restore is None:  # solo path: no peers to agree with
+            restore = host_checkpoint_exists(self.cfg.ckpt_dir)
+        if restore:
+            self._disk_fallback()
+            return
+        self.params = init_task_params(self.cfg.task)
+        w = self.world_size
+        self.momentum = {
+            name: np.zeros(self._leaf_shapes[name], np.float32)
+            for i, name in enumerate(self._leaf_names)
+            if self.rank in leaf_owners(i, w, self.cfg.replication)
+        }
+        self.step = 0
+        self._has_state = True
+        if self.rank == 0 and self.cfg.ckpt_dir:
+            # the fallback basis must exist before the first loss can;
+            # genesis momentum is zeros everywhere, so rank 0 needs no
+            # gather to write the full set
+            zeros = {
+                n: np.zeros(self._leaf_shapes[n], np.float32)
+                for n in self._leaf_names
+            }
+            save_host_checkpoint(
+                self.cfg.ckpt_dir, self._checkpoint_leaves(zeros), 0
+            )
+
+    def _disk_fallback(self) -> None:
+        """Adopt the last on-disk checkpoint on every rank, then let the
+        ordinary (deterministic) loop replay the lost steps. Rank 0
+        reads; everyone receives via uniform broadcasts — N ranks must
+        not each re-read the checkpoint, and more importantly they must
+        adopt the SAME one."""
+        w, rank = self.world_size, self.rank
+        pre_step = self.step if self._has_state else 0
+        t0 = time.perf_counter()
+        if w == 1:
+            leaves, step = load_host_checkpoint(self.cfg.ckpt_dir)
+            self._adopt_checkpoint(leaves, step, pre_step)
+        else:
+            blob = b""
+            if rank == 0:
+                leaves, step = load_host_checkpoint(self.cfg.ckpt_dir)
+                blob = pickle.dumps(
+                    (leaves, step), protocol=pickle.HIGHEST_PROTOCOL
+                )
+            payload = np.frombuffer(blob, np.uint8)
+            n = int(
+                self.ring.broadcast(
+                    np.array([len(payload)], np.int64), src=0
+                )[0]
+            )
+            buf = np.zeros(n, np.uint8)
+            buf[: len(payload)] = payload
+            leaves, step = pickle.loads(
+                self.ring.broadcast(buf, src=0).tobytes()
+            )
+            self._adopt_checkpoint(leaves, step, pre_step)
+        self.goodput.add("recovering", time.perf_counter() - t0)
+
+    def _adopt_checkpoint(
+        self, leaves: Dict[str, np.ndarray], step: int, pre_step: int
+    ) -> None:
+        w = self.world_size
+        self.params = {
+            n: leaves[f"params_{n}"] for n in self._leaf_names
+        }
+        self.momentum = {
+            name: leaves[f"momentum_{name}"]
+            for i, name in enumerate(self._leaf_names)
+            if self.rank in leaf_owners(i, w, self.cfg.replication)
+        }
+        cursor_vec = leaves["elastic_cursor"]
+        self.step = int(step)
+        self._restore_cursor(
+            {"epoch": int(cursor_vec[0]), "offset": int(cursor_vec[1])},
+            int(cursor_vec[2]),
+        )
+        self._replay_until = max(
+            int(cursor_vec[4]), pre_step, self._replay_until
+        )
+        self._has_state = True
+
+    def _check_agreement(self) -> None:
+        """Post-sync audit: every rank must hold the identical
+        (step, params) — a protocol bug dies HERE, loudly, instead of
+        training divergent worlds."""
+        digest = np.array(
+            [self.step, params_crc(self.params)], np.int64
+        )
+        if self.world_size > 1:
+            rows = self.ring.all_gather(digest)
+            if not np.all(rows == rows[0]):
+                raise MembershipError(
+                    f"post-resize state divergence: {rows.tolist()}"
+                )
+
+
+# --------------------------------------------------------------------------
+# Worker entry point (the drill / bench / launcher target).
+# --------------------------------------------------------------------------
+
+
+def run_worker(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="elastic-world worker (one membership per process)"
+    )
+    p.add_argument("--rendezvous-dir", required=True)
+    p.add_argument("--worker-id", required=True)
+    p.add_argument("--expected-world", type=int, default=None,
+                   help="genesis: block until this many members announce")
+    p.add_argument("--join", action="store_true",
+                   help="late joiner: announce and wait for admission")
+    p.add_argument("--total-steps", type=int, default=24)
+    p.add_argument("--global-batch", type=int, default=16)
+    p.add_argument("--microshards", type=int, default=4)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--sgd-momentum", type=float, default=0.9)
+    p.add_argument("--replication", type=int, default=2)
+    p.add_argument("--ckpt-dir", default=None)
+    p.add_argument("--ckpt-every", type=int, default=8)
+    p.add_argument("--data-seed", type=int, default=0)
+    p.add_argument("--on-peer-loss", choices=("resize", "exit"),
+                   default="resize")
+    p.add_argument("--step-delay-s", type=float, default=0.0)
+    p.add_argument("--ring-timeout-s", type=float, default=5.0)
+    p.add_argument("--metrics-path", default=None)
+    p.add_argument("--result-path", default=None,
+                   help="default <rendezvous>/result-<worker_id>.json")
+    p.add_argument("--trace-dir", default=None)
+    args = p.parse_args(argv)
+
+    cfg = ElasticConfig(
+        total_steps=args.total_steps,
+        global_batch=args.global_batch,
+        microshards=args.microshards,
+        lr=args.lr,
+        momentum=args.sgd_momentum,
+        replication=args.replication,
+        ckpt_dir=args.ckpt_dir,
+        ckpt_every=args.ckpt_every,
+        data_seed=args.data_seed,
+        on_peer_loss=args.on_peer_loss,
+        metrics_path=args.metrics_path,
+        step_delay_s=args.step_delay_s,
+    )
+    result_path = args.result_path or os.path.join(
+        args.rendezvous_dir, f"result-{args.worker_id}.json"
+    )
+    tracer = (
+        tracing.configure(args.trace_dir) if args.trace_dir else None
+    )
+    membership = WorldMembership(
+        args.rendezvous_dir, args.worker_id,
+        ring_timeout_s=args.ring_timeout_s,
+    )
+    engine = ElasticWorldEngine(
+        cfg, membership,
+        expected_world=args.expected_world, join=args.join,
+    )
+    code = 0
+    try:
+        result = engine.run()
+    except PeerLost as e:
+        result = {
+            "worker_id": args.worker_id,
+            "final_step": engine.step,
+            "ok": False,
+            "exited": "peer_lost",
+            "error": str(e),
+        }
+        code = EX_TEMPFAIL
+    finally:
+        membership.leave()
+        if engine._writer is not None:
+            engine._writer.close()
+        if tracer is not None:
+            tracer.export()
+            tracing.clear()
+    tmp = result_path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(result, f)
+    os.replace(tmp, result_path)
+    return code
+
+
+def reference_run(cfg: ElasticConfig) -> dict:
+    """The unresized reference world: the same engine, solo, same global
+    data order — what the drill's bit-exactness verdict compares to."""
+    solo = dataclasses.replace(
+        cfg, on_peer_loss="resize", metrics_path=None, ckpt_dir=None
+    )
+    engine = ElasticWorldEngine(solo, membership=None)
+    engine.start()
+    return engine.run()
+
+
+if __name__ == "__main__":
+    sys.exit(run_worker())

@@ -401,6 +401,11 @@ class Trainer:
         self.host_step = int(host_scalar(self.state.step))
         self._first_epoch = 0
         self._resume_skip_batches = 0
+        # live data cursor (epoch + batches consumed this epoch): saved
+        # next to every checkpoint so resume — and an elastic resize —
+        # replays from the exact batch, not a steps-per-epoch heuristic
+        self._cursor_epoch = 0
+        self._cursor_offset = 0
         self._preemption = None
         self._watchdog = None
         self._async_ckpt = None
@@ -505,6 +510,15 @@ class Trainer:
                     self.config.ckpt_dir, self.state, tag=tag
                 )
         logger.info("checkpoint saved: %s (step %d)", path, self.host_step)
+        if jax.process_index() == 0:  # the commit owner, like best/prune
+            from pytorch_distributed_tpu.train.checkpoint import (
+                save_sampler_cursor,
+            )
+
+            save_sampler_cursor(
+                self.config.ckpt_dir, step=self.host_step,
+                epoch=self._cursor_epoch, offset=self._cursor_offset,
+            )
         if self._watchdog is not None:
             self._watchdog.tick()  # a slow (sharded) save is not a hang
         return path
@@ -735,6 +749,50 @@ class Trainer:
     def _resume_bookkeeping(self, tag: str) -> None:
         step = int(host_scalar(self.state.step))
         self.host_step = step
+        from pytorch_distributed_tpu.train.checkpoint import (
+            load_sampler_cursor,
+        )
+
+        cursor = load_sampler_cursor(self.config.ckpt_dir)
+        if cursor is not None and cursor["step"] == step:
+            # exact-batch resume: the persisted cursor replaces the
+            # steps-per-epoch division (which cannot place bounded or
+            # streaming loaders mid-epoch correctly). A cursor whose
+            # offset equals a KNOWN epoch length (a cadence save that
+            # landed exactly on the boundary) rolls to the next epoch —
+            # replay-skipping a whole finished epoch of batch fetches
+            # would waste an epoch of data loading on every resume.
+            try:
+                epoch_len = max(len(self.train_loader), 1)
+                if self.config.max_steps_per_epoch:
+                    epoch_len = min(
+                        epoch_len, self.config.max_steps_per_epoch
+                    )
+            except TypeError:
+                epoch_len = None  # stream: length unknowable, keep exact
+            if epoch_len is not None and cursor["offset"] >= epoch_len:
+                cursor = {
+                    "step": step,
+                    "epoch": cursor["epoch"] + 1,
+                    "offset": 0,
+                }
+            self._first_epoch = cursor["epoch"]
+            self._resume_skip_batches = cursor["offset"]
+            self._cursor_epoch = cursor["epoch"]
+            self._cursor_offset = cursor["offset"]
+            self._load_best_record()
+            logger.info(
+                "resumed %r at step %d from the sampler cursor "
+                "(epoch %d, skipping %d batches)",
+                tag, step, self._first_epoch, self._resume_skip_batches,
+            )
+            return
+        if cursor is not None:
+            logger.warning(
+                "sampler cursor on disk is for step %d but the restored "
+                "checkpoint is step %d — ignoring it (falling back to "
+                "the steps-per-epoch heuristic)", cursor["step"], step,
+            )
         try:
             steps_per_epoch = max(len(self.train_loader), 1)
             if self.config.max_steps_per_epoch:
@@ -803,6 +861,11 @@ class Trainer:
             for epoch in range(self._first_epoch, cfg.epochs):
                 self.train_loader.set_epoch(epoch)
                 self._train_epoch(epoch)
+                # the epoch is consumed: a checkpoint written at this
+                # boundary must resume at the NEXT epoch's first batch,
+                # not replay-skip the finished one
+                self._cursor_epoch = epoch + 1
+                self._cursor_offset = 0
                 if self.eval_step is not None and (
                     (epoch + 1) % cfg.eval_every_epochs == 0
                 ):
@@ -961,6 +1024,8 @@ class Trainer:
         capped = False
         skip = self._resume_skip_batches
         self._resume_skip_batches = 0
+        self._cursor_epoch = epoch
+        self._cursor_offset = 0
         it = iter(self.train_loader)
         while True:
             t_wait = time.perf_counter()
@@ -975,6 +1040,7 @@ class Trainer:
                 capped = True
                 break
             taken += 1
+            self._cursor_offset = taken  # batches consumed this epoch
             if skip > 0:
                 skip -= 1
                 # resume replay: consuming already-trained batches to

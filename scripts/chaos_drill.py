@@ -38,6 +38,28 @@ def parse_args(argv=None):
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
+    p.add_argument("--drill", choices=("kill_resume", "resize"),
+                   default="kill_resume",
+                   help="kill_resume: SIGKILL the whole training process "
+                   "and restart it from disk (the original drill). "
+                   "resize: SIGKILL one RANK of a multi-process elastic "
+                   "world mid-run, assert the survivors re-mesh "
+                   "IN-PROCESS and finish bit-identical to an unresized "
+                   "reference, then grow back to full world and assert "
+                   "the same (train/elastic_world.py)")
+    p.add_argument("--world", type=int, default=3,
+                   help="[resize] genesis world size")
+    p.add_argument("--total-steps", type=int, default=36,
+                   help="[resize] steps every survivor must reach")
+    p.add_argument("--kill-after", type=int, default=8,
+                   help="[resize] victim dies at this step boundary")
+    p.add_argument("--step-delay-s", type=float, default=0.12,
+                   help="[resize] synthetic per-step compute")
+    p.add_argument("--ring-timeout-s", type=float, default=2.5,
+                   help="[resize] collective deadline = detection bound")
+    p.add_argument("--replication", type=int, default=2,
+                   help="[resize] optimizer-shard copies (1 forces the "
+                   "disk-fallback + replay path)")
     p.add_argument("--recipe", default="recipes/resnet18_cifar10.py")
     p.add_argument("--ckpt-dir", default=None,
                    help="default: a fresh temp dir, removed on success")
@@ -81,8 +103,144 @@ def _child_cmd(args, ckpt_dir, metrics_path):
     ]
 
 
+def resize_main(args):
+    """The shrink/grow drill: one rank SIGKILLed mid-run, survivors must
+    re-mesh in-process (no process restart) and finish with params
+    bit-identical to an unresized reference world on the same global
+    data order; a replacement then joins and must land on the same bits.
+    """
+    from pytorch_distributed_tpu.launch import ElasticWorldLauncher
+    from pytorch_distributed_tpu.train.elastic_world import (
+        ElasticConfig,
+        reference_run,
+    )
+
+    base = args.ckpt_dir or tempfile.mkdtemp(prefix="resize_drill_")
+    owns_dir = args.ckpt_dir is None
+    ckpt_dir = os.path.join(base, "ckpt")
+    t0 = time.monotonic()
+    launcher = ElasticWorldLauncher(
+        os.path.join(base, "rdv"),
+        worker_args=(
+            "--total-steps", str(args.total_steps),
+            "--global-batch", "16", "--microshards", "4",
+            "--ckpt-dir", ckpt_dir, "--ckpt-every", "8",
+            "--replication", str(args.replication),
+            "--data-seed", str(args.seed),
+            "--step-delay-s", str(args.step_delay_s),
+            "--ring-timeout-s", str(args.ring_timeout_s),
+            "--metrics-path", os.path.join(base, "metrics.jsonl"),
+        ),
+    )
+    ids = [f"w{i}" for i in range(args.world)]
+    victim = ids[-1]
+    launcher.start_world(ids, env_overrides={victim: {
+        # the deterministic departure: mode=kill at the elastic.peer_lost
+        # step-boundary site — an os._exit, SIGKILL-grade
+        "PTD_FAULTS": (
+            f"elastic.peer_lost:mode=kill,after={args.kill_after}"
+        ),
+        "PTD_FAULTS_SEED": str(args.seed),
+    }})
+    # grow back only after the SHRUNKEN view has committed (the view-*.
+    # json audit records the survivors' rank 0 writes) — otherwise the
+    # death and the join coalesce into one 3->3 transition and the drill
+    # never observes the shrink it is supposed to prove
+    def committed_worlds():
+        out = {}
+        rdv = os.path.join(base, "rdv")
+        for name in os.listdir(rdv):
+            if name.startswith("view-") and name.endswith(".json"):
+                try:
+                    with open(os.path.join(rdv, name)) as f:
+                        rec = json.load(f)
+                    out[int(rec["epoch"])] = int(rec["world_size"])
+                except (OSError, ValueError, KeyError):
+                    continue
+        return out
+
+    deadline = time.monotonic() + 90
+    while launcher.procs[victim].poll() is None:
+        if time.monotonic() > deadline:
+            break
+        time.sleep(0.1)
+    victim_rc = launcher.procs[victim].poll()
+    while time.monotonic() < deadline:
+        if (args.world - 1) in committed_worlds().values():
+            break
+        time.sleep(0.1)
+    joiner = f"w{args.world}"
+    launcher.add_worker(joiner)
+    codes = launcher.wait(240)
+    results = launcher.results()
+    survivors = [w for w in ids if w != victim] + [joiner]
+
+    ref = reference_run(ElasticConfig(
+        total_steps=args.total_steps,
+        replication=args.replication, data_seed=args.seed,
+    ))
+    crcs = {w: results.get(w, {}).get("params_crc") for w in survivors}
+    bit_exact = all(c == ref["params_crc"] for c in crcs.values())
+    finished = all(
+        results.get(w, {}).get("final_step") == args.total_steps
+        for w in survivors
+    )
+    shrank = any(
+        v["world_size"] == args.world - 1
+        for w in survivors for v in results.get(w, {}).get("views", [])
+    )
+    regrew = any(
+        v["world_size"] == args.world and v["epoch"] > 1
+        for w in survivors for v in results.get(w, {}).get("views", [])
+    )
+    no_restart = all(codes.get(w) == 0 for w in survivors)
+    from pytorch_distributed_tpu.train.checkpoint import verify_checkpoint
+
+    problems = verify_checkpoint(ckpt_dir)
+    resize_log = []
+    for w in survivors:
+        for rec in results.get(w, {}).get("resizes", []):
+            resize_log.append({"worker": w, **rec})
+    goodput = {
+        w: results.get(w, {}).get("goodput", {}) for w in survivors
+    }
+    passed = (
+        bit_exact and finished and shrank and regrew and no_restart
+        and victim_rc not in (0, None) and not problems
+    )
+    print(json.dumps({
+        "drill": "resize",
+        "world": args.world,
+        "victim": victim,
+        "victim_rc": victim_rc,
+        "exit_codes": codes,
+        "completed": finished,
+        "shrank": shrank,
+        "regrew": regrew,
+        "bit_exact_vs_reference": bit_exact,
+        "reference_params_crc": ref["params_crc"],
+        "params_crc": crcs,
+        "resizes": resize_log,
+        "resize_goodput": {
+            w: round(g.get("resize_s", 0.0), 3)
+            for w, g in goodput.items()
+        },
+        "goodput": goodput,
+        "verify_problems": problems,
+        "wall_s": round(time.monotonic() - t0, 2),
+        "passed": passed,
+    }))
+    if passed and owns_dir:
+        shutil.rmtree(base, ignore_errors=True)
+    elif not passed:
+        print(f"# drill dir kept for autopsy: {base}", file=sys.stderr)
+    return 0 if passed else 1
+
+
 def main(argv=None):
     args = parse_args(argv)
+    if args.drill == "resize":
+        return resize_main(args)
     import numpy as np
 
     rng = np.random.default_rng(args.seed)

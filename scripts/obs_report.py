@@ -399,6 +399,30 @@ def report(trace_path, metric_paths, top_n=10, out=None,
             print(f"    {k:<16} {g[k]:10.2f}", file=out)
     else:
         print("  no goodput records in the metrics stream", file=out)
+    # elastic-world membership transitions ride the same stream
+    # (train/elastic_world.py, split="elastic"): each in-process resize
+    # names its epochs, the surviving world size, and what it cost —
+    # the goodput 'resize' bucket, itemized
+    views = [
+        r for r in records
+        if r.get("split") == "elastic" and r.get("event") == "view_change"
+    ]
+    if views:
+        total_resize = sum(float(r.get("resize_s", 0.0)) for r in views)
+        print(
+            f"  membership: {len(views)} view change(s), "
+            f"{total_resize:.2f}s total resize cost", file=out,
+        )
+        for r in views:
+            print(
+                f"    step {r.get('step', '?'):>6}  epoch "
+                f"{r.get('from_epoch', '?')} -> {r.get('epoch', '?')}  "
+                f"world {r.get('world_size', '?')}  "
+                f"({r.get('reason', '?')}, {r.get('resize_s', 0.0):.2f}s)",
+                file=out,
+            )
+        g["view_changes"] = len(views)
+        g["resize_total_s"] = round(total_resize, 4)
 
     # -- serve telemetry, if present --------------------------------------
     serve_recs = [r for r in records if r.get("split") == "serve"]

@@ -172,6 +172,7 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
     assert durations.get("serving_paged", 999) < 300, durations
     assert durations.get("serving_spec", 999) < 300, durations
     assert durations.get("serving_paged_attn", 999) < 300, durations
+    assert durations.get("elastic", 999) < 300, durations
 
     # ...and the same numbers must land as DATA: one phase_durations_s
     # record (the print-only stderr notes were unparseable by the
@@ -184,7 +185,7 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
     assert len(pd) == 1, proc.stderr[-2000:]
     for phase in ("input_pipeline_feed", "serving", "serving_paged",
                   "serving_spec", "serving_paged_attn",
-                  "observability", "planning"):
+                  "observability", "planning", "elastic"):
         assert phase in pd[0]["value"], pd[0]
     assert pd[0]["value"] == pytest.approx(durations, abs=0.2)
 
@@ -214,6 +215,20 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
     assert set(plan_rec[0]["chosen"]) == {"gpt2_tiny", "resnet50"}
     assert "planning" in durations, sorted(durations)
     assert durations["planning"] < 180, durations
+
+    # the elastic phase: in-process resize must BEAT die-and-restore on
+    # wall-clock downtime — same workers, same SIGKILLed victim, same
+    # detection deadline, and BOTH paths verified bit-identical to the
+    # unresized reference inside the phase (a fast recovery to wrong
+    # params raises there, so this ratio can never come from bad math)
+    el = one_metric("elastic_resize_downtime_s")
+    assert el["value"] > 0, el
+    assert el["resize_goodput_s"] > 0, el
+    ratio = one_metric("elastic_vs_restart_ratio")
+    assert 0 < ratio["value"] < 1.0, (
+        f"in-process resize lost to die-and-restore: {ratio}"
+    )
+    assert ratio["restart_downtime_s"] > el["value"], ratio
 
     # the comms phase: q8's RECORDED wire bytes at gradient size must be
     # <= 0.3x f32 (the encoding is int8 + one f32 scale per 256 elems,

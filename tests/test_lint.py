@@ -170,6 +170,44 @@ class TestRealTree:
         )
         assert findings == [], [(f.path, f.line) for f in findings]
 
+    def test_elastic_world_lockstep_clean(self):
+        """PTD001 pin on the elastic subsystem (r13): the membership
+        view-change collectives (commit digest allgather + barrier) and
+        the resize engine's re-shard broadcasts are uniform-by-
+        construction — every rank issues the identical sequence, with
+        rank-dependence confined to VALUES, never to call sites."""
+        findings = lint_paths(
+            [
+                "pytorch_distributed_tpu/runtime/membership.py",
+                "pytorch_distributed_tpu/train/elastic_world.py",
+            ],
+            rules=[LockstepCollectives()],
+        )
+        assert findings == [], [(f.path, f.line) for f in findings]
+
+    def test_injected_view_change_rank_guard_is_caught(self, tmp_path):
+        """A rank-gated view-commit collective smuggled into a copy of
+        membership.py — the exact hazard the commit barrier exists to
+        prevent — is flagged."""
+        src = os.path.join(
+            ROOT, "pytorch_distributed_tpu", "runtime", "membership.py"
+        )
+        target = tmp_path / "membership.py"
+        shutil.copy(src, target)
+        with open(target, "a") as f:
+            f.write(
+                "\n\ndef _leader_only_commit(ring, digest):\n"
+                "    if ring.rank == 0:\n"
+                "        rows = ring.all_gather(digest)\n"
+                "        return rows\n"
+            )
+        findings = lint_paths(
+            [str(target)], root=str(tmp_path),
+            rules=[LockstepCollectives()],
+        )
+        assert [f.rule_id for f in findings] == ["PTD001"]
+        assert "all_gather" in findings[0].message
+
     def test_injected_rank_guard_is_caught(self, tmp_path):
         """Injecting a rank-guarded collective into a copy of the real
         module is caught — the rule defends the file it patrols, not

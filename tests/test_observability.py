@@ -268,6 +268,32 @@ class TestGoodput:
         assert s["goodput_pct"] == pytest.approx(60.0)
         assert s["other_s"] == pytest.approx(2.0)
 
+    def test_resize_bucket_reported_and_sums_to_wall(self):
+        """The elastic-world bucket (r13): ``resize`` is a first-class
+        goodput bucket — always present in the summary (0.0 when no
+        resize happened), and the sum-to-wall invariant holds with it
+        charged."""
+        assert "resize" in tracing.GOODPUT_BUCKETS
+        now = [0.0]
+        g = tracing.GoodputAccount(clock=lambda: now[0])
+        now[0] += 20.0
+        g.add("productive", 12.0)
+        g.add("resize", 3.0)
+        g.add("recovering", 2.0)
+        g.add("checkpoint", 1.0)
+        s = g.summary()
+        assert s["resize_s"] == pytest.approx(3.0)
+        total = sum(
+            v for k, v in s.items()
+            if k.endswith("_s") and k != "wall_s"
+        )
+        assert total == pytest.approx(s["wall_s"])
+        assert s["other_s"] == pytest.approx(2.0)
+        # an account that never resized still REPORTS the bucket: a
+        # dashboard diffing runs must not see a schema change
+        empty = tracing.GoodputAccount(clock=lambda: now[0]).summary()
+        assert empty["resize_s"] == 0.0
+
     def test_buckets_sum_to_wall_under_injected_faults(self, tmp_path):
         """End to end: a Trainer run with PTD_FAULTS armed (a step.nan
         injection plus a checkpoint cadence) still accounts every wall
