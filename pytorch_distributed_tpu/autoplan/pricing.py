@@ -136,9 +136,47 @@ class CommTerm:
     wire_bytes: int = 0  # count x per-participant wire bytes
     extrapolated: bool = False
     note: str = ""
+    #: for q8 terms: the f32 bytes the quantization REPLACED — the
+    #: quantize/dequant passes sweep this domain, so the analytic
+    #: quantize-cost term below prices against it, not the wire bytes
+    f32_bytes: int = 0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
+
+
+#: analytic quantize-cost passes for an UNCALIBRATED q8 fallback: the
+#: native q8 ring (native/hostring.cpp) sweeps the f32 domain ~3x per
+#: participant beyond the wire bytes (quantize the contribution,
+#: dequant-accumulate the owned segment across peers, requantize +
+#: dequant-copy the result), priced at the transport's own per-byte β.
+#: Calibrated ON the measured shm numbers: at 6.4 MB / world 4 this
+#: reproduces the recorded "q8 ~2x SLOWER than f32"
+#: (runtime/hostring.py's measured trade-off) instead of the wire-bytes-
+#: only model that predicted 0.25x — the mispricing that made
+#: `--strategy auto` prefer a measured regression. A model with a real
+#: all_reduce_q8 fit never uses this (the fit carries the true cost).
+Q8_QUANTIZE_PASSES = 3.0
+
+
+def q8_quantize_seconds(f32_bytes: int, beta_s_per_byte: float,
+                        count: int = 1) -> float:
+    """Analytic per-step quantize/dequant cost of a q8 collective whose
+    f32 payload is ``f32_bytes`` — used ONLY when the cost model has no
+    ``all_reduce_q8`` fit (which would already include it)."""
+    return Q8_QUANTIZE_PASSES * float(f32_bytes) * beta_s_per_byte * count
+
+
+def exposed_comm_seconds(comm_seconds: float,
+                         overlappable_compute_seconds: float) -> float:
+    """The round-14 overlap model: comm that fits under concurrently
+    schedulable compute is hidden; only the excess extends the step.
+    ``max(0, comm - overlappable)`` — an UPPER bound on hiding (perfect
+    pipelining, no interference), the planner's usual serialized-bound
+    honesty inverted, so candidates are compared by the same optimistic
+    rule and the plan records which assumption priced them."""
+    return max(0.0, float(comm_seconds)
+               - float(overlappable_compute_seconds))
 
 
 def grad_comm_terms(strategy: str, grad_payload_bytes: int,
@@ -151,7 +189,8 @@ def grad_comm_terms(strategy: str, grad_payload_bytes: int,
         if compress == "int8":
             return [CommTerm("all_reduce_q8",
                              q8_wire_payload(grad_elems), data_world, 1,
-                             note="q8 wire occupancy of the f32 grads")]
+                             note="q8 wire occupancy of the f32 grads",
+                             f32_bytes=int(grad_payload_bytes))]
         return [CommTerm("all_reduce", grad_payload_bytes, data_world, 1)]
     if strategy == "zero1":
         return [
@@ -207,6 +246,7 @@ def price_comm_terms(terms: Sequence[CommTerm], model: CostModel,
         op = t.op
         note = t.note
         forced_extrapolated = False
+        quantize_s = 0.0
         try:
             p = model.predict(op, t.payload_bytes, t.world)
         except KeyError:
@@ -214,8 +254,21 @@ def price_comm_terms(terms: Sequence[CommTerm], model: CostModel,
                 o == "all_reduce" for o, _ in model.fits
             ):
                 p = model.predict("all_reduce", t.payload_bytes, t.world)
-                note = (note + "; " if note else "") + \
-                    "priced on the all_reduce fit (no q8 calibration)"
+                # the wire-bytes-only fallback UNDERPRICED q8: on the
+                # shm transport the quantize compute outweighs the byte
+                # savings (measured ~2x slower — hostring.py). Add the
+                # per-transport quantize-cost term at the fit's own β,
+                # flagged: only a real q8 calibration removes the guess.
+                quantize_s = q8_quantize_seconds(
+                    t.f32_bytes, p.fit.beta_s_per_byte, t.count
+                )
+                forced_extrapolated = True
+                note = (note + "; " if note else "") + (
+                    "priced on the all_reduce fit (no q8 calibration) "
+                    "+ analytic quantize cost "
+                    f"(~{Q8_QUANTIZE_PASSES:g} f32 passes at the fit's "
+                    "β)"
+                )
             elif fallback is not None:
                 p = fallback.predict(op, t.payload_bytes, t.world)
                 forced_extrapolated = True
@@ -231,7 +284,7 @@ def price_comm_terms(terms: Sequence[CommTerm], model: CostModel,
                 ) from None
         priced.append(dataclasses.replace(
             t,
-            seconds=p.seconds * t.count,
+            seconds=p.seconds * t.count + quantize_s,
             wire_bytes=p.wire_bytes * t.count,
             extrapolated=p.extrapolated or forced_extrapolated,
             note=note,

@@ -23,6 +23,7 @@ identical across ranks.
 from __future__ import annotations
 
 import contextlib
+import os
 
 import jax
 import numpy as np
@@ -57,22 +58,22 @@ _COMPRESS_DTYPES = {"bf16": "bfloat16", "bfloat16": "bfloat16",
 
 #: float leaves below this element count coalesce into one flat
 #: allreduce per wire dtype (also the q8 path's exact-f32 threshold —
-#: one number, one meaning)
-_COALESCE_MAX_ELEMS = 4096
-
-#: wire dtypes eligible for coalescing: the ring's native float set
-#: (halves ship 2-byte and accumulate f32 — native/hostring.cpp)
-_COALESCE_DTYPES = [np.dtype(np.float32), np.dtype(np.float64),
-                    np.dtype(np.float16)]
-try:
-    import ml_dtypes as _ml_dtypes
-
-    _COALESCE_DTYPES.append(np.dtype(_ml_dtypes.bfloat16))
-except ImportError:  # pragma: no cover - ml_dtypes ships with jax
-    pass
+#: one number, one meaning; the canonical constant now lives in
+#: parallel/overlap.py, THE one place the ship grouping is computed)
+from pytorch_distributed_tpu.parallel.overlap import (  # noqa: E402
+    COALESCE_MAX_ELEMS as _COALESCE_MAX_ELEMS,
+)
 
 
-def sync_grads(grads, compress: str | None = None):
+def _overlap_default() -> bool:
+    """The bucketed pipeline is the default sync engine; set
+    ``PTD_GRAD_SYNC=legacy`` for the pre-r14 single-callback path (the
+    bench's synchronous A/B baseline)."""
+    return os.environ.get("PTD_GRAD_SYNC", "overlap") != "legacy"
+
+
+def sync_grads(grads, compress: str | None = None, *,
+               overlap: bool | None = None):
     """Average gradient pytree across ranks (no-op unless multi-process).
 
     Safe to call inside jit: the collective runs as ONE ordered io_callback
@@ -106,10 +107,28 @@ def sync_grads(grads, compress: str | None = None):
     regardless. The whole callback runs under a ``comm.sync_grads``
     span recording leaf count and pre-/post-compression wire bytes
     when tracing is armed.
+
+    ``overlap`` (default on; ``PTD_GRAD_SYNC=legacy`` or
+    ``overlap=False`` restores the pre-r14 path): the callback routes
+    through the bucketed pipeline (``parallel/overlap.py``) — leaves
+    pack into reusable staging and reduce IN PLACE on a dedicated comm
+    thread, pack(b+1) ∥ ring-reduce(b), with ``comm.sync_drain`` /
+    ``comm.sync.exposed_s`` recording how much comm the main thread
+    actually blocked on. Per-item ring calls, element layout, and
+    grouping are IDENTICAL to the legacy path (shared plan code), so
+    the result is bit-identical to it; with ``compress="int8"`` the
+    pipeline additionally keeps per-leaf error-feedback residuals
+    (ROADMAP item 1) — each sync ships ``g + e`` and carries the local
+    quantization error into the next step. The legacy path stays
+    residual-free (it IS the pre-r14 behavior).
     """
     import jax.numpy as jnp
     from jax.experimental import io_callback
 
+    from pytorch_distributed_tpu.parallel.overlap import (
+        ShipPlan,
+        get_engine,
+    )
     from pytorch_distributed_tpu.runtime import distributed as dist
     from pytorch_distributed_tpu.runtime import tracing
     from pytorch_distributed_tpu.runtime.hostring import (
@@ -120,6 +139,8 @@ def sync_grads(grads, compress: str | None = None):
     ring = dist.multiprocess_ring()
     if ring is None or ring.world_size == 1:
         return grads
+    if overlap is None:
+        overlap = _overlap_default()
     leaves, treedef = tree_util.tree_flatten(grads)
     if not leaves:
         return grads
@@ -146,35 +167,25 @@ def sync_grads(grads, compress: str | None = None):
             for l in leaves
         ]
 
-    sizes = [int(np.prod(np.shape(l), dtype=np.int64)) for l in leaves]
-    # group small float leaves by their ON-THE-WIRE dtype (post any
-    # compress cast, so bf16-compressed runs coalesce too); a group
-    # needs >= 2 members to be worth a concatenate
-    by_dtype: dict = {}
-    for i, l in enumerate(leaves):
-        if sizes[i] < _COALESCE_MAX_ELEMS and any(
-            l.dtype == d for d in _COALESCE_DTYPES
-        ):
-            by_dtype.setdefault(np.dtype(l.dtype).name, []).append(i)
-    groups = [
-        idxs for _, idxs in sorted(by_dtype.items())
-        if len(idxs) >= 2
-    ]
-    coalesced = {i for g in groups for i in g}
-    solo = [i for i in range(n_leaves) if i not in coalesced]
-    flats = [
-        jnp.concatenate([leaves[i].reshape(-1) for i in g])
-        for g in groups
-    ]
-    ship = [leaves[i] for i in solo] + flats
-    # flat buffers stay exact (never q8, even when >= 4096 elems): they
-    # replace leaves the q8 path already kept exact — too small to
-    # amortize the block scales
-    q_flags = tuple(
-        quantize and leaves[i].dtype == jnp.float32
-        and sizes[i] >= _COALESCE_MAX_ELEMS
-        for i in solo
-    ) + (False,) * len(flats)
+    # ONE source of grouping truth: the ship plan (parallel/overlap.py)
+    # computes the coalesce groups and q8 flags for both engines, so the
+    # pipelined path can never drift from the legacy grouping
+    # grouping only (coalesce + q8 flags): leaves ship WHOLE through the
+    # callback — the engine applies its slot-aligned chunking host-side
+    plan = ShipPlan(
+        [(np.shape(l), np.dtype(l.dtype)) for l in leaves],
+        quantize=quantize, chunk_bytes=1 << 62,
+    )
+    sizes = plan.sizes
+    ship = []
+    for item in plan.items:
+        if item.kind == "flat":
+            ship.append(jnp.concatenate(
+                [leaves[i].reshape(-1) for i in item.leaf_ids]
+            ))
+        else:
+            ship.append(leaves[item.leaf_ids[0]])
+    q_flags = tuple(item.q8 for item in plan.items)
     ship_shapes = tuple(
         jax.ShapeDtypeStruct(np.shape(l), l.dtype) for l in ship
     )
@@ -191,10 +202,11 @@ def sync_grads(grads, compress: str | None = None):
     span_args = {
         "leaves": n_leaves,
         "collectives": len(ship),
-        "coalesced_leaves": len(coalesced),
+        "coalesced_leaves": len(plan.coalesced),
         "pre_bytes": int(pre_bytes),
         "wire_bytes": int(wire_bytes),
         "world": ring.world_size,
+        "overlap": bool(overlap),
     }
 
     def _allreduce_all(*arrs):
@@ -204,6 +216,11 @@ def sync_grads(grads, compress: str | None = None):
             else tracing._Span(tr, "comm.sync_grads", span_args)
         )
         with span:
+            if overlap:
+                out, _stats = get_engine(ring).reduce_shipped(
+                    arrs, q_flags
+                )
+                return tuple(out)
             out = []
             for a, qf in zip(arrs, q_flags):
                 a = np.asarray(a)
@@ -216,23 +233,31 @@ def sync_grads(grads, compress: str | None = None):
     shipped = io_callback(
         _allreduce_all, ship_shapes, *ship, ordered=True
     )
-    if coalesced:
-        synced = [None] * n_leaves
-        for j, i in enumerate(solo):
-            synced[i] = shipped[j]
-        for k, g in enumerate(groups):
-            flat_synced, off = shipped[len(solo) + k], 0
-            for i in g:
-                synced[i] = flat_synced[off:off + sizes[i]].reshape(
+    synced = [None] * n_leaves
+    for item, arr in zip(plan.items, shipped):
+        if item.kind == "flat":
+            off = 0
+            for i in item.leaf_ids:
+                synced[i] = arr[off:off + sizes[i]].reshape(
                     np.shape(leaves[i])
                 )
                 off += sizes[i]
-        synced = tuple(synced)
-    else:
-        synced = shipped
+        else:
+            synced[item.leaf_ids[0]] = arr
+    synced = tuple(synced)
     if orig_dtypes is not None:
         synced = tuple(
             s.astype(d) if s.dtype != d else s
             for s, d in zip(synced, orig_dtypes)
         )
     return tree_util.tree_unflatten(treedef, synced)
+
+
+def reset_error_feedback() -> None:
+    """Drop the q8 error-feedback residuals (a fresh training run on
+    the same process — stale residuals would leak the old run's last
+    gradient into the new run's first sync)."""
+    from pytorch_distributed_tpu.parallel import overlap as _ov
+
+    if _ov._ENGINE is not None:
+        _ov._ENGINE.reset_residuals()

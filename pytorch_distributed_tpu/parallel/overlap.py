@@ -1,0 +1,735 @@
+"""Bucketed, pipelined gradient synchronisation for the hostring path.
+
+The reference DDP's signature performance mechanic is bucketed allreduce
+that runs *during* backward. Through round 13 this repo reproduced it
+"minus the bucketing": ``ddp.sync_grads`` was one synchronous host
+callback that stalled the step while every leaf rode the ring, paying a
+full functional copy (a cold allocation + memcpy of the whole payload)
+before the first shm byte moved. BENCH_r04/r05 drove the ring itself to
+its touched-bytes memcpy bound, so the remaining levers are exactly the
+two this module implements:
+
+* **touch fewer bytes** — leaves are packed once into *reusable* staging
+  buffers and reduced IN PLACE (``hr_allreduce`` writes the result where
+  the contribution already sits). The legacy path's per-call
+  ``a.copy()`` — measured at roughly the cost of the ring itself on this
+  box, because a cold 6 MB allocation faults every page — is gone.
+* **hide the rest** — a dedicated comm thread drains a deterministic
+  bucket queue while the main thread keeps packing (and, in the
+  ``overlap_accum`` trainer mode, keeps fetching/accumulating microbatch
+  gradients and the caller keeps staging its next batch). The 3-stage
+  shape is the issue's D2H(b+1) ∥ ring-reduce(b) ∥ H2D(b−1) pipeline.
+
+Determinism and lockstep safety are BY CONSTRUCTION: every rank builds
+the same :class:`ShipPlan` from the same leaf specs (the jit trace is
+identical across ranks), enqueues the same buckets in the same fixed
+order, and the comm thread drains the queue FIFO — so the sequence of
+ring collectives is identical on every rank regardless of per-rank
+timing, which is what ``trace_merge``'s k-th-occurrence alignment and
+the PTD001 lint rule continue to verify. Per-item reduction is the SAME
+``hr_allreduce`` call on the same element layout as the legacy path, so
+results are bit-identical to it (and the coalescing grouping is shared
+code, not a reimplementation).
+
+Honest limits (DESIGN.md §19): on a 1-core box the comm thread cannot
+create wall time — compute and memcpy serialize on the one core, and the
+measured win comes from the touched-byte reduction above. What the
+pipeline buys here is *structure*: the exposed/hidden accounting below
+measures how much of the comm wall ran while other work was in flight,
+which is the quantity that turns into real hiding the moment transfer,
+reduction, and compute stop sharing a core.
+
+Error feedback (ROADMAP item 1's missing half): the q8 path keeps a
+per-item residual — each sync quantizes ``g + e`` and stores
+``e' = (g + e) − Q(g + e)`` with ``Q`` a numpy replication of the native
+block quantizer (``native/hostring.cpp``: 256-elem blocks, scale
+``amax/127``, round-half-away) — so the quantization error is carried
+into the next step instead of being dropped (EQuARX, arxiv 2506.17615).
+The second-stage requantization of the *reduced* segment is not
+compensated (its error is only visible to the segment owner); the
+loss-curve parity test bounds the total.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pytorch_distributed_tpu.runtime import faults, tracing
+from pytorch_distributed_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+#: float leaves below this element count coalesce into one flat wire
+#: buffer per dtype (also the q8 exact-f32 threshold) — ONE number with
+#: one meaning, shared with parallel/ddp.py which re-exports it
+COALESCE_MAX_ELEMS = 4096
+
+#: default pipeline bucket target — matches the ring's slot size, so one
+#: bucket is roughly one slot-chunk of ring work (override with
+#: PTD_GRAD_BUCKET_BYTES or the ``bucket_bytes=`` argument)
+DEFAULT_BUCKET_BYTES = 4 << 20
+
+_COALESCE_DTYPES = [np.dtype(np.float32), np.dtype(np.float64),
+                    np.dtype(np.float16)]
+try:
+    import ml_dtypes as _ml_dtypes
+
+    _COALESCE_DTYPES.append(np.dtype(_ml_dtypes.bfloat16))
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    pass
+
+_Q8_BLOCK = 256  # must match kQBlock in native/hostring.cpp
+
+
+# --------------------------------------------------------------------------
+# The ship plan: the ONE place the coalescing/bucketing structure lives.
+# --------------------------------------------------------------------------
+class ShipItem:
+    """One on-the-wire unit — exactly one ring collective.
+
+    ``kind == "flat"``: a coalesced group of sub-:data:`COALESCE_MAX_ELEMS`
+    float leaves sharing a wire dtype (the legacy coalescing, unchanged —
+    the issue's "degenerate first bucket"). ``kind == "solo"``: one whole
+    leaf. ``kind == "chunk"``: a slot-aligned slice of an oversized leaf
+    — ``hr_allreduce`` processes payloads in slot-sized chunks with
+    segment ownership computed PER CHUNK, so splitting at exactly the
+    ring's slot boundaries issues the identical per-element reduce the
+    unsplit call would have run (bit-identical by construction), while
+    giving the pipeline slot-granular stagger.
+
+    Every item addresses a slice ``[start, start+elems)`` of one parent
+    staging buffer (``parent`` indexes ``ShipPlan.buffers``); chunks of
+    one leaf share a parent, so the reduced leaf is contiguous with no
+    reassembly copy.
+    """
+
+    __slots__ = ("kind", "leaf_ids", "dtype", "elems", "nbytes",
+                 "q8", "offsets", "parent", "start")
+
+    def __init__(self, kind: str, leaf_ids: Tuple[int, ...],
+                 dtype, elems: int, q8: bool, parent: int,
+                 start: int = 0, offsets: Tuple[int, ...] = ()):
+        self.kind = kind
+        self.leaf_ids = leaf_ids
+        self.dtype = np.dtype(dtype)
+        self.elems = int(elems)
+        self.nbytes = self.elems * self.dtype.itemsize
+        self.q8 = bool(q8)
+        self.parent = parent
+        self.start = int(start)
+        self.offsets = offsets  # flat: per-leaf start offsets (elements)
+
+
+def _bucketize(items: Sequence[ShipItem], bucket_bytes: int
+               ) -> List[List[int]]:
+    """Size-targeted buckets over CONSECUTIVE items (fixed order): close
+    a bucket when the next item would cross the target; an oversized
+    item rides alone."""
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for j, it in enumerate(items):
+        if cur and cur_bytes + it.nbytes > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(j)
+        cur_bytes += it.nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def _chunk_items(kind_leaf: int, dtype: np.dtype, elems: int, q8: bool,
+                 parent: int, chunk_bytes: int) -> List[ShipItem]:
+    """Split one leaf/array into slot-aligned chunk items (or one solo
+    item when it fits). q8 items never split: the native q8 path chunks
+    at its own scale-adjusted stride, so a python-side split would
+    change the block scales — the f32 path's slot chunking is the only
+    one this mirrors exactly."""
+    chunk_elems = max(chunk_bytes // dtype.itemsize, 1)
+    if q8 or elems <= chunk_elems:
+        return [ShipItem("solo", (kind_leaf,), dtype, elems, q8, parent)]
+    out = []
+    for start in range(0, elems, chunk_elems):
+        n = min(chunk_elems, elems - start)
+        out.append(ShipItem("chunk", (kind_leaf,), dtype, n, False,
+                            parent, start=start))
+    return out
+
+
+class ShipPlan:
+    """Deterministic partition of a leaf list into ship items + buckets.
+
+    Built from abstract specs only (shapes/dtypes), so every rank —
+    tracing the same step function — derives the identical plan, which
+    is what makes the bucket queue's collective order lockstep-safe.
+    ``chunk_bytes`` MUST equal the ring's ``slot_bytes`` for the
+    bit-identity argument above (the engine passes it).
+    """
+
+    def __init__(self, specs: Sequence[Tuple[Tuple[int, ...], np.dtype]],
+                 *, quantize: bool = False,
+                 bucket_bytes: Optional[int] = None,
+                 chunk_bytes: Optional[int] = None):
+        if bucket_bytes is None:
+            bucket_bytes = int(os.environ.get(
+                "PTD_GRAD_BUCKET_BYTES", DEFAULT_BUCKET_BYTES
+            ))
+        self.bucket_bytes = max(int(bucket_bytes), 1)
+        self.chunk_bytes = int(chunk_bytes or DEFAULT_BUCKET_BYTES)
+        self.specs = [(tuple(s), np.dtype(d)) for s, d in specs]
+        sizes = [int(np.prod(s, dtype=np.int64)) for s, _ in self.specs]
+        self.sizes = sizes
+        # the legacy coalescing, verbatim: group small float leaves by
+        # their ON-THE-WIRE dtype; a group needs >= 2 members
+        by_dtype: Dict[str, List[int]] = {}
+        for i, (_, dt) in enumerate(self.specs):
+            if sizes[i] < COALESCE_MAX_ELEMS and any(
+                dt == d for d in _COALESCE_DTYPES
+            ):
+                by_dtype.setdefault(dt.name, []).append(i)
+        groups = [idxs for _, idxs in sorted(by_dtype.items())
+                  if len(idxs) >= 2]
+        self.coalesced = {i for g in groups for i in g}
+        solo = [i for i in range(len(self.specs)) if i not in self.coalesced]
+        items: List[ShipItem] = []
+        buffers: List[Tuple[int, np.dtype]] = []  # (elems, dtype)
+        # flats FIRST: the degenerate first bucket(s)
+        for g in groups:
+            offs, total = [], 0
+            dt = self.specs[g[0]][1]
+            for i in g:
+                offs.append(total)
+                total += sizes[i]
+            items.append(ShipItem("flat", tuple(g), dt, total, False,
+                                  len(buffers), offsets=tuple(offs)))
+            buffers.append((total, dt))
+        for i in solo:
+            _, dt = self.specs[i]
+            q8 = (quantize and dt == np.dtype(np.float32)
+                  and sizes[i] >= COALESCE_MAX_ELEMS)
+            items.extend(_chunk_items(i, dt, sizes[i], q8,
+                                      len(buffers), self.chunk_bytes))
+            buffers.append((sizes[i], dt))
+        self.items = items
+        self.buffers = buffers
+        self.buckets = _bucketize(items, self.bucket_bytes)
+
+    def signature(self) -> tuple:
+        return (tuple(self.specs),
+                tuple(it.q8 for it in self.items), self.bucket_bytes,
+                self.chunk_bytes)
+
+    @classmethod
+    def pre_shipped(cls, specs, q_flags: Sequence[bool],
+                    bucket_bytes: Optional[int] = None,
+                    chunk_bytes: Optional[int] = None) -> "ShipPlan":
+        """A plan over ALREADY-packed wire items (ddp.sync_grads ships
+        its coalesced flats + solos through io_callback): no
+        re-coalescing — only the slot-aligned chunking of oversized
+        arrays and the size-targeted bucketing."""
+        plan = cls.__new__(cls)
+        if bucket_bytes is None:
+            bucket_bytes = int(os.environ.get(
+                "PTD_GRAD_BUCKET_BYTES", DEFAULT_BUCKET_BYTES
+            ))
+        plan.bucket_bytes = max(int(bucket_bytes), 1)
+        plan.chunk_bytes = int(chunk_bytes or DEFAULT_BUCKET_BYTES)
+        plan.specs = [(tuple(s), np.dtype(d)) for s, d in specs]
+        plan.sizes = [int(np.prod(s, dtype=np.int64))
+                      for s, _ in plan.specs]
+        plan.coalesced = set()
+        items: List[ShipItem] = []
+        buffers: List[Tuple[int, np.dtype]] = []
+        for i, ((_, dt), qf) in enumerate(zip(plan.specs, q_flags)):
+            items.extend(_chunk_items(i, dt, plan.sizes[i], bool(qf),
+                                      len(buffers), plan.chunk_bytes))
+            buffers.append((plan.sizes[i], dt))
+        plan.items = items
+        plan.buffers = buffers
+        plan.buckets = _bucketize(items, plan.bucket_bytes)
+        return plan
+
+
+def ship_plan_for_leaves(leaves, *, quantize: bool = False,
+                         bucket_bytes: Optional[int] = None) -> ShipPlan:
+    """Plan from concrete arrays / ShapeDtypeStructs (shape+dtype duck)."""
+    return ShipPlan(
+        [(np.shape(x), np.dtype(x.dtype)) for x in leaves],
+        quantize=quantize, bucket_bytes=bucket_bytes,
+    )
+
+
+# --------------------------------------------------------------------------
+# numpy replication of the native block quantizer (error feedback).
+# --------------------------------------------------------------------------
+def q8_local_roundtrip(x: np.ndarray) -> np.ndarray:
+    """``dequant(quant(x))`` per 256-element block, replicating
+    ``native/hostring.cpp``'s ``quantize_block`` (scale = amax/127,
+    ``x * (1/scale)`` in f32, clamp ±127, round half away from zero).
+    Non-finite blocks dequantize to NaN, like the native side."""
+    x = np.ascontiguousarray(x, dtype=np.float32).reshape(-1)
+    n = x.size
+    pad = (-n) % _Q8_BLOCK
+    xp = np.pad(x, (0, pad)).reshape(-1, _Q8_BLOCK)
+    amax = np.max(np.abs(xp), axis=1)
+    bad = ~(amax <= np.float32(3.4e38))  # False for NaN/inf, like the C
+    s = (amax / np.float32(127.0)).astype(np.float32)
+    safe = np.where(s > 0, s, np.float32(1.0))
+    inv = (np.float32(1.0) / safe).astype(np.float32)
+    v = xp * inv[:, None]
+    v = np.clip(v, np.float32(-127.0), np.float32(127.0))
+    q = np.trunc(v + np.copysign(np.float32(0.5), v))
+    out = (q * s[:, None]).astype(np.float32)
+    out[s == 0] = 0.0
+    out[bad] = np.nan
+    return out.reshape(-1)[:n]
+
+
+# --------------------------------------------------------------------------
+# The engine: one comm thread, a FIFO bucket queue, reusable staging.
+# --------------------------------------------------------------------------
+_STOP = object()
+
+
+class _Pending:
+    """One in-flight sync: per-bucket completion + timing + error."""
+
+    __slots__ = ("total_buckets", "done", "comm_s", "error", "_cv")
+
+    def __init__(self, total_buckets: int):
+        self.total_buckets = total_buckets
+        self.done = 0
+        self.comm_s = 0.0
+        self.error: Optional[BaseException] = None
+        self._cv = threading.Condition()
+
+    def _bucket_done(self, seconds: float,
+                     error: Optional[BaseException]) -> None:
+        with self._cv:
+            self.done += 1
+            self.comm_s += seconds
+            if error is not None and self.error is None:
+                self.error = error
+            self._cv.notify_all()
+
+    def wait(self, timeout_s: float) -> None:
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while self.done < self.total_buckets:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise RuntimeError(
+                        "grad-sync pipeline drain timed out "
+                        f"({self.done}/{self.total_buckets} buckets)"
+                    )
+                self._cv.wait(left)
+
+
+class GradSyncEngine:
+    """Process-level pipelined reducer bound to ONE HostRingGroup.
+
+    All collectives issue from the single comm thread in FIFO bucket
+    order (deterministic — see the module docstring); the main thread
+    packs, drains and unpacks. A ring failure (peer death, deadline)
+    poisons the engine: the error surfaces on ``drain`` and every later
+    call refuses loudly until :func:`reset_engine` — the elastic path
+    re-meshes onto a fresh ring and a fresh engine (the chaos drill in
+    tests/test_overlap.py proves the recovery).
+    """
+
+    def __init__(self, ring, *, bucket_bytes: Optional[int] = None):
+        self.ring = ring
+        self.bucket_bytes = bucket_bytes
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._dead: Optional[BaseException] = None
+        self._plans: Dict[tuple, ShipPlan] = {}
+        # staging double-buffers per plan signature: generation g's
+        # output arrays may still be aliased by a jit consumer while
+        # generation g^1 is being packed; g is only rewritten two syncs
+        # later, after its consumer provably completed (DESIGN.md §19)
+        self._staging: Dict[tuple, list] = {}
+        self._residuals: Dict[tuple, Dict[int, np.ndarray]] = {}
+        self._gen = 0
+        self._named_tracer = None
+        # cumulative stats (the bench's exposed/hidden account)
+        self.syncs = 0
+        self.comm_s_total = 0.0
+        self.exposed_s_total = 0.0
+        self.hidden_s_total = 0.0
+
+    # -- lifecycle ---------------------------------------------------------
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._comm_loop, name="grad-sync-comm", daemon=True
+            )
+            self._thread.start()
+
+    def close(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            self._q.put(_STOP)
+            self._thread.join(timeout=5)
+        self._thread = None
+
+    def _check_alive(self) -> None:
+        if self._dead is not None:
+            raise RuntimeError(
+                "grad-sync pipeline is poisoned by an earlier ring "
+                f"failure ({self._dead}) — re-mesh the world and call "
+                "parallel.overlap.reset_engine() for a fresh pipeline"
+            )
+
+    # -- the comm thread ---------------------------------------------------
+    def _comm_loop(self) -> None:
+        while True:
+            task = self._q.get()
+            if task is _STOP:
+                return
+            bucket, pending = task
+            tr = tracing._tracer
+            if tr is not None and self._named_tracer is not tr:
+                # the comm.* spans below land on this thread's tid; name
+                # the track once per tracer so Perfetto shows "grad-sync-
+                # comm" instead of a bare thread id
+                self._named_tracer = tr
+                tracing.name_thread("grad-sync-comm")
+            err: Optional[BaseException] = None
+            t0 = time.perf_counter()
+            try:
+                if pending.error is None and self._dead is None:
+                    # a failed bucket poisons the WHOLE sync: issuing
+                    # later buckets on an aborted ring would desync peers
+                    faults.check("comm.overlap_stall")
+                    for work in bucket:
+                        work()
+            except BaseException as e:  # noqa: BLE001 - surfaced on drain
+                err = e
+                self._dead = e
+            pending._bucket_done(time.perf_counter() - t0, err)
+
+    # -- plan/staging ------------------------------------------------------
+    def _plan(self, specs, quantize: bool) -> ShipPlan:
+        key = (tuple((tuple(s), np.dtype(d).name) for s, d in specs),
+               bool(quantize))
+        plan = self._plans.get(key)
+        if plan is None:
+            plan = ShipPlan(
+                specs, quantize=quantize,
+                bucket_bytes=self.bucket_bytes,
+                # chunking MUST follow the ring's own slot stride — any
+                # other boundary changes hr_allreduce's per-chunk segment
+                # ownership and breaks bit-identity vs the unsplit call
+                chunk_bytes=getattr(self.ring, "slot_bytes", None),
+            )
+            self._plans[key] = plan
+        return plan
+
+    def _buffers(self, plan: ShipPlan, gen: int) -> List[np.ndarray]:
+        key = (plan.signature(), gen)
+        bufs = self._staging.get(key)
+        if bufs is None:
+            bufs = [np.empty(elems, dt) for elems, dt in plan.buffers]
+            self._staging[key] = bufs
+        return bufs
+
+    @staticmethod
+    def _view(plan: ShipPlan, bufs: List[np.ndarray],
+              item: ShipItem) -> np.ndarray:
+        return bufs[item.parent][item.start:item.start + item.elems]
+
+    def _residual(self, plan: ShipPlan, item_idx: int,
+                  elems: int) -> np.ndarray:
+        per_plan = self._residuals.setdefault(plan.signature(), {})
+        r = per_plan.get(item_idx)
+        if r is None:
+            r = per_plan[item_idx] = np.zeros(elems, np.float32)
+        return r
+
+    def reset_residuals(self) -> None:
+        """Drop all error-feedback state (a fresh training run)."""
+        self._residuals.clear()
+
+    # -- reduction work ----------------------------------------------------
+    def _reduce_item(self, plan: ShipPlan, item_idx: int,
+                     view: np.ndarray) -> None:
+        """Ring-reduce one packed ship item IN PLACE (comm thread)."""
+        item = plan.items[item_idx]
+        if item.q8:
+            res = self._residual(plan, item_idx, item.elems)
+            # error feedback: ship g + e, keep e' = (g+e) - Q(g+e)
+            np.add(view, res, out=view)
+            rt = q8_local_roundtrip(view)
+            np.subtract(view, rt, out=res)
+            # a non-finite block round-trips to NaN (deliberately loud
+            # on the wire); the residual must not carry that poison
+            # into every later step once training recovers
+            np.copyto(res, 0.0, where=~np.isfinite(res))
+            self.ring.all_reduce_q8(view, op="avg", inplace=True)
+        else:
+            self.ring.all_reduce(view, op="avg", inplace=True)
+
+    # -- public: io_callback path (sync_grads) -----------------------------
+    def reduce_shipped(self, arrs: Sequence, q_flags: Sequence[bool]
+                       ) -> Tuple[List[np.ndarray], dict]:
+        """Average pre-packed ship arrays across ranks.
+
+        ``arrs`` are the jit-side ship items (coalesced flats + solo
+        leaves, already cast to their wire dtype) in plan order — the
+        engine re-derives the same plan from their specs and asserts the
+        q8 flags agree, packs each into reusable staging, and pipelines
+        pack(b+1) ∥ ring-reduce(b). Returns (reduced arrays in ship
+        order, stats).
+        """
+        self._check_alive()
+        self._ensure_thread()
+        specs = [(np.shape(a), np.dtype(a.dtype)) for a in arrs]
+        key = (tuple((tuple(s), np.dtype(d).name) for s, d in specs),
+               ("shipped",) + tuple(bool(f) for f in q_flags))
+        plan = self._plans.get(key)
+        if plan is None:
+            plan = ShipPlan.pre_shipped(
+                specs, q_flags, bucket_bytes=self.bucket_bytes,
+                chunk_bytes=getattr(self.ring, "slot_bytes", None),
+            )
+            self._plans[key] = plan
+        gen = self._gen
+        self._gen ^= 1
+        bufs = self._buffers(plan, gen)
+        pending = _Pending(len(plan.buckets))
+        t_start = time.perf_counter()
+        flat_srcs: Dict[int, np.ndarray] = {}
+        for bucket in plan.buckets:
+            work = []
+            for j in bucket:
+                item = plan.items[j]
+                src = flat_srcs.get(item.parent)
+                if src is None:
+                    src = flat_srcs[item.parent] = np.asarray(
+                        arrs[item.parent]
+                    ).reshape(-1)
+                view = self._view(plan, bufs, item)
+                np.copyto(view, src[item.start:item.start + item.elems])
+                work.append(self._make_work(plan, j, view))
+            self._q.put((work, pending))
+        stats = self._drain(pending, t_start)
+        out = [
+            bufs[p].reshape(plan.specs[p][0])
+            for p in range(len(plan.buffers))
+        ]
+        return out, stats
+
+    def _make_work(self, plan: ShipPlan, j: int, view: np.ndarray):
+        return lambda: self._reduce_item(plan, j, view)
+
+    # -- public: host-loop accumulation path (overlap_accum) ---------------
+    def begin_accum(self, specs, *, quantize: bool = False) -> "AccumSession":
+        self._check_alive()
+        self._ensure_thread()
+        return AccumSession(self, self._plan(specs, quantize))
+
+    # -- drain/stats -------------------------------------------------------
+    def _drain(self, pending: _Pending, t_start: float) -> dict:
+        t0 = time.perf_counter()
+        tr = tracing._tracer
+        # the drain wait IS the exposed comm: everything the main thread
+        # still blocks on after its concurrent work ran out — its span
+        # duration is the per-sync comm_exposed the rollups report
+        span = (
+            tracing._NULL_SPAN if tr is None
+            else tracing._Span(tr, "comm.sync_drain", None)
+        )
+        with span:
+            pending.wait(timeout_s=self.ring.timeout_s * (
+                pending.total_buckets + 2
+            ))
+        exposed = time.perf_counter() - t0
+        if pending.error is not None:
+            raise RuntimeError(
+                "grad-sync pipeline failed mid-drain (a peer died or "
+                "the ring deadline passed) — survivors should re-mesh "
+                f"and reset_engine(): {pending.error}"
+            ) from pending.error
+        comm = pending.comm_s
+        hidden = max(comm - exposed, 0.0)
+        self.syncs += 1
+        self.comm_s_total += comm
+        self.exposed_s_total += exposed
+        self.hidden_s_total += hidden
+        tr = tracing._tracer
+        if tr is not None:
+            tr.counter("comm.sync.exposed_s",
+                       round(self.exposed_s_total, 6))
+            tr.counter("comm.sync.hidden_s",
+                       round(self.hidden_s_total, 6))
+        return {
+            "comm_s": comm,
+            "exposed_s": min(exposed, comm),
+            "hidden_s": hidden,
+            "wall_s": time.perf_counter() - t_start,
+            "buckets": pending.total_buckets,
+        }
+
+    def stats(self) -> dict:
+        total = self.comm_s_total
+        return {
+            "syncs": self.syncs,
+            "comm_s": total,
+            "exposed_s": min(self.exposed_s_total, total),
+            "hidden_s": self.hidden_s_total,
+            "exposed_ratio": (
+                min(self.exposed_s_total, total) / total if total > 0
+                else 0.0
+            ),
+        }
+
+
+class AccumSession:
+    """Microbatch accumulation straight into the wire staging buffers.
+
+    ``add`` folds one microbatch's per-leaf gradients into the staging
+    (first add copies, later adds accumulate — the exact left-fold
+    association ``lax.scan`` uses, so the local sums are bit-identical
+    to the scanned path's). ``finish`` applies the 1/accum scale and
+    enqueues buckets STAGGERED: bucket b's ring reduce starts while the
+    main thread is still scaling/finalizing bucket b+1 (and, at the
+    caller's level, staging its next batch). ``drain`` blocks, unpacks,
+    and reports the exposed/hidden split.
+    """
+
+    def __init__(self, engine: GradSyncEngine, plan: ShipPlan):
+        self.engine = engine
+        self.plan = plan
+        gen = engine._gen
+        engine._gen ^= 1
+        self.bufs = engine._buffers(plan, gen)
+        self.adds = 0
+        self._pending: Optional[_Pending] = None
+        self._t_start = time.perf_counter()
+
+    def _pieces(self, item: ShipItem, flat_leaves):
+        """(dst staging view, src leaf slice) pairs for one item."""
+        view = self.engine._view(self.plan, self.bufs, item)
+        if item.kind == "flat":
+            for leaf, loff in zip(item.leaf_ids, item.offsets):
+                n = self.plan.sizes[leaf]
+                yield view[loff:loff + n], flat_leaves[leaf]
+        else:
+            leaf = item.leaf_ids[0]
+            yield view, flat_leaves[leaf][
+                item.start:item.start + item.elems
+            ]
+
+    @staticmethod
+    def _flat(leaves: Sequence) -> List[np.ndarray]:
+        return [np.asarray(x).reshape(-1) for x in leaves]
+
+    def _fold(self, item: ShipItem, flat_leaves, first: bool) -> None:
+        for dst, src in self._pieces(item, flat_leaves):
+            if first:
+                np.copyto(dst, src, casting="unsafe")
+            else:
+                np.add(dst, src, out=dst, casting="unsafe")
+
+    def add(self, leaves: Sequence[np.ndarray]) -> None:
+        first = self.adds == 0
+        flat_leaves = self._flat(leaves)
+        for item in self.plan.items:
+            self._fold(item, flat_leaves, first)
+        self.adds += 1
+
+    def finish(self, last_leaves: Sequence[np.ndarray],
+               scale: float) -> None:
+        """Fold the LAST microbatch in bucket-by-bucket, scaling and
+        enqueueing each bucket as it completes — the pipeline's comm
+        starts before the host finishes accumulating later buckets."""
+        first = self.adds == 0
+        self.adds += 1
+        flat_leaves = self._flat(last_leaves)
+        pending = _Pending(len(self.plan.buckets))
+        self._pending = pending
+        for bucket in self.plan.buckets:
+            work = []
+            for j in bucket:
+                item = self.plan.items[j]
+                self._fold(item, flat_leaves, first)
+                view = self.engine._view(self.plan, self.bufs, item)
+                if scale != 1.0:
+                    np.multiply(
+                        view, np.float32(scale).astype(view.dtype),
+                        out=view,
+                    )
+                work.append(self.engine._make_work(self.plan, j, view))
+            self.engine._q.put((work, pending))
+
+    def drain(self) -> Tuple[List[np.ndarray], dict]:
+        """Wait for the ring, return (per-LEAF reduced arrays, stats)."""
+        if self._pending is None:
+            raise RuntimeError("drain() before finish()")
+        stats = self.engine._drain(self._pending, self._t_start)
+        out: List[Optional[np.ndarray]] = [None] * len(self.plan.specs)
+        for item in self.plan.items:
+            view = self.engine._view(self.plan, self.bufs, item)
+            if item.kind == "flat":
+                for leaf, loff in zip(item.leaf_ids, item.offsets):
+                    n = self.plan.sizes[leaf]
+                    out[leaf] = view[loff:loff + n].reshape(
+                        self.plan.specs[leaf][0]
+                    )
+            elif item.kind == "solo":
+                leaf = item.leaf_ids[0]
+                out[leaf] = view.reshape(self.plan.specs[leaf][0])
+            else:  # chunk: the parent buffer IS the contiguous leaf
+                leaf = item.leaf_ids[0]
+                out[leaf] = self.bufs[item.parent].reshape(
+                    self.plan.specs[leaf][0]
+                )
+        return out, stats  # type: ignore[return-value]
+
+
+# --------------------------------------------------------------------------
+# The process-level engine registry (one engine per live ring).
+# --------------------------------------------------------------------------
+_ENGINE: Optional[GradSyncEngine] = None
+_ENGINE_KEY = None
+
+
+def _ring_key(ring) -> tuple:
+    return (id(ring), getattr(ring, "name", None), ring.rank,
+            ring.world_size)
+
+
+def get_engine(ring, *, bucket_bytes: Optional[int] = None
+               ) -> GradSyncEngine:
+    """The engine bound to ``ring`` — rebuilt whenever the ring changes
+    (an elastic re-mesh swaps rings; the old engine's queue and staging
+    must not survive onto the new membership)."""
+    global _ENGINE, _ENGINE_KEY
+    key = _ring_key(ring)
+    if _ENGINE is None or _ENGINE_KEY != key:
+        if _ENGINE is not None:
+            _ENGINE.close()
+        _ENGINE = GradSyncEngine(ring, bucket_bytes=bucket_bytes)
+        _ENGINE_KEY = key
+    return _ENGINE
+
+
+def reset_engine() -> None:
+    """Drop the process engine (staging, residuals, comm thread).
+
+    The elastic recovery path: after a peer death poisons the pipeline,
+    survivors re-mesh onto a fresh ring and the next ``get_engine``
+    builds a clean pipeline for it.
+    """
+    global _ENGINE, _ENGINE_KEY
+    if _ENGINE is not None:
+        _ENGINE.close()
+    _ENGINE = None
+    _ENGINE_KEY = None

@@ -73,6 +73,13 @@ Known sites (grep for ``faults.check`` to find the exact spots):
 ``elastic.rejoin``   at the top of ``WorldMembership.join`` — a kill
                      here is a joiner that announced and vanished; the
                      incumbents must burn the epoch and re-settle
+``comm.overlap_stall`` in the grad-sync comm pipeline
+                     (``parallel/overlap.py``), before each bucket's
+                     ring reduce — ``mode=kill`` makes this rank die
+                     MID-PIPELINE (some buckets reduced, some queued);
+                     survivors' ring hits its deadline, the pipeline
+                     poisons itself, and the elastic re-mesh + a fresh
+                     engine recover (tests/test_overlap.py's chaos case)
 ================== ====================================================
 """
 
@@ -116,6 +123,7 @@ KNOWN_SITES = (
     "elastic.peer_lost",
     "elastic.resize",
     "elastic.rejoin",
+    "comm.overlap_stall",
 )
 _MODES = ("raise", "kill", "truncate", "bitflip")
 

@@ -296,6 +296,12 @@ class HostRingGroup:
         self.rank = rank
         self.world_size = world_size
         self.timeout_s = timeout_s
+        #: the per-rank shm slot size: hr_allreduce processes payloads in
+        #: slot-sized chunks with segment ownership computed PER CHUNK —
+        #: the grad-sync pipeline (parallel/overlap.py) splits oversized
+        #: leaves at exactly these boundaries, which is what makes the
+        #: split bit-identical to the unsplit call
+        self.slot_bytes = int(slot_bytes)
         if debug is None:
             # DETAIL turns on cross-rank call verification, the analogue
             # of TORCH_DISTRIBUTED_DEBUG=DETAIL (SURVEY.md §5: collective
@@ -429,7 +435,8 @@ class HostRingGroup:
             a //= self.world_size
         return a
 
-    def all_reduce_q8(self, x, op: str = "sum") -> np.ndarray:
+    def all_reduce_q8(self, x, op: str = "sum", *,
+                      inplace: bool = False) -> np.ndarray:
         """Block-quantized f32 allreduce (EQuARX-style, PAPERS.md): int8
         payload + one f32 scale per 256 elements on the wire (~4x fewer
         bytes), f32 accumulation, identical results on every rank. Lossy
@@ -448,7 +455,19 @@ class HostRingGroup:
             raise TypeError(
                 f"q8 allreduce is f32-only, got {np.asarray(x).dtype}"
             )
-        a = np.ascontiguousarray(x, dtype=np.float32).copy()
+        if inplace:
+            # the grad-sync pipeline's staging buffers: same contract as
+            # all_reduce(inplace=True) — a buffer needing conversion
+            # would silently reduce into a private copy
+            a = _as_contig(x)
+            if a is not x:
+                raise ValueError(
+                    "all_reduce_q8(inplace=True) needs a C-contiguous "
+                    f"f32 ndarray; got {type(x).__name__} needing "
+                    "conversion"
+                )
+        else:
+            a = np.ascontiguousarray(x, dtype=np.float32).copy()
         if self.debug:
             self._verify_uniform("all_reduce_q8", a, op)
         tr = tracing._tracer

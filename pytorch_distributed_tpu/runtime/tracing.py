@@ -236,6 +236,21 @@ class Tracer:
         with self._lock:
             self._append(ev)
 
+    def name_thread(self, name: str) -> None:
+        """Label the CALLING thread's track in the export (Chrome "M"
+        thread_name metadata) — the grad-sync comm thread names its own
+        lane so ``comm.*`` spans issued off the main thread read as
+        "grad-sync-comm" in Perfetto, not a bare thread id."""
+        ev = {
+            "name": "thread_name",
+            "ph": "M",
+            "pid": self._pid,
+            "tid": threading.get_ident(),
+            "args": {"name": name},
+        }
+        with self._lock:
+            self._append(ev)
+
     def counter(self, name: str, value: float) -> None:
         ev = {
             "name": name,
@@ -368,6 +383,14 @@ def instant(name: str, **args) -> None:
     if t is None:
         return
     t.instant(name, args or None)
+
+
+def name_thread(name: str) -> None:
+    """Name the calling thread's trace track; no-op when disarmed."""
+    t = _tracer
+    if t is None:
+        return
+    t.name_thread(name)
 
 
 def counter(name: str, value) -> None:

@@ -95,6 +95,53 @@ def _split_microbatches(batch, accum_steps: int):
     return jax.tree_util.tree_map(split, batch)
 
 
+def _apply_update(state, grads, new_stats, loss_value, *, scaler,
+                  scaling, ema_decay):
+    """Post-sync optimizer / scaler-skip / EMA section — ONE definition
+    shared by the scanned step and HostLoopStep, so the two paths'
+    update math cannot drift (the cross-mode bit-identity pins depend
+    on these being the same expressions). Returns
+    ``(new_state, extra_metrics)``."""
+    extra = {}
+    if scaling:
+        new_scaler_state, grads_ok = scaler.functional_update(
+            grads, state.scaler_state
+        )
+        candidate = state.apply_gradients(
+            grads, batch_stats=new_stats, scaler_state=new_scaler_state,
+            loss_value=loss_value,
+        )
+        skipped = state.replace(
+            scaler_state=new_scaler_state, step=state.step + 1
+        )
+        new_state = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(grads_ok, a, b), candidate, skipped
+        )
+        extra["loss_scale"] = new_scaler_state.scale
+        extra["grads_finite"] = grads_ok.astype(jnp.float32)
+    else:
+        new_state = state.apply_gradients(
+            grads, batch_stats=new_stats, loss_value=loss_value
+        )
+
+    if ema_decay is not None:
+        if state.ema_params is None:
+            raise ValueError(
+                "ema_decay set but the state has no shadow params — "
+                "create it with TrainState.create(..., ema=True)"
+            )
+        d = ema_decay
+        new_state = new_state.replace(
+            ema_params=jax.tree_util.tree_map(
+                # accumulate in the shadow's dtype (f32): see
+                # TrainState.create's half-ulp note
+                lambda e, p: d * e + (1.0 - d) * p.astype(e.dtype),
+                new_state.ema_params, new_state.params,
+            )
+        )
+    return new_state, extra
+
+
 def build_train_step(
     loss_fn: LossFn,
     *,
@@ -103,6 +150,8 @@ def build_train_step(
     batch_transform: Optional[Callable[[Any], Any]] = None,
     grad_compression: Optional[str] = None,
     ema_decay: Optional[float] = None,
+    overlap_accum: bool = False,
+    reduce_schedule: str = "step",
 ) -> Callable[[TrainState, Any], Tuple[TrainState, Dict[str, jax.Array]]]:
     """Build ``step(state, batch) -> (state, metrics)`` for jit/Strategy.compile.
 
@@ -129,7 +178,35 @@ def build_train_step(
     ``ema = d*ema + (1-d)*params`` after every optimizer update) — create
     the state with ``TrainState.create(..., ema=True)``; evaluate the
     shadow via ``TrainerConfig(eval_with_ema=True)``.
+
+    ``overlap_accum=True`` (opt-in, the multi-process/1-device-per-rank
+    path) hoists the microbatch loop OUT of ``lax.scan`` into
+    host-dispatched programs so gradient sync can pipeline with the
+    step's own work: per-microbatch grads are fetched as JAX's async
+    dispatch computes the next microbatch, accumulated straight into
+    the grad-sync engine's wire staging in fixed microbatch order (the
+    exact left-fold ``lax.scan`` uses — bit-identical local sums), and
+    the bucketed ring reduce drains on a comm thread while the host
+    finishes accumulating later buckets / staging the next batch (the
+    ``begin()``/``finish()`` split exposes the overlap window to custom
+    loops). The returned step is a :class:`HostLoopStep` — a callable
+    with the same ``(state, batch) -> (state, metrics)`` contract that
+    the Trainer uses as-is (it compiles its own three programs: prep,
+    per-microbatch grad, apply — each exactly once). See DESIGN.md §19
+    for the bit-exactness argument and the honest 1-core limits.
     """
+    if overlap_accum:
+        return HostLoopStep(
+            loss_fn, accum_steps=accum_steps, scaler=scaler,
+            batch_transform=batch_transform,
+            grad_compression=grad_compression, ema_decay=ema_decay,
+            reduce_schedule=reduce_schedule,
+        )
+    if reduce_schedule != "step":
+        raise ValueError(
+            "reduce_schedule is an overlap_accum option — the scanned "
+            "step has exactly one (end-of-step) reduce"
+        )
     if ema_decay is not None and not 0.0 <= ema_decay < 1.0:
         # d=1 freezes the shadow at init (eval_with_ema then silently
         # scores random weights); d>1 diverges
@@ -219,49 +296,326 @@ def build_train_step(
         # through the extra-args channel; None when the loss_fn reports no
         # "loss" metric
         loss_value = metrics.get("loss")
-        if scaling:
-            new_scaler_state, grads_ok = scaler.functional_update(
-                grads, state.scaler_state
-            )
-            candidate = state.apply_gradients(
-                grads, batch_stats=new_stats, scaler_state=new_scaler_state,
-                loss_value=loss_value,
-            )
-            skipped = state.replace(
-                scaler_state=new_scaler_state, step=state.step + 1
-            )
-            new_state = jax.tree_util.tree_map(
-                lambda a, b: jnp.where(grads_ok, a, b), candidate, skipped
-            )
-            metrics["loss_scale"] = new_scaler_state.scale
-            metrics["grads_finite"] = grads_ok.astype(jnp.float32)
-        else:
-            new_state = state.apply_gradients(
-                grads, batch_stats=new_stats, loss_value=loss_value
-            )
-
-        if ema_decay is not None:
-            if state.ema_params is None:
-                raise ValueError(
-                    "ema_decay set but the state has no shadow params — "
-                    "create it with TrainState.create(..., ema=True)"
-                )
-            d = ema_decay
-            new_state = new_state.replace(
-                ema_params=jax.tree_util.tree_map(
-                    # accumulate in the shadow's dtype (f32): see
-                    # TrainState.create's half-ulp note
-                    lambda e, p: d * e + (1.0 - d) * p.astype(e.dtype),
-                    new_state.ema_params, new_state.params,
-                )
-            )
-
+        new_state, extra = _apply_update(
+            state, grads, new_stats, loss_value,
+            scaler=scaler, scaling=scaling, ema_decay=ema_decay,
+        )
+        metrics.update(extra)
         return new_state, metrics
 
     # introspection for Trainer guards: distinguishes "built by this
     # factory without EMA" (attr None) from a user's custom step (absent)
     step._ptd_ema_decay = ema_decay
     return step
+
+
+class HostLoopStep:
+    """``build_train_step(overlap_accum=True)``'s step: the microbatch
+    loop runs on the HOST so gradient sync can pipeline.
+
+    Same ``(state, batch) -> (state, metrics)`` contract as the jitted
+    step, compiled as exactly THREE programs (each once): ``prep``
+    (batch transform + microbatch split), ``grad`` (one microbatch's
+    gradients + metrics + batch_stats, called ``accum_steps`` times per
+    step with the microbatch index as a traced argument), and ``apply``
+    (the identical post-sync optimizer/scaler/EMA section). Between
+    them the host fetches each microbatch's grads while JAX's async
+    dispatch executes the next one, folds them into the grad-sync
+    engine's wire staging in fixed microbatch order — the same
+    left-fold association ``lax.scan`` uses, so the local sums are
+    bit-identical to the scanned path's — and the bucketed ring reduce
+    drains on the comm thread.
+
+    ``begin(state, batch) -> pending`` / ``finish(pending)`` split the
+    step at the point where every bucket is enqueued: a custom loop
+    stages its NEXT batch between the two calls and that work runs
+    while the ring drains (the bench's ``overlap`` phase and the
+    DataLoader's producer thread both live in that window).
+    ``__call__`` is ``finish(begin(...))`` — what the Trainer uses.
+
+    Scope (documented, not discovered): the multi-process hostring /
+    single-device-per-rank path. SPMD strategies keep the scanned step
+    — a host loop cannot carry their shardings. ``grad_compression``
+    supports ``None`` and ``"int8"`` (with error feedback); the half
+    casts stay on the scanned path.
+    """
+
+    _ptd_host_step = True
+
+    def __init__(self, loss_fn, *, accum_steps=1, scaler=None,
+                 batch_transform=None, grad_compression=None,
+                 ema_decay=None, reduce_schedule="step"):
+        if ema_decay is not None and not 0.0 <= ema_decay < 1.0:
+            raise ValueError(
+                f"ema_decay must be in [0, 1), got {ema_decay}"
+            )
+        if grad_compression not in (None, "int8"):
+            raise ValueError(
+                "overlap_accum supports grad_compression None or "
+                f"'int8', got {grad_compression!r} — half-precision "
+                "wire casts stay on the scanned path"
+            )
+        if reduce_schedule not in ("step", "microbatch"):
+            raise ValueError(
+                f"reduce_schedule must be 'step' or 'microbatch', "
+                f"got {reduce_schedule!r}"
+            )
+        if reduce_schedule == "microbatch" and grad_compression == "int8":
+            # per-item error-feedback residuals assume one quantized
+            # sync per step; A syncs/step would fold A residual updates
+            # into one leaf — refuse rather than silently change the math
+            raise ValueError(
+                "reduce_schedule='microbatch' does not compose with "
+                "grad_compression='int8' (error feedback is per step)"
+            )
+        if accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        self.reduce_schedule = reduce_schedule
+        self.accum_steps = accum_steps
+        self.scaler = scaler
+        self.ema_decay = ema_decay
+        self.grad_compression = grad_compression
+        self._ptd_ema_decay = ema_decay
+        self.last_sync_stats: Optional[Dict[str, float]] = None
+        scaling = scaler is not None and scaler.enabled
+        self._scaling = scaling
+        takes_rng = (
+            batch_transform is not None and _accepts_rng(batch_transform)
+        )
+
+        def grad_fn(params, batch_stats, mb, rng, scaler_state):
+            def scaled_loss(p):
+                loss, aux = loss_fn(p, batch_stats, mb, rng)
+                if scaling:
+                    loss = scaler.scale_value(loss, scaler_state)
+                return loss, aux
+
+            (_, aux), grads = jax.value_and_grad(
+                scaled_loss, has_aux=True
+            )(params)
+            if scaling:
+                grads = scaler.unscale_grads(grads, scaler_state)
+            return grads, aux
+
+        def prep(state, batch):
+            rng = key_for(state.step)
+            if batch_transform is not None:
+                if takes_rng:
+                    batch = batch_transform(
+                        batch, jax.random.fold_in(rng, 0x617567)
+                    )
+                else:
+                    batch = batch_transform(batch)
+            return _split_microbatches(batch, accum_steps)
+
+        def grad_one(state, batch_stats, mb, i):
+            rng = key_for(state.step)
+            # accum==1 keeps the scanned/plain path's key exactly;
+            # accum>1 folds the microbatch index like the scan body
+            k = rng if accum_steps == 1 else jax.random.fold_in(
+                rng, i.astype(jnp.int32)
+            )
+            grads, aux = grad_fn(
+                state.params, batch_stats, mb, k, state.scaler_state
+            )
+            return (
+                grads,
+                dict(aux.get("metrics", {})),
+                aux.get("batch_stats", batch_stats),
+            )
+
+        def apply(state, grads, new_stats, loss_value):
+            # the SAME shared section the scanned step jits — any drift
+            # here would break the cross-mode bit-identity pins
+            return _apply_update(
+                state, grads, new_stats, loss_value,
+                scaler=scaler, scaling=scaling, ema_decay=ema_decay,
+            )
+
+        self._prep = jax.jit(prep)
+        self._grad = jax.jit(grad_one)
+        self._apply_fn = apply
+        self._apply = None  # built lazily: loss presence is static
+        self._apply_has_loss = None
+
+    # -- introspection ------------------------------------------------------
+    def compile_counts(self) -> Dict[str, Optional[int]]:
+        from pytorch_distributed_tpu.runtime.compat import jit_cache_size
+
+        return {
+            "prep": jit_cache_size(self._prep),
+            "grad": jit_cache_size(self._grad),
+            "apply": (
+                jit_cache_size(self._apply)
+                if self._apply is not None else 0
+            ),
+        }
+
+    # -- the two-phase step -------------------------------------------------
+    def begin(self, state, batch):
+        """Dispatch + fetch + accumulate; returns with every grad-sync
+        bucket ENQUEUED — work done by the caller before ``finish`` runs
+        concurrently with the ring drain.
+
+        ``reduce_schedule="step"`` (default): microbatch grads fold into
+        the wire staging as local sums (bit-identical to the scanned
+        step's left fold) and ONE bucketed reduce drains at the end —
+        the lowest-wire-volume schedule, the right one when comm rides
+        a memcpy-bound transport. ``reduce_schedule="microbatch"``: each
+        microbatch's grads ring-reduce as soon as they land, while
+        JAX's async dispatch executes the NEXT microbatch — true
+        structural comm/compute overlap (the veScale shape), at
+        ``accum_steps`` x the wire volume; reduced sums fold on the
+        host in fixed microbatch order (the elastic_world fixed-shard
+        discipline), so the result is deterministic and lockstep across
+        ranks, and equals the step schedule's up to summation
+        association (last-ulp — see DESIGN.md §19).
+        """
+        from pytorch_distributed_tpu.parallel.overlap import get_engine
+        from pytorch_distributed_tpu.runtime import distributed as dist
+
+        A = self.accum_steps
+        mbs = self._prep(state, batch)
+        stats = state.batch_stats
+        outs = []
+        for i in range(A):
+            mb = jax.tree_util.tree_map(lambda x, _i=i: x[_i], mbs)
+            grads, m, stats = self._grad(state, stats, mb, np.int32(i))
+            outs.append((grads, m))
+        inv = 1.0 / A
+        ring = dist.multiprocess_ring()
+        use_ring = ring is not None and ring.world_size > 1
+        per_mb = use_ring and self.reduce_schedule == "microbatch"
+        treedef = None
+        session = None
+        local_acc = None
+        mb_acc = None
+        mb_comm = mb_exposed = 0.0
+        m_acc: Dict[str, Any] = {}
+        for i, (grads, m) in enumerate(outs):
+            leaves, treedef = jax.tree_util.tree_flatten(grads)
+            np_leaves = [np.asarray(x) for x in leaves]
+            for k, v in m.items():
+                v = np.asarray(v)
+                m_acc[k] = v if k not in m_acc else m_acc[k] + v
+            if per_mb:
+                # enqueue mb i FIRST, then drain mb i-1: i-1's ring ran
+                # under mb i's in-flight compute AND under this fold +
+                # enqueue, so only its residual tail is exposed. The
+                # staggered generations make this safe: i-1's staging is
+                # folded (copied) here, before generation reuse at i+1.
+                prev = session
+                session = get_engine(ring).begin_accum(
+                    [(x.shape, x.dtype) for x in np_leaves],
+                    quantize=False,
+                )
+                session.finish(np_leaves, scale=1.0)
+                if prev is not None:
+                    done, st = prev.drain()
+                    mb_comm += st["comm_s"]
+                    mb_exposed += st["exposed_s"]
+                    mb_acc = self._fold_reduced(mb_acc, done)
+            elif use_ring:
+                if session is None:
+                    session = get_engine(ring).begin_accum(
+                        [(x.shape, x.dtype) for x in np_leaves],
+                        quantize=self.grad_compression == "int8",
+                    )
+                if i < A - 1:
+                    session.add(np_leaves)
+                else:
+                    # bucket-staggered: each bucket's ring reduce starts
+                    # while the host accumulates/scales the next bucket
+                    session.finish(np_leaves, scale=inv)
+            else:
+                if local_acc is None:
+                    local_acc = [
+                        np.array(x, copy=True) for x in np_leaves
+                    ]
+                else:
+                    for dst, src in zip(local_acc, np_leaves):
+                        np.add(dst, src, out=dst)
+        metrics = {
+            k: (v * np.float32(inv) if A > 1 else v)
+            for k, v in m_acc.items()
+        }
+        return {
+            "state": state,
+            "session": session,
+            "per_mb": per_mb,
+            "mb_acc": mb_acc,
+            "mb_comm": mb_comm,
+            "mb_exposed": mb_exposed,
+            "local_acc": local_acc,
+            "treedef": treedef,
+            "stats": stats,
+            "metrics": metrics,
+            "inv": inv,
+        }
+
+    @staticmethod
+    def _fold_reduced(acc, leaves):
+        if acc is None:
+            return [np.array(x, copy=True) for x in leaves]
+        for dst, src in zip(acc, leaves):
+            np.add(dst, src, out=dst)
+        return acc
+
+    def finish(self, pending):
+        """Drain the ring, apply the update, return (state, metrics)."""
+        state = pending["state"]
+        metrics = pending["metrics"]
+        inv = np.float32(pending["inv"])
+        if pending["per_mb"]:
+            done, st = pending["session"].drain()
+            comm = pending["mb_comm"] + st["comm_s"]
+            exposed = pending["mb_exposed"] + st["exposed_s"]
+            leaves = self._fold_reduced(pending["mb_acc"], done)
+            if self.accum_steps > 1:
+                for leaf in leaves:
+                    np.multiply(leaf, inv.astype(leaf.dtype), out=leaf)
+            self.last_sync_stats = {
+                "comm_s": comm,
+                "exposed_s": exposed,
+                "hidden_s": max(comm - exposed, 0.0),
+            }
+        elif pending["session"] is not None:
+            leaves, sync_stats = pending["session"].drain()
+            self.last_sync_stats = sync_stats
+        else:
+            leaves = pending["local_acc"]
+            if self.accum_steps > 1:
+                for leaf in leaves:
+                    np.multiply(
+                        leaf, inv.astype(leaf.dtype), out=leaf
+                    )
+            self.last_sync_stats = None
+        grads = jax.tree_util.tree_unflatten(pending["treedef"], leaves)
+        loss_value = metrics.get("loss")
+        if self._apply is None:
+            self._apply_has_loss = loss_value is not None
+            fn = self._apply_fn
+            if self._apply_has_loss:
+                self._apply = jax.jit(fn, donate_argnums=(0,))
+            else:
+                self._apply = jax.jit(
+                    lambda s, g, st: fn(s, g, st, None),
+                    donate_argnums=(0,),
+                )
+        if self._apply_has_loss != (loss_value is not None):
+            raise ValueError(
+                "loss metric presence changed between steps — the apply "
+                "program's signature is static"
+            )
+        args = (state, grads, pending["stats"])
+        if self._apply_has_loss:
+            args = args + (np.float32(loss_value),)
+        new_state, extra = self._apply(*args)
+        metrics.update(extra)
+        return new_state, metrics
+
+    def __call__(self, state, batch):
+        return self.finish(self.begin(state, batch))
 
 
 @dataclasses.dataclass
@@ -358,17 +712,39 @@ class Trainer:
                 "ema_decay — pass build_train_step(..., ema_decay=...)"
             )
         self.state = strategy.place(state)
+        # a new Trainer is a new training run: q8 error-feedback
+        # residuals from a previous run in this process (same leaf
+        # shapes, same engine) would leak its LAST gradient's
+        # quantization error into this run's first sync
+        from pytorch_distributed_tpu.parallel.ddp import (
+            reset_error_feedback,
+        )
+
+        reset_error_feedback()
         donate_batch = self.config.donate_batch
         if donate_batch is None:
             from pytorch_distributed_tpu.runtime.device import platform
 
             donate_batch = platform() != "cpu"
-        try:
-            self.train_step = strategy.compile(
-                train_step, self.state, donate_batch=donate_batch
-            )
-        except TypeError:  # user strategy predating the donate_batch hook
-            self.train_step = strategy.compile(train_step, self.state)
+        if getattr(train_step, "_ptd_host_step", False):
+            # build_train_step(overlap_accum=True): the step drives its
+            # own host microbatch loop and compiles its own programs —
+            # jitting it through the strategy would trace the loop away.
+            # Scope: the hostring / 1-device-per-rank path only.
+            if jax.device_count() > 1:
+                raise ValueError(
+                    "overlap_accum steps drive a host microbatch loop "
+                    "and cannot carry multi-device SPMD shardings — "
+                    "use the scanned build_train_step on this mesh"
+                )
+            self.train_step = train_step
+        else:
+            try:
+                self.train_step = strategy.compile(
+                    train_step, self.state, donate_batch=donate_batch
+                )
+            except TypeError:  # user strategy predating donate_batch
+                self.train_step = strategy.compile(train_step, self.state)
         self.eval_step = (
             jax.jit(eval_step) if eval_step is not None else None
         )

@@ -248,6 +248,42 @@ def comms_section(events, rows, other, costmodel_path, out):
         if model is not None:
             print("  (pred_ms from the α–β fit at each op's mean "
                   "payload; * = outside the calibrated range)", file=out)
+    # overlapped grad sync (r14): the engine's cumulative exposed/hidden
+    # counters — how much of the comm wall the main thread actually
+    # blocked on vs how much ran under concurrent work. Counters are
+    # cumulative PER ENGINE LIFE and restart at 0 when the engine is
+    # rebuilt (elastic re-mesh, reset_engine), so sum the per-(rank,
+    # counter) increments: a drop below the previous value marks a
+    # fresh engine whose reading counts in full.
+    expose: dict = {}
+    prev: dict = {}
+    for ev in events:
+        if ev.get("ph") == "C" and str(ev.get("name", "")).startswith(
+            "comm.sync."
+        ):
+            name = ev["name"]
+            v = float((ev.get("args") or {}).get("value", 0.0))
+            k = (ev.get("pid"), name)
+            p = prev.get(k, 0.0)
+            expose[name] = expose.get(name, 0.0) + (
+                v - p if v >= p else v
+            )
+            prev[k] = v
+    if expose:
+        exp = expose.get("comm.sync.exposed_s", 0.0)
+        hid = expose.get("comm.sync.hidden_s", 0.0)
+        total = exp + hid
+        stats["comm.sync.overlap"] = {
+            "exposed_s": exp, "hidden_s": hid,
+            **({"exposed_ratio": exp / total} if total > 0 else {}),
+        }
+        print(
+            f"  grad-sync overlap: comm exposed {exp:.3f}s / hidden "
+            f"{hid:.3f}s"
+            + (f" (exposed ratio {exp / total:.2f})" if total > 0
+               else ""),
+            file=out,
+        )
     if skew:
         print("  per-rank straggler skew (merged trace):", file=out)
         for name, s in sorted(skew.items()):

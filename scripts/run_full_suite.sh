@@ -23,6 +23,37 @@ if ! python scripts/ptd_lint.py; then
   echo "=== ptdlint FAILED — fix findings (or baseline with a justification) before running the batches"
   exit 1
 fi
+# grad-sync order gate (r14): every rank derives its bucket queue from
+# the ShipPlan alone, so lockstep collective order rests on the plan
+# being a pure function of (specs, quantize, sizes). Two independent
+# builds must agree item-for-item and bucket-for-bucket — seconds, no
+# jax, and a drift here would desync every multi-process test below.
+echo "=== grad-sync plan order"
+if ! python - <<'EOF'
+import numpy as np
+from pytorch_distributed_tpu.parallel.overlap import ShipPlan
+specs = [((7,), np.float32), ((11,), np.float16), ((9,), np.float32),
+         ((6000,), np.float32), ((1_200_000,), np.float32)]
+for quantize in (False, True):
+    a = ShipPlan(specs, quantize=quantize, chunk_bytes=4 << 20)
+    b = ShipPlan(specs, quantize=quantize, chunk_bytes=4 << 20)
+    assert a.signature() == b.signature(), "plan signature drifted"
+    order = [(i.kind, i.leaf_ids, i.start, i.elems, i.q8) for i in a.items]
+    assert order == [(i.kind, i.leaf_ids, i.start, i.elems, i.q8)
+                     for i in b.items], "item order drifted"
+    assert a.buckets == b.buckets, "bucket order drifted"
+    # the documented fixed order: coalesced flats first, then solos in
+    # leaf order, oversized leaves split into consecutive slot chunks
+    assert order[0][0] == "flat", order
+    assert [o[1][0] for o in order[1:]] == sorted(
+        o[1][0] for o in order[1:]
+    ), order
+print("plan order deterministic")
+EOF
+then
+  echo "=== grad-sync plan order FAILED — the bucket queue is no longer a pure function of the specs; every multi-process test below would desync"
+  exit 1
+fi
 total_rc=0
 mapfile -t FILES < <(ls tests/test_*.py | sort)
 BATCH=5

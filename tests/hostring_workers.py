@@ -1224,3 +1224,426 @@ def reinit_worker(rank: int, world: int, name: str, q) -> None:
         q.put((rank, "ok"))
     except Exception as e:  # pragma: no cover - reported via queue
         q.put((rank, f"{type(e).__name__}: {e}"))
+
+
+def overlap_parity_worker(rank: int, world: int, name: str, q) -> None:
+    """The bucketed pipeline (sync_grads overlap=True, the default) is
+    bit-identical to the legacy synchronous path — per leaf, including a
+    slot-CHUNKED multi-MB leaf (split at exactly the ring's slot
+    boundaries, so the per-element reduce order is the C loop's own) —
+    and its comm.* spans land on a named comm-thread track with the
+    exposed/hidden accounting wired. Also pins the q8 error-feedback
+    mechanism: residuals make the k-call mean converge on the exact
+    mean, which the legacy (residual-free) path cannot do."""
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["RANK"] = str(rank)
+        os.environ["WORLD_SIZE"] = str(world)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp  # noqa: F401
+
+        import pytorch_distributed_tpu as ptd
+        from pytorch_distributed_tpu.parallel.ddp import sync_grads
+        from pytorch_distributed_tpu.parallel.overlap import (
+            get_engine,
+            reset_engine,
+        )
+        from pytorch_distributed_tpu.runtime import tracing
+        from pytorch_distributed_tpu.runtime.distributed import (
+            multiprocess_ring,
+        )
+
+        ptd.init_process_group("gloo", group_name=name, timeout_s=120.0)
+        ring = multiprocess_ring()
+        rng = np.random.default_rng(3 + rank)
+        grads = {
+            f"t{i}": (rng.normal(size=(11 + i,)) * 2).astype(np.float32)
+            for i in range(4)
+        }
+        grads["big"] = (rng.normal(size=(6000,)) * 2).astype(np.float32)
+        # > one ring slot (4 MB): exercises the slot-aligned chunk items
+        grads["huge"] = (rng.normal(size=(1_200_000,)) * 2).astype(
+            np.float32
+        )
+
+        legacy_fn = jax.jit(lambda g: sync_grads(g, overlap=False))
+        overlap_fn = jax.jit(lambda g: sync_grads(g, overlap=True))
+        out_legacy = jax.tree_util.tree_map(np.asarray, legacy_fn(grads))
+        tracing.configure(None)
+        out_overlap = jax.tree_util.tree_map(np.asarray, overlap_fn(grads))
+        t = tracing.get()
+        for k in grads:
+            assert np.array_equal(out_legacy[k], out_overlap[k]), k
+        evs = [e for e in t._events if e.get("ph") == "X"]
+        ar = [e for e in evs if e["name"] == "comm.all_reduce"]
+        # 1 coalesced flat + big solo + huge as 2 slot chunks = 4
+        assert len(ar) == 4, [e["args"]["count"] for e in ar]
+        main_tid = None
+        sg = [e for e in evs if e["name"] == "comm.sync_grads"]
+        assert len(sg) == 1 and sg[0]["args"]["overlap"] is True, sg
+        assert sg[0]["args"]["leaves"] == 6
+        main_tid = sg[0]["tid"]
+        # collectives issue from the comm thread, on a NAMED track
+        assert all(e["tid"] != main_tid for e in ar), "ring on main thread"
+        thread_names = {
+            e["tid"]: e["args"]["name"] for e in t._events
+            if e.get("ph") == "M" and e["name"] == "thread_name"
+        }
+        assert thread_names.get(ar[0]["tid"]) == "grad-sync-comm"
+        drains = [e for e in evs if e["name"] == "comm.sync_drain"]
+        assert len(drains) == 1 and drains[0]["tid"] == main_tid
+        counters = {
+            e["name"] for e in t._events if e.get("ph") == "C"
+        }
+        assert "comm.sync.exposed_s" in counters, counters
+        assert "comm.sync.hidden_s" in counters, counters
+        tracing.clear()
+        stats = get_engine(ring).stats()
+        assert stats["syncs"] == 1 and stats["comm_s"] > 0, stats
+        assert stats["exposed_s"] <= stats["comm_s"] + 1e-9, stats
+
+        # q8 first call: zero residual, overlap == legacy exactly
+        q_legacy = jax.jit(
+            lambda g: sync_grads(g, compress="int8", overlap=False)
+        )
+        q_overlap = jax.jit(
+            lambda g: sync_grads(g, compress="int8", overlap=True)
+        )
+        reset_engine()  # fresh residuals
+        o1 = np.asarray(q_overlap(grads)["big"])
+        l1 = np.asarray(q_legacy(grads)["big"])
+        assert np.array_equal(o1, l1), "first q8 call must match legacy"
+        # error feedback: over k CONSTANT-gradient calls the mean of the
+        # reduced outputs telescopes toward the exact mean (residual
+        # carries each call's quantization error into the next), while
+        # the legacy path repeats the same biased value forever
+        rows = ring.all_gather(grads["big"])
+        exact = rows.astype(np.float64).mean(axis=0)
+        outs = [o1] + [
+            np.asarray(q_overlap(grads)["big"]) for _ in range(7)
+        ]
+        ef_err = np.abs(np.mean(outs, axis=0) - exact).max()
+        # the legacy path returns the IDENTICAL biased value every call
+        # (no residual), so its k-call mean never improves; EF's mean
+        # error floors at the UNCOMPENSATED second-stage requantization
+        # of the reduced segment (DESIGN.md §19) — better, not zero
+        legacy_err = np.abs(l1 - exact).max()
+        assert ef_err < legacy_err * 0.8, (ef_err, legacy_err)
+        assert not np.array_equal(outs[1], o1), "residual never engaged"
+        ptd.destroy_process_group()
+        q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover - reported via queue
+        import traceback
+
+        q.put((rank, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
+
+
+def overlap_accum_worker(rank: int, world: int, name: str, q) -> None:
+    """build_train_step(overlap_accum=True): the hoisted host loop is
+    BIT-IDENTICAL to the scanned step + synchronous sync (same left-fold
+    accumulation, same power-of-two scale, same ring calls), the
+    microbatch schedule stays lockstep across ranks and last-ulp close,
+    and each of the three programs compiles exactly once."""
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["RANK"] = str(rank)
+        os.environ["WORLD_SIZE"] = str(world)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import optax
+
+        import pytorch_distributed_tpu as ptd
+        from pytorch_distributed_tpu.parallel.overlap import reset_engine
+        from pytorch_distributed_tpu.runtime.distributed import (
+            multiprocess_ring,
+        )
+        from pytorch_distributed_tpu.train import (
+            TrainState,
+            build_train_step,
+        )
+
+        ptd.init_process_group("gloo", group_name=name, timeout_s=120.0)
+
+        def loss_fn(params, batch_stats, batch, rng):
+            pred = jnp.tanh(batch["x"] @ params["w"]) @ params["v"]
+            loss = jnp.mean((pred - batch["y"]) ** 2)
+            return loss, {"metrics": {"loss": loss},
+                          "batch_stats": batch_stats}
+
+        ri = np.random.default_rng(0)  # same init on every rank
+        init = {
+            "w": ri.normal(size=(16, 40)).astype(np.float32),
+            "v": ri.normal(size=(40, 4)).astype(np.float32),
+        }
+
+        def mkstate():
+            return TrainState.create(
+                apply_fn=lambda p, x: x,
+                params={k: jnp.asarray(v) for k, v in init.items()},
+                tx=optax.sgd(0.125),  # power-of-two lr: every
+                # contractible multiply is exact, so bit-identity holds
+                # across differently-fused programs (DESIGN.md §19)
+            )
+
+        def batch_for(step):  # per-rank shard of a global batch
+            r = np.random.default_rng(100 + step * world + rank)
+            return {
+                "x": r.normal(size=(8, 16)).astype(np.float32),
+                "y": r.normal(size=(8, 4)).astype(np.float32),
+            }
+
+        def run(step_fn, steps=4):
+            s = mkstate()
+            for t in range(steps):
+                s, m = step_fn(s, batch_for(t))
+            return np.concatenate([
+                np.asarray(s.params[k]).ravel() for k in sorted(init)
+            ]), float(np.asarray(m["loss"]))
+
+        os.environ["PTD_GRAD_SYNC"] = "legacy"
+        scan_params, scan_loss = run(
+            jax.jit(build_train_step(loss_fn, accum_steps=4))
+        )
+        del os.environ["PTD_GRAD_SYNC"]
+        host = build_train_step(loss_fn, accum_steps=4,
+                                overlap_accum=True)
+        host_params, host_loss = run(host)
+        assert np.array_equal(scan_params, host_params), (
+            np.abs(scan_params - host_params).max()
+        )
+        assert host.compile_counts() == {"prep": 1, "grad": 1,
+                                         "apply": 1}
+        assert host.last_sync_stats is not None
+        st = host.last_sync_stats
+        assert st["comm_s"] > 0
+        assert st["exposed_s"] <= st["comm_s"] + 1e-9
+
+        reset_engine()
+        mb = build_train_step(loss_fn, accum_steps=4,
+                              overlap_accum=True,
+                              reduce_schedule="microbatch")
+        mb_params, _ = run(mb)
+        # different summation association (per-mb ring then fixed-order
+        # host fold): last-ulp close, never bit-guaranteed
+        np.testing.assert_allclose(mb_params, scan_params,
+                                   rtol=2e-5, atol=2e-6)
+        # ...but STRICTLY lockstep across ranks
+        ring = multiprocess_ring()
+        rows = ring.all_gather(mb_params)
+        assert all(np.array_equal(rows[0], rows[i])
+                   for i in range(world)), "mb schedule diverged"
+        ptd.destroy_process_group()
+        q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover - reported via queue
+        import traceback
+
+        q.put((rank, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
+
+
+def overlap_chaos_worker(rank: int, world: int, name: str, q) -> None:
+    """A rank SIGKILLed MID-PIPELINE (the comm.overlap_stall fault site,
+    mode=kill between bucket reduces) must leave the survivors
+    recoverable: their next drain raises instead of hanging forever, the
+    poisoned engine refuses further work, and after re-meshing onto a
+    fresh ring + reset_engine() the survivors train on in lockstep —
+    the same fresh-ring recovery shape the elastic membership layer
+    commits (runtime/membership.py)."""
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["RANK"] = str(rank)
+        os.environ["WORLD_SIZE"] = str(world)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+        import pytorch_distributed_tpu as ptd
+        from pytorch_distributed_tpu.parallel.overlap import (
+            get_engine,
+            reset_engine,
+        )
+        from pytorch_distributed_tpu.runtime import faults
+        from pytorch_distributed_tpu.runtime.distributed import (
+            multiprocess_ring,
+        )
+
+        victim = world - 1
+        if rank == victim:
+            # die between the 2nd sync's bucket reduces — deterministic
+            faults.configure(
+                "comm.overlap_stall:mode=kill,after=2", seed=0
+            )
+        ptd.init_process_group("gloo", group_name=name, timeout_s=6.0)
+        ring = multiprocess_ring()
+        engine = get_engine(ring)
+        rng = np.random.default_rng(5 + rank)
+        leaves = [
+            (rng.normal(size=(200_000,)) * 2).astype(np.float32),
+            np.ones(64, np.float32) * rank,
+        ]
+        specs = [(x.shape, x.dtype) for x in leaves]
+
+        def one_sync(eng):
+            sess = eng.begin_accum(specs)
+            sess.finish(leaves, scale=1.0)
+            return sess.drain()
+
+        one_sync(engine)  # sync 1 completes everywhere
+        try:
+            one_sync(engine)  # victim dies mid-sync-2
+            # on a lucky schedule the victim's death can land after the
+            # survivors' sync 2 completed; the NEXT sync must then fail
+            one_sync(engine)
+            raise AssertionError("survivor never saw the peer death")
+        except RuntimeError as e:
+            assert "re-mesh" in str(e) or "pipeline" in str(e), e
+        # the poisoned pipeline refuses further work LOUDLY
+        try:
+            one_sync(engine)
+            raise AssertionError("poisoned engine accepted work")
+        except RuntimeError as e:
+            assert "poisoned" in str(e), e
+        # re-mesh the survivors on a fresh ring (what the elastic
+        # membership commit does) + a fresh engine
+        ptd.destroy_process_group()
+        reset_engine()
+        os.environ["RANK"] = str(rank)  # survivors keep their ranks:
+        os.environ["WORLD_SIZE"] = str(world - 1)  # victim was last
+        ptd.init_process_group(
+            "gloo", group_name=name + "_b", timeout_s=60.0
+        )
+        ring2 = multiprocess_ring()
+        engine2 = get_engine(ring2)
+        out, _ = one_sync(engine2)
+        rows = ring2.all_gather(out[0])
+        assert all(
+            np.array_equal(rows[0], rows[i]) for i in range(world - 1)
+        ), "survivors diverged after re-mesh"
+        ptd.destroy_process_group()
+        q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover - reported via queue
+        import traceback
+
+        q.put((rank, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
+
+
+def overlap_ef_worker(rank: int, world: int, name: str, q) -> None:
+    """Loss-curve parity (ROADMAP item 1): training with
+    sync_grads(compress='int8') + error feedback tracks the f32 run's
+    loss curve at a pinned tolerance over a real descent."""
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["RANK"] = str(rank)
+        os.environ["WORLD_SIZE"] = str(world)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import optax
+
+        import pytorch_distributed_tpu as ptd
+        from pytorch_distributed_tpu.parallel.overlap import reset_engine
+        from pytorch_distributed_tpu.train import (
+            TrainState,
+            build_train_step,
+        )
+
+        ptd.init_process_group("gloo", group_name=name, timeout_s=120.0)
+
+        ri = np.random.default_rng(0)
+        w_true = ri.normal(size=(12, 3)).astype(np.float32)
+        init = {"w": np.zeros((12, 3), np.float32)}
+
+        def loss_fn(params, batch_stats, batch, rng):
+            loss = jnp.mean(
+                (batch["x"] @ params["w"] - batch["y"]) ** 2
+            )
+            return loss, {"metrics": {"loss": loss},
+                          "batch_stats": batch_stats}
+
+        def batch_for(step):
+            r = np.random.default_rng(50 + step * world + rank)
+            x = r.normal(size=(16, 12)).astype(np.float32)
+            return {"x": x, "y": (x @ w_true).astype(np.float32)}
+
+        def run(compress):
+            reset_engine()  # residuals must not leak across runs
+            step = jax.jit(build_train_step(
+                loss_fn, grad_compression=compress
+            ))
+            s = TrainState.create(
+                apply_fn=lambda p, x: x,
+                params={"w": jnp.asarray(init["w"])},
+                tx=optax.sgd(0.05),
+            )
+            losses = []
+            for t in range(30):
+                s, m = step(s, batch_for(t))
+                losses.append(float(np.asarray(m["loss"])))
+            return np.asarray(losses)
+
+        f32 = run(None)
+        q8 = run("int8")
+        assert f32[-1] < f32[0] * 0.2, "reference run failed to descend"
+        # pinned parity: the compressed curve tracks f32 within 3%
+        # relative at every step past the first few
+        rel = np.abs(q8[3:] - f32[3:]) / np.maximum(f32[3:], 1e-6)
+        assert rel.max() < 0.03, (rel.max(), q8[-3:], f32[-3:])
+        ptd.destroy_process_group()
+        q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover - reported via queue
+        import traceback
+
+        q.put((rank, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
+
+
+def overlap_trace_worker(rank: int, world: int, name: str, q,
+                         trace_dir: str) -> None:
+    """Traced overlapped syncs for the trace_merge alignment test: the
+    comm thread's collectives keep lockstep ISSUE order across ranks
+    (the deterministic bucket queue), so the k-th comm.* occurrence per
+    rank is the same collective — straggler skew stays computable."""
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["RANK"] = str(rank)
+        os.environ["WORLD_SIZE"] = str(world)
+        import time as _time
+
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+        import pytorch_distributed_tpu as ptd
+        from pytorch_distributed_tpu.parallel.overlap import get_engine
+        from pytorch_distributed_tpu.runtime import tracing
+        from pytorch_distributed_tpu.runtime.distributed import (
+            multiprocess_ring,
+        )
+
+        tracer = tracing.configure(trace_dir)
+        ptd.init_process_group("gloo", group_name=name, timeout_s=120.0)
+        ring = multiprocess_ring()
+        engine = get_engine(ring)
+        rng = np.random.default_rng(9)
+        leaves = [
+            rng.normal(size=(150_000,)).astype(np.float32),
+            rng.normal(size=(30_000,)).astype(np.float32),
+        ]
+        specs = [(x.shape, x.dtype) for x in leaves]
+        for i in range(4):
+            _time.sleep(0.002 * rank)  # real straggle, visible skew
+            sess = engine.begin_accum(specs)
+            sess.finish(leaves, scale=1.0)
+            sess.drain()
+        ptd.destroy_process_group()
+        fname = "trace.json" if rank == 0 else f"trace-rank{rank}.json"
+        tracer.export(os.path.join(trace_dir, fname))
+        tracing.clear()
+        q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover - reported via queue
+        import traceback
+
+        q.put((rank, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))

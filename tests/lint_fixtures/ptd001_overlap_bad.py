@@ -1,0 +1,25 @@
+"""PTD001 known-bad: a rank-conditional bucket skip in a drain loop.
+
+The round-14 grad-sync pipeline's safety argument is that every rank
+drains the SAME deterministic bucket queue — a rank-guarded skip breaks
+lockstep exactly like a guarded collective (the skipping rank's peers
+block at the ring until the group deadline). The loop-carried shape is
+the one the comm thread actually runs, so the rule must keep seeing
+through it.
+"""
+
+
+def drain_with_rank_skip(ring, rank, buckets):
+    for i, bucket in enumerate(buckets):
+        if rank == 0 and i % 2:
+            continue  # rank 0 silently drops odd buckets...
+        for item in bucket:
+            ring.all_reduce(item)  # expect: PTD001
+
+
+def tainted_skip(ring, buckets):
+    fast_rank = ring.rank != 0
+    for bucket in buckets:
+        if fast_rank:
+            continue  # taint through the local: same divergence
+        ring.all_reduce(bucket)  # expect: PTD001

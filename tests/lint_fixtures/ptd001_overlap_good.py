@@ -1,0 +1,34 @@
+"""PTD001 known-good twin: deterministic queue drains stay silent.
+
+The pipelined engine's real shapes (parallel/overlap.py): FIFO bucket
+drains, payload-dependent (NOT rank-dependent) dispatch between the
+plain and quantized reduce, and an error-guard that refuses work on
+every rank identically.
+"""
+
+
+def drain_fifo(ring, buckets):
+    # the comm thread's loop: every rank drains the same queue in the
+    # same order — rank never appears in the control flow
+    for bucket in buckets:
+        for item in bucket:
+            ring.all_reduce(item)
+
+
+def drain_dispatch_by_payload(ring, buckets):
+    for bucket in buckets:
+        for item, quantized in bucket:
+            # per-item DISPATCH on a plan property shared by all ranks
+            if quantized:
+                ring.all_reduce_q8(item)
+            else:
+                ring.all_reduce(item)
+
+
+def drain_with_uniform_error_guard(ring, failed, buckets):
+    for bucket in buckets:
+        if failed:
+            # a poisoned pipeline skips identically on EVERY rank (the
+            # abort flag propagates through the shm segment)
+            continue
+        ring.all_reduce(bucket)

@@ -607,3 +607,150 @@ def test_obs_report_renders_plan_section(abstract_state, tmp_path):
     assert "== Plan ==" in proc.stdout
     assert plan.best().name in proc.stdout
     assert "CHOSEN" in proc.stdout
+
+
+# -- round-14: q8 quantize-cost + overlap-aware pricing ---------------------
+class TestRound14Pricing:
+    def test_q8_fallback_carries_quantize_cost(self):
+        """The mispricing fix: with NO q8 calibration, q8 must price
+        wire bytes + the analytic quantize passes — on a β where f32
+        moves X seconds, q8 must come out SLOWER than f32 (the measured
+        shm fact), not 0.25x."""
+        from pytorch_distributed_tpu.autoplan.pricing import (
+            Q8_QUANTIZE_PASSES,
+            grad_comm_terms,
+            price_comm_terms,
+        )
+
+        beta = 1e-9
+        fits = {
+            ("all_reduce", 4): costmodel.OpFit(
+                "all_reduce", 4, 0.0, beta, 1.0, 4, 0, 1 << 62
+            )
+        }
+        m = costmodel.CostModel("test", fits)
+        elems = 1_600_000  # the 6.4 MB measured regime
+        f32 = price_comm_terms(
+            grad_comm_terms("dp", elems * 4, elems, 4), m
+        )
+        q8 = price_comm_terms(
+            grad_comm_terms("dp", elems * 4, elems, 4, compress="int8"),
+            m,
+        )
+        # hand arithmetic: wire(q8) x β + PASSES x f32_bytes x β
+        wire = algo_wire_bytes("all_reduce_q8",
+                               q8_wire_payload(elems), 4)
+        want = wire * beta + Q8_QUANTIZE_PASSES * elems * 4 * beta
+        assert abs(q8[0].seconds - want) < 1e-12
+        assert q8[0].seconds > f32[0].seconds  # the measured direction
+        assert q8[0].extrapolated
+        assert "quantize cost" in q8[0].note
+        assert "no q8 calibration" in q8[0].note
+
+    def test_calibrated_q8_fit_bypasses_the_analytic_term(self):
+        m = hand_model(1e-9, 1e-9)  # has a real all_reduce_q8 fit
+        from pytorch_distributed_tpu.autoplan.pricing import (
+            grad_comm_terms,
+            price_comm_terms,
+        )
+
+        q8 = price_comm_terms(
+            grad_comm_terms("dp", 4096 * 4, 4096, 8, compress="int8"), m
+        )
+        assert "quantize cost" not in q8[0].note
+        assert q8[0].seconds == pytest.approx(
+            algo_wire_bytes("all_reduce_q8", q8_wire_payload(4096), 8)
+            * 1e-9
+        )
+
+    def test_auto_stops_preferring_uncalibrated_q8(self, abstract_state):
+        """End to end: with only an all_reduce fit, include_q8 candidates
+        must now LOSE to plain f32 dp on the shm-shaped transport —
+        `--strategy auto` stops picking a measured regression."""
+        fits = {}
+        for w in (2, 4, 8):
+            fits[("all_reduce", w)] = costmodel.OpFit(
+                "all_reduce", w, 0.0, 1e-9, 1.0, 4, 0, 1 << 62
+            )
+            fits[("reduce_scatter", w)] = costmodel.OpFit(
+                "reduce_scatter", w, 0.0, 1e-9, 1.0, 4, 0, 1 << 62
+            )
+            fits[("all_gather", w)] = costmodel.OpFit(
+                "all_gather", w, 0.0, 1e-9, 1.0, 4, 0, 1 << 62
+            )
+        m = costmodel.CostModel("test", fits)
+        p = run_plan(abstract_state, m, strategies=("dp",),
+                     include_q8=True)
+        assert p.best().spec.compress is None, p.best().name
+        q8_row = next(c for c in p.candidates
+                      if c.spec.compress == "int8")
+        assert q8_row.comm_seconds > p.best().comm_seconds
+
+    def test_overlap_pricing_hides_grad_comm(self, abstract_state):
+        """exposed-comm = max(0, comm - overlappable compute): with
+        accum 4, 3/4 of the compute window can hide the dp allreduce —
+        hand-computed hidden seconds land on the candidate and
+        step_seconds drops by exactly that amount."""
+        m = hand_model(1e-6, 1e-6)
+        profile = autoplan.ModelProfile(
+            flops_per_sample=1e9, activation_bytes_per_sample=0.0
+        )
+
+        def one(overlap):
+            return autoplan.plan(
+                profile=profile, global_batch=8, accum_steps=4,
+                abstract_state=abstract_state, cost_model=m,
+                compute=MEASURED, strategies=("dp",), max_tp=1,
+                n_devices=8, budget_bytes=None,
+                overlap_grad_sync=overlap,
+            ).best()
+
+        serial = one(False)
+        ovl = one(True)
+        assert serial.hidden_comm_seconds == 0.0
+        grad_s = serial.comm_seconds
+        overlappable = serial.compute_seconds * 3 / 4
+        want_hidden = min(grad_s, overlappable)
+        assert ovl.hidden_comm_seconds == pytest.approx(want_hidden)
+        assert ovl.step_seconds == pytest.approx(
+            serial.step_seconds - want_hidden
+        )
+
+    def test_overlap_never_hides_tp_activation_collectives(self):
+        """tp activation allreduces sit ON the forward/backward critical
+        path — only the grad-exchange terms may hide."""
+        model = nn.Dense(64)
+        state = jax.eval_shape(lambda: TrainState.create(
+            apply_fn=model.apply,
+            params=model.init(jax.random.key(0),
+                              jnp.zeros((1, 64)))["params"],
+            tx=optax.sgd(0.1),
+        ))
+        profile = autoplan.ModelProfile(
+            flops_per_sample=1e9, activation_bytes_per_sample=0.0,
+            layers=2, hidden=64, seq_len=8,
+        )
+        m = hand_model(1e-6, 1e-6)
+        p = autoplan.plan(
+            profile=profile, global_batch=8, accum_steps=2,
+            abstract_state=state, cost_model=m, compute=MEASURED,
+            strategies=("dp",), tp_candidates=(2,), n_devices=8,
+            budget_bytes=None, overlap_grad_sync=True,
+        )
+        tp_cand = next(c for c in p.candidates if c.spec.tp == 2
+                       and c.feasible)
+        grad_s = sum(t.seconds for t in tp_cand.comm_terms
+                     if "tp activation" not in t.note)
+        assert tp_cand.hidden_comm_seconds <= grad_s + 1e-15
+
+    def test_plan_json_records_overlap(self, abstract_state, tmp_path):
+        p = run_plan(abstract_state, hand_model(1e-9, 1e-9),
+                     overlap_grad_sync=True)
+        doc = json.load(open(p.save(str(tmp_path / "plan.json"))))
+        assert doc["overlap_grad_sync"] is True
+        c = doc["candidates"][0]
+        assert "hidden_seconds" in c["comms"]
+        assert "exposed_seconds" in c["comms"]
+        assert c["comms"]["exposed_seconds"] == pytest.approx(
+            c["comms"]["seconds"] - c["comms"]["hidden_seconds"]
+        )

@@ -1,0 +1,488 @@
+"""Overlapped gradient sync: the bucketed, pipelined host-ring engine.
+
+Covers the round-14 tentpole (DESIGN.md §19):
+
+* ``ShipPlan`` — deterministic coalesce/chunk/bucket structure (shared
+  by the legacy and pipelined paths, so they can never drift);
+* the q8 error-feedback quantizer replication and residual mechanics;
+* ``sync_grads(overlap=True)`` bit-parity with the legacy path over a
+  live ring, comm-thread span tracks, exposed/hidden accounting;
+* ``build_train_step(overlap_accum=True)`` — bit-identity with the
+  scanned step (world 1 in-process; world 2 over the ring), the
+  microbatch reduce schedule's lockstep + last-ulp closeness, compile
+  counts, Trainer integration;
+* the ``comm.overlap_stall`` chaos case: a rank SIGKILLed mid-pipeline
+  leaves survivors recoverable by a fresh-ring re-mesh + reset_engine;
+* trace_merge's k-th-occurrence straggler alignment over comm-thread
+  traces.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.parallel import overlap as ov
+from pytorch_distributed_tpu.runtime import faults
+from tests import hostring_workers
+
+SCRIPTS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+)
+
+pytestmark = pytest.mark.overlap
+
+
+def _run(world, target, extra_args=(), timeout=420.0):
+    return hostring_workers.run_ring_workers(
+        world, target, extra_args=extra_args, timeout=timeout
+    )
+
+
+# --------------------------------------------------------------------------
+# ShipPlan structure (pure host, no ring, no jax)
+# --------------------------------------------------------------------------
+class TestShipPlan:
+    def specs(self):
+        return [
+            ((17,), np.float32),    # coalesces
+            ((23,), np.float32),    # coalesces
+            ((5000,), np.float32),  # solo
+            ((3_000_000,), np.float32),  # 12 MB: chunks at 4 MB
+            ((9,), np.int32),       # non-float: solo, never coalesced
+        ]
+
+    def test_structure_and_determinism(self):
+        a = ov.ShipPlan(self.specs(), quantize=True)
+        b = ov.ShipPlan(self.specs(), quantize=True)
+        assert a.signature() == b.signature()
+        assert [list(x) for x in a.buckets] == [list(x) for x in b.buckets]
+        kinds = [(i.kind, i.leaf_ids, i.q8) for i in a.items]
+        # flat FIRST (the degenerate first bucket), then solos/chunks in
+        # leaf order; q8 on the big f32 solos (which then never split —
+        # the native q8 path chunks at its own scale-adjusted stride),
+        # never on flats
+        assert kinds[0] == ("flat", (0, 1), False)
+        assert kinds[1] == ("solo", (2,), True)
+        assert kinds[2] == ("solo", (3,), True)  # q8: whole, unsplit
+        assert kinds[-1] == ("solo", (4,), False)
+
+    def test_uncompressed_big_leaf_chunks_at_slot_boundaries(self):
+        a = ov.ShipPlan(self.specs(), quantize=False)
+        # 12 MB f32 leaf: 3 slot chunks sharing one parent buffer, so
+        # the reduced leaf is contiguous with no reassembly copy
+        chunks = [i for i in a.items if i.kind == "chunk"]
+        assert [c.leaf_ids for c in chunks] == [(3,)] * 3
+        assert len({c.parent for c in chunks}) == 1
+        assert [c.start for c in chunks] == [0, 1 << 20, 2 << 20]
+        assert sum(c.elems for c in chunks) == 3_000_000
+        assert not any(c.q8 for c in chunks)
+
+    def test_chunk_boundaries_follow_chunk_bytes(self):
+        plan = ov.ShipPlan([((1_000_000,), np.float32)],
+                           chunk_bytes=1 << 20)
+        chunks = [i for i in plan.items if i.kind == "chunk"]
+        assert [c.start for c in chunks] == [0, 262144, 524288, 786432]
+
+    def test_buckets_cover_items_in_order(self):
+        plan = ov.ShipPlan(self.specs())
+        flat = [j for b in plan.buckets for j in b]
+        assert flat == list(range(len(plan.items)))
+        for b in plan.buckets[:-1]:
+            assert b  # no empty buckets
+
+    def test_pre_shipped_never_recoalesces(self):
+        # two tiny arrays that WOULD coalesce as leaves must stay one
+        # item each when they arrive pre-packed through io_callback
+        plan = ov.ShipPlan.pre_shipped(
+            [((40,), np.float32), ((41,), np.float32)], [False, False]
+        )
+        assert [i.kind for i in plan.items] == ["solo", "solo"]
+
+    def test_grouping_is_shared_with_ddp(self):
+        # the tentpole's no-drift guarantee: ddp re-exports THE constant
+        from pytorch_distributed_tpu.parallel import ddp
+
+        assert ddp._COALESCE_MAX_ELEMS is ov.COALESCE_MAX_ELEMS
+
+
+class TestQ8ErrorFeedback:
+    def test_roundtrip_matches_native_bound(self):
+        x = (np.random.default_rng(0).normal(size=10_000) * 5).astype(
+            np.float32
+        )
+        rt = ov.q8_local_roundtrip(x)
+        # per-256-block bound: |err| <= scale/2 = amax/254
+        x = x[:9984]  # whole blocks
+        rt = rt[:9984]
+        xb = x.reshape(-1, 256)
+        bound = np.abs(xb).max(axis=1) / 127.0 * 0.5 + 1e-7
+        err = np.abs((rt - x).reshape(-1, 256)).max(axis=1)
+        assert np.all(err <= bound)
+
+    def test_roundtrip_edge_blocks(self):
+        zeros = np.zeros(300, np.float32)
+        assert np.array_equal(ov.q8_local_roundtrip(zeros), zeros)
+        bad = np.ones(300, np.float32)
+        bad[5] = np.inf
+        rt = ov.q8_local_roundtrip(bad)
+        assert np.all(np.isnan(rt[:256]))  # poisoned block is LOUD
+        assert np.all(np.isfinite(rt[256:]))  # later blocks untouched
+
+    def test_site_registered(self):
+        assert "comm.overlap_stall" in faults.KNOWN_SITES
+
+
+class TestEngineLocal:
+    def test_reset_engine_idempotent(self):
+        ov.reset_engine()
+        ov.reset_engine()
+
+    def test_build_guards(self):
+        from pytorch_distributed_tpu.train import build_train_step
+
+        def loss_fn(p, bs, b, r):
+            return 0.0, {}
+
+        with pytest.raises(ValueError, match="bf16"):
+            build_train_step(loss_fn, overlap_accum=True,
+                             grad_compression="bf16")
+        with pytest.raises(ValueError, match="reduce_schedule"):
+            build_train_step(loss_fn, overlap_accum=True,
+                             reduce_schedule="eager")
+        with pytest.raises(ValueError, match="microbatch"):
+            build_train_step(loss_fn, overlap_accum=True,
+                             reduce_schedule="microbatch",
+                             grad_compression="int8")
+        with pytest.raises(ValueError, match="scanned step"):
+            build_train_step(loss_fn, reduce_schedule="microbatch")
+
+
+# --------------------------------------------------------------------------
+# world-1 bit-identity: the fixed-order argument, in-process
+# --------------------------------------------------------------------------
+class TestHostLoopWorldOne:
+    def _parts(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from pytorch_distributed_tpu.train import TrainState
+
+        def loss_fn(params, batch_stats, batch, rng):
+            pred = jnp.tanh(batch["x"] @ params["w"]) @ params["v"]
+            loss = jnp.mean((pred - batch["y"]) ** 2)
+            return loss, {"metrics": {"loss": loss},
+                          "batch_stats": batch_stats}
+
+        ri = np.random.default_rng(0)
+        init = {
+            "w": ri.normal(size=(8, 24)).astype(np.float32),
+            "v": ri.normal(size=(24, 4)).astype(np.float32),
+        }
+
+        def mkstate(tx):
+            return TrainState.create(
+                apply_fn=lambda p, x: x,
+                params={k: jnp.asarray(v) for k, v in init.items()},
+                tx=tx,
+            )
+
+        def batch_for(t):
+            r = np.random.default_rng(10 + t)
+            return {"x": r.normal(size=(16, 8)).astype(np.float32),
+                    "y": r.normal(size=(16, 4)).astype(np.float32)}
+
+        return loss_fn, init, mkstate, batch_for
+
+    def _params(self, s, init):
+        return np.concatenate(
+            [np.asarray(s.params[k]).ravel() for k in sorted(init)]
+        )
+
+    def test_bitwise_vs_scanned_multistep(self):
+        """The tentpole claim: host-loop accumulation + apply equals the
+        scanned path to the BIT over several steps. Power-of-two lr so
+        every contractible multiply is exact — bit-identity then holds
+        regardless of XLA's per-program fusion choices (§19)."""
+        import jax
+        import optax
+
+        from pytorch_distributed_tpu.train import build_train_step
+
+        loss_fn, init, mkstate, batch_for = self._parts()
+        scan = jax.jit(build_train_step(loss_fn, accum_steps=4))
+        host = build_train_step(loss_fn, accum_steps=4,
+                                overlap_accum=True)
+        s1, s2 = mkstate(optax.sgd(0.125)), mkstate(optax.sgd(0.125))
+        for t in range(5):
+            s1, m1 = scan(s1, batch_for(t))
+            s2, m2 = host(s2, batch_for(t))
+            assert abs(float(np.asarray(m1["loss"]))
+                       - float(np.asarray(m2["loss"]))) < 1e-6
+        assert np.array_equal(self._params(s1, init),
+                              self._params(s2, init))
+        assert host.compile_counts() == {"prep": 1, "grad": 1,
+                                         "apply": 1}
+
+    def test_single_step_bitwise_with_momentum(self):
+        """With momentum the cross-program FMA-contraction caveat kicks
+        in from step 2 (§19 documents it); step 1 — zero momentum, so
+        every contraction multiplies by zero or the exact grads — is
+        bitwise, which pins the accumulation order itself."""
+        import jax
+        import optax
+
+        from pytorch_distributed_tpu.train import build_train_step
+
+        loss_fn, init, mkstate, batch_for = self._parts()
+        scan = jax.jit(build_train_step(loss_fn, accum_steps=2))
+        host = build_train_step(loss_fn, accum_steps=2,
+                                overlap_accum=True)
+        tx = lambda: __import__("optax").sgd(0.1, momentum=0.9)  # noqa
+        s1, _ = scan(mkstate(tx()), batch_for(0))
+        s2, _ = host(mkstate(tx()), batch_for(0))
+        assert np.array_equal(self._params(s1, init),
+                              self._params(s2, init))
+
+    def test_accum_one_matches_plain(self):
+        import jax
+        import optax
+
+        from pytorch_distributed_tpu.train import build_train_step
+
+        loss_fn, init, mkstate, batch_for = self._parts()
+        plain = jax.jit(build_train_step(loss_fn))
+        host = build_train_step(loss_fn, overlap_accum=True)
+        s1, _ = plain(mkstate(optax.sgd(0.125)), batch_for(0))
+        s2, _ = host(mkstate(optax.sgd(0.125)), batch_for(0))
+        assert np.array_equal(self._params(s1, init),
+                              self._params(s2, init))
+
+    def test_begin_finish_split(self):
+        import optax
+
+        from pytorch_distributed_tpu.train import build_train_step
+
+        loss_fn, init, mkstate, batch_for = self._parts()
+        host = build_train_step(loss_fn, accum_steps=2,
+                                overlap_accum=True)
+        s = mkstate(optax.sgd(0.125))
+        pending = host.begin(s, batch_for(0))
+        s2, metrics = host.finish(pending)
+        assert "loss" in metrics
+        whole = build_train_step(loss_fn, accum_steps=2,
+                                 overlap_accum=True)
+        s3, _ = whole(mkstate(optax.sgd(0.125)), batch_for(0))
+        assert np.array_equal(self._params(s2, init),
+                              self._params(s3, init))
+
+    def test_scaler_and_ema_ride_the_apply_program(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from pytorch_distributed_tpu.runtime.precision import GradScaler
+        from pytorch_distributed_tpu.train import (
+            TrainState,
+            build_train_step,
+        )
+
+        loss_fn, init, mkstate, batch_for = self._parts()
+
+        scaler = GradScaler(dtype=jnp.float16)
+
+        def mk(ema):
+            return TrainState.create(
+                apply_fn=lambda p, x: x,
+                params={k: jnp.asarray(v) for k, v in init.items()},
+                tx=optax.sgd(0.125), ema=ema,
+                scaler_state=scaler.init_state(),
+            )
+        scan = jax.jit(build_train_step(
+            loss_fn, accum_steps=2, scaler=scaler, ema_decay=0.5
+        ))
+        host = build_train_step(
+            loss_fn, accum_steps=2, scaler=scaler, ema_decay=0.5,
+            overlap_accum=True,
+        )
+        s1, m1 = scan(mk(True), batch_for(0))
+        s2, m2 = host(mk(True), batch_for(0))
+        assert float(np.asarray(m2["grads_finite"])) == 1.0
+        assert float(np.asarray(m1["loss_scale"])) == float(
+            np.asarray(m2["loss_scale"])
+        )
+        assert np.array_equal(self._params(s1, init),
+                              self._params(s2, init))
+        for k in init:
+            assert np.array_equal(np.asarray(s1.ema_params[k]),
+                                  np.asarray(s2.ema_params[k])), k
+
+
+# --------------------------------------------------------------------------
+# live multi-process coverage
+# --------------------------------------------------------------------------
+class TestOverRing:
+    def test_overlap_parity_spans_and_error_feedback(self):
+        world = 2
+        results = _run(world, hostring_workers.overlap_parity_worker)
+        assert results == [(r, "ok") for r in range(world)], results
+
+    def test_overlap_accum_bitwise_and_microbatch_lockstep(self):
+        world = 2
+        results = _run(world, hostring_workers.overlap_accum_worker)
+        assert results == [(r, "ok") for r in range(world)], results
+
+    def test_ef_loss_curve_parity(self):
+        world = 2
+        results = _run(world, hostring_workers.overlap_ef_worker)
+        assert results == [(r, "ok") for r in range(world)], results
+
+    def test_chaos_kill_mid_pipeline_recovers(self):
+        """The comm.overlap_stall drill: the victim dies between bucket
+        reduces; every SURVIVOR must report ok (poisoned-engine refusal
+        + fresh-ring re-mesh + lockstep after), and the victim's exit
+        status must be the injected-kill code, not a clean exit."""
+        import multiprocessing as mp
+        import uuid
+
+        world = 3
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        name = f"ptdovl_{uuid.uuid4().hex[:8]}"
+        procs = [
+            ctx.Process(
+                target=hostring_workers.overlap_chaos_worker,
+                args=(r, world, name, q),
+            )
+            for r in range(world)
+        ]
+        old = os.environ.get("JAX_PLATFORMS")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            for p in procs:
+                p.start()
+        finally:
+            if old is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = old
+        try:
+            results = sorted(q.get(timeout=420.0)
+                             for _ in range(world - 1))
+        finally:
+            for p in procs:
+                p.join(timeout=60)
+                if p.is_alive():
+                    p.terminate()
+        assert results == [(r, "ok") for r in range(world - 1)], results
+        assert procs[world - 1].exitcode == faults.KILLED_EXIT
+
+    def test_trace_merge_alignment_with_comm_thread(self, tmp_path):
+        world = 3
+        results = _run(
+            world, hostring_workers.overlap_trace_worker,
+            extra_args=(str(tmp_path),),
+        )
+        assert results == [(r, "ok") for r in range(world)], results
+        sys.path.insert(0, SCRIPTS)
+        try:
+            import trace_merge
+        finally:
+            sys.path.pop(0)
+        rc = trace_merge.main([str(tmp_path)])
+        assert rc == 0
+        doc = json.load(
+            open(os.path.join(str(tmp_path), "merged_trace.json"))
+        )
+        events = doc["traceEvents"]
+        # the k-th comm.all_reduce per rank is the same collective:
+        # every rank must have issued the SAME count, in lockstep order
+        per_rank = {}
+        for e in events:
+            if e.get("ph") == "X" and e["name"] == "comm.all_reduce":
+                per_rank.setdefault(e["pid"], []).append(e)
+        assert set(per_rank) == set(range(world))
+        counts = {r: len(v) for r, v in per_rank.items()}
+        assert len(set(counts.values())) == 1, counts
+        # 4 syncs x 2 ship items each
+        assert counts[0] == 8, counts
+        # straggler summary computed over the comm spans
+        skew = doc["otherData"]["comm_skew"]
+        assert "comm.all_reduce" in skew
+        # the comm thread's track is NAMED in each rank's process
+        tnames = [
+            e for e in events
+            if e.get("ph") == "M" and e["name"] == "thread_name"
+            and e["args"]["name"] == "grad-sync-comm"
+        ]
+        assert {e["pid"] for e in tnames} == set(range(world))
+
+    def test_obs_report_renders_exposed_hidden(self, tmp_path, capsys):
+        """obs_report's Comms section surfaces the engine's cumulative
+        exposed/hidden counters — the comm_hidden-vs-comm_exposed
+        account the overlap work is judged by. (Counter PRODUCTION over
+        a live ring is pinned by overlap_parity_worker; this renders a
+        locally-built trace, no ring needed.)"""
+        import time as _time
+
+        from pytorch_distributed_tpu.runtime import tracing
+
+        with tracing.enabled(str(tmp_path)) as t:
+            with tracing.span("comm.all_reduce", wire_bytes=1048576,
+                              payload_bytes=1048576, world=2):
+                _time.sleep(0.001)
+            # cumulative within an engine's life; the drop to 0.05/0.15
+            # is an engine REBUILD (elastic re-mesh) whose fresh
+            # readings must count in full, not clobber the total
+            t.counter("comm.sync.exposed_s", 0.10)
+            t.counter("comm.sync.hidden_s", 0.30)
+            t.counter("comm.sync.exposed_s", 0.20)
+            t.counter("comm.sync.hidden_s", 0.60)
+            t.counter("comm.sync.exposed_s", 0.05)
+            t.counter("comm.sync.hidden_s", 0.15)
+            t.export()
+        sys.path.insert(0, SCRIPTS)
+        try:
+            import obs_report
+        finally:
+            sys.path.pop(0)
+        rc = obs_report.main([str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "grad-sync overlap: comm exposed 0.250s" in out
+        assert "exposed ratio 0.25" in out
+
+
+class TestTrainerIntegration:
+    def test_trainer_refuses_host_step_on_multidevice_mesh(self):
+        """The conftest runs an 8-device CPU mesh: a host-loop step
+        cannot carry SPMD shardings, and the Trainer must say so loudly
+        instead of silently mis-sharding."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from pytorch_distributed_tpu.parallel import DataParallel
+        from pytorch_distributed_tpu.train import (
+            Trainer,
+            TrainState,
+            build_train_step,
+        )
+
+        assert jax.device_count() > 1  # the conftest's virtual mesh
+
+        def loss_fn(params, batch_stats, batch, rng):
+            loss = jnp.mean((batch["x"] @ params["w"]) ** 2)
+            return loss, {"metrics": {"loss": loss},
+                          "batch_stats": batch_stats}
+
+        state = TrainState.create(
+            apply_fn=lambda p, x: x,
+            params={"w": jnp.ones((4, 2))}, tx=optax.sgd(0.1),
+        )
+        step = build_train_step(loss_fn, overlap_accum=True)
+        with pytest.raises(ValueError, match="overlap_accum"):
+            Trainer(state, DataParallel(), step, train_loader=[])
