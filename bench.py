@@ -1543,6 +1543,137 @@ def bench_elastic() -> None:
     shutil.rmtree(base, ignore_errors=True)
 
 
+def bench_hetero() -> None:
+    """Heterogeneity-aware microshard balancing vs the even split (r15).
+
+    A 3-proc elastic world with ONE rank deterministically throttled 2x
+    (the ``elastic.slow_rank`` fault site, ``mode=throttle`` — the same
+    injector the drill and the balance tests use) runs the identical
+    workload twice, differing only in ``--balance``: ``off`` is the
+    pre-r15 round-robin split (every step commits at the slow rank's
+    pace), ``on`` reassigns microshards in proportion to the measured
+    per-rank rates (train/balance.py). Correctness is enforced in-phase
+    and three-way: both modes AND the unthrottled even-split solo
+    reference must land on bit-identical final params — the invariance
+    argument (same shards, same fixed fold order, only ownership moves)
+    as a measured fact, so the ratio can never come from different math.
+
+    The even-split ceiling with one rank at half speed on 3 ranks is
+    ~1.5x (4+4+4 shards at the slow rank's pace vs 5+5+2 at near-fleet
+    pace); the phase pins >= 1.25x, leaving room for the telemetry
+    warm-up steps (the first rebalance boundary), the rebalance
+    collectives themselves, and this box's scheduler noise. One
+    documented timing-only retry (contended 1-core box); the CRC
+    equalities are never retried.
+    """
+    import shutil
+    import tempfile
+
+    from pytorch_distributed_tpu.launch import ElasticWorldLauncher
+    from pytorch_distributed_tpu.train.elastic_world import (
+        ElasticConfig,
+        reference_run,
+    )
+
+    total_steps, world = 24, 3
+    global_batch, microshards = 24, 12
+    shard_delay, factor = 0.02, 2.0
+    rebalance_every = 2
+    ref = reference_run(ElasticConfig(
+        total_steps=total_steps, global_batch=global_batch,
+        microshards=microshards,
+    ))
+    ids = [f"w{i}" for i in range(world)]
+    throttle_env = {
+        ids[-1]: {
+            "PTD_FAULTS":
+                f"elastic.slow_rank:mode=throttle,factor={factor}"
+        }
+    }
+
+    def run_mode(base: str, mode: str) -> dict:
+        metrics = os.path.join(base, f"{mode}.jsonl")
+        launcher = ElasticWorldLauncher(
+            os.path.join(base, f"rdv_{mode}"),
+            worker_args=(
+                "--total-steps", str(total_steps),
+                "--global-batch", str(global_batch),
+                "--microshards", str(microshards),
+                "--shard-delay-s", str(shard_delay),
+                "--balance", mode,
+                "--rebalance-every", str(rebalance_every),
+                "--ring-timeout-s", "30",
+                "--metrics-path", metrics,
+            ),
+        )
+        launcher.start_world(ids, env_overrides=throttle_env)
+        codes = launcher.wait(240)
+        results = launcher.results()
+        for wid in ids:
+            if codes.get(wid) != 0:
+                raise RuntimeError(
+                    f"hetero balance={mode} worker {wid} rc={codes}"
+                )
+            if results[wid]["params_crc"] != ref["params_crc"]:
+                raise RuntimeError(
+                    f"hetero balance={mode} diverged from the "
+                    f"unthrottled even-split reference: {wid}"
+                )
+            if results[wid]["final_step"] != total_steps:
+                raise RuntimeError(f"{wid} stopped early: {results[wid]}")
+        return results
+
+    tokens = total_steps * global_batch
+    for attempt in (1, 2):  # timing-only retry; CRCs checked every run
+        base = tempfile.mkdtemp(prefix="bench_hetero_")
+        res_off = run_mode(base, "off")
+        res_on = run_mode(base, "on")
+        # the step commits at a collective: every rank's wall is the
+        # world's; charge the slowest to be safe
+        wall_off = max(res_off[w]["wall_s"] for w in ids)
+        wall_on = max(res_on[w]["wall_s"] for w in ids)
+        ratio = wall_off / wall_on
+        counts = res_on[ids[0]]["assignment_counts"]
+        rebalances = res_on[ids[0]]["rebalances"]
+        shutil.rmtree(base, ignore_errors=True)
+        if ratio >= 1.25 or attempt == 2:
+            break
+        print(
+            f"# hetero: attempt {attempt} ratio {ratio:.2f}x < 1.25x "
+            f"on a contended box — one timing-only retry",
+            file=sys.stderr,
+        )
+    if counts == [microshards // world] * world:
+        raise RuntimeError(
+            "hetero balance=on never moved ownership off the even "
+            f"split: {rebalances}"
+        )
+    _emit({
+        "metric": "hetero_balanced_tokens_per_sec",
+        "value": round(tokens / wall_on, 2),
+        "unit": (
+            f"samples/s, {world}-proc CPU ring, 1 rank throttled "
+            f"{factor}x (elastic.slow_rank), balance=on; vs_baseline = "
+            "ratio over balance=off on the IDENTICAL throttled world "
+            "(even-split ceiling ~1.5x); both modes + the unthrottled "
+            "solo reference verified bit-identical in-phase"
+        ),
+        "vs_baseline": round(ratio, 4),
+        "even_tokens_per_sec": round(tokens / wall_off, 2),
+        "assignment_counts": counts,
+        "rebalances": len(rebalances),
+    })
+    print(
+        f"# hetero: balanced {wall_on:.2f}s vs even {wall_off:.2f}s "
+        f"({ratio:.2f}x), counts {counts}", file=sys.stderr,
+    )
+    if ratio < 1.25:
+        raise RuntimeError(
+            f"balance=on ({wall_on:.2f}s) did not recover >= 1.25x over "
+            f"balance=off ({wall_off:.2f}s): {ratio:.2f}x"
+        )
+
+
 def bench_planning() -> None:
     """Auto-parallel planner wall time over the reference config sweep.
 
@@ -2370,6 +2501,9 @@ def main():
         # elastic resize vs die-and-restore is a host-process mechanics
         # ratio over the multi-process CPU ring — meaningful anywhere
         run_if_budget("elastic", bench_elastic)
+        # so is balanced-vs-even on a throttled world: a relative ratio
+        # with three-way bit-identity enforced in-phase (r15)
+        run_if_budget("hetero", bench_hetero)
     else:
         bench_resnet50(on_tpu)
         run_if_budget("input_pipeline", bench_input_pipeline, on_tpu)
@@ -2395,6 +2529,7 @@ def main():
         run_if_budget("observability", bench_observability)
         run_if_budget("planning", bench_planning)
         run_if_budget("elastic", bench_elastic)
+        run_if_budget("hetero", bench_hetero)
     # the per-phase wall clocks as DATA (the stderr "# phase ... done"
     # notes were print-only): one record the driver's BENCH tail and
     # test_bench_contract can both parse

@@ -382,8 +382,9 @@ class FaultSiteRegistry(Rule):
     ``KNOWN_SITES`` in runtime/faults.py (the arming parser already
     refuses unknown names; this rule closes the *call-site* half).
     Checked literals: ``faults.check("...")`` / ``faults.fires("...")``
-    first args, ``faults.injected("spec")`` / ``faults.configure``
-    specs, and ``PTD_FAULTS`` spec strings in env dicts/assignments —
+    / ``faults.throttle("...")`` first args, ``faults.injected("spec")``
+    / ``faults.configure`` specs, and ``PTD_FAULTS`` spec strings in
+    env dicts/assignments —
     which is how tests and drills name sites, so tests/docs snippets
     using a dead name fail the lint too.
     """
@@ -454,7 +455,11 @@ class FaultSiteRegistry(Rule):
                     isinstance(first, ast.Constant)
                     and isinstance(first.value, str)
                 )
-                if owner == "faults" and fn in ("check", "fires") and is_str:
+                if (
+                    owner == "faults"
+                    and fn in ("check", "fires", "throttle")
+                    and is_str
+                ):
                     yield first.value, node
                 elif (
                     owner == "faults"

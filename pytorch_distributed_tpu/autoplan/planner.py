@@ -44,6 +44,7 @@ from pytorch_distributed_tpu.autoplan.pricing import (
     compute_seconds,
     exposed_comm_seconds,
     grad_comm_terms,
+    hetero_compute_seconds,
     price_comm_terms,
     tp_comm_terms,
 )
@@ -91,6 +92,13 @@ class PricedCandidate:
     #: round-14 overlap pricing: grad-sync comm hidden under the step's
     #: overlappable compute (0 when the plan priced serialized comms)
     hidden_comm_seconds: float = 0.0
+    #: round-15 heterogeneous pricing (rank_rates given): the even and
+    #: balanced splits' compute terms, BOTH always recorded whichever
+    #: one compute_seconds carried (plan(balanced=False) prices the
+    #: even baseline but must still report the balancer's gain) — the
+    #: delta is the balancer's priced gain
+    compute_seconds_even: Optional[float] = None
+    compute_seconds_balanced: Optional[float] = None
 
     @property
     def name(self) -> str:
@@ -132,6 +140,23 @@ class PricedCandidate:
             "compute_seconds": self.compute_seconds,
             "step_seconds": self.step_seconds,
             "extrapolated": self.extrapolated,
+            **(
+                {
+                    "hetero": {
+                        "compute_seconds_even": self.compute_seconds_even,
+                        "compute_seconds_balanced":
+                            self.compute_seconds_balanced,
+                        "balance_gain": (
+                            self.compute_seconds_even
+                            / self.compute_seconds_balanced
+                            if (self.compute_seconds_balanced or 0) > 0
+                            else 1.0
+                        ),
+                    }
+                }
+                if self.compute_seconds_even is not None
+                else {}
+            ),
         }
 
 
@@ -148,6 +173,12 @@ class Plan:
     #: True when candidates were priced with the round-14 overlapped
     #: grad sync (exposed-comm = max(0, comm - overlappable compute))
     overlap_grad_sync: bool = False
+    #: round-15: the per-device relative speed vector the compute terms
+    #: were priced with (None = homogeneous fleet assumed)
+    rank_rates: Optional[List[float]] = None
+    #: whether the heterogeneous compute term priced the BALANCED split
+    #: (train/balance.py's apportionment) or the even baseline
+    balanced: bool = True
 
     @property
     def chosen(self) -> Optional[PricedCandidate]:
@@ -204,6 +235,11 @@ class Plan:
             "compute_model": {"source": self.compute_source},
             "uncalibrated": self.uncalibrated,
             "overlap_grad_sync": self.overlap_grad_sync,
+            **(
+                {"rank_rates": list(self.rank_rates),
+                 "balanced": self.balanced}
+                if self.rank_rates is not None else {}
+            ),
             "chosen": self.chosen.name if self.chosen else None,
             "candidates": [c.to_dict() for c in self.candidates],
         }
@@ -270,6 +306,15 @@ def format_plan(doc: dict) -> List[str]:
             "  UNCALIBRATED: prices are analytic guesses — run "
             f"`{calibration_command()}` for a real ranking"
         )
+    rates = doc.get("rank_rates")
+    if rates:
+        mode = "balanced" if doc.get("balanced", True) else "EVEN (off)"
+        lines.append(
+            "  fleet: heterogeneous per-device rates "
+            f"{[round(float(r), 3) for r in rates]} — compute priced on "
+            f"the {mode} microshard split (train/balance.py); each "
+            "candidate's [bal ...x] is its balanced-vs-even compute gain"
+        )
     header = ("rank", "candidate", "step_ms", "comm_ms", "compute_ms",
               "mem/dev_MB", "verdict")
     rows = doc.get("candidates", [])
@@ -288,6 +333,9 @@ def format_plan(doc: dict) -> List[str]:
             verdict = c.get("why_not", "")
         if c.get("extrapolated"):
             verdict += " [extrapolated]"
+        hetero = c.get("hetero")
+        if hetero:
+            verdict += f" [bal {hetero.get('balance_gain', 1.0):.2f}x]"
         lines.append("  " + "  ".join(str(v).ljust(w) for v, w in zip(
             ("-" if c.get("rank") is None else c["rank"],
              c["name"],
@@ -352,8 +400,22 @@ def plan(
     compute: Optional[ComputeModel] = None,
     budget_bytes=_AUTO,
     overlap_grad_sync: bool = False,
+    rank_rates: Optional[Sequence[float]] = None,
+    microshards: Optional[int] = None,
+    balanced: bool = True,
 ) -> Plan:
     """Price every candidate and rank the feasible ones.
+
+    ``rank_rates`` (r15) prices a HETEROGENEOUS fleet: one relative
+    speed multiplier per device (1.0 = the compute model's nominal
+    rate). The compute term becomes ``max over data ways of (assigned
+    work / way rate)`` — ``pricing.hetero_compute_seconds``, using the
+    engine's own microshard apportionment (``microshards`` units,
+    default the granularity floor) so the plan predicts what
+    ``train/balance.py`` will actually assign. ``balanced=False``
+    prices the balance=off even split instead; every candidate records
+    both numbers (``hetero.compute_seconds_even`` vs ``..._balanced``),
+    so the table shows the balancer's priced gain per candidate.
 
     ``overlap_grad_sync=True`` prices the round-14 overlapped gradient
     sync instead of the serialized upper bound: the GRAD exchange terms
@@ -379,6 +441,15 @@ def plan(
         n_devices = len(jax.devices())
     if budget_bytes is _AUTO:
         budget_bytes = device_budget_bytes()
+    if rank_rates is not None:
+        rank_rates = [float(r) for r in rank_rates]
+        if len(rank_rates) != n_devices:
+            raise ValueError(
+                f"rank_rates has {len(rank_rates)} entries for "
+                f"{n_devices} device(s) — one relative rate per device"
+            )
+        if any(r <= 0 for r in rank_rates):
+            raise ValueError(f"rank_rates must be positive: {rank_rates}")
     if tp_candidates is None and max_tp is None:
         # no model-dimension information: enumerating every tp divisor
         # would price tp widths the model's heads may not divide (the
@@ -449,8 +520,20 @@ def plan(
         )
         terms = gterms + tterms
         comm_s = sum(t.seconds for t in terms)
-        comp_s = compute_seconds(profile, global_batch, n_devices,
-                                 compute)
+        comp_even = comp_bal = None
+        if rank_rates is not None:
+            comp_bal = hetero_compute_seconds(
+                profile, global_batch, compute, rank_rates,
+                tp=spec.tp, microshards=microshards, balanced=True,
+            )
+            comp_even = hetero_compute_seconds(
+                profile, global_batch, compute, rank_rates,
+                tp=spec.tp, microshards=microshards, balanced=False,
+            )
+            comp_s = comp_bal if balanced else comp_even
+        else:
+            comp_s = compute_seconds(profile, global_batch, n_devices,
+                                     compute)
         hidden_s = 0.0
         if overlap_grad_sync:
             grad_s = sum(t.seconds for t in gterms)
@@ -460,6 +543,8 @@ def plan(
             spec=spec, memory=memory, comm_terms=terms,
             comm_seconds=comm_s, compute_seconds=comp_s,
             hidden_comm_seconds=hidden_s,
+            compute_seconds_even=comp_even,
+            compute_seconds_balanced=comp_bal,
             feasible=feasible, reason=reason,
             extrapolated=any(t.extrapolated for t in terms),
         ))
@@ -502,6 +587,8 @@ def plan(
         uncalibrated=uncalibrated,
         compute_source=compute.source,
         overlap_grad_sync=overlap_grad_sync,
+        rank_rates=rank_rates,
+        balanced=balanced,
     )
 
 

@@ -301,6 +301,66 @@ def compute_seconds(profile: ModelProfile, global_batch: int,
     return flops / max(n_devices, 1) / compute.flops_per_s_per_device
 
 
+def hetero_compute_seconds(
+    profile: ModelProfile,
+    global_batch: int,
+    compute: ComputeModel,
+    rank_rates: Sequence[float],
+    *,
+    tp: int = 1,
+    microshards: Optional[int] = None,
+    balanced: bool = True,
+) -> float:
+    """Per-step compute on a MIXED-SPEED fleet: the step commits when
+    the slowest rank finishes, so the term is ``max over data ways of
+    (assigned work / way rate)`` — the r15 balancing model
+    (train/balance.py), priced with the engine's OWN discrete
+    apportionment so the plan reproduces what the balancer will
+    actually assign, quantization and all.
+
+    ``rank_rates`` are RELATIVE per-device speed multipliers on
+    ``compute.flops_per_s_per_device`` (1.0 = nominal, 0.5 = half
+    speed). With ``tp > 1`` consecutive devices form one tp group that
+    computes in lockstep, so a way's rate is the MIN over its members —
+    mixing speeds inside a tp group wastes the fast members, and the
+    price says so. ``balanced=False`` prices the even split (the
+    balance=off baseline; its max is governed by the slowest way);
+    ``balanced=True`` prices the proportional split over ``microshards``
+    units (default ``MIN_SHARDS_PER_RANK x ways`` — the granularity
+    floor ``train/balance.granularity_ok`` warns below).
+    """
+    from pytorch_distributed_tpu.train.balance import (
+        MIN_SHARDS_PER_RANK,
+        apportion,
+        counts_of,
+        even_assignment,
+        quantize_rates,
+    )
+
+    n = len(rank_rates)
+    tp = max(int(tp), 1)
+    if n % tp:
+        raise ValueError(
+            f"{n} device rate(s) do not form tp={tp} groups"
+        )
+    ways = [
+        min(float(r) for r in rank_rates[g * tp:(g + 1) * tp])
+        for g in range(n // tp)
+    ]
+    D = len(ways)
+    flops = profile.flops_per_sample * global_batch
+    S = int(microshards) if microshards else MIN_SHARDS_PER_RANK * D
+    if balanced and S >= D:
+        counts = apportion(S, quantize_rates(ways), floor=1)
+    else:
+        counts = counts_of(even_assignment(S, D), D)
+    per_way_flops_per_s = compute.flops_per_s_per_device * tp
+    return max(
+        (flops * c / S) / (per_way_flops_per_s * r)
+        for c, r in zip(counts, ways)
+    )
+
+
 def wire_ratio(terms_a: Sequence[CommTerm],
                terms_b: Sequence[CommTerm]) -> float:
     """Total-wire-bytes ratio a/b — the q8-vs-f32 comparison number."""

@@ -34,6 +34,13 @@ Grammar: ``site[:key=value,...]`` joined by ``;``. Options per site:
     * ``truncate`` — silently truncate the site's file to half (requires
       the site to pass ``path=``; models a torn write)
     * ``bitflip``  — silently flip one byte mid-file (models bit rot)
+    * ``throttle`` — no raise/kill/corruption: the site polls
+      :func:`throttle` and gets back ``factor`` (below) instead of 1.0
+      — a deterministic slowdown injector (the heterogeneity drills'
+      "one rank is 2x slower" knob). ``check`` ignores throttle-mode
+      sites entirely so a shared site name can't double-consume budgets
+* ``factor`` — the slowdown multiplier a firing ``mode=throttle`` site
+  reports (default 2.0; must be > 0)
 * ``match`` — only checks whose ``path`` contains this substring are
   eligible (e.g. corrupt one specific shard)
 
@@ -73,6 +80,14 @@ Known sites (grep for ``faults.check`` to find the exact spots):
 ``elastic.rejoin``   at the top of ``WorldMembership.join`` — a kill
                      here is a joiner that announced and vanished; the
                      incumbents must burn the epoch and re-settle
+``elastic.slow_rank`` polled once per elastic-world step by the
+                     per-shard compute loop (``train/elastic_world.py``)
+                     — ``mode=throttle,factor=F`` makes THIS rank's
+                     synthetic per-microshard compute F-x slower,
+                     deterministically (``after=N`` delays the onset),
+                     so the heterogeneity drill, the bench ``hetero``
+                     phase, and the balance tests all inject the
+                     identical skew the load balancer must absorb
 ``comm.overlap_stall`` in the grad-sync comm pipeline
                      (``parallel/overlap.py``), before each bucket's
                      ring reduce — ``mode=kill`` makes this rank die
@@ -123,9 +138,10 @@ KNOWN_SITES = (
     "elastic.peer_lost",
     "elastic.resize",
     "elastic.rejoin",
+    "elastic.slow_rank",
     "comm.overlap_stall",
 )
-_MODES = ("raise", "kill", "truncate", "bitflip")
+_MODES = ("raise", "kill", "truncate", "bitflip", "throttle")
 
 # unknown site names already warned about (once per name per process:
 # these sit on hot paths when armed)
@@ -168,12 +184,17 @@ class _Site:
         after: int = 0,
         mode: str = "raise",
         match: Optional[str] = None,
+        factor: float = 2.0,
         seed: int = 0,
     ):
         if mode not in _MODES:
             raise ValueError(
                 f"fault site {name!r}: unknown mode {mode!r} "
                 f"(one of {_MODES})"
+            )
+        if not factor > 0:
+            raise ValueError(
+                f"fault site {name!r}: factor must be > 0, got {factor}"
             )
         if not 0.0 <= p <= 1.0:
             raise ValueError(f"fault site {name!r}: p={p} not in [0, 1]")
@@ -187,6 +208,7 @@ class _Site:
         self.after = int(after)
         self.mode = mode
         self.match = match
+        self.factor = float(factor)
         self.fired = 0  # times this site actually fired
         self.seen = 0  # eligible checks observed
         # per-site stream keyed by (seed, site name): arming another site
@@ -239,8 +261,8 @@ class FaultPlan:
                 key, _, value = opt.partition("=")
                 key = key.strip()
                 value = value.strip()
-                if key == "p":
-                    kw["p"] = float(value)
+                if key in ("p", "factor"):
+                    kw[key] = float(value)
                 elif key in ("count", "after"):
                     kw[key] = int(value)
                 elif key in ("mode", "match"):
@@ -311,6 +333,22 @@ def fires(site: str, path: Optional[str] = None) -> bool:
     return s is not None and s.decide(path)
 
 
+def throttle(site: str) -> float:
+    """The slowdown-injection site: the armed ``mode=throttle`` factor
+    when this poll fires, else 1.0 (always 1.0 unarmed — the caller
+    multiplies a sleep/work unit by it, so the production path pays one
+    is-None test and no change). Budgets (``after``/``count``/``p``)
+    gate it like any site, so a drill can switch a rank slow mid-run."""
+    if _plan is None:
+        return 1.0
+    if site not in KNOWN_SITES:  # armed-only: the unarmed path stays
+        _warn_unknown_site(site)  # one is-None test
+    s = _plan.sites.get(site)
+    if s is None or s.mode != "throttle" or not s.decide(None):
+        return 1.0
+    return s.factor
+
+
 def check(site: str, path: Optional[str] = None) -> None:
     """The production fault site: no-op unless this site is armed and its
     budgets elect this check. ``path`` (when the site touches a file)
@@ -320,7 +358,7 @@ def check(site: str, path: Optional[str] = None) -> None:
     if site not in KNOWN_SITES:  # armed-only: the unarmed path stays
         _warn_unknown_site(site)  # one is-None test
     s = _plan.sites.get(site)
-    if s is None or not s.decide(path):
+    if s is None or s.mode == "throttle" or not s.decide(path):
         return
     logger.warning(
         "fault injection: firing %s (mode=%s, %d/%s) at %s",

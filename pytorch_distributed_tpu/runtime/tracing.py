@@ -78,8 +78,12 @@ logger = get_logger(__name__)
 #: peer-loss detection -> membership re-rendezvous -> in-memory re-shard —
 #: distinct from ``recovering`` (restore + replay), so the resize cost is
 #: a priced fact the bench's ``elastic`` phase compares against restart.
+#: ``rebalance`` (r15) is the heterogeneity balancer's own overhead — the
+#: rate allgather + assignment derivation at each rebalance boundary
+#: (train/balance.py) — priced separately so the balancing win the bench
+#: ``hetero`` phase claims is net of what the balancer itself costs.
 GOODPUT_BUCKETS = ("productive", "stalled", "recovering", "checkpoint",
-                   "resize")
+                   "resize", "rebalance")
 
 
 class _NullSpan:

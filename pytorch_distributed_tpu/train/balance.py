@@ -1,0 +1,314 @@
+"""Heterogeneity-aware microshard balancing: deterministic, bit-exact.
+
+A mixed-generation (or mixed-backend, or noisy-neighbor) world runs every
+step at the slowest rank's pace when work is split evenly, because the
+step commits at a collective every rank must reach. The heterogeneous
+joint-training result (PAPERS.md: arxiv 2602.18007) is that splitting the
+batch in proportion to measured per-rank throughput recovers the fleet's
+AGGREGATE speed. This repo is uniquely positioned to do that *bit-exactly*:
+the elastic engine (train/elastic_world.py) already computes gradients as
+per-microshard SUMS over a FIXED virtual shard count, reduced in shard
+order 0..S-1 — the update math is invariant to WHICH rank computes WHICH
+shard (the cross-replica ownership discipline of arxiv 2004.13336, with
+assignment as a free variable). This module makes assignment a computed
+quantity:
+
+* :class:`RateEMA` — per-rank throughput telemetry: an EMA of the
+  per-microshard wall time of the LOCAL compute section only (the engine
+  times the grad loop between collectives, so comm/stall time — which the
+  tracer already separates — never pollutes the rate a rank reports).
+* :func:`assign` — THE pure function ``(S, rates) -> shard->rank map``.
+  Every rank calls it on the identical allgathered rate vector and
+  derives the identical assignment — lockstep by construction, the same
+  idiom as ShipPlan (parallel/overlap.py) and the membership view commits
+  (runtime/membership.py). No rank ever branches on its own rank id to
+  decide the map; ptdlint's PTD001 fixtures pin the shape
+  (``tests/lint_fixtures/ptd001_balance_good.py`` / ``_bad.py``).
+* :func:`microbatch_counts` — the same apportionment for r14's
+  ``HostLoopStep`` path, where the unit is a microbatch instead of a
+  microshard (``trainer.HostLoopStep.set_microbatch_plan``).
+
+Apportionment is largest-remainder (Hamilton) over the rate vector with
+a floor of ONE unit per rank, and every tie broken by rank index — a
+deterministic integer algorithm, no float comparisons across differently
+-optimized builds (the quotas are compared via exact integer cross
+-multiplication). Rejecting zero-shard ranks is deliberate: a rank with
+no work still pays every collective, so "drop the slow rank" must be a
+MEMBERSHIP decision (leave/evict), never a silent side effect of a
+balance step.
+
+Granularity: proportional splits need enough units to express the ratio.
+:func:`granularity_ok` is the guard — below ``4 * world`` units the split
+quantizes so coarsely that balancing cannot express a 2x skew without
+starving someone; the engine warns once and keeps going (the math stays
+correct either way — only the speedup is limited).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional, Sequence, Tuple
+
+from pytorch_distributed_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+#: minimum shards-per-rank multiple below which proportional balancing is
+#: too coarse to express realistic (~2x) skews — the warn-once threshold
+MIN_SHARDS_PER_RANK = 4
+
+#: resolution of the rate quantization in :func:`quantize_rates` — rates
+#: become integers in [1, RATE_RESOLUTION], so the apportionment below is
+#: pure integer arithmetic on every rank
+RATE_RESOLUTION = 1 << 16
+
+
+class BalanceError(ValueError):
+    """An assignment request that cannot be satisfied (e.g. fewer shards
+    than ranks — someone would get zero work but still pay every
+    collective)."""
+
+
+# ---------------------------------------------------------------------------
+# Telemetry: the per-rank rate estimate.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RateEMA:
+    """EMA of per-unit (microshard / microbatch) wall seconds.
+
+    ``update(units, seconds)`` folds one step's local compute time in;
+    ``per_unit_s`` is the current estimate (0.0 = no telemetry yet —
+    the consumer substitutes the fleet mean, see :func:`fill_unknown`).
+    ``alpha`` weights the NEW observation: 0.5 tracks a genuine speed
+    change in a couple of steps while riding out one noisy step.
+    """
+
+    alpha: float = 0.5
+    per_unit_s: float = 0.0
+    samples: int = 0
+
+    def update(self, units: int, seconds: float) -> float:
+        if units <= 0 or seconds <= 0:
+            return self.per_unit_s
+        obs = float(seconds) / float(units)
+        if self.samples == 0:
+            self.per_unit_s = obs
+        else:
+            a = float(self.alpha)
+            self.per_unit_s = a * obs + (1.0 - a) * self.per_unit_s
+        self.samples += 1
+        return self.per_unit_s
+
+
+def fill_unknown(per_unit_s: Sequence[float]) -> List[float]:
+    """Replace no-telemetry entries (<= 0: fresh joiners, genesis) with
+    the mean of the known ones — identical arithmetic on the identical
+    allgathered vector, so the substitution is lockstep too. All-unknown
+    degrades to all-equal (the even split)."""
+    known = [float(v) for v in per_unit_s if v > 0.0]
+    if not known:
+        return [1.0] * len(per_unit_s)
+    mean = sum(known) / len(known)
+    return [float(v) if v > 0.0 else mean for v in per_unit_s]
+
+
+def rates_from_times(per_unit_s: Sequence[float]) -> List[float]:
+    """Throughput vector (units/sec) from per-unit seconds, unknowns
+    filled with the fleet mean."""
+    return [1.0 / t for t in fill_unknown(per_unit_s)]
+
+
+def skew(per_unit_s: Sequence[float]) -> float:
+    """max/min per-unit time over ranks WITH telemetry — the
+    ``train.rank_skew`` gauge (1.0 = homogeneous, 2.0 = one rank half
+    speed; 1.0 when fewer than two ranks have reported)."""
+    known = [float(v) for v in per_unit_s if v > 0.0]
+    if len(known) < 2:
+        return 1.0
+    return max(known) / min(known)
+
+
+# ---------------------------------------------------------------------------
+# The pure assignment function.
+# ---------------------------------------------------------------------------
+
+
+def quantize_rates(rates: Sequence[float]) -> List[int]:
+    """Rates -> integers in [1, RATE_RESOLUTION], scaled by the max.
+
+    The apportionment must be identical on every rank. The inputs
+    already are (they come off one allgather), so float arithmetic
+    would *probably* agree — but integer quotas make it unconditional:
+    after this quantization every comparison in :func:`apportion` is
+    exact integer math.
+    """
+    rs = [float(r) for r in rates]
+    if not rs or any(r <= 0 or not math.isfinite(r) for r in rs):
+        raise BalanceError(f"rates must be positive finite, got {rates}")
+    top = max(rs)
+    return [max(1, round(r / top * RATE_RESOLUTION)) for r in rs]
+
+
+def apportion(units: int, weights: Sequence[int],
+              floor: int = 1) -> List[int]:
+    """Largest-remainder apportionment of ``units`` over integer
+    ``weights`` with a per-slot ``floor``; ties by lowest index.
+
+    Pure integer arithmetic: slot i's quota is ``units * w_i / W``;
+    remainders are compared as the exact integers ``units * w_i % W``.
+    """
+    n = len(weights)
+    if n == 0:
+        raise BalanceError("apportion over zero ranks")
+    if units < n * floor:
+        raise BalanceError(
+            f"{units} unit(s) cannot give {n} rank(s) {floor} each"
+        )
+    total_w = sum(weights)
+    if total_w <= 0 or any(w < 0 for w in weights):
+        raise BalanceError(f"weights must be non-negative, got {weights}")
+    base = [units * w // total_w for w in weights]
+    rem = [units * w % total_w for w in weights]
+    # the floor first: lift starved slots, paid for by the largest
+    # holders (deterministic: largest count, then lowest index)
+    counts = list(base)
+    left = units - sum(counts)
+    # distribute the remainder seats by largest remainder (ties: lowest
+    # index — deterministic)
+    order = sorted(range(n), key=lambda i: (-rem[i], i))
+    for i in order:
+        if left <= 0:
+            break
+        counts[i] += 1
+        left -= 1
+    while True:
+        starved = [i for i in range(n) if counts[i] < floor]
+        if not starved:
+            break
+        i = starved[0]
+        donors = sorted(range(n), key=lambda j: (-counts[j], j))
+        j = donors[0]
+        if counts[j] <= floor:
+            raise BalanceError(
+                f"cannot satisfy floor={floor} for {units} units over "
+                f"{n} ranks"
+            )
+        counts[j] -= 1
+        counts[i] += 1
+    return counts
+
+
+def even_assignment(S: int, world: int) -> Tuple[int, ...]:
+    """The legacy round-robin map ``shard s -> rank s % world`` — the
+    engine's pre-r15 behavior and the balance=off baseline."""
+    if world <= 0:
+        raise BalanceError(f"world must be positive, got {world}")
+    return tuple(s % world for s in range(S))
+
+
+def assignment_from_counts(counts: Sequence[int]) -> Tuple[int, ...]:
+    """Counts -> the canonical shard->rank map: contiguous runs in rank
+    order (shards 0..c0-1 to rank 0, the next c1 to rank 1, ...). The
+    RUN layout is a free choice — any layout folds identically because
+    the reduce order is the shard index, not the owner — but it must be
+    ONE choice, shared by every rank and by the autoplan pricing."""
+    out: List[int] = []
+    for r, c in enumerate(counts):
+        out.extend([r] * int(c))
+    return tuple(out)
+
+
+def assign(S: int, rates: Sequence[float]) -> Tuple[int, ...]:
+    """THE deterministic balance map: shard -> owning rank, proportional
+    to ``rates`` (throughput, units/sec), every rank >= 1 shard.
+
+    Raises :class:`BalanceError` when ``S < len(rates)`` (a zero-shard
+    rank would still pay every collective — that situation is a
+    membership decision, not a balancing one). Every rank derives the
+    identical tuple from the identical allgathered ``rates``.
+    """
+    world = len(rates)
+    if world <= 0:
+        raise BalanceError("assign over zero ranks")
+    if S < world:
+        raise BalanceError(
+            f"{S} microshard(s) over {world} rank(s): a rank would own "
+            "zero shards but still pay every collective — shrink the "
+            "world or raise microshards"
+        )
+    counts = apportion(S, quantize_rates(rates), floor=1)
+    return assignment_from_counts(counts)
+
+
+def counts_of(assignment: Sequence[int], world: int) -> List[int]:
+    """Per-rank shard counts of an assignment map."""
+    counts = [0] * world
+    for r in assignment:
+        counts[int(r)] += 1
+    return counts
+
+
+def owned_shards(assignment: Sequence[int], rank: int) -> List[int]:
+    """The shard ids ``rank`` owns, ascending — row i of the rank's
+    allgather contribution carries shard ``owned[i]``."""
+    return [s for s, r in enumerate(assignment) if int(r) == rank]
+
+
+def row_index(assignment: Sequence[int]) -> List[int]:
+    """shard -> row index within its owner's (ascending) contribution;
+    with ``counts_of`` this is everything the fixed-order fold needs to
+    locate shard s in the allgathered ``[world, k_max, ...]`` block."""
+    seen: dict = {}
+    out: List[int] = []
+    for r in assignment:
+        r = int(r)
+        out.append(seen.get(r, 0))
+        seen[r] = seen.get(r, 0) + 1
+    return out
+
+
+def microbatch_counts(total: int, rates: Sequence[float]) -> List[int]:
+    """Per-rank microbatch counts for the HostLoopStep path: the same
+    floor-1 largest-remainder apportionment, unit = one microbatch of
+    the fixed per-microbatch size (the balancer moves microbatch COUNT
+    between ranks, never microbatch SIZE — sizes must stay uniform for
+    the global mean to be a mean of per-microbatch means)."""
+    return apportion(int(total), quantize_rates(rates), floor=1)
+
+
+def granularity_ok(S: int, world: int) -> bool:
+    """True when ``S`` gives proportional splits room to work (>=
+    MIN_SHARDS_PER_RANK shards per rank)."""
+    return S >= MIN_SHARDS_PER_RANK * world
+
+
+def derive_assignment(
+    S: int,
+    per_unit_s: Sequence[float],
+    *,
+    warn_coarse: Optional[bool] = True,
+) -> Tuple[int, ...]:
+    """The engine's one-call form: allgathered per-unit seconds -> the
+    assignment. Unknown rates filled with the fleet mean; all-unknown
+    (genesis) lands exactly on the even split's counts. Falls back to
+    :func:`even_assignment` — loudly — when S < world (the zero-shard
+    rejection) so a misconfigured world trains correctly at the old
+    pace instead of dying."""
+    world = len(per_unit_s)
+    if S < world:
+        logger.warning(
+            "balance: %d microshards < %d ranks — keeping the even "
+            "split (a proportional split would starve a rank)", S, world,
+        )
+        return even_assignment(S, world)
+    if warn_coarse and not granularity_ok(S, world):
+        logger.warning(
+            "balance: %d microshards over %d ranks is coarse (< %dx "
+            "world) — proportional splits quantize too hard to express "
+            "a ~2x skew; raise ElasticConfig.microshards for real gains",
+            S, world, MIN_SHARDS_PER_RANK,
+        )
+    return assign(S, rates_from_times(per_unit_s))

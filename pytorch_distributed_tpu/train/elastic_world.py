@@ -44,6 +44,20 @@ data order. Three design choices make that provable rather than hoped:
   window is priced as ``recovering`` in the goodput account, the resize
   window as the new ``resize`` bucket.
 
+Round 15 exploits the first invariant's free variable: since the update
+math is independent of WHICH rank computes WHICH shard, shard ownership
+can follow measured per-rank throughput (``train/balance.py``) — a world
+with one 2x-slow rank approaches the fleet's aggregate speed instead of
+running at half speed, with final params **bit-identical to the evenly
+split run by construction** (same shards, same fixed fold order — only
+ownership moves). ``ElasticConfig.balance`` gates it (default on;
+``balance="off"`` is the pre-r15 round-robin A/B baseline); rebalances
+commit at step boundaries every ``rebalance_every`` steps and at every
+view commit, each one a rate allgather + a pure assignment function of
+the identical allgathered vector — lockstep by construction, the same
+idiom as the membership view commits. The balancer's own cost lands in
+the goodput ``rebalance`` bucket.
+
 Everything here is numpy (no jax): elastic workers spawn in ~1 s, the
 math is trivially deterministic, and the subsystem's claims are about
 membership/re-shard/replay mechanics — which are backend-agnostic — not
@@ -57,7 +71,6 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
-import math
 import os
 import pickle
 import shutil
@@ -70,6 +83,7 @@ import numpy as np
 
 from pytorch_distributed_tpu.data.sampler import GlobalBatchSampler
 from pytorch_distributed_tpu.runtime import faults, tracing
+from pytorch_distributed_tpu.train import balance
 from pytorch_distributed_tpu.runtime.membership import (
     MembershipError,
     WorldMembership,
@@ -314,6 +328,16 @@ class ElasticConfig:
     # steps in ~1 ms, far faster than any real model — drills/benches set
     # this so membership events land MID-run and downtime is measured
     # against a realistic step cadence, not a degenerate one
+    shard_delay_s: float = 0.0  # synthetic per-MICROSHARD compute: what
+    # the heterogeneity balancer can actually move between ranks (a
+    # fixed per-step floor cannot be rebalanced) — the hetero bench and
+    # the elastic.slow_rank throttle site both scale THIS
+    balance: str = "on"  # "on": shard ownership follows measured rates
+    # (train/balance.py; bit-identical by construction) | "off": the
+    # pre-r15 round-robin split, the hetero bench's A/B baseline
+    rebalance_every: int = 8  # steps between rate allgathers (0 = only
+    # at view commits); every boundary is a lockstep collective point
+    rate_ema: float = 0.5  # weight of the NEWEST per-shard observation
 
     def __post_init__(self):
         if self.global_batch % self.microshards:
@@ -325,6 +349,22 @@ class ElasticConfig:
             raise ValueError(
                 f"on_peer_loss must be 'resize' or 'exit', got "
                 f"{self.on_peer_loss!r}"
+            )
+        if self.balance not in ("on", "off"):
+            raise ValueError(
+                f"balance must be 'on' or 'off', got {self.balance!r}"
+            )
+        if self.rebalance_every < 0:
+            raise ValueError(
+                f"rebalance_every must be >= 0, got {self.rebalance_every}"
+            )
+        if not 0.0 < self.rate_ema <= 1.0:
+            raise ValueError(
+                f"rate_ema must be in (0, 1], got {self.rate_ema}"
+            )
+        if self.shard_delay_s < 0:
+            raise ValueError(
+                f"shard_delay_s must be >= 0, got {self.shard_delay_s}"
             )
 
 
@@ -388,6 +428,14 @@ class ElasticWorldEngine:
         self._has_state = False
         self.resizes: List[dict] = []
         self.views: List[dict] = []
+        self.rebalances: List[dict] = []
+        self._rate = balance.RateEMA(alpha=cfg.rate_ema)
+        self._assignment: Optional[Tuple[int, ...]] = None
+        self._owned: List[int] = []
+        self._rowidx: List[int] = []
+        self._kmax = 1
+        self._last_rebalance_step = -1
+        self._warned_coarse = False
         self._task_x, self._task_y = task_data(cfg.task)
         self._leaf_names = sorted(init_task_params(cfg.task))
         self._leaf_shapes = {
@@ -433,6 +481,9 @@ class ElasticWorldEngine:
         if self.membership is None:
             self.view, self.ring = None, None
             self._genesis_or_restore()
+            self._set_assignment(
+                balance.even_assignment(self.cfg.microshards, 1)
+            )
             self._note_view()
             self._open_writer()
             return
@@ -443,6 +494,7 @@ class ElasticWorldEngine:
                 world_size=self._expected_world
             )
         self._sync_after_view()
+        self._rebalance("view-commit")
         self._note_view()
         self._open_writer()
 
@@ -466,6 +518,7 @@ class ElasticWorldEngine:
                 self._resize("membership-change")
                 continue
             try:
+                self._maybe_rebalance()
                 self._one_step()
             except MembershipError:
                 raise
@@ -493,6 +546,11 @@ class ElasticWorldEngine:
             "loss": self.losses[-1] if self.losses else None,
             "views": self.views,
             "resizes": self.resizes,
+            "rebalances": self.rebalances,
+            "assignment_counts": (
+                balance.counts_of(self._assignment, self.world_size)
+                if self._assignment is not None else None
+            ),
             "goodput": summary,
             "wall_s": time.monotonic() - t0,
             "ok": True,
@@ -552,23 +610,41 @@ class ElasticWorldEngine:
             S = cfg.microshards
             msz = cfg.global_batch // S
             dims = self._flat_dim()
-            owned = list(range(rank, S, w))
-            k = math.ceil(S / w)
-            local = np.zeros((k, dims + 1), np.float32)
+            if self._assignment is None:  # pre-r15 shape = even split
+                self._set_assignment(balance.even_assignment(S, w))
+            owned = self._owned
+            local = np.zeros((self._kmax, dims + 1), np.float32)
             x, y = self._task_x[idx], self._task_y[idx]
+            # the LOCAL compute section — what the rate telemetry times.
+            # Collectives (the allgather + broadcasts below) stay outside
+            # the window, so a rank blocked on a slow peer never reports
+            # itself slow. elastic.slow_rank is the deterministic
+            # heterogeneity injector (mode=throttle): it scales the
+            # synthetic per-shard compute, one poll per step.
+            throttle = faults.throttle("elastic.slow_rank")
+            t_c0 = time.perf_counter()
             for j, s in enumerate(owned):
+                if cfg.shard_delay_s:
+                    time.sleep(cfg.shard_delay_s * throttle)
                 sl = slice(s * msz, (s + 1) * msz)
                 g, loss = grad_sums(self.params, x[sl], y[sl])
                 local[j, :dims] = self._flatten(g)
                 local[j, dims] = loss
+            if owned:
+                self._rate.update(
+                    len(owned), time.perf_counter() - t_c0
+                )
             if w > 1:
-                rows = self.ring.all_gather(local)  # [w, k, dims+1]
+                rows = self.ring.all_gather(local)  # [w, kmax, dims+1]
             else:
                 rows = local[None]
             gsum = np.zeros(dims, np.float32)
             loss_sum = np.float32(0.0)
-            for s in range(S):  # FIXED order: the invariance argument
-                r, j = s % w, s // w
+            rowidx = self._rowidx
+            assignment = self._assignment
+            for s in range(S):  # FIXED order: the invariance argument —
+                # the fold visits shard s at position s whoever owns it
+                r, j = assignment[s], rowidx[s]
                 gsum = gsum + rows[r, j, :dims]
                 loss_sum = loss_sum + rows[r, j, dims]
             grads = self._unflatten(gsum / np.float32(cfg.global_batch))
@@ -632,6 +708,88 @@ class ElasticWorldEngine:
             ).astype(np.float32)
             off += size
         return out
+
+    # -- heterogeneity-aware shard balancing (r15) -------------------------
+    def _set_assignment(self, assignment: Tuple[int, ...]) -> None:
+        """Commit a shard->rank map and derive the fold bookkeeping:
+        this rank's owned shards (ascending = its allgather row order),
+        the shard->row index, and the padded row count every rank's
+        contribution is shaped to (identical on every rank because the
+        assignment is)."""
+        self._assignment = tuple(int(r) for r in assignment)
+        self._owned = balance.owned_shards(self._assignment, self.rank)
+        self._rowidx = balance.row_index(self._assignment)
+        self._kmax = max(
+            1, max(balance.counts_of(self._assignment, self.world_size))
+        )
+
+    def _maybe_rebalance(self) -> None:
+        """Interval rebalance at the step boundary — gated on the step
+        counter every rank holds identically, so every rank enters (or
+        skips) the collective together."""
+        cfg = self.cfg
+        if (
+            cfg.balance == "on"
+            and cfg.rebalance_every
+            and self.step > 0
+            and self.step % cfg.rebalance_every == 0
+            and self._last_rebalance_step != self.step
+        ):
+            self._rebalance("interval")
+
+    def _rebalance(self, reason: str, book_goodput: bool = True) -> None:
+        """Allgather per-shard rates and commit the new assignment — a
+        pure function (train/balance.py) of the identical allgathered
+        vector, so every rank derives the identical map with no extra
+        barrier: the allgather IS the synchronization. balance=off keeps
+        the legacy round-robin map (the A/B baseline).
+
+        ``book_goodput=False`` when the caller's window already covers
+        this wall time (the resize path books its whole span into the
+        ``resize`` bucket — booking the inner rebalance again would
+        break buckets-sum-to-wall)."""
+        cfg = self.cfg
+        w = self.world_size
+        S = cfg.microshards
+        if cfg.balance != "on" or w == 1:
+            self._set_assignment(balance.even_assignment(S, w))
+            self._last_rebalance_step = self.step
+            return
+        t0 = time.perf_counter()
+        with tracing.span("elastic.rebalance"):
+            mine = np.array([self._rate.per_unit_s], np.float64)
+            rows = self.ring.all_gather(mine)  # [w, 1], identical rows
+            per_unit = [float(rows[r][0]) for r in range(w)]
+            warn = not self._warned_coarse
+            new = balance.derive_assignment(
+                S, per_unit, warn_coarse=warn
+            )
+            if warn and not balance.granularity_ok(S, w):
+                self._warned_coarse = True
+            changed = new != self._assignment
+            self._set_assignment(new)
+        if book_goodput:
+            self.goodput.add("rebalance", time.perf_counter() - t0)
+        self._last_rebalance_step = self.step
+        sk = round(balance.skew(per_unit), 4)
+        if tracing._tracer is not None:  # armed-only gauge emission
+            tracing.counter("train.rank_skew", sk)
+        rec = {
+            "step": self.step,
+            "reason": reason,
+            "counts": balance.counts_of(new, w),
+            "skew": sk,
+            "changed": bool(changed),
+        }
+        self.rebalances.append(rec)
+        if self._writer is not None:
+            self._writer.write(
+                self.step,
+                {"event": "rebalance", "reason": reason,
+                 "counts": rec["counts"], "skew": rec["skew"],
+                 "changed": rec["changed"]},
+                split="elastic",
+            )
 
     # -- checkpointing -----------------------------------------------------
     def _checkpoint_leaves(
@@ -698,6 +856,14 @@ class ElasticWorldEngine:
                 try:
                     self.view, self.ring = self.membership.next_view()
                     self._sync_after_view()
+                    # a resize IS a rebalance boundary: the new world's
+                    # assignment commits before the next step, from the
+                    # survivors' carried rate telemetry (a joiner's
+                    # unknown rate fills with the fleet mean) — inside
+                    # the attempt so a peer death here retries the whole
+                    # view change; the resize span already books this
+                    # wall time, so the inner rebalance must not
+                    self._rebalance("view-commit", book_goodput=False)
                     break
                 except MembershipError:
                     raise
@@ -1010,6 +1176,14 @@ def run_worker(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--on-peer-loss", choices=("resize", "exit"),
                    default="resize")
     p.add_argument("--step-delay-s", type=float, default=0.0)
+    p.add_argument("--shard-delay-s", type=float, default=0.0,
+                   help="synthetic per-microshard compute — what the "
+                   "balancer moves between ranks")
+    p.add_argument("--balance", choices=("on", "off"), default="on",
+                   help="heterogeneity-aware shard balancing (off = the "
+                   "pre-r15 round-robin split, bit-identical output)")
+    p.add_argument("--rebalance-every", type=int, default=8)
+    p.add_argument("--rate-ema", type=float, default=0.5)
     p.add_argument("--ring-timeout-s", type=float, default=5.0)
     p.add_argument("--metrics-path", default=None)
     p.add_argument("--result-path", default=None,
@@ -1030,6 +1204,10 @@ def run_worker(argv: Optional[List[str]] = None) -> int:
         on_peer_loss=args.on_peer_loss,
         metrics_path=args.metrics_path,
         step_delay_s=args.step_delay_s,
+        shard_delay_s=args.shard_delay_s,
+        balance=args.balance,
+        rebalance_every=args.rebalance_every,
+        rate_ema=args.rate_ema,
     )
     result_path = args.result_path or os.path.join(
         args.rendezvous_dir, f"result-{args.worker_id}.json"

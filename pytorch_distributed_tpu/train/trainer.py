@@ -397,7 +397,11 @@ class HostLoopStep:
                 grads = scaler.unscale_grads(grads, scaler_state)
             return grads, aux
 
-        def prep(state, batch):
+        def prep(state, batch, accum):
+            # ``accum`` is static: the default path always passes
+            # accum_steps (one compile); a microbatch plan passes its
+            # local count — one extra compile per distinct count, which
+            # the rebalance cadence bounds
             rng = key_for(state.step)
             if batch_transform is not None:
                 if takes_rng:
@@ -406,7 +410,7 @@ class HostLoopStep:
                     )
                 else:
                     batch = batch_transform(batch)
-            return _split_microbatches(batch, accum_steps)
+            return _split_microbatches(batch, accum)
 
         def grad_one(state, batch_stats, mb, i):
             rng = key_for(state.step)
@@ -432,11 +436,96 @@ class HostLoopStep:
                 scaler=scaler, scaling=scaling, ema_decay=ema_decay,
             )
 
-        self._prep = jax.jit(prep)
+        self._prep = jax.jit(prep, static_argnums=(2,))
         self._grad = jax.jit(grad_one)
         self._apply_fn = apply
         self._apply = None  # built lazily: loss presence is static
         self._apply_has_loss = None
+        self._mb_plan: Optional[Tuple[int, int, int]] = None
+
+    # -- heterogeneity-aware microbatch counts (r15) ------------------------
+    def set_microbatch_plan(self, local_steps: int, total_steps: int,
+                            offset: int = 0) -> None:
+        """Run ``local_steps`` microbatches on THIS rank while the world
+        runs ``total_steps`` in aggregate — the HostLoopStep half of the
+        r15 heterogeneity balancer (``train/balance.microbatch_counts``
+        derives the per-rank counts from the same rate telemetry the
+        elastic engine allgathers).
+
+        Contract: per-MICROBATCH size stays what ``accum_steps`` implied
+        — the balancer moves microbatch COUNT between ranks, never size
+        — so the caller feeds this rank ``local_steps x microbatch``
+        samples per step, and the ring exchange scales local sums by
+        ``world / total_steps`` so the averaged update is the mean over
+        all ``total_steps`` microbatches, exactly the quantity the even
+        split computes. Unlike the elastic engine's fixed-shard fold
+        this is NOT bit-identical to the even split (per-rank partial
+        sums regroup the summation), but it is deterministic and
+        lockstep: the collective sequence per step (one bucketed sync)
+        is independent of the per-rank count.
+
+        ``offset`` is this rank's first GLOBAL microbatch index (the
+        contiguous-runs layout ``balance.assignment_from_counts`` uses:
+        rank r starts after the lower ranks' counts). Each grad call is
+        rng-keyed by its global index, so microbatch j draws the same
+        key whichever rank computes it — a solo run over the same
+        ``total_steps`` microbatches is the reference an uneven world
+        converges to (last-ulp: summation association differs).
+
+        Changing ``local_steps`` changes ``prep``'s input batch shape —
+        one additional compile of the prep/grad programs per DISTINCT
+        local count (bounded by the number of rebalances), which the
+        recompile sentinel treats as a new warm-up baseline.
+
+        Refused for ``reduce_schedule="microbatch"`` (its collective
+        count per step IS the local count — uneven counts desync the
+        ring) and for ``grad_compression="int8"`` (the error-feedback
+        parity claims are pinned on the even path). Call with
+        ``local == total == accum_steps`` to restore the default
+        behavior (clears the plan). Any other stored ``local == total``
+        plan is a SOLO contract — on a multi-rank ring it would mean
+        every rank duplicates every microbatch (and the even ``1/total``
+        scale would silently become ``world/total``), so ``begin()``
+        refuses the combination loudly.
+        """
+        local, total = int(local_steps), int(total_steps)
+        off = int(offset)
+        if local < 1 or total < local:
+            raise ValueError(
+                f"need 1 <= local <= total, got local={local} "
+                f"total={total}"
+            )
+        if off < 0 or off + local > total:
+            raise ValueError(
+                f"offset {off} + local {local} must fit in total {total}"
+            )
+        if self.accum_steps == 1 and total != local:
+            raise ValueError(
+                "an uneven microbatch plan needs accum_steps > 1 at "
+                "build time (accum_steps==1 steps key their single "
+                "microbatch off the raw step rng — there is no global "
+                "index to rebalance over)"
+            )
+        if self.reduce_schedule == "microbatch" and local != total:
+            raise ValueError(
+                "set_microbatch_plan does not compose with "
+                "reduce_schedule='microbatch': per-rank counts ARE the "
+                "per-step collective counts there — uneven counts would "
+                "desync the ring"
+            )
+        if self.grad_compression == "int8" and local != total:
+            raise ValueError(
+                "set_microbatch_plan does not compose with "
+                "grad_compression='int8' (q8 error-feedback parity is "
+                "pinned on the even split)"
+            )
+        if local == total == self.accum_steps:
+            # the documented restore: identical to never having set a
+            # plan, so clear it — begin() takes the default path (and a
+            # multi-rank ring keeps its exact 1/A scale)
+            self._mb_plan = None
+            return
+        self._mb_plan = (local, total, off)
 
     # -- introspection ------------------------------------------------------
     def compile_counts(self) -> Dict[str, Optional[int]]:
@@ -474,17 +563,53 @@ class HostLoopStep:
         from pytorch_distributed_tpu.parallel.overlap import get_engine
         from pytorch_distributed_tpu.runtime import distributed as dist
 
-        A = self.accum_steps
-        mbs = self._prep(state, batch)
+        plan = self._mb_plan
+        A = self.accum_steps if plan is None else plan[0]
+        offset = 0 if plan is None else plan[2]
+        mbs = self._prep(state, batch, A)
         stats = state.batch_stats
         outs = []
         for i in range(A):
             mb = jax.tree_util.tree_map(lambda x, _i=i: x[_i], mbs)
-            grads, m, stats = self._grad(state, stats, mb, np.int32(i))
+            # a microbatch plan keys each grad by its GLOBAL microbatch
+            # index (this rank covers [offset, offset+local)), so the
+            # same microbatch draws the same rng whichever rank computes
+            # it — the elastic engine's ownership-free key discipline
+            grads, m, stats = self._grad(
+                state, stats, mb, np.int32(offset + i)
+            )
             outs.append((grads, m))
         inv = 1.0 / A
         ring = dist.multiprocess_ring()
         use_ring = ring is not None and ring.world_size > 1
+        if plan is not None:
+            total = plan[1]
+            if use_ring:
+                if A >= total:
+                    raise RuntimeError(
+                        f"microbatch plan local={A} == total={total} on "
+                        f"a {ring.world_size}-rank ring: every rank "
+                        "would duplicate every microbatch and the "
+                        "reduced gradient would be scaled by world — "
+                        "pass local == total == accum_steps to clear "
+                        "the plan, or a per-rank share summing to total"
+                    )
+                # ring "avg" divides the summed contributions by world,
+                # so scaling local sums by world/total makes the reduced
+                # result the mean over ALL total microbatches — the even
+                # split's world/(A*world) == 1/A exactly, uneven worlds
+                # the aggregate-speed generalization of it
+                wire_scale = ring.world_size / total
+            elif total != A:
+                raise RuntimeError(
+                    f"microbatch plan local={A} < total={total} needs a "
+                    "multiprocess ring to cover the remaining "
+                    "microbatches — solo runs must set local == total"
+                )
+            else:
+                wire_scale = inv
+        else:
+            wire_scale = inv
         per_mb = use_ring and self.reduce_schedule == "microbatch"
         treedef = None
         session = None
@@ -526,7 +651,7 @@ class HostLoopStep:
                 else:
                     # bucket-staggered: each bucket's ring reduce starts
                     # while the host accumulates/scales the next bucket
-                    session.finish(np_leaves, scale=inv)
+                    session.finish(np_leaves, scale=wire_scale)
             else:
                 if local_acc is None:
                     local_acc = [
@@ -571,7 +696,8 @@ class HostLoopStep:
             comm = pending["mb_comm"] + st["comm_s"]
             exposed = pending["mb_exposed"] + st["exposed_s"]
             leaves = self._fold_reduced(pending["mb_acc"], done)
-            if self.accum_steps > 1:
+            if inv != 1.0:  # the pending's OWN count (a microbatch
+                # plan may differ from the built accum_steps)
                 for leaf in leaves:
                     np.multiply(leaf, inv.astype(leaf.dtype), out=leaf)
             self.last_sync_stats = {
@@ -584,7 +710,7 @@ class HostLoopStep:
             self.last_sync_stats = sync_stats
         else:
             leaves = pending["local_acc"]
-            if self.accum_steps > 1:
+            if inv != 1.0:  # ditto: the pending's own count
                 for leaf in leaves:
                     np.multiply(
                         leaf, inv.astype(leaf.dtype), out=leaf

@@ -18,6 +18,12 @@ investigation starts from —
   latency when a calibrated ``costmodel.json`` sits in the run dir,
   and per-rank straggler skew when the trace is a
   ``scripts/trace_merge.py`` merge of several ranks,
+* stragglers: per-rank step-time skew when the trace is a
+  ``scripts/trace_merge.py`` merge (its k-th-occurrence alignment
+  puts every rank's k-th step on one clock), the ``train.rank_skew``
+  gauge the elastic balancer emits at each rebalance boundary, and
+  the rebalance audit trail (``split="elastic"`` records: per-rank
+  shard counts, measured skew, whether ownership moved),
 * plan: the auto-parallel planner's ranked candidate table when a
   ``plan.json`` (``--strategy auto`` / autoplan/planner.py) sits in
   the run dir — the audit trail for why this run's strategy was
@@ -297,6 +303,92 @@ def comms_section(events, rows, other, costmodel_path, out):
     return stats
 
 
+#: spans that mean "one training step" — the unit the per-rank
+#: straggler comparison is over (the trainer's and the elastic
+#: engine's step sections respectively)
+STEP_SPANS = ("train.step", "elastic.step")
+
+
+def stragglers_section(events, records, out):
+    """Per-rank step-time skew + the heterogeneity balancer's audit.
+
+    Three inputs, each optional: merged-trace step spans (pid = rank
+    after trace_merge, so per-rank step walls line up on one clock),
+    the ``train.rank_skew`` counter the rebalancer emits (max/min
+    per-microshard seconds across ranks as allgathered — the quantity
+    assignments are derived from), and ``split="elastic"`` rebalance
+    records (what the balancer actually did about it)."""
+    per_rank = {}
+    for ev in events:
+        if ev.get("ph") == "X" and ev.get("name") in STEP_SPANS:
+            per_rank.setdefault(ev.get("pid"), []).append(
+                float(ev.get("dur", 0.0)) / 1e3
+            )
+    gauge = [
+        float((ev.get("args") or {}).get("value", 0.0))
+        for ev in events
+        if ev.get("ph") == "C" and ev.get("name") == "train.rank_skew"
+    ]
+    rebalances = [
+        r for r in records
+        if r.get("split") == "elastic" and r.get("event") == "rebalance"
+    ]
+    if (len(per_rank) < 2) and not gauge and not rebalances:
+        return None
+    print("\n== Stragglers ==", file=out)
+    summary = {}
+    if len(per_rank) >= 2:  # skew needs a merged multi-rank trace
+        means = {
+            r: sum(d) / len(d) for r, d in per_rank.items() if d
+        }
+        skew = max(means.values()) / min(means.values())
+        summary["step_skew"] = round(skew, 4)
+        summary["ranks"] = len(means)
+        print(
+            f"  per-rank step time (merged trace, "
+            f"{min(len(d) for d in per_rank.values())} steps/rank):",
+            file=out,
+        )
+        for r in sorted(means):
+            d = per_rank[r]
+            print(
+                f"    rank{r}: mean={means[r]:.2f}ms "
+                f"p95={percentile(d, 95):.2f}ms max={max(d):.2f}ms",
+                file=out,
+            )
+        print(
+            f"  step-time skew (slowest/fastest rank): {skew:.2f}x",
+            file=out,
+        )
+    if gauge:
+        summary["rank_skew_gauge"] = gauge[-1]
+        print(
+            f"  train.rank_skew gauge: last {gauge[-1]:.2f}x, max "
+            f"{max(gauge):.2f}x over {len(gauge)} rebalance "
+            f"boundar{'y' if len(gauge) == 1 else 'ies'} (measured "
+            f"per-microshard seconds, max/min across ranks)", file=out,
+        )
+    if rebalances:
+        moved = sum(1 for r in rebalances if r.get("changed"))
+        summary["rebalances"] = len(rebalances)
+        summary["rebalances_changed"] = moved
+        print(
+            f"  rebalances: {len(rebalances)} boundar"
+            f"{'y' if len(rebalances) == 1 else 'ies'}, ownership moved "
+            f"at {moved}", file=out,
+        )
+        for r in rebalances:
+            print(
+                f"    step {r.get('step', '?'):>6}  "
+                f"counts={r.get('counts')}  "
+                f"skew={r.get('skew', 0.0):.2f}x  "
+                f"({r.get('reason', '?')}"
+                f"{', moved' if r.get('changed') else ', unchanged'})",
+                file=out,
+            )
+    return summary
+
+
 def _fmt_row(cols, widths):
     return "  ".join(str(c).rjust(w) for c, w in zip(cols, widths))
 
@@ -419,6 +511,9 @@ def report(trace_path, metric_paths, top_n=10, out=None,
     # -- comms -------------------------------------------------------------
     comms = comms_section(events, rows, other, costmodel_path, out)
 
+    # -- stragglers (r15: heterogeneity picture) ---------------------------
+    stragglers = stragglers_section(events, records, out)
+
     # -- auto-parallel plan ------------------------------------------------
     plan_doc = plan_section(plan_path, out)
 
@@ -521,7 +616,8 @@ def report(trace_path, metric_paths, top_n=10, out=None,
                 f"emits its correction token)", file=out,
             )
     return {"spans": rows, "recompiles": recompiles, "goodput": g,
-            "comms": comms or {}, "plan": plan_doc, "serve": serve}
+            "comms": comms or {}, "stragglers": stragglers or {},
+            "plan": plan_doc, "serve": serve}
 
 
 def main(argv=None):

@@ -1647,3 +1647,115 @@ def overlap_trace_worker(rank: int, world: int, name: str, q,
         import traceback
 
         q.put((rank, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
+
+
+def hetero_microbatch_worker(rank: int, world: int, name: str, q) -> None:
+    """r15 HostLoopStep.set_microbatch_plan over a live 2-proc ring:
+    (a) an EVEN plan (local == total/world, contiguous offsets) is
+    bit-identical to the default path — the plan machinery itself adds
+    no arithmetic; (b) an UNEVEN plan (balance.microbatch_counts over a
+    2:1 rate skew -> [4, 2] of 6) over the SAME global microbatches is
+    deterministic (two runs, identical bits) and last-ulp close to the
+    even split (per-rank partial sums regroup the summation — the
+    documented non-bit-exact scope); (c) the collective sequence stays
+    lockstep with uneven counts: both ranks finish every step."""
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["RANK"] = str(rank)
+        os.environ["WORLD_SIZE"] = str(world)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import optax
+
+        import pytorch_distributed_tpu as ptd
+        from pytorch_distributed_tpu.train import (
+            TrainState,
+            build_train_step,
+        )
+        from pytorch_distributed_tpu.train import balance
+
+        ptd.init_process_group("gloo", group_name=name, timeout_s=120.0)
+
+        def loss_fn(params, batch_stats, batch, rng):
+            pred = jnp.tanh(batch["x"] @ params["w"]) @ params["v"]
+            loss = jnp.mean((pred - batch["y"]) ** 2)
+            return loss, {"metrics": {"loss": loss},
+                          "batch_stats": batch_stats}
+
+        ri = np.random.default_rng(0)  # same init on every rank
+        init = {
+            "w": ri.normal(size=(16, 40)).astype(np.float32),
+            "v": ri.normal(size=(40, 4)).astype(np.float32),
+        }
+
+        def mkstate():
+            return TrainState.create(
+                apply_fn=lambda p, x: x,
+                params={k: jnp.asarray(v) for k, v in init.items()},
+                tx=optax.sgd(0.125),  # power-of-two lr (DESIGN.md §19)
+            )
+
+        TOTAL, MB = 6, 8  # 6 global microbatches of 8 rows each
+
+        def global_mb(step, j):  # microbatch j is the same whoever
+            r = np.random.default_rng(1000 + step * TOTAL + j)  # owns it
+            return {
+                "x": r.normal(size=(MB, 16)).astype(np.float32),
+                "y": r.normal(size=(MB, 4)).astype(np.float32),
+            }
+
+        def batch_for(step, offset, local):
+            mbs = [global_mb(step, offset + i) for i in range(local)]
+            return {
+                k: np.concatenate([m[k] for m in mbs]) for k in ("x", "y")
+            }
+
+        def run(counts, accum_build):
+            offset = sum(counts[:rank])
+            local = counts[rank]
+            host = build_train_step(loss_fn, accum_steps=accum_build,
+                                    overlap_accum=True)
+            host.set_microbatch_plan(local, TOTAL, offset)
+            s = mkstate()
+            for t in range(3):
+                s, _ = host(s, batch_for(t, offset, local))
+            return np.concatenate([
+                np.asarray(s.params[k]).ravel() for k in sorted(init)
+            ])
+
+        even = [TOTAL // world] * world
+        # default path (no plan): rank covers its contiguous run via the
+        # SAME per-rank batches, keyed 0..local-1 — the plan's even form
+        # must be bit-identical to it
+        host0 = build_train_step(loss_fn, accum_steps=TOTAL // world,
+                                 overlap_accum=True)
+        s = mkstate()
+        for t in range(3):
+            s, _ = host0(
+                s, batch_for(t, sum(even[:rank]), even[rank])
+            )
+        default_params = np.concatenate([
+            np.asarray(s.params[k]).ravel() for k in sorted(init)
+        ])
+        even_params = run(even, TOTAL // world)
+        # loss_fn ignores rng, so the offset-keyed grads match the
+        # index-keyed default bit for bit
+        assert np.array_equal(default_params, even_params), (
+            np.abs(default_params - even_params).max()
+        )
+        uneven = balance.microbatch_counts(TOTAL, [2.0, 1.0])
+        assert uneven == [4, 2], uneven
+        u1 = run(uneven, TOTAL // world)
+        u2 = run(uneven, TOTAL // world)
+        assert np.array_equal(u1, u2)  # deterministic
+        # same global microbatches, regrouped partial sums: last-ulp
+        np.testing.assert_allclose(u1, even_params, rtol=2e-5, atol=2e-6)
+        assert not np.array_equal(u1, np.zeros_like(u1))
+        ptd.destroy_process_group()
+        q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover - reported via queue
+        import traceback
+
+        q.put((rank, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))

@@ -1,0 +1,23 @@
+"""PTD001 known-bad: rank-conditional control flow in a rebalance.
+
+Two anti-shapes of the r15 balancer: a "leader" computing the new
+assignment and broadcasting only from its own branch (ranks != 0 never
+reach the collective → the world deadlocks at the ring deadline), and a
+slow rank opting out of the rate allgather it feels it doesn't need
+(its peers block forever waiting for its row).
+"""
+
+
+def leader_decides_assignment(ring, rate, derive):
+    if ring.rank == 0:
+        rows = ring.all_gather(rate)  # expect: PTD001
+        return derive(rows)
+    return None
+
+
+def slow_rank_skips_the_allgather(ring, rank, busy, rate, derive):
+    overloaded = rank == 2 and busy
+    if overloaded:
+        return None  # opts out: peers block at the ring
+    rows = ring.all_gather(rate)  # expect: PTD001
+    return derive(rows)

@@ -1,0 +1,31 @@
+"""PTD001 known-good twin: the rebalance protocol's lockstep shape.
+
+The r15 balancer's safety argument (train/elastic_world.py:_rebalance):
+every rank allgathers its rate, then derives the new shard->rank map as
+a PURE function of the identical allgathered vector — the allgather IS
+the synchronization, and rank appears only in VALUES (which row is
+mine), never in the control flow around a collective.
+"""
+
+
+def rebalance_from_allgather(ring, rate, derive):
+    # every rank contributes one rate and derives the identical map
+    rows = ring.all_gather(rate)
+    assignment = derive(rows)
+    return assignment
+
+
+def rebalance_gated_on_shared_step(ring, step, every, rate, derive):
+    # the interval gate reads the STEP COUNTER every rank holds
+    # identically — all ranks enter (or skip) the collective together
+    if every and step % every == 0:
+        rows = ring.all_gather(rate)
+        return derive(rows)
+    return None
+
+
+def apply_owned_shards(ring, assignment, rank, shards, grads):
+    # ownership is rank-dependent DATA (which shards I compute), while
+    # the collective itself is issued unconditionally on every rank
+    local = [grads[s] for s in shards if assignment[s] == rank]
+    return ring.all_gather(local)

@@ -754,3 +754,144 @@ class TestRound14Pricing:
         assert c["comms"]["exposed_seconds"] == pytest.approx(
             c["comms"]["seconds"] - c["comms"]["hidden_seconds"]
         )
+
+
+class TestRound15HeteroPricing:
+    """r15: pricing mixed-speed fleets with the engine's OWN discrete
+    apportionment — hand-computed prices throughout, so the planner's
+    balanced-vs-even ordering is a checked arithmetic fact, not a
+    trend."""
+
+    PROFILE = autoplan.ModelProfile(
+        flops_per_sample=1e9, activation_bytes_per_sample=0.0
+    )
+
+    def test_hand_computed_balanced_and_even(self):
+        from pytorch_distributed_tpu.autoplan.pricing import (
+            hetero_compute_seconds,
+        )
+
+        # rates [1, 1, 0.5], 12 shards -> counts [5, 5, 2]
+        # (tests/test_balance.py pins the same apportionment);
+        # flops = 12e9 at 1e9 f/s/dev:
+        #   balanced: max(5, 5, (2/12*12e9)/(0.5e9)=4) = 5 s
+        #   even [4,4,4]: max(4, 4, 8) = 8 s
+        bal = hetero_compute_seconds(
+            self.PROFILE, 12, MEASURED, [1.0, 1.0, 0.5], balanced=True
+        )
+        even = hetero_compute_seconds(
+            self.PROFILE, 12, MEASURED, [1.0, 1.0, 0.5], balanced=False
+        )
+        assert bal == pytest.approx(5.0)
+        assert even == pytest.approx(8.0)
+
+    def test_homogeneous_rates_match_the_flat_term(self):
+        from pytorch_distributed_tpu.autoplan.pricing import (
+            compute_seconds,
+            hetero_compute_seconds,
+        )
+
+        flat = compute_seconds(self.PROFILE, 12, 3, MEASURED)
+        for balanced in (True, False):
+            assert hetero_compute_seconds(
+                self.PROFILE, 12, MEASURED, [1.0] * 3, balanced=balanced
+            ) == pytest.approx(flat)
+
+    def test_tp_group_rate_is_the_min_member(self):
+        from pytorch_distributed_tpu.autoplan.pricing import (
+            hetero_compute_seconds,
+        )
+
+        # tp=2 groups: ways = [min(1, .5), min(1, 1)] = [.5, 1]; 8
+        # shards -> counts [3, 5]; flops 8e9, per-way rate 2e9:
+        #   balanced: max((3/8*8e9)/(2e9*.5), (5/8*8e9)/2e9) = 3 s
+        #   even [4,4]: max(4e9/1e9, 4e9/2e9) = 4 s
+        bal = hetero_compute_seconds(
+            self.PROFILE, 8, MEASURED, [1.0, 0.5, 1.0, 1.0],
+            tp=2, balanced=True,
+        )
+        even = hetero_compute_seconds(
+            self.PROFILE, 8, MEASURED, [1.0, 0.5, 1.0, 1.0],
+            tp=2, balanced=False,
+        )
+        assert bal == pytest.approx(3.0)
+        assert even == pytest.approx(4.0)
+        with pytest.raises(ValueError, match="tp=3"):
+            hetero_compute_seconds(
+                self.PROFILE, 8, MEASURED, [1.0] * 4, tp=3
+            )
+
+    def _bench_shape_plan(self, abstract_state, **kw):
+        # the bench `hetero` phase's shape: 3 ranks, one at half speed,
+        # 12 microshards, dp only
+        return autoplan.plan(
+            profile=self.PROFILE, global_batch=24,
+            abstract_state=abstract_state,
+            cost_model=hand_model(1e-9, 1e-9, worlds=(3,)),
+            compute=MEASURED, strategies=("dp",), max_tp=1,
+            n_devices=3, budget_bytes=None,
+            rank_rates=[1.0, 1.0, 0.5], microshards=12, **kw,
+        )
+
+    def test_plan_reproduces_the_bench_ordering(self, abstract_state):
+        """The acceptance pin: on the bench workload's shape the plan
+        prices balanced at 1.6x the even split — the same ordering the
+        measured phase enforces (>= 1.25x with overheads), with the
+        numbers hand-computable: counts [5,5,2] -> 10 s vs even
+        [4,4,4] -> 16 s at flops 24e9."""
+        p = self._bench_shape_plan(abstract_state)
+        c = p.best()
+        assert c.compute_seconds == pytest.approx(10.0)
+        assert c.compute_seconds_even == pytest.approx(16.0)
+        d = c.to_dict()["hetero"]
+        assert d["balance_gain"] == pytest.approx(1.6)
+        assert d["compute_seconds_balanced"] == pytest.approx(10.0)
+        # balanced=False prices the balance=off baseline — but the
+        # hetero record must still carry the TRUE balanced price and
+        # gain (the whole point of pricing the baseline is seeing what
+        # turning balancing on would buy; review catch: it reported
+        # its own even price as "balanced" and a 1.00x gain)
+        off = self._bench_shape_plan(abstract_state, balanced=False)
+        assert off.best().compute_seconds == pytest.approx(16.0)
+        assert off.best().step_seconds > c.step_seconds
+        d_off = off.best().to_dict()["hetero"]
+        assert d_off["compute_seconds_balanced"] == pytest.approx(10.0)
+        assert d_off["balance_gain"] == pytest.approx(1.6)
+
+    def test_plan_json_records_rates_and_table_renders(
+        self, abstract_state, tmp_path
+    ):
+        from pytorch_distributed_tpu.autoplan.planner import format_plan
+
+        p = self._bench_shape_plan(abstract_state)
+        doc = json.load(open(p.save(str(tmp_path / "plan.json"))))
+        assert doc["rank_rates"] == [1.0, 1.0, 0.5]
+        assert doc["balanced"] is True
+        text = "\n".join(format_plan(doc))
+        assert "heterogeneous" in text
+        assert "[bal 1.60x]" in text
+        # a homogeneous plan records neither (no schema noise)
+        q = run_plan(abstract_state, hand_model(1e-9, 1e-9))
+        qdoc = json.load(open(q.save(str(tmp_path / "plan2.json"))))
+        assert "rank_rates" not in qdoc
+        assert "hetero" not in qdoc["candidates"][0]
+
+    def test_rate_vector_validated(self, abstract_state):
+        with pytest.raises(ValueError, match="one relative rate"):
+            autoplan.plan(
+                profile=self.PROFILE, global_batch=24,
+                abstract_state=abstract_state,
+                cost_model=hand_model(1e-9, 1e-9, worlds=(3,)),
+                compute=MEASURED, strategies=("dp",), max_tp=1,
+                n_devices=3, budget_bytes=None,
+                rank_rates=[1.0, 1.0],
+            )
+        with pytest.raises(ValueError, match="positive"):
+            autoplan.plan(
+                profile=self.PROFILE, global_batch=24,
+                abstract_state=abstract_state,
+                cost_model=hand_model(1e-9, 1e-9, worlds=(3,)),
+                compute=MEASURED, strategies=("dp",), max_tp=1,
+                n_devices=3, budget_bytes=None,
+                rank_rates=[1.0, 1.0, -0.5],
+            )

@@ -1,0 +1,455 @@
+"""Heterogeneity-aware microshard balancing (marker: hetero).
+
+Three layers:
+
+* the pure pieces — ``train/balance.py``'s integer apportionment
+  (hand-computed counts, determinism, the zero-shard rejection, the
+  granularity guard) and the ``mode=throttle`` fault injector;
+* the engine — THE invariance proof live on a 3-proc ring: even split,
+  rate-skewed split, and mid-run reassignments all land bit-identical
+  to the solo reference (same shards, same fixed fold order — only
+  ownership moves), plus the chaos case (the throttled rank SIGKILLed
+  mid-run; the rebalanced survivors still match the solo CRC);
+* the HostLoopStep half — ``set_microbatch_plan`` validation and the
+  2-proc uneven-counts parity worker (deterministic, lockstep,
+  last-ulp vs the even split — the documented non-bit-exact scope).
+
+The bench ``hetero`` phase (throughput ratio + three-way CRC equality,
+pinned by test_bench_contract) is the performance half of the claim;
+everything here is correctness.
+"""
+
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.launch import ElasticWorldLauncher
+from pytorch_distributed_tpu.runtime import faults
+from pytorch_distributed_tpu.train import balance
+from pytorch_distributed_tpu.train.balance import BalanceError
+from pytorch_distributed_tpu.train.elastic_world import (
+    ElasticConfig,
+    reference_run,
+)
+
+from tests import hostring_workers
+
+pytestmark = pytest.mark.hetero
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- the pure assignment function ------------------------------------------
+
+
+class TestApportion:
+    def test_hand_computed_two_to_one(self):
+        # rates [1, 1, 0.5] -> quantized [65536, 65536, 32768]; exact
+        # integer quotas 4.8/4.8/2.4 of 12 -> base [4, 4, 2], two
+        # remainder seats to the two largest remainders (ranks 0, 1)
+        assert balance.assign(12, [1.0, 1.0, 0.5]) == (
+            0, 0, 0, 0, 0, 1, 1, 1, 1, 1, 2, 2
+        )
+
+    def test_equal_rates_recover_the_even_counts(self):
+        for world in (1, 2, 3, 4):
+            a = balance.assign(4 * world, [1.0] * world)
+            assert balance.counts_of(a, world) == [4] * world
+
+    def test_deterministic_and_scale_invariant(self):
+        rates = [3.1, 1.7, 2.4, 0.9]
+        a = balance.assign(16, rates)
+        assert a == balance.assign(16, rates)
+        # rates are relative: scaling the vector changes nothing
+        assert a == balance.assign(16, [r * 7.3 for r in rates])
+
+    def test_every_rank_keeps_at_least_one_shard(self):
+        # a 100x skew must not starve the slow rank: zero-shard ranks
+        # still pay every collective, so dropping one is a MEMBERSHIP
+        # decision, never a balancing side effect
+        counts = balance.counts_of(
+            balance.assign(8, [100.0, 100.0, 1.0]), 3
+        )
+        assert min(counts) >= 1 and sum(counts) == 8
+
+    def test_fewer_shards_than_ranks_rejected(self):
+        with pytest.raises(BalanceError, match="zero shards"):
+            balance.assign(2, [1.0, 1.0, 1.0])
+
+    def test_bad_rates_rejected(self):
+        for bad in ([], [1.0, 0.0], [1.0, -2.0], [1.0, float("nan")],
+                    [1.0, float("inf")]):
+            with pytest.raises(BalanceError):
+                balance.assign(8, bad)
+
+    def test_apportion_floor_lifts_from_largest_holder(self):
+        # 5 units, weights heavily skewed: the floor seat comes out of
+        # the largest count, deterministically
+        counts = balance.apportion(5, [1000, 1000, 1], floor=1)
+        assert counts == [2, 2, 1]
+        with pytest.raises(BalanceError):
+            balance.apportion(2, [1, 1, 1], floor=1)
+
+    def test_row_bookkeeping_consistent(self):
+        a = balance.assign(12, [1.0, 2.0, 0.5])
+        world = 3
+        rowidx = balance.row_index(a)
+        for rank in range(world):
+            owned = balance.owned_shards(a, rank)
+            assert owned == sorted(owned)
+            # shard s sits at row rowidx[s] of its owner's contribution
+            for j, s in enumerate(owned):
+                assert rowidx[s] == j
+        assert sorted(
+            s for r in range(world) for s in balance.owned_shards(a, r)
+        ) == list(range(12))
+
+    def test_microbatch_counts_same_apportionment(self):
+        assert balance.microbatch_counts(6, [2.0, 1.0]) == [4, 2]
+        assert balance.microbatch_counts(4, [1.0, 1.0]) == [2, 2]
+
+
+class TestTelemetry:
+    def test_rate_ema_tracks_and_rides_out_noise(self):
+        r = balance.RateEMA(alpha=0.5)
+        assert r.update(4, 0.4) == pytest.approx(0.1)  # first: exact
+        r.update(4, 0.4)
+        assert r.per_unit_s == pytest.approx(0.1)
+        r.update(4, 0.8)  # one slow step moves it halfway
+        assert r.per_unit_s == pytest.approx(0.15)
+        # zero/negative observations are ignored, not folded
+        before = r.per_unit_s
+        r.update(0, 1.0)
+        r.update(4, 0.0)
+        assert r.per_unit_s == before
+
+    def test_fill_unknown_uses_fleet_mean(self):
+        assert balance.fill_unknown([0.2, 0.0, 0.4]) == pytest.approx(
+            [0.2, 0.3, 0.4]
+        )
+        # all-unknown (genesis) degrades to all-equal -> the even split
+        assert balance.fill_unknown([0.0, 0.0]) == [1.0, 1.0]
+
+    def test_skew_gauge(self):
+        assert balance.skew([0.1, 0.2, 0.1]) == pytest.approx(2.0)
+        assert balance.skew([0.1]) == 1.0
+        assert balance.skew([0.0, 0.0]) == 1.0
+
+    def test_derive_assignment_genesis_is_even(self):
+        # no telemetry anywhere -> exactly the even split's counts
+        a = balance.derive_assignment(12, [0.0, 0.0, 0.0])
+        assert balance.counts_of(a, 3) == [4, 4, 4]
+
+    def test_derive_assignment_s_below_world_falls_back_loudly(
+        self, caplog
+    ):
+        ns = logging.getLogger("pytorch_distributed_tpu")
+        ns.addHandler(caplog.handler)
+        try:
+            with caplog.at_level(
+                logging.WARNING, logger="pytorch_distributed_tpu"
+            ):
+                a = balance.derive_assignment(2, [0.1, 0.2, 0.3])
+        finally:
+            ns.removeHandler(caplog.handler)
+        assert a == balance.even_assignment(2, 3)
+        assert any("even split" in r.message for r in caplog.records)
+
+    def test_granularity_guard(self, caplog):
+        assert balance.granularity_ok(12, 3)
+        assert not balance.granularity_ok(11, 3)
+        ns = logging.getLogger("pytorch_distributed_tpu")
+        ns.addHandler(caplog.handler)
+        try:
+            with caplog.at_level(
+                logging.WARNING, logger="pytorch_distributed_tpu"
+            ):
+                balance.derive_assignment(4, [0.1, 0.2, 0.1])
+                balance.derive_assignment(4, [0.1, 0.2, 0.1],
+                                          warn_coarse=False)
+        finally:
+            ns.removeHandler(caplog.handler)
+        warns = [r for r in caplog.records if "coarse" in r.message]
+        assert len(warns) == 1  # warn_coarse=False suppresses
+
+
+class TestElasticConfigGuards:
+    def test_balance_flag_validated(self):
+        with pytest.raises(ValueError, match="balance"):
+            ElasticConfig(total_steps=1, global_batch=4, microshards=4,
+                          balance="maybe")
+        with pytest.raises(ValueError, match="rebalance_every"):
+            ElasticConfig(total_steps=1, global_batch=4, microshards=4,
+                          rebalance_every=-1)
+        with pytest.raises(ValueError, match="rate_ema"):
+            ElasticConfig(total_steps=1, global_batch=4, microshards=4,
+                          rate_ema=0.0)
+        with pytest.raises(ValueError, match="shard_delay_s"):
+            ElasticConfig(total_steps=1, global_batch=4, microshards=4,
+                          shard_delay_s=-0.1)
+
+
+# -- the throttle injector -------------------------------------------------
+
+
+class TestThrottleSite:
+    def test_site_registered(self):
+        assert "elastic.slow_rank" in faults.KNOWN_SITES
+
+    def test_disarmed_is_unit_factor(self):
+        assert not faults.active()
+        assert faults.throttle("elastic.slow_rank") == 1.0
+
+    def test_armed_factor_and_after_budget(self):
+        spec = "elastic.slow_rank:mode=throttle,factor=2.5,after=2"
+        with faults.injected(spec):
+            got = [faults.throttle("elastic.slow_rank") for _ in range(5)]
+        assert got == [1.0, 1.0, 2.5, 2.5, 2.5]
+
+    def test_check_ignores_throttle_sites(self):
+        # a throttle-mode site must never raise/kill through check():
+        # the same site name polled by both forms cannot double-fire
+        with faults.injected("elastic.slow_rank:mode=throttle,factor=3"):
+            faults.check("elastic.slow_rank")  # no raise
+            assert faults.throttle("elastic.slow_rank") == 3.0
+
+    def test_non_throttle_site_reports_unit_factor(self):
+        with faults.injected("elastic.peer_lost:mode=kill,after=99"):
+            assert faults.throttle("elastic.peer_lost") == 1.0
+
+    def test_factor_validated(self):
+        with pytest.raises(ValueError, match="factor"):
+            faults.FaultPlan.parse(
+                "elastic.slow_rank:mode=throttle,factor=0"
+            )
+
+
+# -- the invariance proof, live --------------------------------------------
+
+
+def _launcher(tmp_path, tag, **overrides):
+    defaults = {
+        "--total-steps": "10",
+        "--global-batch": "24",
+        "--microshards": "12",
+        "--shard-delay-s": "0.005",
+        "--rebalance-every": "3",
+        "--ring-timeout-s": "3.0",
+        "--metrics-path": str(tmp_path / f"{tag}.jsonl"),
+    }
+    defaults.update(overrides)
+    args = []
+    for k, v in defaults.items():
+        if v is not None:
+            args += [k, str(v)]
+    return ElasticWorldLauncher(
+        str(tmp_path / f"rdv_{tag}"), worker_args=args
+    )
+
+
+THROTTLE = "elastic.slow_rank:mode=throttle,factor=2"
+
+
+def test_assignment_invariance_even_skewed_and_midrun(tmp_path):
+    """THE bit-exactness proof: the same 3-proc world with one rank
+    throttled 2x runs under balance=off (even split, every step) and
+    balance=on (telemetry-skewed split, committed MID-RUN at the
+    rebalance boundaries — steps before the first boundary run even,
+    after it skewed, so one run covers even, skewed, AND the
+    reassignment transition at step k) — and both land bit-identical
+    to the solo reference. Same shards, same fixed fold order; only
+    ownership moved."""
+    ref = reference_run(ElasticConfig(
+        total_steps=10, global_batch=24, microshards=12
+    ))
+    results = {}
+    for mode in ("off", "on"):
+        launcher = _launcher(tmp_path, mode, **{"--balance": mode})
+        launcher.start_world(
+            ["w0", "w1", "w2"],
+            env_overrides={"w2": {"PTD_FAULTS": THROTTLE}},
+        )
+        codes = launcher.wait(120)
+        assert all(c == 0 for c in codes.values()), codes
+        results[mode] = launcher.results()
+    for mode in ("off", "on"):
+        for wid in ("w0", "w1", "w2"):
+            r = results[mode][wid]
+            assert r["final_step"] == 10, (mode, wid)
+            assert r["params_crc"] == ref["params_crc"], (mode, wid)
+    # balance=off never moved off round-robin
+    assert results["off"]["w0"]["assignment_counts"] == [4, 4, 4]
+    assert results["off"]["w0"]["rebalances"] == []
+    # balance=on measured the skew and moved ownership mid-run (the
+    # genesis view-commit has no telemetry and stays at even counts;
+    # the first INTERVAL boundary carries the measured skew)
+    on = results["on"]["w0"]
+    assert on["rebalances"], on
+    moved = [
+        r for r in on["rebalances"]
+        if r["changed"] and r["counts"] != [4, 4, 4]
+    ]
+    assert moved, on["rebalances"]
+    assert moved[0]["skew"] > 1.3, moved[0]
+    assert moved[0]["step"] > 0, moved[0]  # committed MID-run
+    counts = on["assignment_counts"]
+    assert counts != [4, 4, 4] and sum(counts) == 12
+    assert counts[2] < 4, counts  # the throttled rank sheds shards
+    # every rank committed the identical final assignment
+    for wid in ("w1", "w2"):
+        assert results["on"][wid]["assignment_counts"] == counts
+
+
+def test_chaos_throttled_rank_killed_midrun(tmp_path):
+    """The chaos case: the 2x-throttled rank is SIGKILLed mid-run. The
+    survivors re-mesh in-process (the r13 path), the post-resize
+    rebalance re-derives ownership over the 2-rank world, and the
+    finishers STILL match the solo reference CRC — a resize and a
+    rebalance are the same kind of event, and neither moves the
+    math."""
+    launcher = _launcher(tmp_path, "chaos", **{
+        "--total-steps": "12", "--balance": "on",
+        "--ring-timeout-s": "2.0",
+    })
+    launcher.start_world(
+        ["w0", "w1", "w2"],
+        env_overrides={"w2": {
+            "PTD_FAULTS": THROTTLE + ";elastic.peer_lost:mode=kill,after=5"
+        }},
+    )
+    codes = launcher.wait(120)
+    assert codes["w2"] == faults.KILLED_EXIT, codes
+    results = launcher.results()
+    ref = reference_run(ElasticConfig(
+        total_steps=12, global_batch=24, microshards=12
+    ))
+    for wid in ("w0", "w1"):
+        r = results[wid]
+        assert codes[wid] == 0, codes
+        assert r["final_step"] == 12
+        assert r["params_crc"] == ref["params_crc"], wid
+        assert [v["world_size"] for v in r["views"]] == [3, 2]
+        # the view commit IS a rebalance boundary: the 2-rank world
+        # re-derived a full-coverage assignment
+        counts = r["assignment_counts"]
+        assert len(counts) == 2 and sum(counts) == 12
+        assert min(counts) >= 1
+
+
+# -- the HostLoopStep half -------------------------------------------------
+
+
+class TestMicrobatchPlanValidation:
+    def _host(self, **kw):
+        import jax.numpy as jnp
+
+        from pytorch_distributed_tpu.train import build_train_step
+
+        def loss_fn(params, batch_stats, batch, rng):
+            loss = jnp.mean((batch["x"] @ params["w"]) ** 2)
+            return loss, {"metrics": {"loss": loss},
+                          "batch_stats": batch_stats}
+
+        kw.setdefault("accum_steps", 4)
+        return build_train_step(loss_fn, overlap_accum=True, **kw)
+
+    def test_bounds_validated(self):
+        h = self._host()
+        with pytest.raises(ValueError, match="local"):
+            h.set_microbatch_plan(0, 4)
+        with pytest.raises(ValueError, match="local"):
+            h.set_microbatch_plan(5, 4)
+        with pytest.raises(ValueError, match="offset"):
+            h.set_microbatch_plan(3, 8, offset=6)
+
+    def test_accum_one_cannot_rebalance(self):
+        h = self._host(accum_steps=1)
+        with pytest.raises(ValueError, match="accum_steps > 1"):
+            h.set_microbatch_plan(1, 2)
+        h.set_microbatch_plan(1, 1)  # the solo/even restore form is fine
+
+    def test_microbatch_schedule_refused(self):
+        h = self._host(reduce_schedule="microbatch")
+        with pytest.raises(ValueError, match="microbatch"):
+            h.set_microbatch_plan(3, 8)
+
+    def test_int8_compression_refused(self):
+        h = self._host(grad_compression="int8")
+        with pytest.raises(ValueError, match="int8"):
+            h.set_microbatch_plan(3, 8)
+
+    def test_solo_run_requires_local_equals_total(self):
+        import optax
+
+        import jax.numpy as jnp
+
+        from pytorch_distributed_tpu.train import (
+            TrainState,
+            build_train_step,
+        )
+
+        def loss_fn(params, batch_stats, batch, rng):
+            loss = jnp.mean((batch["x"] @ params["w"]) ** 2)
+            return loss, {"metrics": {"loss": loss},
+                          "batch_stats": batch_stats}
+
+        h = build_train_step(loss_fn, accum_steps=4, overlap_accum=True)
+        h.set_microbatch_plan(2, 4)
+        s = TrainState.create(
+            apply_fn=lambda p, x: x,
+            params={"w": np.ones((4, 2), np.float32)},
+            tx=optax.sgd(0.125),
+        )
+        batch = {"x": np.ones((8, 4), np.float32)}
+        with pytest.raises(RuntimeError, match="multiprocess ring"):
+            h.begin(s, batch)
+
+    def test_restore_clears_the_plan(self):
+        """``local == total == accum_steps`` is the documented restore:
+        it must be IDENTICAL to never having set a plan (review catch:
+        a stored restore plan on a multi-rank ring would have scaled
+        the reduced gradient by world — world/total != 1/A)."""
+        h = self._host()  # accum_steps=4
+        h.set_microbatch_plan(3, 8)
+        assert h._mb_plan == (3, 8, 0)
+        h.set_microbatch_plan(4, 4)
+        assert h._mb_plan is None
+
+    def test_local_equals_total_refused_on_a_ring(self, monkeypatch):
+        """A stored ``local == total`` plan (a SOLO contract — only
+        reachable with local != accum_steps) on a multi-rank ring would
+        mean every rank duplicates every microbatch with the gradient
+        silently scaled by world: begin() must refuse, never scale."""
+        import optax
+
+        from pytorch_distributed_tpu.runtime import distributed as dist
+        from pytorch_distributed_tpu.train import TrainState
+
+        h = self._host()  # accum_steps=4
+        h.set_microbatch_plan(2, 2)  # solo contract, NOT the restore
+        assert h._mb_plan == (2, 2, 0)
+
+        class _FakeRing:
+            world_size = 2
+
+        monkeypatch.setattr(
+            dist, "multiprocess_ring", lambda: _FakeRing()
+        )
+        s = TrainState.create(
+            apply_fn=lambda p, x: x,
+            params={"w": np.ones((4, 2), np.float32)},
+            tx=optax.sgd(0.125),
+        )
+        batch = {"x": np.ones((8, 4), np.float32)}
+        with pytest.raises(RuntimeError, match="duplicate every"):
+            h.begin(s, batch)
+
+
+def test_uneven_microbatch_plan_parity_over_ring():
+    world = 2
+    results = hostring_workers.run_ring_workers(
+        world, hostring_workers.hetero_microbatch_worker, timeout=420.0
+    )
+    assert results == [(r, "ok") for r in range(world)], results

@@ -230,6 +230,27 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
     )
     assert ratio["restart_downtime_s"] > el["value"], ratio
 
+    # the hetero phase (r15): one rank throttled 2x on a 3-proc world —
+    # proportional microshard balancing must recover >= 1.25x over the
+    # even split (even-split ceiling ~1.5x; the pin leaves room for the
+    # telemetry warm-up and the rebalance collectives), with final
+    # params verified bit-identical INSIDE the phase between both modes
+    # and the unthrottled solo reference (it raises on divergence, so
+    # this ratio can never come from different math), and ownership
+    # must actually have moved off the even split
+    het = one_metric("hetero_balanced_tokens_per_sec")
+    assert het["value"] > 0, het
+    assert het["vs_baseline"] is not None and het["vs_baseline"] >= 1.25, (
+        f"balanced split lost its speedup over the even split: {het}"
+    )
+    assert het["even_tokens_per_sec"] > 0, het
+    counts = het["assignment_counts"]
+    assert counts != [4, 4, 4], het  # the even split over 12 shards
+    assert sum(counts) == 12 and min(counts) >= 1, het
+    assert het["rebalances"] > 0, het
+    assert "hetero" in pd[0]["value"], pd[0]
+    assert durations.get("hetero", 999) < 300, durations
+
     # the comms phase: q8's RECORDED wire bytes at gradient size must be
     # <= 0.3x f32 (the encoding is int8 + one f32 scale per 256 elems,
     # ~0.254 — ROADMAP item 1's bytes-moved-reduction number, measured

@@ -507,6 +507,20 @@ class TestRuleEnvelopes:
 
         assert FaultSiteRegistry().registry == set(faults.KNOWN_SITES)
 
+    def test_ptd003_covers_throttle_call_sites(self):
+        """r15: the slowdown-injection poll (``faults.throttle``) is a
+        registry-checked call form too — a typo'd site name would make a
+        heterogeneity drill inject nothing and 'pass'."""
+        src = (
+            "from pytorch_distributed_tpu.runtime import faults\n"
+            "def f():\n"
+            "    return faults.throttle('elastic.slow_wrank')\n"
+        )
+        fs = lint_source(src, rules=[FaultSiteRegistry()])
+        assert [f.rule_id for f in fs] == ["PTD003"]
+        ok = src.replace("slow_wrank", "slow_rank")
+        assert lint_source(ok, rules=[FaultSiteRegistry()]) == []
+
     def test_ptd004_respects_path_filter(self):
         src = "import jax.numpy as jnp\nx = jnp.zeros(4).at[0].set(1.0)\n"
         hot = lint_source(

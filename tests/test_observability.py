@@ -294,6 +294,38 @@ class TestGoodput:
         empty = tracing.GoodputAccount(clock=lambda: now[0]).summary()
         assert empty["resize_s"] == 0.0
 
+    def test_rebalance_bucket_reported_and_sums_to_wall(self):
+        """The heterogeneity-balancer bucket (r15): ``rebalance`` is a
+        first-class goodput bucket — the rate-allgather + assignment
+        derivation at each boundary is priced separately, so the bench
+        ``hetero`` phase's balancing win is net of what the balancer
+        itself costs — and the sum-to-wall invariant holds with it
+        charged."""
+        assert "rebalance" in tracing.GOODPUT_BUCKETS
+        now = [0.0]
+        g = tracing.GoodputAccount(clock=lambda: now[0])
+        now[0] += 10.0
+        g.add("productive", 7.0)
+        g.add("rebalance", 0.5)
+        g.add("resize", 1.5)
+        s = g.summary()
+        assert s["rebalance_s"] == pytest.approx(0.5)
+        total = sum(
+            v for k, v in s.items()
+            if k.endswith("_s") and k != "wall_s"
+        )
+        assert total == pytest.approx(s["wall_s"])
+        assert s["other_s"] == pytest.approx(1.0)
+        # never-rebalanced accounts still report the bucket (schema)
+        empty = tracing.GoodputAccount(clock=lambda: now[0]).summary()
+        assert empty["rebalance_s"] == 0.0
+        # ...and summarize_goodput carries it through the JSONL account
+        summ = tracing.summarize_goodput(
+            [{"split": "goodput", "rebalance_s": 0.25, "wall_s": 1.0,
+              "productive_s": 0.75}]
+        )
+        assert summ["rebalance_s"] == pytest.approx(0.25)
+
     def test_buckets_sum_to_wall_under_injected_faults(self, tmp_path):
         """End to end: a Trainer run with PTD_FAULTS armed (a step.nan
         injection plus a checkpoint cadence) still accounts every wall
@@ -477,6 +509,54 @@ class TestTrainerTraceFlag:
         # summed across attempt records (2+1), trace's 1 merged by max
         assert "train.step: 3 steady-state" in out
         assert "Goodput" in out
+
+    def test_obs_report_stragglers_section(self, tmp_path, capsys):
+        """r15: the Stragglers section renders all three inputs — the
+        per-rank step skew from a merged trace (pid = rank after
+        trace_merge), the ``train.rank_skew`` gauge the rebalancer
+        emits, and the ``split="elastic"`` rebalance audit records —
+        and a run with none of them prints no section at all."""
+        # a merged-trace shape: rank 1's steps take 2x rank 0's
+        events = []
+        for rank, dur_us in ((0, 10_000.0), (1, 20_000.0)):
+            for k in range(3):
+                events.append({
+                    "name": "elastic.step", "ph": "X", "pid": rank,
+                    "tid": 0, "ts": k * 30_000.0, "dur": dur_us,
+                })
+        events.append({
+            "name": "train.rank_skew", "ph": "C", "pid": 0, "tid": 0,
+            "ts": 0.0, "args": {"value": 2.0},
+        })
+        (tmp_path / "trace.json").write_text(json.dumps(
+            {"traceEvents": events, "otherData": {}}
+        ))
+        with MetricsWriter(str(tmp_path / "m.jsonl")) as w:
+            w.write(8, {"event": "rebalance", "reason": "interval",
+                        "counts": [8, 4], "skew": 2.0,
+                        "changed": True}, split="elastic")
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts",
+        ))
+        try:
+            import obs_report
+        finally:
+            sys.path.pop(0)
+        rc = obs_report.main([str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "Stragglers" in out
+        assert "step-time skew (slowest/fastest rank): 2.00x" in out
+        assert "train.rank_skew gauge: last 2.00x" in out
+        assert "counts=[8, 4]" in out and "moved" in out
+        # silent when a run carries none of the three inputs
+        solo = tmp_path / "solo"
+        solo.mkdir()
+        with MetricsWriter(str(solo / "m.jsonl")) as w:
+            w.write(1, {"loss": 1.0}, split="train")
+        assert obs_report.main([str(solo)]) == 0
+        assert "Stragglers" not in capsys.readouterr().out
 
 
 # -- torn metrics (the PR 2 chaos scenario) --------------------------------
