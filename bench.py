@@ -60,6 +60,7 @@ import json
 import os
 import sys
 import time
+import zlib
 
 import jax
 import jax.numpy as jnp
@@ -1674,6 +1675,188 @@ def bench_hetero() -> None:
         )
 
 
+def _multihost_worker(rank, world, name, q, mode, addr, elems, iters):
+    """One rank of the multihost phase: ``mode`` picks hierarchical
+    (two shm domains, TCP between the leaders) or flat-over-TCP; both
+    run the identical integer-valued allreduce so the parent can demand
+    bit-identical results across modes AND ranks."""
+    try:
+        import zlib
+
+        from pytorch_distributed_tpu.runtime.hierarchy import (
+            build_hierarchical_group,
+        )
+        from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
+        from pytorch_distributed_tpu.runtime.transport import TcpTransport
+
+        half = world // 2
+        # integer-valued f32: sums stay < 2^24, so ANY grouping of the
+        # additions is exact — the hier-vs-flat bit-identity is claimable
+        data = ((np.arange(elems, dtype=np.int64) % 97) + rank + 1).astype(
+            np.float32
+        )
+        if mode == "hier":
+            g = build_hierarchical_group(
+                name, rank,
+                [list(range(half)), list(range(half, world))],
+                inter_addr=addr,
+            )
+            tcp_bytes = lambda: g.inter_bytes_sent  # noqa: E731
+        else:
+            t = TcpTransport(name, rank, world, addr)
+            g = HostRingGroup(name, rank, world, transport=t)
+            tcp_bytes = lambda: t.bytes_sent  # noqa: E731
+        buf = data.copy()
+        g.all_reduce(buf, op="sum", inplace=True)  # warmup (throttled too)
+        g.barrier()
+        b0 = tcp_bytes()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            np.copyto(buf, data)  # fresh inputs: sums must stay integer
+            g.all_reduce(buf, op="sum", inplace=True)
+        wall = time.perf_counter() - t0
+        moved = tcp_bytes() - b0
+        crc = zlib.crc32(buf.tobytes())
+        g.close()
+        q.put((rank, {"wall_s": wall, "crc": crc, "tcp_bytes": moved}))
+    except Exception as e:  # reported via queue
+        q.put((rank, f"{type(e).__name__}: {e}"))
+
+
+def _free_port_addr() -> str:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    addr = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    return addr
+
+
+def bench_multihost() -> None:
+    """Hierarchical vs flat-over-TCP allreduce across two "hosts" (r16).
+
+    Two shm domains of 2 ranks each on this box, TCP between them — the
+    multi-host topology scaled down to one machine. The slow link is
+    made PHYSICAL, identically for both paths, by arming the
+    ``transport.slow_link`` throttle (factor x the calibrated 1 GB/s
+    wire time, applied to exactly the bytes each TCP exchange moved), so
+    the measured ratio isolates the one thing hierarchy changes:
+    bytes-over-the-slow-link. Flat ships ``2(w-1)/w x payload = 1.5P``
+    per RANK per step over TCP; hierarchical ships ``2(H-1)/H x P = P``
+    per LEADER and nothing from non-leaders.
+
+    Three in-phase checks, only the first ever retried (timing, 1-core
+    box): the wall ratio >= 1.3x; the measured TCP byte counters equal
+    the analytic formulas EXACTLY (the transport counts payload bytes
+    only, and the payload divides the world evenly — floor-free); and
+    final tensors are bit-identical across ranks, across the two paths,
+    and vs the numpy reference (integer-valued f32 payload, so grouping
+    cannot change the bits — the one regime where flat-vs-hier equality
+    is claimable; DESIGN.md §21)."""
+    from pytorch_distributed_tpu.runtime.hostring import algo_wire_bytes
+
+    world, iters, factor = 4, 10, 16.0
+    elems = 1 << 20  # 4 MB f32 == one slot: single-chunk, divides evenly
+    payload = elems * 4
+    env = {
+        "PTD_FAULTS": f"transport.slow_link:mode=throttle,factor={factor}"
+    }
+    ref = np.zeros(elems, np.float32)
+    for r in range(world):
+        ref += ((np.arange(elems, dtype=np.int64) % 97) + r + 1).astype(
+            np.float32
+        )
+    ref_crc = zlib.crc32(ref.tobytes())
+
+    def run_mode(mode: str) -> dict:
+        res = _spawn_ring_workers(
+            world, _multihost_worker, timeout=600,
+            extra=(mode, _free_port_addr(), elems, iters), env=env,
+        )
+        bad = [r for r in res if not isinstance(r[1], dict)]
+        if bad:
+            raise RuntimeError(f"multihost {mode} failed: {bad}")
+        out = {r: d for r, d in res}
+        for r, d in out.items():
+            if d["crc"] != ref_crc:
+                raise RuntimeError(
+                    f"multihost {mode} rank {r}: result differs from "
+                    f"the numpy reference (crc {d['crc']:#x} != "
+                    f"{ref_crc:#x})"
+                )
+        return out
+
+    flat_rank_bytes = iters * algo_wire_bytes("all_reduce", payload, world)
+    hier_leader_bytes = iters * algo_wire_bytes("all_reduce", payload, 2)
+    for attempt in (1, 2):  # timing-only retry; bytes+bits every run
+        hier = run_mode("hier")
+        flat = run_mode("flat")
+        # exact byte accounting, NEVER retried: leaders move exactly
+        # 2(H-1)/H x payload per step, non-leaders nothing; every flat
+        # rank moves exactly 2(w-1)/w x payload per step
+        for r in range(world):
+            want = hier_leader_bytes if r in (0, world // 2) else 0
+            if hier[r]["tcp_bytes"] != want:
+                raise RuntimeError(
+                    f"hier rank {r} moved {hier[r]['tcp_bytes']} TCP "
+                    f"bytes, analytic says {want}"
+                )
+            if flat[r]["tcp_bytes"] != flat_rank_bytes:
+                raise RuntimeError(
+                    f"flat rank {r} moved {flat[r]['tcp_bytes']} TCP "
+                    f"bytes, analytic says {flat_rank_bytes}"
+                )
+        wall_hier = max(d["wall_s"] for d in hier.values())
+        wall_flat = max(d["wall_s"] for d in flat.values())
+        ratio = wall_flat / wall_hier
+        if ratio >= 1.3 or attempt == 2:
+            break
+        print(
+            f"# multihost: attempt {attempt} ratio {ratio:.2f}x < 1.3x "
+            f"on a contended box — one timing-only retry",
+            file=sys.stderr,
+        )
+    _emit({
+        "metric": "multihost_hier_vs_flat_ratio",
+        "value": round(ratio, 4),
+        "unit": (
+            f"flat-over-TCP wall / hierarchical wall, {world} ranks in "
+            f"2 shm domains + TCP inter-host leg throttled {factor:g}x "
+            f"(transport.slow_link armed identically in both paths); "
+            f"all outputs bit-identical across ranks, paths, and the "
+            f"numpy reference"
+        ),
+        "vs_baseline": None,
+        "wall_hier_s": round(wall_hier, 3),
+        "wall_flat_s": round(wall_flat, 3),
+    })
+    _emit({
+        "metric": "multihost_slow_link_bytes_per_step",
+        "value": hier_leader_bytes // iters,
+        "unit": (
+            f"TCP bytes per leader per allreduce step at {payload / 1e6:.1f}"
+            f" MB payload, H=2 domains — measured counter EQUALS the "
+            f"analytic 2(H-1)/H x payload (flat: {flat_rank_bytes // iters}"
+            f" per rank = 2(w-1)/w x payload); exactness enforced "
+            "in-phase, never retried"
+        ),
+        "vs_baseline": None,
+        "flat_bytes_per_rank_per_step": flat_rank_bytes // iters,
+        "bytes_exact": True,
+    })
+    print(
+        f"# multihost: hier {wall_hier:.2f}s vs flat {wall_flat:.2f}s "
+        f"({ratio:.2f}x), leader bytes/step {hier_leader_bytes // iters}",
+        file=sys.stderr,
+    )
+    if ratio < 1.3:
+        raise RuntimeError(
+            f"hierarchical ({wall_hier:.2f}s) did not beat flat-over-TCP "
+            f"({wall_flat:.2f}s) by >= 1.3x: {ratio:.2f}x"
+        )
+
+
 def bench_planning() -> None:
     """Auto-parallel planner wall time over the reference config sweep.
 
@@ -1879,31 +2062,36 @@ def _hostring_ar_worker(rank: int, world: int, name: str, q) -> None:
         q.put((rank, f"{type(e).__name__}: {e}"))
 
 
-def _spawn_ring_workers(world: int, target, timeout: float = 300.0):
-    """Spawn one (rank, world, name, q)-shaped worker per rank on the
-    CPU backend and collect one queue result per rank. Join/terminate
-    runs even when a rank dies without reporting (a native-lib crash
-    would otherwise leave the survivors unjoined behind a queue.Empty)."""
+def _spawn_ring_workers(world: int, target, timeout: float = 300.0,
+                        extra=(), env=None):
+    """Spawn one (rank, world, name, q, *extra)-shaped worker per rank
+    on the CPU backend and collect one queue result per rank.
+    Join/terminate runs even when a rank dies without reporting (a
+    native-lib crash would otherwise leave the survivors unjoined behind
+    a queue.Empty). ``env`` entries are set for the children (spawn
+    inherits the parent environment) and restored before returning."""
     import multiprocessing as mp
     import uuid
 
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
     name = f"ptdbench_{uuid.uuid4().hex[:8]}"
-    old = os.environ.get("JAX_PLATFORMS")
-    os.environ["JAX_PLATFORMS"] = "cpu"  # children must not touch the chip
+    overrides = {"JAX_PLATFORMS": "cpu", **(env or {})}
+    old = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)  # children must not touch the chip
     try:
         procs = [
-            ctx.Process(target=target, args=(r, world, name, q))
+            ctx.Process(target=target, args=(r, world, name, q) + tuple(extra))
             for r in range(world)
         ]
         for p in procs:
             p.start()
     finally:
-        if old is None:
-            os.environ.pop("JAX_PLATFORMS", None)
-        else:
-            os.environ["JAX_PLATFORMS"] = old
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     try:
         return [q.get(timeout=timeout) for _ in range(world)]
     finally:
@@ -2504,6 +2692,9 @@ def main():
         # so is balanced-vs-even on a throttled world: a relative ratio
         # with three-way bit-identity enforced in-phase (r15)
         run_if_budget("hetero", bench_hetero)
+        # hierarchical-vs-flat over a throttled TCP leg: relative ratio
+        # plus EXACT slow-link byte accounting, bit-identity in-phase
+        run_if_budget("multihost", bench_multihost)
     else:
         bench_resnet50(on_tpu)
         run_if_budget("input_pipeline", bench_input_pipeline, on_tpu)
@@ -2530,6 +2721,7 @@ def main():
         run_if_budget("planning", bench_planning)
         run_if_budget("elastic", bench_elastic)
         run_if_budget("hetero", bench_hetero)
+        run_if_budget("multihost", bench_multihost)
     # the per-phase wall clocks as DATA (the stderr "# phase ... done"
     # notes were print-only): one record the driver's BENCH tail and
     # test_bench_contract can both parse

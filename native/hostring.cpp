@@ -567,6 +567,17 @@ void dequant_copy(float* dst, const int8_t* q, const float* scales,
 
 }  // namespace
 
+// Exported for the TCP transport (runtime/transport.py): its q8 owner
+// fold must run the EXACT same instruction sequence as the shm ring's
+// dequant_add — the compiler contracts acc += q*s to an FMA here, which
+// a numpy two-step (multiply, then add) cannot reproduce bit-for-bit.
+// Sharing the compiled kernel makes cross-transport q8 bit-identity a
+// property of the build, not of rounding luck.
+extern "C" void hr_q8_dequant_add(float* acc, const int8_t* q,
+                                  const float* scales, uint64_t n) {
+  dequant_add(acc, q, scales, (size_t)n);
+}
+
 extern "C" int hr_allreduce_q8(void* h, float* data, uint64_t count,
                                int32_t op) {
   Group* g = (Group*)h;

@@ -367,3 +367,97 @@ def wire_ratio(terms_a: Sequence[CommTerm],
     a = sum(t.wire_bytes for t in terms_a)
     b = sum(t.wire_bytes for t in terms_b)
     return a / b if b else math.inf
+
+
+@dataclasses.dataclass(frozen=True)
+class HierPrice:
+    """A priced hierarchical allreduce (runtime/hierarchy.py): the three
+    sequential legs, each on its own transport's fit."""
+
+    intra_reduce_s: float
+    inter_exchange_s: float
+    intra_bcast_s: float
+    #: per-leader bytes over the slow link — 2(H-1)/H x payload for the
+    #: f32 leg (q8 inter shrinks the payload first); THE number the
+    #: bench multihost phase verifies against the measured counter
+    inter_wire_bytes: int
+    extrapolated: bool
+    terms: List[CommTerm] = dataclasses.field(default_factory=list)
+
+    @property
+    def seconds(self) -> float:
+        return (self.intra_reduce_s + self.inter_exchange_s
+                + self.intra_bcast_s)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["seconds"] = self.seconds
+        d["terms"] = [t.to_dict() for t in self.terms]
+        return d
+
+
+def hierarchical_allreduce_seconds(
+    payload_bytes: int,
+    grad_elems: int,
+    domain_sizes: Sequence[int],
+    intra_model: CostModel,
+    inter_model: CostModel,
+    *,
+    q8_inter: bool = False,
+    fallback: Optional[CostModel] = None,
+) -> HierPrice:
+    """Price one hierarchical allreduce: intra-domain reduce -> one
+    inter-domain leader exchange -> intra-domain broadcast
+    (``runtime/hierarchy.py``'s decomposition), each leg on ITS OWN
+    transport's α–β fit — the per-transport discipline
+    ``CostModel.load(expected_transport=...)`` enforces is exactly what
+    makes this sum meaningful (an shm β under the inter leg would
+    underprice the slow link ~an order of magnitude).
+
+    The legs are sequential (a leg cannot start before the previous
+    completes), so the total is their SUM; within a leg every domain
+    runs concurrently, so each leg's price is the MAX over its domains'
+    sizes (equal-size domains — the only shape the group supports for
+    all_gather — collapse to one prediction). ``q8_inter=True`` prices
+    the quantized inter leg at its real wire occupancy
+    (``q8_wire_payload``), falling back through
+    :func:`price_comm_terms`'s flagged q8 path when the inter model has
+    no ``all_reduce_q8`` fit. Degenerate shapes price honestly: one
+    domain -> no inter leg; all domains singleton -> only the inter leg.
+    """
+    doms = [int(d) for d in domain_sizes]
+    if not doms or any(d < 1 for d in doms):
+        raise ValueError(f"bad domain sizes {domain_sizes!r}")
+    H = len(doms)
+
+    def leg_max(op: str, note: str) -> List[CommTerm]:
+        sizes = sorted({d for d in doms if d > 1})
+        terms = price_comm_terms(
+            [CommTerm(op, int(payload_bytes), d, 1, note=note)
+             for d in sizes],
+            intra_model, fallback=fallback,
+        )
+        return terms
+
+    intra_reduce = leg_max("all_reduce", "hier intra reduce")
+    intra_bcast = leg_max("broadcast", "hier intra broadcast")
+    inter_terms: List[CommTerm] = []
+    if H > 1:
+        if q8_inter:
+            t = CommTerm("all_reduce_q8", q8_wire_payload(int(grad_elems)),
+                         H, 1, note="hier inter exchange (q8)",
+                         f32_bytes=int(payload_bytes))
+        else:
+            t = CommTerm("all_reduce", int(payload_bytes), H, 1,
+                         note="hier inter exchange")
+        inter_terms = price_comm_terms([t], inter_model,
+                                       fallback=fallback)
+    all_terms = intra_reduce + inter_terms + intra_bcast
+    return HierPrice(
+        intra_reduce_s=max((t.seconds for t in intra_reduce), default=0.0),
+        inter_exchange_s=sum(t.seconds for t in inter_terms),
+        intra_bcast_s=max((t.seconds for t in intra_bcast), default=0.0),
+        inter_wire_bytes=sum(t.wire_bytes for t in inter_terms),
+        extrapolated=any(t.extrapolated for t in all_terms),
+        terms=all_terms,
+    )

@@ -95,6 +95,21 @@ Known sites (grep for ``faults.check`` to find the exact spots):
                      survivors' ring hits its deadline, the pipeline
                      poisons itself, and the elastic re-mesh + a fresh
                      engine recover (tests/test_overlap.py's chaos case)
+``transport.link_lost`` at every TCP transport exchange
+                     (``runtime/transport.py``) — ``mode=raise`` severs
+                     THIS rank's links mid-collective: the transport
+                     poisons itself and closes every socket, peers see
+                     EOF within one exchange and poison too (loud, never
+                     a wrong answer), and survivors recover via the r13
+                     re-mesh path (tests/test_transport.py chaos case);
+                     ``mode=kill`` is the whole-process variant
+``transport.slow_link`` polled after every TCP exchange —
+                     ``mode=throttle,factor=F`` stretches THIS rank's
+                     link to F-x the calibrated wire time
+                     (``SLOW_LINK_BYTES_PER_S``), deterministically;
+                     the bench multihost phase arms it identically under
+                     hierarchical and flat paths so the measured ratio
+                     isolates bytes-over-the-slow-link, not noise
 ================== ====================================================
 """
 
@@ -140,6 +155,8 @@ KNOWN_SITES = (
     "elastic.rejoin",
     "elastic.slow_rank",
     "comm.overlap_stall",
+    "transport.link_lost",
+    "transport.slow_link",
 )
 _MODES = ("raise", "kill", "truncate", "bitflip", "throttle")
 

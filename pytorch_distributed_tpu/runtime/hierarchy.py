@@ -1,0 +1,429 @@
+"""Topology-aware hierarchical collectives over two transport tiers.
+
+A multi-host fleet has two very different links: the intra-host one
+(shm here, NVLink/ICI on real rigs — high bandwidth, low latency) and
+the inter-host DCN, which is where the bytes hurt. A flat allreduce
+over the slow link ships ``2*(world-1)/world * payload`` PER RANK; the
+hierarchical decomposition keeps all but one rank per host off the slow
+link entirely:
+
+1. **intra-domain reduce-scatter + allgather** — the domain's shm ring
+   reduces the full payload (``hr_allreduce`` IS the segmented
+   reduce-scatter-then-allgather: per chunk, rank r owns segment r,
+   folds it, and republishes — see native/hostring.cpp), leaving every
+   member, the leader included, with the domain sum;
+2. **one inter-domain exchange per domain leader** — the H leaders run
+   one allreduce over the inter transport (TCP for real multi-host),
+   moving ``2*(H-1)/H * payload`` per leader and NOTHING from
+   non-leaders — the exact slow-link byte count the bench multihost
+   phase pins;
+3. **intra-domain broadcast** from the leader fans the global result
+   back out.
+
+Determinism and lockstep, by construction: domains are a fixed ordered
+partition of ``range(world)``, the leader is each domain's FIRST listed
+rank, and both legs are themselves lockstep collectives with fixed fold
+order — so the sequence of float additions is a pure function of
+``(domains, payload, slot_bytes)``, every rank of every domain issues
+the identical call sequence ON ITS OWN GROUPS (the PTD001 invariant,
+scoped per group: non-leaders never touch the inter group, which is a
+*membership* fact fixed at construction, not a data-dependent branch),
+and all ranks finish with byte-identical results (leader bits are
+broadcast verbatim). Because both transports implement one reduction
+structure (see runtime/transport.py), swapping the inter leg between
+shm and TCP changes no bits either — pinned in tests/test_transport.py.
+
+What hierarchical does NOT promise: bit-identity with the FLAT
+allreduce on general float payloads — the grouping of additions
+differs (domain sums first), the same reason train/elastic_world.py
+reduces fixed virtual shards instead of using a ring. On integer-valued
+f32 payloads (exactly representable sums < 2^24) any grouping is exact,
+which is how the bench proves hierarchical-vs-flat equality where it IS
+claimable. DESIGN.md §21 carries the full argument.
+
+The optional q8 inter leg (:meth:`HierarchicalGroup.all_reduce_q8`)
+quantizes ONLY the slow link: the intra leg stays exact f32 (r14
+measured shm q8 ~2x SLOWER than f32 — quantization compute outweighs
+byte savings when the wire is a memcpy), while the inter leg reuses
+``all_reduce_q8``'s 256-block quantizer where the ~4x byte cut actually
+buys wall-clock. One q8 roundtrip on domain sums, every rank sees the
+leader's dequantized bits.
+
+jax-free, like the rest of the runtime collectives stack.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from pytorch_distributed_tpu.runtime.hostring import (
+    HostRingGroup,
+    _HALF,
+    _as_contig,
+)
+
+
+class _LegGuard:
+    def __init__(self, group: "HierarchicalGroup"):
+        self._g = group
+
+    def __enter__(self):
+        self._g._check_poisoned()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is not None and isinstance(exc, (RuntimeError, OSError)):
+            self._g._poisoned = str(exc)
+        return False
+
+
+def _check_domains(domains: Sequence[Sequence[int]]) -> Tuple[Tuple[int, ...], ...]:
+    doms = tuple(tuple(int(r) for r in d) for d in domains)
+    if not doms or any(not d for d in doms):
+        raise ValueError("domains must be non-empty groups of ranks")
+    flat = [r for d in doms for r in d]
+    world = len(flat)
+    if sorted(flat) != list(range(world)):
+        raise ValueError(
+            f"domains {doms} are not a partition of range({world})"
+        )
+    return doms
+
+
+class HierarchicalGroup:
+    """A :class:`HostRingGroup`-shaped facade over an intra-domain group
+    plus (on leaders only) an inter-domain leader group.
+
+    ``domains`` is the fixed ordered partition; this rank's domain is
+    found by membership, its leader is ``domain[0]``. ``intra`` must be
+    a group over this rank's domain with LOCAL ranks (0..d-1 in domain
+    order); ``inter`` must be the leader group (world = number of
+    domains, rank = this domain's index) on leaders and None otherwise.
+    ``slot_bytes`` must agree between the legs: the chunk grid is what
+    keeps split-at-slot-boundary callers (parallel/overlap.py's
+    ShipPlan) bit-identical, so the two legs must share it.
+    """
+
+    def __init__(self, name: str, rank: int,
+                 domains: Sequence[Sequence[int]],
+                 intra: HostRingGroup,
+                 inter: Optional[HostRingGroup] = None):
+        doms = _check_domains(domains)
+        world = sum(len(d) for d in doms)
+        mine = [i for i, d in enumerate(doms) if rank in d]
+        if not mine:
+            raise ValueError(f"rank {rank} not in any domain of {doms}")
+        self._domain_idx = mine[0]
+        dom = doms[self._domain_idx]
+        self._local_rank = dom.index(rank)
+        self._is_leader = self._local_rank == 0
+        if intra.world_size != len(dom) or intra.rank != self._local_rank:
+            raise ValueError(
+                f"intra group rank/world ({intra.rank}/"
+                f"{intra.world_size}) != this rank's domain position "
+                f"({self._local_rank}/{len(dom)})"
+            )
+        if self._is_leader:
+            if inter is None:
+                raise ValueError(
+                    f"rank {rank} leads domain {self._domain_idx} and "
+                    "needs the inter-domain leader group"
+                )
+            if (inter.world_size != len(doms)
+                    or inter.rank != self._domain_idx):
+                raise ValueError(
+                    f"inter group rank/world ({inter.rank}/"
+                    f"{inter.world_size}) != domain index/count "
+                    f"({self._domain_idx}/{len(doms)})"
+                )
+            if inter.slot_bytes != intra.slot_bytes:
+                raise ValueError(
+                    f"slot_bytes mismatch: intra {intra.slot_bytes} vs "
+                    f"inter {inter.slot_bytes} — the legs must share "
+                    "the chunk grid for split-at-slot bit-identity"
+                )
+        elif inter is not None:
+            raise ValueError(
+                f"rank {rank} is not a leader; inter must be None"
+            )
+        self.name = name
+        self.rank = rank
+        self.world_size = world
+        self.domains = doms
+        self.slot_bytes = intra.slot_bytes
+        self.timeout_s = intra.timeout_s
+        self._intra = intra
+        self._inter = inter
+        self._poisoned: Optional[str] = None
+
+    # -- failure containment -----------------------------------------------
+    def _legs(self):
+        """Guard a collective's leg sequence: a leg failure (peer death,
+        deadline, injected link loss) leaves the MEMBERS divergent — some
+        ranks hold the reduced value, some don't, some are still blocked
+        — so the whole group poisons and every later call refuses
+        instantly (the same contract as the TCP transport's endpoint
+        poison, lifted to the group where non-leaders can see it). Caller
+        errors (bad op/shape ValueErrors) are raised before entering and
+        do NOT poison."""
+        return _LegGuard(self)
+
+    def _check_poisoned(self) -> None:
+        if self._poisoned is not None:
+            raise RuntimeError(
+                f"hierarchical group {self.name!r} poisoned "
+                f"({self._poisoned}) — a collective failed mid-flight "
+                "and member state may have diverged; re-mesh via the "
+                "elastic membership path"
+            )
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    @property
+    def inter_bytes_sent(self) -> int:
+        """Data bytes THIS rank pushed over the inter-domain (slow)
+        link — 0 on non-leaders, the inter transport's exact counter on
+        leaders (exact when the inter transport is tcp)."""
+        return self._inter.bytes_sent if self._inter is not None else 0
+
+    # -- collectives -------------------------------------------------------
+    def barrier(self) -> None:
+        with self._legs():
+            self._intra.barrier()
+            if self._inter is not None:
+                self._inter.barrier()
+            # second intra barrier: non-leaders must not cross until
+            # their leader has heard from every other domain
+            self._intra.barrier()
+
+    def all_reduce(self, x, op: str = "sum", *,
+                   inplace: bool = False) -> np.ndarray:
+        a = _as_contig(x)
+        if inplace:
+            if a is not x:
+                raise ValueError(
+                    "all_reduce(inplace=True) needs a C-contiguous "
+                    f"supported-dtype ndarray; got {type(x).__name__}"
+                    " needing conversion"
+                )
+        else:
+            a = a.copy()
+        half = a.dtype in _HALF
+        int_avg = op == "avg" and a.dtype.kind in "iu"
+        # both legs run the pre-division op; the global divide happens
+        # once at the end (in f32 for halves, BEFORE the single
+        # rounding — the flat ring's divide-then-round discipline)
+        leg_op = "sum" if op == "avg" else op
+        work = a.astype(np.float32) if half else a
+        with self._legs():
+            self._intra.all_reduce(work, op=leg_op, inplace=True)
+            if self._inter is not None:
+                self._inter.all_reduce(work, op=leg_op, inplace=True)
+            self._intra.broadcast(work, src=0, inplace=True)
+        if op == "avg" and not int_avg:
+            work /= work.dtype.type(self.world_size)
+        if half:
+            a[...] = work.astype(a.dtype)
+        if int_avg:
+            a //= self.world_size
+        return a
+
+    def all_reduce_q8(self, x, op: str = "sum", *,
+                      inplace: bool = False) -> np.ndarray:
+        """f32 allreduce with the q8 block quantizer on the INTER leg
+        only: intra stays exact f32 (cheap wire, expensive quantize —
+        r14's measurement), the slow link ships int8+scales (~4x fewer
+        bytes). Exactly one quantize roundtrip, applied to domain sums;
+        every rank adopts the leader's dequantized bits, so results are
+        identical across all ranks (the lockstep invariant), just not
+        equal to the flat q8 path's (different quantization points —
+        documented in DESIGN.md §21)."""
+        if op not in ("sum", "avg"):
+            raise ValueError(f"q8 allreduce supports sum/avg, got {op!r}")
+        if np.asarray(x).dtype != np.float32:
+            raise TypeError(
+                f"q8 allreduce is f32-only, got {np.asarray(x).dtype}"
+            )
+        if inplace:
+            a = _as_contig(x)
+            if a is not x:
+                raise ValueError(
+                    "all_reduce_q8(inplace=True) needs a C-contiguous "
+                    f"f32 ndarray; got {type(x).__name__} needing "
+                    "conversion"
+                )
+        else:
+            a = np.ascontiguousarray(x, dtype=np.float32).copy()
+        with self._legs():
+            self._intra.all_reduce(a, op="sum", inplace=True)
+            if self._inter is not None:
+                self._inter.all_reduce_q8(a, op="sum", inplace=True)
+            self._intra.broadcast(a, src=0, inplace=True)
+        if op == "avg":
+            # divide AFTER the inter requantization, identically on
+            # every rank (the inter q8 op cannot divide by the global
+            # world — it only sees the H leaders)
+            a /= np.float32(self.world_size)
+        return a
+
+    def all_gather(self, x) -> np.ndarray:
+        d = len(self.domains[self._domain_idx])
+        if any(len(dom) != d for dom in self.domains):
+            raise ValueError(
+                f"hierarchical all_gather needs equal domain sizes, "
+                f"got {[len(dom) for dom in self.domains]}"
+            )
+        a = _as_contig(x, dtype_required=False)
+        with self._legs():
+            local = self._intra.all_gather(a)  # [d, ...] in domain order
+            out = np.empty((self.world_size,) + a.shape, a.dtype)
+            if self._inter is not None:
+                gathered = self._inter.all_gather(local)  # [H, d, ...]
+                # reorder (domain, local) rows into GLOBAL rank order —
+                # fixed by the domains map, same on every leader
+                for h, dom in enumerate(self.domains):
+                    for l, r in enumerate(dom):
+                        out[r] = gathered[h, l]
+            self._intra.broadcast(out, src=0, inplace=True)
+        return out
+
+    def reduce_scatter(self, x, op: str = "sum") -> np.ndarray:
+        """[world, ...] in GLOBAL rank order -> this rank's reduced row.
+        Composed as all_reduce + row select (correctness-first, like the
+        facade's all_to_all; the intra ring still does the heavy
+        lifting)."""
+        if op == "avg":
+            raise ValueError("op='avg' is only supported for all_reduce")
+        a = _as_contig(x)
+        if a.shape[0] != self.world_size:
+            raise ValueError(
+                f"leading dim {a.shape[0]} != world_size "
+                f"{self.world_size}"
+            )
+        return self.all_reduce(a, op=op)[self.rank]
+
+    def broadcast(self, x, src: int = 0) -> np.ndarray:
+        a = _as_contig(x, dtype_required=False).copy()
+        src_dom = [i for i, d in enumerate(self.domains) if src in d][0]
+        with self._legs():
+            # hop 1: the source's own domain moves the data to its
+            # leader (every member of that intra group participates —
+            # lockstep is per group; other domains' groups untouched)
+            if self._domain_idx == src_dom:
+                local_src = self.domains[src_dom].index(src)
+                self._intra.broadcast(a, src=local_src, inplace=True)
+            # hop 2: leaders relay across domains
+            if self._inter is not None:
+                self._inter.broadcast(a, src=src_dom, inplace=True)
+            # hop 3: every domain fans out from its leader
+            self._intra.broadcast(a, src=0, inplace=True)
+        return a
+
+    def send(self, x, dst: int) -> None:
+        dom = self.domains[self._domain_idx]
+        if dst in dom:
+            with self._legs():
+                self._intra.send(x, dom.index(dst))
+            return
+        leaders = [d[0] for d in self.domains]
+        if self.rank in leaders and dst in leaders:
+            with self._legs():
+                # p2p is caller-matched by contract (dst issues the
+                # mirrored recv); the rank test is ROUTING onto the
+                # leader mesh, not conditional participation
+                # ptdlint: disable=PTD001
+                self._inter.send(x, leaders.index(dst))
+            return
+        raise NotImplementedError(
+            f"p2p {self.rank}->{dst} crosses domains off the leader "
+            "mesh; route via the leaders explicitly"
+        )
+
+    def recv(self, x, src: int) -> np.ndarray:
+        dom = self.domains[self._domain_idx]
+        if src in dom:
+            with self._legs():
+                return self._intra.recv(x, dom.index(src))
+        leaders = [d[0] for d in self.domains]
+        if self.rank in leaders and src in leaders:
+            with self._legs():
+                # p2p is caller-matched by contract (src issues the
+                # mirrored send); see send() above
+                # ptdlint: disable=PTD001
+                return self._inter.recv(x, leaders.index(src))
+        raise NotImplementedError(
+            f"p2p {src}->{self.rank} crosses domains off the leader "
+            "mesh; route via the leaders explicitly"
+        )
+
+    def close(self) -> None:
+        if self._inter is not None:
+            self._inter.close()
+            self._inter = None
+        if self._intra is not None:
+            self._intra.close()
+            self._intra = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def build_hierarchical_group(
+    name: str,
+    rank: int,
+    domains: Sequence[Sequence[int]],
+    *,
+    inter_addr: Optional[str] = None,
+    slot_bytes: int = 4 << 20,
+    timeout_s: float = 120.0,
+    debug: Optional[bool] = None,
+) -> HierarchicalGroup:
+    """Convenience builder: shm intra groups (one segment per domain,
+    ``<name>_d<h>``), and for leaders an inter group over TCP at
+    ``inter_addr`` (the real multi-host shape) or — when ``inter_addr``
+    is None — over a third shm segment ``<name>_x`` (single-box tests
+    and the bench's "two hosts on one box" topology still exercise the
+    full hierarchical code path; only the leg's transport differs, and
+    transports are bit-interchangeable)."""
+    doms = _check_domains(domains)
+    mine = [i for i, d in enumerate(doms) if rank in d]
+    if not mine:
+        raise ValueError(f"rank {rank} not in any domain of {doms}")
+    h = mine[0]
+    dom = doms[h]
+    intra = HostRingGroup(
+        f"{name}_d{h}", dom.index(rank), len(dom),
+        slot_bytes=slot_bytes, timeout_s=timeout_s, debug=debug,
+    )
+    inter = None
+    if dom.index(rank) == 0:
+        try:
+            if inter_addr is not None:
+                from pytorch_distributed_tpu.runtime.transport import (
+                    TcpTransport,
+                )
+
+                t = TcpTransport(
+                    f"{name}_x", h, len(doms), inter_addr,
+                    slot_bytes=slot_bytes, timeout_s=timeout_s,
+                )
+                inter = HostRingGroup(
+                    f"{name}_x", h, len(doms), transport=t, debug=debug,
+                )
+            else:
+                inter = HostRingGroup(
+                    f"{name}_x", h, len(doms), slot_bytes=slot_bytes,
+                    timeout_s=timeout_s, debug=debug,
+                )
+        except BaseException:
+            intra.close()
+            raise
+    return HierarchicalGroup(name, rank, doms, intra, inter)

@@ -111,6 +111,14 @@ def _load() -> ctypes.CDLL:
             fn = getattr(lib, name)
             fn.argtypes = args
             fn.restype = ctypes.c_int
+        # the q8 fold kernel, shared with the TCP transport so both
+        # transports run the identical (FMA-contracted) instruction
+        # sequence — see hr_q8_dequant_add in native/hostring.cpp
+        lib.hr_q8_dequant_add.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_uint64,
+        ]
+        lib.hr_q8_dequant_add.restype = None
         _lib = lib
     return _lib
 
@@ -245,11 +253,20 @@ class _CommSpan:
         self._t.counter(self._name + ".calls", cum[0])
         self._t.counter(self._name + ".bytes_moved", cum[1])
         self._t.counter(self._name + ".seconds", round(cum[2], 6))
+        tkind = self._args.get("transport")
+        if tkind:
+            # per-transport byte totals (comm.bytes.shm / comm.bytes.tcp):
+            # obs_report's "Cross-host bytes" line sums the non-shm tracks
+            # — the bytes that would cross a real DCN
+            tname = "comm.bytes." + tkind
+            tcum = _COMM_CUM.setdefault(tname, [0, 0, 0.0])
+            tcum[1] += self._args["wire_bytes"]
+            self._t.counter(tname, tcum[1])
         return False
 
 
 def _comm_span(tracer, kind: str, op: str, count: int, dtype,
-               payload_bytes: int, world: int):
+               payload_bytes: int, world: int, transport: str):
     """Build the armed comm span. Call sites gate on the module-global
     ``tracing._tracer is None`` test FIRST (the faults.py discipline), so
     the disarmed path never reaches this function — no arg evaluation,
@@ -261,11 +278,26 @@ def _comm_span(tracer, kind: str, op: str, count: int, dtype,
         "payload_bytes": int(payload_bytes),
         "wire_bytes": algo_wire_bytes(kind, payload_bytes, world),
         "world": world,
+        "transport": transport,
     })
 
 
 class HostRingGroup:
-    """One process's membership in a shared-memory collectives group."""
+    """One process's membership in a collectives group.
+
+    The byte-moving layer is pluggable (r16): by default the group
+    constructs the native shared-memory ring
+    (:class:`~pytorch_distributed_tpu.runtime.transport.ShmTransport` —
+    the exact pre-r16 segment layout and code path), but any
+    :class:`~pytorch_distributed_tpu.runtime.transport.Transport` with
+    matching rank/world can be passed instead (``TcpTransport`` for
+    ranks that do not share a host). Everything above the transport —
+    dtype/op validation, copy-vs-inplace semantics, DETAIL fingerprint
+    handshakes, integer-avg floor division, the half reduce_scatter
+    round trip, ``comm.*`` spans — is transport-independent, and the
+    transports share one reduction structure, so results are
+    bit-identical across transports (tests/test_transport.py pins it).
+    """
 
     def __init__(
         self,
@@ -277,17 +309,25 @@ class HostRingGroup:
         timeout_s: float = 120.0,
         debug: Optional[bool] = None,
         clock_sync: bool = False,
+        transport=None,
     ):
-        lib = _load()
-        handle = ctypes.c_void_p()
-        # shm names must start with '/' and contain no further slashes
-        shm = "/" + name.strip("/").replace("/", "_")
-        rc = lib.hr_init(
-            shm.encode(), rank, world_size, slot_bytes, timeout_s,
-            ctypes.byref(handle),
-        )
-        _check(rc, "init")
-        self._h = handle
+        if transport is None:
+            from pytorch_distributed_tpu.runtime.transport import (
+                ShmTransport,
+            )
+
+            transport = ShmTransport(
+                name, rank, world_size, slot_bytes=slot_bytes,
+                timeout_s=timeout_s,
+            )
+        elif (transport.rank != rank
+              or transport.world_size != world_size):
+            raise ValueError(
+                f"transport rank/world ({transport.rank}/"
+                f"{transport.world_size}) != group rank/world "
+                f"({rank}/{world_size})"
+            )
+        self._transport = transport
         #: the group's segment name as given (pre-shm mangling): the
         #: teardown side (``unlink_segment``) and the elastic membership
         #: layer (which reaps a dead peer's never-finalized segment on
@@ -295,13 +335,15 @@ class HostRingGroup:
         self.name = name
         self.rank = rank
         self.world_size = world_size
-        self.timeout_s = timeout_s
-        #: the per-rank shm slot size: hr_allreduce processes payloads in
+        self.timeout_s = float(transport.timeout_s)
+        #: the per-rank slot size: allreduce processes payloads in
         #: slot-sized chunks with segment ownership computed PER CHUNK —
         #: the grad-sync pipeline (parallel/overlap.py) splits oversized
         #: leaves at exactly these boundaries, which is what makes the
-        #: split bit-identical to the unsplit call
-        self.slot_bytes = int(slot_bytes)
+        #: split bit-identical to the unsplit call. Taken from the
+        #: transport: cross-transport bit-identity requires agreeing
+        #: chunk boundaries.
+        self.slot_bytes = int(transport.slot_bytes)
         if debug is None:
             # DETAIL turns on cross-rank call verification, the analogue
             # of TORCH_DISTRIBUTED_DEBUG=DETAIL (SURVEY.md §5: collective
@@ -326,24 +368,18 @@ class HostRingGroup:
         ``t_r - t_0``. On one host the clocks are literally the same, so
         the offsets bound the barrier-exit jitter (~us-ms here) — the
         alignment error budget ``scripts/trace_merge.py`` inherits. The
-        readings ride raw lib calls so the handshake itself never lands
-        on the ``comm.*`` tracks. Stamped into the trace metadata
+        readings ride raw transport calls so the handshake itself never
+        lands on the ``comm.*`` tracks. Stamped into the trace metadata
         (:func:`tracing.set_meta`) at init AND at :meth:`close`, so a
         tracer armed between the two still exports aligned ranks.
         """
-        lib = _load()
         offsets = np.empty((rounds, self.world_size), np.float64)
         t = np.empty(1, np.float64)
         out = np.empty((self.world_size, 1), np.float64)
         for i in range(rounds):
-            _check(lib.hr_barrier(self._h), "clock-sync barrier")
+            self._transport.barrier()
             t[0] = time.time()
-            rc = lib.hr_allgather(
-                self._h, t.ctypes.data_as(ctypes.c_void_p),
-                out.ctypes.data_as(ctypes.c_void_p), 1,
-                _DTYPES[np.dtype(np.float64)],
-            )
-            _check(rc, "clock-sync allgather")
+            self._transport.allgather(t, out)
             offsets[i] = out[:, 0] - out[0, 0]
         per_rank = np.median(offsets, axis=0)
         self.clock_offsets_s = [float(o) for o in per_rank]
@@ -358,6 +394,15 @@ class HostRingGroup:
             clock_offsets_s=self.clock_offsets_s,
         )
 
+    @property
+    def bytes_sent(self) -> int:
+        """Cumulative data bytes this rank's transport pushed — exact
+        socket-payload bytes on tcp (``Transport.bytes_exact``), the
+        NCCL-convention algorithmic estimate on shm (a memcpy has no
+        wire). The bench multihost phase pins the tcp counter against
+        the analytic 2*(H-1)/H formula as an integer equality."""
+        return self._transport.bytes_sent if self._transport else 0
+
     _FP_BYTES = 96
 
     def _verify_uniform(self, kind: str, a: np.ndarray, op: str = "") -> None:
@@ -368,11 +413,7 @@ class HostRingGroup:
         buf = np.zeros(self._FP_BYTES, np.uint8)
         buf[: len(sig)] = np.frombuffer(sig, np.uint8)
         out = np.empty((self.world_size, self._FP_BYTES), np.uint8)
-        rc = _load().hr_allgather(
-            self._h, buf.ctypes.data_as(ctypes.c_void_p),
-            out.ctypes.data_as(ctypes.c_void_p), self._FP_BYTES, _U8,
-        )
-        _check(rc, "debug fingerprint allgather")
+        self._transport.allgather(buf, out)
         sigs = [bytes(row).rstrip(b"\x00").decode() for row in out]
         if len(set(sigs)) != 1:
             detail = "; ".join(f"rank{r}: {s}" for r, s in enumerate(sigs))
@@ -390,10 +431,11 @@ class HostRingGroup:
             self._verify_uniform("barrier", np.zeros(0, np.uint8))
         tr = tracing._tracer
         span = tracing._NULL_SPAN if tr is None else _comm_span(
-            tr, "barrier", "", 0, "", 0, self.world_size
+            tr, "barrier", "", 0, "", 0, self.world_size,
+            self._transport.kind,
         )
         with span:
-            _check(_load().hr_barrier(self._h), "barrier")
+            self._transport.barrier()
 
     def all_reduce(self, x, op: str = "sum", *, inplace: bool = False) -> np.ndarray:
         """``inplace=True`` reduces directly into ``x`` (torch
@@ -423,14 +465,10 @@ class HostRingGroup:
         tr = tracing._tracer
         span = tracing._NULL_SPAN if tr is None else _comm_span(
             tr, "all_reduce", op, a.size, a.dtype, a.nbytes,
-            self.world_size,
+            self.world_size, self._transport.kind,
         )
         with span:
-            rc = _load().hr_allreduce(
-                self._h, a.ctypes.data_as(ctypes.c_void_p), a.size,
-                _DTYPES[a.dtype], _OPS["sum" if int_avg else op],
-            )
-            _check(rc, "all_reduce")
+            self._transport.allreduce(a, "sum" if int_avg else op)
         if int_avg:
             a //= self.world_size
         return a
@@ -485,14 +523,11 @@ class HostRingGroup:
                     self.world_size,
                 ),
                 "world": self.world_size,
+                "transport": self._transport.kind,
             },
         )
         with span:
-            rc = _load().hr_allreduce_q8(
-                self._h, a.ctypes.data_as(ctypes.c_void_p), a.size,
-                _OPS[op],
-            )
-            _check(rc, "all_reduce_q8")
+            self._transport.allreduce_q8(a, op)
         return a
 
     def all_gather(self, x) -> np.ndarray:
@@ -500,21 +535,13 @@ class HostRingGroup:
         if self.debug:
             self._verify_uniform("all_gather", a)
         out = np.empty((self.world_size,) + a.shape, a.dtype)
-        if a.dtype in _DTYPES:
-            count, dt = a.size, _DTYPES[a.dtype]
-        else:  # any other dtype gathers as raw bytes
-            count, dt = a.nbytes, _U8
         tr = tracing._tracer
         span = tracing._NULL_SPAN if tr is None else _comm_span(
             tr, "all_gather", "", a.size, a.dtype, out.nbytes,
-            self.world_size,
+            self.world_size, self._transport.kind,
         )
         with span:
-            rc = _load().hr_allgather(
-                self._h, a.ctypes.data_as(ctypes.c_void_p),
-                out.ctypes.data_as(ctypes.c_void_p), count, dt,
-            )
-            _check(rc, "all_gather")
+            self._transport.allgather(a, out)
         return out
 
     def reduce_scatter(self, x, op: str = "sum") -> np.ndarray:
@@ -532,35 +559,40 @@ class HostRingGroup:
         if self.debug:
             self._verify_uniform("reduce_scatter", a, op)
         out = np.empty(a.shape[1:], a.dtype)
-        chunk = int(np.prod(a.shape[1:], dtype=np.int64))
         tr = tracing._tracer
         span = tracing._NULL_SPAN if tr is None else _comm_span(
             tr, "reduce_scatter", op, a.size, a.dtype, a.nbytes,
-            self.world_size,
+            self.world_size, self._transport.kind,
         )
         with span:
-            rc = _load().hr_reduce_scatter(
-                self._h, a.ctypes.data_as(ctypes.c_void_p),
-                out.ctypes.data_as(ctypes.c_void_p), chunk,
-                _DTYPES[a.dtype], _OPS[op],
-            )
-            _check(rc, "reduce_scatter")
+            self._transport.reduce_scatter(a, out, op)
         return out.astype(half) if half is not None else out
 
-    def broadcast(self, x, src: int = 0) -> np.ndarray:
-        a = _as_contig(x, dtype_required=False).copy()
+    def broadcast(self, x, src: int = 0, *,
+                  inplace: bool = False) -> np.ndarray:
+        """``inplace=True`` broadcasts directly into ``x`` (same
+        contract as ``all_reduce(inplace=True)``: a buffer needing
+        conversion would receive the bytes in a private copy while the
+        caller's array kept stale values) — the hierarchical group's
+        fan-out hop uses it to skip a full payload copy per leg."""
+        if inplace:
+            a = _as_contig(x, dtype_required=False)
+            if a is not x:
+                raise ValueError(
+                    "broadcast(inplace=True) needs a C-contiguous "
+                    f"ndarray; got {type(x).__name__} needing conversion"
+                )
+        else:
+            a = _as_contig(x, dtype_required=False).copy()
         if self.debug:
             self._verify_uniform("broadcast", a, str(src))
         tr = tracing._tracer
         span = tracing._NULL_SPAN if tr is None else _comm_span(
             tr, "broadcast", str(src), a.size, a.dtype, a.nbytes,
-            self.world_size,
+            self.world_size, self._transport.kind,
         )
         with span:
-            rc = _load().hr_broadcast(
-                self._h, a.ctypes.data_as(ctypes.c_void_p), a.nbytes, src
-            )
-            _check(rc, "broadcast")
+            self._transport.broadcast(a, src)
         return a
 
     def all_to_all(self, x) -> np.ndarray:
@@ -604,29 +636,12 @@ class HostRingGroup:
             sig[: self._FP_BYTES], np.uint8
         )
         theirs = np.zeros(self._FP_BYTES, np.uint8)
-        lib = _load()
         if self.rank == src:  # fingerprint ahead of payload, echo back
-            rc = lib.hr_sendrecv(
-                self._h, mine.ctypes.data_as(ctypes.c_void_p),
-                self._FP_BYTES, src, dst,
-            )
-            _check(rc, "debug p2p fingerprint send")
-            rc = lib.hr_sendrecv(
-                self._h, theirs.ctypes.data_as(ctypes.c_void_p),
-                self._FP_BYTES, dst, src,
-            )
-            _check(rc, "debug p2p fingerprint echo recv")
+            self._transport.sendrecv(mine, src, dst)
+            self._transport.sendrecv(theirs, dst, src)
         else:
-            rc = lib.hr_sendrecv(
-                self._h, theirs.ctypes.data_as(ctypes.c_void_p),
-                self._FP_BYTES, src, dst,
-            )
-            _check(rc, "debug p2p fingerprint recv")
-            rc = lib.hr_sendrecv(
-                self._h, mine.ctypes.data_as(ctypes.c_void_p),
-                self._FP_BYTES, dst, src,
-            )
-            _check(rc, "debug p2p fingerprint echo send")
+            self._transport.sendrecv(theirs, src, dst)
+            self._transport.sendrecv(mine, dst, src)
         if bytes(mine) != bytes(theirs):
             me = bytes(mine).rstrip(b"\x00").decode()
             peer = bytes(theirs).rstrip(b"\x00").decode()
@@ -645,14 +660,10 @@ class HostRingGroup:
         tr = tracing._tracer
         span = tracing._NULL_SPAN if tr is None else _comm_span(
             tr, "send", f"->{dst}", a.size, a.dtype, a.nbytes,
-            self.world_size,
+            self.world_size, self._transport.kind,
         )
         with span:
-            rc = _load().hr_sendrecv(
-                self._h, a.ctypes.data_as(ctypes.c_void_p), a.nbytes,
-                self.rank, dst,
-            )
-            _check(rc, "send")
+            self._transport.sendrecv(a, self.rank, dst)
 
     def recv(self, x, src: int) -> np.ndarray:
         """x supplies shape/dtype; returns the received array. True P2P —
@@ -663,25 +674,21 @@ class HostRingGroup:
         tr = tracing._tracer
         span = tracing._NULL_SPAN if tr is None else _comm_span(
             tr, "recv", f"<-{src}", a.size, a.dtype, a.nbytes,
-            self.world_size,
+            self.world_size, self._transport.kind,
         )
         with span:
-            rc = _load().hr_sendrecv(
-                self._h, a.ctypes.data_as(ctypes.c_void_p), a.nbytes,
-                src, self.rank,
-            )
-            _check(rc, "recv")
+            self._transport.sendrecv(a, src, self.rank)
         return a
 
     def close(self) -> None:
-        if self._h:
+        if self._transport is not None:
             if self._clock_synced:
                 # re-stamp (no re-measure: close() isn't barrier-safe —
                 # a lone closing rank must not block on absent peers): a
                 # tracer armed AFTER init still exports aligned metadata
                 self._stamp_clock_meta()
-            _load().hr_finalize(self._h)
-            self._h = None
+            self._transport.close()
+            self._transport = None
 
     def __enter__(self):
         return self

@@ -9,15 +9,19 @@ protocol that turns "some process died / a new process wants in" into an
 agreed, epoch-numbered world view — without restarting the surviving
 processes.
 
-Design (single-host, matching the shm transport underneath):
+Design:
 
-* **The rendezvous channel is a directory.** Every live member keeps one
-  ``member-<worker_id>.json`` record (worker id, pid, and its *bid* — the
-  view epoch it wants next). Writing that record IS the join endpoint: a
-  new process announces itself by dropping its record; incumbents notice
-  at their next step-boundary :meth:`poll_change`. Liveness is the pid
-  (``os.kill(pid, 0)``): a SIGKILLed member's record reads as dead and is
-  garbage-collected by whoever re-rendezvouses next.
+* **The rendezvous channel is pluggable** (``runtime/rendezvous.py``).
+  Every live member keeps one record (worker id, pid, and its *bid* —
+  the view epoch it wants next). Writing that record IS the join
+  endpoint: a new process announces itself by publishing its record;
+  incumbents notice at their next step-boundary :meth:`poll_change`.
+  The default channel is a shared directory (``member-<id>.json``
+  files, pid liveness, any member reaps dead records); passing
+  ``rendezvous_dir="tcp://host:port"`` selects the TCP channel instead,
+  where the same records live on a :class:`RendezvousServer` and
+  liveness is each member's own persistent connection. The settle /
+  max-bid-wins / view-commit protocol below is channel-agnostic.
 * **Peer loss rides the existing group deadline.** A member that dies
   mid-step leaves its peers blocked in a collective; the ring's compiled
   deadline fires (``rc=-110``/``-5``) and the caller routes the error
@@ -38,11 +42,13 @@ Design (single-host, matching the shm transport underneath):
   joiner that read a stale epoch is pulled forward by the incumbents'
   bids and vice versa.
 
-Honest limits: pid liveness can alias a recycled pid to a dead member
-(bounded by the settle window; acceptable on the drill scale), and the
-filesystem channel assumes all members share one host — the multi-host
-version of this protocol would put the same records on the coordinator's
-KV store. Both are documented in DESIGN.md §18.
+Honest limits: the file channel's pid liveness can alias a recycled pid
+to a dead member (bounded by the settle window; acceptable on the drill
+scale — the TCP channel has no such window, its lease is the kernel
+socket), and the per-view data-plane rings this module constructs are
+still shm: the TCP channel makes the *rendezvous* multi-host-shaped,
+while a cross-host data plane arrives via ``runtime/transport.py`` /
+``runtime/hierarchy.py``. Documented in DESIGN.md §18 and §21.
 
 This module deliberately imports no jax (same contract as hostring.py):
 spawned elastic workers must be able to rendezvous without dragging in a
@@ -52,25 +58,22 @@ TPU runtime.
 from __future__ import annotations
 
 import dataclasses
-import json
 import os
 import time
 import zlib
 from typing import List, Optional, Tuple
 
-from pytorch_distributed_tpu.runtime import faults, tracing
+from pytorch_distributed_tpu.runtime import faults, rendezvous, tracing
 from pytorch_distributed_tpu.runtime.hostring import (
     HostRingGroup,
     unlink_segment,
 )
+from pytorch_distributed_tpu.runtime.rendezvous import _pid_alive  # noqa: F401  (re-export; historical home)
 from pytorch_distributed_tpu.utils.logging import get_logger
 
 import numpy as np
 
 logger = get_logger(__name__)
-
-_MEMBER_PREFIX = "member-"
-_VIEW_PREFIX = "view-"
 
 
 class MembershipError(RuntimeError):
@@ -134,8 +137,13 @@ class WorldMembership:
     ):
         if "/" in worker_id or not worker_id:
             raise ValueError(f"bad worker_id {worker_id!r}")
-        self.dir = os.path.abspath(rendezvous_dir)
-        os.makedirs(self.dir, exist_ok=True)
+        self._channel = rendezvous.open_channel(
+            rendezvous_dir, timeout_s=float(rendezvous_timeout_s)
+        )
+        # the channel's stable key (abspath for the directory channel —
+        # byte-identical to the pre-r16 prefix derivation — or the
+        # server address for tcp://)
+        self.dir = self._channel.key()
         self.worker_id = worker_id
         self.ring_timeout_s = float(ring_timeout_s)
         self.rendezvous_timeout_s = float(rendezvous_timeout_s)
@@ -146,26 +154,19 @@ class WorldMembership:
         self.settle_s = float(settle_s)
         self.poll_s = float(poll_s)
         # shared shm prefix: every process pointing at this rendezvous
-        # dir derives the same one
+        # channel derives the same one
         self._prefix = f"ptdm_{zlib.crc32(self.dir.encode()):08x}"
         self.view: Optional[WorldView] = None
         self.ring: Optional[HostRingGroup] = None
         self._bid = 0  # the epoch this process wants next
 
-    # -- the rendezvous channel (files) ------------------------------------
-    def _member_path(self, worker_id: str) -> str:
-        return os.path.join(self.dir, _MEMBER_PREFIX + worker_id + ".json")
-
+    # -- the rendezvous channel --------------------------------------------
     def _write_member(self) -> None:
-        rec = {
+        self._channel.write_member({
             "worker_id": self.worker_id,
             "pid": os.getpid(),
             "bid": self._bid,
-        }
-        tmp = self._member_path(self.worker_id) + f".tmp{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(rec, f)
-        os.replace(tmp, self._member_path(self.worker_id))
+        })
 
     def announce(self, bid: Optional[int] = None) -> None:
         """Publish (or refresh) this process's member record."""
@@ -174,63 +175,19 @@ class WorldMembership:
         self._write_member()
 
     def _read_members(self) -> List[dict]:
-        """All live member records; dead-pid records are unlinked."""
-        out = []
-        try:
-            names = sorted(os.listdir(self.dir))
-        except OSError:
-            return out
-        for name in names:
-            if not (name.startswith(_MEMBER_PREFIX)
-                    and name.endswith(".json")):
-                continue
-            path = os.path.join(self.dir, name)
-            try:
-                with open(path) as f:
-                    rec = json.load(f)
-                pid = int(rec["pid"])
-                wid = str(rec["worker_id"])
-                int(rec["bid"])
-            except (OSError, ValueError, TypeError, KeyError):
-                continue  # torn write: the writer will replace it
-            if not _pid_alive(pid):
-                # the garbage collection of the protocol: any member may
-                # reap a dead peer's record (peer loss becomes visible to
-                # poll_change even before a collective deadline fires)
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
-                continue
-            out.append(rec)
-        return out
+        """All live member records (the channel reaps dead members)."""
+        return self._channel.read_members()
 
     def last_committed_epoch(self) -> int:
-        best = 0
-        try:
-            names = os.listdir(self.dir)
-        except OSError:
-            return 0
-        for name in names:
-            if name.startswith(_VIEW_PREFIX) and name.endswith(".json"):
-                try:
-                    best = max(best, int(name[len(_VIEW_PREFIX):-5]))
-                except ValueError:
-                    continue
-        return best
+        return self._channel.last_committed_epoch()
 
     def _write_view_record(self, view: WorldView) -> None:
-        rec = {
+        self._channel.write_view_record({
             "epoch": view.epoch,
             "members": list(view.members),
             "world_size": view.world_size,
             "committed_unix_s": time.time(),
-        }
-        path = os.path.join(self.dir, f"{_VIEW_PREFIX}{view.epoch}.json")
-        tmp = path + f".tmp{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(rec, f)
-        os.replace(tmp, path)
+        })
 
     # -- change detection --------------------------------------------------
     def poll_change(self) -> bool:
@@ -392,9 +349,9 @@ class WorldMembership:
         deadline. The ring handle is closed but its segment is left for
         the survivors' next_view teardown."""
         try:
-            os.unlink(self._member_path(self.worker_id))
-        except OSError:
-            pass
+            self._channel.remove_member(self.worker_id)
+        except RuntimeError:
+            pass  # tcp channel with a dead server: nothing to remove
         if self.ring is not None:
             self.ring.close()
             self.ring = None
@@ -405,32 +362,3 @@ class WorldMembership:
 
     def __exit__(self, *exc) -> None:
         self.leave()
-
-
-def _pid_alive(pid: int) -> bool:
-    """Is ``pid`` a live (non-zombie) process?
-
-    ``os.kill(pid, 0)`` alone is wrong here: a SIGKILLed worker stays a
-    ZOMBIE until its launcher reaps it, and kill(0) reports zombies as
-    alive — the survivors' candidate set would never settle. /proc's
-    stat state field distinguishes them (this backend is Linux-only shm
-    already); kill(0) is the fallback when /proc is unreadable.
-    """
-    if pid <= 0:
-        return False
-    try:
-        with open(f"/proc/{pid}/stat", "rb") as f:
-            stat = f.read()
-        # state is the first field after the parenthesized comm (which
-        # may itself contain spaces/parens — split on the LAST ')')
-        state = stat.rsplit(b")", 1)[1].split()[0]
-        return state not in (b"Z", b"X")
-    except (OSError, IndexError):
-        pass
-    try:
-        os.kill(pid, 0)
-    except ProcessLookupError:
-        return False
-    except PermissionError:  # pragma: no cover - someone else's pid
-        return True
-    return True

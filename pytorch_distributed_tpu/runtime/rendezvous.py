@@ -1,0 +1,442 @@
+"""Pluggable rendezvous channels for elastic membership.
+
+``WorldMembership`` (runtime/membership.py) speaks one small record
+protocol: upsert my member record (worker id, pid, epoch bid), read all
+live records (dead members garbage-collected), read/write the committed
+``view-<epoch>`` audit records, drop my record on clean exit. Through
+r15 that protocol had exactly one home — a shared directory — which is
+also the reason the whole membership layer was single-host: the module
+docstring promised "the multi-host version of this protocol would put
+the same records on the coordinator's KV store". This module keeps that
+promise: the channel is now an interface with two implementations,
+
+* :class:`FileRendezvousChannel` — the r13 directory protocol verbatim
+  (atomic tmp+rename record writes, pid-based liveness with the
+  zombie-aware /proc check, any member reaps dead records), and
+* :class:`TcpRendezvousChannel` — the same records over ONE persistent
+  connection per member to a :class:`RendezvousServer`. Liveness is the
+  connection itself: the kernel closes a SIGKILLed member's socket, and
+  the server drops its record — strictly better than pid polling (pids
+  are meaningless across hosts, and there is no recycled-pid aliasing
+  window). Max-bid-wins, settle, and the view-commit barrier all live
+  ABOVE the channel and run unchanged over either one.
+
+``WorldMembership(rendezvous_dir="tcp://host:port", ...)`` selects the
+TCP channel; anything else is a directory path. The per-view data-plane
+rings are constructed by membership, not the channel — on one box they
+stay shm regardless of which channel carried the rendezvous (the
+channels agree on the ``key()`` string the shm prefix is derived from
+only within one channel kind, which is fine: a world must anyway agree
+on its rendezvous address).
+
+jax-free, like the rest of the runtime stack.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional
+
+from pytorch_distributed_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_MEMBER_PREFIX = "member-"
+_VIEW_PREFIX = "view-"
+
+
+class RendezvousChannel:
+    """The record protocol :class:`WorldMembership` settles views over."""
+
+    def key(self) -> str:
+        """Stable identity string every member of this rendezvous derives
+        identically — the shm ring prefix hashes it."""
+        raise NotImplementedError
+
+    def write_member(self, rec: dict) -> None:
+        """Upsert this process's member record (keyed by worker_id)."""
+        raise NotImplementedError
+
+    def read_members(self) -> List[dict]:
+        """All LIVE member records; the channel garbage-collects dead
+        members (dead pid / dropped connection) before returning."""
+        raise NotImplementedError
+
+    def remove_member(self, worker_id: str) -> None:
+        raise NotImplementedError
+
+    def last_committed_epoch(self) -> int:
+        raise NotImplementedError
+
+    def write_view_record(self, rec: dict) -> None:
+        """Persist the committed ``view-<epoch>`` audit record."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+def _pid_alive(pid: int) -> bool:
+    """Is ``pid`` a live (non-zombie) process?
+
+    ``os.kill(pid, 0)`` alone is wrong here: a SIGKILLed worker stays a
+    ZOMBIE until its launcher reaps it, and kill(0) reports zombies as
+    alive — the survivors' candidate set would never settle. /proc's
+    stat state field distinguishes them (this backend is Linux-only shm
+    already); kill(0) is the fallback when /proc is unreadable.
+    """
+    if pid <= 0:
+        return False
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read()
+        # state is the first field after the parenthesized comm (which
+        # may itself contain spaces/parens — split on the LAST ')')
+        state = stat.rsplit(b")", 1)[1].split()[0]
+        return state not in (b"Z", b"X")
+    except (OSError, IndexError):
+        pass
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:  # pragma: no cover - someone else's pid
+        return True
+    return True
+
+
+class FileRendezvousChannel(RendezvousChannel):
+    """The shared-directory channel (single-host): one
+    ``member-<id>.json`` per live member, ``view-<epoch>.json`` audit
+    records, pid liveness, torn writes tolerated (the writer replaces
+    them atomically)."""
+
+    def __init__(self, rendezvous_dir: str):
+        self.dir = os.path.abspath(rendezvous_dir)
+        os.makedirs(self.dir, exist_ok=True)
+
+    def key(self) -> str:
+        return self.dir
+
+    def _member_path(self, worker_id: str) -> str:
+        return os.path.join(self.dir, _MEMBER_PREFIX + worker_id + ".json")
+
+    def write_member(self, rec: dict) -> None:
+        path = self._member_path(rec["worker_id"])
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
+
+    def read_members(self) -> List[dict]:
+        out = []
+        try:
+            names = sorted(os.listdir(self.dir))
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith(_MEMBER_PREFIX)
+                    and name.endswith(".json")):
+                continue
+            path = os.path.join(self.dir, name)
+            try:
+                with open(path) as f:
+                    rec = json.load(f)
+                pid = int(rec["pid"])
+                str(rec["worker_id"])
+                int(rec["bid"])
+            except (OSError, ValueError, TypeError, KeyError):
+                continue  # torn write: the writer will replace it
+            if not _pid_alive(pid):
+                # the garbage collection of the protocol: any member may
+                # reap a dead peer's record (peer loss becomes visible
+                # to poll_change even before a collective deadline)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            out.append(rec)
+        return out
+
+    def remove_member(self, worker_id: str) -> None:
+        try:
+            os.unlink(self._member_path(worker_id))
+        except OSError:
+            pass
+
+    def last_committed_epoch(self) -> int:
+        best = 0
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return 0
+        for name in names:
+            if name.startswith(_VIEW_PREFIX) and name.endswith(".json"):
+                try:
+                    best = max(best, int(name[len(_VIEW_PREFIX):-5]))
+                except ValueError:
+                    continue
+        return best
+
+    def write_view_record(self, rec: dict) -> None:
+        path = os.path.join(self.dir, f"{_VIEW_PREFIX}{rec['epoch']}.json")
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
+
+
+# --------------------------------------------------------------------------
+# The TCP channel: same records, one coordinator, connection liveness.
+# --------------------------------------------------------------------------
+def _send_line(sock: socket.socket, obj: dict) -> None:
+    sock.sendall(json.dumps(obj).encode() + b"\n")
+
+
+class _LineReader:
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._buf = bytearray()
+
+    def read(self) -> Optional[dict]:
+        while b"\n" not in self._buf:
+            b = self._sock.recv(65536)
+            if not b:
+                return None
+            self._buf += b
+            if len(self._buf) > 16 << 20:
+                raise RuntimeError("oversized rendezvous frame")
+        line, _, rest = bytes(self._buf).partition(b"\n")
+        self._buf = bytearray(rest)
+        return json.loads(line.decode())
+
+
+class RendezvousServer:
+    """The coordinator: member records keyed by worker_id, each owned by
+    the connection that announced it (drop the connection, drop the
+    record — SIGKILL becomes visible at kernel-close speed), plus the
+    committed view audit records. One thread per client; state under one
+    lock. Run it anywhere every member can reach — the launcher process
+    on one box, a head node in a real fleet."""
+
+    def __init__(self, addr: str = "127.0.0.1:0"):
+        host, _, port = addr.rpartition(":")
+        self._lsock = socket.socket()
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host or "127.0.0.1", int(port)))
+        self._lsock.listen(64)
+        self.host, self.port = self._lsock.getsockname()[:2]
+        self.addr = f"{self.host}:{self.port}"
+        self._lock = threading.Lock()
+        self._members: Dict[str, dict] = {}
+        self._owner: Dict[str, socket.socket] = {}
+        self._views: Dict[int, dict] = {}
+        self._conns: set = set()
+        self._closing = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="ptd-rdzv-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return
+            if self._closing:  # the close() wake-up connection
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name="ptd-rdzv-conn", daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        owned: Optional[str] = None
+        reader = _LineReader(conn)
+        with self._lock:
+            self._conns.add(conn)
+        try:
+            while True:
+                req = reader.read()
+                if req is None:
+                    return
+                cmd = req.get("cmd")
+                if cmd == "announce":
+                    rec = dict(req["rec"])
+                    wid = str(rec["worker_id"])
+                    with self._lock:
+                        self._members[wid] = rec
+                        self._owner[wid] = conn
+                    owned = wid
+                    _send_line(conn, {"ok": True})
+                elif cmd == "members":
+                    with self._lock:
+                        recs = list(self._members.values())
+                    _send_line(conn, {"members": recs})
+                elif cmd == "leave":
+                    self._drop(str(req["worker_id"]), conn)
+                    owned = None
+                    _send_line(conn, {"ok": True})
+                elif cmd == "view":
+                    rec = dict(req["rec"])
+                    with self._lock:
+                        self._views[int(rec["epoch"])] = rec
+                    _send_line(conn, {"ok": True})
+                elif cmd == "last_epoch":
+                    with self._lock:
+                        epoch = max(self._views, default=0)
+                    _send_line(conn, {"epoch": epoch})
+                elif cmd == "views":
+                    with self._lock:
+                        views = list(self._views.values())
+                    _send_line(conn, {"views": views})
+                else:
+                    _send_line(conn, {"error": f"unknown cmd {cmd!r}"})
+        except (OSError, ValueError, KeyError, RuntimeError):
+            pass
+        finally:
+            # connection gone: the member it owned is dead (the GC of
+            # the protocol — the kernel closed this socket even if the
+            # process was SIGKILLed mid-collective)
+            if owned is not None:
+                self._drop(owned, conn)
+            with self._lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _drop(self, worker_id: str, conn: socket.socket) -> None:
+        with self._lock:
+            if self._owner.get(worker_id) is conn:
+                self._members.pop(worker_id, None)
+                self._owner.pop(worker_id, None)
+
+    def views(self) -> List[dict]:
+        with self._lock:
+            return [self._views[e] for e in sorted(self._views)]
+
+    def close(self) -> None:
+        """Stop accepting AND sever every live client connection: a
+        closed coordinator must not keep serving stale membership — the
+        clients' next RPC raises loudly instead."""
+        self._closing = True
+        # Closing the listener fd does NOT interrupt a thread already
+        # parked inside accept() on it — the loop would keep serving new
+        # connections on a "closed" server. Wake it with a throwaway
+        # connection, join it, THEN release the port.
+        try:
+            w = socket.create_connection((self.host, self.port), timeout=1.0)
+            w.close()
+        except OSError:
+            pass
+        self._accept_thread.join(timeout=5.0)
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+class TcpRendezvousChannel(RendezvousChannel):
+    """Client side: one persistent connection carrying JSON-line RPCs.
+    The connection doubles as the liveness lease — losing it (server
+    gone) makes every later call raise loudly rather than settle on a
+    stale view."""
+
+    def __init__(self, addr: str, *, timeout_s: float = 60.0):
+        if addr.startswith("tcp://"):
+            addr = addr[len("tcp://"):]
+        self.addr = addr
+        host, _, port = addr.rpartition(":")
+        deadline = time.monotonic() + timeout_s
+        while True:
+            self._sock = socket.socket()
+            self._sock.settimeout(timeout_s)
+            try:
+                self._sock.connect((host or "127.0.0.1", int(port)))
+                # connect() alone doesn't prove the server is alive — a
+                # SYN can land in a dead listener's backlog and "succeed"
+                # with nobody ever serving the connection. One ping
+                # round-trip at construction makes "server gone" loud at
+                # the join point instead of a hang on the first real RPC.
+                self._sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+                reader = _LineReader(self._sock)
+                _send_line(self._sock, {"cmd": "last_epoch"})
+                if reader.read() is None:
+                    raise OSError("server closed during handshake")
+                break
+            except OSError:
+                self._sock.close()
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"rendezvous server at {addr} unreachable for "
+                        f"{timeout_s:.0f}s"
+                    ) from None
+                time.sleep(0.05)
+        self._reader = reader
+        self._lock = threading.Lock()
+
+    def key(self) -> str:
+        return "tcp://" + self.addr
+
+    def _rpc(self, req: dict) -> dict:
+        with self._lock:
+            _send_line(self._sock, req)
+            reply = self._reader.read()
+        if reply is None:
+            raise RuntimeError(
+                f"rendezvous server at {self.addr} closed the connection"
+            )
+        if "error" in reply:
+            raise RuntimeError(f"rendezvous rpc failed: {reply['error']}")
+        return reply
+
+    def write_member(self, rec: dict) -> None:
+        self._rpc({"cmd": "announce", "rec": rec})
+
+    def read_members(self) -> List[dict]:
+        return [dict(r) for r in self._rpc({"cmd": "members"})["members"]]
+
+    def remove_member(self, worker_id: str) -> None:
+        self._rpc({"cmd": "leave", "worker_id": worker_id})
+
+    def last_committed_epoch(self) -> int:
+        return int(self._rpc({"cmd": "last_epoch"})["epoch"])
+
+    def write_view_record(self, rec: dict) -> None:
+        self._rpc({"cmd": "view", "rec": rec})
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def open_channel(rendezvous_dir: str, *,
+                 timeout_s: float = 60.0) -> RendezvousChannel:
+    """``tcp://host:port`` selects the TCP channel; anything else is a
+    shared directory."""
+    if rendezvous_dir.startswith("tcp://"):
+        return TcpRendezvousChannel(rendezvous_dir, timeout_s=timeout_s)
+    return FileRendezvousChannel(rendezvous_dir)

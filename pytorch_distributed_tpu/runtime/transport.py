@@ -1,0 +1,886 @@
+"""Pluggable byte-moving transports under :class:`HostRingGroup`.
+
+Through r15 the ring WAS the shm segment: every collective in
+``runtime/hostring.py`` called straight into ``native/hostring.cpp``,
+which hard-wired "distributed" to "N processes on one host". This module
+splits the group into two layers:
+
+* **The group** (``hostring.HostRingGroup``) keeps everything that makes
+  the collectives torch-shaped and safe: dtype/op validation, copy-vs-
+  inplace semantics, the DETAIL fingerprint handshakes, integer-avg
+  floor division, the half-precision reduce_scatter round trip, the
+  ``comm.*`` tracing spans, and the composed ops (all_to_all/scatter).
+* **The transport** (this module) moves the bytes. It takes contiguous
+  numpy arrays and implements the collective *algorithm*:
+  :class:`ShmTransport` is the existing native shm ring verbatim (one
+  ctypes call per op — the default, and byte-for-byte the pre-r16
+  behaviour); :class:`TcpTransport` runs the SAME algorithm over a full
+  socket mesh, for ranks that do not share a host.
+
+Bit-identity contract (the load-bearing property): ``TcpTransport``
+replicates ``hr_allreduce``'s exact reduction structure — payloads chunk
+by ``slot_bytes``; within a chunk of ``n`` elements rank ``r`` owns
+segment ``[r*seg, r*seg+sn)`` with ``seg = n // world`` (the last rank
+takes the tail); the owner folds its own contribution first and then
+peers in rotated rank order ``(owner+1) % world, ...``; halves
+accumulate in an f32 scratch and round ONCE; AVG divides in the
+accumulator before that rounding. Because owner, segmentation, and fold
+order are all pure functions of ``(count, world, slot_bytes, rank)``,
+the same inputs produce the same float-addition sequence on either
+transport — ``tcp`` vs ``shm`` results are bit-identical at ANY world
+size, which is what lets :class:`~pytorch_distributed_tpu.runtime.
+hierarchy.HierarchicalGroup` swap its inter-host leg freely
+(tests/test_transport.py pins the full matrix). The q8 path replicates
+``quantize_block`` (256-elem blocks, scale ``amax/127``, round half
+away, NaN/inf blocks poison to NaN) in numpy with the owner keeping its
+exact f32 base, same as the native side.
+
+Wire accounting: ``bytes_sent`` counts the DATA bytes this rank pushed
+into its sockets (control tokens — barrier handshakes, setup frames —
+excluded), so the bench's bytes-over-the-slow-link assertion is an exact
+integer equality, not an estimate. ``ShmTransport`` reports the
+NCCL-convention algorithmic bytes instead (a memcpy has no wire), and
+says so via ``bytes_exact``.
+
+Fault sites (``runtime/faults.py``): ``transport.link_lost`` fires at
+every TCP exchange (``mode=kill`` severs the link mid-collective — the
+chaos drill's injected partition; ``mode=raise`` poisons this endpoint
+loudly), and ``transport.slow_link`` (``mode=throttle, factor=F``)
+prices each exchange's bytes at an F-times-slower simulated link —
+the deterministic "the DCN is slow" knob the bench multihost phase arms
+identically under both compared paths.
+
+Like hostring.py, this module is deliberately jax-free: spawned workers
+must be able to import it without dragging in a TPU runtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import selectors
+import socket
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pytorch_distributed_tpu.runtime import faults
+
+#: simulated slow-link bandwidth for the ``transport.slow_link`` throttle:
+#: an armed factor F sleeps ``bytes * (F - 1) / SLOW_LINK_BYTES_PER_S``
+#: after each data exchange — i.e. the link behaves as if it ran at
+#: ``SLOW_LINK_BYTES_PER_S / F``. 1 GB/s baseline ≈ a 10 GbE DCN hop.
+SLOW_LINK_BYTES_PER_S = 1e9
+
+_CONNECT_POLL_S = 0.01
+
+
+class Transport:
+    """The byte-moving contract under a :class:`HostRingGroup`.
+
+    All array arguments are C-contiguous numpy arrays already validated
+    by the group layer; reductions are IN PLACE on the given array.
+    Implementations must be deterministic and lockstep: the same call
+    sequence on every rank, no data-dependent control flow.
+
+    Attributes: ``kind`` ("shm"/"tcp" — the per-transport label the
+    ``comm.*`` spans and cost models carry), ``rank``, ``world_size``,
+    ``slot_bytes`` (the chunking quantum — identical values are REQUIRED
+    for cross-transport bit-identity), ``timeout_s``, ``name``,
+    ``bytes_sent`` (cumulative data bytes; see ``bytes_exact``).
+    """
+
+    kind: str = "?"
+    #: True when ``bytes_sent`` counts real bytes pushed to a peer
+    #: (tcp); False when it is the NCCL-convention algorithmic estimate
+    #: (shm — a memcpy has no wire)
+    bytes_exact: bool = False
+
+    def barrier(self) -> None:
+        raise NotImplementedError
+
+    def allreduce(self, a: np.ndarray, op: str) -> None:
+        raise NotImplementedError
+
+    def allreduce_q8(self, a: np.ndarray, op: str) -> None:
+        raise NotImplementedError
+
+    def allgather(self, src: np.ndarray, out: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def reduce_scatter(self, src: np.ndarray, out: np.ndarray,
+                       op: str) -> None:
+        raise NotImplementedError
+
+    def broadcast(self, buf: np.ndarray, src: int) -> None:
+        raise NotImplementedError
+
+    def sendrecv(self, buf: np.ndarray, src: int, dst: int) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------------------
+# ShmTransport: the native ring, extracted verbatim.
+# --------------------------------------------------------------------------
+class ShmTransport(Transport):
+    """The POSIX-shm native ring (``native/hostring.cpp``) behind the
+    :class:`Transport` interface — one ctypes call per op, the exact
+    pre-r16 code path. Default transport of :class:`HostRingGroup`; shm
+    users see zero behavioural change."""
+
+    kind = "shm"
+    bytes_exact = False
+
+    def __init__(self, name: str, rank: int, world_size: int, *,
+                 slot_bytes: int = 4 << 20, timeout_s: float = 120.0):
+        # imported here (not at module top) to keep the hostring <->
+        # transport import cycle one-directional at import time
+        from pytorch_distributed_tpu.runtime import hostring
+
+        self._hr = hostring
+        lib = hostring._load()
+        handle = ctypes.c_void_p()
+        # shm names must start with '/' and contain no further slashes
+        shm = "/" + name.strip("/").replace("/", "_")
+        rc = lib.hr_init(
+            shm.encode(), rank, world_size, slot_bytes, timeout_s,
+            ctypes.byref(handle),
+        )
+        hostring._check(rc, "init")
+        self._h = handle
+        self._lib = lib
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.slot_bytes = int(slot_bytes)
+        self.timeout_s = float(timeout_s)
+        self.bytes_sent = 0
+
+    def _count(self, kind: str, payload_bytes: int) -> None:
+        self.bytes_sent += self._hr.algo_wire_bytes(
+            kind, payload_bytes, self.world_size
+        )
+
+    def barrier(self) -> None:
+        self._hr._check(self._lib.hr_barrier(self._h), "barrier")
+
+    def allreduce(self, a: np.ndarray, op: str) -> None:
+        rc = self._lib.hr_allreduce(
+            self._h, a.ctypes.data_as(ctypes.c_void_p), a.size,
+            self._hr._DTYPES[a.dtype], self._hr._OPS[op],
+        )
+        self._hr._check(rc, "all_reduce")
+        self._count("all_reduce", a.nbytes)
+
+    def allreduce_q8(self, a: np.ndarray, op: str) -> None:
+        rc = self._lib.hr_allreduce_q8(
+            self._h, a.ctypes.data_as(ctypes.c_void_p), a.size,
+            self._hr._OPS[op],
+        )
+        self._hr._check(rc, "all_reduce_q8")
+        self._count("all_reduce_q8", self._hr.q8_wire_payload(a.size))
+
+    def allgather(self, src: np.ndarray, out: np.ndarray) -> None:
+        # native dtypes gather as elements, anything else as raw bytes
+        # (identical copies either way — this preserves the exact
+        # pre-r16 call shape)
+        if src.dtype in self._hr._DTYPES:
+            count, dt = src.size, self._hr._DTYPES[src.dtype]
+        else:
+            count, dt = src.nbytes, self._hr._U8
+        rc = self._lib.hr_allgather(
+            self._h, src.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p), count, dt,
+        )
+        self._hr._check(rc, "all_gather")
+        self._count("all_gather", out.nbytes)
+
+    def reduce_scatter(self, src: np.ndarray, out: np.ndarray,
+                       op: str) -> None:
+        rc = self._lib.hr_reduce_scatter(
+            self._h, src.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p), out.size,
+            self._hr._DTYPES[src.dtype], self._hr._OPS[op],
+        )
+        self._hr._check(rc, "reduce_scatter")
+        self._count("reduce_scatter", src.nbytes)
+
+    def broadcast(self, buf: np.ndarray, src: int) -> None:
+        rc = self._lib.hr_broadcast(
+            self._h, buf.ctypes.data_as(ctypes.c_void_p), buf.nbytes, src
+        )
+        self._hr._check(rc, "broadcast")
+        self._count("broadcast", buf.nbytes)
+
+    def sendrecv(self, buf: np.ndarray, src: int, dst: int) -> None:
+        rc = self._lib.hr_sendrecv(
+            self._h, buf.ctypes.data_as(ctypes.c_void_p), buf.nbytes,
+            src, dst,
+        )
+        self._hr._check(rc, "sendrecv")
+        if self.rank == src:
+            self._count("send", buf.nbytes)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.hr_finalize(self._h)
+            self._h = None
+
+
+# --------------------------------------------------------------------------
+# The native reduction structure as pure functions (shared with tests,
+# the hierarchy pricing, and anyone proving the bit-identity argument).
+# --------------------------------------------------------------------------
+def allreduce_ranges(count: int, world: int, chunk_elems: int,
+                     *, q8: bool = False) -> List[List[Tuple[int, int]]]:
+    """Per-rank owned element ranges, replicating ``hr_allreduce``'s
+    per-chunk segmentation (``hr_allreduce_q8``'s with ``q8=True``:
+    segments round down to 256-element blocks, last rank takes the
+    tail). Returns ``ranges[rank] = [(start, length), ...]`` in global
+    element offsets — the complete ownership map the owner-computes
+    exchange below is built from."""
+    from pytorch_distributed_tpu.runtime.hostring import Q8_BLOCK
+
+    ranges: List[List[Tuple[int, int]]] = [[] for _ in range(world)]
+    off = 0
+    while off < count:
+        n = min(count - off, chunk_elems)
+        seg = n // world
+        if q8:
+            seg &= ~(Q8_BLOCK - 1)
+        for r in range(world):
+            s0 = r * seg
+            sn = (n - s0) if r == world - 1 else seg
+            if sn > 0:
+                ranges[r].append((off + s0, sn))
+        off += n
+    return ranges
+
+
+def q8_chunk_elems(slot_bytes: int) -> int:
+    """Elements per q8 chunk — ``q_chunk_elems`` capped by the reduce
+    scratch, exactly as ``hr_allreduce_q8`` computes it."""
+    n = slot_bytes * 256 // (256 + 4)
+    n = n - 8 if n > 8 else n
+    return min(n, slot_bytes // 2)
+
+
+def q8_quantize(x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """numpy replication of ``native/hostring.cpp``'s ``quantize``:
+    per-256-block scale ``amax/127``, ``x * (1/scale)`` in f32, clamp
+    ±127, round half away from zero; zero blocks quantize to (0, 0);
+    non-finite blocks to (1s, NaN scale). Returns ``(q int8[n],
+    scales f32[ceil(n/256)])``. Same arithmetic as
+    ``parallel/overlap.q8_local_roundtrip`` (pinned against the C
+    output there), split into its quantize half."""
+    from pytorch_distributed_tpu.runtime.hostring import Q8_BLOCK
+
+    x = np.ascontiguousarray(x, dtype=np.float32).reshape(-1)
+    n = x.size
+    pad = (-n) % Q8_BLOCK
+    xp = np.pad(x, (0, pad)).reshape(-1, Q8_BLOCK)
+    amax = np.max(np.abs(xp), axis=1)
+    bad = ~(amax <= np.float32(3.4e38))  # False for NaN/inf, like the C
+    s = (amax / np.float32(127.0)).astype(np.float32)
+    safe = np.where(s > 0, s, np.float32(1.0))
+    inv = (np.float32(1.0) / safe).astype(np.float32)
+    v = np.clip(xp * inv[:, None], np.float32(-127.0), np.float32(127.0))
+    v = np.trunc(v + np.copysign(np.float32(0.5), v))
+    q = np.where(np.isfinite(v), v, np.float32(0)).astype(np.int8)
+    q[s == 0] = 0
+    q[bad] = 1
+    s[bad] = np.float32("nan")
+    return q.reshape(-1)[:n], s
+
+
+def q8_dequantize(q: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    """``float(q[i]) * scale(block of i)`` in f32 — ``dequant_copy``.
+    NaN scales (non-finite source blocks) dequantize to NaN; zero
+    scales to 0, both without special cases, exactly like the C."""
+    from pytorch_distributed_tpu.runtime.hostring import Q8_BLOCK
+
+    n = q.size
+    pad = (-n) % Q8_BLOCK
+    qp = np.pad(q.astype(np.float32), (0, pad)).reshape(-1, Q8_BLOCK)
+    out = qp * scales.astype(np.float32)[:, None]
+    return out.reshape(-1)[:n]
+
+
+def _combine(acc: np.ndarray, src: np.ndarray, op: str) -> np.ndarray:
+    """One fold step, matching the native ``combine`` exactly — incl.
+    the comparison-based max/min (NaN loses, whichever side it is on;
+    ``np.maximum`` would propagate it instead)."""
+    if op in ("sum", "avg"):
+        acc += src
+    elif op in ("prod", "product"):
+        acc *= src
+    elif op == "max":
+        acc = np.where(acc < src, src, acc)
+    elif op == "min":
+        acc = np.where(src < acc, src, acc)
+    else:
+        raise ValueError(f"unknown reduce op {op!r}")
+    return acc
+
+
+def _byte_view(a: np.ndarray) -> memoryview:
+    # reinterpret as uint8 first: bf16 has no buffer-protocol format
+    # char, so memoryview(a) would raise on it
+    return memoryview(a.view(np.uint8))
+
+
+_HELLO = "hello"
+
+
+class TcpTransport(Transport):
+    """Socket-mesh transport: the native ring's collectives over TCP.
+
+    Rendezvous: rank 0 listens at ``addr`` (``host:port``); every other
+    rank connects, sends a hello carrying ``(name, world, slot_bytes)``
+    plus its own ephemeral listener port, and rank 0 — after validating
+    the parameters exactly like ``hr_init`` validates the segment
+    header — replies with the full rank->address map. The non-zero
+    ranks then pairwise-connect (higher rank dials lower rank's
+    listener) with the same validating handshake, yielding a full mesh:
+    ``world * (world-1) / 2`` sockets, TCP_NODELAY, one per unordered
+    pair.
+
+    Collectives are owner-computes exchanges over the mesh (see
+    :func:`allreduce_ranges` for the ownership math and the module
+    docstring for the bit-identity argument). Every exchange interleaves
+    non-blocking sends and receives through one selector loop, so
+    mutually-saturating payloads cannot deadlock on socket buffers. A
+    peer that dies severs the stream; this endpoint then POISONS itself
+    (every later call raises immediately) and closes its sockets, which
+    cascades the failure to the rest of the group within one exchange —
+    the loud-failure contract the elastic re-mesh path recovers from.
+    """
+
+    kind = "tcp"
+    bytes_exact = True
+
+    def __init__(self, name: str, rank: int, world_size: int,
+                 addr: str, *, slot_bytes: int = 4 << 20,
+                 timeout_s: float = 120.0):
+        if world_size <= 0 or not 0 <= rank < world_size:
+            raise ValueError(f"bad rank {rank} / world {world_size}")
+        if slot_bytes <= 0:
+            raise ValueError("slot_bytes must be positive")
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.slot_bytes = int(slot_bytes)
+        self.timeout_s = float(timeout_s)
+        self.addr = addr
+        self.bytes_sent = 0
+        self._poisoned: Optional[str] = None
+        self._socks: Dict[int, socket.socket] = {}
+        if world_size == 1:
+            return
+        host, _, port = addr.rpartition(":")
+        try:
+            self._connect_mesh(host or "127.0.0.1", int(port))
+        except BaseException:
+            self._close_all()
+            raise
+
+    # -- mesh setup --------------------------------------------------------
+    def _params(self) -> dict:
+        return {"name": self.name, "world": self.world_size,
+                "slot_bytes": self.slot_bytes}
+
+    def _check_params(self, theirs: dict) -> Optional[str]:
+        mine = self._params()
+        for k, v in mine.items():
+            if theirs.get(k) != v:
+                return (f"{k} mismatch: peer rank {theirs.get('rank')} "
+                        f"has {theirs.get(k)!r}, this rank has {v!r}")
+        return None
+
+    def _connect_mesh(self, host: str, port: int) -> None:
+        deadline = time.monotonic() + self.timeout_s
+        if self.rank == 0:
+            lsock = socket.socket()
+            lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            lsock.settimeout(self.timeout_s)
+            lsock.bind((host, port))
+            lsock.listen(self.world_size)
+            peers: Dict[int, Tuple[str, int]] = {}
+            try:
+                while len(self._socks) < self.world_size - 1:
+                    conn, peer_addr = lsock.accept()
+                    conn.settimeout(max(deadline - time.monotonic(), 0.1))
+                    hello = _recv_json(conn)
+                    err = self._check_params(hello)
+                    r = int(hello.get("rank", -1))
+                    if err is None and (
+                        not 0 < r < self.world_size or r in self._socks
+                    ):
+                        err = f"bad or duplicate rank {r} in hello"
+                    if err is not None:
+                        _send_json(conn, {"error": err})
+                        conn.close()
+                        raise RuntimeError(
+                            f"tcp transport handshake failed: {err}"
+                        )
+                    peers[r] = (peer_addr[0], int(hello["port"]))
+                    self._socks[r] = conn
+                peers[0] = (host, port)
+                for r, conn in self._socks.items():
+                    _send_json(conn, {"map": {
+                        str(k): list(v) for k, v in peers.items()
+                    }})
+            finally:
+                lsock.close()
+        else:
+            lsock = socket.socket()
+            lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            lsock.settimeout(self.timeout_s)
+            lsock.bind(("", 0))
+            lsock.listen(self.world_size)
+            my_port = lsock.getsockname()[1]
+            try:
+                root = self._dial((host, port), deadline)
+                _send_json(root, {**self._params(), "rank": self.rank,
+                                  "port": my_port, "type": _HELLO})
+                reply = _recv_json(root)
+                if "error" in reply:
+                    root.close()
+                    raise RuntimeError(
+                        f"tcp transport handshake rejected: "
+                        f"{reply['error']}"
+                    )
+                self._socks[0] = root
+                peers = {int(k): (v[0], int(v[1]))
+                         for k, v in reply["map"].items()}
+                # lower ranks listen, higher ranks dial — a fixed
+                # direction per pair, so the mesh completes without a
+                # connection cycle
+                for r in range(1, self.rank):
+                    s = self._dial(peers[r], deadline)
+                    _send_json(s, {**self._params(), "rank": self.rank})
+                    ack = _recv_json(s)
+                    if "error" in ack:
+                        s.close()
+                        raise RuntimeError(
+                            f"tcp transport handshake rejected by rank "
+                            f"{r}: {ack['error']}"
+                        )
+                    self._socks[r] = s
+                while len(self._socks) < self.world_size - 1:
+                    conn, _ = lsock.accept()
+                    conn.settimeout(max(deadline - time.monotonic(), 0.1))
+                    hello = _recv_json(conn)
+                    err = self._check_params(hello)
+                    r = int(hello.get("rank", -1))
+                    if err is None and (
+                        not self.rank < r < self.world_size
+                        or r in self._socks
+                    ):
+                        err = f"bad or duplicate rank {r} in hello"
+                    if err is not None:
+                        _send_json(conn, {"error": err})
+                        conn.close()
+                        raise RuntimeError(
+                            f"tcp transport handshake failed: {err}"
+                        )
+                    _send_json(conn, {"ok": True})
+                    self._socks[r] = conn
+            finally:
+                lsock.close()
+        for s in self._socks.values():
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s.setblocking(False)
+
+    def _dial(self, addr: Tuple[str, int], deadline: float) -> socket.socket:
+        while True:
+            s = socket.socket()
+            s.settimeout(max(deadline - time.monotonic(), 0.1))
+            try:
+                s.connect(addr)
+                return s
+            except OSError:
+                s.close()
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"tcp transport rendezvous timed out connecting "
+                        f"to {addr} (peer never listened; -110-style)"
+                    ) from None
+                time.sleep(_CONNECT_POLL_S)
+
+    # -- the exchange workhorse --------------------------------------------
+    def _poison(self, reason: str) -> None:
+        self._poisoned = reason
+        self._close_all()
+
+    def _close_all(self) -> None:
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._socks.clear()
+
+    def _guard(self, what: str) -> None:
+        if self._poisoned is not None:
+            raise RuntimeError(
+                f"tcp transport {what} failed: group poisoned "
+                f"({self._poisoned}; -5-style peer death — re-mesh via "
+                f"the elastic membership path)"
+            )
+
+    def _exchange(self, send: Dict[int, List[memoryview]],
+                  recv: Dict[int, List[memoryview]],
+                  *, control: bool = False) -> None:
+        """Move every ``send`` buffer to its peer and fill every ``recv``
+        buffer from its peer, interleaved through one selector loop.
+        Buffer sizes are agreed by the collective's own math on both
+        ends, so no framing is needed (and ``bytes_sent`` is exactly the
+        data payload). ``control=True`` marks protocol tokens (barrier):
+        excluded from ``bytes_sent`` and from the slow-link throttle."""
+        self._guard("exchange")
+        try:
+            faults.check("transport.link_lost")
+        except faults.InjectedFault:
+            self._poison("transport.link_lost injected")
+            raise
+        sendq = {r: [_as_bytes(v) for v in views if v.nbytes]
+                 for r, views in send.items()}
+        recvq = {r: [_as_bytes(v) for v in views if v.nbytes]
+                 for r, views in recv.items()}
+        sendq = {r: q for r, q in sendq.items() if q}
+        recvq = {r: q for r, q in recvq.items() if q}
+        moved = 0
+        if sendq or recvq:
+            moved = self._drain(sendq, recvq)
+        if not control:
+            self.bytes_sent += moved
+            fac = faults.throttle("transport.slow_link")
+            if fac > 1.0:
+                time.sleep(moved * (fac - 1.0) / SLOW_LINK_BYTES_PER_S)
+
+    def _drain(self, sendq: Dict[int, List[memoryview]],
+               recvq: Dict[int, List[memoryview]]) -> int:
+        deadline = time.monotonic() + self.timeout_s
+        sel = selectors.DefaultSelector()
+        sent_bytes = 0
+        try:
+            for r in set(sendq) | set(recvq):
+                sock = self._socks.get(r)
+                if sock is None:
+                    raise RuntimeError(f"no link to rank {r}")
+                ev = 0
+                if r in sendq:
+                    ev |= selectors.EVENT_WRITE
+                if r in recvq:
+                    ev |= selectors.EVENT_READ
+                sel.register(sock, ev, r)
+            while sendq or recvq:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"tcp exchange timed out after "
+                        f"{self.timeout_s:.0f}s (peer hung or died; "
+                        f"-110-style)"
+                    )
+                for key, mask in sel.select(timeout=0.2):
+                    r = key.data
+                    if mask & selectors.EVENT_READ and r in recvq:
+                        q = recvq[r]
+                        n = key.fileobj.recv_into(q[0])
+                        if n == 0:
+                            raise RuntimeError(
+                                f"rank {r} closed the link mid-exchange"
+                            )
+                        q[0] = q[0][n:]
+                        if not q[0].nbytes:
+                            q.pop(0)
+                        if not q:
+                            del recvq[r]
+                            self._downgrade(sel, key, selectors.EVENT_READ)
+                    if mask & selectors.EVENT_WRITE and r in sendq:
+                        q = sendq[r]
+                        n = key.fileobj.send(q[0])
+                        sent_bytes += n
+                        q[0] = q[0][n:]
+                        if not q[0].nbytes:
+                            q.pop(0)
+                        if not q:
+                            del sendq[r]
+                            self._downgrade(sel, key, selectors.EVENT_WRITE)
+        except (OSError, RuntimeError) as e:
+            self._poison(str(e))
+            raise RuntimeError(
+                f"tcp transport exchange failed: {e} (group poisoned)"
+            ) from e
+        finally:
+            sel.close()
+        return sent_bytes
+
+    @staticmethod
+    def _downgrade(sel, key, done_event) -> None:
+        remaining = key.events & ~done_event
+        if remaining:
+            sel.modify(key.fileobj, remaining, key.data)
+        else:
+            sel.unregister(key.fileobj)
+
+    # -- collectives -------------------------------------------------------
+    def barrier(self) -> None:
+        if self.world_size == 1:
+            return
+        token = np.zeros(1, np.uint8)
+        if self.rank == 0:
+            gather = {r: np.zeros(1, np.uint8)
+                      for r in range(1, self.world_size)}
+            self._exchange({}, {r: [_byte_view(b)]
+                                for r, b in gather.items()}, control=True)
+            self._exchange({r: [_byte_view(token)]
+                            for r in gather}, {}, control=True)
+        else:
+            self._exchange({0: [_byte_view(token)]}, {}, control=True)
+            got = np.zeros(1, np.uint8)
+            self._exchange({}, {0: [_byte_view(got)]}, control=True)
+
+    def allreduce(self, a: np.ndarray, op: str) -> None:
+        if op == "avg" and a.dtype.kind not in "f" and a.dtype not in (
+            np.dtype(np.float16),
+        ) and str(a.dtype) != "bfloat16":
+            raise ValueError(
+                "op='avg' over tcp needs a float dtype (integers "
+                "sum + floor-divide in the group layer, like the native "
+                "ring)"
+            )
+        if self.world_size == 1:
+            return
+        esize = a.itemsize
+        chunk = self.slot_bytes // esize
+        if chunk == 0:
+            raise ValueError("slot_bytes smaller than one element")
+        flat = a.reshape(-1)
+        w, me = self.world_size, self.rank
+        ranges = allreduce_ranges(flat.size, w, chunk)
+        half = str(a.dtype) in ("float16", "bfloat16")
+        # phase A: ship my copy of each owner's segments to the owner
+        send = {r: [_byte_view(flat[s:s + n]) for s, n in ranges[r]]
+                for r in range(w) if r != me}
+        mylen = sum(n for _, n in ranges[me])
+        inbox = {r: np.empty(mylen, a.dtype) for r in range(w) if r != me}
+        self._exchange(send, {r: [_byte_view(b)]
+                              for r, b in inbox.items()})
+        if mylen:
+            own = (np.concatenate([flat[s:s + n] for s, n in ranges[me]])
+                   if len(ranges[me]) > 1
+                   else flat[ranges[me][0][0]:
+                             ranges[me][0][0] + ranges[me][0][1]].copy())
+            # the native fold: own contribution first, then peers in
+            # rotated rank order — the same float-addition sequence the
+            # shm ring runs, hence bit-identical results
+            acc = own.astype(np.float32) if half else own
+            for k in range(1, w):
+                src = (me + k) % w
+                peer = inbox[src]
+                acc = _combine(
+                    acc, peer.astype(np.float32) if half else peer, op
+                )
+            if op == "avg":
+                # divide in the accumulator BEFORE the single half
+                # rounding (a rounded half sum can overflow to inf)
+                acc /= acc.dtype.type(w)
+            red = acc.astype(a.dtype) if half else acc
+            pos = 0
+            for s, n in ranges[me]:
+                flat[s:s + n] = red[pos:pos + n]
+                pos += n
+        # phase B: ship my reduced segments to every peer; receive each
+        # owner's reduced segments straight into their home slices
+        send = {r: [_byte_view(flat[s:s + n]) for s, n in ranges[me]]
+                for r in range(w) if r != me}
+        recv = {r: [_byte_view(flat[s:s + n]) for s, n in ranges[r]]
+                for r in range(w) if r != me}
+        self._exchange(send, recv)
+
+    def allreduce_q8(self, a: np.ndarray, op: str) -> None:
+        from pytorch_distributed_tpu.runtime.hostring import Q8_BLOCK
+
+        if op not in ("sum", "avg"):
+            raise ValueError(f"q8 allreduce supports sum/avg, got {op!r}")
+        if self.world_size == 1:
+            return
+        w, me = self.world_size, self.rank
+        chunk = q8_chunk_elems(self.slot_bytes)
+        if chunk < Q8_BLOCK * w:
+            raise ValueError(
+                f"slot_bytes {self.slot_bytes} too small for a q8 "
+                f"allreduce at world {w} (needs >= {Q8_BLOCK} elems "
+                "per rank per chunk, like the native ring)"
+            )
+        flat = a.reshape(-1)
+        ranges = allreduce_ranges(flat.size, w, chunk, q8=True)
+
+        def nsc(n: int) -> int:  # scales per n-element range
+            return (n + Q8_BLOCK - 1) // Q8_BLOCK
+
+        # phase A: quantize my copy of each owner's segments, ship
+        # (q, scales) per range; owners keep their own exact f32 base
+        send: Dict[int, List[memoryview]] = {}
+        for r in range(w):
+            if r == me:
+                continue
+            views: List[memoryview] = []
+            for s, n in ranges[r]:
+                q, sc = q8_quantize(flat[s:s + n])
+                views.append(_byte_view(q))
+                views.append(_byte_view(sc))
+            send[r] = views
+        inbox: Dict[int, List[Tuple[np.ndarray, np.ndarray]]] = {}
+        recv: Dict[int, List[memoryview]] = {}
+        for r in range(w):
+            if r == me:
+                continue
+            bufs = [(np.empty(n, np.int8), np.empty(nsc(n), np.float32))
+                    for _, n in ranges[me]]
+            inbox[r] = bufs
+            recv[r] = [v for q, sc in bufs
+                       for v in (_byte_view(q), _byte_view(sc))]
+        self._exchange(send, recv)
+        # owner fold per range: exact own f32 base + peers dequantized
+        # in rotated order; AVG divides; the reduced segment REQUANTIZES
+        # and the owner takes the dequantized value too (DDP lockstep:
+        # every rank must see the same bits). The fold itself runs the
+        # native dequant_add kernel — the compiler contracts its
+        # acc += q*s to an FMA, so only the shared compiled kernel can
+        # match the shm ring bit-for-bit.
+        from pytorch_distributed_tpu.runtime.hostring import _load
+
+        dequant_add = _load().hr_q8_dequant_add
+        reduced: List[Tuple[np.ndarray, np.ndarray]] = []
+        for i, (s, n) in enumerate(ranges[me]):
+            acc = flat[s:s + n].astype(np.float32)
+            for k in range(1, w):
+                src = (me + k) % w
+                q, sc = inbox[src][i]
+                dequant_add(
+                    acc.ctypes.data_as(ctypes.c_void_p),
+                    q.ctypes.data_as(ctypes.c_void_p),
+                    sc.ctypes.data_as(ctypes.c_void_p), n,
+                )
+            if op == "avg":
+                acc /= np.float32(w)
+            q, sc = q8_quantize(acc)
+            flat[s:s + n] = q8_dequantize(q, sc)
+            reduced.append((q, sc))
+        # phase B: ship the requantized segments; peers dequantize
+        send = {r: [v for q, sc in reduced
+                    for v in (_byte_view(q), _byte_view(sc))]
+                for r in range(w) if r != me}
+        recv = {}
+        peer_red: Dict[int, List[Tuple[np.ndarray, np.ndarray]]] = {}
+        for r in range(w):
+            if r == me:
+                continue
+            bufs = [(np.empty(n, np.int8), np.empty(nsc(n), np.float32))
+                    for _, n in ranges[r]]
+            peer_red[r] = bufs
+            recv[r] = [v for q, sc in bufs
+                       for v in (_byte_view(q), _byte_view(sc))]
+        self._exchange(send, recv)
+        for r in range(w):
+            if r == me:
+                continue
+            for (s, n), (q, sc) in zip(ranges[r], peer_red[r]):
+                flat[s:s + n] = q8_dequantize(q, sc)
+
+    def allgather(self, src: np.ndarray, out: np.ndarray) -> None:
+        out_rows = out.reshape(self.world_size, -1)
+        flat = src.reshape(-1)
+        out_rows[self.rank] = flat
+        if self.world_size == 1:
+            return
+        send = {r: [_byte_view(flat)]
+                for r in range(self.world_size) if r != self.rank}
+        recv = {r: [_byte_view(out_rows[r])]
+                for r in range(self.world_size) if r != self.rank}
+        self._exchange(send, recv)
+
+    def reduce_scatter(self, src: np.ndarray, out: np.ndarray,
+                       op: str) -> None:
+        if op == "avg":
+            raise ValueError("op='avg' is only supported for all_reduce")
+        w, me = self.world_size, self.rank
+        rows = src.reshape(w, -1)
+        flat_out = out.reshape(-1)
+        flat_out[...] = rows[me]
+        if w == 1:
+            return
+        send = {r: [_byte_view(rows[r])] for r in range(w) if r != me}
+        inbox = {r: np.empty(flat_out.size, src.dtype)
+                 for r in range(w) if r != me}
+        self._exchange(send, {r: [_byte_view(b)]
+                              for r, b in inbox.items()})
+        acc = flat_out
+        # same fold order as hr_reduce_scatter: own row first, then
+        # peers rotated from this rank
+        for k in range(1, w):
+            acc = _combine(acc, inbox[(me + k) % w], op)
+        flat_out[...] = acc
+
+    def broadcast(self, buf: np.ndarray, src: int) -> None:
+        if not 0 <= src < self.world_size:
+            raise ValueError(f"bad broadcast src {src}")
+        if self.world_size == 1:
+            return
+        flat = buf.reshape(-1)
+        if self.rank == src:
+            self._exchange({r: [_byte_view(flat)]
+                            for r in range(self.world_size) if r != src},
+                           {})
+        else:
+            self._exchange({}, {src: [_byte_view(flat)]})
+
+    def sendrecv(self, buf: np.ndarray, src: int, dst: int) -> None:
+        if src == dst or not (0 <= src < self.world_size
+                              and 0 <= dst < self.world_size):
+            raise ValueError(f"bad p2p pair {src}->{dst}")
+        if self.rank not in (src, dst):
+            raise ValueError(
+                f"rank {self.rank} is a bystander of p2p {src}->{dst}"
+            )
+        flat = buf.reshape(-1)
+        if self.rank == src:
+            self._exchange({dst: [_byte_view(flat)]}, {})
+        else:
+            self._exchange({}, {src: [_byte_view(flat)]})
+
+    def close(self) -> None:
+        self._close_all()
+
+
+def _as_bytes(v: memoryview) -> memoryview:
+    return v if v.format == "B" else v.cast("B")
+
+
+# -- blocking JSON-line frames for the setup handshake ---------------------
+def _send_json(sock: socket.socket, obj: dict) -> None:
+    sock.sendall(json.dumps(obj).encode() + b"\n")
+
+
+def _recv_json(sock: socket.socket) -> dict:
+    buf = bytearray()
+    while not buf.endswith(b"\n"):
+        # one byte at a time: a peer that finishes ITS mesh first may
+        # already have data-plane bytes queued right behind the ack on
+        # this stream — a chunked read would swallow them (seen live as
+        # "oversized tcp handshake frame" under the 4 MB bench payload).
+        # Handshakes run once per socket and are ~100 bytes; the syscall
+        # cost is irrelevant.
+        b = sock.recv(1)
+        if not b:
+            raise RuntimeError("peer closed during tcp handshake")
+        buf += b
+        if len(buf) > 1 << 20:
+            raise RuntimeError("oversized tcp handshake frame")
+    return json.loads(buf.decode())

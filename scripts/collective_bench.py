@@ -30,30 +30,35 @@ auto-parallel planner (ROADMAP item 4) consumes; the fit summary
 prints each op's α/β/R² and the worst predicted-vs-measured ratio over
 the sweep (the "within 2x" self-check).
 
+``--transport tcp`` (or ``shm``) bypasses the jax facade entirely: it
+spawns ``--world`` jax-free worker processes running the REAL transport
+(runtime/transport.py) under :class:`HostRingGroup` and sweeps the host
+collectives — all_reduce, all_reduce_q8, all_gather, reduce_scatter,
+broadcast — so ``--fit`` writes a model whose ``transport`` label is the
+thing actually measured. One per-transport model file per transport:
+``CostModel.load(expected_transport=...)`` refuses the wrong one.
+
 Run (any env; on the chip follow docs/CHIP_PROTOCOL.md — no kill timers):
     python scripts/collective_bench.py --sizes 4 32 128
     python scripts/collective_bench.py --axis dp --iters 50
     python scripts/collective_bench.py --sizes 1 4 16 64 \
         --metrics-path runs/comm.jsonl --fit runs/costmodel.json
+    python scripts/collective_bench.py --transport tcp --world 2 \
+        --sizes 1 4 16 --fit runs/costmodel_tcp.json
 """
 
 import argparse
+import multiprocessing
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-import pytorch_distributed_tpu as ptd
-from pytorch_distributed_tpu.runtime.distributed import ReduceOp
-from pytorch_distributed_tpu.runtime.mesh import MeshSpec, mesh_axis_size
-
 
 def _timed(fn, x, iters, warmup=3):
+    import jax.numpy as jnp
+
     y = fn(x)
     for _ in range(warmup):
         y = fn(y)
@@ -63,6 +68,115 @@ def _timed(fn, x, iters, warmup=3):
         y = fn(y)
     float(jnp.sum(y[..., :1]))
     return (time.perf_counter() - t0) / iters
+
+
+def _transport_worker(rank, world, name, q, kind, addr, sizes_mb, iters,
+                      slot_bytes):
+    """One spawn-context rank of the ``--transport`` sweep (jax-free)."""
+    import numpy as np
+
+    from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
+    from pytorch_distributed_tpu.runtime.transport import TcpTransport
+
+    try:
+        tp = None
+        if kind == "tcp":
+            tp = TcpTransport(name, rank, world, addr,
+                              slot_bytes=slot_bytes)
+        ring = HostRingGroup(name, rank, world, slot_bytes=slot_bytes,
+                             transport=tp)
+        records = []
+        for mb in sizes_mb:
+            # elems divisible by world (reduce_scatter rows) AND by 256
+            # (q8 block grid) so every op runs the same logical payload
+            elems = max(int(mb * 1e6 / 4) // (world * 256), 1) * world * 256
+            payload = elems * 4
+            per = elems // world
+            cases = {
+                "all_reduce": (
+                    np.ones(elems, np.float32),
+                    lambda a: ring.all_reduce(a, inplace=True),
+                ),
+                "all_reduce_q8": (
+                    np.ones(elems, np.float32),
+                    lambda a: ring.all_reduce_q8(a, inplace=True),
+                ),
+                "all_gather": (
+                    np.ones(per, np.float32),
+                    lambda a: ring.all_gather(a),
+                ),
+                "reduce_scatter": (
+                    np.ones((world, per), np.float32),
+                    lambda a: ring.reduce_scatter(a),
+                ),
+                "broadcast": (
+                    np.ones(elems, np.float32),
+                    lambda a: ring.broadcast(a, 0, inplace=True),
+                ),
+            }
+            if elems < 256 * world:
+                del cases["all_reduce_q8"]  # below the q8 segment floor
+            for op, (x, fn) in cases.items():
+                for _ in range(2):
+                    fn(x)
+                ring.barrier()
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    fn(x)
+                dt = (time.perf_counter() - t0) / iters
+                if rank == 0:
+                    records.append({
+                        "op": op, "payload_bytes": payload,
+                        "seconds": dt, "world": world, "iters": iters,
+                    })
+        ring.close()
+        q.put((rank, "ok", records))
+    except Exception as e:  # surfaced by the parent
+        q.put((rank, "error", f"{type(e).__name__}: {e}"))
+
+
+def _transport_sweep(args):
+    """Spawn a world of transport workers; returns rank 0's records."""
+    from pytorch_distributed_tpu.runtime.hostring import unlink_segment
+
+    ctx = multiprocessing.get_context("spawn")
+    q = ctx.Queue()
+    name = f"cbench_{os.getpid()}"
+    addr = "127.0.0.1:0"
+    if args.transport == "tcp":
+        # pick a concrete free port up front: every rank needs the same
+        # dial address before rank 0's listener exists
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        addr = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+    procs = [
+        ctx.Process(
+            target=_transport_worker,
+            args=(r, args.world, name, q, args.transport, addr,
+                  args.sizes, args.iters, int(args.slot_mb * 1e6)),
+        )
+        for r in range(args.world)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(args.world):
+            r, status, payload = q.get(timeout=600)
+            if status != "ok":
+                raise RuntimeError(f"rank {r} failed: {payload}")
+            results[r] = payload
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+        if args.transport == "shm":
+            unlink_segment(name)
+    return results.get(0, [])
 
 
 def main(argv=None):
@@ -86,7 +200,54 @@ def main(argv=None):
     p.add_argument("--fit", default=None, metavar="COSTMODEL_JSON",
                    help="fit the α–β comms cost model from this sweep "
                    "and write it here")
+    p.add_argument("--transport", default="auto",
+                   choices=("auto", "shm", "tcp"),
+                   help="auto = the jax facade sweep below; shm/tcp = "
+                   "spawn a jax-free HostRingGroup worker ring on that "
+                   "transport and sweep the host collectives")
+    p.add_argument("--world", type=int, default=2,
+                   help="worker count for --transport shm/tcp sweeps")
+    p.add_argument("--slot-mb", type=float, default=4.0,
+                   help="transport slot size (MB) for --transport sweeps")
     args = p.parse_args(argv)
+
+    if args.transport != "auto":
+        from pytorch_distributed_tpu.runtime.hostring import (
+            algo_wire_bytes,
+        )
+
+        if args.world < 2:
+            print("# --transport sweeps need --world >= 2",
+                  file=sys.stderr)
+            return 1
+        transport = args.transport
+        print(f"# transport={transport} world={args.world} "
+              f"(host collectives over runtime/transport.py; "
+              f"loopback physics on one box)", flush=True)
+        records = []
+        for r in _transport_sweep(args):
+            wire = algo_wire_bytes(r["op"], r["payload_bytes"],
+                                   r["world"])
+            rec = {**r, "wire_bytes": wire,
+                   "gb_per_s": wire / r["seconds"] / 1e9,
+                   "transport": transport}
+            records.append(rec)
+            print(
+                f"{rec['op']:15s} {rec['payload_bytes'] / 1e6:8.1f}MB "
+                f"{rec['seconds'] * 1e3:8.3f}ms  "
+                f"{rec['gb_per_s']:7.2f} GB/s busbw",
+                flush=True,
+            )
+        return _write_outputs(args, records, transport)
+
+    import jax.numpy as jnp  # noqa: F401 — facade path only
+
+    import pytorch_distributed_tpu as ptd
+    from pytorch_distributed_tpu.runtime.distributed import ReduceOp
+    from pytorch_distributed_tpu.runtime.mesh import (
+        MeshSpec,
+        mesh_axis_size,
+    )
 
     ptd.enable_compilation_cache()
     if not ptd.is_initialized():
@@ -179,6 +340,11 @@ def main(argv=None):
                 print(f"{name:15s} {payload / 1e6:8.1f}MB FAILED: "
                       f"{type(e).__name__}: {e}", flush=True)
 
+    return _write_outputs(args, records, transport)
+
+
+def _write_outputs(args, records, transport):
+    """Shared tail of both sweep paths: JSONL records + the α–β fit."""
     if args.metrics_path:
         from pytorch_distributed_tpu.train.metrics import MetricsWriter
 

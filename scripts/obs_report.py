@@ -211,13 +211,45 @@ def comms_section(events, rows, other, costmodel_path, out):
     if costmodel_path:
         from pytorch_distributed_tpu.runtime import costmodel as cm
 
+        # every comm span since r16 records which transport carried it;
+        # refuse to compare measurements against a model fit on a
+        # DIFFERENT transport (a tcp β is ~an order of magnitude off an
+        # shm one — the meas/pred column would be confidently wrong).
+        # Pre-r16 traces carry no transport arg: no check possible.
+        kinds = sorted({
+            str((ev.get("args") or {}).get("transport"))
+            for ev in events
+            if ev.get("ph") == "X"
+            and str(ev.get("name", "")).startswith("comm.")
+            and (ev.get("args") or {}).get("transport")
+        })
         try:
             model = cm.CostModel.load(costmodel_path)
-            print(f"  cost model: {costmodel_path} "
-                  f"(transport={model.transport})", file=out)
         except (OSError, ValueError, KeyError, TypeError) as e:
+            # missing/unreadable stays graceful (reports render without
+            # the pred column) ...
             print(f"  (costmodel {costmodel_path} unreadable: {e})",
                   file=out)
+        # "hostring" (the facade-sweep label for the native shm ring)
+        # and "shm" (the ring's own span kind) are the same physical
+        # transport — normalize before comparing
+        alias = {"hostring": "shm"}
+        kinds = sorted({alias.get(k, k) for k in kinds})
+        mkind = (alias.get(model.transport, model.transport)
+                 if model is not None else None)
+        if model is not None and kinds and mkind not in kinds:
+            # ... but a transport MISMATCH raises: silence here is a
+            # wrong number in the report
+            raise cm.CostModelUnavailable(
+                f"cost model {costmodel_path!r} was calibrated on "
+                f"transport {model.transport!r} but this trace's comm "
+                f"spans ran on {kinds} — refit per transport "
+                f"(`collective_bench.py --transport ...`) or point "
+                f"--costmodel at the matching fit"
+            )
+        if model is not None:
+            print(f"  cost model: {costmodel_path} "
+                  f"(transport={model.transport})", file=out)
     if stats:
         header = ("op", "calls", "total_ms", "mean_ms", "moved_MB",
                   "GB/s", "pred_ms", "meas/pred")
@@ -254,6 +286,38 @@ def comms_section(events, rows, other, costmodel_path, out):
         if model is not None:
             print("  (pred_ms from the α–β fit at each op's mean "
                   "payload; * = outside the calibrated range)", file=out)
+    # per-transport wire accounting (r16): every armed comm span also
+    # bumps a cumulative ``comm.bytes.<transport>`` counter per process.
+    # Counters are per-GROUP-life cumulative and restart at 0 on a fresh
+    # ring (elastic re-mesh), so sum per-(pid, counter) increments like
+    # the comm.sync counters below.
+    tbytes: dict = {}
+    tprev: dict = {}
+    for ev in events:
+        if ev.get("ph") == "C" and str(ev.get("name", "")).startswith(
+            "comm.bytes."
+        ):
+            name = ev["name"]
+            v = float((ev.get("args") or {}).get("value", 0.0))
+            k = (ev.get("pid"), name)
+            p = tprev.get(k, 0.0)
+            tbytes[name] = tbytes.get(name, 0.0) + (
+                v - p if v >= p else v
+            )
+            tprev[k] = v
+    if tbytes:
+        cross = tbytes.get("comm.bytes.tcp", 0.0)
+        parts = ", ".join(
+            f"{n[len('comm.bytes.'):]} {v / 1e6:.2f} MB"
+            for n, v in sorted(tbytes.items())
+        )
+        print(
+            f"  Cross-host bytes: {cross / 1e6:.2f} MB over tcp "
+            f"(per transport: {parts})", file=out,
+        )
+        stats["comm.bytes"] = {
+            n[len("comm.bytes."):]: int(v) for n, v in tbytes.items()
+        }
     # overlapped grad sync (r14): the engine's cumulative exposed/hidden
     # counters — how much of the comm wall the main thread actually
     # blocked on vs how much ran under concurrent work. Counters are

@@ -251,6 +251,27 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
     assert "hetero" in pd[0]["value"], pd[0]
     assert durations.get("hetero", 999) < 300, durations
 
+    # the multihost phase (r16): 4 ranks in 2 shm domains with a TCP
+    # inter-host leg throttled identically under both paths — the
+    # hierarchical allreduce must beat flat-over-TCP >= 1.3x (analytic
+    # ceiling 1.5x at H=2: it moves P vs flat's 1.5P over the slow
+    # link), with bit-identity across ranks/paths/numpy and the EXACT
+    # byte accounting both enforced INSIDE the phase (it raises, so
+    # the ratio can never come from wrong math or miscounted bytes)
+    mh = one_metric("multihost_hier_vs_flat_ratio")
+    assert mh["value"] >= 1.3, (
+        f"hierarchical allreduce lost its edge over flat-over-TCP: {mh}"
+    )
+    assert 0 < mh["wall_hier_s"] < mh["wall_flat_s"], mh
+    mhb = one_metric("multihost_slow_link_bytes_per_step")
+    # leader moves exactly 2(H-1)/H x payload = 4 MB at the bench shape;
+    # flat moves exactly 2(w-1)/w x payload = 6 MB per rank
+    assert mhb["value"] == 4 * (1 << 20), mhb
+    assert mhb["flat_bytes_per_rank_per_step"] == 6 * (1 << 20), mhb
+    assert mhb["bytes_exact"] is True, mhb
+    assert "multihost" in pd[0]["value"], pd[0]
+    assert durations.get("multihost", 999) < 120, durations
+
     # the comms phase: q8's RECORDED wire bytes at gradient size must be
     # <= 0.3x f32 (the encoding is int8 + one f32 scale per 256 elems,
     # ~0.254 — ROADMAP item 1's bytes-moved-reduction number, measured

@@ -1,0 +1,400 @@
+"""Multi-host transports (r16): pluggable Transport under the ring,
+TCP rendezvous, hierarchical collectives, per-transport pricing.
+
+The parity contract is the whole point: a ``TcpTransport``-backed group
+must be indistinguishable from the native shm ring — same collectives,
+same bits (q8 included: both fold through the one compiled
+``hr_q8_dequant_add`` kernel), same fingerprint-handshake rejections,
+same loud poison-on-peer-death. The hierarchical group's claim is
+byte-structural: exactly ``2(H-1)/H x payload`` crosses the inter-host
+link per allreduce, counted by an exact integer counter, with the flat
+ring as the bit-reference on integer-valued payloads.
+
+Process tests spawn genuine OS processes via the shared
+``hostring_workers.run_ring_workers`` harness; TCP listeners bind
+parent-chosen free ports so parallel tests can't collide.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.autoplan import pricing
+from pytorch_distributed_tpu.runtime import costmodel, rendezvous
+from pytorch_distributed_tpu.runtime.hostring import algo_wire_bytes
+
+from tests import hostring_workers, transport_workers
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(REPO, "scripts")
+
+_run = hostring_workers.run_ring_workers
+pytestmark = pytest.mark.multihost
+
+
+class TestTcpTransportParity:
+    def test_full_collective_matrix_vs_shm(self):
+        """Every collective x dtype x op cell bit-identical between the
+        shm ring and the TCP mesh on a 3-rank world (odd world: chunk
+        remainders exercised), plus exact wire accounting."""
+        results = _run(
+            3, transport_workers.parity_worker,
+            extra_args=(transport_workers.free_addr(),),
+        )
+        assert results == [(r, "ok") for r in range(3)], results
+
+    def test_handshake_rejects_mismatched_params(self):
+        """A joiner with different slot_bytes is refused at the hello —
+        the socket-mesh analogue of hr_init's header validation."""
+        results = _run(
+            2, transport_workers.mismatch_worker,
+            extra_args=(transport_workers.free_addr(),),
+        )
+        assert results == [(r, "ok") for r in range(2)], results
+
+    def test_traced_spans_carry_transport_and_bytes_counter(self, tmp_path):
+        """Armed comm spans record ``transport="tcp"`` and the
+        cumulative ``comm.bytes.tcp`` counter equals the transport's own
+        exact ``bytes_sent`` — the source for obs_report's Cross-host
+        bytes line."""
+        results = _run(
+            2, transport_workers.traced_tcp_worker,
+            extra_args=(transport_workers.free_addr(), str(tmp_path)),
+        )
+        bad = [r for r in results if not isinstance(r[1], dict)]
+        assert not bad, bad
+        want = 3 * algo_wire_bytes("all_reduce", 4096 * 4, 2)
+        assert all(d["bytes_sent"] == want for _, d in results), results
+        for rank in range(2):
+            fname = "trace.json" if rank == 0 else f"trace-rank{rank}.json"
+            doc = json.load(open(os.path.join(str(tmp_path), fname)))
+            evs = doc if isinstance(doc, list) else doc["traceEvents"]
+            ar = [e for e in evs if e.get("ph") == "X"
+                  and e.get("name") == "comm.all_reduce"]
+            assert len(ar) == 3, [e.get("name") for e in evs]
+            assert all(e["args"]["transport"] == "tcp" for e in ar), ar
+            ctr = [e for e in evs if e.get("ph") == "C"
+                   and e.get("name") == "comm.bytes.tcp"]
+            assert ctr, "comm.bytes.tcp counter never emitted"
+            assert ctr[-1]["args"]["value"] == want, ctr[-1]
+
+
+class TestHierarchicalGroup:
+    def test_2x2_hierarchy_parity_and_inter_bytes(self):
+        """tcp-inter == shm-inter bitwise; hier == flat bitwise on
+        integer payloads; q8 inter bounded + cross-rank identical; the
+        inter-link counter exactly 2(H-1)/H x payload on leaders, 0
+        elsewhere."""
+        results = _run(
+            4, transport_workers.hier_worker,
+            extra_args=(transport_workers.free_addr(),),
+        )
+        assert results == [(r, "ok") for r in range(4)], results
+
+    def test_severed_link_poisons_loudly_then_remesh(self):
+        """The chaos contract: an injected ``transport.link_lost`` on a
+        leader fails EVERY rank loudly (poison + EOF cascade on the TCP
+        leg, deadline on the intra rings), and survivors recover on a
+        fresh re-meshed ring — the r13 elastic recovery shape."""
+        results = _run(
+            4, transport_workers.link_lost_worker,
+            extra_args=(transport_workers.free_addr(),), timeout=120.0,
+        )
+        assert results == [(r, "ok") for r in range(4)], results
+
+
+class TestGradSyncOverTcp:
+    def test_engine_routes_through_handed_group(self):
+        """Verify-don't-fork: GradSyncEngine on a TCP-backed group is
+        bit-identical to the same engine on the shm ring — the overlap
+        pipeline has no transport-specific branch."""
+        results = _run(
+            2, transport_workers.gradsync_tcp_worker,
+            extra_args=(transport_workers.free_addr(),),
+        )
+        assert results == [(r, "ok") for r in range(2)], results
+
+
+class TestTcpRendezvous:
+    def test_channel_records_roundtrip_and_connection_lease(self):
+        """In-process unit: announce/read/leave/view RPCs round-trip,
+        and dropping a client connection reaps its member record — the
+        liveness lease that replaces pid polling."""
+        srv = rendezvous.RendezvousServer("127.0.0.1:0")
+        try:
+            c1 = rendezvous.open_channel("tcp://" + srv.addr)
+            c2 = rendezvous.open_channel("tcp://" + srv.addr)
+            assert isinstance(c1, rendezvous.TcpRendezvousChannel)
+            assert c1.key() == "tcp://" + srv.addr == c2.key()
+            c1.write_member({"worker_id": "a", "pid": 1, "bid": 1})
+            c2.write_member({"worker_id": "b", "pid": 2, "bid": 1})
+            ids = sorted(r["worker_id"] for r in c1.read_members())
+            assert ids == ["a", "b"], ids
+            assert c1.last_committed_epoch() == 0
+            c1.write_view_record({"epoch": 3, "members": ["a", "b"],
+                                  "world_size": 2})
+            assert c2.last_committed_epoch() == 3
+            assert [v["epoch"] for v in srv.views()] == [3]
+            # the lease: close c2's socket without a leave RPC
+            c2.close()
+            deadline = 50
+            while deadline and any(
+                r["worker_id"] == "b" for r in c1.read_members()
+            ):
+                deadline -= 1
+                import time
+
+                time.sleep(0.05)
+            assert deadline, "dropped connection's record never reaped"
+            c1.remove_member("a")
+            assert c1.read_members() == []
+            c1.close()
+        finally:
+            srv.close()
+
+    def test_channel_raises_on_dead_server(self):
+        srv = rendezvous.RendezvousServer("127.0.0.1:0")
+        ch = rendezvous.open_channel("tcp://" + srv.addr)
+        ch.write_member({"worker_id": "a", "pid": 1, "bid": 1})
+        srv.close()
+        with pytest.raises(RuntimeError, match="closed|unreachable"):
+            for _ in range(10):  # close() races the in-flight reply
+                ch.read_members()
+        ch.close()
+        with pytest.raises(RuntimeError, match="unreachable"):
+            rendezvous.TcpRendezvousChannel(
+                "tcp://" + srv.addr, timeout_s=0.3
+            )
+
+    def test_open_channel_selects_by_scheme(self, tmp_path):
+        ch = rendezvous.open_channel(str(tmp_path / "rdzv"))
+        assert isinstance(ch, rendezvous.FileRendezvousChannel)
+        assert ch.key() == str(tmp_path / "rdzv")
+
+    @pytest.mark.parametrize("kill_self", [False, True],
+                             ids=["graceful-leave", "sigkill-lease-reap"])
+    def test_membership_over_tcp_shrinks(self, kill_self):
+        """WorldMembership over ``tcp://``: genesis establish at world
+        3, lose one member (cleanly or by SIGKILL — the connection lease
+        makes both visible), survivors commit the shrunken view on a
+        fresh ring and reduce correctly; the server holds the audit
+        trail."""
+        import multiprocessing as mp
+
+        srv = rendezvous.RendezvousServer("127.0.0.1:0")
+        addr = "tcp://" + srv.addr
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        old = os.environ.get("JAX_PLATFORMS")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            procs = [
+                ctx.Process(target=transport_workers.rdzv_worker,
+                            args=(f"w{i}", addr, q, kill_self))
+                for i in range(3)
+            ]
+            for p in procs:
+                p.start()
+        finally:
+            if old is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = old
+        try:
+            msgs = [q.get(timeout=90) for _ in range(5)]
+        finally:
+            for p in procs:
+                p.join(timeout=30)
+                if p.is_alive():
+                    p.terminate()
+        errs = [m for m in msgs if m[1] == "error"]
+        assert not errs, errs
+        v1 = sorted(m for m in msgs if m[1] == "v1")
+        v2 = sorted(m for m in msgs if m[1] == "v2")
+        assert len(v1) == 3 and len(v2) == 2, msgs
+        assert all(m[4] == 6.0 for m in v1), v1  # 1+2+3 over world 3
+        assert all(m[3] == ["w0", "w1"] for m in v2), v2
+        assert all(m[4] == 3.0 for m in v2), v2  # 1+2 over world 2
+        assert v2[0][2] > v1[0][2], (v1, v2)  # epoch advanced
+        views = srv.views()
+        assert [v["world_size"] for v in views] == [3, 2], views
+        srv.close()
+
+
+def _leg_model(transport, beta, *, alpha=0.0, worlds=(2, 3, 4)):
+    fits = {}
+    for op in ("all_reduce", "all_reduce_q8", "broadcast"):
+        for w in worlds:
+            fits[(op, w)] = costmodel.OpFit(
+                op=op, world_size=w, alpha_s=alpha,
+                beta_s_per_byte=beta, r2=1.0, n_samples=4,
+                wire_bytes_min=0, wire_bytes_max=1 << 62,
+            )
+    return costmodel.CostModel(transport, fits)
+
+
+class TestHierarchicalPricing:
+    """hierarchical_allreduce_seconds: hand-computable leg prices."""
+
+    def test_legs_priced_on_their_own_fits(self):
+        # intra: shm at 1 ns/B; inter: tcp at 10 ns/B. payload 1 MB f32.
+        intra = _leg_model("shm", 1e-9)
+        inter = _leg_model("tcp", 10e-9)
+        P = 1 << 20
+        hp = pricing.hierarchical_allreduce_seconds(
+            P, P // 4, [2, 2], intra, inter
+        )
+        # intra reduce leg: reduce-scatter shape of the 2-way allreduce
+        # is priced as the intra model's all_reduce over the domain
+        # world; the exact decomposition is the function's own — pin the
+        # structural facts instead of re-deriving every constant:
+        assert hp.seconds == (hp.intra_reduce_s + hp.inter_exchange_s
+                              + hp.intra_bcast_s)
+        # inter leg: H=2 allreduce at 10 ns/B over 2(H-1)/H x P wire
+        want_inter = 10e-9 * algo_wire_bytes("all_reduce", P, 2)
+        assert abs(hp.inter_exchange_s - want_inter) < 1e-12, hp
+        assert hp.inter_wire_bytes == algo_wire_bytes("all_reduce", P, 2)
+        assert not hp.extrapolated
+        # the slow link dominates: inter leg must be ~10x an intra leg
+        assert hp.inter_exchange_s > 4 * hp.intra_reduce_s, hp
+
+    def test_q8_inter_leg_prices_q8_wire(self):
+        intra = _leg_model("shm", 1e-9)
+        inter = _leg_model("tcp", 10e-9)
+        P, elems = 1 << 20, (1 << 20) // 4
+        full = pricing.hierarchical_allreduce_seconds(
+            P, elems, [2, 2], intra, inter
+        )
+        q8 = pricing.hierarchical_allreduce_seconds(
+            P, elems, [2, 2], intra, inter, q8_inter=True
+        )
+        # q8 moves ~0.26x the f32 bytes over the slow link
+        assert q8.inter_wire_bytes < 0.3 * full.inter_wire_bytes, (
+            q8.inter_wire_bytes, full.inter_wire_bytes
+        )
+        assert q8.inter_exchange_s < full.inter_exchange_s
+        # intra legs identical: quantization only touches the inter leg
+        assert q8.intra_reduce_s == full.intra_reduce_s
+
+    def test_single_domain_has_no_inter_leg(self):
+        intra = _leg_model("shm", 1e-9)
+        inter = _leg_model("tcp", 10e-9)
+        hp = pricing.hierarchical_allreduce_seconds(
+            1 << 20, (1 << 20) // 4, [4], intra, inter
+        )
+        assert hp.inter_exchange_s == 0.0
+        assert hp.inter_wire_bytes == 0
+
+    def test_bad_domains_raise(self):
+        m = _leg_model("shm", 1e-9)
+        with pytest.raises(ValueError):
+            pricing.hierarchical_allreduce_seconds(
+                1024, 256, [], m, m
+            )
+        with pytest.raises(ValueError):
+            pricing.hierarchical_allreduce_seconds(
+                1024, 256, [2, 0], m, m
+            )
+
+
+class TestTransportMismatchRefused:
+    """Satellite 2: a model fit on one transport can never silently
+    price another — every loader raises, not just the planner."""
+
+    def test_load_raises_on_expected_transport_mismatch(self, tmp_path):
+        path = str(tmp_path / "cm.json")
+        _leg_model("tcp", 2e-9).save(path)
+        loaded = costmodel.CostModel.load(path, expected_transport="tcp")
+        assert loaded.transport == "tcp"
+        with pytest.raises(costmodel.CostModelUnavailable,
+                           match="tcp"):
+            costmodel.CostModel.load(path, expected_transport="shm")
+
+    def test_obs_report_refuses_cross_transport_model(self, tmp_path):
+        """obs_report (the one loader that previously skipped the
+        check): a trace whose comm spans ran on tcp vs a model fit on
+        shm must RAISE, not print a confidently-wrong pred column."""
+        sys.path.insert(0, SCRIPTS)
+        try:
+            import obs_report
+        finally:
+            sys.path.pop(0)
+        trace = str(tmp_path / "trace.json")
+        span = {
+            "ph": "X", "name": "comm.all_reduce", "ts": 0, "dur": 1000,
+            "pid": 0, "tid": 1,
+            "args": {"transport": "tcp", "payload_bytes": 4096,
+                     "wire_bytes": 4096, "world": 2},
+        }
+        ctr = {"ph": "C", "name": "comm.bytes.tcp", "ts": 900, "pid": 0,
+               "args": {"value": 4096}}
+        json.dump({"traceEvents": [span, ctr]}, open(trace, "w"))
+        shm_model = str(tmp_path / "cm_shm.json")
+        _leg_model("shm", 1e-9).save(shm_model)
+        with pytest.raises(costmodel.CostModelUnavailable,
+                           match="refit per transport"):
+            obs_report.report(trace, [], out=io.StringIO(),
+                              costmodel_path=shm_model)
+        # the matching fit renders, with the Cross-host bytes line
+        tcp_model = str(tmp_path / "cm_tcp.json")
+        _leg_model("tcp", 1e-9).save(tcp_model)
+        buf = io.StringIO()
+        obs_report.report(trace, [], out=buf, costmodel_path=tcp_model)
+        text = buf.getvalue()
+        assert "Cross-host bytes: 0.00 MB over tcp" in text, text
+        assert "transport=tcp" in text, text
+
+    def test_obs_report_accepts_hostring_alias_for_shm(self, tmp_path):
+        """Facade-sweep models label the native shm ring "hostring";
+        the ring's own spans say "shm" — same physical transport, so
+        the mismatch check must NOT fire across the alias."""
+        sys.path.insert(0, SCRIPTS)
+        try:
+            import obs_report
+        finally:
+            sys.path.pop(0)
+        trace = str(tmp_path / "trace.json")
+        span = {
+            "ph": "X", "name": "comm.all_reduce", "ts": 0, "dur": 1000,
+            "pid": 0, "tid": 1,
+            "args": {"transport": "shm", "payload_bytes": 4096,
+                     "wire_bytes": 4096, "world": 2},
+        }
+        json.dump({"traceEvents": [span]}, open(trace, "w"))
+        model = str(tmp_path / "cm.json")
+        _leg_model("hostring", 1e-9).save(model)
+        buf = io.StringIO()
+        obs_report.report(trace, [], out=buf, costmodel_path=model)
+        assert "transport=hostring" in buf.getvalue()
+
+
+@pytest.mark.slow
+def test_collective_bench_tcp_sweep_fits_tcp_model(tmp_path):
+    """``collective_bench.py --transport tcp`` runs a raw 2-proc socket
+    mesh (no jax in the workers) and writes a model whose transport tag
+    then refuses an shm-expecting load — the per-transport fit flow the
+    planner consumes."""
+    out = str(tmp_path / "cm_tcp.json")
+    metrics = str(tmp_path / "metrics.jsonl")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "collective_bench.py"),
+         "--transport", "tcp", "--world", "2", "--sizes", "0.5", "2",
+         "--iters", "3", "--fit", out, "--metrics-path", metrics],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    m = costmodel.CostModel.load(out, expected_transport="tcp")
+    assert m.transport == "tcp"
+    assert ("all_reduce", 2) in m.fits
+    with pytest.raises(costmodel.CostModelUnavailable):
+        costmodel.CostModel.load(out, expected_transport="shm")
+    recs = [json.loads(l) for l in open(metrics)]
+    assert all(r["transport"] == "tcp" for r in recs), recs[:2]

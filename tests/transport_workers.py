@@ -1,0 +1,382 @@
+"""Spawn targets for the multi-host transport tests (r16).
+
+Same contract as ``hostring_workers``: importable by ``multiprocessing``
+spawn, every worker reports ``(rank, "ok")`` or ``(rank, traceback)``
+through the queue, and the raw workers stay JAX-free — they exercise
+``runtime/transport.py`` and ``runtime/hierarchy.py`` exactly the way a
+spawned bench rank does. TCP listeners bind a parent-chosen free port
+(passed as ``addr``) so two tests can't collide.
+"""
+
+import os
+import socket
+import sys
+import traceback
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def free_addr() -> str:
+    """A ``host:port`` the next listener can bind: bound-then-released,
+    the standard test-port idiom (the tiny reuse race is acceptable in a
+    test harness; the transport would fail loudly, not wrongly)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    addr = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    return addr
+
+
+def _fail(q, rank, e):
+    q.put((rank, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
+
+
+def parity_worker(rank: int, world: int, name: str, q, addr: str) -> None:
+    """THE transport parity matrix: every collective the shm ring
+    offers, run over a ``TcpTransport``-backed group side by side with
+    the native shm group on identical inputs — bit-identical results
+    demanded for every (op, dtype) cell, q8 included (both transports
+    fold through the one compiled ``hr_q8_dequant_add`` kernel, so this
+    equality is by construction, and this worker keeps it checked)."""
+    try:
+        import ml_dtypes
+
+        from pytorch_distributed_tpu.runtime.hostring import (
+            HostRingGroup,
+            algo_wire_bytes,
+        )
+        from pytorch_distributed_tpu.runtime.transport import TcpTransport
+
+        rng = np.random.default_rng(1234 + rank)
+        tcp = TcpTransport(name + "_t", rank, world, addr, slot_bytes=4096)
+        with HostRingGroup(name, rank, world, slot_bytes=4096) as shm_g, \
+                HostRingGroup(name + "_t", rank, world,
+                              transport=tcp) as tcp_g:
+            for op in ("sum", "avg", "prod", "max", "min"):
+                for dt in (np.float32, np.float64):
+                    x = rng.standard_normal(5000).astype(dt)
+                    a = shm_g.all_reduce(x, op=op)
+                    b = tcp_g.all_reduce(x, op=op)
+                    assert a.tobytes() == b.tobytes(), (op, dt)
+            xi = rng.integers(-100, 100, 3000).astype(np.int64)
+            for op in ("sum", "max", "min", "avg"):
+                a = shm_g.all_reduce(xi, op=op)
+                b = tcp_g.all_reduce(xi, op=op)
+                assert a.tobytes() == b.tobytes(), ("int64", op)
+            # half types promote to f32 wire + round back — both paths
+            for dt in (np.float16, ml_dtypes.bfloat16):
+                xh = rng.standard_normal(4097).astype(dt)  # > 1 slot
+                for op in ("sum", "avg"):
+                    a = shm_g.all_reduce(xh, op=op)
+                    b = tcp_g.all_reduce(xh, op=op)
+                    assert a.tobytes() == b.tobytes(), ("half", dt, op)
+            xq = (rng.standard_normal(7000) * 10).astype(np.float32)
+            for op in ("sum", "avg"):
+                a = shm_g.all_reduce_q8(xq, op=op)
+                b = tcp_g.all_reduce_q8(xq, op=op)
+                assert a.tobytes() == b.tobytes(), ("q8", op)
+            xg = rng.standard_normal(333).astype(np.float32)
+            assert (shm_g.all_gather(xg).tobytes()
+                    == tcp_g.all_gather(xg).tobytes())
+            xr = rng.standard_normal((world, 17)).astype(np.float32)
+            assert (shm_g.reduce_scatter(xr).tobytes()
+                    == tcp_g.reduce_scatter(xr).tobytes())
+            assert (shm_g.broadcast(xg, src=1).tobytes()
+                    == tcp_g.broadcast(xg, src=1).tobytes())
+            if rank == 0:
+                shm_g.send(xg, 2)
+                tcp_g.send(xg * 2, 2)
+            elif rank == 2:
+                r1 = shm_g.recv(np.empty_like(xg), 0)
+                r2 = tcp_g.recv(np.empty_like(xg), 0)
+                assert (r1 * 2).tobytes() == r2.tobytes(), "p2p"
+            shm_g.barrier()
+            tcp_g.barrier()
+            # the wire accounting the bench's exactness pin rests on:
+            # data bytes only (the barriers above moved control tokens),
+            # equal to the analytic formula on the shapes where equality
+            # is promised — elems divisible by world, payload within one
+            # slot (multi-chunk indivisible shapes split on chunk
+            # boundaries and drift from the floored formula by a few
+            # elements per chunk; the bench pins only divisible shapes)
+            before = tcp.bytes_sent
+            n = 256 * world  # one slot, divides evenly
+            tcp_g.all_reduce(np.ones(n, np.float32), inplace=True)
+            moved = tcp.bytes_sent - before
+            want = algo_wire_bytes("all_reduce", n * 4, world)
+            assert moved == want, (moved, want)
+        q.put((rank, "ok"))
+    except Exception as e:
+        _fail(q, rank, e)
+
+
+def hier_worker(rank: int, world: int, name: str, q, addr: str) -> None:
+    """2x2 hierarchical group vs the flat ring: tcp-inter and shm-inter
+    builds bit-identical to each other; hier == flat bitwise on
+    integer-valued payloads (the one regime where regrouping float
+    additions is exact); q8 inter leg bounded + cross-rank identical;
+    inter-link byte counter exactly the H-way allreduce formula on
+    leaders and zero elsewhere."""
+    try:
+        from pytorch_distributed_tpu.runtime.hierarchy import (
+            build_hierarchical_group,
+        )
+        from pytorch_distributed_tpu.runtime.hostring import (
+            HostRingGroup,
+            algo_wire_bytes,
+        )
+
+        domains = [(0, 1), (2, 3)]
+        flat = HostRingGroup(name + "_f", rank, world, slot_bytes=4096)
+        hier_tcp = build_hierarchical_group(
+            name + "_ht", rank, domains, inter_addr=addr, slot_bytes=4096
+        )
+        hier_shm = build_hierarchical_group(
+            name + "_hs", rank, domains, slot_bytes=4096
+        )
+        with flat, hier_tcp, hier_shm:
+            x = np.random.default_rng(100 + rank).standard_normal(
+                5000
+            ).astype(np.float32)
+            ht = hier_tcp.all_reduce(x, op="avg")
+            hs = hier_shm.all_reduce(x, op="avg")
+            assert ht.tobytes() == hs.tobytes(), "tcp-inter != shm-inter"
+            assert (hier_tcp.all_reduce(x, op="avg").tobytes()
+                    == ht.tobytes()), "nondeterministic"
+            rows = flat.all_gather(ht)
+            assert all(rows[r].tobytes() == rows[0].tobytes()
+                       for r in range(world)), "cross-rank divergence"
+            for op in ("prod", "max", "min"):
+                assert (hier_tcp.all_reduce(x, op=op).tobytes()
+                        == hier_shm.all_reduce(x, op=op).tobytes()), op
+            # integer-valued f32: regrouping is exact -> hier == flat
+            xi = np.random.default_rng(200 + rank).integers(
+                -1000, 1000, 4096
+            ).astype(np.float32)
+            assert (flat.all_reduce(xi).tobytes()
+                    == hier_tcp.all_reduce(xi).tobytes()), "hier != flat"
+            # q8 inter leg: deterministic, cross-rank identical, error
+            # bounded vs the exact flat avg
+            xq = np.random.default_rng(300 + rank).standard_normal(
+                3000
+            ).astype(np.float32)
+            q1 = hier_tcp.all_reduce_q8(xq, op="avg")
+            q2 = hier_shm.all_reduce_q8(xq, op="avg")
+            assert q1.tobytes() == q2.tobytes(), "q8 inter tcp != shm"
+            exact = flat.all_reduce(xq, op="avg")
+            err = float(np.max(np.abs(q1 - exact)))
+            assert err < 0.05, f"q8 error {err}"
+            rows = flat.all_gather(q1)
+            assert all(rows[r].tobytes() == rows[0].tobytes()
+                       for r in range(world)), "q8 cross-rank"
+            assert (hier_tcp.all_gather(x).tobytes()
+                    == flat.all_gather(x).tobytes()), "all_gather"
+            assert (hier_tcp.broadcast(x, src=3).tobytes()
+                    == flat.broadcast(x, src=3).tobytes()), "broadcast"
+            xr = np.random.default_rng(400 + rank).integers(
+                -50, 50, (world, 33)
+            ).astype(np.float32)
+            assert (hier_tcp.reduce_scatter(xr).tobytes()
+                    == flat.reduce_scatter(xr).tobytes()), "reduce_scatter"
+            hier_tcp.barrier()
+            before = hier_tcp.inter_bytes_sent
+            n = 65536
+            hier_tcp.all_reduce(np.ones(n, np.float32), inplace=True)
+            moved = hier_tcp.inter_bytes_sent - before
+            want = (algo_wire_bytes("all_reduce", n * 4, len(domains))
+                    if hier_tcp.is_leader else 0)
+            assert moved == want, (moved, want)
+        q.put((rank, "ok"))
+    except Exception as e:
+        _fail(q, rank, e)
+
+
+def link_lost_worker(rank: int, world: int, name: str, q,
+                     addr: str) -> None:
+    """The chaos contract for a severed inter-host link: rank 2 (a
+    domain leader) arms ``transport.link_lost`` and dies at its first
+    TCP exchange, the opposite leader sees the EOF cascade within one
+    exchange, non-leaders hit their intra-ring deadline — everyone fails
+    LOUDLY — and the survivors then re-mesh onto a fresh ring with
+    re-numbered ranks (the r13 elastic recovery shape) and complete a
+    collective bit-exactly."""
+    import time
+
+    try:
+        from pytorch_distributed_tpu.runtime import faults
+        from pytorch_distributed_tpu.runtime.hierarchy import (
+            build_hierarchical_group,
+        )
+        from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
+
+        domains = [(0, 1), (2, 3)]
+        g = build_hierarchical_group(
+            name, rank, domains, inter_addr=addr, slot_bytes=4096,
+            timeout_s=6.0,
+        )
+        x = np.ones(2048, np.float32) * (rank + 1)
+        err = None
+        try:
+            if rank == 2:
+                with faults.injected(
+                    "transport.link_lost:mode=raise,count=1"
+                ):
+                    g.all_reduce(x)
+            else:
+                g.all_reduce(x)
+        except (faults.InjectedFault, RuntimeError) as e:
+            err = f"{type(e).__name__}: {e}"
+        assert err is not None, "severed link did not fail loudly"
+        # EVERY rank's group is now poisoned (the leaders by the TCP
+        # EOF cascade, non-leaders by their intra deadline): the next
+        # call must refuse INSTANTLY with the re-mesh pointer, not
+        # wander back into the rings and hang
+        t0 = time.monotonic()
+        try:
+            g.all_reduce(x)
+            raise AssertionError("poisoned group accepted work")
+        except RuntimeError as e:
+            assert "poisoned" in str(e), e
+        assert time.monotonic() - t0 < 1.0, "poison guard not instant"
+        g.close()
+        if rank == 2:  # the victim leaves the world
+            q.put((rank, "ok"))
+            return
+        # survivors re-mesh: fresh ring name, ranks renumbered — exactly
+        # what the elastic membership path does after a view commit
+        new_rank = {0: 0, 1: 1, 3: 2}[rank]
+        with HostRingGroup(name + "_v2", new_rank, 3,
+                           slot_bytes=4096, timeout_s=30.0) as g2:
+            # all three survivors reach this collective — the rank-2
+            # early return above is the DEPARTED member, not a branch
+            # ptdlint: disable=PTD001
+            out = g2.all_reduce(np.ones(64, np.float32))
+            assert float(out[0]) == 3.0, out[0]
+        q.put((rank, "ok"))
+    except Exception as e:
+        _fail(q, rank, e)
+
+
+def gradsync_tcp_worker(rank: int, world: int, name: str, q,
+                        addr: str) -> None:
+    """Verify-don't-fork: ``GradSyncEngine`` bound to a TCP-backed
+    ``HostRingGroup`` produces bit-identical reduced grads to the same
+    engine on the native shm ring — the overlap pipeline has no
+    transport-specific branch, it routes through whatever group it is
+    handed. JAX-free: ``reduce_shipped`` is the engine's numpy-level
+    entry, the same one the jit callback feeds."""
+    try:
+        from pytorch_distributed_tpu.parallel.overlap import GradSyncEngine
+        from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
+        from pytorch_distributed_tpu.runtime.transport import TcpTransport
+
+        rng = np.random.default_rng(3 + rank)
+        grads = [
+            (rng.normal(size=(11 + i,)) * 2).astype(np.float32)
+            for i in range(4)
+        ]
+        grads.append((rng.normal(size=(6000,)) * 2).astype(np.float32))
+        qf = [False] * len(grads)
+        tcp = TcpTransport(name + "_t", rank, world, addr, slot_bytes=4096)
+        with HostRingGroup(name, rank, world, slot_bytes=4096) as shm_g, \
+                HostRingGroup(name + "_t", rank, world,
+                              transport=tcp) as tcp_g:
+            e1 = GradSyncEngine(shm_g)
+            e2 = GradSyncEngine(tcp_g)
+            try:
+                out1, _ = e1.reduce_shipped([a.copy() for a in grads], qf)
+                out2, _ = e2.reduce_shipped([a.copy() for a in grads], qf)
+                for a, b in zip(out1, out2):
+                    assert a.tobytes() == b.tobytes(), "engine forked"
+            finally:
+                e1.close()
+                e2.close()
+        q.put((rank, "ok"))
+    except Exception as e:
+        _fail(q, rank, e)
+
+
+def mismatch_worker(rank: int, world: int, name: str, q,
+                    addr: str) -> None:
+    """A TCP joiner whose parameters disagree with the mesh must be
+    REJECTED at the handshake — the socket-mesh analogue of hr_init's
+    segment-header validation."""
+    try:
+        from pytorch_distributed_tpu.runtime.transport import TcpTransport
+
+        slot = 4096 if rank == 0 else 8192
+        try:
+            t = TcpTransport(name, rank, world, addr, slot_bytes=slot,
+                             timeout_s=30.0)
+            t.close()
+            raise AssertionError("mismatched slot_bytes accepted")
+        except RuntimeError as e:
+            assert "slot_bytes" in str(e) or "mismatch" in str(e), e
+        q.put((rank, "ok"))
+    except Exception as e:
+        _fail(q, rank, e)
+
+
+def traced_tcp_worker(rank: int, world: int, name: str, q, addr: str,
+                      trace_dir: str) -> None:
+    """Armed tracing over a TCP-backed group: comm spans must carry
+    ``transport="tcp"`` and the cumulative ``comm.bytes.tcp`` counter
+    must track the transport's exact ``bytes_sent``."""
+    try:
+        from pytorch_distributed_tpu.runtime import tracing
+        from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
+        from pytorch_distributed_tpu.runtime.transport import TcpTransport
+
+        tracer = tracing.configure(trace_dir)
+        tcp = TcpTransport(name, rank, world, addr, slot_bytes=4096)
+        with HostRingGroup(name, rank, world, transport=tcp) as g:
+            for _ in range(3):
+                g.all_reduce(np.ones(4096, np.float32))
+            moved = tcp.bytes_sent
+        fname = "trace.json" if rank == 0 else f"trace-rank{rank}.json"
+        tracer.export(os.path.join(trace_dir, fname))
+        tracing.clear()
+        q.put((rank, {"bytes_sent": moved}))
+    except Exception as e:
+        _fail(q, rank, e)
+
+
+def rdzv_worker(wid: str, addr: str, q, kill_self: bool) -> None:
+    """One elastic member over a ``tcp://`` rendezvous channel: genesis
+    establish at world 3, then either die (SIGKILL — the server's
+    connection lease reaps the record) or leave gracefully, and the
+    survivors commit the shrunken view and reduce on its fresh ring."""
+    import signal
+    import time
+
+    try:
+        from pytorch_distributed_tpu.runtime.membership import (
+            WorldMembership,
+        )
+
+        m = WorldMembership(addr, worker_id=wid, ring_timeout_s=5.0,
+                            rendezvous_timeout_s=60.0)
+        view, ring = m.establish(world_size=3)
+        a = np.ones(16, np.float32) * (view.rank + 1)
+        a = ring.all_reduce(a)
+        q.put((wid, "v1", view.epoch, list(view.members), float(a[0])))
+        if wid == "w2":
+            if kill_self:
+                time.sleep(1.0)  # let the queue feeder flush first
+                os.kill(os.getpid(), signal.SIGKILL)
+            m.leave()
+            return
+        deadline = time.monotonic() + 30.0
+        while not m.poll_change():
+            if time.monotonic() > deadline:
+                raise RuntimeError("poll_change never fired")
+            time.sleep(0.05)
+        view, ring = m.next_view()
+        a = np.ones(16, np.float32) * (view.rank + 1)
+        a = ring.all_reduce(a)
+        q.put((wid, "v2", view.epoch, list(view.members), float(a[0])))
+        m.leave()
+    except Exception as e:
+        q.put((wid, "error", f"{type(e).__name__}: {e}", [], 0.0))
