@@ -1675,6 +1675,206 @@ def bench_hetero() -> None:
         )
 
 
+def bench_ckpt_shard() -> None:
+    """Sharded checkpoints: bytes-per-rank scaling + the torn-save drill.
+
+    Part A prices the r17 sharded save against the full gather-to-rank-0
+    baseline on the same synthetic state: at replication=1 every rank
+    must write <= 1.2x its fair share (full_bytes / world — the
+    acceptance pin; the slack covers per-rank manifests, the replicated
+    elastic_cursor, and integer leaf apportionment), and at the default
+    replication=2 the same bound scaled by the replication factor (two
+    copies of every leaf IS 2x the bytes — that redundancy is the
+    feature, priced honestly, not hidden). Restore correctness is
+    enforced in-phase: the sharded dir and the full dir must both load
+    back CRC-identical to the source state, so the byte savings can
+    never come from dropped data. Walls are emitted, not pinned: all
+    "ranks" of Part A run serially in one process on this 1-core box,
+    so bytes — not seconds — are the claim that transfers.
+
+    Part B runs the ``ckpt_shard`` chaos drill (one rank killed between
+    its shard files and its per-rank COMMIT): the torn epoch must read
+    as absent, the restarted world must restore the newest
+    world-COMPLETE epoch, and the final params must land bit-identical
+    to an uninterrupted reference. The drill's own verdict is the pin.
+    """
+    import shutil
+    import subprocess
+    import tempfile
+
+    from pytorch_distributed_tpu.train import ckpt_io
+    from pytorch_distributed_tpu.train.elastic_world import (
+        leaf_owners,
+        params_crc,
+    )
+
+    world = 3
+    rng = np.random.default_rng(0)
+    names = [f"leaf_{i:02d}" for i in range(12)]
+    leaves = {
+        n: rng.standard_normal((128, 256)).astype(np.float32)
+        for n in names
+    }  # 12 x 128KiB = 1.5 MiB of state; per-rank overhead is ~KB
+    leaves["elastic_cursor"] = np.array([0, 0, 0, 7, 0], np.int64)
+    src_crc = params_crc(leaves)
+
+    def dir_bytes(d):
+        return sum(
+            os.path.getsize(os.path.join(r, f))
+            for r, _, fs in os.walk(d) for f in fs
+        )
+
+    base = tempfile.mkdtemp(prefix="bench_ckpt_shard_")
+    try:
+        # -- full baseline -------------------------------------------------
+        full_dir = os.path.join(base, "full")
+        t0 = time.perf_counter()
+        ckpt_io.save_single_checkpoint(full_dir, leaves, 7)
+        full_wall = time.perf_counter() - t0
+        full_final = os.path.join(full_dir, "latest")
+        full_bytes = dir_bytes(full_final)
+        full_manifest_bytes = os.path.getsize(
+            os.path.join(full_final, ckpt_io._MANIFEST)
+        )
+        if params_crc(ckpt_io.load_checkpoint(full_final).leaves) != src_crc:
+            raise RuntimeError("full-format restore diverged from source")
+
+        # -- sharded at replication 1 and 2 --------------------------------
+        stats = {}
+        for repl in (1, 2):
+            sh_dir = os.path.join(base, f"sharded_r{repl}")
+            tmp = os.path.join(sh_dir, "step-7") + ".tmp"
+            os.makedirs(tmp)
+            rank_bytes, rank_walls = [], []
+            for rank in range(world):
+                owned = {
+                    f"{n}": leaves[n]
+                    for i, n in enumerate(names)
+                    if rank in leaf_owners(i, world, repl)
+                }
+                owned["elastic_cursor"] = leaves["elastic_cursor"]
+                t0 = time.perf_counter()
+                ckpt_io.save_rank_shards(
+                    tmp, rank, owned, 7, world=world, replication=repl
+                )
+                rank_walls.append(time.perf_counter() - t0)
+                rank_bytes.append(
+                    dir_bytes(os.path.join(tmp, f"rank-{rank}"))
+                )
+            ckpt_io.write_world_commit(
+                tmp, step=7, world=world, replication=repl,
+                expected_leaves=names + ["elastic_cursor"],
+            )
+            ckpt_io._swing(sh_dir, "step-7", tmp)
+            final = os.path.join(sh_dir, "step-7")
+            loaded = ckpt_io.load_checkpoint(final)
+            if params_crc(loaded.leaves) != src_crc or not loaded.sharded:
+                raise RuntimeError(
+                    f"sharded restore (replication={repl}) diverged "
+                    f"from source"
+                )
+            rank_manifest_bytes = max(
+                os.path.getsize(
+                    os.path.join(final, f"rank-{r}", ckpt_io._MANIFEST)
+                )
+                for r in range(world)
+            )
+            stats[repl] = {
+                "ratio": max(rank_bytes) / (full_bytes / world),
+                "rank_bytes": rank_bytes,
+                "max_rank_wall_s": max(rank_walls),
+                "manifest_shrink": (
+                    full_manifest_bytes / rank_manifest_bytes
+                ),
+            }
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+    ratio1, ratio2 = stats[1]["ratio"], stats[2]["ratio"]
+    _emit({
+        "metric": "ckpt_shard_rank_bytes_ratio",
+        "value": round(ratio1, 4),
+        "unit": (
+            f"max per-rank bytes / (full_bytes / world), world={world}, "
+            "replication=1; <= 1.2 is the acceptance pin. replication=2 "
+            "carries two copies of every leaf, so its bound is 1.2 x 2"
+        ),
+        "vs_baseline": None,
+        "replication2_ratio": round(ratio2, 4),
+        "full_bytes": full_bytes,
+        "rank_bytes_r1": stats[1]["rank_bytes"],
+        "rank_bytes_r2": stats[2]["rank_bytes"],
+        "manifest_shrink_r1": round(stats[1]["manifest_shrink"], 2),
+        "full_save_wall_s": round(full_wall, 4),
+        "max_rank_save_wall_s_r1": round(
+            stats[1]["max_rank_wall_s"], 4
+        ),
+    })
+    print(
+        f"# ckpt_shard: bytes/rank ratio {ratio1:.3f}x (r1) "
+        f"{ratio2:.3f}x (r2) vs fair share; manifest shrink "
+        f"{stats[1]['manifest_shrink']:.1f}x", file=sys.stderr,
+    )
+    if ratio1 > 1.2:
+        raise RuntimeError(
+            f"replication=1 rank bytes ratio {ratio1:.3f} > 1.2"
+        )
+    if ratio2 > 1.2 * 2:
+        raise RuntimeError(
+            f"replication=2 rank bytes ratio {ratio2:.3f} > 2.4"
+        )
+    if stats[1]["manifest_shrink"] < 2:
+        raise RuntimeError(
+            "per-rank manifests did not shrink >= 2x vs the full "
+            f"manifest: {stats[1]['manifest_shrink']:.2f}x"
+        )
+
+    # -- Part B: the mid-distributed-save kill drill -----------------------
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "scripts", "chaos_drill.py"),
+            "--drill", "ckpt_shard", "--total-steps", "15",
+        ],
+        capture_output=True, text=True, timeout=300,
+    )
+    drill_wall = time.perf_counter() - t0
+    verdict = None
+    for line in proc.stdout.splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("drill") == "ckpt_shard":
+            verdict = rec
+    if proc.returncode != 0 or verdict is None or not verdict["passed"]:
+        raise RuntimeError(
+            f"ckpt_shard drill failed (rc={proc.returncode}): "
+            f"{verdict}\n{proc.stderr[-2000:]}"
+        )
+    _emit({
+        "metric": "ckpt_shard_drill_wall_s",
+        "value": round(drill_wall, 2),
+        "unit": (
+            "mid-distributed-save kill drill: torn epoch absent, "
+            "restart restores newest world-COMPLETE epoch, final params "
+            "bit-identical to the uninterrupted reference"
+        ),
+        "vs_baseline": None,
+        "torn_reads_absent": verdict["torn_reads_absent"],
+        "newest_complete_step": verdict["newest_complete_step"],
+        "bit_exact_vs_reference": verdict["bit_exact_vs_reference"],
+        "passed": verdict["passed"],
+    })
+    print(
+        f"# ckpt_shard: drill passed in {drill_wall:.1f}s (torn epoch "
+        f"absent, restored step {verdict['newest_complete_step']})",
+        file=sys.stderr,
+    )
+
+
 def _multihost_worker(rank, world, name, q, mode, addr, elems, iters):
     """One rank of the multihost phase: ``mode`` picks hierarchical
     (two shm domains, TCP between the leaders) or flat-over-TCP; both
@@ -2692,6 +2892,7 @@ def main():
         # so is balanced-vs-even on a throttled world: a relative ratio
         # with three-way bit-identity enforced in-phase (r15)
         run_if_budget("hetero", bench_hetero)
+        run_if_budget("ckpt_shard", bench_ckpt_shard)
         # hierarchical-vs-flat over a throttled TCP leg: relative ratio
         # plus EXACT slow-link byte accounting, bit-identity in-phase
         run_if_budget("multihost", bench_multihost)
@@ -2721,6 +2922,7 @@ def main():
         run_if_budget("planning", bench_planning)
         run_if_budget("elastic", bench_elastic)
         run_if_budget("hetero", bench_hetero)
+        run_if_budget("ckpt_shard", bench_ckpt_shard)
         run_if_budget("multihost", bench_multihost)
     # the per-phase wall clocks as DATA (the stderr "# phase ... done"
     # notes were print-only): one record the driver's BENCH tail and

@@ -58,6 +58,25 @@ Known sites (grep for ``faults.check`` to find the exact spots):
 ``ckpt.swing``       inside the atomic-rename window of ``_swing``
                      (between ``final -> old`` and ``tmp -> final``)
 ``ckpt.read_shard``  before each shard ``np.load`` on restore
+``ckpt.rank_commit`` in the distributed sharded save
+                     (``train/ckpt_io.save_rank_shards``), after a
+                     rank's shard files are down but BEFORE its
+                     per-rank COMMIT lands — ``mode=kill`` is the
+                     mid-distributed-save crash: the rank dir stays
+                     commit-less, the world COMMIT is never written,
+                     and by the two-phase rule the whole save reads as
+                     absent (``match=rank-<r>`` picks the victim dir)
+``ckpt.world_commit`` after every per-rank COMMIT has been verified
+                     but BEFORE the world COMMIT marker is written
+                     (``train/ckpt_io.write_world_commit``) — a kill
+                     here strands a quorum-complete set of rank dirs
+                     with no super-manifest; recovery must garbage-
+                     collect it, never promote it
+``ckpt.peer_fetch``  before the sharded loader falls back to a
+                     replication peer's copy of a leaf whose primary
+                     copy failed verification — ``mode=raise`` makes
+                     the peer copy unreadable too (the both-copies-
+                     lost case: restore walks back an epoch)
 ``data.fetch``       before opening a sample file (transient I/O; the
                      ingest retry path treats it as retryable)
 ``data.decode``      after open, before decode (permanent rot; the
@@ -145,6 +164,9 @@ KNOWN_SITES = (
     "ckpt.write_shard",
     "ckpt.swing",
     "ckpt.read_shard",
+    "ckpt.rank_commit",
+    "ckpt.world_commit",
+    "ckpt.peer_fetch",
     "data.fetch",
     "data.decode",
     "step.nan",

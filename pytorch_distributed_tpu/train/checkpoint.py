@@ -56,24 +56,49 @@ import numpy as np
 
 from pytorch_distributed_tpu.runtime import faults
 from pytorch_distributed_tpu.train.train_state import TrainState
+
+# The jax-free checkpoint machinery lives in train/ckpt_io.py (manifests,
+# COMMIT/WORLD_COMMIT markers, verification, candidate ranking, stranded-
+# write recovery, pruning, shard assembly, the sharded per-rank loaders).
+# Everything is re-exported here so `from train.checkpoint import ...`
+# keeps working for every caller that predates the split.
+from pytorch_distributed_tpu.train.ckpt_io import (  # noqa: F401
+    _COMMIT,
+    _MANIFEST,
+    _WORLD_COMMIT,
+    CheckpointCorrupted,
+    LoadedCheckpoint,
+    _assemble,
+    _entry_shards,
+    _load_shard,
+    _read_commit,
+    _read_manifest,
+    _read_world_commit,
+    _swing,
+    checkpoint_exists,
+    checkpoint_step,
+    is_sharded_checkpoint,
+    load_best_checkpoint,
+    load_checkpoint,
+    prune_checkpoints,
+    recover_stranded_checkpoints,
+    resolve_tag,
+    restore_candidates,
+    save_rank_shards,
+    save_single_checkpoint,
+    step_tags,
+    verify_checkpoint,
+    write_world_commit,
+)
 from pytorch_distributed_tpu.utils.integrity import (
     PREFERRED_ALGO,
-    algo_supported,
     checksum_file,
 )
 from pytorch_distributed_tpu.utils.logging import get_logger
 
-_MANIFEST = "manifest.json"
-_COMMIT = "COMMIT"  # written last: its presence means the dir is complete
 _IO_THREADS = 8
 
 logger = get_logger(__name__)
-
-
-class CheckpointCorrupted(RuntimeError):
-    """Checkpoints exist on disk but none survived integrity checks —
-    resuming fresh would silently discard (and eventually overwrite) the
-    run's only remaining state."""
 
 
 def _leaf_files(tree) -> list:
@@ -247,23 +272,6 @@ def _save_sync(ckpt_dir: str, tag: str, snap: list, step: int) -> str:
     return final
 
 
-def _swing(ckpt_dir: str, tag: str, tmp: str) -> str:
-    """Atomically replace ckpt_dir/tag with the fully-written tmp dir."""
-    final = os.path.join(ckpt_dir, tag)
-    old = final + ".old"
-    if os.path.exists(old):
-        shutil.rmtree(old)
-    if os.path.exists(final):
-        os.replace(final, old)
-    # the crash window: a kill here leaves no <tag>, only <tag>.old (and
-    # the complete <tag>.tmp) — recover_stranded_checkpoints undoes it
-    faults.check("ckpt.swing", path=final)
-    os.replace(tmp, final)
-    if os.path.exists(old):
-        shutil.rmtree(old)
-    return final
-
-
 def save_checkpoint(ckpt_dir: str, state: TrainState, *, tag: str = "latest") -> str:
     """Write ``state`` under ``ckpt_dir/tag`` atomically; returns the path.
 
@@ -273,54 +281,6 @@ def save_checkpoint(ckpt_dir: str, state: TrainState, *, tag: str = "latest") ->
     hostring path; the Trainer does this).
     """
     return _save_sync(ckpt_dir, tag, _snapshot(state), _host_int(state.step))
-
-
-def step_tags(ckpt_dir: str) -> List[int]:
-    """Sorted step numbers of the ``step-<N>`` checkpoints present."""
-    if not os.path.isdir(ckpt_dir):
-        return []
-    out = []
-    for name in os.listdir(ckpt_dir):
-        if name.startswith("step-") and not name.endswith(".old"):
-            try:
-                out.append(int(name[len("step-"):]))
-            except ValueError:
-                continue
-    return sorted(out)
-
-
-def prune_checkpoints(ckpt_dir: str, *, keep: int) -> List[str]:
-    """Delete the oldest ``step-<N>`` checkpoints beyond ``keep``.
-
-    Only step-tagged directories participate; ``latest``/``best``/custom
-    tags are never pruned. Returns the removed paths. Multi-host: call on
-    process 0 only (the commit owner). ``keep=0`` is allowed for the
-    prune-before-save pattern (the imminent save provides the survivor).
-    """
-    if keep < 0:
-        raise ValueError(f"keep must be >= 0, got {keep}")
-    steps = step_tags(ckpt_dir)
-    removed = []
-    for step in (steps if keep == 0 else steps[:-keep]):
-        path = os.path.join(ckpt_dir, f"step-{step}")
-        shutil.rmtree(path, ignore_errors=True)
-        removed.append(path)
-    # orphaned partial writes: a kill mid-save leaves step-<N>.tmp, and a
-    # step tag is never saved twice, so nothing else ever cleans them —
-    # they would accumulate full-size dirs across preempted restarts.
-    # Only LIVE tags' tmps are spared (their own next save owns them).
-    live = {f"step-{s}" for s in step_tags(ckpt_dir)}
-    if os.path.isdir(ckpt_dir):
-        for name in os.listdir(ckpt_dir):
-            if (
-                name.startswith("step-")
-                and name.endswith(".tmp")
-                and name[: -len(".tmp")] not in live
-            ):
-                path = os.path.join(ckpt_dir, name)
-                shutil.rmtree(path, ignore_errors=True)
-                removed.append(path)
-    return removed
 
 
 _SAMPLER_CURSOR = "sampler_cursor.json"
@@ -361,29 +321,6 @@ def load_sampler_cursor(ckpt_dir: str) -> Optional[dict]:
         }
     except (OSError, ValueError, TypeError, KeyError):
         return None
-
-
-def resolve_tag(ckpt_dir: str, tag: str = "latest") -> Optional[str]:
-    """The tag to restore. An explicitly-requested absent tag resolves to
-    None — silently substituting a different checkpoint for a named
-    request would hand back the wrong weights. The DEFAULT ``latest``
-    resolves to whichever checkpoint is NEWEST by step: a hard kill can
-    leave a stale ``latest`` (written at the last epoch boundary) beside
-    newer mid-epoch ``step-<N>`` tags, and resuming the stale one would
-    silently redo up to an epoch of training. A candidate whose manifest
-    is corrupt/truncated reads as absent (``checkpoint_step`` is None)
-    on BOTH paths — never hand back a tag that cannot be restored."""
-    if tag != "latest":
-        return tag if checkpoint_step(ckpt_dir, tag) is not None else None
-    best_tag = None
-    best_step = -1
-    candidates = ["latest"] + [f"step-{s}" for s in step_tags(ckpt_dir)]
-    for cand in candidates:
-        if checkpoint_exists(ckpt_dir, cand):
-            step = checkpoint_step(ckpt_dir, cand)
-            if step is not None and step > best_step:
-                best_tag, best_step = cand, step
-    return best_tag
 
 
 class AsyncCheckpointer:
@@ -430,269 +367,6 @@ class AsyncCheckpointer:
         if self._error is not None:
             err, self._error = self._error, None
             raise RuntimeError("async checkpoint save failed") from err
-
-
-def checkpoint_exists(ckpt_dir: str, tag: str = "latest") -> bool:
-    return os.path.exists(os.path.join(ckpt_dir, tag, _MANIFEST))
-
-
-def _read_manifest(final: str) -> Optional[dict]:
-    """The manifest of checkpoint dir ``final``, or None when it is
-    missing, truncated, or not a manifest — a corrupt candidate must read
-    as ABSENT to the tag-resolution/fallback machinery, not crash it."""
-    path = os.path.join(final, _MANIFEST)
-    try:
-        with open(path) as f:
-            manifest = json.load(f)
-        if not isinstance(manifest, dict) or "leaves" not in manifest:
-            raise ValueError("not a checkpoint manifest")
-        int(manifest["step"])
-    except (OSError, ValueError, TypeError, KeyError) as e:
-        if os.path.exists(path):
-            logger.warning(
-                "unreadable checkpoint manifest %s (%s) — treating the "
-                "checkpoint as absent", path, e,
-            )
-        return None
-    return manifest
-
-
-def _read_commit(final: str) -> Optional[dict]:
-    """The COMMIT marker of ``final`` — None when absent/unreadable
-    (pre-integrity checkpoints have none; that alone is not corruption)."""
-    try:
-        with open(os.path.join(final, _COMMIT)) as f:
-            commit = json.load(f)
-        return commit if isinstance(commit, dict) else None
-    except (OSError, ValueError):
-        return None
-
-
-def checkpoint_step(ckpt_dir: str, tag: str = "latest") -> Optional[int]:
-    """Step of ``tag``, or None when absent OR its manifest is corrupt —
-    callers scanning for the newest checkpoint keep scanning either way."""
-    manifest = _read_manifest(os.path.join(ckpt_dir, tag))
-    return None if manifest is None else int(manifest["step"])
-
-
-def verify_checkpoint(
-    ckpt_dir: str, tag: str = "latest", *, deep: bool = True
-) -> List[str]:
-    """Integrity problems of checkpoint ``tag`` ([] == intact).
-
-    Checks, in order of cost: manifest readability; the COMMIT marker
-    (when present) against the manifest's actual bytes; every shard
-    file's existence and recorded byte length; and — with ``deep`` — the
-    recorded per-shard checksums (a full read of the checkpoint; page
-    cache makes the verify-then-restore pattern roughly one read).
-    Checkpoints written before the integrity fields only get the
-    existence checks, not false corruption reports.
-    """
-    final = os.path.join(ckpt_dir, tag)
-    manifest = _read_manifest(final)
-    if manifest is None:
-        return [f"manifest missing or unreadable in {final}"]
-    problems = []
-    commit = _read_commit(final)
-    if commit is not None:
-        algo = commit.get("checksum_algo", "")
-        try:
-            value, nbytes = checksum_file(
-                os.path.join(final, _MANIFEST),
-                algo if algo_supported(algo) else PREFERRED_ALGO,
-            )
-        except OSError as e:  # raced a concurrent delete
-            return [f"manifest unreadable in {final}: {e}"]
-        if nbytes != commit.get("manifest_bytes"):
-            problems.append("manifest length does not match COMMIT marker")
-        elif (
-            algo_supported(algo)
-            and value != commit.get("manifest_checksum")
-        ):
-            problems.append("manifest checksum does not match COMMIT marker")
-        if int(commit.get("step", -1)) != int(manifest["step"]):
-            problems.append("COMMIT step does not match manifest step")
-    for entry in manifest["leaves"]:
-        for shard in _entry_shards(entry):
-            path = os.path.join(final, shard["file"])
-            if not os.path.isfile(path):
-                problems.append(f"shard {shard['file']} missing")
-                continue
-            nbytes = os.path.getsize(path)
-            if "bytes" in shard and nbytes != shard["bytes"]:
-                problems.append(
-                    f"shard {shard['file']} truncated "
-                    f"({nbytes} bytes, manifest says {shard['bytes']})"
-                )
-                continue
-            if deep and "checksum" in shard:
-                algo = shard.get("checksum_algo", "crc32c")
-                if not algo_supported(algo):
-                    continue  # length already checked; can't do better
-                value, _ = checksum_file(path, algo)
-                if value != shard["checksum"]:
-                    problems.append(
-                        f"shard {shard['file']} {algo} mismatch"
-                    )
-    return problems
-
-
-def _tag_names(ckpt_dir: str, tag: str) -> List[str]:
-    """Directory names that could satisfy a restore of ``tag``, including
-    the ``.old`` leftovers of an interrupted swing. ``latest`` (the
-    resume default) widens to every step-tagged checkpoint."""
-    if tag != "latest":
-        return [tag, tag + ".old"]
-    names = ["latest", "latest.old"]
-    if os.path.isdir(ckpt_dir):
-        for name in sorted(os.listdir(ckpt_dir)):
-            base = name[:-len(".old")] if name.endswith(".old") else name
-            if base.startswith("step-") and not base.endswith(".tmp"):
-                names.append(name)
-    return names
-
-
-def restore_candidates(ckpt_dir: str, tag: str = "latest") -> List[str]:
-    """Restorable checkpoint dirs for ``tag``, newest step first.
-
-    Candidates with unreadable manifests are dropped (they cannot be
-    restored, whatever else is wrong with them); ``.old`` dirs rank
-    after a same-step non-old sibling. This is the fallback order
-    ``Trainer.restore_checkpoint`` walks.
-    """
-    ranked = []
-    for name in _tag_names(ckpt_dir, tag):
-        if not os.path.isdir(os.path.join(ckpt_dir, name)):
-            continue
-        step = checkpoint_step(ckpt_dir, name)
-        if step is None:
-            continue
-        ranked.append((step, 0 if name.endswith(".old") else 1, name))
-    return [name for _, _, name in sorted(ranked, reverse=True)]
-
-
-def recover_stranded_checkpoints(ckpt_dir: str) -> List[str]:
-    """Undo what a kill inside the save/swing window left behind.
-
-    Two stranded shapes exist (see ``_swing``):
-
-    * ``<tag>.tmp`` with a COMMIT marker AND shards that pass deep
-      verification — the checkpoint was fully written but the rename
-      never ran (or ran halfway). Finish the swing: it is the NEWEST
-      state on disk. Verification first is load-bearing: ``_swing``
-      deletes ``<tag>.old``, so promoting a COMMIT-complete tmp whose
-      shards rotted after checksumming would destroy the only intact
-      fallback.
-    * ``<tag>.old`` without ``<tag>`` — the kill landed between
-      ``final -> old`` and ``tmp -> final`` and the tmp is unusable.
-      Promote the old dir back; it is the previous complete checkpoint.
-
-    Returns the recovered tags. Call only when no save can be in flight
-    (job start / restore time) — a live AsyncCheckpointer owns its tmp.
-    """
-    if not os.path.isdir(ckpt_dir):
-        return []
-    recovered = []
-    for name in sorted(os.listdir(ckpt_dir)):
-        if not name.endswith(".tmp"):
-            continue
-        tag = name[:-len(".tmp")]
-        tmp = os.path.join(ckpt_dir, name)
-        commit = _read_commit(tmp)
-        if commit is None or _read_manifest(tmp) is None:
-            continue  # an aborted write; prune_checkpoints cleans it
-        problems = verify_checkpoint(ckpt_dir, name)
-        if problems:
-            logger.warning(
-                "stranded checkpoint write %s is COMMIT-complete but "
-                "fails verification (%s) — not promoting it (an intact "
-                "%s.old can still be recovered)",
-                tmp, "; ".join(problems[:3]), tag,
-            )
-            continue
-        logger.warning(
-            "recovering stranded checkpoint write %s (step %s): "
-            "finishing the interrupted commit", tmp, commit.get("step"),
-        )
-        _swing(ckpt_dir, tag, tmp)
-        recovered.append(tag)
-    for name in sorted(os.listdir(ckpt_dir)):
-        if not name.endswith(".old"):
-            continue
-        tag = name[:-len(".old")]
-        final = os.path.join(ckpt_dir, tag)
-        old = os.path.join(ckpt_dir, name)
-        if os.path.exists(final):
-            continue  # normal swing debris or already recovered above
-        if _read_manifest(old) is None:
-            continue  # junk; never promote what cannot be restored
-        logger.warning(
-            "recovering stranded checkpoint %s: the swing's rename "
-            "window was interrupted — restoring it as %r", old, tag,
-        )
-        os.replace(old, final)
-        recovered.append(tag)
-    return recovered
-
-
-def _entry_shards(entry: dict) -> List[dict]:
-    """Shard list for a manifest entry; v1 manifests are one full shard."""
-    if "shards" in entry:
-        return entry["shards"]
-    shape = entry["shape"]
-    return [
-        {"file": entry["file"], "start": [0] * len(shape), "stop": shape}
-    ]
-
-
-def _load_shard(final: str, fname: str, **kw) -> np.ndarray:
-    """``np.load`` of one shard file, with the ``ckpt.read_shard`` fault
-    site in front (chaos runs fail reads here to drive the fallback
-    chain; unarmed it is a no-op)."""
-    path = os.path.join(final, fname)
-    faults.check("ckpt.read_shard", path=path)
-    return np.load(path, **kw)
-
-
-def _assemble(
-    final: str,
-    entry: dict,
-    box_start: Tuple[int, ...],
-    box_stop: Tuple[int, ...],
-    dtype,
-) -> np.ndarray:
-    """Read the [start, stop) box of a leaf from its overlapping shards."""
-    out_shape = tuple(b - a for a, b in zip(box_start, box_stop))
-    shards = _entry_shards(entry)
-    # Fast path: one shard covering exactly the requested box.
-    for s in shards:
-        if tuple(s["start"]) == box_start and tuple(s["stop"]) == box_stop:
-            return _load_shard(final, s["file"]).astype(dtype, copy=False)
-    out = np.empty(out_shape, dtype)
-    filled = 0
-    for s in shards:
-        s_start, s_stop = s["start"], s["stop"]
-        lo = tuple(max(a, b) for a, b in zip(box_start, s_start))
-        hi = tuple(min(a, b) for a, b in zip(box_stop, s_stop))
-        if any(l >= h for l, h in zip(lo, hi)) and out.ndim > 0:
-            continue
-        src = _load_shard(final, s["file"], mmap_mode="r")
-        src_sel = tuple(
-            slice(l - a, h - a) for l, h, a in zip(lo, hi, s_start)
-        )
-        dst_sel = tuple(
-            slice(l - a, h - a) for l, h, a in zip(lo, hi, box_start)
-        )
-        out[dst_sel] = src[src_sel]
-        filled += int(np.prod([h - l for l, h in zip(lo, hi)])) if out.ndim else 1
-    if out.ndim == 0 and shards:
-        out[()] = _load_shard(final, shards[0]["file"])
-    elif filled < int(np.prod(out_shape)):
-        raise ValueError(
-            f"checkpoint shards for {entry['path']!r} do not cover the "
-            f"requested box [{box_start}, {box_stop}) — incomplete save?"
-        )
-    return out
 
 
 def restore_checkpoint(

@@ -1,0 +1,1076 @@
+"""Crash-consistent checkpoint IO: manifests, COMMIT markers, and the
+distributed two-phase world-commit protocol. No jax anywhere in this
+module — it is the machinery BOTH checkpoint stacks share: the jitted
+Trainer path (``train/checkpoint.py`` re-exports everything here and
+adds the jax Array save/restore on top) and the elastic engine's
+host-side path (``train/elastic_world.py``).
+
+Single-directory format (r2, unchanged)
+---------------------------------------
+``<ckpt_dir>/<tag>/`` holds shard ``.npy`` files, a ``manifest.json``
+(v2: per-leaf shard lists with byte lengths + CRC32C), and a ``COMMIT``
+marker written LAST that records the manifest's own checksum. Writes
+land in ``<tag>.tmp`` and swing atomically (:func:`_swing`); a dir
+without a readable manifest reads as absent.
+
+Sharded per-rank format (r17)
+-----------------------------
+A *distributed* save has no single writer, so a single COMMIT cannot
+express "everyone finished". The two-phase layout::
+
+    <ckpt_dir>/step-<N>/
+        WORLD_COMMIT            # phase 2: rank 0, written LAST
+        rank-0/
+            manifest.json       # + rank/world/replication keys
+            COMMIT              # phase 1: this rank finished
+            00000_momentum_w1.p0s0.npy ...
+        rank-1/ ...
+
+Phase 1 (:func:`save_rank_shards`): each rank writes ONLY the leaves it
+owns (the replication-2 ownership map) into its own ``rank-<r>/`` dir,
+manifest then per-rank COMMIT last. Phase 2
+(:func:`write_world_commit`): after a barrier, rank 0 re-verifies every
+rank manifest against its COMMIT and writes the ``WORLD_COMMIT``
+super-manifest (world size, per-rank manifest checksums, step, byte
+totals). THE rule every reader enforces: **a sharded save without a
+WORLD_COMMIT is absent** — :func:`checkpoint_step` returns None for it,
+:func:`restore_candidates` skips it, :func:`verify_checkpoint` reports
+it, and :func:`recover_stranded_checkpoints` garbage-collects a
+world-incomplete ``.tmp`` instead of promoting it. A rank killed at any
+point therefore tears NOTHING: either the WORLD_COMMIT landed (the save
+is complete and verifiable) or it did not (the save never happened and
+restore walks back to the newest world-complete epoch).
+
+Restore (:func:`load_checkpoint`) is re-shard aware by construction:
+it reads leaves by NAME from whichever rank dirs hold them, so any
+world size restores a checkpoint written by any other. Replication puts
+each leaf in ``replication`` rank dirs; a copy that fails CRC falls
+back to the peer's copy (loudly, behind the ``ckpt.peer_fetch`` fault
+site), and loss of every copy raises ``CheckpointCorrupted`` so
+:func:`load_best_checkpoint` walks back an epoch instead of crashing.
+
+Fault sites on these paths: ``ckpt.write_shard`` (per shard file),
+``ckpt.rank_commit`` (shards down, per-rank COMMIT not yet),
+``ckpt.world_commit`` (all rank COMMITs verified, WORLD_COMMIT not
+yet), ``ckpt.swing`` (inside the rename window), ``ckpt.read_shard``
+(per shard read), ``ckpt.peer_fetch`` (before a replication-peer
+fallback read). DESIGN.md §22 has the full torn-save matrix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pytorch_distributed_tpu.runtime import faults
+from pytorch_distributed_tpu.utils.integrity import (
+    PREFERRED_ALGO,
+    algo_supported,
+    checksum_file,
+)
+from pytorch_distributed_tpu.utils.logging import get_logger
+
+_MANIFEST = "manifest.json"
+_COMMIT = "COMMIT"  # written last: its presence means the dir is complete
+_WORLD_COMMIT = "WORLD_COMMIT"  # sharded saves: written last, by rank 0
+
+logger = get_logger(__name__)
+
+
+class CheckpointCorrupted(RuntimeError):
+    """Checkpoints exist on disk but none survived integrity checks —
+    resuming fresh would silently discard (and eventually overwrite) the
+    run's only remaining state."""
+
+
+# --------------------------------------------------------------------------
+# Readers: manifests, COMMIT markers, and the layout probe.
+# --------------------------------------------------------------------------
+
+
+def _read_manifest(final: str) -> Optional[dict]:
+    """The manifest of checkpoint dir ``final``, or None when it is
+    missing, truncated, or not a manifest — a corrupt candidate must read
+    as ABSENT to the tag-resolution/fallback machinery, not crash it."""
+    path = os.path.join(final, _MANIFEST)
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+        if not isinstance(manifest, dict) or "leaves" not in manifest:
+            raise ValueError("not a checkpoint manifest")
+        int(manifest["step"])
+    except (OSError, ValueError, TypeError, KeyError) as e:
+        if os.path.exists(path):
+            logger.warning(
+                "unreadable checkpoint manifest %s (%s) — treating the "
+                "checkpoint as absent", path, e,
+            )
+        return None
+    return manifest
+
+
+def _read_commit(final: str) -> Optional[dict]:
+    """The COMMIT marker of ``final`` — None when absent/unreadable
+    (pre-integrity checkpoints have none; that alone is not corruption)."""
+    try:
+        with open(os.path.join(final, _COMMIT)) as f:
+            commit = json.load(f)
+        return commit if isinstance(commit, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def _read_world_commit(final: str) -> Optional[dict]:
+    """The WORLD_COMMIT super-manifest of a sharded save, or None when
+    absent/unreadable. None IS the two-phase verdict: a sharded dir
+    without a world COMMIT never happened."""
+    path = os.path.join(final, _WORLD_COMMIT)
+    try:
+        with open(path) as f:
+            wc = json.load(f)
+        if not isinstance(wc, dict) or "ranks" not in wc:
+            raise ValueError("not a world commit")
+        int(wc["step"])
+        int(wc["world"])
+    except (OSError, ValueError, TypeError, KeyError) as e:
+        if os.path.exists(path):
+            logger.warning(
+                "unreadable WORLD_COMMIT %s (%s) — treating the sharded "
+                "checkpoint as absent", path, e,
+            )
+        return None
+    return wc
+
+
+def _rank_dirs(final: str) -> List[str]:
+    """``rank-<r>`` subdirectory names present under ``final``."""
+    if not os.path.isdir(final):
+        return []
+    out = []
+    for name in sorted(os.listdir(final)):
+        if not name.startswith("rank-"):
+            continue
+        try:
+            int(name[len("rank-"):])
+        except ValueError:
+            continue
+        if os.path.isdir(os.path.join(final, name)):
+            out.append(name)
+    return out
+
+
+def is_sharded_checkpoint(final: str) -> bool:
+    """True when ``final`` is (or was meant to be) a per-rank sharded
+    save: no top-level manifest, but a WORLD_COMMIT or rank dirs. A torn
+    sharded save (rank dirs, no WORLD_COMMIT) answers True — the caller
+    decides absence via :func:`_read_world_commit`."""
+    if os.path.isfile(os.path.join(final, _MANIFEST)):
+        return False
+    if os.path.isfile(os.path.join(final, _WORLD_COMMIT)):
+        return True
+    return bool(_rank_dirs(final))
+
+
+def checkpoint_exists(ckpt_dir: str, tag: str = "latest") -> bool:
+    final = os.path.join(ckpt_dir, tag)
+    return os.path.exists(
+        os.path.join(final, _MANIFEST)
+    ) or os.path.exists(os.path.join(final, _WORLD_COMMIT))
+
+
+def checkpoint_step(ckpt_dir: str, tag: str = "latest") -> Optional[int]:
+    """Step of ``tag``, or None when absent OR unrestorable — callers
+    scanning for the newest checkpoint keep scanning either way. For
+    sharded saves "unrestorable" includes the two-phase rule: rank dirs
+    without a WORLD_COMMIT read as absent."""
+    final = os.path.join(ckpt_dir, tag)
+    manifest = _read_manifest(final)
+    if manifest is not None:
+        return int(manifest["step"])
+    if is_sharded_checkpoint(final):
+        wc = _read_world_commit(final)
+        if wc is not None:
+            return int(wc["step"])
+    return None
+
+
+def step_tags(ckpt_dir: str) -> List[int]:
+    """Sorted step numbers of the ``step-<N>`` checkpoints present."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    out = []
+    for name in os.listdir(ckpt_dir):
+        if name.startswith("step-") and not name.endswith(".old"):
+            try:
+                out.append(int(name[len("step-"):]))
+            except ValueError:
+                continue
+    return sorted(out)
+
+
+def resolve_tag(ckpt_dir: str, tag: str = "latest") -> Optional[str]:
+    """The tag to restore. An explicitly-requested absent tag resolves to
+    None — silently substituting a different checkpoint for a named
+    request would hand back the wrong weights. The DEFAULT ``latest``
+    resolves to whichever checkpoint is NEWEST by step: a hard kill can
+    leave a stale ``latest`` (written at the last epoch boundary) beside
+    newer mid-epoch ``step-<N>`` tags, and resuming the stale one would
+    silently redo up to an epoch of training. A candidate whose manifest
+    is corrupt/truncated — or, sharded, whose WORLD_COMMIT is missing —
+    reads as absent (``checkpoint_step`` is None) on BOTH paths — never
+    hand back a tag that cannot be restored."""
+    if tag != "latest":
+        return tag if checkpoint_step(ckpt_dir, tag) is not None else None
+    best_tag = None
+    best_step = -1
+    candidates = ["latest"] + [f"step-{s}" for s in step_tags(ckpt_dir)]
+    for cand in candidates:
+        if checkpoint_exists(ckpt_dir, cand):
+            step = checkpoint_step(ckpt_dir, cand)
+            if step is not None and step > best_step:
+                best_tag, best_step = cand, step
+    return best_tag
+
+
+# --------------------------------------------------------------------------
+# Verification.
+# --------------------------------------------------------------------------
+
+
+def _verify_manifest_dir(final: str, *, deep: bool = True) -> List[str]:
+    """Problems of one manifest+COMMIT dir (a single-dir checkpoint, or
+    one ``rank-<r>`` dir of a sharded one)."""
+    manifest = _read_manifest(final)
+    if manifest is None:
+        return [f"manifest missing or unreadable in {final}"]
+    problems = []
+    commit = _read_commit(final)
+    if commit is not None:
+        algo = commit.get("checksum_algo", "")
+        try:
+            value, nbytes = checksum_file(
+                os.path.join(final, _MANIFEST),
+                algo if algo_supported(algo) else PREFERRED_ALGO,
+            )
+        except OSError as e:  # raced a concurrent delete
+            return [f"manifest unreadable in {final}: {e}"]
+        if nbytes != commit.get("manifest_bytes"):
+            problems.append("manifest length does not match COMMIT marker")
+        elif (
+            algo_supported(algo)
+            and value != commit.get("manifest_checksum")
+        ):
+            problems.append("manifest checksum does not match COMMIT marker")
+        if int(commit.get("step", -1)) != int(manifest["step"]):
+            problems.append("COMMIT step does not match manifest step")
+    for entry in manifest["leaves"]:
+        for shard in _entry_shards(entry):
+            path = os.path.join(final, shard["file"])
+            if not os.path.isfile(path):
+                problems.append(f"shard {shard['file']} missing")
+                continue
+            nbytes = os.path.getsize(path)
+            if "bytes" in shard and nbytes != shard["bytes"]:
+                problems.append(
+                    f"shard {shard['file']} truncated "
+                    f"({nbytes} bytes, manifest says {shard['bytes']})"
+                )
+                continue
+            if deep and "checksum" in shard:
+                algo = shard.get("checksum_algo", "crc32c")
+                if not algo_supported(algo):
+                    continue  # length already checked; can't do better
+                value, _ = checksum_file(path, algo)
+                if value != shard["checksum"]:
+                    problems.append(
+                        f"shard {shard['file']} {algo} mismatch"
+                    )
+    return problems
+
+
+def _verify_sharded(final: str, *, deep: bool = True) -> List[str]:
+    """Problems of a per-rank sharded save ([] == intact).
+
+    The WORLD_COMMIT is the root of trust: its absence is THE problem
+    (two-phase rule — the save never happened); when present, every
+    rank manifest is re-checksummed against the record it carries, every
+    rank must hold its own COMMIT, and the per-rank shard checks run
+    with ``rank r:`` prefixes. A leaf named in the world commit but held
+    by no rank manifest is reported — replication made every leaf land
+    in >= 1 rank dir at save time."""
+    wc = _read_world_commit(final)
+    if wc is None:
+        return [
+            f"sharded checkpoint {final} has no WORLD_COMMIT — a torn "
+            "distributed save; by the two-phase rule it reads as absent"
+        ]
+    problems = []
+    world = int(wc["world"])
+    ranks = wc.get("ranks", {})
+    if len(ranks) != world:
+        problems.append(
+            f"WORLD_COMMIT records {len(ranks)} ranks but world={world}"
+        )
+    seen_paths = set()
+    for r in range(world):
+        prefix = f"rank {r}: "
+        rec = ranks.get(str(r))
+        rdir = os.path.join(final, f"rank-{r}")
+        if rec is None:
+            problems.append(prefix + "missing from WORLD_COMMIT")
+            continue
+        manifest = _read_manifest(rdir)
+        if manifest is None:
+            problems.append(prefix + "manifest missing or unreadable")
+            continue
+        algo = rec.get("checksum_algo", "")
+        try:
+            value, nbytes = checksum_file(
+                os.path.join(rdir, _MANIFEST),
+                algo if algo_supported(algo) else PREFERRED_ALGO,
+            )
+        except OSError as e:
+            problems.append(prefix + f"manifest unreadable: {e}")
+            continue
+        if nbytes != rec.get("manifest_bytes"):
+            problems.append(
+                prefix + "manifest length does not match WORLD_COMMIT"
+            )
+        elif (
+            algo_supported(algo)
+            and value != rec.get("manifest_checksum")
+        ):
+            problems.append(
+                prefix + "manifest checksum does not match WORLD_COMMIT"
+            )
+        if _read_commit(rdir) is None:
+            # unlike single-dir saves (where a missing COMMIT just means
+            # a pre-integrity write), a rank dir without its COMMIT
+            # never finished phase 1 — the world commit should not exist
+            problems.append(prefix + "per-rank COMMIT missing")
+        problems.extend(
+            prefix + p for p in _verify_manifest_dir(rdir, deep=deep)
+        )
+        for entry in manifest["leaves"]:
+            seen_paths.add(entry["path"])
+    for path in wc.get("leaf_paths", []):
+        if path not in seen_paths:
+            problems.append(
+                f"leaf {path!r} is in the WORLD_COMMIT but no rank "
+                "manifest holds it"
+            )
+    return problems
+
+
+def verify_checkpoint(
+    ckpt_dir: str, tag: str = "latest", *, deep: bool = True
+) -> List[str]:
+    """Integrity problems of checkpoint ``tag`` ([] == intact).
+
+    Checks, in order of cost: manifest readability; the COMMIT marker
+    (when present) against the manifest's actual bytes; every shard
+    file's existence and recorded byte length; and — with ``deep`` — the
+    recorded per-shard checksums (a full read of the checkpoint; page
+    cache makes the verify-then-restore pattern roughly one read).
+    Checkpoints written before the integrity fields only get the
+    existence checks, not false corruption reports. Sharded saves get
+    the world-commit quorum checks first (:func:`_verify_sharded`),
+    then the same per-shard checks inside every rank dir.
+    """
+    final = os.path.join(ckpt_dir, tag)
+    if is_sharded_checkpoint(final):
+        return _verify_sharded(final, deep=deep)
+    return _verify_manifest_dir(final, deep=deep)
+
+
+# --------------------------------------------------------------------------
+# Candidates, stranded-write recovery, pruning.
+# --------------------------------------------------------------------------
+
+
+def _tag_names(ckpt_dir: str, tag: str) -> List[str]:
+    """Directory names that could satisfy a restore of ``tag``, including
+    the ``.old`` leftovers of an interrupted swing. ``latest`` (the
+    resume default) widens to every step-tagged checkpoint."""
+    if tag != "latest":
+        return [tag, tag + ".old"]
+    names = ["latest", "latest.old"]
+    if os.path.isdir(ckpt_dir):
+        for name in sorted(os.listdir(ckpt_dir)):
+            base = name[:-len(".old")] if name.endswith(".old") else name
+            if base.startswith("step-") and not base.endswith(".tmp"):
+                names.append(name)
+    return names
+
+
+def restore_candidates(ckpt_dir: str, tag: str = "latest") -> List[str]:
+    """Restorable checkpoint dirs for ``tag``, newest step first.
+
+    Candidates with unreadable manifests — or, sharded, without a
+    WORLD_COMMIT — are dropped (they cannot be restored, whatever else
+    is wrong with them); ``.old`` dirs rank after a same-step non-old
+    sibling. This is the fallback order ``Trainer.restore_checkpoint``
+    and :func:`load_best_checkpoint` walk.
+    """
+    ranked = []
+    for name in _tag_names(ckpt_dir, tag):
+        if not os.path.isdir(os.path.join(ckpt_dir, name)):
+            continue
+        step = checkpoint_step(ckpt_dir, name)
+        if step is None:
+            continue
+        ranked.append((step, 0 if name.endswith(".old") else 1, name))
+    return [name for _, _, name in sorted(ranked, reverse=True)]
+
+
+def recover_stranded_checkpoints(ckpt_dir: str) -> List[str]:
+    """Undo what a kill inside the save/swing window left behind.
+
+    Single-dir shapes (see ``_swing``):
+
+    * ``<tag>.tmp`` with a COMMIT marker AND shards that pass deep
+      verification — the checkpoint was fully written but the rename
+      never ran (or ran halfway). Finish the swing: it is the NEWEST
+      state on disk. Verification first is load-bearing: ``_swing``
+      deletes ``<tag>.old``, so promoting a COMMIT-complete tmp whose
+      shards rotted after checksumming would destroy the only intact
+      fallback.
+    * ``<tag>.old`` without ``<tag>`` — the kill landed between
+      ``final -> old`` and ``tmp -> final`` and the tmp is unusable.
+      Promote the old dir back; it is the previous complete checkpoint.
+
+    Sharded (per-rank) tmp dirs add the two-phase verdict:
+
+    * world-COMPLETE (WORLD_COMMIT present, quorum verifies) — finish
+      the swing, exactly like the single-dir case.
+    * world-INCOMPLETE (no WORLD_COMMIT: a rank died before its COMMIT,
+      or rank 0 died before the world commit) — garbage-collect it. By
+      the two-phase rule the save never happened; promoting any subset
+      would resurrect a torn world.
+
+    Returns the recovered tags. Call only when no save can be in flight
+    (job start / restore time) — a live AsyncCheckpointer owns its tmp.
+    """
+    if not os.path.isdir(ckpt_dir):
+        return []
+    recovered = []
+    for name in sorted(os.listdir(ckpt_dir)):
+        if not name.endswith(".tmp"):
+            continue
+        tag = name[:-len(".tmp")]
+        tmp = os.path.join(ckpt_dir, name)
+        if is_sharded_checkpoint(tmp):
+            wc = _read_world_commit(tmp)
+            if wc is None:
+                logger.warning(
+                    "garbage-collecting world-INCOMPLETE sharded "
+                    "checkpoint write %s: no WORLD_COMMIT, so by the "
+                    "two-phase rule this save never happened", tmp,
+                )
+                shutil.rmtree(tmp, ignore_errors=True)
+                continue
+            problems = _verify_sharded(tmp)
+            if problems:
+                logger.warning(
+                    "stranded sharded checkpoint write %s carries a "
+                    "WORLD_COMMIT but fails verification (%s) — not "
+                    "promoting it", tmp, "; ".join(problems[:3]),
+                )
+                continue
+            logger.warning(
+                "recovering stranded sharded checkpoint write %s "
+                "(step %s, world %s): finishing the interrupted commit",
+                tmp, wc.get("step"), wc.get("world"),
+            )
+            _swing(ckpt_dir, tag, tmp)
+            recovered.append(tag)
+            continue
+        commit = _read_commit(tmp)
+        if commit is None or _read_manifest(tmp) is None:
+            continue  # an aborted write; prune_checkpoints cleans it
+        problems = verify_checkpoint(ckpt_dir, name)
+        if problems:
+            logger.warning(
+                "stranded checkpoint write %s is COMMIT-complete but "
+                "fails verification (%s) — not promoting it (an intact "
+                "%s.old can still be recovered)",
+                tmp, "; ".join(problems[:3]), tag,
+            )
+            continue
+        logger.warning(
+            "recovering stranded checkpoint write %s (step %s): "
+            "finishing the interrupted commit", tmp, commit.get("step"),
+        )
+        _swing(ckpt_dir, tag, tmp)
+        recovered.append(tag)
+    for name in sorted(os.listdir(ckpt_dir)):
+        if not name.endswith(".old"):
+            continue
+        tag = name[:-len(".old")]
+        final = os.path.join(ckpt_dir, tag)
+        old = os.path.join(ckpt_dir, name)
+        if os.path.exists(final):
+            continue  # normal swing debris or already recovered above
+        if _read_manifest(old) is None and _read_world_commit(old) is None:
+            continue  # junk; never promote what cannot be restored
+        logger.warning(
+            "recovering stranded checkpoint %s: the swing's rename "
+            "window was interrupted — restoring it as %r", old, tag,
+        )
+        os.replace(old, final)
+        recovered.append(tag)
+    return recovered
+
+
+def prune_checkpoints(ckpt_dir: str, *, keep: int) -> List[str]:
+    """Delete the oldest ``step-<N>`` checkpoints beyond ``keep``.
+
+    Only step-tagged directories participate; ``latest``/``best``/custom
+    tags are never pruned. Returns the removed paths. Multi-host: call on
+    process 0 only (the commit owner). ``keep=0`` is allowed for the
+    prune-before-save pattern (the imminent save provides the survivor).
+
+    Safety rule: prune never deletes the LAST restorable checkpoint.
+    When every surviving tag (``latest`` included) is absent or
+    unrestorable — e.g. a sharded run whose only complete epoch sits in
+    the prune window — the newest restorable doomed tag is spared,
+    loudly. An imminent save that then fails leaves the run restorable
+    instead of bare.
+    """
+    if keep < 0:
+        raise ValueError(f"keep must be >= 0, got {keep}")
+    steps = step_tags(ckpt_dir)
+    doomed = list(steps if keep == 0 else steps[:-keep])
+    if doomed:
+        doomed_set = set(doomed)
+        survivors = ["latest"] + [
+            f"step-{s}" for s in steps if s not in doomed_set
+        ]
+        if not any(
+            checkpoint_step(ckpt_dir, t) is not None for t in survivors
+        ):
+            for s in reversed(doomed):
+                if checkpoint_step(ckpt_dir, f"step-{s}") is not None:
+                    logger.warning(
+                        "prune(keep=%d) would delete the only restorable "
+                        "checkpoint under %s — sparing step-%d",
+                        keep, ckpt_dir, s,
+                    )
+                    doomed.remove(s)
+                    break
+    removed = []
+    for step in doomed:
+        path = os.path.join(ckpt_dir, f"step-{step}")
+        shutil.rmtree(path, ignore_errors=True)
+        removed.append(path)
+    # orphaned partial writes: a kill mid-save leaves step-<N>.tmp, and a
+    # step tag is never saved twice, so nothing else ever cleans them —
+    # they would accumulate full-size dirs across preempted restarts.
+    # Only LIVE tags' tmps are spared (their own next save owns them).
+    live = {f"step-{s}" for s in step_tags(ckpt_dir)}
+    if os.path.isdir(ckpt_dir):
+        for name in os.listdir(ckpt_dir):
+            if (
+                name.startswith("step-")
+                and name.endswith(".tmp")
+                and name[: -len(".tmp")] not in live
+            ):
+                path = os.path.join(ckpt_dir, name)
+                shutil.rmtree(path, ignore_errors=True)
+                removed.append(path)
+    return removed
+
+
+# --------------------------------------------------------------------------
+# The atomic swing.
+# --------------------------------------------------------------------------
+
+
+def _swing(ckpt_dir: str, tag: str, tmp: str) -> str:
+    """Atomically replace ckpt_dir/tag with the fully-written tmp dir."""
+    final = os.path.join(ckpt_dir, tag)
+    old = final + ".old"
+    if os.path.exists(old):
+        shutil.rmtree(old)
+    if os.path.exists(final):
+        os.replace(final, old)
+    # the crash window: a kill here leaves no <tag>, only <tag>.old (and
+    # the complete <tag>.tmp) — recover_stranded_checkpoints undoes it
+    faults.check("ckpt.swing", path=final)
+    os.replace(tmp, final)
+    if os.path.exists(old):
+        shutil.rmtree(old)
+    return final
+
+
+# --------------------------------------------------------------------------
+# Writers: host-array saves (single-dir and per-rank sharded).
+# --------------------------------------------------------------------------
+
+
+def _axis0_boxes(
+    arr: np.ndarray, chunk_rows: Optional[int]
+) -> List[Tuple[Tuple[int, ...], Tuple[int, ...]]]:
+    """(start, stop) boxes of ``arr``: the whole extent, or axis-0 chunks
+    of ``chunk_rows`` rows (multi-shard leaves — the layout the restore
+    side must assemble)."""
+    shape = tuple(arr.shape)
+    if not chunk_rows or arr.ndim == 0 or shape[0] <= chunk_rows:
+        return [((0,) * arr.ndim, shape)]
+    boxes = []
+    for lo in range(0, shape[0], chunk_rows):
+        hi = min(lo + chunk_rows, shape[0])
+        boxes.append(((lo,) + (0,) * (arr.ndim - 1), (hi,) + shape[1:]))
+    return boxes
+
+
+def _write_leaf_files(
+    dest: str,
+    leaves: Dict[str, np.ndarray],
+    *,
+    chunk_rows: Optional[int] = None,
+) -> Tuple[List[dict], int]:
+    """Write flat host arrays as shard files; returns (manifest leaf
+    entries, total bytes). Each shard file's byte length and CRC land in
+    its entry (the integrity basis for every check downstream); the
+    ``ckpt.write_shard`` fault site fires after each file."""
+    entries = []
+    total = 0
+    for i, name in enumerate(sorted(leaves)):
+        arr = np.ascontiguousarray(leaves[name])
+        shards = []
+        for j, (start, stop) in enumerate(_axis0_boxes(arr, chunk_rows)):
+            sel = tuple(slice(a, b) for a, b in zip(start, stop))
+            fname = f"{i:05d}_{name[:72]}.p0s{j}.npy"
+            path = os.path.join(dest, fname)
+            np.save(path, arr[sel])
+            value, nbytes = checksum_file(path)
+            shard = {
+                "file": fname,
+                "start": list(start),
+                "stop": list(stop),
+                "bytes": nbytes,
+            }
+            if value is not None:
+                shard["checksum"] = value
+                shard["checksum_algo"] = PREFERRED_ALGO
+            faults.check("ckpt.write_shard", path=path)
+            total += int(nbytes)
+            shards.append(shard)
+        entries.append(
+            {
+                "path": name,
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+                "shards": shards,
+            }
+        )
+    return entries, total
+
+
+def _write_commit(dest: str, step: int) -> None:
+    """The COMMIT marker, from the manifest file as it landed on disk."""
+    value, nbytes = checksum_file(os.path.join(dest, _MANIFEST))
+    commit = {"step": int(step), "manifest_bytes": nbytes}
+    if value is not None:
+        commit["manifest_checksum"] = value
+        commit["checksum_algo"] = PREFERRED_ALGO
+    with open(os.path.join(dest, _COMMIT), "w") as f:
+        json.dump(commit, f)
+
+
+def save_single_checkpoint(
+    ckpt_dir: str,
+    leaves: Dict[str, np.ndarray],
+    step: int,
+    tag: str = "latest",
+    *,
+    chunk_rows: Optional[int] = None,
+) -> str:
+    """Atomic single-process checkpoint of flat host arrays: manifest v2,
+    per-shard CRC, COMMIT marker, tmp+swing — the r2 format,
+    ``verify_checkpoint`` applies unchanged. ``chunk_rows`` splits each
+    leaf's axis 0 into multiple shards (exercises the multi-shard
+    assembly path)."""
+    final = os.path.join(ckpt_dir, tag)
+    tmp = final + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp, exist_ok=True)
+    entries, _ = _write_leaf_files(tmp, leaves, chunk_rows=chunk_rows)
+    with open(os.path.join(tmp, _MANIFEST), "w") as f:
+        json.dump(
+            {"version": 2, "step": int(step), "leaves": entries}, f,
+            indent=1,
+        )
+    _write_commit(tmp, step)
+    return _swing(ckpt_dir, tag, tmp)
+
+
+def save_rank_shards(
+    tmp: str,
+    rank: int,
+    leaves: Dict[str, np.ndarray],
+    step: int,
+    *,
+    world: int,
+    replication: int,
+) -> int:
+    """Phase 1 of the two-phase distributed save: this rank's owned
+    leaves into ``<tmp>/rank-<rank>/`` with a per-rank manifest and —
+    LAST — a per-rank COMMIT. Returns the bytes written. The caller
+    barriers after every rank's phase 1, then rank 0 runs
+    :func:`write_world_commit`. The ``ckpt.rank_commit`` site sits
+    between the manifest and the COMMIT: a ``mode=kill`` there is the
+    canonical mid-distributed-save crash (shards down, rank COMMIT
+    missing, world COMMIT therefore never written)."""
+    rdir = os.path.join(tmp, f"rank-{int(rank)}")
+    if os.path.exists(rdir):
+        shutil.rmtree(rdir)
+    os.makedirs(rdir)
+    entries, total = _write_leaf_files(rdir, leaves)
+    with open(os.path.join(rdir, _MANIFEST), "w") as f:
+        json.dump(
+            {
+                "version": 2,
+                "step": int(step),
+                "rank": int(rank),
+                "world": int(world),
+                "replication": int(replication),
+                "leaves": entries,
+            },
+            f,
+            indent=1,
+        )
+    faults.check("ckpt.rank_commit", path=rdir)
+    _write_commit(rdir, step)
+    return total
+
+
+def write_world_commit(
+    tmp: str,
+    *,
+    step: int,
+    world: int,
+    replication: int,
+    expected_leaves: Optional[Sequence[str]] = None,
+) -> dict:
+    """Phase 2: the WORLD_COMMIT super-manifest, by rank 0 only, only
+    after every rank's COMMIT verifies. Re-checksums each rank manifest
+    against its COMMIT (a quorum check on the actual bytes, not on
+    file existence); any torn rank raises ``CheckpointCorrupted`` and
+    NO world commit is written — the save reads as absent, which is the
+    protocol working, not failing. ``expected_leaves`` (the engine's
+    full leaf-name set) guards against an ownership-map bug silently
+    dropping a leaf from the save. The ``ckpt.world_commit`` site fires
+    after the quorum check, before the marker lands."""
+    ranks = {}
+    total_bytes = 0
+    leaf_paths: List[str] = []
+    seen = set()
+    for r in range(int(world)):
+        rdir = os.path.join(tmp, f"rank-{r}")
+        commit = _read_commit(rdir)
+        manifest = _read_manifest(rdir)
+        if commit is None or manifest is None:
+            raise CheckpointCorrupted(
+                f"rank {r} of sharded save {tmp} has no COMMIT — the "
+                "save is torn; refusing to write a WORLD_COMMIT over it"
+            )
+        algo = commit.get("checksum_algo", "")
+        value, nbytes = checksum_file(
+            os.path.join(rdir, _MANIFEST),
+            algo if algo_supported(algo) else PREFERRED_ALGO,
+        )
+        if nbytes != commit.get("manifest_bytes") or (
+            algo_supported(algo)
+            and value != commit.get("manifest_checksum")
+        ):
+            raise CheckpointCorrupted(
+                f"rank {r} manifest does not match its COMMIT in {tmp}"
+            )
+        if int(manifest["step"]) != int(step):
+            raise CheckpointCorrupted(
+                f"rank {r} committed step {manifest['step']}, the world "
+                f"save is step {step} — mixed-step save"
+            )
+        rbytes = 0
+        for entry in manifest["leaves"]:
+            if entry["path"] not in seen:
+                seen.add(entry["path"])
+                leaf_paths.append(entry["path"])
+            for shard in _entry_shards(entry):
+                rbytes += int(shard.get("bytes", 0))
+        rec = {"manifest_bytes": nbytes, "bytes": rbytes,
+               "leaves": len(manifest["leaves"])}
+        if value is not None:
+            rec["manifest_checksum"] = value
+            rec["checksum_algo"] = (
+                algo if algo_supported(algo) else PREFERRED_ALGO
+            )
+        ranks[str(r)] = rec
+        total_bytes += rbytes
+    if expected_leaves is not None:
+        missing = sorted(set(expected_leaves) - seen)
+        if missing:
+            raise CheckpointCorrupted(
+                f"no rank committed leaves {missing[:5]} — the ownership "
+                "map and the save disagree"
+            )
+    faults.check("ckpt.world_commit", path=tmp)
+    wc = {
+        "step": int(step),
+        "world": int(world),
+        "replication": int(replication),
+        "ranks": ranks,
+        "total_bytes": total_bytes,
+        "leaf_paths": leaf_paths,
+    }
+    path = os.path.join(tmp, _WORLD_COMMIT)
+    part = path + ".tmp"
+    with open(part, "w") as f:
+        json.dump(wc, f, indent=1)
+    os.replace(part, path)
+    return wc
+
+
+# --------------------------------------------------------------------------
+# Readers: shard assembly and the re-shard-aware load.
+# --------------------------------------------------------------------------
+
+
+def _entry_shards(entry: dict) -> List[dict]:
+    """Shard list for a manifest entry; v1 manifests are one full shard."""
+    if "shards" in entry:
+        return entry["shards"]
+    shape = entry["shape"]
+    return [
+        {"file": entry["file"], "start": [0] * len(shape), "stop": shape}
+    ]
+
+
+def _load_shard(final: str, fname: str, **kw) -> np.ndarray:
+    """``np.load`` of one shard file, with the ``ckpt.read_shard`` fault
+    site in front (chaos runs fail reads here to drive the fallback
+    chain; unarmed it is a no-op)."""
+    path = os.path.join(final, fname)
+    faults.check("ckpt.read_shard", path=path)
+    return np.load(path, **kw)
+
+
+def _assemble(
+    final: str,
+    entry: dict,
+    box_start: Tuple[int, ...],
+    box_stop: Tuple[int, ...],
+    dtype,
+) -> np.ndarray:
+    """Read the [start, stop) box of a leaf from its overlapping shards."""
+    out_shape = tuple(b - a for a, b in zip(box_start, box_stop))
+    shards = _entry_shards(entry)
+    # Fast path: one shard covering exactly the requested box.
+    for s in shards:
+        if tuple(s["start"]) == box_start and tuple(s["stop"]) == box_stop:
+            return _load_shard(final, s["file"]).astype(dtype, copy=False)
+    out = np.empty(out_shape, dtype)
+    filled = 0
+    for s in shards:
+        s_start, s_stop = s["start"], s["stop"]
+        lo = tuple(max(a, b) for a, b in zip(box_start, s_start))
+        hi = tuple(min(a, b) for a, b in zip(box_stop, s_stop))
+        if any(l >= h for l, h in zip(lo, hi)) and out.ndim > 0:
+            continue
+        src = _load_shard(final, s["file"], mmap_mode="r")
+        src_sel = tuple(
+            slice(l - a, h - a) for l, h, a in zip(lo, hi, s_start)
+        )
+        dst_sel = tuple(
+            slice(l - a, h - a) for l, h, a in zip(lo, hi, box_start)
+        )
+        out[dst_sel] = src[src_sel]
+        filled += int(np.prod([h - l for l, h in zip(lo, hi)])) if out.ndim else 1
+    if out.ndim == 0 and shards:
+        out[()] = _load_shard(final, shards[0]["file"])
+    elif filled < int(np.prod(out_shape)):
+        raise ValueError(
+            f"checkpoint shards for {entry['path']!r} do not cover the "
+            f"requested box [{box_start}, {box_stop}) — incomplete save?"
+        )
+    return out
+
+
+def _read_entry(final: str, entry: dict, *, verify: bool = True) -> np.ndarray:
+    """One leaf's full extent, assembled from its shard files.
+    ``verify`` checks each shard's recorded byte length and checksum
+    first and raises ``CheckpointCorrupted`` on mismatch — the copy
+    either restores intact or counts as lost, never restores wrong."""
+    if verify:
+        for shard in _entry_shards(entry):
+            path = os.path.join(final, shard["file"])
+            if not os.path.isfile(path):
+                raise CheckpointCorrupted(
+                    f"shard {shard['file']} missing in {final}"
+                )
+            nbytes = os.path.getsize(path)
+            if "bytes" in shard and nbytes != shard["bytes"]:
+                raise CheckpointCorrupted(
+                    f"shard {shard['file']} truncated ({nbytes} bytes, "
+                    f"manifest says {shard['bytes']}) in {final}"
+                )
+            if "checksum" in shard:
+                algo = shard.get("checksum_algo", "crc32c")
+                if algo_supported(algo):
+                    value, _ = checksum_file(path, algo)
+                    if value != shard["checksum"]:
+                        raise CheckpointCorrupted(
+                            f"shard {shard['file']} {algo} mismatch "
+                            f"in {final}"
+                        )
+    shape = tuple(entry["shape"])
+    return _assemble(
+        final, entry, (0,) * len(shape), shape, np.dtype(entry["dtype"])
+    )
+
+
+@dataclasses.dataclass
+class LoadedCheckpoint:
+    """What :func:`load_checkpoint` hands back: the flat leaves plus the
+    restore provenance the audit trail records."""
+
+    leaves: Dict[str, np.ndarray]
+    step: int
+    tag: str = ""
+    world: int = 1  # the world size that WROTE it, not the reader's
+    sharded: bool = False
+    peer_fetches: int = 0  # leaves restored from a replication peer copy
+    walked_back: int = 0  # candidates skipped before this one restored
+
+
+def load_checkpoint(final: str) -> LoadedCheckpoint:
+    """Flat leaves of the checkpoint at directory ``final`` (the full
+    path, tag included) — the jax-free restore both formats share.
+
+    Single-dir saves assemble every leaf through ``_assemble``, so
+    multi-shard leaves load the same way ``restore_checkpoint`` reads
+    them. Sharded saves REQUIRE a WORLD_COMMIT (two-phase rule), then
+    read each leaf by name from the rank dirs holding a copy, primary
+    first: a copy failing CRC/byte checks falls back to the replication
+    peer's copy — loudly, behind the ``ckpt.peer_fetch`` site — and
+    loss of every copy raises ``CheckpointCorrupted`` so the caller
+    walks back an epoch. Re-shard awareness is free: nothing here
+    depends on the READER's world size.
+    """
+    if not is_sharded_checkpoint(final):
+        manifest = _read_manifest(final)
+        if manifest is None:
+            raise CheckpointCorrupted(
+                f"no readable manifest in {final}"
+            )
+        leaves = {
+            entry["path"]: _read_entry(final, entry)
+            for entry in manifest["leaves"]
+        }
+        return LoadedCheckpoint(leaves=leaves, step=int(manifest["step"]))
+    wc = _read_world_commit(final)
+    if wc is None:
+        raise CheckpointCorrupted(
+            f"sharded checkpoint {final} has no WORLD_COMMIT — a torn "
+            "distributed save reads as absent"
+        )
+    world = int(wc["world"])
+    copies: Dict[str, List[Tuple[str, dict]]] = {}
+    discovered: List[str] = []
+    for r in range(world):
+        rdir = os.path.join(final, f"rank-{r}")
+        manifest = _read_manifest(rdir)
+        if manifest is None:
+            # the quorum held at save time; treat later rot of a whole
+            # rank dir as copy loss for every leaf it held
+            logger.warning(
+                "rank %d manifest unreadable in %s — treating its "
+                "copies as lost", r, final,
+            )
+            continue
+        for entry in manifest["leaves"]:
+            if entry["path"] not in copies:
+                discovered.append(entry["path"])
+            copies.setdefault(entry["path"], []).append((rdir, entry))
+    leaves: Dict[str, np.ndarray] = {}
+    peer_fetches = 0
+    for name in wc.get("leaf_paths") or discovered:
+        cands = copies.get(name, [])
+        errors: List[str] = []
+        for k, (rdir, entry) in enumerate(cands):
+            if k > 0:
+                # the replication-peer fallback read; mode=raise here is
+                # the both-copies-lost drill
+                faults.check("ckpt.peer_fetch", path=rdir)
+            try:
+                arr = _read_entry(rdir, entry)
+            except (CheckpointCorrupted, OSError, ValueError,
+                    faults.InjectedFault) as e:
+                errors.append(f"{os.path.basename(rdir)}: {e}")
+                continue
+            if k > 0:
+                peer_fetches += 1
+                logger.warning(
+                    "leaf %r: primary copy failed (%s) — restored from "
+                    "the replication peer copy in %s",
+                    name, "; ".join(errors), rdir,
+                )
+            leaves[name] = arr
+            break
+        else:
+            raise CheckpointCorrupted(
+                f"leaf {name!r}: all {len(cands)} copies failed in "
+                f"{final}: {'; '.join(errors) or 'no rank holds it'}"
+            )
+    return LoadedCheckpoint(
+        leaves=leaves,
+        step=int(wc["step"]),
+        world=world,
+        sharded=True,
+        peer_fetches=peer_fetches,
+    )
+
+
+def load_best_checkpoint(
+    ckpt_dir: str, tag: str = "latest"
+) -> Optional[LoadedCheckpoint]:
+    """Walk :func:`restore_candidates` newest-first and restore the first
+    one that survives integrity checks, counting every skip in
+    ``walked_back`` (the audit trail's epoch-walk-back record). Returns
+    None when NO candidate exists (a fresh run); raises
+    ``CheckpointCorrupted`` when candidates exist but none restores —
+    resuming fresh over damaged state must be a deliberate human
+    decision."""
+    cands = restore_candidates(ckpt_dir, tag)
+    walked = 0
+    errors: List[str] = []
+    for name in cands:
+        final = os.path.join(ckpt_dir, name)
+        try:
+            loaded = load_checkpoint(final)
+        except (CheckpointCorrupted, OSError, ValueError, KeyError,
+                faults.InjectedFault) as e:
+            logger.warning(
+                "checkpoint %s failed restore (%s) — walking back to "
+                "the next candidate", final, e,
+            )
+            errors.append(f"{name}: {e}")
+            walked += 1
+            continue
+        loaded.tag = name
+        loaded.walked_back = walked
+        return loaded
+    if cands:
+        raise CheckpointCorrupted(
+            f"checkpoints exist under {ckpt_dir} but none survived "
+            f"restore: {'; '.join(errors)}"
+        )
+    return None

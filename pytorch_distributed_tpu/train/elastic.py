@@ -31,6 +31,7 @@ failure and resizes instead).
 
 from __future__ import annotations
 
+import contextlib
 import faulthandler
 import logging
 import os
@@ -43,6 +44,48 @@ from typing import Callable, Optional
 logger = logging.getLogger(__name__)
 
 EX_TEMPFAIL = 75  # exit code: "transient failure, restart me"
+
+
+@contextlib.contextmanager
+def deferred_signals(signums=(signal.SIGTERM, signal.SIGINT)):
+    """Latch (don't deliver) the given signals for the duration of the
+    block, then re-deliver any that arrived once it exits.
+
+    The checkpoint commit window uses this: the world-commit + swing +
+    prune sequence is a few renames that must land as a unit — a SIGTERM
+    mid-sequence would strand a world-complete ``.tmp`` behind a fresh
+    restart for recover_stranded_checkpoints to mop up, when waiting a
+    millisecond would have finished the commit. SIGKILL is of course
+    not deferrable; that window stays covered by the recovery protocol,
+    not by this latch. Re-delivery uses ``os.kill(getpid(), sig)`` so an
+    outer :class:`PreemptionHandler` (or the default handler) sees the
+    signal exactly as if it arrived late. On non-main threads — where
+    ``signal.signal`` raises ValueError — the block runs unprotected,
+    matching :class:`PreemptionHandler`'s install behavior."""
+    if threading.current_thread() is not threading.main_thread():
+        # signal.signal raises ValueError off the main thread: run the
+        # block unprotected (the latch is an optimization, not a
+        # correctness requirement — the two-phase protocol is that)
+        yield
+        return
+    pending = []
+    previous = {}
+    for signum in signums:
+        previous[signum] = signal.signal(
+            signum,
+            lambda s, frame: pending.append(s),
+        )
+    try:
+        yield
+    finally:
+        for signum, handler in previous.items():
+            signal.signal(signum, handler)
+        for signum in pending:
+            logger.warning(
+                "re-delivering signal %s deferred across the checkpoint "
+                "commit window", signum,
+            )
+            os.kill(os.getpid(), signum)
 
 
 class Preempted(RuntimeError):

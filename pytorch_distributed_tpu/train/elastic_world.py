@@ -61,9 +61,21 @@ the goodput ``rebalance`` bucket.
 Everything here is numpy (no jax): elastic workers spawn in ~1 s, the
 math is trivially deterministic, and the subsystem's claims are about
 membership/re-shard/replay mechanics — which are backend-agnostic — not
-about model throughput. Checkpoints are written in the standard manifest
--v2 + COMMIT format (``train/checkpoint.py``), so ``verify_checkpoint``
-and the drill's integrity audit apply unchanged.
+about model throughput.
+
+Round 17 makes the checkpoint itself distributed: by default
+(``ElasticConfig.ckpt_format="sharded"``) every rank writes only the
+shards it owns into ``step-<N>/rank-<r>/`` with a per-rank COMMIT, and
+rank 0 seals the epoch with a WORLD_COMMIT only after verifying every
+rank's commit — a sharded save without a world commit reads as *absent*
+everywhere, so a mid-save crash can never be restored from. Restore is
+re-shard aware (any world size reads any other's checkpoint), falls back
+to the replication peer's copy when a sole copy is lost, and walks back
+an epoch when both copies are gone. ``ckpt_format="full"`` keeps the
+pre-r17 gather-to-rank-0 single-dir write as the measured baseline; both
+formats are the standard manifest-v2 + COMMIT machinery
+(``train/ckpt_io.py``), so ``verify_checkpoint`` and the drill's
+integrity audit apply to both. Protocol + torn-save matrix: DESIGN §22.
 """
 
 from __future__ import annotations
@@ -89,10 +101,11 @@ from pytorch_distributed_tpu.runtime.membership import (
     WorldMembership,
     WorldView,
 )
-from pytorch_distributed_tpu.train.elastic import EX_TEMPFAIL, PeerLost
-from pytorch_distributed_tpu.utils.integrity import (
-    PREFERRED_ALGO,
-    checksum_file,
+from pytorch_distributed_tpu.train import ckpt_io
+from pytorch_distributed_tpu.train.elastic import (
+    EX_TEMPFAIL,
+    PeerLost,
+    deferred_signals,
 )
 from pytorch_distributed_tpu.utils.logging import get_logger
 
@@ -192,13 +205,15 @@ def leaf_owners(leaf_idx: int, world: int, replication: int) -> Tuple[int, ...]:
 
 
 # --------------------------------------------------------------------------
-# Host checkpoints: the standard manifest-v2 + COMMIT format, written and
-# read without jax so elastic workers stay light. verify_checkpoint /
-# restore_candidates in train/checkpoint.py accept these unchanged.
+# Host checkpoints: the standard manifest-v2 + COMMIT format (and, r17,
+# the per-rank sharded world-commit format), written and read without
+# jax so elastic workers stay light. The machinery lives in
+# train/ckpt_io.py; verify_checkpoint / restore_candidates in
+# train/checkpoint.py accept everything written here unchanged.
 # --------------------------------------------------------------------------
 
-_MANIFEST = "manifest.json"
-_COMMIT = "COMMIT"
+_MANIFEST = ckpt_io._MANIFEST
+_COMMIT = ckpt_io._COMMIT
 
 
 def save_host_checkpoint(
@@ -212,92 +227,27 @@ def save_host_checkpoint(
     per-shard CRC, COMMIT marker, tmp+swing) — ``verify_checkpoint``
     applies to it unchanged, which is how the resize drill audits its
     fallback basis."""
-    final = os.path.join(ckpt_dir, tag)
-    tmp = final + ".tmp"
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
-    os.makedirs(tmp, exist_ok=True)
-    entries = []
-    for i, name in enumerate(sorted(leaves)):
-        arr = np.ascontiguousarray(leaves[name])
-        fname = f"{i:05d}_{name[:72]}.p0s0.npy"
-        path = os.path.join(tmp, fname)
-        np.save(path, arr)
-        value, nbytes = checksum_file(path)
-        shard = {
-            "file": fname,
-            "start": [0] * arr.ndim,
-            "stop": list(arr.shape),
-            "bytes": nbytes,
-        }
-        if value is not None:
-            shard["checksum"] = value
-            shard["checksum_algo"] = PREFERRED_ALGO
-        faults.check("ckpt.write_shard", path=path)
-        entries.append(
-            {
-                "path": name,
-                "shape": list(arr.shape),
-                "dtype": str(arr.dtype),
-                "shards": [shard],
-            }
-        )
-    manifest_path = os.path.join(tmp, _MANIFEST)
-    with open(manifest_path, "w") as f:
-        json.dump({"version": 2, "step": int(step), "leaves": entries}, f,
-                  indent=1)
-    value, nbytes = checksum_file(manifest_path)
-    commit = {"step": int(step), "manifest_bytes": nbytes}
-    if value is not None:
-        commit["manifest_checksum"] = value
-        commit["checksum_algo"] = PREFERRED_ALGO
-    with open(os.path.join(tmp, _COMMIT), "w") as f:
-        json.dump(commit, f)
-    # the swing, same semantics as checkpoint._swing
-    old = final + ".old"
-    if os.path.exists(old):
-        shutil.rmtree(old)
-    if os.path.exists(final):
-        os.replace(final, old)
-    faults.check("ckpt.swing", path=final)
-    os.replace(tmp, final)
-    if os.path.exists(old):
-        shutil.rmtree(old)
-    return final
+    return ckpt_io.save_single_checkpoint(ckpt_dir, leaves, step, tag)
 
 
 def load_host_checkpoint(
     ckpt_dir: str, tag: str = "latest"
 ) -> Tuple[Dict[str, np.ndarray], int]:
-    """Read a (host-written or single-process) checkpoint back as flat
-    arrays, newest shard layout only — the jax-free counterpart of
-    ``restore_checkpoint`` the disk-fallback path uses."""
-    final = os.path.join(ckpt_dir, tag)
-    with open(os.path.join(final, _MANIFEST)) as f:
-        manifest = json.load(f)
-    out: Dict[str, np.ndarray] = {}
-    for entry in manifest["leaves"]:
-        shards = entry["shards"]
-        if len(shards) != 1:
-            raise ValueError(
-                f"leaf {entry['path']!r} has {len(shards)} shards — the "
-                "host loader reads single-shard checkpoints only"
-            )
-        if faults.active():  # armed-only arg evaluation (PTD002)
-            faults.check(
-                "ckpt.read_shard",
-                path=os.path.join(final, shards[0]["file"]),
-            )
-        out[entry["path"]] = np.load(
-            os.path.join(final, shards[0]["file"])
-        )
-    return out, int(manifest["step"])
+    """Read a checkpoint back as flat arrays — the jax-free counterpart
+    of ``restore_checkpoint`` the disk-fallback path uses. Multi-shard
+    leaves assemble through the same ``_assemble`` box reads
+    ``restore_checkpoint`` uses (the r17 removal of the old single-
+    shard-only refusal), and per-rank sharded saves load through the
+    world-commit reader, whatever world size wrote them."""
+    loaded = ckpt_io.load_checkpoint(os.path.join(ckpt_dir, tag))
+    return loaded.leaves, loaded.step
 
 
 def host_checkpoint_exists(ckpt_dir: Optional[str], tag: str = "latest") -> bool:
-    return bool(ckpt_dir) and os.path.isfile(
-        os.path.join(ckpt_dir, tag, _MANIFEST)
-    )
+    """True when a RESTORABLE checkpoint exists for ``tag``: the default
+    ``latest`` widens to the newest step tag, and a sharded save counts
+    only once its WORLD_COMMIT landed (the two-phase absence rule)."""
+    return bool(ckpt_dir) and ckpt_io.resolve_tag(ckpt_dir, tag) is not None
 
 
 # --------------------------------------------------------------------------
@@ -317,7 +267,15 @@ class ElasticConfig:
     # sole-copy loss and exercises the disk fallback
     ckpt_dir: Optional[str] = None
     ckpt_every: int = 8  # steps between checkpoints (0 = genesis +
-    # run-completion saves only; every run ends by refreshing 'latest')
+    # run-completion saves only)
+    ckpt_format: str = "sharded"  # "sharded": each rank writes only the
+    # leaves it owns, under the two-phase world-commit protocol (r17) —
+    # step-tagged so restore can walk back an epoch; "full": the pre-r17
+    # gather-to-rank-0 single-dir 'latest' save (the A/B baseline the
+    # bench checkpoint_shard phase measures against)
+    ckpt_keep: int = 2  # step-tagged epochs retained by the post-save
+    # prune; the prune's safety rule still never deletes the only
+    # restorable one
     data_seed: int = 0
     task: TaskConfig = dataclasses.field(default_factory=TaskConfig)
     on_peer_loss: str = "resize"  # "resize" (in-process) | "exit" (the
@@ -344,6 +302,15 @@ class ElasticConfig:
             raise ValueError(
                 f"global_batch {self.global_batch} must divide into "
                 f"microshards {self.microshards}"
+            )
+        if self.ckpt_format not in ("sharded", "full"):
+            raise ValueError(
+                f"ckpt_format must be 'sharded' or 'full', got "
+                f"{self.ckpt_format!r}"
+            )
+        if self.ckpt_keep < 1:
+            raise ValueError(
+                f"ckpt_keep must be >= 1, got {self.ckpt_keep}"
             )
         if self.on_peer_loss not in ("resize", "exit"):
             raise ValueError(
@@ -451,6 +418,13 @@ class ElasticWorldEngine:
         self._pending_cursor: Optional[dict] = None
         self._writer: Optional[_Jsonl] = None
         self.losses: List[float] = []
+        # checkpoint provenance: counters for the result summary plus an
+        # audit-record buffer (split="ckpt") — genesis saves land before
+        # the writer opens, so records queue until _open_writer flushes
+        self.ckpt_stats = {
+            "saves": 0, "restores": 0, "peer_fetches": 0, "walked_back": 0,
+        }
+        self._ckpt_pending: List[Tuple[int, dict]] = []
 
     # -- world plumbing ----------------------------------------------------
     @property
@@ -475,6 +449,21 @@ class ElasticWorldEngine:
             self._writer = None
         if self.cfg.metrics_path and self.rank == 0:
             self._writer = _Jsonl(self.cfg.metrics_path)
+        self._flush_ckpt_audit()
+
+    def _audit_ckpt(self, event: str, payload: dict) -> None:
+        """Queue a split="ckpt" audit record (save/restore provenance:
+        format, world size, peer fetches, walk-backs) for the metrics
+        stream; obs_report's Checkpoint section renders these."""
+        self._ckpt_pending.append((self.step, {"event": event, **payload}))
+        self._flush_ckpt_audit()
+
+    def _flush_ckpt_audit(self) -> None:
+        if self._writer is None:
+            return
+        for step, rec in self._ckpt_pending:
+            self._writer.write(step, rec, split="ckpt")
+        self._ckpt_pending.clear()
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -552,6 +541,7 @@ class ElasticWorldEngine:
                 if self._assignment is not None else None
             ),
             "goodput": summary,
+            "ckpt": dict(self.ckpt_stats, format=self.cfg.ckpt_format),
             "wall_s": time.monotonic() - t0,
             "ok": True,
         }
@@ -807,40 +797,140 @@ class ElasticWorldEngine:
         )
         return leaves
 
+    def _ckpt_leaf_names(self) -> List[str]:
+        """Every leaf name a complete checkpoint must carry — the
+        world-commit completeness guard compares against this."""
+        return (
+            [f"params_{n}" for n in self._leaf_names]
+            + [f"momentum_{n}" for n in self._leaf_names]
+            + ["elastic_cursor"]
+        )
+
+    def _owned_ckpt_leaves(self) -> Dict[str, np.ndarray]:
+        """The checkpoint leaves THIS rank persists in a sharded save:
+        the params_/momentum_ pair of every leaf it owns — so disk
+        carries exactly the replication the memory layout does, and no
+        gather collective runs at save time — plus the tiny
+        elastic_cursor in EVERY rank dir (40 bytes buys the control
+        state surviving any single loss)."""
+        w = self.world_size
+        cursor, data_epoch = self._cursor_state()
+        leaves: Dict[str, np.ndarray] = {}
+        for i, name in enumerate(self._leaf_names):
+            if self.rank in leaf_owners(i, w, self.cfg.replication):
+                leaves[f"params_{name}"] = self.params[name]
+                leaves[f"momentum_{name}"] = self.momentum[name]
+        leaves["elastic_cursor"] = np.array(
+            [cursor.get("epoch", 0), cursor.get("offset", 0),
+             data_epoch, self.step, self._replay_until],
+            np.int64,
+        )
+        return leaves
+
     def _maybe_checkpoint(self) -> None:
-        """Write 'latest' (cadence gating is the caller's: _one_step's
+        """Write a checkpoint (cadence gating is the caller's: _one_step's
         ckpt_every check, plus one unconditional save at genesis and at
         run completion). Uniform collectives — every rank must call this
-        at the same step."""
+        at the same step, which also means membership cannot change
+        mid-save: saves run at step boundaries, inside the same quiesce
+        discipline every other collective sequence uses.
+
+        ``ckpt_format="sharded"`` (default) runs the r17 two-phase
+        distributed save; ``"full"`` is the pre-r17 gather-to-rank-0
+        single-dir 'latest' write, kept as the measured baseline."""
         if not self.cfg.ckpt_dir:
             return
         t0 = time.perf_counter()
         with tracing.span("elastic.checkpoint"):
-            w = self.world_size
-            # gather the momentum shards rank 0 lacks — a uniform
-            # per-leaf broadcast sequence (lockstep: every rank runs the
-            # checkpoint cadence at the same step)
-            full_momentum = {}
-            for i, name in enumerate(self._leaf_names):
-                owners = leaf_owners(i, w, self.cfg.replication)
-                if w > 1:
-                    buf = self.momentum.get(name)
-                    if buf is None:
-                        buf = np.zeros(
-                            self._leaf_shapes[name], np.float32
-                        )
-                    full_momentum[name] = self.ring.broadcast(
-                        buf, src=owners[0]
-                    )
-                else:
-                    full_momentum[name] = self.momentum[name]
-            if self.rank == 0:
-                save_host_checkpoint(
-                    self.cfg.ckpt_dir,
-                    self._checkpoint_leaves(full_momentum),
-                    self.step,
-                )
+            if self.cfg.ckpt_format == "sharded":
+                self._save_sharded()
+            else:
+                self._save_full()
         self.goodput.add("checkpoint", time.perf_counter() - t0)
+
+    def _save_sharded(self) -> None:
+        """The two-phase distributed save (DESIGN.md §22).
+
+        Phase 1: every rank writes its owned leaves + per-rank COMMIT
+        into ``step-<N>.tmp/rank-<r>/`` — no gather, bytes/rank ~
+        replication x full/world. Phase 2: after a barrier proves every
+        COMMIT is down, rank 0 verifies the quorum, writes the
+        WORLD_COMMIT, swings the tmp into place, and prunes old epochs —
+        all inside a deferred-signal window so a polite preemption can't
+        tear the rename sequence (a SIGKILL can, and the two-phase rule
+        makes that torn save read as absent). A rank killed anywhere in
+        here fails a barrier on the survivors, which resize (or raise
+        PeerLost) exactly like any other collective failure."""
+        cfg = self.cfg
+        w, rank = self.world_size, self.rank
+        repl = max(1, min(cfg.replication, w))
+        tag = f"step-{self.step}"
+        tmp = os.path.join(cfg.ckpt_dir, tag) + ".tmp"
+        if rank == 0:
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp, exist_ok=True)
+        if w > 1:
+            self.ring.barrier()  # tmp dir exists before anyone writes
+        nbytes = ckpt_io.save_rank_shards(
+            tmp, rank, self._owned_ckpt_leaves(), self.step,
+            world=w, replication=repl,
+        )
+        if w > 1:
+            self.ring.barrier()  # phase 1 complete: every COMMIT down
+        if rank == 0:
+            expected = self._ckpt_leaf_names()
+            with deferred_signals():
+                wc = ckpt_io.write_world_commit(
+                    tmp, step=self.step, world=w, replication=repl,
+                    expected_leaves=expected,
+                )
+                ckpt_io._swing(cfg.ckpt_dir, tag, tmp)
+                ckpt_io.prune_checkpoints(
+                    cfg.ckpt_dir, keep=cfg.ckpt_keep
+                )
+            self._audit_ckpt(
+                "save",
+                {"format": "sharded", "tag": tag, "world": w,
+                 "replication": repl, "rank_bytes": int(nbytes),
+                 "total_bytes": int(wc["total_bytes"])},
+            )
+        if w > 1:
+            self.ring.barrier()  # the commit is visible everywhere
+        self.ckpt_stats["saves"] += 1
+
+    def _save_full(self) -> None:
+        """The pre-r17 full save: gather the momentum shards rank 0
+        lacks — a uniform per-leaf broadcast sequence (lockstep: every
+        rank runs the checkpoint cadence at the same step) — and rank 0
+        writes the whole state as a single-dir 'latest'."""
+        w = self.world_size
+        full_momentum = {}
+        for i, name in enumerate(self._leaf_names):
+            owners = leaf_owners(i, w, self.cfg.replication)
+            if w > 1:
+                buf = self.momentum.get(name)
+                if buf is None:
+                    buf = np.zeros(
+                        self._leaf_shapes[name], np.float32
+                    )
+                full_momentum[name] = self.ring.broadcast(
+                    buf, src=owners[0]
+                )
+            else:
+                full_momentum[name] = self.momentum[name]
+        if self.rank == 0:
+            save_host_checkpoint(
+                self.cfg.ckpt_dir,
+                self._checkpoint_leaves(full_momentum),
+                self.step,
+            )
+            self._audit_ckpt(
+                "save",
+                {"format": "full", "tag": "latest",
+                 "world": w, "replication": 1},
+            )
+        self.ckpt_stats["saves"] += 1
 
     # -- resize ------------------------------------------------------------
     def _resize(self, reason: str) -> None:
@@ -1066,36 +1156,59 @@ class ElasticWorldEngine:
         }
         self.step = 0
         self._has_state = True
-        if self.rank == 0 and self.cfg.ckpt_dir:
+        if self.cfg.ckpt_dir:
             # the fallback basis must exist before the first loss can;
-            # genesis momentum is zeros everywhere, so rank 0 needs no
-            # gather to write the full set
-            zeros = {
-                n: np.zeros(self._leaf_shapes[n], np.float32)
-                for n in self._leaf_names
-            }
-            save_host_checkpoint(
-                self.cfg.ckpt_dir, self._checkpoint_leaves(zeros), 0
+            # genesis momentum is zeros, so this is cheap — and running
+            # the ordinary save path (all ranks, uniform) means the
+            # genesis checkpoint exercises the same format the cadence
+            # saves will
+            self._maybe_checkpoint()
+
+    def _load_fallback(self) -> Tuple[Dict[str, np.ndarray], int, dict]:
+        """Rank 0's half of the disk fallback: mop up stranded writes,
+        then restore the NEWEST restorable checkpoint — sharded saves
+        without a WORLD_COMMIT read as absent, a lost sole copy pulls
+        the replication peer's, and a checkpoint with no surviving copy
+        of some leaf walks back an epoch (ckpt_io.load_best_checkpoint
+        does all three). Returns (leaves, step, audit-metadata)."""
+        recovered = ckpt_io.recover_stranded_checkpoints(self.cfg.ckpt_dir)
+        loaded = ckpt_io.load_best_checkpoint(self.cfg.ckpt_dir)
+        if loaded is None:
+            raise ckpt_io.CheckpointCorrupted(
+                f"disk fallback found no restorable checkpoint under "
+                f"{self.cfg.ckpt_dir!r}"
             )
+        meta = {
+            "tag": loaded.tag,
+            "ckpt_world": loaded.world,
+            "sharded": loaded.sharded,
+            "peer_fetches": loaded.peer_fetches,
+            "walked_back": loaded.walked_back,
+            "recovered": list(recovered),
+        }
+        return loaded.leaves, loaded.step, meta
 
     def _disk_fallback(self) -> None:
         """Adopt the last on-disk checkpoint on every rank, then let the
         ordinary (deterministic) loop replay the lost steps. Rank 0
         reads; everyone receives via uniform broadcasts — N ranks must
         not each re-read the checkpoint, and more importantly they must
-        adopt the SAME one."""
+        adopt the SAME one. Re-shard aware: the checkpoint's world size
+        is whatever it is; _adopt_checkpoint keeps only the leaves THIS
+        world's ownership map assigns this rank."""
         w, rank = self.world_size, self.rank
         pre_step = self.step if self._has_state else 0
         t0 = time.perf_counter()
         if w == 1:
-            leaves, step = load_host_checkpoint(self.cfg.ckpt_dir)
+            leaves, step, meta = self._load_fallback()
             self._adopt_checkpoint(leaves, step, pre_step)
         else:
             blob = b""
             if rank == 0:
-                leaves, step = load_host_checkpoint(self.cfg.ckpt_dir)
+                leaves, step, meta = self._load_fallback()
                 blob = pickle.dumps(
-                    (leaves, step), protocol=pickle.HIGHEST_PROTOCOL
+                    (leaves, step, meta),
+                    protocol=pickle.HIGHEST_PROTOCOL,
                 )
             payload = np.frombuffer(blob, np.uint8)
             n = int(
@@ -1105,10 +1218,17 @@ class ElasticWorldEngine:
             )
             buf = np.zeros(n, np.uint8)
             buf[: len(payload)] = payload
-            leaves, step = pickle.loads(
+            leaves, step, meta = pickle.loads(
                 self.ring.broadcast(buf, src=0).tobytes()
             )
             self._adopt_checkpoint(leaves, step, pre_step)
+        self.ckpt_stats["restores"] += 1
+        self.ckpt_stats["peer_fetches"] += meta["peer_fetches"]
+        self.ckpt_stats["walked_back"] += meta["walked_back"]
+        if rank == 0:
+            self._audit_ckpt(
+                "restore", dict(meta, restored_step=int(step))
+            )
         self.goodput.add("recovering", time.perf_counter() - t0)
 
     def _adopt_checkpoint(
@@ -1172,6 +1292,12 @@ def run_worker(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--replication", type=int, default=2)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--ckpt-every", type=int, default=8)
+    p.add_argument("--ckpt-format", choices=("sharded", "full"),
+                   default="sharded",
+                   help="sharded = r17 per-rank shards + world commit; "
+                   "full = pre-r17 gather-to-rank-0 single dir")
+    p.add_argument("--ckpt-keep", type=int, default=2,
+                   help="world-complete sharded epochs to keep on disk")
     p.add_argument("--data-seed", type=int, default=0)
     p.add_argument("--on-peer-loss", choices=("resize", "exit"),
                    default="resize")
@@ -1200,6 +1326,8 @@ def run_worker(argv: Optional[List[str]] = None) -> int:
         replication=args.replication,
         ckpt_dir=args.ckpt_dir,
         ckpt_every=args.ckpt_every,
+        ckpt_format=args.ckpt_format,
+        ckpt_keep=args.ckpt_keep,
         data_seed=args.data_seed,
         on_peer_loss=args.on_peer_loss,
         metrics_path=args.metrics_path,

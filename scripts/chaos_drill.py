@@ -38,7 +38,8 @@ def parse_args(argv=None):
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
-    p.add_argument("--drill", choices=("kill_resume", "resize"),
+    p.add_argument("--drill", choices=("kill_resume", "resize",
+                                       "ckpt_shard"),
                    default="kill_resume",
                    help="kill_resume: SIGKILL the whole training process "
                    "and restart it from disk (the original drill). "
@@ -46,7 +47,13 @@ def parse_args(argv=None):
                    "world mid-run, assert the survivors re-mesh "
                    "IN-PROCESS and finish bit-identical to an unresized "
                    "reference, then grow back to full world and assert "
-                   "the same (train/elastic_world.py)")
+                   "the same (train/elastic_world.py). "
+                   "ckpt_shard: kill one rank MID-DISTRIBUTED-SAVE "
+                   "(after its shards, before its per-rank COMMIT), "
+                   "assert the torn epoch reads as absent, restart the "
+                   "whole world, and assert it restores the newest "
+                   "world-COMPLETE epoch and finishes bit-identical to "
+                   "an uninterrupted reference (train/ckpt_io.py)")
     p.add_argument("--world", type=int, default=3,
                    help="[resize] genesis world size")
     p.add_argument("--total-steps", type=int, default=36,
@@ -194,9 +201,18 @@ def resize_main(args):
         for w in survivors for v in results.get(w, {}).get("views", [])
     )
     no_restart = all(codes.get(w) == 0 for w in survivors)
-    from pytorch_distributed_tpu.train.checkpoint import verify_checkpoint
+    from pytorch_distributed_tpu.train.checkpoint import (
+        resolve_tag,
+        verify_checkpoint,
+    )
 
-    problems = verify_checkpoint(ckpt_dir)
+    # sharded saves are step-tagged (full-format keeps 'latest'):
+    # resolve the newest restorable tag, whichever format wrote it
+    tag = resolve_tag(ckpt_dir)
+    problems = (
+        verify_checkpoint(ckpt_dir, tag) if tag is not None
+        else ["no restorable checkpoint found"]
+    )
     resize_log = []
     for w in survivors:
         for rec in results.get(w, {}).get("resizes", []):
@@ -237,10 +253,146 @@ def resize_main(args):
     return 0 if passed else 1
 
 
+def ckpt_shard_main(args):
+    """The mid-distributed-save drill: one rank of a sharded-checkpoint
+    world is killed AFTER writing its shard files but BEFORE its
+    per-rank COMMIT (the ``ckpt.rank_commit`` site, ``mode=kill``). The
+    two-phase protocol must make that torn epoch read as ABSENT: a
+    restarted world restores the newest world-COMPLETE epoch instead,
+    replays, and finishes bit-identical to an uninterrupted reference.
+    """
+    from pytorch_distributed_tpu.launch import ElasticWorldLauncher
+    from pytorch_distributed_tpu.train import ckpt_io
+    from pytorch_distributed_tpu.train.elastic import EX_TEMPFAIL
+    from pytorch_distributed_tpu.train.elastic_world import (
+        ElasticConfig,
+        reference_run,
+    )
+
+    base = args.ckpt_dir or tempfile.mkdtemp(prefix="ckpt_shard_drill_")
+    owns_dir = args.ckpt_dir is None
+    ckpt_dir = os.path.join(base, "ckpt")
+    t0 = time.monotonic()
+    ckpt_every = 3
+    # the victim's rank_commit hit sequence: genesis save (hit 1), then
+    # one per cadence save — after=2 fires on hit 3, i.e. mid-save at
+    # step 2*ckpt_every, leaving step-<ckpt_every> the newest COMPLETE
+    kill_hits = 2
+    torn_step = 2 * ckpt_every
+    complete_step = ckpt_every
+    worker_args = (
+        "--total-steps", str(args.total_steps),
+        "--global-batch", "16", "--microshards", "4",
+        "--ckpt-dir", ckpt_dir, "--ckpt-every", str(ckpt_every),
+        "--ckpt-format", "sharded",
+        "--replication", str(args.replication),
+        "--data-seed", str(args.seed),
+        "--on-peer-loss", "exit",
+        "--ring-timeout-s", str(args.ring_timeout_s),
+        "--metrics-path", os.path.join(base, "metrics.jsonl"),
+    )
+    ids = [f"w{i}" for i in range(args.world)]
+    victim = ids[-1]
+    launcher = ElasticWorldLauncher(
+        os.path.join(base, "rdv"), worker_args=worker_args
+    )
+    launcher.start_world(ids, env_overrides={victim: {
+        "PTD_FAULTS": (
+            f"ckpt.rank_commit:mode=kill,count=1,after={kill_hits}"
+        ),
+        "PTD_FAULTS_SEED": str(args.seed),
+    }})
+    codes1 = launcher.wait(120)
+    victim_rc = codes1.get(victim)
+    interrupted = victim_rc not in (0, None) and all(
+        codes1.get(w) not in (0, None) for w in ids
+    )
+
+    # the on-disk state the restart will see: the torn epoch's .tmp has
+    # no WORLD_COMMIT and must read as absent; the newest restorable tag
+    # is the last world-COMPLETE epoch
+    torn_tmps = sorted(
+        n for n in os.listdir(ckpt_dir) if n.endswith(".tmp")
+    ) if os.path.isdir(ckpt_dir) else []
+    torn_is_absent = all(
+        ckpt_io._read_world_commit(os.path.join(ckpt_dir, n)) is None
+        for n in torn_tmps
+    )
+    newest_tag = ckpt_io.resolve_tag(ckpt_dir)
+    newest_step = (
+        ckpt_io.checkpoint_step(ckpt_dir, newest_tag)
+        if newest_tag is not None else None
+    )
+
+    # restart the whole world, clean, against the same checkpoint dir
+    # (fresh rendezvous: the die-and-restore baseline's agent would)
+    ids2 = [f"r{i}" for i in range(args.world)]
+    launcher2 = ElasticWorldLauncher(
+        os.path.join(base, "rdv2"), worker_args=worker_args
+    )
+    launcher2.start_world(ids2)
+    codes2 = launcher2.wait(240)
+    results = launcher2.results()
+
+    ref = reference_run(ElasticConfig(
+        total_steps=args.total_steps,
+        replication=args.replication, data_seed=args.seed,
+    ))
+    crcs = {w: results.get(w, {}).get("params_crc") for w in ids2}
+    bit_exact = all(c == ref["params_crc"] for c in crcs.values())
+    finished = all(
+        results.get(w, {}).get("final_step") == args.total_steps
+        and codes2.get(w) == 0
+        for w in ids2
+    )
+    ckpt_stats = {
+        w: results.get(w, {}).get("ckpt", {}) for w in ids2
+    }
+    restored = all(
+        s.get("restores", 0) >= 1 and s.get("walked_back", 0) == 0
+        for s in ckpt_stats.values()
+    )
+    passed = (
+        interrupted
+        and bool(torn_tmps) and torn_is_absent
+        and newest_step == complete_step
+        and restored and finished and bit_exact
+    )
+    print(json.dumps({
+        "drill": "ckpt_shard",
+        "world": args.world,
+        "victim": victim,
+        "victim_rc": victim_rc,
+        "survivor_rc_expected": EX_TEMPFAIL,
+        "exit_codes_interrupted": codes1,
+        "torn_tmp_dirs": torn_tmps,
+        "torn_step_expected": torn_step,
+        "torn_reads_absent": torn_is_absent,
+        "newest_complete_tag": newest_tag,
+        "newest_complete_step": newest_step,
+        "restart_exit_codes": codes2,
+        "restored": restored,
+        "ckpt_stats": ckpt_stats,
+        "completed": finished,
+        "bit_exact_vs_reference": bit_exact,
+        "reference_params_crc": ref["params_crc"],
+        "params_crc": crcs,
+        "wall_s": round(time.monotonic() - t0, 2),
+        "passed": passed,
+    }))
+    if passed and owns_dir:
+        shutil.rmtree(base, ignore_errors=True)
+    elif not passed:
+        print(f"# drill dir kept for autopsy: {base}", file=sys.stderr)
+    return 0 if passed else 1
+
+
 def main(argv=None):
     args = parse_args(argv)
     if args.drill == "resize":
         return resize_main(args)
+    if args.drill == "ckpt_shard":
+        return ckpt_shard_main(args)
     import numpy as np
 
     rng = np.random.default_rng(args.seed)
@@ -295,12 +447,14 @@ def main(argv=None):
     from pytorch_distributed_tpu.train.checkpoint import (
         checkpoint_step,
         recover_stranded_checkpoints,
+        resolve_tag,
         verify_checkpoint,
     )
 
     recovered = recover_stranded_checkpoints(ckpt_dir)
-    final_step = checkpoint_step(ckpt_dir)
-    problems = verify_checkpoint(ckpt_dir)
+    tag = resolve_tag(ckpt_dir) or "latest"
+    final_step = checkpoint_step(ckpt_dir, tag)
+    problems = verify_checkpoint(ckpt_dir, tag)
     passed = (
         ok and final_step == expected_final and not problems
     )

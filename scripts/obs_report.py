@@ -24,6 +24,12 @@ investigation starts from —
   gauge the elastic balancer emits at each rebalance boundary, and
   the rebalance audit trail (``split="elastic"`` records: per-rank
   shard counts, measured skew, whether ownership moved),
+* checkpoint: the ``split="ckpt"`` audit trail — every save's
+  format/tag/world/replication and per-rank vs total bytes, every
+  restore's adopted tag with its peer-fetch / walk-back / stranded-
+  write counts — plus per-rank ``elastic.checkpoint`` save walls from
+  a merged trace (sharded saves should be balanced; the full format
+  concentrates the write on rank 0),
 * plan: the auto-parallel planner's ranked candidate table when a
   ``plan.json`` (``--strategy auto`` / autoplan/planner.py) sits in
   the run dir — the audit trail for why this run's strategy was
@@ -453,6 +459,105 @@ def stragglers_section(events, records, out):
     return summary
 
 
+def checkpoint_section(events, records, out):
+    """The checkpoint audit trail + per-rank save cost (r17).
+
+    Two inputs, each optional: ``split="ckpt"`` records the elastic
+    engine writes (every save names its format/tag/world/replication
+    and — sharded — this rank's bytes vs the world total; every restore
+    names the tag it adopted, the world that WROTE it, and how hard the
+    loader had to work: peer fetches, epochs walked back, stranded
+    writes mopped up), and merged-trace ``elastic.checkpoint`` spans
+    (pid = rank after trace_merge), which show whether save cost is
+    balanced across ranks — the point of sharding it."""
+    recs = [r for r in records if r.get("split") == "ckpt"]
+    saves = [r for r in recs if r.get("event") == "save"]
+    restores = [r for r in recs if r.get("event") == "restore"]
+    per_rank = {}
+    for ev in events:
+        if ev.get("ph") == "X" and ev.get("name") == "elastic.checkpoint":
+            per_rank.setdefault(ev.get("pid"), []).append(
+                float(ev.get("dur", 0.0)) / 1e3
+            )
+    if not recs and len(per_rank) < 2:
+        return None
+    print("\n== Checkpoint ==", file=out)
+    summary = {
+        "saves": len(saves),
+        "restores": len(restores),
+        "peer_fetches": sum(
+            int(r.get("peer_fetches", 0)) for r in restores
+        ),
+        "walked_back": sum(
+            int(r.get("walked_back", 0)) for r in restores
+        ),
+    }
+    if recs:
+        sharded = sum(1 for r in saves if r.get("format") == "sharded")
+        print(
+            f"  saves: {len(saves)} ({sharded} sharded, "
+            f"{len(saves) - sharded} full); restores: {len(restores)}",
+            file=out,
+        )
+        for r in saves:
+            if r.get("format") == "sharded":
+                detail = (
+                    f"world {r.get('world', '?')} repl "
+                    f"{r.get('replication', '?')}  rank "
+                    f"{r.get('rank_bytes', 0) / 1e6:.2f}MB / total "
+                    f"{r.get('total_bytes', 0) / 1e6:.2f}MB"
+                )
+            else:
+                detail = f"world {r.get('world', '?')} (gather to rank 0)"
+            print(
+                f"    step {r.get('step', '?'):>6}  save     "
+                f"{r.get('format', '?'):<8} tag {r.get('tag', '?'):<12} "
+                f"{detail}", file=out,
+            )
+        for r in restores:
+            extras = []
+            if r.get("peer_fetches"):
+                extras.append(
+                    f"peer_fetches {r['peer_fetches']} <-- sole-copy "
+                    f"loss repaired from the replication peer"
+                )
+            if r.get("walked_back"):
+                extras.append(
+                    f"walked back {r['walked_back']} epoch(s) <-- "
+                    f"INVESTIGATE (a whole checkpoint was unrestorable)"
+                )
+            if r.get("recovered"):
+                extras.append(f"recovered {r['recovered']}")
+            print(
+                f"    step {r.get('step', '?'):>6}  restore  "
+                f"tag {r.get('tag', '?'):<12} wrote by world "
+                f"{r.get('ckpt_world', '?')} -> step "
+                f"{r.get('restored_step', '?')}"
+                + ("  " + "; ".join(extras) if extras else ""),
+                file=out,
+            )
+    if len(per_rank) >= 2:
+        totals = {r: sum(d) for r, d in per_rank.items()}
+        balance = max(totals.values()) / max(min(totals.values()), 1e-9)
+        summary["save_wall_skew"] = round(balance, 4)
+        print(
+            f"  per-rank save wall (merged trace, elastic.checkpoint):",
+            file=out,
+        )
+        for r in sorted(per_rank):
+            d = per_rank[r]
+            print(
+                f"    rank{r}: {len(d)} save(s), total "
+                f"{totals[r]:.2f}ms, max {max(d):.2f}ms", file=out,
+            )
+        print(
+            f"  save-wall skew (slowest/fastest rank): {balance:.2f}x "
+            f"(sharded saves should be balanced; the full format "
+            f"concentrates the write on rank 0)", file=out,
+        )
+    return summary
+
+
 def _fmt_row(cols, widths):
     return "  ".join(str(c).rjust(w) for c, w in zip(cols, widths))
 
@@ -578,6 +683,9 @@ def report(trace_path, metric_paths, top_n=10, out=None,
     # -- stragglers (r15: heterogeneity picture) ---------------------------
     stragglers = stragglers_section(events, records, out)
 
+    # -- checkpoint audit (r17: sharded save/restore trail) ----------------
+    ckpt = checkpoint_section(events, records, out)
+
     # -- auto-parallel plan ------------------------------------------------
     plan_doc = plan_section(plan_path, out)
 
@@ -681,7 +789,7 @@ def report(trace_path, metric_paths, top_n=10, out=None,
             )
     return {"spans": rows, "recompiles": recompiles, "goodput": g,
             "comms": comms or {}, "stragglers": stragglers or {},
-            "plan": plan_doc, "serve": serve}
+            "checkpoint": ckpt or {}, "plan": plan_doc, "serve": serve}
 
 
 def main(argv=None):

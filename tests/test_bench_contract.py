@@ -272,6 +272,29 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
     assert "multihost" in pd[0]["value"], pd[0]
     assert durations.get("multihost", 999) < 120, durations
 
+    # the ckpt_shard phase (r17): at replication=1 every rank of the
+    # sharded save must write <= 1.2x its fair share of the full
+    # checkpoint's bytes (the acceptance pin; replication=2 carries two
+    # copies of every leaf, so its bound is the same pin scaled by 2),
+    # with restore CRC-equality vs the source state enforced INSIDE the
+    # phase — and the mid-distributed-save kill drill must pass: torn
+    # epoch reads as absent, restart restores the newest world-COMPLETE
+    # epoch, final params bit-identical to the uninterrupted reference
+    cs = one_metric("ckpt_shard_rank_bytes_ratio")
+    assert 0 < cs["value"] <= 1.2, (
+        f"sharded save wrote more than its fair share per rank: {cs}"
+    )
+    assert 0 < cs["replication2_ratio"] <= 2.4, cs
+    assert cs["manifest_shrink_r1"] >= 2, cs
+    assert cs["full_bytes"] > 0 and len(cs["rank_bytes_r1"]) == 3, cs
+    drill = one_metric("ckpt_shard_drill_wall_s")
+    assert drill["passed"] is True, drill
+    assert drill["torn_reads_absent"] is True, drill
+    assert drill["newest_complete_step"] == 3, drill
+    assert drill["bit_exact_vs_reference"] is True, drill
+    assert "ckpt_shard" in pd[0]["value"], pd[0]
+    assert durations.get("ckpt_shard", 999) < 120, durations
+
     # the comms phase: q8's RECORDED wire bytes at gradient size must be
     # <= 0.3x f32 (the encoding is int8 + one f32 scale per 256 elems,
     # ~0.254 — ROADMAP item 1's bytes-moved-reduction number, measured
