@@ -129,6 +129,21 @@ Known sites (grep for ``faults.check`` to find the exact spots):
                      the bench multihost phase arms it identically under
                      hierarchical and flat paths so the measured ratio
                      isolates bytes-over-the-slow-link, not noise
+``serve.engine_loss`` checked once per live engine per router step
+                     (``serve/router.py``; ``path`` is the engine id,
+                     so ``match=<engine_id>`` picks the victim) —
+                     ``mode=raise`` loses that engine mid-request: the
+                     router stops driving it, evicts its live requests,
+                     and replays them from scratch on a surviving peer
+                     (the elastic evict-and-replay idiom applied to
+                     serving; ``after=N`` times the loss mid-storm)
+``serve.kv_migrate`` before a prefill-tier engine packs a finished
+                     request's page frames for migration
+                     (``serve/engine.py``; ``path`` is the request id)
+                     — ``mode=raise`` fails the hand-off: the request
+                     is evicted (FAILED) on the prefill engine, which
+                     keeps serving — same degrade-don't-crash contract
+                     as ``serve.prefill``
 ================== ====================================================
 """
 
@@ -179,6 +194,8 @@ KNOWN_SITES = (
     "comm.overlap_stall",
     "transport.link_lost",
     "transport.slow_link",
+    "serve.engine_loss",
+    "serve.kv_migrate",
 )
 _MODES = ("raise", "kill", "truncate", "bitflip", "throttle")
 

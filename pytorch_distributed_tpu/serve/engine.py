@@ -64,7 +64,8 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any, Optional
+from collections import deque
+from typing import Any, Deque, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -75,6 +76,12 @@ from pytorch_distributed_tpu.generation import (
     decode_step_body,
     model_max_len,
 )
+from pytorch_distributed_tpu.serve.disagg import (
+    MigrationError,
+    MigrationFrame,
+    request_from_wire,
+    request_to_wire,
+)
 from pytorch_distributed_tpu.ops.paged_attention import (
     PagedView,
     paged_view,
@@ -84,8 +91,11 @@ from pytorch_distributed_tpu.runtime import faults
 from pytorch_distributed_tpu.runtime import tracing
 from pytorch_distributed_tpu.serve.kv_slots import (
     PagedKVPool,
+    extract_frames,
+    frame_signature,
     gather_pages,
     scatter_kv,
+    splice_frames,
 )
 from pytorch_distributed_tpu.serve.sampling import (
     TOP_K_OFF,
@@ -149,12 +159,36 @@ class EngineConfig:
     # keeps the round-11 full-width gather programs — the A/B baseline
     # bench.py's serving_paged_attn phase measures the paged path against
     decode_mode: str = "paged"
+    # r18 tiers: "solo" (the default — the bit-identical A/B baseline,
+    # every pre-r18 code path byte-for-byte unchanged) serves requests
+    # end to end; "prefill" fills pages and ships MigrationFrames via
+    # ``outbox`` instead of decoding; "decode" owns the tick and takes
+    # work via ``inject_migration`` only. All three roles drive the SAME
+    # jitted programs — a role only changes which ones a request reaches.
+    role: str = "solo"
+    # fleet label: stamps telemetry records (engine_id gauge label) and
+    # migration frames; None keeps the single-engine-implicit schema
+    engine_id: Optional[str] = None
+    # synthetic per-token compute (the r15 ``shard_delay_s`` idiom for
+    # serving): what a disaggregated tier can actually overlap. A
+    # prefill chunk sleeps prefill_delay_s * chunk_len; a decode tick
+    # sleeps decode_delay_s * active_slots. Bench/chaos only — sleeps
+    # never touch the math, so CRCs are invariant to either knob, and a
+    # 1-core host running N sleeping processes behaves like an N-way
+    # fleet (compute overlaps; the python between sleeps serializes).
+    prefill_delay_s: float = 0.0
+    decode_delay_s: float = 0.0
 
     def __post_init__(self):
         if self.decode_mode not in ("paged", "dense"):
             raise ValueError(
                 f"decode_mode must be 'paged' or 'dense', got "
                 f"{self.decode_mode!r}"
+            )
+        if self.role not in ("solo", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'solo', 'prefill' or 'decode', got "
+                f"{self.role!r}"
             )
         if self.num_slots < 1:
             raise ValueError("num_slots must be >= 1")
@@ -172,6 +206,11 @@ class EngineConfig:
             raise ValueError(
                 f"prefill_chunk {self.prefill_chunk} > max_len "
                 f"{self.max_len}: no request could ever be admitted"
+            )
+        if self.prefill_delay_s < 0 or self.decode_delay_s < 0:
+            raise ValueError(
+                "prefill_delay_s / decode_delay_s must be >= 0, got "
+                f"{self.prefill_delay_s} / {self.decode_delay_s}"
             )
         if self.page_size is not None and (
             self.page_size < 1 or self.max_len % self.page_size
@@ -205,13 +244,38 @@ class ServeEngine:
         *,
         spec: Optional[SpecConfig] = None,
         telemetry: Optional[ServeTelemetry] = None,
+        prefix_store=None,
         clock=time.monotonic,
     ):
         self.model = model
         self.params = params
         self.config = config
         self.spec = spec
-        self.telemetry = telemetry or ServeTelemetry(clock=clock)
+        self.role = config.role
+        self.engine_id = config.engine_id
+        if config.role != "solo" and spec is not None:
+            # tiered speculation would also have to migrate the DRAFT
+            # pool's pages and re-derive its rng chain — future work;
+            # refuse loudly rather than ship a frame the decode tier
+            # cannot faithfully adopt
+            raise ValueError(
+                f"role={config.role!r} requires spec=None: speculative "
+                "decoding is solo-engine only (the draft cache does not "
+                "ride the migration frame)"
+            )
+        if prefix_store is not None and spec is not None:
+            raise ValueError(
+                "prefix_store requires spec=None: store adoption splices "
+                "target pages only, and a draft pool sharing the slot "
+                "would miss the prefix"
+            )
+        self.telemetry = telemetry or ServeTelemetry(
+            clock=clock, engine_id=config.engine_id
+        )
+        if self.telemetry.engine_id is None and config.engine_id:
+            # caller-supplied telemetry inherits the fleet label so
+            # merged multi-engine streams stay disambiguable
+            self.telemetry.engine_id = config.engine_id
         self._clock = clock
         limit = model_max_len(model)
         if limit is not None and config.max_len > limit:
@@ -246,6 +310,34 @@ class ServeEngine:
             # emitted horizon — reserved at admission, checked at submit
             self._spec_tail = spec.num_draft_tokens
         self.scheduler = Scheduler(config.num_slots, config.prefill_chunk)
+        # -- r18 fleet state ------------------------------------------------
+        # one geometry string commits the pool's frame layout; every
+        # migration packet and store access is fingerprint-checked
+        # against it (the _verify_p2p DETAIL idiom, per hand-off)
+        self.migration_signature = frame_signature(
+            self.pool.cache, self.pool.page_size
+        )
+        #: prefill role: packed frames awaiting the router's pick-up
+        self.outbox: Deque[MigrationFrame] = deque()
+        #: decode/solo role: injected handles awaiting slot capacity
+        self._inject_backlog: Deque[RequestHandle] = deque()
+        self._store = prefix_store
+        if prefix_store is not None:
+            sig = getattr(prefix_store, "signature", None)
+            if sig is None:
+                # first engine to attach commits the fleet geometry
+                prefix_store.signature = self.migration_signature
+            elif sig != self.migration_signature:
+                raise ValueError(
+                    "prefix-store geometry mismatch at attach: store "
+                    f"holds {sig!r}, this engine's pool is "
+                    f"{self.migration_signature!r}"
+                )
+        self._holder = config.engine_id or f"engine-{id(self):x}"
+        self.migrated_out = 0          # frames shipped (prefill role)
+        self.migrated_in = 0           # frames spliced (decode/solo)
+        self.store_published_pages = 0
+        self.store_adopted_pages = 0
         S = config.num_slots
         mp = self.pool.max_pages
         # per-slot sampling/decode state lives ON DEVICE and is updated
@@ -363,6 +455,9 @@ class ServeEngine:
         # (measured under cProfile — per-request transitions were half
         # the serving wall-clock), a fused compiled update is ~0.1ms
         self._admit_rows = jax.jit(self._admit_rows_fn)
+        # migration admission writes a DECODING row directly (no
+        # prefill pass): same fused-update rationale as _admit_rows
+        self._inject_rows = jax.jit(self._inject_rows_fn)
 
     # -- jitted programs ---------------------------------------------------
     @staticmethod
@@ -512,6 +607,26 @@ class ServeEngine:
         if dpt is not None:
             out = out + (dpt.at[slot].set(dpt_row),)
         return out
+
+    def _inject_rows_fn(self, temps, top_ks, top_ps, keys, lengths, toks,
+                        pt, slot, temp, top_k, top_p, seed, length, tok,
+                        pt_row):
+        # re-derive the row state the prefill tier's final chunk left
+        # behind instead of shipping it: generate()'s discipline is ONE
+        # split of PRNGKey(seed) before the first token, so the decode
+        # key is split(...)[0], the pending token is the shipped first
+        # token, and the cursor sits at prompt_len — bit-identical to
+        # the solo engine's post-prefill row by construction
+        key0 = jax.random.split(jax.random.PRNGKey(seed))[0]
+        return (
+            temps.at[slot].set(temp),
+            top_ks.at[slot].set(top_k),
+            top_ps.at[slot].set(top_p),
+            keys.at[slot].set(key0),
+            lengths.at[slot].set(length),
+            toks.at[slot].set(tok),
+            pt.at[slot].set(pt_row),
+        )
 
     def _decode_fn(self, params, cache, pt, toks, lengths, keys, temps,
                    top_ks, top_ps, active, n_pages):
@@ -754,8 +869,7 @@ class ServeEngine:
         )
 
     # -- intake ------------------------------------------------------------
-    def submit(self, request: Request) -> RequestHandle:
-        """Validate + enqueue; returns the streaming handle."""
+    def _validate_request(self, request: Request) -> None:
         cfg = self.config
         P = request.prompt_len
         chunks = -(-P // cfg.prefill_chunk)  # ceil
@@ -777,6 +891,15 @@ class ServeEngine:
                 f"({request.max_new_tokens}){tail_note} exceeds the "
                 f"engine's max_len {cfg.max_len}"
             )
+
+    def submit(self, request: Request) -> RequestHandle:
+        """Validate + enqueue; returns the streaming handle."""
+        if self.role == "decode":
+            raise RuntimeError(
+                "decode-tier engines take work via inject_migration() "
+                "only — route submissions to a prefill or solo engine"
+            )
+        self._validate_request(request)
         handle = RequestHandle(request, submitted_at=self._clock())
         if request.deadline_s is not None:
             self._n_deadlines += 1
@@ -793,10 +916,127 @@ class ServeEngine:
         self._any_cancel = True
         return True
 
+    # -- migration intake (decode/solo roles) ------------------------------
+    def inject_migration(
+        self, frame: MigrationFrame, submitted_at: Optional[float] = None,
+    ) -> RequestHandle:
+        """Adopt a prefill-tier frame: fingerprint-check it, rebuild the
+        ``Request``, and queue it for direct-to-DECODING admission at
+        the next ``step()``. ``submitted_at`` (the router's original
+        submit time) keeps TTFT honest across the tier hand-off."""
+        if self.role == "prefill":
+            raise RuntimeError(
+                "prefill-tier engines ship frames via outbox; they do "
+                "not accept them"
+            )
+        if frame.signature != self.migration_signature:
+            raise MigrationError(
+                "migration frame geometry mismatch: this pool is "
+                f"{self.migration_signature!r}, frame declares "
+                f"{frame.signature!r} — refusing the splice"
+            )
+        req = request_from_wire(frame.request)
+        self._validate_request(req)
+        if frame.prompt_len != req.prompt_len:
+            raise MigrationError(
+                f"frame prompt_len {frame.prompt_len} disagrees with "
+                f"its own request ({req.prompt_len} tokens)"
+            )
+        want_pages = -(-frame.prompt_len // self.pool.page_size)
+        if frame.n_pages != want_pages:
+            raise MigrationError(
+                f"frame ships {frame.n_pages} pages but a "
+                f"{frame.prompt_len}-token prompt spans {want_pages} "
+                f"at page_size {self.pool.page_size}"
+            )
+        h = RequestHandle(
+            req,
+            submitted_at=(
+                self._clock() if submitted_at is None else submitted_at
+            ),
+        )
+        if req.deadline_s is not None:
+            self._n_deadlines += 1
+        h._mig_frame = frame
+        self._inject_backlog.append(h)
+        self.telemetry.record_submit(h)
+        return h
+
+    def _admit_injected(self, h: RequestHandle) -> bool:
+        """Bind an injected handle to a slot: allocate the same span the
+        solo path would (chunk-rounded prompt + max_new + tail), splice
+        the frame's page bytes in, and write the decode row the prefill
+        tier's final chunk would have left — the handle enters DECODING
+        with no prefill pass. Returns False when no slot/pages fit yet
+        (strict FIFO over the backlog, like the queue)."""
+        frame: MigrationFrame = h._mig_frame
+        req = h.request
+        # keys=[] disables BOTH the shared-prefix walk and registration:
+        # the arriving pages are private splices, and registering them
+        # would advertise pages this engine never hashed. Delta
+        # migration (shipping only the pages the decode side lacks) is
+        # the documented future step.
+        lease = self.pool.allocate(
+            req.prompt_ids, max_new=req.max_new_tokens,
+            chunk=self.config.prefill_chunk, tail=self._spec_tail,
+            keys=[],
+        )
+        if lease is None:
+            return False
+        self.scheduler.adopt(h, lease)
+        pages = np.asarray(lease.page_row[:frame.n_pages], np.int32)
+        span = (
+            tracing._NULL_SPAN if tracing._tracer is None
+            else tracing.span(
+                "serve.migrate_in", request=req.request_id,
+                pages=int(frame.n_pages), nbytes=frame.payload_nbytes,
+            )
+        )
+        with span:
+            self.pool.cache = splice_frames(
+                self.pool.cache, pages, frame.payload
+            )
+        self.pool.lengths[lease.slot] = frame.prompt_len
+        (
+            self._temps, self._top_ks, self._top_ps, self._keys,
+            self._lengths, self._toks, self._pt,
+        ) = self._inject_rows(
+            self._temps, self._top_ks, self._top_ps, self._keys,
+            self._lengths, self._toks, self._pt, lease.slot,
+            req.temperature,
+            TOP_K_OFF if req.top_k is None else req.top_k,
+            TOP_P_OFF if req.top_p is None else req.top_p,
+            req.seed, frame.prompt_len, frame.first_token,
+            lease.page_row,
+        )
+        self._decoding_dirty = True
+        self.migrated_in += 1
+        h._mig_frame = None
+        self._emit(h, int(frame.first_token))
+        return True
+
+    def _drain_inject_backlog(self) -> None:
+        now = self._clock()
+        while self._inject_backlog:
+            h = self._inject_backlog[0]
+            if h.done:  # cancelled/expired while waiting
+                self._inject_backlog.popleft()
+                continue
+            if h.deadline_at is not None and now >= h.deadline_at:
+                self._inject_backlog.popleft()
+                self._finish(h, RequestStatus.EXPIRED)
+                continue
+            if not self._admit_injected(h):
+                break
+            self._inject_backlog.popleft()
+
     # -- the loop ----------------------------------------------------------
     def has_work(self) -> bool:
         # O(1): the drive loop asks once per step — no live-handle list
-        return bool(self.scheduler.queue or self.scheduler.by_slot)
+        return bool(
+            self.scheduler.queue or self.scheduler.by_slot
+            or self._inject_backlog
+        )
 
     def step(self) -> bool:
         """One scheduler iteration; returns True when any device work
@@ -812,6 +1052,10 @@ class ServeEngine:
             self._any_cancel = False
             for h in self.scheduler.sweep_cancelled():
                 self._finish(h, RequestStatus.CANCELLED)
+        if self._inject_backlog:
+            self._drain_inject_backlog()
+        if self._store is not None and self.scheduler.queue:
+            self._adopt_from_store()
         for h in self.scheduler.admit(
             self.pool, self.draft_pool, tail=self._spec_tail
         ):
@@ -1002,6 +1246,89 @@ class ServeEngine:
             f"({len(self.scheduler.live_handles())} requests live)"
         )
 
+    # -- cross-engine prefix store (r18) -----------------------------------
+    def _adopt_from_store(self) -> None:
+        """Walk each queued request's chain keys once: pages the FLEET
+        already prefilled (store hit) but this pool doesn't hold are
+        claimed (``adopt_page``) and spliced in, so the normal
+        ``allocate`` path then shares them copy-free — the hot system
+        prompt is prefilled once per fleet, not once per engine. Stops
+        at the first miss (chain contiguity); any failure to claim a
+        page is a skipped optimization, never an error."""
+        pool = self.pool
+        if not pool.prefix_cache:
+            return
+        # a handle is re-walked only when the store has grown since its
+        # last walk (``puts`` moved): a queued request that missed
+        # yesterday adopts the page a PEER published today, and the
+        # steady state pays zero store traffic per step
+        version = getattr(self._store, "puts", None)
+        for h in self.scheduler.queue:
+            if getattr(h, "_store_walked", None) == version:
+                continue
+            h._store_walked = version
+            req = h.request
+            if h._chain_keys is None:
+                h._chain_keys = pool.chain_keys(req.prompt_ids)
+            cap = (req.prompt_len - 1) // pool.page_size
+            for key in h._chain_keys[:cap]:
+                if key in pool._registry:
+                    continue  # already local (own prefill or adoption)
+                payload = self._store.get(
+                    key, holder=self._holder,
+                    signature=self.migration_signature,
+                )
+                if payload is None:
+                    break
+                pg = pool.adopt_page(key)
+                if pg is None:
+                    break
+                pool.cache = splice_frames(
+                    pool.cache, np.asarray([pg], np.int32), payload
+                )
+                self.store_adopted_pages += 1
+
+    def _publish_prefixes(self, h: RequestHandle) -> None:
+        """Push the finished prompt's full pages the store lacks (first
+        writer wins — a racing peer's duplicate is dropped unread)."""
+        lease = h._lease
+        row = self.pool.page_tables[lease.slot]
+        for i, key in enumerate(lease.page_keys):
+            if key in self._store:
+                continue
+            payload = extract_frames(
+                self.pool.cache, np.asarray([row[i]], np.int32)
+            )
+            if self._store.put(
+                key, payload, holder=self._holder,
+                signature=self.migration_signature,
+            ):
+                self.store_published_pages += 1
+
+    # -- migration packing (prefill role) ----------------------------------
+    def _pack_migration(self, h: RequestHandle, first_token: int):
+        """Freeze a finished prefill into a MigrationFrame — called
+        strictly BEFORE ``_finish`` releases the slot (packing reads
+        the live pages). Ships ``ceil(P / page_size)`` pages: every
+        position < P lives there; bytes beyond P in the last page are
+        garbage on BOTH tiers and never attended before overwrite."""
+        req = h.request
+        lease = h._lease
+        n = -(-req.prompt_len // self.pool.page_size)
+        pages = np.asarray(
+            self.pool.page_tables[lease.slot][:n], np.int32
+        )
+        payload = extract_frames(self.pool.cache, pages)
+        return MigrationFrame(
+            request=request_to_wire(req),
+            first_token=int(first_token),
+            prompt_len=req.prompt_len,
+            n_pages=n,
+            signature=self.migration_signature,
+            payload=payload,
+            src_engine=self.engine_id or "",
+        )
+
     # -- phase bodies ------------------------------------------------------
     def _run_prefill(self) -> bool:
         cfg = self.config
@@ -1051,6 +1378,8 @@ class ServeEngine:
                     self._prefill_bucket_compiles.get(n_pages),
                 )
             self.pool.lengths[slot] = plan.start + plan.chunk_len
+            if cfg.prefill_delay_s:
+                time.sleep(cfg.prefill_delay_s * plan.chunk_len)
             did = True
             if plan.final:
                 # the slot's full prompt pages now hold canonical KV —
@@ -1060,6 +1389,27 @@ class ServeEngine:
                     self.draft_pool.register_prefix(
                         h._dlease, h.request.prompt_ids
                     )
+                if self._store is not None:
+                    self._publish_prefixes(h)
+                if self.role == "prefill":
+                    # tier hand-off: pack the prompt's pages + the first
+                    # token into a frame, park it in the outbox for the
+                    # router, and retire the request here as MIGRATED —
+                    # it continues on a decode-tier peer
+                    try:
+                        if faults.active():
+                            faults.check(
+                                "serve.kv_migrate",
+                                path=h.request.request_id,
+                            )
+                        frame = self._pack_migration(h, int(tok))
+                    except faults.InjectedFault as e:
+                        self._finish(h, RequestStatus.FAILED, error=e)
+                        continue
+                    self.outbox.append(frame)
+                    self.migrated_out += 1
+                    self._finish(h, RequestStatus.MIGRATED)
+                    continue
                 self.scheduler.prefill_finished(h)
                 self._decoding_dirty = True
                 self._emit(h, int(tok))
@@ -1107,6 +1457,8 @@ class ServeEngine:
         self.decode_gather_bytes += gb
         self.decode_hbm_bytes += hb
         self._decode_tokens += len(decoding)
+        if self.config.decode_delay_s:
+            time.sleep(self.config.decode_delay_s * len(decoding))
         with tracing.span("serve.token_fetch"):
             # the one per-tick device sync: every sampled token comes down
             nxt = np.asarray(nxt)

@@ -211,6 +211,118 @@ def scatter_kv(cache, dense, page_tables, positions, keep):
     return jax.tree_util.tree_map_with_path(f, cache, dense)
 
 
+def _frame_leaves(cache):
+    """(name, batch_axis, leaf) for every KV-payload leaf, in canonical
+    tree-flatten order — the ONE iteration order the frame codec (and
+    therefore the migration wire format and the prefix store's page
+    payloads) is defined over."""
+    out = []
+    for path, leaf in jax.tree_util.tree_leaves_with_path(cache):
+        ax = cache_batch_axis(path, leaf)
+        if ax is not None:
+            name = getattr(path[-1], "key", None) or str(path[-1])
+            out.append((name, ax, leaf))
+    return out
+
+
+def frame_signature(cache, page_size: int) -> str:
+    """Geometry commitment for one page frame: leaf names, per-frame
+    shapes and dtypes (in codec order) plus the page size. Two pools
+    agree on this string iff ``extract_frames`` bytes from one splice
+    losslessly into the other — the DETAIL string the migration
+    fingerprint handshake commits to (the ``_verify_p2p`` idiom)."""
+    parts = [f"ps={page_size}"]
+    for name, ax, leaf in _frame_leaves(cache):
+        frame = leaf.shape[:ax] + leaf.shape[ax + 1:]
+        parts.append(f"{name}:{frame}:{leaf.dtype}")
+    return "|".join(parts)
+
+
+def frame_nbytes(cache) -> int:
+    """Native bytes of ONE page frame across the KV-payload leaves —
+    the exact per-page payload size ``extract_frames`` produces (int8
+    caches: int8 K/V plus their f32 per-token scale sidecars)."""
+    total = 0
+    for _, ax, leaf in _frame_leaves(cache):
+        elems = leaf.size // leaf.shape[ax]
+        total += int(elems) * leaf.dtype.itemsize
+    return total
+
+
+def frame_f32_nbytes(cache) -> int:
+    """Bytes ONE page frame would cost with an f32 KV cache: payload
+    elements at 4 bytes, no scale sidecars (an f32 cache has none).
+    The denominator of the bench's migration-bytes ratio — an int8
+    pool's native frames cost ``(1 + 4/D) / 4`` of this."""
+    total = 0
+    for name, ax, leaf in _frame_leaves(cache):
+        if name.endswith("_scale"):
+            continue
+        total += int(leaf.size // leaf.shape[ax]) * 4
+    return total
+
+
+def extract_frames(cache, pages) -> np.ndarray:
+    """Gather whole page frames into one flat ``uint8`` payload.
+
+    Layout is leaf-major in ``_frame_leaves`` order: for each KV-payload
+    leaf, the ``len(pages)`` frames' native bytes (C order, native
+    dtype — int8 payloads ship as int8, their scale sidecars as f32).
+    Verbatim bytes, so a splice on a geometry-identical pool is
+    lossless for ANY cache dtype: migration can never change tokens.
+    """
+    idx = jnp.asarray(np.asarray(pages, np.int32).reshape(-1))
+    chunks = []
+    for _, ax, leaf in _frame_leaves(cache):
+        g = np.asarray(jnp.take(leaf, idx, axis=ax))
+        chunks.append(np.ascontiguousarray(g).tobytes())
+    return np.frombuffer(b"".join(chunks), np.uint8)
+
+
+def splice_frames(cache, pages, payload):
+    """Inverse of :func:`extract_frames`: write frame bytes into the
+    pool at ``pages``. Host-side, once per migrated request (NOT per
+    tick — the per-request cost the eager-scatter rule polices is paid
+    exactly once per hand-off, priced in the bench's migration
+    accounting). Raises when the payload size disagrees with the pool's
+    frame geometry — the byte-level half of the fingerprint handshake.
+    """
+    idx = jnp.asarray(np.asarray(pages, np.int32).reshape(-1))
+    n = int(idx.size)
+    buf = np.asarray(payload, np.uint8).reshape(-1)
+    off = 0
+
+    def f(path, leaf):
+        nonlocal off
+        ax = cache_batch_axis(path, leaf)
+        if ax is None:
+            return leaf
+        shape = leaf.shape[:ax] + (n,) + leaf.shape[ax + 1:]
+        count = int(np.prod(shape, dtype=np.int64)) * leaf.dtype.itemsize
+        if off + count > buf.size:
+            raise ValueError(
+                f"migration payload too short: leaf at {path} needs "
+                f"bytes [{off}, {off + count}) of {buf.size}"
+            )
+        frames = np.ascontiguousarray(buf[off:off + count]).view(
+            leaf.dtype
+        ).reshape(shape)
+        off += count
+        m = jnp.moveaxis(leaf, ax, 0)
+        m = m.at[idx].set(  # ptdlint: disable=PTD004
+            jnp.moveaxis(jnp.asarray(frames), ax, 0)
+        )  # once per migrated request (bounded, priced), never per tick
+        return jnp.moveaxis(m, 0, ax)
+
+    out = jax.tree_util.tree_map_with_path(f, cache)
+    if off != buf.size:
+        raise ValueError(
+            f"migration payload size mismatch: spliced {off} bytes, "
+            f"payload holds {buf.size} — pool geometries disagree"
+        )
+    return out
+
+
 @dataclasses.dataclass(frozen=True)
 class SlotLease:
     """One admission's allocation: which slot, which pages, where
@@ -505,6 +617,36 @@ class PagedKVPool:
             self._registry[key] = page
             self._page_key[page] = key
             self._ref[page] += 1
+
+    def adopt_page(self, key: bytes) -> Optional[int]:
+        """Claim one free page and register it under ``key`` — the
+        bookkeeping half of pulling a prefix page from a cross-engine
+        store (``serve/prefix_store.py``): the caller splices the
+        store's canonical frame bytes into the returned page, after
+        which the page is indistinguishable from one this pool's own
+        prefill produced and every sharing invariant applies unchanged.
+        The registry holds the page's one reference (it survives any
+        requester's retirement, exactly like a locally-registered
+        prefix). Returns the already-registered page when ``key`` is
+        known, and None when the prefix cache is off or no page can be
+        freed — adoption is an optimization, never a requirement."""
+        if not self.prefix_cache:
+            return None
+        cur = self._registry.get(key)
+        if cur is not None:
+            self._registry.move_to_end(key)
+            return cur
+        if not self._free_pages:
+            if not any(
+                self._ref[pg] == 1 for pg in self._registry.values()
+            ):
+                return None
+            self._evict_lru()
+        pg = heapq.heappop(self._free_pages)
+        self._ref[pg] = 1
+        self._registry[key] = pg
+        self._page_key[pg] = key
+        return pg
 
     def free(self, slot: int) -> None:
         """Retire a slot: drop its page references; pages nobody else

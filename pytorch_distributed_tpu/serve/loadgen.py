@@ -47,11 +47,13 @@ def warm_up(
     if precompile_buckets:
         engine.precompile_decode_buckets()
     engine.telemetry = telemetry or ServeTelemetry(
-        # keep the engine's writer/clock: replacing a writer-backed
-        # telemetry with a writer-less one would silently drop the
-        # JSONL stream the caller wired up
+        # keep the engine's writer/clock/engine_id: replacing a
+        # writer-backed telemetry with a writer-less one would silently
+        # drop the JSONL stream the caller wired up, and dropping the
+        # fleet label would anonymize a fleet member's records
         writer=engine.telemetry.writer,
         clock=engine.telemetry.clock,
+        engine_id=engine.telemetry.engine_id,
     )
     # a caller-built telemetry was stamped BEFORE this warm-up ran —
     # restart its wall clock or summary() throughput eats the compile

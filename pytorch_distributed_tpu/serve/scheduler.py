@@ -41,6 +41,9 @@ class RequestStatus(enum.Enum):
     CANCELLED = "cancelled"
     EXPIRED = "expired"
     FAILED = "failed"
+    #: terminal on a PREFILL-tier engine: the prompt's pages were packed
+    #: and shipped; the request continues on a decode-tier peer (r18)
+    MIGRATED = "migrated"
 
 
 #: statuses a request can still make progress from
@@ -248,6 +251,19 @@ class Scheduler:
             self._prefilling.append(h)
             admitted.append(h)
         return admitted
+
+    def adopt(self, handle: RequestHandle, lease) -> None:
+        """Bind an already-prefilled request to a slot, skipping the
+        queue and the prefill plan entirely — the decode-tier half of a
+        migration (``ServeEngine.inject_migration``): the pages arrive
+        spliced from the prefill tier, so the handle enters the batch
+        directly in DECODING with its whole prompt accounted for."""
+        handle.slot = lease.slot
+        handle._lease = lease
+        handle._dlease = None
+        handle.status = RequestStatus.DECODING
+        handle._prefill_done = handle.request.prompt_len
+        self.by_slot[lease.slot] = handle
 
     # -- prefill planning --------------------------------------------------
     def plan_prefill(self, budget: int) -> List[PrefillChunk]:

@@ -30,9 +30,14 @@ class ServeTelemetry:
     MetricsWriter-protocol ``writer``. ``clock`` is injectable so tests
     drive deterministic time."""
 
-    def __init__(self, writer=None, clock=time.monotonic):
+    def __init__(self, writer=None, clock=time.monotonic,
+                 engine_id: Optional[str] = None):
         self.writer = writer
         self.clock = clock
+        #: fleet label stamped on every record (r18): merged multi-
+        #: engine traces disambiguate emitters by it; None (the solo
+        #: default) keeps the single-engine-implicit schema unchanged
+        self.engine_id = engine_id
         self.started_at = clock()
         self.ttfts_s: List[float] = []
         self.status_counts: Dict[str, int] = {}
@@ -106,6 +111,8 @@ class ServeTelemetry:
     def _write(self, metrics: Dict) -> None:
         if self.writer is not None:
             self._events += 1
+            if self.engine_id is not None:
+                metrics = {"engine_id": self.engine_id, **metrics}
             self.writer.write(self._events, metrics, split="serve")
 
     # -- aggregates --------------------------------------------------------

@@ -459,6 +459,84 @@ def stragglers_section(events, records, out):
     return summary
 
 
+def fleet_section(records, out):
+    """The serving-fleet picture (r18): per-engine telemetry + the
+    router's migration/replay audit.
+
+    Fires only on fleet-shaped runs — ``split="serve"`` records that
+    carry the ``engine_id`` label (a lone engine omits it and keeps the
+    single-engine Serving section below), or router ``migrate``/
+    ``replay`` records. Per engine: request counts and TTFT
+    percentiles from ``event="request"``, last slot occupancy from
+    ``event="snapshot"``. Fleet-wide: KV migration totals (frames,
+    wire bytes, payload bytes, pages) and evict-and-replay counts —
+    the at-least-once cost of surviving an engine loss."""
+    serve = [r for r in records if r.get("split") == "serve"]
+    # migrate/replay also carry engine_id (the source/lost engine) —
+    # only request/snapshot records describe an engine's own traffic
+    labeled = [
+        r for r in serve
+        if r.get("engine_id")
+        and r.get("event") in ("request", "snapshot")
+    ]
+    migrates = [r for r in serve if r.get("event") == "migrate"]
+    replays = [r for r in serve if r.get("event") == "replay"]
+    if not labeled and not migrates and not replays:
+        return None
+    print("\n== Fleet ==", file=out)
+    summary = {}
+    per_engine = {}
+    for r in labeled:
+        per_engine.setdefault(r["engine_id"], []).append(r)
+    if per_engine:
+        summary["engines"] = len(per_engine)
+        print(f"  {len(per_engine)} engine(s) in the merged stream:",
+              file=out)
+        for eid in sorted(per_engine):
+            recs = per_engine[eid]
+            done = [
+                r for r in recs
+                if r.get("event") == "request"
+                and r.get("status") == "completed"
+            ]
+            ttfts = [r["ttft_ms"] for r in done if "ttft_ms" in r]
+            snaps = [r for r in recs if r.get("event") == "snapshot"]
+            bits = [f"{len(done)} completed"]
+            if ttfts:
+                bits.append(
+                    f"ttft p50={percentile(ttfts, 50):.1f}ms "
+                    f"p99={percentile(ttfts, 99):.1f}ms"
+                )
+            if snaps:
+                bits.append(
+                    f"occupancy last "
+                    f"{snaps[-1].get('slot_occupancy', 0.0):.2f}"
+                )
+            print(f"    {eid:<8} " + "  ".join(bits), file=out)
+    if migrates:
+        nbytes = sum(int(r.get("nbytes", 0)) for r in migrates)
+        payload = sum(int(r.get("payload_nbytes", 0)) for r in migrates)
+        pages = sum(int(r.get("n_pages", 0)) for r in migrates)
+        summary["migrated_frames"] = len(migrates)
+        summary["migrated_nbytes"] = nbytes
+        summary["migrated_pages"] = pages
+        print(
+            f"  kv migration: {len(migrates)} frame(s), {pages} "
+            f"page(s), {nbytes / 1e6:.2f}MB wire "
+            f"({payload / 1e6:.2f}MB KV payload)", file=out,
+        )
+    if replays:
+        lost = sorted({r.get("engine_id", "?") for r in replays})
+        summary["replays"] = len(replays)
+        summary["engines_lost"] = lost
+        print(
+            f"  replays: {len(replays)} request(s) re-admitted after "
+            f"losing {', '.join(lost)} <-- at-least-once: lost decode "
+            f"work is re-run, outputs stay deterministic", file=out,
+        )
+    return summary
+
+
 def checkpoint_section(events, records, out):
     """The checkpoint audit trail + per-rank save cost (r17).
 
@@ -686,6 +764,9 @@ def report(trace_path, metric_paths, top_n=10, out=None,
     # -- checkpoint audit (r17: sharded save/restore trail) ----------------
     ckpt = checkpoint_section(events, records, out)
 
+    # -- serving fleet (r18: per-engine telemetry + migration audit) -------
+    fleet = fleet_section(records, out)
+
     # -- auto-parallel plan ------------------------------------------------
     plan_doc = plan_section(plan_path, out)
 
@@ -789,7 +870,8 @@ def report(trace_path, metric_paths, top_n=10, out=None,
             )
     return {"spans": rows, "recompiles": recompiles, "goodput": g,
             "comms": comms or {}, "stragglers": stragglers or {},
-            "checkpoint": ckpt or {}, "plan": plan_doc, "serve": serve}
+            "checkpoint": ckpt or {}, "fleet": fleet or {},
+            "plan": plan_doc, "serve": serve}
 
 
 def main(argv=None):

@@ -14,6 +14,20 @@ profiling and capacity probing::
 ``--rate 0`` submits everything up front (closed-loop saturation).
 Params are randomly initialized — the workload numbers (tokens/sec,
 TTFT percentiles, occupancy) measure the ENGINE, not any checkpoint.
+
+Storm mode (r18) drives a FLEET behind the deterministic router::
+
+    python scripts/serve_loadgen.py --engines 4 --router --requests 64
+    python scripts/serve_loadgen.py --engines 4 --router --disagg \\
+        --store --prefix-share 0.8 --requests 64
+
+``--router`` load-balances N solo engines; ``--disagg`` splits them
+into prefill/decode tiers with ring KV migration between them;
+``--store`` shares one cross-engine prefix registry so a hot system
+prompt is prefilled once per fleet. Arrivals stay seeded and
+replayable — the same ``--seed`` routes the same storm identically —
+and the summary reports p50/p95/p99 TTFT, aggregate tokens/s,
+migration bytes, and replay counts.
 """
 
 import argparse
@@ -51,6 +65,88 @@ def parse_range(s: str):
     lo, _, hi = s.partition(",")
     lo = int(lo)
     return (lo, int(hi) if hi else lo)
+
+
+def run_storm(args, model, params, max_len, reqs, arrivals, writer,
+              spec):
+    """--router fleet storm: N solo engines, or --disagg tiers with
+    ring KV migration, behind the deterministic router."""
+    import numpy as np
+
+    from pytorch_distributed_tpu.serve import (
+        EngineConfig, InProcPrefixStore, Router, ServeEngine, drive,
+    )
+
+    store = InProcPrefixStore() if args.store else None
+
+    def mk(role, eid):
+        return ServeEngine(
+            model, params,
+            EngineConfig(num_slots=args.slots, max_len=max_len,
+                         prefill_chunk=args.prefill_chunk,
+                         page_size=args.page_size,
+                         num_pages=args.num_pages,
+                         decode_mode=args.decode_mode,
+                         role=role, engine_id=eid),
+            spec=spec if role == "solo" else None,
+            prefix_store=store if role != "decode" else None,
+            telemetry=None,
+        )
+
+    if args.disagg:
+        n_pre = -(-args.engines // 2)
+        prefill = [mk("prefill", f"p{i}") for i in range(n_pre)]
+        decode = [
+            mk("decode", f"d{i}") for i in range(args.engines - n_pre)
+        ]
+        for e in prefill + decode:
+            e.telemetry.writer = writer
+        router = Router(prefill=prefill, decode=decode, writer=writer,
+                        store=store)
+        shape = f"{n_pre} prefill + {args.engines - n_pre} decode"
+    else:
+        engines = [mk("solo", f"e{i}") for i in range(args.engines)]
+        for e in engines:
+            e.telemetry.writer = writer
+        router = Router(engines=engines, writer=writer, store=store)
+        shape = f"{args.engines} solo"
+    router.warm_up(np.ones(1, np.int32))
+    dt = drive(router, reqs, arrivals)
+    if writer is not None:
+        writer.close()
+    s = router.summary()
+    total_tokens = sum(
+        e["completed_tokens"] for e in s["engines"].values()
+    )
+    print(f"model={args.model} fleet=[{shape}] max_len={max_len} "
+          f"requests={args.requests} rate="
+          f"{args.rate or 'closed-loop'} wall={dt:.2f}s")
+    print(f"  tokens/s (fleet)   = {total_tokens / max(dt, 1e-9):.2f} "
+          f"({total_tokens} completed tokens)")
+    for q in (50, 95, 99):
+        v = s.get(f"ttft_ms_p{q}")
+        if v is not None:
+            print(f"  ttft_ms_p{q:<8} = {v:.2f}")
+    if args.disagg:
+        print(f"  migration          = {s['migration_frames']} frames, "
+              f"{s['migration_bytes']:,d} wire B "
+              f"({s['migration_payload_bytes']:,d} KV payload B)")
+    if s["replays"] or s["lost_engines"]:
+        print(f"  replays            = {s['replays']} "
+              f"(lost engines: {s['lost_engines']})")
+    if store is not None:
+        st = store.stats()
+        print(f"  prefix store       = {st['puts']} puts "
+              f"({st['hits']} hits, {st['dup_puts']} dup puts, "
+              f"{st['entries']} resident pages)")
+    for eid, es in s["engines"].items():
+        done = es.get("completed", 0)
+        print(f"  [{eid}] completed={done} "
+              f"tokens={es['completed_tokens']} "
+              + (f"p99={es['ttft_ms_p99']:.1f}ms"
+                 if "ttft_ms_p99" in es else ""))
+    if args.log:
+        print(f"telemetry JSONL -> {args.log}")
 
 
 def main():
@@ -105,7 +201,32 @@ def main():
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--log", default=None,
                     help="telemetry JSONL path (MetricsWriter stream)")
+    ap.add_argument("--engines", type=int, default=1,
+                    help="fleet size for --router storm mode")
+    ap.add_argument("--router", action="store_true",
+                    help="drive --engines N engines behind the "
+                    "deterministic telemetry-driven router")
+    ap.add_argument("--disagg", action="store_true",
+                    help="split the fleet into prefill/decode tiers "
+                    "(half each, prefill rounded up) with ring KV "
+                    "migration between them; implies --router")
+    ap.add_argument("--store", action="store_true",
+                    help="share one cross-engine prefix store across "
+                    "the fleet (hot prompts prefilled once per fleet)")
     args = ap.parse_args()
+    if args.disagg:
+        args.router = True
+    if args.router and args.engines < 2:
+        ap.error("--router needs --engines >= 2 (a 1-engine fleet is "
+                 "just the solo path — drop --router)")
+    if args.disagg and args.spec_k:
+        ap.error("--disagg refuses --spec-k: tiered speculation is not "
+                 "supported (the draft cache does not ride the "
+                 "migration frame)")
+    if args.store and not args.router:
+        ap.error("--store is a FLEET feature (cross-engine registry) — "
+                 "a single engine already has its local page registry; "
+                 "add --router --engines N")
     if args.long_context and args.max_len:
         # the preset's whole job is sizing max_len; honoring both would
         # either silently drop the preset or silently rewrite an
@@ -196,6 +317,10 @@ def main():
         )["params"]
         spec = SpecConfig(draft, dparams,
                           num_draft_tokens=args.spec_k)
+    if args.router:
+        run_storm(args, model, params, max_len, reqs, arrivals,
+                  writer, spec)
+        return
     engine = ServeEngine(
         model, params,
         EngineConfig(num_slots=args.slots, max_len=max_len,

@@ -1759,3 +1759,136 @@ def hetero_microbatch_worker(rank: int, world: int, name: str, q) -> None:
         import traceback
 
         q.put((rank, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
+
+
+def disagg_migration_worker(rank: int, world: int, name: str, q) -> None:
+    """r18 cross-process KV migration: a prefill-role engine on rank 0
+    ships MigrationFrames over the ring's REAL P2P mailboxes to a
+    decode-role engine on rank 1. The receiving side pins the whole
+    wire contract: the page-table splice lands the exact payload bytes
+    in the adopted pages, int8 payloads carry their native (int8 +
+    f32-scale) accounting at <= 0.55x the f32 frame cost, a signature
+    mismatch is REFUSED before anything is used, and every finished
+    stream is bit-identical to the solo engine's."""
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+
+        from pytorch_distributed_tpu.models.gpt2 import (
+            GPT2Config,
+            GPT2LMHead,
+        )
+        from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
+        from pytorch_distributed_tpu.serve import (
+            EngineConfig,
+            MigrationError,
+            Request,
+            RequestStatus,
+            ServeEngine,
+            extract_frames,
+            frame_f32_nbytes,
+            frame_nbytes,
+            recv_frame,
+            send_frame,
+        )
+
+        cfg = GPT2Config(
+            vocab_size=211, n_positions=96, hidden_size=32, num_layers=2,
+            num_heads=2, dropout_rate=0.0, kv_cache_quantize="int8",
+        )
+        model = GPT2LMHead(cfg)
+        # identical init on both ranks: key(0) is the shared-model story
+        params = model.init(
+            jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        ecfg = dict(num_slots=4, max_len=96, prefill_chunk=8)
+        prng = np.random.default_rng(7)
+        prompts = [
+            prng.integers(1, 211, size=n).astype(np.int32)
+            for n in (5, 8, 13, 21)  # mixes page-aligned + ragged tails
+        ]
+        reqs = [
+            Request(
+                p, max_new_tokens=10, request_id=f"mig-{i}",
+                temperature=(0.8 if i % 2 else 0.0),
+                top_k=(20 if i % 2 else None), seed=100 + i,
+            )
+            for i, p in enumerate(prompts)
+        ]
+        with HostRingGroup(name, rank, world, timeout_s=120) as ring:
+            if rank == 0:
+                eng = ServeEngine(
+                    model, params,
+                    EngineConfig(role="prefill", engine_id="p0", **ecfg),
+                )
+                hs = [eng.submit(r) for r in reqs]
+                eng.run_until_drained()
+                assert all(
+                    h.status is RequestStatus.MIGRATED for h in hs
+                ), [h.status for h in hs]
+                frames = list(eng.outbox)
+                assert len(frames) == len(reqs), len(frames)
+                ring.send(np.array([len(frames)], np.int64), dst=1)
+                for fr in frames:
+                    send_frame(ring, fr, dst=1)
+                # one duplicate for the receiver's wrong-signature check
+                send_frame(ring, frames[0], dst=1)
+            else:
+                eng = ServeEngine(
+                    model, params,
+                    EngineConfig(role="decode", engine_id="d0", **ecfg),
+                )
+                per_page = frame_nbytes(eng.pool.cache)
+                # int8 payload accounting: native frame <= 0.55x the f32
+                # frame (this model: (1 + 4/16) / 4 = 0.3125x)
+                assert per_page * 100 <= 55 * frame_f32_nbytes(
+                    eng.pool.cache
+                ), (per_page, frame_f32_nbytes(eng.pool.cache))
+                n = int(ring.recv(np.zeros(1, np.int64), src=0)[0])
+                assert n == len(reqs), n
+                handles = {}
+                for _ in range(n):
+                    fr = recv_frame(ring, 0, eng.migration_signature)
+                    assert fr.payload.nbytes == fr.n_pages * per_page
+                    h = eng.inject_migration(fr)
+                    eng._drain_inject_backlog()  # splice NOW, pre-tick
+                    # page-table splice: the adopted pages hold the wire
+                    # bytes verbatim (no decode has touched them yet)
+                    lease = h._lease
+                    got = extract_frames(
+                        eng.pool.cache,
+                        list(lease.page_row[: fr.n_pages]),
+                    )
+                    assert got.tobytes() == np.asarray(
+                        fr.payload, np.uint8
+                    ).tobytes(), fr.request_id
+                    handles[fr.request_id] = h
+                # fingerprint refusal over the real wire: a receiver
+                # expecting different pool geometry never uses the frame
+                try:
+                    recv_frame(ring, 0, "ps=1|bogus:(1,):int8")
+                    raise AssertionError("signature mismatch accepted")
+                except MigrationError:
+                    pass
+                eng.run_until_drained()
+                # parity: every migrated stream == the solo engine's
+                solo = ServeEngine(model, params, EngineConfig(**ecfg))
+                solo_hs = [solo.submit(r) for r in reqs]
+                solo.run_until_drained()
+                for r, sh in zip(reqs, solo_hs):
+                    h = handles[r.request_id]
+                    assert h.status is RequestStatus.COMPLETED, (
+                        r.request_id, h.status, h.error,
+                    )
+                    assert h.tokens == sh.tokens, (
+                        r.request_id, h.tokens, sh.tokens,
+                    )
+            ring.barrier()  # neither side exits before the other checks
+        q.put((rank, "ok"))
+    except Exception as e:  # pragma: no cover - reported via queue
+        import traceback
+
+        q.put((rank, f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))

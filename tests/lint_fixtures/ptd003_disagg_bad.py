@@ -1,0 +1,19 @@
+"""PTD003 known-bad: typo'd serving-fleet site names."""
+from pytorch_distributed_tpu.runtime import faults
+
+
+def router_step(engine_id):
+    faults.check("serve.engine_los", path=engine_id)  # expect: PTD003
+
+
+def pack_frames(request_id):
+    faults.check("serve.kv_migate", path=request_id)  # expect: PTD003
+
+
+def loss_drill():
+    with faults.injected("serve.engineloss:mode=raise,count=1"):  # expect: PTD003
+        pass
+
+
+def env_spec(env):
+    env["PTD_FAULTS"] = "serve.kv_migrate_:count=1"  # expect: PTD003

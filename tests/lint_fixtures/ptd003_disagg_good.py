@@ -1,0 +1,19 @@
+"""PTD003 known-good twins for the r18 serving-fleet sites."""
+from pytorch_distributed_tpu.runtime import faults
+
+
+def router_step(engine_id):
+    faults.check("serve.engine_loss", path=engine_id)
+
+
+def pack_frames(request_id):
+    faults.check("serve.kv_migrate", path=request_id)
+
+
+def loss_drill():
+    with faults.injected("serve.engine_loss:mode=raise,count=1,match=d0"):
+        pass
+
+
+def env_spec(env):
+    env["PTD_FAULTS"] = "serve.kv_migrate:count=1;serve.engine_loss:after=4"

@@ -558,6 +558,59 @@ class TestTrainerTraceFlag:
         assert obs_report.main([str(solo)]) == 0
         assert "Stragglers" not in capsys.readouterr().out
 
+    def test_obs_report_fleet_section(self, tmp_path, capsys):
+        """r18: the Fleet section renders per-engine request/TTFT/
+        occupancy lines from engine_id-labeled serve records plus the
+        router's migrate/replay audit — and a single-engine run (no
+        engine_id label, no router records) keeps the old Serving
+        section and prints no Fleet section at all."""
+        with MetricsWriter(str(tmp_path / "m.jsonl")) as w:
+            for eid, ttft in (("d0", 40.0), ("d0", 60.0), ("d1", 90.0)):
+                w.write(1, {"event": "request", "engine_id": eid,
+                            "request_id": "r", "status": "completed",
+                            "prompt_tokens": 8, "new_tokens": 4,
+                            "ttft_ms": ttft}, split="serve")
+            w.write(2, {"event": "snapshot", "engine_id": "d0",
+                        "queue_depth": 0, "slots_occupied": 2,
+                        "slots_total": 4, "slot_occupancy": 0.5,
+                        "decode_ticks": 9}, split="serve")
+            w.write(3, {"event": "migrate", "engine_id": "p0",
+                        "dst": "d0", "request_id": "r", "nbytes": 2000,
+                        "payload_nbytes": 1280, "n_pages": 1},
+                    split="serve")
+            w.write(4, {"event": "replay", "engine_id": "d1",
+                        "dst": "d0", "request_id": "r"}, split="serve")
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts",
+        ))
+        try:
+            import obs_report
+        finally:
+            sys.path.pop(0)
+        rc = obs_report.main([str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "Fleet" in out
+        assert "2 engine(s)" in out
+        assert "d0" in out and "d1" in out
+        assert "2 completed" in out  # d0's two requests grouped
+        assert "occupancy last 0.50" in out
+        assert "1 frame(s), 1 page(s)" in out
+        assert "re-admitted after losing d1" in out
+        # single-engine runs (no engine_id label) stay Serving-only
+        solo = tmp_path / "solo"
+        solo.mkdir()
+        with MetricsWriter(str(solo / "m.jsonl")) as w:
+            w.write(1, {"event": "request", "request_id": "r",
+                        "status": "completed", "prompt_tokens": 8,
+                        "new_tokens": 4, "ttft_ms": 12.0},
+                    split="serve")
+        assert obs_report.main([str(solo)]) == 0
+        solo_out = capsys.readouterr().out
+        assert "Fleet" not in solo_out
+        assert "Serving" in solo_out
+
 
 # -- torn metrics (the PR 2 chaos scenario) --------------------------------
 class TestTornMetrics:
