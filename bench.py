@@ -1384,6 +1384,99 @@ def bench_observability() -> None:
     )
 
 
+def bench_flightrec() -> None:
+    """Always-on flight-recorder cost, plus the hang-dump/autopsy smoke.
+
+    (a) Per-record overhead: the full begin/start/complete triple on a
+    fresh recorder, tight host loop, min over windows (the same
+    variance discipline as the observability phase — min isolates the
+    code's cost from this 1-core box's scheduling noise). Unlike the
+    tracer this path has NO disarmed state to subtract: recording is
+    always on, so the number pinned here is the cost every collective
+    pays, every run. The contract budget is deliberately loose (25us)
+    against a measured ~1-3us — the pin exists to catch an accidental
+    allocation or dict churn creeping onto the hot path, not to race
+    the box.
+
+    (b) A 2-proc injected hang: rank 1 arms ``comm.hang:mode=skip`` and
+    silently drops out of an all_reduce; rank 0 must hit its ring
+    deadline, dump ``flight-rank0.json``, and the merged autopsy must
+    name rank 1 as a ``missing_rank`` victim with the diverging
+    seq/op. End-to-end over real shm-ring processes — the drill
+    shape of scripts/chaos_drill.py --drill hang, smallest world.
+    """
+    import shutil
+    import tempfile
+
+    from pytorch_distributed_tpu.runtime import flightrec
+    from tests.flight_workers import hang_worker
+
+    rec = flightrec.FlightRecorder(4096)
+    n, windows = 20_000, 5
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            seq = rec.begin("all_reduce", "sum", "float32", 1024, 8192,
+                            "shm", "bench")
+            rec.start(seq)
+            rec.complete(seq)
+        best = min(best, (time.perf_counter() - t0) / n)
+    per_record_us = best * 1e6
+    _emit({
+        "metric": "flightrec_record_overhead_us",
+        "value": round(per_record_us, 3),
+        "unit": (
+            "us per begin/start/complete record triple, min over "
+            f"{windows} windows x {n} records (always-on: every "
+            "collective pays this; budget < 25us guards against "
+            "allocation creeping onto the hot path)"
+        ),
+        "vs_baseline": None,
+    })
+
+    base = tempfile.mkdtemp(prefix="ptd_bench_flight_")
+    try:
+        res = _spawn_ring_workers(
+            2, hang_worker, timeout=120,
+            extra=(base, 1, "comm.hang:mode=skip"),
+        )
+        # a survivor's err is its EXPECTED deadline message; role "?"
+        # is the worker's own assertion/traceback failure path
+        bad = [r for r in res
+               if not isinstance(r[1], dict) or r[1].get("role") == "?"]
+        survivors = {r: d for r, d in res
+                     if isinstance(d, dict) and d.get("role") == "survivor"}
+        if bad or not survivors:
+            raise RuntimeError(f"flightrec hang smoke failed: {res}")
+        verdict = flightrec.autopsy(flightrec.load_dumps(base))
+        if (verdict["verdict"] != "missing_rank"
+                or verdict["victim_rank"] != 1
+                or verdict["seq"] is None):
+            raise RuntimeError(
+                f"autopsy did not name the injected victim: {verdict}"
+            )
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    _emit({
+        "metric": "flightrec_hang_verdict",
+        "value": 1.0,
+        "unit": (
+            "1.0 = 2-proc injected hang (comm.hang:mode=skip on rank 1) "
+            "produced a survivor dump and an autopsy verdict naming the "
+            f"victim; verdict={verdict['verdict']} at seq={verdict['seq']} "
+            f"op={verdict['op']}"
+        ),
+        "vs_baseline": None,
+    })
+    print(
+        f"# flightrec: record triple {per_record_us:.2f}us, hang smoke "
+        f"verdict {verdict['verdict']} victim={verdict['victim_rank']} "
+        f"seq={verdict['seq']} op={verdict['op']}",
+        file=sys.stderr,
+    )
+
+
 def _elastic_downtime(metrics_path: str) -> float:
     """Wall-clock downtime off the engine's progress records: the widest
     gap between consecutive NEW-HIGH step commits. Steps normally land
@@ -3375,6 +3468,9 @@ def main():
         # so is the tracing-overhead ratio: traced vs untraced on the
         # same loop, same box
         run_if_budget("observability", bench_observability)
+        # always-on recorder cost + the hang-dump/autopsy smoke: host
+        # loops and CPU shm-ring processes — meaningful anywhere
+        run_if_budget("flightrec", bench_flightrec)
         # planner wall time is host arithmetic — meaningful anywhere
         run_if_budget("planning", bench_planning)
         # elastic resize vs die-and-restore is a host-process mechanics
@@ -3414,6 +3510,7 @@ def main():
             "serving_paged_attn", bench_serving_paged_attn, on_tpu
         )
         run_if_budget("observability", bench_observability)
+        run_if_budget("flightrec", bench_flightrec)
         run_if_budget("planning", bench_planning)
         run_if_budget("elastic", bench_elastic)
         run_if_budget("hetero", bench_hetero)

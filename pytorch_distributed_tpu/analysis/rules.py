@@ -269,6 +269,14 @@ class DisarmedCostDiscipline(Rule):
     computes must move behind a guard: the
     ``tracing._NULL_SPAN if tracing._tracer is None else ...`` ternary
     or an ``if tracing.active():`` / ``is not None`` block.
+
+    Boundary: this discipline governs ARMED-ONLY instrumentation —
+    paths that exist to be off in production. The flight recorder
+    (runtime/flightrec.py) is deliberately the opposite: ALWAYS-ON
+    with no disarmed state, so "disarmed cost" is not a concept there;
+    its (recording) cost is pinned by bench.py's ``flightrec`` phase
+    instead of by this rule, and the module is exempt below alongside
+    the guard-implementing substrates.
     """
 
     rule_id = "PTD002"
@@ -279,8 +287,10 @@ class DisarmedCostDiscipline(Rule):
         {"span", "instant", "counter", "note_compiles"}
     )
     _FAULTS_FNS = frozenset({"check", "fires"})
-    #: the substrate modules implement the guards; they are exempt
-    _EXEMPT = ("runtime/tracing.py", "runtime/faults.py")
+    #: the substrate modules implement the guards; flightrec is
+    #: always-on by design (no disarmed state — see docstring boundary)
+    _EXEMPT = ("runtime/tracing.py", "runtime/faults.py",
+               "runtime/flightrec.py")
 
     def check(self, module: ParsedModule) -> Iterable[Finding]:
         if module.relpath.endswith(self._EXEMPT):
@@ -382,7 +392,8 @@ class FaultSiteRegistry(Rule):
     ``KNOWN_SITES`` in runtime/faults.py (the arming parser already
     refuses unknown names; this rule closes the *call-site* half).
     Checked literals: ``faults.check("...")`` / ``faults.fires("...")``
-    / ``faults.throttle("...")`` first args, ``faults.injected("spec")``
+    / ``faults.throttle("...")`` / ``faults.hang_action("...")`` first
+    args, ``faults.injected("spec")``
     / ``faults.configure`` specs, and ``PTD_FAULTS`` spec strings in
     env dicts/assignments —
     which is how tests and drills name sites, so tests/docs snippets
@@ -457,7 +468,8 @@ class FaultSiteRegistry(Rule):
                 )
                 if (
                     owner == "faults"
-                    and fn in ("check", "fires", "throttle")
+                    and fn in ("check", "fires", "throttle",
+                               "hang_action")
                     and is_str
                 ):
                     yield first.value, node

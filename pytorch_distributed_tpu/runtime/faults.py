@@ -39,8 +39,19 @@ Grammar: ``site[:key=value,...]`` joined by ``;``. Options per site:
       — a deterministic slowdown injector (the heterogeneity drills'
       "one rank is 2x slower" knob). ``check`` ignores throttle-mode
       sites entirely so a shared site name can't double-consume budgets
+    * ``stall`` — the hang injector's soft half: the site polls
+      :func:`hang_action` and sleeps ``seconds`` before proceeding (a
+      rank that is alive but late — the straggler shape)
+    * ``skip``  — the hang injector's hard half: the site polls
+      :func:`hang_action` and SKIPS the collective entirely, returning
+      its local data unreduced — the desynced-rank shape (a PTD001
+      violation made flesh: this rank's op stream is now shifted by
+      one vs its peers, and the world dies at the next deadline).
+      ``check`` ignores stall/skip-mode sites like throttle ones
 * ``factor`` — the slowdown multiplier a firing ``mode=throttle`` site
   reports (default 2.0; must be > 0)
+* ``seconds`` — the stall duration a firing ``mode=stall`` site reports
+  (default 30.0; must be > 0)
 * ``match`` — only checks whose ``path`` contains this substring are
   eligible (e.g. corrupt one specific shard)
 
@@ -144,6 +155,20 @@ Known sites (grep for ``faults.check`` to find the exact spots):
                      is evicted (FAILED) on the prefill engine, which
                      keeps serving — same degrade-don't-crash contract
                      as ``serve.prefill``
+``comm.hang``        polled at the top of every ``HostRingGroup``
+                     collective and P2P (``runtime/hostring.py``) via
+                     :func:`hang_action` — ``mode=stall,seconds=S``
+                     delays THIS rank's entry into the collective by S
+                     seconds (the straggler shape the flight-recorder
+                     autopsy must call out); ``mode=skip`` makes THIS
+                     rank silently skip the collective and return its
+                     local data (the desynced rank: peers block at the
+                     group deadline, every survivor dumps its flight
+                     log, and ``scripts/hang_autopsy.py`` must name
+                     this rank and the diverging seq/op — the hang
+                     drill's and the bench ``flightrec`` phase's
+                     injector). Budgets (``after``/``count``/``match``)
+                     pick which collective call hangs
 ================== ====================================================
 """
 
@@ -196,8 +221,9 @@ KNOWN_SITES = (
     "transport.slow_link",
     "serve.engine_loss",
     "serve.kv_migrate",
+    "comm.hang",
 )
-_MODES = ("raise", "kill", "truncate", "bitflip", "throttle")
+_MODES = ("raise", "kill", "truncate", "bitflip", "throttle", "stall", "skip")
 
 # unknown site names already warned about (once per name per process:
 # these sit on hot paths when armed)
@@ -241,6 +267,7 @@ class _Site:
         mode: str = "raise",
         match: Optional[str] = None,
         factor: float = 2.0,
+        seconds: float = 30.0,
         seed: int = 0,
     ):
         if mode not in _MODES:
@@ -251,6 +278,10 @@ class _Site:
         if not factor > 0:
             raise ValueError(
                 f"fault site {name!r}: factor must be > 0, got {factor}"
+            )
+        if not seconds > 0:
+            raise ValueError(
+                f"fault site {name!r}: seconds must be > 0, got {seconds}"
             )
         if not 0.0 <= p <= 1.0:
             raise ValueError(f"fault site {name!r}: p={p} not in [0, 1]")
@@ -265,6 +296,7 @@ class _Site:
         self.mode = mode
         self.match = match
         self.factor = float(factor)
+        self.seconds = float(seconds)
         self.fired = 0  # times this site actually fired
         self.seen = 0  # eligible checks observed
         # per-site stream keyed by (seed, site name): arming another site
@@ -317,7 +349,7 @@ class FaultPlan:
                 key, _, value = opt.partition("=")
                 key = key.strip()
                 value = value.strip()
-                if key in ("p", "factor"):
+                if key in ("p", "factor", "seconds"):
                     kw[key] = float(value)
                 elif key in ("count", "after"):
                     kw[key] = int(value)
@@ -405,6 +437,30 @@ def throttle(site: str) -> float:
     return s.factor
 
 
+def hang_action(site: str, path: Optional[str] = None):
+    """The hang-injection site: ``None`` unless ``site`` is armed with
+    ``mode=stall`` or ``mode=skip`` and its budgets elect this poll, in
+    which case ``(mode, seconds)`` is returned and the caller applies
+    the effect (sleep-then-proceed for stall, skip-the-collective for
+    skip). Unarmed this is one is-None test — the poll sits at the top
+    of EVERY hostring collective, so the production path must stay free.
+    Like :func:`throttle`, other modes at the same name are ignored so
+    a shared site can't double-consume budgets."""
+    if _plan is None:
+        return None
+    if site not in KNOWN_SITES:  # armed-only: the unarmed path stays
+        _warn_unknown_site(site)  # one is-None test
+    s = _plan.sites.get(site)
+    if s is None or s.mode not in ("stall", "skip") or not s.decide(path):
+        return None
+    logger.warning(
+        "fault injection: hang %s at %s (mode=%s, seconds=%s, %d/%s)",
+        site, path or "<no path>", s.mode, s.seconds, s.fired,
+        s.count if s.count is not None else "inf",
+    )
+    return (s.mode, s.seconds)
+
+
 def check(site: str, path: Optional[str] = None) -> None:
     """The production fault site: no-op unless this site is armed and its
     budgets elect this check. ``path`` (when the site touches a file)
@@ -414,7 +470,7 @@ def check(site: str, path: Optional[str] = None) -> None:
     if site not in KNOWN_SITES:  # armed-only: the unarmed path stays
         _warn_unknown_site(site)  # one is-None test
     s = _plan.sites.get(site)
-    if s is None or s.mode == "throttle" or not s.decide(path):
+    if s is None or s.mode in ("throttle", "stall", "skip") or not s.decide(path):
         return
     logger.warning(
         "fault injection: firing %s (mode=%s, %d/%s) at %s",

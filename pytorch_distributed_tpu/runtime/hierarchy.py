@@ -58,10 +58,13 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
+from pytorch_distributed_tpu.runtime import flightrec
 from pytorch_distributed_tpu.runtime.hostring import (
     HostRingGroup,
     _HALF,
     _as_contig,
+    algo_wire_bytes,
+    q8_wire_payload,
 )
 
 
@@ -76,6 +79,12 @@ class _LegGuard:
     def __exit__(self, exc_type, exc, tb):
         if exc is not None and isinstance(exc, (RuntimeError, OSError)):
             self._g._poisoned = str(exc)
+            # group poison is a dump trigger: some members hold partial
+            # results, some are still blocked — the flight ring holds
+            # which leg (intra/inter segment name) stopped the world
+            flightrec.dump(
+                f"hierarchical group {self._g.name} poisoned: {exc}"
+            )
         return False
 
 
@@ -179,6 +188,19 @@ class HierarchicalGroup:
                 "elastic membership path"
             )
 
+    def _flight(self, kind: str, op: str, count: int, dtype,
+                payload_bytes: int) -> int:
+        """Begin this hierarchical collective's always-on flight record
+        (transport kind ``hier``). The legs record their own group-level
+        and transport-level entries against the ``<name>_d<h>`` /
+        ``<name>_x`` segments, so an autopsy sees the failing leg AND
+        the enclosing hierarchical op."""
+        return flightrec.RECORDER.begin(
+            kind, op, dtype, int(count),
+            algo_wire_bytes(kind, payload_bytes, self.world_size),
+            "hier", self.name,
+        )
+
     # -- introspection -----------------------------------------------------
     @property
     def is_leader(self) -> bool:
@@ -193,6 +215,8 @@ class HierarchicalGroup:
 
     # -- collectives -------------------------------------------------------
     def barrier(self) -> None:
+        fseq = self._flight("barrier", "", 0, "", 0)
+        flightrec.RECORDER.start(fseq)
         with self._legs():
             self._intra.barrier()
             if self._inter is not None:
@@ -200,6 +224,7 @@ class HierarchicalGroup:
             # second intra barrier: non-leaders must not cross until
             # their leader has heard from every other domain
             self._intra.barrier()
+        flightrec.RECORDER.complete(fseq)
 
     def all_reduce(self, x, op: str = "sum", *,
                    inplace: bool = False) -> np.ndarray:
@@ -220,11 +245,14 @@ class HierarchicalGroup:
         # rounding — the flat ring's divide-then-round discipline)
         leg_op = "sum" if op == "avg" else op
         work = a.astype(np.float32) if half else a
+        fseq = self._flight("all_reduce", op, a.size, a.dtype, a.nbytes)
+        flightrec.RECORDER.start(fseq)
         with self._legs():
             self._intra.all_reduce(work, op=leg_op, inplace=True)
             if self._inter is not None:
                 self._inter.all_reduce(work, op=leg_op, inplace=True)
             self._intra.broadcast(work, src=0, inplace=True)
+        flightrec.RECORDER.complete(fseq)
         if op == "avg" and not int_avg:
             work /= work.dtype.type(self.world_size)
         if half:
@@ -259,11 +287,19 @@ class HierarchicalGroup:
                 )
         else:
             a = np.ascontiguousarray(x, dtype=np.float32).copy()
+        fseq = flightrec.RECORDER.begin(
+            "all_reduce_q8", op, a.dtype, int(a.size),
+            algo_wire_bytes("all_reduce_q8", q8_wire_payload(a.size),
+                            self.world_size),
+            "hier", self.name,
+        )
+        flightrec.RECORDER.start(fseq)
         with self._legs():
             self._intra.all_reduce(a, op="sum", inplace=True)
             if self._inter is not None:
                 self._inter.all_reduce_q8(a, op="sum", inplace=True)
             self._intra.broadcast(a, src=0, inplace=True)
+        flightrec.RECORDER.complete(fseq)
         if op == "avg":
             # divide AFTER the inter requantization, identically on
             # every rank (the inter q8 op cannot divide by the global
@@ -279,6 +315,9 @@ class HierarchicalGroup:
                 f"got {[len(dom) for dom in self.domains]}"
             )
         a = _as_contig(x, dtype_required=False)
+        fseq = self._flight("all_gather", "", a.size, a.dtype,
+                            a.nbytes * self.world_size)
+        flightrec.RECORDER.start(fseq)
         with self._legs():
             local = self._intra.all_gather(a)  # [d, ...] in domain order
             out = np.empty((self.world_size,) + a.shape, a.dtype)
@@ -290,6 +329,7 @@ class HierarchicalGroup:
                     for l, r in enumerate(dom):
                         out[r] = gathered[h, l]
             self._intra.broadcast(out, src=0, inplace=True)
+        flightrec.RECORDER.complete(fseq)
         return out
 
     def reduce_scatter(self, x, op: str = "sum") -> np.ndarray:
@@ -310,6 +350,9 @@ class HierarchicalGroup:
     def broadcast(self, x, src: int = 0) -> np.ndarray:
         a = _as_contig(x, dtype_required=False).copy()
         src_dom = [i for i, d in enumerate(self.domains) if src in d][0]
+        fseq = self._flight("broadcast", str(src), a.size, a.dtype,
+                            a.nbytes)
+        flightrec.RECORDER.start(fseq)
         with self._legs():
             # hop 1: the source's own domain moves the data to its
             # leader (every member of that intra group participates —
@@ -322,6 +365,7 @@ class HierarchicalGroup:
                 self._inter.broadcast(a, src=src_dom, inplace=True)
             # hop 3: every domain fans out from its leader
             self._intra.broadcast(a, src=0, inplace=True)
+        flightrec.RECORDER.complete(fseq)
         return a
 
     def send(self, x, dst: int) -> None:

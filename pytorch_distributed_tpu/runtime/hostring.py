@@ -20,9 +20,10 @@ from typing import Optional
 
 import numpy as np
 
-# tracing is deliberately jax-free too, so instrumenting the collectives
-# keeps this module importable from spawned workers without a TPU runtime
-from pytorch_distributed_tpu.runtime import tracing
+# tracing/faults/flightrec are deliberately jax-free too, so instrumenting
+# the collectives keeps this module importable from spawned workers without
+# a TPU runtime
+from pytorch_distributed_tpu.runtime import faults, flightrec, tracing
 
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
@@ -125,8 +126,14 @@ def _load() -> ctypes.CDLL:
 
 def _check(rc: int, what: str) -> None:
     if rc != 0:
+        # where this rank stopped, even without a dump dir armed: the
+        # flight recorder's last completed record turns a bare deadline
+        # legend into "the world died after seq N kind/op"
+        where = flightrec.last_completed_desc()
+        flightrec.dump(f"hostring {what} failed (rc={rc}; {where})")
         raise RuntimeError(f"hostring {what} failed (rc={rc}; "
-                           f"-110=peer timeout, -22=bad args, -5=peer died)")
+                           f"-110=peer timeout, -22=bad args, -5=peer died; "
+                           f"{where})")
 
 
 def _as_contig(x, dtype_required=True) -> np.ndarray:
@@ -394,6 +401,35 @@ class HostRingGroup:
             clock_offsets_s=self.clock_offsets_s,
         )
 
+    def _hang(self, kind: str) -> bool:
+        """The ``comm.hang`` injection poll at the top of every collective
+        (one is-None test unarmed). ``mode=stall`` sleeps here and
+        proceeds; ``mode=skip`` returns True and the caller skips the
+        transport call entirely, returning its LOCAL data — the desynced
+        rank the flight-recorder autopsy exists to name. A skipped
+        collective deliberately leaves NO flight record: the victim's
+        log really does end one operation early, which is exactly the
+        evidence shape the ``missing_rank``/``mismatch`` verdicts key on."""
+        act = faults.hang_action("comm.hang", kind)
+        if act is None:
+            return False
+        mode, seconds = act
+        if mode == "stall":
+            time.sleep(seconds)
+            return False
+        return True  # skip
+
+    def _flight(self, kind: str, op: str, count: int, dtype,
+                payload_bytes: int) -> int:
+        """Begin this collective's always-on flight record (ENQUEUED).
+        Not tracer-gated on purpose — see runtime/flightrec.py; the
+        per-record cost is pinned by bench.py's ``flightrec`` phase."""
+        return flightrec.RECORDER.begin(
+            kind, op, dtype, int(count),
+            algo_wire_bytes(kind, payload_bytes, self.world_size),
+            self._transport.kind, self.name,
+        )
+
     @property
     def bytes_sent(self) -> int:
         """Cumulative data bytes this rank's transport pushed — exact
@@ -423,19 +459,24 @@ class HostRingGroup:
             )
 
     def barrier(self) -> None:
+        if self._hang("barrier"):
+            return
         if self.debug:
             # a rank calling barrier() while peers issue a data collective
             # used to hang until the group deadline; the fingerprint
             # allgather meets the peers' _verify_uniform allgather and
             # both sides raise naming the divergent rank instead
             self._verify_uniform("barrier", np.zeros(0, np.uint8))
+        fseq = self._flight("barrier", "", 0, "", 0)
         tr = tracing._tracer
         span = tracing._NULL_SPAN if tr is None else _comm_span(
             tr, "barrier", "", 0, "", 0, self.world_size,
             self._transport.kind,
         )
+        flightrec.RECORDER.start(fseq)
         with span:
             self._transport.barrier()
+        flightrec.RECORDER.complete(fseq)
 
     def all_reduce(self, x, op: str = "sum", *, inplace: bool = False) -> np.ndarray:
         """``inplace=True`` reduces directly into ``x`` (torch
@@ -457,18 +498,23 @@ class HostRingGroup:
                 )
         else:
             a = a.copy()
+        if self._hang("all_reduce"):
+            return a  # skipped: local values, peers left at the rendezvous
         if self.debug:
             self._verify_uniform("all_reduce", a, op)
         # floats average natively (divide-then-round in the C f32
         # accumulator); integers sum natively and floor-divide here
         int_avg = op == "avg" and a.dtype.kind in "iu"
+        fseq = self._flight("all_reduce", op, a.size, a.dtype, a.nbytes)
         tr = tracing._tracer
         span = tracing._NULL_SPAN if tr is None else _comm_span(
             tr, "all_reduce", op, a.size, a.dtype, a.nbytes,
             self.world_size, self._transport.kind,
         )
+        flightrec.RECORDER.start(fseq)
         with span:
             self._transport.allreduce(a, "sum" if int_avg else op)
+        flightrec.RECORDER.complete(fseq)
         if int_avg:
             a //= self.world_size
         return a
@@ -506,8 +552,16 @@ class HostRingGroup:
                 )
         else:
             a = np.ascontiguousarray(x, dtype=np.float32).copy()
+        if self._hang("all_reduce_q8"):
+            return a
         if self.debug:
             self._verify_uniform("all_reduce_q8", a, op)
+        fseq = flightrec.RECORDER.begin(
+            "all_reduce_q8", op, a.dtype, int(a.size),
+            algo_wire_bytes("all_reduce_q8", q8_wire_payload(a.size),
+                            self.world_size),
+            self._transport.kind, self.name,
+        )
         tr = tracing._tracer
         # payload = the REAL wire occupancy of the quantized form (int8 +
         # one f32 scale per 256-elem block), NOT the f32 nbytes — the
@@ -526,22 +580,30 @@ class HostRingGroup:
                 "transport": self._transport.kind,
             },
         )
+        flightrec.RECORDER.start(fseq)
         with span:
             self._transport.allreduce_q8(a, op)
+        flightrec.RECORDER.complete(fseq)
         return a
 
     def all_gather(self, x) -> np.ndarray:
         a = _as_contig(x, dtype_required=False)
+        out = np.empty((self.world_size,) + a.shape, a.dtype)
+        if self._hang("all_gather"):
+            out[:] = a  # skipped: every row is this rank's local data
+            return out
         if self.debug:
             self._verify_uniform("all_gather", a)
-        out = np.empty((self.world_size,) + a.shape, a.dtype)
+        fseq = self._flight("all_gather", "", a.size, a.dtype, out.nbytes)
         tr = tracing._tracer
         span = tracing._NULL_SPAN if tr is None else _comm_span(
             tr, "all_gather", "", a.size, a.dtype, out.nbytes,
             self.world_size, self._transport.kind,
         )
+        flightrec.RECORDER.start(fseq)
         with span:
             self._transport.allgather(a, out)
+        flightrec.RECORDER.complete(fseq)
         return out
 
     def reduce_scatter(self, x, op: str = "sum") -> np.ndarray:
@@ -556,16 +618,22 @@ class HostRingGroup:
             raise ValueError(
                 f"leading dim {a.shape[0]} != world_size {self.world_size}"
             )
+        out = np.empty(a.shape[1:], a.dtype)
+        if self._hang("reduce_scatter"):
+            out[:] = a[self.rank]  # skipped: this rank's unreduced chunk
+            return out.astype(half) if half is not None else out
         if self.debug:
             self._verify_uniform("reduce_scatter", a, op)
-        out = np.empty(a.shape[1:], a.dtype)
+        fseq = self._flight("reduce_scatter", op, a.size, a.dtype, a.nbytes)
         tr = tracing._tracer
         span = tracing._NULL_SPAN if tr is None else _comm_span(
             tr, "reduce_scatter", op, a.size, a.dtype, a.nbytes,
             self.world_size, self._transport.kind,
         )
+        flightrec.RECORDER.start(fseq)
         with span:
             self._transport.reduce_scatter(a, out, op)
+        flightrec.RECORDER.complete(fseq)
         return out.astype(half) if half is not None else out
 
     def broadcast(self, x, src: int = 0, *,
@@ -584,15 +652,20 @@ class HostRingGroup:
                 )
         else:
             a = _as_contig(x, dtype_required=False).copy()
+        if self._hang("broadcast"):
+            return a  # skipped: local bytes, whatever the src holds
         if self.debug:
             self._verify_uniform("broadcast", a, str(src))
+        fseq = self._flight("broadcast", str(src), a.size, a.dtype, a.nbytes)
         tr = tracing._tracer
         span = tracing._NULL_SPAN if tr is None else _comm_span(
             tr, "broadcast", str(src), a.size, a.dtype, a.nbytes,
             self.world_size, self._transport.kind,
         )
+        flightrec.RECORDER.start(fseq)
         with span:
             self._transport.broadcast(a, src)
+        flightrec.RECORDER.complete(fseq)
         return a
 
     def all_to_all(self, x) -> np.ndarray:
@@ -655,29 +728,39 @@ class HostRingGroup:
         (per-pair shm mailbox — no group barrier, bystander ranks are free
         to run other collectives or nothing at all)."""
         a = _as_contig(x, dtype_required=False).copy()
+        if self._hang("send"):
+            return  # skipped: the peer's recv is left hanging
         if self.debug:
             self._verify_p2p(a, self.rank, dst)
+        fseq = self._flight("send", f"->{dst}", a.size, a.dtype, a.nbytes)
         tr = tracing._tracer
         span = tracing._NULL_SPAN if tr is None else _comm_span(
             tr, "send", f"->{dst}", a.size, a.dtype, a.nbytes,
             self.world_size, self._transport.kind,
         )
+        flightrec.RECORDER.start(fseq)
         with span:
             self._transport.sendrecv(a, self.rank, dst)
+        flightrec.RECORDER.complete(fseq)
 
     def recv(self, x, src: int) -> np.ndarray:
         """x supplies shape/dtype; returns the received array. True P2P —
         see :meth:`send`."""
         a = _as_contig(x, dtype_required=False).copy()
+        if self._hang("recv"):
+            return a  # skipped: stale local bytes, the sender left hanging
         if self.debug:
             self._verify_p2p(a, src, self.rank)
+        fseq = self._flight("recv", f"<-{src}", a.size, a.dtype, a.nbytes)
         tr = tracing._tracer
         span = tracing._NULL_SPAN if tr is None else _comm_span(
             tr, "recv", f"<-{src}", a.size, a.dtype, a.nbytes,
             self.world_size, self._transport.kind,
         )
+        flightrec.RECORDER.start(fseq)
         with span:
             self._transport.sendrecv(a, src, self.rank)
+        flightrec.RECORDER.complete(fseq)
         return a
 
     def close(self) -> None:

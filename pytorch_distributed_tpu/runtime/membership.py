@@ -63,7 +63,12 @@ import time
 import zlib
 from typing import List, Optional, Tuple
 
-from pytorch_distributed_tpu.runtime import faults, rendezvous, tracing
+from pytorch_distributed_tpu.runtime import (
+    faults,
+    flightrec,
+    rendezvous,
+    tracing,
+)
 from pytorch_distributed_tpu.runtime.hostring import (
     HostRingGroup,
     unlink_segment,
@@ -215,6 +220,10 @@ class WorldMembership:
             deadline = time.monotonic() + self.rendezvous_timeout_s
             while len(self._read_members()) < world_size:
                 if time.monotonic() > deadline:
+                    flightrec.dump(
+                        f"{self.worker_id}: establish() announce-count "
+                        f"deadline at world {world_size}"
+                    )
                     raise MembershipError(
                         f"only {len(self._read_members())} of "
                         f"{world_size} members announced within "
@@ -249,6 +258,14 @@ class WorldMembership:
         deadline = time.monotonic() + self.rendezvous_timeout_s
         while True:
             if time.monotonic() > deadline:
+                # the view-commit deadline is an elastic-drill dump
+                # trigger: whatever collective wedged the OLD world is
+                # still in this process's flight ring
+                flightrec.dump(
+                    f"{self.worker_id}: no view committed within "
+                    f"{self.rendezvous_timeout_s:.0f}s (last bid "
+                    f"{self._bid})"
+                )
                 raise MembershipError(
                     f"{self.worker_id}: no view committed within "
                     f"{self.rendezvous_timeout_s:.0f}s (last bid "
@@ -282,6 +299,9 @@ class WorldMembership:
                 continue
             view = WorldView(epoch=epoch, members=members, rank=rank)
             self.view, self.ring, self._bid = view, ring, epoch
+            # the committed view's rank is THE rank a later flight dump
+            # should carry (re-meshes renumber; latest view wins)
+            flightrec.configure(rank=rank, world=len(members))
             self._write_member()
             if rank == 0:
                 self._write_view_record(view)
@@ -301,6 +321,9 @@ class WorldMembership:
         last = None
         while True:
             if time.monotonic() > deadline:
+                flightrec.dump(
+                    f"{self.worker_id}: candidate set never settled"
+                )
                 raise MembershipError(
                     f"{self.worker_id}: candidate set never settled"
                 )

@@ -65,7 +65,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from pytorch_distributed_tpu.runtime import faults
+from pytorch_distributed_tpu.runtime import faults, flightrec
 
 #: simulated slow-link bandwidth for the ``transport.slow_link`` throttle:
 #: an armed factor F sleeps ``bytes * (F - 1) / SLOW_LINK_BYTES_PER_S``
@@ -74,6 +74,30 @@ from pytorch_distributed_tpu.runtime import faults
 SLOW_LINK_BYTES_PER_S = 1e9
 
 _CONNECT_POLL_S = 0.01
+
+# hostring.algo_wire_bytes, bound lazily (the hostring <-> transport
+# import cycle is one-directional at import time) and cached so the
+# always-on flight record below costs no repeated module lookup
+_algo_wire_bytes = None
+
+
+def _flight_start(t: "Transport", kind: str, op: str, count: int, dtype,
+                  payload_bytes: int) -> int:
+    """Begin one transport-level flight record, already STARTED (the
+    transport is the wire: there is no enqueued-but-not-started window
+    at this layer). Always-on by design — see runtime/flightrec.py."""
+    global _algo_wire_bytes
+    if _algo_wire_bytes is None:
+        from pytorch_distributed_tpu.runtime.hostring import algo_wire_bytes
+
+        _algo_wire_bytes = algo_wire_bytes
+    seq = flightrec.RECORDER.begin(
+        kind, op, dtype, int(count),
+        _algo_wire_bytes(kind, payload_bytes, t.world_size),
+        t.kind, t.name,
+    )
+    flightrec.RECORDER.start(seq)
+    return seq
 
 
 class Transport:
@@ -166,22 +190,30 @@ class ShmTransport(Transport):
         )
 
     def barrier(self) -> None:
+        seq = _flight_start(self, "barrier", "", 0, "", 0)
         self._hr._check(self._lib.hr_barrier(self._h), "barrier")
+        flightrec.RECORDER.complete(seq)
 
     def allreduce(self, a: np.ndarray, op: str) -> None:
+        seq = _flight_start(self, "all_reduce", op, a.size, a.dtype,
+                            a.nbytes)
         rc = self._lib.hr_allreduce(
             self._h, a.ctypes.data_as(ctypes.c_void_p), a.size,
             self._hr._DTYPES[a.dtype], self._hr._OPS[op],
         )
         self._hr._check(rc, "all_reduce")
+        flightrec.RECORDER.complete(seq)
         self._count("all_reduce", a.nbytes)
 
     def allreduce_q8(self, a: np.ndarray, op: str) -> None:
+        seq = _flight_start(self, "all_reduce_q8", op, a.size, a.dtype,
+                            self._hr.q8_wire_payload(a.size))
         rc = self._lib.hr_allreduce_q8(
             self._h, a.ctypes.data_as(ctypes.c_void_p), a.size,
             self._hr._OPS[op],
         )
         self._hr._check(rc, "all_reduce_q8")
+        flightrec.RECORDER.complete(seq)
         self._count("all_reduce_q8", self._hr.q8_wire_payload(a.size))
 
     def allgather(self, src: np.ndarray, out: np.ndarray) -> None:
@@ -192,36 +224,49 @@ class ShmTransport(Transport):
             count, dt = src.size, self._hr._DTYPES[src.dtype]
         else:
             count, dt = src.nbytes, self._hr._U8
+        seq = _flight_start(self, "all_gather", "", src.size, src.dtype,
+                            out.nbytes)
         rc = self._lib.hr_allgather(
             self._h, src.ctypes.data_as(ctypes.c_void_p),
             out.ctypes.data_as(ctypes.c_void_p), count, dt,
         )
         self._hr._check(rc, "all_gather")
+        flightrec.RECORDER.complete(seq)
         self._count("all_gather", out.nbytes)
 
     def reduce_scatter(self, src: np.ndarray, out: np.ndarray,
                        op: str) -> None:
+        seq = _flight_start(self, "reduce_scatter", op, src.size,
+                            src.dtype, src.nbytes)
         rc = self._lib.hr_reduce_scatter(
             self._h, src.ctypes.data_as(ctypes.c_void_p),
             out.ctypes.data_as(ctypes.c_void_p), out.size,
             self._hr._DTYPES[src.dtype], self._hr._OPS[op],
         )
         self._hr._check(rc, "reduce_scatter")
+        flightrec.RECORDER.complete(seq)
         self._count("reduce_scatter", src.nbytes)
 
     def broadcast(self, buf: np.ndarray, src: int) -> None:
+        seq = _flight_start(self, "broadcast", str(src), buf.size,
+                            buf.dtype, buf.nbytes)
         rc = self._lib.hr_broadcast(
             self._h, buf.ctypes.data_as(ctypes.c_void_p), buf.nbytes, src
         )
         self._hr._check(rc, "broadcast")
+        flightrec.RECORDER.complete(seq)
         self._count("broadcast", buf.nbytes)
 
     def sendrecv(self, buf: np.ndarray, src: int, dst: int) -> None:
+        kind = "send" if self.rank == src else "recv"
+        seq = _flight_start(self, kind, f"{src}->{dst}", buf.size,
+                            buf.dtype, buf.nbytes)
         rc = self._lib.hr_sendrecv(
             self._h, buf.ctypes.data_as(ctypes.c_void_p), buf.nbytes,
             src, dst,
         )
         self._hr._check(rc, "sendrecv")
+        flightrec.RECORDER.complete(seq)
         if self.rank == src:
             self._count("send", buf.nbytes)
 
@@ -515,6 +560,9 @@ class TcpTransport(Transport):
     # -- the exchange workhorse --------------------------------------------
     def _poison(self, reason: str) -> None:
         self._poisoned = reason
+        # autopsy-ready evidence before the sockets go away: the record
+        # still STARTED at the head of the ring is the hung exchange
+        flightrec.dump(f"tcp transport {self.name} poisoned: {reason}")
         self._close_all()
 
     def _close_all(self) -> None:
@@ -632,6 +680,7 @@ class TcpTransport(Transport):
     def barrier(self) -> None:
         if self.world_size == 1:
             return
+        seq = _flight_start(self, "barrier", "", 0, "", 0)
         token = np.zeros(1, np.uint8)
         if self.rank == 0:
             gather = {r: np.zeros(1, np.uint8)
@@ -644,6 +693,7 @@ class TcpTransport(Transport):
             self._exchange({0: [_byte_view(token)]}, {}, control=True)
             got = np.zeros(1, np.uint8)
             self._exchange({}, {0: [_byte_view(got)]}, control=True)
+        flightrec.RECORDER.complete(seq)
 
     def allreduce(self, a: np.ndarray, op: str) -> None:
         if op == "avg" and a.dtype.kind not in "f" and a.dtype not in (
@@ -660,6 +710,8 @@ class TcpTransport(Transport):
         chunk = self.slot_bytes // esize
         if chunk == 0:
             raise ValueError("slot_bytes smaller than one element")
+        fseq = _flight_start(self, "all_reduce", op, a.size, a.dtype,
+                             a.nbytes)
         flat = a.reshape(-1)
         w, me = self.world_size, self.rank
         ranges = allreduce_ranges(flat.size, w, chunk)
@@ -702,9 +754,13 @@ class TcpTransport(Transport):
         recv = {r: [_byte_view(flat[s:s + n]) for s, n in ranges[r]]
                 for r in range(w) if r != me}
         self._exchange(send, recv)
+        flightrec.RECORDER.complete(fseq)
 
     def allreduce_q8(self, a: np.ndarray, op: str) -> None:
-        from pytorch_distributed_tpu.runtime.hostring import Q8_BLOCK
+        from pytorch_distributed_tpu.runtime.hostring import (
+            Q8_BLOCK,
+            q8_wire_payload,
+        )
 
         if op not in ("sum", "avg"):
             raise ValueError(f"q8 allreduce supports sum/avg, got {op!r}")
@@ -718,6 +774,8 @@ class TcpTransport(Transport):
                 f"allreduce at world {w} (needs >= {Q8_BLOCK} elems "
                 "per rank per chunk, like the native ring)"
             )
+        fseq = _flight_start(self, "all_reduce_q8", op, a.size, a.dtype,
+                             q8_wire_payload(a.size))
         flat = a.reshape(-1)
         ranges = allreduce_ranges(flat.size, w, chunk, q8=True)
 
@@ -793,6 +851,7 @@ class TcpTransport(Transport):
                 continue
             for (s, n), (q, sc) in zip(ranges[r], peer_red[r]):
                 flat[s:s + n] = q8_dequantize(q, sc)
+        flightrec.RECORDER.complete(fseq)
 
     def allgather(self, src: np.ndarray, out: np.ndarray) -> None:
         out_rows = out.reshape(self.world_size, -1)
@@ -800,11 +859,14 @@ class TcpTransport(Transport):
         out_rows[self.rank] = flat
         if self.world_size == 1:
             return
+        fseq = _flight_start(self, "all_gather", "", src.size, src.dtype,
+                             out.nbytes)
         send = {r: [_byte_view(flat)]
                 for r in range(self.world_size) if r != self.rank}
         recv = {r: [_byte_view(out_rows[r])]
                 for r in range(self.world_size) if r != self.rank}
         self._exchange(send, recv)
+        flightrec.RECORDER.complete(fseq)
 
     def reduce_scatter(self, src: np.ndarray, out: np.ndarray,
                        op: str) -> None:
@@ -816,6 +878,8 @@ class TcpTransport(Transport):
         flat_out[...] = rows[me]
         if w == 1:
             return
+        fseq = _flight_start(self, "reduce_scatter", op, src.size,
+                             src.dtype, src.nbytes)
         send = {r: [_byte_view(rows[r])] for r in range(w) if r != me}
         inbox = {r: np.empty(flat_out.size, src.dtype)
                  for r in range(w) if r != me}
@@ -827,12 +891,15 @@ class TcpTransport(Transport):
         for k in range(1, w):
             acc = _combine(acc, inbox[(me + k) % w], op)
         flat_out[...] = acc
+        flightrec.RECORDER.complete(fseq)
 
     def broadcast(self, buf: np.ndarray, src: int) -> None:
         if not 0 <= src < self.world_size:
             raise ValueError(f"bad broadcast src {src}")
         if self.world_size == 1:
             return
+        fseq = _flight_start(self, "broadcast", str(src), buf.size,
+                             buf.dtype, buf.nbytes)
         flat = buf.reshape(-1)
         if self.rank == src:
             self._exchange({r: [_byte_view(flat)]
@@ -840,6 +907,7 @@ class TcpTransport(Transport):
                            {})
         else:
             self._exchange({}, {src: [_byte_view(flat)]})
+        flightrec.RECORDER.complete(fseq)
 
     def sendrecv(self, buf: np.ndarray, src: int, dst: int) -> None:
         if src == dst or not (0 <= src < self.world_size
@@ -849,11 +917,15 @@ class TcpTransport(Transport):
             raise ValueError(
                 f"rank {self.rank} is a bystander of p2p {src}->{dst}"
             )
+        fseq = _flight_start(self, "send" if self.rank == src else "recv",
+                             f"{src}->{dst}", buf.size, buf.dtype,
+                             buf.nbytes)
         flat = buf.reshape(-1)
         if self.rank == src:
             self._exchange({dst: [_byte_view(flat)]}, {})
         else:
             self._exchange({}, {src: [_byte_view(flat)]})
+        flightrec.RECORDER.complete(fseq)
 
     def close(self) -> None:
         self._close_all()

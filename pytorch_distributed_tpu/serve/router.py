@@ -44,7 +44,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence
 
-from pytorch_distributed_tpu.runtime import faults
+from pytorch_distributed_tpu.runtime import faults, flightrec
 from pytorch_distributed_tpu.serve.disagg import roundtrip_frame
 from pytorch_distributed_tpu.serve.scheduler import (
     Request,
@@ -380,6 +380,7 @@ class Router:
         """Evict a lost engine and replay every request it owned on a
         surviving peer — from scratch, same Request + seed, so the
         replayed final stream is bit-identical to the no-fault run."""
+        flightrec.dump(f"serve engine {eid} lost: {cause!r}")
         self._engines.pop(eid)
         for ids in (self._prefill_ids, self._decode_ids, self._solo_ids):
             if eid in ids:

@@ -39,7 +39,7 @@ def parse_args(argv=None):
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
     p.add_argument("--drill", choices=("kill_resume", "resize",
-                                       "ckpt_shard"),
+                                       "ckpt_shard", "hang"),
                    default="kill_resume",
                    help="kill_resume: SIGKILL the whole training process "
                    "and restart it from disk (the original drill). "
@@ -53,7 +53,13 @@ def parse_args(argv=None):
                    "assert the torn epoch reads as absent, restart the "
                    "whole world, and assert it restores the newest "
                    "world-COMPLETE epoch and finishes bit-identical to "
-                   "an uninterrupted reference (train/ckpt_io.py)")
+                   "an uninterrupted reference (train/ckpt_io.py). "
+                   "hang: one rank of a live ring silently desyncs "
+                   "(comm.hang mode=skip — no crash, no error, it just "
+                   "stops showing up), every survivor must hit its "
+                   "collective deadline, dump its flight ring, and the "
+                   "merged autopsy must name the victim and the "
+                   "diverging seq/op (runtime/flightrec.py)")
     p.add_argument("--world", type=int, default=3,
                    help="[resize] genesis world size")
     p.add_argument("--total-steps", type=int, default=36,
@@ -387,12 +393,98 @@ def ckpt_shard_main(args):
     return 0 if passed else 1
 
 
+def hang_main(args):
+    """The silent-desync drill: a 4-rank shm ring runs clean collective
+    rounds, then one rank arms ``comm.hang:mode=skip`` and silently
+    drops out of the next all_reduce — no crash, no error, the worst
+    failure shape a fleet sees. Every survivor must hit its 2s
+    collective deadline, dump its flight ring (``flight-rank<r>.json``),
+    and the merged ``hang_autopsy`` verdict must name the victim rank
+    and the diverging seq/op. The victim leaves NO dump by design — a
+    desynced rank's absence IS the evidence.
+    """
+    import multiprocessing as mp
+
+    from pytorch_distributed_tpu.runtime import flightrec
+    from tests.flight_workers import WARMUP_ROUNDS, hang_worker
+
+    base = args.ckpt_dir or tempfile.mkdtemp(prefix="hang_drill_")
+    owns_dir = args.ckpt_dir is None
+    t0 = time.monotonic()
+    world = 4
+    victim = world - 1
+    spec = "comm.hang:mode=skip"
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=hang_worker,
+                    args=(r, world, "hangdrill", q, base, victim, spec))
+        for r in range(world)
+    ]
+    for p in procs:
+        p.start()
+    reports = {}
+    for _ in range(world):
+        rank, payload = q.get(timeout=120)
+        reports[rank] = payload
+    for p in procs:
+        p.join(timeout=30)
+    worker_errs = {r: p["err"] for r, p in reports.items()
+                   if p["role"] == "?" or (p["role"] == "victim"
+                                           and p["err"])}
+    survivors = sorted(r for r in range(world) if r != victim)
+    all_dumped = all(
+        reports.get(r, {}).get("dump") is not None for r in survivors
+    )
+    dumps = flightrec.load_dumps(base) if os.path.isdir(base) else {}
+    verdict = flightrec.autopsy(dumps)
+    # the victim may or may not wedge itself after the skip — both
+    # missing_rank (it left no dump) and mismatch (it logged a diverging
+    # op before dying) name the same culprit with seq/op evidence
+    named = (
+        verdict["verdict"] in ("missing_rank", "mismatch")
+        and verdict["victim_rank"] == victim
+        and verdict["seq"] is not None
+        and verdict["op"] is not None
+    )
+    # the survivors completed WARMUP_ROUNDS clean rounds before the
+    # divergence, so the autopsy must point past them, not at round 0
+    deep_enough = all(
+        len(d.get("records", [])) > WARMUP_ROUNDS for d in dumps.values()
+    )
+    passed = (
+        not worker_errs and all_dumped and named and deep_enough
+        and victim not in dumps
+    )
+    print(json.dumps({
+        "drill": "hang",
+        "world": world,
+        "victim": victim,
+        "fault": spec,
+        "survivor_dumps": {
+            r: reports.get(r, {}).get("dump") for r in survivors
+        },
+        "victim_dumped": victim in dumps,
+        "worker_errors": worker_errs,
+        "verdict": verdict,
+        "wall_s": round(time.monotonic() - t0, 2),
+        "passed": passed,
+    }))
+    if passed and owns_dir:
+        shutil.rmtree(base, ignore_errors=True)
+    elif not passed:
+        print(f"# drill dir kept for autopsy: {base}", file=sys.stderr)
+    return 0 if passed else 1
+
+
 def main(argv=None):
     args = parse_args(argv)
     if args.drill == "resize":
         return resize_main(args)
     if args.drill == "ckpt_shard":
         return ckpt_shard_main(args)
+    if args.drill == "hang":
+        return hang_main(args)
     import numpy as np
 
     rng = np.random.default_rng(args.seed)

@@ -1,0 +1,126 @@
+"""Merge per-rank flight-recorder dumps into a hang verdict.
+
+Input: a directory of ``flight-rank<r>.json`` dumps — what every
+surviving process writes on a collective deadline, a transport poison,
+an elastic view-commit timeout, or ``SIGTERM`` when
+``PTD_FLIGHT_DUMP`` is armed (runtime/flightrec.py).  Output: one
+verdict naming the failure class —
+
+* ``missing_rank`` — a rank's log ends (or it left no dump) while a
+  peer shows the next collective started: the classic dead/desynced
+  victim,
+* ``mismatch`` — same occurrence index, different op/shape across
+  ranks: the PTD001 violation class, post-mortem,
+* ``straggler`` — streams agree but one rank's start stamps trail its
+  peers beyond the r6 clock-offset budget,
+* ``inconclusive`` — none of the above holds; the detail line says
+  what evidence was (and wasn't) there.
+
+Alongside the verdict the report prints a per-rank evidence table at
+the deciding occurrence index, and each rank's last completed record
+(the "how far did everyone get" view).
+
+Exit status: 0 when a verdict other than ``inconclusive`` was reached,
+2 on ``inconclusive``, 1 on unusable input (no dumps, duplicate
+ranks).  ``--json`` emits the verdict dict as one JSON line instead of
+the human report — the form the chaos drill asserts on.
+
+Torn ``.tmp`` orphans (writer SIGKILLed mid-dump) and unparseable
+files are skipped with a warning; ``--strict`` turns them into hard
+errors.  Two dumps claiming the same rank are always refused — a
+verdict merged over ambiguous evidence would be worse than none.
+
+Usage::
+
+    python scripts/hang_autopsy.py DUMP_DIR [--json] [--strict]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pytorch_distributed_tpu.runtime import flightrec  # noqa: E402
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("dump_dir", help="directory holding flight-rank*.json")
+    p.add_argument("--json", action="store_true",
+                   help="emit the verdict dict as one JSON line")
+    p.add_argument("--strict", action="store_true",
+                   help="hard-error on torn/invalid dumps instead of skipping")
+    return p.parse_args(argv)
+
+
+def _fmt_evidence(rows, out):
+    header = ("rank", "seq", "kind", "op", "count", "state")
+    table = [header] + [
+        tuple("-" if r[k] is None else str(r[k]) for k in header)
+        for r in rows
+    ]
+    widths = [max(len(row[i]) for row in table) for i in range(len(header))]
+    for j, row in enumerate(table):
+        print("  " + "  ".join(c.ljust(w) for c, w in zip(row, widths)),
+              file=out)
+        if j == 0:
+            print("  " + "  ".join("-" * w for w in widths), file=out)
+
+
+def report(dumps, verdict, out=None):
+    out = out or sys.stdout
+    print("== Hang autopsy ==", file=out)
+    print(f"  dumps: {len(dumps)} rank(s): {sorted(dumps)}", file=out)
+    for r in sorted(dumps):
+        p = dumps[r]
+        done = [rec for rec in p.get("records", ())
+                if rec["state"] == "completed"]
+        last = (f"seq={done[-1]['seq']} {done[-1]['kind']}/{done[-1]['op']} "
+                f"group={done[-1]['group']}" if done
+                else "no collective completed")
+        print(f"    rank {r}: {len(p.get('records', []))} record(s), "
+              f"last completed {last}  (dump reason: {p.get('reason')})",
+              file=out)
+    print(f"\n  verdict: {verdict['verdict']}", file=out)
+    if verdict["victim_rank"] is not None:
+        print(f"  victim:  rank {verdict['victim_rank']} at seq "
+              f"{verdict['seq']} ({verdict['op']}, group "
+              f"{verdict['group']})", file=out)
+    print(f"  detail:  {verdict['detail']}", file=out)
+    if verdict["evidence"]:
+        print("\n  evidence (deciding occurrence, one row per rank):",
+              file=out)
+        _fmt_evidence(verdict["evidence"], out)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if not os.path.isdir(args.dump_dir):
+        print(f"hang_autopsy: no such directory: {args.dump_dir}",
+              file=sys.stderr)
+        return 1
+    try:
+        dumps = flightrec.load_dumps(args.dump_dir, strict=args.strict)
+    except ValueError as e:
+        print(f"hang_autopsy: {e}", file=sys.stderr)
+        return 1
+    if not dumps:
+        print(f"hang_autopsy: no flight-rank*.json dumps under "
+              f"{args.dump_dir}", file=sys.stderr)
+        return 1
+    verdict = flightrec.autopsy(dumps)
+    if args.json:
+        print(json.dumps(verdict))
+    else:
+        report(dumps, verdict)
+    return 0 if verdict["verdict"] != "inconclusive" else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

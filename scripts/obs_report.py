@@ -34,6 +34,11 @@ investigation starts from —
   ``plan.json`` (``--strategy auto`` / autoplan/planner.py) sits in
   the run dir — the audit trail for why this run's strategy was
   chosen,
+* hang autopsy: when the run dir holds ``flight-rank*.json`` dumps
+  (what every surviving rank's always-on flight recorder writes on a
+  collective deadline or transport poison, runtime/flightrec.py), the
+  merged verdict — missing_rank / mismatch / straggler — with the
+  per-rank evidence rows at the deciding occurrence,
 * serving: TTFT percentiles plus the paged-KV saturation picture from
   ``split="serve"`` snapshots — peak pages in use, prefix-cache hit
   rate, and speculative accepted-tokens-per-verify when the engine ran
@@ -89,7 +94,10 @@ def parse_args(argv=None):
 def _discover(args):
     trace_path, metric_paths = args.trace, list(args.metrics or [])
     costmodel_path, plan_path = args.costmodel, args.plan
+    flight_dir = None
     if args.run_dir:
+        if glob.glob(os.path.join(args.run_dir, "flight-rank*.json")):
+            flight_dir = args.run_dir
         if trace_path is None:
             for name in ("trace.json", "merged_trace.json"):
                 cand = os.path.join(args.run_dir, name)
@@ -106,7 +114,7 @@ def _discover(args):
         if plan_path is None:
             cand = os.path.join(args.run_dir, "plan.json")
             plan_path = cand if os.path.isfile(cand) else None
-    return trace_path, metric_paths, costmodel_path, plan_path
+    return trace_path, metric_paths, costmodel_path, plan_path, flight_dir
 
 
 def plan_section(plan_path, out):
@@ -136,6 +144,44 @@ def plan_section(plan_path, out):
     for line in lines:
         print("  " + line, file=out)
     return doc
+
+
+def hang_section(flight_dir, out):
+    """Render the flight-recorder hang autopsy when a run dir holds
+    ``flight-rank*.json`` dumps — what every surviving rank writes on a
+    collective deadline, a transport poison, or an elastic view-commit
+    timeout (runtime/flightrec.py)."""
+    if not flight_dir:
+        return None
+    from pytorch_distributed_tpu.runtime import flightrec
+
+    try:
+        dumps = flightrec.load_dumps(flight_dir)
+    except ValueError as e:
+        print(f"\n== Hang autopsy ==\n  (flight dumps unusable: {e})",
+              file=out)
+        return None
+    if not dumps:
+        return None
+    verdict = flightrec.autopsy(dumps)
+    print("\n== Hang autopsy ==", file=out)
+    print(f"  source: {len(dumps)} flight dump(s) under {flight_dir} "
+          f"(ranks {sorted(dumps)})", file=out)
+    print(f"  verdict: {verdict['verdict']}", file=out)
+    if verdict["victim_rank"] is not None:
+        print(f"  victim:  rank {verdict['victim_rank']} at seq "
+              f"{verdict['seq']} ({verdict['op']}, group "
+              f"{verdict['group']})", file=out)
+    print(f"  detail:  {verdict['detail']}", file=out)
+    for r in verdict["evidence"]:
+        state = r["state"]
+        desc = ("left no dump" if state == "absent" else
+                f"seq={r['seq']} {r['kind']}/{r['op']} "
+                f"count={r['count']} [{state}]")
+        print(f"    rank {r['rank']}: {desc}", file=out)
+    print("  (full per-rank report: python scripts/hang_autopsy.py "
+          f"{flight_dir})", file=out)
+    return verdict
 
 
 def load_trace(path):
@@ -667,7 +713,7 @@ def phase_table(rows, wall_ms):
 
 
 def report(trace_path, metric_paths, top_n=10, out=None,
-           costmodel_path=None, plan_path=None):
+           costmodel_path=None, plan_path=None, flight_dir=None):
     # resolve the CURRENT sys.stdout, not import-time's: under pytest
     # capture an import-time default would pin the first importing
     # test's capture stream and every later caller would print into it
@@ -868,10 +914,13 @@ def report(trace_path, metric_paths, top_n=10, out=None,
                 f"({apv:.2f} accepted tokens/verify; each verify also "
                 f"emits its correction token)", file=out,
             )
+    # -- hang autopsy, if the run left flight dumps -----------------------
+    hang = hang_section(flight_dir, out)
+
     return {"spans": rows, "recompiles": recompiles, "goodput": g,
             "comms": comms or {}, "stragglers": stragglers or {},
             "checkpoint": ckpt or {}, "fleet": fleet or {},
-            "plan": plan_doc, "serve": serve}
+            "plan": plan_doc, "serve": serve, "hang": hang}
 
 
 def main(argv=None):
@@ -880,15 +929,18 @@ def main(argv=None):
         print("nothing to report: pass RUN_DIR or --trace/--metrics",
               file=sys.stderr)
         return 2
-    trace_path, metric_paths, costmodel_path, plan_path = _discover(args)
-    if not trace_path and not metric_paths and not plan_path:
+    (trace_path, metric_paths, costmodel_path, plan_path,
+     flight_dir) = _discover(args)
+    if (not trace_path and not metric_paths and not plan_path
+            and not flight_dir):
         print(
-            f"no trace.json, *.jsonl or plan.json found under "
-            f"{args.run_dir!r}", file=sys.stderr,
+            f"no trace.json, *.jsonl, plan.json or flight-rank*.json "
+            f"found under {args.run_dir!r}", file=sys.stderr,
         )
         return 2
     report(trace_path, metric_paths, top_n=args.top,
-           costmodel_path=costmodel_path, plan_path=plan_path)
+           costmodel_path=costmodel_path, plan_path=plan_path,
+           flight_dir=flight_dir)
     return 0
 
 

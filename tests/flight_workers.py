@@ -1,0 +1,95 @@
+"""Spawn targets for the flight-recorder hang tests (r19).
+
+Same contract as ``transport_workers``: importable by
+``multiprocessing`` spawn, every worker reports ``(rank, payload)``
+through the queue with ``payload["err"]`` carrying a traceback string
+on failure, and the workers stay JAX-free — they exercise the
+always-on recorder exactly the way a real training rank does.
+
+``hang_worker`` is the drill body shared by ``tests/test_flightrec.py``
+and ``scripts/chaos_drill.py --drill hang``: N ranks run a few clean
+collective rounds, then the victim arms ``comm.hang:mode=skip``
+in-process and silently drops out of the next all_reduce (skip returns
+its LOCAL data and leaves NO flight record — exactly the evidence
+shape a desynced rank produces).  The survivors block until the ring
+deadline fires, at which point ``hostring._check`` dumps their flight
+rings and raises with the last-completed clause.  Faults are armed via
+``faults.configure`` rather than ``PTD_FAULTS`` because spawn gives
+every child the same environment — per-rank arming has to happen after
+the fork, keyed on the rank argument.
+"""
+
+import os
+import sys
+import time
+import traceback
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: clean alternating rounds every rank completes before the hang round —
+#: enough history that the autopsy's "last completed" view is non-trivial
+WARMUP_ROUNDS = 3
+
+
+def hang_worker(rank: int, world: int, name: str, q, out_dir: str,
+                victim: int, spec: str) -> None:
+    """One rank of the hang drill; see the module docstring."""
+    try:
+        from pytorch_distributed_tpu.runtime import faults, flightrec
+        from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
+
+        flightrec.configure(out_dir=out_dir, rank=rank, world=world)
+        g = HostRingGroup(name, rank, world, slot_bytes=4096, timeout_s=2.0)
+        try:
+            x = np.ones(256, np.float32) * (rank + 1)
+            for _ in range(WARMUP_ROUNDS):
+                g.all_reduce(x)
+                g.all_gather(x)
+            if rank == victim:
+                # silent desync: skip returns local data, records nothing
+                faults.configure(spec)
+                g.all_reduce(x)
+                # outlive the survivors' deadline so they fail on their
+                # own -110 timeout, not on this process tearing down the
+                # shared ring under them
+                time.sleep(4.0)
+                q.put((rank, {"role": "victim", "dump": None, "err": None}))
+                return
+            err = None
+            try:
+                g.all_reduce(x)
+            except RuntimeError as e:
+                err = str(e)
+            assert err is not None, "survivor's collective did not deadline"
+            assert "last completed flight" in err, err
+            dump = os.path.join(out_dir,
+                                f"{flightrec.DUMP_PREFIX}{rank}.json")
+            assert os.path.exists(dump), f"survivor {rank} left no dump"
+            q.put((rank, {"role": "survivor", "dump": dump, "err": err}))
+        finally:
+            g.close()
+    except Exception as e:
+        q.put((rank, {"role": "?", "dump": None,
+                      "err": f"{type(e).__name__}: {e}\n"
+                             f"{traceback.format_exc()}"}))
+
+
+def env_dump_worker(out_dir: str) -> None:
+    """Subprocess body for the ``PTD_FLIGHT_DUMP`` env-arming test: run
+    with the env var set, log one completed record, then SIGTERM
+    yourself — the installed handler must dump before the process dies.
+    Spawned via ``python -c`` (not mp) so the import-time
+    ``_install_from_env`` path is the one under test."""
+    import signal
+
+    from pytorch_distributed_tpu.runtime import flightrec
+
+    seq = flightrec.RECORDER.begin("all_reduce", "sum", "float32",
+                                   64, 512, "shm", "env_world")
+    flightrec.RECORDER.start(seq)
+    flightrec.RECORDER.complete(seq)
+    os.kill(os.getpid(), signal.SIGTERM)
+    time.sleep(10.0)  # never reached: SIGTERM handler re-kills
+    raise SystemExit(f"SIGTERM did not terminate; dir was {out_dir}")

@@ -1,0 +1,15 @@
+"""PTD003 known-bad: typo'd hang-site names never fire."""
+from pytorch_distributed_tpu.runtime import faults
+
+
+def collective_entry(kind):
+    return faults.hang_action("comm.hng", kind)  # expect: PTD003
+
+
+def drill_spec():
+    with faults.injected("comms.hang:mode=skip"):  # expect: PTD003
+        pass
+
+
+def stall_spec(env):
+    env["PTD_FAULTS"] = "comm.hang_:mode=stall,seconds=0.5"  # expect: PTD003

@@ -1,0 +1,15 @@
+"""PTD003 known-good twins: hang-site names all in the registry."""
+from pytorch_distributed_tpu.runtime import faults
+
+
+def collective_entry(kind):
+    return faults.hang_action("comm.hang", kind)
+
+
+def drill_spec():
+    with faults.injected("comm.hang:mode=skip,match=all_gather"):
+        pass
+
+
+def stall_spec(env):
+    env["PTD_FAULTS"] = "comm.hang:mode=stall,seconds=0.5,count=1"

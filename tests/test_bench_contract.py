@@ -185,7 +185,7 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
     assert len(pd) == 1, proc.stderr[-2000:]
     for phase in ("input_pipeline_feed", "serving", "serving_paged",
                   "serving_spec", "serving_paged_attn",
-                  "observability", "planning", "elastic"):
+                  "observability", "flightrec", "planning", "elastic"):
         assert phase in pd[0]["value"], pd[0]
     assert pd[0]["value"] == pytest.approx(durations, abs=0.2)
 
@@ -200,6 +200,20 @@ def test_bench_cpu_fallback_is_host_meaningful(tmp_path):
     ]
     assert len(obs) == 1, proc.stderr[-2000:]
     assert obs[0]["value"] < 2.0, obs[0]
+
+    # the flightrec micro-phase: the ALWAYS-ON recorder's
+    # begin/start/complete triple must stay allocation-free cheap
+    # (measured ~1-3us on this box; 25us budget guards against dict
+    # churn or allocation creeping onto the hot path, not the box), and
+    # the 2-proc injected-hang smoke must end in an autopsy verdict
+    # naming the victim (the phase raises otherwise, so the metric's
+    # presence IS the assertion — value 1.0 by construction)
+    frec = one_metric("flightrec_record_overhead_us")
+    assert 0 < frec["value"] < 25.0, frec
+    hang = one_metric("flightrec_hang_verdict")
+    assert hang["value"] == 1.0, hang
+    assert "missing_rank" in hang["unit"], hang
+    assert durations.get("flightrec", 999) < 120, durations
 
     # the planning micro-phase: the auto-parallel planner must sweep
     # the two reference configs in host-arithmetic time (it is

@@ -1,0 +1,551 @@
+"""Collective flight recorder + cross-rank hang autopsy (r19).
+
+The contract under test has three layers:
+
+* the recorder itself — a fixed-slot always-on ring whose hot path
+  (begin/start/complete) never allocates, survives wraparound, and
+  keeps an O(1) last-completed summary for deadline error messages;
+* the dump discipline — ``flight-rank<r>.json`` written atomically
+  (tmp + ``os.replace``) with rank/world/clock metadata, a strict
+  no-op while unconfigured (error paths call :func:`flightrec.dump`
+  unconditionally, and the hundreds of tier-1 tests that provoke rc
+  failures on purpose must not leave files), armed by
+  ``PTD_FLIGHT_DUMP`` + SIGTERM in the environment path;
+* the autopsy — N dumps merged by per-group occurrence index into a
+  verdict (missing_rank / mismatch / straggler / inconclusive) with a
+  per-rank evidence table, refusing duplicate-rank dump sets and
+  skipping torn ``.tmp`` orphans with a warning.
+
+The 2-proc class runs a REAL hang: one rank arms ``comm.hang
+:mode=skip`` (the silent-desync fault this round adds to the
+registry) and vanishes from an all_reduce; the survivor must deadline,
+dump, raise with the last-completed clause, and the autopsy must name
+the victim. The 4-proc version lives in ``scripts/chaos_drill.py
+--drill hang``; the overhead budget in bench.py's ``flightrec`` phase.
+"""
+
+import contextlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.runtime import faults, flightrec, tracing
+from pytorch_distributed_tpu.runtime.flightrec import FlightRecorder
+
+from tests import flight_workers
+from tests.hostring_workers import run_ring_workers
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(REPO, "scripts")
+
+pytestmark = pytest.mark.flight
+
+
+@contextlib.contextmanager
+def ptd_caplog(caplog, level="WARNING"):
+    """The package's namespace logger has propagate=False; pipe it into
+    caplog, which only listens on the root logger (test_lint.py idiom)."""
+    ns = __import__("logging").getLogger("pytorch_distributed_tpu")
+    ns.addHandler(caplog.handler)
+    try:
+        with caplog.at_level(level, logger="pytorch_distributed_tpu"):
+            yield caplog
+    finally:
+        ns.removeHandler(caplog.handler)
+
+
+@pytest.fixture
+def fresh(monkeypatch):
+    """A private recorder + disarmed dump config: the process-wide
+    RECORDER accumulates records from every other test in this run, and
+    configure() is sticky by design — tests must not leak either."""
+    rec = FlightRecorder(64)
+    monkeypatch.setattr(flightrec, "RECORDER", rec)
+    monkeypatch.setattr(flightrec, "_dump_dir", None)
+    monkeypatch.setattr(flightrec, "_rank", None)
+    monkeypatch.setattr(flightrec, "_world", None)
+    return rec
+
+
+def _triple(rec, kind="all_reduce", op="sum", group="g", count=8):
+    seq = rec.begin(kind, op, "float32", count, count * 8, "shm", group)
+    rec.start(seq)
+    rec.complete(seq)
+    return seq
+
+
+class TestRecorder:
+    def test_state_machine_and_schema(self, fresh):
+        seq = fresh.begin("all_reduce", "sum", np.dtype(np.float32),
+                          128, 1024, "shm", "world")
+        assert seq == 0
+        assert fresh.records()[-1]["state"] == "enqueued"
+        fresh.start(seq)
+        assert fresh.records()[-1]["state"] == "started"
+        fresh.complete(seq)
+        r = fresh.records()[-1]
+        assert r["state"] == "completed"
+        assert r["kind"] == "all_reduce" and r["op"] == "sum"
+        assert r["dtype"] == "float32"  # stringified at snapshot time
+        assert r["count"] == 128 and r["wire_bytes"] == 1024
+        assert r["transport"] == "shm" and r["group"] == "world"
+        assert 0 < r["t0_mono_s"] <= r["t1_mono_s"]
+
+    def test_seq_monotonic_across_kinds(self, fresh):
+        seqs = [_triple(fresh, k) for k in
+                ("all_reduce", "all_gather", "barrier", "send")]
+        assert seqs == [0, 1, 2, 3]
+        assert [r["seq"] for r in fresh.records()] == seqs
+
+    def test_wraparound_keeps_newest(self):
+        rec = FlightRecorder(8)
+        for _ in range(20):
+            _triple(rec)
+        recs = rec.records()
+        assert len(recs) == 8
+        assert [r["seq"] for r in recs] == list(range(12, 20))
+
+    def test_stale_seq_after_wrap_is_ignored(self):
+        rec = FlightRecorder(4)
+        old = rec.begin("all_reduce", "sum", "f32", 1, 8, "shm", "g")
+        for _ in range(4):  # old's slot is reclaimed
+            _triple(rec, "barrier", "")
+        rec.complete(old)  # must NOT corrupt the slot's new owner
+        assert all(r["kind"] == "barrier" for r in rec.records())
+        assert rec.last_completed()[1] == "barrier"
+
+    def test_last_completed_is_newest_completed(self, fresh):
+        assert fresh.last_completed() is None
+        _triple(fresh, "all_reduce", "sum")
+        hung = fresh.begin("all_gather", "", "f32", 4, 32, "shm", "g")
+        fresh.start(hung)  # started, never completed
+        assert fresh.last_completed() == (0, "all_reduce", "sum")
+
+    def test_capacity_validation(self):
+        with pytest.raises(ValueError):
+            FlightRecorder(0)
+
+    def test_env_slot_override_in_subprocess(self):
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "from pytorch_distributed_tpu.runtime import flightrec; "
+             "print(flightrec.RECORDER.capacity)"],
+            env={**os.environ, "PTD_FLIGHT_SLOTS": "17",
+                 "JAX_PLATFORMS": "cpu"},
+            cwd=REPO, capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip() == "17"
+
+
+class TestDump:
+    def test_unconfigured_is_noop(self, fresh, tmp_path):
+        _triple(fresh)
+        assert flightrec.dump("should go nowhere") is None
+        assert list(tmp_path.iterdir()) == []
+
+    def test_dump_schema_and_atomicity(self, fresh, tmp_path):
+        flightrec.configure(out_dir=str(tmp_path), rank=2, world=4)
+        _triple(fresh, "all_reduce", "sum")
+        _triple(fresh, "all_gather", "")
+        path = flightrec.dump("unit test")
+        assert path == str(tmp_path / "flight-rank2.json")
+        assert not list(tmp_path.glob("*.tmp"))  # replace(), not rename-race
+        with open(path) as f:
+            payload = json.load(f)
+        assert payload["version"] == flightrec.DUMP_VERSION
+        assert payload["rank"] == 2 and payload["world_size"] == 4
+        assert payload["reason"] == "unit test"
+        assert payload["wall_unix_s"] > 0 and payload["monotonic_s"] > 0
+        assert isinstance(payload["meta"], dict)
+        kinds = [r["kind"] for r in payload["records"]]
+        assert kinds == ["all_reduce", "all_gather"]
+        assert all(r["state"] == "completed" for r in payload["records"])
+
+    def test_redump_overwrites_in_place(self, fresh, tmp_path):
+        flightrec.configure(out_dir=str(tmp_path), rank=0)
+        _triple(fresh)
+        flightrec.dump("first")
+        _triple(fresh)
+        flightrec.dump("second")
+        with open(tmp_path / "flight-rank0.json") as f:
+            payload = json.load(f)
+        assert payload["reason"] == "second"
+        assert len(payload["records"]) == 2
+
+    def test_explicit_dir_overrides_configured(self, fresh, tmp_path):
+        a, b = tmp_path / "a", tmp_path / "b"
+        flightrec.configure(out_dir=str(a), rank=0)
+        _triple(fresh)
+        path = flightrec.dump("elsewhere", out_dir=str(b))
+        assert path == str(b / "flight-rank0.json")
+        assert not a.exists()
+
+    def test_rank_precedence(self, fresh, monkeypatch, tmp_path):
+        # tracing meta is the weakest source...
+        monkeypatch.setattr(tracing, "_meta", {"rank": 5})
+        assert flightrec._resolved_rank() == 5
+        # ...the env var beats it...
+        monkeypatch.setenv("PTD_FLIGHT_RANK", "7")
+        assert flightrec._resolved_rank() == 7
+        # ...and configure() beats both (membership stamps each view)
+        flightrec.configure(rank=3)
+        assert flightrec._resolved_rank() == 3
+
+    def test_dump_never_raises(self, fresh):
+        flightrec.configure(out_dir="/proc/definitely/not/writable")
+        _triple(fresh)
+        assert flightrec.dump("doomed") is None  # logged, not raised
+
+
+class TestHangFaultSite:
+    def test_seconds_option_parsed_and_validated(self):
+        with faults.injected("comm.hang:mode=stall,seconds=0.25"):
+            assert faults.hang_action("comm.hang") == ("stall", 0.25)
+        try:
+            with pytest.raises(ValueError):
+                faults.configure("comm.hang:mode=stall,seconds=0")
+        finally:
+            faults.clear()
+
+    def test_skip_mode_and_match(self):
+        with faults.injected("comm.hang:mode=skip,match=all_gather"):
+            assert faults.hang_action("comm.hang", "all_reduce") is None
+            act = faults.hang_action("comm.hang", "all_gather")
+            assert act is not None and act[0] == "skip"
+
+    def test_disarmed_and_foreign_modes_return_none(self):
+        assert faults.hang_action("comm.hang") is None  # nothing armed
+        with faults.injected("comm.hang:mode=raise"):
+            # raise/kill/... belong to check(); hang_action ignores them
+            assert faults.hang_action("comm.hang") is None
+
+    def test_check_ignores_hang_modes(self):
+        with faults.injected("comm.hang:mode=skip"):
+            faults.check("comm.hang")  # must not raise InjectedFault
+        with faults.injected("comm.hang:mode=stall,seconds=9"):
+            faults.check("comm.hang")
+
+
+class TestHostRingIntegration:
+    """world=1 ring: the cheapest real HostRingGroup — every collective
+    still runs its full record/hang plumbing."""
+
+    def _group(self):
+        from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
+        return HostRingGroup(f"flt_{uuid.uuid4().hex[:8]}", 0, 1,
+                             slot_bytes=4096)
+
+    def test_collectives_leave_completed_records(self, fresh):
+        with self._group() as g:
+            g.all_reduce(np.ones(16, np.float32))
+            g.all_gather(np.ones(4, np.float32))
+            g.barrier()
+        kinds = [r["kind"] for r in fresh.records()]
+        # group-level records present (the shm transport may add its own)
+        for want in ("all_reduce", "all_gather", "barrier"):
+            assert want in kinds, kinds
+        assert all(r["state"] == "completed" for r in fresh.records())
+        assert "all_reduce/sum" in flightrec.last_completed_desc() or \
+            "barrier" in flightrec.last_completed_desc()
+
+    def test_skip_returns_local_and_records_nothing(self, fresh):
+        with self._group() as g:
+            x = np.arange(8, dtype=np.float32)
+            with faults.injected("comm.hang:mode=skip"):
+                y = g.all_reduce(x, op="sum")
+            assert y.tobytes() == x.tobytes()  # local values, no wire
+            # the silent desync leaves NO record — that absence is
+            # exactly the evidence the missing_rank verdict keys on
+            assert fresh.records() == []
+
+    def test_stall_delays_then_proceeds(self, fresh):
+        with self._group() as g:
+            x = np.ones(8, np.float32)
+            t0 = time.monotonic()
+            with faults.injected("comm.hang:mode=stall,seconds=0.2"):
+                y = g.all_reduce(x)
+            assert time.monotonic() - t0 >= 0.2
+            assert y.tobytes() == x.tobytes()
+            # stall is a delay, not a desync: the collective still ran
+            # and recorded
+            assert any(r["kind"] == "all_reduce" and
+                       r["state"] == "completed"
+                       for r in fresh.records())
+
+    def test_check_failure_names_last_completed_and_dumps(
+            self, fresh, tmp_path):
+        from pytorch_distributed_tpu.runtime.hostring import _check
+        flightrec.configure(out_dir=str(tmp_path), rank=0)
+        _triple(fresh, "all_reduce", "sum")
+        with pytest.raises(RuntimeError) as ei:
+            _check(-110, "all_gather")
+        assert "last completed flight seq=0 all_reduce/sum" in str(ei.value)
+        assert (tmp_path / "flight-rank0.json").exists()
+
+    def test_check_failure_before_any_collective(self, fresh):
+        from pytorch_distributed_tpu.runtime.hostring import _check
+        with pytest.raises(RuntimeError) as ei:
+            _check(-5, "barrier")
+        assert "no collective completed yet" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# synthetic-dump autopsy: each verdict class from hand-built evidence
+# ---------------------------------------------------------------------------
+
+def _rec(seq, kind, op="sum", count=4, state="completed", t0=1.0,
+         t1=2.0, group="g"):
+    return {"seq": seq, "kind": kind, "op": op, "dtype": "float32",
+            "count": count, "wire_bytes": 64, "transport": "shm",
+            "group": group, "state": state, "t0_mono_s": t0,
+            "t1_mono_s": t1}
+
+
+def _payload(rank, world, recs, off=0.0, offs=None):
+    meta = {"clock_offset_s": off}
+    if offs is not None:
+        meta["clock_offsets_s"] = offs
+    return {"version": flightrec.DUMP_VERSION, "rank": rank,
+            "world_size": world, "reason": "synthetic",
+            "wall_unix_s": 1000.0, "monotonic_s": 0.0, "meta": meta,
+            "records": recs}
+
+
+class TestAutopsyVerdicts:
+    def test_mismatch_names_minority(self):
+        dumps = {
+            0: _payload(0, 3, [_rec(0, "all_reduce"), _rec(1, "all_reduce")]),
+            1: _payload(1, 3, [_rec(0, "all_reduce"), _rec(1, "all_reduce")]),
+            2: _payload(2, 3, [_rec(0, "all_reduce"),
+                               _rec(1, "all_gather", op="")]),
+        }
+        v = flightrec.autopsy(dumps)
+        assert v["verdict"] == "mismatch"
+        assert v["victim_rank"] == 2
+        assert v["op"] == "all_gather"
+        assert "PTD001" in v["detail"]
+        assert {r["rank"] for r in v["evidence"]} == {0, 1, 2}
+
+    def test_missing_rank_stream_exhausted(self):
+        dumps = {
+            0: _payload(0, 2, [_rec(0, "all_reduce"),
+                               _rec(1, "all_reduce", state="started",
+                                    t1=0.0)]),
+            1: _payload(1, 2, [_rec(0, "all_reduce")]),
+        }
+        v = flightrec.autopsy(dumps)
+        assert v["verdict"] == "missing_rank"
+        assert v["victim_rank"] == 1
+        assert v["seq"] == 1 and v["op"] == "all_reduce/sum"
+
+    def test_missing_rank_absent_dump(self):
+        dumps = {
+            0: _payload(0, 3, [_rec(0, "all_reduce"),
+                               _rec(1, "all_reduce", state="started")]),
+            1: _payload(1, 3, [_rec(0, "all_reduce"),
+                               _rec(1, "all_reduce", state="started")]),
+        }
+        v = flightrec.autopsy(dumps)
+        assert v["verdict"] == "missing_rank"
+        assert v["victim_rank"] == 2
+        absent = [r for r in v["evidence"] if r["state"] == "absent"]
+        assert [r["rank"] for r in absent] == [2]
+
+    def test_straggler_beyond_budget(self):
+        dumps = {
+            0: _payload(0, 2, [_rec(0, "all_reduce", t0=1.0, t1=9.0)]),
+            1: _payload(1, 2, [_rec(0, "all_reduce", t0=6.0, t1=9.0)]),
+        }
+        v = flightrec.autopsy(dumps)
+        assert v["verdict"] == "straggler"
+        assert v["victim_rank"] == 1
+        assert "budget" in v["detail"]
+
+    def test_clock_offset_absorbs_apparent_skew(self):
+        # rank 1's stamps trail by 5s — but its wall clock leads by 5s
+        # (r6 calibration), so on shared wall time the starts align
+        dumps = {
+            0: _payload(0, 2, [_rec(0, "all_reduce", t0=1.0, t1=9.0)]),
+            1: _payload(1, 2, [_rec(0, "all_reduce", t0=6.0, t1=9.0)],
+                        off=5.0),
+        }
+        assert flightrec.autopsy(dumps)["verdict"] == "inconclusive"
+
+    def test_divergence_beats_straggler(self):
+        # a straggler-looking early round must not mask a later hard
+        # divergence: the op mismatch is the verdict, skew the footnote
+        dumps = {
+            0: _payload(0, 2, [_rec(0, "all_reduce", t0=1.0, t1=9.0),
+                               _rec(1, "all_reduce")]),
+            1: _payload(1, 2, [_rec(0, "all_reduce", t0=6.0, t1=9.0),
+                               _rec(1, "broadcast", op="0")]),
+        }
+        assert flightrec.autopsy(dumps)["verdict"] == "mismatch"
+
+    def test_inconclusive_on_single_or_empty(self):
+        assert flightrec.autopsy({})["verdict"] == "inconclusive"
+        one = {0: _payload(0, 1, [_rec(0, "all_reduce")])}
+        assert flightrec.autopsy(one)["verdict"] == "inconclusive"
+
+
+class TestLoadDumps:
+    def _write(self, tmp_path, name, payload):
+        with open(tmp_path / name, "w") as f:
+            if isinstance(payload, str):
+                f.write(payload)
+            else:
+                json.dump(payload, f)
+
+    def test_torn_tmp_skipped_with_warning(self, tmp_path, caplog):
+        self._write(tmp_path, "flight-rank0.json",
+                    _payload(0, 2, [_rec(0, "barrier", op="")]))
+        self._write(tmp_path, "flight-rank1.json.tmp", '{"rank": 1, "tru')
+        with ptd_caplog(caplog):
+            dumps = flightrec.load_dumps(str(tmp_path))
+        assert set(dumps) == {0}
+        assert any("torn" in r.getMessage() for r in caplog.records)
+        with pytest.raises(ValueError, match="torn"):
+            flightrec.load_dumps(str(tmp_path), strict=True)
+
+    def test_unparseable_json_skipped_with_warning(self, tmp_path, caplog):
+        self._write(tmp_path, "flight-rank0.json",
+                    _payload(0, 2, [_rec(0, "barrier", op="")]))
+        self._write(tmp_path, "flight-rank1.json", "not json at all {")
+        with ptd_caplog(caplog):
+            dumps = flightrec.load_dumps(str(tmp_path))
+        assert set(dumps) == {0}
+        with pytest.raises(ValueError):
+            flightrec.load_dumps(str(tmp_path), strict=True)
+
+    def test_duplicate_rank_refused_loudly(self, tmp_path):
+        p = _payload(0, 2, [_rec(0, "barrier", op="")])
+        self._write(tmp_path, "flight-rank0.json", p)
+        self._write(tmp_path, "flight-rank00.json", p)  # same rank claim
+        with pytest.raises(ValueError, match="duplicate"):
+            flightrec.load_dumps(str(tmp_path))
+
+    def test_version_mismatch_refused(self, tmp_path, caplog):
+        bad = _payload(0, 2, [])
+        bad["version"] = flightrec.DUMP_VERSION + 1
+        self._write(tmp_path, "flight-rank0.json", bad)
+        with ptd_caplog(caplog):
+            assert flightrec.load_dumps(str(tmp_path)) == {}
+        with pytest.raises(ValueError):
+            flightrec.load_dumps(str(tmp_path), strict=True)
+
+
+class TestRealHangTwoProc:
+    def test_survivor_dumps_and_autopsy_names_victim(self, tmp_path):
+        """One rank silently skips an all_reduce; the survivor must
+        deadline with the last-completed clause, dump, and the merged
+        autopsy must indict the silent rank (which left NO dump)."""
+        results = run_ring_workers(
+            2, flight_workers.hang_worker,
+            extra_args=(str(tmp_path), 1, "comm.hang:mode=skip"),
+            timeout=120,
+        )
+        by_rank = dict(results)
+        assert by_rank[0]["role"] == "survivor", by_rank
+        assert by_rank[1]["role"] == "victim", by_rank
+        err = by_rank[0]["err"]
+        assert "last completed flight seq=" in err
+        dumps = flightrec.load_dumps(str(tmp_path))
+        assert set(dumps) == {0}  # the victim's absence is the evidence
+        v = flightrec.autopsy(dumps)
+        assert v["verdict"] == "missing_rank"
+        assert v["victim_rank"] == 1
+        assert v["seq"] is not None and v["op"] == "all_reduce/sum"
+        # the survivor got through the warm-up rounds before diverging
+        assert len(dumps[0]["records"]) > flight_workers.WARMUP_ROUNDS
+
+
+class TestEnvArming:
+    def test_sigterm_dump_via_env(self, tmp_path):
+        """PTD_FLIGHT_DUMP + SIGTERM: the import-time handler must dump
+        the ring before the default SIGTERM disposition kills the
+        process (exactly what an elastic agent's preemption delivers)."""
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import sys; sys.path.insert(0, %r); "
+             "from tests.flight_workers import env_dump_worker; "
+             "env_dump_worker(%r)" % (REPO, str(tmp_path))],
+            env={**os.environ, "PTD_FLIGHT_DUMP": str(tmp_path),
+                 "PTD_FLIGHT_RANK": "5", "JAX_PLATFORMS": "cpu"},
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == -signal.SIGTERM, (proc.returncode,
+                                                    proc.stderr)
+        path = tmp_path / "flight-rank5.json"
+        assert path.exists(), list(tmp_path.iterdir())
+        with open(path) as f:
+            payload = json.load(f)
+        assert payload["rank"] == 5
+        assert f"signal {int(signal.SIGTERM)}" in payload["reason"]
+        assert payload["records"][-1]["state"] == "completed"
+
+
+class TestCliAndReport:
+    def _dump_set(self, tmp_path):
+        dumps = {
+            0: _payload(0, 2, [_rec(0, "all_reduce"),
+                               _rec(1, "all_reduce", state="started")]),
+        }
+        for r, p in dumps.items():
+            with open(tmp_path / f"flight-rank{r}.json", "w") as f:
+                json.dump(p, f)
+
+    def test_hang_autopsy_cli_json(self, tmp_path, capsys):
+        self._dump_set(tmp_path)
+        sys.path.insert(0, SCRIPTS)
+        try:
+            import hang_autopsy
+        finally:
+            sys.path.remove(SCRIPTS)
+        rc = hang_autopsy.main([str(tmp_path), "--json"])
+        v = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert v["verdict"] == "missing_rank" and v["victim_rank"] == 1
+
+    def test_hang_autopsy_cli_human_report(self, tmp_path, capsys):
+        self._dump_set(tmp_path)
+        sys.path.insert(0, SCRIPTS)
+        try:
+            import hang_autopsy
+        finally:
+            sys.path.remove(SCRIPTS)
+        rc = hang_autopsy.main([str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "== Hang autopsy ==" in out
+        assert "verdict: missing_rank" in out
+        assert "rank" in out and "state" in out  # evidence table header
+
+    def test_hang_autopsy_cli_empty_dir(self, tmp_path):
+        sys.path.insert(0, SCRIPTS)
+        try:
+            import hang_autopsy
+        finally:
+            sys.path.remove(SCRIPTS)
+        assert hang_autopsy.main([str(tmp_path)]) == 1
+
+    def test_obs_report_renders_hang_section(self, tmp_path, capsys):
+        self._dump_set(tmp_path)
+        sys.path.insert(0, SCRIPTS)
+        try:
+            import obs_report
+        finally:
+            sys.path.remove(SCRIPTS)
+        rc = obs_report.main([str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "== Hang autopsy ==" in out
+        assert "missing_rank" in out
+        assert "scripts/hang_autopsy.py" in out
