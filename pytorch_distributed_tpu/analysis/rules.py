@@ -542,8 +542,12 @@ class EagerScatterHotPath(Rule):
     # serve/ + train/ are the hot paths; ops/paged_attention.py joined
     # them in round 12 — its per-page write helper (paged_write) IS the
     # serving decode tick's KV write, traced inside the engine's jitted
-    # programs, and an eager copy of it would be the same ~2.4 ms bug
-    path_filter = r"(^|/)(serve|train)/|(^|/)ops/paged_attention\.py$"
+    # programs, and an eager copy of it would be the same ~2.4 ms bug.
+    # parallel/pipeline_schedule.py joined in round 20: the host 1F1B
+    # loop dispatches per (microbatch, op) TICK — an eager scatter in
+    # the fold/handoff path would pay the ~2.4 ms 2*M*S times per step
+    path_filter = (r"(^|/)(serve|train)/|(^|/)ops/paged_attention\.py$"
+                   r"|(^|/)parallel/pipeline_schedule\.py$")
 
     _SCATTER_METHODS = frozenset({
         "set", "add", "multiply", "mul", "divide", "div", "power",

@@ -1,15 +1,24 @@
 """Candidate enumeration: mesh shapes x strategy classes.
 
 A candidate is (strategy class, data-parallel ways, tensor-parallel
-ways, optional wire compression). The data axes map onto the mesh the
-way parallel/strategies.py expects them: dp/zero1 put the data ways on
-``dp``, fsdp puts them on ``fsdp`` (so the batch still shards — both
-are batch axes — while params/opt shard over the fsdp axis). tp
-composes with any of the three via the model's TensorRules, which the
-rule engine keeps valid on every enumerated shape.
+ways, optional wire compression, pipeline stages). The data axes map
+onto the mesh the way parallel/strategies.py expects them: dp/zero1
+put the data ways on ``dp``, fsdp puts them on ``fsdp`` (so the batch
+still shards — both are batch axes — while params/opt shard over the
+fsdp axis). tp composes with any of the three via the model's
+TensorRules, which the rule engine keeps valid on every enumerated
+shape. pp (r20) stacks pipeline stages on the ``pp`` axis and composes
+with ``dp`` only: zero1/fsdp shard optimizer/params over the data ways
+a stage's gradient exchange already spans, and pricing that
+composition honestly needs the per-stage re-gather model we don't
+have — refusing beats underpricing a ghost. q8 wire compression is a
+``ddp.sync_grads`` path property and never composes with pp either.
 
-Enumeration is deterministic (sorted by strategy name, then tp) so two
-runs of the planner on the same inputs produce byte-identical plans.
+Enumeration is deterministic (sorted by strategy name, then pp, then
+tp) so two runs of the planner on the same inputs produce
+byte-identical plans. pp == 1 IS the plain candidate — the pp
+dimension adds rows only for pp > 1, never a duplicate ``dp/dpN`` row
+with a different name.
 """
 
 from __future__ import annotations
@@ -29,24 +38,28 @@ class CandidateSpec:
     data: int  # data-parallel ways (dp or fsdp axis size)
     tp: int = 1
     compress: Optional[str] = None  # None | "int8" (q8 grad wire)
+    pp: int = 1  # pipeline stages (dp-only composition, r20)
 
     @property
     def name(self) -> str:
         n = f"{self.strategy}/dp{self.data}"
         if self.tp > 1:
             n += f"xtp{self.tp}"
+        if self.pp > 1:
+            n += f"xpp{self.pp}"
         if self.compress:
             n += "+q8"
         return n
 
     @property
     def n_devices(self) -> int:
-        return self.data * self.tp
+        return self.data * self.tp * self.pp
 
     def mesh_sizes(self) -> dict:
         sizes = {a: 1 for a in AXES}
         sizes["fsdp" if self.strategy == "fsdp" else "dp"] = self.data
         sizes["tp"] = self.tp
+        sizes["pp"] = self.pp
         return sizes
 
     def mesh_spec(self) -> MeshSpec:
@@ -78,6 +91,16 @@ class CandidateSpec:
                 f"{self.name} prices q8 wire compression (ddp/hostring "
                 "path); it cannot be built as an SPMD strategy"
             )
+        if self.pp > 1:
+            # the pipeline candidate builds the SPMD stage-sharded
+            # strategy; the recipe also swaps in the pipelined loss
+            # (pipelined_causal_lm_loss_fn) — PricedCandidate carries
+            # the (pp, num_microbatches) the loss needs
+            from pytorch_distributed_tpu.parallel.pipeline_lm import (
+                PipelineParallel,
+            )
+
+            return PipelineParallel(mesh, extra_rules=extra_rules)
         return self.strategy_class()(mesh, extra_rules=extra_rules)
 
 
@@ -88,34 +111,50 @@ def enumerate_candidates(
     tp_candidates: Optional[Sequence[int]] = None,
     max_tp: Optional[int] = None,
     include_q8: bool = False,
+    pp_candidates: Optional[Sequence[int]] = None,
+    max_pp: Optional[int] = None,
 ) -> List[CandidateSpec]:
     """All (strategy, mesh shape) candidates for ``n_devices``.
 
     ``tp_candidates`` restricts tensor-parallel widths (recipes pass
     the divisors of the model's head count via
     ``rules.max_divisible_tp``); default is every divisor of the device
-    count. Degenerate duplicates are collapsed: at data==1 the three
-    strategy classes place identically, so only the ``dp`` form is
-    emitted. ``include_q8`` adds an int8-compressed-gradient variant of
-    each dp candidate (the hostring/ddp wire-compression path).
+    count. ``pp_candidates``/``max_pp`` open the pipeline dimension the
+    same way (dp-only composition, module docstring) — pp == 1 yields
+    the plain candidates exactly once, never a renamed duplicate.
+    Degenerate duplicates are collapsed: at data==1 the three strategy
+    classes place identically, so only the ``dp`` form is emitted.
+    ``include_q8`` adds an int8-compressed-gradient variant of each
+    unpipelined dp candidate (the hostring/ddp wire-compression path).
     """
     unknown = set(strategies) - set(STRATEGY_CLASSES)
     if unknown:
         raise ValueError(f"unknown strategy classes {sorted(unknown)}")
-    tps = [
-        t for t in range(1, n_devices + 1)
-        if n_devices % t == 0
-        and (tp_candidates is None or t in tp_candidates)
-        and (max_tp is None or t <= max_tp)
+    pps = [
+        s for s in range(1, n_devices + 1)
+        if n_devices % s == 0
+        and (pp_candidates is None or s in pp_candidates or s == 1)
+        and (max_pp is None or s <= max_pp or s == 1)
     ]
     out: List[CandidateSpec] = []
     for strategy in sorted(strategies):
-        for tp in tps:
-            data = n_devices // tp
-            if data == 1 and strategy != "dp":
-                continue  # replicated==sharded-over-1: same placement
-            out.append(CandidateSpec(strategy, data, tp))
-            if include_q8 and strategy == "dp" and data > 1:
-                out.append(CandidateSpec(strategy, data, tp,
-                                         compress="int8"))
+        for pp in pps:
+            if pp > 1 and strategy != "dp":
+                continue  # dp-only composition (module docstring)
+            rest = n_devices // pp
+            tps = [
+                t for t in range(1, rest + 1)
+                if rest % t == 0
+                and (tp_candidates is None or t in tp_candidates)
+                and (max_tp is None or t <= max_tp)
+            ]
+            for tp in tps:
+                data = rest // tp
+                if data == 1 and strategy != "dp" and pp == 1:
+                    continue  # replicated==sharded-over-1: same placement
+                out.append(CandidateSpec(strategy, data, tp, pp=pp))
+                if include_q8 and strategy == "dp" and data > 1 \
+                        and pp == 1:
+                    out.append(CandidateSpec(strategy, data, tp,
+                                             compress="int8"))
     return out

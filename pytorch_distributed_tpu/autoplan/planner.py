@@ -45,6 +45,8 @@ from pytorch_distributed_tpu.autoplan.pricing import (
     exposed_comm_seconds,
     grad_comm_terms,
     hetero_compute_seconds,
+    pipeline_comm_terms,
+    pipeline_compute_split,
     price_comm_terms,
     tp_comm_terms,
 )
@@ -99,6 +101,14 @@ class PricedCandidate:
     #: delta is the balancer's priced gain
     compute_seconds_even: Optional[float] = None
     compute_seconds_balanced: Optional[float] = None
+    #: round-20 pipeline pricing: warm-up/drain bubble seconds — the
+    #: analytic (S-1)/(M+S-1) fraction of the pipelined step, on the
+    #: critical path like compute, never overlappable (0 for pp == 1)
+    bubble_seconds: float = 0.0
+    #: round-20: the pp audit record — {"pp", "num_microbatches",
+    #: "bubble_fraction", "bubble_seconds", "link_seconds",
+    #: "stage_depths"}; None for unpipelined candidates
+    pipeline: Optional[dict] = None
 
     @property
     def name(self) -> str:
@@ -107,7 +117,7 @@ class PricedCandidate:
     @property
     def step_seconds(self) -> float:
         return self.comm_seconds + self.compute_seconds \
-            - self.hidden_comm_seconds
+            + self.bubble_seconds - self.hidden_comm_seconds
 
     # recipe-facing conveniences: the chosen candidate IS the thing a
     # recipe needs to build (mesh spec first, then the strategy)
@@ -140,6 +150,8 @@ class PricedCandidate:
             "compute_seconds": self.compute_seconds,
             "step_seconds": self.step_seconds,
             "extrapolated": self.extrapolated,
+            **({"pipeline": dict(self.pipeline)}
+               if self.pipeline is not None else {}),
             **(
                 {
                     "hetero": {
@@ -394,6 +406,9 @@ def plan(
     tp_candidates: Optional[Sequence[int]] = None,
     max_tp: Optional[int] = None,
     include_q8: bool = False,
+    pp_candidates: Optional[Sequence[int]] = None,
+    max_pp: Optional[int] = None,
+    pp_microbatches: Optional[int] = None,
     cost_model: Optional[CostModel] = None,
     cost_model_path: Optional[str] = None,
     transport: Optional[str] = None,
@@ -458,11 +473,24 @@ def plan(
         # candidate). Opening the tp dimension is an explicit opt-in:
         # pass tp_candidates=rules.max_divisible_tp(...) or max_tp.
         max_tp = 1
+    if pp_candidates is None and max_pp is None:
+        # same opt-in discipline for the pipeline dimension (r20): a
+        # pp split is a LAYER split, and pricing one honestly needs the
+        # caller to confirm the recipe can actually run the pipelined
+        # loss (recipes pass --pp through as max_pp). Default stays
+        # the unpipelined search space.
+        max_pp = 1
     specs = enumerate_candidates(
         n_devices, strategies=strategies, tp_candidates=tp_candidates,
         max_tp=max_tp, include_q8=include_q8,
+        pp_candidates=pp_candidates, max_pp=max_pp,
     )
-    worlds = sorted({s.data for s in specs} | {s.tp for s in specs})
+    # pipeline handoffs are P2P: the cost-model world for a send/recv
+    # term is the ordered 2-rank pair, whatever the stage count
+    worlds = sorted(
+        {s.data for s in specs} | {s.tp for s in specs}
+        | ({2} if any(s.pp > 1 for s in specs) else set())
+    )
     model, uncalibrated = resolve_cost_model(
         cost_model, cost_model_path, transport=transport, worlds=worlds,
     )
@@ -480,8 +508,22 @@ def plan(
     priced: List[PricedCandidate] = []
     for spec in specs:
         mesh_like = PlanMesh(spec.mesh_sizes())
-        strategy = spec.strategy_class()(mesh_like,
-                                         extra_rules=tuple(extra_rules))
+        if spec.pp > 1:
+            # memory must be accounted against the strategy the recipe
+            # would actually build: the pp-sharded layer stack
+            # (pipeline_lm's rules duck-type over PlanMesh like the
+            # others — planning still never compiles)
+            from pytorch_distributed_tpu.parallel.pipeline_lm import (
+                PipelineParallel,
+            )
+
+            strategy = PipelineParallel(
+                mesh_like, extra_rules=tuple(extra_rules)
+            )
+        else:
+            strategy = spec.strategy_class()(
+                mesh_like, extra_rules=tuple(extra_rules)
+            )
         data = spec.data
         feasible, reason = True, ""
         if global_batch % data != 0 or global_batch < data:
@@ -489,13 +531,31 @@ def plan(
             reason = (f"global batch {global_batch} does not split over "
                       f"{data} data way(s)")
         per_dev_batch = max(global_batch // data, 1)
+        # r20: the pipelined step's microbatch count plays the accum
+        # role (the executor folds grads across M microbatches); the
+        # recipe default keeps >= 2*S in flight so 1F1B has a steady
+        # state to amortize the (S-1)-tick bubble over
+        num_mb = max(accum_steps, 1)
+        if spec.pp > 1:
+            num_mb = pp_microbatches or max(accum_steps, 2 * spec.pp)
+            if feasible and per_dev_batch % num_mb != 0:
+                feasible = False
+                reason = (
+                    f"per-device batch {per_dev_batch} does not split "
+                    f"into {num_mb} microbatch(es) "
+                    f"(HostPipelineStep splits the batch dim evenly)"
+                )
         # live activations are per MICROBATCH: grad accumulation scans
         # accum_steps slices inside the jitted step, one slice resident
-        micro_batch = max(-(-per_dev_batch // max(accum_steps, 1)), 1)
+        # — a pipeline stage instead holds its 1/pp layer share of up
+        # to min(pp, M) in-flight microbatches (1F1B's peak at stage 0)
+        micro_batch = max(-(-per_dev_batch // num_mb), 1)
+        act_scale = min(spec.pp, num_mb) / spec.pp
         memory = account_state(
             abstract_state, strategy, mesh_like,
             activation_bytes=int(
                 profile.activation_bytes_per_sample * micro_batch
+                * act_scale
             ),
         )
         if feasible and budget_bytes is not None \
@@ -504,8 +564,9 @@ def plan(
             reason = (f"needs {memory.total_bytes / 1e9:.2f} GB/device "
                       f"> budget {budget_bytes / 1e9:.2f} GB")
         # gradient exchange payload: with tp the grads are already
-        # tp-sharded, so each tp group reduces only its shard
-        grad_payload = memory.params_global_bytes // spec.tp
+        # tp-sharded, so each tp group reduces only its shard; with pp
+        # each stage's data ways reduce only the stage's layer share
+        grad_payload = memory.params_global_bytes // (spec.tp * spec.pp)
         grad_elems = grad_payload // 4  # f32 grads (param dtype)
         gterms = price_comm_terms(
             grad_comm_terms(
@@ -518,10 +579,50 @@ def plan(
                           accum_steps=accum_steps),
             model, fallback=fallback,
         )
-        terms = gterms + tterms
+        pterms = price_comm_terms(
+            pipeline_comm_terms(profile, micro_batch, spec.pp, num_mb),
+            model, fallback=fallback,
+        )
+        terms = gterms + tterms + pterms
         comm_s = sum(t.seconds for t in terms)
+        link_s = sum(t.seconds for t in pterms)
         comp_even = comp_bal = None
-        if rank_rates is not None:
+        bubble_s = 0.0
+        pipeline_doc = None
+        if spec.pp > 1:
+            # stage s owns the next data*tp consecutive devices; its
+            # rate is the group MIN (a stage's data ways commit in
+            # lockstep at the grad fold)
+            stage_rates = None
+            if rank_rates is not None:
+                g = spec.data * spec.tp
+                stage_rates = [
+                    min(rank_rates[s * g:(s + 1) * g])
+                    for s in range(spec.pp)
+                ]
+            depths = None
+            comp_s = 0.0
+            try:
+                comp_s, bubble_s, depths = pipeline_compute_split(
+                    profile, global_batch, compute,
+                    data=data, tp=spec.tp, pp=spec.pp,
+                    num_microbatches=num_mb, stage_rates=stage_rates,
+                )
+            except ValueError as e:
+                if feasible:
+                    feasible = False
+                    reason = str(e)
+            pipeline_doc = {
+                "pp": spec.pp,
+                "num_microbatches": num_mb,
+                "bubble_fraction": (
+                    (spec.pp - 1) / (num_mb + spec.pp - 1)
+                ),
+                "bubble_seconds": bubble_s,
+                "link_seconds": link_s,
+                "stage_depths": list(depths) if depths else None,
+            }
+        elif rank_rates is not None:
             comp_bal = hetero_compute_seconds(
                 profile, global_batch, compute, rank_rates,
                 tp=spec.tp, microshards=microshards, balanced=True,
@@ -545,6 +646,8 @@ def plan(
             hidden_comm_seconds=hidden_s,
             compute_seconds_even=comp_even,
             compute_seconds_balanced=comp_bal,
+            bubble_seconds=bubble_s,
+            pipeline=pipeline_doc,
             feasible=feasible, reason=reason,
             extrapolated=any(t.extrapolated for t in terms),
         ))
@@ -561,7 +664,14 @@ def plan(
         if i > 0:
             w = feasible[0]
             delta = (c.step_seconds - w.step_seconds) * 1e3
-            if c.comm_seconds - w.comm_seconds >= \
+            if c.spec.pp > 1:
+                # a losing pipeline candidate must name its OWN price:
+                # the warm-up/drain bubble and the per-link handoffs
+                # are what the bubble-vs-parallelism trade bought
+                link = (c.pipeline or {}).get("link_seconds", 0.0)
+                bound = (f"bubble {c.bubble_seconds * 1e3:.3f} ms + "
+                         f"links {link * 1e3:.3f} ms")
+            elif c.comm_seconds - w.comm_seconds >= \
                     c.compute_seconds - w.compute_seconds:
                 bound = (f"comms {c.comm_seconds * 1e3:.3f} vs "
                          f"{w.comm_seconds * 1e3:.3f} ms")

@@ -221,6 +221,93 @@ def tp_comm_terms(profile: ModelProfile, micro_batch: int,
                      note="tp activation slabs")]
 
 
+def pipeline_comm_terms(profile: ModelProfile, micro_batch: int,
+                        pp: int, num_microbatches: int) -> List[CommTerm]:
+    """The r20 host-pipeline link traffic: every interior stage boundary
+    moves one activation slab forward and one grad slab back per
+    microbatch (``HostPipelineStep``'s tagged send/recv pairs). Priced
+    at world=2 — the ordered P2P pair — and SERIALIZED (the planner's
+    usual upper bound: the host loop issues them between compute ops,
+    and on the steady-state critical path each link's transfers add
+    up). Models without layer/hidden info (conv nets) price no pp
+    links, same convention as :func:`tp_comm_terms`."""
+    if pp <= 1 or profile.hidden <= 0:
+        return []
+    slab = (micro_batch * max(profile.seq_len, 1) * profile.hidden
+            * profile.act_dtype_bytes)
+    return [CommTerm(
+        "send", int(slab), 2,
+        2 * num_microbatches * (pp - 1),
+        note="pp activation/grad handoffs (fwd + bwd per boundary)",
+    )]
+
+
+def pipeline_compute_split(
+    profile: ModelProfile,
+    global_batch: int,
+    compute: ComputeModel,
+    *,
+    data: int,
+    tp: int,
+    pp: int,
+    num_microbatches: int,
+    stage_rates: Optional[Sequence[float]] = None,
+):
+    """(compute_seconds, bubble_seconds, stage_depths) for a pp
+    candidate.
+
+    The slowest stage's total work is the steady-state critical path:
+    ``max over stages of (depth share / stage rate)`` applied to the
+    per-(data x tp)-way flops. The warm-up/drain bubble adds
+    ``(S-1)/M`` of that on top (the analytic ``(S-1)/(M+S-1)`` fraction
+    of the whole step, bench-measurable from merged traces via
+    ``parallel.pipeline_schedule.pipeline_trace_stats``). Homogeneous
+    even splits reproduce the flat term exactly: ``max_stage =
+    flops / (data*tp*pp) / rate``.
+
+    ``stage_rates`` (one relative rate per stage: the MIN over the
+    stage's device group — a stage's data ways commit in lockstep)
+    makes the depth split the hetero apportionment
+    (``pipeline_schedule.stage_depths`` -> ``train/balance.py``): a
+    slow stage gets proportionally fewer layers, and the price reflects
+    the discrete split the executor would actually build. Raises
+    ValueError when ``profile.layers`` cannot fill/split the stages —
+    the planner turns that into the candidate's infeasibility reason.
+    """
+    from pytorch_distributed_tpu.parallel.pipeline_schedule import (
+        stage_depths,
+    )
+
+    if num_microbatches < 1:
+        raise ValueError(
+            f"num_microbatches must be >= 1, got {num_microbatches}"
+        )
+    layers = profile.layers
+    if layers <= 0:
+        raise ValueError(
+            "pipeline candidates need profile.layers > 0 (the stage "
+            "split is a layer split)"
+        )
+    rates = None
+    if stage_rates is not None:
+        rates = [float(r) for r in stage_rates]
+        if len(set(rates)) == 1:
+            rates = None  # homogeneous: use the even split
+    depths = stage_depths(
+        layers, pp,
+        rank_rates=rates,
+    )
+    flops = profile.flops_per_sample * global_batch
+    per_way = compute.flops_per_s_per_device * max(data, 1) * max(tp, 1)
+    stage_seconds = [
+        (flops * d / layers) / (per_way * (rates[s] if rates else 1.0))
+        for s, d in enumerate(depths)
+    ]
+    slowest = max(stage_seconds)
+    bubble = slowest * (pp - 1) / num_microbatches
+    return slowest, bubble, depths
+
+
 def price_comm_terms(terms: Sequence[CommTerm], model: CostModel,
                      fallback: Optional[CostModel] = None) -> List[CommTerm]:
     """Fill in seconds/wire_bytes/extrapolated from the cost model.

@@ -153,6 +153,236 @@ def pipelined_causal_lm_loss_fn(
     return loss_fn
 
 
+# -- host-dispatched stages (r20) -------------------------------------------
+# The 1F1B executor's GPT-2 bridge: slice the scanned param tree into
+# per-rank stage trees, and build the per-stage programs
+# ``parallel/pipeline_schedule.HostPipelineStep`` compiles once each.
+# The embed/ln_f/tied-head math mirrors ``gpt2_pipeline_logits`` above
+# (which mirrors ``GPT2LMHead.__call__``) — keep the three in lockstep.
+
+
+def host_stage_depths(num_layers, num_stages, rank_rates=None):
+    """Layers per stage — even split, or rate-apportioned (a slow rank
+    gets a shallower stage; ``pipeline_schedule.stage_depths``)."""
+    from pytorch_distributed_tpu.parallel.pipeline_schedule import (
+        stage_depths,
+    )
+
+    return stage_depths(num_layers, num_stages, rank_rates)
+
+
+def host_stage_params(params, *, stage, num_stages, depths=None):
+    """Slice a scanned GPT2LMHead tree into stage ``stage``'s param tree
+    plus its non-optimized buffers.
+
+    Stage 0 owns wte/wpe (and the tied wte's optimizer state); the last
+    stage owns ln_f and carries ``buffers["head_wte"]`` — a REPLICA of
+    stage 0's wte for the tied head projection, refreshed after every
+    apply by the executor's ``exchange_params`` hook (S == 1 ties
+    directly, exactly like the plain model). Returns
+    ``(stage_params, buffers)``.
+    """
+    import numpy as np
+
+    blocks = params["blocks"]["block"]
+    num_layers = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+    if depths is None:
+        depths = host_stage_depths(num_layers, num_stages)
+    if sum(depths) != num_layers:
+        raise ValueError(f"depths {depths} do not cover {num_layers} layers")
+    start = sum(depths[:stage])
+    stop = start + depths[stage]
+    sp = {
+        "blocks": jax.tree_util.tree_map(
+            lambda p: p[start:stop], blocks
+        )
+    }
+    first = stage == 0
+    last = stage == num_stages - 1
+    if first:
+        sp["wte"] = params["wte"]
+        sp["wpe"] = params["wpe"]
+    if last:
+        sp["ln_f"] = params["ln_f"]
+    buffers = {}
+    if last and not first:
+        buffers["head_wte"] = jnp.asarray(
+            np.asarray(params["wte"]["embedding"])
+        )
+    return sp, buffers
+
+
+def host_merge_stage_params(stage_trees, depths):
+    """Inverse of :func:`host_stage_params`: reassemble the full scanned
+    tree from every stage's final params (the parity check's gather)."""
+    num_stages = len(stage_trees)
+    if num_stages != len(depths):
+        raise ValueError(f"{num_stages} trees vs {len(depths)} depths")
+    blocks = jax.tree_util.tree_map(
+        lambda *leaves: jnp.concatenate(leaves, axis=0),
+        *[t["blocks"] for t in stage_trees],
+    )
+    return {
+        "wte": stage_trees[0]["wte"],
+        "wpe": stage_trees[0]["wpe"],
+        "blocks": {"block": blocks},
+        "ln_f": stage_trees[-1]["ln_f"],
+    }
+
+
+def host_act_template(cfg, microbatch_size, seq_len, dtype=None):
+    """Recv-buffer prototype for the stage-boundary activations/grads:
+    ``[mb, seq, hidden]`` in the compute dtype."""
+    import numpy as np
+
+    from pytorch_distributed_tpu.runtime.precision import current_policy
+
+    if dtype is None:
+        dtype = np.dtype(jnp.dtype(current_policy().compute_dtype))
+    return np.zeros(
+        (microbatch_size, seq_len, cfg.hidden_size), dtype
+    )
+
+
+class GPT2HostStagePrograms:
+    """Per-stage forward/backward programs for ``HostPipelineStep``.
+
+    One jitted forward and one jitted backward per stage (the backward
+    re-derives the forward via ``jax.vjp`` so only the stage INPUT is
+    stashed per live microbatch); the last stage fuses loss + backward in
+    one ``value_and_grad`` program. Blocks run deterministic (module
+    docstring); the CE loss mirrors ``pipelined_causal_lm_loss_fn``.
+
+    The tied head: the last stage projects with its ``head_wte`` replica
+    and its gradient contribution travels to stage 0 over a tagged P2P
+    pair (``exchange_grads``) where it joins the embedding gradient —
+    the two tied contributions dp sums inside one backward are here
+    regrouped across stages, the documented last-ulp tolerance class —
+    and stage 0's freshly-applied wte travels back (``exchange_params``).
+    """
+
+    def __init__(self, cfg, *, stage, num_stages):
+        import flax.linen as nn
+
+        from pytorch_distributed_tpu.models.gpt2 import GPT2Block
+        from pytorch_distributed_tpu.runtime.precision import current_policy
+
+        self.cfg = cfg
+        policy = current_policy()
+        first = stage == 0
+        last = stage == num_stages - 1
+        blocks_fn = _block_stage_fn(GPT2Block(cfg))
+        ln = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=policy.compute_dtype,
+            param_dtype=policy.param_dtype,
+        )
+
+        def embed(sp, ids):
+            wte = sp["wte"]["embedding"]
+            wpe = sp["wpe"]["embedding"]
+            x = wte[ids] + wpe[jnp.arange(ids.shape[1])][None, :]
+            return x.astype(policy.compute_dtype)
+
+        def body(sp, xin):
+            x = (
+                embed(sp, xin) if first
+                else xin.astype(policy.compute_dtype)
+            )
+            return blocks_fn(sp["blocks"], x)
+
+        def head_loss(sp, x, ids, head_wte):
+            h = ln.apply({"params": sp["ln_f"]}, x)
+            logits = jnp.einsum(
+                "bsd,vd->bsv", h,
+                head_wte.astype(policy.compute_dtype),
+                preferred_element_type=jnp.float32,
+            )
+            shift_logits = logits[:, :-1].astype(jnp.float32)
+            return jnp.mean(
+                optax.softmax_cross_entropy_with_integer_labels(
+                    shift_logits, ids[:, 1:]
+                )
+            )
+
+        if num_stages == 1:
+
+            def loss_grad_solo(sp, ids):
+                def f(p):
+                    return head_loss(
+                        p, body(p, ids), ids, p["wte"]["embedding"]
+                    )
+
+                return jax.value_and_grad(f)(sp)
+
+            self.loss_grad_solo = loss_grad_solo
+        elif last:
+
+            def loss_grad(sp, head_wte, x, ids):
+                def f(p, hw, xi):
+                    return head_loss(p, body(p, xi), ids, hw)
+
+                loss, (gp, ghw, dx) = jax.value_and_grad(
+                    f, argnums=(0, 1, 2)
+                )(sp, head_wte, x)
+                return loss, gp, ghw, dx
+
+            self.loss_grad = loss_grad
+        elif first:
+
+            def fwd(sp, ids):
+                return body(sp, ids)
+
+            def bwd(sp, ids, dy):
+                y, vjp_fn = jax.vjp(lambda p: body(p, ids), sp)
+                (gp,) = vjp_fn(dy.astype(y.dtype))
+                return gp
+
+            self.fwd, self.bwd = fwd, bwd
+        else:
+
+            def fwd(sp, x):
+                return body(sp, x)
+
+            def bwd(sp, x, dy):
+                y, vjp_fn = jax.vjp(body, sp, x)
+                gp, dx = vjp_fn(dy.astype(y.dtype))
+                return gp, dx
+
+            self.fwd, self.bwd = fwd, bwd
+
+    # -- tied-wte pairing (first <-> last stage, tagged P2P) ----------------
+    def exchange_grads(self, group, stage, num_stages, grads, aux_grad):
+        import numpy as np
+
+        if num_stages == 1:
+            return grads
+        last = num_stages - 1
+        if stage == last:
+            group.send(np.asarray(aux_grad), 0, tag="tied.wte.grad")
+        elif stage == 0:
+            emb = np.asarray(grads["wte"]["embedding"])
+            proto = np.empty_like(emb)
+            got = group.recv(proto, last, tag="tied.wte.grad")
+            np.add(emb, got, out=emb)
+        return grads
+
+    def exchange_params(self, group, stage, num_stages, params, buffers):
+        import numpy as np
+
+        if num_stages == 1:
+            return
+        last = num_stages - 1
+        if stage == 0:
+            group.send(
+                np.asarray(params["wte"]["embedding"]), last,
+                tag="tied.wte.param",
+            )
+        elif stage == last:
+            proto = np.empty_like(np.asarray(buffers["head_wte"]))
+            got = group.recv(proto, 0, tag="tied.wte.param")
+            buffers["head_wte"] = jnp.asarray(np.array(got))
+
+
 class _PipelineRules(PartitionRules):
     """TP rules composed with the pp stage sharding, not racing it.
 

@@ -211,9 +211,11 @@ class CostModel:
 #: their "this is a guess" warnings off it
 ANALYTIC_TRANSPORT = "analytic-guess"
 
-#: every op the planner may need to price
+#: every op the planner may need to price — send/recv are the pipeline
+#: candidates' per-link activation/grad handoffs (world = the 2-rank
+#: ordered pair; wire bytes = payload, algo_wire_bytes)
 _ANALYTIC_OPS = ("all_reduce", "all_reduce_q8", "all_gather",
-                 "reduce_scatter", "broadcast")
+                 "reduce_scatter", "broadcast", "send", "recv")
 
 
 def analytic_cost_model(

@@ -169,6 +169,17 @@ Known sites (grep for ``faults.check`` to find the exact spots):
                      drill's and the bench ``flightrec`` phase's
                      injector). Budgets (``after``/``count``/``match``)
                      pick which collective call hangs
+``pipeline.stage_stall`` polled before every compute op of the host
+                     1F1B pipeline executor
+                     (``parallel/pipeline_schedule.py``; ``path`` is
+                     ``s<stage>.<op>.m<microbatch>``) — ``mode=stall``
+                     delays THIS stage's slot (the straggler stage the
+                     neighbor handoffs then expose); ``mode=kill`` dies
+                     mid-schedule (the ``--drill pipeline`` case: the
+                     surviving stages block at the ring deadline, dump
+                     their flight logs, and ``hang_autopsy`` must
+                     convict the dead stage); ``match`` selects the
+                     exact op (e.g. ``match=s1.bwd.m2``)
 ================== ====================================================
 """
 
@@ -222,6 +233,7 @@ KNOWN_SITES = (
     "serve.engine_loss",
     "serve.kv_migrate",
     "comm.hang",
+    "pipeline.stage_stall",
 )
 _MODES = ("raise", "kill", "truncate", "bitflip", "throttle", "stall", "skip")
 

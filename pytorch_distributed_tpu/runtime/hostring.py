@@ -694,16 +694,21 @@ class HostRingGroup:
             )
         return self.broadcast(a, src=src)[self.rank]
 
-    def _verify_p2p(self, a: np.ndarray, src: int, dst: int) -> None:
+    def _verify_p2p(
+        self, a: np.ndarray, src: int, dst: int, tag: str = ""
+    ) -> None:
         """Debug mode for the P2P pair: both endpoints describe the
-        transfer (``shape|dtype|src->dst``) and exchange the 96-byte
+        transfer (``shape|dtype|src->dst|tag``) and exchange the 96-byte
         fingerprints over the same mailbox pair BEFORE the payload — a
-        shape/dtype/peer mismatch raises on BOTH ranks naming both
+        shape/dtype/peer/tag mismatch raises on BOTH ranks naming both
         descriptions, instead of a silently short/corrupt copy or a
-        mailbox hang. Debug mode must be uniform across ranks (true for
-        the env-var arming): a lone debug endpoint would ship its
-        fingerprint into a peer expecting payload."""
-        sig = f"p2p|{a.shape}|{a.dtype}|{src}->{dst}".encode()
+        mailbox hang. The caller-supplied ``tag`` (the r20 pipeline
+        stamps ``(microbatch, stage, direction)``) extends the handshake
+        to PROTOCOL mismatches: same shape, wrong message — the schedule
+        desync a shape check can't see. Debug mode must be uniform across
+        ranks (true for the env-var arming): a lone debug endpoint would
+        ship its fingerprint into a peer expecting payload."""
+        sig = f"p2p|{a.shape}|{a.dtype}|{src}->{dst}|{tag}".encode()
         mine = np.zeros(self._FP_BYTES, np.uint8)
         mine[: len(sig[: self._FP_BYTES])] = np.frombuffer(
             sig[: self._FP_BYTES], np.uint8
@@ -723,15 +728,17 @@ class HostRingGroup:
                 f"{self.rank} expects {me}; peer sees {peer}"
             )
 
-    def send(self, x, dst: int) -> None:
+    def send(self, x, dst: int, *, tag: str = "") -> None:
         """True point-to-point send: only this rank and ``dst`` participate
         (per-pair shm mailbox — no group barrier, bystander ranks are free
-        to run other collectives or nothing at all)."""
+        to run other collectives or nothing at all). ``tag`` names the
+        message (default "" keeps old callers byte-compatible); under
+        DETAIL debug both ends must present the same tag."""
         a = _as_contig(x, dtype_required=False).copy()
         if self._hang("send"):
             return  # skipped: the peer's recv is left hanging
         if self.debug:
-            self._verify_p2p(a, self.rank, dst)
+            self._verify_p2p(a, self.rank, dst, tag)
         fseq = self._flight("send", f"->{dst}", a.size, a.dtype, a.nbytes)
         tr = tracing._tracer
         span = tracing._NULL_SPAN if tr is None else _comm_span(
@@ -743,14 +750,14 @@ class HostRingGroup:
             self._transport.sendrecv(a, self.rank, dst)
         flightrec.RECORDER.complete(fseq)
 
-    def recv(self, x, src: int) -> np.ndarray:
+    def recv(self, x, src: int, *, tag: str = "") -> np.ndarray:
         """x supplies shape/dtype; returns the received array. True P2P —
-        see :meth:`send`."""
+        see :meth:`send` (and its ``tag``)."""
         a = _as_contig(x, dtype_required=False).copy()
         if self._hang("recv"):
             return a  # skipped: stale local bytes, the sender left hanging
         if self.debug:
-            self._verify_p2p(a, src, self.rank)
+            self._verify_p2p(a, src, self.rank, tag)
         fseq = self._flight("recv", f"<-{src}", a.size, a.dtype, a.nbytes)
         tr = tracing._tracer
         span = tracing._NULL_SPAN if tr is None else _comm_span(

@@ -138,20 +138,18 @@ def main(argv=None):
         # plan BEFORE the group exists: the planner reads only device
         # count + abstract shapes (eval_shape — zero compiles), and the
         # chosen candidate's mesh spec is what init_process_group gets
-        if args.pp > 1:
-            raise SystemExit(
-                "--strategy auto does not enumerate pipeline "
-                "candidates; drop --pp or pick a strategy explicitly"
-            )
         if "RANK" in os.environ:
             raise SystemExit(
                 "--strategy auto plans the single-controller SPMD "
-                "mesh; it is not supported under a per-rank launch"
+                "mesh; it is not supported under a per-rank launch — "
+                "unset RANK or pick --strategy dp/zero1 explicitly"
             )
         if args.dp != -1 or args.tp != 1:
             raise SystemExit(
                 "--strategy auto chooses the mesh shape itself; drop "
-                "--dp/--tp or pick a strategy explicitly"
+                "--dp/--tp (--pp N is allowed: it OPENS the pipeline "
+                "dimension so the planner ranks dp x tp x pp meshes up "
+                "to N stages) or pick a strategy explicitly"
             )
         from pytorch_distributed_tpu import autoplan
 
@@ -184,6 +182,11 @@ def main(argv=None):
             # hostring-calibrated model must not silently price them
             transport=f"spmd:{ptd.platform()}",
             accum_steps=args.accum_steps,
+            # --pp N under auto is the pipeline opt-in (r20): the
+            # planner prices dp x tp x pp meshes up to N stages, each
+            # with its bubble + per-link handoff terms, and every
+            # losing pipeline row names them in the table
+            max_pp=args.pp if args.pp > 1 else None,
         )
         chosen = plan_report.best()
         plan_report.save(args.plan_path)
@@ -269,7 +272,16 @@ def main(argv=None):
         params=variables["params"],
         tx=tx,
     )
-    if args.pp > 1:
+    # under --strategy auto the PLAN decides whether the run pipelines:
+    # --pp N only opened the search space, chosen.spec.pp is the answer
+    # (and carries the microbatch count the bubble was priced at)
+    effective_pp = args.pp
+    pipeline_microbatches = max(args.accum_steps, 2 * max(args.pp, 1))
+    if chosen is not None:
+        effective_pp = chosen.spec.pp
+        if chosen.pipeline is not None:
+            pipeline_microbatches = chosen.pipeline["num_microbatches"]
+    if effective_pp > 1:
         from pytorch_distributed_tpu.parallel.pipeline_lm import (
             PipelineParallel,
             pipelined_causal_lm_loss_fn,
@@ -277,10 +289,13 @@ def main(argv=None):
 
         strategy = PipelineParallel(extra_rules=gpt2_partition_rules())
         loss_fn = pipelined_causal_lm_loss_fn(
-            cfg, num_microbatches=max(args.accum_steps, 2 * args.pp)
+            cfg, num_microbatches=pipeline_microbatches
         )
         # microbatching lives inside the pipeline schedule here
         accum_steps = 1
+        if chosen is not None:
+            log_rank0("auto strategy: %s -> %s", chosen.name,
+                      strategy.describe())
     else:
         if chosen is not None:  # --strategy auto: the planner's pick
             strategy = chosen.build_strategy(

@@ -39,7 +39,7 @@ def parse_args(argv=None):
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
     p.add_argument("--drill", choices=("kill_resume", "resize",
-                                       "ckpt_shard", "hang"),
+                                       "ckpt_shard", "hang", "pipeline"),
                    default="kill_resume",
                    help="kill_resume: SIGKILL the whole training process "
                    "and restart it from disk (the original drill). "
@@ -59,7 +59,14 @@ def parse_args(argv=None):
                    "stops showing up), every survivor must hit its "
                    "collective deadline, dump its flight ring, and the "
                    "merged autopsy must name the victim and the "
-                   "diverging seq/op (runtime/flightrec.py)")
+                   "diverging seq/op (runtime/flightrec.py). "
+                   "pipeline: one STAGE of a live 2-stage host 1F1B "
+                   "pipeline dies mid-schedule (pipeline.stage_stall "
+                   "mode=kill at a specific (stage, op, microbatch)), "
+                   "the surviving stage must hit its handoff deadline, "
+                   "dump its flight ring, and the autopsy must convict "
+                   "the dead stage from the survivor's dump alone "
+                   "(parallel/pipeline_schedule.py)")
     p.add_argument("--world", type=int, default=3,
                    help="[resize] genesis world size")
     p.add_argument("--total-steps", type=int, default=36,
@@ -477,6 +484,71 @@ def hang_main(args):
     return 0 if passed else 1
 
 
+def pipeline_main(args):
+    """The dead-stage drill: a 2-stage host 1F1B pipeline trains over
+    the real ring; stage 1 arms ``pipeline.stage_stall:mode=kill`` at a
+    specific ``s1.bwd.m1`` op and dies there (os._exit — SIGKILL-grade,
+    no dump). Stage 0 must hit its 2s handoff deadline, raise with its
+    last completed flight named, and dump its ring; the autopsy must
+    convict the dead stage from the survivor's dump alone. The victim
+    leaves NO dump by design — the absent stage IS the evidence.
+    """
+    from pytorch_distributed_tpu.runtime import flightrec
+    from tests.pipeline_workers import (
+        pipeline_drill_worker,
+        run_pipeline_world,
+    )
+
+    base = args.ckpt_dir or tempfile.mkdtemp(prefix="pipeline_drill_")
+    owns_dir = args.ckpt_dir is None
+    t0 = time.monotonic()
+    world, victim = 2, 1
+    spec = "pipeline.stage_stall:mode=kill,match=s1.bwd.m1"
+    # the victim never reports (os._exit mid-schedule): expect only the
+    # survivor's queue entry
+    reports = dict(run_pipeline_world(
+        world, pipeline_drill_worker,
+        extra_args=(base, victim, spec), timeout=120.0, expect=1,
+    ))
+    survivor = reports.get(0, {})
+    worker_errs = {
+        r: p["error"] for r, p in reports.items() if "error" in p
+    }
+    survived = (
+        survivor.get("role") == "survivor"
+        and survivor.get("dumped") is True
+        and "last completed flight" in survivor.get("err", "")
+    )
+    dumps = flightrec.load_dumps(base) if os.path.isdir(base) else {}
+    verdict = flightrec.autopsy(dumps)
+    named = (
+        verdict["verdict"] == "missing_rank"
+        and verdict["victim_rank"] == victim
+    )
+    passed = (
+        not worker_errs and survived and named
+        and victim not in dumps
+    )
+    print(json.dumps({
+        "drill": "pipeline",
+        "world": world,
+        "victim_stage": victim,
+        "fault": spec,
+        "survivor_err": survivor.get("err"),
+        "survivor_dumped": survivor.get("dumped"),
+        "victim_dumped": victim in dumps,
+        "worker_errors": worker_errs,
+        "verdict": verdict,
+        "wall_s": round(time.monotonic() - t0, 2),
+        "passed": passed,
+    }))
+    if passed and owns_dir:
+        shutil.rmtree(base, ignore_errors=True)
+    elif not passed:
+        print(f"# drill dir kept for autopsy: {base}", file=sys.stderr)
+    return 0 if passed else 1
+
+
 def main(argv=None):
     args = parse_args(argv)
     if args.drill == "resize":
@@ -485,6 +557,8 @@ def main(argv=None):
         return ckpt_shard_main(args)
     if args.drill == "hang":
         return hang_main(args)
+    if args.drill == "pipeline":
+        return pipeline_main(args)
     import numpy as np
 
     rng = np.random.default_rng(args.seed)

@@ -505,6 +505,45 @@ def stragglers_section(events, records, out):
     return summary
 
 
+def pipeline_section(events, out):
+    """Per-stage pipeline accounting (r20) from merged-trace
+    ``pipeline.fwd``/``pipeline.bwd`` spans: busy vs window time, the
+    idle (bubble) fraction, and the exposed-link share per stage.
+
+    Whole-run numbers: step 0's compiles and the inter-step optimizer
+    boundaries count as idle here, so these fractions read HIGH
+    relative to the analytic ``(S-1)/(V*M+S-1)`` — the bench's
+    steady-state-windowed measurement is the number the planner's
+    pricing is checked against; this section is the triage view."""
+    from pytorch_distributed_tpu.parallel.pipeline_schedule import (
+        pipeline_trace_stats,
+    )
+
+    stats = pipeline_trace_stats(events)
+    if not stats:
+        return None
+    print("\n== Pipeline ==", file=out)
+    print(
+        f"  {len(stats)} stage(s) with schedule spans (whole-run "
+        f"window: compiles + step boundaries count as idle):", file=out,
+    )
+    for rank, s in stats.items():
+        print(
+            f"    stage{rank}: busy={s['busy_s']:.2f}s "
+            f"window={s['window_s']:.2f}s bubble={s['bubble']:.3f} "
+            f"link={s['link_s']:.2f}s "
+            f"({s['link_s'] / s['window_s']:.3f} of window)", file=out,
+        )
+    worst = max(stats.values(), key=lambda s: s["bubble"])
+    return {
+        "stages": len(stats),
+        "max_bubble": round(worst["bubble"], 4),
+        "max_link_ratio": round(
+            max(s["link_s"] / s["window_s"] for s in stats.values()), 4
+        ),
+    }
+
+
 def fleet_section(records, out):
     """The serving-fleet picture (r18): per-engine telemetry + the
     router's migration/replay audit.
@@ -807,6 +846,9 @@ def report(trace_path, metric_paths, top_n=10, out=None,
     # -- stragglers (r15: heterogeneity picture) ---------------------------
     stragglers = stragglers_section(events, records, out)
 
+    # -- pipeline stages (r20: per-stage busy/bubble/link picture) ---------
+    pipe = pipeline_section(events, out)
+
     # -- checkpoint audit (r17: sharded save/restore trail) ----------------
     ckpt = checkpoint_section(events, records, out)
 
@@ -919,8 +961,9 @@ def report(trace_path, metric_paths, top_n=10, out=None,
 
     return {"spans": rows, "recompiles": recompiles, "goodput": g,
             "comms": comms or {}, "stragglers": stragglers or {},
-            "checkpoint": ckpt or {}, "fleet": fleet or {},
-            "plan": plan_doc, "serve": serve, "hang": hang}
+            "pipeline": pipe or {}, "checkpoint": ckpt or {},
+            "fleet": fleet or {}, "plan": plan_doc, "serve": serve,
+            "hang": hang}
 
 
 def main(argv=None):

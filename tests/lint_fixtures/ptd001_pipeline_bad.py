@@ -1,0 +1,21 @@
+"""PTD001 known-bad: pipeline stage handoffs with a dropped direction.
+
+The r20 host pipeline makes stage == ring rank, so every boundary
+handoff sits under a stage guard — the exact shape PTD001 exists for.
+A send whose matching recv got edited away deadlocks the neighbor at
+its handoff deadline.
+"""
+
+
+def forward_handoff(group, num_stages, act):
+    stage = group.rank
+    if stage < num_stages - 1:
+        group.send(act, stage + 1, tag="act.m0.s1")  # expect: PTD001
+
+
+def grad_handoff(group, grad):
+    stage = group.rank
+    if stage == 0:
+        group.recv(grad, 1, tag="grad.m0.s0")  # expect: PTD001
+    else:
+        group.all_reduce(grad)  # expect: PTD001

@@ -1,0 +1,22 @@
+"""PTD001 known-good twins: pipeline boundary handoffs pairwise-complete.
+
+The send<->recv pair across the stage-guard branches (each endpoint
+takes one side), and the interior stage's own send+recv set (P2P blocks
+only its two endpoints — the hostring contract — so a guarded group
+doing a full exchange owes the other branch nothing).
+"""
+
+
+def boundary_handoff(group, act):
+    stage = group.rank
+    if stage == 0:
+        group.send(act, 1, tag="act.m0.s1")
+    else:
+        group.recv(act, 0, tag="act.m0.s1")
+
+
+def steady_state_tick(group, num_stages, act, grad):
+    stage = group.rank
+    if 0 < stage < num_stages - 1:
+        group.recv(act, stage - 1, tag="act.m1.s1")
+        group.send(grad, stage - 1, tag="grad.m0.s0")

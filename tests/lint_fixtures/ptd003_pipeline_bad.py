@@ -1,0 +1,11 @@
+"""PTD003 known-bad: typo'd pipeline stall-site names never fire."""
+from pytorch_distributed_tpu.runtime import faults
+
+
+def drill_spec():
+    with faults.injected("pipeline.stall:mode=kill,match=s1.bwd.m1"):  # expect: PTD003
+        pass
+
+
+def stall_env(env):
+    env["PTD_FAULTS"] = "pipeline.stage_stal:mode=stall,seconds=0.5"  # expect: PTD003

@@ -1,0 +1,11 @@
+"""PTD003 known-good twins: the pipeline stall site as registered."""
+from pytorch_distributed_tpu.runtime import faults
+
+
+def drill_spec():
+    with faults.injected("pipeline.stage_stall:mode=kill,match=s1.bwd.m1"):
+        pass
+
+
+def stall_env(env):
+    env["PTD_FAULTS"] = "pipeline.stage_stall:mode=stall,seconds=0.5,count=1"

@@ -1,0 +1,364 @@
+"""Spawn targets for the host-dispatched pipeline tests (r20).
+
+Own importable module (``multiprocessing`` spawn pickles targets by
+reference). Each worker is one pipeline STAGE: rank == stage, neighbor
+handoffs over the shm hostring. Every rank derives the same initial
+params / batches from the shared seed, so the final stage trees can be
+merged and compared against the in-process dp reference without any
+extra broadcast.
+
+``run_pipeline_world`` is the harness the tests, the chaos drill
+(``scripts/chaos_drill.py --drill pipeline``) and the bench ``pipeline``
+phase all reuse — one implementation of "spawn S stage workers and
+collect their reports".
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_pipeline_world(world, target, extra_args=(), timeout=300.0,
+                       expect=None):
+    """Spawn one ``(rank, world, name, q, *extra_args)`` worker per stage
+    on the CPU backend; returns the rank-sorted queue reports. ``expect``
+    caps how many reports to wait for (default ``world``) — the drill's
+    SIGKILLed victim never reports."""
+    import multiprocessing as mp
+    import uuid
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    name = f"ptdpipe_{uuid.uuid4().hex[:8]}"
+    procs = [
+        ctx.Process(target=target,
+                    args=(r, world, name, q) + tuple(extra_args))
+        for r in range(world)
+    ]
+    old = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        for p in procs:
+            p.start()
+    finally:
+        if old is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = old
+    try:
+        results = [
+            q.get(timeout=timeout)
+            for _ in range(world if expect is None else expect)
+        ]
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+    return sorted(results)
+
+
+def _tiny_cfg(opts=None):
+    from pytorch_distributed_tpu.models.gpt2 import GPT2Config
+
+    opts = opts or {}
+    return GPT2Config(
+        vocab_size=opts.get("vocab", 128),
+        n_positions=opts.get("n_positions", 32),
+        hidden_size=opts.get("hidden", 32),
+        num_layers=opts.get("layers", 4),
+        num_heads=2,
+        dropout_rate=0.0,
+    )
+
+
+def make_batches(steps, batch, seq, vocab, seed):
+    """The shared synthetic stream: every stage derives the same batches
+    from the seed (stage 0 embeds them, the last stage reads labels)."""
+    rng = np.random.default_rng(seed)
+    return [
+        {"input_ids": rng.integers(0, vocab, (batch, seq)).astype(np.int32)}
+        for _ in range(steps)
+    ]
+
+
+def _crc_tree(tree):
+    import zlib
+
+    import jax
+
+    crc = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        crc = zlib.crc32(np.ascontiguousarray(leaf).tobytes(), crc)
+    return crc
+
+
+def pipeline_train_worker(rank, world, name, q, opts) -> None:
+    """One stage of an S-deep host 1F1B (or gpipe) pipeline on the real
+    ring. ``opts`` keys (all optional beyond defaults): steps, batch,
+    seq, microbatches, seed, schedule, delay_s, trace_dir, faults,
+    lr, depths, timeout_s.
+
+    Reports final stage params (+ CRC), per-step losses from the last
+    stage, steady-state wall seconds, and compile counts — everything
+    the parity tests, the bench phase, and the drill assert on.
+    """
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from pytorch_distributed_tpu.models.gpt2 import GPT2LMHead
+        from pytorch_distributed_tpu.parallel.pipeline_lm import (
+            GPT2HostStagePrograms,
+            host_act_template,
+            host_stage_params,
+        )
+        from pytorch_distributed_tpu.parallel.pipeline_schedule import (
+            HostPipelineStep,
+        )
+        from pytorch_distributed_tpu.runtime import faults, tracing
+        from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
+
+        steps = opts.get("steps", 3)
+        batch = opts.get("batch", 8)
+        seq = opts.get("seq", 16)
+        M = opts.get("microbatches", 4)
+        seed = opts.get("seed", 0)
+        cfg = _tiny_cfg(opts)
+        trace_dir = opts.get("trace_dir")
+        if trace_dir:
+            tracing.configure(trace_dir)
+        if opts.get("faults"):
+            faults.configure(opts["faults"])
+        model = GPT2LMHead(cfg)
+        variables = model.init(
+            jax.random.key(seed), jnp.zeros((1, seq), jnp.int32)
+        )
+        tx = optax.sgd(opts.get("lr", 0.1))
+        depths = opts.get("depths")
+        sp, buffers = host_stage_params(
+            variables["params"], stage=rank, num_stages=world,
+            depths=depths,
+        )
+        group = None
+        if world > 1:
+            group = HostRingGroup(
+                name, rank, world,
+                timeout_s=opts.get("timeout_s", 60.0),
+            )
+        host = HostPipelineStep(
+            GPT2HostStagePrograms(cfg, stage=rank, num_stages=world),
+            stage=rank, num_stages=world, num_microbatches=M, tx=tx,
+            group=group, schedule=opts.get("schedule", "1f1b"),
+            act_template=host_act_template(cfg, batch // M, seq),
+            delay_s=opts.get("delay_s", 0.0),
+        )
+        params, opt_state = sp, tx.init(sp)
+        batches = make_batches(steps, batch, seq, cfg.vocab_size, seed + 1)
+        losses = []
+        # step 0 pays the compiles; time the warm steady state only
+        t0 = None
+        for i, b in enumerate(batches):
+            if i == 1:
+                t0 = time.perf_counter()
+            params, opt_state, met = host.step(
+                params, opt_state, b, buffers
+            )
+            if "loss" in met:
+                losses.append(met["loss"])
+        wall = time.perf_counter() - t0 if t0 is not None else 0.0
+        if trace_dir:
+            fname = (
+                "trace.json" if rank == 0 else f"trace-rank{rank}.json"
+            )
+            tracing.get().export(os.path.join(trace_dir, fname))
+        np_params = jax.tree_util.tree_map(np.asarray, params)
+        q.put((rank, {
+            "stage_params": np_params,
+            "crc": _crc_tree(np_params),
+            "losses": losses,
+            "steady_wall_s": wall,
+            "compile_counts": host.compile_counts(),
+        }))
+        if group is not None:
+            group.close()
+    except Exception as e:  # pragma: no cover - failure reporting
+        q.put((rank, {"error": f"{type(e).__name__}: {e}"}))
+
+
+def spmd_gpipe_main() -> None:
+    """SPMD GPipe baseline for the bench ``pipeline`` phase.
+
+    Runs the EXISTING single-process GPipe (parallel/pipeline.py via
+    ``pipelined_causal_lm_loss_fn``) over two forced host devices and
+    prints a JSON report. The parent must set
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=2`` (and
+    ``JAX_PLATFORMS=cpu``) in the subprocess env BEFORE this runs — XLA
+    reads the flag at first jax import. Opts come as a JSON blob in
+    ``sys.argv[1]`` (same keys as ``pipeline_train_worker``).
+
+    This is the honest bench baseline: the SPMD schedule pays
+    ``(M+S-1)/M`` garbage-tick compute per step (every stage runs every
+    tick, pre-fill and drain ticks included), which is exactly the FLOP
+    overhead the host-dispatched 1F1B avoids on a core-bound box.
+    """
+    import json
+
+    opts = json.loads(sys.argv[1]) if len(sys.argv) > 1 else {}
+    import jax
+    import optax
+
+    import pytorch_distributed_tpu as ptd
+    from pytorch_distributed_tpu.models.gpt2 import GPT2LMHead
+    from pytorch_distributed_tpu.parallel.pipeline_lm import (
+        PipelineParallel,
+        pipelined_causal_lm_loss_fn,
+    )
+    from pytorch_distributed_tpu.runtime.mesh import MeshSpec
+    from pytorch_distributed_tpu.train import TrainState, build_train_step
+
+    steps = opts.get("steps", 3)
+    batch = opts.get("batch", 8)
+    seq = opts.get("seq", 16)
+    M = opts.get("microbatches", 4)
+    seed = opts.get("seed", 0)
+    world = opts.get("world", 2)
+    assert len(jax.devices()) >= world, (
+        f"need XLA_FLAGS forcing >= {world} host devices, "
+        f"got {len(jax.devices())}"
+    )
+    cfg = _tiny_cfg(opts)
+    model = GPT2LMHead(cfg)
+    ptd.init_process_group(mesh_spec=MeshSpec(dp=-1, pp=world))
+    params = model.init(
+        jax.random.key(seed), np.zeros((1, seq), np.int32)
+    )["params"]
+    state = TrainState.create(
+        apply_fn=model.apply, params=params,
+        tx=optax.sgd(opts.get("lr", 0.1)),
+    )
+    strategy = PipelineParallel()
+    state = strategy.place(state)
+    step = strategy.compile(
+        build_train_step(pipelined_causal_lm_loss_fn(cfg, num_microbatches=M)),
+        state,
+    )
+    losses = []
+    t0 = None
+    for i, b in enumerate(make_batches(steps, batch, seq, cfg.vocab_size,
+                                       seed + 1)):
+        if i == 1:
+            t0 = time.perf_counter()
+        state, metrics = step(state, strategy.shard_batch(b))
+        losses.append(float(metrics["loss"]))
+    wall = time.perf_counter() - t0 if t0 is not None else 0.0
+    print(json.dumps({
+        "schedule": "spmd_gpipe",
+        "steady_wall_s": wall,
+        "losses": losses,
+    }))
+
+
+def pipeline_mismatch_worker(rank, world, name, q) -> None:
+    """DETAIL-debug handoff desync: both ends present DIFFERENT
+    (microbatch, stage, direction) tags for the same-shape transfer —
+    the fingerprint handshake must raise on BOTH ranks naming both
+    descriptions (instead of silently delivering the wrong message)."""
+    try:
+        from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
+
+        with HostRingGroup(
+            name, rank, world, timeout_s=30.0, debug=True
+        ) as g:
+            a = np.full((4, 8), float(rank), np.float32)
+            # a matched tagged pair works under DETAIL
+            if rank == 0:
+                g.send(a, 1, tag="act.m0.s1")
+            else:
+                got = g.recv(a, 0, tag="act.m0.s1")
+                assert np.all(got == 0.0), got
+            # then the schedule desyncs: sender ships act.m1, receiver
+            # expects act.m2
+            err = None
+            try:
+                if rank == 0:
+                    g.send(a, 1, tag="act.m1.s1")
+                else:
+                    g.recv(a, 0, tag="act.m2.s1")
+            except RuntimeError as e:
+                err = str(e)
+            q.put((rank, {"mismatch_error": err}))
+    except Exception as e:  # pragma: no cover - failure reporting
+        q.put((rank, {"error": f"{type(e).__name__}: {e}"}))
+
+
+def pipeline_drill_worker(rank, world, name, q, out_dir, victim,
+                          spec) -> None:
+    """The ``--drill pipeline`` stage: run the real 1F1B executor with
+    the flight recorder armed; the victim stage arms ``spec``
+    (``pipeline.stage_stall:mode=kill,...``) and dies mid-schedule, the
+    survivors block at the ring deadline, dump their flight rings, and
+    report — ``scripts/hang_autopsy.py`` must then convict the victim
+    stage from the survivors' dumps alone."""
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from pytorch_distributed_tpu.models.gpt2 import GPT2LMHead
+        from pytorch_distributed_tpu.parallel.pipeline_lm import (
+            GPT2HostStagePrograms,
+            host_act_template,
+            host_stage_params,
+        )
+        from pytorch_distributed_tpu.parallel.pipeline_schedule import (
+            HostPipelineStep,
+        )
+        from pytorch_distributed_tpu.runtime import faults, flightrec
+        from pytorch_distributed_tpu.runtime.hostring import HostRingGroup
+
+        flightrec.configure(out_dir=out_dir, rank=rank, world=world)
+        if rank == victim:
+            faults.configure(spec)
+        steps, batch, seq, M = 4, 8, 16, 4
+        cfg = _tiny_cfg()
+        model = GPT2LMHead(cfg)
+        variables = model.init(
+            jax.random.key(0), jnp.zeros((1, seq), jnp.int32)
+        )
+        tx = optax.sgd(0.1)
+        sp, buffers = host_stage_params(
+            variables["params"], stage=rank, num_stages=world
+        )
+        group = HostRingGroup(name, rank, world, timeout_s=2.0)
+        host = HostPipelineStep(
+            GPT2HostStagePrograms(cfg, stage=rank, num_stages=world),
+            stage=rank, num_stages=world, num_microbatches=M, tx=tx,
+            group=group,
+            act_template=host_act_template(cfg, batch // M, seq),
+        )
+        params, opt_state = sp, tx.init(sp)
+        try:
+            for b in make_batches(steps, batch, seq, cfg.vocab_size, 1):
+                params, opt_state, _ = host.step(
+                    params, opt_state, b, buffers
+                )
+            q.put((rank, {"role": "no_hang"}))
+        except RuntimeError as e:
+            dump = os.path.join(
+                out_dir, f"{flightrec.DUMP_PREFIX}{rank}.json"
+            )
+            q.put((rank, {
+                "role": "survivor",
+                "err": str(e)[:300],
+                "dumped": os.path.exists(dump),
+            }))
+    except Exception as e:  # pragma: no cover - failure reporting
+        q.put((rank, {"error": f"{type(e).__name__}: {e}"}))

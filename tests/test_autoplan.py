@@ -11,6 +11,7 @@ subprocess on the 8-device CPU mesh.
 """
 
 import contextlib
+import dataclasses
 import json
 import logging
 import os
@@ -78,19 +79,29 @@ def abstract_state():
     return jax.eval_shape(make, jax.random.key(0))
 
 
-def hand_model(ar_beta, rsag_beta, *, alpha=0.0, worlds=(2, 4, 8)):
-    """Hand-built α–β model: prices are exactly computable on paper."""
+def hand_model(ar_beta, rsag_beta, *, alpha=0.0, worlds=(2, 4, 8),
+               p2p_beta=None):
+    """Hand-built α–β model: prices are exactly computable on paper.
+    ``p2p_beta`` adds world-2 send/recv fits (the pp handoff links)."""
     fits = {}
-    for op, beta in (
+    ops = [
         ("all_reduce", ar_beta),
         ("all_reduce_q8", ar_beta),
         ("reduce_scatter", rsag_beta),
         ("all_gather", rsag_beta),
-    ):
+    ]
+    for op, beta in ops:
         for w in worlds:
             fits[(op, w)] = costmodel.OpFit(
                 op=op, world_size=w, alpha_s=alpha,
                 beta_s_per_byte=beta, r2=1.0, n_samples=4,
+                wire_bytes_min=0, wire_bytes_max=1 << 62,
+            )
+    if p2p_beta is not None:
+        for op in ("send", "recv"):
+            fits[(op, 2)] = costmodel.OpFit(
+                op=op, world_size=2, alpha_s=alpha,
+                beta_s_per_byte=p2p_beta, r2=1.0, n_samples=4,
                 wire_bytes_min=0, wire_bytes_max=1 << 62,
             )
     return costmodel.CostModel("test", fits)
@@ -895,3 +906,197 @@ class TestRound15HeteroPricing:
                 n_devices=3, budget_bytes=None,
                 rank_rates=[1.0, 1.0, -0.5],
             )
+
+
+# -- round 20: pipeline-parallel candidates ---------------------------------
+class TestPipelinePlanning:
+    """The pp dimension of the plan: opt-in enumeration, hand-computed
+    bubble + link pricing, hetero stage depths via the balancer, and
+    the audit record on plan.json."""
+
+    PROFILE = autoplan.ModelProfile(
+        flops_per_sample=1e9, activation_bytes_per_sample=1024.0,
+        layers=4, hidden=64, seq_len=16, act_dtype_bytes=4,
+    )
+
+    def pp_plan(self, abstract_state, model, **kw):
+        kw.setdefault("strategies", ("dp",))
+        kw.setdefault("max_tp", 1)
+        kw.setdefault("n_devices", 2)
+        kw.setdefault("budget_bytes", None)
+        kw.setdefault("max_pp", 2)
+        kw.setdefault("profile", self.PROFILE)
+        return autoplan.plan(
+            global_batch=kw.pop("global_batch", 8),
+            abstract_state=abstract_state, cost_model=model,
+            compute=MEASURED, **kw,
+        )
+
+    def test_pp_needs_explicit_opt_in(self, abstract_state):
+        # same discipline as tp: no pp_candidates/max_pp -> the search
+        # space stays unpipelined
+        plan = autoplan.plan(
+            profile=self.PROFILE, global_batch=8,
+            abstract_state=abstract_state,
+            cost_model=hand_model(1e-9, 1e-9), compute=MEASURED,
+            strategies=("dp",), max_tp=1, n_devices=2,
+            budget_bytes=None,
+        )
+        assert [c.name for c in plan.candidates] == ["dp/dp2"]
+
+    def test_pp_enumeration_dp_only_no_q8_no_duplicates(self):
+        cands = autoplan.enumerate_candidates(
+            8, max_pp=8, include_q8=True
+        )
+        names = [c.name for c in cands]
+        assert len(names) == len(set(names))
+        pp = [c for c in cands if c.pp > 1]
+        assert pp, names
+        assert all(c.strategy == "dp" and c.compress is None for c in pp)
+        # pp == 1 rows are EXACTLY the unpipelined enumeration — the pp
+        # dimension never re-emits a renamed duplicate of dp/dpN
+        base = [c.name for c in autoplan.enumerate_candidates(
+            8, max_pp=1, include_q8=True)]
+        assert [c.name for c in cands if c.pp == 1] == base
+        # and the mesh shape carries the pp axis
+        two = next(c for c in pp if c.pp == 2 and c.data == 4)
+        assert two.mesh_spec().pp == 2
+        assert two.n_devices == 8
+
+    def test_pp_bubble_and_links_hand_computed(self, abstract_state):
+        m = hand_model(1e-9, 1e-9, p2p_beta=1e-9)
+        plan = self.pp_plan(abstract_state, m)
+        by = {c.name: c for c in plan.candidates}
+        pp2 = by["dp/dp1xpp2"]
+        assert pp2.feasible
+        # S=2, M=max(accum 1, 2*pp)=4, per-dev batch 8 -> microbatch 2.
+        # compute: the slowest stage's 2/4 layer share of
+        # 8 samples x 1e9 flops at the 1e9 flops/s measured rate = 4 s
+        assert pp2.compute_seconds == pytest.approx(4.0, rel=1e-9)
+        # bubble: slowest_stage x (S-1)/M = 4.0 / 4 = 1 s, and the
+        # analytic fraction is (S-1)/(M+S-1)
+        assert pp2.bubble_seconds == pytest.approx(1.0, rel=1e-9)
+        assert pp2.pipeline["bubble_fraction"] == \
+            pytest.approx(1 / 5, rel=1e-9)
+        # links: one act + one grad slab per microbatch per boundary =
+        # 2 x M x (S-1) = 8 transfers of microbatch x seq x hidden x 4
+        # = 2*16*64*4 = 8192 bytes at the world-2 send fit
+        slab = 2 * 16 * 64 * 4
+        want_links = 8 * m.predict("send", slab, 2).seconds
+        assert pp2.pipeline["link_seconds"] == \
+            pytest.approx(want_links, rel=1e-9)
+        assert not pp2.extrapolated  # the send fit priced it, no guess
+        # the step price carries the bubble ON the critical path
+        assert pp2.step_seconds == pytest.approx(
+            pp2.comm_seconds + 4.0 + 1.0, rel=1e-9
+        )
+        # data=1 inside each stage: NO grad exchange — the handoff
+        # link is the candidate's whole comm bill
+        assert [t.op for t in pp2.comm_terms] == ["send"]
+        assert pp2.comm_seconds == pytest.approx(want_links, rel=1e-9)
+        # the losing pipeline row names its OWN price
+        assert pp2.why_not and "bubble" in pp2.why_not \
+            and "links" in pp2.why_not
+
+    def test_pp_even_split_matches_flat_compute(self, abstract_state):
+        # homogeneous even depths reproduce the flat flops/n term
+        # exactly: pp "costs" only the bubble and the links
+        m = hand_model(1e-9, 1e-9, p2p_beta=1e-9)
+        plan = self.pp_plan(abstract_state, m)
+        by = {c.name: c for c in plan.candidates}
+        assert by["dp/dp1xpp2"].compute_seconds == \
+            pytest.approx(by["dp/dp2"].compute_seconds, rel=1e-9)
+        assert by["dp/dp1xpp2"].pipeline["stage_depths"] == [2, 2]
+
+    def test_pp_hetero_depths_pin(self, abstract_state):
+        # 8 layers over 2 stages at rates [1.0, 0.5]: the balancer's
+        # apportionment gives the slow stage the SHALLOWER split — the
+        # hand-computed (5, 3), the same depths
+        # pipeline_schedule.stage_depths hands the executor
+        prof = dataclasses.replace(self.PROFILE, layers=8)
+        m = hand_model(1e-9, 1e-9, p2p_beta=1e-9)
+        plan = self.pp_plan(abstract_state, m, profile=prof,
+                            rank_rates=[1.0, 0.5])
+        pp2 = next(c for c in plan.candidates if c.spec.pp == 2)
+        assert pp2.feasible
+        assert pp2.pipeline["stage_depths"] == [5, 3]
+        # priced at the split it would BUILD: slowest stage is the slow
+        # one, 3/8 of 8e9 flops at 0.5e9 flops/s = 6 s
+        assert pp2.compute_seconds == pytest.approx(6.0, rel=1e-9)
+
+    def test_pp_infeasibility_reasons(self, abstract_state):
+        m = hand_model(1e-9, 1e-9, p2p_beta=1e-9)
+        # layers that cannot fill the stages: 4 devices pp=4 over a
+        # 2-layer model (floor=1 layer per stage)
+        prof = dataclasses.replace(self.PROFILE, layers=2)
+        plan = self.pp_plan(
+            abstract_state,
+            hand_model(1e-9, 1e-9, worlds=(2, 4), p2p_beta=1e-9),
+            profile=prof, n_devices=4, max_pp=4, global_batch=16,
+        )
+        pp4 = next(c for c in plan.candidates if c.spec.pp == 4)
+        assert not pp4.feasible
+        assert "cannot fill" in pp4.reason or "divide" in pp4.reason
+        # batch that cannot split into the microbatch count: M=4 needs
+        # per-device batch % 4 == 0
+        plan2 = self.pp_plan(abstract_state, m, global_batch=6)
+        pp2 = next(c for c in plan2.candidates if c.spec.pp == 2)
+        assert not pp2.feasible and "microbatch" in pp2.reason
+
+    def test_pp_plan_json_schema(self, abstract_state, tmp_path):
+        m = hand_model(1e-9, 1e-9, p2p_beta=1e-9)
+        plan = self.pp_plan(abstract_state, m)
+        doc = json.load(open(plan.save(str(tmp_path / "plan.json"))))
+        pp2 = next(c for c in doc["candidates"]
+                   if c["name"] == "dp/dp1xpp2")
+        pl = pp2["pipeline"]
+        assert set(pl) == {"pp", "num_microbatches", "bubble_fraction",
+                           "bubble_seconds", "link_seconds",
+                           "stage_depths"}
+        assert pl["pp"] == 2 and pl["num_microbatches"] == 4
+        assert pp2["mesh"]["pp"] == 2
+        # unpipelined rows carry no pipeline key (no schema noise)
+        dp = next(c for c in doc["candidates"] if c["name"] == "dp/dp2")
+        assert "pipeline" not in dp
+        # microbatch override flows through
+        plan8 = self.pp_plan(abstract_state, m, pp_microbatches=8,
+                             global_batch=16)
+        pp2b = next(c for c in plan8.candidates if c.spec.pp == 2)
+        assert pp2b.pipeline["num_microbatches"] == 8
+
+    @pytest.mark.slow
+    def test_strategy_auto_ranks_pp_end_to_end(self, tmp_path):
+        """``--strategy auto --pp 2`` on a 2-device CPU mesh: the
+        recipe opens the pipeline dimension, the plan ranks the
+        dp x pp space, the pp row carries its pipeline audit record,
+        and the run trains with the chosen strategy."""
+        plan_path = str(tmp_path / "plan.json")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PALLAS_AXON_POOL_IPS="")
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "recipes", "gpt2_zero1.py"),
+             "--strategy", "auto", "--pp", "2", "--size", "tiny",
+             "--epochs", "1", "--steps-per-epoch", "2",
+             "--batch-size", "8", "--seq-len", "32",
+             "--accum-steps", "1", "--log-every", "1",
+             "--plan-path", plan_path,
+             "--costmodel", str(tmp_path / "absent.json")],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=600,
+        )
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        doc = json.load(open(plan_path))
+        names = [c["name"] for c in doc["candidates"]]
+        assert "dp/dp1xpp2" in names, names
+        pp2 = next(c for c in doc["candidates"]
+                   if c["name"] == "dp/dp1xpp2")
+        assert pp2["pipeline"]["pp"] == 2
+        assert pp2["pipeline"]["stage_depths"]
+        # wherever it ranked, the pipeline row's verdict is priced:
+        # either it won or its why_not names the bubble/link price
+        assert pp2["feasible"]
+        if doc["chosen"] != "dp/dp1xpp2" and pp2["rank"] is not None:
+            assert "bubble" in pp2["why_not"]
+        assert "auto strategy:" in proc.stdout + proc.stderr

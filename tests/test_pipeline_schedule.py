@@ -1,0 +1,535 @@
+"""Host-dispatched 1F1B pipeline parallelism (marker: pp).
+
+Three layers:
+
+* the pure schedule math — hand-pinned 1F1B/GPipe tick tables, the
+  warm-up formula, in-flight peaks (the memory claim ``min(S - s, M)``
+  vs GPipe's ``M``), the analytic bubble ``(S-1)/(V*M + S-1)``, the
+  interleaved virtual-stage mapping, and ``simulate_links`` replaying
+  every schedule against the shm transport's one-slot mailbox model
+  (deadlock freedom AND tag order, statically);
+* the executor — the S == 1 ``HostPipelineStep`` against an inline dp
+  scan-fold reference (same association: numpy left fold in microbatch
+  order == ``lax.scan``), compile counts pinned at one program each;
+* the ring — the 2-proc S == 2 1F1B run on the real hostring matches
+  the solo executor (losses and merged params), and a deliberately
+  desynced activation handoff trips the DETAIL fingerprint handshake
+  on BOTH ends instead of delivering the wrong microbatch.
+
+The performance half — 1F1B vs the SPMD GPipe's garbage-tick compute,
+the measured-vs-analytic bubble, the exposed-link ratio — lives in
+bench.py's ``pipeline`` phase (pinned by test_bench_contract); the
+stage-death autopsy drill in ``scripts/chaos_drill.py --drill
+pipeline``.
+"""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.parallel import pipeline_schedule as ps
+from pytorch_distributed_tpu.parallel.pipeline_schedule import (
+    BWD,
+    FWD,
+    RECV_ACT,
+    RECV_GRAD,
+    SEND_ACT,
+    SEND_GRAD,
+    ScheduleDeadlock,
+    StageOp,
+    bubble_fraction,
+    peak_live_microbatches,
+    schedule_1f1b,
+    schedule_gpipe,
+    schedule_interleaved,
+    simulate_links,
+    stage_depths,
+    stage_layer_slices,
+    virtual_stage,
+)
+from pytorch_distributed_tpu.runtime import faults
+
+from tests import pipeline_workers
+
+pytestmark = pytest.mark.pp
+
+
+def _skeleton(program):
+    return [(op.kind, op.mb) for op in program if op.kind in (FWD, BWD)]
+
+
+# -- hand-pinned tick tables ------------------------------------------------
+
+def test_1f1b_s2_m4_hand_table():
+    # stage 0: one warm-up fwd, steady (F,B) x3, one cool-down bwd
+    assert _skeleton(schedule_1f1b(0, 2, 4)) == [
+        (FWD, 0), (FWD, 1), (BWD, 0), (FWD, 2), (BWD, 1), (FWD, 3),
+        (BWD, 2), (BWD, 3),
+    ]
+    # stage 1 (last): no warm-up — strict 1F1B from the first microbatch
+    assert _skeleton(schedule_1f1b(1, 2, 4)) == [
+        (FWD, 0), (BWD, 0), (FWD, 1), (BWD, 1), (FWD, 2), (BWD, 2),
+        (FWD, 3), (BWD, 3),
+    ]
+
+
+def test_1f1b_s2_m4_full_op_lists():
+    assert schedule_1f1b(0, 2, 4) == (
+        StageOp(FWD, 0), StageOp(SEND_ACT, 0),
+        StageOp(FWD, 1), StageOp(SEND_ACT, 1),
+        StageOp(RECV_GRAD, 0), StageOp(BWD, 0),
+        StageOp(FWD, 2), StageOp(SEND_ACT, 2),
+        StageOp(RECV_GRAD, 1), StageOp(BWD, 1),
+        StageOp(FWD, 3), StageOp(SEND_ACT, 3),
+        StageOp(RECV_GRAD, 2), StageOp(BWD, 2),
+        StageOp(RECV_GRAD, 3), StageOp(BWD, 3),
+    )
+    assert schedule_1f1b(1, 2, 4) == (
+        StageOp(RECV_ACT, 0), StageOp(FWD, 0),
+        StageOp(BWD, 0), StageOp(SEND_GRAD, 0),
+        StageOp(RECV_ACT, 1), StageOp(FWD, 1),
+        StageOp(BWD, 1), StageOp(SEND_GRAD, 1),
+        StageOp(RECV_ACT, 2), StageOp(FWD, 2),
+        StageOp(BWD, 2), StageOp(SEND_GRAD, 2),
+        StageOp(RECV_ACT, 3), StageOp(FWD, 3),
+        StageOp(BWD, 3), StageOp(SEND_GRAD, 3),
+    )
+
+
+def test_1f1b_s3_m3_middle_stage_table():
+    assert _skeleton(schedule_1f1b(1, 3, 3)) == [
+        (FWD, 0), (FWD, 1), (BWD, 0), (FWD, 2), (BWD, 1), (BWD, 2),
+    ]
+
+
+def test_gpipe_hand_table():
+    assert _skeleton(schedule_gpipe(0, 2, 3)) == [
+        (FWD, 0), (FWD, 1), (FWD, 2), (BWD, 0), (BWD, 1), (BWD, 2),
+    ]
+
+
+# -- structural properties over an (S, M) grid ------------------------------
+
+GRID = [(s, S, M) for S in (1, 2, 3, 4) for s in range(S)
+        for M in (1, 2, 3, 4, 8)]
+
+
+def test_schedule_is_pure_function_of_args():
+    for s, S, M in GRID:
+        assert schedule_1f1b(s, S, M) == schedule_1f1b(s, S, M)
+        assert schedule_gpipe(s, S, M) == schedule_gpipe(s, S, M)
+
+
+@pytest.mark.parametrize("maker", [schedule_1f1b, schedule_gpipe])
+def test_every_microbatch_exactly_once_per_kind(maker):
+    for s, S, M in GRID:
+        program = maker(s, S, M)
+        for kind in (FWD, BWD):
+            mbs = sorted(op.mb for op in program if op.kind == kind)
+            assert mbs == list(range(M)), (s, S, M, kind)
+
+
+def test_1f1b_warmup_formula():
+    for s, S, M in GRID:
+        sk = _skeleton(schedule_1f1b(s, S, M))
+        lead = 0
+        for kind, _ in sk:
+            if kind != FWD:
+                break
+            lead += 1
+        # warm-up is min(S-1-stage, M) forwards; if any microbatches
+        # remain, the first steady-state forward also precedes bwd 0
+        warmup = min(S - 1 - s, M)
+        assert lead == (warmup + 1 if warmup < M else warmup), (s, S, M)
+
+
+def test_1f1b_backwards_complete_in_increasing_mb_order():
+    # the left-fold == lax.scan association argument needs this
+    for s, S, M in GRID:
+        order = [op.mb for op in schedule_1f1b(s, S, M) if op.kind == BWD]
+        assert order == sorted(order), (s, S, M)
+
+
+def test_peak_live_1f1b_is_min_S_minus_stage_M():
+    for s, S, M in GRID:
+        assert peak_live_microbatches(
+            schedule_1f1b(s, S, M)
+        ) == min(S - s, M), (s, S, M)
+
+
+def test_peak_live_gpipe_is_M():
+    for s, S, M in GRID:
+        assert peak_live_microbatches(schedule_gpipe(s, S, M)) == M
+
+
+# -- the analytic bubble ----------------------------------------------------
+
+def test_bubble_fraction_values():
+    assert bubble_fraction(1, 4) == 0.0
+    assert bubble_fraction(2, 4) == pytest.approx(0.2)
+    assert bubble_fraction(4, 8) == pytest.approx(3 / 11)
+    # interleaving with V chunks divides the bubble's share of the path
+    assert bubble_fraction(4, 8, 2) == pytest.approx(3 / 19)
+    assert bubble_fraction(2, 4, 4) < bubble_fraction(2, 4)
+
+
+def test_bubble_fraction_rejects_degenerate_shapes():
+    with pytest.raises(ValueError):
+        bubble_fraction(0, 4)
+    with pytest.raises(ValueError):
+        bubble_fraction(2, 0)
+
+
+# -- channel-model replay: deadlock freedom + tag order ---------------------
+
+@pytest.mark.parametrize("maker", [schedule_1f1b, schedule_gpipe])
+def test_schedules_drain_one_slot_mailboxes(maker):
+    for S in (1, 2, 3, 4):
+        for M in (1, 2, 4, 8):
+            programs = [maker(s, S, M) for s in range(S)]
+            assert simulate_links(programs, capacity=1) > 0, (S, M)
+
+
+def test_simulate_links_raises_on_circular_wait():
+    # both stages lead with a receive: nobody ever sends
+    programs = [
+        (StageOp(RECV_GRAD, 0), StageOp(BWD, 0)),
+        (StageOp(RECV_ACT, 0), StageOp(FWD, 0)),
+    ]
+    with pytest.raises(ScheduleDeadlock):
+        simulate_links(programs)
+
+
+def test_simulate_links_raises_on_tag_reorder():
+    # sender ships m0 then m1; receiver wants m1 first — the static form
+    # of the DETAIL fingerprint mismatch
+    programs = [
+        (StageOp(FWD, 0), StageOp(SEND_ACT, 0),
+         StageOp(FWD, 1), StageOp(SEND_ACT, 1)),
+        (StageOp(RECV_ACT, 1), StageOp(FWD, 1),
+         StageOp(RECV_ACT, 0), StageOp(FWD, 0)),
+    ]
+    with pytest.raises(ValueError, match="fingerprint"):
+        simulate_links(programs)
+
+
+# -- interleaved virtual stages ---------------------------------------------
+
+def test_virtual_stage_mapping():
+    # consecutive global stages land on consecutive ranks
+    world = 2
+    assert [virtual_stage(r, c, world)
+            for c in range(2) for r in range(world)] == [0, 1, 2, 3]
+
+
+def test_interleaved_each_chunk_mb_once_per_kind():
+    for world, V, M in [(2, 2, 2), (2, 2, 4), (2, 3, 4), (4, 2, 4),
+                        (3, 2, 6)]:
+        for rank in range(world):
+            program = schedule_interleaved(rank, world, V, M)
+            for kind in (FWD, BWD):
+                seen = sorted(
+                    (op.chunk, op.mb) for op in program if op.kind == kind
+                )
+                assert seen == sorted(
+                    (c, m) for c in range(V) for m in range(M)
+                ), (world, V, M, rank, kind)
+
+
+def test_interleaved_global_drain_respects_dependencies():
+    # replay all ranks' programs against the data dependencies: fwd of
+    # global stage g needs fwd of g-1 on the same microbatch; bwd of g
+    # needs its own fwd plus bwd of g+1. A drain proves the warm-up
+    # depth keeps every chunk fed.
+    for world, V, M in [(2, 2, 2), (2, 2, 4), (4, 2, 4)]:
+        S = world * V
+        programs = [list(schedule_interleaved(r, world, V, M))
+                    for r in range(world)]
+        pcs = [0] * world
+        done = set()
+        while any(pc < len(programs[r]) for r, pc in enumerate(pcs)):
+            progressed = False
+            for r in range(world):
+                while pcs[r] < len(programs[r]):
+                    op = programs[r][pcs[r]]
+                    g = virtual_stage(r, op.chunk, world)
+                    if op.kind == FWD:
+                        ready = g == 0 or (FWD, g - 1, op.mb) in done
+                    else:
+                        ready = (FWD, g, op.mb) in done and (
+                            g == S - 1 or (BWD, g + 1, op.mb) in done
+                        )
+                    if not ready:
+                        break
+                    done.add((op.kind, g, op.mb))
+                    pcs[r] += 1
+                    progressed = True
+            assert progressed, (world, V, M, pcs)
+
+
+def test_interleaved_rejects_bad_shapes():
+    with pytest.raises(ValueError, match="num_chunks >= 2"):
+        schedule_interleaved(0, 2, 1, 4)
+    with pytest.raises(ValueError, match="divisible"):
+        schedule_interleaved(0, 2, 2, 3)
+
+
+# -- layer apportionment ----------------------------------------------------
+
+def test_stage_depths_even_split():
+    assert stage_depths(8, 2) == (4, 4)
+    assert stage_depths(12, 4) == (3, 3, 3, 3)
+
+
+def test_stage_depths_hetero_gives_slow_rank_shallower_stage():
+    # the hand-computed hetero pin: 8 layers, rank 1 at half speed
+    assert stage_depths(8, 2, rank_rates=[1.0, 0.5]) == (5, 3)
+
+
+def test_stage_depths_refuses_uneven_without_rates():
+    with pytest.raises(ValueError, match="rank_rates"):
+        stage_depths(7, 2)
+
+
+def test_stage_depths_refuses_more_stages_than_layers():
+    with pytest.raises(ValueError, match="cannot fill"):
+        stage_depths(2, 4)
+
+
+def test_stage_layer_slices():
+    assert stage_layer_slices((5, 3)) == ((0, 5), (5, 8))
+    assert stage_layer_slices((4, 4)) == ((0, 4), (4, 8))
+
+
+# -- the stage_stall fault site ---------------------------------------------
+
+def test_stage_stall_site_registered():
+    assert "pipeline.stage_stall" in faults.KNOWN_SITES
+
+
+def test_stage_stall_match_selects_exact_op():
+    with faults.injected(
+        "pipeline.stage_stall:mode=stall,seconds=0.5,match=s1.bwd.m2"
+    ):
+        assert faults.hang_action(
+            "pipeline.stage_stall", "s1.bwd.m2"
+        ) == ("stall", 0.5)
+        assert faults.hang_action(
+            "pipeline.stage_stall", "s0.fwd.m0"
+        ) is None
+        # stall sites never corrupt through check()
+        faults.check("pipeline.stage_stall", "s1.bwd.m2")
+
+
+# -- the executor: S == 1 vs an inline dp reference -------------------------
+
+def _dp_reference(cfg, steps, batch, seq, M, seed, lr):
+    """The dp baseline the pipeline must match: lax.scan fold over the
+    same microbatch split, 1/M inside jit, sgd — the trainer's scanned
+    accumulation shape with the same CE loss."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from pytorch_distributed_tpu.models.gpt2 import GPT2LMHead
+
+    model = GPT2LMHead(cfg)
+    variables = model.init(
+        jax.random.key(seed), jnp.zeros((1, seq), jnp.int32)
+    )
+    params = variables["params"]
+    tx = optax.sgd(lr)
+    opt = tx.init(params)
+
+    def loss_fn(p, ids):
+        logits = model.apply({"params": p}, ids)
+        shift = logits[:, :-1].astype(jnp.float32)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            shift, ids[:, 1:]
+        ).mean()
+
+    @jax.jit
+    def step(p, o, ids_mbs):
+        def body(acc, ids):
+            loss, g = jax.value_and_grad(loss_fn)(p, ids)
+            return jax.tree_util.tree_map(jnp.add, acc, g), loss
+
+        zero = jax.tree_util.tree_map(
+            lambda a: jnp.zeros_like(a), p
+        )
+        gsum, mb_losses = jax.lax.scan(body, zero, ids_mbs)
+        g = jax.tree_util.tree_map(lambda a: a / M, gsum)
+        updates, o2 = tx.update(g, o, p)
+        return optax.apply_updates(p, updates), o2, mb_losses.mean()
+
+    losses = []
+    for b in pipeline_workers.make_batches(
+        steps, batch, seq, cfg.vocab_size, seed + 1
+    ):
+        ids = np.stack(np.split(b["input_ids"], M, axis=0))
+        params, opt, loss = step(params, opt, ids)
+        losses.append(float(loss))
+    return jax.tree_util.tree_map(np.asarray, params), losses
+
+
+def test_solo_executor_matches_dp_reference():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from pytorch_distributed_tpu.models.gpt2 import GPT2LMHead
+    from pytorch_distributed_tpu.parallel.pipeline_lm import (
+        GPT2HostStagePrograms,
+        host_stage_params,
+    )
+    from pytorch_distributed_tpu.parallel.pipeline_schedule import (
+        HostPipelineStep,
+    )
+
+    opts = {"layers": 2, "hidden": 16, "vocab": 64, "n_positions": 16}
+    steps, batch, seq, M, seed, lr = 2, 4, 8, 2, 0, 0.1
+    cfg = pipeline_workers._tiny_cfg(opts)
+    ref_params, ref_losses = _dp_reference(
+        cfg, steps, batch, seq, M, seed, lr
+    )
+
+    model = GPT2LMHead(cfg)
+    variables = model.init(
+        jax.random.key(seed), jnp.zeros((1, seq), jnp.int32)
+    )
+    tx = optax.sgd(lr)
+    host = HostPipelineStep(
+        GPT2HostStagePrograms(cfg, stage=0, num_stages=1),
+        stage=0, num_stages=1, num_microbatches=M, tx=tx,
+    )
+    params, _buffers = host_stage_params(
+        variables["params"], stage=0, num_stages=1
+    )
+    opt = host.init_opt_state(params)
+    losses = []
+    for b in pipeline_workers.make_batches(
+        steps, batch, seq, cfg.vocab_size, seed + 1
+    ):
+        params, opt, met = host.step(params, opt, b)
+        losses.append(met["loss"])
+
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-6)
+    flat_ref = jax.tree_util.tree_leaves(ref_params)
+    flat = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(np.asarray, params)
+    )
+    for a, b in zip(flat_ref, flat):
+        # documented last-ulp class: regrouped f32 sums
+        np.testing.assert_allclose(a, b, atol=5e-6, rtol=1e-5)
+    # the HostLoopStep compile discipline: one program each, re-stepping
+    # the same shape compiles nothing new
+    assert host.compile_counts() == {"apply": 1, "loss_grad": 1}
+
+
+# -- the real ring: 2-proc parity and the fingerprint handshake -------------
+
+SMALL = {
+    "layers": 2, "hidden": 16, "vocab": 64, "n_positions": 16,
+    "steps": 2, "batch": 4, "seq": 8, "microbatches": 2, "seed": 0,
+}
+
+
+def test_two_stage_1f1b_ring_matches_solo():
+    from pytorch_distributed_tpu.parallel.pipeline_lm import (
+        host_merge_stage_params,
+        host_stage_depths,
+    )
+
+    r1 = pipeline_workers.run_pipeline_world(
+        1, pipeline_workers.pipeline_train_worker, (SMALL,), timeout=240
+    )
+    r2 = pipeline_workers.run_pipeline_world(
+        2, pipeline_workers.pipeline_train_worker, (SMALL,), timeout=240
+    )
+    for rank, rep in r1 + r2:
+        assert "error" not in rep, (rank, rep.get("error"))
+    solo, (s0, s1) = r1[0][1], (r2[0][1], r2[1][1])
+
+    # the last stage reports the loss stream; solo == pipelined
+    np.testing.assert_allclose(s1["losses"], solo["losses"], rtol=1e-6)
+    # compile counts: one fwd + one bwd (stage 0), fused loss_grad (last)
+    assert s0["compile_counts"] == {"apply": 1, "bwd": 1, "fwd": 1}
+    assert s1["compile_counts"] == {"apply": 1, "loss_grad": 1}
+
+    depths = host_stage_depths(SMALL["layers"], 2)
+    merged = host_merge_stage_params(
+        [s0["stage_params"], s1["stage_params"]], depths
+    )
+    ref = host_merge_stage_params(
+        [solo["stage_params"]], host_stage_depths(SMALL["layers"], 1)
+    )
+    import jax
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ref), jax.tree_util.tree_leaves(merged)
+    ):
+        np.testing.assert_allclose(a, b, atol=5e-6, rtol=1e-5)
+
+
+def test_handoff_mismatch_raises_on_both_ends():
+    results = pipeline_workers.run_pipeline_world(
+        2, pipeline_workers.pipeline_mismatch_worker, (), timeout=120
+    )
+    for rank, rep in results:
+        assert "error" not in rep, (rank, rep.get("error"))
+        assert rep["mismatch_error"] is not None, rank
+        assert "P2P mismatch" in rep["mismatch_error"], rep
+
+
+@pytest.mark.slow
+def test_two_stage_gpipe_matches_1f1b():
+    opts = dict(SMALL, schedule="gpipe")
+    g = pipeline_workers.run_pipeline_world(
+        2, pipeline_workers.pipeline_train_worker, (opts,), timeout=240
+    )
+    f = pipeline_workers.run_pipeline_world(
+        2, pipeline_workers.pipeline_train_worker, (SMALL,), timeout=240
+    )
+    for rank, rep in g + f:
+        assert "error" not in rep, (rank, rep.get("error"))
+    # same math, different issue order: backwards still fold in mb order,
+    # so GPipe and 1F1B land bit-identical params
+    assert [rep["crc"] for _, rep in g] == [rep["crc"] for _, rep in f]
+
+
+@pytest.mark.slow
+def test_two_stage_hetero_depths_parity():
+    # uneven stage depths (the hetero apportionment shape) change only
+    # WHERE layers run, not the update math
+    opts = dict(SMALL, layers=3, depths=(2, 1))
+    r1 = pipeline_workers.run_pipeline_world(
+        1, pipeline_workers.pipeline_train_worker,
+        (dict(opts, depths=(3,)),), timeout=240
+    )
+    r2 = pipeline_workers.run_pipeline_world(
+        2, pipeline_workers.pipeline_train_worker, (opts,), timeout=240
+    )
+    for rank, rep in r1 + r2:
+        assert "error" not in rep, (rank, rep.get("error"))
+    np.testing.assert_allclose(
+        r2[1][1]["losses"], r1[0][1]["losses"], rtol=1e-6
+    )
+
+
+@pytest.mark.slow
+def test_stage_death_leaves_survivor_dump(tmp_path):
+    # the --drill pipeline shape at test scale: the last stage dies
+    # mid-schedule (mode=kill), the survivor blocks at the ring deadline
+    # and dumps its flight ring for the autopsy
+    out = str(tmp_path)
+    results = pipeline_workers.run_pipeline_world(
+        2, pipeline_workers.pipeline_drill_worker,
+        (out, 1, "pipeline.stage_stall:mode=kill,match=s1.bwd.m1"),
+        timeout=240, expect=1,
+    )
+    by_rank = dict(results)
+    # rank 1 was SIGKILLed via os._exit — only rank 0 reports
+    assert len(by_rank) >= 1 and 0 in by_rank, results
+    rep = by_rank[0]
+    assert rep.get("role") == "survivor", rep
+    assert rep["dumped"], rep
+    assert "last completed flight" in rep["err"], rep["err"]
